@@ -1,0 +1,29 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus framework.
+
+A from-scratch rebuild of the capabilities of Lighthouse (the Rust consensus
+client, see /root/reference) designed TPU-first:
+
+- The data plane — BLS12-381 batch signature verification, SSZ/SHA-256
+  merkleization, KZG blob-proof batches, and vectorized epoch processing —
+  runs as JAX/XLA programs (jnp + pallas) over batched lanes.
+- The control plane — fork choice, chain orchestration, work scheduling,
+  stores, APIs — is host-side Python/C++ built around a beacon-processor
+  style batching queue that accumulates device-sized batches.
+
+The architectural seams mirror the reference's (crypto backend trait,
+pluggable tree-hash hasher, batching work queue) without porting its code.
+
+Layout:
+    ops/               JAX/Pallas device kernels (sha256, bls field/curve, kzg)
+    crypto/            BLS & KZG backend registry (reference / fake / tpu)
+    ssz/               SSZ types, serialization, hash_tree_root
+    types/             Consensus containers (multi-fork), ChainSpec
+    state_transition/  per-slot / per-block / per-epoch pure transition
+    fork_choice/       proto-array LMD-GHOST
+    processor/         priority batching work queue
+    parallel/          mesh/sharding helpers for multi-chip scaling
+    models/            end-to-end assembled pipelines ("the beacon node core")
+    utils/             misc (hex, clock, metrics)
+"""
+
+__version__ = "0.1.0"

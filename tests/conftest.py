@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Force an 8-device virtual CPU platform BEFORE jax initializes so that all
+sharding/mesh tests exercise real multi-device paths without TPU hardware
+(mirrors how the reference tests multi-node behaviour in-process,
+/root/reference/testing/simulator).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
