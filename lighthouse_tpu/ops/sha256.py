@@ -1,0 +1,257 @@
+"""Batched SHA-256 for SSZ merkleization, as a JAX/XLA program.
+
+The reference client's #2 CPU cost is SHA-256 merkleization of the beacon
+state forest (reference: tree_hash `MerkleHasher` + ethereum_hashing's
+CPU-vectorized SHA-256; consumed at
+/root/reference/consensus/types/src/beacon_state.rs:2031
+``update_tree_hash_cache``).  Here the hasher is a data-parallel device
+program: every (left, right) node pair in a tree level is one lane of a
+batched 64-round compression, so a level with N pairs is two fused
+compression sweeps over a ``uint32[N, 16]`` tensor — int32 VPU work that
+vectorizes across the whole level at once.
+
+Design notes (TPU-first):
+- All arithmetic is uint32 (wrapping adds, shifts, xors) — no 64-bit needed,
+  so the same program runs identically on TPU and the CPU test platform.
+- The 64-byte merkle node message is exactly one message block; the second
+  (padding) block is a compile-time constant, so its message schedule is
+  precomputed host-side once (``_PAD_W``) and only the 64 round updates run
+  for it on device.
+- Message-schedule extension and the round function are `lax.scan`s: traced
+  once, compiled once, batch-vectorized by XLA.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 round constants.
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _py_rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & 0xFFFFFFFF
+
+
+def _np_schedule(block: np.ndarray) -> np.ndarray:
+    """Host-side message-schedule expansion (for the constant padding block)."""
+    w = [int(v) for v in block]
+    for t in range(16, 64):
+        s0 = _py_rotr(w[t - 15], 7) ^ _py_rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
+        s1 = _py_rotr(w[t - 2], 17) ^ _py_rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
+        w.append((w[t - 16] + s0 + w[t - 7] + s1) & 0xFFFFFFFF)
+    return np.array(w, dtype=np.uint32)
+
+
+# Padding block for a message of exactly 64 bytes: 0x80 then zeros, bit length
+# 512 in the final 64-bit field.  Its schedule is message-independent.
+_PAD_BLOCK = np.zeros(16, dtype=np.uint32)
+_PAD_BLOCK[0] = 0x80000000
+_PAD_BLOCK[15] = 512
+_PAD_W = _np_schedule(_PAD_BLOCK)  # uint32[64]
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _expand_schedule(block: jax.Array) -> jax.Array:
+    """block: uint32[..., 16] -> W: uint32[64, ...] (round axis leading)."""
+    window = jnp.moveaxis(block, -1, 0)  # [16, ...]
+
+    def step(win, _):
+        w15, w2 = win[1], win[14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        new = win[0] + s0 + win[9] + s1
+        return jnp.concatenate([win[1:], new[None]], axis=0), new
+
+    _, extra = jax.lax.scan(step, window, None, length=48)
+    return jnp.concatenate([window, extra], axis=0)
+
+
+def _rounds(state: jax.Array, w: jax.Array) -> jax.Array:
+    """Run 64 rounds.  state: uint32[..., 8]; w: uint32[64, ...]."""
+    kw = w + jnp.asarray(_K, dtype=jnp.uint32).reshape((64,) + (1,) * (w.ndim - 1))
+
+    def round_fn(carry, kw_t):
+        a, b, c, d, e, f, g, h = carry
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kw_t
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    out, _ = jax.lax.scan(round_fn, init, kw)
+    return state + jnp.stack(out, axis=-1)
+
+
+@jax.jit
+def sha256_block(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One compression: state uint32[...,8], block uint32[...,16] -> uint32[...,8]."""
+    return _rounds(state, _expand_schedule(block))
+
+
+@jax.jit
+def hash_pairs_device(pairs: jax.Array) -> jax.Array:
+    """SHA-256 of N 64-byte messages given as big-endian words.
+
+    pairs: uint32[N, 16] (each row = left||right node) -> uint32[N, 8].
+    This is the merkle work-horse: compress the data block, then apply the
+    constant-schedule padding block.
+    """
+    h0 = jnp.broadcast_to(jnp.asarray(_H0, jnp.uint32), pairs.shape[:-1] + (8,))
+    mid = _rounds(h0, _expand_schedule(pairs))
+    pad_w = jnp.asarray(_PAD_W, jnp.uint32).reshape((64,) + (1,) * (pairs.ndim - 1))
+    pad_w = jnp.broadcast_to(pad_w, (64,) + pairs.shape[:-1])
+    return _rounds(mid, pad_w)
+
+
+def hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
+    """hashlib fallback with identical semantics (uint32[N,16] -> uint32[N,8])."""
+    out = np.empty((pairs.shape[0], 8), dtype=np.uint32)
+    data = pairs.astype(">u4").tobytes()
+    for i in range(pairs.shape[0]):
+        out[i] = np.frombuffer(
+            hashlib.sha256(data[64 * i: 64 * (i + 1)]).digest(), dtype=">u4"
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Byte <-> word helpers (SSZ chunks are 32-byte little-endian-agnostic blobs;
+# SHA-256 words are big-endian).
+# --------------------------------------------------------------------------
+
+def chunks_to_words(data: bytes) -> np.ndarray:
+    """bytes (len % 32 == 0) -> uint32[n_chunks, 8] in SHA-256 word order."""
+    if len(data) % 32:
+        raise ValueError("chunk data must be a multiple of 32 bytes")
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 8)
+
+
+def words_to_bytes(words: np.ndarray) -> bytes:
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def _zero_hash_ladder(depth: int = 64) -> list[bytes]:
+    zh = [b"\x00" * 32]
+    for _ in range(depth):
+        zh.append(hashlib.sha256(zh[-1] + zh[-1]).digest())
+    return zh
+
+
+ZERO_HASHES: list[bytes] = _zero_hash_ladder()
+ZERO_HASH_WORDS: np.ndarray = np.stack(
+    [np.frombuffer(h, dtype=">u4").astype(np.uint32) for h in ZERO_HASHES]
+)
+
+
+# --------------------------------------------------------------------------
+# Merkleization
+# --------------------------------------------------------------------------
+
+# Below this many pairs a device round-trip costs more than hashlib; measured
+# on CPU this is conservative, tuned on TPU by bench.py.
+_DEVICE_MIN_PAIRS = 64
+
+
+def _hash_level(pairs: np.ndarray, *, device: bool | None = None) -> np.ndarray:
+    use_device = device if device is not None else pairs.shape[0] >= _DEVICE_MIN_PAIRS
+    if use_device:
+        # Pad the lane count to a power of two so the jit compile cache is
+        # bounded at ~log2(max_pairs) programs shared by every tree size
+        # (padded lanes hash garbage and are discarded).
+        n = pairs.shape[0]
+        padded = 1 << max(n - 1, 0).bit_length()
+        if padded != n:
+            pairs = np.concatenate(
+                [pairs, np.zeros((padded - n, 16), np.uint32)], axis=0
+            )
+        return np.asarray(hash_pairs_device(jnp.asarray(pairs)))[:n]
+    return hash_pairs_np(pairs)
+
+
+def merkleize_words(
+    leaves: np.ndarray, limit: int | None = None, *, device: bool | None = None
+) -> np.ndarray:
+    """SSZ merkleize: uint32[n, 8] leaf chunks -> uint32[8] root.
+
+    Pads the leaf count to the next power of two (or to ``limit``) with the
+    precomputed zero-subtree ladder, then folds level by level; each level is
+    one batched device sweep.  Mirrors tree_hash's ``merkleize_padded``
+    semantics (reference consumer: consensus/types tree-hash caches).
+    """
+    n = leaves.shape[0]
+    size = max(limit if limit is not None else n, 1)
+    depth = max(size - 1, 0).bit_length()
+    if limit is not None and n > limit:
+        raise ValueError(f"{n} leaves exceed limit {limit}")
+    if n == 0:
+        return ZERO_HASH_WORDS[depth].copy()
+
+    level = np.ascontiguousarray(leaves, dtype=np.uint32)
+    for d in range(depth):
+        if level.shape[0] % 2:
+            level = np.concatenate([level, ZERO_HASH_WORDS[d][None]], axis=0)
+        pairs = level.reshape(level.shape[0] // 2, 16)
+        level = _hash_level(pairs, device=device)
+        # Entirely-zero right subtrees above current data are folded lazily:
+        # once a single node remains we can combine with ladder constants.
+        if level.shape[0] == 1 and d + 1 < depth:
+            node = level[0]
+            for dd in range(d + 1, depth):
+                pair = np.concatenate([node, ZERO_HASH_WORDS[dd]])[None, :]
+                node = hash_pairs_np(pair)[0]
+            return node
+    return level[0]
+
+
+def merkleize(data: bytes, limit: int | None = None, *, device: bool | None = None) -> bytes:
+    """SSZ merkleize over packed 32-byte chunks -> 32-byte root."""
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    leaves = chunks_to_words(data) if data else np.zeros((0, 8), np.uint32)
+    return words_to_bytes(merkleize_words(leaves, limit, device=device))
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hashlib.sha256(root + length.to_bytes(32, "little")).digest()
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hashlib.sha256(root + selector.to_bytes(32, "little")).digest()
+
+
+def sha256(data: bytes) -> bytes:
+    """Host one-shot SHA-256 (control-plane use)."""
+    return hashlib.sha256(data).digest()

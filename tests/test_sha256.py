@@ -1,0 +1,69 @@
+"""SHA-256 kernel and merkleization correctness vs hashlib."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ops import sha256 as s
+
+
+def _ref_hash_pairs(pairs: np.ndarray) -> np.ndarray:
+    data = pairs.astype(">u4").tobytes()
+    return np.stack(
+        [
+            np.frombuffer(hashlib.sha256(data[64 * i: 64 * (i + 1)]).digest(), dtype=">u4")
+            for i in range(pairs.shape[0])
+        ]
+    ).astype(np.uint32)
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 64, 333])
+def test_hash_pairs_device_matches_hashlib(n):
+    rng = np.random.default_rng(n)
+    pairs = rng.integers(0, 2**32, size=(n, 16), dtype=np.uint32)
+    got = np.asarray(s.hash_pairs_device(pairs))
+    np.testing.assert_array_equal(got, _ref_hash_pairs(pairs))
+
+
+def test_hash_pairs_np_matches_device():
+    rng = np.random.default_rng(0)
+    pairs = rng.integers(0, 2**32, size=(17, 16), dtype=np.uint32)
+    np.testing.assert_array_equal(s.hash_pairs_np(pairs), np.asarray(s.hash_pairs_device(pairs)))
+
+
+def _naive_merkleize(chunks: list[bytes], limit=None) -> bytes:
+    n = len(chunks)
+    size = max(limit if limit is not None else n, 1)
+    depth = max(size - 1, 0).bit_length()
+    padded = 1 << depth
+    nodes = chunks + [b"\x00" * 32] * (padded - n)
+    while len(nodes) > 1:
+        nodes = [hashlib.sha256(nodes[i] + nodes[i + 1]).digest() for i in range(0, len(nodes), 2)]
+    return nodes[0]
+
+
+@pytest.mark.parametrize("n,limit", [(0, None), (1, None), (2, None), (3, None), (5, 8),
+                                     (1, 16), (100, 128), (0, 4), (8, 8), (33, None)])
+def test_merkleize_matches_naive(n, limit):
+    rng = np.random.default_rng(n + (limit or 0))
+    chunks = [rng.bytes(32) for _ in range(n)]
+    got = s.merkleize(b"".join(chunks), limit)
+    assert got == _naive_merkleize(chunks, limit)
+
+
+def test_merkleize_device_path_matches_naive():
+    rng = np.random.default_rng(7)
+    chunks = [rng.bytes(32) for _ in range(1000)]
+    got = s.merkleize(b"".join(chunks), device=True)
+    assert got == _naive_merkleize(chunks)
+
+
+def test_zero_hashes():
+    assert s.ZERO_HASHES[1] == hashlib.sha256(b"\x00" * 64).digest()
+    assert s.ZERO_HASHES[2] == hashlib.sha256(s.ZERO_HASHES[1] * 2).digest()
+
+
+def test_mix_in_length():
+    root = b"\x11" * 32
+    assert s.mix_in_length(root, 5) == hashlib.sha256(root + (5).to_bytes(32, "little")).digest()
