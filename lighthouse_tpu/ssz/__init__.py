@@ -1,0 +1,34 @@
+"""SSZ type system (serialization + merkleization)."""
+
+from lighthouse_tpu.ssz.core import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    SSZType,
+    Uint,
+    Vector,
+    boolean,
+    coerce_type,
+    hash_tree_root,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+
+__all__ = [
+    "Bitlist", "Bitvector", "ByteList", "ByteVector", "Bytes4", "Bytes20",
+    "Bytes32", "Bytes48", "Bytes96", "Container", "List", "SSZType", "Uint",
+    "Vector", "boolean", "coerce_type", "hash_tree_root", "uint8", "uint16",
+    "uint32", "uint64", "uint128", "uint256",
+]

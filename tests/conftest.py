@@ -1,16 +1,35 @@
-"""Test configuration.
+"""Test configuration: hermetic multi-device CPU JAX.
 
-Force an 8-device virtual CPU platform BEFORE jax initializes so that all
-sharding/mesh tests exercise real multi-device paths without TPU hardware
-(mirrors how the reference tests multi-node behaviour in-process,
+All tests run on an 8-virtual-device CPU platform so sharding/mesh code
+exercises real multi-device paths without TPU hardware (mirrors how the
+reference tests multi-node behaviour in-process,
 /root/reference/testing/simulator).
+
+The session environment registers an `axon` remote-TPU PJRT plugin via
+sitecustomize, which imports jax before conftest runs — so the JAX_PLATFORMS
+env var alone is frozen too early and the live config must be updated.  With
+``jax_platforms=cpu`` set via config.update, jax initializes only the CPU
+backend; popping the axon factory below is belt-and-braces so that even an
+accidental full-backend init (or a future config regression) can never touch
+the axon tunnel, whose remote-compile relay is single-client and slow.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    if isinstance(getattr(_xb, "_backend_factories", None), dict):
+        _xb._backend_factories.pop("axon", None)
+except Exception:  # private API may move across jax versions; best-effort only
+    pass

@@ -1,0 +1,9 @@
+"""Guard rails for the hermetic test platform itself."""
+
+import jax
+
+
+def test_eight_virtual_cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    assert all(d.platform == "cpu" for d in devs)

@@ -1,0 +1,203 @@
+"""SSZ serialization + hash_tree_root vs an independent naive reference."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import ssz
+
+
+# --- independent naive reference (recursive, hashlib-only) -----------------
+
+def _h(a, b):
+    return hashlib.sha256(a + b).digest()
+
+
+def _naive_merkleize(chunks, limit=None):
+    n = len(chunks)
+    size = max(limit if limit is not None else n, 1)
+    depth = max(size - 1, 0).bit_length()
+    nodes = list(chunks) + [b"\x00" * 32] * ((1 << depth) - n)
+    while len(nodes) > 1:
+        nodes = [_h(nodes[i], nodes[i + 1]) for i in range(0, len(nodes), 2)]
+    return nodes[0]
+
+
+def _mixin(root, n):
+    return _h(root, n.to_bytes(32, "little"))
+
+
+# --- fixtures ---------------------------------------------------------------
+
+class Checkpoint(ssz.Container):
+    epoch: ssz.uint64
+    root: ssz.Bytes32
+
+
+class Validator(ssz.Container):
+    pubkey: ssz.Bytes48
+    withdrawal_credentials: ssz.Bytes32
+    effective_balance: ssz.uint64
+    slashed: ssz.boolean
+    activation_eligibility_epoch: ssz.uint64
+    activation_epoch: ssz.uint64
+    exit_epoch: ssz.uint64
+    withdrawable_epoch: ssz.uint64
+
+
+class VarBlob(ssz.Container):
+    slot: ssz.uint64
+    data: ssz.ByteList(100)
+    tail: ssz.uint32
+
+
+def _mk_validator(i):
+    return Validator(
+        pubkey=bytes([i % 256]) * 48,
+        withdrawal_credentials=bytes([(i * 7) % 256]) * 32,
+        effective_balance=32_000_000_000 + i,
+        slashed=bool(i % 2),
+        activation_eligibility_epoch=i,
+        activation_epoch=i + 1,
+        exit_epoch=2**64 - 1,
+        withdrawable_epoch=2**64 - 1,
+    )
+
+
+# --- serialization ----------------------------------------------------------
+
+def test_uint_roundtrip():
+    assert ssz.uint64.serialize(258) == (258).to_bytes(8, "little")
+    assert ssz.uint64.deserialize(ssz.uint64.serialize(2**63)) == 2**63
+    with pytest.raises(ValueError):
+        ssz.uint64.deserialize(b"\x00" * 7)
+
+
+def test_checkpoint_roundtrip():
+    cp = Checkpoint(epoch=7, root=b"\xaa" * 32)
+    data = cp.serialize()
+    assert len(data) == 40
+    assert Checkpoint.deserialize(data) == cp
+
+
+def test_variable_container_roundtrip():
+    v = VarBlob(slot=9, data=b"hello world", tail=77)
+    data = v.serialize()
+    # fixed part: 8 (slot) + 4 (offset) + 4 (tail); body: 11
+    assert len(data) == 8 + 4 + 4 + 11
+    assert VarBlob.deserialize(data) == v
+
+
+def test_list_of_containers_roundtrip():
+    t = ssz.List(Checkpoint, 10)
+    vals = [Checkpoint(epoch=i, root=bytes([i]) * 32) for i in range(3)]
+    assert t.deserialize(t.serialize(vals)) == vals
+
+
+def test_list_of_variable_roundtrip():
+    t = ssz.List(VarBlob, 8)
+    vals = [VarBlob(slot=i, data=b"x" * i, tail=i) for i in range(4)]
+    assert t.deserialize(t.serialize(vals)) == vals
+
+
+def test_bitlist_roundtrip():
+    t = ssz.Bitlist(12)
+    for bits in ([], [True], [False] * 12, [True, False, True] * 4):
+        assert t.deserialize(t.serialize(bits)) == bits
+    with pytest.raises(ValueError):
+        t.serialize([True] * 13)
+    with pytest.raises(ValueError):
+        t.deserialize(b"")
+
+
+def test_bitvector_roundtrip():
+    t = ssz.Bitvector(10)
+    bits = [True, False] * 5
+    assert t.deserialize(t.serialize(bits)) == bits
+    with pytest.raises(ValueError):
+        t.deserialize(b"\xff\xff")  # padding bits set
+
+
+# --- hashing ----------------------------------------------------------------
+
+def test_uint64_root():
+    assert ssz.uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_checkpoint_root_vs_naive():
+    cp = Checkpoint(epoch=3, root=b"\xbb" * 32)
+    expect = _naive_merkleize([(3).to_bytes(32, "little"), b"\xbb" * 32])
+    assert cp.hash_tree_root() == expect
+
+
+def test_validator_root_vs_naive():
+    v = _mk_validator(5)
+    leaves = [
+        _naive_merkleize([v.pubkey[:32], v.pubkey[32:].ljust(32, b"\x00")]),
+        v.withdrawal_credentials,
+        v.effective_balance.to_bytes(32, "little"),
+        b"\x01" + b"\x00" * 31,
+        v.activation_eligibility_epoch.to_bytes(32, "little"),
+        v.activation_epoch.to_bytes(32, "little"),
+        v.exit_epoch.to_bytes(32, "little"),
+        v.withdrawable_epoch.to_bytes(32, "little"),
+    ]
+    assert v.hash_tree_root() == _naive_merkleize(leaves)
+
+
+def test_list_of_uint64_root_vs_naive():
+    t = ssz.List(ssz.uint64, 1024)
+    vals = list(range(100))
+    packed = b"".join(v.to_bytes(8, "little") for v in vals)
+    packed += b"\x00" * (32 - len(packed) % 32)
+    chunks = [packed[i:i + 32] for i in range(0, len(packed), 32)]
+    expect = _mixin(_naive_merkleize(chunks, 1024 * 8 // 32), 100)
+    assert t.hash_tree_root(vals) == expect
+
+
+def test_registry_batch_root_vs_loop():
+    """The columnar batched registry path must equal per-element hashing."""
+    t = ssz.List(Validator, 2**20)
+    vals = [_mk_validator(i) for i in range(300)]
+    roots = Validator.batch_roots(vals)
+    for i in (0, 1, 150, 299):
+        assert bytes(np.asarray(roots[i:i+1]).astype(">u4").tobytes()) == vals[i].hash_tree_root()
+    # full list root: merkleize columnar roots + mixin
+    got = t.hash_tree_root(vals)
+    naive_roots = [v.hash_tree_root() for v in vals]
+    expect = _mixin(_naive_merkleize(naive_roots, 2**20), 300)
+    assert got == expect
+
+
+def test_empty_list_root():
+    t = ssz.List(Checkpoint, 16)
+    assert t.hash_tree_root([]) == _mixin(_naive_merkleize([], 16), 0)
+
+
+def test_bitlist_root_vs_naive():
+    t = ssz.Bitlist(300)  # 2 chunks
+    bits = [True] * 5 + [False] * 250 + [True]
+    byts = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            byts[i // 8] |= 1 << (i % 8)
+    padded = bytes(byts).ljust(64, b"\x00")
+    expect = _mixin(_naive_merkleize([padded[:32], padded[32:]], 2), len(bits))
+    assert t.hash_tree_root(bits) == expect
+
+
+def test_vector_of_bytes32_root():
+    t = ssz.Vector(ssz.Bytes32, 4)
+    vals = [bytes([i]) * 32 for i in range(4)]
+    assert t.hash_tree_root(vals) == _naive_merkleize(vals)
+
+
+def test_nested_container_default():
+    class Outer(ssz.Container):
+        a: ssz.uint64
+        cp: Checkpoint
+
+    o = Outer()
+    assert o.cp == Checkpoint()
+    assert Outer.deserialize(o.serialize()) == o
