@@ -180,9 +180,16 @@ ZERO_HASH_WORDS: np.ndarray = np.stack(
 # Merkleization
 # --------------------------------------------------------------------------
 
-# Below this many pairs a device round-trip costs more than hashlib; measured
-# on CPU this is conservative, tuned on TPU by bench.py.
-_DEVICE_MIN_PAIRS = 64
+# Below this many pairs a device dispatch costs more than hashlib (measured:
+# XLA-CPU ≈ hashlib ≈ 0.55 Mhash/s, but per-call dispatch ~100µs; small tree
+# levels are pure overhead).  Also bounds the jit compile cache to the few
+# large power-of-two shapes.
+_DEVICE_MIN_PAIRS = 2048
+
+
+def batch_hash_pairs(pairs: np.ndarray, *, device: bool | None = None) -> np.ndarray:
+    """Public batched pair-hash: uint32[N,16] -> uint32[N,8], device-routed."""
+    return _hash_level(pairs, device=device)
 
 
 def _hash_level(pairs: np.ndarray, *, device: bool | None = None) -> np.ndarray:

@@ -89,13 +89,7 @@ def _batch_merkleize_subtrees(leaves: np.ndarray) -> np.ndarray:
     level = leaves
     while level.shape[1] > 1:
         pairs = level.reshape(n * level.shape[1] // 2, 16)
-        if pairs.shape[0] >= 64:
-            import jax.numpy as jnp
-
-            hashed = np.asarray(sha_ops.hash_pairs_device(jnp.asarray(pairs)))
-        else:
-            hashed = sha_ops.hash_pairs_np(pairs)
-        level = hashed.reshape(n, level.shape[1] // 2, 8)
+        level = sha_ops.batch_hash_pairs(pairs).reshape(n, level.shape[1] // 2, 8)
     return level[:, 0, :]
 
 
@@ -126,7 +120,6 @@ class Uint(SSZType):
         return 1
 
     def batch_roots(self, values: Sequence[int]) -> np.ndarray:
-        arr = np.zeros((len(values), 8), dtype=np.uint32)
         raw = b"".join(self.serialize(v).ljust(32, b"\x00") for v in values)
         return np.frombuffer(raw, dtype=">u4").reshape(len(values), 8).astype(np.uint32)
 
@@ -195,6 +188,9 @@ class ByteVector(SSZType):
 
     def batch_roots(self, values: Sequence[bytes]) -> np.ndarray:
         n = len(values)
+        for v in values:
+            if len(v) != self.length:
+                raise ValueError(f"ByteVector[{self.length}]: got {len(v)} bytes")
         if self.length <= 32:
             raw = b"".join(v.ljust(32, b"\x00") for v in values)
             return np.frombuffer(raw, dtype=">u4").reshape(n, 8).astype(np.uint32)
@@ -255,11 +251,7 @@ class Bitvector(SSZType):
     def serialize(self, value: Sequence[bool]) -> bytes:
         if len(value) != self.length:
             raise ValueError(f"Bitvector[{self.length}]: got {len(value)} bits")
-        out = bytearray(self.fixed_size)
-        for i, bit in enumerate(value):
-            if bit:
-                out[i // 8] |= 1 << (i % 8)
-        return bytes(out)
+        return bytes(_pack_bits(value, self.fixed_size))
 
     def deserialize(self, data: bytes) -> list[bool]:
         if len(data) != self.fixed_size:
@@ -272,7 +264,7 @@ class Bitvector(SSZType):
         return bits
 
     def hash_tree_root(self, value: Sequence[bool]) -> bytes:
-        return merkleize_chunks(_pad_chunks(self.serialize(value)), self.chunk_count())
+        return merkleize_chunks(self.serialize(value), self.chunk_count())
 
     def default(self) -> list[bool]:
         return [False] * self.length
@@ -284,6 +276,14 @@ class Bitvector(SSZType):
         return f"Bitvector[{self.length}]"
 
 
+def _pack_bits(value: Sequence[bool], nbytes: int) -> bytearray:
+    out = bytearray(nbytes)
+    for i, bit in enumerate(value):
+        if bit:
+            out[i // 8] |= 1 << (i % 8)
+    return out
+
+
 class Bitlist(SSZType):
     def __init__(self, limit: int):
         self.limit = limit
@@ -292,10 +292,7 @@ class Bitlist(SSZType):
     def serialize(self, value: Sequence[bool]) -> bytes:
         if len(value) > self.limit:
             raise ValueError(f"Bitlist[{self.limit}]: {len(value)} bits over limit")
-        out = bytearray((len(value) + 8) // 8)
-        for i, bit in enumerate(value):
-            if bit:
-                out[i // 8] |= 1 << (i % 8)
+        out = _pack_bits(value, (len(value) + 8) // 8)
         out[len(value) // 8] |= 1 << (len(value) % 8)  # delimiter
         return bytes(out)
 
@@ -311,11 +308,10 @@ class Bitlist(SSZType):
         return [bool(data[i // 8] >> (i % 8) & 1) for i in range(bit_len)]
 
     def hash_tree_root(self, value: Sequence[bool]) -> bytes:
-        out = bytearray((len(value) + 7) // 8)
-        for i, bit in enumerate(value):
-            if bit:
-                out[i // 8] |= 1 << (i % 8)
-        root = merkleize_chunks(_pad_chunks(bytes(out)), self.chunk_count())
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: {len(value)} bits over limit")
+        out = _pack_bits(value, (len(value) + 7) // 8)
+        root = merkleize_chunks(bytes(out), self.chunk_count())
         return sha_ops.mix_in_length(root, len(value))
 
     def default(self) -> list[bool]:
@@ -358,6 +354,10 @@ class Vector(SSZType):
         return out
 
     def hash_tree_root(self, value: Sequence[Any]) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(
+                f"Vector[{self.element},{self.length}]: got {len(value)} elements"
+            )
         if isinstance(self.element, (Uint, _Boolean)):
             return merkleize_chunks(_pack_basics(self.element, value), self.chunk_count())
         roots = self.element.batch_roots(list(value))
@@ -395,6 +395,8 @@ class List(SSZType):
         return out
 
     def hash_tree_root(self, value: Sequence[Any]) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"List limit {self.limit} exceeded: {len(value)}")
         if isinstance(self.element, (Uint, _Boolean)):
             root = merkleize_chunks(_pack_basics(self.element, value), self.chunk_count())
         else:
@@ -444,7 +446,7 @@ def _deserialize_homogeneous(element: SSZType, data: bytes, limit: int | None) -
     if not data:
         return []
     first_off = int.from_bytes(data[:OFFSET_BYTES], "little")
-    if first_off % OFFSET_BYTES or first_off > len(data):
+    if first_off == 0 or first_off % OFFSET_BYTES or first_off > len(data):
         raise ValueError("bad first offset")
     n = first_off // OFFSET_BYTES
     offs = [int.from_bytes(data[i * 4:(i + 1) * 4], "little") for i in range(n)] + [len(data)]
@@ -476,6 +478,22 @@ class ContainerMeta(type):
         container_cls = globals().get("Container")
         for base in reversed(cls.__mro__):
             for fname, ftype in vars(base).get("__annotations__", {}).items():
+                if isinstance(ftype, str):
+                    # `from __future__ import annotations` in the defining
+                    # module stringifies annotations; resolve them there.
+                    # Failure is loud: silently dropping a field would change
+                    # consensus-critical serialization/roots.
+                    import sys
+
+                    mod = sys.modules.get(base.__module__)
+                    try:
+                        ftype = eval(ftype, vars(mod) if mod else {})  # noqa: S307
+                    except Exception as e:
+                        raise TypeError(
+                            f"{name}.{fname}: cannot resolve annotation "
+                            f"{ftype!r} ({e}); SSZ containers need resolvable "
+                            "field types"
+                        ) from e
                 is_nested = (
                     container_cls is not None
                     and isinstance(ftype, type)
@@ -511,9 +529,16 @@ class Container(metaclass=ContainerMeta):
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
     def __eq__(self, other):
-        return type(self) is type(other) and all(
-            getattr(self, f) == getattr(other, f) for f in type(self).fields
-        )
+        if type(self) is not type(other):
+            return False
+        for f in type(self).fields:
+            a, b = getattr(self, f), getattr(other, f)
+            if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    return False
+            elif a != b:
+                return False
+        return True
 
     def __repr__(self):
         inner = ", ".join(f"{f}={getattr(self, f)!r}" for f in type(self).fields)
@@ -596,6 +621,10 @@ class Container(metaclass=ContainerMeta):
                 off = int.from_bytes(data[pos:pos + OFFSET_BYTES], "little")
                 var_fields.append((fname, ftype, off))
                 pos += OFFSET_BYTES
+        if not var_fields and pos != len(data):
+            raise ValueError(
+                f"{cls.__name__}: {len(data) - pos} trailing bytes after fixed fields"
+            )
         if var_fields and var_fields[0][2] != pos:
             raise ValueError(
                 f"first offset {var_fields[0][2]} != fixed-part length {pos}"

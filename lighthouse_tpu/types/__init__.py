@@ -1,0 +1,57 @@
+"""Consensus types: spec/presets, columnar registry, multi-fork containers."""
+
+from lighthouse_tpu.types.spec import (
+    FAR_FUTURE_EPOCH,
+    FORKS,
+    GENESIS_EPOCH,
+    GENESIS_SLOT,
+    MAINNET_PRESET,
+    MINIMAL_PRESET,
+    ChainSpec,
+    Preset,
+)
+from lighthouse_tpu.types.registry import (
+    RootsList,
+    RootsVector,
+    U8List,
+    U64List,
+    U64Vector,
+    ValidatorRegistryType,
+    Validators,
+)
+from lighthouse_tpu.types.containers import (
+    AttestationData,
+    BeaconBlockHeader,
+    BLSToExecutionChange,
+    Checkpoint,
+    Deposit,
+    DepositData,
+    DepositMessage,
+    Eth1Data,
+    Fork,
+    ForkData,
+    HistoricalSummary,
+    ProposerSlashing,
+    SignedBeaconBlockHeader,
+    SignedBLSToExecutionChange,
+    SignedVoluntaryExit,
+    SigningData,
+    SyncCommitteeMessage,
+    Validator,
+    VoluntaryExit,
+    Withdrawal,
+    make_types,
+)
+
+__all__ = [
+    "FAR_FUTURE_EPOCH", "FORKS", "GENESIS_EPOCH", "GENESIS_SLOT",
+    "MAINNET_PRESET", "MINIMAL_PRESET", "ChainSpec", "Preset",
+    "RootsList", "RootsVector", "U8List", "U64List", "U64Vector",
+    "ValidatorRegistryType", "Validators",
+    "AttestationData", "BeaconBlockHeader", "BLSToExecutionChange",
+    "Checkpoint", "Deposit", "DepositData", "DepositMessage", "Eth1Data",
+    "Fork", "ForkData", "HistoricalSummary", "ProposerSlashing",
+    "SignedBeaconBlockHeader", "SignedBLSToExecutionChange",
+    "SignedVoluntaryExit", "SigningData", "SyncCommitteeMessage",
+    "Validator", "VoluntaryExit", "Withdrawal", "make_types",
+]

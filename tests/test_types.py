@@ -1,0 +1,166 @@
+"""Consensus types: columnar SSZ types vs generic object paths, fork variants."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu import types as T
+
+
+@pytest.fixture(scope="module")
+def t():
+    return T.make_types(T.MINIMAL_PRESET)
+
+
+def _mk_registry(n):
+    vr = T.Validators(n)
+    rng = np.random.default_rng(n)
+    vr.pubkeys = rng.integers(0, 256, size=(n, 48), dtype=np.uint8)
+    vr.withdrawal_credentials = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+    vr.effective_balance = rng.integers(0, 32_000_000_000, size=n, dtype=np.uint64)
+    vr.slashed = rng.integers(0, 2, size=n, dtype=np.uint8).astype(bool)
+    vr.activation_eligibility_epoch = rng.integers(0, 100, size=n, dtype=np.uint64)
+    vr.activation_epoch = rng.integers(0, 100, size=n, dtype=np.uint64)
+    vr.exit_epoch = np.full(n, T.FAR_FUTURE_EPOCH, dtype=np.uint64)
+    vr.withdrawable_epoch = np.full(n, T.FAR_FUTURE_EPOCH, dtype=np.uint64)
+    return vr
+
+
+def _registry_as_objects(vr):
+    return [
+        T.Validator(
+            pubkey=vr.pubkeys[i].tobytes(),
+            withdrawal_credentials=vr.withdrawal_credentials[i].tobytes(),
+            effective_balance=int(vr.effective_balance[i]),
+            slashed=bool(vr.slashed[i]),
+            activation_eligibility_epoch=int(vr.activation_eligibility_epoch[i]),
+            activation_epoch=int(vr.activation_epoch[i]),
+            exit_epoch=int(vr.exit_epoch[i]),
+            withdrawable_epoch=int(vr.withdrawable_epoch[i]),
+        )
+        for i in range(len(vr))
+    ]
+
+
+def test_registry_matches_object_list():
+    vr = _mk_registry(77)
+    objs = _registry_as_objects(vr)
+    col_t = T.ValidatorRegistryType(2**40)
+    obj_t = ssz.List(T.Validator, 2**40)
+    assert col_t.serialize(vr) == obj_t.serialize(objs)
+    assert col_t.hash_tree_root(vr) == obj_t.hash_tree_root(objs)
+    back = col_t.deserialize(col_t.serialize(vr))
+    assert back == vr
+
+
+def test_u64_list_matches_generic():
+    col = T.U64List(4096)
+    gen = ssz.List(ssz.uint64, 4096)
+    vals = list(range(1000))
+    arr = np.arange(1000, dtype=np.uint64)
+    assert col.serialize(arr) == gen.serialize(vals)
+    assert col.hash_tree_root(arr) == gen.hash_tree_root(vals)
+    assert col.hash_tree_root(np.zeros(0, np.uint64)) == gen.hash_tree_root([])
+
+
+def test_u64_vector_matches_generic():
+    col = T.U64Vector(64)
+    gen = ssz.Vector(ssz.uint64, 64)
+    arr = np.arange(64, dtype=np.uint64) * 7
+    assert col.serialize(arr) == gen.serialize(list(arr))
+    assert col.hash_tree_root(arr) == gen.hash_tree_root(list(arr))
+
+
+def test_u8_list_matches_generic():
+    col = T.U8List(2048)
+    gen = ssz.List(ssz.uint8, 2048)
+    arr = np.arange(100, dtype=np.uint8)
+    assert col.serialize(arr) == gen.serialize(list(arr))
+    assert col.hash_tree_root(arr) == gen.hash_tree_root(list(arr))
+    assert col.hash_tree_root(np.zeros(0, np.uint8)) == gen.hash_tree_root([])
+
+
+def test_roots_vector_matches_generic():
+    col = T.RootsVector(8)
+    gen = ssz.Vector(ssz.Bytes32, 8)
+    vals = [bytes([i]) * 32 for i in range(8)]
+    arr = np.frombuffer(b"".join(vals), dtype=np.uint8).reshape(8, 32)
+    assert col.serialize(arr) == gen.serialize(vals)
+    assert col.hash_tree_root(arr) == gen.hash_tree_root(vals)
+
+
+def test_roots_list_matches_generic():
+    col = T.RootsList(64)
+    gen = ssz.List(ssz.Bytes32, 64)
+    vals = [bytes([i]) * 32 for i in range(5)]
+    arr = np.frombuffer(b"".join(vals), dtype=np.uint8).reshape(5, 32)
+    assert col.serialize(arr) == gen.serialize(vals)
+    assert col.hash_tree_root(arr) == gen.hash_tree_root(vals)
+    assert col.hash_tree_root(np.zeros((0, 32), np.uint8)) == gen.hash_tree_root([])
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix", "capella", "deneb"])
+def test_state_roundtrip_all_forks(t, fork):
+    cls = t.beacon_state_class(fork)
+    st = cls()
+    st.validators = _mk_registry(10)
+    st.balances = np.full(10, 32_000_000_000, dtype=np.uint64)
+    if fork != "phase0":
+        st.previous_epoch_participation = np.arange(10, dtype=np.uint8) % 8
+        st.current_epoch_participation = np.zeros(10, np.uint8)
+        st.inactivity_scores = np.ones(10, np.uint64)
+    blob = st.serialize()
+    back = cls.deserialize(blob)
+    assert back == st
+    assert back.hash_tree_root() == st.hash_tree_root()
+
+
+def test_fork_state_roots_distinct(t):
+    roots = {f: t.beacon_state_class(f)().hash_tree_root() for f in t.forks}
+    assert len(set(roots.values())) == len(roots)
+
+
+def test_block_roundtrip(t):
+    body = t.BeaconBlockBodyCapella(randao_reveal=b"\x01" * 96)
+    blk = t.BeaconBlockCapella(slot=5, proposer_index=2, body=body)
+    sb = t.SignedBeaconBlockCapella(message=blk, signature=b"\x02" * 96)
+    blob = sb.serialize()
+    assert t.SignedBeaconBlockCapella.deserialize(blob) == sb
+
+
+def test_attestation_roundtrip(t):
+    att = t.Attestation(
+        aggregation_bits=[True, False, True],
+        data=T.AttestationData(slot=3, index=1),
+        signature=b"\x03" * 96,
+    )
+    assert t.Attestation.deserialize(att.serialize()) == att
+
+
+def test_chain_spec_forks():
+    spec = T.ChainSpec.mainnet()
+    assert spec.fork_at_epoch(0) == "phase0"
+    assert spec.fork_at_epoch(74240) == "altair"
+    assert spec.fork_at_epoch(200000) == "capella"
+    assert spec.fork_at_epoch(300000) == "deneb"
+    assert spec.fork_version("capella") == b"\x03\x00\x00\x00"
+    s2 = T.ChainSpec.minimal().with_forks_at(0, through="capella")
+    assert s2.fork_at_epoch(0) == "capella"
+    assert s2.deneb_fork_epoch == T.FAR_FUTURE_EPOCH
+
+
+def test_spec_epoch_math():
+    spec = T.ChainSpec.minimal()
+    assert spec.slots_per_epoch == 8
+    assert spec.compute_epoch_at_slot(17) == 2
+    assert spec.compute_start_slot_at_epoch(2) == 16
+    assert spec.compute_activation_exit_epoch(5) == 10
+
+
+def test_registry_helpers():
+    vr = _mk_registry(20)
+    vr.activation_epoch[:5] = 0
+    vr.exit_epoch[:5] = 10
+    active = vr.is_active(5)
+    assert active[:5].all()
+    assert not vr.is_active(10)[:5].any()
