@@ -1,0 +1,29 @@
+"""BLS12-381 for eth2: pure-Python reference oracle + backend registry.
+
+The device (JAX/Pallas) backend registers itself as "tpu" via
+lighthouse_tpu.ops.bls; the control plane only ever calls
+`verify_signature_sets` through this facade.
+"""
+
+from lighthouse_tpu.crypto.bls.api import (
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_verify,
+    fast_aggregate_verify,
+    get_backend,
+    register_backend,
+    set_backend,
+    verify,
+    verify_signature_sets,
+)
+from lighthouse_tpu.crypto.bls.hash_to_curve import DST_G2, hash_to_g2
+
+__all__ = [
+    "BlsError", "PublicKey", "SecretKey", "Signature", "SignatureSet",
+    "aggregate_verify", "fast_aggregate_verify", "get_backend",
+    "register_backend", "set_backend", "verify", "verify_signature_sets",
+    "DST_G2", "hash_to_g2",
+]

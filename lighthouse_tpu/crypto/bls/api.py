@@ -1,0 +1,282 @@
+"""BLS signature API + pluggable backend registry.
+
+This is the rebuild of the reference's generic BLS facade
+(/root/reference/crypto/bls/src/lib.rs:86-141): one stable API
+(`verify_signature_sets`, `SignatureSet`, key/signature types) over
+swappable backends:
+
+- "reference": the pure-Python pairing in this package (correctness oracle)
+- "fake":      structure checks only, signatures always verify (the
+               reference's fake_crypto backend, used by spec tests)
+- "tpu":       batched JAX/Pallas backend (lighthouse_tpu.ops.bls), the
+               device data plane
+
+Batch semantics mirror blst's verify_multiple_aggregate_signatures
+(/root/reference/crypto/bls/src/impls/blst.rs:37-119): per-set nonzero
+64-bit random scalars r_i, one combined multi-pairing check
+
+    e(-g1, Σ r_i·sig_i) · ∏ e(r_i·agg_pk_i, H(m_i)) == 1
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from lighthouse_tpu.crypto.bls import curve as cv
+from lighthouse_tpu.crypto.bls.fields import R
+from lighthouse_tpu.crypto.bls.hash_to_curve import DST_G2, hash_to_g2
+
+RAND_BITS = 64
+
+
+class BlsError(ValueError):
+    pass
+
+
+class PublicKey:
+    """Compressed G1 public key with lazy decompression + caching."""
+
+    __slots__ = ("_bytes", "_point")
+
+    def __init__(self, data: bytes, point=None):
+        if len(data) != 48:
+            raise BlsError("public key must be 48 bytes")
+        self._bytes = bytes(data)
+        self._point = point
+
+    @property
+    def point(self):
+        if self._point is None:
+            pt = cv.g1_from_bytes(self._bytes)
+            if pt is cv.INF:
+                raise BlsError("infinity public key rejected (eth2 KeyValidate)")
+            self._point = pt
+        return self._point
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def __eq__(self, o):
+        return isinstance(o, PublicKey) and self._bytes == o._bytes
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __repr__(self):
+        return f"PublicKey({self._bytes.hex()[:16]}…)"
+
+    @staticmethod
+    def aggregate(pubkeys: Sequence["PublicKey"]) -> "PublicKey":
+        if not pubkeys:
+            raise BlsError("cannot aggregate zero pubkeys")
+        pt = cv.INF
+        for pk in pubkeys:
+            pt = cv.g1_add(pt, pk.point)
+        return PublicKey(cv.g1_to_bytes(pt), pt)
+
+
+class Signature:
+    """Compressed G2 signature with lazy decompression."""
+
+    __slots__ = ("_bytes", "_point")
+
+    def __init__(self, data: bytes, point=None):
+        if len(data) != 96:
+            raise BlsError("signature must be 96 bytes")
+        self._bytes = bytes(data)
+        self._point = point
+
+    @property
+    def point(self):
+        if self._point is None:
+            self._point = cv.g2_from_bytes(self._bytes)
+        return self._point
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def is_infinity(self) -> bool:
+        return self._bytes[0] & 0x40 != 0
+
+    def __eq__(self, o):
+        return isinstance(o, Signature) and self._bytes == o._bytes
+
+    def __repr__(self):
+        return f"Signature({self._bytes.hex()[:16]}…)"
+
+    @staticmethod
+    def aggregate(sigs: Sequence["Signature"]) -> "Signature":
+        if not sigs:
+            raise BlsError("cannot aggregate zero signatures")
+        pt = cv.INF
+        for s in sigs:
+            pt = cv.g2_add(pt, s.point)
+        return Signature(cv.g2_to_bytes(pt), pt)
+
+
+class SecretKey:
+    __slots__ = ("k",)
+
+    def __init__(self, k: int):
+        if not 0 < k < R:
+            raise BlsError("secret key out of range")
+        self.k = k
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "SecretKey":
+        return SecretKey(int.from_bytes(data, "big"))
+
+    @staticmethod
+    def generate() -> "SecretKey":
+        return SecretKey(secrets.randbelow(R - 1) + 1)
+
+    def to_bytes(self) -> bytes:
+        return self.k.to_bytes(32, "big")
+
+    def public_key(self) -> PublicKey:
+        pt = cv.g1_mul(cv.g1_generator(), self.k)
+        return PublicKey(cv.g1_to_bytes(pt), pt)
+
+    def sign(self, message: bytes, dst: bytes = DST_G2) -> Signature:
+        h = hash_to_g2(message, dst)
+        pt = cv.g2_mul(h, self.k)
+        return Signature(cv.g2_to_bytes(pt), pt)
+
+
+@dataclass
+class SignatureSet:
+    """One verification unit: signature over `message` by the aggregate of
+    `pubkeys` (reference GenericSignatureSet,
+    crypto/bls/src/generic_signature_set.rs:61-121)."""
+
+    signature: Signature
+    pubkeys: list[PublicKey]
+    message: bytes
+
+    def aggregate_pubkey(self):
+        pt = cv.INF
+        for pk in self.pubkeys:
+            pt = cv.g1_add(pt, pk.point)
+        return pt
+
+
+# --- single verification ----------------------------------------------------
+
+def verify(pubkey: PublicKey, message: bytes, signature: Signature) -> bool:
+    try:
+        sig_pt = signature.point
+        pk_pt = pubkey.point
+    except (BlsError, ValueError):
+        return False
+    if sig_pt is cv.INF:
+        return False
+    h = hash_to_g2(message)
+    res = cv.multi_pairing([
+        (cv.g1_neg(cv.g1_generator()), sig_pt),
+        (pk_pt, h),
+    ])
+    return res.is_one()
+
+
+def fast_aggregate_verify(
+    pubkeys: Sequence[PublicKey], message: bytes, signature: Signature
+) -> bool:
+    if not pubkeys:
+        return False
+    return verify_signature_sets([SignatureSet(signature, list(pubkeys), message)])
+
+
+def aggregate_verify(
+    pubkeys: Sequence[PublicKey], messages: Sequence[bytes], signature: Signature
+) -> bool:
+    """Distinct-message aggregate verification."""
+    if not pubkeys or len(pubkeys) != len(messages):
+        return False
+    try:
+        sig_pt = signature.point
+        pairs = [(cv.g1_neg(cv.g1_generator()), sig_pt)]
+        for pk, msg in zip(pubkeys, messages):
+            pairs.append((pk.point, hash_to_g2(msg)))
+    except (BlsError, ValueError):
+        return False
+    if sig_pt is cv.INF:
+        return False
+    return cv.multi_pairing(pairs).is_one()
+
+
+# --- batch verification backends -------------------------------------------
+
+def _verify_signature_sets_reference(sets: Sequence[SignatureSet]) -> bool:
+    """Randomized batch verification (one multi-pairing for the batch)."""
+    if not sets:
+        return False
+    pairs = []
+    sig_acc = cv.INF
+    for s in sets:
+        if not s.pubkeys:
+            return False
+        try:
+            sig_pt = s.signature.point
+            agg_pk = s.aggregate_pubkey()
+        except (BlsError, ValueError):
+            return False
+        if sig_pt is cv.INF:
+            return False
+        rand = 0
+        while rand == 0:
+            rand = secrets.randbits(RAND_BITS)
+        sig_acc = cv.g2_add(sig_acc, cv.g2_mul(sig_pt, rand))
+        pairs.append((cv.g1_mul(agg_pk, rand), hash_to_g2(s.message)))
+    pairs.append((cv.g1_neg(cv.g1_generator()), sig_acc))
+    return cv.multi_pairing(pairs).is_one()
+
+
+def _verify_signature_sets_fake(sets: Sequence[SignatureSet]) -> bool:
+    """Structure checks only; all well-formed signatures verify (reference
+    fake_crypto backend, crypto/bls/src/impls/fake_crypto.rs)."""
+    if not sets:
+        return False
+    for s in sets:
+        if not s.pubkeys:
+            return False
+        if len(s.signature.to_bytes()) != 96:
+            return False
+    return True
+
+
+_BACKENDS: dict[str, Callable[[Sequence[SignatureSet]], bool]] = {
+    "reference": _verify_signature_sets_reference,
+    "fake": _verify_signature_sets_fake,
+}
+
+_active_backend = "reference"
+
+
+def register_backend(name: str, fn: Callable[[Sequence[SignatureSet]], bool]):
+    _BACKENDS[name] = fn
+
+
+def set_backend(name: str):
+    global _active_backend
+    if name not in _BACKENDS:
+        raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}")
+    _active_backend = name
+
+
+def get_backend() -> str:
+    return _active_backend
+
+
+def verify_signature_sets(
+    sets: Sequence[SignatureSet], *, backend: str | None = None
+) -> bool:
+    """THE seam: batch-verify many signature sets on the active backend.
+
+    Callers (block signature verifier, attestation batches) accumulate sets
+    and call this once — mirroring the reference call site
+    state_processing/src/per_block_processing/block_signature_verifier.rs:396.
+    """
+    fn = _BACKENDS[backend or _active_backend]
+    return fn(sets)
