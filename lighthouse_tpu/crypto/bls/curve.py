@@ -46,6 +46,8 @@ INF = None  # point at infinity sentinel
 class _IntField:
     """Adapter giving plain ints the same protocol as Fq2."""
 
+    one = 1
+
     @staticmethod
     def add(a, b):
         return (a + b) % P
@@ -80,6 +82,7 @@ class _IntField:
 
 
 class _Fq2Field:
+    one = Fq2.ONE
     add = staticmethod(lambda a, b: a + b)
     sub = staticmethod(lambda a, b: a - b)
     mul = staticmethod(lambda a, b: a * b)
@@ -125,16 +128,73 @@ def _ec_neg(pt, F):
     return (pt[0], F.neg(pt[1]))
 
 
+def _jac_double(p, F):
+    # 2007 Bernstein-Lange doubling for a=0 curves, Jacobian (X, Y, Z)
+    X, Y, Z = p
+    A = F.sq(X)
+    B = F.sq(Y)
+    C = F.sq(B)
+    D = F.scale(F.sub(F.sq(F.add(X, B)), F.add(A, C)), 2)
+    E = F.scale(A, 3)
+    Fv = F.sq(E)
+    X3 = F.sub(Fv, F.scale(D, 2))
+    Y3 = F.sub(F.mul(E, F.sub(D, X3)), F.scale(C, 8))
+    Z3 = F.scale(F.mul(Y, Z), 2)
+    return (X3, Y3, Z3)
+
+
+def _jac_add(p, q, F):
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    if F.is_zero(Z1):
+        return q
+    if F.is_zero(Z2):
+        return p
+    Z1Z1 = F.sq(Z1)
+    Z2Z2 = F.sq(Z2)
+    U1 = F.mul(X1, Z2Z2)
+    U2 = F.mul(X2, Z1Z1)
+    S1 = F.mul(F.mul(Y1, Z2), Z2Z2)
+    S2 = F.mul(F.mul(Y2, Z1), Z1Z1)
+    if U1 == U2:
+        if S1 == S2:
+            return _jac_double(p, F)
+        return (F.add(U1, U1), F.add(S1, S1), F.sub(Z1, Z1))  # infinity (Z=0)
+    H = F.sub(U2, U1)
+    I = F.sq(F.scale(H, 2))
+    J = F.mul(H, I)
+    r = F.scale(F.sub(S2, S1), 2)
+    V = F.mul(U1, I)
+    X3 = F.sub(F.sub(F.sq(r), J), F.scale(V, 2))
+    Y3 = F.sub(F.mul(r, F.sub(V, X3)), F.scale(F.mul(S1, J), 2))
+    Z3 = F.mul(F.scale(F.mul(Z1, Z2), 2), H)
+    return (X3, Y3, Z3)
+
+
 def _ec_mul(pt, k, F):
-    # NOTE: no mod-R reduction — subgroup checks multiply by R itself and
-    # must see the true scalar (g1_mul(p, R) == INF iff p ∈ subgroup).
-    out, base = INF, pt
+    """Scalar mult via Jacobian double-and-add (no field inversions in the
+    loop; one inversion to return to affine).
+
+    NOTE: no mod-R reduction — subgroup checks multiply by R itself and
+    must see the true scalar (g1_mul(p, R) == INF iff p ∈ subgroup)."""
+    if pt is INF or k == 0:
+        return INF
+    if k < 0:
+        return _ec_mul(_ec_neg(pt, F), -k, F)
+    zero = F.sub(pt[0], pt[0])
+    base = (pt[0], pt[1], F.one)
+    acc = (pt[0], pt[1], zero)  # Z=0 → Jacobian infinity
     while k:
         if k & 1:
-            out = _ec_add(out, base, F)
-        base = _ec_double(base, F)
+            acc = _jac_add(acc, base, F)
+        base = _jac_double(base, F)
         k >>= 1
-    return out
+    X, Y, Z = acc
+    if F.is_zero(Z):
+        return INF
+    zinv = F.inv(Z)
+    zinv2 = F.sq(zinv)
+    return (F.mul(X, zinv2), F.mul(F.mul(Y, zinv2), zinv))
 
 
 # --- G1 ---------------------------------------------------------------------

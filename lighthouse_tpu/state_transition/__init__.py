@@ -1,0 +1,52 @@
+"""Pure state transition: per-slot, per-block, per-epoch.
+
+Reference: /root/reference/consensus/state_processing.  Entry points mirror
+the spec: `state_transition(state, signed_block)` = advance slots + process
+block + optional state-root validation.
+"""
+
+from lighthouse_tpu.state_transition.block_processing import (
+    BlockProcessingError,
+    BulkVerifier,
+    SignatureStrategy,
+    process_block,
+)
+from lighthouse_tpu.state_transition.epoch_processing import process_epoch
+from lighthouse_tpu.state_transition.genesis import (
+    genesis_state,
+    interop_pubkey,
+    interop_secret_key,
+)
+from lighthouse_tpu.state_transition.slot_processing import (
+    per_slot_processing,
+    process_slot,
+    state_advance,
+)
+from lighthouse_tpu.state_transition import misc, shuffle, signature_sets
+
+
+def state_transition(
+    state,
+    spec,
+    signed_block,
+    strategy: SignatureStrategy = SignatureStrategy.VERIFY_BULK,
+    validate_result: bool = True,
+) -> None:
+    """Spec `state_transition`: slots → block → state-root check."""
+    block = signed_block.message
+    state_advance(state, spec, int(block.slot))
+    process_block(state, spec, signed_block, strategy)
+    if validate_result:
+        got = state.hash_tree_root()
+        if got != block.state_root:
+            raise BlockProcessingError(
+                f"state root mismatch: block {block.state_root.hex()[:16]} "
+                f"!= computed {got.hex()[:16]}")
+
+
+__all__ = [
+    "BlockProcessingError", "BulkVerifier", "SignatureStrategy",
+    "genesis_state", "interop_pubkey", "interop_secret_key", "misc",
+    "per_slot_processing", "process_block", "process_epoch", "process_slot",
+    "shuffle", "signature_sets", "state_advance", "state_transition",
+]

@@ -1,0 +1,628 @@
+"""Block processing (altair..capella, header-only execution payloads).
+
+Reference: /root/reference/consensus/state_processing/src/per_block_processing.rs:100
+and process_operations.rs.  Signature policy mirrors BlockSignatureStrategy
+(NoVerification / VerifyIndividual / VerifyBulk): with `bulk_verifier` set,
+every operation contributes SignatureSets to one batched verification
+instead of verifying inline — the TPU offload seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from enum import Enum
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import misc, signature_sets as sigs
+from lighthouse_tpu.state_transition.epoch_processing import (
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    PARTICIPATION_FLAG_WEIGHTS,
+    add_flag,
+    base_reward_per_increment,
+    has_flag,
+    initiate_validator_exit,
+)
+
+
+class SignatureStrategy(Enum):
+    NO_VERIFICATION = "no_verification"
+    VERIFY_INDIVIDUAL = "verify_individual"
+    VERIFY_BULK = "verify_bulk"
+
+
+class BlockProcessingError(ValueError):
+    pass
+
+
+def _err(cond: bool, msg: str):
+    if not cond:
+        raise BlockProcessingError(msg)
+
+
+class BulkVerifier:
+    """Accumulates SignatureSets for one batched verify (reference
+    BlockSignatureVerifier, block_signature_verifier.rs:73-138)."""
+
+    def __init__(self):
+        self.sets: list[bls.SignatureSet] = []
+
+    def add(self, s: bls.SignatureSet | list[bls.SignatureSet]):
+        if isinstance(s, list):
+            self.sets.extend(s)
+        else:
+            self.sets.append(s)
+
+    def verify(self) -> bool:
+        if not self.sets:
+            return True
+        return bls.verify_signature_sets(self.sets)
+
+
+def _check_or_accumulate(verifier, strategy, sset):
+    if strategy is SignatureStrategy.NO_VERIFICATION:
+        return
+    if strategy is SignatureStrategy.VERIFY_BULK:
+        verifier.add(sset)
+        return
+    sets = sset if isinstance(sset, list) else [sset]
+    for s in sets:
+        _err(bls.verify_signature_sets([s]), "signature verification failed")
+
+
+def process_block(
+    state,
+    spec: T.ChainSpec,
+    signed_block,
+    strategy: SignatureStrategy = SignatureStrategy.VERIFY_BULK,
+    *,
+    verify_block_root: bytes | None = None,
+) -> None:
+    """Apply a signed block to `state` (which must already be advanced to the
+    block's slot).  Raises BlockProcessingError on any invalid condition."""
+    block = signed_block.message
+    fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(int(block.slot)))
+    verifier = BulkVerifier()
+
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy,
+            sigs.block_proposal_set(state, spec, signed_block, verify_block_root))
+
+    process_block_header(state, spec, block)
+    if fork in ("bellatrix", "capella", "deneb", "electra"):
+        if fork != "bellatrix":
+            process_withdrawals(state, spec, block.body.execution_payload)
+        process_execution_payload(state, spec, block.body, fork)
+    process_randao(state, spec, block, strategy, verifier)
+    process_eth1_data(state, spec, block.body)
+    process_operations(state, spec, block.body, fork, strategy, verifier)
+    if fork != "phase0":
+        process_sync_aggregate(
+            state, spec, block.body.sync_aggregate, int(block.slot),
+            strategy, verifier)
+
+    if strategy is SignatureStrategy.VERIFY_BULK:
+        _err(verifier.verify(), "bulk signature verification failed")
+
+
+def process_block_header(state, spec: T.ChainSpec, block) -> None:
+    _err(int(block.slot) == int(state.slot), "block slot != state slot")
+    _err(
+        int(block.slot) > int(state.latest_block_header.slot),
+        "block not newer than latest header")
+    proposer = misc.get_beacon_proposer_index(state, spec)
+    _err(int(block.proposer_index) == proposer, "wrong proposer index")
+    _err(
+        block.parent_root == state.latest_block_header.hash_tree_root(),
+        "parent root mismatch")
+    _err(not bool(state.validators.slashed[proposer]), "proposer is slashed")
+    state.latest_block_header = T.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,
+        body_root=block.body.hash_tree_root(),
+    )
+
+
+def process_randao(state, spec, block, strategy, verifier) -> None:
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy, sigs.randao_set(state, spec, block))
+    epoch = misc.current_epoch(state, spec)
+    n = spec.preset.epochs_per_historical_vector
+    mix = misc.get_randao_mix(state, spec, epoch)
+    new_mix = bytes(
+        a ^ b for a, b in zip(mix, hashlib.sha256(block.body.randao_reveal).digest()))
+    state.randao_mixes[epoch % n] = np.frombuffer(new_mix, np.uint8)
+
+
+def process_eth1_data(state, spec, body) -> None:
+    votes = list(state.eth1_data_votes)
+    votes.append(body.eth1_data)
+    state.eth1_data_votes = votes
+    period_slots = spec.preset.epochs_per_eth1_voting_period * spec.preset.slots_per_epoch
+    if sum(1 for v in votes if v == body.eth1_data) * 2 > period_slots:
+        state.eth1_data = body.eth1_data
+
+
+def process_operations(state, spec, body, fork, strategy, verifier) -> None:
+    expected_deposits = min(
+        spec.preset.max_deposits,
+        int(state.eth1_data.deposit_count) - int(state.eth1_deposit_index))
+    _err(
+        len(body.deposits) == expected_deposits,
+        f"expected {expected_deposits} deposits, got {len(body.deposits)}")
+
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(state, spec, ps, strategy, verifier)
+    for asl in body.attester_slashings:
+        process_attester_slashing(state, spec, asl, strategy, verifier)
+    for att in body.attestations:
+        process_attestation(state, spec, att, fork, strategy, verifier)
+    for dep in body.deposits:
+        process_deposit(state, spec, dep)
+    for exit_ in body.voluntary_exits:
+        process_voluntary_exit(state, spec, exit_, strategy, verifier)
+    if hasattr(body, "bls_to_execution_changes"):
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(state, spec, change, strategy, verifier)
+
+
+# --- slashings --------------------------------------------------------------
+
+def slash_validator(
+    state, spec, index: int, fork: str, whistleblower: int | None = None
+) -> None:
+    epoch = misc.current_epoch(state, spec)
+    initiate_validator_exit(state, spec, index)
+    v = state.validators
+    v.slashed[index] = True
+    v.withdrawable_epoch[index] = max(
+        int(v.withdrawable_epoch[index]),
+        epoch + spec.preset.epochs_per_slashings_vector)
+    state.slashings[epoch % spec.preset.epochs_per_slashings_vector] += (
+        v.effective_balance[index])
+    quotient = {
+        "altair": spec.min_slashing_penalty_quotient_altair,
+        "phase0": spec.min_slashing_penalty_quotient,
+    }.get(fork, spec.min_slashing_penalty_quotient_bellatrix)
+    penalty = int(v.effective_balance[index]) // quotient
+    state.balances[index] = max(0, int(state.balances[index]) - penalty)
+
+    proposer = misc.get_beacon_proposer_index(state, spec)
+    if whistleblower is None:
+        whistleblower = proposer
+    wb_reward = int(v.effective_balance[index]) // spec.whistleblower_reward_quotient
+    proposer_reward = wb_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    state.balances[proposer] += np.uint64(proposer_reward)
+    state.balances[whistleblower] += np.uint64(wb_reward - proposer_reward)
+
+
+def process_proposer_slashing(state, spec, slashing, strategy, verifier) -> None:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _err(int(h1.slot) == int(h2.slot), "proposer slashing: slots differ")
+    _err(
+        int(h1.proposer_index) == int(h2.proposer_index),
+        "proposer slashing: proposers differ")
+    _err(h1 != h2, "proposer slashing: headers identical")
+    idx = int(h1.proposer_index)
+    _err(idx < len(state.validators), "proposer slashing: unknown validator")
+    _err(
+        bool(state.validators.is_slashable(misc.current_epoch(state, spec))[idx]),
+        "proposer slashing: not slashable")
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy,
+            sigs.proposer_slashing_sets(state, spec, slashing))
+    fork = spec.fork_at_epoch(misc.current_epoch(state, spec))
+    slash_validator(state, spec, idx, fork)
+
+
+def is_slashable_attestation_data(d1, d2) -> bool:
+    double = d1 != d2 and int(d1.target.epoch) == int(d2.target.epoch)
+    surround = (
+        int(d1.source.epoch) < int(d2.source.epoch)
+        and int(d2.target.epoch) < int(d1.target.epoch))
+    return double or surround
+
+
+def _validate_indexed_attestation(state, spec, indexed, strategy, verifier) -> None:
+    idxs = np.asarray(indexed.attesting_indices, dtype=np.int64)
+    _err(idxs.size > 0, "indexed attestation: empty indices")
+    _err(
+        idxs.size <= spec.preset.max_validators_per_committee,
+        "indexed attestation: too many indices")
+    _err(bool((np.diff(idxs) > 0).all()), "indices not sorted/unique")
+    _err(int(idxs.max(initial=0)) < len(state.validators), "unknown validator index")
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy, sigs.indexed_attestation_set(state, spec, indexed))
+
+
+def process_attester_slashing(state, spec, slashing, strategy, verifier) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _err(
+        is_slashable_attestation_data(a1.data, a2.data),
+        "attestations not slashable")
+    _validate_indexed_attestation(state, spec, a1, strategy, verifier)
+    _validate_indexed_attestation(state, spec, a2, strategy, verifier)
+    cur = misc.current_epoch(state, spec)
+    fork = spec.fork_at_epoch(cur)
+    slashable = state.validators.is_slashable(cur)
+    common = sorted(
+        set(np.asarray(a1.attesting_indices).tolist())
+        & set(np.asarray(a2.attesting_indices).tolist()))
+    slashed_any = False
+    for idx in common:
+        if slashable[idx]:
+            slash_validator(state, spec, int(idx), fork)
+            slashed_any = True
+    _err(slashed_any, "attester slashing: nobody slashed")
+
+
+# --- attestations -----------------------------------------------------------
+
+def get_attesting_indices(state, spec, attestation, shuffled=None) -> np.ndarray:
+    committee = misc.get_beacon_committee(
+        state, spec, int(attestation.data.slot), int(attestation.data.index),
+        shuffled)
+    bits = attestation.aggregation_bits
+    _err(len(bits) == committee.shape[0], "aggregation bits length mismatch")
+    mask = np.asarray(bits, dtype=bool)
+    return committee[mask]
+
+
+def to_indexed_attestation(state, spec, attestation, types_ns, shuffled=None):
+    indices = np.sort(get_attesting_indices(state, spec, attestation, shuffled))
+    return types_ns.IndexedAttestation(
+        attesting_indices=indices.astype(np.uint64),
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def get_attestation_participation_flag_indices(
+    state, spec, data, inclusion_delay: int, fork: str
+) -> list[int]:
+    cur = misc.current_epoch(state, spec)
+    prev = misc.previous_epoch(state, spec)
+    if int(data.target.epoch) == cur:
+        justified = state.current_justified_checkpoint
+    else:
+        justified = state.previous_justified_checkpoint
+    is_matching_source = data.source == justified
+    _err(is_matching_source, "attestation source does not match justified checkpoint")
+    is_matching_target = is_matching_source and (
+        data.target.root == misc.get_block_root(state, spec, int(data.target.epoch)))
+    is_matching_head = is_matching_target and (
+        data.beacon_block_root
+        == misc.get_block_root_at_slot(state, spec, int(data.slot)))
+    flags = []
+    if is_matching_source and inclusion_delay <= misc.integer_squareroot(
+            spec.preset.slots_per_epoch):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if fork in ("deneb", "electra"):
+        target_ok = is_matching_target
+    else:
+        target_ok = is_matching_target and inclusion_delay <= spec.preset.slots_per_epoch
+    if target_ok:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == spec.min_attestation_inclusion_delay:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def process_attestation(
+    state, spec, attestation, fork, strategy, verifier, shuffled=None
+) -> None:
+    data = attestation.data
+    cur = misc.current_epoch(state, spec)
+    prev = misc.previous_epoch(state, spec)
+    _err(int(data.target.epoch) in (prev, cur), "attestation target epoch out of range")
+    _err(
+        int(data.target.epoch) == spec.compute_epoch_at_slot(int(data.slot)),
+        "target epoch != slot epoch")
+    delay = int(state.slot) - int(data.slot)
+    _err(delay >= spec.min_attestation_inclusion_delay, "attestation too fresh")
+    if fork not in ("deneb", "electra"):
+        _err(delay <= spec.preset.slots_per_epoch, "attestation too old")
+    epoch_shuffle = shuffled
+    active_count = misc.get_active_validator_indices(
+        state, int(data.target.epoch)).shape[0]
+    _err(
+        int(data.index) < misc.get_committee_count_per_slot(spec, active_count),
+        "committee index out of range")
+
+    flag_indices = get_attestation_participation_flag_indices(
+        state, spec, data, delay, fork)
+
+    t = T.make_types(spec.preset)
+    indexed = to_indexed_attestation(state, spec, attestation, t, epoch_shuffle)
+    _validate_indexed_attestation(state, spec, indexed, strategy, verifier)
+
+    participation = (
+        state.current_epoch_participation
+        if int(data.target.epoch) == cur
+        else state.previous_epoch_participation
+    )
+    total = misc.get_total_active_balance(state, spec)
+    brpi = base_reward_per_increment(spec, total)
+    idxs = np.asarray(indexed.attesting_indices, dtype=np.int64)
+    increments = state.validators.effective_balance[idxs] // np.uint64(
+        spec.effective_balance_increment)
+    base_rewards = increments.astype(np.int64) * brpi
+
+    proposer_reward_numerator = 0
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        if flag_index in flag_indices:
+            fresh = ~has_flag(participation[idxs], flag_index)
+            proposer_reward_numerator += int(
+                (base_rewards[fresh] * weight).sum())
+            add_flag(participation, idxs[fresh], flag_index)
+    proposer_reward = proposer_reward_numerator // (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer = misc.get_beacon_proposer_index(state, spec)
+    state.balances[proposer] += np.uint64(proposer_reward)
+
+
+# --- deposits ---------------------------------------------------------------
+
+def get_validator_from_deposit(spec, pubkey, withdrawal_credentials, amount):
+    eff = min(
+        amount - amount % spec.effective_balance_increment,
+        spec.max_effective_balance)
+    return dict(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        effective_balance=eff,
+        slashed=False,
+        activation_eligibility_epoch=T.FAR_FUTURE_EPOCH,
+        activation_epoch=T.FAR_FUTURE_EPOCH,
+        exit_epoch=T.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=T.FAR_FUTURE_EPOCH,
+    )
+
+
+def apply_deposit(state, spec, deposit_data, check_signature: bool = True) -> None:
+    pubkey = deposit_data.pubkey
+    amount = int(deposit_data.amount)
+    pubkeys = state.validators.pubkeys
+    matches = np.nonzero((pubkeys == np.frombuffer(pubkey, np.uint8)).all(axis=1))[0]
+    if matches.size:
+        idx = int(matches[0])
+        state.balances[idx] += np.uint64(amount)
+        return
+    if check_signature:
+        sset = sigs.deposit_set(deposit_data)
+        if not bls.verify_signature_sets([sset]):
+            return  # invalid proof-of-possession: deposit is skipped, not fatal
+    state.validators.append(**get_validator_from_deposit(
+        spec, pubkey, deposit_data.withdrawal_credentials, amount))
+    state.balances = np.append(state.balances, np.uint64(amount))
+    if hasattr(state, "previous_epoch_participation"):
+        state.previous_epoch_participation = np.append(
+            state.previous_epoch_participation, np.uint8(0))
+        state.current_epoch_participation = np.append(
+            state.current_epoch_participation, np.uint8(0))
+        state.inactivity_scores = np.append(
+            state.inactivity_scores, np.uint64(0))
+
+
+def process_deposit(state, spec, deposit, check_proof: bool = True) -> None:
+    if check_proof:
+        _err(
+            misc.is_valid_merkle_branch(
+                deposit.data.hash_tree_root(),
+                list(deposit.proof),
+                33,  # DEPOSIT_CONTRACT_TREE_DEPTH + 1 (length mix-in)
+                int(state.eth1_deposit_index),
+                state.eth1_data.deposit_root,
+            ),
+            "invalid deposit merkle proof")
+    state.eth1_deposit_index += 1
+    apply_deposit(state, spec, deposit.data)
+
+
+# --- exits ------------------------------------------------------------------
+
+def process_voluntary_exit(state, spec, signed_exit, strategy, verifier) -> None:
+    exit_ = signed_exit.message
+    idx = int(exit_.validator_index)
+    cur = misc.current_epoch(state, spec)
+    v = state.validators
+    _err(idx < len(v), "exit: unknown validator")
+    _err(bool(v.is_active(cur)[idx]), "exit: validator not active")
+    _err(
+        int(v.exit_epoch[idx]) == T.FAR_FUTURE_EPOCH, "exit: already exiting")
+    _err(cur >= int(exit_.epoch), "exit: epoch in future")
+    _err(
+        cur >= int(v.activation_epoch[idx]) + spec.shard_committee_period,
+        "exit: too young")
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy, sigs.voluntary_exit_set(state, spec, signed_exit))
+    initiate_validator_exit(state, spec, idx)
+
+
+# --- capella ----------------------------------------------------------------
+
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = 0x01
+BLS_WITHDRAWAL_PREFIX = 0x00
+
+
+def process_bls_to_execution_change(state, spec, signed_change, strategy, verifier) -> None:
+    change = signed_change.message
+    idx = int(change.validator_index)
+    _err(idx < len(state.validators), "bls change: unknown validator")
+    creds = state.validators.withdrawal_credentials[idx]
+    _err(int(creds[0]) == BLS_WITHDRAWAL_PREFIX, "bls change: not BLS credentials")
+    expect = hashlib.sha256(change.from_bls_pubkey).digest()[1:]
+    _err(creds[1:].tobytes() == expect, "bls change: pubkey hash mismatch")
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy,
+            sigs.bls_to_execution_change_set(state, spec, signed_change))
+    new_creds = (
+        bytes([ETH1_ADDRESS_WITHDRAWAL_PREFIX]) + b"\x00" * 11
+        + change.to_execution_address)
+    state.validators.withdrawal_credentials[idx] = np.frombuffer(new_creds, np.uint8)
+
+
+def _has_eth1_credentials(creds: np.ndarray) -> bool:
+    return int(creds[0]) == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def get_expected_withdrawals(state, spec) -> list:
+    epoch = misc.current_epoch(state, spec)
+    idx = int(state.next_withdrawal_index)
+    vidx = int(state.next_withdrawal_validator_index)
+    n = len(state.validators)
+    out = []
+    bound = min(n, spec.preset.max_validators_per_withdrawals_sweep)
+    for _ in range(bound):
+        v_creds = state.validators.withdrawal_credentials[vidx]
+        balance = int(state.balances[vidx])
+        eff = int(state.validators.effective_balance[vidx])
+        withdrawable = int(state.validators.withdrawable_epoch[vidx]) <= epoch
+        if _has_eth1_credentials(v_creds) and withdrawable and balance > 0:
+            out.append(T.Withdrawal(
+                index=idx, validator_index=vidx,
+                address=v_creds[12:].tobytes(), amount=balance))
+            idx += 1
+        elif (
+            _has_eth1_credentials(v_creds)
+            and eff == spec.max_effective_balance
+            and balance > spec.max_effective_balance
+        ):
+            out.append(T.Withdrawal(
+                index=idx, validator_index=vidx,
+                address=v_creds[12:].tobytes(),
+                amount=balance - spec.max_effective_balance))
+            idx += 1
+        if len(out) == spec.preset.max_withdrawals_per_payload:
+            break
+        vidx = (vidx + 1) % n
+    return out
+
+
+def process_withdrawals(state, spec, payload) -> None:
+    expected = get_expected_withdrawals(state, spec)
+    got = list(payload.withdrawals)
+    _err(len(got) == len(expected), "withdrawals count mismatch")
+    for g, e in zip(got, expected):
+        _err(g == e, "withdrawal mismatch")
+    for w in expected:
+        vi = int(w.validator_index)
+        state.balances[vi] -= np.uint64(int(w.amount))
+    if expected:
+        state.next_withdrawal_index = int(expected[-1].index) + 1
+    n = len(state.validators)
+    if len(expected) == spec.preset.max_withdrawals_per_payload:
+        state.next_withdrawal_validator_index = (
+            int(expected[-1].validator_index) + 1) % n
+    else:
+        bound = min(n, spec.preset.max_validators_per_withdrawals_sweep)
+        state.next_withdrawal_validator_index = (
+            int(state.next_withdrawal_validator_index) + bound) % n
+
+
+# --- execution payload (header-only verification) ---------------------------
+
+def process_execution_payload(state, spec, body, fork) -> None:
+    payload = body.execution_payload
+    header = state.latest_execution_payload_header
+    # merge-complete checks (we only support post-merge states in round 1)
+    _err(
+        payload.parent_hash == header.block_hash,
+        "payload parent hash mismatch")
+    _err(
+        payload.prev_randao == misc.get_randao_mix(
+            state, spec, misc.current_epoch(state, spec)),
+        "payload prev_randao mismatch")
+    _err(
+        int(payload.timestamp) == compute_timestamp_at_slot(state, spec),
+        "payload timestamp mismatch")
+    t = T.make_types(spec.preset)
+    header_cls = {
+        "bellatrix": t.ExecutionPayloadHeaderBellatrix,
+        "capella": t.ExecutionPayloadHeaderCapella,
+        "deneb": t.ExecutionPayloadHeaderDeneb,
+    }[fork]
+    kw = dict(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=t.Transactions.hash_tree_root(payload.transactions),
+    )
+    if fork in ("capella", "deneb"):
+        from lighthouse_tpu import ssz
+
+        wl = ssz.List(T.Withdrawal, spec.preset.max_withdrawals_per_payload)
+        kw["withdrawals_root"] = wl.hash_tree_root(payload.withdrawals)
+    if fork == "deneb":
+        kw["blob_gas_used"] = payload.blob_gas_used
+        kw["excess_blob_gas"] = payload.excess_blob_gas
+    state.latest_execution_payload_header = header_cls(**kw)
+
+
+def compute_timestamp_at_slot(state, spec) -> int:
+    return int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
+
+
+# --- sync aggregate ---------------------------------------------------------
+
+def process_sync_aggregate(state, spec, aggregate, block_slot, strategy, verifier) -> None:
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        if any(aggregate.sync_committee_bits):
+            sset, _ = sigs.sync_aggregate_set(state, spec, aggregate, block_slot)
+            _check_or_accumulate(verifier, strategy, sset)
+        else:
+            # empty participation: signature must be the infinity point
+            _err(
+                aggregate.sync_committee_signature == b"\xc0" + b"\x00" * 95,
+                "empty sync aggregate must carry infinity signature")
+
+    total = misc.get_total_active_balance(state, spec)
+    brpi = base_reward_per_increment(spec, total)
+    total_increments = total // spec.effective_balance_increment
+    total_base_rewards = brpi * total_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR // spec.preset.slots_per_epoch)
+    participant_reward = max_participant_rewards // spec.preset.sync_committee_size
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+
+    proposer = misc.get_beacon_proposer_index(state, spec)
+    # committee pubkey -> validator index (registry lookup)
+    pubkeys = state.validators.pubkeys
+    for pk, bit in zip(state.current_sync_committee.pubkeys, aggregate.sync_committee_bits):
+        matches = np.nonzero((pubkeys == np.frombuffer(pk, np.uint8)).all(axis=1))[0]
+        _err(matches.size > 0, "sync committee pubkey not in registry")
+        vidx = int(matches[0])
+        if bit:
+            state.balances[vidx] += np.uint64(participant_reward)
+            state.balances[proposer] += np.uint64(proposer_reward)
+        else:
+            state.balances[vidx] = max(
+                0, int(state.balances[vidx]) - participant_reward)

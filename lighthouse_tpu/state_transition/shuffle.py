@@ -1,0 +1,72 @@
+"""Swap-or-not shuffle (spec `compute_shuffled_index` / full-list shuffle).
+
+Reference: /root/reference/consensus/swap_or_not_shuffle (scalar Rust).
+TPU-first design: the full-list shuffle is vectorized — each of the 90
+rounds operates on ALL indices at once with numpy (and the per-round
+"source" bytes are produced by one batched hash sweep), instead of the
+reference's per-index loop.  This is the committee-shuffling hot path for
+~1M validators.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def compute_shuffled_index(index: int, count: int, seed: bytes, rounds: int) -> int:
+    """Single-index forward shuffle (spec semantics, scalar)."""
+    assert index < count
+    for r in range(rounds):
+        pivot = int.from_bytes(
+            hashlib.sha256(seed + bytes([r])).digest()[:8], "little"
+        ) % count
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = hashlib.sha256(
+            seed + bytes([r]) + (position // 256).to_bytes(4, "little")
+        ).digest()
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) & 1:
+            index = flip
+    return index
+
+
+def shuffle_list(indices: np.ndarray, seed: bytes, rounds: int) -> np.ndarray:
+    """Vectorized full-list shuffle: permutation of `indices`.
+
+    Equivalent to applying compute_shuffled_index to every position (the
+    output at shuffled position i is indices[unshuffled original]).  We
+    compute, for every position at once, the 90 swap-or-not rounds as
+    column operations.
+    """
+    count = indices.shape[0]
+    if count <= 1:
+        return indices.copy()
+    pos = np.arange(count, dtype=np.int64)
+    # forward shuffle of positions: track where each original index lands…
+    # simpler: compute the permutation by applying rounds to the position
+    # array exactly as the scalar loop does to a single index.
+    cur = pos.copy()
+    for r in range(rounds):
+        pivot = int.from_bytes(
+            hashlib.sha256(seed + bytes([r])).digest()[:8], "little"
+        ) % count
+        flip = (pivot - cur) % count
+        position = np.maximum(cur, flip)
+        # batched source bytes: hash(seed + r + chunk) for every needed chunk
+        n_chunks = (count - 1) // 256 + 1
+        prefix = seed + bytes([r])
+        chunk_hashes = np.empty((n_chunks, 32), dtype=np.uint8)
+        for c in range(n_chunks):
+            chunk_hashes[c] = np.frombuffer(
+                hashlib.sha256(prefix + c.to_bytes(4, "little")).digest(), np.uint8
+            )
+        byte_idx = (position % 256) // 8
+        bytes_ = chunk_hashes[position // 256, byte_idx]
+        bits = (bytes_ >> (position % 8).astype(np.uint8)) & 1
+        cur = np.where(bits.astype(bool), flip, cur)
+    out = np.empty(count, dtype=indices.dtype)
+    out[:] = indices[cur]
+    return out

@@ -1,0 +1,185 @@
+"""SignatureSet constructors: (state, operation) -> bls.SignatureSet.
+
+Rebuild of the reference's 19 constructors
+(/root/reference/consensus/state_processing/src/per_block_processing/signature_sets.rs:56-670):
+each consensus operation yields one (or more) SignatureSets which the
+BlockSignatureVerifier accumulates into a single batched
+`verify_signature_sets` call on the active backend — the TPU offload seam.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import misc
+
+
+def _pubkey(state, index: int) -> bls.PublicKey:
+    return bls.PublicKey(state.validators.pubkeys[int(index)].tobytes())
+
+
+def block_proposal_set(state, spec, signed_block, block_root: bytes | None = None):
+    block = signed_block.message
+    root = block_root if block_root is not None else block.hash_tree_root()
+    domain = misc.get_domain(
+        state, spec, spec.domain_beacon_proposer,
+        spec.compute_epoch_at_slot(int(block.slot)))
+    signing_root = misc.compute_signing_root(root, domain)
+    return bls.SignatureSet(
+        bls.Signature(signed_block.signature),
+        [_pubkey(state, block.proposer_index)],
+        signing_root,
+    )
+
+
+def randao_set(state, spec, block):
+    epoch = spec.compute_epoch_at_slot(int(block.slot))
+    domain = misc.get_domain(state, spec, spec.domain_randao, epoch)
+    from lighthouse_tpu import ssz
+
+    signing_root = misc.compute_signing_root(
+        ssz.uint64.hash_tree_root(epoch), domain)
+    return bls.SignatureSet(
+        bls.Signature(block.body.randao_reveal),
+        [_pubkey(state, block.proposer_index)],
+        signing_root,
+    )
+
+
+def proposer_slashing_sets(state, spec, slashing):
+    out = []
+    for signed_header in (slashing.signed_header_1, slashing.signed_header_2):
+        header = signed_header.message
+        domain = misc.get_domain(
+            state, spec, spec.domain_beacon_proposer,
+            spec.compute_epoch_at_slot(int(header.slot)))
+        signing_root = misc.compute_signing_root(header.hash_tree_root(), domain)
+        out.append(bls.SignatureSet(
+            bls.Signature(signed_header.signature),
+            [_pubkey(state, header.proposer_index)],
+            signing_root,
+        ))
+    return out
+
+
+def indexed_attestation_set(state, spec, indexed):
+    domain = misc.get_domain(
+        state, spec, spec.domain_beacon_attester, int(indexed.data.target.epoch))
+    signing_root = misc.compute_signing_root(indexed.data.hash_tree_root(), domain)
+    pubkeys = [_pubkey(state, i) for i in np.asarray(indexed.attesting_indices)]
+    return bls.SignatureSet(bls.Signature(indexed.signature), pubkeys, signing_root)
+
+
+def deposit_set(deposit_data):
+    """Deposit signatures use the genesis fork version and empty GVR (they
+    predate the chain)."""
+    domain = bls and misc.compute_domain(3, b"\x00\x00\x00\x00", b"\x00" * 32)
+    msg = T.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    signing_root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(deposit_data.signature),
+        [bls.PublicKey(deposit_data.pubkey)],
+        signing_root,
+    )
+
+
+def voluntary_exit_set(state, spec, signed_exit):
+    exit_ = signed_exit.message
+    # capella+: exits are signed with the capella fork domain even after
+    # later forks (deneb rule); pre-deneb states use the epoch's fork.
+    fork = spec.fork_at_epoch(misc.current_epoch(state, spec))
+    if fork in ("deneb", "electra"):
+        domain = misc.compute_domain(
+            spec.domain_voluntary_exit,
+            spec.fork_version("capella"),
+            state.genesis_validators_root,
+        )
+    else:
+        domain = misc.get_domain(
+            state, spec, spec.domain_voluntary_exit, int(exit_.epoch))
+    signing_root = misc.compute_signing_root(exit_.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(signed_exit.signature),
+        [_pubkey(state, exit_.validator_index)],
+        signing_root,
+    )
+
+
+def bls_to_execution_change_set(state, spec, signed_change):
+    change = signed_change.message
+    # signed with GENESIS fork version regardless of current fork
+    domain = misc.compute_domain(
+        spec.domain_bls_to_execution_change,
+        spec.genesis_fork_version,
+        state.genesis_validators_root,
+    )
+    signing_root = misc.compute_signing_root(change.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(signed_change.signature),
+        [bls.PublicKey(change.from_bls_pubkey)],
+        signing_root,
+    )
+
+
+def sync_aggregate_set(state, spec, sync_aggregate, block_slot: int):
+    """Aggregate of current sync committee members over the previous slot's
+    block root."""
+    previous_slot = max(int(block_slot), 1) - 1
+    domain = misc.get_domain(
+        state, spec, spec.domain_sync_committee,
+        spec.compute_epoch_at_slot(previous_slot))
+    block_root = misc.get_block_root_at_slot(state, spec, previous_slot)
+    signing_root = misc.compute_signing_root(block_root, domain)
+    bits = sync_aggregate.sync_committee_bits
+    pubkeys = [
+        bls.PublicKey(pk)
+        for pk, bit in zip(state.current_sync_committee.pubkeys, bits)
+        if bit
+    ]
+    return bls.SignatureSet(
+        bls.Signature(sync_aggregate.sync_committee_signature),
+        pubkeys,
+        signing_root,
+    ), pubkeys
+
+
+def selection_proof_set(state, spec, slot: int, validator_index: int, proof: bytes):
+    domain = misc.get_domain(
+        state, spec, spec.domain_selection_proof,
+        spec.compute_epoch_at_slot(slot))
+    from lighthouse_tpu import ssz
+
+    signing_root = misc.compute_signing_root(ssz.uint64.hash_tree_root(slot), domain)
+    return bls.SignatureSet(
+        bls.Signature(proof), [_pubkey(state, validator_index)], signing_root)
+
+
+def aggregate_and_proof_set(state, spec, signed_aggregate):
+    msg = signed_aggregate.message
+    domain = misc.get_domain(
+        state, spec, spec.domain_aggregate_and_proof,
+        spec.compute_epoch_at_slot(int(msg.aggregate.data.slot)))
+    signing_root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(signed_aggregate.signature),
+        [_pubkey(state, msg.aggregator_index)],
+        signing_root,
+    )
+
+
+def sync_committee_message_set(state, spec, message):
+    domain = misc.get_domain(
+        state, spec, spec.domain_sync_committee,
+        spec.compute_epoch_at_slot(int(message.slot)))
+    signing_root = misc.compute_signing_root(message.beacon_block_root, domain)
+    return bls.SignatureSet(
+        bls.Signature(message.signature),
+        [_pubkey(state, message.validator_index)],
+        signing_root,
+    )

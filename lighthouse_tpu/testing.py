@@ -1,0 +1,233 @@
+"""In-process chain harness: produce and sign valid blocks on interop keys.
+
+Rebuild of the reference's `BeaconChainHarness`
+(/root/reference/beacon_node/beacon_chain/src/test_utils.rs:611): extend a
+chain block-by-block with correctly signed randao/proposals/sync
+aggregates/attestations, entirely in-process, no network.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    genesis_state,
+    interop_secret_key,
+    misc,
+    process_block,
+    state_advance,
+)
+from lighthouse_tpu.state_transition.block_processing import (
+    get_expected_withdrawals,
+)
+
+
+class Harness:
+    """`real_crypto=False` mirrors the reference's fake_crypto test builds:
+    deterministic dummy signatures + the "fake" verification backend, so
+    transition-logic tests don't pay pairing costs (the crypto itself is
+    covered by the real-crypto tests and tests/test_bls.py)."""
+
+    def __init__(self, n_validators: int = 64, spec: T.ChainSpec | None = None,
+                 fork: str = "capella", real_crypto: bool = True):
+        self.spec = spec or T.ChainSpec.minimal().with_forks_at(0, through=fork)
+        self.fork = fork
+        self.real_crypto = real_crypto
+        self.t = T.make_types(self.spec.preset)
+        self.state = genesis_state(n_validators, self.spec, fork)
+        self.genesis_root = self.state.latest_block_header.hash_tree_root()
+        self._sk_by_pubkey = {}
+        for i in range(n_validators):
+            sk = interop_secret_key(i)
+            self._sk_by_pubkey[sk.public_key().to_bytes()] = sk
+
+    # --- signing helpers ---------------------------------------------------
+
+    def sk(self, validator_index: int) -> bls.SecretKey:
+        pk = self.state.validators.pubkeys[validator_index].tobytes()
+        return self._sk_by_pubkey[pk]
+
+    def _sign(self, sk, obj_root: bytes, domain_type: int, epoch: int) -> bytes:
+        if not self.real_crypto:
+            return b"\xab" * 96
+        domain = misc.get_domain(self.state, self.spec, domain_type, epoch)
+        return sk.sign(misc.compute_signing_root(obj_root, domain)).to_bytes()
+
+    def _verify_strategy(self) -> SignatureStrategy:
+        return (SignatureStrategy.VERIFY_BULK if self.real_crypto
+                else SignatureStrategy.NO_VERIFICATION)
+
+    # --- block production --------------------------------------------------
+
+    def produce_block(self, slot: int | None = None, attestations=()):
+        """Produce a fully valid signed block at `slot` (default: next slot).
+
+        Advances self.state to the block's slot as a side effect of
+        production (on a copy), then applies the block to self.state.
+        """
+        spec, t = self.spec, self.t
+        target_slot = int(self.state.slot) + 1 if slot is None else slot
+
+        # work on a copy advanced to the target slot
+        pre = self.state.copy()
+        state_advance(pre, spec, target_slot)
+
+        proposer = misc.get_beacon_proposer_index(pre, spec)
+        sk = self.sk(proposer)
+        epoch = spec.compute_epoch_at_slot(target_slot)
+
+        randao_reveal = self._sign(
+            sk, ssz.uint64.hash_tree_root(epoch), spec.domain_randao, epoch)
+
+        body_kw = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=pre.eth1_data,
+            graffiti=b"lighthouse-tpu".ljust(32, b"\x00"),
+            attestations=list(attestations),
+        )
+        if self.fork != "phase0":
+            body_kw["sync_aggregate"] = self._sync_aggregate(pre, target_slot)
+        if self.fork in ("bellatrix", "capella", "deneb"):
+            body_kw["execution_payload"] = self._execution_payload(pre, target_slot)
+
+        body = t.beacon_block_body_class(self.fork)(**body_kw)
+        parent_root = self._parent_root(pre)
+        block = t.beacon_block_class(self.fork)(
+            slot=target_slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+
+        # trial-apply to compute the post-state root
+        trial = pre.copy()
+        trial_signed = t.signed_beacon_block_class(self.fork)(
+            message=block, signature=b"\x00" * 95 + b"\x01")
+        process_block(
+            trial, spec, trial_signed, SignatureStrategy.NO_VERIFICATION)
+        block.state_root = trial.hash_tree_root()
+
+        sig = self._sign(
+            sk, block.hash_tree_root(), spec.domain_beacon_proposer, epoch)
+        return t.signed_beacon_block_class(self.fork)(
+            message=block, signature=sig)
+
+    def _parent_root(self, advanced_state) -> bytes:
+        header = advanced_state.latest_block_header
+        if header.state_root == b"\x00" * 32:
+            # root as it will appear after process_slot fills state_root —
+            # but advance already ran process_slot for past slots, so the
+            # header here always has its state root filled unless genesis
+            hdr = T.BeaconBlockHeader(
+                slot=header.slot, proposer_index=header.proposer_index,
+                parent_root=header.parent_root,
+                state_root=advanced_state.hash_tree_root(),
+                body_root=header.body_root)
+            return hdr.hash_tree_root()
+        return header.hash_tree_root()
+
+    def _sync_aggregate(self, pre, slot: int):
+        spec = self.spec
+        prev_slot = max(slot, 1) - 1
+        domain = misc.get_domain(
+            pre, spec, spec.domain_sync_committee,
+            spec.compute_epoch_at_slot(prev_slot))
+        root = misc.get_block_root_at_slot(pre, spec, prev_slot)
+        signing_root = misc.compute_signing_root(root, domain)
+        sigs, bits = [], []
+        for pk in pre.current_sync_committee.pubkeys:
+            sk = self._sk_by_pubkey.get(pk)
+            if sk is None:
+                bits.append(False)
+                continue
+            if self.real_crypto:
+                sigs.append(sk.sign(signing_root))
+            bits.append(True)
+        if not self.real_crypto:
+            agg = b"\xab" * 96 if any(bits) else b"\xc0" + b"\x00" * 95
+        else:
+            agg = (bls.Signature.aggregate(sigs).to_bytes()
+                   if sigs else b"\xc0" + b"\x00" * 95)
+        return self.t.SyncAggregate(
+            sync_committee_bits=bits, sync_committee_signature=agg)
+
+    def _execution_payload(self, pre, slot: int):
+        spec = self.spec
+        parent_hash = pre.latest_execution_payload_header.block_hash
+        block_hash = hashlib.sha256(parent_hash + slot.to_bytes(8, "little")).digest()
+        cls = {
+            "bellatrix": self.t.ExecutionPayloadBellatrix,
+            "capella": self.t.ExecutionPayloadCapella,
+            "deneb": self.t.ExecutionPayloadDeneb,
+        }[self.fork]
+        kw = dict(
+            parent_hash=parent_hash,
+            prev_randao=misc.get_randao_mix(
+                pre, spec, spec.compute_epoch_at_slot(slot)),
+            block_number=slot,
+            timestamp=int(pre.genesis_time) + slot * spec.seconds_per_slot,
+            block_hash=block_hash,
+        )
+        if self.fork in ("capella", "deneb"):
+            kw["withdrawals"] = get_expected_withdrawals(pre, spec)
+        return cls(**kw)
+
+    # --- attestations -------------------------------------------------------
+
+    def attest(self, slot: int | None = None, committee_index: int = 0):
+        """All committee members attest to the current head at `slot`."""
+        spec, state = self.spec, self.state
+        s = int(state.slot) if slot is None else slot
+        epoch = spec.compute_epoch_at_slot(s)
+        committee = misc.get_beacon_committee(state, spec, s, committee_index)
+        head_root = self._parent_root(state)
+        target_root = (
+            head_root if spec.compute_start_slot_at_epoch(epoch) >= int(state.slot)
+            else misc.get_block_root(state, spec, epoch))
+        source = (
+            state.current_justified_checkpoint
+            if epoch == misc.current_epoch(state, spec)
+            else state.previous_justified_checkpoint)
+        data = T.AttestationData(
+            slot=s, index=committee_index,
+            beacon_block_root=head_root,
+            source=source,
+            target=T.Checkpoint(epoch=epoch, root=target_root),
+        )
+        if self.real_crypto:
+            domain = misc.get_domain(state, spec, spec.domain_beacon_attester, epoch)
+            signing_root = misc.compute_signing_root(data.hash_tree_root(), domain)
+            sigs = [self.sk(int(v)).sign(signing_root) for v in committee]
+            sig = bls.Signature.aggregate(sigs).to_bytes()
+        else:
+            sig = b"\xab" * 96
+        return self.t.Attestation(
+            aggregation_bits=[True] * committee.shape[0],
+            data=data,
+            signature=sig,
+        )
+
+    # --- driving ------------------------------------------------------------
+
+    def extend_chain(self, n_blocks: int, with_attestations: bool = True):
+        """Apply n blocks to self.state, optionally packing attestations from
+        the previous slot."""
+        from lighthouse_tpu.state_transition import state_transition
+
+        blocks = []
+        for _ in range(n_blocks):
+            atts = []
+            if with_attestations and int(self.state.slot) > 0:
+                atts = [self.attest()]
+            signed = self.produce_block(attestations=atts)
+            state_transition(self.state, self.spec, signed,
+                             self._verify_strategy())
+            blocks.append(signed)
+        return blocks
