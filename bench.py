@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Headline benchmark for lighthouse_tpu — one JSON line on stdout.
+
+Measures the device data plane against the host baseline on the BASELINE.md
+configs that are implemented so far.  Headline metric evolves with the build:
+
+  round-1 current: SSZ/SHA-256 merkleization throughput (BASELINE config #4,
+  the 1M-validator tree_hash_root analogue) — device batched-pair hashes/sec,
+  vs_baseline = speedup over single-thread host hashlib (the reference's
+  ethereum_hashing CPU path analogue measured in-process).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench_merkleize() -> dict:
+    import jax
+
+    from lighthouse_tpu.ops import sha256 as sha_ops
+
+    # 2^20 leaf chunks ≈ the per-field leaf count of a 1M-validator registry
+    # column (BASELINE config #4).  Total pair-hashes for the fold = 2^20 - 1.
+    log_leaves = 20
+    n_leaves = 1 << log_leaves
+    rng = np.random.default_rng(0)
+    leaves = rng.integers(0, 2**32, size=(n_leaves, 8), dtype=np.uint64).astype(
+        np.uint32
+    )
+
+    # --- device path (warm up compile first) -------------------------------
+    def device_merkle_root(lvl):
+        # fold entirely on device: one hash_pairs_device sweep per level
+        import jax.numpy as jnp
+
+        x = jnp.asarray(lvl)
+        while x.shape[0] > 1:
+            x = sha_ops.hash_pairs_device(x.reshape(x.shape[0] // 2, 16))
+        return x
+
+    device_merkle_root(leaves[:2048]).block_until_ready()  # compile small
+    device_merkle_root(leaves).block_until_ready()  # compile all levels
+    n_iters = 3
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        root = device_merkle_root(leaves).block_until_ready()
+    dt_device = (time.perf_counter() - t0) / n_iters
+    n_hashes = n_leaves - 1
+    device_rate = n_hashes / dt_device
+
+    # --- host baseline (hashlib, single-thread, sampled + scaled) ----------
+    sample = leaves[: 1 << 14].reshape(-1, 16)  # 8192 pair-hashes
+    t0 = time.perf_counter()
+    out = sha_ops.hash_pairs_np(sample)
+    dt_host_sample = time.perf_counter() - t0
+    host_rate = sample.shape[0] / dt_host_sample
+
+    # correctness cross-check on the sample
+    dev_sample = np.asarray(sha_ops.hash_pairs_device(sample))
+    assert np.array_equal(out, dev_sample), "device/host SHA-256 mismatch"
+    del root
+
+    return {
+        "metric": "sha256_merkleize_1M_leaf_fold",
+        "value": round(device_rate / 1e6, 4),
+        "unit": "Mhash/s",
+        "vs_baseline": round(device_rate / host_rate, 3),
+    }
+
+
+def main() -> None:
+    result = _bench_merkleize()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,8 +166,18 @@ def process_operations(state, spec, body, fork, strategy, verifier) -> None:
         process_proposer_slashing(state, spec, ps, strategy, verifier)
     for asl in body.attester_slashings:
         process_attester_slashing(state, spec, asl, strategy, verifier)
+    # one committee shuffle per referenced epoch (at most two) and one
+    # proposer lookup serve every attestation in the block
+    shuffles: dict[int, np.ndarray] = {}
+    proposer = (
+        misc.get_beacon_proposer_index(state, spec) if body.attestations else None)
     for att in body.attestations:
-        process_attestation(state, spec, att, fork, strategy, verifier)
+        ep = int(att.data.target.epoch)
+        if ep not in shuffles:
+            shuffles[ep] = misc.compute_committee_shuffle(state, spec, ep)
+        process_attestation(
+            state, spec, att, fork, strategy, verifier,
+            shuffled=shuffles[ep], proposer=proposer)
     for dep in body.deposits:
         process_deposit(state, spec, dep)
     for exit_ in body.voluntary_exits:
@@ -323,7 +333,8 @@ def get_attestation_participation_flag_indices(
 
 
 def process_attestation(
-    state, spec, attestation, fork, strategy, verifier, shuffled=None
+    state, spec, attestation, fork, strategy, verifier, shuffled=None,
+    proposer: int | None = None,
 ) -> None:
     data = attestation.data
     cur = misc.current_epoch(state, spec)
@@ -371,7 +382,8 @@ def process_attestation(
             add_flag(participation, idxs[fresh], flag_index)
     proposer_reward = proposer_reward_numerator // (
         (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
-    proposer = misc.get_beacon_proposer_index(state, spec)
+    if proposer is None:
+        proposer = misc.get_beacon_proposer_index(state, spec)
     state.balances[proposer] += np.uint64(proposer_reward)
 
 
@@ -403,7 +415,7 @@ def apply_deposit(state, spec, deposit_data, check_signature: bool = True) -> No
         state.balances[idx] += np.uint64(amount)
         return
     if check_signature:
-        sset = sigs.deposit_set(deposit_data)
+        sset = sigs.deposit_set(spec, deposit_data)
         if not bls.verify_signature_sets([sset]):
             return  # invalid proof-of-possession: deposit is skipped, not fatal
     state.validators.append(**get_validator_from_deposit(
@@ -531,9 +543,11 @@ def process_withdrawals(state, spec, payload) -> None:
         state.next_withdrawal_validator_index = (
             int(expected[-1].validator_index) + 1) % n
     else:
-        bound = min(n, spec.preset.max_validators_per_withdrawals_sweep)
+        # the cursor advances by the raw sweep constant even when the registry
+        # is smaller (capella spec process_withdrawals; NOT min(n, sweep))
         state.next_withdrawal_validator_index = (
-            int(state.next_withdrawal_validator_index) + bound) % n
+            int(state.next_withdrawal_validator_index)
+            + spec.preset.max_validators_per_withdrawals_sweep) % n
 
 
 # --- execution payload (header-only verification) ---------------------------
@@ -614,12 +628,13 @@ def process_sync_aggregate(state, spec, aggregate, block_slot, strategy, verifie
         participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
 
     proposer = misc.get_beacon_proposer_index(state, spec)
-    # committee pubkey -> validator index (registry lookup)
-    pubkeys = state.validators.pubkeys
+    # one pass over the registry builds the pubkey -> index map for all 512
+    # committee members (instead of an O(n) scan per member)
+    index_of = {
+        pk.tobytes(): i for i, pk in enumerate(state.validators.pubkeys)}
     for pk, bit in zip(state.current_sync_committee.pubkeys, aggregate.sync_committee_bits):
-        matches = np.nonzero((pubkeys == np.frombuffer(pk, np.uint8)).all(axis=1))[0]
-        _err(matches.size > 0, "sync committee pubkey not in registry")
-        vidx = int(matches[0])
+        vidx = index_of.get(bytes(pk))
+        _err(vidx is not None, "sync committee pubkey not in registry")
         if bit:
             state.balances[vidx] += np.uint64(participant_reward)
             state.balances[proposer] += np.uint64(proposer_reward)

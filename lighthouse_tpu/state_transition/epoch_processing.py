@@ -275,7 +275,7 @@ def process_registry_updates(state, spec: T.ChainSpec) -> None:
     )
     idxs = np.nonzero(pending)[0]
     order = np.lexsort((idxs, v.activation_eligibility_epoch[idxs]))
-    churn = misc.get_validator_churn_limit(state, spec)
+    churn = misc.get_validator_activation_churn_limit(state, spec)
     dequeued = idxs[order][:churn]
     v.activation_epoch[dequeued] = spec.compute_activation_exit_epoch(cur)
 

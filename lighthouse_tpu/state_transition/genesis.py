@@ -96,9 +96,11 @@ def genesis_state(
         spec.preset.validator_registry_limit).hash_tree_root(state.validators)
 
     if fork != "phase0":
+        # both committees are derived from the identical genesis state, so
+        # one computation serves both (spec initialize_beacon_state semantics)
         committee = misc.get_next_sync_committee(state, spec, t)
         state.current_sync_committee = committee
-        state.next_sync_committee = misc.get_next_sync_committee(state, spec, t)
+        state.next_sync_committee = committee
 
     if fork in ("bellatrix", "capella", "deneb"):
         # a synthetic pre-existing execution head so payload checks chain

@@ -93,6 +93,15 @@ def get_validator_churn_limit(state, spec: T.ChainSpec) -> int:
     return max(spec.min_per_epoch_churn_limit, active // spec.churn_limit_quotient)
 
 
+def get_validator_activation_churn_limit(state, spec: T.ChainSpec) -> int:
+    """Deneb+ caps per-epoch activations below the uncapped churn limit."""
+    churn = get_validator_churn_limit(state, spec)
+    if spec.fork_at_epoch(current_epoch(state, spec)) in (
+            "phase0", "altair", "bellatrix", "capella"):
+        return churn
+    return min(spec.max_per_epoch_activation_churn_limit, churn)
+
+
 def get_committee_count_per_slot(spec: T.ChainSpec, active_count: int) -> int:
     return max(
         1,

@@ -72,10 +72,11 @@ def indexed_attestation_set(state, spec, indexed):
     return bls.SignatureSet(bls.Signature(indexed.signature), pubkeys, signing_root)
 
 
-def deposit_set(deposit_data):
+def deposit_set(spec, deposit_data):
     """Deposit signatures use the genesis fork version and empty GVR (they
     predate the chain)."""
-    domain = bls and misc.compute_domain(3, b"\x00\x00\x00\x00", b"\x00" * 32)
+    domain = misc.compute_domain(
+        spec.domain_deposit, spec.genesis_fork_version, b"\x00" * 32)
     msg = T.DepositMessage(
         pubkey=deposit_data.pubkey,
         withdrawal_credentials=deposit_data.withdrawal_credentials,
