@@ -1,0 +1,32 @@
+"""Fork choice: columnar LMD-GHOST proto-array + spec store.
+
+Reference: /root/reference/consensus/{proto_array,fork_choice}.
+"""
+
+from lighthouse_tpu.fork_choice.fork_choice import (
+    ForkChoice,
+    ForkChoiceError,
+    QueuedAttestation,
+)
+from lighthouse_tpu.fork_choice.proto_array import (
+    EXEC_INVALID,
+    EXEC_IRRELEVANT,
+    EXEC_OPTIMISTIC,
+    EXEC_VALID,
+    CheckpointKey,
+    ProtoArray,
+    ProtoArrayError,
+)
+
+__all__ = [
+    "ForkChoice",
+    "ForkChoiceError",
+    "QueuedAttestation",
+    "ProtoArray",
+    "ProtoArrayError",
+    "CheckpointKey",
+    "EXEC_IRRELEVANT",
+    "EXEC_OPTIMISTIC",
+    "EXEC_VALID",
+    "EXEC_INVALID",
+]

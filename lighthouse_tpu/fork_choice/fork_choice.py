@@ -1,0 +1,431 @@
+"""Spec fork choice: on_block / on_attestation / get_head over ProtoArray.
+
+Rebuild of /root/reference/consensus/fork_choice/src/fork_choice.rs
+(`on_block` :642, `on_attestation` :1037, `on_attester_slashing` :1089,
+`get_head` :468) plus the vote-delta machinery from
+proto_array/src/proto_array_fork_choice.rs (`compute_deltas`).
+
+TPU-first data layout: votes are three numpy columns over validator index
+(current vote node, next vote node, next vote epoch), so `compute_deltas`
+is two vectorized scatter-adds (np.add.at) instead of a per-validator loop
+— the same shape the device-side batch reductions use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.fork_choice.proto_array import (
+    EXEC_IRRELEVANT,
+    NONE,
+    CheckpointKey,
+    ProtoArray,
+    ProtoArrayError,
+)
+from lighthouse_tpu.state_transition import misc
+from lighthouse_tpu.state_transition.epoch_processing import (
+    process_justification_and_finalization,
+)
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+def _ckpt(cp) -> CheckpointKey:
+    return CheckpointKey(int(cp.epoch), bytes(cp.root))
+
+
+class QueuedAttestation:
+    __slots__ = ("slot", "indices", "root", "target_epoch")
+
+    def __init__(self, slot, indices, root, target_epoch):
+        self.slot, self.indices = slot, indices
+        self.root, self.target_epoch = root, target_epoch
+
+
+class ForkChoice:
+    """The protocol store + proto-array + columnar vote tracker."""
+
+    def __init__(
+        self,
+        spec: T.ChainSpec,
+        anchor_root: bytes,
+        anchor_state,
+        balances_fn: Callable[[bytes], np.ndarray] | None = None,
+    ):
+        self.spec = spec
+        self.proto = ProtoArray()
+        self.time_slot = int(anchor_state.slot)
+        self.genesis_time = int(anchor_state.genesis_time)
+
+        anchor_epoch = spec.compute_epoch_at_slot(int(anchor_state.slot))
+        anchor_cp = CheckpointKey(anchor_epoch, anchor_root)
+        jc, fc = anchor_state.current_justified_checkpoint, anchor_state.finalized_checkpoint
+        self.justified = _ckpt(jc) if int(jc.epoch) else anchor_cp
+        self.finalized = _ckpt(fc) if int(fc.epoch) else anchor_cp
+        # the anchor must be findable by the justified root
+        if self.justified.root not in (anchor_root,):
+            self.justified = anchor_cp
+        if self.finalized.root not in (anchor_root,):
+            self.finalized = anchor_cp
+
+        self._balances_fn = balances_fn
+        self._balance_snapshots: dict[bytes, np.ndarray] = {}
+        eb = np.asarray(anchor_state.validators.effective_balance, np.int64).copy()
+        active = anchor_state.validators.is_active(anchor_epoch)
+        eb[~active] = 0
+        self._balance_snapshots[anchor_root] = eb
+        self.justified_balances = self._balances_for(self.justified.root)
+
+        nv = eb.shape[0]
+        self._vote_current = np.full(nv, NONE, np.int32)
+        self._vote_next = np.full(nv, NONE, np.int32)
+        self._vote_next_epoch = np.zeros(nv, np.int64)
+        self._old_balances = np.zeros(nv, np.int64)
+        self.equivocating = np.zeros(nv, bool)
+
+        self.proposer_boost_root: bytes | None = None
+        self._applied_boost_root: bytes | None = None
+        self._applied_boost_amount = 0
+        self._queued: list[QueuedAttestation] = []
+        # best unrealized checkpoints seen this epoch; promoted into the
+        # store at the next epoch tick (spec pull_up_store_checkpoints)
+        self._best_unrealized_j = self.justified
+        self._best_unrealized_f = self.finalized
+
+        self.proto.add_block(
+            anchor_root, None, int(anchor_state.slot),
+            self.justified, self.finalized,
+            execution_status=EXEC_IRRELEVANT,
+        )
+
+    # -- balances ---------------------------------------------------------
+
+    def _balances_for(self, root: bytes) -> np.ndarray:
+        if root in self._balance_snapshots:
+            return self._balance_snapshots[root]
+        if self._balances_fn is not None:
+            b = np.asarray(self._balances_fn(root), np.int64)
+            self._balance_snapshots[root] = b
+            return b
+        # fall back to the most recent snapshot
+        return next(reversed(self._balance_snapshots.values()))
+
+    def _grow_votes(self, n: int):
+        cur = self._vote_current.shape[0]
+        if n <= cur:
+            return
+        pad = n - cur
+        self._vote_current = np.concatenate([self._vote_current, np.full(pad, NONE, np.int32)])
+        self._vote_next = np.concatenate([self._vote_next, np.full(pad, NONE, np.int32)])
+        self._vote_next_epoch = np.concatenate([self._vote_next_epoch, np.zeros(pad, np.int64)])
+        self._old_balances = np.concatenate([self._old_balances, np.zeros(pad, np.int64)])
+        self.equivocating = np.concatenate([self.equivocating, np.zeros(pad, bool)])
+
+    # -- time -------------------------------------------------------------
+
+    def update_time(self, current_slot: int) -> None:
+        if current_slot > self.time_slot:
+            prev_epoch = self.spec.compute_epoch_at_slot(self.time_slot)
+            self.time_slot = current_slot
+            # boost expires every slot (spec: on_tick resets proposer boost)
+            self.proposer_boost_root = None
+            if self.spec.compute_epoch_at_slot(current_slot) > prev_epoch:
+                # epoch tick: pull unrealized checkpoints into the store
+                # (spec on_tick → pull_up_store_checkpoints)
+                self._update_checkpoints(
+                    self._best_unrealized_j, self._best_unrealized_f)
+            self._dequeue(current_slot)
+
+    def _dequeue(self, current_slot: int):
+        still = []
+        for q in self._queued:
+            if q.slot < current_slot:
+                self._apply_attestation(q.indices, q.root, q.target_epoch)
+            else:
+                still.append(q)
+        self._queued = still
+
+    # -- on_block ---------------------------------------------------------
+
+    def on_block(
+        self,
+        current_slot: int,
+        block,
+        block_root: bytes,
+        state,
+        execution_status: int = EXEC_IRRELEVANT,
+        is_timely: bool = False,
+    ) -> None:
+        """Register an imported block (reference fork_choice.rs:642).
+
+        `state` is the post-state of the block; unrealized justification is
+        computed from it directly (run justification weighing on the live
+        participation counters, then restore — the reference computes the
+        same via its ParticipationCache without cloning the state).
+        """
+        spec = self.spec
+        self.update_time(max(current_slot, self.time_slot))
+        slot = int(block.slot)
+        if block_root in self.proto:
+            return
+        parent_root = bytes(block.parent_root)
+        if parent_root not in self.proto:
+            raise ForkChoiceError(f"unknown parent {parent_root.hex()[:16]}")
+        if slot > current_slot:
+            raise ForkChoiceError("block from the future")
+        fin_slot = spec.compute_start_slot_at_epoch(self.finalized.epoch)
+        if slot <= fin_slot:
+            raise ForkChoiceError("block slot not beyond finalized slot")
+        if self.proto.get_ancestor(parent_root, fin_slot) != self.finalized.root:
+            raise ForkChoiceError("block does not descend from finalized root")
+
+        justified = _ckpt(state.current_justified_checkpoint)
+        finalized = _ckpt(state.finalized_checkpoint)
+        unrealized_j, unrealized_f = self._compute_unrealized(state, justified, finalized)
+
+        block_epoch = spec.compute_epoch_at_slot(slot)
+        current_epoch = spec.compute_epoch_at_slot(current_slot)
+        if block_epoch < current_epoch:
+            # pull-up tip: blocks from prior epochs adopt their unrealized
+            # checkpoints immediately (spec compute_pulled_up_tip)
+            node_j, node_f = unrealized_j, unrealized_f
+        else:
+            node_j, node_f = justified, finalized
+
+        self._update_checkpoints(node_j, node_f)
+        # unrealized checkpoints are remembered but only promoted into the
+        # store at the next epoch tick (spec update_unrealized_checkpoints)
+        if unrealized_j.epoch > self._best_unrealized_j.epoch:
+            self._best_unrealized_j = unrealized_j
+        if unrealized_f.epoch > self._best_unrealized_f.epoch:
+            self._best_unrealized_f = unrealized_f
+
+        # snapshot effective balances only for justified-checkpoint
+        # candidates: blocks that begin a new epoch along their branch
+        # (a checkpoint root is always the first block at/after the epoch
+        # start).  Everything else resolves via _balances_fn on demand.
+        parent_idx = self.proto.indices[parent_root]
+        parent_epoch = spec.compute_epoch_at_slot(int(self.proto.slots[parent_idx]))
+        if block_epoch > parent_epoch or self._balances_fn is None:
+            eb = np.asarray(state.validators.effective_balance, np.int64).copy()
+            eb[~state.validators.is_active(block_epoch)] = 0
+            self._balance_snapshots[block_root] = eb
+        self._grow_votes(state.validators.effective_balance.shape[0])
+
+        if is_timely and slot == current_slot:
+            self.proposer_boost_root = block_root
+
+        self.proto.add_block(
+            block_root, parent_root, slot,
+            node_j, node_f, unrealized_j, unrealized_f, execution_status,
+        )
+
+    def _compute_unrealized(self, state, justified, finalized):
+        spec = self.spec
+        epoch = misc.current_epoch(state, spec)
+        if epoch <= T.GENESIS_EPOCH + 1:
+            return justified, finalized
+        snap = (
+            state.previous_justified_checkpoint,
+            state.current_justified_checkpoint,
+            state.finalized_checkpoint,
+            list(state.justification_bits),
+        )
+        try:
+            process_justification_and_finalization(state, spec)
+            uj = _ckpt(state.current_justified_checkpoint)
+            uf = _ckpt(state.finalized_checkpoint)
+        finally:
+            (state.previous_justified_checkpoint,
+             state.current_justified_checkpoint,
+             state.finalized_checkpoint) = snap[:3]
+            state.justification_bits = snap[3]
+        return uj, uf
+
+    def _update_checkpoints(self, justified: CheckpointKey, finalized: CheckpointKey):
+        if justified.epoch > self.justified.epoch:
+            self.justified = justified
+            self.justified_balances = self._balances_for(justified.root)
+        if finalized.epoch > self.finalized.epoch:
+            self.finalized = finalized
+
+    # -- attestations ------------------------------------------------------
+
+    def on_attestation(
+        self,
+        current_slot: int,
+        attesting_indices: np.ndarray,
+        beacon_block_root: bytes,
+        target_epoch: int,
+        att_slot: int,
+        is_from_block: bool = False,
+    ) -> None:
+        """Register LMD votes (reference fork_choice.rs:1037).
+
+        Chain-level validity (committee membership, signature) is the
+        caller's job; here: known head block, sane target, and the spec's
+        one-slot delay for gossip attestations (queued until next slot).
+        """
+        spec = self.spec
+        self.update_time(max(current_slot, self.time_slot))
+        current_epoch = spec.compute_epoch_at_slot(current_slot)
+        if not is_from_block:
+            if target_epoch not in (current_epoch, max(current_epoch - 1, 0)):
+                raise ForkChoiceError("attestation target epoch not current/previous")
+        if beacon_block_root not in self.proto:
+            raise ForkChoiceError("attestation for unknown block")
+        i = self.proto.indices[beacon_block_root]
+        if int(self.proto.slots[i]) > att_slot:
+            raise ForkChoiceError("attestation for block newer than attestation slot")
+        idx = np.asarray(attesting_indices, np.int64)
+        if not is_from_block and att_slot >= current_slot:
+            self._queued.append(
+                QueuedAttestation(att_slot, idx, beacon_block_root, target_epoch))
+            return
+        self._apply_attestation(idx, beacon_block_root, target_epoch)
+
+    def _apply_attestation(self, idx: np.ndarray, root: bytes, target_epoch: int):
+        node = self.proto.indices.get(root)
+        if node is None:
+            return
+        self._grow_votes(int(idx.max()) + 1 if idx.size else 0)
+        newer = target_epoch > self._vote_next_epoch[idx]
+        sel = idx[newer & ~self.equivocating[idx]]
+        self._vote_next[sel] = node
+        self._vote_next_epoch[sel] = target_epoch
+
+    def on_attester_slashing(self, attesting_indices: np.ndarray) -> None:
+        """Zero equivocating validators out of fork choice forever
+        (reference fork_choice.rs:1089)."""
+        idx = np.asarray(attesting_indices, np.int64)
+        if idx.size == 0:
+            return
+        self._grow_votes(int(idx.max()) + 1)
+        self.equivocating[idx] = True
+
+    # -- get_head ----------------------------------------------------------
+
+    def _compute_deltas(self) -> np.ndarray:
+        """Vectorized compute_deltas (proto_array_fork_choice.rs).
+
+        For every validator: subtract old balance at the current vote,
+        add new balance at the next vote, then commit next → current.
+        Equivocating validators contribute zero new weight.
+        """
+        n_nodes = len(self.proto)
+        deltas = np.zeros(n_nodes, np.int64)
+        nv = self._vote_current.shape[0]
+        new_bal = np.zeros(nv, np.int64)
+        jb = self.justified_balances
+        new_bal[: min(nv, jb.shape[0])] = jb[: min(nv, jb.shape[0])]
+        new_bal[self.equivocating] = 0
+        # equivocators never vote again; their next vote is cleared so the
+        # subtraction below removes their old weight exactly once
+        self._vote_next[self.equivocating] = NONE
+
+        cur, nxt = self._vote_current, self._vote_next
+        has_cur = (cur != NONE) & (cur < n_nodes)
+        has_nxt = nxt != NONE
+        np.add.at(deltas, cur[has_cur], -self._old_balances[has_cur])
+        np.add.at(deltas, nxt[has_nxt], new_bal[has_nxt])
+        # commit
+        self._vote_current = np.where(has_nxt, nxt, NONE).astype(np.int32)
+        self._old_balances = np.where(has_nxt, new_bal, 0)
+        return deltas
+
+    def _proposer_boost_amount(self) -> int:
+        spec = self.spec
+        total = int(self.justified_balances.sum())
+        committee_weight = total // spec.slots_per_epoch
+        return committee_weight * spec.proposer_score_boost // 100
+
+    def get_head(self, current_slot: int | None = None) -> bytes:
+        if current_slot is not None:
+            self.update_time(current_slot)
+        slot = self.time_slot
+        current_epoch = self.spec.compute_epoch_at_slot(slot)
+        deltas = self._compute_deltas()
+        # proposer boost: remove the previously applied boost, apply current
+        if self._applied_boost_root is not None:
+            i = self.proto.indices.get(self._applied_boost_root)
+            if i is not None:
+                deltas[i] -= self._applied_boost_amount
+            self._applied_boost_root = None
+            self._applied_boost_amount = 0
+        if self.proposer_boost_root is not None:
+            i = self.proto.indices.get(self.proposer_boost_root)
+            if i is not None:
+                amt = self._proposer_boost_amount()
+                deltas[i] += amt
+                self._applied_boost_root = self.proposer_boost_root
+                self._applied_boost_amount = amt
+        self.proto.apply_score_changes(
+            deltas, self.justified, self.finalized, current_epoch)
+        return self.proto.find_head(
+            self.justified.root, self.justified, self.finalized, current_epoch)
+
+    # -- proposer re-org ---------------------------------------------------
+
+    def get_proposer_head(
+        self, head_root: bytes, proposal_slot: int
+    ) -> bytes:
+        """Reference `get_proposer_head` (fork_choice.rs:516): propose on the
+        parent when the head block is late/weak and the parent is strong."""
+        spec = self.spec
+        i = self.proto.indices.get(head_root)
+        if i is None:
+            return head_root
+        head_slot = int(self.proto.slots[i])
+        p = self.proto.parents[i]
+        if p == NONE or head_slot + 1 != proposal_slot:
+            return head_root
+        if (self.spec.compute_epoch_at_slot(proposal_slot) - self.finalized.epoch
+                > spec.reorg_max_epochs_since_finalization):
+            return head_root
+        total = int(self.justified_balances.sum())
+        committee_weight = total // spec.slots_per_epoch
+        head_weak = int(self.proto.weights[i]) * 100 < (
+            committee_weight * spec.reorg_head_weight_threshold)
+        parent_strong = int(self.proto.weights[p]) * 100 > (
+            committee_weight * spec.reorg_parent_weight_threshold)
+        if head_weak and parent_strong:
+            return self.proto.roots[p]
+        return head_root
+
+    # -- optimistic sync / pruning ----------------------------------------
+
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        self.proto.set_execution_valid(root)
+
+    def on_invalid_execution_payload(self, root: bytes) -> None:
+        self.proto.set_execution_invalid(root)
+
+    def prune(self) -> None:
+        mapping = self.proto.prune(self.finalized.root)
+        # re-map vote node indices through the pruned index space
+        lut = np.full(max(mapping.keys(), default=0) + 1, NONE, np.int32)
+        for old, new in mapping.items():
+            lut[old] = new
+        for name in ("_vote_current", "_vote_next"):
+            col = getattr(self, name)
+            ok = (col != NONE) & (col < lut.shape[0])
+            out = np.full_like(col, NONE)
+            out[ok] = lut[col[ok]]
+            setattr(self, name, out)
+        # drop balance snapshots for pruned roots
+        live = set(self.proto.indices)
+        live.add(self.justified.root)
+        self._balance_snapshots = {
+            r: b for r, b in self._balance_snapshots.items() if r in live}
+
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.proto
+
+    def block_slot(self, root: bytes) -> int | None:
+        i = self.proto.indices.get(root)
+        return int(self.proto.slots[i]) if i is not None else None

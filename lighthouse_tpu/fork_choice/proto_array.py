@@ -1,0 +1,345 @@
+"""Columnar LMD-GHOST proto-array.
+
+Rebuild of the reference's flat-array fork choice store
+(/root/reference/consensus/proto_array/src/proto_array.rs).  The reference
+keeps a Vec of node structs; here the node store is a struct-of-arrays —
+every per-node field is one numpy column (parents, weights, best-child
+pointers, checkpoint epochs) so weight application and viability filtering
+are vectorized sweeps over the whole block DAG instead of per-node struct
+walks.  The only inherently sequential step — propagating child deltas into
+parents — is a single reverse pass over an int32 column (nodes are
+insertion-ordered, so every parent precedes its children).
+
+Execution status mirrors the reference's optimistic-sync statuses
+(proto_array.rs `ExecutionStatus`): blocks verified by an execution engine
+are Valid, known-bad payloads are Invalid (never viable for head), and
+not-yet-checked payloads are Optimistic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+NONE = -1
+
+# execution status column values
+EXEC_IRRELEVANT = 0  # pre-merge / no payload
+EXEC_OPTIMISTIC = 1  # payload not yet verified by an EL
+EXEC_VALID = 2
+EXEC_INVALID = 3
+
+
+@dataclass(frozen=True)
+class CheckpointKey:
+    epoch: int
+    root: bytes
+
+
+class ProtoArrayError(ValueError):
+    pass
+
+
+class ProtoArray:
+    """Struct-of-arrays node store for LMD-GHOST."""
+
+    _GROW = 1024
+
+    def __init__(self):
+        n = self._GROW
+        self.n_nodes = 0
+        self.slots = np.zeros(n, np.int64)
+        self.parents = np.full(n, NONE, np.int32)
+        self.weights = np.zeros(n, np.int64)
+        self.best_child = np.full(n, NONE, np.int32)
+        self.best_descendant = np.full(n, NONE, np.int32)
+        self.justified_epoch = np.zeros(n, np.int64)
+        self.finalized_epoch = np.zeros(n, np.int64)
+        self.unrealized_justified_epoch = np.zeros(n, np.int64)
+        self.unrealized_finalized_epoch = np.zeros(n, np.int64)
+        self.execution_status = np.zeros(n, np.int8)
+        self.roots: list[bytes] = []
+        self.indices: dict[bytes, int] = {}
+        # per-node checkpoint roots (small python lists; epochs above are the
+        # columns used in the vectorized viability filter)
+        self.justified_roots: list[bytes] = []
+        self.unrealized_justified_roots: list[bytes] = []
+
+    # -- plumbing ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.n_nodes
+
+    def __contains__(self, root: bytes) -> bool:
+        return root in self.indices
+
+    def _ensure_capacity(self):
+        if self.n_nodes < self.slots.shape[0]:
+            return
+        for name in ("slots", "parents", "weights", "best_child",
+                     "best_descendant", "justified_epoch", "finalized_epoch",
+                     "unrealized_justified_epoch", "unrealized_finalized_epoch",
+                     "execution_status"):
+            col = getattr(self, name)
+            fill = NONE if name in ("parents", "best_child", "best_descendant") else 0
+            grown = np.full(col.shape[0] * 2, fill, col.dtype)
+            grown[: col.shape[0]] = col
+            setattr(self, name, grown)
+
+    # -- mutation ---------------------------------------------------------
+
+    def add_block(
+        self,
+        root: bytes,
+        parent_root: bytes | None,
+        slot: int,
+        justified: CheckpointKey,
+        finalized: CheckpointKey,
+        unrealized_justified: CheckpointKey | None = None,
+        unrealized_finalized: CheckpointKey | None = None,
+        execution_status: int = EXEC_IRRELEVANT,
+    ) -> int:
+        if root in self.indices:
+            return self.indices[root]
+        parent = self.indices.get(parent_root, NONE) if parent_root else NONE
+        if parent_root is not None and parent == NONE and self.n_nodes > 0:
+            raise ProtoArrayError(f"unknown parent {parent_root.hex()[:16]}")
+        self._ensure_capacity()
+        i = self.n_nodes
+        self.n_nodes += 1
+        uj = unrealized_justified or justified
+        uf = unrealized_finalized or finalized
+        self.slots[i] = slot
+        self.parents[i] = parent
+        self.weights[i] = 0
+        self.best_child[i] = NONE
+        self.best_descendant[i] = NONE
+        self.justified_epoch[i] = justified.epoch
+        self.finalized_epoch[i] = finalized.epoch
+        self.unrealized_justified_epoch[i] = uj.epoch
+        self.unrealized_finalized_epoch[i] = uf.epoch
+        self.execution_status[i] = execution_status
+        self.roots.append(root)
+        self.indices[root] = i
+        self.justified_roots.append(justified.root)
+        self.unrealized_justified_roots.append(uj.root)
+        return i
+
+    # -- viability --------------------------------------------------------
+
+    def _viable_mask(
+        self, justified: CheckpointKey, finalized: CheckpointKey, current_epoch: int
+    ) -> np.ndarray:
+        """Vectorized `node_is_viable_for_head` over all nodes.
+
+        Mirrors the spec's filter_block_tree / the reference's
+        `node_is_viable_for_head`: the node's voting source must match the
+        store's justified epoch, or have been pulled up to it, or be recent
+        enough (within 2 epochs, the "lenient" rule); the node must descend
+        from the finalized block (one vectorizable forward sweep — parents
+        precede children, so descendant status propagates in index order);
+        invalid execution disqualifies outright.
+        """
+        n = self.n_nodes
+        je = self.justified_epoch[:n]
+        uje = self.unrealized_justified_epoch[:n]
+        ok_j = (
+            (justified.epoch == 0)
+            | (je == justified.epoch)
+            | (uje >= justified.epoch)
+            | (je + 2 >= current_epoch)
+        )
+        if finalized.epoch == 0 or finalized.root not in self.indices:
+            ok_f = np.ones(n, bool)
+        else:
+            fin = self.indices[finalized.root]
+            ok_f = np.zeros(n, bool)
+            ok_f[fin] = True
+            parents = self.parents[:n]
+            for i in range(fin + 1, n):
+                p = parents[i]
+                if p != NONE and ok_f[p]:
+                    ok_f[i] = True
+        ok_exec = self.execution_status[:n] != EXEC_INVALID
+        return ok_j & ok_f & ok_exec
+
+    # -- the core update --------------------------------------------------
+
+    def apply_score_changes(
+        self,
+        deltas: np.ndarray,
+        justified: CheckpointKey,
+        finalized: CheckpointKey,
+        current_epoch: int,
+    ) -> None:
+        """Add `deltas` (int64[n_nodes]) to node weights, propagate child →
+        parent, and rebuild best_child/best_descendant pointers.
+
+        Reference: proto_array.rs `apply_score_changes` +
+        `maybe_update_best_child_and_descendant`.  Deltas are propagated in
+        one reverse sweep; the best-pointer rebuild is a second reverse
+        sweep using the vectorized viability mask.
+        """
+        n = self.n_nodes
+        if n == 0:
+            return
+        if deltas.shape[0] != n:
+            raise ProtoArrayError("delta length mismatch")
+        d = deltas.astype(np.int64, copy=True)
+        parents = self.parents[:n]
+        # child → parent accumulation (reverse insertion order = reverse topo)
+        for i in range(n - 1, 0, -1):
+            p = parents[i]
+            if p != NONE:
+                d[p] += d[i]
+        self.weights[:n] += d
+
+        viable = self._viable_mask(justified, finalized, current_epoch)
+        weights = self.weights[:n]
+        best_child = np.full(n, NONE, np.int32)
+        best_descendant = np.full(n, NONE, np.int32)
+        # reverse sweep: children of a node appear after it, so by the time
+        # we visit child i its own best_descendant is final.
+        for i in range(n - 1, -1, -1):
+            p = parents[i]
+            if p == NONE:
+                continue
+            # is node i a viable head candidate (itself or via descendants)?
+            if not viable[i] and best_descendant[i] == NONE:
+                continue
+            cur = best_child[p]
+            if cur == NONE:
+                take = True
+            else:
+                w_i, w_c = weights[i], weights[cur]
+                if w_i != w_c:
+                    take = w_i > w_c
+                else:
+                    # tie-break on root bytes (reference: op_root comparison)
+                    take = self.roots[i] > self.roots[cur]
+            if take:
+                best_child[p] = i
+                bd = best_descendant[i]
+                best_descendant[p] = bd if bd != NONE else (
+                    i if viable[i] else NONE)
+        # a viable node is its own best descendant when it has no best child
+        own = (best_descendant[:n] == NONE) & viable
+        best_descendant[own] = np.nonzero(own)[0]
+        self.best_child[:n] = best_child
+        self.best_descendant[:n] = best_descendant
+        self._viable = viable
+
+    def find_head(
+        self,
+        justified_root: bytes,
+        justified: CheckpointKey,
+        finalized: CheckpointKey,
+        current_epoch: int,
+    ) -> bytes:
+        if justified_root not in self.indices:
+            raise ProtoArrayError(f"unknown justified root {justified_root.hex()[:16]}")
+        start = self.indices[justified_root]
+        bd = self.best_descendant[start]
+        head = bd if bd != NONE else start
+        viable = getattr(self, "_viable", None)
+        if viable is not None and head < viable.shape[0] and not viable[head]:
+            # fall back to the justified node itself (always permitted head)
+            head = start
+        return self.roots[head]
+
+    # -- ancestry ---------------------------------------------------------
+
+    def get_ancestor(self, root: bytes, slot: int) -> bytes | None:
+        i = self.indices.get(root)
+        if i is None:
+            return None
+        while i != NONE and self.slots[i] > slot:
+            i = self.parents[i]
+        return self.roots[i] if i != NONE else None
+
+    def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
+        a = self.indices.get(ancestor_root)
+        if a is None:
+            return False
+        got = self.get_ancestor(descendant_root, int(self.slots[a]))
+        return got == ancestor_root
+
+    # -- optimistic sync --------------------------------------------------
+
+    def set_execution_valid(self, root: bytes) -> None:
+        """Mark `root` and all ancestors with payloads as valid."""
+        i = self.indices.get(root)
+        while i is not None and i != NONE:
+            if self.execution_status[i] == EXEC_INVALID:
+                raise ProtoArrayError("valid block descends from invalid block")
+            if self.execution_status[i] in (EXEC_VALID, EXEC_IRRELEVANT):
+                break
+            self.execution_status[i] = EXEC_VALID
+            i = self.parents[i]
+
+    def set_execution_invalid(self, root: bytes) -> None:
+        """Mark `root` and all descendants invalid (reference
+        `propagate_execution_status` on invalid payloads)."""
+        start = self.indices.get(root)
+        if start is None:
+            return
+        n = self.n_nodes
+        bad = np.zeros(n, bool)
+        bad[start] = True
+        parents = self.parents[:n]
+        for i in range(start + 1, n):
+            p = parents[i]
+            if p != NONE and bad[p]:
+                bad[i] = True
+        self.execution_status[:n][bad] = EXEC_INVALID
+
+    # -- pruning ----------------------------------------------------------
+
+    def prune(self, finalized_root: bytes) -> dict[int, int]:
+        """Drop every node that is not the finalized block or a descendant
+        of it.  Returns the old→new index mapping for callers holding node
+        indices (the vote tracker re-maps through it)."""
+        if finalized_root not in self.indices:
+            raise ProtoArrayError("cannot prune to unknown root")
+        fin = self.indices[finalized_root]
+        n = self.n_nodes
+        keep = np.zeros(n, bool)
+        keep[fin] = True
+        parents = self.parents[:n]
+        for i in range(fin + 1, n):
+            p = parents[i]
+            if p != NONE and keep[p]:
+                keep[i] = True
+        if keep.all():
+            return {i: i for i in range(n)}
+        new_of_old = np.cumsum(keep) - 1
+        mapping = {i: int(new_of_old[i]) for i in range(n) if keep[i]}
+        kept_idx = np.nonzero(keep)[0]
+        m = kept_idx.shape[0]
+        for name in ("slots", "weights", "justified_epoch", "finalized_epoch",
+                     "unrealized_justified_epoch", "unrealized_finalized_epoch",
+                     "execution_status"):
+            col = getattr(self, name)
+            col[:m] = col[kept_idx]
+        # pointer columns need re-mapping
+        for name in ("parents", "best_child", "best_descendant"):
+            col = getattr(self, name)
+            vals = col[kept_idx]
+            remapped = np.full(m, NONE, np.int32)
+            ok = vals != NONE
+            remapped[ok] = new_of_old[vals[ok]]
+            # parents outside the kept set (the finalized node's parent) drop
+            if name == "parents":
+                outside = ok & ~keep[np.clip(vals, 0, n - 1)]
+                remapped[outside] = NONE
+            col[:m] = remapped
+        self.roots = [self.roots[i] for i in kept_idx]
+        self.justified_roots = [self.justified_roots[i] for i in kept_idx]
+        self.unrealized_justified_roots = [
+            self.unrealized_justified_roots[i] for i in kept_idx]
+        self.indices = {r: i for i, r in enumerate(self.roots)}
+        if hasattr(self, "_viable"):
+            self._viable = self._viable[kept_idx]
+        self.n_nodes = m
+        return mapping

@@ -605,6 +605,36 @@ def compute_timestamp_at_slot(state, spec) -> int:
 
 # --- sync aggregate ---------------------------------------------------------
 
+# The sync committee is fixed for a whole committee period (256 epochs), so
+# its pubkey -> validator-index resolution is cached across blocks.  The
+# registry is append-only (indices never move), so a resolution stays valid
+# for the lifetime of the committee.  Keyed by a digest of the committee's
+# pubkeys; bounded to a handful of entries (current + next committees across
+# the states a process touches).
+_SYNC_COMMITTEE_INDEX_CACHE: dict[bytes, list[int]] = {}
+
+
+def _sync_committee_validator_indices(state) -> list[int]:
+    pubkeys = state.current_sync_committee.pubkeys
+    h = hashlib.sha256()
+    for pk in pubkeys:
+        h.update(pk)
+    key = h.digest()
+    cached = _SYNC_COMMITTEE_INDEX_CACHE.get(key)
+    if cached is not None:
+        return cached
+    index_of = {pk.tobytes(): i for i, pk in enumerate(state.validators.pubkeys)}
+    out = []
+    for pk in pubkeys:
+        vidx = index_of.get(bytes(pk))
+        _err(vidx is not None, "sync committee pubkey not in registry")
+        out.append(vidx)
+    if len(_SYNC_COMMITTEE_INDEX_CACHE) > 8:
+        _SYNC_COMMITTEE_INDEX_CACHE.clear()
+    _SYNC_COMMITTEE_INDEX_CACHE[key] = out
+    return out
+
+
 def process_sync_aggregate(state, spec, aggregate, block_slot, strategy, verifier) -> None:
     if strategy is not SignatureStrategy.NO_VERIFICATION:
         if any(aggregate.sync_committee_bits):
@@ -628,13 +658,8 @@ def process_sync_aggregate(state, spec, aggregate, block_slot, strategy, verifie
         participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
 
     proposer = misc.get_beacon_proposer_index(state, spec)
-    # one pass over the registry builds the pubkey -> index map for all 512
-    # committee members (instead of an O(n) scan per member)
-    index_of = {
-        pk.tobytes(): i for i, pk in enumerate(state.validators.pubkeys)}
-    for pk, bit in zip(state.current_sync_committee.pubkeys, aggregate.sync_committee_bits):
-        vidx = index_of.get(bytes(pk))
-        _err(vidx is not None, "sync committee pubkey not in registry")
+    committee_indices = _sync_committee_validator_indices(state)
+    for vidx, bit in zip(committee_indices, aggregate.sync_committee_bits):
         if bit:
             state.balances[vidx] += np.uint64(participant_reward)
             state.balances[proposer] += np.uint64(proposer_reward)

@@ -1,0 +1,255 @@
+"""Fork choice tests: proto-array mechanics + spec store over the harness.
+
+Models the reference's fork-choice test vectors
+(/root/reference/consensus/proto_array/src/fork_choice_test_definition.rs)
+and the harness-driven fork_choice EF handler: scripted on_block /
+on_attestation sequences with expected heads.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.fork_choice import (
+    EXEC_INVALID,
+    CheckpointKey,
+    ForkChoice,
+    ForkChoiceError,
+    ProtoArray,
+)
+from lighthouse_tpu.testing import Harness
+
+
+def _root(i: int) -> bytes:
+    return i.to_bytes(32, "little")
+
+
+CP0 = CheckpointKey(0, _root(0))
+
+
+def _pa_chain(n: int) -> ProtoArray:
+    pa = ProtoArray()
+    pa.add_block(_root(0), None, 0, CP0, CP0)
+    for i in range(1, n):
+        pa.add_block(_root(i), _root(i - 1), i, CP0, CP0)
+    return pa
+
+
+class TestProtoArray:
+    def test_linear_chain_head_is_tip(self):
+        pa = _pa_chain(5)
+        pa.apply_score_changes(np.zeros(5, np.int64), CP0, CP0, 0)
+        assert pa.find_head(_root(0), CP0, CP0, 0) == _root(4)
+
+    def test_fork_weight_decides(self):
+        pa = _pa_chain(2)
+        # two children of block 1
+        pa.add_block(_root(10), _root(1), 2, CP0, CP0)
+        pa.add_block(_root(11), _root(1), 2, CP0, CP0)
+        d = np.zeros(4, np.int64)
+        d[pa.indices[_root(10)]] = 5
+        d[pa.indices[_root(11)]] = 7
+        pa.apply_score_changes(d, CP0, CP0, 0)
+        assert pa.find_head(_root(0), CP0, CP0, 0) == _root(11)
+        # moving weight flips the head
+        d2 = np.zeros(4, np.int64)
+        d2[pa.indices[_root(10)]] = 4
+        pa.apply_score_changes(d2, CP0, CP0, 0)
+        assert pa.find_head(_root(0), CP0, CP0, 0) == _root(10)
+
+    def test_tie_breaks_by_root(self):
+        pa = _pa_chain(1)
+        pa.add_block(_root(2), _root(0), 1, CP0, CP0)
+        pa.add_block(_root(3), _root(0), 1, CP0, CP0)
+        pa.apply_score_changes(np.zeros(3, np.int64), CP0, CP0, 0)
+        want = max(_root(2), _root(3))
+        assert pa.find_head(_root(0), CP0, CP0, 0) == want
+
+    def test_weight_propagates_to_ancestors(self):
+        pa = _pa_chain(4)
+        d = np.zeros(4, np.int64)
+        d[3] = 10
+        pa.apply_score_changes(d, CP0, CP0, 0)
+        assert list(pa.weights[:4]) == [10, 10, 10, 10]
+
+    def test_invalid_execution_excluded(self):
+        pa = _pa_chain(2)
+        pa.add_block(_root(10), _root(1), 2, CP0, CP0)
+        pa.add_block(_root(11), _root(1), 2, CP0, CP0)
+        d = np.zeros(4, np.int64)
+        d[pa.indices[_root(11)]] = 100
+        pa.apply_score_changes(d, CP0, CP0, 0)
+        assert pa.find_head(_root(0), CP0, CP0, 0) == _root(11)
+        pa.set_execution_invalid(_root(11))
+        pa.apply_score_changes(np.zeros(4, np.int64), CP0, CP0, 0)
+        assert pa.find_head(_root(0), CP0, CP0, 0) == _root(10)
+
+    def test_invalidation_cascades_to_descendants(self):
+        pa = _pa_chain(4)
+        pa.set_execution_invalid(_root(1))
+        assert all(pa.execution_status[1:4] == EXEC_INVALID)
+        assert pa.execution_status[0] != EXEC_INVALID
+
+    def test_ancestor_and_descendant(self):
+        pa = _pa_chain(5)
+        assert pa.get_ancestor(_root(4), 2) == _root(2)
+        assert pa.get_ancestor(_root(4), 0) == _root(0)
+        assert pa.is_descendant(_root(1), _root(4))
+        assert not pa.is_descendant(_root(4), _root(1))
+
+    def test_prune_keeps_descendants_and_remaps(self):
+        pa = _pa_chain(5)
+        pa.add_block(_root(10), _root(1), 2, CP0, CP0)  # orphan branch
+        mapping = pa.prune(_root(2))
+        assert set(pa.indices) == {_root(2), _root(3), _root(4)}
+        assert mapping[pa.n_nodes and 2] == 0
+        pa.apply_score_changes(np.zeros(3, np.int64), CP0, CP0, 0)
+        assert pa.find_head(_root(2), CP0, CP0, 0) == _root(4)
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """A 4-epoch minimal chain driven through fork choice (fake crypto).
+
+    Spec timing: earliest justification is epoch 2 (weighing is skipped
+    while current_epoch <= 1), so earliest finalization lands at the end
+    of epoch 3 — hence 4 epochs of blocks."""
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    anchor_root = h._parent_root(h.state)
+    fc = ForkChoice(h.spec, anchor_root, h.state)
+    blocks = []
+    for _ in range(4 * h.spec.slots_per_epoch):
+        atts = [h.attest()] if int(h.state.slot) > 0 else []
+        signed = h.produce_block(attestations=atts)
+        from lighthouse_tpu.state_transition import state_transition
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        root = signed.message.hash_tree_root()
+        fc.on_block(int(signed.message.slot), signed.message, root, h.state)
+        blocks.append((root, signed))
+    return h, fc, blocks
+
+
+class TestForkChoiceStore:
+    def test_head_is_chain_tip(self, chain):
+        h, fc, blocks = chain
+        head = fc.get_head(int(h.state.slot))
+        assert head == blocks[-1][0]
+
+    def test_checkpoints_advance(self, chain):
+        h, fc, blocks = chain
+        # after 3 epochs of full participation, justification must advance
+        assert fc.justified.epoch >= 1
+        assert fc.finalized.epoch >= 1
+
+    def test_attestation_votes_move_head(self, chain):
+        h, fc, blocks = chain
+        # all validators vote for an older block: with equal committee
+        # weights the heavier (older) branch can't lose since the tip
+        # descends from it — instead check vote application machinery
+        root, _ = blocks[-2]
+        idx = np.arange(16)
+        fc.on_attestation(
+            int(h.state.slot) + 1, idx, root,
+            h.spec.compute_epoch_at_slot(int(h.state.slot)),
+            int(h.state.slot), is_from_block=True)
+        head = fc.get_head(int(h.state.slot) + 1)
+        # votes for an ancestor keep the tip as head (weight propagates up)
+        assert head == blocks[-1][0]
+
+    def test_unknown_block_attestation_rejected(self, chain):
+        h, fc, _ = chain
+        with pytest.raises(ForkChoiceError):
+            fc.on_attestation(
+                int(h.state.slot), np.array([0]), b"\xaa" * 32,
+                h.spec.compute_epoch_at_slot(int(h.state.slot)),
+                int(h.state.slot))
+
+    def test_future_block_rejected(self, chain):
+        h, fc, blocks = chain
+        blk = blocks[-1][1].message
+        with pytest.raises(ForkChoiceError):
+            fc.on_block(int(blk.slot) - 1, blk, b"\xbb" * 32, h.state)
+
+    def test_equivocation_zeroes_weight(self, chain):
+        h, fc, blocks = chain
+        fc.on_attester_slashing(np.array([0, 1, 2]))
+        assert fc.equivocating[:3].all()
+        # head unchanged; equivocators removed from deltas without error
+        assert fc.get_head(int(h.state.slot)) == blocks[-1][0]
+
+
+class TestForkScenario:
+    def test_two_branches_votes_decide(self):
+        """Two sibling blocks at the same slot; attestation weight picks."""
+        h = Harness(n_validators=32, fork="altair", real_crypto=False)
+        anchor_root = h._parent_root(h.state)
+        fc = ForkChoice(h.spec, anchor_root, h.state)
+        from lighthouse_tpu.state_transition import state_transition
+
+        # common chain of 2 blocks
+        for _ in range(2):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            fc.on_block(int(signed.message.slot), signed.message,
+                        signed.message.hash_tree_root(), h.state)
+
+        # branch A: honest next block
+        state_a = h.state.copy()
+        saved = h.state
+        block_a = h.produce_block()
+        h.state = state_a
+        state_transition(h.state, h.spec, block_a, h._verify_strategy())
+        root_a = block_a.message.hash_tree_root()
+        fc.on_block(int(block_a.message.slot), block_a.message, root_a, h.state)
+        state_a = h.state
+
+        # branch B: different graffiti at the same slot
+        h.state = saved.copy()
+        block_b = h.produce_block()
+        block_b.message.body.graffiti = b"branch-b".ljust(32, b"\x00")
+        # recompute state root for modified body
+        trial = h.state.copy()
+        from lighthouse_tpu.state_transition import (
+            SignatureStrategy,
+            process_block,
+            state_advance,
+        )
+        state_advance(trial, h.spec, int(block_b.message.slot))
+        process_block(trial, h.spec, block_b, SignatureStrategy.NO_VERIFICATION)
+        block_b.message.state_root = trial.hash_tree_root()
+        root_b = block_b.message.hash_tree_root()
+        fc.on_block(int(block_b.message.slot), block_b.message, root_b, trial)
+
+        assert root_a != root_b
+        slot = int(block_a.message.slot)
+        epoch = h.spec.compute_epoch_at_slot(slot)
+
+        # 4 validators vote A, 10 vote B → B wins
+        fc.on_attestation(slot + 1, np.arange(4), root_a, epoch, slot,
+                          is_from_block=True)
+        fc.on_attestation(slot + 1, np.arange(4, 14), root_b, epoch, slot,
+                          is_from_block=True)
+        assert fc.get_head(slot + 1) == root_b
+
+        # votes migrate: same validators now prefer A with a newer target
+        fc.on_attestation(slot + 2, np.arange(4, 14), root_a, epoch + 1,
+                          slot + 1, is_from_block=True)
+        assert fc.get_head(slot + 2) == root_a
+
+    def test_proposer_boost(self):
+        """A timely block gets the boost and outweighs a few votes."""
+        h = Harness(n_validators=32, fork="altair", real_crypto=False)
+        anchor_root = h._parent_root(h.state)
+        fc = ForkChoice(h.spec, anchor_root, h.state)
+        from lighthouse_tpu.state_transition import state_transition
+
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        root = signed.message.hash_tree_root()
+        fc.on_block(int(signed.message.slot), signed.message, root, h.state,
+                    is_timely=True)
+        assert fc.proposer_boost_root == root
+        assert fc.get_head(int(signed.message.slot)) == root
+        # boost expires on the next slot tick
+        fc.update_time(int(signed.message.slot) + 1)
+        assert fc.proposer_boost_root is None
