@@ -84,7 +84,7 @@ class ForkChoice:
         nv = eb.shape[0]
         self._vote_current = np.full(nv, NONE, np.int32)
         self._vote_next = np.full(nv, NONE, np.int32)
-        self._vote_next_epoch = np.zeros(nv, np.int64)
+        self._vote_next_epoch = np.full(nv, -1, np.int64)  # -1 = no vote yet
         self._old_balances = np.zeros(nv, np.int64)
         self.equivocating = np.zeros(nv, bool)
 
@@ -122,7 +122,7 @@ class ForkChoice:
         pad = n - cur
         self._vote_current = np.concatenate([self._vote_current, np.full(pad, NONE, np.int32)])
         self._vote_next = np.concatenate([self._vote_next, np.full(pad, NONE, np.int32)])
-        self._vote_next_epoch = np.concatenate([self._vote_next_epoch, np.zeros(pad, np.int64)])
+        self._vote_next_epoch = np.concatenate([self._vote_next_epoch, np.full(pad, -1, np.int64)])
         self._old_balances = np.concatenate([self._old_balances, np.zeros(pad, np.int64)])
         self.equivocating = np.concatenate([self.equivocating, np.zeros(pad, bool)])
 
@@ -217,7 +217,10 @@ class ForkChoice:
             self._balance_snapshots[block_root] = eb
         self._grow_votes(state.validators.effective_balance.shape[0])
 
-        if is_timely and slot == current_slot:
+        if (is_timely and slot == current_slot
+                and self.proposer_boost_root is None):
+            # spec on_block: only the FIRST timely block in a slot gets the
+            # boost (equivocation/ex-ante-reorg defence)
             self.proposer_boost_root = block_root
 
         self.proto.add_block(

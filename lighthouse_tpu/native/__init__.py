@@ -1,0 +1,50 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Each component is a single translation unit compiled on demand with g++
+into a cached shared object next to the source (no pybind11 in the image;
+SURVEY.md §7's native-component ledger maps the reference's C/C++ deps to
+these).  Compilation happens once per source change; the .so is keyed by a
+content digest so stale binaries are never loaded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+_BUILD = _DIR / "_build"
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_shared_lib(source_name: str) -> Path:
+    """Compile native/<source_name> to a cached .so and return its path."""
+    src = _DIR / source_name
+    code = src.read_bytes()
+    digest = hashlib.sha256(code).hexdigest()[:16]
+    stem = src.stem
+    out = _BUILD / f"lib{stem}-{digest}.so"
+    if out.exists():
+        return out
+    _BUILD.mkdir(exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        str(src), "-o", str(out),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"g++ failed for {source_name}:\n{proc.stderr[-4000:]}")
+    # drop stale builds of the same stem
+    for old in _BUILD.glob(f"lib{stem}-*.so"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out

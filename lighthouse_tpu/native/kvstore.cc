@@ -1,0 +1,386 @@
+// Embedded log-structured key-value store with atomic batches.
+//
+// Native-runtime replacement for the reference's LevelDB dependency
+// (/root/reference/beacon_node/store/src/leveldb_store.rs): the hot/cold
+// beacon database needs ordered iteration, point lookups, atomic write
+// batches, and compaction — nothing more — so this is a single-writer
+// append-only log with an in-memory ordered index and copy-forward
+// compaction.
+//
+// On-disk format (one file, "kv.log"):
+//   record  := type(u8) klen(u32 LE) vlen(u32 LE) key[klen] value[vlen]
+//   type    := 1 PUT | 2 DEL | 3 COMMIT (klen=vlen=0)
+// Recovery replays records into the index, applying only batches that end
+// with a COMMIT record (partial tails from crashes are dropped).  Every
+// public call is guarded by one mutex — callers (the Python layer) already
+// serialize imports the same way the reference's store does.
+//
+// C ABI for ctypes; buffers returned to the caller are malloc'd and must be
+// released with kv_free.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t REC_PUT = 1;
+constexpr uint8_t REC_DEL = 2;
+constexpr uint8_t REC_COMMIT = 3;
+
+struct Entry {
+  uint64_t offset;  // file offset of the value bytes
+  uint32_t vlen;
+};
+
+struct KV {
+  std::string dir;
+  std::string log_path;
+  FILE* log = nullptr;
+  int read_fd = -1;  // persistent pread handle for value lookups
+  uint64_t log_size = 0;
+  uint64_t live_bytes = 0;  // payload bytes referenced by the index
+  std::map<std::string, Entry> index;
+  std::mutex mu;
+};
+
+bool write_record(KV* kv, uint8_t type, const uint8_t* k, uint32_t klen,
+                  const uint8_t* v, uint32_t vlen, uint64_t* value_off) {
+  uint8_t hdr[9];
+  hdr[0] = type;
+  memcpy(hdr + 1, &klen, 4);
+  memcpy(hdr + 5, &vlen, 4);
+  if (fwrite(hdr, 1, 9, kv->log) != 9) return false;
+  if (klen && fwrite(k, 1, klen, kv->log) != klen) return false;
+  if (value_off) *value_off = kv->log_size + 9 + klen;
+  if (vlen && fwrite(v, 1, vlen, kv->log) != vlen) return false;
+  kv->log_size += 9 + klen + vlen;
+  return true;
+}
+
+// Replay the log into the index.  Batches are delimited by COMMIT records;
+// a trailing run of records with no COMMIT is discarded (crash tail).
+void recover(KV* kv) {
+  FILE* f = fopen(kv->log_path.c_str(), "rb");
+  kv->index.clear();
+  kv->log_size = 0;
+  kv->live_bytes = 0;
+  if (!f) return;
+  std::map<std::string, Entry> committed;
+  uint64_t committed_size = 0, live = 0;
+  std::map<std::string, Entry> pending = committed;
+  uint64_t off = 0;
+  std::vector<uint8_t> keybuf;
+  for (;;) {
+    uint8_t hdr[9];
+    if (fread(hdr, 1, 9, f) != 9) break;
+    uint32_t klen, vlen;
+    memcpy(&klen, hdr + 1, 4);
+    memcpy(&vlen, hdr + 5, 4);
+    if (hdr[0] == REC_COMMIT) {
+      off += 9;
+      committed = pending;
+      committed_size = off;
+      continue;
+    }
+    keybuf.resize(klen);
+    if (klen && fread(keybuf.data(), 1, klen, f) != klen) break;
+    uint64_t voff = off + 9 + klen;
+    if (vlen && fseek(f, (long)vlen, SEEK_CUR) != 0) break;
+    off += 9 + klen + vlen;
+    std::string key((const char*)keybuf.data(), klen);
+    if (hdr[0] == REC_PUT) {
+      pending[key] = Entry{voff, vlen};
+    } else if (hdr[0] == REC_DEL) {
+      pending.erase(key);
+    } else {
+      break;  // corrupt record type: stop at last good commit
+    }
+  }
+  fclose(f);
+  kv->index = committed;
+  kv->log_size = committed_size;
+  for (auto& it : kv->index) live += it.second.vlen + it.first.size();
+  kv->live_bytes = live;
+  // truncate any uncommitted tail so new writes start at a clean offset
+  if (committed_size > 0) {
+    truncate(kv->log_path.c_str(), (off_t)committed_size);
+  } else {
+    remove(kv->log_path.c_str());
+  }
+}
+
+bool read_value(KV* kv, const Entry& e, uint8_t* out) {
+  if (kv->log) fflush(kv->log);
+  if (kv->read_fd < 0) {
+    kv->read_fd = open(kv->log_path.c_str(), O_RDONLY);
+    if (kv->read_fd < 0) return false;
+  }
+  return pread(kv->read_fd, out, e.vlen, (off_t)e.offset) == (ssize_t)e.vlen;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* dir) {
+  KV* kv = new KV();
+  kv->dir = dir;
+  mkdir(dir, 0755);
+  kv->log_path = kv->dir + "/kv.log";
+  recover(kv);
+  kv->log = fopen(kv->log_path.c_str(), "ab");
+  if (!kv->log) {
+    delete kv;
+    return nullptr;
+  }
+  // recovery may have truncated; ensure append position matches
+  fseek(kv->log, 0, SEEK_END);
+  kv->log_size = (uint64_t)ftell(kv->log);
+  return kv;
+}
+
+void kv_close(void* h) {
+  KV* kv = (KV*)h;
+  if (!kv) return;
+  if (kv->log) {
+    fflush(kv->log);
+    fclose(kv->log);
+  }
+  if (kv->read_fd >= 0) close(kv->read_fd);
+  delete kv;
+}
+
+int kv_put(void* h, const uint8_t* k, size_t klen, const uint8_t* v,
+           size_t vlen) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  uint64_t voff = 0;
+  if (!write_record(kv, REC_PUT, k, (uint32_t)klen, v, (uint32_t)vlen, &voff))
+    return -1;
+  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr)) return -1;
+  fflush(kv->log);
+  std::string key((const char*)k, klen);
+  auto old = kv->index.find(key);
+  if (old != kv->index.end()) kv->live_bytes -= old->second.vlen + key.size();
+  kv->index[key] = Entry{voff, (uint32_t)vlen};
+  kv->live_bytes += vlen + klen;
+  return 0;
+}
+
+int kv_del(void* h, const uint8_t* k, size_t klen) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  std::string key((const char*)k, klen);
+  auto it = kv->index.find(key);
+  if (it == kv->index.end()) return 1;  // not found (not an error)
+  if (!write_record(kv, REC_DEL, k, (uint32_t)klen, nullptr, 0, nullptr))
+    return -1;
+  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr)) return -1;
+  fflush(kv->log);
+  kv->live_bytes -= it->second.vlen + key.size();
+  kv->index.erase(it);
+  return 0;
+}
+
+// Atomic batch.  buf := [op(u8) klen(u32) key vlen(u32) value]*
+// All records are appended, then one COMMIT; the index is updated only
+// after the COMMIT hits the file, so a crash mid-batch loses the whole
+// batch, never half of it (reference: do_atomically on the LevelDB
+// write-batch, store/src/hot_cold_store.rs).
+int kv_batch(void* h, const uint8_t* buf, size_t len) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  struct Op {
+    std::string key;
+    uint64_t voff;
+    uint32_t vlen;
+    bool is_del;
+  };
+  std::vector<Op> ops;
+  size_t p = 0;
+  uint64_t restore_size = kv->log_size;
+  while (p < len) {
+    if (p + 5 > len) return -2;
+    uint8_t op = buf[p];
+    uint32_t klen;
+    memcpy(&klen, buf + p + 1, 4);
+    p += 5;
+    if (p + klen + 4 > len) return -2;
+    const uint8_t* k = buf + p;
+    p += klen;
+    uint32_t vlen;
+    memcpy(&vlen, buf + p, 4);
+    p += 4;
+    if (p + vlen > len) return -2;
+    const uint8_t* v = buf + p;
+    p += vlen;
+    uint64_t voff = 0;
+    uint8_t rec = (op == REC_DEL) ? REC_DEL : REC_PUT;
+    if (!write_record(kv, rec, k, klen, v, (rec == REC_DEL) ? 0 : vlen,
+                      &voff)) {
+      kv->log_size = restore_size;
+      return -1;
+    }
+    ops.push_back(Op{std::string((const char*)k, klen), voff, vlen,
+                     rec == REC_DEL});
+  }
+  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr))
+    return -1;
+  fflush(kv->log);
+  for (auto& op : ops) {
+    auto old = kv->index.find(op.key);
+    if (old != kv->index.end())
+      kv->live_bytes -= old->second.vlen + op.key.size();
+    if (op.is_del) {
+      kv->index.erase(op.key);
+    } else {
+      kv->index[op.key] = Entry{op.voff, op.vlen};
+      kv->live_bytes += op.vlen + op.key.size();
+    }
+  }
+  return 0;
+}
+
+uint8_t* kv_get(void* h, const uint8_t* k, size_t klen, size_t* out_len) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  auto it = kv->index.find(std::string((const char*)k, klen));
+  if (it == kv->index.end()) {
+    *out_len = 0;
+    return nullptr;
+  }
+  uint8_t* out = (uint8_t*)malloc(it->second.vlen ? it->second.vlen : 1);
+  if (!read_value(kv, it->second, out)) {
+    free(out);
+    *out_len = 0;
+    return nullptr;
+  }
+  *out_len = it->second.vlen;
+  return out;
+}
+
+int kv_exists(void* h, const uint8_t* k, size_t klen) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  return kv->index.count(std::string((const char*)k, klen)) ? 1 : 0;
+}
+
+void kv_free(uint8_t* p) { free(p); }
+
+uint64_t kv_count(void* h) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  return kv->index.size();
+}
+
+uint64_t kv_log_size(void* h) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  return kv->log_size;
+}
+
+// Ordered prefix iteration: snapshot matching keys at open.
+struct Iter {
+  std::vector<std::pair<std::string, Entry>> items;
+  size_t pos = 0;
+  KV* kv;
+};
+
+void* kv_iter_prefix(void* h, const uint8_t* prefix, size_t plen) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  Iter* it = new Iter();
+  it->kv = kv;
+  std::string pre((const char*)prefix, plen);
+  for (auto i = kv->index.lower_bound(pre); i != kv->index.end(); ++i) {
+    if (i->first.compare(0, plen, pre) != 0) break;
+    it->items.push_back(*i);
+  }
+  return it;
+}
+
+int kv_iter_next(void* hi, uint8_t** k, size_t* klen, uint8_t** v,
+                 size_t* vlen) {
+  Iter* it = (Iter*)hi;
+  if (it->pos >= it->items.size()) return 0;
+  auto& item = it->items[it->pos++];
+  *klen = item.first.size();
+  *k = (uint8_t*)malloc(*klen ? *klen : 1);
+  memcpy(*k, item.first.data(), *klen);
+  *vlen = item.second.vlen;
+  *v = (uint8_t*)malloc(*vlen ? *vlen : 1);
+  std::lock_guard<std::mutex> lock(it->kv->mu);
+  if (!read_value(it->kv, item.second, *v)) {
+    free(*k);
+    free(*v);
+    return -1;
+  }
+  return 1;
+}
+
+void kv_iter_close(void* hi) { delete (Iter*)hi; }
+
+// Copy-forward compaction: write all live entries to a fresh log, swap.
+int kv_compact(void* h) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  std::string tmp_path = kv->dir + "/kv.log.compact";
+  FILE* out = fopen(tmp_path.c_str(), "wb");
+  if (!out) return -1;
+  std::map<std::string, Entry> fresh;
+  uint64_t off = 0;
+  std::vector<uint8_t> val;
+  for (auto& it : kv->index) {
+    val.resize(it.second.vlen);
+    if (it.second.vlen && !read_value(kv, it.second, val.data())) {
+      fclose(out);
+      remove(tmp_path.c_str());
+      return -1;
+    }
+    uint32_t klen = (uint32_t)it.first.size(), vlen = it.second.vlen;
+    uint8_t hdr[9];
+    hdr[0] = REC_PUT;
+    memcpy(hdr + 1, &klen, 4);
+    memcpy(hdr + 5, &vlen, 4);
+    fwrite(hdr, 1, 9, out);
+    fwrite(it.first.data(), 1, klen, out);
+    fwrite(val.data(), 1, vlen, out);
+    fresh[it.first] = Entry{off + 9 + klen, vlen};
+    off += 9 + klen + vlen;
+  }
+  uint8_t commit[9] = {REC_COMMIT, 0, 0, 0, 0, 0, 0, 0, 0};
+  fwrite(commit, 1, 9, out);
+  off += 9;
+  if (fflush(out) != 0) {
+    fclose(out);
+    return -1;
+  }
+  fclose(out);
+  fclose(kv->log);
+  if (kv->read_fd >= 0) {
+    close(kv->read_fd);  // old inode; reopen lazily after the swap
+    kv->read_fd = -1;
+  }
+  if (rename(tmp_path.c_str(), kv->log_path.c_str()) != 0) {
+    kv->log = fopen(kv->log_path.c_str(), "ab");
+    return -1;
+  }
+  kv->log = fopen(kv->log_path.c_str(), "ab");
+  kv->index = fresh;
+  kv->log_size = off;
+  uint64_t live = 0;
+  for (auto& it : kv->index) live += it.second.vlen + it.first.size();
+  kv->live_bytes = live;
+  return 0;
+}
+
+}  // extern "C"

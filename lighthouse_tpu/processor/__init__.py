@@ -1,0 +1,34 @@
+"""Work scheduling: priority queues, worker pool, device-sized batching.
+
+Reference: /root/reference/beacon_node/beacon_processor.
+"""
+
+from lighthouse_tpu.processor.beacon_processor import (
+    PRIORITY_ORDER,
+    BeaconProcessor,
+    ProcessorMetrics,
+    WorkEvent,
+    WorkType,
+    default_queue_lengths,
+)
+from lighthouse_tpu.processor.reprocess import (
+    ADDITIONAL_QUEUED_BLOCK_DELAY,
+    QUEUED_ATTESTATION_DELAY,
+    QUEUED_RPC_BLOCK_DELAY,
+    DuplicateCache,
+    ReprocessQueue,
+)
+
+__all__ = [
+    "BeaconProcessor",
+    "WorkEvent",
+    "WorkType",
+    "ProcessorMetrics",
+    "PRIORITY_ORDER",
+    "default_queue_lengths",
+    "ReprocessQueue",
+    "DuplicateCache",
+    "ADDITIONAL_QUEUED_BLOCK_DELAY",
+    "QUEUED_ATTESTATION_DELAY",
+    "QUEUED_RPC_BLOCK_DELAY",
+]

@@ -1,0 +1,347 @@
+"""Priority work scheduler — the single place device-sized batches form.
+
+Rebuild of the reference beacon_processor
+(/root/reference/beacon_node/beacon_processor/src/lib.rs): a manager loop
+over per-work-type bounded queues with an explicit priority order
+(lib.rs:950-977), a capped worker pool, and opportunistic batch formation
+for attestations/aggregates (lib.rs:977-1010).
+
+TPU-first deltas from the reference:
+- The reference drains at most 64 queued attestations into one batch
+  (lib.rs:196-203) because its batch verifier is CPU-bound.  Here the batch
+  cap defaults to 2048 lanes and adds a time-based flush, because the device
+  batch-pairing kernel wants large, padded, bucketed batches (SURVEY.md §7:
+  "raise the 64-item cap, add time-based flush").
+- Queues are deques of work events; batch formation concatenates event
+  payloads so the BLS backend sees one contiguous lane batch.
+
+Concurrency model: asyncio manager + thread-pool executor for CPU/device
+work (the reference's tokio manager + blocking worker pool,
+task_executor::spawn_blocking).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from enum import Enum, auto
+from typing import Any, Awaitable, Callable
+
+
+class WorkType(Enum):
+    """Work taxonomy (reference Work enum, lib.rs:552-618)."""
+
+    # highest priority: chain structure
+    CHAIN_SEGMENT = auto()
+    CHAIN_SEGMENT_BACKFILL = auto()
+    RPC_BLOCK = auto()
+    RPC_BLOBS = auto()
+    # delayed re-imports
+    DELAYED_IMPORT_BLOCK = auto()
+    # gossip block parts
+    GOSSIP_BLOCK = auto()
+    GOSSIP_BLOB_SIDECAR = auto()
+    # API priorities
+    API_REQUEST_P0 = auto()
+    API_REQUEST_P1 = auto()
+    # aggregates before unaggregated attestations
+    GOSSIP_AGGREGATE = auto()
+    GOSSIP_AGGREGATE_BATCH = auto()
+    GOSSIP_ATTESTATION = auto()
+    GOSSIP_ATTESTATION_BATCH = auto()
+    # remaining gossip
+    GOSSIP_SYNC_SIGNATURE = auto()
+    GOSSIP_SYNC_CONTRIBUTION = auto()
+    GOSSIP_VOLUNTARY_EXIT = auto()
+    GOSSIP_PROPOSER_SLASHING = auto()
+    GOSSIP_ATTESTER_SLASHING = auto()
+    GOSSIP_BLS_TO_EXECUTION_CHANGE = auto()
+    GOSSIP_LIGHT_CLIENT_UPDATE = auto()
+    # Req/Resp serving
+    STATUS = auto()
+    BLOCKS_BY_RANGE_REQUEST = auto()
+    BLOCKS_BY_ROOT_REQUEST = auto()
+    BLOBS_BY_RANGE_REQUEST = auto()
+    BLOBS_BY_ROOT_REQUEST = auto()
+    LIGHT_CLIENT_BOOTSTRAP_REQUEST = auto()
+    UNKNOWN_BLOCK_ATTESTATION = auto()
+    UNKNOWN_BLOCK_AGGREGATE = auto()
+
+
+# Manager poll order (reference lib.rs:950-977): chain segments, then rpc
+# blocks, delayed imports, gossip blocks/blobs, P0 API, aggregates,
+# attestations, then everything else.
+PRIORITY_ORDER: tuple[WorkType, ...] = (
+    WorkType.CHAIN_SEGMENT,
+    WorkType.RPC_BLOCK,
+    WorkType.RPC_BLOBS,
+    WorkType.CHAIN_SEGMENT_BACKFILL,
+    WorkType.DELAYED_IMPORT_BLOCK,
+    WorkType.GOSSIP_BLOCK,
+    WorkType.GOSSIP_BLOB_SIDECAR,
+    WorkType.API_REQUEST_P0,
+    WorkType.GOSSIP_AGGREGATE,
+    WorkType.GOSSIP_ATTESTATION,
+    WorkType.UNKNOWN_BLOCK_AGGREGATE,
+    WorkType.UNKNOWN_BLOCK_ATTESTATION,
+    WorkType.GOSSIP_SYNC_CONTRIBUTION,
+    WorkType.GOSSIP_SYNC_SIGNATURE,
+    WorkType.API_REQUEST_P1,
+    WorkType.GOSSIP_ATTESTER_SLASHING,
+    WorkType.GOSSIP_PROPOSER_SLASHING,
+    WorkType.GOSSIP_VOLUNTARY_EXIT,
+    WorkType.GOSSIP_BLS_TO_EXECUTION_CHANGE,
+    WorkType.GOSSIP_LIGHT_CLIENT_UPDATE,
+    WorkType.STATUS,
+    WorkType.BLOCKS_BY_RANGE_REQUEST,
+    WorkType.BLOCKS_BY_ROOT_REQUEST,
+    WorkType.BLOBS_BY_RANGE_REQUEST,
+    WorkType.BLOBS_BY_ROOT_REQUEST,
+    WorkType.LIGHT_CLIENT_BOOTSTRAP_REQUEST,
+)
+
+# queues that drop the OLDEST item when full (gossip floods); everything
+# else drops the newest (reference FifoQueue/LifoQueue split)
+_LIFO_TYPES = {
+    WorkType.GOSSIP_ATTESTATION,
+    WorkType.GOSSIP_AGGREGATE,
+    WorkType.GOSSIP_SYNC_SIGNATURE,
+    WorkType.GOSSIP_SYNC_CONTRIBUTION,
+}
+
+# work types eligible for batch formation: (batch type, per-event lanes)
+_BATCHABLE = {
+    WorkType.GOSSIP_ATTESTATION: WorkType.GOSSIP_ATTESTATION_BATCH,
+    WorkType.GOSSIP_AGGREGATE: WorkType.GOSSIP_AGGREGATE_BATCH,
+}
+
+
+def default_queue_lengths(active_validator_count: int) -> dict[WorkType, int]:
+    """Queue bounds scaled from the active validator count
+    (reference lib.rs:96-183: attestation queue = validators/32, etc.)."""
+    n = max(active_validator_count, 1024)
+    return {
+        WorkType.GOSSIP_ATTESTATION: max(4096, n // 32),
+        WorkType.GOSSIP_AGGREGATE: 4096,
+        WorkType.GOSSIP_SYNC_SIGNATURE: max(2048, n // 64),
+        WorkType.GOSSIP_SYNC_CONTRIBUTION: 1024,
+        WorkType.GOSSIP_BLOCK: 1024,
+        WorkType.GOSSIP_BLOB_SIDECAR: 1024,
+        WorkType.RPC_BLOCK: 1024,
+        WorkType.RPC_BLOBS: 1024,
+        WorkType.CHAIN_SEGMENT: 64,
+        WorkType.CHAIN_SEGMENT_BACKFILL: 64,
+        WorkType.API_REQUEST_P0: 1024,
+        WorkType.API_REQUEST_P1: 1024,
+        WorkType.UNKNOWN_BLOCK_ATTESTATION: 4096,
+        WorkType.UNKNOWN_BLOCK_AGGREGATE: 1024,
+    }
+
+
+@dataclass
+class WorkEvent:
+    """One unit of work.
+
+    `process` runs on a worker (sync callables go to the thread pool,
+    async callables are awaited).  For batchable types, `process_batch`
+    receives a list of payloads when the manager forms a batch
+    (reference Work::GossipAttestation {process_individual, process_batch},
+    lib.rs:552-557).
+    """
+
+    work_type: WorkType
+    process: Callable[[], Any] | Callable[[], Awaitable[Any]] | None = None
+    payload: Any = None
+    process_batch: Callable[[list[Any]], Any] | None = None
+    drop_during_sync: bool = False
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ProcessorMetrics:
+    enqueued: dict[WorkType, int] = field(default_factory=dict)
+    processed: dict[WorkType, int] = field(default_factory=dict)
+    dropped: dict[WorkType, int] = field(default_factory=dict)
+    batches_formed: int = 0
+    batch_lanes: int = 0
+
+    def bump(self, table: dict, wt: WorkType, by: int = 1):
+        table[wt] = table.get(wt, 0) + by
+
+
+class BeaconProcessor:
+    """Manager + worker pool (reference BeaconProcessor::spawn_manager,
+    lib.rs:758)."""
+
+    def __init__(
+        self,
+        max_workers: int = 4,
+        max_batch: int = 2048,
+        batch_flush_ms: float = 50.0,
+        queue_lengths: dict[WorkType, int] | None = None,
+        work_journal: Callable[[str], None] | None = None,
+    ):
+        self.max_workers = max(2, max_workers)
+        self.max_batch = max_batch
+        self.batch_flush_ms = batch_flush_ms
+        self._lengths = queue_lengths or default_queue_lengths(0)
+        self._queues: dict[WorkType, deque[WorkEvent]] = {
+            wt: deque() for wt in WorkType}
+        self.metrics = ProcessorMetrics()
+        # test hook: emits one token per scheduling decision (reference
+        # work_journal_tx, lib.rs:925-935)
+        self._journal = work_journal
+        self._idle = asyncio.Semaphore(self.max_workers)
+        self._wakeup = asyncio.Event()
+        self._stopped = False
+        self._manager_task: asyncio.Task | None = None
+        self._executor = ThreadPoolExecutor(max_workers=self.max_workers)
+        self._inflight: set[asyncio.Task] = set()
+        # first-seen timestamps for batch flush decisions
+        self._batch_deadline: dict[WorkType, float] = {}
+
+    # -- submission (any task/thread) -------------------------------------
+
+    def submit(self, event: WorkEvent) -> bool:
+        """Enqueue work; returns False if the queue was full and the event
+        (or the oldest event, for LIFO gossip queues) was dropped."""
+        wt = event.work_type
+        q = self._queues[wt]
+        limit = self._lengths.get(wt, 1024)
+        self.metrics.bump(self.metrics.enqueued, wt)
+        accepted = True
+        if len(q) >= limit:
+            self.metrics.bump(self.metrics.dropped, wt)
+            if wt in _LIFO_TYPES:
+                q.popleft()  # drop oldest, keep newest
+            else:
+                accepted = False
+        if accepted:
+            q.append(event)
+            if wt in _BATCHABLE and wt not in self._batch_deadline:
+                self._batch_deadline[wt] = (
+                    time.monotonic() + self.batch_flush_ms / 1000.0)
+        self._wakeup.set()
+        return accepted
+
+    def queue_len(self, wt: WorkType) -> int:
+        return len(self._queues[wt])
+
+    # -- manager loop ------------------------------------------------------
+
+    async def start(self):
+        if self._manager_task is None:
+            self._stopped = False
+            self._manager_task = asyncio.ensure_future(self._manager())
+
+    async def stop(self, drain: bool = True):
+        if drain:
+            await self.drain()
+        self._stopped = True
+        self._wakeup.set()
+        if self._manager_task is not None:
+            await self._manager_task
+            self._manager_task = None
+
+    async def drain(self):
+        """Wait until every queue is empty and all workers are idle."""
+        while True:
+            busy = any(self._queues[wt] for wt in WorkType) or self._inflight
+            if not busy:
+                return
+            await asyncio.sleep(0.002)
+
+    async def _manager(self):
+        while not self._stopped:
+            event_or_batch = self._next_work()
+            if event_or_batch is None:
+                self._wakeup.clear()
+                # re-check with a timeout so batch flush deadlines fire
+                try:
+                    await asyncio.wait_for(
+                        self._wakeup.wait(), timeout=self.batch_flush_ms / 1000.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            await self._idle.acquire()
+            task = asyncio.ensure_future(self._run_work(event_or_batch))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    def _journal_emit(self, token: str):
+        if self._journal is not None:
+            self._journal(token)
+
+    def _next_work(self):
+        """Pick the highest-priority queue with work; form batches
+        opportunistically for attestations/aggregates."""
+        now = time.monotonic()
+        for wt in PRIORITY_ORDER:
+            q = self._queues[wt]
+            if not q:
+                continue
+            if wt in _BATCHABLE:
+                n = len(q)
+                deadline = self._batch_deadline.get(wt, 0.0)
+                if n >= self.max_batch or now >= deadline:
+                    take = min(n, self.max_batch)
+                    events = [q.popleft() for _ in range(take)]
+                    if not q:
+                        self._batch_deadline.pop(wt, None)
+                    if take == 1:
+                        self._journal_emit(wt.name)
+                        return events[0]
+                    self.metrics.batches_formed += 1
+                    self.metrics.batch_lanes += take
+                    self._journal_emit(f"{_BATCHABLE[wt].name}({take})")
+                    return events
+                # not enough lanes yet and deadline pending: let lower
+                # priorities run while the batch accumulates
+                continue
+            self._journal_emit(wt.name)
+            return q.popleft()
+        return None
+
+    async def _run_work(self, work):
+        try:
+            if isinstance(work, list):
+                await self._run_batch(work)
+            else:
+                await self._run_one(work)
+        finally:
+            self._idle.release()
+            self._wakeup.set()
+
+    async def _run_one(self, event: WorkEvent):
+        fn = event.process
+        if fn is None:
+            return
+        try:
+            if asyncio.iscoroutinefunction(fn):
+                await fn()
+            else:
+                loop = asyncio.get_running_loop()
+                res = await loop.run_in_executor(self._executor, fn)
+                if asyncio.iscoroutine(res):
+                    await res
+        except Exception:  # worker panics must not kill the manager
+            pass
+        self.metrics.bump(self.metrics.processed, event.work_type)
+
+    async def _run_batch(self, events: list[WorkEvent]):
+        wt = events[0].work_type
+        batch_fn = events[0].process_batch
+        if batch_fn is None:
+            for e in events:
+                await self._run_one(e)
+            return
+        payloads = [e.payload for e in events]
+        try:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(self._executor, batch_fn, payloads)
+        except Exception:
+            pass
+        self.metrics.bump(self.metrics.processed, wt, len(events))

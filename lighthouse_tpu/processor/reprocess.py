@@ -1,0 +1,135 @@
+"""Work reprocessing: early blocks, unknown-block attestations, rpc retries.
+
+Rebuild of /root/reference/beacon_node/beacon_processor/src/
+work_reprocessing_queue.rs: messages that arrive before their dependencies
+(a block before its slot starts; attestations for a block still in flight)
+are parked and re-queued when the dependency lands or a timeout passes
+(:40-51 — early blocks fire 5 ms into their slot, unknown-block
+attestations wait up to 12 s, rpc blocks 4 s).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from lighthouse_tpu.processor.beacon_processor import BeaconProcessor, WorkEvent
+
+# reference work_reprocessing_queue.rs:40-51
+ADDITIONAL_QUEUED_BLOCK_DELAY = 0.005
+QUEUED_ATTESTATION_DELAY = 12.0
+QUEUED_RPC_BLOCK_DELAY = 4.0
+MAX_QUEUED_ATTESTATIONS = 16_384
+
+
+@dataclass
+class _Parked:
+    event: WorkEvent
+    expires: float
+    root: bytes | None = None
+
+
+class ReprocessQueue:
+    """Parks work until a dependency root is seen or a deadline passes."""
+
+    def __init__(self, processor: BeaconProcessor):
+        self.processor = processor
+        self._by_root: dict[bytes, list[_Parked]] = defaultdict(list)
+        self._timers: list[tuple[float, WorkEvent]] = []
+        self._n_parked = 0
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+
+    # -- parking -----------------------------------------------------------
+
+    def park_until_slot(self, event: WorkEvent, slot_start_unix: float):
+        """Early block: re-queue ADDITIONAL_QUEUED_BLOCK_DELAY into its slot."""
+        fire_at = slot_start_unix + ADDITIONAL_QUEUED_BLOCK_DELAY
+        delay = max(0.0, fire_at - time.time())
+        self._timers.append((time.monotonic() + delay, event))
+
+    def park_for_block(self, event: WorkEvent, block_root: bytes,
+                       timeout: float = QUEUED_ATTESTATION_DELAY) -> bool:
+        """Attestation/aggregate for an unknown block: requeue when the
+        block is imported, or drop after `timeout` (reference behaviour:
+        expired unknown-block attestations are discarded, :447)."""
+        if self._n_parked >= MAX_QUEUED_ATTESTATIONS:
+            return False
+        self._by_root[block_root].append(
+            _Parked(event, time.monotonic() + timeout, block_root))
+        self._n_parked += 1
+        return True
+
+    def park_delayed(self, event: WorkEvent, delay: float = QUEUED_RPC_BLOCK_DELAY):
+        """Fixed-delay retry (rpc blocks)."""
+        self._timers.append((time.monotonic() + delay, event))
+
+    # -- signals -----------------------------------------------------------
+
+    def on_block_imported(self, block_root: bytes):
+        """Dependency landed: flush everything parked on this root."""
+        for parked in self._by_root.pop(block_root, []):
+            self._n_parked -= 1
+            self.processor.submit(parked.event)
+
+    # -- timer pump --------------------------------------------------------
+
+    async def start(self):
+        if self._task is None:
+            self._stopped = False
+            self._task = asyncio.ensure_future(self._pump())
+
+    async def stop(self):
+        self._stopped = True
+        if self._task is not None:
+            t, self._task = self._task, None
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+
+    async def _pump(self):
+        while not self._stopped:
+            now = time.monotonic()
+            due = [e for at, e in self._timers if at <= now]
+            self._timers = [(at, e) for at, e in self._timers if at > now]
+            for e in due:
+                self.processor.submit(e)
+            # expire unknown-root attestations
+            for root in list(self._by_root):
+                keep = []
+                for p in self._by_root[root]:
+                    if p.expires <= now:
+                        self._n_parked -= 1
+                    else:
+                        keep.append(p)
+                if keep:
+                    self._by_root[root] = keep
+                else:
+                    self._by_root.pop(root, None)
+            await asyncio.sleep(0.005)
+
+
+class DuplicateCache:
+    """In-flight dedup of block roots (reference lib.rs:397-423): the first
+    handler to claim a root gets a guard; concurrent claims are rejected
+    until the guard is released."""
+
+    def __init__(self):
+        self._inflight: set[bytes] = set()
+
+    def check_and_insert(self, root: bytes) -> bool:
+        if root in self._inflight:
+            return False
+        self._inflight.add(root)
+        return True
+
+    def release(self, root: bytes):
+        self._inflight.discard(root)
+
+    def __contains__(self, root: bytes) -> bool:
+        return root in self._inflight

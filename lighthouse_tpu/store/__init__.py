@@ -1,0 +1,28 @@
+"""Persistence: KV engines + hot/cold split beacon database.
+
+Reference: /root/reference/beacon_node/store.
+"""
+
+from lighthouse_tpu.store.hot_cold import (
+    SCHEMA_VERSION,
+    HotColdDB,
+    HotStateSummary,
+    StoreError,
+)
+from lighthouse_tpu.store.kv import (
+    KeyValueOp,
+    KeyValueStore,
+    MemoryStore,
+    NativeKVStore,
+)
+
+__all__ = [
+    "HotColdDB",
+    "HotStateSummary",
+    "StoreError",
+    "SCHEMA_VERSION",
+    "KeyValueStore",
+    "KeyValueOp",
+    "MemoryStore",
+    "NativeKVStore",
+]

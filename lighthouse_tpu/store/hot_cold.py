@@ -1,0 +1,440 @@
+"""Hot/cold split beacon database.
+
+Rebuild of /root/reference/beacon_node/store/src/hot_cold_store.rs: a hot
+DB holding recent blocks, per-slot state summaries and full states at epoch
+boundaries, and a cold "freezer" holding the finalized chain as per-slot
+root entries plus periodic full restore-point states.  Intermediate states
+are reconstructed by loading the nearest stored full state and replaying
+blocks (reference `block_replayer` + reconstruct.rs).
+
+Storage engine: any KeyValueStore (the C++ log store for persistence,
+MemoryStore for tests) — the reference's LevelDB/memory split behind the
+same trait.  All import writes go through one atomic batch
+(do_atomically_with_block_and_blobs_cache, hot_cold_store.rs).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    process_block,
+    state_advance,
+)
+from lighthouse_tpu.store.kv import KeyValueOp, KeyValueStore, MemoryStore
+
+SCHEMA_VERSION = 1
+
+# key prefixes (reference DBColumn)
+P_BLOCK = b"blk:"
+P_STATE = b"sta:"        # hot full states by state root
+P_SUMMARY = b"sum:"      # hot per-slot state summaries by state root
+P_BLOBS = b"blb:"
+P_COLD_STATE = b"fzs:"   # freezer restore-point states by slot
+P_COLD_BLOCK_ROOT = b"fbr:"   # freezer canonical block root by slot
+P_COLD_STATE_ROOT = b"fsr:"   # freezer canonical state root by slot
+P_META = b"met:"
+
+K_SCHEMA = P_META + b"schema"
+K_SPLIT = P_META + b"split"
+K_GENESIS_STATE_ROOT = P_META + b"genesis_state_root"
+K_HEAD = P_META + b"head"
+K_FORK_CHOICE = P_META + b"fork_choice"
+K_OP_POOL = P_META + b"op_pool"
+
+
+def _slot_key(prefix: bytes, slot: int) -> bytes:
+    return prefix + int(slot).to_bytes(8, "big")
+
+
+class StoreError(ValueError):
+    pass
+
+
+@dataclass
+class HotStateSummary:
+    """Per-slot summary pointing to the epoch-boundary state to replay from
+    (reference HotStateSummary, hot_cold_store.rs)."""
+
+    slot: int
+    latest_block_root: bytes
+    epoch_boundary_state_root: bytes
+
+    def to_bytes(self) -> bytes:
+        return (int(self.slot).to_bytes(8, "little")
+                + self.latest_block_root + self.epoch_boundary_state_root)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "HotStateSummary":
+        return HotStateSummary(
+            int.from_bytes(data[:8], "little"), data[8:40], data[40:72])
+
+
+class HotColdDB:
+    def __init__(
+        self,
+        spec: T.ChainSpec,
+        hot: KeyValueStore | None = None,
+        cold: KeyValueStore | None = None,
+        slots_per_restore_point: int | None = None,
+    ):
+        self.spec = spec
+        self.t = T.make_types(spec.preset)
+        self.hot = hot if hot is not None else MemoryStore()
+        self.cold = cold if cold is not None else self.hot
+        self.slots_per_restore_point = (
+            slots_per_restore_point
+            if slots_per_restore_point is not None
+            else 2 * spec.slots_per_epoch)
+        self._init_schema()
+        self.split_slot = self._load_split()
+
+    # -- schema / metadata -------------------------------------------------
+
+    def _init_schema(self):
+        existing = self.hot.get(K_SCHEMA)
+        if existing is None:
+            self.hot.put(K_SCHEMA, SCHEMA_VERSION.to_bytes(8, "little"))
+        else:
+            found = int.from_bytes(existing, "little")
+            if found != SCHEMA_VERSION:
+                raise StoreError(
+                    f"schema version {found} != supported {SCHEMA_VERSION}"
+                    " (run the database manager migrate command)")
+
+    def _load_split(self) -> int:
+        raw = self.hot.get(K_SPLIT)
+        return int.from_bytes(raw, "little") if raw else 0
+
+    def _save_split(self, ops: list[KeyValueOp] | None = None):
+        data = int(self.split_slot).to_bytes(8, "little")
+        if ops is None:
+            self.hot.put(K_SPLIT, data)
+        else:
+            ops.append(KeyValueOp(K_SPLIT, data))
+
+    def put_metadata(self, key: bytes, value: bytes):
+        self.hot.put(P_META + key, value)
+
+    def get_metadata(self, key: bytes) -> bytes | None:
+        return self.hot.get(P_META + key)
+
+    # -- fork helpers ------------------------------------------------------
+
+    def _fork_at_slot(self, slot: int) -> str:
+        return self.spec.fork_at_epoch(self.spec.compute_epoch_at_slot(slot))
+
+    def _block_cls(self, slot: int):
+        return self.t.signed_beacon_block_class(self._fork_at_slot(slot))
+
+    def _state_cls(self, slot: int):
+        return self.t.beacon_state_class(self._fork_at_slot(slot))
+
+    # -- blocks ------------------------------------------------------------
+
+    def put_block(self, root: bytes, signed_block) -> None:
+        slot = int(signed_block.message.slot)
+        payload = slot.to_bytes(8, "little") + signed_block.serialize()
+        self.hot.put(P_BLOCK + root, payload)
+
+    def get_block(self, root: bytes):
+        raw = self.hot.get(P_BLOCK + root)
+        if raw is None:
+            return None
+        slot = int.from_bytes(raw[:8], "little")
+        return self._block_cls(slot).deserialize(raw[8:])
+
+    def block_exists(self, root: bytes) -> bool:
+        return self.hot.exists(P_BLOCK + root)
+
+    def delete_block(self, root: bytes) -> None:
+        self.hot.delete(P_BLOCK + root)
+
+    # -- blobs -------------------------------------------------------------
+
+    def put_blobs(self, block_root: bytes, blobs_ssz: bytes) -> None:
+        self.hot.put(P_BLOBS + block_root, blobs_ssz)
+
+    def get_blobs(self, block_root: bytes) -> bytes | None:
+        return self.hot.get(P_BLOBS + block_root)
+
+    # -- hot states --------------------------------------------------------
+
+    def _encode_state(self, state) -> bytes:
+        return int(state.slot).to_bytes(8, "little") + state.serialize()
+
+    def _decode_state(self, raw: bytes):
+        slot = int.from_bytes(raw[:8], "little")
+        return self._state_cls(slot).deserialize(raw[8:])
+
+    def put_state(self, state_root: bytes, state) -> None:
+        self.hot.put(P_STATE + state_root, self._encode_state(state))
+
+    def get_hot_state(self, state_root: bytes):
+        """Load a hot state: full if stored, else boundary state + replay."""
+        raw = self.hot.get(P_STATE + state_root)
+        if raw is not None:
+            return self._decode_state(raw)
+        raw = self.hot.get(P_SUMMARY + state_root)
+        if raw is None:
+            return None
+        summary = HotStateSummary.from_bytes(raw)
+        base_raw = self.hot.get(P_STATE + summary.epoch_boundary_state_root)
+        if base_raw is None:
+            raise StoreError(
+                f"missing epoch boundary state "
+                f"{summary.epoch_boundary_state_root.hex()[:16]}")
+        state = self._decode_state(base_raw)
+        blocks = self._blocks_between(
+            summary.latest_block_root, int(state.slot))
+        return self._replay(state, blocks, summary.slot)
+
+    def _blocks_between(self, head_block_root: bytes, after_slot: int) -> list:
+        """Walk parent pointers back to `after_slot`, return ascending."""
+        out = []
+        root = head_block_root
+        while True:
+            blk = self.get_block(root)
+            if blk is None or int(blk.message.slot) <= after_slot:
+                break
+            out.append(blk)
+            root = bytes(blk.message.parent_root)
+        out.reverse()
+        return out
+
+    def _replay(self, state, blocks, target_slot: int):
+        """Reference block_replayer: advance + apply, no sig checks."""
+        for blk in blocks:
+            if int(blk.message.slot) <= int(state.slot):
+                continue
+            state_advance(state, self.spec, int(blk.message.slot))
+            process_block(state, self.spec, blk,
+                          SignatureStrategy.NO_VERIFICATION)
+        if int(state.slot) < target_slot:
+            state_advance(state, self.spec, target_slot)
+        return state
+
+    # -- atomic import -----------------------------------------------------
+
+    def import_block(
+        self,
+        block_root: bytes,
+        signed_block,
+        state,
+        state_root: bytes,
+        blobs_ssz: bytes | None = None,
+    ) -> None:
+        """Atomically store a block + its post-state artifacts.
+
+        Full states are stored at epoch boundaries; every slot gets a
+        summary for replay-based loads (reference store_hot_state).
+        """
+        slot = int(signed_block.message.slot)
+        ops: list[KeyValueOp] = []
+        payload = slot.to_bytes(8, "little") + signed_block.serialize()
+        ops.append(KeyValueOp(P_BLOCK + block_root, payload))
+        if blobs_ssz is not None:
+            ops.append(KeyValueOp(P_BLOBS + block_root, blobs_ssz))
+
+        boundary_root = self._epoch_boundary_root(state, slot)
+        if slot % self.spec.slots_per_epoch == 0 or boundary_root is None:
+            ops.append(KeyValueOp(P_STATE + state_root,
+                                  self._encode_state(state)))
+            boundary_root = state_root
+        summary = HotStateSummary(
+            slot=slot,
+            latest_block_root=block_root,
+            epoch_boundary_state_root=boundary_root,
+        )
+        ops.append(KeyValueOp(P_SUMMARY + state_root, summary.to_bytes()))
+        self.hot.do_atomically(ops)
+
+    def _epoch_boundary_root(self, state, slot: int) -> bytes | None:
+        """State root at this epoch's first slot, from state.state_roots."""
+        boundary_slot = self.spec.compute_start_slot_at_epoch(
+            self.spec.compute_epoch_at_slot(slot))
+        if boundary_slot == slot:
+            return None
+        sphr = self.spec.preset.slots_per_historical_root
+        if not boundary_slot < int(state.slot) <= boundary_slot + sphr:
+            return None
+        root = bytes(state.state_roots[boundary_slot % sphr].tobytes())
+        if self.hot.exists(P_STATE + root) or self.hot.exists(P_SUMMARY + root):
+            return root
+        return None
+
+    def store_anchor_state(self, state_root: bytes, state) -> None:
+        """Store a full state unconditionally (genesis / checkpoint sync)."""
+        ops = [
+            KeyValueOp(P_STATE + state_root, self._encode_state(state)),
+            KeyValueOp(P_SUMMARY + state_root, HotStateSummary(
+                slot=int(state.slot),
+                latest_block_root=state.latest_block_header.hash_tree_root()
+                if bytes(state.latest_block_header.state_root) != b"\x00" * 32
+                else b"\x00" * 32,
+                epoch_boundary_state_root=state_root,
+            ).to_bytes()),
+        ]
+        if int(state.slot) == 0:
+            ops.append(KeyValueOp(K_GENESIS_STATE_ROOT, state_root))
+        self.hot.do_atomically(ops)
+
+    # -- freezer -----------------------------------------------------------
+
+    def migrate_to_finalized(
+        self, finalized_state_root: bytes, finalized_block_root: bytes
+    ) -> None:
+        """Move the canonical chain below the finalized slot to the freezer
+        and prune the hot DB (reference migrate.rs + store freezer logic).
+
+        For every slot in [split, finalized_slot): write canonical block
+        root + state root entries; full restore-point states every
+        `slots_per_restore_point`; delete hot summaries/states and
+        non-canonical (orphaned) blocks.
+        """
+        fin_state = self.get_hot_state(finalized_state_root)
+        if fin_state is None:
+            raise StoreError("finalized state missing")
+        fin_slot = int(fin_state.slot)
+        if fin_slot <= self.split_slot:
+            return
+        sphr = self.spec.preset.slots_per_historical_root
+
+        cold_ops: list[KeyValueOp] = []
+        canonical_state_roots: dict[int, bytes] = {}
+        canonical_block_roots: dict[int, bytes] = {}
+        for slot in range(self.split_slot, fin_slot):
+            if not slot < fin_slot <= slot + sphr:
+                continue
+            br = bytes(fin_state.block_roots[slot % sphr].tobytes())
+            sr = bytes(fin_state.state_roots[slot % sphr].tobytes())
+            canonical_block_roots[slot] = br
+            canonical_state_roots[slot] = sr
+            cold_ops.append(KeyValueOp(_slot_key(P_COLD_BLOCK_ROOT, slot), br))
+            cold_ops.append(KeyValueOp(_slot_key(P_COLD_STATE_ROOT, slot), sr))
+            if slot % self.slots_per_restore_point == 0:
+                st = self.get_hot_state(sr)
+                if st is not None:
+                    cold_ops.append(KeyValueOp(
+                        _slot_key(P_COLD_STATE, slot), self._encode_state(st)))
+        if cold_ops:
+            self.cold.do_atomically(cold_ops)
+
+        # prune hot: drop summaries/states below the new split, and blocks
+        # not on the canonical chain (orphans die at finalization)
+        hot_ops: list[KeyValueOp] = []
+        canonical_set = set(canonical_block_roots.values())
+        canonical_set.add(finalized_block_root)
+        for key, raw in list(self.hot.iter_prefix(P_SUMMARY)):
+            summary = HotStateSummary.from_bytes(raw)
+            if summary.slot < fin_slot and key[len(P_SUMMARY):] != finalized_state_root:
+                hot_ops.append(KeyValueOp(key, None))
+        for key, raw in list(self.hot.iter_prefix(P_STATE)):
+            slot = int.from_bytes(raw[:8], "little")
+            if slot < fin_slot and key[len(P_STATE):] != finalized_state_root:
+                hot_ops.append(KeyValueOp(key, None))
+        for key, raw in list(self.hot.iter_prefix(P_BLOCK)):
+            slot = int.from_bytes(raw[:8], "little")
+            root = key[len(P_BLOCK):]
+            if slot < fin_slot and root not in canonical_set:
+                hot_ops.append(KeyValueOp(key, None))
+
+        self.split_slot = fin_slot
+        self._save_split(hot_ops)
+        self.hot.do_atomically(hot_ops)
+
+    def get_cold_state_by_slot(self, slot: int):
+        """Restore-point load + replay (reference load_cold_state)."""
+        rp_slot = slot - (slot % self.slots_per_restore_point)
+        raw = self.cold.get(_slot_key(P_COLD_STATE, rp_slot))
+        if raw is None:
+            return None
+        state = self._decode_state(raw)
+        blocks = []
+        for s in range(rp_slot + 1, slot + 1):
+            br = self.cold.get(_slot_key(P_COLD_BLOCK_ROOT, s))
+            if br is None:
+                continue
+            if blocks and blocks[-1][1] == br:
+                continue  # skipped slot repeats the previous root
+            blocks.append((s, br))
+        seen = set()
+        chain = []
+        for s, br in blocks:
+            if br in seen:
+                continue
+            seen.add(br)
+            blk = self.get_block(br)
+            if blk is not None and int(blk.message.slot) > rp_slot:
+                chain.append(blk)
+        return self._replay(state, chain, slot)
+
+    def get_state(self, state_root: bytes, slot: int | None = None):
+        """Universal state load: hot first, then freezer by slot."""
+        st = self.get_hot_state(state_root)
+        if st is not None:
+            return st
+        if slot is not None and slot < self.split_slot:
+            return self.get_cold_state_by_slot(slot)
+        return None
+
+    def cold_block_root_at_slot(self, slot: int) -> bytes | None:
+        return self.cold.get(_slot_key(P_COLD_BLOCK_ROOT, slot))
+
+    def cold_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self.cold.get(_slot_key(P_COLD_STATE_ROOT, slot))
+
+    def forwards_block_roots(self, start_slot: int, end_slot: int):
+        """Iterate canonical (slot, block_root) from the freezer."""
+        for slot in range(start_slot, end_slot):
+            br = self.cold_block_root_at_slot(slot)
+            if br is not None:
+                yield slot, br
+
+    # -- persistence of auxiliary components ------------------------------
+
+    def persist_fork_choice(self, blob: bytes):
+        self.hot.put(K_FORK_CHOICE, blob)
+
+    def load_fork_choice(self) -> bytes | None:
+        return self.hot.get(K_FORK_CHOICE)
+
+    def persist_op_pool(self, blob: bytes):
+        self.hot.put(K_OP_POOL, blob)
+
+    def load_op_pool(self) -> bytes | None:
+        return self.hot.get(K_OP_POOL)
+
+    def persist_head(self, head_root: bytes):
+        self.hot.put(K_HEAD, head_root)
+
+    def load_head(self) -> bytes | None:
+        return self.hot.get(K_HEAD)
+
+    # -- inspection (database manager support) ----------------------------
+
+    def summary_stats(self) -> dict:
+        counts: dict[str, int] = {}
+        for name, prefix in [
+            ("blocks", P_BLOCK), ("states", P_STATE),
+            ("summaries", P_SUMMARY), ("cold_states", P_COLD_STATE),
+            ("cold_block_roots", P_COLD_BLOCK_ROOT),
+        ]:
+            src = self.cold if prefix.startswith(b"f") else self.hot
+            counts[name] = sum(1 for _ in src.iter_prefix(prefix))
+        counts["split_slot"] = self.split_slot
+        counts["schema"] = SCHEMA_VERSION
+        return counts
+
+    def compact(self):
+        self.hot.compact()
+        if self.cold is not self.hot:
+            self.cold.compact()
+
+    def close(self):
+        self.hot.close()
+        if self.cold is not self.hot:
+            self.cold.close()

@@ -1,0 +1,225 @@
+"""Key-value store interface + backends (memory, native C++ log store).
+
+Rebuild of the reference's `KeyValueStore` trait with its LevelDB and
+in-memory implementations (/root/reference/beacon_node/store/src/
+{lib.rs,leveldb_store.rs,memory_store.rs}).  The persistent backend is the
+C++ embedded log store in lighthouse_tpu/native/kvstore.cc, bound via
+ctypes — the hot path (batch import) crosses the FFI once per batch with a
+single packed buffer, not once per key.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+from typing import Iterator
+
+
+@dataclass
+class KeyValueOp:
+    """One op in an atomic batch: put (value is bytes) or delete (None)."""
+
+    key: bytes
+    value: bytes | None  # None = delete
+
+
+class KeyValueStore:
+    """Interface: get/put/delete/atomic batch/ordered prefix iteration."""
+
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def do_atomically(self, ops: list[KeyValueOp]) -> None:
+        raise NotImplementedError
+
+    def iter_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def compact(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class MemoryStore(KeyValueStore):
+    """Ephemeral dict-backed store (reference memory_store.rs)."""
+
+    def __init__(self):
+        self._d: dict[bytes, bytes] = {}
+
+    def get(self, key):
+        return self._d.get(key)
+
+    def put(self, key, value):
+        self._d[key] = bytes(value)
+
+    def delete(self, key):
+        self._d.pop(key, None)
+
+    def exists(self, key):
+        return key in self._d
+
+    def do_atomically(self, ops):
+        for op in ops:
+            if op.value is None:
+                self._d.pop(op.key, None)
+            else:
+                self._d[op.key] = bytes(op.value)
+
+    def iter_prefix(self, prefix):
+        for k in sorted(self._d):
+            if k.startswith(prefix):
+                yield k, self._d[k]
+
+    def __len__(self):
+        return len(self._d)
+
+
+_lib = None
+
+
+def _load_native():
+    global _lib
+    if _lib is not None:
+        return _lib
+    from lighthouse_tpu.native import build_shared_lib
+
+    path = build_shared_lib("kvstore.cc")
+    lib = ctypes.CDLL(str(path))
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    lib.kv_put.restype = ctypes.c_int
+    lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                           ctypes.c_char_p, ctypes.c_size_t]
+    lib.kv_del.restype = ctypes.c_int
+    lib.kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.kv_batch.restype = ctypes.c_int
+    lib.kv_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.kv_get.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                           ctypes.POINTER(ctypes.c_size_t)]
+    lib.kv_exists.restype = ctypes.c_int
+    lib.kv_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.kv_count.restype = ctypes.c_uint64
+    lib.kv_count.argtypes = [ctypes.c_void_p]
+    lib.kv_log_size.restype = ctypes.c_uint64
+    lib.kv_log_size.argtypes = [ctypes.c_void_p]
+    lib.kv_iter_prefix.restype = ctypes.c_void_p
+    lib.kv_iter_prefix.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.kv_iter_next.restype = ctypes.c_int
+    lib.kv_iter_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.kv_iter_close.argtypes = [ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+_PUT, _DEL = 1, 2
+
+
+class NativeKVStore(KeyValueStore):
+    """Persistent store over the C++ log engine."""
+
+    def __init__(self, path: str):
+        self._lib = _load_native()
+        self._h = self._lib.kv_open(str(path).encode())
+        if not self._h:
+            raise OSError(f"kv_open failed for {path}")
+
+    def get(self, key):
+        n = ctypes.c_size_t(0)
+        p = self._lib.kv_get(self._h, key, len(key), ctypes.byref(n))
+        if not p:
+            return None
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            self._lib.kv_free(p)
+
+    def put(self, key, value):
+        if self._lib.kv_put(self._h, key, len(key), value, len(value)) != 0:
+            raise OSError("kv_put failed")
+
+    def delete(self, key):
+        if self._lib.kv_del(self._h, key, len(key)) < 0:
+            raise OSError("kv_del failed")
+
+    def exists(self, key):
+        return bool(self._lib.kv_exists(self._h, key, len(key)))
+
+    def do_atomically(self, ops):
+        parts = []
+        for op in ops:
+            v = b"" if op.value is None else bytes(op.value)
+            code = _DEL if op.value is None else _PUT
+            parts.append(bytes([code]))
+            parts.append(len(op.key).to_bytes(4, "little"))
+            parts.append(op.key)
+            parts.append(len(v).to_bytes(4, "little"))
+            parts.append(v)
+        buf = b"".join(parts)
+        rc = self._lib.kv_batch(self._h, buf, len(buf))
+        if rc != 0:
+            raise OSError(f"kv_batch failed rc={rc}")
+
+    def iter_prefix(self, prefix):
+        it = self._lib.kv_iter_prefix(self._h, prefix, len(prefix))
+        try:
+            while True:
+                kp = ctypes.POINTER(ctypes.c_uint8)()
+                vp = ctypes.POINTER(ctypes.c_uint8)()
+                kn = ctypes.c_size_t(0)
+                vn = ctypes.c_size_t(0)
+                rc = self._lib.kv_iter_next(
+                    it, ctypes.byref(kp), ctypes.byref(kn),
+                    ctypes.byref(vp), ctypes.byref(vn))
+                if rc <= 0:
+                    if rc < 0:
+                        raise OSError("kv_iter_next failed")
+                    return
+                try:
+                    yield (ctypes.string_at(kp, kn.value),
+                           ctypes.string_at(vp, vn.value))
+                finally:
+                    self._lib.kv_free(kp)
+                    self._lib.kv_free(vp)
+        finally:
+            self._lib.kv_iter_close(it)
+
+    def compact(self):
+        if self._lib.kv_compact(self._h) != 0:
+            raise OSError("kv_compact failed")
+
+    def close(self):
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+    def log_size(self) -> int:
+        return int(self._lib.kv_log_size(self._h))
+
+    def __len__(self):
+        return int(self._lib.kv_count(self._h))

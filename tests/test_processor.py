@@ -1,0 +1,202 @@
+"""Beacon processor tests: priority, batching, reprocessing, dedup.
+
+Models the reference's queue/priority assertions driven through the
+work-journal hook (/root/reference/beacon_node/network/src/
+network_beacon_processor/tests.rs, using work_journal_tx).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from lighthouse_tpu.processor import (
+    BeaconProcessor,
+    DuplicateCache,
+    ReprocessQueue,
+    WorkEvent,
+    WorkType,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_priority_order_blocks_before_attestations():
+    """With one worker, queued gossip blocks are scheduled before queued
+    attestations regardless of submission order."""
+
+    async def main():
+        journal = []
+        bp = BeaconProcessor(max_workers=2, batch_flush_ms=5,
+                             work_journal=journal.append)
+        order = []
+        # submit attestations FIRST, then a block — block must run first
+        for i in range(3):
+            bp.submit(WorkEvent(
+                WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_batch=lambda ps: order.append(("atts", len(ps)))))
+        bp.submit(WorkEvent(
+            WorkType.GOSSIP_BLOCK, process=lambda: order.append(("block", 1))))
+        await bp.start()
+        await bp.stop()
+        assert order[0] == ("block", 1)
+        assert ("atts", 3) in order
+        assert journal[0] == "GOSSIP_BLOCK"
+        return journal
+
+    journal = run(main())
+    assert any(j.startswith("GOSSIP_ATTESTATION_BATCH(") for j in journal)
+
+
+def test_batch_formation_caps_at_max_batch():
+    async def main():
+        done = []
+        bp = BeaconProcessor(max_workers=2, max_batch=8, batch_flush_ms=1)
+        for i in range(20):
+            bp.submit(WorkEvent(
+                WorkType.GOSSIP_ATTESTATION, payload=i,
+                process_batch=lambda ps: done.append(list(ps))))
+        await bp.start()
+        await bp.stop()
+        assert sum(len(b) for b in done) == 20
+        assert max(len(b) for b in done) <= 8
+        assert bp.metrics.batches_formed >= 2
+
+    run(main())
+
+
+def test_time_based_flush_forms_partial_batch():
+    async def main():
+        done = []
+        bp = BeaconProcessor(max_workers=2, max_batch=1024, batch_flush_ms=20)
+        for i in range(5):
+            bp.submit(WorkEvent(
+                WorkType.GOSSIP_ATTESTATION, payload=i,
+                process_batch=lambda ps: done.append(len(ps))))
+        await bp.start()
+        t0 = time.monotonic()
+        while not done and time.monotonic() - t0 < 2.0:
+            await asyncio.sleep(0.005)
+        await bp.stop()
+        # far fewer than max_batch lanes, flushed by the deadline
+        assert done and done[0] == 5
+
+    run(main())
+
+
+def test_lifo_gossip_queue_drops_oldest():
+    async def main():
+        bp = BeaconProcessor(
+            max_workers=2,
+            queue_lengths={WorkType.GOSSIP_ATTESTATION: 4})
+        for i in range(6):
+            bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i))
+        q = bp._queues[WorkType.GOSSIP_ATTESTATION]
+        assert [e.payload for e in q] == [2, 3, 4, 5]
+        assert bp.metrics.dropped[WorkType.GOSSIP_ATTESTATION] == 2
+
+    run(main())
+
+
+def test_fifo_queue_rejects_newest_when_full():
+    async def main():
+        bp = BeaconProcessor(
+            max_workers=2, queue_lengths={WorkType.RPC_BLOCK: 2})
+        assert bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=1))
+        assert bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=2))
+        assert not bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=3))
+        q = bp._queues[WorkType.RPC_BLOCK]
+        assert [e.payload for e in q] == [1, 2]
+
+    run(main())
+
+
+def test_worker_exception_does_not_kill_manager():
+    async def main():
+        done = []
+
+        def boom():
+            raise RuntimeError("worker panic")
+
+        bp = BeaconProcessor(max_workers=2)
+        bp.submit(WorkEvent(WorkType.GOSSIP_BLOCK, process=boom))
+        bp.submit(WorkEvent(WorkType.GOSSIP_BLOCK,
+                            process=lambda: done.append(1)))
+        await bp.start()
+        await bp.stop()
+        assert done == [1]
+
+    run(main())
+
+
+def test_async_work_supported():
+    async def main():
+        done = []
+
+        async def work():
+            await asyncio.sleep(0.001)
+            done.append("async")
+
+        bp = BeaconProcessor(max_workers=2)
+        bp.submit(WorkEvent(WorkType.API_REQUEST_P0, process=work))
+        await bp.start()
+        await bp.stop()
+        assert done == ["async"]
+
+    run(main())
+
+
+def test_reprocess_unknown_block_attestation_flushes_on_import():
+    async def main():
+        done = []
+        bp = BeaconProcessor(max_workers=2, batch_flush_ms=1)
+        rq = ReprocessQueue(bp)
+        root = b"\x11" * 32
+        ev = WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION,
+                       process=lambda: done.append("att"))
+        assert rq.park_for_block(ev, root)
+        await bp.start()
+        await rq.start()
+        await asyncio.sleep(0.02)
+        assert done == []  # still parked
+        rq.on_block_imported(root)
+        await bp.drain()
+        assert done == ["att"]
+        await rq.stop()
+        await bp.stop()
+
+    run(main())
+
+
+def test_reprocess_timer_fires():
+    async def main():
+        done = []
+        bp = BeaconProcessor(max_workers=2)
+        rq = ReprocessQueue(bp)
+        rq.park_delayed(
+            WorkEvent(WorkType.DELAYED_IMPORT_BLOCK,
+                      process=lambda: done.append("block")),
+            delay=0.02)
+        await bp.start()
+        await rq.start()
+        t0 = time.monotonic()
+        while not done and time.monotonic() - t0 < 2.0:
+            await asyncio.sleep(0.005)
+        await rq.stop()
+        await bp.stop()
+        assert done == ["block"]
+        assert time.monotonic() - t0 >= 0.01
+
+    run(main())
+
+
+def test_duplicate_cache():
+    dc = DuplicateCache()
+    r = b"\x22" * 32
+    assert dc.check_and_insert(r)
+    assert not dc.check_and_insert(r)
+    dc.release(r)
+    assert dc.check_and_insert(r)

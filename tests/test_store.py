@@ -1,0 +1,193 @@
+"""Store tests: native KV engine + hot/cold DB over harness chains.
+
+Models the reference's store tests
+(/root/reference/beacon_node/beacon_chain/tests/store_tests.rs): round-trip
+blocks/states, replay-based state loads, finalization migration, pruning.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.store import (
+    HotColdDB,
+    KeyValueOp,
+    MemoryStore,
+    NativeKVStore,
+)
+from lighthouse_tpu.testing import Harness
+
+
+class TestNativeKV:
+    def test_roundtrip_and_persistence(self, tmp_path):
+        db = NativeKVStore(str(tmp_path / "db"))
+        db.put(b"a", b"1")
+        db.put(b"b", b"" )
+        db.do_atomically([KeyValueOp(b"c", b"3"), KeyValueOp(b"a", None)])
+        assert db.get(b"a") is None
+        assert db.get(b"b") == b""
+        assert db.get(b"c") == b"3"
+        db.close()
+        db2 = NativeKVStore(str(tmp_path / "db"))
+        assert db2.get(b"c") == b"3"
+        assert db2.get(b"a") is None
+        assert len(db2) == 2
+        db2.close()
+
+    def test_prefix_iteration_is_ordered(self, tmp_path):
+        db = NativeKVStore(str(tmp_path / "db"))
+        for i in [3, 1, 2]:
+            db.put(b"p:" + bytes([i]), bytes([i]))
+        db.put(b"q:x", b"other")
+        got = list(db.iter_prefix(b"p:"))
+        assert got == [(b"p:\x01", b"\x01"), (b"p:\x02", b"\x02"),
+                       (b"p:\x03", b"\x03")]
+        db.close()
+
+    def test_compaction_reclaims_space(self, tmp_path):
+        db = NativeKVStore(str(tmp_path / "db"))
+        for i in range(50):
+            db.put(b"k", b"v" * 1000)  # 49 dead versions
+        before = db.log_size()
+        db.compact()
+        after = db.log_size()
+        assert after < before / 10
+        assert db.get(b"k") == b"v" * 1000
+        db.close()
+
+    def test_large_values(self, tmp_path):
+        db = NativeKVStore(str(tmp_path / "db"))
+        big = bytes(range(256)) * 4096  # 1 MiB
+        db.put(b"big", big)
+        assert db.get(b"big") == big
+        db.close()
+
+
+@pytest.fixture(scope="module")
+def chain_db():
+    """A 2.5-epoch chain imported into a memory-backed HotColdDB."""
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    db = HotColdDB(h.spec, MemoryStore(), slots_per_restore_point=8)
+    genesis_root = h.state.hash_tree_root()
+    db.store_anchor_state(genesis_root, h.state)
+    from lighthouse_tpu.state_transition import state_transition
+
+    imported = []
+    for _ in range(20):
+        atts = [h.attest()] if int(h.state.slot) > 0 else []
+        signed = h.produce_block(attestations=atts)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        block_root = signed.message.hash_tree_root()
+        state_root = bytes(signed.message.state_root)
+        db.import_block(block_root, signed, h.state, state_root)
+        imported.append((block_root, state_root, signed, h.state.copy()))
+    return h, db, imported
+
+
+class TestHotColdDB:
+    def test_block_roundtrip(self, chain_db):
+        h, db, imported = chain_db
+        root, _, signed, _ = imported[7]
+        got = db.get_block(root)
+        assert got is not None
+        assert got.hash_tree_root() == signed.hash_tree_root()
+
+    def test_full_state_at_epoch_boundary(self, chain_db):
+        h, db, imported = chain_db
+        # block at slot 8 (epoch boundary, minimal preset) stored in full
+        for root, state_root, signed, post in imported:
+            if int(signed.message.slot) == 8:
+                raw = db.hot.get(b"sta:" + state_root)
+                assert raw is not None
+                return
+        pytest.fail("no epoch boundary block found")
+
+    def test_replay_based_state_load(self, chain_db):
+        h, db, imported = chain_db
+        # a mid-epoch state has no full record: must load via replay
+        root, state_root, signed, post = imported[10]  # slot 11
+        assert db.hot.get(b"sta:" + state_root) is None
+        st = db.get_hot_state(state_root)
+        assert st is not None
+        assert int(st.slot) == int(post.slot)
+        assert st.hash_tree_root() == post.hash_tree_root()
+
+    def test_migration_moves_chain_to_freezer(self, chain_db):
+        h, db, imported = chain_db
+        # finalize at slot 16 (epoch 2): slots [0,16) go cold
+        fin_root, fin_state_root, fin_signed, fin_post = imported[15]
+        db.migrate_to_finalized(fin_state_root, fin_root)
+        assert db.split_slot == 16
+        # canonical block roots live in the freezer
+        got = db.cold_block_root_at_slot(10)
+        want = imported[9][0]  # block at slot 10
+        assert got == want
+        # cold restore point exists at slot 8 (sprp=8)
+        assert db.cold.get(b"fzs:" + (8).to_bytes(8, "big")) is not None
+        # hot summaries below the split are pruned
+        old_state_root = imported[5][1]
+        assert db.get_hot_state(old_state_root) is None
+
+    def test_cold_state_reconstruction(self, chain_db):
+        h, db, imported = chain_db
+        st = db.get_cold_state_by_slot(11)
+        assert st is not None
+        assert int(st.slot) == 11
+        want = imported[10][3]  # post-state of the slot-11 block
+        assert st.hash_tree_root() == want.hash_tree_root()
+
+    def test_blocks_survive_migration(self, chain_db):
+        h, db, imported = chain_db
+        # canonical blocks stay addressable by root after going cold
+        root, _, signed, _ = imported[3]
+        assert db.get_block(root) is not None
+
+    def test_forwards_iteration(self, chain_db):
+        h, db, imported = chain_db
+        roots = dict(db.forwards_block_roots(1, 16))
+        assert roots[5] == imported[4][0]
+        assert len(roots) == 15
+
+    def test_metadata_persistence(self, chain_db):
+        h, db, imported = chain_db
+        db.persist_head(imported[-1][0])
+        assert db.load_head() == imported[-1][0]
+        db.persist_fork_choice(b"fc-blob")
+        assert db.load_fork_choice() == b"fc-blob"
+
+    def test_stats(self, chain_db):
+        h, db, imported = chain_db
+        stats = db.summary_stats()
+        assert stats["blocks"] >= 15
+        assert stats["cold_block_roots"] == 16
+
+
+class TestHotColdOnNativeKV:
+    def test_chain_on_disk(self, tmp_path):
+        """End-to-end: real C++ KV engine under the hot/cold DB."""
+        h = Harness(n_validators=32, fork="altair", real_crypto=False)
+        hot = NativeKVStore(str(tmp_path / "hot"))
+        cold = NativeKVStore(str(tmp_path / "cold"))
+        db = HotColdDB(h.spec, hot, cold, slots_per_restore_point=8)
+        db.store_anchor_state(h.state.hash_tree_root(), h.state)
+        from lighthouse_tpu.state_transition import state_transition
+
+        roots = []
+        for _ in range(10):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            br = signed.message.hash_tree_root()
+            db.import_block(br, signed, h.state,
+                            bytes(signed.message.state_root))
+            roots.append((br, bytes(signed.message.state_root)))
+        db.close()
+
+        # reopen from disk and load the tip state via replay
+        db2 = HotColdDB(h.spec, NativeKVStore(str(tmp_path / "hot")),
+                        NativeKVStore(str(tmp_path / "cold")),
+                        slots_per_restore_point=8)
+        br, sr = roots[-1]
+        assert db2.get_block(br) is not None
+        st = db2.get_hot_state(sr)
+        assert st is not None
+        assert st.hash_tree_root() == h.state.hash_tree_root()
+        db2.close()
