@@ -1,0 +1,50 @@
+"""Chain orchestration: verification pipelines, caches, canonical head.
+
+Reference: /root/reference/beacon_node/beacon_chain.
+"""
+
+from lighthouse_tpu.chain.attestation_verification import (
+    AttestationError,
+    VerifiedAttestation,
+    verify_signature_sets_with_bisection,
+)
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.chain.block_verification import (
+    BlockError,
+    ExecutionPendingBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+    execute_block,
+    verify_block_for_gossip,
+    verify_block_signatures,
+)
+from lighthouse_tpu.chain.caches import (
+    BlockTimesCache,
+    EpochIndexedSeen,
+    ObservedDigests,
+    ShufflingCache,
+    SlotIndexedSeen,
+    StateCache,
+    ValidatorPubkeyCache,
+)
+
+__all__ = [
+    "BeaconChain",
+    "BlockError",
+    "AttestationError",
+    "VerifiedAttestation",
+    "GossipVerifiedBlock",
+    "SignatureVerifiedBlock",
+    "ExecutionPendingBlock",
+    "verify_block_for_gossip",
+    "verify_block_signatures",
+    "execute_block",
+    "verify_signature_sets_with_bisection",
+    "ShufflingCache",
+    "ValidatorPubkeyCache",
+    "EpochIndexedSeen",
+    "SlotIndexedSeen",
+    "ObservedDigests",
+    "StateCache",
+    "BlockTimesCache",
+]

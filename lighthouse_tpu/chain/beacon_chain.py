@@ -1,0 +1,389 @@
+"""BeaconChain: verification pipelines, import, canonical head.
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/beacon_chain.rs
+(the BeaconChain god-object) at the altitude this framework needs: the
+gossip → signature → execution typestate pipeline feeding fork choice and
+the hot/cold store, batch attestation verification on the pluggable BLS
+backend, canonical-head recompute (canonical_head.rs:495), and block
+production (beacon_chain.rs:4224).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.chain import attestation_verification as att_verify
+from lighthouse_tpu.chain.block_verification import (
+    BlockError,
+    ExecutionPendingBlock,
+    execute_block,
+    verify_block_for_gossip,
+    verify_block_signatures,
+)
+from lighthouse_tpu.chain.caches import (
+    BlockTimesCache,
+    EpochIndexedSeen,
+    ObservedDigests,
+    ShufflingCache,
+    SlotIndexedSeen,
+    StateCache,
+    ValidatorPubkeyCache,
+)
+from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
+from lighthouse_tpu.fork_choice import ForkChoice
+from lighthouse_tpu.store import HotColdDB
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        spec: T.ChainSpec,
+        genesis_state,
+        store: HotColdDB | None = None,
+        slot_clock: SlotClock | None = None,
+        verify_signatures: bool = True,
+    ):
+        self.spec = spec
+        self.t = T.make_types(spec.preset)
+        self.store = store if store is not None else HotColdDB(spec)
+        self.slot_clock = slot_clock or ManualSlotClock(
+            int(genesis_state.genesis_time), spec.seconds_per_slot)
+        self.verify_signatures = verify_signatures
+
+        genesis_root = self._anchor_block_root(genesis_state)
+        state_root = genesis_state.hash_tree_root()
+        self.genesis_block_root = genesis_root
+        self.store.store_anchor_state(state_root, genesis_state)
+
+        self.fork_choice = ForkChoice(
+            spec, genesis_root, genesis_state,
+            balances_fn=self._balances_for_checkpoint)
+        self._anchor_state_root = state_root
+
+        self.head_root = genesis_root
+        self.head_state = genesis_state
+        self.state_cache = StateCache(capacity=8)
+        self.state_cache.insert(state_root, genesis_state)
+        # block root -> state root (for state_for_block); the store also
+        # resolves this via block records, this is the hot fast path
+        self._state_root_of_block: dict[bytes, bytes] = {
+            genesis_root: state_root}
+
+        self.shuffling_cache = ShufflingCache()
+        self.pubkey_cache = ValidatorPubkeyCache()
+        self.pubkey_cache.import_new(genesis_state.validators)
+        self.observed_attesters = EpochIndexedSeen()
+        self.observed_aggregators = EpochIndexedSeen()
+        self.observed_aggregates = ObservedDigests()
+        self.observed_block_producers = SlotIndexedSeen()
+        self.block_times = BlockTimesCache()
+        self.metrics: dict[str, float] = {}
+        self._migrated_finalized_epoch = self.fork_choice.finalized.epoch
+        self._advanced_states: dict[bytes, object] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    @staticmethod
+    def _anchor_block_root(state) -> bytes:
+        header = state.latest_block_header
+        if bytes(header.state_root) == b"\x00" * 32:
+            hdr = T.BeaconBlockHeader(
+                slot=header.slot, proposer_index=header.proposer_index,
+                parent_root=header.parent_root,
+                state_root=state.hash_tree_root(),
+                body_root=header.body_root)
+            return hdr.hash_tree_root()
+        return header.hash_tree_root()
+
+    def current_slot(self) -> int:
+        return self.slot_clock.current_slot()
+
+    def _balances_for_checkpoint(self, block_root: bytes) -> np.ndarray:
+        st = self.state_for_block(block_root)
+        if st is None:
+            st = self.head_state
+        epoch = self.spec.compute_epoch_at_slot(int(st.slot))
+        eb = np.asarray(st.validators.effective_balance, np.int64).copy()
+        eb[~st.validators.is_active(epoch)] = 0
+        return eb
+
+    def committee_shuffle(self, state, epoch: int):
+        """Cached committee shuffle for (epoch, seed, active-count) — the
+        seed pins the randao mix, so equal keys give equal shuffles across
+        branches (reference shuffling_cache keyed by decision root)."""
+        from lighthouse_tpu.state_transition import misc
+
+        seed = misc.get_seed(state, self.spec, epoch,
+                             self.spec.domain_beacon_attester)
+        n_active = int(state.validators.is_active(epoch).sum())
+        key = seed + n_active.to_bytes(8, "little")
+        shuffle = self.shuffling_cache.get(epoch, key)
+        if shuffle is None:
+            shuffle = misc.compute_committee_shuffle(state, self.spec, epoch)
+            self.shuffling_cache.insert(epoch, key, shuffle)
+        return shuffle
+
+    def state_for_block(self, block_root: bytes):
+        """Post-state of `block_root`: hot cache first, then store replay."""
+        state_root = self._state_root_of_block.get(block_root)
+        if state_root is None:
+            blk = self.store.get_block(block_root)
+            if blk is None:
+                if block_root == self.genesis_block_root:
+                    state_root = self._anchor_state_root
+                else:
+                    return None
+            else:
+                state_root = bytes(blk.message.state_root)
+            self._state_root_of_block[block_root] = state_root
+        cached = self.state_cache.get(state_root)
+        if cached is not None:
+            return cached
+        st = self.store.get_hot_state(state_root)
+        if st is not None:
+            self.state_cache.insert(state_root, st)
+        return st
+
+    # -- block import pipeline --------------------------------------------
+
+    def process_block(self, signed_block, blobs_ssz: bytes | None = None,
+                      source: str = "gossip") -> bytes:
+        """Full pipeline: gossip-verify → batch-signature-verify → execute
+        → import (reference chain.process_block, beacon_chain.rs:3089).
+        source="rpc" for sync-fetched blocks (skips gossip-only checks)."""
+        t_start = time.perf_counter()
+        gossip = verify_block_for_gossip(self, signed_block, source)
+        sigv = verify_block_signatures(self, gossip)
+        pending = execute_block(self, sigv)
+        root = self.import_block(pending, blobs_ssz)
+        self.block_times.record(root, "total", time.perf_counter() - t_start)
+        return root
+
+    def import_block(self, pending: ExecutionPendingBlock,
+                     blobs_ssz: bytes | None = None) -> bytes:
+        """Fork choice + atomic DB write + head recompute
+        (reference chain.import_block, beacon_chain.rs:3449)."""
+        block = pending.signed_block.message
+        root = pending.block_root
+        state = pending.post_state
+        current_slot = max(self.current_slot(), int(block.slot))
+
+        is_timely = (
+            int(block.slot) == self.slot_clock.current_slot()
+            and self.slot_clock.is_timely_for_boost())
+        self.fork_choice.on_block(
+            current_slot, block, root, state, is_timely=is_timely)
+
+        # apply the block's attestations/slashings to fork choice
+        # (block_verification.rs:1654-1688)
+        from lighthouse_tpu.state_transition.block_processing import (
+            get_attesting_indices,
+        )
+        for att in block.body.attestations:
+            try:
+                shuffle = self.committee_shuffle(
+                    state, int(att.data.target.epoch))
+                indices = get_attesting_indices(state, self.spec, att, shuffle)
+                self.fork_choice.on_attestation(
+                    current_slot, indices, bytes(att.data.beacon_block_root),
+                    int(att.data.target.epoch), int(att.data.slot),
+                    is_from_block=True)
+            except Exception:
+                pass  # invalid-for-fork-choice attestations are skippable
+        for slashing in block.body.attester_slashings:
+            a1 = set(int(i) for i in slashing.attestation_1.attesting_indices)
+            a2 = set(int(i) for i in slashing.attestation_2.attesting_indices)
+            both = np.array(sorted(a1 & a2), np.int64)
+            if both.size:
+                self.fork_choice.on_attester_slashing(both)
+
+        self.store.import_block(root, pending.signed_block, state,
+                                pending.state_root, blobs_ssz)
+        self._state_root_of_block[root] = pending.state_root
+        self.state_cache.insert(pending.state_root, state)
+        self.pubkey_cache.import_new(state.validators)
+        self.recompute_head()
+        return root
+
+    def recompute_head(self) -> bytes:
+        """Fork-choice get_head + head snapshot update + finality pruning
+        (reference recompute_head_at_slot, canonical_head.rs:495)."""
+        head = self.fork_choice.get_head(self.current_slot())
+        if head != self.head_root:
+            st = self.state_for_block(head)
+            if st is not None:
+                self.head_root = head
+                self.head_state = st
+                self.store.persist_head(head)
+        if self.fork_choice.finalized.epoch > self._migrated_finalized_epoch:
+            self._on_finalized()
+        return self.head_root
+
+    def _on_finalized(self):
+        """Prune fork choice + migrate the store (reference migrate.rs)."""
+        fin = self.fork_choice.finalized
+        fin_block = self.store.get_block(fin.root)
+        if fin_block is None:
+            return  # retry at the next head recompute
+        self.fork_choice.prune()
+        self.store.migrate_to_finalized(
+            bytes(fin_block.message.state_root), fin.root)
+        self._migrated_finalized_epoch = fin.epoch
+
+    # -- attestation pipelines --------------------------------------------
+
+    def verify_attestations_for_gossip(self, attestations: list):
+        """Batch-verify unaggregated gossip attestations
+        (reference batch_verify_unaggregated_attestations,
+        beacon_chain.rs:1961 + batch.rs:133).  Returns
+        (verified, rejects) — verified items are already applied to fork
+        choice."""
+        return self._batch_pipeline(
+            attestations, att_verify.verify_unaggregated_for_gossip)
+
+    def verify_aggregates_for_gossip(self, aggregates: list):
+        """Batch-verify SignedAggregateAndProofs (3 sets each,
+        batch.rs:62-102)."""
+        verified, rejects = self._batch_pipeline(
+            aggregates, att_verify.verify_aggregated_for_gossip)
+        return verified, rejects
+
+    def _batch_pipeline(self, items, verify_fn):
+        candidates, rejects = [], []
+        for item in items:
+            state = self._attestation_state(item)
+            try:
+                candidates.append(verify_fn(self, item, state))
+            except att_verify.AttestationError as e:
+                rejects.append((item, e.reason))
+        if self.verify_signatures:
+            att_verify.batch_verify(self, candidates)
+        else:
+            for c in candidates:
+                c.ok = True
+        verified = []
+        for c in candidates:
+            if not c.ok:
+                rejects.append((c.item, "invalid_signature"))
+                continue
+            if not att_verify.commit_observations(self, c):
+                rejects.append((c.item, "duplicate_in_batch"))
+                continue
+            verified.append(c)
+            try:
+                self.fork_choice.on_attestation(
+                    self.current_slot(), c.indexed_indices,
+                    bytes(c.attestation.data.beacon_block_root),
+                    int(c.attestation.data.target.epoch),
+                    int(c.attestation.data.slot))
+            except Exception:
+                pass
+        return verified, rejects
+
+    def _attestation_state(self, item):
+        """State to validate an attestation against: the target block's
+        post-state, advanced to the attestation's target epoch when stale
+        (committees come from the target-epoch shuffle, so an old state
+        would compute the wrong committee)."""
+        from lighthouse_tpu.state_transition import state_advance
+
+        att = getattr(getattr(item, "message", item), "aggregate", None)
+        att = att if att is not None else getattr(item, "message", item)
+        data = att.data if hasattr(att, "data") else att
+        root = bytes(data.beacon_block_root)
+        st = self.state_for_block(root)
+        if st is None:
+            st = self.head_state
+        target_epoch = int(data.target.epoch)
+        spec = self.spec
+        if spec.compute_epoch_at_slot(int(st.slot)) < target_epoch:
+            key = root + target_epoch.to_bytes(8, "little")
+            cached = self._advanced_states.get(key)
+            if cached is None:
+                cached = st.copy()
+                state_advance(cached, spec,
+                              spec.compute_start_slot_at_epoch(target_epoch))
+                if len(self._advanced_states) > 8:
+                    self._advanced_states.clear()
+                self._advanced_states[key] = cached
+            st = cached
+        return st
+
+    # -- block production --------------------------------------------------
+
+    def produce_block_on(self, slot: int, randao_reveal: bytes,
+                         graffiti: bytes = b"", attestations: list = (),
+                         sync_aggregate=None, execution_payload=None):
+        """Produce an unsigned block on the current head
+        (reference produce_block_with_verification, beacon_chain.rs:4224).
+        The caller (validator client) signs it."""
+        from lighthouse_tpu.state_transition import (
+            SignatureStrategy,
+            misc,
+            process_block,
+            state_advance,
+        )
+
+        spec = self.spec
+        fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(slot))
+        head_root = self.get_proposer_head(slot)
+        pre = self.state_for_block(head_root).copy()
+        if int(pre.slot) < slot:
+            state_advance(pre, spec, slot)
+        proposer = misc.get_beacon_proposer_index(pre, spec, slot)
+
+        body_kw = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=pre.eth1_data,
+            graffiti=graffiti.ljust(32, b"\x00")[:32],
+            attestations=list(attestations),
+        )
+        if fork != "phase0":
+            body_kw["sync_aggregate"] = (
+                sync_aggregate if sync_aggregate is not None
+                else self.t.SyncAggregate(
+                    sync_committee_bits=[False] * spec.preset.sync_committee_size,
+                    sync_committee_signature=b"\xc0" + b"\x00" * 95))
+        if fork in ("bellatrix", "capella", "deneb"):
+            if execution_payload is None:
+                raise BlockError("execution_payload_required")
+            body_kw["execution_payload"] = execution_payload
+
+        body = self.t.beacon_block_body_class(fork)(**body_kw)
+        block = self.t.beacon_block_class(fork)(
+            slot=slot, proposer_index=proposer,
+            parent_root=head_root, state_root=b"\x00" * 32, body=body)
+        trial = pre.copy()
+        signed_cls = self.t.signed_beacon_block_class(fork)
+        process_block(trial, spec, signed_cls(
+            message=block, signature=b"\x00" * 96),
+            SignatureStrategy.NO_VERIFICATION)
+        block.state_root = trial.hash_tree_root()
+        return block, proposer
+
+    def get_proposer_head(self, slot: int) -> bytes:
+        """Head to build on, with the late-block re-org rule
+        (reference get_proposer_head, fork_choice.rs:516)."""
+        return self.fork_choice.get_proposer_head(self.head_root, slot)
+
+    # -- queries ----------------------------------------------------------
+
+    def block_root_at_slot(self, slot: int) -> bytes | None:
+        if slot < self.store.split_slot:
+            return self.store.cold_block_root_at_slot(slot)
+        st = self.head_state
+        sphr = self.spec.preset.slots_per_historical_root
+        if slot == int(st.slot):
+            return self.head_root
+        if slot < int(st.slot) <= slot + sphr:
+            return bytes(st.block_roots[slot % sphr].tobytes())
+        return None
+
+    def finalized_checkpoint(self):
+        return self.fork_choice.finalized
+
+    def justified_checkpoint(self):
+        return self.fork_choice.justified

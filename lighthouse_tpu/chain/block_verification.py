@@ -1,0 +1,164 @@
+"""Typestate block verification pipeline.
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/
+block_verification.rs: a block moves through
+
+    SignedBeaconBlock
+      → GossipVerifiedBlock      (structure, slot, proposer sig only)
+      → SignatureVerifiedBlock   (ALL signatures in one batch)
+      → ExecutionPendingBlock    (state transition + state-root check)
+      → imported                 (fork choice + atomic DB write)
+
+(diagram at block_verification.rs:24-44).  Each stage is a class holding
+what later stages need, so a block can never reach import without passing
+every prior stage — the typestate discipline the reference encodes in Rust
+types.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    misc,
+    process_block,
+    signature_sets as sigs,
+    state_advance,
+)
+class BlockError(ValueError):
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
+@dataclass
+class GossipVerifiedBlock:
+    """Structure + slot + proposer-signature-verified
+    (reference GossipVerifiedBlock::new, block_verification.rs:793)."""
+
+    signed_block: object
+    block_root: bytes
+    parent_state: object  # parent post-state advanced to the block's slot
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    """Every signature in the block batch-verified
+    (reference SignatureVerifiedBlock, block_verification.rs:1117)."""
+
+    signed_block: object
+    block_root: bytes
+    parent_state: object
+
+
+@dataclass
+class ExecutionPendingBlock:
+    """State transition applied; post-state root validated
+    (reference ExecutionPendingBlock, block_verification.rs:1286)."""
+
+    signed_block: object
+    block_root: bytes
+    post_state: object
+    state_root: bytes
+    timings: dict = field(default_factory=dict)
+
+
+def verify_block_for_gossip(chain, signed_block,
+                            source: str = "gossip") -> GossipVerifiedBlock:
+    """source="rpc" skips gossip-only equivocation checks so competing
+    fork blocks fetched by sync can still import (reference: rpc blocks
+    enter at SignatureVerifiedBlock, not GossipVerifiedBlock)."""
+    spec = chain.spec
+    block = signed_block.message
+    slot = int(block.slot)
+    current_slot = chain.current_slot()
+    if slot > current_slot:
+        raise BlockError("future_slot")
+    fin_slot = spec.compute_start_slot_at_epoch(chain.fork_choice.finalized.epoch)
+    if slot <= fin_slot:
+        raise BlockError("finalized_slot")
+    block_root = block.hash_tree_root()
+    if chain.store.block_exists(block_root):
+        raise BlockError("duplicate")
+    proposer = int(block.proposer_index)
+    # read-only dup probe here; the slot is only MARKED seen after the
+    # proposer signature verifies, so unauthenticated garbage cannot block
+    # the real proposal (reference observes post-signature too)
+    if (source == "gossip"
+            and chain.observed_block_producers.is_seen(slot, proposer)):
+        raise BlockError("repeat_proposal")
+
+    parent_root = bytes(block.parent_root)
+    if parent_root not in chain.fork_choice.proto:
+        raise BlockError("unknown_parent")
+    parent_state = chain.state_for_block(parent_root)
+    if parent_state is None:
+        raise BlockError("parent_state_unavailable")
+    # cheap advance to the block slot to obtain proposer/committees
+    # (reference cheap_state_advance_to_obtain_committees, :2062)
+    if int(parent_state.slot) < slot:
+        parent_state = parent_state.copy()
+        state_advance(parent_state, spec, slot)
+    expected_proposer = misc.get_beacon_proposer_index(parent_state, spec, slot)
+    if proposer != expected_proposer:
+        raise BlockError("incorrect_proposer")
+    # proposer-signature-only verification (:2140)
+    if chain.verify_signatures:
+        sset = sigs.block_proposal_set(
+            parent_state, spec, signed_block, block_root)
+        if not bls.verify_signature_sets([sset]):
+            raise BlockError("proposer_signature_invalid")
+    if chain.observed_block_producers.observe(slot, proposer) and source == "gossip":
+        raise BlockError("repeat_proposal")
+    return GossipVerifiedBlock(signed_block, block_root, parent_state)
+
+
+def verify_block_signatures(chain, gossip_block: GossipVerifiedBlock) -> SignatureVerifiedBlock:
+    """Accumulate every signature in the block and verify in ONE batch
+    (reference BlockSignatureVerifier::include_all_signatures →
+    verify_signature_sets, block_signature_verifier.rs:141-176,396-419).
+    The batch rides the active BLS backend — this is the TPU offload seam.
+    """
+    if chain.verify_signatures:
+        try:
+            # the proposal signature already passed at the gossip stage —
+            # don't pay that pairing twice (reference:
+            # include_all_signatures_except_proposal)
+            sets = sigs.include_all_signatures(
+                gossip_block.parent_state, chain.spec,
+                gossip_block.signed_block, gossip_block.block_root,
+                include_proposal=False)
+        except ValueError as e:
+            raise BlockError(f"invalid_signature_structure: {e}")
+        if sets and not bls.verify_signature_sets(sets):
+            raise BlockError("batch_signature_invalid")
+    return SignatureVerifiedBlock(
+        gossip_block.signed_block, gossip_block.block_root,
+        gossip_block.parent_state)
+
+
+def execute_block(chain, sig_block: SignatureVerifiedBlock) -> ExecutionPendingBlock:
+    """Run the state transition and validate the claimed state root
+    (reference ExecutionPendingBlock::from_signature_verified_components,
+    block_verification.rs:1286: catch-up slots :1472, per_block_processing
+    :1599, state-root check :1632)."""
+    t0 = time.perf_counter()
+    spec = chain.spec
+    state = sig_block.parent_state.copy()
+    block = sig_block.signed_block.message
+    if int(state.slot) < int(block.slot):
+        state_advance(state, spec, int(block.slot))
+    process_block(state, spec, sig_block.signed_block,
+                  SignatureStrategy.NO_VERIFICATION)
+    t1 = time.perf_counter()
+    state_root = state.hash_tree_root()
+    if state_root != bytes(block.state_root):
+        raise BlockError("state_root_mismatch")
+    t2 = time.perf_counter()
+    return ExecutionPendingBlock(
+        sig_block.signed_block, sig_block.block_root, state, state_root,
+        timings={"core": t1 - t0, "state_root": t2 - t1},
+    )

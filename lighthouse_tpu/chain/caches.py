@@ -1,0 +1,235 @@
+"""Chain-level caches: shuffling, decompressed pubkeys, observed-dup sets.
+
+Reference equivalents in /root/reference/beacon_node/beacon_chain/src/:
+shuffling_cache.rs, validator_pubkey_cache.rs, observed_attesters.rs,
+observed_aggregates.rs, observed_block_producers.rs.
+
+TPU-first data layout: observed-attester sets are epoch-keyed boolean
+numpy columns over validator index (one vectorized gather/scatter per
+batch instead of per-item set probes), matching the columnar vote tracker
+in fork choice.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+
+class ShufflingCache:
+    """Committee shuffles keyed by (epoch, shuffling decision root)
+    (reference shuffling_cache.rs).  The decision root is the block root at
+    the last slot of the epoch two before the shuffling epoch — states on
+    the same chain share shuffles."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._d: OrderedDict[tuple[int, bytes], np.ndarray] = OrderedDict()
+
+    def get(self, epoch: int, decision_root: bytes) -> np.ndarray | None:
+        key = (epoch, decision_root)
+        shuffle = self._d.get(key)
+        if shuffle is not None:
+            self._d.move_to_end(key)
+        return shuffle
+
+    def insert(self, epoch: int, decision_root: bytes, shuffle: np.ndarray):
+        self._d[(epoch, decision_root)] = shuffle
+        self._d.move_to_end((epoch, decision_root))
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def get_or_compute(self, state, spec, epoch: int, decision_root: bytes):
+        from lighthouse_tpu.state_transition import misc
+
+        shuffle = self.get(epoch, decision_root)
+        if shuffle is None:
+            shuffle = misc.compute_committee_shuffle(state, spec, epoch)
+            self.insert(epoch, decision_root, shuffle)
+        return shuffle
+
+
+def shuffling_decision_root(state, spec, epoch: int, head_block_root: bytes) -> bytes:
+    """Block root at the last slot before the shuffling's randao seed was
+    fixed (reference: proto-array shuffling_id).  Falls back to the head
+    block root when the chain is too young."""
+    from lighthouse_tpu.state_transition import misc
+
+    decision_slot = spec.compute_start_slot_at_epoch(max(epoch - 1, 0))
+    if decision_slot == 0 or decision_slot >= int(state.slot):
+        return head_block_root
+    try:
+        return misc.get_block_root_at_slot(state, spec, decision_slot - 1)
+    except ValueError:
+        return head_block_root
+
+
+class ValidatorPubkeyCache:
+    """Decompressed G1 pubkey points by validator index (reference
+    validator_pubkey_cache.rs) — decompression costs a sqrt in Fp, so it is
+    paid once per validator, not once per signature."""
+
+    def __init__(self):
+        from lighthouse_tpu.crypto import bls
+
+        self._bls = bls
+        self._keys: list = []
+
+    def import_new(self, validators) -> None:
+        """Extend with any registry entries beyond the cache length."""
+        pubkeys = validators.pubkeys
+        n = pubkeys.shape[0] if hasattr(pubkeys, "shape") else len(pubkeys)
+        for i in range(len(self._keys), n):
+            pk_bytes = bytes(pubkeys[i].tobytes()
+                             if hasattr(pubkeys[i], "tobytes") else pubkeys[i])
+            self._keys.append(self._bls.PublicKey(pk_bytes))
+
+    def get(self, index: int):
+        if 0 <= index < len(self._keys):
+            return self._keys[index]
+        return None
+
+    def __len__(self):
+        return len(self._keys)
+
+
+class EpochIndexedSeen:
+    """Epoch-keyed seen-bitmaps over validator index (reference
+    observed_attesters.rs ObservedAttesters): `check_and_observe` a whole
+    batch vectorized."""
+
+    def __init__(self, retained_epochs: int = 4):
+        self.retained = retained_epochs
+        self._by_epoch: dict[int, np.ndarray] = {}
+
+    def _bitmap(self, epoch: int, n: int) -> np.ndarray:
+        bm = self._by_epoch.get(epoch)
+        if bm is None:
+            bm = np.zeros(max(n, 1024), bool)
+            self._by_epoch[epoch] = bm
+            self._prune(epoch)
+        elif bm.shape[0] < n:
+            bm = np.concatenate([bm, np.zeros(n - bm.shape[0], bool)])
+            self._by_epoch[epoch] = bm
+        return bm
+
+    def _prune(self, current_epoch: int):
+        for e in [e for e in self._by_epoch if e + self.retained < current_epoch]:
+            del self._by_epoch[e]
+
+    def observe_batch(self, epoch: int, indices: np.ndarray) -> np.ndarray:
+        """Mark indices seen; returns mask of indices that were ALREADY seen."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0:
+            return np.zeros(0, bool)
+        bm = self._bitmap(epoch, int(idx.max()) + 1)
+        already = bm[idx].copy()
+        bm[idx] = True
+        return already
+
+    def seen_mask(self, epoch: int, indices: np.ndarray) -> np.ndarray:
+        """Read-only: which of `indices` are already seen (no mutation) —
+        dup checks run BEFORE signature verification, marking happens only
+        after it succeeds (unauthenticated input must not poison the
+        cache)."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0:
+            return np.zeros(0, bool)
+        bm = self._by_epoch.get(epoch)
+        if bm is None:
+            return np.zeros(idx.shape[0], bool)
+        out = np.zeros(idx.shape[0], bool)
+        inb = idx < bm.shape[0]
+        out[inb] = bm[idx[inb]]
+        return out
+
+    def is_seen(self, epoch: int, index: int) -> bool:
+        bm = self._by_epoch.get(epoch)
+        return bool(bm[index]) if bm is not None and index < bm.shape[0] else False
+
+
+class SlotIndexedSeen:
+    """Slot-keyed variant (observed block producers / sync contributions)."""
+
+    def __init__(self, retained_slots: int = 64):
+        self.retained = retained_slots
+        self._by_slot: dict[int, set[int]] = {}
+
+    def observe(self, slot: int, index: int) -> bool:
+        """Returns True if (slot, index) was already seen."""
+        s = self._by_slot.setdefault(slot, set())
+        for old in [x for x in self._by_slot if x + self.retained < slot]:
+            del self._by_slot[old]
+        if index in s:
+            return True
+        s.add(index)
+        return False
+
+    def is_seen(self, slot: int, index: int) -> bool:
+        """Read-only probe (no marking) for pre-signature dup checks."""
+        return index in self._by_slot.get(slot, ())
+
+
+class ObservedDigests:
+    """Epoch-keyed digests of seen objects (reference
+    observed_aggregates.rs: dedup identical aggregates/sync contributions)."""
+
+    def __init__(self, retained_epochs: int = 4):
+        self.retained = retained_epochs
+        self._by_epoch: dict[int, set[bytes]] = {}
+
+    def observe(self, epoch: int, data: bytes) -> bool:
+        """Returns True if already seen."""
+        d = hashlib.sha256(data).digest()
+        s = self._by_epoch.setdefault(epoch, set())
+        for old in [e for e in self._by_epoch if e + self.retained < epoch]:
+            del self._by_epoch[old]
+        if d in s:
+            return True
+        s.add(d)
+        return False
+
+    def is_seen(self, epoch: int, data: bytes) -> bool:
+        """Read-only probe for pre-signature dup checks."""
+        return hashlib.sha256(data).digest() in self._by_epoch.get(epoch, ())
+
+
+class StateCache:
+    """Small LRU of recent post-states by state root (reference: the
+    snapshot cache / state LRU feeding block verification)."""
+
+    def __init__(self, capacity: int = 8):
+        self.capacity = capacity
+        self._d: OrderedDict[bytes, object] = OrderedDict()
+
+    def get(self, state_root: bytes):
+        st = self._d.get(state_root)
+        if st is not None:
+            self._d.move_to_end(state_root)
+        return st
+
+    def insert(self, state_root: bytes, state):
+        self._d[state_root] = state
+        self._d.move_to_end(state_root)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+
+class BlockTimesCache:
+    """Wall-clock import timeline per block (reference block_times_cache.rs)."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = capacity
+        self._d: OrderedDict[bytes, dict] = OrderedDict()
+
+    def record(self, block_root: bytes, event: str, t: float):
+        entry = self._d.setdefault(block_root, {})
+        entry[event] = t
+        self._d.move_to_end(block_root)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def get(self, block_root: bytes) -> dict:
+        return dict(self._d.get(block_root, {}))

@@ -1,0 +1,63 @@
+"""Slot clocks: wall-clock and manually-driven (tests).
+
+Reference: /root/reference/common/slot_clock (SlotClock trait,
+SystemTimeSlotClock, ManualSlotClock/TestingSlotClock).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def current_slot(self) -> int:
+        t = self.now()
+        if t < self.genesis_time:
+            return 0
+        return int((t - self.genesis_time) // self.seconds_per_slot)
+
+    def slot_start(self, slot: int) -> float:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        return self.now() - self.slot_start(self.current_slot())
+
+    def seconds_until_slot(self, slot: int) -> float:
+        return max(0.0, self.slot_start(slot) - self.now())
+
+    def is_timely_for_boost(self, attestation_deadline_fraction: int = 3) -> bool:
+        """Within SECONDS_PER_SLOT / INTERVALS_PER_SLOT of the slot start
+        (the proposer-boost timeliness window)."""
+        return self.seconds_into_slot() < self.seconds_per_slot / attestation_deadline_fraction
+
+
+class SystemTimeSlotClock(SlotClock):
+    def now(self) -> float:
+        return time.time()
+
+
+class ManualSlotClock(SlotClock):
+    """Tests advance time explicitly (reference TestingSlotClock)."""
+
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        super().__init__(genesis_time, seconds_per_slot)
+        self._now = float(genesis_time)
+
+    def now(self) -> float:
+        return self._now
+
+    def set_slot(self, slot: int):
+        self._now = self.slot_start(slot)
+
+    def advance_slot(self):
+        self.set_slot(self.current_slot() + 1)
+
+    def advance_seconds(self, s: float):
+        self._now += s

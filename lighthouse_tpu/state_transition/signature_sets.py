@@ -174,6 +174,61 @@ def aggregate_and_proof_set(state, spec, signed_aggregate):
     )
 
 
+def include_all_signatures(state, spec, signed_block, block_root=None,
+                           include_proposal: bool = True):
+    """Every SignatureSet in a block, for one batched verify.
+
+    Rebuild of BlockSignatureVerifier::include_all_signatures
+    (/root/reference/consensus/state_processing/src/per_block_processing/
+    block_signature_verifier.rs:141-176): proposal + randao + proposer
+    slashings + attester slashings + attestations + exits + sync aggregate
+    + bls changes.  Deposit signatures are deliberately excluded — invalid
+    deposit signatures are legal (the deposit is skipped, not the block
+    rejected), so they are checked individually during processing.
+
+    `state` must be the parent state advanced to the block's slot (pre-block).
+    """
+    from lighthouse_tpu.state_transition.block_processing import (
+        to_indexed_attestation,
+    )
+
+    block = signed_block.message
+    body = block.body
+    fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(int(block.slot)))
+    t = T.make_types(spec.preset)
+    sets = [randao_set(state, spec, block)]
+    if include_proposal:
+        sets.insert(0, block_proposal_set(state, spec, signed_block, block_root))
+    for slashing in body.proposer_slashings:
+        sets.extend(proposer_slashing_sets(state, spec, slashing))
+    for slashing in body.attester_slashings:
+        sets.append(indexed_attestation_set(state, spec, slashing.attestation_1))
+        sets.append(indexed_attestation_set(state, spec, slashing.attestation_2))
+    shuffles: dict[int, np.ndarray] = {}
+    for att in body.attestations:
+        epoch = spec.compute_epoch_at_slot(int(att.data.slot))
+        if epoch not in shuffles:
+            shuffles[epoch] = misc.compute_committee_shuffle(state, spec, epoch)
+        indexed = to_indexed_attestation(state, spec, att, t, shuffles[epoch])
+        sets.append(indexed_attestation_set(state, spec, indexed))
+    for signed_exit in body.voluntary_exits:
+        sets.append(voluntary_exit_set(state, spec, signed_exit))
+    if fork != "phase0":
+        if any(body.sync_aggregate.sync_committee_bits):
+            sset, _ = sync_aggregate_set(
+                state, spec, body.sync_aggregate, int(block.slot))
+            sets.append(sset)
+        elif bytes(body.sync_aggregate.sync_committee_signature) != (
+                b"\xc0" + b"\x00" * 95):
+            # zero participation must carry the G2 infinity signature
+            # (spec eth_fast_aggregate_verify rule; other clients reject)
+            raise ValueError("empty sync aggregate without infinity signature")
+    if fork in ("capella", "deneb", "electra"):
+        for change in body.bls_to_execution_changes:
+            sets.append(bls_to_execution_change_set(state, spec, change))
+    return sets
+
+
 def sync_committee_message_set(state, spec, message):
     domain = misc.get_domain(
         state, spec, spec.domain_sync_committee,
