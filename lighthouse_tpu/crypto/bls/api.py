@@ -260,6 +260,9 @@ def register_backend(name: str, fn: Callable[[Sequence[SignatureSet]], bool]):
 
 def set_backend(name: str):
     global _active_backend
+    if name == "tpu" and name not in _BACKENDS:
+        # lazy registration: importing the device backend pulls in jax
+        import lighthouse_tpu.ops.bls_backend  # noqa: F401
     if name not in _BACKENDS:
         raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}")
     _active_backend = name

@@ -1,0 +1,245 @@
+"""Batched 381-bit modular arithmetic for BLS12-381 on TPU (jnp, uint32).
+
+The machine has no wide integers (SURVEY.md §7 hard part #1), so Fp
+elements are 27 limbs x 15 bits in uint32 lanes (trailing axis), kept in a
+REDUNDANT representation: limbs may slightly exceed 2^15 (bounded by
+~2^15 + 2^11) and values may exceed P (bounded by ~2^394 << 2^405 = R).
+The redundancy is what makes the arithmetic vectorize:
+
+- products of two sub-2^16 limbs fit uint32 exactly;
+- every product is split into 15-bit hi/lo halves before accumulation, so
+  a full 27x27 schoolbook column sum stays < 2^24 — no carry chains in
+  the hot path;
+- ONE data-parallel carry pass (limb_k = (col_k & mask) + (col_{k-1}>>15))
+  restores the limb bound.  The capacity margin (405 representable bits
+  vs < 2^394 values) makes the top limb tiny, so the pass never spills —
+  no sequential ripple exists anywhere.
+
+Montgomery multiplication uses the separated REDC (m = T·N' mod R;
+out = (T + m·N)/R with R = 2^405).  The carry out of the low half — the
+one place an exact carry chain seems unavoidable — is recovered from the
+divisibility invariant instead: T + mN ≡ 0 (mod R) forces the low-half
+value to be exactly 0 or R, so the carry is (S_26 >> 15) + (1 iff any low
+residue is nonzero), a vectorized reduction.
+
+Subtraction adds a precomputed multiple of P whose limbs all dominate the
+redundancy bound (so no borrows), with a tiny top limb (so values stay
+bounded).  Values re-enter the canonical range only at the host boundary
+(to_mont / from_mont).  Value-bound ledger (worst cases, enforced by the
+asserts in tests/test_bigint.py):
+
+    mul out   < 2^383      add out < in + 2^393      sub out < in + 2^392
+    limbs     < 2^15 + 2^11 everywhere; top limb < 2^7
+
+Reference counterpart: the limb arithmetic inside blst
+(/root/reference/crypto/bls/src/impls/blst.rs's FFI layer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+B = 15                 # bits per limb
+L = 27                 # limbs (405 bits of capacity for 381-bit values)
+MASK = (1 << B) - 1
+R_BITS = B * L         # 405
+R_INT = 1 << R_BITS    # Montgomery R
+
+
+def _int_to_limbs(v: int, n: int = L) -> np.ndarray:
+    out = np.zeros(n, np.uint32)
+    for i in range(n):
+        out[i] = (v >> (B * i)) & MASK
+    assert v >> (B * n) == 0, "value does not fit"
+    return out
+
+
+def _limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(arr[..., i]) << (B * i) for i in range(arr.shape[-1]))
+
+
+# --- module constants (host-computed once) ---------------------------------
+
+P_LIMBS = _int_to_limbs(P_INT)
+# -P^{-1} mod R, for the separated Montgomery reduction
+NPRIME_INT = (-pow(P_INT, -1, R_INT)) % R_INT
+NPRIME_LIMBS = _int_to_limbs(NPRIME_INT)
+
+# Montgomery form of 1
+ONE_M = _int_to_limbs((1 * R_INT) % P_INT)
+ZERO_L = np.zeros(L, np.uint32)
+
+
+# 2^394 mod P: folds excess top-limb bits (>= bit 4 of limb 26) back into
+# range, pinning every value below ~2^395 with a single vectorized pass.
+FOLDQ_INT = (1 << (B * (L - 1) + 4)) % P_INT
+FOLDQ_LIMBS = _int_to_limbs(FOLDQ_INT)
+
+
+def _neg_const() -> np.ndarray:
+    """A multiple of P decomposed so limbs 0..25 sit in
+    [2^15+2^10, 2^16+2^10) — dominating any redundant operand limb, and a
+    full 2^15 wide so the representable set is contiguous — while the top
+    limb sits in [2^6, 2^7): above any folded value's top limb (< 2^5)
+    but small enough that values stay < 2^397 pre-fold."""
+    lo_limb = (1 << B) + (1 << 10)
+    hi_limb = lo_limb + (1 << B)  # width exactly 2^15 → contiguous
+    top_lo, top_hi = 1 << 6, 1 << 7
+    lo = top_lo << (B * (L - 1))
+    hi = (top_hi - 1) << (B * (L - 1))
+    for i in range(L - 1):
+        lo += lo_limb << (B * i)
+        hi += (hi_limb - 1) << (B * i)
+    k = lo // P_INT + 1
+    v = k * P_INT
+    assert lo <= v <= hi, "no representable multiple of P in range"
+    out = np.zeros(L, np.uint32)
+    rem = v
+    for i in range(L - 1, -1, -1):
+        unit = 1 << (B * i)
+        lo_i, hi_i = (top_lo, top_hi - 1) if i == L - 1 else (lo_limb, hi_limb - 1)
+        low_rest = sum(lo_limb << (B * j) for j in range(i))
+        hi_rest = sum((hi_limb - 1) << (B * j) for j in range(i))
+        # keep the remainder representable by the lower limbs' ranges
+        d_max = min(hi_i, (rem - low_rest) // unit)
+        d_min = max(lo_i, -((hi_rest - rem) // unit) if rem > hi_rest else lo_i)
+        d = max(d_min, min(d_max, (rem - low_rest) // unit))
+        assert lo_i <= d <= hi_i and low_rest <= rem - d * unit <= hi_rest or i == 0, (
+            i, hex(d))
+        out[i] = d
+        rem -= d * unit
+    assert rem == 0 and _limbs_to_int(out) == v
+    return out
+
+
+NEG_CONST = _neg_const()
+
+
+# --- device primitives ------------------------------------------------------
+
+def _carry(cols: jax.Array) -> jax.Array:
+    """One vectorized carry pass; by the value-bound ledger the top limb's
+    own carry is provably zero, so nothing spills."""
+    hi = cols >> B
+    lo = cols & MASK
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+    out = lo + shifted
+    # keep the top limb's high bits (tiny by the value bound) instead of
+    # dropping them: top limb = col & mask + carry_in + (col >> B << B)
+    return out.at[..., -1].add((cols[..., -1] >> B) << B)
+
+
+def _fold_top(x: jax.Array) -> jax.Array:
+    """Fold top-limb bits >= 4 down via 2^394 ≡ FOLDQ (mod P): one pass,
+    no iteration — output value < 2^395, top limb < 2^5."""
+    foldq = jnp.asarray(FOLDQ_LIMBS, jnp.uint32)
+    e = x[..., -1] >> 4
+    x = x.at[..., -1].set(x[..., -1] & 0xF)
+    return _carry(x + e[..., None] * foldq)
+
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return _fold_top(_carry(a + b))
+
+
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a - b + kP (NEG_CONST limbs dominate any redundant b limb)."""
+    neg = jnp.asarray(NEG_CONST, jnp.uint32)
+    return _fold_top(_carry(a + (neg - b)))
+
+
+def neg(a: jax.Array) -> jax.Array:
+    neg_c = jnp.asarray(NEG_CONST, jnp.uint32)
+    return _fold_top(_carry(neg_c - a))
+
+
+def scale_small(a: jax.Array, k: int) -> jax.Array:
+    """a·k for small positive k (k <= 16 keeps values in fold range)."""
+    assert 0 < k <= 16
+    return _fold_top(_carry(a * np.uint32(k)))
+
+
+def _mul_cols(a: jax.Array, b: jax.Array, out_cols: int) -> jax.Array:
+    """Schoolbook column accumulation with 15-bit hi/lo split.
+
+    a, b: uint32[..., L] with limbs < 2^16 → columns < 2^25.
+    out_cols = 2L for the full product, L for the mod-R low product.
+
+    Implemented as shifted pad-and-add (concats, no scatters — scatter-add
+    chains sent XLA's algebraic simplifier into a rewrite loop and blew up
+    compile time)."""
+    terms = []
+    for i in range(min(L, out_cols)):
+        p = a[..., i:i + 1] * b  # [..., L]
+        lo = p & MASK
+        hi = p >> B
+        w = min(L, out_cols - i)
+        terms.append(_shift_pad(lo[..., :w], i, out_cols))
+        w2 = min(L, out_cols - i - 1)
+        if w2 > 0:
+            terms.append(_shift_pad(hi[..., :w2], i + 1, out_cols))
+    return sum(terms[1:], terms[0])
+
+
+def _shift_pad(x: jax.Array, off: int, width: int) -> jax.Array:
+    pads = [(0, 0, 0)] * (x.ndim - 1) + [(off, width - off - x.shape[-1], 0)]
+    return jax.lax.pad(x, jnp.uint32(0), pads)
+
+
+def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Montgomery product a·b·R⁻¹ (mod P, redundant representation)."""
+    nprime = jnp.asarray(NPRIME_LIMBS, jnp.uint32)
+    n = jnp.asarray(P_LIMBS, jnp.uint32)
+
+    t_cols = _mul_cols(a, b, 2 * L)            # 54 columns < 2^24
+    t = _carry(t_cols)                         # 54 limbs < 2^16
+    m_cols = _mul_cols(t[..., :L], nprime, L)  # low product only (mod R)
+    m = _carry(m_cols)                         # limbs < 2^16 (redundant)
+    # mod R: mask ONLY the top limb (drops multiples of R = 2^405, legal;
+    # masking other limbs would change m mod R and break divisibility)
+    m = m.at[..., -1].set(m[..., -1] & MASK)
+    mn_cols = _mul_cols(m, n, 2 * L)           # 54 columns
+    s = mn_cols + t                            # < 2^25 ✓ uint32
+    # low half of s has value ≡ 0 (mod R): carry into the high half is
+    # (s_26 >> B) + (1 iff any low residue bits remain)
+    low_resid = jnp.concatenate(
+        [s[..., :L - 1], (s[..., L - 1:L] & MASK)], axis=-1)
+    delta = jnp.any(low_resid != 0, axis=-1).astype(jnp.uint32)
+    c = (s[..., L - 1] >> B) + delta
+    out_cols = s[..., L:]                      # 27 columns
+    out_cols = out_cols.at[..., 0].add(c)
+    return _carry(out_cols)
+
+
+def mont_sqr(a: jax.Array) -> jax.Array:
+    return mont_mul(a, a)
+
+
+# --- host boundary ----------------------------------------------------------
+
+def to_mont(v: int | np.ndarray) -> np.ndarray:
+    """int (or array of ints) -> Montgomery limb vector(s)."""
+    if isinstance(v, (int, np.integer)):
+        return _int_to_limbs((int(v) * R_INT) % P_INT)
+    flat = [(int(x) * R_INT) % P_INT for x in np.ravel(np.asarray(v, object))]
+    out = np.stack([_int_to_limbs(x) for x in flat])
+    return out.reshape(np.shape(v) + (L,))
+
+
+def from_mont(limbs) -> int | np.ndarray:
+    """Montgomery limb vector(s) -> canonical int(s)."""
+    arr = np.asarray(limbs)
+    rinv = pow(R_INT, -1, P_INT)
+    if arr.ndim == 1:
+        return (_limbs_to_int(arr) * rinv) % P_INT
+    flat = arr.reshape(-1, arr.shape[-1])
+    vals = np.array(
+        [(_limbs_to_int(x) * rinv) % P_INT for x in flat], dtype=object)
+    return vals.reshape(arr.shape[:-1])

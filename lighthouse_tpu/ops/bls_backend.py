@@ -1,0 +1,82 @@
+"""The "tpu" BLS backend: batched device multi-pairing behind the
+`verify_signature_sets` seam.
+
+Mirrors the reference blst backend's batch semantics
+(/root/reference/crypto/bls/src/impls/blst.rs:37-119): per-set nonzero
+64-bit random scalars r_i, then ONE combined check
+
+    e(-g1, Σ r_i·sig_i) · Π e(r_i·agg_pk_i, H(m_i)) == 1
+
+Division of labour (v1):
+- host (pure python): decompression + subgroup checks (cached on the key
+  objects), per-set pubkey aggregation, random scalars, the two scalar
+  multiplications per set, hash-to-curve — SURVEY.md §7 hard-part #2
+  recommends exactly this host/device split as the first cut;
+- device (jnp, ops/bls12_381.py): all Miller loops batched over lanes +
+  the product tree — the pairing work that dominates at batch scale;
+- host: the single final exponentiation per batch, then is_one().
+
+Registered as backend "tpu" on import (see crypto/bls/api.py set_backend's
+lazy hook).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Sequence
+
+from lighthouse_tpu.crypto.bls import api, curve as cv
+from lighthouse_tpu.ops.bls12_381 import multi_pairing_device
+
+RAND_BITS = 64
+
+# distinct messages hash to the same G2 point; memoize across batches
+_H2C_CACHE: dict[bytes, object] = {}
+
+
+def _hash_to_g2_cached(message: bytes):
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+
+    pt = _H2C_CACHE.get(message)
+    if pt is None:
+        if len(_H2C_CACHE) > 1 << 16:
+            _H2C_CACHE.clear()
+        pt = hash_to_g2(message)
+        _H2C_CACHE[message] = pt
+    return pt
+
+
+def prepare_pairs(sets: Sequence[api.SignatureSet]):
+    """Host prep: [(r·agg_pk, H(m))] per set + the (-g1, Σ r·sig) lane.
+    Returns None if any set is structurally invalid."""
+    pairs = []
+    sig_acc = cv.INF
+    for s in sets:
+        if not s.pubkeys:
+            return None
+        try:
+            sig_pt = s.signature.point
+            agg_pk = s.aggregate_pubkey()
+        except (api.BlsError, ValueError):
+            return None
+        if sig_pt is cv.INF:
+            return None
+        rand = 0
+        while rand == 0:
+            rand = secrets.randbits(RAND_BITS)
+        sig_acc = cv.g2_add(sig_acc, cv.g2_mul(sig_pt, rand))
+        pairs.append((cv.g1_mul(agg_pk, rand), _hash_to_g2_cached(s.message)))
+    pairs.append((cv.g1_neg(cv.g1_generator()), sig_acc))
+    return pairs
+
+
+def verify_signature_sets_device(sets: Sequence[api.SignatureSet]) -> bool:
+    if not sets:
+        return False
+    pairs = prepare_pairs(sets)
+    if pairs is None:
+        return False
+    return multi_pairing_device(pairs).is_one()
+
+
+api.register_backend("tpu", verify_signature_sets_device)

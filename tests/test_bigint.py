@@ -1,0 +1,123 @@
+"""Limb-arithmetic tests: device Fp ops vs Python bigints.
+
+The differential oracle strategy from SURVEY.md §7 gate (b): every device
+op is checked against plain modular integers, including bound-stressing
+chains and edge values.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import bigint as bi
+
+P = bi.P_INT
+
+
+def _batch(vals):
+    return jnp.asarray(np.stack([bi.to_mont(v) for v in vals]))
+
+
+@pytest.fixture(scope="module")
+def rand_vals():
+    random.seed(7)
+    xs = [random.randrange(P) for _ in range(32)]
+    ys = [random.randrange(P) for _ in range(32)]
+    return xs, ys
+
+
+def test_constants():
+    assert bi._limbs_to_int(bi.P_LIMBS) == P
+    assert (bi._limbs_to_int(bi.NEG_CONST)) % P == 0
+    assert (bi.NPRIME_INT * P) % bi.R_INT == bi.R_INT - 1
+    assert bi._limbs_to_int(bi.FOLDQ_LIMBS) == (1 << 394) % P
+
+
+def test_roundtrip(rand_vals):
+    xs, _ = rand_vals
+    for x in xs[:8]:
+        assert bi.from_mont(bi.to_mont(x)) == x
+
+
+def test_mont_mul(rand_vals):
+    xs, ys = rand_vals
+    out = np.asarray(jax.jit(bi.mont_mul)(_batch(xs), _batch(ys)))
+    got = bi.from_mont(out)
+    assert all(int(g) == (x * y) % P for g, x, y in zip(got, xs, ys))
+    # limb bound invariant
+    assert out.max() < (1 << 15) + (1 << 12)
+
+
+def test_add_sub_neg(rand_vals):
+    xs, ys = rand_vals
+    ax, ay = _batch(xs), _batch(ys)
+    assert all(int(g) == (x + y) % P for g, x, y in
+               zip(bi.from_mont(np.asarray(bi.add(ax, ay))), xs, ys))
+    assert all(int(g) == (x - y) % P for g, x, y in
+               zip(bi.from_mont(np.asarray(bi.sub(ax, ay))), xs, ys))
+    assert all(int(g) == (-x) % P for g, x in
+               zip(bi.from_mont(np.asarray(bi.neg(ax))), xs))
+
+
+def test_scale_small(rand_vals):
+    xs, _ = rand_vals
+    ax = _batch(xs)
+    for k in (2, 3, 8, 16):
+        got = bi.from_mont(np.asarray(bi.scale_small(ax, k)))
+        assert all(int(g) == (k * x) % P for g, x in zip(got, xs))
+
+
+def test_edge_values():
+    edge = [0, 1, 2, P - 1, P - 2, (P + 1) // 2, (1 << 380) % P]
+    ae = _batch(edge)
+    got = bi.from_mont(np.asarray(bi.mont_mul(ae, ae)))
+    assert all(int(g) == (x * x) % P for g, x in zip(got, edge))
+    z = bi.from_mont(np.asarray(bi.sub(ae, ae)))
+    assert all(int(g) == 0 for g in z)
+
+
+def test_deep_chain_keeps_bounds(rand_vals):
+    """60 rounds of mul/sub/add/neg: redundant-representation invariants
+    hold and values stay exact."""
+    xs, ys = rand_vals
+    ax, ay = _batch(xs), _batch(ys)
+    mm = jax.jit(bi.mont_mul)
+    z, zv = ax, list(xs)
+    maxlimb = 0
+    for _ in range(60):
+        z = mm(z, ay)
+        zv = [(a * b) % P for a, b in zip(zv, ys)]
+        z = bi.sub(z, ax)
+        zv = [(a - b) % P for a, b in zip(zv, xs)]
+        z = bi.add(z, z)
+        zv = [(2 * a) % P for a in zv]
+        z = bi.neg(z)
+        zv = [(-a) % P for a in zv]
+        maxlimb = max(maxlimb, int(np.asarray(z).max()))
+    got = bi.from_mont(np.asarray(z))
+    assert all(int(g) == w for g, w in zip(got, zv))
+    assert maxlimb < (1 << 15) + (1 << 12), maxlimb
+
+
+def test_fp2_tower_ops(rand_vals):
+    """Spot-check the Fq2 layer against the python field."""
+    from lighthouse_tpu.crypto.bls.fields import Fq2
+    from lighthouse_tpu.ops import bls12_381 as dev
+
+    xs, ys = rand_vals
+    x = (_batch(xs[:4]), _batch(ys[:4]))
+    y = (_batch(ys[4:8]), _batch(xs[4:8]))
+    got = dev.fp2_mul(x, y)
+    for i in range(4):
+        want = Fq2(xs[i], ys[i]) * Fq2(ys[4 + i], xs[4 + i])
+        assert int(bi.from_mont(np.asarray(got[0])[i])) == want.a
+        assert int(bi.from_mont(np.asarray(got[1])[i])) == want.b
+    got = dev.fp2_sqr(x)
+    for i in range(4):
+        want = Fq2(xs[i], ys[i]).square()
+        assert int(bi.from_mont(np.asarray(got[0])[i])) == want.a
+        assert int(bi.from_mont(np.asarray(got[1])[i])) == want.b
