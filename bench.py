@@ -1,31 +1,39 @@
 #!/usr/bin/env python
-"""Headline benchmark for lighthouse_tpu — one JSON line on stdout.
+"""Headline benchmark for lighthouse_tpu — one JSON line on stdout, always.
 
 Measures the device data plane against the host baseline on the BASELINE.md
-configs that are implemented so far.  Headline metric evolves with the build:
+configs implemented so far (config #4: SSZ/SHA-256 merkleization, the
+1M-validator tree_hash_root analogue; reference hot path
+/root/reference/consensus/types/src/beacon_state.rs:2031).
 
-  round-1 current: SSZ/SHA-256 merkleization throughput (BASELINE config #4,
-  the 1M-validator tree_hash_root analogue) — device batched-pair hashes/sec,
-  vs_baseline = speedup over single-thread host hashlib (the reference's
-  ethereum_hashing CPU path analogue measured in-process).
+Robustness contract (VERDICT.md round-1 weak #1): the measurement runs in a
+CHILD process under a hard timeout; if the TPU backend fails to initialize
+or hangs, the parent retries on the host-CPU platform, and if everything
+fails it still prints exactly one JSON line with an "error" field instead
+of a traceback.
 
-Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+_REPO = os.path.dirname(os.path.abspath(__file__))
+CHILD_TIMEOUT_S = int(os.environ.get("LHTPU_BENCH_TIMEOUT", "420"))
 
 
 def _bench_merkleize() -> dict:
     import jax
+    import numpy as np
 
     from lighthouse_tpu.ops import sha256 as sha_ops
+
+    platform = jax.devices()[0].platform
 
     # 2^20 leaf chunks ≈ the per-field leaf count of a 1M-validator registry
     # column (BASELINE config #4).  Total pair-hashes for the fold = 2^20 - 1.
@@ -36,22 +44,17 @@ def _bench_merkleize() -> dict:
         np.uint32
     )
 
-    # --- device path (warm up compile first) -------------------------------
-    def device_merkle_root(lvl):
-        # fold entirely on device: one hash_pairs_device sweep per level
-        import jax.numpy as jnp
+    # --- device path: single jitted whole-fold program ---------------------
+    import jax.numpy as jnp
 
-        x = jnp.asarray(lvl)
-        while x.shape[0] > 1:
-            x = sha_ops.hash_pairs_device(x.reshape(x.shape[0] // 2, 16))
-        return x
+    device_merkle_root = jax.jit(sha_ops.fold_to_root_device)
 
-    device_merkle_root(leaves[:2048]).block_until_ready()  # compile small
-    device_merkle_root(leaves).block_until_ready()  # compile all levels
+    dev_leaves = jax.device_put(jnp.asarray(leaves))  # keep off the clock:
+    device_merkle_root(dev_leaves).block_until_ready()  # compile warm-up
     n_iters = 3
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        root = device_merkle_root(leaves).block_until_ready()
+        root = device_merkle_root(dev_leaves).block_until_ready()
     dt_device = (time.perf_counter() - t0) / n_iters
     n_hashes = n_leaves - 1
     device_rate = n_hashes / dt_device
@@ -64,7 +67,7 @@ def _bench_merkleize() -> dict:
     host_rate = sample.shape[0] / dt_host_sample
 
     # correctness cross-check on the sample
-    dev_sample = np.asarray(sha_ops.hash_pairs_device(sample))
+    dev_sample = np.asarray(sha_ops.hash_pairs_device(jnp.asarray(sample)))
     assert np.array_equal(out, dev_sample), "device/host SHA-256 mismatch"
     del root
 
@@ -73,12 +76,60 @@ def _bench_merkleize() -> dict:
         "value": round(device_rate / 1e6, 4),
         "unit": "Mhash/s",
         "vs_baseline": round(device_rate / host_rate, 3),
+        "platform": platform,
     }
 
 
-def main() -> None:
+def _child_main() -> int:
     result = _bench_merkleize()
+    print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
+    return 0
+
+
+def _run_child(extra_env: dict | None) -> dict | None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=CHILD_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("LHTPU_BENCH_JSON "):
+            try:
+                return json.loads(line[len("LHTPU_BENCH_JSON "):])
+            except json.JSONDecodeError:
+                return None
+    sys.stderr.write((proc.stderr or "")[-2000:])
+    return None
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child_main()
+
+    # attempt 1: default platform (TPU when the tunnel works)
+    result = _run_child(None)
+    if result is None:
+        # attempt 2: force host CPU so a number always exists
+        result = _run_child({"JAX_PLATFORMS": "cpu"})
+        if result is not None:
+            result["note"] = "tpu backend unavailable; measured on host cpu"
+    if result is None:
+        result = {
+            "metric": "sha256_merkleize_1M_leaf_fold",
+            "value": 0.0,
+            "unit": "Mhash/s",
+            "vs_baseline": 0.0,
+            "error": f"benchmark child failed/timed out ({CHILD_TIMEOUT_S}s) "
+                     "on both tpu and cpu platforms",
+        }
     print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":

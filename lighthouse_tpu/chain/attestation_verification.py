@@ -26,6 +26,18 @@ from lighthouse_tpu.state_transition import signature_sets as sigs
 from lighthouse_tpu.state_transition.block_processing import (
     get_attesting_indices,
 )
+from lighthouse_tpu.state_transition.misc import get_beacon_committee
+
+
+def is_aggregator(spec, committee_len: int, selection_proof: bytes) -> bool:
+    """Spec is_aggregator: the selection proof elects ~TARGET_AGGREGATORS
+    members per committee (reference attestation_verification.rs
+    InvalidSelectionProof rejection)."""
+    import hashlib
+
+    modulo = max(1, committee_len // spec.target_aggregators_per_committee)
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
 class AttestationError(ValueError):
@@ -85,8 +97,20 @@ def _gossip_checks(chain, attestation, state) -> np.ndarray:
     target_epoch = int(data.target.epoch)
     if target_epoch != spec.compute_epoch_at_slot(att_slot):
         raise AttestationError("target_epoch_mismatch")
-    if bytes(data.beacon_block_root) not in chain.fork_choice.proto:
+    head_root = bytes(data.beacon_block_root)
+    if head_root not in chain.fork_choice.proto:
         raise AttestationError("unknown_head_block")
+    # target consistency (reference verify_attestation_target_root): the
+    # target must be a known block AND the epoch-boundary ancestor of the
+    # LMD vote, else validly-signed attestations with inconsistent targets
+    # would be counted in fork choice
+    target_root = bytes(data.target.root)
+    if target_root not in chain.fork_choice.proto:
+        raise AttestationError("unknown_target_root")
+    expected_target = chain.fork_choice.proto.get_ancestor(
+        head_root, spec.compute_start_slot_at_epoch(target_epoch))
+    if expected_target != target_root:
+        raise AttestationError("invalid_target_root")
     shuffle = chain.committee_shuffle(state, target_epoch)
     indices = get_attesting_indices(state, spec, attestation, shuffle)
     if indices.size == 0:
@@ -129,6 +153,12 @@ def verify_aggregated_for_gossip(chain, signed_aggregate, state) -> VerifiedAtte
     if aggregator not in set(int(i) for i in indices):
         raise AttestationError("aggregator_not_in_committee")
     slot = int(aggregate.data.slot)
+    committee = get_beacon_committee(
+        state, chain.spec, slot, int(aggregate.data.index),
+        chain.committee_shuffle(state, epoch))
+    if not is_aggregator(
+            chain.spec, committee.shape[0], bytes(msg.selection_proof)):
+        raise AttestationError("invalid_selection_proof_not_aggregator")
     sets = [
         sigs.selection_proof_set(
             state, chain.spec, slot, aggregator, bytes(msg.selection_proof)),

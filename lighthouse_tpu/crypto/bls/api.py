@@ -258,13 +258,25 @@ def register_backend(name: str, fn: Callable[[Sequence[SignatureSet]], bool]):
     _BACKENDS[name] = fn
 
 
-def set_backend(name: str):
-    global _active_backend
+def _resolve_backend(name: str) -> Callable[[Sequence[SignatureSet]], bool]:
     if name == "tpu" and name not in _BACKENDS:
         # lazy registration: importing the device backend pulls in jax
-        import lighthouse_tpu.ops.bls_backend  # noqa: F401
-    if name not in _BACKENDS:
-        raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}")
+        # (explicit re-register in case the module was already imported)
+        import importlib
+
+        mod = importlib.import_module("lighthouse_tpu.ops.bls_backend")
+        _BACKENDS.setdefault("tpu", mod.verify_signature_sets_device)
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}"
+        ) from None
+
+
+def set_backend(name: str):
+    global _active_backend
+    _resolve_backend(name)
     _active_backend = name
 
 
@@ -281,5 +293,5 @@ def verify_signature_sets(
     and call this once — mirroring the reference call site
     state_processing/src/per_block_processing/block_signature_verifier.rs:396.
     """
-    fn = _BACKENDS[backend or _active_backend]
+    fn = _resolve_backend(backend or _active_backend)
     return fn(sets)

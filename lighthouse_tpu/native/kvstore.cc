@@ -48,9 +48,37 @@ struct KV {
   int read_fd = -1;  // persistent pread handle for value lookups
   uint64_t log_size = 0;
   uint64_t live_bytes = 0;  // payload bytes referenced by the index
+  bool failed = false;  // set when a rollback failed; writes are refused
+  bool sync = false;    // fdatasync after each COMMIT (durability flag)
   std::map<std::string, Entry> index;
   std::mutex mu;
 };
+
+// Undo partially-written records after a write_record failure: the file is
+// append-only ('ab'), so orphaned bytes would silently shift every later
+// value offset.  Truncate back to the last committed size and reposition
+// the stream; if that itself fails the store is marked failed and refuses
+// further writes.
+bool rollback_log(KV* kv, uint64_t restore_size) {
+  kv->log_size = restore_size;
+  clearerr(kv->log);
+  fflush(kv->log);
+  if (ftruncate(fileno(kv->log), (off_t)restore_size) != 0 ||
+      fseek(kv->log, (long)restore_size, SEEK_SET) != 0) {
+    kv->failed = true;
+    return false;
+  }
+  return true;
+}
+
+// Seal a batch: flush the stdio buffer and, when the durability flag is
+// set, fdatasync so a COMMIT-terminated batch survives power loss (the
+// reference's LevelDB sync-write semantics for critical batches).
+bool commit_flush(KV* kv) {
+  if (fflush(kv->log) != 0) return false;
+  if (kv->sync && fdatasync(fileno(kv->log)) != 0) return false;
+  return true;
+}
 
 bool write_record(KV* kv, uint8_t type, const uint8_t* k, uint32_t klen,
                   const uint8_t* v, uint32_t vlen, uint64_t* value_off) {
@@ -163,11 +191,15 @@ int kv_put(void* h, const uint8_t* k, size_t klen, const uint8_t* v,
            size_t vlen) {
   KV* kv = (KV*)h;
   std::lock_guard<std::mutex> lock(kv->mu);
+  if (kv->failed) return -3;
+  uint64_t restore_size = kv->log_size;
   uint64_t voff = 0;
-  if (!write_record(kv, REC_PUT, k, (uint32_t)klen, v, (uint32_t)vlen, &voff))
+  if (!write_record(kv, REC_PUT, k, (uint32_t)klen, v, (uint32_t)vlen, &voff) ||
+      !write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr) ||
+      !commit_flush(kv)) {
+    rollback_log(kv, restore_size);
     return -1;
-  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr)) return -1;
-  fflush(kv->log);
+  }
   std::string key((const char*)k, klen);
   auto old = kv->index.find(key);
   if (old != kv->index.end()) kv->live_bytes -= old->second.vlen + key.size();
@@ -182,10 +214,14 @@ int kv_del(void* h, const uint8_t* k, size_t klen) {
   std::string key((const char*)k, klen);
   auto it = kv->index.find(key);
   if (it == kv->index.end()) return 1;  // not found (not an error)
-  if (!write_record(kv, REC_DEL, k, (uint32_t)klen, nullptr, 0, nullptr))
+  if (kv->failed) return -3;
+  uint64_t restore_size = kv->log_size;
+  if (!write_record(kv, REC_DEL, k, (uint32_t)klen, nullptr, 0, nullptr) ||
+      !write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr) ||
+      !commit_flush(kv)) {
+    rollback_log(kv, restore_size);
     return -1;
-  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr)) return -1;
-  fflush(kv->log);
+  }
   kv->live_bytes -= it->second.vlen + key.size();
   kv->index.erase(it);
   return 0;
@@ -199,6 +235,7 @@ int kv_del(void* h, const uint8_t* k, size_t klen) {
 int kv_batch(void* h, const uint8_t* buf, size_t len) {
   KV* kv = (KV*)h;
   std::lock_guard<std::mutex> lock(kv->mu);
+  if (kv->failed) return -3;
   struct Op {
     std::string key;
     uint64_t voff;
@@ -227,15 +264,17 @@ int kv_batch(void* h, const uint8_t* buf, size_t len) {
     uint8_t rec = (op == REC_DEL) ? REC_DEL : REC_PUT;
     if (!write_record(kv, rec, k, klen, v, (rec == REC_DEL) ? 0 : vlen,
                       &voff)) {
-      kv->log_size = restore_size;
+      rollback_log(kv, restore_size);
       return -1;
     }
     ops.push_back(Op{std::string((const char*)k, klen), voff, vlen,
                      rec == REC_DEL});
   }
-  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr))
+  if (!write_record(kv, REC_COMMIT, nullptr, 0, nullptr, 0, nullptr) ||
+      !commit_flush(kv)) {
+    rollback_log(kv, restore_size);
     return -1;
-  fflush(kv->log);
+  }
   for (auto& op : ops) {
     auto old = kv->index.find(op.key);
     if (old != kv->index.end())
@@ -275,6 +314,14 @@ int kv_exists(void* h, const uint8_t* k, size_t klen) {
 }
 
 void kv_free(uint8_t* p) { free(p); }
+
+// Durability flag: when on, every COMMIT is fdatasync'd so committed
+// batches survive power loss, not just process crashes.
+void kv_set_sync(void* h, int on) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lock(kv->mu);
+  kv->sync = on != 0;
+}
 
 uint64_t kv_count(void* h) {
   KV* kv = (KV*)h;

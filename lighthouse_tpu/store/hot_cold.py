@@ -303,17 +303,52 @@ class HotColdDB:
             return
         sphr = self.spec.preset.slots_per_historical_root
 
-        cold_ops: list[KeyValueOp] = []
-        canonical_state_roots: dict[int, bytes] = {}
+        # Canonical block roots for EVERY slot in [split, fin_slot), even
+        # when finalization advanced past the state_roots window (long
+        # non-finality): walk parent pointers from the finalized block.
+        # block_roots semantics: root at slot s = latest block at or below s.
         canonical_block_roots: dict[int, bytes] = {}
+        block_at_slot: dict[int, bytes] = {}  # slots that have a real block
+        walk_state_roots: dict[int, bytes] = {}  # block slot -> post-state
+        root = finalized_block_root
+        upper = fin_slot
+        while upper > self.split_slot:
+            blk = self.get_block(root)
+            if blk is None:
+                break
+            bslot = int(blk.message.slot)
+            block_at_slot[bslot] = root
+            walk_state_roots[bslot] = bytes(blk.message.state_root)
+            for s in range(max(bslot, self.split_slot), upper):
+                canonical_block_roots[s] = root
+            upper = min(upper, bslot)
+            if bslot <= self.split_slot:
+                break
+            root = bytes(blk.message.parent_root)
+
+        canonical_state_roots: dict[int, bytes] = {}
         for slot in range(self.split_slot, fin_slot):
-            if not slot < fin_slot <= slot + sphr:
+            if slot < fin_slot <= slot + sphr:
+                # inside the window: exact roots from the finalized state
+                canonical_block_roots[slot] = bytes(
+                    fin_state.block_roots[slot % sphr].tobytes())
+                canonical_state_roots[slot] = bytes(
+                    fin_state.state_roots[slot % sphr].tobytes())
+            elif slot in walk_state_roots:
+                # older block slot: a block's state_root is its post-state
+                canonical_state_roots[slot] = walk_state_roots[slot]
+            # older skipped slots: state root unknown without replay; the
+            # block-root entry below still records the canonical chain
+
+        cold_ops: list[KeyValueOp] = []
+        for slot in range(self.split_slot, fin_slot):
+            br = canonical_block_roots.get(slot)
+            if br is not None:
+                cold_ops.append(
+                    KeyValueOp(_slot_key(P_COLD_BLOCK_ROOT, slot), br))
+            sr = canonical_state_roots.get(slot)
+            if sr is None:
                 continue
-            br = bytes(fin_state.block_roots[slot % sphr].tobytes())
-            sr = bytes(fin_state.state_roots[slot % sphr].tobytes())
-            canonical_block_roots[slot] = br
-            canonical_state_roots[slot] = sr
-            cold_ops.append(KeyValueOp(_slot_key(P_COLD_BLOCK_ROOT, slot), br))
             cold_ops.append(KeyValueOp(_slot_key(P_COLD_STATE_ROOT, slot), sr))
             if slot % self.slots_per_restore_point == 0:
                 st = self.get_hot_state(sr)
@@ -324,9 +359,12 @@ class HotColdDB:
             self.cold.do_atomically(cold_ops)
 
         # prune hot: drop summaries/states below the new split, and blocks
-        # not on the canonical chain (orphans die at finalization)
+        # not on the canonical chain (orphans die at finalization).  A
+        # canonical block may only be dropped once its root is recorded in
+        # the freezer — never lose canonical chain data.
         hot_ops: list[KeyValueOp] = []
         canonical_set = set(canonical_block_roots.values())
+        canonical_set.update(block_at_slot.values())
         canonical_set.add(finalized_block_root)
         for key, raw in list(self.hot.iter_prefix(P_SUMMARY)):
             summary = HotStateSummary.from_bytes(raw)
@@ -339,7 +377,10 @@ class HotColdDB:
         for key, raw in list(self.hot.iter_prefix(P_BLOCK)):
             slot = int.from_bytes(raw[:8], "little")
             root = key[len(P_BLOCK):]
-            if slot < fin_slot and root not in canonical_set:
+            # only prune when the canonical root for that slot is known
+            # (recorded in the freezer above) and this block isn't it
+            if (slot < fin_slot and root not in canonical_set
+                    and slot in canonical_block_roots):
                 hot_ops.append(KeyValueOp(key, None))
 
         self.split_slot = fin_slot

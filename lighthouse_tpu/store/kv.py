@@ -133,6 +133,7 @@ def _load_native():
     lib.kv_iter_close.argtypes = [ctypes.c_void_p]
     lib.kv_compact.restype = ctypes.c_int
     lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
     _lib = lib
     return lib
 
@@ -143,11 +144,14 @@ _PUT, _DEL = 1, 2
 class NativeKVStore(KeyValueStore):
     """Persistent store over the C++ log engine."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, sync: bool = False):
         self._lib = _load_native()
         self._h = self._lib.kv_open(str(path).encode())
         if not self._h:
             raise OSError(f"kv_open failed for {path}")
+        if sync:
+            # fdatasync every COMMIT: committed batches survive power loss
+            self._lib.kv_set_sync(self._h, 1)
 
     def get(self, key):
         n = ctypes.c_size_t(0)

@@ -1,7 +1,9 @@
-"""Device pairing e2e tests (heavy: Miller-loop scan compile ~1-2 min).
+"""Device pairing e2e tests.
 
-Gated behind LHTPU_SLOW=1 so the default suite stays fast; the driver's
-bench and the verify drives exercise this path on real hardware.
+The default suite exercises the full tpu BLS backend end-to-end on one
+shared 4-lane compiled program (persistent compile cache in conftest keeps
+repeat runs fast).  The per-lane scalar-oracle comparison compiles a
+second program and stays behind LHTPU_SLOW=1.
 """
 
 import os
@@ -12,11 +14,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-pytestmark = pytest.mark.skipif(
+slow = pytest.mark.skipif(
     os.environ.get("LHTPU_SLOW") != "1",
-    reason="device pairing compile is slow; set LHTPU_SLOW=1")
+    reason="extra compile shape; set LHTPU_SLOW=1")
 
 
+@slow
 def test_batch_miller_matches_scalar_oracle():
     from lighthouse_tpu.crypto.bls import curve as cv
     from lighthouse_tpu.crypto.bls.pairing_fast import miller_loop_fast
@@ -58,3 +61,19 @@ def test_tpu_backend_verifies_real_signatures():
     sets[1] = bls.SignatureSet(
         sks[0].sign(b"other" + b"\x00" * 27), [sks[1].public_key()], msg)
     assert not bls.verify_signature_sets(sets, backend="tpu")
+
+
+def test_tpu_backend_lazy_registration():
+    """The round-1 regression: verify_signature_sets(backend='tpu') raised
+    KeyError when the tpu backend had not been registered via set_backend
+    yet (crypto/bls/api.py).  Simulate the fresh-process state by popping
+    the registration."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import api
+
+    api._BACKENDS.pop("tpu", None)
+    sk = bls.SecretKey.from_bytes(bytes([0] * 31 + [9]))
+    msg = b"z" * 32
+    sets = [bls.SignatureSet(sk.sign(msg), [sk.public_key()], msg)]
+    # must lazily register + verify without a prior set_backend call
+    assert bls.verify_signature_sets(sets, backend="tpu")

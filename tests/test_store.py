@@ -147,6 +147,45 @@ class TestHotColdDB:
         assert roots[5] == imported[4][0]
         assert len(roots) == 15
 
+
+def test_migration_beyond_historical_root_window():
+    """Long non-finality: finalization jumps past slots_per_historical_root.
+
+    Slots older than the window can't be resolved from the finalized
+    state's root arrays; the migration must recover them by walking parent
+    pointers and must never drop canonical blocks (ADVICE.md round-1:
+    hot_cold.py migrate data-loss bug)."""
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    db = HotColdDB(h.spec, MemoryStore(), slots_per_restore_point=64)
+    sphr = h.spec.preset.slots_per_historical_root  # 64 on minimal
+    db.store_anchor_state(h.state.hash_tree_root(), h.state)
+    from lighthouse_tpu.state_transition import state_transition
+
+    imported = []
+    # sparse chain: one block every 8 slots, out to past the window
+    for target in range(4, sphr + 24, 8):
+        signed = h.produce_block(slot=target)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        block_root = signed.message.hash_tree_root()
+        state_root = bytes(signed.message.state_root)
+        db.import_block(block_root, signed, h.state, state_root)
+        imported.append((target, block_root, state_root))
+
+    fin_slot, fin_root, fin_state_root = imported[-1]
+    db.migrate_to_finalized(fin_state_root, fin_root)
+    assert db.split_slot == fin_slot
+
+    # every canonical block — including those older than the window —
+    # is still addressable and has a freezer block-root entry
+    for slot, block_root, _ in imported[:-1]:
+        assert db.get_block(block_root) is not None, f"slot {slot} lost"
+        assert db.cold_block_root_at_slot(slot) == block_root
+    # skipped slots inherit the latest block at-or-below them
+    first_slot, first_root, _ = imported[0]
+    assert db.cold_block_root_at_slot(first_slot + 3) == first_root
+
+
+class TestHotColdMetadata:
     def test_metadata_persistence(self, chain_db):
         h, db, imported = chain_db
         db.persist_head(imported[-1][0])
