@@ -32,6 +32,7 @@ from lighthouse_tpu.chain.caches import (
     StateCache,
     ValidatorPubkeyCache,
 )
+from lighthouse_tpu.chain.data_availability import DataAvailabilityChecker
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
 from lighthouse_tpu.fork_choice import ForkChoice
 from lighthouse_tpu.store import HotColdDB
@@ -45,6 +46,7 @@ class BeaconChain:
         store: HotColdDB | None = None,
         slot_clock: SlotClock | None = None,
         verify_signatures: bool = True,
+        kzg_settings=None,
     ):
         self.spec = spec
         self.t = T.make_types(spec.preset)
@@ -78,7 +80,15 @@ class BeaconChain:
         self.observed_attesters = EpochIndexedSeen()
         self.observed_aggregators = EpochIndexedSeen()
         self.observed_aggregates = ObservedDigests()
+        self.observed_blob_sidecars = ObservedDigests()
         self.observed_block_producers = SlotIndexedSeen()
+        self.da_checker = DataAvailabilityChecker(spec)
+        self.kzg_settings = kzg_settings
+        self._pending_executed: dict[bytes, object] = {}
+        from lighthouse_tpu.pool import NaiveAggregationPool, OperationPool
+
+        self.op_pool = OperationPool()
+        self.naive_pool = NaiveAggregationPool()
         self.block_times = BlockTimesCache()
         self.metrics: dict[str, float] = {}
         self._migrated_finalized_epoch = self.fork_choice.finalized.epoch
@@ -150,17 +160,75 @@ class BeaconChain:
     # -- block import pipeline --------------------------------------------
 
     def process_block(self, signed_block, blobs_ssz: bytes | None = None,
-                      source: str = "gossip") -> bytes:
+                      source: str = "gossip") -> bytes | None:
         """Full pipeline: gossip-verify → batch-signature-verify → execute
-        → import (reference chain.process_block, beacon_chain.rs:3089).
-        source="rpc" for sync-fetched blocks (skips gossip-only checks)."""
+        → availability gate → import (reference chain.process_block,
+        beacon_chain.rs:3089).  source="rpc" for sync-fetched blocks
+        (skips gossip-only checks).  Returns None when the block carries
+        blob commitments whose sidecars have not all arrived yet — it
+        waits in the DA checker and imports when they do."""
         t_start = time.perf_counter()
         gossip = verify_block_for_gossip(self, signed_block, source)
         sigv = verify_block_signatures(self, gossip)
         pending = execute_block(self, sigv)
+
+        # Deneb data-availability gate (data_availability_checker.rs:32).
+        # Callers that ALREADY hold the block's blob data (RPC/backfill
+        # sync, which verifies sidecars out-of-band) pass blobs_ssz and
+        # import directly — only gossip blocks wait on gossip sidecars.
+        commitments = getattr(signed_block.message.body,
+                              "blob_kzg_commitments", None)
+        if (commitments is not None and len(commitments) > 0
+                and blobs_ssz is None):
+            self._pending_executed[pending.block_root] = pending
+            while len(self._pending_executed) > self.da_checker.capacity:
+                # stay in lockstep with the DA checker's LRU bound
+                oldest = next(iter(self._pending_executed))
+                del self._pending_executed[oldest]
+            availability = self.da_checker.put_pending_executed_block(
+                pending.block_root, pending.signed_block)
+            if not availability.is_available:
+                return None
+            return self._import_available(availability)
+
         root = self.import_block(pending, blobs_ssz)
         self.block_times.record(root, "total", time.perf_counter() - t_start)
         return root
+
+    def process_gossip_blob(self, sidecar) -> bytes | None:
+        """Verify one gossip blob sidecar and import its block if that
+        completes availability (blob_verification.rs + DA checker)."""
+        from lighthouse_tpu.chain.blob_verification import (
+            BlobError,
+            validate_blobs,
+            verify_blob_sidecar_for_gossip,
+        )
+
+        verified = verify_blob_sidecar_for_gossip(self, sidecar,
+                                                  self.kzg_settings)
+        if not validate_blobs(
+                self.kzg_settings, [sidecar.kzg_commitment],
+                [sidecar.blob], [sidecar.kzg_proof]):
+            raise BlobError("invalid_kzg_proof")
+        # mark the dup cache only now that the FULL verification (incl.
+        # KZG) passed — a corrupted copy must not block the honest sidecar
+        epoch = self.spec.compute_epoch_at_slot(
+            int(sidecar.signed_block_header.message.slot))
+        self.observed_blob_sidecars.observe(
+            epoch,
+            verified.block_root + int(sidecar.index).to_bytes(8, "little"))
+        availability = self.da_checker.put_verified_blobs(
+            verified.block_root, [verified])
+        if availability.is_available:
+            return self._import_available(availability)
+        return None
+
+    def _import_available(self, availability) -> bytes | None:
+        pending = self._pending_executed.pop(availability.block_root, None)
+        if pending is None:
+            return None  # block arrived via another path already
+        blobs_ssz = b"".join(s.serialize() for s in (availability.blobs or []))
+        return self.import_block(pending, blobs_ssz or None)
 
     def import_block(self, pending: ExecutionPendingBlock,
                      blobs_ssz: bytes | None = None) -> bytes:
@@ -232,6 +300,13 @@ class BeaconChain:
         self.store.migrate_to_finalized(
             bytes(fin_block.message.state_root), fin.root)
         self._migrated_finalized_epoch = fin.epoch
+        fin_slot = self.spec.compute_start_slot_at_epoch(fin.epoch)
+        self.da_checker.prune_finalized(fin_slot)
+        self._pending_executed = {
+            r: p for r, p in self._pending_executed.items()
+            if int(p.signed_block.message.slot) >= fin_slot}
+        self.op_pool.prune(self.head_state, self.spec)
+        self.naive_pool.prune_below(fin_slot)
 
     # -- attestation pipelines --------------------------------------------
 
@@ -241,14 +316,24 @@ class BeaconChain:
         beacon_chain.rs:1961 + batch.rs:133).  Returns
         (verified, rejects) — verified items are already applied to fork
         choice."""
-        return self._batch_pipeline(
+        verified, rejects = self._batch_pipeline(
             attestations, att_verify.verify_unaggregated_for_gossip)
+        for v in verified:
+            # feed the naive aggregation pool; its aggregates in turn feed
+            # block packing via the operation pool
+            self.naive_pool.insert(v.attestation)
+        return verified, rejects
 
     def verify_aggregates_for_gossip(self, aggregates: list):
         """Batch-verify SignedAggregateAndProofs (3 sets each,
         batch.rs:62-102)."""
         verified, rejects = self._batch_pipeline(
             aggregates, att_verify.verify_aggregated_for_gossip)
+        for v in verified:
+            att = v.attestation
+            self.op_pool.insert_attestation(
+                att.data, np.asarray(att.aggregation_bits, bool),
+                bytes(att.signature))
         return verified, rejects
 
     def _batch_pipeline(self, items, verify_fn):
@@ -315,11 +400,14 @@ class BeaconChain:
     # -- block production --------------------------------------------------
 
     def produce_block_on(self, slot: int, randao_reveal: bytes,
-                         graffiti: bytes = b"", attestations: list = (),
+                         graffiti: bytes = b"", attestations: list | None = None,
                          sync_aggregate=None, execution_payload=None):
         """Produce an unsigned block on the current head
         (reference produce_block_with_verification, beacon_chain.rs:4224).
-        The caller (validator client) signs it."""
+        The caller (validator client) signs it.  With attestations=None,
+        the operation pool packs them (max-cover) along with slashings,
+        exits and BLS changes (produce_partial_beacon_block,
+        beacon_chain.rs:4930)."""
         from lighthouse_tpu.state_transition import (
             SignatureStrategy,
             misc,
@@ -335,11 +423,29 @@ class BeaconChain:
             state_advance(pre, spec, slot)
         proposer = misc.get_beacon_proposer_index(pre, spec, slot)
 
+        pool_kw = {}
+        if attestations is None:
+            # fold the naive pool's current aggregates in before packing
+            for data, bits, sig in self.naive_pool.iter_aggregates():
+                self.op_pool.insert_attestation(data, bits, sig)
+            attestations = self.op_pool.get_attestations(
+                pre, spec, lambda e: self.committee_shuffle(pre, e), t=self.t)
+            prop_sl, att_sl = self.op_pool.get_slashings(pre, spec)
+            pool_kw = dict(
+                proposer_slashings=prop_sl,
+                attester_slashings=att_sl,
+                voluntary_exits=self.op_pool.get_voluntary_exits(pre, spec),
+            )
+            if fork in ("capella", "deneb", "electra"):
+                pool_kw["bls_to_execution_changes"] = (
+                    self.op_pool.get_bls_to_execution_changes(pre, spec))
+
         body_kw = dict(
             randao_reveal=randao_reveal,
             eth1_data=pre.eth1_data,
             graffiti=graffiti.ljust(32, b"\x00")[:32],
             attestations=list(attestations),
+            **pool_kw,
         )
         if fork != "phase0":
             body_kw["sync_aggregate"] = (

@@ -1,0 +1,196 @@
+"""Blob sidecar verification (Deneb).
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/blob_verification.rs
+(gossip checks + the KZG batch at :380) and kzg_utils.rs:23-35
+(validate_blobs -> verify_blob_kzg_proof_batch): structural/timing checks
+per sidecar, the commitment inclusion proof against the block header's
+body root, the proposer's header signature, then ONE batched KZG proof
+verification riding the device multi-pairing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from lighthouse_tpu.crypto import bls, kzg
+from lighthouse_tpu.state_transition.misc import is_valid_merkle_branch
+
+# deneb BeaconBlockBody: 12 fields, blob_kzg_commitments is field 11
+_BODY_FIELDS = 16  # padded to next power of two
+_BODY_DEPTH = 4
+_COMMITMENTS_FIELD_INDEX = 11
+
+
+class BlobError(ValueError):
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
+def _inclusion_depth(spec) -> int:
+    list_depth = max(spec.preset.max_blob_commitments_per_block - 1, 1).bit_length()
+    return _BODY_DEPTH + 1 + list_depth
+
+
+def _commitment_leaf(commitment: bytes) -> bytes:
+    # Bytes48 hash_tree_root: chunk0 = bytes[0:32], chunk1 = bytes[32:48]+pad
+    return hashlib.sha256(commitment + b"\x00" * 16).digest()
+
+
+def _list_subtree_nodes(commitments: list[bytes], depth: int) -> list[list[bytes]]:
+    """Levels of the (padded) commitments chunk tree, leaves first."""
+    zero = [b"\x00" * 32]
+    for _ in range(depth):
+        zero.append(hashlib.sha256(zero[-1] * 2).digest())
+    level = [_commitment_leaf(c) for c in commitments]
+    levels = []
+    for d in range(depth):
+        width = 1 << (depth - d)
+        levels.append(level)
+        nxt = []
+        for i in range(0, max(len(level), 2), 2):
+            left = level[i] if i < len(level) else zero[d]
+            right = level[i + 1] if i + 1 < len(level) else zero[d]
+            nxt.append(hashlib.sha256(left + right).digest())
+        level = nxt
+    levels.append(level)  # the chunks root
+    return levels
+
+
+def compute_kzg_inclusion_proof(body, index: int, spec) -> list[bytes]:
+    """Merkle branch proving body.blob_kzg_commitments[index] under the
+    body root (depth 4 + 1 + log2(max commitments), reference
+    KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)."""
+    commitments = [bytes(c) for c in body.blob_kzg_commitments]
+    list_depth = _inclusion_depth(spec) - _BODY_DEPTH - 1
+
+    levels = _list_subtree_nodes(commitments, list_depth)
+    branch = []
+    idx = index
+    for d in range(list_depth):
+        sib = idx ^ 1
+        level = levels[d]
+        if sib < len(level):
+            branch.append(level[sib])
+        else:
+            zero = b"\x00" * 32
+            for _ in range(d):
+                zero = hashlib.sha256(zero * 2).digest()
+            branch.append(zero)
+        idx >>= 1
+
+    # length mix-in: sibling is the little-endian list length
+    branch.append(len(commitments).to_bytes(32, "little"))
+
+    # body field tree: siblings of field 11 at depth 4
+    field_roots = []
+    for fname, ftype in type(body).fields.items():
+        field_roots.append(ftype.hash_tree_root(getattr(body, fname)))
+    while len(field_roots) < _BODY_FIELDS:
+        field_roots.append(b"\x00" * 32)
+    nodes = field_roots
+    idx = _COMMITMENTS_FIELD_INDEX
+    for _ in range(_BODY_DEPTH):
+        branch.append(nodes[idx ^ 1])
+        nodes = [hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
+                 for i in range(0, len(nodes), 2)]
+        idx >>= 1
+    return branch
+
+
+def verify_kzg_inclusion_proof(sidecar, spec) -> bool:
+    depth = _inclusion_depth(spec)
+    list_depth = depth - _BODY_DEPTH - 1
+    index = (int(sidecar.index)
+             | (_COMMITMENTS_FIELD_INDEX << (list_depth + 1)))
+    return is_valid_merkle_branch(
+        _commitment_leaf(bytes(sidecar.kzg_commitment)),
+        [bytes(b) for b in sidecar.kzg_commitment_inclusion_proof],
+        depth, index,
+        bytes(sidecar.signed_block_header.message.body_root))
+
+
+@dataclass
+class VerifiedBlob:
+    sidecar: object
+    block_root: bytes
+
+
+def verify_blob_sidecar_for_gossip(chain, sidecar, settings: kzg.KzgSettings
+                                   ) -> VerifiedBlob:
+    """Gossip-level checks for one sidecar (reference GossipVerifiedBlob).
+    KZG proof itself is verified in batch via `validate_blobs`."""
+    spec = chain.spec
+    header = sidecar.signed_block_header.message
+    slot = int(header.slot)
+    epoch = spec.compute_epoch_at_slot(slot)
+    if int(sidecar.index) >= spec.preset.max_blobs_per_block:
+        raise BlobError("invalid_subnet_index")
+    if slot > chain.current_slot():
+        raise BlobError("future_slot")
+    if epoch < chain.fork_choice.finalized.epoch:
+        raise BlobError("past_finalized_slot")
+    parent_root = bytes(header.parent_root)
+    if parent_root not in chain.fork_choice.proto:
+        raise BlobError("unknown_parent")
+    block_root = header.hash_tree_root()
+    digest = block_root + int(sidecar.index).to_bytes(8, "little")
+    if chain.observed_blob_sidecars.is_seen(epoch, digest):
+        raise BlobError("repeat_blob")
+    if not verify_kzg_inclusion_proof(sidecar, spec):
+        raise BlobError("invalid_inclusion_proof")
+    if not check_expected_proposer(chain, header):
+        raise BlobError("invalid_proposer")
+
+    # proposer header signature against the parent's post-state
+    if chain.verify_signatures:
+        state = chain.state_for_block(parent_root)
+        if state is None:
+            raise BlobError("parent_state_unavailable")
+        from lighthouse_tpu.state_transition import misc
+
+        proposer = int(header.proposer_index)
+        domain = misc.get_domain(state, spec, spec.domain_beacon_proposer, epoch)
+        root = misc.compute_signing_root(header.hash_tree_root(), domain)
+        pk = chain.pubkey_cache.get(proposer)
+        if pk is None:
+            raise BlobError("unknown_proposer")
+        sset = bls.SignatureSet(
+            bls.Signature(bytes(sidecar.signed_block_header.signature)),
+            [pk], root)
+        if not bls.verify_signature_sets([sset]):
+            raise BlobError("invalid_proposer_signature")
+    # NOTE: the dup cache is marked by the CALLER after the KZG proof
+    # checks out (blob bytes aren't covered by the header signature, so
+    # observing here would let a corrupted copy block the honest one)
+    return VerifiedBlob(sidecar, block_root)
+
+
+def check_expected_proposer(chain, header) -> bool:
+    """header.proposer_index must be the slot's actual proposer — else any
+    validator key could flood the DA checker with self-signed sidecars
+    under fresh bogus block roots (reference checks this via shuffling)."""
+    from lighthouse_tpu.state_transition import misc, state_advance
+
+    state = chain.state_for_block(bytes(header.parent_root))
+    if state is None:
+        return False
+    slot = int(header.slot)
+    st = state
+    if int(state.slot) < slot:
+        st = state.copy()
+        state_advance(st, chain.spec, slot)
+    expected = misc.get_beacon_proposer_index(st, chain.spec)
+    return int(header.proposer_index) == expected
+
+
+def validate_blobs(settings: kzg.KzgSettings, commitments, blobs, proofs) -> bool:
+    """Batched KZG verification for a block's blobs (kzg_utils.rs:23-35)."""
+    if not blobs:
+        return True
+    return kzg.verify_blob_kzg_proof_batch(
+        [bytes(b) for b in blobs],
+        [bytes(c) for c in commitments],
+        [bytes(p) for p in proofs],
+        settings)

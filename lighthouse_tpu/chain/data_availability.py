@@ -1,0 +1,101 @@
+"""Data-availability checker (Deneb).
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/
+data_availability_checker.rs (:32,:61) + its overflow LRU cache: pending
+block/blob components are held per block root until every commitment the
+block carries has a verified sidecar — only then does import proceed.
+Capacity-bounded; finalization prunes.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PendingComponents:
+    block: object | None = None
+    blobs: dict[int, object] = field(default_factory=dict)  # index -> sidecar
+
+    def num_expected(self) -> int | None:
+        if self.block is None:
+            return None
+        body = self.block.message.body
+        commitments = getattr(body, "blob_kzg_commitments", None)
+        return 0 if commitments is None else len(commitments)
+
+
+@dataclass
+class Availability:
+    """Either available (block + ordered blobs) or missing components."""
+
+    block_root: bytes
+    block: object | None = None
+    blobs: list | None = None
+
+    @property
+    def is_available(self) -> bool:
+        return self.block is not None
+
+
+class DataAvailabilityChecker:
+    def __init__(self, spec, capacity: int = 64):
+        self.spec = spec
+        self._pending: OrderedDict[bytes, PendingComponents] = OrderedDict()
+        self.capacity = capacity
+
+    def _entry(self, block_root: bytes) -> PendingComponents:
+        entry = self._pending.get(block_root)
+        if entry is None:
+            entry = self._pending[block_root] = PendingComponents()
+            while len(self._pending) > self.capacity:
+                self._pending.popitem(last=False)  # LRU overflow
+        else:
+            self._pending.move_to_end(block_root)
+        return entry
+
+    def _check(self, block_root: bytes) -> Availability:
+        entry = self._pending.get(block_root)
+        if entry is None:
+            return Availability(block_root)
+        expected = entry.num_expected()
+        if expected is None or len(entry.blobs) < expected:
+            return Availability(block_root)
+        blobs = [entry.blobs[i] for i in sorted(entry.blobs)][:expected]
+        self._pending.pop(block_root, None)
+        return Availability(block_root, entry.block, blobs)
+
+    def put_verified_blobs(self, block_root: bytes, verified_blobs) -> Availability:
+        """Record gossip/RPC-verified sidecars; returns availability."""
+        entry = self._entry(block_root)
+        for vb in verified_blobs:
+            sidecar = getattr(vb, "sidecar", vb)
+            entry.blobs[int(sidecar.index)] = sidecar
+        return self._check(block_root)
+
+    def put_pending_executed_block(self, block_root: bytes, block) -> Availability:
+        """Record a fully-verified block awaiting its blobs."""
+        entry = self._entry(block_root)
+        entry.block = block
+        return self._check(block_root)
+
+    def has_block(self, block_root: bytes) -> bool:
+        e = self._pending.get(block_root)
+        return e is not None and e.block is not None
+
+    def missing_blob_indices(self, block_root: bytes) -> list[int] | None:
+        e = self._pending.get(block_root)
+        if e is None or e.block is None:
+            return None
+        expected = e.num_expected() or 0
+        return [i for i in range(expected) if i not in e.blobs]
+
+    def prune_finalized(self, finalized_slot: int):
+        for root in list(self._pending):
+            e = self._pending[root]
+            if e.block is not None and int(e.block.message.slot) < finalized_slot:
+                del self._pending[root]
+
+    def __len__(self) -> int:
+        return len(self._pending)

@@ -1,0 +1,92 @@
+"""EIP-2333 BLS key derivation (HKDF tree).
+
+Rebuild of /root/reference/crypto/eth2_key_derivation: hkdf_mod_r master
+key generation and the Lamport-based child derivation, from the EIP-2333
+specification, on the python stdlib (hashlib/hmac).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from lighthouse_tpu.crypto.bls.fields import R as CURVE_ORDER
+
+_SALT0 = b"BLS-SIG-KEYGEN-SALT-"
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def hkdf_mod_r(ikm: bytes, key_info: bytes = b"") -> int:
+    """IKM -> secret key scalar in (0, r)."""
+    salt = _SALT0
+    sk = 0
+    while sk == 0:
+        salt = hashlib.sha256(salt).digest()
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + (48).to_bytes(2, "big"), 48)
+        sk = int.from_bytes(okm, "big") % CURVE_ORDER
+    return sk
+
+
+def _ikm_to_lamport_sk(ikm: bytes, salt: bytes) -> list[bytes]:
+    prk = _hkdf_extract(salt, ikm)
+    okm = _hkdf_expand(prk, b"", 255 * 32)
+    return [okm[i:i + 32] for i in range(0, 255 * 32, 32)]
+
+
+def _flip_bits(data: bytes) -> bytes:
+    return bytes(b ^ 0xFF for b in data)
+
+
+def parent_sk_to_lamport_pk(parent_sk: int, index: int) -> bytes:
+    salt = index.to_bytes(4, "big")
+    ikm = parent_sk.to_bytes(32, "big")
+    lamport = _ikm_to_lamport_sk(ikm, salt)
+    lamport += _ikm_to_lamport_sk(_flip_bits(ikm), salt)
+    return hashlib.sha256(
+        b"".join(hashlib.sha256(chunk).digest() for chunk in lamport)
+    ).digest()
+
+
+def derive_master_sk(seed: bytes) -> int:
+    if len(seed) < 32:
+        raise ValueError("seed must be at least 32 bytes (EIP-2333)")
+    return hkdf_mod_r(seed)
+
+
+def derive_child_sk(parent_sk: int, index: int) -> int:
+    return hkdf_mod_r(parent_sk_to_lamport_pk(parent_sk, index))
+
+
+def derive_path(seed: bytes, path: str) -> int:
+    """EIP-2334 path derivation, e.g. 'm/12381/3600/0/0/0'."""
+    parts = path.strip().split("/")
+    if parts[0] != "m":
+        raise ValueError("path must start with m")
+    sk = derive_master_sk(seed)
+    for p in parts[1:]:
+        if not p.isdigit():
+            raise ValueError(f"invalid path component {p!r}")
+        sk = derive_child_sk(sk, int(p))
+    return sk
+
+
+def validator_keys(seed: bytes, index: int) -> tuple[int, int]:
+    """(signing_sk, withdrawal_sk) for validator `index` per EIP-2334."""
+    withdrawal = derive_path(seed, f"m/12381/3600/{index}/0")
+    signing = derive_child_sk(withdrawal, 0)
+    return signing, withdrawal

@@ -1,0 +1,124 @@
+"""EIP-2335 encrypted BLS keystores.
+
+Rebuild of /root/reference/crypto/eth2_keystore: scrypt or PBKDF2 key
+derivation + AES-128-CTR encryption + sha256 checksum, serialized as the
+standard keystore JSON.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import unicodedata
+import uuid
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+class KeystoreError(ValueError):
+    pass
+
+
+def _normalize_password(password: str) -> bytes:
+    norm = unicodedata.normalize("NFKD", password)
+    # strip C0/C1 control codes and DEL per EIP-2335
+    return "".join(
+        c for c in norm
+        if not (ord(c) < 0x20 or 0x7F <= ord(c) <= 0x9F)).encode()
+
+
+def _kdf(password: bytes, params: dict) -> bytes:
+    fn = params["function"]
+    p = params["params"]
+    salt = bytes.fromhex(p["salt"])
+    if fn == "scrypt":
+        return hashlib.scrypt(
+            password, salt=salt, n=p["n"], r=p["r"], p=p["p"],
+            dklen=p["dklen"], maxmem=256 * 1024 * 1024)
+    if fn == "pbkdf2":
+        return hashlib.pbkdf2_hmac(
+            p["prf"].removeprefix("hmac-"), password, salt, p["c"], p["dklen"])
+    raise KeystoreError(f"unsupported kdf {fn}")
+
+
+def _aes128ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def encrypt(secret: bytes, password: str, *, path: str = "",
+            kdf: str = "scrypt", description: str = "") -> dict:
+    """Secret -> EIP-2335 keystore dict."""
+    pw = _normalize_password(password)
+    salt = secrets.token_bytes(32)
+    if kdf == "scrypt":
+        kdf_module = {
+            "function": "scrypt",
+            "params": {"dklen": 32, "n": 262144, "r": 8, "p": 1,
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    else:
+        kdf_module = {
+            "function": "pbkdf2",
+            "params": {"dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    dk = _kdf(pw, kdf_module)
+    iv = secrets.token_bytes(16)
+    cipher_message = _aes128ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_message).digest()
+
+    from lighthouse_tpu.crypto import bls
+
+    pubkey = ""
+    if len(secret) == 32:
+        try:
+            pubkey = bls.SecretKey.from_bytes(secret).public_key() \
+                .to_bytes().hex()
+        except Exception:
+            pubkey = ""
+    return {
+        "crypto": {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {"function": "aes-128-ctr", "params": {"iv": iv.hex()},
+                       "message": cipher_message.hex()},
+        },
+        "description": description,
+        "pubkey": pubkey,
+        "path": path,
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt(keystore: dict, password: str) -> bytes:
+    """EIP-2335 keystore dict -> secret bytes (raises on bad password)."""
+    if keystore.get("version") != 4:
+        raise KeystoreError("only version-4 keystores supported")
+    crypto = keystore["crypto"]
+    pw = _normalize_password(password)
+    dk = _kdf(pw, crypto["kdf"])
+    cipher_message = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_message).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("invalid password (checksum mismatch)")
+    if crypto["cipher"]["function"] != "aes-128-ctr":
+        raise KeystoreError("unsupported cipher")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return _aes128ctr(dk[:16], iv, cipher_message)
+
+
+def save(keystore: dict, path) -> None:
+    with open(path, "w") as f:
+        json.dump(keystore, f, indent=2)
+
+
+def load(path) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,58 @@
+"""EIP-2386 hierarchical deterministic wallets.
+
+Rebuild of /root/reference/crypto/eth2_wallet: a wallet is an encrypted
+seed plus a counter of derived validator accounts; each account's signing
+and withdrawal keys come from the EIP-2334 paths m/12381/3600/i/0[/0].
+"""
+
+from __future__ import annotations
+
+import secrets
+import uuid
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.key_derivation import validator_keys
+
+
+class WalletError(ValueError):
+    pass
+
+
+class Wallet:
+    def __init__(self, data: dict):
+        self.data = data
+
+    @staticmethod
+    def create(name: str, password: str, seed: bytes | None = None) -> "Wallet":
+        seed = seed if seed is not None else secrets.token_bytes(32)
+        if len(seed) < 32:
+            raise WalletError("seed must be >= 32 bytes")
+        crypto = ks.encrypt(seed, password, kdf="pbkdf2")["crypto"]
+        return Wallet({
+            "crypto": crypto,
+            "name": name,
+            "nextaccount": 0,
+            "type": "hierarchical deterministic",
+            "uuid": str(uuid.uuid4()),
+            "version": 1,
+        })
+
+    def decrypt_seed(self, password: str) -> bytes:
+        shell = {"crypto": self.data["crypto"], "version": 4}
+        return ks.decrypt(shell, password)
+
+    def next_validator(self, wallet_password: str, keystore_password: str
+                       ) -> tuple[dict, dict]:
+        """Derive the next validator account; returns (signing keystore,
+        withdrawal keystore) and bumps nextaccount."""
+        seed = self.decrypt_seed(wallet_password)
+        index = int(self.data["nextaccount"])
+        signing_sk, withdrawal_sk = validator_keys(seed, index)
+        signing = ks.encrypt(
+            signing_sk.to_bytes(32, "big"), keystore_password,
+            path=f"m/12381/3600/{index}/0/0", kdf="pbkdf2")
+        withdrawal = ks.encrypt(
+            withdrawal_sk.to_bytes(32, "big"), keystore_password,
+            path=f"m/12381/3600/{index}/0", kdf="pbkdf2")
+        self.data["nextaccount"] = index + 1
+        return signing, withdrawal

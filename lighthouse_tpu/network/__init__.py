@@ -1,0 +1,19 @@
+"""Networking layer (L6): gossip pub/sub, Req/Resp RPC, router, sync,
+peer management (reference beacon_node/{network,lighthouse_network})."""
+
+from lighthouse_tpu.network.gossip import GossipHub
+from lighthouse_tpu.network.peer_manager import PeerManager
+from lighthouse_tpu.network.router import Router
+from lighthouse_tpu.network.rpc import RpcFabric
+from lighthouse_tpu.network.service import NetworkFabric, NetworkService
+from lighthouse_tpu.network.sync import SyncManager
+
+__all__ = [
+    "GossipHub",
+    "PeerManager",
+    "Router",
+    "RpcFabric",
+    "NetworkFabric",
+    "NetworkService",
+    "SyncManager",
+]

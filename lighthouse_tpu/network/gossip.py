@@ -1,0 +1,133 @@
+"""Gossip pub/sub message layer.
+
+Rebuild of the reference's gossipsub stack
+(/root/reference/beacon_node/lighthouse_network/src/service/mod.rs:112-113
+and the vendored gossipsub fork) at the altitude this framework needs: a
+`GossipHub` is the in-process swarm fabric — real SSZ bytes move between
+endpoints, with per-topic subscription, a seen-message dedup cache, and
+per-peer delivery scoring hooks.  `GossipEndpoint` is one node's handle
+(the reference `Network` wrapper).  Transport is synchronous in-process
+delivery; the seam (publish/subscribe over topic strings + bytes) is
+exactly what a socket transport would implement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, defaultdict
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+def message_id(topic: str, data: bytes) -> bytes:
+    """Spec-shaped message id: hash over domain + topic + payload."""
+    return hashlib.sha256(
+        b"\x01\x00\x00\x00" + topic.encode() + data).digest()[:20]
+
+
+@dataclass
+class GossipMessage:
+    topic: str
+    data: bytes
+    source: str  # peer id of the publisher
+
+
+class _SeenCache:
+    def __init__(self, capacity: int = 4096):
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        self.capacity = capacity
+
+    def observe(self, mid: bytes) -> bool:
+        """True if newly seen."""
+        if mid in self._seen:
+            return False
+        self._seen[mid] = None
+        while len(self._seen) > self.capacity:
+            self._seen.popitem(last=False)
+        return True
+
+
+class GossipEndpoint:
+    """One node's gossip handle: subscriptions + handlers + dedup."""
+
+    def __init__(self, hub: "GossipHub", peer_id: str):
+        self.hub = hub
+        self.peer_id = peer_id
+        self.handlers: dict[str, Callable[[GossipMessage], None]] = {}
+        self.seen = _SeenCache()
+        self.on_delivery_result: Callable[[str, str, bool], None] | None = None
+
+    def subscribe(self, topic: str, handler: Callable[[GossipMessage], None]):
+        self.handlers[topic] = handler
+        self.hub._subscribe(topic, self)
+
+    def unsubscribe(self, topic: str):
+        self.handlers.pop(topic, None)
+        self.hub._unsubscribe(topic, self)
+
+    def publish(self, topic: str, data: bytes):
+        self.hub.route(GossipMessage(topic, data, self.peer_id))
+
+    def _deliver(self, msg: GossipMessage):
+        if not self.seen.observe(message_id(msg.topic, msg.data)):
+            return
+        handler = self.handlers.get(msg.topic)
+        if handler is None:
+            return
+        ok = True
+        try:
+            handler(msg)
+        except Exception:
+            ok = False
+        if self.on_delivery_result is not None:
+            self.on_delivery_result(msg.source, msg.topic, ok)
+
+
+class GossipHub:
+    """The in-process swarm: flood-routes published messages to every
+    subscribed endpoint except the publisher."""
+
+    def __init__(self):
+        self._topics: dict[str, list[GossipEndpoint]] = defaultdict(list)
+        self._endpoints: dict[str, GossipEndpoint] = {}
+        self._partitions: dict[str, set[str]] = {}
+
+    def join(self, peer_id: str) -> GossipEndpoint:
+        ep = GossipEndpoint(self, peer_id)
+        self._endpoints[peer_id] = ep
+        return ep
+
+    def leave(self, peer_id: str):
+        ep = self._endpoints.pop(peer_id, None)
+        if ep:
+            for subs in self._topics.values():
+                if ep in subs:
+                    subs.remove(ep)
+
+    def disconnect(self, a: str, b: str):
+        """Partition two peers (fault injection for tests)."""
+        self._partitions.setdefault(a, set()).add(b)
+        self._partitions.setdefault(b, set()).add(a)
+
+    def reconnect(self, a: str, b: str):
+        self._partitions.get(a, set()).discard(b)
+        self._partitions.get(b, set()).discard(a)
+
+    def _subscribe(self, topic: str, ep: GossipEndpoint):
+        if ep not in self._topics[topic]:
+            self._topics[topic].append(ep)
+
+    def _unsubscribe(self, topic: str, ep: GossipEndpoint):
+        if ep in self._topics[topic]:
+            self._topics[topic].remove(ep)
+
+    def route(self, msg: GossipMessage):
+        blocked = self._partitions.get(msg.source, set())
+        for ep in list(self._topics.get(msg.topic, ())):
+            if ep.peer_id == msg.source or ep.peer_id in blocked:
+                continue
+            ep._deliver(msg)
+
+    @property
+    def peers(self) -> list[str]:
+        return list(self._endpoints)

@@ -1,0 +1,77 @@
+"""Peer scoring and ban management.
+
+Rebuild of /root/reference/beacon_node/lighthouse_network/src/peer_manager/
+peerdb/score.rs:3-32: scores live in [-100, 100], decay toward zero, and
+crossing the ban threshold disconnects the peer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+MAX_SCORE = 100.0
+MIN_SCORE = -100.0
+BAN_THRESHOLD = -50.0
+DISCONNECT_THRESHOLD = -20.0
+HALFLIFE_S = 600.0
+
+# standard penalty/reward magnitudes (peer_manager score actions)
+PENALTIES = {
+    "low": -1.0,
+    "mid": -10.0,
+    "high": -25.0,
+    "fatal": -100.0,
+}
+REWARDS = {
+    "valid_message": 0.5,
+    "useful_response": 1.0,
+}
+
+
+@dataclass
+class PeerInfo:
+    score: float = 0.0
+    last_update: float = field(default_factory=time.monotonic)
+    banned: bool = False
+
+
+class PeerManager:
+    def __init__(self, clock=time.monotonic):
+        self.peers: dict[str, PeerInfo] = {}
+        self.clock = clock
+
+    def _info(self, peer: str) -> PeerInfo:
+        info = self.peers.get(peer)
+        if info is None:
+            info = self.peers[peer] = PeerInfo(last_update=self.clock())
+        return info
+
+    def _decay(self, info: PeerInfo):
+        now = self.clock()
+        dt = now - info.last_update
+        if dt > 0:
+            info.score *= 0.5 ** (dt / HALFLIFE_S)
+            info.last_update = now
+
+    def report(self, peer: str, action: str):
+        info = self._info(peer)
+        self._decay(info)
+        delta = PENALTIES.get(action, REWARDS.get(action, 0.0))
+        info.score = max(MIN_SCORE, min(MAX_SCORE, info.score + delta))
+        if info.score <= BAN_THRESHOLD:
+            info.banned = True
+
+    def score(self, peer: str) -> float:
+        info = self._info(peer)
+        self._decay(info)
+        return info.score
+
+    def is_banned(self, peer: str) -> bool:
+        return self._info(peer).banned
+
+    def should_disconnect(self, peer: str) -> bool:
+        return self.score(peer) <= DISCONNECT_THRESHOLD
+
+    def good_peers(self) -> list[str]:
+        return [p for p, i in self.peers.items() if not i.banned]

@@ -1,0 +1,217 @@
+"""Per-node message router: gossip decodables + Req/Resp serving.
+
+Rebuild of /root/reference/beacon_node/network/src/router.rs:272-434 and
+network_beacon_processor/{gossip_methods,rpc_methods}.rs: decodes topic
+payloads, dispatches them into the chain's verification pipelines (via the
+beacon_processor when attached, directly otherwise), and serves the
+Req/Resp protocols from the store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import TYPE_CHECKING
+
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    P_BLOBS_BY_RANGE,
+    P_BLOCKS_BY_RANGE,
+    P_BLOCKS_BY_ROOT,
+    P_STATUS,
+    StatusMessage,
+)
+
+if TYPE_CHECKING:
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+
+MAX_REQUEST_BLOCKS = 1024
+
+
+def fork_digest(chain) -> bytes:
+    """4-byte fork digest (spec compute_fork_digest)."""
+    cur = bytes(chain.head_state.fork.current_version)
+    root = bytes(chain.head_state.genesis_validators_root)
+    return hashlib.sha256(cur + root).digest()[:4]
+
+
+def topic(chain, kind: str) -> str:
+    return f"/eth2/{fork_digest(chain).hex()}/{kind}/ssz"
+
+
+class Router:
+    """Wires a chain + store to gossip topics and RPC protocols."""
+
+    def __init__(self, chain: "BeaconChain", gossip_ep, rpc_ep, peer_manager,
+                 on_unknown_parent=None):
+        self.chain = chain
+        self.gossip = gossip_ep
+        self.rpc = rpc_ep
+        self.peers = peer_manager
+        self.on_unknown_parent = on_unknown_parent
+        self._subscribe_topics()
+        self._register_rpc()
+        self.gossip.on_delivery_result = self._score_delivery
+
+    # -- gossip -------------------------------------------------------------
+
+    def _subscribe_topics(self):
+        c = self.chain
+        self.gossip.subscribe(topic(c, "beacon_block"), self._on_block)
+        self.gossip.subscribe(
+            topic(c, "beacon_aggregate_and_proof"), self._on_aggregate)
+        for subnet in range(c.spec.attestation_subnet_count):
+            self.gossip.subscribe(
+                topic(c, f"beacon_attestation_{subnet}"), self._on_attestation)
+        for i in range(c.spec.preset.max_blobs_per_block):
+            self.gossip.subscribe(
+                topic(c, f"blob_sidecar_{i}"), self._on_blob)
+        self.gossip.subscribe(
+            topic(c, "voluntary_exit"), self._on_voluntary_exit)
+        self.gossip.subscribe(
+            topic(c, "proposer_slashing"), self._on_proposer_slashing)
+        self.gossip.subscribe(
+            topic(c, "attester_slashing"), self._on_attester_slashing)
+
+    def _score_delivery(self, source: str, topic_: str, ok: bool):
+        self.peers.report(source, "valid_message" if ok else "low")
+
+    def _on_block(self, msg):
+        c = self.chain
+        fork = c.spec.fork_at_epoch(c.spec.compute_epoch_at_slot(
+            c.current_slot()))
+        block = None
+        # the wire block may be from the previous fork near boundaries
+        for f in dict.fromkeys((fork, *reversed(c.t.forks))):
+            try:
+                block = c.t.signed_beacon_block_class(f).deserialize(msg.data)
+                break
+            except Exception:
+                continue
+        if block is None:
+            self.peers.report(msg.source, "mid")
+            return
+        from lighthouse_tpu.chain.block_verification import BlockError
+
+        try:
+            c.process_block(block)
+        except BlockError as e:
+            if "unknown_parent" in str(e) and self.on_unknown_parent:
+                self.on_unknown_parent(msg.source, block)
+            else:
+                self.peers.report(msg.source, "mid")
+                raise
+
+    def _on_attestation(self, msg):
+        c = self.chain
+        att = c.t.Attestation.deserialize(msg.data)
+        verified, rejects = c.verify_attestations_for_gossip([att])
+        if rejects:
+            reasons = {r for _, r in rejects}
+            if not reasons & {"past_slot", "unknown_head_block",
+                              "prior_attestation_known"}:
+                self.peers.report(msg.source, "low")
+
+    def _on_aggregate(self, msg):
+        c = self.chain
+        agg = c.t.SignedAggregateAndProof.deserialize(msg.data)
+        c.verify_aggregates_for_gossip([agg])
+
+    def _on_blob(self, msg):
+        c = self.chain
+        sidecar = c.t.BlobSidecar.deserialize(msg.data)
+        c.process_gossip_blob(sidecar)
+
+    def _on_voluntary_exit(self, msg):
+        from lighthouse_tpu.types.containers import SignedVoluntaryExit
+
+        self.chain.op_pool.insert_voluntary_exit(
+            SignedVoluntaryExit.deserialize(msg.data))
+
+    def _on_proposer_slashing(self, msg):
+        from lighthouse_tpu.types.containers import ProposerSlashing
+
+        self.chain.op_pool.insert_proposer_slashing(
+            ProposerSlashing.deserialize(msg.data))
+
+    def _on_attester_slashing(self, msg):
+        c = self.chain
+        self.chain.op_pool.insert_attester_slashing(
+            c.t.AttesterSlashing.deserialize(msg.data))
+
+    # -- publishing ---------------------------------------------------------
+
+    def publish_block(self, signed_block):
+        self.gossip.publish(
+            topic(self.chain, "beacon_block"), signed_block.serialize())
+
+    def publish_attestation(self, attestation, subnet: int = 0):
+        self.gossip.publish(
+            topic(self.chain, f"beacon_attestation_{subnet}"),
+            attestation.serialize())
+
+    def publish_blob(self, sidecar):
+        self.gossip.publish(
+            topic(self.chain, f"blob_sidecar_{int(sidecar.index)}"),
+            sidecar.serialize())
+
+    # -- Req/Resp serving ---------------------------------------------------
+
+    def _register_rpc(self):
+        self.rpc.register(P_STATUS, self._serve_status)
+        self.rpc.register(P_BLOCKS_BY_RANGE, self._serve_blocks_by_range)
+        self.rpc.register(P_BLOCKS_BY_ROOT, self._serve_blocks_by_root)
+        self.rpc.register(P_BLOBS_BY_RANGE, self._serve_blobs_by_range)
+
+    def local_status(self) -> StatusMessage:
+        c = self.chain
+        fin = c.finalized_checkpoint()
+        return StatusMessage(
+            fork_digest=fork_digest(c),
+            finalized_root=fin.root,
+            finalized_epoch=fin.epoch,
+            head_root=c.head_root,
+            head_slot=int(c.head_state.slot),
+        )
+
+    def _serve_status(self, src: str, data: bytes) -> list[bytes]:
+        StatusMessage.deserialize(data)  # validate
+        return [self.local_status().serialize()]
+
+    def _serve_blocks_by_range(self, src: str, data: bytes) -> list[bytes]:
+        req = BlocksByRangeRequest.deserialize(data)
+        count = min(int(req.count), MAX_REQUEST_BLOCKS)
+        out = []
+        c = self.chain
+        for slot in range(int(req.start_slot), int(req.start_slot) + count):
+            root = c.block_root_at_slot(slot)
+            if root is None:
+                continue
+            blk = c.store.get_block(root)
+            if blk is not None and int(blk.message.slot) == slot:
+                out.append(blk.serialize())
+        return out
+
+    def _serve_blocks_by_root(self, src: str, data: bytes) -> list[bytes]:
+        if len(data) % 32:
+            raise rpc_mod.RpcError("malformed roots request")
+        out = []
+        for i in range(0, min(len(data), 32 * MAX_REQUEST_BLOCKS), 32):
+            blk = self.chain.store.get_block(data[i:i + 32])
+            if blk is not None:
+                out.append(blk.serialize())
+        return out
+
+    def _serve_blobs_by_range(self, src: str, data: bytes) -> list[bytes]:
+        req = BlocksByRangeRequest.deserialize(data)
+        count = min(int(req.count), MAX_REQUEST_BLOCKS)
+        out = []
+        c = self.chain
+        for slot in range(int(req.start_slot), int(req.start_slot) + count):
+            root = c.block_root_at_slot(slot)
+            if root is None:
+                continue
+            blobs = c.store.get_blobs(root)
+            if blobs:
+                out.append(blobs)
+        return out

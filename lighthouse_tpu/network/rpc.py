@@ -1,0 +1,123 @@
+"""Req/Resp RPC layer.
+
+Rebuild of /root/reference/beacon_node/lighthouse_network/src/rpc/: typed
+request/response protocols (Status, Goodbye, BlocksByRange, BlocksByRoot,
+BlobsByRange) between peers over the in-process fabric, with a token-
+bucket rate limiter per (peer, protocol) mirroring the reference's
+rate_limiter.rs.  Payloads are SSZ bytes; responses are streamed as lists
+of SSZ chunks (the reference's response-chunk framing).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from lighthouse_tpu.ssz import core as ssz
+
+
+class RpcError(ValueError):
+    pass
+
+
+class RateLimited(RpcError):
+    pass
+
+
+# --- protocol payload containers (reference rpc/methods.rs) ----------------
+
+class StatusMessage(ssz.Container):
+    fork_digest: ssz.ByteVector(4)       # noqa: F821
+    finalized_root: ssz.Bytes32
+    finalized_epoch: ssz.uint64
+    head_root: ssz.Bytes32
+    head_slot: ssz.uint64
+
+
+class BlocksByRangeRequest(ssz.Container):
+    start_slot: ssz.uint64
+    count: ssz.uint64
+    step: ssz.uint64
+
+
+class GoodbyeReason(ssz.Container):
+    reason: ssz.uint64
+
+
+@dataclass
+class _Bucket:
+    tokens: float
+    last: float
+
+
+class RateLimiter:
+    """Token bucket per (peer, protocol) (reference rpc/rate_limiter.rs)."""
+
+    def __init__(self, capacity: float = 64, refill_per_s: float = 16,
+                 clock=time.monotonic):
+        self.capacity = capacity
+        self.refill = refill_per_s
+        self.clock = clock
+        self._buckets: dict[tuple[str, str], _Bucket] = {}
+
+    def allow(self, peer: str, protocol: str, cost: float = 1.0) -> bool:
+        now = self.clock()
+        b = self._buckets.get((peer, protocol))
+        if b is None:
+            b = self._buckets[(peer, protocol)] = _Bucket(self.capacity, now)
+        b.tokens = min(self.capacity, b.tokens + (now - b.last) * self.refill)
+        b.last = now
+        if b.tokens < cost:
+            return False
+        b.tokens -= cost
+        return True
+
+
+class RpcFabric:
+    """In-process request routing between registered RPC endpoints."""
+
+    def __init__(self):
+        self._nodes: dict[str, "RpcEndpoint"] = {}
+
+    def join(self, peer_id: str) -> "RpcEndpoint":
+        ep = RpcEndpoint(self, peer_id)
+        self._nodes[peer_id] = ep
+        return ep
+
+    def call(self, src: str, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        ep = self._nodes.get(dst)
+        if ep is None:
+            raise RpcError(f"unknown peer {dst}")
+        return ep._serve(src, protocol, data)
+
+
+class RpcEndpoint:
+    def __init__(self, fabric: RpcFabric, peer_id: str):
+        self.fabric = fabric
+        self.peer_id = peer_id
+        self.handlers: dict[str, Callable[[str, bytes], list[bytes]]] = {}
+        self.limiter = RateLimiter()
+
+    def register(self, protocol: str,
+                 handler: Callable[[str, bytes], list[bytes]]):
+        self.handlers[protocol] = handler
+
+    def request(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        return self.fabric.call(self.peer_id, dst, protocol, data)
+
+    def _serve(self, src: str, protocol: str, data: bytes) -> list[bytes]:
+        if not self.limiter.allow(src, protocol):
+            raise RateLimited(f"{src} rate-limited on {protocol}")
+        handler = self.handlers.get(protocol)
+        if handler is None:
+            raise RpcError(f"unsupported protocol {protocol}")
+        return handler(src, data)
+
+
+# protocol ids (reference rpc/protocol.rs)
+P_STATUS = "/eth2/beacon_chain/req/status/1"
+P_GOODBYE = "/eth2/beacon_chain/req/goodbye/1"
+P_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2"
+P_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2"
+P_BLOBS_BY_RANGE = "/eth2/beacon_chain/req/blob_sidecars_by_range/1"

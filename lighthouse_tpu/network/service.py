@@ -1,0 +1,47 @@
+"""Network service: one node's full networking stack.
+
+Rebuild of /root/reference/beacon_node/network/src/service.rs:160,432 —
+binds a BeaconChain to the gossip fabric, the RPC fabric, the router, the
+peer manager and the sync manager.  `NetworkService.connect` performs the
+status handshake both ways (the reference's dial + Status exchange).
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.network.gossip import GossipHub
+from lighthouse_tpu.network.peer_manager import PeerManager
+from lighthouse_tpu.network.router import Router
+from lighthouse_tpu.network.rpc import RpcFabric
+from lighthouse_tpu.network.sync import SyncManager
+
+
+class NetworkFabric:
+    """Shared in-process swarm: gossip + rpc hubs (the simulator's
+    localhost network, /root/reference/testing/simulator/src/local_network.rs)."""
+
+    def __init__(self):
+        self.gossip = GossipHub()
+        self.rpc = RpcFabric()
+
+
+class NetworkService:
+    def __init__(self, chain, fabric: NetworkFabric, peer_id: str):
+        self.chain = chain
+        self.fabric = fabric
+        self.peer_id = peer_id
+        self.peer_manager = PeerManager()
+        self.gossip_ep = fabric.gossip.join(peer_id)
+        self.rpc_ep = fabric.rpc.join(peer_id)
+        self.router = Router(
+            chain, self.gossip_ep, self.rpc_ep, self.peer_manager,
+            on_unknown_parent=self._on_unknown_parent)
+        self.sync = SyncManager(chain, self.rpc_ep, self.router,
+                                self.peer_manager)
+
+    def connect(self, other: "NetworkService"):
+        """Mutual status handshake (dial)."""
+        self.sync.status_handshake(other.peer_id)
+        other.sync.status_handshake(self.peer_id)
+
+    def _on_unknown_parent(self, peer: str, block):
+        self.sync.lookup_unknown_parent(peer, block)

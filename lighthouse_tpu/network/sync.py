@@ -1,0 +1,152 @@
+"""Sync manager: range sync + parent-lookup sync.
+
+Rebuild of /root/reference/beacon_node/network/src/sync/ (manager.rs:1-34,
+range_sync/, block_lookups/): STATUS handshakes pick a peer ahead of us,
+BlocksByRange batches walk from our finalized slot to the peer's head, and
+unknown-parent blocks trigger a backwards lookup chase capped in depth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    P_BLOCKS_BY_RANGE,
+    P_BLOCKS_BY_ROOT,
+    P_STATUS,
+    RpcError,
+    StatusMessage,
+)
+
+BATCH_SIZE = 32
+MAX_LOOKUP_DEPTH = 16
+
+
+@dataclass
+class PeerStatus:
+    head_slot: int
+    head_root: bytes
+    finalized_epoch: int
+
+
+class SyncManager:
+    def __init__(self, chain, rpc_ep, router, peer_manager):
+        self.chain = chain
+        self.rpc = rpc_ep
+        self.router = router
+        self.peers = peer_manager
+        self.statuses: dict[str, PeerStatus] = {}
+
+    # -- status -------------------------------------------------------------
+
+    def status_handshake(self, peer: str) -> PeerStatus | None:
+        try:
+            chunks = self.rpc.request(
+                peer, P_STATUS, self.router.local_status().serialize())
+        except RpcError:
+            self.peers.report(peer, "mid")
+            return None
+        if not chunks:
+            return None
+        remote = StatusMessage.deserialize(chunks[0])
+        st = PeerStatus(
+            head_slot=int(remote.head_slot),
+            head_root=bytes(remote.head_root),
+            finalized_epoch=int(remote.finalized_epoch),
+        )
+        self.statuses[peer] = st
+        self.peers.report(peer, "useful_response")  # register as connected
+        return st
+
+    # -- range sync ----------------------------------------------------------
+
+    def sync_to_peer(self, peer: str) -> int:
+        """Range-sync toward `peer`'s head; returns blocks imported."""
+        status = self.statuses.get(peer) or self.status_handshake(peer)
+        if status is None:
+            return 0
+        imported = 0
+        local_head = int(self.chain.head_state.slot)
+        slot = local_head + 1
+        while slot <= status.head_slot:
+            req = BlocksByRangeRequest(
+                start_slot=slot, count=BATCH_SIZE, step=1)
+            try:
+                chunks = self.rpc.request(
+                    peer, P_BLOCKS_BY_RANGE, req.serialize())
+            except RpcError:
+                self.peers.report(peer, "mid")
+                break
+            if not chunks:
+                break
+            for raw in chunks:
+                block = self._decode_block(raw)
+                if block is None:
+                    self.peers.report(peer, "high")
+                    return imported
+                try:
+                    root = self.chain.process_block(block, source="rpc")
+                    if root is not None:
+                        imported += 1
+                except Exception:
+                    self.peers.report(peer, "mid")
+                    return imported
+            self.peers.report(peer, "useful_response")
+            slot += BATCH_SIZE
+        return imported
+
+    def sync(self) -> int:
+        """Pick the best peer ahead of us and range-sync to it
+        (manager.rs's RangeSync target selection)."""
+        local = int(self.chain.head_state.slot)
+        best, best_slot = None, local
+        for peer in self.peers.good_peers():
+            st = self.statuses.get(peer) or self.status_handshake(peer)
+            if st is not None and st.head_slot > best_slot:
+                best, best_slot = peer, st.head_slot
+        if best is None:
+            return 0
+        return self.sync_to_peer(best)
+
+    # -- lookup sync ----------------------------------------------------------
+
+    def lookup_unknown_parent(self, peer: str, block) -> int:
+        """Chase missing ancestors by root, then import the chain segment
+        (block_lookups/)."""
+        chain_segment = [block]
+        parent = bytes(block.message.parent_root)
+        for _ in range(MAX_LOOKUP_DEPTH):
+            if parent in self.chain.fork_choice.proto:
+                break
+            try:
+                chunks = self.rpc.request(peer, P_BLOCKS_BY_ROOT, parent)
+            except RpcError:
+                return 0
+            if not chunks:
+                return 0
+            got = self._decode_block(chunks[0])
+            if got is None or got.message.hash_tree_root() != parent:
+                self.peers.report(peer, "high")
+                return 0
+            chain_segment.append(got)
+            parent = bytes(got.message.parent_root)
+        else:
+            return 0  # exceeded depth without finding a known ancestor
+        imported = 0
+        for blk in reversed(chain_segment):
+            try:
+                if self.chain.process_block(blk, source="rpc") is not None:
+                    imported += 1
+            except Exception:
+                break
+        return imported
+
+    def _decode_block(self, raw: bytes):
+        c = self.chain
+        for f in reversed(c.t.forks):
+            try:
+                return c.t.signed_beacon_block_class(f).deserialize(raw)
+            except Exception:
+                continue
+        return None

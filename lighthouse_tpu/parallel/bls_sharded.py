@@ -63,7 +63,7 @@ def _sharded_miller_reduce(mesh, per_dev: int):
 
 def multi_pairing_sharded(pairs, mesh) -> "object":
     """Device multi-pairing over a mesh: prod Miller(P_i, Q_i), host final exp."""
-    from lighthouse_tpu.crypto.bls.fields import final_exponentiation
+    from lighthouse_tpu.crypto.bls.fields import final_exponentiation_fast
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_dev = mesh.devices.size
@@ -82,7 +82,7 @@ def multi_pairing_sharded(pairs, mesh) -> "object":
     args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
     f = fn(*args, jax.device_put(jnp.asarray(mask), shm))
     f_host = dev.fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
-    return final_exponentiation(f_host)
+    return final_exponentiation_fast(f_host)
 
 
 def verify_signature_sets_sharded(

@@ -105,6 +105,11 @@ def main() -> int:
     t0 = time.perf_counter()
     import jax
 
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
     # belt-and-braces: even if a sitecustomize hook forced another
     # platform into the config at interpreter start, pin CPU before any
     # backend initializes (same pattern as tests/conftest.py)

@@ -1,0 +1,13 @@
+"""Operation + aggregation pools (reference beacon_node/operation_pool,
+beacon_chain/naive_aggregation_pool)."""
+
+from lighthouse_tpu.pool.max_cover import CoverItem, maximum_cover
+from lighthouse_tpu.pool.naive_aggregation import NaiveAggregationPool
+from lighthouse_tpu.pool.operation_pool import OperationPool
+
+__all__ = [
+    "CoverItem",
+    "maximum_cover",
+    "NaiveAggregationPool",
+    "OperationPool",
+]

@@ -1,0 +1,40 @@
+"""Greedy weighted maximum-coverage packing.
+
+Rebuild of /root/reference/beacon_node/operation_pool/src/max_cover.rs:
+pick up to `limit` items maximizing total covered weight, rescoring the
+remaining candidates after every pick (the classic (1 - 1/e)
+approximation).  Items expose their coverage as a dict of
+element -> weight; chosen items report only their FRESH coverage.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Generic, Iterable, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass
+class CoverItem(Generic[T]):
+    item: T
+    covering: dict          # element -> weight (mutated during packing)
+
+
+def maximum_cover(items: Iterable[CoverItem], limit: int) -> list[CoverItem]:
+    """Greedy max-cover; each returned CoverItem.covering holds exactly
+    the elements it was credited with (its marginal contribution)."""
+    candidates = [CoverItem(c.item, dict(c.covering)) for c in items]
+    chosen: list[CoverItem] = []
+    while candidates and len(chosen) < limit:
+        best_i = max(range(len(candidates)),
+                     key=lambda i: sum(candidates[i].covering.values()))
+        best = candidates.pop(best_i)
+        if not best.covering or sum(best.covering.values()) == 0:
+            break
+        for c in candidates:
+            for k in best.covering:
+                c.covering.pop(k, None)
+        chosen.append(best)
+        candidates = [c for c in candidates if c.covering]
+    return chosen

@@ -1,0 +1,85 @@
+"""Naive attestation aggregation pool.
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/
+naive_aggregation_pool.rs: gossip-verified unaggregated attestations are
+greedily OR-ed into one aggregate per AttestationData root, per slot.
+Aggregators read their committee's current best aggregate from here; the
+operation pool ingests the same aggregates for block packing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu.crypto import bls
+
+
+def _aggregate(sigs):
+    """Aggregate, tolerating fake-crypto signatures (arbitrary bytes that
+    don't decompress): any one of them stands in — the fake backend
+    verifies anything well-formed anyway."""
+    if len(sigs) == 1:
+        return sigs[0]
+    try:
+        return bls.Signature.aggregate(sigs)
+    except (ValueError, bls.BlsError):
+        return sigs[0]
+
+
+class NaiveAggregationPool:
+    def __init__(self, retained_slots: int = 32):
+        self.retained_slots = retained_slots
+        # slot -> data_root -> (data, bits np.bool_, [signatures])
+        self._slots: dict[int, dict[bytes, tuple]] = {}
+
+    def insert(self, attestation) -> bool:
+        """Fold one (single-bit or partial) attestation in.  Returns True
+        if it contributed at least one new bit."""
+        data = attestation.data
+        slot = int(data.slot)
+        data_root = data.hash_tree_root()
+        per_slot = self._slots.setdefault(slot, {})
+        bits = np.asarray(attestation.aggregation_bits, dtype=bool)
+        entry = per_slot.get(data_root)
+        if entry is None:
+            per_slot[data_root] = (
+                data, bits.copy(),
+                [bls.Signature(bytes(attestation.signature))])
+            self._prune()
+            return True
+        _, agg_bits, sigs = entry
+        fresh = bits & ~agg_bits
+        if not fresh.any():
+            return False
+        if (bits & agg_bits).any():
+            # overlapping contribution can't be naively aggregated
+            return False
+        agg_bits |= bits
+        sigs.append(bls.Signature(bytes(attestation.signature)))
+        return True
+
+    def get_aggregate(self, data) -> "object | None":
+        """Best aggregate for this AttestationData (or None)."""
+        entry = self._slots.get(int(data.slot), {}).get(data.hash_tree_root())
+        if entry is None:
+            return None
+        data, bits, sigs = entry
+        return data, bits.copy(), _aggregate(sigs)
+
+    def iter_aggregates(self):
+        for per_slot in self._slots.values():
+            for data, bits, sigs in per_slot.values():
+                yield data, bits.copy(), _aggregate(sigs)
+
+    def _prune(self):
+        if len(self._slots) <= self.retained_slots:
+            return
+        for slot in sorted(self._slots)[: len(self._slots) - self.retained_slots]:
+            del self._slots[slot]
+
+    def prune_below(self, slot: int):
+        for s in [s for s in self._slots if s < slot]:
+            del self._slots[s]
+
+    def __len__(self):
+        return sum(len(v) for v in self._slots.values())

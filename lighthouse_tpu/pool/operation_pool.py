@@ -1,0 +1,224 @@
+"""Operation pool: attestations (max-cover packed), slashings, exits,
+BLS-to-execution changes.
+
+Rebuild of /root/reference/beacon_node/operation_pool (attestation_storage
++ max_cover + persistence): gossip-verified operations accumulate here and
+block production packs them — attestations by greedy weighted max-cover
+against the target state's participation flags, other ops by re-checking
+validity against the target state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
+from lighthouse_tpu.pool.max_cover import CoverItem, maximum_cover
+from lighthouse_tpu.state_transition.misc import get_beacon_committee
+
+_TIMELY_TARGET_BIT = 1 << 1  # TIMELY_TARGET_FLAG_INDEX
+
+
+@dataclass
+class _StoredAttestation:
+    data: object
+    bits: np.ndarray
+    signature: object  # bls.Signature
+
+
+@dataclass
+class OperationPool:
+    """All pools keyed for dedup; pruning is against a finalized state."""
+
+    attestations: dict = field(default_factory=dict)   # data_root -> [stored]
+    exits: dict = field(default_factory=dict)          # vindex -> signed exit
+    proposer_slashings: dict = field(default_factory=dict)  # vindex -> op
+    attester_slashings: list = field(default_factory=list)
+    bls_changes: dict = field(default_factory=dict)    # vindex -> signed change
+    max_variants_per_data: int = 8
+
+    # -- attestations -------------------------------------------------------
+
+    def insert_attestation(self, data, bits: np.ndarray, signature) -> bool:
+        """Insert an aggregate (from the naive pool or gossip aggregates).
+        Keeps up to `max_variants_per_data` non-subsumed bitsets per data."""
+        root = data.hash_tree_root()
+        bits = np.asarray(bits, dtype=bool)
+        variants = self.attestations.setdefault(root, [])
+        for v in variants:
+            if (bits & ~v.bits).sum() == 0:
+                return False  # subsumed by an existing aggregate
+        variants[:] = [v for v in variants if (v.bits & ~bits).any()]
+        variants.append(_StoredAttestation(
+            data, bits, signature if isinstance(signature, bls.Signature)
+            else bls.Signature(bytes(signature))))
+        if len(variants) > self.max_variants_per_data:
+            variants.sort(key=lambda v: int(v.bits.sum()), reverse=True)
+            del variants[self.max_variants_per_data:]
+        return True
+
+    def get_attestations(self, state, spec, shuffle_for_epoch, limit=None,
+                         t=None):
+        """Max-cover pack attestations for a block on `state`
+        (/root/reference/beacon_node/operation_pool/src/attestation.rs).
+
+        shuffle_for_epoch: epoch -> full committee shuffle (the chain's
+        shuffling cache hook).  Weight = effective balance of attesters
+        whose TIMELY_TARGET flag is still unset for the matching epoch.
+        """
+        limit = limit if limit is not None else spec.preset.max_attestations
+        slot = int(state.slot)
+        cur_epoch = spec.compute_epoch_at_slot(slot)
+        prev_epoch = max(cur_epoch - 1, 0)
+        items = []
+        eb = np.asarray(state.validators.effective_balance, np.int64)
+        cur_part = np.asarray(state.current_epoch_participation, np.uint8)
+        prev_part = np.asarray(state.previous_epoch_participation, np.uint8)
+        for variants in self.attestations.values():
+            for stored in variants:
+                att_slot = int(stored.data.slot)
+                target_epoch = int(stored.data.target.epoch)
+                if target_epoch not in (cur_epoch, prev_epoch):
+                    continue
+                if att_slot + spec.min_attestation_inclusion_delay > slot:
+                    continue
+                part = cur_part if target_epoch == cur_epoch else prev_part
+                try:
+                    shuffle = shuffle_for_epoch(target_epoch)
+                    committee = get_beacon_committee(
+                        state, spec, att_slot, int(stored.data.index), shuffle)
+                except Exception:
+                    continue
+                if committee.shape[0] != stored.bits.shape[0]:
+                    continue
+                attesters = committee[stored.bits]
+                in_range = attesters[attesters < part.shape[0]]
+                fresh = in_range[(part[in_range] & _TIMELY_TARGET_BIT) == 0]
+                if fresh.size == 0:
+                    continue
+                items.append(CoverItem(
+                    stored, {int(v): int(eb[v]) for v in fresh}))
+        if t is None:
+            raise TypeError("pass t= (the preset type namespace)")
+        chosen = maximum_cover(items, limit)
+        out = []
+        for c in chosen:
+            s = c.item
+            att = t.Attestation(
+                aggregation_bits=[bool(b) for b in s.bits],
+                data=s.data,
+                signature=s.signature.to_bytes())
+            out.append(att)
+        return out
+
+    # -- other operations ---------------------------------------------------
+
+    def insert_voluntary_exit(self, signed_exit) -> bool:
+        idx = int(signed_exit.message.validator_index)
+        if idx in self.exits:
+            return False
+        self.exits[idx] = signed_exit
+        return True
+
+    def insert_proposer_slashing(self, slashing) -> bool:
+        idx = int(slashing.signed_header_1.message.proposer_index)
+        if idx in self.proposer_slashings:
+            return False
+        self.proposer_slashings[idx] = slashing
+        return True
+
+    def insert_attester_slashing(self, slashing) -> bool:
+        a1 = set(int(i) for i in slashing.attestation_1.attesting_indices)
+        a2 = set(int(i) for i in slashing.attestation_2.attesting_indices)
+        new = a1 & a2
+        for existing in self.attester_slashings:
+            e1 = set(int(i) for i in existing.attestation_1.attesting_indices)
+            e2 = set(int(i) for i in existing.attestation_2.attesting_indices)
+            if new <= (e1 & e2):
+                return False
+        self.attester_slashings.append(slashing)
+        return True
+
+    def insert_bls_to_execution_change(self, signed_change) -> bool:
+        idx = int(signed_change.message.validator_index)
+        if idx in self.bls_changes:
+            return False
+        self.bls_changes[idx] = signed_change
+        return True
+
+    def get_voluntary_exits(self, state, spec, limit=None):
+        limit = limit if limit is not None else spec.preset.max_voluntary_exits
+        epoch = spec.compute_epoch_at_slot(int(state.slot))
+        exit_epochs = np.asarray(state.validators.exit_epoch, np.uint64)
+        far = FAR_FUTURE_EPOCH
+        out = []
+        for idx, ex in self.exits.items():
+            if len(out) >= limit:
+                break
+            if idx < exit_epochs.shape[0] and int(exit_epochs[idx]) == far \
+                    and int(ex.message.epoch) <= epoch:
+                out.append(ex)
+        return out
+
+    def get_slashings(self, state, spec):
+        slashed = np.asarray(state.validators.slashed, bool)
+        prop = []
+        for idx, op in self.proposer_slashings.items():
+            if len(prop) >= spec.preset.max_proposer_slashings:
+                break
+            if idx < slashed.shape[0] and not slashed[idx]:
+                prop.append(op)
+        att = []
+        for op in self.attester_slashings:
+            if len(att) >= spec.preset.max_attester_slashings:
+                break
+            a1 = set(int(i) for i in op.attestation_1.attesting_indices)
+            a2 = set(int(i) for i in op.attestation_2.attesting_indices)
+            live = [i for i in (a1 & a2)
+                    if i < slashed.shape[0] and not slashed[i]]
+            if live:
+                att.append(op)
+        return prop, att
+
+    def get_bls_to_execution_changes(self, state, spec, limit=None):
+        limit = (limit if limit is not None
+                 else spec.preset.max_bls_to_execution_changes)
+        wc = state.validators.withdrawal_credentials
+        out = []
+        for idx, change in self.bls_changes.items():
+            if len(out) >= limit:
+                break
+            if idx < len(state.validators) and wc[idx][0] == 0x00:
+                out.append(change)
+        return out
+
+    # -- maintenance --------------------------------------------------------
+
+    def prune(self, head_state, spec):
+        """Drop operations that can never be included again."""
+        cur_epoch = spec.compute_epoch_at_slot(int(head_state.slot))
+        keep: dict = {}
+        for root, variants in self.attestations.items():
+            if variants and int(variants[0].data.target.epoch) + 1 >= cur_epoch:
+                keep[root] = variants
+        self.attestations = keep
+        exit_epochs = np.asarray(head_state.validators.exit_epoch, np.uint64)
+        far = FAR_FUTURE_EPOCH
+        self.exits = {i: e for i, e in self.exits.items()
+                      if i < exit_epochs.shape[0]
+                      and int(exit_epochs[i]) == far}
+        slashed = np.asarray(head_state.validators.slashed, bool)
+        self.proposer_slashings = {
+            i: s for i, s in self.proposer_slashings.items()
+            if i < slashed.shape[0] and not slashed[i]}
+        self.attester_slashings = [
+            s for s in self.attester_slashings
+            if any(i < slashed.shape[0] and not slashed[i]
+                   for i in (set(int(x) for x in s.attestation_1.attesting_indices)
+                             & set(int(x) for x in s.attestation_2.attesting_indices)))]
+
+    def num_attestations(self) -> int:
+        return sum(len(v) for v in self.attestations.values())

@@ -34,11 +34,17 @@ def process_slot(state, spec: T.ChainSpec) -> bytes:
 
 def per_slot_processing(state, spec: T.ChainSpec) -> None:
     """Advance the state by exactly one slot (epoch processing included when
-    crossing an epoch boundary)."""
+    crossing an epoch boundary, fork upgrades at activation epochs)."""
     process_slot(state, spec)
     if (int(state.slot) + 1) % spec.preset.slots_per_epoch == 0:
         process_epoch(state, spec)
     state.slot = int(state.slot) + 1
+    if int(state.slot) % spec.preset.slots_per_epoch == 0:
+        from lighthouse_tpu.state_transition.upgrades import (
+            upgrade_state_if_due,
+        )
+
+        upgrade_state_if_due(state, spec)
 
 
 def state_advance(state, spec: T.ChainSpec, target_slot: int) -> None:

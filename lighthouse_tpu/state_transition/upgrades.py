@@ -1,0 +1,143 @@
+"""Fork-boundary state upgrades.
+
+Rebuild of /root/reference/consensus/state_processing/src/upgrade/ — when
+per-slot processing crosses into a fork's activation epoch, the state is
+converted in place to the next fork's container: the instance's class is
+swapped to the target fork's state class and the new fields are populated
+per the consensus specs' upgrade functions.  In-place mutation (rather
+than returning a new object) keeps every state_advance call site working
+unchanged — callers hold the same object across the boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.types.containers import Fork
+
+_FORK_ORDER = ("phase0", "altair", "bellatrix", "capella", "deneb", "electra")
+
+
+def _fork_of_state(state, spec: T.ChainSpec) -> str:
+    cur = bytes(state.fork.current_version)
+    for name in _FORK_ORDER:
+        if spec.fork_version(name) == cur:
+            return name
+    raise ValueError(f"unknown fork version {cur.hex()}")
+
+
+def _set_fork(state, spec, name: str, epoch: int):
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.fork_version(name),
+        epoch=epoch,
+    )
+
+
+def _swap_class(state, t, fork: str):
+    state.__class__ = t.beacon_state_class(fork)
+
+
+def upgrade_to_altair(state, spec: T.ChainSpec, t) -> None:
+    """phase0 -> altair: participation from pending attestations,
+    inactivity scores, sync committees (upgrade/altair.rs)."""
+    from lighthouse_tpu.state_transition import misc
+    from lighthouse_tpu.state_transition.block_processing import (
+        get_attestation_participation_flag_indices,
+        get_attesting_indices,
+    )
+
+    n = len(state.validators)
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    prev_atts = list(state.previous_epoch_attestations)
+
+    # drop phase0-only fields, add altair's
+    del state.previous_epoch_attestations
+    del state.current_epoch_attestations
+    _swap_class(state, t, "altair")
+    state.previous_epoch_participation = np.zeros(n, np.uint8)
+    state.current_epoch_participation = np.zeros(n, np.uint8)
+    state.inactivity_scores = np.zeros(n, np.uint64)
+    _set_fork(state, spec, "altair", epoch)
+
+    # translate_participation: replay pending attestations into flags
+    for pending in prev_atts:
+        data = pending.data
+        try:
+            indices = get_attesting_indices(
+                state, spec, pending, None)
+            flags = get_attestation_participation_flag_indices(
+                state, spec, data, int(pending.inclusion_delay))
+        except Exception:
+            continue
+        part = state.previous_epoch_participation
+        for f in flags:
+            part[indices] |= np.uint8(1 << f)
+
+    committee = misc.get_next_sync_committee(state, spec, t)
+    state.current_sync_committee = committee
+    state.next_sync_committee = misc.get_next_sync_committee(state, spec, t)
+
+
+def upgrade_to_bellatrix(state, spec: T.ChainSpec, t) -> None:
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    _swap_class(state, t, "bellatrix")
+    state.latest_execution_payload_header = t.ExecutionPayloadHeaderBellatrix()
+    _set_fork(state, spec, "bellatrix", epoch)
+
+
+def _copy_header_fields(old, new_cls, **extra):
+    kw = {}
+    for fname in new_cls.fields:
+        if hasattr(old, fname):
+            kw[fname] = getattr(old, fname)
+    kw.update(extra)
+    return new_cls(**kw)
+
+
+def upgrade_to_capella(state, spec: T.ChainSpec, t) -> None:
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    old_header = state.latest_execution_payload_header
+    _swap_class(state, t, "capella")
+    state.latest_execution_payload_header = _copy_header_fields(
+        old_header, t.ExecutionPayloadHeaderCapella)
+    state.next_withdrawal_index = 0
+    state.next_withdrawal_validator_index = 0
+    state.historical_summaries = []
+    _set_fork(state, spec, "capella", epoch)
+
+
+def upgrade_to_deneb(state, spec: T.ChainSpec, t) -> None:
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    old_header = state.latest_execution_payload_header
+    _swap_class(state, t, "deneb")
+    state.latest_execution_payload_header = _copy_header_fields(
+        old_header, t.ExecutionPayloadHeaderDeneb)
+    _set_fork(state, spec, "deneb", epoch)
+
+
+_UPGRADES = {
+    "altair": upgrade_to_altair,
+    "bellatrix": upgrade_to_bellatrix,
+    "capella": upgrade_to_capella,
+    "deneb": upgrade_to_deneb,
+}
+
+
+def upgrade_state_if_due(state, spec: T.ChainSpec) -> None:
+    """Run any fork upgrades activating at the state's current epoch.
+    Called at epoch starts by per_slot_processing (after the slot bump)."""
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    target = spec.fork_at_epoch(epoch)
+    current = _fork_of_state(state, spec)
+    ti = _FORK_ORDER.index(target)
+    ci = _FORK_ORDER.index(current)
+    if ci >= ti:
+        return
+    t = T.make_types(spec.preset)
+    for name in _FORK_ORDER[ci + 1: ti + 1]:
+        fn = _UPGRADES.get(name)
+        if fn is None:
+            raise NotImplementedError(f"upgrade to {name} not implemented")
+        fn(state, spec, t)

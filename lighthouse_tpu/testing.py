@@ -65,11 +65,13 @@ class Harness:
 
     # --- block production --------------------------------------------------
 
-    def produce_block(self, slot: int | None = None, attestations=()):
+    def produce_block(self, slot: int | None = None, attestations=(),
+                      blob_commitments=()):
         """Produce a fully valid signed block at `slot` (default: next slot).
 
         Advances self.state to the block's slot as a side effect of
         production (on a copy), then applies the block to self.state.
+        `blob_commitments` populates body.blob_kzg_commitments (deneb+).
         """
         spec, t = self.spec, self.t
         target_slot = int(self.state.slot) + 1 if slot is None else slot
@@ -95,6 +97,8 @@ class Harness:
             body_kw["sync_aggregate"] = self._sync_aggregate(pre, target_slot)
         if self.fork in ("bellatrix", "capella", "deneb"):
             body_kw["execution_payload"] = self._execution_payload(pre, target_slot)
+        if blob_commitments:
+            body_kw["blob_kzg_commitments"] = [bytes(c) for c in blob_commitments]
 
         body = t.beacon_block_body_class(self.fork)(**body_kw)
         parent_root = self._parent_root(pre)
@@ -180,6 +184,40 @@ class Harness:
         return cls(**kw)
 
     # --- attestations -------------------------------------------------------
+
+    def make_blob_sidecars(self, signed_block, blobs, proofs):
+        """BlobSidecars for a produced block (header reuses the block
+        signature: header root == block root by construction)."""
+        from lighthouse_tpu.chain.blob_verification import (
+            compute_kzg_inclusion_proof,
+        )
+        from lighthouse_tpu.types.containers import (
+            BeaconBlockHeader,
+            SignedBeaconBlockHeader,
+        )
+
+        block = signed_block.message
+        body = block.body
+        header = SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(
+                slot=int(block.slot),
+                proposer_index=int(block.proposer_index),
+                parent_root=bytes(block.parent_root),
+                state_root=bytes(block.state_root),
+                body_root=body.hash_tree_root()),
+            signature=bytes(signed_block.signature))
+        out = []
+        for i, (blob, proof) in enumerate(zip(blobs, proofs)):
+            out.append(self.t.BlobSidecar(
+                index=i,
+                blob=blob,
+                kzg_commitment=bytes(body.blob_kzg_commitments[i]),
+                kzg_proof=proof,
+                signed_block_header=header,
+                kzg_commitment_inclusion_proof=compute_kzg_inclusion_proof(
+                    body, i, self.spec),
+            ))
+        return out
 
     def attest(self, slot: int | None = None, committee_index: int = 0):
         """All committee members attest to the current head at `slot`."""

@@ -60,6 +60,7 @@ class Preset:
     # blobs (deneb)
     max_blob_commitments_per_block: int
     field_elements_per_blob: int
+    max_blobs_per_block: int = 6
     # electra
     max_attester_slashings_electra: int = 1
     max_attestations_electra: int = 8

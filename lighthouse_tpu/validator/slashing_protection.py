@@ -1,0 +1,194 @@
+"""Slashing protection database (EIP-3076).
+
+Rebuild of /root/reference/validator_client/slashing_protection: an
+SQLite-backed record of every signed block and attestation per validator,
+enforcing the minimal slashing conditions:
+
+- blocks: never sign two different roots at the same slot, never sign
+  below the recorded minimum slot;
+- attestations: no double votes (same target, different data), no
+  surround votes in either direction (source/target interval nesting).
+
+Interchange (EIP-3076 JSON) import/export for migration between clients.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+
+
+class SlashingProtectionError(Exception):
+    """Signing refused: it would violate a slashing condition."""
+
+
+class SlashingProtectionDB:
+    def __init__(self, path: str = ":memory:",
+                 genesis_validators_root: bytes = b"\x00" * 32):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self.genesis_validators_root = genesis_validators_root
+        with self._conn:
+            self._conn.executescript("""
+                CREATE TABLE IF NOT EXISTS signed_blocks (
+                    pubkey BLOB NOT NULL,
+                    slot INTEGER NOT NULL,
+                    signing_root BLOB,
+                    UNIQUE (pubkey, slot)
+                );
+                CREATE TABLE IF NOT EXISTS signed_attestations (
+                    pubkey BLOB NOT NULL,
+                    source_epoch INTEGER NOT NULL,
+                    target_epoch INTEGER NOT NULL,
+                    signing_root BLOB,
+                    UNIQUE (pubkey, target_epoch)
+                );
+            """)
+
+    # -- blocks --------------------------------------------------------------
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """Permit + record, or raise (validator_store.rs:552-582 gate)."""
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "SELECT signing_root FROM signed_blocks "
+                "WHERE pubkey = ? AND slot = ?", (pubkey, slot))
+            row = cur.fetchone()
+            if row is not None:
+                if row[0] == signing_root:
+                    return  # same proposal re-signed: benign
+                raise SlashingProtectionError(
+                    f"double block proposal at slot {slot}")
+            cur = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE pubkey = ?",
+                (pubkey,))
+            max_slot = cur.fetchone()[0]
+            if max_slot is not None and slot <= max_slot:
+                raise SlashingProtectionError(
+                    f"block slot {slot} not above recorded maximum {max_slot}")
+            self._conn.execute(
+                "INSERT INTO signed_blocks VALUES (?, ?, ?)",
+                (pubkey, slot, signing_root))
+
+    # -- attestations ---------------------------------------------------------
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int,
+        signing_root: bytes
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source after target")
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "SELECT signing_root FROM signed_attestations "
+                "WHERE pubkey = ? AND target_epoch = ?", (pubkey, target_epoch))
+            row = cur.fetchone()
+            if row is not None:
+                if row[0] == signing_root:
+                    return
+                raise SlashingProtectionError(
+                    f"double vote at target epoch {target_epoch}")
+            # surrounding: an existing att with source < our source and
+            # target > our target (we would be surrounded), or source >
+            # our source and target < our target (we would surround)
+            cur = self._conn.execute(
+                "SELECT 1 FROM signed_attestations WHERE pubkey = ? AND "
+                "source_epoch < ? AND target_epoch > ?",
+                (pubkey, source_epoch, target_epoch))
+            if cur.fetchone():
+                raise SlashingProtectionError("attestation would be surrounded")
+            cur = self._conn.execute(
+                "SELECT 1 FROM signed_attestations WHERE pubkey = ? AND "
+                "source_epoch > ? AND target_epoch < ?",
+                (pubkey, source_epoch, target_epoch))
+            if cur.fetchone():
+                raise SlashingProtectionError("attestation would surround")
+            # monotonic lower bounds (EIP-3076 minimal conditions)
+            cur = self._conn.execute(
+                "SELECT MAX(source_epoch), MAX(target_epoch) "
+                "FROM signed_attestations WHERE pubkey = ?", (pubkey,))
+            max_src, max_tgt = cur.fetchone()
+            if max_src is not None and source_epoch < max_src:
+                raise SlashingProtectionError(
+                    f"source {source_epoch} below recorded maximum {max_src}")
+            if max_tgt is not None and target_epoch <= max_tgt:
+                raise SlashingProtectionError(
+                    f"target {target_epoch} not above maximum {max_tgt}")
+            self._conn.execute(
+                "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+                (pubkey, source_epoch, target_epoch, signing_root))
+
+    # -- EIP-3076 interchange -------------------------------------------------
+
+    def export_interchange(self) -> dict:
+        data = []
+        with self._lock:
+            pubkeys = {r[0] for r in self._conn.execute(
+                "SELECT DISTINCT pubkey FROM signed_blocks UNION "
+                "SELECT DISTINCT pubkey FROM signed_attestations")}
+            for pk in sorted(pubkeys):
+                blocks = [
+                    {"slot": str(slot),
+                     "signing_root": "0x" + (root or b"").hex()}
+                    for slot, root in self._conn.execute(
+                        "SELECT slot, signing_root FROM signed_blocks "
+                        "WHERE pubkey = ? ORDER BY slot", (pk,))]
+                atts = [
+                    {"source_epoch": str(s), "target_epoch": str(t),
+                     "signing_root": "0x" + (root or b"").hex()}
+                    for s, t, root in self._conn.execute(
+                        "SELECT source_epoch, target_epoch, signing_root "
+                        "FROM signed_attestations WHERE pubkey = ? "
+                        "ORDER BY target_epoch", (pk,))]
+                data.append({
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                })
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root":
+                    "0x" + self.genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: dict) -> None:
+        meta = interchange.get("metadata", {})
+        gvr = bytes.fromhex(
+            meta.get("genesis_validators_root", "0x").removeprefix("0x"))
+        if gvr and gvr != self.genesis_validators_root:
+            raise SlashingProtectionError(
+                "interchange genesis_validators_root mismatch")
+        with self._lock, self._conn:
+            for record in interchange.get("data", []):
+                pk = bytes.fromhex(record["pubkey"].removeprefix("0x"))
+                for b in record.get("signed_blocks", []):
+                    root = bytes.fromhex(
+                        b.get("signing_root", "0x").removeprefix("0x"))
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO signed_blocks VALUES (?, ?, ?)",
+                        (pk, int(b["slot"]), root))
+                for a in record.get("signed_attestations", []):
+                    root = bytes.fromhex(
+                        a.get("signing_root", "0x").removeprefix("0x"))
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO signed_attestations "
+                        "VALUES (?, ?, ?, ?)",
+                        (pk, int(a["source_epoch"]), int(a["target_epoch"]),
+                         root))
+
+    def export_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.export_interchange(), f, indent=2)
+
+    def import_json(self, path: str) -> None:
+        with open(path) as f:
+            self.import_interchange(json.load(f))
+
+    def close(self):
+        self._conn.close()

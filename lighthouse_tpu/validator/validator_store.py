@@ -1,0 +1,120 @@
+"""ValidatorStore: every signing operation gated by slashing protection.
+
+Rebuild of /root/reference/validator_client/src/validator_store.rs
+(:552-582 block gate, :636-661 attestation gate) + signing_method.rs's
+LocalKeystore path and initialized_validators.rs's keystore lifecycle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import misc
+from lighthouse_tpu.validator.slashing_protection import (
+    SlashingProtectionDB,
+    SlashingProtectionError,
+)
+
+
+@dataclass
+class InitializedValidator:
+    secret_key: bls.SecretKey
+    pubkey: bytes
+    index: int | None = None
+    enabled: bool = True
+
+
+class ValidatorStore:
+    def __init__(self, spec, genesis_validators_root: bytes,
+                 slashing_db: SlashingProtectionDB | None = None):
+        self.spec = spec
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_db = slashing_db or SlashingProtectionDB(
+            genesis_validators_root=genesis_validators_root)
+        self.validators: dict[bytes, InitializedValidator] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def add_validator(self, secret_key: bls.SecretKey,
+                      index: int | None = None) -> bytes:
+        pk = secret_key.public_key().to_bytes()
+        self.validators[pk] = InitializedValidator(secret_key, pk, index)
+        return pk
+
+    def import_keystore(self, keystore: dict, password: str) -> bytes:
+        from lighthouse_tpu.crypto import keystore as ks
+
+        secret = ks.decrypt(keystore, password)
+        return self.add_validator(bls.SecretKey.from_bytes(secret))
+
+    def voting_pubkeys(self) -> list[bytes]:
+        return [pk for pk, v in self.validators.items() if v.enabled]
+
+    def _sk(self, pubkey: bytes) -> bls.SecretKey:
+        v = self.validators.get(pubkey)
+        if v is None or not v.enabled:
+            raise KeyError(f"unknown or disabled validator {pubkey.hex()[:16]}")
+        return v.secret_key
+
+    # -- signing (each call hits the slashing gate first) -------------------
+
+    def _domain(self, state_or_fork, domain_type: int, epoch: int) -> bytes:
+        fork_version = (
+            self.spec.fork_version(self.spec.fork_at_epoch(epoch)))
+        return misc.compute_domain(
+            domain_type, fork_version, self.genesis_validators_root)
+
+    def sign_block(self, pubkey: bytes, block) -> bytes:
+        slot = int(block.slot)
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        domain = self._domain(None, self.spec.domain_beacon_proposer, epoch)
+        root = misc.compute_signing_root(block.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_block_proposal(pubkey, slot, root)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_attestation(self, pubkey: bytes, data) -> bytes:
+        domain = self._domain(None, self.spec.domain_beacon_attester,
+                              int(data.target.epoch))
+        root = misc.compute_signing_root(data.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, int(data.source.epoch), int(data.target.epoch), root)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
+        from lighthouse_tpu.ssz import core as ssz
+
+        domain = self._domain(None, self.spec.domain_randao, epoch)
+        root = misc.compute_signing_root(
+            ssz.uint64.hash_tree_root(epoch), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        from lighthouse_tpu.ssz import core as ssz
+
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        domain = self._domain(None, self.spec.domain_selection_proof, epoch)
+        root = misc.compute_signing_root(
+            ssz.uint64.hash_tree_root(slot), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, message) -> bytes:
+        epoch = self.spec.compute_epoch_at_slot(
+            int(message.aggregate.data.slot))
+        domain = self._domain(
+            None, self.spec.domain_aggregate_and_proof, epoch)
+        root = misc.compute_signing_root(message.hash_tree_root(), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_voluntary_exit(self, pubkey: bytes, exit_message) -> bytes:
+        domain = self._domain(
+            None, self.spec.domain_voluntary_exit, int(exit_message.epoch))
+        root = misc.compute_signing_root(exit_message.hash_tree_root(), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+
+__all__ = [
+    "InitializedValidator",
+    "SlashingProtectionError",
+    "ValidatorStore",
+]

@@ -1,0 +1,203 @@
+"""Blob sidecar verification + data-availability checker tests."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.chain.blob_verification import (
+    compute_kzg_inclusion_proof,
+    validate_blobs,
+    verify_kzg_inclusion_proof,
+)
+from lighthouse_tpu.chain.data_availability import DataAvailabilityChecker
+from lighthouse_tpu.types.containers import (
+    BeaconBlockHeader,
+    SignedBeaconBlockHeader,
+)
+from lighthouse_tpu.crypto import kzg
+from lighthouse_tpu.crypto.bls.fields import R
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return T.ChainSpec.minimal()
+
+
+@pytest.fixture(scope="module")
+def t(spec):
+    return T.make_types(spec.preset)
+
+
+@pytest.fixture(scope="module")
+def settings():
+    return kzg.KzgSettings.dev(width=16)
+
+
+def _dev_blob(settings, seed):
+    rng = np.random.default_rng(seed)
+    return b"".join(
+        kzg.bls_field_to_bytes(int(rng.integers(0, 2**63)) % R)
+        for _ in range(settings.width))
+
+
+def _deneb_body_with_commitments(t, commitments):
+    body_cls = t.beacon_block_body_class("deneb")
+    return body_cls(blob_kzg_commitments=list(commitments))
+
+
+class TestInclusionProof:
+    def test_proof_roundtrip(self, spec, t):
+        commitments = [bytes([i]) * 48 for i in range(3)]
+        body = _deneb_body_with_commitments(t, commitments)
+        body_root = body.hash_tree_root()
+        for index in range(3):
+            proof = compute_kzg_inclusion_proof(body, index, spec)
+            header = BeaconBlockHeader(
+                slot=5, proposer_index=0, parent_root=b"\x11" * 32,
+                state_root=b"\x22" * 32, body_root=body_root)
+            sidecar = t.BlobSidecar(
+                index=index,
+                blob=b"\x00" * (spec.preset.field_elements_per_blob * 32),
+                kzg_commitment=commitments[index],
+                kzg_proof=b"\x00" * 48,
+                signed_block_header=SignedBeaconBlockHeader(
+                    message=header, signature=b"\x00" * 96),
+                kzg_commitment_inclusion_proof=proof,
+            )
+            assert verify_kzg_inclusion_proof(sidecar, spec), f"index {index}"
+
+    def test_tampered_commitment_rejected(self, spec, t):
+        commitments = [bytes([7]) * 48]
+        body = _deneb_body_with_commitments(t, commitments)
+        proof = compute_kzg_inclusion_proof(body, 0, spec)
+        header = BeaconBlockHeader(
+            slot=5, proposer_index=0, parent_root=b"\x11" * 32,
+            state_root=b"\x22" * 32, body_root=body.hash_tree_root())
+        sidecar = t.BlobSidecar(
+            index=0,
+            blob=b"\x00" * (spec.preset.field_elements_per_blob * 32),
+            kzg_commitment=bytes([8]) * 48,  # wrong commitment
+            kzg_proof=b"\x00" * 48,
+            signed_block_header=SignedBeaconBlockHeader(
+                message=header, signature=b"\x00" * 96),
+            kzg_commitment_inclusion_proof=proof,
+        )
+        assert not verify_kzg_inclusion_proof(sidecar, spec)
+
+
+def test_validate_blobs_batch(settings):
+    blobs = [_dev_blob(settings, i) for i in range(3)]
+    cs = [kzg.blob_to_kzg_commitment(b, settings) for b in blobs]
+    proofs = [kzg.compute_blob_kzg_proof(b, c, settings)
+              for b, c in zip(blobs, cs)]
+    assert validate_blobs(settings, cs, blobs, proofs)
+    assert not validate_blobs(settings, cs, blobs, list(reversed(proofs)))
+    assert validate_blobs(settings, [], [], [])
+
+
+class TestDataAvailability:
+    def _block(self, t, n_commitments, slot=3):
+        body = _deneb_body_with_commitments(
+            t, [bytes([i]) * 48 for i in range(n_commitments)])
+        block = t.beacon_block_class("deneb")(
+            slot=slot, proposer_index=0, parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32, body=body)
+        return t.signed_beacon_block_class("deneb")(
+            message=block, signature=b"\x00" * 96)
+
+    def _sidecar(self, t, spec, index):
+        return t.BlobSidecar(
+            index=index,
+            blob=b"\x00" * (spec.preset.field_elements_per_blob * 32),
+            kzg_commitment=bytes([index]) * 48,
+            kzg_proof=b"\x00" * 48,
+            signed_block_header=SignedBeaconBlockHeader(
+                message=BeaconBlockHeader(
+                    slot=3, proposer_index=0, parent_root=b"\x00" * 32,
+                    state_root=b"\x00" * 32, body_root=b"\x00" * 32),
+                signature=b"\x00" * 96),
+            kzg_commitment_inclusion_proof=[
+                b"\x00" * 32] * (4 + 1 + max(
+                    spec.preset.max_blob_commitments_per_block - 1,
+                    1).bit_length()),
+        )
+
+    def test_block_then_blobs(self, spec, t):
+        da = DataAvailabilityChecker(spec)
+        block = self._block(t, 2)
+        root = b"\xaa" * 32
+        avail = da.put_pending_executed_block(root, block)
+        assert not avail.is_available
+        assert da.missing_blob_indices(root) == [0, 1]
+        avail = da.put_verified_blobs(root, [self._sidecar(t, spec, 0)])
+        assert not avail.is_available
+        avail = da.put_verified_blobs(root, [self._sidecar(t, spec, 1)])
+        assert avail.is_available
+        assert [int(s.index) for s in avail.blobs] == [0, 1]
+        assert len(da) == 0  # consumed
+
+    def test_blobs_then_block(self, spec, t):
+        da = DataAvailabilityChecker(spec)
+        root = b"\xbb" * 32
+        avail = da.put_verified_blobs(
+            root, [self._sidecar(t, spec, i) for i in (1, 0)])
+        assert not avail.is_available
+        avail = da.put_pending_executed_block(root, self._block(t, 2))
+        assert avail.is_available
+
+    def test_zero_commitment_block_immediately_available(self, spec, t):
+        da = DataAvailabilityChecker(spec)
+        avail = da.put_pending_executed_block(b"\xcc" * 32, self._block(t, 0))
+        assert avail.is_available
+        assert avail.blobs == []
+
+    def test_capacity_eviction(self, spec, t):
+        da = DataAvailabilityChecker(spec, capacity=2)
+        for i in range(3):
+            da.put_verified_blobs(bytes([i]) * 32, [self._sidecar(t, spec, 0)])
+        assert len(da) == 2
+        assert bytes([0]) * 32 not in da._pending  # oldest evicted
+
+    def test_prune_finalized(self, spec, t):
+        da = DataAvailabilityChecker(spec)
+        da.put_pending_executed_block(b"\xdd" * 32, self._block(t, 1, slot=3))
+        da.prune_finalized(8)
+        assert len(da) == 0
+
+
+def test_deneb_chain_end_to_end(settings):
+    """Block with blob commitments gates on availability; the gossip blob
+    completes it and triggers the import (process_gossip_blob path)."""
+    import dataclasses
+
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.testing import Harness
+
+    base = T.ChainSpec.minimal().with_forks_at(0, through="deneb")
+    preset = dataclasses.replace(base.preset,
+                                 field_elements_per_blob=settings.width)
+    spec2 = dataclasses.replace(base, preset=preset)
+    h = Harness(n_validators=32, spec=spec2, fork="deneb", real_crypto=False)
+    chain = BeaconChain(spec2, h.state.copy(), verify_signatures=False,
+                        kzg_settings=settings)
+
+    blob = _dev_blob(settings, 42)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, settings)
+
+    from lighthouse_tpu.state_transition import state_transition
+
+    signed = h.produce_block(blob_commitments=[commitment])
+    state_transition(h.state, h.spec, signed, h._verify_strategy())
+    sidecars = h.make_blob_sidecars(signed, [blob], [proof])
+
+    chain.slot_clock.set_slot(int(signed.message.slot))
+    # block first: must wait for the blob
+    assert chain.process_block(signed) is None
+    root = signed.message.hash_tree_root()
+    assert chain.da_checker.missing_blob_indices(root) == [0]
+    # blob completes availability -> import happens
+    got = chain.process_gossip_blob(sidecars[0])
+    assert got == root
+    assert chain.head_root == root
+    assert chain.store.get_blobs(root) is not None

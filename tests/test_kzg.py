@@ -1,0 +1,101 @@
+"""KZG commitment tests on a dev trusted setup (width 16)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import kzg
+from lighthouse_tpu.crypto.bls import curve as cv
+from lighthouse_tpu.crypto.bls.fields import R
+
+
+@pytest.fixture(scope="module")
+def settings():
+    return kzg.KzgSettings.dev(width=16)
+
+
+def _blob(settings, seed=0):
+    rng = np.random.default_rng(seed)
+    vals = [int(rng.integers(0, 2**63)) % R for _ in range(settings.width)]
+    return b"".join(kzg.bls_field_to_bytes(v) for v in vals)
+
+
+def test_roots_of_unity(settings):
+    for w in settings.roots_brp:
+        assert pow(w, 16, R) == 1
+    assert len(set(settings.roots_brp)) == 16
+
+
+def test_commitment_matches_direct_evaluation(settings):
+    """Commitment from Lagrange setup == [p(τ)]G1 computed directly."""
+    blob = _blob(settings, 1)
+    poly = kzg.blob_to_polynomial(blob, settings)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    # dev setup τ is known: evaluate p(τ) via barycentric and compare
+    tau = 0x123456789ABCDEF
+    p_tau = kzg.evaluate_polynomial_in_evaluation_form(poly, tau, settings)
+    want = cv.g1_to_bytes(cv.g1_mul(cv.g1_generator(), p_tau))
+    assert commitment == want
+
+
+def test_eval_at_domain_point(settings):
+    blob = _blob(settings, 2)
+    poly = kzg.blob_to_polynomial(blob, settings)
+    for i in (0, 5, 15):
+        z = settings.roots_brp[i]
+        assert kzg.evaluate_polynomial_in_evaluation_form(
+            poly, z, settings) == poly[i]
+
+
+def test_kzg_proof_roundtrip(settings):
+    blob = _blob(settings, 3)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    z = kzg.bls_field_to_bytes(987654321)
+    proof, y = kzg.compute_kzg_proof(blob, z, settings)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof, settings)
+    # wrong evaluation rejected
+    y_bad = kzg.bls_field_to_bytes(
+        (kzg.bytes_to_bls_field(y) + 1) % R)
+    assert not kzg.verify_kzg_proof(commitment, z, y_bad, proof, settings)
+
+
+def test_proof_at_domain_point(settings):
+    blob = _blob(settings, 4)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    z = kzg.bls_field_to_bytes(settings.roots_brp[7])
+    proof, y = kzg.compute_kzg_proof(blob, z, settings)
+    poly = kzg.blob_to_polynomial(blob, settings)
+    assert kzg.bytes_to_bls_field(y) == poly[7]
+    assert kzg.verify_kzg_proof(commitment, z, y, proof, settings)
+
+
+def test_blob_proof_roundtrip(settings):
+    blob = _blob(settings, 5)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, settings)
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof, settings)
+    # tampered blob rejected
+    other = _blob(settings, 6)
+    assert not kzg.verify_blob_kzg_proof(other, commitment, proof, settings)
+
+
+def test_blob_proof_batch(settings):
+    blobs = [_blob(settings, 10 + i) for i in range(4)]
+    cs = [kzg.blob_to_kzg_commitment(b, settings) for b in blobs]
+    proofs = [kzg.compute_blob_kzg_proof(b, c, settings)
+              for b, c in zip(blobs, cs)]
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cs, proofs, settings)
+    # one bad proof fails the batch
+    bad = list(proofs)
+    bad[2] = proofs[1]
+    assert not kzg.verify_blob_kzg_proof_batch(blobs, cs, bad, settings)
+    # empty batch verifies vacuously (reference behavior)
+    assert kzg.verify_blob_kzg_proof_batch([], [], [], settings)
+
+
+def test_constant_blob_infinity_proof(settings):
+    """Constant polynomial -> zero quotient -> infinity proof point."""
+    vals = [42] * settings.width
+    blob = b"".join(kzg.bls_field_to_bytes(v) for v in vals)
+    commitment = kzg.blob_to_kzg_commitment(blob, settings)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, settings)
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof, settings)

@@ -1,0 +1,150 @@
+"""Networking tests: two in-process nodes exchange blocks over the
+message layer (the VERDICT round-1 #8 milestone; reference
+testing/simulator/src/basic_sim.rs)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.network import NetworkFabric, NetworkService, PeerManager
+from lighthouse_tpu.network.gossip import GossipHub
+from lighthouse_tpu.network.rpc import RateLimiter
+from lighthouse_tpu.state_transition import state_transition
+from lighthouse_tpu.testing import Harness
+
+
+def _node(h, fabric, peer_id):
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+    return NetworkService(chain, fabric, peer_id)
+
+
+@pytest.fixture()
+def two_nodes():
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    fabric = NetworkFabric()
+    a = _node(h, fabric, "node-a")
+    b = _node(h, fabric, "node-b")
+    return h, a, b
+
+
+class TestGossip:
+    def test_block_gossip_propagates(self, two_nodes):
+        h, a, b = two_nodes
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        slot = int(signed.message.slot)
+        a.chain.slot_clock.set_slot(slot)
+        b.chain.slot_clock.set_slot(slot)
+        a.chain.process_block(signed)
+        a.router.publish_block(signed)
+        root = signed.message.hash_tree_root()
+        assert b.chain.head_root == root
+
+    def test_attestation_gossip_reaches_pool(self, two_nodes):
+        h, a, b = two_nodes
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        slot = int(signed.message.slot)
+        for n in (a, b):
+            n.chain.slot_clock.set_slot(slot)
+            n.chain.process_block(signed)
+        att = h.attest()
+        n_bits = len(att.aggregation_bits)
+        bits = [False] * n_bits
+        bits[0] = True
+        single = type(att)(aggregation_bits=bits, data=att.data,
+                           signature=bytes(att.signature))
+        for n in (a, b):
+            n.chain.slot_clock.set_slot(slot + 1)
+        a.router.publish_attestation(single)
+        assert len(b.chain.naive_pool) == 1
+
+    def test_duplicate_suppressed(self, two_nodes):
+        h, a, b = two_nodes
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        slot = int(signed.message.slot)
+        for n in (a, b):
+            n.chain.slot_clock.set_slot(slot)
+        a.chain.process_block(signed)
+        a.router.publish_block(signed)
+        # replay of the same bytes is dropped by the seen-cache (no error,
+        # no reprocessing: the repeat proposal would otherwise raise)
+        a.router.publish_block(signed)
+        assert b.chain.head_root == signed.message.hash_tree_root()
+
+
+class TestRangeSync:
+    def test_two_nodes_sync_over_rpc(self, two_nodes):
+        h, a, b = two_nodes
+        # node A builds a 12-block chain locally
+        for _ in range(12):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            a.chain.slot_clock.set_slot(int(signed.message.slot))
+            a.chain.process_block(signed)
+        assert int(a.chain.head_state.slot) == 12
+
+        b.chain.slot_clock.set_slot(12)
+        b.connect(a)
+        imported = b.sync.sync()
+        assert imported == 12
+        assert b.chain.head_root == a.chain.head_root
+
+    def test_unknown_parent_triggers_lookup(self, two_nodes):
+        h, a, b = two_nodes
+        blocks = []
+        for _ in range(4):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            blocks.append(signed)
+            a.chain.slot_clock.set_slot(int(signed.message.slot))
+            a.chain.process_block(signed)
+        b.chain.slot_clock.set_slot(4)
+        b.connect(a)
+        # gossip only the TIP to node B: parent chase must fill the gap
+        a.router.publish_block(blocks[-1])
+        assert b.chain.head_root == blocks[-1].message.hash_tree_root()
+
+
+class TestPeerScoring:
+    def test_bad_gossip_decreases_score(self, two_nodes):
+        h, a, b = two_nodes
+        a.gossip_ep.publish(
+            list(b.gossip_ep.handlers)[0], b"\x00garbage")
+        assert b.peer_manager.score("node-a") < 0
+
+    def test_ban_threshold(self):
+        pm = PeerManager()
+        for _ in range(5):
+            pm.report("evil", "high")
+        assert pm.is_banned("evil")
+        assert "evil" not in pm.good_peers()
+
+    def test_rate_limiter(self):
+        t = [0.0]
+        rl = RateLimiter(capacity=2, refill_per_s=1, clock=lambda: t[0])
+        assert rl.allow("p", "proto")
+        assert rl.allow("p", "proto")
+        assert not rl.allow("p", "proto")
+        t[0] += 1.0  # one token refilled
+        assert rl.allow("p", "proto")
+
+
+class TestPartition:
+    def test_partitioned_peer_misses_gossip_then_syncs(self, two_nodes):
+        h, a, b = two_nodes
+        fabric_hub: GossipHub = a.fabric.gossip
+        fabric_hub.disconnect("node-a", "node-b")
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        for n in (a, b):
+            n.chain.slot_clock.set_slot(int(signed.message.slot))
+        a.chain.process_block(signed)
+        a.router.publish_block(signed)
+        assert b.chain.head_root != signed.message.hash_tree_root()
+        # heal the partition; range sync catches B up over RPC
+        fabric_hub.reconnect("node-a", "node-b")
+        b.connect(a)
+        assert b.sync.sync() == 1
+        assert b.chain.head_root == signed.message.hash_tree_root()
