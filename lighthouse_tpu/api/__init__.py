@@ -1,0 +1,8 @@
+"""HTTP APIs: Beacon API server + typed client + metrics endpoint
+(reference beacon_node/http_api, common/eth2, beacon_node/http_metrics)."""
+
+from lighthouse_tpu.api.client import BeaconNodeClient, ClientError
+from lighthouse_tpu.api.http_api import ApiError, BeaconApi, HttpServer
+
+__all__ = ["ApiError", "BeaconApi", "BeaconNodeClient", "ClientError",
+           "HttpServer"]

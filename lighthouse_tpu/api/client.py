@@ -1,0 +1,97 @@
+"""Typed Beacon-API HTTP client.
+
+Rebuild of /root/reference/common/eth2/src/lib.rs:1-8: the client the
+validator client and tooling use against any beacon node implementing the
+API (urllib, stdlib-only).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(f"HTTP {status}: {message}")
+
+
+class BeaconNodeClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: dict | None = None):
+        req = urllib.request.Request(
+            self.base_url + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                if resp.headers.get_content_type() == "application/json":
+                    return json.loads(data)
+                return data.decode()
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("message", "")
+            except Exception:
+                msg = ""
+            raise ClientError(e.code, msg) from None
+
+    # -- beacon --------------------------------------------------------------
+
+    def genesis(self):
+        return self._call("GET", "/eth/v1/beacon/genesis")["data"]
+
+    def state_root(self, state_id="head") -> bytes:
+        data = self._call(
+            "GET", f"/eth/v1/beacon/states/{state_id}/root")["data"]
+        return bytes.fromhex(data["root"][2:])
+
+    def finality_checkpoints(self, state_id="head"):
+        return self._call(
+            "GET",
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints")["data"]
+
+    def validator(self, vid, state_id="head"):
+        return self._call(
+            "GET",
+            f"/eth/v1/beacon/states/{state_id}/validators/{vid}")["data"]
+
+    def header(self, block_id="head"):
+        return self._call("GET", f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    def block_ssz(self, block_id="head") -> bytes:
+        data = self._call("GET", f"/eth/v2/beacon/blocks/{block_id}")
+        return bytes.fromhex(data["ssz_hex"])
+
+    def publish_block(self, signed_block) -> bytes | None:
+        data = self._call("POST", "/eth/v1/beacon/blocks",
+                          {"ssz_hex": signed_block.serialize().hex()})["data"]
+        return bytes.fromhex(data["root"][2:]) if data["root"] else None
+
+    def submit_attestations(self, attestations) -> int:
+        data = self._call(
+            "POST", "/eth/v1/beacon/pool/attestations",
+            {"ssz_hex": [a.serialize().hex() for a in attestations]})["data"]
+        return data["accepted"]
+
+    # -- validator -----------------------------------------------------------
+
+    def proposer_duties(self, epoch: int):
+        return self._call(
+            "GET", f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
+
+    # -- node ----------------------------------------------------------------
+
+    def version(self) -> str:
+        return self._call("GET", "/eth/v1/node/version")["data"]["version"]
+
+    def syncing(self):
+        return self._call("GET", "/eth/v1/node/syncing")["data"]
+
+    def metrics_text(self) -> str:
+        return self._call("GET", "/metrics")

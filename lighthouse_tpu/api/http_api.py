@@ -1,0 +1,364 @@
+"""Beacon-API HTTP server.
+
+Rebuild of /root/reference/beacon_node/http_api/src/lib.rs:95-99 at the
+altitude this framework needs: the standard endpoints a validator client
+and operators rely on (genesis, states, blocks, pool, duties, block
+production/publication, node status) plus the Prometheus scrape endpoint
+(/root/reference/beacon_node/http_metrics).  stdlib http.server; JSON in
+the standard response envelopes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        self.message = message
+        super().__init__(message)
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+class BeaconApi:
+    """Route table bound to a chain (+ optional validator helpers)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.routes: list[tuple[str, re.Pattern, callable]] = []
+        r = self._route
+        r("GET", r"/eth/v1/beacon/genesis", self.genesis)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/root",
+          self.state_root)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/finality_checkpoints",
+          self.finality_checkpoints)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/validators/(?P<vid>\w+)",
+          self.validator_info)
+        r("GET", r"/eth/v1/beacon/headers/(?P<block_id>\w+)", self.header)
+        r("GET", r"/eth/v2/beacon/blocks/(?P<block_id>\w+)", self.block)
+        r("POST", r"/eth/v1/beacon/blocks", self.publish_block)
+        r("POST", r"/eth/v1/beacon/pool/attestations", self.pool_attestations)
+        r("GET", r"/eth/v1/beacon/pool/voluntary_exits", self.pool_exits)
+        r("POST", r"/eth/v1/beacon/pool/voluntary_exits", self.submit_exit)
+        r("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)",
+          self.proposer_duties)
+        r("GET", r"/eth/v1/node/version", self.version)
+        r("GET", r"/eth/v1/node/health", self.health)
+        r("GET", r"/eth/v1/node/syncing", self.syncing)
+        r("GET", r"/metrics", self.metrics)
+
+    def _route(self, method, pattern, fn):
+        self.routes.append((method, re.compile("^" + pattern + "$"), fn))
+
+    def dispatch(self, method: str, path: str, body: bytes):
+        for m, pat, fn in self.routes:
+            if m != method:
+                continue
+            match = pat.match(path)
+            if match:
+                return fn(body=body, **match.groupdict())
+        raise ApiError(404, f"route not found: {method} {path}")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _state(self, state_id: str):
+        c = self.chain
+        if state_id in ("head", "justified", "finalized"):
+            if state_id == "head":
+                return c.head_state
+            cp = (c.finalized_checkpoint() if state_id == "finalized"
+                  else c.justified_checkpoint())
+            st = c.state_for_block(cp.root)
+            if st is None:
+                raise ApiError(404, "state unavailable")
+            return st
+        if state_id.isdigit():
+            root = c.block_root_at_slot(int(state_id))
+            if root is None:
+                raise ApiError(404, "unknown slot")
+            st = c.state_for_block(root)
+            if st is None:
+                raise ApiError(404, "state unavailable")
+            return st
+        raise ApiError(400, f"bad state id {state_id}")
+
+    def _block(self, block_id: str):
+        c = self.chain
+        if block_id == "head":
+            root = c.head_root
+        elif block_id == "genesis":
+            root = c.genesis_block_root
+        elif block_id == "finalized":
+            root = c.finalized_checkpoint().root
+        elif block_id.isdigit():
+            root = c.block_root_at_slot(int(block_id))
+        elif block_id.startswith("0x"):
+            try:
+                root = bytes.fromhex(block_id[2:])
+            except ValueError:
+                raise ApiError(400, f"bad block id {block_id}")
+            if len(root) != 32:
+                raise ApiError(400, f"bad block id {block_id}")
+        else:
+            raise ApiError(400, f"bad block id {block_id}")
+        if root is None:
+            raise ApiError(404, "unknown block")
+        blk = c.store.get_block(root)
+        if blk is None:
+            raise ApiError(404, "unknown block")
+        return root, blk
+
+    # -- endpoints -----------------------------------------------------------
+
+    def genesis(self, body=None):
+        st = self.chain.head_state
+        return {"data": {
+            "genesis_time": str(int(st.genesis_time)),
+            "genesis_validators_root": _hex(st.genesis_validators_root),
+            "genesis_fork_version": _hex(
+                self.chain.spec.genesis_fork_version),
+        }}
+
+    def state_root(self, state_id, body=None):
+        st = self._state(state_id)
+        return {"data": {"root": _hex(st.hash_tree_root())}}
+
+    def finality_checkpoints(self, state_id, body=None):
+        st = self._state(state_id)
+        def cp(c):
+            return {"epoch": str(int(c.epoch)), "root": _hex(c.root)}
+        return {"data": {
+            "previous_justified": cp(st.previous_justified_checkpoint),
+            "current_justified": cp(st.current_justified_checkpoint),
+            "finalized": cp(st.finalized_checkpoint),
+        }}
+
+    def validator_info(self, state_id, vid, body=None):
+        st = self._state(state_id)
+        if not vid.isdigit() or int(vid) >= len(st.validators):
+            raise ApiError(404, "unknown validator")
+        i = int(vid)
+        v = st.validators
+        return {"data": {
+            "index": str(i),
+            "balance": str(int(st.balances[i])),
+            "status": "active_ongoing",
+            "validator": {
+                "pubkey": _hex(v.pubkeys[i].tobytes()),
+                "effective_balance": str(int(v.effective_balance[i])),
+                "slashed": bool(v.slashed[i]),
+                "activation_epoch": str(int(v.activation_epoch[i])),
+                "exit_epoch": str(int(v.exit_epoch[i])),
+            },
+        }}
+
+    def header(self, block_id, body=None):
+        try:
+            root, blk = self._block(block_id)
+        except ApiError:
+            # anchor/genesis: no stored block — synthesize from the state's
+            # latest block header (the reference serves genesis this way)
+            c = self.chain
+            if block_id not in ("head", "genesis"):
+                raise
+            hdr = c.head_state.latest_block_header
+            root = hdr.hash_tree_root() if bytes(hdr.state_root) != b"\x00" * 32 \
+                else c.head_root
+            # the synthesized header describes the HEAD block; only serve it
+            # for "genesis" while the chain is still at its anchor
+            if block_id == "genesis" and root != c.genesis_block_root:
+                raise
+            return {"data": {
+                "root": _hex(root),
+                "canonical": True,
+                "header": {"message": {
+                    "slot": str(int(hdr.slot)),
+                    "proposer_index": str(int(hdr.proposer_index)),
+                    "parent_root": _hex(hdr.parent_root),
+                    "state_root": _hex(hdr.state_root),
+                    "body_root": _hex(hdr.body_root),
+                }, "signature": _hex(b"\x00" * 96)},
+            }}
+        msg = blk.message
+        return {"data": {
+            "root": _hex(root),
+            "canonical": True,
+            "header": {"message": {
+                "slot": str(int(msg.slot)),
+                "proposer_index": str(int(msg.proposer_index)),
+                "parent_root": _hex(msg.parent_root),
+                "state_root": _hex(msg.state_root),
+                "body_root": _hex(msg.body.hash_tree_root()),
+            }, "signature": _hex(blk.signature)},
+        }}
+
+    def block(self, block_id, body=None):
+        root, blk = self._block(block_id)
+        return {"data": {"message": {
+            "slot": str(int(blk.message.slot)),
+            "proposer_index": str(int(blk.message.proposer_index)),
+            "parent_root": _hex(blk.message.parent_root),
+            "state_root": _hex(blk.message.state_root),
+        }, "signature": _hex(blk.signature)},
+            "ssz_hex": blk.serialize().hex()}
+
+    def publish_block(self, body=None):
+        c = self.chain
+        raw = bytes.fromhex(json.loads(body)["ssz_hex"])
+        block = None
+        for f in reversed(c.t.forks):
+            try:
+                block = c.t.signed_beacon_block_class(f).deserialize(raw)
+                break
+            except Exception:
+                continue
+        if block is None:
+            raise ApiError(400, "undecodable block")
+        from lighthouse_tpu.chain.block_verification import BlockError
+
+        try:
+            root = c.process_block(block)
+        except BlockError as e:
+            raise ApiError(400, f"invalid block: {e}")
+        return {"data": {"root": _hex(root) if root else None}}
+
+    def pool_attestations(self, body=None):
+        c = self.chain
+        atts = [c.t.Attestation.deserialize(bytes.fromhex(h))
+                for h in json.loads(body)["ssz_hex"]]
+        verified, rejects = c.verify_attestations_for_gossip(atts)
+        if rejects:
+            raise ApiError(400, f"{len(rejects)} attestations rejected: "
+                           f"{[r for _, r in rejects]}")
+        return {"data": {"accepted": len(verified)}}
+
+    def pool_exits(self, body=None):
+        return {"data": [
+            {"message": {
+                "epoch": str(int(e.message.epoch)),
+                "validator_index": str(int(e.message.validator_index))},
+             "signature": _hex(e.signature)}
+            for e in self.chain.op_pool.exits.values()]}
+
+    def submit_exit(self, body=None):
+        from lighthouse_tpu.types.containers import SignedVoluntaryExit
+
+        exit_ = SignedVoluntaryExit.deserialize(
+            bytes.fromhex(json.loads(body)["ssz_hex"]))
+        self.chain.op_pool.insert_voluntary_exit(exit_)
+        return {"data": None}
+
+    def proposer_duties(self, epoch, body=None):
+        c = self.chain
+        spec = c.spec
+        epoch = int(epoch)
+        from lighthouse_tpu.state_transition import misc, state_advance
+
+        st = c.head_state
+        current = spec.compute_epoch_at_slot(int(st.slot))
+        if epoch > current + 1:
+            raise ApiError(
+                400, f"epoch {epoch} beyond next epoch {current + 1}")
+        start = spec.compute_start_slot_at_epoch(epoch)
+        if spec.compute_epoch_at_slot(int(st.slot)) < epoch:
+            st = st.copy()
+            state_advance(st, spec, start)
+        duties = []
+        for slot in range(start, start + spec.slots_per_epoch):
+            try:
+                idx = misc.get_beacon_proposer_index(st, spec, slot)
+            except Exception:
+                continue
+            duties.append({
+                "pubkey": _hex(st.validators.pubkeys[idx].tobytes()),
+                "validator_index": str(idx),
+                "slot": str(slot),
+            })
+        return {"data": duties}
+
+    def version(self, body=None):
+        return {"data": {"version": "lighthouse-tpu/0.2.0"}}
+
+    def health(self, body=None):
+        return {}
+
+    def syncing(self, body=None):
+        c = self.chain
+        head = int(c.head_state.slot)
+        cur = c.current_slot()
+        return {"data": {
+            "head_slot": str(head),
+            "sync_distance": str(max(cur - head, 0)),
+            "is_syncing": cur - head > 1,
+            "is_optimistic": False,
+            "el_offline": True,
+        }}
+
+    def metrics(self, body=None):
+        return REGISTRY.render()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: BeaconApi = None
+
+    def log_message(self, *args):
+        pass
+
+    def _run(self, method):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            result = self.api.dispatch(method, self.path, body)
+            status = 200
+        except ApiError as e:
+            result = {"code": e.code, "message": e.message}
+            status = e.code
+        except Exception as e:  # internal error -> 500 envelope
+            result = {"code": 500, "message": str(e)}
+            status = 500
+        if isinstance(result, str):  # /metrics text exposition
+            payload = result.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            payload = json.dumps(result).encode()
+            ctype = "application/json"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._run("GET")
+
+    def do_POST(self):
+        self._run("POST")
+
+
+class HttpServer:
+    """Threaded HTTP server on an ephemeral localhost port."""
+
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+        self.api = BeaconApi(chain)
+        handler = type("Handler", (_Handler,), {"api": self.api})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,0 +1,78 @@
+"""Structured logging.
+
+Rebuild of /root/reference/common/logging: slog-style key-value records
+with terminal and JSON drains, plus a metrics layer counting log events
+per level (tracing_metrics_layer.rs equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+LEVELS = {"trace": 5, "debug": 10, "info": 20, "warn": 30, "error": 40,
+          "crit": 50}
+
+
+class Logger:
+    def __init__(self, component: str = "", *, level: str = "info",
+                 json_output: bool = False, stream=None):
+        self.component = component
+        self.level = LEVELS[level]
+        self.json_output = json_output
+        self.stream = stream if stream is not None else sys.stderr
+        self._lock = threading.Lock()
+
+    def child(self, component: str) -> "Logger":
+        out = Logger.__new__(Logger)
+        out.__dict__.update(self.__dict__)
+        out.component = (f"{self.component}:{component}"
+                         if self.component else component)
+        return out
+
+    def _log(self, level: str, msg: str, **fields):
+        if LEVELS[level] < self.level:
+            return
+        REGISTRY.counter(f"log_events_{level}_total",
+                         "log events by level").inc()
+        record = {
+            "ts": round(time.time(), 3),
+            "level": level,
+            "component": self.component,
+            "msg": msg,
+            **{k: (v.hex() if isinstance(v, bytes) else v)
+               for k, v in fields.items()},
+        }
+        with self._lock:
+            if self.json_output:
+                self.stream.write(json.dumps(record) + "\n")
+            else:
+                kv = " ".join(f"{k}={v}" for k, v in record.items()
+                              if k not in ("ts", "level", "msg"))
+                self.stream.write(
+                    f"{level.upper():5s} {record['msg']} {kv}\n".rstrip() + "\n")
+
+    def trace(self, msg, **kw):
+        self._log("trace", msg, **kw)
+
+    def debug(self, msg, **kw):
+        self._log("debug", msg, **kw)
+
+    def info(self, msg, **kw):
+        self._log("info", msg, **kw)
+
+    def warn(self, msg, **kw):
+        self._log("warn", msg, **kw)
+
+    def error(self, msg, **kw):
+        self._log("error", msg, **kw)
+
+    def crit(self, msg, **kw):
+        self._log("crit", msg, **kw)
+
+
+ROOT = Logger("lighthouse_tpu")

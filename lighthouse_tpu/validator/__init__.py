@@ -1,0 +1,18 @@
+"""Validator client stack: duties, signing store, slashing protection
+(reference validator_client/)."""
+
+from lighthouse_tpu.validator.client import ValidatorClient
+from lighthouse_tpu.validator.duties import DutiesService
+from lighthouse_tpu.validator.slashing_protection import (
+    SlashingProtectionDB,
+    SlashingProtectionError,
+)
+from lighthouse_tpu.validator.validator_store import ValidatorStore
+
+__all__ = [
+    "DutiesService",
+    "SlashingProtectionDB",
+    "SlashingProtectionError",
+    "ValidatorClient",
+    "ValidatorStore",
+]

@@ -1,0 +1,141 @@
+"""Validator client: per-slot duty execution against a beacon node.
+
+Rebuild of /root/reference/validator_client/src/{block_service,
+attestation_service}.rs: on each slot tick, managed proposers produce +
+sign + publish blocks, attesters produce + sign + publish attestations,
+and selected aggregators publish SignedAggregateAndProofs.  The "beacon
+node" is an in-process BeaconChain (+ optional network router); the same
+flow maps onto the HTTP API client unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.validator.duties import DutiesService
+from lighthouse_tpu.validator.slashing_protection import (
+    SlashingProtectionError,
+)
+
+
+@dataclass
+class SlotSummary:
+    slot: int
+    blocks_proposed: int = 0
+    attestations_published: int = 0
+    aggregates_published: int = 0
+    slashing_refusals: int = 0
+
+
+class ValidatorClient:
+    def __init__(self, chain, store, router=None):
+        self.chain = chain
+        self.store = store
+        self.router = router
+        self.duties = DutiesService(chain, store)
+
+    # -- per-slot tick ------------------------------------------------------
+
+    def run_slot(self, slot: int) -> SlotSummary:
+        summary = SlotSummary(slot)
+        self._propose(slot, summary)
+        self._attest(slot, summary)
+        return summary
+
+    def _propose(self, slot: int, summary: SlotSummary):
+        chain = self.chain
+        spec = chain.spec
+        for duty in self.duties.proposers_at_slot(slot):
+            epoch = spec.compute_epoch_at_slot(slot)
+            randao = self.store.sign_randao_reveal(duty.pubkey, epoch)
+            kwargs = {}
+            fork = spec.fork_at_epoch(epoch)
+            if fork in ("bellatrix", "capella", "deneb"):
+                kwargs["execution_payload"] = (
+                    chain.mock_payload(slot) if hasattr(chain, "mock_payload")
+                    else None)
+            block, proposer = chain.produce_block_on(
+                slot, randao, **kwargs)
+            try:
+                sig = self.store.sign_block(duty.pubkey, block)
+            except SlashingProtectionError:
+                summary.slashing_refusals += 1
+                continue
+            signed = chain.t.signed_beacon_block_class(
+                spec.fork_at_epoch(epoch))(message=block, signature=sig)
+            chain.process_block(signed)
+            if self.router is not None:
+                self.router.publish_block(signed)
+            summary.blocks_proposed += 1
+
+    def _attest(self, slot: int, summary: SlotSummary):
+        chain = self.chain
+        spec = chain.spec
+        duties = self.duties.attesters_at_slot(slot)
+        if not duties:
+            return
+        head_root = chain.head_root
+        state = chain.head_state
+        epoch = spec.compute_epoch_at_slot(slot)
+        target_slot = spec.compute_start_slot_at_epoch(epoch)
+        target_root = (head_root if target_slot >= int(state.slot)
+                       else chain.block_root_at_slot(target_slot))
+        from lighthouse_tpu.types.containers import (
+            AttestationData,
+            Checkpoint,
+        )
+
+        for duty in duties:
+            data = AttestationData(
+                slot=slot, index=duty.committee_index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root or head_root),
+            )
+            try:
+                sig = self.store.sign_attestation(duty.pubkey, data)
+            except SlashingProtectionError:
+                summary.slashing_refusals += 1
+                continue
+            bits = [False] * duty.committee_length
+            bits[duty.committee_position] = True
+            att = chain.t.Attestation(
+                aggregation_bits=bits, data=data, signature=sig)
+            verified, _rejects = chain.verify_attestations_for_gossip([att])
+            if not verified:
+                continue
+            if self.router is not None:
+                self.router.publish_attestation(
+                    att, subnet=duty.committee_index
+                    % spec.attestation_subnet_count)
+            summary.attestations_published += 1
+
+        # aggregation duties (attestation_service.rs:234-519 flow)
+        for duty in duties:
+            if not duty.is_aggregator:
+                continue
+            agg = None
+            for data_agg, bits, sig in self.chain.naive_pool.iter_aggregates():
+                if (int(data_agg.slot) == slot
+                        and int(data_agg.index) == duty.committee_index):
+                    agg = (data_agg, bits, sig)
+                    break
+            if agg is None:
+                continue
+            data_agg, bits, sig = agg
+            aggregate = chain.t.Attestation(
+                aggregation_bits=[bool(b) for b in bits], data=data_agg,
+                signature=sig.to_bytes() if hasattr(sig, "to_bytes")
+                else bytes(sig))
+            message = chain.t.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=duty.selection_proof)
+            proof_sig = self.store.sign_aggregate_and_proof(
+                duty.pubkey, message)
+            signed = chain.t.SignedAggregateAndProof(
+                message=message, signature=proof_sig)
+            verified, _rejects = chain.verify_aggregates_for_gossip([signed])
+            if not verified:
+                continue
+            summary.aggregates_published += 1

@@ -1,0 +1,121 @@
+"""Duties engine: proposer/attester/sync duties per epoch.
+
+Rebuild of /root/reference/validator_client/src/duties_service.rs: polls
+the beacon node (here: the in-process chain) for each managed validator's
+duties, computes selection proofs, and exposes per-slot work lists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.state_transition import misc
+
+
+@dataclass
+class AttesterDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_length: int
+    is_aggregator: bool = False
+    selection_proof: bytes | None = None
+
+
+@dataclass
+class ProposerDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+
+
+@dataclass
+class EpochDuties:
+    epoch: int
+    attesters: list[AttesterDuty] = field(default_factory=list)
+    proposers: list[ProposerDuty] = field(default_factory=list)
+
+
+class DutiesService:
+    def __init__(self, chain, store):
+        self.chain = chain
+        self.store = store  # ValidatorStore
+        self._cache: dict[int, EpochDuties] = {}
+
+    def _indices_by_pubkey(self, state) -> dict[bytes, int]:
+        out = {}
+        pks = state.validators.pubkeys
+        managed = set(self.store.voting_pubkeys())
+        for i in range(len(state.validators)):
+            pk = bytes(pks[i].tobytes())
+            if pk in managed:
+                out[pk] = i
+        return out
+
+    def duties_for_epoch(self, epoch: int) -> EpochDuties:
+        cached = self._cache.get(epoch)
+        if cached is not None:
+            return cached
+        chain = self.chain
+        spec = chain.spec
+        state = chain.head_state
+        if spec.compute_epoch_at_slot(int(state.slot)) < epoch:
+            state = state.copy()
+            from lighthouse_tpu.state_transition import state_advance
+
+            state_advance(state, spec,
+                          spec.compute_start_slot_at_epoch(epoch))
+        by_pk = self._indices_by_pubkey(state)
+        by_idx = {v: k for k, v in by_pk.items()}
+        duties = EpochDuties(epoch)
+
+        shuffle = chain.committee_shuffle(state, epoch)
+        n_active = shuffle.shape[0]
+        per_slot = misc.get_committee_count_per_slot(spec, n_active)
+        start = spec.compute_start_slot_at_epoch(epoch)
+        for slot in range(start, start + spec.slots_per_epoch):
+            for index in range(per_slot):
+                committee = misc.get_beacon_committee(
+                    state, spec, slot, index, shuffle)
+                for pos, vidx in enumerate(committee):
+                    pk = by_idx.get(int(vidx))
+                    if pk is None:
+                        continue
+                    duty = AttesterDuty(
+                        pubkey=pk, validator_index=int(vidx), slot=slot,
+                        committee_index=index, committee_position=pos,
+                        committee_length=committee.shape[0])
+                    proof = self.store.sign_selection_proof(pk, slot)
+                    duty.selection_proof = proof
+                    modulo = max(1, committee.shape[0]
+                                 // spec.target_aggregators_per_committee)
+                    digest = hashlib.sha256(proof).digest()
+                    duty.is_aggregator = (
+                        int.from_bytes(digest[:8], "little") % modulo == 0)
+                    duties.attesters.append(duty)
+
+            try:
+                proposer = misc.get_beacon_proposer_index(state, spec, slot)
+                pk = by_idx.get(proposer)
+                if pk is not None:
+                    duties.proposers.append(
+                        ProposerDuty(pk, proposer, slot))
+            except Exception:
+                pass
+        self._cache[epoch] = duties
+        if len(self._cache) > 4:
+            del self._cache[min(self._cache)]
+        return duties
+
+    def attesters_at_slot(self, slot: int) -> list[AttesterDuty]:
+        epoch = self.chain.spec.compute_epoch_at_slot(slot)
+        return [d for d in self.duties_for_epoch(epoch).attesters
+                if d.slot == slot]
+
+    def proposers_at_slot(self, slot: int) -> list[ProposerDuty]:
+        epoch = self.chain.spec.compute_epoch_at_slot(slot)
+        return [d for d in self.duties_for_epoch(epoch).proposers
+                if d.slot == slot]

@@ -1,0 +1,87 @@
+"""HTTP API tests: real server on an ephemeral port + typed client."""
+
+import pytest
+
+from lighthouse_tpu.api import BeaconNodeClient, ClientError, HttpServer
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.state_transition import state_transition
+from lighthouse_tpu.testing import Harness
+
+
+@pytest.fixture(scope="module")
+def api_setup():
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+    server = HttpServer(chain).start()
+    client = BeaconNodeClient(f"http://127.0.0.1:{server.port}")
+    yield h, chain, client
+    server.stop()
+
+
+def test_genesis_and_version(api_setup):
+    h, chain, client = api_setup
+    g = client.genesis()
+    assert g["genesis_validators_root"] == \
+        "0x" + bytes(h.state.genesis_validators_root).hex()
+    assert client.version().startswith("lighthouse-tpu/")
+
+
+def test_state_and_header_endpoints(api_setup):
+    h, chain, client = api_setup
+    root = client.state_root("head")
+    assert root == chain.head_state.hash_tree_root()
+    hdr = client.header("head")
+    assert hdr["root"] == "0x" + chain.head_root.hex()
+    fc = client.finality_checkpoints("head")
+    assert "finalized" in fc
+
+
+def test_validator_info(api_setup):
+    h, chain, client = api_setup
+    v = client.validator(0)
+    assert v["index"] == "0"
+    assert v["validator"]["pubkey"].startswith("0x")
+    with pytest.raises(ClientError):
+        client.validator(10_000)
+
+
+def test_publish_block_roundtrip(api_setup):
+    h, chain, client = api_setup
+    signed = h.produce_block()
+    state_transition(h.state, h.spec, signed, h._verify_strategy())
+    chain.slot_clock.set_slot(int(signed.message.slot))
+    root = client.publish_block(signed)
+    assert root == signed.message.hash_tree_root()
+    assert chain.head_root == root
+    # fetch it back as SSZ
+    raw = client.block_ssz("head")
+    assert raw == signed.serialize()
+
+
+def test_submit_attestations(api_setup):
+    h, chain, client = api_setup
+    att = h.attest()
+    n = len(att.aggregation_bits)
+    bits = [False] * n
+    bits[0] = True
+    single = type(att)(aggregation_bits=bits, data=att.data,
+                       signature=bytes(att.signature))
+    chain.slot_clock.set_slot(int(att.data.slot) + 1)
+    assert client.submit_attestations([single]) == 1
+
+
+def test_proposer_duties(api_setup):
+    h, chain, client = api_setup
+    duties = client.proposer_duties(0)
+    assert len(duties) == h.spec.slots_per_epoch
+    assert all(d["pubkey"].startswith("0x") for d in duties)
+
+
+def test_syncing_and_metrics(api_setup):
+    h, chain, client = api_setup
+    REGISTRY.counter("test_api_counter", "x").inc()
+    s = client.syncing()
+    assert "head_slot" in s
+    text = client.metrics_text()
+    assert "test_api_counter" in text

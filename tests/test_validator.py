@@ -1,0 +1,139 @@
+"""Validator client stack tests: keys, keystores, slashing protection,
+duties, and a full propose/attest slot loop against an in-process chain."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls, key_derivation as kd, keystore as ks
+from lighthouse_tpu.crypto.wallet import Wallet
+from lighthouse_tpu.validator import (
+    SlashingProtectionDB,
+    SlashingProtectionError,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+class TestKeyDerivation:
+    def test_eip2333_vector(self):
+        """EIP-2333 test case 0 (the published master/child vector)."""
+        seed = bytes.fromhex(
+            "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+            "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04")
+        master = kd.derive_master_sk(seed)
+        assert master == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+        child = kd.derive_child_sk(master, 0)
+        assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+    def test_path_derivation_is_deterministic(self):
+        seed = b"\x01" * 32
+        a = kd.derive_path(seed, "m/12381/3600/0/0/0")
+        b = kd.derive_path(seed, "m/12381/3600/0/0/0")
+        c = kd.derive_path(seed, "m/12381/3600/1/0/0")
+        assert a == b != c
+        assert 0 < a < kd.CURVE_ORDER
+
+
+class TestKeystore:
+    def test_roundtrip_pbkdf2(self):
+        secret = bls.SecretKey.generate().to_bytes()
+        store = ks.encrypt(secret, "hunter22", kdf="pbkdf2")
+        assert ks.decrypt(store, "hunter22") == secret
+        with pytest.raises(ks.KeystoreError):
+            ks.decrypt(store, "wrong")
+
+    def test_password_normalization(self):
+        secret = b"\x05" * 32
+        store = ks.encrypt(secret, "pass\x7fword", kdf="pbkdf2")
+        # control characters are stripped per EIP-2335
+        assert ks.decrypt(store, "password") == secret
+
+    def test_wallet_derives_distinct_validators(self):
+        w = Wallet.create("w", "wpass", seed=b"\x02" * 32)
+        s1, _ = w.next_validator("wpass", "kpass")
+        s2, _ = w.next_validator("wpass", "kpass")
+        assert s1["pubkey"] != s2["pubkey"]
+        assert w.data["nextaccount"] == 2
+
+
+class TestSlashingProtection:
+    def test_double_proposal_refused(self):
+        db = SlashingProtectionDB()
+        pk = b"\xaa" * 48
+        db.check_and_insert_block_proposal(pk, 5, b"\x01" * 32)
+        db.check_and_insert_block_proposal(pk, 5, b"\x01" * 32)  # same: ok
+        with pytest.raises(SlashingProtectionError):
+            db.check_and_insert_block_proposal(pk, 5, b"\x02" * 32)
+        with pytest.raises(SlashingProtectionError):
+            db.check_and_insert_block_proposal(pk, 4, b"\x03" * 32)
+
+    def test_surround_votes_refused(self):
+        db = SlashingProtectionDB()
+        pk = b"\xbb" * 48
+        db.check_and_insert_attestation(pk, 2, 3, b"\x01" * 32)
+        with pytest.raises(SlashingProtectionError):  # double vote
+            db.check_and_insert_attestation(pk, 2, 3, b"\x02" * 32)
+        db.check_and_insert_attestation(pk, 3, 5, b"\x03" * 32)
+        with pytest.raises(SlashingProtectionError):  # would surround (2,6)⊃(3,5)
+            db.check_and_insert_attestation(pk, 2, 6, b"\x04" * 32)
+        with pytest.raises(SlashingProtectionError):  # would be surrounded
+            db.check_and_insert_attestation(pk, 4, 4, b"\x05" * 32)
+
+    def test_interchange_roundtrip(self, tmp_path):
+        db = SlashingProtectionDB()
+        pk = b"\xcc" * 48
+        db.check_and_insert_block_proposal(pk, 10, b"\x01" * 32)
+        db.check_and_insert_attestation(pk, 1, 2, b"\x02" * 32)
+        path = tmp_path / "interchange.json"
+        db.export_json(str(path))
+
+        db2 = SlashingProtectionDB()
+        db2.import_json(str(path))
+        with pytest.raises(SlashingProtectionError):
+            db2.check_and_insert_block_proposal(pk, 10, b"\xff" * 32)
+        with pytest.raises(SlashingProtectionError):
+            db2.check_and_insert_attestation(pk, 1, 2, b"\xff" * 32)
+
+
+@pytest.fixture()
+def vc_setup():
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.testing import Harness, interop_secret_key
+
+    h = Harness(n_validators=32, fork="altair", real_crypto=True)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=True)
+    store = ValidatorStore(
+        h.spec, bytes(h.state.genesis_validators_root))
+    for i in range(32):
+        store.add_validator(interop_secret_key(i), index=i)
+    return h, chain, ValidatorClient(chain, store)
+
+
+class TestValidatorClient:
+    def test_full_slot_loop_proposes_and_attests(self, vc_setup):
+        h, chain, vc = vc_setup
+        chain.slot_clock.set_slot(1)
+        summary = vc.run_slot(1)
+        assert summary.blocks_proposed == 1
+        assert summary.attestations_published >= 1
+        assert int(chain.head_state.slot) == 1
+        # next slot: head advanced again, attestations flow into the pool
+        chain.slot_clock.set_slot(2)
+        s2 = vc.run_slot(2)
+        assert s2.blocks_proposed == 1
+        assert int(chain.head_state.slot) == 2
+
+    def test_double_sign_refused_on_repeat_slot(self, vc_setup):
+        h, chain, vc = vc_setup
+        chain.slot_clock.set_slot(1)
+        first = vc.run_slot(1)
+        assert first.blocks_proposed == 1
+        # run_slot recorded the slot-1 proposal in the slashing DB: signing
+        # a DIFFERENT block at the same slot must now be refused
+        proposer = vc.duties.proposers_at_slot(1)[0]
+        block = chain.store.get_block(chain.head_root).message
+        conflicting = block.copy()
+        conflicting.state_root = b"\xfe" * 32
+        with pytest.raises(SlashingProtectionError):
+            vc.store.sign_block(proposer.pubkey, conflicting)
+        # re-signing the SAME block is idempotent (same signing root)
+        assert vc.store.sign_block(proposer.pubkey, block)
