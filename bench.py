@@ -129,7 +129,9 @@ def _bench_merkleize() -> dict:
 
     # 2^20 leaf chunks ≈ the per-field leaf count of a 1M-validator registry
     # column (BASELINE config #4).  Total pair-hashes for the fold = 2^20 - 1.
-    log_leaves = 20
+    # XLA-CPU fallback uses a smaller tree so the child finishes well under
+    # its timeout even on a loaded host.
+    log_leaves = 20 if platform == "tpu" else 16
     n_leaves = 1 << log_leaves
     rng = np.random.default_rng(0)
     leaves = rng.integers(0, 2**32, size=(n_leaves, 8), dtype=np.uint64).astype(
@@ -172,15 +174,82 @@ def _bench_merkleize() -> dict:
     }
 
 
+def _bench_state_root_incremental() -> dict:
+    """Per-block state-root cost with the incremental tree cache
+    (milhouse-equivalent): root scales with the block's diff, not the
+    state (reference beacon_state.rs:2031 update_tree_hash_cache)."""
+    import numpy as np
+
+    from lighthouse_tpu import types as T
+    from lighthouse_tpu.ssz.tree_cache import enable_tree_cache
+    from lighthouse_tpu.state_transition import genesis_state
+    from lighthouse_tpu.types.registry import Validators
+
+    spec = T.ChainSpec.minimal().with_forks_at(0, through="altair")
+    state = genesis_state(64, spec, "altair")
+    N = 1 << 16
+    rng = np.random.default_rng(0)
+    v = Validators(N)
+    v.pubkeys[...] = rng.integers(0, 256, (N, 48), dtype=np.uint8)
+    v.withdrawal_credentials[...] = rng.integers(0, 256, (N, 32), np.uint8)
+    v.effective_balance[...] = 32_000_000_000
+    v.exit_epoch[...] = 2**64 - 1
+    v.withdrawable_epoch[...] = 2**64 - 1
+    state.validators = v
+    state.balances = np.full(N, 32_000_000_000, dtype=np.uint64)
+    state.previous_epoch_participation = np.zeros(N, dtype=np.uint8)
+    state.current_epoch_participation = np.zeros(N, dtype=np.uint8)
+    state.inactivity_scores = np.zeros(N, dtype=np.uint64)
+
+    t0 = time.perf_counter()
+    fresh = state.hash_tree_root()
+    t_fresh = time.perf_counter() - t0
+
+    enable_tree_cache(state)
+    assert state.hash_tree_root() == fresh
+    times = []
+    for i in range(5):
+        idx = rng.integers(0, N, 128)
+        state.current_epoch_participation[idx] = 7
+        state.balances[idx] += 1
+        state.slot = int(state.slot) + 1
+        t0 = time.perf_counter()
+        state.hash_tree_root()
+        times.append(time.perf_counter() - t0)
+    t_incr = sorted(times)[len(times) // 2]
+    return {
+        "state_root_incremental_ms": round(t_incr * 1000, 2),
+        "state_root_full_ms": round(t_fresh * 1000, 1),
+        "state_root_speedup": round(t_fresh / t_incr, 1),
+        "state_root_validators": N,
+    }
+
+
 def _child_main() -> int:
-    if "--child-kzg" in sys.argv:
+    if "--child-probe" in sys.argv:
+        import jax
+
+        result = {"platform": jax.devices()[0].platform}
+    elif "--child-kzg" in sys.argv:
         result = _bench_kzg_batch()
     elif "--child-merkle" in sys.argv:
         result = _bench_merkleize()
+    elif "--child-stateroot" in sys.argv:
+        result = _bench_state_root_incremental()
     else:
         result = _bench_bls_1k()
     print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
     return 0
+
+
+_CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    # a wedged axon relay blocks jax backend init even under
+    # JAX_PLATFORMS=cpu (the sitecustomize plugin registration dials it);
+    # None = remove from the child env so CPU fallback cannot hang
+    "PALLAS_AXON_POOL_IPS": None,
+    "PALLAS_AXON_REMOTE_COMPILE": None,
+}
 
 
 def _run_child(extra_env: dict | None, child_flag: str = "--child",
@@ -192,7 +261,11 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
                    os.path.join(_REPO, ".jax_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     if extra_env:
-        env.update(extra_env)
+        for k, v in extra_env.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), child_flag],
@@ -210,22 +283,35 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
     return None
 
 
+_CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
+                "--child-probe", "--child-stateroot")
+
+
 def main() -> int:
-    if any(f in sys.argv for f in ("--child", "--child-kzg", "--child-merkle")):
+    if any(f in sys.argv for f in _CHILD_FLAGS):
         return _child_main()
 
     # Each bench runs in its own child so one slow compile can't sink the
     # rest; the headline is BLS (north-star), falling back to the merkle
     # metric, falling back to an error record.  TPU first, then host CPU.
+    #
+    # A cheap liveness probe decides the platform ONCE: when the TPU relay
+    # is wedged, jax.devices() hangs forever in every child, so without
+    # the probe each TPU attempt burns a full child timeout.
     working_env = None
-    merkle = _run_child(None, child_flag="--child-merkle")
-    if merkle is None:
-        working_env = {"JAX_PLATFORMS": "cpu"}
+    probe = _run_child(None, child_flag="--child-probe",
+                       timeout_s=min(150, CHILD_TIMEOUT_S))
+    if probe is None or probe.get("platform") == "cpu":
+        working_env = dict(_CPU_ENV)
+
+    merkle = _run_child(working_env, child_flag="--child-merkle")
+    if merkle is None and working_env is None:
+        working_env = dict(_CPU_ENV)
         merkle = _run_child(working_env, child_flag="--child-merkle")
 
     result = _run_child(working_env, child_flag="--child")
     if result is None and working_env is None:
-        working_env = {"JAX_PLATFORMS": "cpu"}
+        working_env = dict(_CPU_ENV)
         result = _run_child(working_env, child_flag="--child")
 
     if result is not None:
@@ -244,13 +330,18 @@ def main() -> int:
             "error": f"benchmark children failed/timed out ({CHILD_TIMEOUT_S}s) "
                      "on both tpu and cpu platforms",
         }
-    if working_env == {"JAX_PLATFORMS": "cpu"}:
+    if working_env is not None:
         result.setdefault("note", "tpu backend unavailable; measured on host cpu")
     if "error" not in result:
         # KZG batch (BASELINE #5): degradable add-on
         kzg_res = _run_child(working_env, child_flag="--child-kzg")
         if kzg_res:
             result.update(kzg_res)
+        # incremental state root (BASELINE #4's per-block form)
+        sr = _run_child(working_env, child_flag="--child-stateroot",
+                        timeout_s=min(300, CHILD_TIMEOUT_S))
+        if sr:
+            result.update(sr)
     print(json.dumps(result))
     return 0
 

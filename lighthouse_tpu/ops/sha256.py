@@ -146,6 +146,50 @@ def fold_to_root_device(leaves: jax.Array) -> jax.Array:
     return x
 
 
+@jax.jit
+def _fold_levels_device(leaves: jax.Array):
+    """All interior tree levels in ONE device program.
+
+    leaves: uint32[n, 8] with n a power of two -> tuple of levels
+    (uint32[n/2, 8], ..., uint32[1, 8]).  One dispatch and one transfer
+    per level instead of a host round-trip per level — the production
+    full-build path for the incremental tree cache (fixes the
+    per-level ping-pong called out for merkleize_words).
+    """
+    out = []
+    x = leaves
+    while x.shape[0] > 1:
+        x = hash_pairs_device(x.reshape(x.shape[0] // 2, 16))
+        out.append(x)
+    return tuple(out)
+
+
+def fold_levels(leaves: np.ndarray, *, device: bool | None = None) -> list[np.ndarray]:
+    """Build every interior level of a power-of-two-leaf merkle tree.
+
+    leaves: uint32[n, 8], n a power of two (zero-chunk padded by caller).
+    Returns [level1, ..., levelL] where level k has n/2^k rows.  Routes to
+    a single fused device program for large trees, hashlib below the
+    dispatch-overhead threshold.
+    """
+    n = leaves.shape[0]
+    assert n & (n - 1) == 0 and n >= 1
+    if n == 1:
+        return []
+    use_device = device if device is not None else n // 2 >= _DEVICE_MIN_PAIRS
+    if use_device:
+        levels = _fold_levels_device(jnp.asarray(leaves))
+        # np.array (not asarray): device transfers are read-only views and
+        # the incremental cache scatters into these levels
+        return [np.array(lv) for lv in levels]
+    out = []
+    x = leaves
+    while x.shape[0] > 1:
+        x = hash_pairs_np(x.reshape(x.shape[0] // 2, 16))
+        out.append(x)
+    return out
+
+
 def hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
     """hashlib fallback with identical semantics (uint32[N,16] -> uint32[N,8])."""
     out = np.empty((pairs.shape[0], 8), dtype=np.uint32)

@@ -637,6 +637,9 @@ class Container(metaclass=ContainerMeta):
         return cls(**values)
 
     def hash_tree_root(self) -> bytes:
+        cache = getattr(self, "_tree_cache", None)
+        if cache is not None:
+            return cache.state_root(self)
         cls = type(self)
         roots = b"".join(
             ftype.hash_tree_root(getattr(self, fname))

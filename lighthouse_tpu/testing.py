@@ -41,6 +41,9 @@ class Harness:
         self.real_crypto = real_crypto
         self.t = T.make_types(self.spec.preset)
         self.state = genesis_state(n_validators, self.spec, fork)
+        from lighthouse_tpu.ssz.tree_cache import enable_tree_cache
+
+        enable_tree_cache(self.state)
         self.genesis_root = self.state.latest_block_header.hash_tree_root()
         self._sk_by_pubkey = {}
         for i in range(n_validators):

@@ -265,9 +265,15 @@ _VALIDATOR_RECORD_SIZE = 48 + 32 + 8 + 1 + 8 * 4  # = 121 bytes, SSZ field order
 
 
 class Validators:
-    """Columnar validator registry (mutable, numpy-backed)."""
+    """Columnar validator registry (mutable, numpy-backed).
 
-    __slots__ = (
+    Columns are views into capacity-doubled backing arrays so `append`
+    (one per deposit) is amortized O(1) — a deposit flood grows the
+    registry linearly, not quadratically.  Element and mask writes go
+    through the views; whole-column replacement uses the setters.
+    """
+
+    _COLUMNS = (
         "pubkeys",
         "withdrawal_credentials",
         "effective_balance",
@@ -278,18 +284,29 @@ class Validators:
         "withdrawable_epoch",
     )
 
+    __slots__ = tuple("_" + c for c in _COLUMNS) + ("_n",)
+
     def __init__(self, n: int = 0):
-        self.pubkeys = np.zeros((n, 48), dtype=np.uint8)
-        self.withdrawal_credentials = np.zeros((n, 32), dtype=np.uint8)
-        self.effective_balance = np.zeros(n, dtype=np.uint64)
-        self.slashed = np.zeros(n, dtype=bool)
-        self.activation_eligibility_epoch = np.zeros(n, dtype=np.uint64)
-        self.activation_epoch = np.zeros(n, dtype=np.uint64)
-        self.exit_epoch = np.zeros(n, dtype=np.uint64)
-        self.withdrawable_epoch = np.zeros(n, dtype=np.uint64)
+        self._n = n
+        self._pubkeys = np.zeros((n, 48), dtype=np.uint8)
+        self._withdrawal_credentials = np.zeros((n, 32), dtype=np.uint8)
+        self._effective_balance = np.zeros(n, dtype=np.uint64)
+        self._slashed = np.zeros(n, dtype=bool)
+        self._activation_eligibility_epoch = np.zeros(n, dtype=np.uint64)
+        self._activation_epoch = np.zeros(n, dtype=np.uint64)
+        self._exit_epoch = np.zeros(n, dtype=np.uint64)
+        self._withdrawable_epoch = np.zeros(n, dtype=np.uint64)
 
     def __len__(self) -> int:
-        return self.effective_balance.shape[0]
+        return self._n
+
+    def _grow_to(self, cap: int) -> None:
+        for c in self._COLUMNS:
+            backing = getattr(self, "_" + c)
+            shape = (cap,) + backing.shape[1:]
+            new = np.zeros(shape, dtype=backing.dtype)
+            new[: self._n] = backing[: self._n]
+            setattr(self, "_" + c, new)
 
     def append(
         self,
@@ -303,36 +320,39 @@ class Validators:
         exit_epoch: int,
         withdrawable_epoch: int,
     ) -> None:
-        self.pubkeys = np.concatenate(
-            [self.pubkeys, np.frombuffer(pubkey, dtype=np.uint8)[None, :]]
-        )
-        self.withdrawal_credentials = np.concatenate(
-            [self.withdrawal_credentials, np.frombuffer(withdrawal_credentials, dtype=np.uint8)[None, :]]
-        )
-        for name, v in (
-            ("effective_balance", effective_balance),
-            ("activation_eligibility_epoch", activation_eligibility_epoch),
-            ("activation_epoch", activation_epoch),
-            ("exit_epoch", exit_epoch),
-            ("withdrawable_epoch", withdrawable_epoch),
-        ):
-            setattr(self, name, np.append(getattr(self, name), np.uint64(v)))
-        self.slashed = np.append(self.slashed, bool(slashed))
+        if self._n == self._effective_balance.shape[0]:
+            self._grow_to(max(64, 2 * self._n))
+        i = self._n
+        self._pubkeys[i] = np.frombuffer(pubkey, dtype=np.uint8)
+        self._withdrawal_credentials[i] = np.frombuffer(
+            withdrawal_credentials, dtype=np.uint8)
+        self._effective_balance[i] = effective_balance
+        self._slashed[i] = bool(slashed)
+        self._activation_eligibility_epoch[i] = activation_eligibility_epoch
+        self._activation_epoch[i] = activation_epoch
+        self._exit_epoch[i] = exit_epoch
+        self._withdrawable_epoch[i] = withdrawable_epoch
+        self._n = i + 1
 
     def copy(self) -> "Validators":
         out = Validators(0)
-        for f in self.__slots__:
-            setattr(out, f, getattr(self, f).copy())
+        out._n = self._n
+        for c in self._COLUMNS:
+            setattr(out, "_" + c, getattr(self, c).copy())
         return out
 
     def __eq__(self, other) -> bool:
         return isinstance(other, Validators) and all(
-            np.array_equal(getattr(self, f), getattr(other, f)) for f in self.__slots__
+            np.array_equal(getattr(self, f), getattr(other, f))
+            for f in self._COLUMNS
         )
 
     def is_active(self, epoch: int) -> np.ndarray:
         e = np.uint64(epoch)
         return (self.activation_epoch <= e) & (e < self.exit_epoch)
+
+    # Column views (length-n windows over the capacity arrays) are added
+    # below the class body via _install_column_views().
 
     def is_eligible_for_activation_queue(self, max_effective_balance: int) -> np.ndarray:
         from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
@@ -348,6 +368,31 @@ class Validators:
             & (self.activation_epoch <= e)
             & (e < self.withdrawable_epoch)
         )
+
+
+def _install_column_views() -> None:
+    def make(col: str) -> property:
+        backing = "_" + col
+
+        def get(self):
+            return getattr(self, backing)[: self._n]
+
+        def set_(self, value):
+            view = getattr(self, backing)[: self._n]
+            arr = np.asarray(value, dtype=view.dtype)
+            if arr.shape != view.shape:
+                raise ValueError(
+                    f"{col}: column assignment must keep shape {view.shape}, "
+                    f"got {arr.shape}")
+            view[...] = arr
+
+        return property(get, set_)
+
+    for c in Validators._COLUMNS:
+        setattr(Validators, c, make(c))
+
+
+_install_column_views()
 
 
 class ValidatorRegistryType(SSZType):
