@@ -1,0 +1,181 @@
+"""Gossip sync-committee message + contribution verification.
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/
+sync_committee_verification.rs (batch verify at :670): timing/membership/
+duplicate checks produce SignatureSets that ride the same batched BLS
+bridge as attestations, with log-depth bisection fallback on batch
+failure.  Committee membership is resolved columnar (pubkey rows compared
+vectorized), not via per-validator dict walks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import signature_sets as sigs
+
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+
+
+class SyncCommitteeError(ValueError):
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
+@dataclass
+class VerifiedSyncItem:
+    item: object
+    sets: list
+    observations: list = field(default_factory=list)
+    ok: bool = False
+    # for messages: subnet positions for pool insertion
+    positions: list = field(default_factory=list)
+
+
+def is_sync_aggregator(spec, selection_proof: bytes) -> bool:
+    """Spec is_sync_committee_aggregator (selection-proof hash election)."""
+    modulo = max(1, spec.preset.sync_committee_size
+                 // spec.sync_committee_subnet_count
+                 // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
+def committee_positions(pubkey_rows: np.ndarray, pubkey: bytes) -> np.ndarray:
+    """All positions of `pubkey` in the committee (vectorized row match)."""
+    target = np.frombuffer(pubkey, dtype=np.uint8)
+    return np.nonzero((pubkey_rows == target).all(axis=1))[0]
+
+
+def subnet_positions(spec, positions: np.ndarray) -> dict[int, list[int]]:
+    """committee positions -> {subnet: [position within subcommittee]}."""
+    sub_size = (spec.preset.sync_committee_size
+                // spec.sync_committee_subnet_count)
+    out: dict[int, list[int]] = {}
+    for p in positions:
+        out.setdefault(int(p) // sub_size, []).append(int(p) % sub_size)
+    return out
+
+
+def _check_slot(chain, slot: int) -> None:
+    current = chain.current_slot()
+    # one slot of clock disparity, as the reference's gossip window
+    if not (current - 1 <= slot <= current):
+        raise SyncCommitteeError("slot_not_current")
+
+
+def verify_sync_message_for_gossip(
+    chain, message, subnet_id: int, state
+) -> VerifiedSyncItem:
+    spec = chain.spec
+    slot = int(message.slot)
+    _check_slot(chain, slot)
+    vindex = int(message.validator_index)
+    if vindex >= len(state.validators):
+        raise SyncCommitteeError("unknown_validator")
+    rows = chain.sync_committee_rows(state, slot)
+    pubkey = state.validators.pubkeys[vindex].tobytes()
+    positions = committee_positions(rows, pubkey)
+    by_subnet = subnet_positions(spec, positions)
+    if subnet_id not in by_subnet:
+        raise SyncCommitteeError("validator_not_on_subnet")
+    key = vindex * spec.sync_committee_subnet_count + int(subnet_id)
+    if chain.observed_sync_contributors.is_seen(slot, key):
+        raise SyncCommitteeError("prior_message_known")
+    sset = sigs.sync_committee_message_set(state, spec, message)
+    return VerifiedSyncItem(
+        message, [sset],
+        observations=[("contributor", slot, key)],
+        positions=[(subnet_id, p) for p in by_subnet[subnet_id]])
+
+
+def verify_contribution_for_gossip(chain, signed, state) -> VerifiedSyncItem:
+    spec = chain.spec
+    msg = signed.message
+    contribution = msg.contribution
+    slot = int(contribution.slot)
+    _check_slot(chain, slot)
+    subnet = int(contribution.subcommittee_index)
+    if subnet >= spec.sync_committee_subnet_count:
+        raise SyncCommitteeError("invalid_subcommittee_index")
+    if not any(contribution.aggregation_bits):
+        raise SyncCommitteeError("empty_aggregation_bits")
+    aggregator = int(msg.aggregator_index)
+    if aggregator >= len(state.validators):
+        raise SyncCommitteeError("unknown_aggregator")
+    rows = chain.sync_committee_rows(state, slot)
+    pubkey = state.validators.pubkeys[aggregator].tobytes()
+    by_subnet = subnet_positions(
+        spec, committee_positions(rows, pubkey))
+    if subnet not in by_subnet:
+        raise SyncCommitteeError("aggregator_not_in_subcommittee")
+    if not is_sync_aggregator(spec, bytes(msg.selection_proof)):
+        raise SyncCommitteeError("invalid_selection_proof_not_aggregator")
+    agg_key = aggregator * spec.sync_committee_subnet_count + subnet
+    if chain.observed_sync_aggregators.is_seen(slot, agg_key):
+        raise SyncCommitteeError("aggregator_already_known")
+    digest = (contribution.beacon_block_root
+              + bytes([subnet])
+              + bytes(np.packbits(np.asarray(contribution.aggregation_bits))))
+    if chain.observed_contributions.is_seen(slot, digest):
+        raise SyncCommitteeError("contribution_already_known")
+
+    sub_size = (spec.preset.sync_committee_size
+                // spec.sync_committee_subnet_count)
+    sub_pubkeys = [rows[subnet * sub_size + i].tobytes()
+                   for i in range(sub_size)]
+    sets = [
+        sigs.sync_selection_proof_set(
+            state, spec, slot, subnet, aggregator,
+            bytes(msg.selection_proof)),
+        sigs.contribution_and_proof_set(state, spec, signed),
+        sigs.sync_committee_contribution_set(
+            state, spec, contribution, sub_pubkeys),
+    ]
+    return VerifiedSyncItem(
+        signed, sets,
+        observations=[("aggregator", slot, agg_key),
+                      ("contribution", slot, digest)])
+
+
+def commit_observations(chain, verified: VerifiedSyncItem) -> bool:
+    ok = True
+    for kind, slot, payload in verified.observations:
+        if kind == "contributor":
+            if chain.observed_sync_contributors.observe(slot, payload):
+                ok = False
+        elif kind == "aggregator":
+            if chain.observed_sync_aggregators.observe(slot, payload):
+                ok = False
+        elif kind == "contribution":
+            if chain.observed_contributions.observe(slot, payload):
+                ok = False
+    return ok
+
+
+def batch_verify(chain, candidates: list[VerifiedSyncItem]
+                 ) -> list[VerifiedSyncItem]:
+    """Shared batched-BLS path (duck-typed with attestation batching)."""
+    from lighthouse_tpu.chain.attestation_verification import (
+        verify_signature_sets_with_bisection,
+    )
+
+    all_sets, spans = [], []
+    for c in candidates:
+        spans.append((len(all_sets), len(all_sets) + len(c.sets)))
+        all_sets.extend(c.sets)
+    if not all_sets:
+        return candidates
+    if bls.verify_signature_sets(all_sets):
+        for c in candidates:
+            c.ok = True
+        return candidates
+    mask = verify_signature_sets_with_bisection(all_sets)
+    for c, (lo, hi) in zip(candidates, spans):
+        c.ok = bool(mask[lo:hi].all())
+    return candidates

@@ -1,0 +1,22 @@
+"""Eth1 deposit follower + genesis (reference beacon_node/eth1,
+beacon_node/genesis)."""
+
+from lighthouse_tpu.eth1.deposit_tree import DepositTree
+from lighthouse_tpu.eth1.service import (
+    DepositLog,
+    Eth1Block,
+    Eth1GenesisService,
+    Eth1Service,
+    Eth1ServiceConfig,
+    MockEth1Endpoint,
+)
+
+__all__ = [
+    "DepositLog",
+    "DepositTree",
+    "Eth1Block",
+    "Eth1GenesisService",
+    "Eth1Service",
+    "Eth1ServiceConfig",
+    "MockEth1Endpoint",
+]

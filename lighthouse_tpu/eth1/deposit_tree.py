@@ -1,0 +1,75 @@
+"""Incremental deposit-contract merkle tree (depth 32) with proofs.
+
+Rebuild of the deposit-tree logic the reference gets from its
+`deposit_contract`/merkle code (/root/reference/common/deposit_contract,
+consensus/merkle_proof): the classic incremental algorithm the contract
+itself runs (branch array of left siblings), extended with full-leaf
+retention so inclusion proofs for any (index, count) pair can be built —
+what `process_deposit`'s `is_valid_merkle_branch` verifies against
+`eth1_data.deposit_root` (block_processing.py:436).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+class DepositTree:
+    def __init__(self):
+        self.leaves: list[bytes] = []
+        self._zeros = [b"\x00" * 32]
+        for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            self._zeros.append(_h(self._zeros[-1], self._zeros[-1]))
+
+    def push(self, deposit_data_root: bytes) -> None:
+        self.leaves.append(bytes(deposit_data_root))
+
+    def __len__(self) -> int:
+        return len(self.leaves)
+
+    def _root_at(self, count: int) -> bytes:
+        """Tree root over the first `count` leaves (no length mix-in)."""
+        level = self.leaves[:count]
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if len(level) % 2:
+                level = level + [self._zeros[d]]
+            level = [_h(level[i], level[i + 1])
+                     for i in range(0, len(level), 2)]
+            if not level:
+                level = [self._zeros[d + 1]]
+        return level[0]
+
+    def root(self, count: int | None = None) -> bytes:
+        """deposit_root as the contract reports it: tree root mixed with
+        the deposit count (SSZ List semantics)."""
+        n = len(self.leaves) if count is None else count
+        return _h(self._root_at(n), n.to_bytes(32, "little"))
+
+    def proof(self, index: int, count: int | None = None) -> list[bytes]:
+        """33-element branch (32 siblings + length mix-in) proving leaf
+        `index` against root(count)."""
+        n = len(self.leaves) if count is None else count
+        if not 0 <= index < n:
+            raise IndexError("deposit index outside tree")
+        level = [bytes(x) for x in self.leaves[:n]]
+        path = []
+        idx = index
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if len(level) % 2:
+                level = level + [self._zeros[d]]
+            sibling = idx ^ 1
+            path.append(level[sibling] if sibling < len(level)
+                        else self._zeros[d])
+            level = [_h(level[i], level[i + 1])
+                     for i in range(0, len(level), 2)]
+            if not level:
+                level = [self._zeros[d + 1]]
+            idx //= 2
+        path.append(n.to_bytes(32, "little"))
+        return path

@@ -1,0 +1,233 @@
+"""Eth1 deposit-contract follower + eth1data voting + eth1 genesis.
+
+Rebuild of /root/reference/beacon_node/eth1/src/service.rs:393-463 and
+beacon_node/genesis/src/eth1_genesis_service.rs: poll an execution
+endpoint for deposit logs and eth1 blocks into caches, serve
+`get_eth1_vote` for block production (majority vote within the voting
+period, else the follow-distance candidate), and drive genesis from
+deposit events once the min-validator/genesis-time conditions hold.
+
+The endpoint interface is the tiny slice of eth JSON-RPC the reference
+uses (blockNumber / getBlockByNumber / deposit logs); `MockEth1Endpoint`
+implements it in-process and is also served over HTTP by the mock EL.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.eth1.deposit_tree import DepositTree
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_count: int
+    deposit_root: bytes
+
+
+@dataclass
+class DepositLog:
+    index: int
+    block_number: int
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    signature: bytes
+
+    def to_deposit_data(self):
+        return T.DepositData(
+            pubkey=self.pubkey,
+            withdrawal_credentials=self.withdrawal_credentials,
+            amount=self.amount, signature=self.signature)
+
+
+class MockEth1Endpoint:
+    """In-process deposit-contract chain for tests/genesis drills."""
+
+    def __init__(self, seconds_per_block: int = 14, genesis_time: int = 0):
+        self.seconds_per_block = seconds_per_block
+        self.blocks: list[Eth1Block] = [Eth1Block(
+            0, b"\x11" * 32, genesis_time, 0, DepositTree().root(0))]
+        self.logs: list[DepositLog] = []
+        self.tree = DepositTree()
+
+    def add_deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                    amount: int, signature: bytes) -> DepositLog:
+        log = DepositLog(
+            index=len(self.logs), block_number=len(self.blocks),
+            pubkey=pubkey, withdrawal_credentials=withdrawal_credentials,
+            amount=amount, signature=signature)
+        self.logs.append(log)
+        self.tree.push(log.to_deposit_data().hash_tree_root())
+        self.mine_block()
+        return log
+
+    def mine_block(self) -> Eth1Block:
+        prev = self.blocks[-1]
+        import hashlib
+
+        num = prev.number + 1
+        blk = Eth1Block(
+            number=num,
+            hash=hashlib.sha256(b"eth1" + num.to_bytes(8, "little")).digest(),
+            timestamp=prev.timestamp + self.seconds_per_block,
+            deposit_count=len(self.logs),
+            deposit_root=self.tree.root(len(self.logs)))
+        self.blocks.append(blk)
+        return blk
+
+    # -- the JSON-RPC-shaped read interface -------------------------------
+
+    def block_number(self) -> int:
+        return self.blocks[-1].number
+
+    def block_by_number(self, number: int) -> Eth1Block | None:
+        return self.blocks[number] if 0 <= number < len(self.blocks) else None
+
+    def deposit_logs_in_range(self, lo: int, hi: int) -> list[DepositLog]:
+        return [l for l in self.logs if lo <= l.block_number < hi]
+
+
+@dataclass
+class Eth1ServiceConfig:
+    follow_distance: int = 16
+    max_blocks_per_poll: int = 1024
+
+
+class Eth1Service:
+    """Deposit/block cache updater (reference service.rs update loop)."""
+
+    def __init__(self, endpoint, spec: T.ChainSpec,
+                 config: Eth1ServiceConfig | None = None):
+        self.endpoint = endpoint
+        self.spec = spec
+        self.config = config or Eth1ServiceConfig()
+        self.blocks: list[Eth1Block] = []
+        self.deposits: list[DepositLog] = []
+        self.tree = DepositTree()
+        self._next_block = 0
+
+    def update(self) -> int:
+        """One poll: ingest new blocks (up to the follow head) + logs.
+        Returns how many blocks were ingested."""
+        head = self.endpoint.block_number()
+        target = max(head - self.config.follow_distance, 0)
+        n = 0
+        while (self._next_block <= target
+               and n < self.config.max_blocks_per_poll):
+            blk = self.endpoint.block_by_number(self._next_block)
+            if blk is None:
+                break
+            for log in self.endpoint.deposit_logs_in_range(
+                    self._next_block, self._next_block + 1):
+                self.deposits.append(log)
+                self.tree.push(log.to_deposit_data().hash_tree_root())
+            self.blocks.append(blk)
+            self._next_block += 1
+            n += 1
+        return n
+
+    # -- eth1data voting (reference: eth1_chain.rs vote calculation) ------
+
+    def eth1_data_for_block(self, block: Eth1Block) -> T.Eth1Data:
+        return T.Eth1Data(
+            deposit_root=block.deposit_root,
+            deposit_count=block.deposit_count,
+            block_hash=block.hash)
+
+    def get_eth1_vote(self, state) -> T.Eth1Data:
+        spec = self.spec
+        period_slots = (spec.preset.epochs_per_eth1_voting_period
+                        * spec.slots_per_epoch)
+        period_start_slot = (int(state.slot) // period_slots) * period_slots
+        period_start_time = (int(state.genesis_time)
+                             + period_start_slot * spec.seconds_per_slot)
+        lookahead = (self.config.follow_distance
+                     * 14)  # seconds per eth1 block, spec-nominal
+        candidates = [b for b in self.blocks
+                      if b.timestamp + lookahead <= period_start_time
+                      and b.deposit_count
+                      >= int(state.eth1_data.deposit_count)]
+        votes = {}
+        for vote in state.eth1_data_votes:
+            key = (bytes(vote.deposit_root), int(vote.deposit_count),
+                   bytes(vote.block_hash))
+            votes[key] = votes.get(key, 0) + 1
+        valid_keys = {(bytes(b.deposit_root), b.deposit_count, b.hash)
+                      for b in candidates}
+        cast = [(count, key) for key, count in votes.items()
+                if key in valid_keys]
+        if cast:
+            _, key = max(cast)
+            return T.Eth1Data(deposit_root=key[0], deposit_count=key[1],
+                              block_hash=key[2])
+        if candidates:
+            b = candidates[-1]
+            return self.eth1_data_for_block(b)
+        return state.eth1_data
+
+    def deposits_for_inclusion(self, state, max_deposits: int,
+                               eth1_data=None) -> list:
+        """Deposits [state.eth1_deposit_index, …) with proofs against the
+        given eth1_data root — the POST-vote data when the block's vote
+        reaches majority (reference deposit_cache get_deposits)."""
+        data = eth1_data if eth1_data is not None else state.eth1_data
+        start = int(state.eth1_deposit_index)
+        count = int(data.deposit_count)
+        end = min(start + max_deposits, count, len(self.deposits))
+        out = []
+        for i in range(start, end):
+            log = self.deposits[i]
+            out.append(T.Deposit(
+                proof=self.tree.proof(i, count),
+                data=log.to_deposit_data()))
+        return out
+
+
+class Eth1GenesisService:
+    """Drive genesis from deposit-contract events
+    (reference eth1_genesis_service.rs): wait until enough valid deposits
+    and a genesis time, then build the genesis state by applying the
+    deposits in order."""
+
+    def __init__(self, eth1: Eth1Service, spec: T.ChainSpec,
+                 fork: str = "phase0"):
+        self.eth1 = eth1
+        self.spec = spec
+        self.fork = fork
+
+    def try_genesis(self, min_validators: int | None = None):
+        """One attempt: returns the genesis BeaconState or None."""
+        from lighthouse_tpu.state_transition import genesis as gen
+        from lighthouse_tpu.state_transition.block_processing import (
+            apply_deposit,
+        )
+
+        spec = self.spec
+        need = (min_validators if min_validators is not None
+                else spec.min_genesis_active_validator_count)
+        if len(self.eth1.deposits) < need or not self.eth1.blocks:
+            return None
+        anchor = self.eth1.blocks[-1]
+        state = gen.genesis_state(0, spec, self.fork,
+                                  genesis_time=anchor.timestamp
+                                  + spec.genesis_delay)
+        count = len(self.eth1.deposits)
+        state.eth1_data = T.Eth1Data(
+            deposit_root=self.eth1.tree.root(count),
+            deposit_count=count, block_hash=anchor.hash)
+        for log in self.eth1.deposits:
+            apply_deposit(state, spec, log.to_deposit_data())
+            state.eth1_deposit_index += 1
+        if len(state.validators) < need:
+            return None  # some deposits had invalid signatures
+        state.genesis_validators_root = T.ValidatorRegistryType(
+            spec.preset.validator_registry_limit
+        ).hash_tree_root(state.validators)
+        return state

@@ -1,0 +1,32 @@
+"""Execution layer (engine API client, orchestration, mock EL).
+
+Reference: /root/reference/beacon_node/execution_layer.
+"""
+
+from lighthouse_tpu.execution.engine_api import (
+    EngineApiClient,
+    EngineApiError,
+    EngineConnectionError,
+    jwt_token,
+    payload_attributes,
+    payload_to_json,
+)
+from lighthouse_tpu.execution.execution_layer import (
+    ExecutionLayer,
+    NoEngineAvailable,
+    PayloadStatus,
+)
+from lighthouse_tpu.execution.mock_el import MockExecutionLayer
+
+__all__ = [
+    "EngineApiClient",
+    "EngineApiError",
+    "EngineConnectionError",
+    "ExecutionLayer",
+    "MockExecutionLayer",
+    "NoEngineAvailable",
+    "PayloadStatus",
+    "jwt_token",
+    "payload_attributes",
+    "payload_to_json",
+]

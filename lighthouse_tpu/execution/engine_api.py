@@ -1,0 +1,206 @@
+"""Engine API JSON-RPC client (consensus ⇄ execution boundary).
+
+Rebuild of /root/reference/beacon_node/execution_layer/src/engine_api/
+http.rs:34-47: engine_newPayloadV1-3, engine_forkchoiceUpdatedV1-3,
+engine_getPayloadV1-3, engine_exchangeCapabilities over HTTP JSON-RPC
+with JWT (HS256) bearer auth.  stdlib only — hmac for the JWT, urllib
+for transport.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import time
+import urllib.error
+import urllib.request
+
+
+class EngineApiError(Exception):
+    pass
+
+
+class EngineConnectionError(EngineApiError):
+    """Transport-level failure — triggers engine failover."""
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def jwt_token(secret: bytes, iat: int | None = None) -> str:
+    """HS256 JWT with an iat claim, as the engine API's auth demands."""
+    header = _b64url(json.dumps(
+        {"alg": "HS256", "typ": "JWT"}, separators=(",", ":")).encode())
+    payload = _b64url(json.dumps(
+        {"iat": int(iat if iat is not None else time.time())},
+        separators=(",", ":")).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(secret, signing_input, "sha256").digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def _hex(data: bytes) -> str:
+    return "0x" + bytes(data).hex()
+
+
+def _hex_int(value: int) -> str:
+    return hex(int(value))
+
+
+def payload_to_json(payload) -> dict:
+    """ExecutionPayload container -> engine API JSON form."""
+    out = {
+        "parentHash": _hex(payload.parent_hash),
+        "feeRecipient": _hex(payload.fee_recipient),
+        "stateRoot": _hex(payload.state_root),
+        "receiptsRoot": _hex(payload.receipts_root),
+        "logsBloom": _hex(payload.logs_bloom),
+        "prevRandao": _hex(payload.prev_randao),
+        "blockNumber": _hex_int(payload.block_number),
+        "gasLimit": _hex_int(payload.gas_limit),
+        "gasUsed": _hex_int(payload.gas_used),
+        "timestamp": _hex_int(payload.timestamp),
+        "extraData": _hex(payload.extra_data),
+        "baseFeePerGas": _hex_int(payload.base_fee_per_gas),
+        "blockHash": _hex(payload.block_hash),
+        "transactions": [_hex(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [{
+            "index": _hex_int(w.index),
+            "validatorIndex": _hex_int(w.validator_index),
+            "address": _hex(w.address),
+            "amount": _hex_int(w.amount),
+        } for w in payload.withdrawals]
+    if hasattr(payload, "blob_gas_used"):
+        out["blobGasUsed"] = _hex_int(payload.blob_gas_used)
+        out["excessBlobGas"] = _hex_int(payload.excess_blob_gas)
+    return out
+
+
+def json_to_payload_kwargs(obj: dict) -> dict:
+    """Engine API JSON payload -> kwargs for our payload containers."""
+    def b(h):
+        return bytes.fromhex(h[2:])
+
+    def i(h):
+        return int(h, 16)
+
+    kw = dict(
+        parent_hash=b(obj["parentHash"]),
+        fee_recipient=b(obj["feeRecipient"]),
+        state_root=b(obj["stateRoot"]),
+        receipts_root=b(obj["receiptsRoot"]),
+        logs_bloom=b(obj["logsBloom"]),
+        prev_randao=b(obj["prevRandao"]),
+        block_number=i(obj["blockNumber"]),
+        gas_limit=i(obj["gasLimit"]),
+        gas_used=i(obj["gasUsed"]),
+        timestamp=i(obj["timestamp"]),
+        extra_data=b(obj["extraData"]),
+        base_fee_per_gas=i(obj["baseFeePerGas"]),
+        block_hash=b(obj["blockHash"]),
+        transactions=[b(tx) for tx in obj.get("transactions", [])],
+    )
+    if "withdrawals" in obj:
+        from lighthouse_tpu.types.containers import Withdrawal
+
+        kw["withdrawals"] = [Withdrawal(
+            index=i(w["index"]), validator_index=i(w["validatorIndex"]),
+            address=b(w["address"]), amount=i(w["amount"]),
+        ) for w in obj["withdrawals"]]
+    if "blobGasUsed" in obj:
+        kw["blob_gas_used"] = i(obj["blobGasUsed"])
+        kw["excess_blob_gas"] = i(obj["excessBlobGas"])
+    return kw
+
+
+class EngineApiClient:
+    """One execution engine endpoint."""
+
+    def __init__(self, url: str, jwt_secret: bytes, timeout_s: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout_s = timeout_s
+        self._id = 0
+
+    def _call(self, method: str, params: list):
+        self._id += 1
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": self._id,
+            "method": method, "params": params,
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {jwt_token(self.jwt_secret)}",
+            })
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                resp = json.loads(r.read())
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise EngineConnectionError(f"{method}: {e}") from e
+        if "error" in resp and resp["error"]:
+            raise EngineApiError(
+                f"{method}: {resp['error'].get('message')}")
+        return resp.get("result")
+
+    # -- engine methods (versioned by fork) -------------------------------
+
+    def exchange_capabilities(self, ours: list[str]) -> list[str]:
+        return self._call("engine_exchangeCapabilities", [ours])
+
+    def new_payload(self, payload, version: int = 2,
+                    versioned_hashes: list[bytes] | None = None,
+                    parent_beacon_block_root: bytes | None = None) -> dict:
+        """V3+ requires the blob versioned hashes and the parent beacon
+        block root — the EL cross-checks both, so callers must thread the
+        real values through (a Deneb block with defaults would be
+        rejected by a spec-conforming engine)."""
+        params = [payload_to_json(payload)]
+        if version >= 3:
+            params += [
+                [_hex(h) for h in (versioned_hashes or [])],
+                _hex(parent_beacon_block_root or b"\x00" * 32),
+            ]
+        return self._call(f"engine_newPayloadV{version}", params)
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes,
+                           attributes: dict | None = None,
+                           version: int = 2) -> dict:
+        state = {
+            "headBlockHash": _hex(head),
+            "safeBlockHash": _hex(safe),
+            "finalizedBlockHash": _hex(finalized),
+        }
+        return self._call(
+            f"engine_forkchoiceUpdatedV{version}", [state, attributes])
+
+    def get_payload(self, payload_id: str, version: int = 2) -> dict:
+        return self._call(f"engine_getPayloadV{version}", [payload_id])
+
+
+def payload_attributes(timestamp: int, prev_randao: bytes,
+                       fee_recipient: bytes,
+                       withdrawals: list | None = None,
+                       parent_beacon_block_root: bytes | None = None) -> dict:
+    attrs = {
+        "timestamp": _hex_int(timestamp),
+        "prevRandao": _hex(prev_randao),
+        "suggestedFeeRecipient": _hex(fee_recipient),
+    }
+    if withdrawals is not None:
+        attrs["withdrawals"] = [{
+            "index": _hex_int(w.index),
+            "validatorIndex": _hex_int(w.validator_index),
+            "address": _hex(w.address),
+            "amount": _hex_int(w.amount),
+        } for w in withdrawals]
+    if parent_beacon_block_root is not None:
+        # PayloadAttributesV3 (Deneb+): a conforming engine rejects
+        # attributes without this field
+        attrs["parentBeaconBlockRoot"] = _hex(parent_beacon_block_root)
+    return attrs

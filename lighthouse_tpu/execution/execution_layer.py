@@ -1,0 +1,147 @@
+"""ExecutionLayer: engine orchestration with failover.
+
+Rebuild of /root/reference/beacon_node/execution_layer/src/lib.rs +
+engines.rs: a primary engine plus fallbacks behind one API; transport
+errors rotate to the next healthy engine (the reference's Engines state
+machine); payload verification runs as a FUTURE so the beacon state
+transition overlaps with the EL's work
+(block_verification.rs:1342-1415 — §2.9-5 pipeline parallelism).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+from lighthouse_tpu.execution.engine_api import (
+    EngineApiClient,
+    EngineApiError,
+    EngineConnectionError,
+    json_to_payload_kwargs,
+    payload_attributes,
+)
+
+
+@dataclass
+class PayloadStatus:
+    status: str                 # VALID | INVALID | SYNCING | ...
+    latest_valid_hash: bytes | None = None
+    validation_error: str | None = None
+
+    @property
+    def is_valid(self) -> bool:
+        return self.status == "VALID"
+
+    @property
+    def is_invalid(self) -> bool:
+        return self.status in ("INVALID", "INVALID_BLOCK_HASH")
+
+    @property
+    def is_optimistic(self) -> bool:
+        return self.status in ("SYNCING", "ACCEPTED")
+
+
+class NoEngineAvailable(EngineApiError):
+    pass
+
+
+class Engine:
+    def __init__(self, client: EngineApiClient):
+        self.client = client
+        self.healthy = True
+
+
+class ExecutionLayer:
+    def __init__(self, engines: list[EngineApiClient],
+                 default_fee_recipient: bytes = b"\x00" * 20):
+        if not engines:
+            raise ValueError("at least one engine endpoint required")
+        self.engines = [Engine(c) for c in engines]
+        self.default_fee_recipient = default_fee_recipient
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="engine-api")
+        self._lock = threading.Lock()
+
+    # -- failover ----------------------------------------------------------
+
+    def _first_healthy(self) -> list[Engine]:
+        ordered = sorted(self.engines, key=lambda e: not e.healthy)
+        return ordered
+
+    def _with_failover(self, fn):
+        last_err: Exception | None = None
+        for engine in self._first_healthy():
+            try:
+                out = fn(engine.client)
+                engine.healthy = True
+                return out
+            except EngineConnectionError as e:
+                engine.healthy = False
+                last_err = e
+        raise NoEngineAvailable(f"all engines offline: {last_err}")
+
+    # -- API ----------------------------------------------------------------
+
+    def notify_new_payload(self, payload, version: int = 2,
+                           versioned_hashes: list[bytes] | None = None,
+                           parent_beacon_block_root: bytes | None = None
+                           ) -> PayloadStatus:
+        def call(client):
+            r = client.new_payload(
+                payload, version=version,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=parent_beacon_block_root)
+            lvh = r.get("latestValidHash")
+            return PayloadStatus(
+                r["status"],
+                bytes.fromhex(lvh[2:]) if lvh else None,
+                r.get("validationError"))
+
+        return self._with_failover(call)
+
+    def notify_new_payload_async(self, payload, version: int = 2,
+                                 versioned_hashes: list[bytes] | None = None,
+                                 parent_beacon_block_root: bytes | None = None
+                                 ) -> Future:
+        """The payload-verification future joined at import time."""
+        return self._pool.submit(
+            self.notify_new_payload, payload, version,
+            versioned_hashes, parent_beacon_block_root)
+
+    def notify_forkchoice_updated(
+        self, head: bytes, safe: bytes, finalized: bytes,
+        attributes: dict | None = None, version: int = 2
+    ) -> tuple[PayloadStatus, str | None]:
+        def call(client):
+            r = client.forkchoice_updated(
+                head, safe, finalized, attributes, version=version)
+            ps = r["payloadStatus"]
+            return (PayloadStatus(ps["status"], None,
+                                  ps.get("validationError")),
+                    r.get("payloadId"))
+
+        return self._with_failover(call)
+
+    def prepare_payload(self, head_block_hash: bytes, timestamp: int,
+                        prev_randao: bytes, withdrawals: list | None = None,
+                        fee_recipient: bytes | None = None,
+                        version: int = 2,
+                        parent_beacon_block_root: bytes | None = None
+                        ) -> str | None:
+        attrs = payload_attributes(
+            timestamp, prev_randao,
+            fee_recipient or self.default_fee_recipient, withdrawals,
+            parent_beacon_block_root if version >= 3 else None)
+        _, payload_id = self.notify_forkchoice_updated(
+            head_block_hash, head_block_hash, b"\x00" * 32, attrs,
+            version=version)
+        return payload_id
+
+    def get_payload(self, payload_id: str, payload_cls, version: int = 2):
+        def call(client):
+            r = client.get_payload(payload_id, version=version)
+            obj = r["executionPayload"] if "executionPayload" in r else r
+            return payload_cls(**json_to_payload_kwargs(obj))
+
+        return self._with_failover(call)

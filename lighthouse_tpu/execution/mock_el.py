@@ -1,0 +1,218 @@
+"""Mock execution engine: in-process JSON-RPC server + block generator.
+
+Rebuild of /root/reference/beacon_node/execution_layer/src/test_utils/
+(MockExecutionLayer, ExecutionBlockGenerator, handle_rpc.rs): an
+in-memory execution chain that answers engine_newPayload /
+engine_forkchoiceUpdated / engine_getPayload over real HTTP with JWT
+checking, plus fault-injection hooks (static status overrides) the test
+suite uses to exercise optimistic sync and invalid-payload handling.
+
+Block hashes are sha256 over the canonical payload JSON (opaque to the
+consensus layer; a mock needs determinism, not keccak).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu.execution.engine_api import (
+    json_to_payload_kwargs,
+    payload_to_json,
+)
+
+
+def compute_block_hash(payload_json: dict) -> bytes:
+    scrubbed = {k: v for k, v in payload_json.items() if k != "blockHash"}
+    return hashlib.sha256(
+        json.dumps(scrubbed, sort_keys=True).encode()).digest()
+
+
+class ExecutionBlockGenerator:
+    """In-memory execution block tree + payload production."""
+
+    def __init__(self, terminal_block_hash: bytes = b"\x00" * 32):
+        self.blocks: dict[bytes, dict] = {}
+        self.head_hash = terminal_block_hash
+        self.finalized_hash = b"\x00" * 32
+        self.pending: dict[str, dict] = {}  # payload_id -> attributes
+        self._next_payload_id = 1
+        self._next_block_number = 1
+
+    def new_payload(self, payload_json: dict) -> str:
+        block_hash = bytes.fromhex(payload_json["blockHash"][2:])
+        if compute_block_hash(payload_json) != block_hash:
+            return "INVALID_BLOCK_HASH"
+        parent = bytes.fromhex(payload_json["parentHash"][2:])
+        if parent != b"\x00" * 32 and parent not in self.blocks \
+                and self.blocks:
+            return "SYNCING"
+        self.blocks[block_hash] = payload_json
+        return "VALID"
+
+    def forkchoice_updated(self, head: bytes, finalized: bytes,
+                           attributes: dict | None) -> tuple[str, str | None]:
+        if head != b"\x00" * 32 and self.blocks and head not in self.blocks:
+            return "SYNCING", None
+        self.head_hash = head
+        self.finalized_hash = finalized
+        if attributes is None:
+            return "VALID", None
+        payload_id = f"0x{self._next_payload_id:016x}"
+        self._next_payload_id += 1
+        self.pending[payload_id] = dict(attributes, parent=head)
+        return "VALID", payload_id
+
+    def get_payload(self, payload_id: str) -> dict:
+        attrs = self.pending.pop(payload_id, None)
+        if attrs is None:
+            raise KeyError("Unknown payload")
+        parent = attrs["parent"]
+        parent_block = self.blocks.get(parent)
+        number = (int(parent_block["blockNumber"], 16) + 1
+                  if parent_block else self._next_block_number)
+        self._next_block_number = number + 1
+        payload = {
+            "parentHash": "0x" + bytes(parent).hex(),
+            "feeRecipient": attrs["suggestedFeeRecipient"],
+            "stateRoot": "0x" + hashlib.sha256(
+                f"state{number}".encode()).hexdigest(),
+            "receiptsRoot": "0x" + hashlib.sha256(b"receipts").hexdigest(),
+            "logsBloom": "0x" + "00" * 256,
+            "prevRandao": attrs["prevRandao"],
+            "blockNumber": hex(number),
+            "gasLimit": hex(30_000_000),
+            "gasUsed": hex(21_000),
+            "timestamp": attrs["timestamp"],
+            "extraData": "0x",
+            "baseFeePerGas": hex(7),
+            "transactions": [],
+        }
+        if "withdrawals" in attrs:
+            payload["withdrawals"] = attrs["withdrawals"]
+        payload["blockHash"] = "0x" + compute_block_hash(payload).hex()
+        return payload
+
+
+class MockExecutionEngine:
+    """JSON-RPC dispatch + fault injection over the generator."""
+
+    def __init__(self, jwt_secret: bytes = b"\x42" * 32):
+        self.jwt_secret = jwt_secret
+        self.generator = ExecutionBlockGenerator()
+        self.static_new_payload_status: str | None = None
+        self.static_fcu_status: str | None = None
+        self.lock = threading.Lock()
+
+    def handle(self, method: str, params: list):
+        with self.lock:
+            if method == "engine_exchangeCapabilities":
+                return ["engine_newPayloadV1", "engine_newPayloadV2",
+                        "engine_newPayloadV3", "engine_forkchoiceUpdatedV1",
+                        "engine_forkchoiceUpdatedV2",
+                        "engine_forkchoiceUpdatedV3", "engine_getPayloadV1",
+                        "engine_getPayloadV2", "engine_getPayloadV3"]
+            if method.startswith("engine_newPayload"):
+                status = (self.static_new_payload_status
+                          or self.generator.new_payload(params[0]))
+                return {"status": status, "latestValidHash": params[0].get(
+                    "blockHash") if status == "VALID" else None,
+                    "validationError": None}
+            if method.startswith("engine_forkchoiceUpdated"):
+                state, attrs = params[0], params[1] if len(params) > 1 else None
+                status, payload_id = self.generator.forkchoice_updated(
+                    bytes.fromhex(state["headBlockHash"][2:]),
+                    bytes.fromhex(state["finalizedBlockHash"][2:]),
+                    attrs)
+                status = self.static_fcu_status or status
+                return {"payloadStatus": {"status": status,
+                                          "latestValidHash": None,
+                                          "validationError": None},
+                        "payloadId": payload_id}
+            if method.startswith("engine_getPayload"):
+                payload = self.generator.get_payload(params[0])
+                if method.endswith("V1"):
+                    return payload
+                return {"executionPayload": payload,
+                        "blockValue": "0x0"}
+            raise ValueError(f"unknown method {method}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: MockExecutionEngine = None
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        auth = self.headers.get("Authorization", "")
+        if not self._check_jwt(auth):
+            self.send_response(401)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(length))
+        try:
+            result = self.engine.handle(req["method"], req.get("params", []))
+            resp = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+        except Exception as e:
+            resp = {"jsonrpc": "2.0", "id": req.get("id"),
+                    "error": {"code": -32000, "message": str(e)}}
+        payload = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _check_jwt(self, auth: str) -> bool:
+        if not auth.startswith("Bearer "):
+            return False
+        token = auth[len("Bearer "):]
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            import base64
+
+            pad = lambda s: s + "=" * (-len(s) % 4)  # noqa: E731
+            sig = base64.urlsafe_b64decode(pad(sig_b64))
+            expect = hmac.new(self.engine.jwt_secret,
+                              f"{header_b64}.{payload_b64}".encode(),
+                              "sha256").digest()
+            return hmac.compare_digest(sig, expect)
+        except Exception:
+            return False
+
+
+class MockExecutionLayer:
+    """HTTP server wrapper: `url` + direct generator access for tests."""
+
+    def __init__(self, jwt_secret: bytes = b"\x42" * 32,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.engine = MockExecutionEngine(jwt_secret)
+        handler = type("Handler", (_Handler,), {"engine": self.engine})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self.port = self._srv.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self.jwt_secret = jwt_secret
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+
+    def start(self) -> "MockExecutionLayer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+
+
+__all__ = [
+    "ExecutionBlockGenerator",
+    "MockExecutionEngine",
+    "MockExecutionLayer",
+    "compute_block_hash",
+    "json_to_payload_kwargs",
+    "payload_to_json",
+]

@@ -1,0 +1,118 @@
+"""Sync-committee contribution pool.
+
+Rebuild of the sync side of /root/reference/beacon_node/beacon_chain/src/
+naive_aggregation_pool.rs plus the SyncAggregate assembly used by block
+production: verified gossip sync messages OR into per-(slot, block_root,
+subcommittee) contributions; `produce_sync_aggregate` stitches the four
+subcommittee contributions into the block's SyncAggregate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.pool.naive_aggregation import _aggregate
+
+
+class SyncContributionPool:
+    def __init__(self, retained_slots: int = 8):
+        self.retained_slots = retained_slots
+        # (slot, root, subnet) -> (bits np.bool_[sub_size], [signatures])
+        self._entries: dict[tuple, tuple] = {}
+
+    def insert_message(self, message, positions: list[tuple[int, int]],
+                       spec) -> bool:
+        """Fold one verified SyncCommitteeMessage at its (subnet, position)
+        seats.  Returns True if any new bit was contributed."""
+        sub_size = (spec.preset.sync_committee_size
+                    // spec.sync_committee_subnet_count)
+        slot = int(message.slot)
+        root = bytes(message.beacon_block_root)
+        sig = bls.Signature(bytes(message.signature))
+        fresh = False
+        for subnet, pos in positions:
+            key = (slot, root, int(subnet))
+            entry = self._entries.get(key)
+            if entry is None:
+                bits = np.zeros(sub_size, dtype=bool)
+                bits[pos] = True
+                self._entries[key] = (bits, [sig])
+                fresh = True
+                continue
+            bits, sigs = entry
+            if bits[pos]:
+                continue
+            bits[pos] = True
+            sigs.append(sig)
+            fresh = True
+        if fresh:
+            self._prune()
+        return fresh
+
+    def insert_contribution(self, contribution) -> bool:
+        """Fold a whole verified SyncCommitteeContribution (non-overlapping
+        only, as the naive pool semantics demand)."""
+        slot = int(contribution.slot)
+        root = bytes(contribution.beacon_block_root)
+        subnet = int(contribution.subcommittee_index)
+        cbits = np.asarray(contribution.aggregation_bits, dtype=bool)
+        sig = bls.Signature(bytes(contribution.signature))
+        key = (slot, root, subnet)
+        entry = self._entries.get(key)
+        if entry is None:
+            self._entries[key] = (cbits.copy(), [sig])
+            self._prune()
+            return True
+        bits, sigs = entry
+        if (cbits & bits).any() or not (cbits & ~bits).any():
+            return False
+        bits |= cbits
+        sigs.append(sig)
+        return True
+
+    def best_contribution(self, slot: int, root: bytes, subnet: int):
+        entry = self._entries.get((int(slot), bytes(root), int(subnet)))
+        if entry is None:
+            return None
+        bits, sigs = entry
+        return bits.copy(), _aggregate(sigs)
+
+    def produce_sync_aggregate(self, slot: int, root: bytes, spec, t):
+        """SyncAggregate for a block whose parent is `root` at `slot`
+        (reference: get_sync_aggregate in block production)."""
+        size = spec.preset.sync_committee_size
+        sub_size = size // spec.sync_committee_subnet_count
+        bits = np.zeros(size, dtype=bool)
+        sigs = []
+        for subnet in range(spec.sync_committee_subnet_count):
+            best = self.best_contribution(slot, root, subnet)
+            if best is None:
+                continue
+            sub_bits, sig = best
+            bits[subnet * sub_size:(subnet + 1) * sub_size] = sub_bits
+            sigs.append(sig)
+        if not sigs:
+            return t.SyncAggregate(
+                sync_committee_bits=[False] * size,
+                sync_committee_signature=b"\xc0" + b"\x00" * 95)
+        agg = _aggregate(sigs)
+        return t.SyncAggregate(
+            sync_committee_bits=[bool(b) for b in bits],
+            sync_committee_signature=agg.to_bytes()
+            if hasattr(agg, "to_bytes") else bytes(agg))
+
+    def _prune(self):
+        slots = {k[0] for k in self._entries}
+        if len(slots) <= self.retained_slots:
+            return
+        cutoff = sorted(slots)[-self.retained_slots]
+        for k in [k for k in self._entries if k[0] < cutoff]:
+            del self._entries[k]
+
+    def prune_below(self, slot: int):
+        for k in [k for k in self._entries if k[0] < slot]:
+            del self._entries[k]
+
+    def __len__(self):
+        return len(self._entries)

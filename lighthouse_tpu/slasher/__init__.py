@@ -1,0 +1,18 @@
+"""Slasher (reference /root/reference/slasher): surround/double-vote
+detection over columnar (validator × epoch) planes."""
+
+from lighthouse_tpu.slasher.array import SurroundArray
+from lighthouse_tpu.slasher.slasher import (
+    Slasher,
+    SlasherConfig,
+    SlasherService,
+    SlashingsFound,
+)
+
+__all__ = [
+    "Slasher",
+    "SlasherConfig",
+    "SlasherService",
+    "SlashingsFound",
+    "SurroundArray",
+]

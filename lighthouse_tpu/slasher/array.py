@@ -1,0 +1,123 @@
+"""Columnar surround-vote detection engine.
+
+Rebuild of /root/reference/slasher/src/array.rs, redesigned columnar:
+the reference keeps chunked (validator × epoch) u16 min/max-target-
+distance arrays with per-chunk disk pages and lazy running extremes;
+here the whole window lives as two numpy (validator × history) planes
+and every check/update is a vectorized slice over the attesting
+committee — one numpy reduction per (source, target) group instead of
+per-validator chunk walks.
+
+min_plane[v, e % H] = min attestation target by v with source epoch e
+max_plane[v, e % H] = max target likewise (NOVAL sentinels when empty).
+
+For a new attestation (s, t) by committee V:
+  * it SURROUNDS an earlier vote  iff min over e in (s, t) of
+    min_plane[V, e] is < t        (victim has s' > s, t' < t)
+  * it is SURROUNDED by one       iff max over e in (max(0, t-H), s) of
+    max_plane[V, e] is > t        (attacker has s' < s, t' > t)
+
+Epoch indices wrap modulo the history length; advancing the current
+epoch clears the recycled columns (the reference's chunk pruning).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MIN_NOVAL = np.uint32(0xFFFFFFFF)
+MAX_NOVAL = np.uint32(0)
+
+
+class SurroundArray:
+    def __init__(self, n_validators: int, history_length: int = 4096):
+        self.H = int(history_length)
+        self.n = int(n_validators)
+        self.min_plane = np.full((self.n, self.H), MIN_NOVAL, np.uint32)
+        self.max_plane = np.full((self.n, self.H), MAX_NOVAL, np.uint32)
+        # absolute source epoch stored in each column, NONE = -1
+        self.col_epoch = np.full(self.H, -1, np.int64)
+
+    def _ensure_validators(self, max_index: int) -> None:
+        if max_index < self.n:
+            return
+        grow = max(self.n * 2, max_index + 1, 64)
+        for name, noval in (("min_plane", MIN_NOVAL),
+                            ("max_plane", MAX_NOVAL)):
+            old = getattr(self, name)
+            new = np.full((grow, self.H), noval, old.dtype)
+            new[: self.n] = old
+            setattr(self, name, new)
+        self.n = grow
+
+    def _column(self, epoch: int) -> int:
+        """Map an absolute epoch to its column, recycling stale ones."""
+        col = epoch % self.H
+        if self.col_epoch[col] != epoch:
+            self.min_plane[:, col] = MIN_NOVAL
+            self.max_plane[:, col] = MAX_NOVAL
+            self.col_epoch[col] = epoch
+        return col
+
+    def _columns_range(self, lo: int, hi: int) -> np.ndarray:
+        """Valid columns holding sources in [lo, hi) (absolute epochs)."""
+        if hi <= lo:
+            return np.zeros(0, np.int64)
+        epochs = np.arange(max(lo, 0), hi, dtype=np.int64)
+        cols = epochs % self.H
+        live = self.col_epoch[cols] == epochs
+        return cols[live]
+
+    def check_and_insert(
+        self, indices: np.ndarray, source: int, target: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Process one (source, target) group for a whole committee.
+
+        Returns (surrounds_mask, surrounded_mask) over `indices`: which
+        attesters' NEW vote surrounds an older one / is surrounded by an
+        older one.  The vote is recorded either way (the slashing is the
+        caller's to build from the indexed-attestation DB).
+        """
+        indices = np.asarray(indices, np.int64)
+        if indices.size:
+            self._ensure_validators(int(indices.max()))
+        s, t = int(source), int(target)
+
+        # victims of the new vote: sources strictly inside (s, t)
+        cols_in = self._columns_range(s + 1, t)
+        if cols_in.size and indices.size:
+            window = self.min_plane[np.ix_(indices, cols_in)]
+            surrounds = window.min(axis=1) < np.uint32(t)
+        else:
+            surrounds = np.zeros(indices.shape[0], bool)
+
+        # attackers of the new vote: sources strictly before s, targets > t
+        cols_before = self._columns_range(t - self.H + 1, s)
+        if cols_before.size and indices.size:
+            window = self.max_plane[np.ix_(indices, cols_before)]
+            surrounded = window.max(axis=1) > np.uint32(t)
+        else:
+            surrounded = np.zeros(indices.shape[0], bool)
+
+        col = self._column(s)
+        cur_min = self.min_plane[indices, col]
+        cur_max = self.max_plane[indices, col]
+        self.min_plane[indices, col] = np.minimum(cur_min, np.uint32(t))
+        self.max_plane[indices, col] = np.maximum(cur_max, np.uint32(t))
+        return surrounds, surrounded
+
+    def lookup_source_epochs(self, validator: int, lo: int, hi: int
+                             ) -> list[tuple[int, int, int]]:
+        """(source, min_target, max_target) entries for one validator with
+        source in [lo, hi) — used to locate the countervote when building
+        a slashing."""
+        out = []
+        for e in range(max(lo, 0), hi):
+            col = e % self.H
+            if self.col_epoch[col] != e:
+                continue
+            mn = int(self.min_plane[validator, col])
+            mx = int(self.max_plane[validator, col])
+            if mn != int(MIN_NOVAL):
+                out.append((e, mn, mx))
+        return out

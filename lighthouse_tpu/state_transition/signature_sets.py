@@ -161,6 +161,54 @@ def selection_proof_set(state, spec, slot: int, validator_index: int, proof: byt
         bls.Signature(proof), [_pubkey(state, validator_index)], signing_root)
 
 
+def sync_selection_proof_set(state, spec, slot: int, subcommittee_index: int,
+                             validator_index: int, proof: bytes):
+    """Sync-subcommittee aggregator election proof (reference
+    signature_sets.rs sync-committee constructors)."""
+    from lighthouse_tpu.types.containers import SyncAggregatorSelectionData
+
+    domain = misc.get_domain(
+        state, spec, spec.domain_sync_committee_selection_proof,
+        spec.compute_epoch_at_slot(slot))
+    data = SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=subcommittee_index)
+    signing_root = misc.compute_signing_root(data.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(proof), [_pubkey(state, validator_index)], signing_root)
+
+
+def contribution_and_proof_set(state, spec, signed_contribution):
+    msg = signed_contribution.message
+    domain = misc.get_domain(
+        state, spec, spec.domain_contribution_and_proof,
+        spec.compute_epoch_at_slot(int(msg.contribution.slot)))
+    signing_root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(signed_contribution.signature),
+        [_pubkey(state, msg.aggregator_index)],
+        signing_root,
+    )
+
+
+def sync_committee_contribution_set(state, spec, contribution,
+                                    subcommittee_pubkeys):
+    """The contribution signature itself: participating subcommittee
+    members over the beacon block root."""
+    domain = misc.get_domain(
+        state, spec, spec.domain_sync_committee,
+        spec.compute_epoch_at_slot(int(contribution.slot)))
+    signing_root = misc.compute_signing_root(
+        contribution.beacon_block_root, domain)
+    pubkeys = [
+        bls.PublicKey(pk)
+        for pk, bit in zip(subcommittee_pubkeys,
+                           contribution.aggregation_bits)
+        if bit
+    ]
+    return bls.SignatureSet(
+        bls.Signature(contribution.signature), pubkeys, signing_root)
+
+
 def aggregate_and_proof_set(state, spec, signed_aggregate):
     msg = signed_aggregate.message
     domain = misc.get_domain(

@@ -150,6 +150,14 @@ class HotColdDB:
     def block_exists(self, root: bytes) -> bool:
         return self.hot.exists(P_BLOCK + root)
 
+    def iter_hot_blocks(self):
+        """(root, signed_block) for every block in the hot DB — fork
+        choice rebuilds (fork_revert) and admin tooling walk this."""
+        for key, raw in self.hot.iter_prefix(P_BLOCK):
+            slot = int.from_bytes(raw[:8], "little")
+            yield key[len(P_BLOCK):], self._block_cls(slot).deserialize(
+                raw[8:])
+
     def delete_block(self, root: bytes) -> None:
         self.hot.delete(P_BLOCK + root)
 

@@ -158,6 +158,11 @@ class SyncCommitteeMessage(ssz.Container):
     signature: ssz.Bytes96
 
 
+class SyncAggregatorSelectionData(ssz.Container):
+    slot: ssz.uint64
+    subcommittee_index: ssz.uint64
+
+
 class Eth1Block(ssz.Container):
     timestamp: ssz.uint64
     deposit_root: ssz.Bytes32

@@ -1,0 +1,168 @@
+"""Eth1 follower, deposit tree/proofs, eth1data voting, eth1 genesis,
+and deposit inclusion through block production + state transition."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.eth1 import (
+    DepositTree,
+    Eth1GenesisService,
+    Eth1Service,
+    Eth1ServiceConfig,
+    MockEth1Endpoint,
+)
+from lighthouse_tpu.state_transition import misc
+from lighthouse_tpu.state_transition.genesis import interop_secret_key
+
+SPEC = T.ChainSpec.minimal().with_forks_at(0, through="altair")
+
+
+def _deposit_args(i: int, amount: int | None = None):
+    """A correctly-signed deposit for interop validator i."""
+    sk = interop_secret_key(i)
+    pubkey = sk.public_key().to_bytes()
+    wc = b"\x00" + b"\x00" * 11 + pubkey[:20]
+    amt = amount if amount is not None else SPEC.max_effective_balance
+    msg = T.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=wc, amount=amt)
+    domain = misc.compute_domain(
+        SPEC.domain_deposit, SPEC.genesis_fork_version, b"\x00" * 32)
+    root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+    return pubkey, wc, amt, sk.sign(root).to_bytes()
+
+
+class TestDepositTree:
+    def test_proofs_verify_against_root(self):
+        tree = DepositTree()
+        datas = []
+        for i in range(5):
+            data = T.DepositData(
+                pubkey=bytes([i]) * 48,
+                withdrawal_credentials=bytes([i]) * 32,
+                amount=32, signature=b"\x00" * 96)
+            datas.append(data)
+            tree.push(data.hash_tree_root())
+        for count in (1, 3, 5):
+            root = tree.root(count)
+            for idx in range(count):
+                proof = tree.proof(idx, count)
+                assert misc.is_valid_merkle_branch(
+                    datas[idx].hash_tree_root(), proof, 33, idx, root), \
+                    (idx, count)
+
+    def test_proof_outside_count_rejected(self):
+        tree = DepositTree()
+        tree.push(b"\x01" * 32)
+        with pytest.raises(IndexError):
+            tree.proof(1, 1)
+
+
+class TestEth1Service:
+    def test_follow_distance_lags_head(self):
+        ep = MockEth1Endpoint()
+        for i in range(20):
+            ep.mine_block()
+        svc = Eth1Service(ep, SPEC, Eth1ServiceConfig(follow_distance=5))
+        svc.update()
+        assert svc.blocks[-1].number == ep.block_number() - 5
+
+    def test_deposit_logs_ingested_in_order(self):
+        ep = MockEth1Endpoint()
+        for i in range(3):
+            ep.add_deposit(*_deposit_args(i))
+        for _ in range(20):
+            ep.mine_block()
+        svc = Eth1Service(ep, SPEC, Eth1ServiceConfig(follow_distance=2))
+        svc.update()
+        assert [d.index for d in svc.deposits] == [0, 1, 2]
+        assert svc.tree.root(3) == ep.tree.root(3)
+
+    def test_eth1_vote_majority_wins(self):
+        from lighthouse_tpu.state_transition.genesis import genesis_state
+
+        ep = MockEth1Endpoint()
+        for _ in range(40):
+            ep.mine_block()
+        svc = Eth1Service(ep, SPEC, Eth1ServiceConfig(follow_distance=4))
+        svc.update()
+        state = genesis_state(8, SPEC, "altair",
+                              genesis_time=ep.blocks[-1].timestamp + 1000)
+        state.slot = 64
+        # genesis interop state claims 8 deposits; this mock chain has none,
+        # so reset the baseline count or no block qualifies as a candidate
+        state.eth1_data = T.Eth1Data(
+            deposit_root=state.eth1_data.deposit_root, deposit_count=0,
+            block_hash=state.eth1_data.block_hash)
+        candidate = svc.blocks[10]
+        vote = svc.eth1_data_for_block(candidate)
+        state.eth1_data_votes = [vote, vote, svc.eth1_data_for_block(
+            svc.blocks[11])]
+        chosen = svc.get_eth1_vote(state)
+        assert bytes(chosen.block_hash) == candidate.hash
+
+
+class TestEth1Genesis:
+    def test_genesis_from_deposits(self):
+        ep = MockEth1Endpoint(genesis_time=1000)
+        for i in range(8):
+            ep.add_deposit(*_deposit_args(i))
+        svc = Eth1Service(ep, SPEC, Eth1ServiceConfig(follow_distance=0))
+        svc.update()
+        gen = Eth1GenesisService(svc, SPEC, fork="phase0")
+        state = gen.try_genesis(min_validators=8)
+        assert state is not None
+        assert len(state.validators) == 8
+        assert int(state.eth1_data.deposit_count) == 8
+        assert state.genesis_validators_root != b"\x00" * 32
+
+    def test_genesis_waits_for_enough_deposits(self):
+        ep = MockEth1Endpoint()
+        ep.add_deposit(*_deposit_args(0))
+        svc = Eth1Service(ep, SPEC, Eth1ServiceConfig(follow_distance=0))
+        svc.update()
+        gen = Eth1GenesisService(svc, SPEC)
+        assert gen.try_genesis(min_validators=4) is None
+
+
+class TestDepositInclusion:
+    def test_produced_block_includes_pending_deposits(self):
+        """A new deposit observed by the follower flows into the next
+        produced block and grows the registry after the transition."""
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.testing import Harness, interop_secret_key
+        from lighthouse_tpu.validator import (
+            ValidatorClient,
+            ValidatorStore,
+        )
+
+        h = Harness(n_validators=16, fork="altair", real_crypto=False)
+        ep = MockEth1Endpoint()
+        svc = Eth1Service(ep, h.spec, Eth1ServiceConfig(follow_distance=0))
+        # the mock contract: 16 leaves standing in for the genesis
+        # deposits, then one NEW deposit the chain hasn't processed
+        for i in range(16):
+            ep.add_deposit(*_deposit_args(i))
+        ep.add_deposit(*_deposit_args(20))
+        svc.update()
+        # genesis anchor already voted in a block covering all 17 deposits
+        # (voting-period mechanics are covered above); deposit_index stays
+        # at 16, so exactly the new deposit is pending
+        h.state.eth1_data = svc.eth1_data_for_block(svc.blocks[-1])
+        assert int(h.state.eth1_data.deposit_count) == 17
+
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        chain.eth1_service = svc
+        store = ValidatorStore(h.spec,
+                               bytes(h.state.genesis_validators_root))
+        for i in range(16):
+            store.add_validator(interop_secret_key(i), index=i)
+        vc = ValidatorClient(chain, store)
+
+        n_before = len(chain.head_state.validators)
+        chain.slot_clock.set_slot(1)
+        s = vc.run_slot(1)
+        assert s.blocks_proposed == 1
+        assert len(chain.head_state.validators) == n_before + 1
+        assert int(chain.head_state.eth1_deposit_index) == 17
