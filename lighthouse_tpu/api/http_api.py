@@ -51,6 +51,12 @@ class BeaconApi:
         r("POST", r"/eth/v1/beacon/pool/voluntary_exits", self.submit_exit)
         r("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)",
           self.proposer_duties)
+        r("GET", r"/eth/v1/beacon/light_client/bootstrap/(?P<block_root>0x\w+)",
+          self.lc_bootstrap)
+        r("GET", r"/eth/v1/beacon/light_client/optimistic_update",
+          self.lc_optimistic)
+        r("GET", r"/eth/v1/beacon/light_client/finality_update",
+          self.lc_finality)
         r("GET", r"/eth/v1/node/version", self.version)
         r("GET", r"/eth/v1/node/health", self.health)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
@@ -285,6 +291,59 @@ class BeaconApi:
             })
         return {"data": duties}
 
+    def lc_bootstrap(self, block_root, body=None):
+        try:
+            root = bytes.fromhex(block_root[2:])
+        except ValueError:
+            raise ApiError(400, f"bad block root {block_root}")
+        if len(root) != 32:
+            raise ApiError(400, f"bad block root {block_root}")
+        bs = self.chain.light_client.bootstrap(root)
+        if bs is None:
+            raise ApiError(404, "no light-client bootstrap for block")
+        return {"data": {
+            "header": bs.header.to_json(),
+            "current_sync_committee": {
+                "pubkeys": [_hex(pk)
+                            for pk in bs.current_sync_committee.pubkeys],
+                "aggregate_pubkey": _hex(
+                    bs.current_sync_committee.aggregate_pubkey)},
+            "current_sync_committee_branch": [
+                _hex(b) for b in bs.current_sync_committee_branch],
+        }}
+
+    def _lc_update_json(self, upd, with_finality: bool):
+        import numpy as np
+
+        bits = np.asarray(upd.sync_aggregate.sync_committee_bits, bool)
+        out = {
+            "attested_header": upd.attested_header.to_json(),
+            "sync_aggregate": {
+                "sync_committee_bits": _hex(
+                    np.packbits(bits, bitorder="little").tobytes()),
+                "sync_committee_signature": _hex(
+                    upd.sync_aggregate.sync_committee_signature)},
+            "signature_slot": str(upd.signature_slot),
+        }
+        if with_finality:
+            out["finalized_header"] = (
+                upd.finalized_header.to_json()
+                if upd.finalized_header else None)
+            out["finality_branch"] = [_hex(b) for b in upd.finality_branch]
+        return {"data": out}
+
+    def lc_optimistic(self, body=None):
+        upd = self.chain.light_client.latest_optimistic
+        if upd is None:
+            raise ApiError(404, "no optimistic update yet")
+        return self._lc_update_json(upd, with_finality=False)
+
+    def lc_finality(self, body=None):
+        upd = self.chain.light_client.latest_finality
+        if upd is None:
+            raise ApiError(404, "no finality update yet")
+        return self._lc_update_json(upd, with_finality=True)
+
     def version(self, body=None):
         return {"data": {"version": "lighthouse-tpu/0.2.0"}}
 
@@ -313,7 +372,58 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
+    def _stream_events(self):
+        """SSE /eth/v1/events (reference http_api events endpoint).
+        ?topics=head,block filters; ?max_events= / ?timeout= bound the
+        stream (tests + polling clients)."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        topics = q["topics"][0].split(",") if "topics" in q else None
+        max_events = int(q.get("max_events", ["0"])[0]) or None
+        timeout = float(q.get("timeout", ["30"])[0])
+        try:
+            sub = self.api.chain.events.subscribe(topics)
+        except ValueError as e:
+            payload = json.dumps({"code": 400, "message": str(e)}).encode()
+            self.send_response(400)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        import queue as _queue
+        import time as _time
+
+        from lighthouse_tpu.chain.events import EventStream
+
+        sent = 0
+        deadline = _time.time() + timeout
+        try:
+            while _time.time() < deadline:
+                try:
+                    topic, data = sub.get(
+                        timeout=max(deadline - _time.time(), 0.01))
+                except _queue.Empty:
+                    break
+                self.wfile.write(
+                    EventStream.format_sse(topic, data).encode())
+                self.wfile.flush()
+                sent += 1
+                if max_events and sent >= max_events:
+                    break
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.api.chain.events.unsubscribe(sub)
+
     def _run(self, method):
+        if method == "GET" and self.path.split("?")[0] == "/eth/v1/events":
+            self._stream_events()
+            return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         try:

@@ -16,6 +16,7 @@ import numpy as np
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.chain import attestation_verification as att_verify
+from lighthouse_tpu.chain import sync_committee_verification as sync_verify
 from lighthouse_tpu.chain.block_verification import (
     BlockError,
     ExecutionPendingBlock,
@@ -47,6 +48,7 @@ class BeaconChain:
         slot_clock: SlotClock | None = None,
         verify_signatures: bool = True,
         kzg_settings=None,
+        execution_layer=None,
     ):
         self.spec = spec
         self.t = T.make_types(spec.preset)
@@ -55,6 +57,9 @@ class BeaconChain:
             int(genesis_state.genesis_time), spec.seconds_per_slot)
         self.verify_signatures = verify_signatures
 
+        from lighthouse_tpu.ssz.tree_cache import enable_tree_cache
+
+        enable_tree_cache(genesis_state)
         genesis_root = self._anchor_block_root(genesis_state)
         state_root = genesis_state.hash_tree_root()
         self.genesis_block_root = genesis_root
@@ -84,11 +89,28 @@ class BeaconChain:
         self.observed_block_producers = SlotIndexedSeen()
         self.da_checker = DataAvailabilityChecker(spec)
         self.kzg_settings = kzg_settings
+        self.execution_layer = execution_layer
+        self.slasher = None  # attach a SlasherService to enable slashing detection
+        self.eth1_service = None  # attach an Eth1Service for eth1data voting
+        self.state_advance_timer = None  # StateAdvanceTimer.install()
+        from lighthouse_tpu.chain.events import EventStream
+        from lighthouse_tpu.chain.light_client import LightClientServerCache
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+
+        self.events = EventStream()
+        self.validator_monitor = ValidatorMonitor()
+        self.light_client = LightClientServerCache(self)
         self._pending_executed: dict[bytes, object] = {}
         from lighthouse_tpu.pool import NaiveAggregationPool, OperationPool
+        from lighthouse_tpu.pool.sync_contribution import SyncContributionPool
 
         self.op_pool = OperationPool()
         self.naive_pool = NaiveAggregationPool()
+        self.sync_pool = SyncContributionPool()
+        self.observed_sync_contributors = SlotIndexedSeen()
+        self.observed_sync_aggregators = SlotIndexedSeen()
+        self.observed_contributions = ObservedDigests(retained_epochs=64)
+        self._sync_rows_cache: dict[bytes, np.ndarray] = {}
         self.block_times = BlockTimesCache()
         self.metrics: dict[str, float] = {}
         self._migrated_finalized_epoch = self.fork_choice.finalized.epoch
@@ -170,7 +192,14 @@ class BeaconChain:
         t_start = time.perf_counter()
         gossip = verify_block_for_gossip(self, signed_block, source)
         sigv = verify_block_signatures(self, gossip)
+
+        # payload verification runs CONCURRENTLY with the state transition
+        # (reference block_verification.rs:1342-1415 payload future;
+        # SURVEY §2.9-5 pipeline overlap), joined below
+        payload_future = self._spawn_payload_verification(signed_block)
         pending = execute_block(self, sigv)
+        pending.execution_status = self._join_payload_verification(
+            payload_future)
 
         # Deneb data-availability gate (data_availability_checker.rs:32).
         # Callers that ALREADY hold the block's blob data (RPC/backfill
@@ -230,6 +259,52 @@ class BeaconChain:
         blobs_ssz = b"".join(s.serialize() for s in (availability.blobs or []))
         return self.import_block(pending, blobs_ssz or None)
 
+    def _spawn_payload_verification(self, signed_block):
+        """newPayload future when an EL is wired and the block carries a
+        payload; None otherwise."""
+        if self.execution_layer is None:
+            return None
+        payload = getattr(signed_block.message.body, "execution_payload",
+                          None)
+        if payload is None:
+            return None
+        fork = self.spec.fork_at_epoch(self.spec.compute_epoch_at_slot(
+            int(signed_block.message.slot)))
+        version = {"bellatrix": 1, "capella": 2}.get(fork, 3)
+        if version < 3:
+            return self.execution_layer.notify_new_payload_async(
+                payload, version=version)
+        # Deneb+: the EL cross-checks blob versioned hashes and the parent
+        # beacon block root against the payload
+        import hashlib
+
+        commitments = getattr(signed_block.message.body,
+                              "blob_kzg_commitments", [])
+        hashes = [b"\x01" + hashlib.sha256(bytes(c)).digest()[1:]
+                  for c in commitments]
+        return self.execution_layer.notify_new_payload_async(
+            payload, version=version, versioned_hashes=hashes,
+            parent_beacon_block_root=bytes(signed_block.message.parent_root))
+
+    def _join_payload_verification(self, future) -> int:
+        from lighthouse_tpu.fork_choice.proto_array import (
+            EXEC_IRRELEVANT,
+            EXEC_OPTIMISTIC,
+            EXEC_VALID,
+        )
+
+        if future is None:
+            return EXEC_IRRELEVANT
+        try:
+            status = future.result()
+        except Exception:
+            # engine offline: import optimistically, as the reference does
+            return EXEC_OPTIMISTIC
+        if status.is_invalid:
+            raise BlockError(
+                f"payload_invalid: {status.validation_error or status.status}")
+        return EXEC_VALID if status.is_valid else EXEC_OPTIMISTIC
+
     def import_block(self, pending: ExecutionPendingBlock,
                      blobs_ssz: bytes | None = None) -> bytes:
         """Fork choice + atomic DB write + head recompute
@@ -243,7 +318,8 @@ class BeaconChain:
             int(block.slot) == self.slot_clock.current_slot()
             and self.slot_clock.is_timely_for_boost())
         self.fork_choice.on_block(
-            current_slot, block, root, state, is_timely=is_timely)
+            current_slot, block, root, state, is_timely=is_timely,
+            execution_status=getattr(pending, "execution_status", 0))
 
         # apply the block's attestations/slashings to fork choice
         # (block_verification.rs:1654-1688)
@@ -255,6 +331,8 @@ class BeaconChain:
                 shuffle = self.committee_shuffle(
                     state, int(att.data.target.epoch))
                 indices = get_attesting_indices(state, self.spec, att, shuffle)
+                self.validator_monitor.on_attestation_included(
+                    indices, att.data, int(block.slot), self.spec)
                 self.fork_choice.on_attestation(
                     current_slot, indices, bytes(att.data.beacon_block_root),
                     int(att.data.target.epoch), int(att.data.slot),
@@ -268,11 +346,21 @@ class BeaconChain:
             if both.size:
                 self.fork_choice.on_attester_slashing(both)
 
+        if self.slasher is not None:
+            self.slasher.on_block(pending.signed_block)
         self.store.import_block(root, pending.signed_block, state,
                                 pending.state_root, blobs_ssz)
         self._state_root_of_block[root] = pending.state_root
         self.state_cache.insert(pending.state_root, state)
         self.pubkey_cache.import_new(state.validators)
+        self.validator_monitor.on_block_imported(block, self.spec)
+        try:
+            self.light_client.on_block_imported(pending.signed_block)
+        except Exception:
+            pass  # LC serving is best-effort, never blocks import
+        self.events.publish("block", {
+            "slot": str(int(block.slot)), "block": "0x" + root.hex(),
+            "execution_optimistic": pending.execution_status == 1})
         self.recompute_head()
         return root
 
@@ -286,9 +374,39 @@ class BeaconChain:
                 self.head_root = head
                 self.head_state = st
                 self.store.persist_head(head)
+                self.events.publish("head", {
+                    "slot": str(int(st.slot)), "block": "0x" + head.hex(),
+                    "state": "0x" + bytes(
+                        self._state_root_of_block.get(head, b"")).hex(),
+                    "epoch_transition": int(st.slot)
+                    % self.spec.slots_per_epoch == 0})
+                self._notify_forkchoice_updated(st)
         if self.fork_choice.finalized.epoch > self._migrated_finalized_epoch:
             self._on_finalized()
         return self.head_root
+
+    def _notify_forkchoice_updated(self, head_state) -> None:
+        """Push the new head to the EL (reference forkchoiceUpdated on head
+        change).  Best-effort: an offline EL must not stall the chain."""
+        if self.execution_layer is None:
+            return
+        header = getattr(head_state, "latest_execution_payload_header", None)
+        if header is None or bytes(header.block_hash) == b"\x00" * 32:
+            return
+        # finalized payload hash from the stored BLOCK (a few KB) — not the
+        # finalized state, which would be a multi-MB load per head change
+        fin_hash = b"\x00" * 32
+        fin_block = self.store.get_block(self.fork_choice.finalized.root)
+        if fin_block is not None:
+            fin_payload = getattr(
+                fin_block.message.body, "execution_payload", None)
+            if fin_payload is not None:
+                fin_hash = bytes(fin_payload.block_hash)
+        try:
+            self.execution_layer.notify_forkchoice_updated(
+                bytes(header.block_hash), fin_hash, fin_hash)
+        except Exception:
+            pass
 
     def _on_finalized(self):
         """Prune fork choice + migrate the store (reference migrate.rs)."""
@@ -307,6 +425,10 @@ class BeaconChain:
             if int(p.signed_block.message.slot) >= fin_slot}
         self.op_pool.prune(self.head_state, self.spec)
         self.naive_pool.prune_below(fin_slot)
+        self.sync_pool.prune_below(fin_slot)
+        self.validator_monitor.prune_below(max(fin.epoch - 2, 0))
+        self.events.publish("finalized_checkpoint", {
+            "epoch": str(fin.epoch), "block": "0x" + fin.root.hex()})
 
     # -- attestation pipelines --------------------------------------------
 
@@ -358,6 +480,9 @@ class BeaconChain:
                 rejects.append((c.item, "duplicate_in_batch"))
                 continue
             verified.append(c)
+            if self.slasher is not None:
+                self.slasher.on_verified_attestation(att_verify._as_indexed(
+                    self, c.attestation, c.indexed_indices))
             try:
                 self.fork_choice.on_attestation(
                     self.current_slot(), c.indexed_indices,
@@ -367,6 +492,78 @@ class BeaconChain:
             except Exception:
                 pass
         return verified, rejects
+
+    # -- sync-committee pipelines -------------------------------------------
+
+    def sync_committee_rows(self, state, slot: int) -> np.ndarray:
+        """Cached uint8[size, 48] pubkey rows of the committee at `slot`."""
+        epoch = self.spec.compute_epoch_at_slot(int(slot))
+        period = epoch // self.spec.preset.epochs_per_sync_committee_period
+        state_epoch = self.spec.compute_epoch_at_slot(int(state.slot))
+        committee = (
+            state.current_sync_committee
+            if period == state_epoch
+            // self.spec.preset.epochs_per_sync_committee_period
+            else state.next_sync_committee)
+        key = bytes(committee.aggregate_pubkey)
+        rows = self._sync_rows_cache.get(key)
+        if rows is None:
+            rows = np.frombuffer(
+                b"".join(bytes(pk) for pk in committee.pubkeys),
+                dtype=np.uint8,
+            ).reshape(self.spec.preset.sync_committee_size, 48)
+            if len(self._sync_rows_cache) > 4:
+                self._sync_rows_cache.clear()
+            self._sync_rows_cache[key] = rows
+        return rows
+
+    def verify_sync_messages_for_gossip(self, messages: list):
+        """Batch-verify (message, subnet_id) pairs and fold the valid ones
+        into the sync-contribution pool (reference
+        sync_committee_verification.rs:670 batch path)."""
+        state = self.head_state
+        candidates, rejects = [], []
+        for message, subnet in messages:
+            try:
+                candidates.append(sync_verify.verify_sync_message_for_gossip(
+                    self, message, subnet, state))
+            except sync_verify.SyncCommitteeError as e:
+                rejects.append(((message, subnet), e.reason))
+        verified = self._finish_sync_batch(candidates, rejects)
+        for v in verified:
+            self.sync_pool.insert_message(v.item, v.positions, self.spec)
+        return verified, rejects
+
+    def verify_contributions_for_gossip(self, signed_contributions: list):
+        """Batch-verify SignedContributionAndProofs (3 sets each)."""
+        state = self.head_state
+        candidates, rejects = [], []
+        for signed in signed_contributions:
+            try:
+                candidates.append(sync_verify.verify_contribution_for_gossip(
+                    self, signed, state))
+            except sync_verify.SyncCommitteeError as e:
+                rejects.append((signed, e.reason))
+        verified = self._finish_sync_batch(candidates, rejects)
+        for v in verified:
+            self.sync_pool.insert_contribution(v.item.message.contribution)
+        return verified, rejects
+
+    def _finish_sync_batch(self, candidates, rejects):
+        if self.verify_signatures:
+            sync_verify.batch_verify(self, candidates)
+        else:
+            for c in candidates:
+                c.ok = True
+        verified = []
+        for c in candidates:
+            if not c.ok:
+                rejects.append((c.item, "invalid_signature"))
+            elif not sync_verify.commit_observations(self, c):
+                rejects.append((c.item, "duplicate_in_batch"))
+            else:
+                verified.append(c)
+        return verified
 
     def _attestation_state(self, item):
         """State to validate an attestation against: the target block's
@@ -399,6 +596,35 @@ class BeaconChain:
 
     # -- block production --------------------------------------------------
 
+    def _produce_payload(self, pre, slot: int, fork: str):
+        """Build the block's payload via the EL (reference
+        execution_layer.get_payload in produce_partial_beacon_block)."""
+        from lighthouse_tpu.state_transition import misc
+        from lighthouse_tpu.state_transition.block_processing import (
+            get_expected_withdrawals,
+        )
+
+        spec = self.spec
+        parent_hash = bytes(
+            pre.latest_execution_payload_header.block_hash)
+        timestamp = int(pre.genesis_time) + slot * spec.seconds_per_slot
+        epoch = spec.compute_epoch_at_slot(slot)
+        prev_randao = bytes(misc.get_randao_mix(pre, spec, epoch))
+        withdrawals = None
+        version = {"bellatrix": 1, "capella": 2}.get(fork, 3)
+        if fork in ("capella", "deneb", "electra"):
+            withdrawals = get_expected_withdrawals(pre, spec)
+        payload_id = self.execution_layer.prepare_payload(
+            parent_hash, timestamp, prev_randao, withdrawals,
+            version=version,
+            parent_beacon_block_root=self.get_proposer_head(slot))
+        if payload_id is None:
+            raise BlockError("el_did_not_return_payload_id")
+        payload_cls = getattr(
+            self.t, f"ExecutionPayload{fork.capitalize()}")
+        return self.execution_layer.get_payload(
+            payload_id, payload_cls, version=version)
+
     def produce_block_on(self, slot: int, randao_reveal: bytes,
                          graffiti: bytes = b"", attestations: list | None = None,
                          sync_aggregate=None, execution_payload=None):
@@ -418,9 +644,15 @@ class BeaconChain:
         spec = self.spec
         fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(slot))
         head_root = self.get_proposer_head(slot)
-        pre = self.state_for_block(head_root).copy()
-        if int(pre.slot) < slot:
-            state_advance(pre, spec, slot)
+        pre = None
+        if self.state_advance_timer is not None:
+            cached = self.state_advance_timer.get(head_root, slot)
+            if cached is not None:
+                pre = cached.copy()
+        if pre is None:
+            pre = self.state_for_block(head_root).copy()
+            if int(pre.slot) < slot:
+                state_advance(pre, spec, slot)
         proposer = misc.get_beacon_proposer_index(pre, spec, slot)
 
         pool_kw = {}
@@ -440,20 +672,40 @@ class BeaconChain:
                 pool_kw["bls_to_execution_changes"] = (
                     self.op_pool.get_bls_to_execution_changes(pre, spec))
 
+        eth1_data = pre.eth1_data
+        deposits = []
+        if self.eth1_service is not None:
+            eth1_data = self.eth1_service.get_eth1_vote(pre)
+            # the transition applies process_eth1_data BEFORE the deposit
+            # count check, so deposits must match the POST-vote eth1_data:
+            # mirror the majority condition here
+            period_slots = (spec.preset.epochs_per_eth1_voting_period
+                            * spec.preset.slots_per_epoch)
+            n_equal = 1 + sum(
+                1 for v in pre.eth1_data_votes if v == eth1_data)
+            effective = (eth1_data if n_equal * 2 > period_slots
+                         else pre.eth1_data)
+            if int(pre.eth1_deposit_index) < int(effective.deposit_count):
+                deposits = self.eth1_service.deposits_for_inclusion(
+                    pre, spec.preset.max_deposits, eth1_data=effective)
         body_kw = dict(
             randao_reveal=randao_reveal,
-            eth1_data=pre.eth1_data,
+            eth1_data=eth1_data,
             graffiti=graffiti.ljust(32, b"\x00")[:32],
             attestations=list(attestations),
+            deposits=deposits,
             **pool_kw,
         )
         if fork != "phase0":
-            body_kw["sync_aggregate"] = (
-                sync_aggregate if sync_aggregate is not None
-                else self.t.SyncAggregate(
-                    sync_committee_bits=[False] * spec.preset.sync_committee_size,
-                    sync_committee_signature=b"\xc0" + b"\x00" * 95))
+            if sync_aggregate is None:
+                # contributions for the parent root at the previous slot
+                # (reference get_sync_aggregate in block production)
+                sync_aggregate = self.sync_pool.produce_sync_aggregate(
+                    slot - 1, head_root, spec, self.t)
+            body_kw["sync_aggregate"] = sync_aggregate
         if fork in ("bellatrix", "capella", "deneb"):
+            if execution_payload is None and self.execution_layer is not None:
+                execution_payload = self._produce_payload(pre, slot, fork)
             if execution_payload is None:
                 raise BlockError("execution_payload_required")
             body_kw["execution_payload"] = execution_payload

@@ -64,6 +64,7 @@ class ExecutionPendingBlock:
     post_state: object
     state_root: bytes
     timings: dict = field(default_factory=dict)
+    execution_status: int = 0  # proto_array EXEC_* (set by the chain)
 
 
 def verify_block_for_gossip(chain, signed_block,

@@ -1,0 +1,50 @@
+"""Server-sent event stream (reference beacon_chain/src/events.rs +
+http_api events endpoint): chain milestones fan out to subscribers.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+
+
+class EventStream:
+    """Bounded fan-out of chain events to SSE subscribers."""
+
+    TOPICS = ("head", "block", "attestation", "finalized_checkpoint",
+              "voluntary_exit", "contribution_and_proof")
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._subs: list[tuple[set, queue.Queue]] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, topics: list[str] | None = None) -> queue.Queue:
+        topic_set = set(topics or self.TOPICS)
+        unknown = topic_set - set(self.TOPICS)
+        if unknown:
+            raise ValueError(f"unknown event topics: {sorted(unknown)}")
+        q: queue.Queue = queue.Queue(self.capacity)
+        with self._lock:
+            self._subs.append((topic_set, q))
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._subs = [(t, s) for t, s in self._subs if s is not q]
+
+    def publish(self, topic: str, data: dict) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for topics, q in subs:
+            if topic not in topics:
+                continue
+            try:
+                q.put_nowait((topic, data))
+            except queue.Full:
+                pass  # slow consumer: drop (reference lagged-receiver drop)
+
+    @staticmethod
+    def format_sse(topic: str, data: dict) -> str:
+        return f"event: {topic}\ndata: {json.dumps(data)}\n\n"

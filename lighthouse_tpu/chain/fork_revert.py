@@ -1,0 +1,60 @@
+"""Fork-choice revert after EL invalidation (reference beacon_chain/src/
+fork_revert.rs): when the execution layer declares the head's payload
+chain invalid, rebuild fork choice from the finalized (or anchor) state
+and replay the still-valid stored blocks, leaving invalidated branches
+out.
+"""
+
+from __future__ import annotations
+
+
+def revert_to_fork_boundary(chain, invalid_root: bytes):
+    """Mark `invalid_root` and its descendants invalid; if the current
+    head is affected, recompute.  When the whole tree above finality is
+    poisoned, rebuild fork choice from the finalized state."""
+    from lighthouse_tpu.fork_choice import ForkChoice
+
+    proto = chain.fork_choice.proto
+    if invalid_root in proto:
+        proto.set_execution_invalid(invalid_root)
+        new_head = chain.recompute_head()
+        if new_head != invalid_root:
+            return new_head
+
+    # head stuck on an invalid branch: rebuild from the finalized state
+    fin = chain.fork_choice.finalized
+    fin_block = chain.store.get_block(fin.root)
+    fin_state = chain.state_for_block(fin.root)
+    if fin_block is None or fin_state is None:
+        raise RuntimeError(
+            "cannot revert: finalized block/state unavailable")
+    chain.fork_choice = ForkChoice(
+        chain.spec, fin.root, fin_state,
+        balances_fn=chain._balances_for_checkpoint)
+    # replay stored non-finalized blocks that do not descend from the
+    # invalid root
+    replayable = []
+    for root, block in chain.store.iter_hot_blocks():
+        if int(block.message.slot) <= int(fin_state.slot):
+            continue
+        replayable.append((int(block.message.slot), root, block))
+    skipped = {bytes(invalid_root)}
+    for slot, root, block in sorted(replayable):
+        parent = bytes(block.message.parent_root)
+        if parent in skipped or root == bytes(invalid_root):
+            skipped.add(root)
+            continue
+        state = chain.state_for_block(root)
+        if state is None:
+            skipped.add(root)
+            continue
+        try:
+            chain.fork_choice.on_block(
+                max(chain.current_slot(), slot), block.message, root, state)
+        except Exception:
+            skipped.add(root)
+    chain.head_root = chain.fork_choice.get_head(chain.current_slot())
+    st = chain.state_for_block(chain.head_root)
+    if st is not None:
+        chain.head_state = st
+    return chain.head_root

@@ -1,0 +1,157 @@
+"""Light-client server: bootstrap/optimistic/finality updates.
+
+Rebuild of /root/reference/beacon_node/beacon_chain/src/
+light_client_server_cache.rs (+ the LC types from consensus/types): the
+chain keeps the latest sync-aggregate-attested header and serves
+
+  * LightClientBootstrap   — header + current sync committee (+ proof)
+  * LightClientOptimisticUpdate — attested header + sync aggregate
+  * LightClientFinalityUpdate   — + finalized header + finality proof
+
+Merkle proofs ride the generalized-index machinery over the state's
+field roots (altair state: current_sync_committee gindex 54, next 55,
+finalized_checkpoint.root gindex 105 — depth 5/6 over the 2^5-padded
+field tree; computed generically below instead of hardcoding offsets).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+
+def _field_proof(state, field_name: str) -> tuple[bytes, list[bytes], int]:
+    """(leaf_root, branch, generalized_index) for a top-level state field
+    against state.hash_tree_root().
+
+    Field roots ride the state's incremental tree cache when present —
+    this runs on the block-import hot path, so a from-scratch registry
+    rehash here would undo the cache's whole point."""
+    cls = type(state)
+    names = list(cls.fields)
+    idx = names.index(field_name)
+    cache = getattr(state, "_tree_cache", None)
+    if cache is not None:
+        leaves = [cache.field_root(fn, ft, getattr(state, fn))
+                  for fn, ft in cls.fields.items()]
+    else:
+        leaves = [ft.hash_tree_root(getattr(state, fn))
+                  for fn, ft in cls.fields.items()]
+    width = 1
+    while width < len(leaves):
+        width *= 2
+    padded = leaves + [b"\x00" * 32] * (width - len(leaves))
+    branch = []
+    pos = idx
+    level = padded
+    while len(level) > 1:
+        sibling = pos ^ 1
+        branch.append(level[sibling])
+        level = [hashlib.sha256(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+        pos //= 2
+    gindex = width + idx
+    return leaves[idx], branch, gindex
+
+
+@dataclass
+class LightClientHeader:
+    slot: int
+    proposer_index: int
+    parent_root: bytes
+    state_root: bytes
+    body_root: bytes
+
+    def to_json(self) -> dict:
+        return {"beacon": {
+            "slot": str(self.slot),
+            "proposer_index": str(self.proposer_index),
+            "parent_root": "0x" + self.parent_root.hex(),
+            "state_root": "0x" + self.state_root.hex(),
+            "body_root": "0x" + self.body_root.hex(),
+        }}
+
+
+@dataclass
+class LightClientBootstrap:
+    header: LightClientHeader
+    current_sync_committee: object
+    current_sync_committee_branch: list
+
+
+@dataclass
+class LightClientOptimisticUpdate:
+    attested_header: LightClientHeader
+    sync_aggregate: object
+    signature_slot: int
+
+
+@dataclass
+class LightClientFinalityUpdate:
+    attested_header: LightClientHeader
+    finalized_header: LightClientHeader | None
+    finality_branch: list
+    sync_aggregate: object
+    signature_slot: int
+
+
+def _header_for(chain, root: bytes) -> LightClientHeader | None:
+    blk = chain.store.get_block(root)
+    if blk is None:
+        return None
+    m = blk.message
+    return LightClientHeader(
+        int(m.slot), int(m.proposer_index), bytes(m.parent_root),
+        bytes(m.state_root), m.body.hash_tree_root())
+
+
+class LightClientServerCache:
+    """Tracks the best sync-aggregate-attested header per slot."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.latest_optimistic: LightClientOptimisticUpdate | None = None
+        self.latest_finality: LightClientFinalityUpdate | None = None
+
+    def on_block_imported(self, signed_block) -> None:
+        """Feed each imported block: its sync aggregate attests the
+        parent."""
+        chain = self.chain
+        body = signed_block.message.body
+        agg = getattr(body, "sync_aggregate", None)
+        if agg is None or not any(agg.sync_committee_bits):
+            return
+        attested_root = bytes(signed_block.message.parent_root)
+        attested = _header_for(chain, attested_root)
+        if attested is None:
+            return
+        sig_slot = int(signed_block.message.slot)
+        self.latest_optimistic = LightClientOptimisticUpdate(
+            attested, agg, sig_slot)
+
+        state = chain.state_for_block(attested_root)
+        if state is None:
+            return
+        fin_root = bytes(state.finalized_checkpoint.root)
+        fin_header = (_header_for(chain, fin_root)
+                      if fin_root != b"\x00" * 32 else None)
+        # finality proof: finalized_checkpoint field root -> state root,
+        # then checkpoint.root inside (epoch, root) 2-leaf subtree
+        leaf, branch, _ = _field_proof(state, "finalized_checkpoint")
+        epoch_leaf = int(state.finalized_checkpoint.epoch).to_bytes(
+            32, "little")
+        finality_branch = [epoch_leaf] + branch
+        self.latest_finality = LightClientFinalityUpdate(
+            attested, fin_header, finality_branch, agg, sig_slot)
+
+    def bootstrap(self, block_root: bytes) -> LightClientBootstrap | None:
+        chain = self.chain
+        header = _header_for(chain, block_root)
+        state = chain.state_for_block(bytes(block_root))
+        if header is None or state is None:
+            return None
+        if not hasattr(state, "current_sync_committee"):
+            return None
+        _, branch, _ = _field_proof(state, "current_sync_committee")
+        return LightClientBootstrap(
+            header, state.current_sync_committee, branch)

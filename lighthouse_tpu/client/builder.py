@@ -1,0 +1,186 @@
+"""ClientBuilder: assemble a full beacon node from config.
+
+Rebuild of /root/reference/beacon_node/client/src/builder.rs: wire
+store -> eth1 -> beacon chain -> processor -> network -> HTTP API ->
+timers -> notifier, each stage optional per config, returning a `Client`
+whose lifecycle the TaskExecutor supervises.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.common.logging import Logger
+from lighthouse_tpu.common.task_executor import TaskExecutor
+
+
+@dataclass
+class ClientConfig:
+    network: str = "devnet"
+    network_config_path: str | None = None
+    datadir: str | None = None          # None = in-memory store
+    http_enabled: bool = True
+    http_port: int = 0                   # 0 = ephemeral
+    metrics_enabled: bool = True
+    execution_endpoint: str | None = None
+    execution_jwt_hex: str | None = None
+    eth1_endpoint: object | None = None  # in-process endpoint object
+    slasher_enabled: bool = False
+    n_genesis_validators: int = 64
+    genesis_fork: str = "capella"
+    verify_signatures: bool = True
+    sync_tolerance_slots: int = 1
+
+
+@dataclass
+class Client:
+    config: ClientConfig
+    spec: object
+    chain: object
+    executor: TaskExecutor
+    http_server: object | None = None
+    processor: object | None = None
+    network: object | None = None
+    services: dict = field(default_factory=dict)
+
+    def stop(self) -> None:
+        if self.http_server is not None:
+            self.http_server.stop()
+        self.executor.shutdown("client stop")
+
+
+class ClientBuilder:
+    def __init__(self, config: ClientConfig):
+        self.config = config
+        self.log = Logger("client")
+        self.spec: T.ChainSpec | None = None
+        self.genesis_state = None
+        self.chain = None
+        self.executor = TaskExecutor("bn")
+        self._el = None
+        self._eth1 = None
+
+    # -- stages (each returns self, builder-style) ------------------------
+
+    def load_spec(self) -> "ClientBuilder":
+        from lighthouse_tpu.client.network_config import (
+            load_network_config,
+            spec_for_network,
+        )
+
+        cfg = self.config
+        if cfg.network_config_path:
+            self.spec = load_network_config(cfg.network_config_path)
+        else:
+            self.spec = spec_for_network(cfg.network)
+        return self
+
+    def genesis(self, state=None) -> "ClientBuilder":
+        from lighthouse_tpu.state_transition import genesis_state
+
+        if state is not None:
+            self.genesis_state = state
+        else:
+            fork = self.config.genesis_fork
+            self.genesis_state = genesis_state(
+                self.config.n_genesis_validators, self.spec, fork)
+        return self
+
+    def execution_layer(self) -> "ClientBuilder":
+        cfg = self.config
+        if cfg.execution_endpoint is None:
+            return self
+        from lighthouse_tpu.execution import EngineApiClient, ExecutionLayer
+
+        secret = bytes.fromhex(cfg.execution_jwt_hex or "00" * 32)
+        self._el = ExecutionLayer(
+            [EngineApiClient(cfg.execution_endpoint, secret)])
+        return self
+
+    def eth1(self) -> "ClientBuilder":
+        if self.config.eth1_endpoint is None:
+            return self
+        from lighthouse_tpu.eth1 import Eth1Service, Eth1ServiceConfig
+
+        self._eth1 = Eth1Service(
+            self.config.eth1_endpoint, self.spec,
+            Eth1ServiceConfig(follow_distance=min(
+                self.spec.eth1_follow_distance, 16)))
+        return self
+
+    def beacon_chain(self) -> "ClientBuilder":
+        import os
+
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.store import HotColdDB, NativeKVStore
+
+        store = None
+        if self.config.datadir:
+            os.makedirs(self.config.datadir, exist_ok=True)
+            store = HotColdDB(
+                self.spec,
+                hot=NativeKVStore(
+                    os.path.join(self.config.datadir, "hot.db")),
+                cold=NativeKVStore(
+                    os.path.join(self.config.datadir, "cold.db")))
+        self.chain = BeaconChain(
+            self.spec, self.genesis_state, store=store,
+            verify_signatures=self.config.verify_signatures,
+            execution_layer=self._el)
+        if self._eth1 is not None:
+            self.chain.eth1_service = self._eth1
+        if self.config.slasher_enabled:
+            from lighthouse_tpu.slasher import SlasherService
+
+            self.chain.slasher = SlasherService(self.chain)
+        return self
+
+    def build(self) -> Client:
+        from lighthouse_tpu.processor import BeaconProcessor
+
+        if self.spec is None:
+            self.load_spec()
+        if self.genesis_state is None:
+            self.genesis()
+        if self._el is None:
+            self.execution_layer()
+        if self._eth1 is None:
+            self.eth1()
+        if self.chain is None:
+            self.beacon_chain()
+
+        client = Client(self.config, self.spec, self.chain, self.executor)
+        client.processor = BeaconProcessor()
+
+        if self.config.http_enabled:
+            from lighthouse_tpu.api import HttpServer
+
+            client.http_server = HttpServer(
+                self.chain, port=self.config.http_port).start()
+            self.log.info("http api listening",
+                          port=client.http_server.port)
+
+        # per-slot services: eth1 follow + slasher batches + notifier
+        # (reference timer + notifier + slasher service)
+        def slot_tick():
+            chain = self.chain
+            if chain.eth1_service is not None:
+                chain.eth1_service.update()
+            if chain.slasher is not None:
+                chain.slasher.tick(chain.current_slot())
+
+        self.executor.spawn_periodic(
+            slot_tick, self.spec.seconds_per_slot, "slot-services")
+
+        def notify():
+            head = self.chain.head_state
+            self.log.info(
+                "slot status", slot=self.chain.current_slot(),
+                head_slot=int(head.slot),
+                validators=len(head.validators),
+                finalized_epoch=int(self.chain.fork_choice.finalized.epoch))
+
+        self.executor.spawn_periodic(
+            notify, self.spec.seconds_per_slot, "notifier")
+        return client

@@ -1,0 +1,114 @@
+"""Network configuration presets + config.yaml loading.
+
+Rebuild of /root/reference/common/eth2_network_config (built-in configs:
+mainnet/minimal-style config.yaml -> runtime ChainSpec) and the
+config.yaml parsing half of consensus/types/src/chain_spec.rs: UPPER_SNAKE
+keys map onto ChainSpec fields, fork versions are 0x-hex, unknown keys are
+ignored (forward compatibility, as the reference does for new-fork keys).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from lighthouse_tpu import types as T
+
+# config.yaml key -> ChainSpec field (the subset this client consumes)
+_KEY_MAP = {
+    "PRESET_BASE": None,  # handled specially
+    "CONFIG_NAME": "config_name",
+    "SECONDS_PER_SLOT": "seconds_per_slot",
+    "GENESIS_DELAY": "genesis_delay",
+    "MIN_GENESIS_TIME": "min_genesis_time",
+    "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT":
+        "min_genesis_active_validator_count",
+    "MIN_DEPOSIT_AMOUNT": "min_deposit_amount",
+    "MAX_EFFECTIVE_BALANCE": "max_effective_balance",
+    "EJECTION_BALANCE": "ejection_balance",
+    "ETH1_FOLLOW_DISTANCE": "eth1_follow_distance",
+    "MIN_VALIDATOR_WITHDRAWABILITY_DELAY":
+        "min_validator_withdrawability_delay",
+    "SHARD_COMMITTEE_PERIOD": "shard_committee_period",
+    "INACTIVITY_SCORE_BIAS": "inactivity_score_bias",
+    "INACTIVITY_SCORE_RECOVERY_RATE": "inactivity_score_recovery_rate",
+    "MIN_PER_EPOCH_CHURN_LIMIT": "min_per_epoch_churn_limit",
+    "CHURN_LIMIT_QUOTIENT": "churn_limit_quotient",
+    "MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT":
+        "max_per_epoch_activation_churn_limit",
+    "PROPOSER_SCORE_BOOST": "proposer_score_boost",
+    "GENESIS_FORK_VERSION": "genesis_fork_version",
+    "ALTAIR_FORK_VERSION": "altair_fork_version",
+    "ALTAIR_FORK_EPOCH": "altair_fork_epoch",
+    "BELLATRIX_FORK_VERSION": "bellatrix_fork_version",
+    "BELLATRIX_FORK_EPOCH": "bellatrix_fork_epoch",
+    "CAPELLA_FORK_VERSION": "capella_fork_version",
+    "CAPELLA_FORK_EPOCH": "capella_fork_epoch",
+    "DENEB_FORK_VERSION": "deneb_fork_version",
+    "DENEB_FORK_EPOCH": "deneb_fork_epoch",
+    "ELECTRA_FORK_VERSION": "electra_fork_version",
+    "ELECTRA_FORK_EPOCH": "electra_fork_epoch",
+    "DEPOSIT_CONTRACT_ADDRESS": "deposit_contract_address",
+}
+
+_VERSION_KEYS = {k for k in _KEY_MAP if k.endswith("_FORK_VERSION")}
+
+
+def spec_from_config_dict(cfg: dict) -> T.ChainSpec:
+    base = (T.ChainSpec.minimal()
+            if str(cfg.get("PRESET_BASE", "mainnet")).lower() == "minimal"
+            else T.ChainSpec.mainnet())
+    updates = {}
+    for key, value in cfg.items():
+        fname = _KEY_MAP.get(str(key))
+        if fname is None:
+            continue  # unknown/unused keys are forward-compatible
+        if key in _VERSION_KEYS or key == "DEPOSIT_CONTRACT_ADDRESS":
+            if isinstance(value, int):
+                # YAML 1.1 reads unquoted 0x... as an integer
+                width = 4 if key in _VERSION_KEYS else 20
+                updates[fname] = value.to_bytes(width, "big")
+            else:
+                s = str(value)
+                updates[fname] = bytes.fromhex(
+                    s[2:] if s.startswith("0x") else s)
+        elif fname == "config_name":
+            updates[fname] = str(value)
+        else:
+            updates[fname] = int(value)
+    return dataclasses.replace(base, **updates)
+
+
+def load_network_config(path: str) -> T.ChainSpec:
+    """Parse a config.yaml into a ChainSpec."""
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict):
+        raise ValueError(f"{path}: not a config mapping")
+    return spec_from_config_dict(cfg)
+
+
+# Built-in networks (reference built_in_network_configs/): the spec values
+# the client can run without external files.
+_BUILT_IN = {
+    "mainnet": lambda: T.ChainSpec.mainnet(),
+    "minimal": lambda: T.ChainSpec.minimal(),
+    # devnet: minimal preset with all forks from genesis — the config the
+    # in-process simulator and tests run
+    "devnet": lambda: T.ChainSpec.minimal().with_forks_at(
+        0, through="capella"),
+}
+
+
+def built_in_networks() -> list[str]:
+    return sorted(_BUILT_IN)
+
+
+def spec_for_network(name: str) -> T.ChainSpec:
+    try:
+        return _BUILT_IN[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown network {name!r}; built-ins: {built_in_networks()}, "
+            "or pass a config.yaml path via --network-config")

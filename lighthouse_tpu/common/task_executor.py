@@ -1,0 +1,99 @@
+"""TaskExecutor: supervised task spawning with shutdown discipline.
+
+Rebuild of /root/reference/common/task_executor/src/lib.rs:72-290:
+`spawn` (async-ish periodic/one-shot tasks on threads), `spawn_blocking`,
+an exit signal that stops every task, a shutdown channel that a panicking
+critical task triggers (graceful whole-process shutdown, lib.rs:134-150),
+and per-task metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+
+@dataclass
+class ShutdownReason:
+    message: str
+    failure: bool = False
+
+
+class TaskExecutor:
+    def __init__(self, name: str = "node", max_blocking_workers: int = 8):
+        self.name = name
+        self.exit_event = threading.Event()
+        self._shutdown_cb: list = []
+        self.shutdown_reason: ShutdownReason | None = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_blocking_workers,
+            thread_name_prefix=f"{name}-blocking")
+        self._threads: list[threading.Thread] = []
+        self._tasks_started = REGISTRY.counter(
+            "task_executor_spawned_total", "tasks spawned")
+        self._tasks_failed = REGISTRY.counter(
+            "task_executor_failed_total", "tasks that raised")
+
+    # -- spawning ---------------------------------------------------------
+
+    def spawn(self, fn, name: str, critical: bool = False) -> threading.Thread:
+        """Run `fn(exit_event)` on a dedicated thread.  A critical task
+        that raises triggers whole-process shutdown (reference monitor)."""
+        self._tasks_started.inc()
+
+        def run():
+            try:
+                fn(self.exit_event)
+            except Exception as e:
+                self._tasks_failed.inc()
+                traceback.print_exc()
+                if critical:
+                    self.shutdown(f"critical task {name} failed: {e}",
+                                  failure=True)
+
+        t = threading.Thread(target=run, name=f"{self.name}-{name}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def spawn_periodic(self, fn, interval_s: float, name: str,
+                       critical: bool = False) -> threading.Thread:
+        """Run `fn()` every `interval_s` until exit."""
+
+        def loop(exit_event: threading.Event):
+            while not exit_event.wait(interval_s):
+                fn()
+
+        return self.spawn(loop, name, critical=critical)
+
+    def spawn_blocking(self, fn, *args) -> Future:
+        """Off-thread CPU work (reference spawn_blocking)."""
+        self._tasks_started.inc()
+        return self._pool.submit(fn, *args)
+
+    # -- shutdown ---------------------------------------------------------
+
+    def on_shutdown(self, cb) -> None:
+        self._shutdown_cb.append(cb)
+
+    def shutdown(self, message: str = "requested", failure: bool = False
+                 ) -> None:
+        if self.exit_event.is_set():
+            return
+        self.shutdown_reason = ShutdownReason(message, failure)
+        self.exit_event.set()
+        for cb in self._shutdown_cb:
+            try:
+                cb(self.shutdown_reason)
+            except Exception:
+                pass
+        self._pool.shutdown(wait=False)
+
+    def join(self, timeout_s: float = 5.0) -> None:
+        for t in self._threads:
+            t.join(timeout=timeout_s)

@@ -24,6 +24,8 @@ class SlotSummary:
     blocks_proposed: int = 0
     attestations_published: int = 0
     aggregates_published: int = 0
+    sync_messages_published: int = 0
+    sync_contributions_published: int = 0
     slashing_refusals: int = 0
 
 
@@ -40,6 +42,7 @@ class ValidatorClient:
         summary = SlotSummary(slot)
         self._propose(slot, summary)
         self._attest(slot, summary)
+        self._sync_committee(slot, summary)
         return summary
 
     def _propose(self, slot: int, summary: SlotSummary):
@@ -110,7 +113,67 @@ class ValidatorClient:
                     % spec.attestation_subnet_count)
             summary.attestations_published += 1
 
+        self._aggregate(slot, duties, summary)
+
+    def _sync_committee(self, slot: int, summary: SlotSummary):
+        """Sync-committee service: every managed committee member signs the
+        head root; elected aggregators publish contributions
+        (reference sync_committee_service.rs)."""
+        chain = self.chain
+        duties = self.duties.sync_duties_at_slot(slot)
+        if not duties:
+            return
+        head_root = chain.head_root
+        messages = []
+        for duty in duties:
+            sig = self.store.sign_sync_committee_message(
+                duty.pubkey, slot, head_root)
+            from lighthouse_tpu.types.containers import SyncCommitteeMessage
+
+            msg = SyncCommitteeMessage(
+                slot=slot, beacon_block_root=head_root,
+                validator_index=duty.validator_index, signature=sig)
+            for subnet in duty.subnet_positions:
+                messages.append((msg, subnet))
+        verified, _rejects = chain.verify_sync_messages_for_gossip(messages)
+        summary.sync_messages_published += len(verified)
+        if self.router is not None and hasattr(
+                self.router, "publish_sync_message"):
+            for v in verified:
+                subnet = v.positions[0][0] if v.positions else 0
+                self.router.publish_sync_message(v.item, subnet=subnet)
+
+        # aggregators assemble their subnet's best contribution
+        contributions = []
+        for duty in duties:
+            for subnet, proof in duty.aggregator_proofs.items():
+                best = chain.sync_pool.best_contribution(
+                    slot, head_root, subnet)
+                if best is None:
+                    continue
+                bits, sig = best
+                contribution = chain.t.SyncCommitteeContribution(
+                    slot=slot, beacon_block_root=head_root,
+                    subcommittee_index=subnet,
+                    aggregation_bits=[bool(b) for b in bits],
+                    signature=sig.to_bytes() if hasattr(sig, "to_bytes")
+                    else bytes(sig))
+                message = chain.t.ContributionAndProof(
+                    aggregator_index=duty.validator_index,
+                    contribution=contribution, selection_proof=proof)
+                signed = chain.t.SignedContributionAndProof(
+                    message=message,
+                    signature=self.store.sign_contribution_and_proof(
+                        duty.pubkey, message))
+                contributions.append(signed)
+        if contributions:
+            verified, _rejects = chain.verify_contributions_for_gossip(
+                contributions)
+            summary.sync_contributions_published += len(verified)
+
+    def _aggregate(self, slot, duties, summary):
         # aggregation duties (attestation_service.rs:234-519 flow)
+        chain = self.chain
         for duty in duties:
             if not duty.is_aggregator:
                 continue

@@ -33,6 +33,17 @@ class ProposerDuty:
 
 
 @dataclass
+class SyncDuty:
+    pubkey: bytes
+    validator_index: int
+    # {subnet: [positions within the subcommittee]}
+    subnet_positions: dict
+    # subnet -> selection proof for subnets where this validator is the
+    # elected aggregator (filled per slot)
+    aggregator_proofs: dict = field(default_factory=dict)
+
+
+@dataclass
 class EpochDuties:
     epoch: int
     attesters: list[AttesterDuty] = field(default_factory=list)
@@ -44,15 +55,25 @@ class DutiesService:
         self.chain = chain
         self.store = store  # ValidatorStore
         self._cache: dict[int, EpochDuties] = {}
+        self._indices_cache: tuple[int, int, dict] | None = None
 
     def _indices_by_pubkey(self, state) -> dict[bytes, int]:
+        """Managed-validator index map, cached until the registry grows or
+        the managed key set changes (this is called every slot)."""
+        n = len(state.validators)
+        managed = self.store.voting_pubkeys()
+        key = (n, len(managed))
+        if self._indices_cache is not None \
+                and self._indices_cache[:2] == key:
+            return self._indices_cache[2]
+        managed_set = set(managed)
         out = {}
         pks = state.validators.pubkeys
-        managed = set(self.store.voting_pubkeys())
-        for i in range(len(state.validators)):
+        for i in range(n):
             pk = bytes(pks[i].tobytes())
-            if pk in managed:
+            if pk in managed_set:
                 out[pk] = i
+        self._indices_cache = (n, len(managed), out)
         return out
 
     def duties_for_epoch(self, epoch: int) -> EpochDuties:
@@ -109,6 +130,37 @@ class DutiesService:
         if len(self._cache) > 4:
             del self._cache[min(self._cache)]
         return duties
+
+    def sync_duties_at_slot(self, slot: int) -> list[SyncDuty]:
+        """Managed validators serving in the sync committee at `slot`,
+        with per-slot aggregator elections (reference
+        duties_service/sync.rs)."""
+        from lighthouse_tpu.chain.sync_committee_verification import (
+            committee_positions,
+            is_sync_aggregator,
+            subnet_positions,
+        )
+
+        chain = self.chain
+        spec = chain.spec
+        state = chain.head_state
+        if not hasattr(state, "current_sync_committee"):
+            return []  # phase0
+        rows = chain.sync_committee_rows(state, slot)
+        out = []
+        by_pk = self._indices_by_pubkey(state)
+        for pk, vidx in by_pk.items():
+            positions = committee_positions(rows, pk)
+            if positions.size == 0:
+                continue
+            duty = SyncDuty(pk, vidx, subnet_positions(spec, positions))
+            for subnet in duty.subnet_positions:
+                proof = self.store.sign_sync_selection_proof(
+                    pk, slot, subnet)
+                if is_sync_aggregator(spec, proof):
+                    duty.aggregator_proofs[subnet] = proof
+            out.append(duty)
+        return out
 
     def attesters_at_slot(self, slot: int) -> list[AttesterDuty]:
         epoch = self.chain.spec.compute_epoch_at_slot(slot)

@@ -106,6 +106,35 @@ class ValidatorStore:
         root = misc.compute_signing_root(message.hash_tree_root(), domain)
         return self._sk(pubkey).sign(root).to_bytes()
 
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int,
+                                    beacon_block_root: bytes) -> bytes:
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        domain = self._domain(None, self.spec.domain_sync_committee, epoch)
+        root = misc.compute_signing_root(beacon_block_root, domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_sync_selection_proof(self, pubkey: bytes, slot: int,
+                                  subcommittee_index: int) -> bytes:
+        from lighthouse_tpu.types.containers import (
+            SyncAggregatorSelectionData,
+        )
+
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        domain = self._domain(
+            None, self.spec.domain_sync_committee_selection_proof, epoch)
+        data = SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index)
+        root = misc.compute_signing_root(data.hash_tree_root(), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_contribution_and_proof(self, pubkey: bytes, message) -> bytes:
+        epoch = self.spec.compute_epoch_at_slot(
+            int(message.contribution.slot))
+        domain = self._domain(
+            None, self.spec.domain_contribution_and_proof, epoch)
+        root = misc.compute_signing_root(message.hash_tree_root(), domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
     def sign_voluntary_exit(self, pubkey: bytes, exit_message) -> bytes:
         domain = self._domain(
             None, self.spec.domain_voluntary_exit, int(exit_message.epoch))

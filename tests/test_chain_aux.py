@@ -1,0 +1,196 @@
+"""Chain auxiliaries: SSE events, validator monitor, state-advance timer,
+fork revert, light-client server (reference beacon_chain aux modules)."""
+
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.chain.events import EventStream
+from lighthouse_tpu.chain.fork_revert import revert_to_fork_boundary
+from lighthouse_tpu.chain.state_advance_timer import StateAdvanceTimer
+from lighthouse_tpu.state_transition import misc, state_transition
+from lighthouse_tpu.testing import Harness, interop_secret_key
+from lighthouse_tpu.validator import ValidatorClient, ValidatorStore
+
+
+@pytest.fixture()
+def node():
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+    store = ValidatorStore(h.spec, bytes(h.state.genesis_validators_root))
+    for i in range(32):
+        store.add_validator(interop_secret_key(i), index=i)
+    return h, chain, ValidatorClient(chain, store)
+
+
+class TestEventStream:
+    def test_topic_filter_and_fanout(self):
+        es = EventStream()
+        all_q = es.subscribe()
+        head_q = es.subscribe(["head"])
+        es.publish("block", {"slot": "1"})
+        es.publish("head", {"slot": "1"})
+        assert all_q.qsize() == 2
+        assert head_q.qsize() == 1
+        assert head_q.get()[0] == "head"
+
+    def test_unknown_topic_rejected(self):
+        with pytest.raises(ValueError):
+            EventStream().subscribe(["nope"])
+
+    def test_chain_publishes_block_and_head(self, node):
+        h, chain, vc = node
+        sub = chain.events.subscribe(["head", "block"])
+        chain.slot_clock.set_slot(1)
+        vc.run_slot(1)
+        got = {sub.get_nowait()[0] for _ in range(sub.qsize())}
+        assert got == {"head", "block"}
+
+    def test_sse_endpoint_streams(self, node):
+        from lighthouse_tpu.api import HttpServer
+
+        h, chain, vc = node
+        server = HttpServer(chain).start()
+        try:
+            url = (f"http://127.0.0.1:{server.port}/eth/v1/events"
+                   f"?topics=block&max_events=1&timeout=10")
+            out = {}
+
+            def read():
+                with urllib.request.urlopen(url, timeout=15) as r:
+                    out["body"] = r.read().decode()
+
+            t = threading.Thread(target=read)
+            t.start()
+            import time
+
+            time.sleep(0.3)  # let the subscriber attach
+            chain.slot_clock.set_slot(1)
+            vc.run_slot(1)
+            t.join(timeout=15)
+            assert "event: block" in out.get("body", "")
+        finally:
+            server.stop()
+
+
+class TestValidatorMonitor:
+    def test_attestation_and_proposal_tracking(self, node):
+        h, chain, vc = node
+        chain.validator_monitor.auto_register = True
+        for slot in (1, 2):
+            chain.slot_clock.set_slot(slot)
+            vc.run_slot(slot)
+        # slot-1 attestations landed in the slot-2 block
+        summaries = chain.validator_monitor.epoch_summary(0)
+        hits = sum(s.attestation_hits for s in summaries.values())
+        proposals = sum(s.blocks_proposed for s in summaries.values())
+        assert hits > 0
+        assert proposals == 2
+        delays = [d for s in summaries.values()
+                  for d in s.inclusion_delays]
+        assert delays and min(delays) == 1
+
+    def test_unregistered_ignored(self, node):
+        h, chain, vc = node
+        chain.validator_monitor.register(5)
+        chain.slot_clock.set_slot(1)
+        vc.run_slot(1)
+        summaries = chain.validator_monitor.epoch_summary(0)
+        assert set(summaries) <= {5}
+
+
+class TestStateAdvanceTimer:
+    def test_pre_advance_used_by_production(self, node):
+        h, chain, vc = node
+        timer = StateAdvanceTimer(chain)
+        timer.install()
+        chain.slot_clock.set_slot(0)
+        assert timer.pre_advance(for_slot=1)
+        cached = timer.get(chain.head_root, 1)
+        assert cached is not None and int(cached.slot) == 1
+        chain.slot_clock.set_slot(1)
+        s = vc.run_slot(1)
+        assert s.blocks_proposed == 1
+        assert int(chain.head_state.slot) == 1
+
+    def test_pre_advance_noop_when_cached(self, node):
+        h, chain, vc = node
+        timer = StateAdvanceTimer(chain)
+        assert timer.pre_advance(for_slot=2)
+        assert not timer.pre_advance(for_slot=2)
+
+
+class TestForkRevert:
+    def test_invalid_head_reverted(self, node):
+        h, chain, vc = node
+        chain.slot_clock.set_slot(1)
+        vc.run_slot(1)
+        good_head = chain.head_root
+        chain.slot_clock.set_slot(2)
+        vc.run_slot(2)
+        bad_head = chain.head_root
+        assert bad_head != good_head
+        new_head = revert_to_fork_boundary(chain, bad_head)
+        assert new_head == good_head
+        assert chain.head_root == good_head
+
+
+class TestLightClient:
+    def test_optimistic_update_after_sync_aggregate(self, node):
+        h, chain, vc = node
+        for slot in (1, 2):
+            chain.slot_clock.set_slot(slot)
+            vc.run_slot(slot)
+        upd = chain.light_client.latest_optimistic
+        assert upd is not None
+        # the slot-2 block's aggregate attests the slot-1 head
+        assert upd.signature_slot == 2
+        assert upd.attested_header.slot == 1
+
+    def test_bootstrap_proof_verifies(self, node):
+        h, chain, vc = node
+        chain.slot_clock.set_slot(1)
+        vc.run_slot(1)
+        bs = chain.light_client.bootstrap(chain.head_root)
+        assert bs is not None
+        state = chain.head_state
+        # verify the branch against the state root (generalized index
+        # = width + field position)
+        names = list(type(state).fields)
+        idx = names.index("current_sync_committee")
+        leaf = type(state).fields["current_sync_committee"].hash_tree_root(
+            state.current_sync_committee)
+        assert misc.is_valid_merkle_branch(
+            leaf, bs.current_sync_committee_branch,
+            len(bs.current_sync_committee_branch), idx,
+            state.hash_tree_root())
+
+    def test_lc_http_endpoints(self, node):
+        import json
+
+        from lighthouse_tpu.api import HttpServer
+
+        h, chain, vc = node
+        for slot in (1, 2):
+            chain.slot_clock.set_slot(slot)
+            vc.run_slot(slot)
+        server = HttpServer(chain).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(
+                    base + "/eth/v1/beacon/light_client/optimistic_update",
+                    timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["data"]["signature_slot"] == "2"
+            root = "0x" + chain.head_root.hex()
+            with urllib.request.urlopen(
+                    base + f"/eth/v1/beacon/light_client/bootstrap/{root}",
+                    timeout=5) as r:
+                body = json.loads(r.read())
+            assert len(body["data"]["current_sync_committee"]["pubkeys"]) \
+                == h.spec.preset.sync_committee_size
+        finally:
+            server.stop()

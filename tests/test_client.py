@@ -1,0 +1,145 @@
+"""Client assembly + CLI + network configs + task executor."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.cli import main as cli_main
+from lighthouse_tpu.client import (
+    ClientBuilder,
+    ClientConfig,
+    load_network_config,
+    spec_for_network,
+)
+from lighthouse_tpu.common.task_executor import TaskExecutor
+
+
+class TestNetworkConfig:
+    def test_built_in_networks(self):
+        assert spec_for_network("mainnet").config_name == "mainnet"
+        assert spec_for_network("minimal").preset.slots_per_epoch == 8
+        with pytest.raises(ValueError, match="unknown network"):
+            spec_for_network("nope")
+
+    def test_config_yaml_loading(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("""
+PRESET_BASE: 'minimal'
+CONFIG_NAME: 'testnet-7'
+SECONDS_PER_SLOT: 3
+ALTAIR_FORK_VERSION: 0x01000099
+ALTAIR_FORK_EPOCH: 2
+SOME_FUTURE_KEY: 12345
+""")
+        spec = load_network_config(str(cfg))
+        assert spec.config_name == "testnet-7"
+        assert spec.seconds_per_slot == 3
+        assert spec.altair_fork_version == bytes.fromhex("01000099")
+        assert spec.altair_fork_epoch == 2
+        assert spec.preset.slots_per_epoch == 8  # minimal base
+
+
+class TestTaskExecutor:
+    def test_periodic_and_shutdown(self):
+        ex = TaskExecutor("t")
+        hits = []
+        ex.spawn_periodic(lambda: hits.append(1), 0.01, "ticker")
+        time.sleep(0.08)
+        ex.shutdown("done")
+        n = len(hits)
+        assert n >= 2
+        time.sleep(0.05)
+        assert len(hits) <= n + 1  # stopped
+
+    def test_critical_failure_triggers_shutdown(self):
+        ex = TaskExecutor("t")
+        reasons = []
+        ex.on_shutdown(lambda r: reasons.append(r))
+
+        def boom(exit_event):
+            raise RuntimeError("kaput")
+
+        ex.spawn(boom, "boom", critical=True)
+        time.sleep(0.2)
+        assert ex.exit_event.is_set()
+        assert reasons and reasons[0].failure
+
+    def test_spawn_blocking_result(self):
+        ex = TaskExecutor("t")
+        assert ex.spawn_blocking(lambda a, b: a + b, 2, 3).result() == 5
+
+
+class TestClientBuilder:
+    def test_full_assembly_and_http(self):
+        import urllib.request
+
+        cfg = ClientConfig(network="devnet", n_genesis_validators=16,
+                           genesis_fork="altair", verify_signatures=False)
+        client = ClientBuilder(cfg).build()
+        try:
+            assert client.chain is not None
+            port = client.http_server.port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/eth/v1/node/version",
+                    timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["data"]["version"].startswith("lighthouse-tpu/")
+        finally:
+            client.stop()
+
+    def test_persistent_datadir(self, tmp_path):
+        cfg = ClientConfig(network="devnet", n_genesis_validators=8,
+                           genesis_fork="altair", http_enabled=False,
+                           verify_signatures=False,
+                           datadir=str(tmp_path / "node"))
+        client = ClientBuilder(cfg).build()
+        root = client.chain.genesis_block_root
+        client.stop()
+        assert (tmp_path / "node" / "hot.db").exists()
+
+
+class TestCli:
+    def test_bn_runs_and_exits(self, capsys):
+        rc = cli_main(["--network", "devnet", "bn", "--http-port", "0",
+                       "--interop-validators", "8",
+                       "--genesis-fork", "altair",
+                       "--run-seconds", "0.2"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.splitlines()[0])
+        assert out["running"] == "bn"
+        assert out["genesis_root"].startswith("0x")
+
+    def test_key_tooling_roundtrip(self, tmp_path, capsys):
+        wallet = tmp_path / "wallet.json"
+        keys = tmp_path / "keys"
+        rc = cli_main(["account-manager", "wallet-create",
+                       "--name", "w1", "--password", "pw",
+                       "--out", str(wallet)])
+        assert rc == 0
+        rc = cli_main(["account-manager", "validator-create",
+                       "--wallet", str(wallet), "--wallet-password", "pw",
+                       "--keystore-password", "kpw", "--count", "2",
+                       "--out-dir", str(keys)])
+        assert rc == 0
+        created = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert len(created["created"]) == 2
+
+        defs = tmp_path / "defs.json"
+        rc = cli_main(["validator-manager", "import",
+                       "--keystores-dir", str(keys),
+                       "--password", "kpw", "--out", str(defs)])
+        assert rc == 0
+        assert json.loads(defs.read_text())[0]["enabled"] is True
+
+    def test_db_inspect(self, tmp_path, capsys):
+        datadir = tmp_path / "node"
+        cli_main(["--network", "devnet", "--datadir", str(datadir),
+                  "bn", "--http-port", "0", "--interop-validators", "8",
+                  "--genesis-fork", "altair", "--run-seconds", "0.1"])
+        capsys.readouterr()
+        rc = cli_main(["--datadir", str(datadir), "db", "inspect"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["inspect"]["hot.db"]["keys"] > 0
