@@ -1,0 +1,6 @@
+"""Conformance harness (reference testing/ef_tests): EF-layout vector
+runner + local generator + independent naive-SSZ oracle."""
+
+from lighthouse_tpu.conformance.runner import RunReport, run_tree
+
+__all__ = ["RunReport", "run_tree"]

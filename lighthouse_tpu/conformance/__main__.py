@@ -1,0 +1,5 @@
+import sys
+
+from lighthouse_tpu.conformance.runner import main
+
+sys.exit(main())

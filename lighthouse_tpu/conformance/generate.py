@@ -1,0 +1,399 @@
+"""Local conformance-vector generator (EF layout).
+
+The official consensus-spec-tests tarballs cannot be fetched in this
+environment (zero egress), so this module emits a vector tree in the
+identical directory layout the runner (and the reference's ef_tests)
+consumes.  Independence per handler:
+
+- ssz_static roots come from the naive hashlib oracle
+  (conformance/naive_ssz.py), NOT the production merkleizer;
+- shuffling mappings come from the scalar compute_shuffled_index, NOT
+  the vectorized shuffle under test;
+- bls cases pair positive vectors (regression pins) with *behaviorally
+  derived* negatives — tampered signatures, wrong messages, wrong
+  pubkeys — whose expected outputs are dictated by the spec, not the
+  implementation;
+- operations / sanity / epoch_processing / fork post-states are produced
+  by the transition but their expected ROOTS go through the naive
+  oracle, so the merkle layer cross-checks the whole state each time;
+  invalid cases (missing post) assert the reject paths.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import yaml
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.conformance import naive_ssz
+from lighthouse_tpu.crypto import bls
+
+
+def _w(path: str, name: str, data) -> None:
+    os.makedirs(path, exist_ok=True)
+    full = os.path.join(path, name)
+    if isinstance(data, bytes):
+        with open(full, "wb") as f:
+            f.write(data)
+    else:
+        with open(full, "w") as f:
+            yaml.safe_dump(data, f)
+
+
+def _hexs(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _case(root, config, fork, runner, handler_name, suite, case_name):
+    return os.path.join(root, "tests", config, fork, runner, handler_name,
+                        suite, case_name)
+
+
+# -- bls ---------------------------------------------------------------------
+
+def gen_bls(root: str) -> None:
+    rng = np.random.default_rng(7)
+    sks = [bls.SecretKey.from_bytes((i + 11).to_bytes(32, "big"))
+           for i in range(4)]
+    msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(4)]
+
+    def case(handler_name, i, data):
+        _w(_case(root, "general", "phase0", "bls", handler_name, "bls",
+                 f"case_{i}"), "data.yaml", data)
+
+    # sign: regression pins
+    for i, (sk, msg) in enumerate(zip(sks, msgs)):
+        case("sign", i, {
+            "input": {"privkey": _hexs(sk.to_bytes()),
+                      "message": _hexs(msg)},
+            "output": _hexs(sk.sign(msg).to_bytes())})
+
+    # verify: positive + spec-dictated negatives
+    sk, msg = sks[0], msgs[0]
+    sig = sk.sign(msg)
+    pk = sk.public_key()
+    verify_cases = [
+        (pk, msg, sig.to_bytes(), True),
+        (pk, msgs[1], sig.to_bytes(), False),              # wrong message
+        (sks[1].public_key(), msg, sig.to_bytes(), False),  # wrong pubkey
+        (pk, msg, sks[1].sign(msg).to_bytes(), False),      # wrong signer
+        (pk, msg, b"\xc0" + b"\x00" * 95, False),           # inf signature
+        (pk, msg, b"\xff" * 96, False),                     # junk bytes
+    ]
+    for i, (p, m, s, expect) in enumerate(verify_cases):
+        case("verify", i, {
+            "input": {"pubkey": _hexs(p.to_bytes()), "message": _hexs(m),
+                      "signature": _hexs(s)},
+            "output": expect})
+
+    # aggregate
+    sigs = [sk.sign(msgs[0]) for sk in sks]
+    case("aggregate", 0, {
+        "input": [_hexs(s.to_bytes()) for s in sigs],
+        "output": _hexs(bls.Signature.aggregate(sigs).to_bytes())})
+    case("aggregate", 1, {"input": [], "output": None})
+
+    # fast_aggregate_verify: n-of-n same message
+    agg = bls.Signature.aggregate(sigs)
+    case("fast_aggregate_verify", 0, {
+        "input": {"pubkeys": [_hexs(sk.public_key().to_bytes())
+                              for sk in sks],
+                  "message": _hexs(msgs[0]),
+                  "signature": _hexs(agg.to_bytes())},
+        "output": True})
+    case("fast_aggregate_verify", 1, {
+        "input": {"pubkeys": [_hexs(sk.public_key().to_bytes())
+                              for sk in sks[:3]],
+                  "message": _hexs(msgs[0]),
+                  "signature": _hexs(agg.to_bytes())},
+        "output": False})  # missing participant
+
+    # batch_verify: the production batch path
+    triples = [(sk.public_key(), m, sk.sign(m))
+               for sk, m in zip(sks, msgs)]
+    case("batch_verify", 0, {
+        "input": {
+            "pubkeys": [_hexs(p.to_bytes()) for p, _, _ in triples],
+            "messages": [_hexs(m) for _, m, _ in triples],
+            "signatures": [_hexs(s.to_bytes()) for _, _, s in triples]},
+        "output": True})
+    bad = list(triples)
+    bad[2] = (triples[2][0], triples[2][1], triples[3][2])
+    case("batch_verify", 1, {
+        "input": {
+            "pubkeys": [_hexs(p.to_bytes()) for p, _, _ in bad],
+            "messages": [_hexs(m) for _, m, _ in bad],
+            "signatures": [_hexs(s.to_bytes()) for _, _, s in bad]},
+        "output": False})
+
+
+# -- shuffling ---------------------------------------------------------------
+
+def gen_shuffling(root: str, config: str, spec: T.ChainSpec) -> None:
+    from lighthouse_tpu.state_transition.shuffle import (
+        compute_shuffled_index,
+    )
+
+    rng = np.random.default_rng(13)
+    for i, count in enumerate((1, 7, 64, 333)):
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        rounds = spec.preset.shuffle_round_count
+        # scalar oracle: position -> shuffled source index, matching
+        # shuffle_list's output convention (out[i] = indices[pi(i)])
+        mapping = [compute_shuffled_index(j, count, seed, rounds)
+                   for j in range(count)]
+        _w(_case(root, config, "phase0", "shuffling", "core", "shuffle",
+                 f"shuffle_{i}"), "mapping.yaml", {
+            "seed": _hexs(seed), "count": count,
+            "mapping": mapping})
+
+
+# -- ssz_static --------------------------------------------------------------
+
+def gen_ssz_static(root: str, config: str, spec: T.ChainSpec,
+                   fork: str) -> None:
+    from lighthouse_tpu.state_transition import genesis_state
+    from lighthouse_tpu.testing import Harness
+
+    t = T.make_types(spec.preset)
+    rng = np.random.default_rng(17)
+
+    def emit(type_name, typ, value, i=0):
+        from lighthouse_tpu.ssz.core import Container, SSZType
+
+        if isinstance(typ, type) and issubclass(typ, Container):
+            typ = typ.as_ssz_type()
+        path = _case(root, config, fork, "ssz_static", type_name,
+                     "ssz_random", f"case_{i}")
+        _w(path, "serialized.ssz", typ.serialize(value))
+        _w(path, "roots.yaml",
+           {"root": _hexs(naive_ssz.hash_tree_root(typ, value))})
+
+    def rb(n):
+        return bytes(rng.integers(0, 256, n, dtype=np.uint8))
+
+    emit("Checkpoint", T.Checkpoint,
+         T.Checkpoint(epoch=7, root=rb(32)))
+    emit("AttestationData", T.AttestationData, T.AttestationData(
+        slot=9, index=2, beacon_block_root=rb(32),
+        source=T.Checkpoint(epoch=1, root=rb(32)),
+        target=T.Checkpoint(epoch=2, root=rb(32))))
+    emit("BeaconBlockHeader", T.BeaconBlockHeader, T.BeaconBlockHeader(
+        slot=3, proposer_index=4, parent_root=rb(32), state_root=rb(32),
+        body_root=rb(32)))
+    emit("Eth1Data", T.Eth1Data, T.Eth1Data(
+        deposit_root=rb(32), deposit_count=55, block_hash=rb(32)))
+    emit("DepositData", T.DepositData, T.DepositData(
+        pubkey=rb(48), withdrawal_credentials=rb(32),
+        amount=32 * 10**9, signature=rb(96)))
+    bits = [bool(b) for b in rng.integers(0, 2, 9)]
+    emit("Attestation", t.Attestation, t.Attestation(
+        aggregation_bits=bits,
+        data=T.AttestationData(
+            slot=1, index=0, beacon_block_root=rb(32),
+            source=T.Checkpoint(epoch=0, root=rb(32)),
+            target=T.Checkpoint(epoch=0, root=rb(32))),
+        signature=rb(96)))
+    emit("SyncCommitteeMessage", T.SyncCommitteeMessage,
+         T.SyncCommitteeMessage(slot=5, beacon_block_root=rb(32),
+                                validator_index=3, signature=rb(96)))
+    # whole-state case: the big one (columnar registry + every field)
+    h = Harness(n_validators=12, spec=spec, fork=fork, real_crypto=False)
+    for _ in range(2):
+        signed = h.produce_block()
+        from lighthouse_tpu.state_transition import state_transition
+
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+    emit("BeaconState", t.beacon_state_class(fork), h.state)
+    emit("SignedBeaconBlock", t.signed_beacon_block_class(fork), signed)
+
+
+# -- operations / sanity / epoch_processing / fork ---------------------------
+
+def _emit_state_pair(path, state_t, pre, post) -> None:
+    _w(path, "pre.ssz", state_t.serialize(pre))
+    if post is not None:
+        _w(path, "post.ssz", state_t.serialize(post))
+
+
+def gen_transitions(root: str, config: str, spec: T.ChainSpec,
+                    fork: str) -> None:
+    from lighthouse_tpu.ssz.core import Container
+    from lighthouse_tpu.state_transition import (
+        epoch_processing as ep,
+        state_advance,
+        state_transition,
+    )
+    from lighthouse_tpu.testing import Harness
+
+    t = T.make_types(spec.preset)
+    state_t = t.beacon_state_class(fork).as_ssz_type()
+    signed_t = t.signed_beacon_block_class(fork).as_ssz_type()
+
+    # sanity/blocks: two-block advance
+    h = Harness(n_validators=16, spec=spec, fork=fork, real_crypto=True)
+    pre = h.state.copy()
+    blocks = []
+    for _ in range(2):
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        blocks.append(signed)
+    path = _case(root, config, fork, "sanity", "blocks", "sanity",
+                 "two_blocks")
+    _emit_state_pair(path, state_t, pre, h.state)
+    for i, b in enumerate(blocks):
+        _w(path, f"blocks_{i}.ssz", signed_t.serialize(b))
+    _w(path, "meta.yaml", {"blocks_count": len(blocks)})
+
+    # sanity/blocks invalid: proposer signature tampered (no post)
+    h2 = Harness(n_validators=16, spec=spec, fork=fork, real_crypto=True)
+    pre2 = h2.state.copy()
+    bad = h2.produce_block()
+    tampered = signed_t.deserialize(signed_t.serialize(bad))
+    tampered.signature = bytes(tampered.signature[:95]) + bytes(
+        [tampered.signature[95] ^ 1])
+    path = _case(root, config, fork, "sanity", "blocks", "sanity",
+                 "invalid_proposer_signature")
+    _emit_state_pair(path, state_t, pre2, None)
+    _w(path, "blocks_0.ssz", signed_t.serialize(tampered))
+    _w(path, "meta.yaml", {"blocks_count": 1})
+
+    # sanity/slots: cross an epoch boundary
+    h3 = Harness(n_validators=16, spec=spec, fork=fork, real_crypto=False)
+    pre3 = h3.state.copy()
+    n_slots = spec.slots_per_epoch + 2
+    state_advance(h3.state, spec, int(pre3.slot) + n_slots)
+    path = _case(root, config, fork, "sanity", "slots", "sanity",
+                 "epoch_boundary")
+    _emit_state_pair(path, state_t, pre3, h3.state)
+    _w(path, "slots.yaml", n_slots)
+
+    # epoch_processing sub-transitions from a mid-chain state with live
+    # slashings (so the proportional-multiplier path has real input)
+    h4 = Harness(n_validators=16, spec=spec, fork=fork, real_crypto=False)
+    for _ in range(3):
+        signed = h4.produce_block()
+        state_transition(h4.state, h4.spec, signed, h4._verify_strategy())
+    v4 = h4.state.validators
+    epoch4 = int(h4.state.slot) // spec.slots_per_epoch
+    for bad in (2, 5):
+        v4.slashed[bad] = True
+        v4.withdrawable_epoch[bad] = (
+            epoch4 + spec.preset.epochs_per_slashings_vector // 2)
+        h4.state.slashings[epoch4 % spec.preset.epochs_per_slashings_vector] \
+            += v4.effective_balance[bad]
+    if fork == "phase0":
+        from lighthouse_tpu.state_transition import phase0_epoch as p0
+
+        j_and_f = lambda s: p0.process_justification_and_finalization_phase0(  # noqa: E731
+            s, spec)
+        rewards = lambda s: p0.process_rewards_and_penalties_phase0(s, spec)  # noqa: E731
+    else:
+        j_and_f = lambda s: ep.process_justification_and_finalization(  # noqa: E731
+            s, spec)
+        rewards = lambda s: ep.process_rewards_and_penalties(s, spec, fork)  # noqa: E731
+    for sub, fn in (
+        ("justification_and_finalization", j_and_f),
+        ("inactivity_updates",
+         lambda s: ep.process_inactivity_updates(s, spec)),
+        ("rewards_and_penalties", rewards),
+        ("registry_updates",
+         lambda s: ep.process_registry_updates(s, spec)),
+        ("slashings", lambda s: ep.process_slashings(s, spec, fork)),
+        ("effective_balance_updates",
+         lambda s: ep.process_effective_balance_updates(s, spec)),
+    ):
+        if fork == "phase0" and sub == "inactivity_updates":
+            continue
+        pre4 = h4.state.copy()
+        post4 = h4.state.copy()
+        fn(post4)
+        path = _case(root, config, fork, "epoch_processing", sub,
+                     "epoch", "mid_chain")
+        _emit_state_pair(path, state_t, pre4, post4)
+
+    # operations/voluntary_exit (valid + invalid-signature)
+    if fork != "phase0":
+        from lighthouse_tpu.state_transition import misc
+        from lighthouse_tpu.testing import interop_secret_key
+
+        h5 = Harness(n_validators=16, spec=spec, fork=fork,
+                     real_crypto=True)
+        st = h5.state
+        st.slot = (spec.shard_committee_period + 1) * spec.slots_per_epoch
+        exit_msg = T.VoluntaryExit(
+            epoch=spec.shard_committee_period, validator_index=3)
+        sk = interop_secret_key(3)
+        domain = misc.get_domain(
+            st, spec, spec.domain_voluntary_exit,
+            int(exit_msg.epoch))
+        sig = sk.sign(misc.compute_signing_root(
+            exit_msg.hash_tree_root(), domain))
+        signed_exit = T.SignedVoluntaryExit(
+            message=exit_msg, signature=sig.to_bytes())
+        from lighthouse_tpu.state_transition import block_processing as bp
+
+        pre5 = st.copy()
+        post5 = st.copy()
+        bp.process_voluntary_exit(
+            post5, spec, signed_exit,
+            bp.SignatureStrategy.VERIFY_INDIVIDUAL, None)
+        path = _case(root, config, fork, "operations", "voluntary_exit",
+                     "ops", "valid")
+        _emit_state_pair(path, state_t, pre5, post5)
+        _w(path, "voluntary_exit.ssz", signed_exit.serialize())
+
+        bad_exit = T.SignedVoluntaryExit(
+            message=exit_msg, signature=b"\xaa" * 96)
+        path = _case(root, config, fork, "operations", "voluntary_exit",
+                     "ops", "invalid_signature")
+        _emit_state_pair(path, state_t, pre5, None)
+        _w(path, "voluntary_exit.ssz", bad_exit.serialize())
+
+    # fork upgrade: previous fork -> this fork
+    order = ["phase0", "altair", "bellatrix", "capella", "deneb"]
+    if fork != "phase0":
+        prev = order[order.index(fork) - 1]
+        from lighthouse_tpu.state_transition import genesis_state, upgrades
+
+        prev_spec = spec.with_forks_at(0, through=prev)
+        pre6 = genesis_state(16, prev_spec, prev)
+        target_spec = spec.with_forks_at(0, through=prev)
+        import dataclasses as _dc
+
+        target_spec = _dc.replace(
+            target_spec, **{f"{fork}_fork_epoch": 0})
+        post6_t = t.beacon_state_class(fork).as_ssz_type()
+        post6 = genesis_state(16, prev_spec, prev)
+        getattr(upgrades, f"upgrade_to_{fork}")(post6, target_spec, t)
+        path = _case(root, config, fork, "fork", "fork", "fork",
+                     f"{prev}_to_{fork}")
+        prev_t = t.beacon_state_class(prev).as_ssz_type()
+        _w(path, "pre.ssz", prev_t.serialize(pre6))
+        _w(path, "post.ssz", post6_t.serialize(post6))
+        _w(path, "meta.yaml", {"fork": fork})
+
+
+def generate_tree(root: str, forks: tuple = ("phase0", "altair"),
+                  config: str = "minimal") -> str:
+    """Emit the full local vector tree; returns `root`."""
+    spec_base = (T.ChainSpec.minimal() if config == "minimal"
+                 else T.ChainSpec.mainnet())
+    gen_bls(root)
+    gen_shuffling(root, config, spec_base)
+    for fork in forks:
+        spec = spec_base.with_forks_at(0, through=fork)
+        gen_ssz_static(root, config, spec, fork)
+        gen_transitions(root, config, spec, fork)
+    return root
+
+
+if __name__ == "__main__":
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "conformance-vectors"
+    generate_tree(out)
+    print(out)

@@ -1,0 +1,190 @@
+"""Independent naive SSZ merkleization oracle.
+
+A deliberately boring, scalar, hashlib-only re-implementation of SSZ
+hash_tree_root used as the differential oracle for the production
+columnar/device path (ssz/core.py + ssz/tree_cache.py).  It shares NO
+code with the production implementation: recursion + hashlib here vs
+descriptor objects + batched device sweeps there.  The conformance
+generator computes every expected root through THIS module, so a bug in
+the production path cannot self-certify.
+
+(The reference gets the same independence from the EF consensus-spec-test
+vectors, produced by the Python spec executable; with zero egress those
+tarballs cannot be fetched, so this oracle fills the same role locally —
+and the runner consumes official vector trees unchanged when present.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _pad32(b: bytes) -> bytes:
+    return b + b"\x00" * (-len(b) % 32)
+
+
+def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
+    n = len(chunks)
+    size = max(limit if limit is not None else n, 1)
+    depth = 0
+    while (1 << depth) < size:
+        depth += 1
+    layer = list(chunks)
+    zero = b"\x00" * 32
+    for _ in range(depth):
+        if len(layer) % 2:
+            layer.append(zero)
+        layer = [_h(layer[i], layer[i + 1])
+                 for i in range(0, len(layer), 2)]
+        zero = _h(zero, zero)
+        if not layer:
+            layer = [zero]
+    return layer[0] if layer else zero
+
+
+def mix_length(root: bytes, length: int) -> bytes:
+    return _h(root, length.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> list[bytes]:
+    data = _pad32(bytes(data))
+    return [data[i:i + 32] for i in range(0, len(data), 32)] or []
+
+
+def uint_root(value: int, byte_len: int) -> bytes:
+    return _pad32(int(value).to_bytes(byte_len, "little"))
+
+
+def u64_list_root(values, limit: int) -> bytes:
+    chunks = pack_bytes(b"".join(
+        int(v).to_bytes(8, "little") for v in values))
+    return mix_length(
+        merkleize(chunks, (limit * 8 + 31) // 32), len(list(values)))
+
+
+def u64_vector_root(values, length: int) -> bytes:
+    chunks = pack_bytes(b"".join(
+        int(v).to_bytes(8, "little") for v in values))
+    return merkleize(chunks, (length * 8 + 31) // 32)
+
+
+def u8_list_root(values: bytes, limit: int) -> bytes:
+    chunks = pack_bytes(bytes(values))
+    return mix_length(
+        merkleize(chunks, (limit + 31) // 32), len(values))
+
+
+def bytes_root(value: bytes) -> bytes:
+    return merkleize(pack_bytes(value), (len(value) + 31) // 32)
+
+
+def roots_vector_root(rows, length: int) -> bytes:
+    return merkleize([bytes(r) for r in rows], length)
+
+
+def roots_list_root(rows, limit: int) -> bytes:
+    rows = [bytes(r) for r in rows]
+    return mix_length(merkleize(rows, limit), len(rows))
+
+
+def bitvector_root(bits, length: int) -> bytes:
+    by = bytearray((length + 7) // 8)
+    for i, bit in enumerate(bits):
+        if bit:
+            by[i // 8] |= 1 << (i % 8)
+    return merkleize(pack_bytes(bytes(by)), (length + 255) // 256)
+
+
+def bitlist_root(bits, limit: int) -> bytes:
+    by = bytearray((len(bits) + 7) // 8)
+    for i, bit in enumerate(bits):
+        if bit:
+            by[i // 8] |= 1 << (i % 8)
+    return mix_length(
+        merkleize(pack_bytes(bytes(by)) if bits else [],
+                  (limit + 255) // 256),
+        len(bits))
+
+
+def container_root(field_roots: list[bytes]) -> bytes:
+    return merkleize(field_roots, len(field_roots))
+
+
+# -- generic walker over the production type descriptors --------------------
+# (only the *descriptors* are consulted for structure — lengths, limits,
+# field order; every hash is computed here.)
+
+def hash_tree_root(typ, value) -> bytes:
+    from lighthouse_tpu.ssz import core as c
+    from lighthouse_tpu.types import registry as reg
+
+    if isinstance(typ, type) and issubclass(typ, c.Container):
+        typ = typ.as_ssz_type()
+    if isinstance(typ, c.Container._Descriptor):
+        roots = [hash_tree_root(ft, getattr(value, fn))
+                 for fn, ft in typ.cls.fields.items()]
+        return container_root(roots)
+    if isinstance(typ, c.Uint):
+        return uint_root(value, typ.fixed_size)
+    if isinstance(typ, c._Boolean):
+        return uint_root(1 if value else 0, 1)
+    if isinstance(typ, c.ByteVector):
+        return bytes_root(bytes(value))
+    if isinstance(typ, c.ByteList):
+        return mix_length(
+            merkleize(pack_bytes(bytes(value)), (typ.limit + 31) // 32),
+            len(value))
+    if isinstance(typ, c.Bitvector):
+        return bitvector_root(list(value), typ.length)
+    if isinstance(typ, c.Bitlist):
+        return bitlist_root(list(value), typ.limit)
+    if isinstance(typ, c.Vector):
+        if isinstance(typ.element, (c.Uint, c._Boolean)):
+            data = b"".join(typ.element.serialize(v) for v in value)
+            return merkleize(pack_bytes(data), typ.chunk_count())
+        return merkleize(
+            [hash_tree_root(typ.element, v) for v in value], typ.length)
+    if isinstance(typ, c.List):
+        if isinstance(typ.element, (c.Uint, c._Boolean)):
+            data = b"".join(typ.element.serialize(v) for v in value)
+            chunks = pack_bytes(data) if len(value) else []
+            return mix_length(
+                merkleize(chunks, typ.chunk_count()), len(value))
+        return mix_length(
+            merkleize([hash_tree_root(typ.element, v) for v in value],
+                      typ.limit),
+            len(value))
+    if isinstance(typ, reg.U64List):
+        return u64_list_root(list(value), typ.limit)
+    if isinstance(typ, reg.U64Vector):
+        return u64_vector_root(list(value), typ.length)
+    if isinstance(typ, reg.U8List):
+        return u8_list_root(bytes(bytearray(value)), typ.limit)
+    if isinstance(typ, reg.RootsVector):
+        rows = typ._as_array(value)
+        return roots_vector_root([rows[i].tobytes() for i in
+                                  range(rows.shape[0])], typ.length)
+    if isinstance(typ, reg.RootsList):
+        rows = typ._as_array(value)
+        return roots_list_root([rows[i].tobytes() for i in
+                                range(rows.shape[0])], typ.limit)
+    if isinstance(typ, reg.ValidatorRegistryType):
+        roots = []
+        v = value
+        for i in range(len(v)):
+            roots.append(container_root([
+                bytes_root(v.pubkeys[i].tobytes()),
+                bytes_root(v.withdrawal_credentials[i].tobytes()),
+                uint_root(int(v.effective_balance[i]), 8),
+                uint_root(1 if v.slashed[i] else 0, 1),
+                uint_root(int(v.activation_eligibility_epoch[i]), 8),
+                uint_root(int(v.activation_epoch[i]), 8),
+                uint_root(int(v.exit_epoch[i]), 8),
+                uint_root(int(v.withdrawable_epoch[i]), 8),
+            ]))
+        return mix_length(merkleize(roots, typ.limit), len(v))
+    raise TypeError(f"naive oracle: unsupported type {typ!r}")

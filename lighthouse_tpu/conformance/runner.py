@@ -1,0 +1,244 @@
+"""EF consensus-spec-test style conformance runner.
+
+Rebuild of /root/reference/testing/ef_tests/src/handler.rs:10-70: a
+generic walker over the standard vector layout
+
+    <root>/tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>/
+
+dispatching each case directory to a registered handler, tallying
+passes/failures, and (like the reference's check_all_files_accessed.py)
+reporting vector files nothing consumed.  Official consensus-spec-tests
+trees are consumed unchanged when mounted; `generate.py` emits
+locally-built trees in the identical layout (expected values from the
+independent naive-SSZ oracle + published known-answer vectors), because
+this environment cannot download the official tarballs.
+
+Run: ``python -m lighthouse_tpu.conformance <vector-root> [--fake-crypto]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import yaml
+
+from lighthouse_tpu import types as T
+
+
+@dataclass
+class CaseResult:
+    path: str
+    ok: bool
+    error: str | None = None
+
+
+@dataclass
+class RunReport:
+    results: list[CaseResult] = field(default_factory=list)
+    skipped_handlers: dict[str, int] = field(default_factory=dict)
+    unconsumed_files: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> int:
+        return sum(1 for r in self.results if r.ok)
+
+    @property
+    def failed(self) -> int:
+        return sum(1 for r in self.results if not r.ok)
+
+    def failures(self) -> list[CaseResult]:
+        return [r for r in self.results if not r.ok]
+
+    def to_json(self) -> dict:
+        return {
+            "passed": self.passed,
+            "failed": self.failed,
+            "skipped_handlers": dict(self.skipped_handlers),
+            "unconsumed_files": len(self.unconsumed_files),
+            "failures": [{"case": r.path, "error": r.error}
+                         for r in self.failures()[:20]],
+        }
+
+
+class CaseFiles:
+    """One case directory; tracks which files the handler consumed."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.consumed: set[str] = set()
+
+    def _resolve(self, name: str) -> str | None:
+        for candidate in (name, name + ".ssz", name + ".ssz_snappy",
+                          name + ".yaml"):
+            p = os.path.join(self.path, candidate)
+            if os.path.exists(p):
+                return p
+        return None
+
+    def exists(self, name: str) -> bool:
+        return self._resolve(name) is not None
+
+    def ssz(self, name: str) -> bytes | None:
+        p = self._resolve(name)
+        if p is None:
+            return None
+        self.consumed.add(p)
+        with open(p, "rb") as f:
+            raw = f.read()
+        if p.endswith(".ssz_snappy"):
+            raw = _snappy_decompress(raw)
+        return raw
+
+    def yaml(self, name: str):
+        p = self._resolve(name)
+        if p is None or not p.endswith(".yaml"):
+            p = os.path.join(self.path, name + ".yaml")
+            if not os.path.exists(p):
+                return None
+        self.consumed.add(p)
+        with open(p) as f:
+            return yaml.safe_load(f)
+
+    def all_files(self) -> list[str]:
+        out = []
+        for base, _dirs, files in os.walk(self.path):
+            out += [os.path.join(base, f) for f in files]
+        return out
+
+
+def _snappy_decompress(raw: bytes) -> bytes:
+    try:
+        import snappy  # type: ignore
+
+        return snappy.uncompress(raw)
+    except ImportError:
+        try:
+            import cramjam  # type: ignore
+
+            return bytes(cramjam.snappy.decompress_raw(raw))
+        except ImportError:
+            raise RuntimeError(
+                "ssz_snappy vectors need a snappy codec; regenerate with "
+                "plain .ssz or install python-snappy")
+
+
+@dataclass
+class Ctx:
+    """Per-run context a handler receives."""
+
+    spec: T.ChainSpec
+    fork: str
+    config: str
+    fake_crypto: bool
+
+    @property
+    def types(self):
+        return T.make_types(self.spec.preset)
+
+    def state_cls(self):
+        return self.types.beacon_state_class(self.fork)
+
+
+class SkipHandler(Exception):
+    """A wildcard handler raising this marks the sub-handler as skipped
+    (not failed) — official trees contain sub-handlers this client does
+    not implement yet."""
+
+
+# handler registry: "<runner>/<handler>" or "<runner>/*" -> fn(ctx, case)
+HANDLERS: dict[str, object] = {}
+
+
+def handler(key: str):
+    def deco(fn):
+        HANDLERS[key] = fn
+        return fn
+
+    return deco
+
+
+def _lookup(runner: str, name: str):
+    return HANDLERS.get(f"{runner}/{name}") or HANDLERS.get(f"{runner}/*")
+
+
+def run_tree(root: str, fake_crypto: bool = False,
+             configs: tuple = ("minimal", "mainnet"),
+             forks: tuple | None = None) -> RunReport:
+    from lighthouse_tpu.conformance import handlers as _h  # registers
+
+    report = RunReport()
+    tests = os.path.join(root, "tests")
+    if not os.path.isdir(tests):
+        tests = root
+    for config in sorted(os.listdir(tests)):
+        if config not in configs:
+            continue
+        spec = (T.ChainSpec.minimal() if config == "minimal"
+                else T.ChainSpec.mainnet())
+        cfg_dir = os.path.join(tests, config)
+        for fork in sorted(os.listdir(cfg_dir)):
+            if forks is not None and fork not in forks:
+                continue
+            if fork not in ("phase0", "altair", "bellatrix", "capella",
+                            "deneb", "general"):
+                continue
+            fork_dir = os.path.join(cfg_dir, fork)
+            run_spec = (spec if fork == "general"
+                        else spec.with_forks_at(0, through=fork))
+            ctx = Ctx(run_spec, fork if fork != "general" else "phase0",
+                      config, fake_crypto)
+            _run_fork_dir(fork_dir, ctx, report)
+    return report
+
+
+def _run_fork_dir(fork_dir: str, ctx: Ctx, report: RunReport) -> None:
+    for runner in sorted(os.listdir(fork_dir)):
+        runner_dir = os.path.join(fork_dir, runner)
+        for hname in sorted(os.listdir(runner_dir)):
+            fn = _lookup(runner, hname)
+            handler_dir = os.path.join(runner_dir, hname)
+            if fn is None:
+                key = f"{runner}/{hname}"
+                n = sum(len(files) for _, _, files in os.walk(handler_dir))
+                report.skipped_handlers[key] = (
+                    report.skipped_handlers.get(key, 0) + n)
+                continue
+            for suite in sorted(os.listdir(handler_dir)):
+                suite_dir = os.path.join(handler_dir, suite)
+                for case in sorted(os.listdir(suite_dir)):
+                    case_dir = os.path.join(suite_dir, case)
+                    files = CaseFiles(case_dir)
+                    try:
+                        fn(ctx, files, hname)
+                        ok, err = True, None
+                    except SkipHandler:
+                        key = f"{runner}/{hname}"
+                        report.skipped_handlers[key] = (
+                            report.skipped_handlers.get(key, 0) + 1)
+                        continue
+                    except AssertionError as e:
+                        ok, err = False, f"assertion: {e}"
+                    except Exception as e:
+                        ok, err = False, f"{type(e).__name__}: {e}"
+                    report.results.append(
+                        CaseResult(case_dir, ok, err))
+                    report.unconsumed_files += [
+                        f for f in files.all_files()
+                        if f not in files.consumed
+                        and not f.endswith("meta.yaml")]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="lighthouse-tpu-conformance")
+    p.add_argument("root", help="vector tree root")
+    p.add_argument("--fake-crypto", action="store_true")
+    p.add_argument("--fork", default=None)
+    args = p.parse_args(argv)
+    report = run_tree(args.root, fake_crypto=args.fake_crypto,
+                      forks=(args.fork,) if args.fork else None)
+    print(json.dumps(report.to_json(), indent=2))
+    return 1 if report.failed else 0

@@ -51,6 +51,8 @@ def _inactivity_penalty_quotient(spec: T.ChainSpec, fork: str) -> int:
 
 
 def _proportional_slashing_multiplier(spec: T.ChainSpec, fork: str) -> int:
+    if fork == "phase0":
+        return spec.proportional_slashing_multiplier
     if fork == "altair":
         return spec.proportional_slashing_multiplier_altair
     return spec.proportional_slashing_multiplier_bellatrix
@@ -73,9 +75,12 @@ def process_epoch(state, spec: T.ChainSpec) -> None:
     """Full epoch transition, mutating `state` in place (altair+ forks)."""
     fork = spec.fork_at_epoch(misc.current_epoch(state, spec))
     if fork == "phase0":
-        raise NotImplementedError(
-            "phase0 epoch processing is not implemented; start chains at altair+"
+        from lighthouse_tpu.state_transition.phase0_epoch import (
+            process_epoch_phase0,
         )
+
+        process_epoch_phase0(state, spec)
+        return
     process_justification_and_finalization(state, spec)
     process_inactivity_updates(state, spec)
     process_rewards_and_penalties(state, spec, fork)

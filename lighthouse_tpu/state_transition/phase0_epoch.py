@@ -1,0 +1,202 @@
+"""Phase0 epoch processing (PendingAttestation-based).
+
+The altair+ path (epoch_processing.py) walks participation-flag columns;
+phase0 instead derives participation from the epoch's stored
+PendingAttestations (reference per_epoch_processing/base.rs +
+validator_statuses.rs).  Design here: resolve every pending
+attestation's committee once, then reduce to boolean attester masks and
+per-validator minimum inclusion delays — the rewards pass is pure
+columnar arithmetic like the altair path.
+
+Reference: consensus/state_processing/src/per_epoch_processing/base.rs
+(get_attestation_deltas), spec phase0 epoch processing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.state_transition import misc
+
+BASE_REWARDS_PER_EPOCH = 4
+
+
+def _base_rewards(state, spec, total_balance: int) -> np.ndarray:
+    eff = state.validators.effective_balance.astype(np.int64)
+    sqrt_total = misc.integer_squareroot(total_balance)
+    return (eff * spec.base_reward_factor
+            // sqrt_total // BASE_REWARDS_PER_EPOCH)
+
+
+class _EpochAttestations:
+    """Resolved participation for one epoch's pending attestations."""
+
+    def __init__(self, state, spec, epoch: int, atts):
+        from lighthouse_tpu.state_transition.block_processing import (
+            get_attesting_indices,
+        )
+
+        n = len(state.validators)
+        self.source = np.zeros(n, bool)
+        self.target = np.zeros(n, bool)
+        self.head = np.zeros(n, bool)
+        self.inclusion_delay = np.full(n, np.iinfo(np.int64).max, np.int64)
+        self.proposer = np.full(n, -1, np.int64)
+
+        epoch_start_root = None
+        try:
+            epoch_start_root = misc.get_block_root(state, spec, epoch)
+        except Exception:
+            pass
+        # all attestations in one epoch's list share the epoch's shuffle:
+        # compute it ONCE and amortize over every committee lookup
+        shuffle = (misc.compute_committee_shuffle(state, spec, epoch)
+                   if atts else None)
+        for att in atts:
+            indices = get_attesting_indices(state, spec, att, shuffle)
+            self.source[indices] = True
+            delay = int(att.inclusion_delay)
+            better = delay < self.inclusion_delay[indices]
+            upd = indices[better]
+            self.inclusion_delay[upd] = delay
+            self.proposer[upd] = int(att.proposer_index)
+            if (epoch_start_root is not None
+                    and bytes(att.data.target.root) == epoch_start_root):
+                self.target[indices] = True
+                try:
+                    head_root = misc.get_block_root_at_slot(
+                        state, spec, int(att.data.slot))
+                except Exception:
+                    continue
+                if bytes(att.data.beacon_block_root) == head_root:
+                    self.head[indices] = True
+
+    def unslashed(self, state, mask: np.ndarray) -> np.ndarray:
+        return mask & ~state.validators.slashed
+
+
+def _attesting_balance(state, spec, mask: np.ndarray) -> int:
+    total = int(state.validators.effective_balance[mask].sum())
+    return max(spec.effective_balance_increment, total)
+
+
+def process_justification_and_finalization_phase0(state, spec,
+                                                  prev_atts=None) -> None:
+    from lighthouse_tpu.state_transition.epoch_processing import (
+        weigh_justification_and_finalization,
+    )
+
+    cur = misc.current_epoch(state, spec)
+    if cur <= T.GENESIS_EPOCH + 1:
+        return
+    prev = misc.previous_epoch(state, spec)
+    if prev_atts is None:
+        prev_atts = _EpochAttestations(
+            state, spec, prev, state.previous_epoch_attestations)
+    cur_atts = _EpochAttestations(
+        state, spec, cur, state.current_epoch_attestations)
+    total = misc.get_total_active_balance(state, spec)
+    weigh_justification_and_finalization(
+        state, spec, total,
+        _attesting_balance(state, spec,
+                           prev_atts.unslashed(state, prev_atts.target)),
+        _attesting_balance(state, spec,
+                           cur_atts.unslashed(state, cur_atts.target)))
+
+
+def process_rewards_and_penalties_phase0(state, spec, atts=None) -> None:
+    from lighthouse_tpu.state_transition.epoch_processing import (
+        _eligible_validator_mask,
+    )
+
+    cur = misc.current_epoch(state, spec)
+    if cur == T.GENESIS_EPOCH:
+        return
+    prev = misc.previous_epoch(state, spec)
+    v = state.validators
+    n = len(v)
+    if atts is None:
+        atts = _EpochAttestations(
+            state, spec, prev, state.previous_epoch_attestations)
+
+    total = misc.get_total_active_balance(state, spec)
+    base = _base_rewards(state, spec, total)
+    eff = v.effective_balance.astype(np.int64)
+    increment = spec.effective_balance_increment
+
+    eligible = _eligible_validator_mask(state, spec)
+
+    finality_delay = prev - int(state.finalized_checkpoint.epoch)
+    in_leak = finality_delay > spec.min_epochs_to_inactivity_penalty
+
+    rewards = np.zeros(n, np.int64)
+    penalties = np.zeros(n, np.int64)
+
+    for mask in (atts.source, atts.target, atts.head):
+        unslashed = atts.unslashed(state, mask)
+        att_bal = _attesting_balance(state, spec, unslashed)
+        attester = eligible & unslashed
+        if in_leak:
+            # cancelled-out reward: attesters get exactly base_reward
+            rewards[attester] += base[attester]
+        else:
+            # scale in balance increments to dodge u64 overflow, as the
+            # spec's reward_numerator does
+            inc_att = att_bal // increment
+            inc_total = total // increment
+            rewards[attester] += (base[attester] * inc_att) // inc_total
+        penalties[eligible & ~unslashed] += base[eligible & ~unslashed]
+
+    # inclusion delay: attester + proposer micro-rewards
+    src = atts.unslashed(state, atts.source) & eligible
+    idx = np.nonzero(src)[0]
+    if idx.size:
+        delays = atts.inclusion_delay[idx]
+        proposer_share = base[idx] // spec.proposer_reward_quotient
+        max_reward = base[idx] - proposer_share
+        rewards[idx] += (max_reward
+                         * spec.min_attestation_inclusion_delay // delays)
+        proposers = atts.proposer[idx]
+        np.add.at(rewards, proposers[proposers >= 0],
+                  proposer_share[proposers >= 0])
+
+    if in_leak:
+        target_unslashed = atts.unslashed(state, atts.target) & eligible
+        proposer_share = base // spec.proposer_reward_quotient
+        penalties[eligible] += (BASE_REWARDS_PER_EPOCH * base[eligible]
+                                - proposer_share[eligible])
+        lagging = eligible & ~target_unslashed
+        penalties[lagging] += (eff[lagging] * finality_delay
+                               // spec.inactivity_penalty_quotient)
+
+    bal = state.balances.astype(np.int64) + rewards - penalties
+    state.balances = np.maximum(bal, 0).astype(np.uint64)
+
+
+def process_participation_record_updates(state) -> None:
+    state.previous_epoch_attestations = list(
+        state.current_epoch_attestations)
+    state.current_epoch_attestations = []
+
+
+def process_epoch_phase0(state, spec) -> None:
+    """Full phase0 epoch transition (counterpart of the altair+
+    process_epoch in epoch_processing.py)."""
+    from lighthouse_tpu.state_transition import epoch_processing as ep
+
+    # previous-epoch attestations resolve ONCE, shared by both passes
+    prev = misc.previous_epoch(state, spec)
+    prev_atts = _EpochAttestations(
+        state, spec, prev, state.previous_epoch_attestations)
+    process_justification_and_finalization_phase0(
+        state, spec, prev_atts=prev_atts)
+    process_rewards_and_penalties_phase0(state, spec, atts=prev_atts)
+    ep.process_registry_updates(state, spec)
+    ep.process_slashings(state, spec, "phase0")
+    ep.process_eth1_data_reset(state, spec)
+    ep.process_effective_balance_updates(state, spec)
+    ep.process_slashings_reset(state, spec)
+    ep.process_randao_mixes_reset(state, spec)
+    ep.process_historical_update(state, spec, "phase0")
+    process_participation_record_updates(state)
