@@ -1,0 +1,94 @@
+"""Overflow-checked consensus arithmetic.
+
+Rebuild of /root/reference/consensus/safe_arith/src/lib.rs: the reference
+wraps every state-transition integer op in a `SafeArith` trait returning
+`Result` so an overflow is a typed consensus error, never a silent wrap.
+Python ints are arbitrary-precision, so the hazard here is different — a
+value escaping the u64 domain and then being truncated when written back
+into a numpy uint64 column.  These helpers check the u64 domain at the
+operation site and raise `ArithError`, giving the same fail-closed
+semantics at the same call sites (epoch processing, rewards, balances).
+"""
+
+from __future__ import annotations
+
+U64_MAX = 2**64 - 1
+
+
+class ArithError(ArithmeticError):
+    """Overflow/underflow/division-by-zero in consensus arithmetic."""
+
+
+def _check(value: int) -> int:
+    if value < 0 or value > U64_MAX:
+        raise ArithError(f"u64 overflow: {value}")
+    return value
+
+
+def safe_add(a: int, b: int) -> int:
+    return _check(int(a) + int(b))
+
+
+def safe_sub(a: int, b: int) -> int:
+    return _check(int(a) - int(b))
+
+
+def safe_mul(a: int, b: int) -> int:
+    return _check(int(a) * int(b))
+
+
+def safe_div(a: int, b: int) -> int:
+    if int(b) == 0:
+        raise ArithError("division by zero")
+    return int(a) // int(b)
+
+
+def safe_rem(a: int, b: int) -> int:
+    if int(b) == 0:
+        raise ArithError("modulo by zero")
+    return int(a) % int(b)
+
+
+def safe_pow(a: int, b: int) -> int:
+    return _check(int(a) ** int(b))
+
+
+def saturating_add(a: int, b: int) -> int:
+    return min(int(a) + int(b), U64_MAX)
+
+
+def saturating_sub(a: int, b: int) -> int:
+    """The reference uses saturating_sub for balance decreases
+    (decrease_balance in the spec): clamp at zero."""
+    return max(int(a) - int(b), 0)
+
+
+def integer_squareroot(n: int) -> int:
+    """Spec integer_squareroot via Newton's method (used by
+    get_base_reward's sqrt(total_active_balance))."""
+    n = int(n)
+    if n < 0 or n > U64_MAX:
+        raise ArithError(f"u64 overflow: {n}")
+    if n == 0:
+        return 0
+    x = n
+    y = (x + 1) // 2
+    while y < x:
+        x = y
+        y = (x + n // x) // 2
+    return x
+
+
+__all__ = [
+    "ArithError",
+    "U64_MAX",
+    "safe_add",
+    "safe_sub",
+    "safe_mul",
+    "safe_div",
+    "safe_rem",
+    "safe_pow",
+    "saturating_add",
+    "saturating_sub",
+    "integer_squareroot",
+]

@@ -1,0 +1,187 @@
+"""Generalized-index Merkle proofs and an incremental proof tree.
+
+Rebuild of /root/reference/consensus/merkle_proof/src/lib.rs: a
+`MerkleTree` that supports leaf insertion up to a fixed depth with
+zero-subtree sharing, plus generalized-index proof generation and
+verification as used by the light-client protocol and deposit-contract
+proofs.  The hash plumbing rides the repo's batched SHA-256 ops
+(lighthouse_tpu/ops/sha256.py) so large proof batches can be verified in
+one device dispatch.
+
+Generalized indices (SSZ spec): the root is gindex 1; node g's children
+are 2g and 2g+1; a leaf at depth d, position i has gindex 2**d + i.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from lighthouse_tpu.ops import sha256 as sha_ops
+
+ZERO_HASHES: list[bytes] = [b"\x00" * 32]
+for _ in range(64):
+    ZERO_HASHES.append(
+        hashlib.sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest())
+
+
+def hash_pair(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(left + right).digest()
+
+
+# --- generalized-index helpers ---------------------------------------------
+
+def gindex_depth(gindex: int) -> int:
+    return gindex.bit_length() - 1
+
+
+def gindex_child(gindex: int, right: bool) -> int:
+    return 2 * gindex + (1 if right else 0)
+
+
+def gindex_sibling(gindex: int) -> int:
+    return gindex ^ 1
+
+def gindex_parent(gindex: int) -> int:
+    return gindex // 2
+
+
+def gindex_branch_indices(gindex: int) -> list[int]:
+    """Sibling gindices along the path to the root (proof node order:
+    leaf-adjacent first)."""
+    out = []
+    g = gindex
+    while g > 1:
+        out.append(gindex_sibling(g))
+        g = gindex_parent(g)
+    return out
+
+
+def compute_root_from_proof(leaf: bytes, gindex: int,
+                            proof: list[bytes]) -> bytes:
+    """Fold a single-leaf proof to its root."""
+    if len(proof) != gindex_depth(gindex):
+        raise ValueError(
+            f"proof length {len(proof)} != depth {gindex_depth(gindex)}")
+    node = leaf
+    g = gindex
+    for sib in proof:
+        node = hash_pair(sib, node) if g & 1 else hash_pair(node, sib)
+        g //= 2
+    return node
+
+
+def verify_merkle_proof(leaf: bytes, proof: list[bytes], gindex: int,
+                        root: bytes) -> bool:
+    return compute_root_from_proof(leaf, gindex, proof) == root
+
+
+def verify_merkle_proofs_batch(leaves: list[bytes], proofs: list[list[bytes]],
+                               gindices: list[int], root: bytes) -> bool:
+    """Verify many single-leaf proofs of equal depth in level-synchronous
+    device batches: one `hash_pairs` dispatch per tree level covering every
+    proof at once (the TPU-shaped form of the reference's per-proof loop)."""
+    if not leaves:
+        return True
+    if not (len(leaves) == len(proofs) == len(gindices)):
+        raise ValueError("length mismatch")
+    depth = gindex_depth(gindices[0])
+    if any(gindex_depth(g) != depth for g in gindices) or any(
+            len(p) != depth for p in proofs):
+        # mixed depths: fall back to scalar verification
+        return all(
+            verify_merkle_proof(l, p, g, root)
+            for l, p, g in zip(leaves, proofs, gindices))
+    nodes = list(leaves)
+    gs = [int(g) for g in gindices]
+    for level in range(depth):
+        pairs = np.empty((len(nodes), 16), dtype=np.uint32)
+        for i, node in enumerate(nodes):
+            sib = proofs[i][level]
+            pair = (sib + node) if gs[i] & 1 else (node + sib)
+            pairs[i] = np.frombuffer(pair, dtype=">u4").astype(np.uint32)
+        hashed = sha_ops.batch_hash_pairs(pairs)
+        nodes = [sha_ops.words_to_bytes(h) for h in hashed]
+        gs = [g // 2 for g in gs]
+    return all(n == root for n in nodes)
+
+
+# --- incremental proof tree -------------------------------------------------
+
+class MerkleTree:
+    """Fixed-depth append-only Merkle tree with zero-subtree sharing.
+
+    Functional equivalent of the reference's recursive MerkleTree enum
+    (Leaf/Node/Zero), stored flat: per level a list of known node hashes,
+    right-padded with the zero ladder.  push_leaf is O(depth); proofs are
+    read straight out of the levels.
+    """
+
+    def __init__(self, depth: int):
+        if not 0 < depth <= 63:
+            raise ValueError("depth out of range")
+        self.depth = depth
+        self._levels: list[list[bytes]] = [[] for _ in range(depth + 1)]
+
+    @classmethod
+    def create(cls, leaves: list[bytes], depth: int) -> "MerkleTree":
+        t = cls(depth)
+        for leaf in leaves:
+            t.push_leaf(leaf)
+        return t
+
+    def __len__(self) -> int:
+        return len(self._levels[0])
+
+    def push_leaf(self, leaf: bytes) -> None:
+        if len(self._levels[0]) >= (1 << self.depth):
+            raise ValueError("merkle tree full")
+        self._levels[0].append(leaf)
+        # bubble up: recompute the rightmost node of each level whose
+        # subtree gained the leaf
+        idx = len(self._levels[0]) - 1
+        for level in range(1, self.depth + 1):
+            idx //= 2
+            left = self._node(level - 1, 2 * idx)
+            right = self._node(level - 1, 2 * idx + 1)
+            row = self._levels[level]
+            if idx < len(row):
+                row[idx] = hash_pair(left, right)
+            else:
+                row.append(hash_pair(left, right))
+
+    def _node(self, level: int, idx: int) -> bytes:
+        row = self._levels[level]
+        return row[idx] if idx < len(row) else ZERO_HASHES[level]
+
+    def root(self) -> bytes:
+        return self._node(self.depth, 0)
+
+    def generate_proof(self, index: int) -> tuple[bytes, list[bytes]]:
+        """(leaf, branch) for leaf position `index`; branch is
+        leaf-adjacent-first, length == depth."""
+        if index >= (1 << self.depth):
+            raise ValueError("index out of range")
+        leaf = self._node(0, index)
+        branch = []
+        idx = index
+        for level in range(self.depth):
+            branch.append(self._node(level, idx ^ 1))
+            idx //= 2
+        return leaf, branch
+
+
+__all__ = [
+    "MerkleTree",
+    "ZERO_HASHES",
+    "compute_root_from_proof",
+    "gindex_branch_indices",
+    "gindex_child",
+    "gindex_depth",
+    "gindex_parent",
+    "gindex_sibling",
+    "hash_pair",
+    "verify_merkle_proof",
+    "verify_merkle_proofs_batch",
+]

@@ -1,0 +1,154 @@
+"""Gossip-level verification of standalone operations.
+
+Rebuild of /root/reference/consensus/state_processing/src/verify_operation.rs:
+each pooled operation type gets a `verify_*_for_gossip` that performs the
+full spec validity check against the head state WITHOUT mutating it, and
+returns a `SigVerifiedOp` carrying the signature set so callers can either
+verify it individually (gossip) or accumulate it into a device batch (the
+beacon_processor's batch lane).  `SigVerifiedOp.validate_at` re-checks
+fork-dependent validity when the op is packed into a block at a later
+epoch (the reference's `TransactionValidity` re-check).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import signature_sets as sigs
+from lighthouse_tpu.state_transition.block_processing import (
+    BLS_WITHDRAWAL_PREFIX,
+    BlockProcessingError,
+    is_slashable_attestation_data,
+)
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
+
+
+class OperationError(ValueError):
+    pass
+
+
+@dataclass
+class SigVerifiedOp:
+    """An operation whose stateless checks passed; `sets` still pending
+    signature verification (individually or batched)."""
+
+    operation: object
+    sets: list[bls.SignatureSet]
+    verified_at_epoch: int
+
+    def verify_signatures(self, backend: str | None = None) -> bool:
+        kw = {"backend": backend} if backend else {}
+        return bls.verify_signature_sets(self.sets, **kw)
+
+    def validate_at(self, state, spec) -> bool:
+        """Signature domains are fork-scoped; an op verified before a fork
+        boundary whose epoch lands after it must be re-verified (reference
+        verify_operation.rs signature re-check on fork change)."""
+        cur = spec.compute_epoch_at_slot(int(state.slot))
+        return spec.fork_at_epoch(cur) == spec.fork_at_epoch(
+            self.verified_at_epoch)
+
+
+def _active(state, index: int, epoch: int) -> bool:
+    v = state.validators
+    return bool(v.activation_epoch[index] <= epoch < v.exit_epoch[index])
+
+
+def verify_voluntary_exit_for_gossip(state, spec, signed_exit) -> SigVerifiedOp:
+    """Spec process_voluntary_exit checks, read-only
+    (verify_operation.rs VerifyOperation for SignedVoluntaryExit)."""
+    exit_msg = signed_exit.message
+    index = int(exit_msg.validator_index)
+    if index >= len(state.validators):
+        raise OperationError("unknown validator")
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    if not _active(state, index, epoch):
+        raise OperationError("validator not active")
+    if int(state.validators.exit_epoch[index]) != FAR_FUTURE_EPOCH:
+        raise OperationError("exit already initiated")
+    if epoch < int(exit_msg.epoch):
+        raise OperationError("exit epoch in the future")
+    shard = int(state.validators.activation_epoch[index])
+    if epoch < shard + spec.shard_committee_period:
+        raise OperationError("validator too young to exit")
+    sset = sigs.voluntary_exit_set(state, spec, signed_exit)
+    return SigVerifiedOp(signed_exit, [sset], epoch)
+
+
+def verify_proposer_slashing_for_gossip(state, spec, slashing) -> SigVerifiedOp:
+    h1, h2 = slashing.signed_header_1.message, slashing.signed_header_2.message
+    if int(h1.slot) != int(h2.slot):
+        raise OperationError("headers at different slots")
+    if int(h1.proposer_index) != int(h2.proposer_index):
+        raise OperationError("headers from different proposers")
+    if h1.hash_tree_root() == h2.hash_tree_root():
+        raise OperationError("headers identical")
+    index = int(h1.proposer_index)
+    if index >= len(state.validators):
+        raise OperationError("unknown proposer")
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    v = state.validators
+    if bool(v.slashed[index]):
+        raise OperationError("proposer already slashed")
+    if not (_active(state, index, epoch)
+            or epoch < int(v.withdrawable_epoch[index])):
+        raise OperationError("proposer not slashable")
+    sets = sigs.proposer_slashing_sets(state, spec, slashing)
+    return SigVerifiedOp(slashing, list(sets), epoch)
+
+
+def verify_attester_slashing_for_gossip(state, spec, slashing) -> SigVerifiedOp:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise OperationError("attestations not slashable")
+    i1 = np.asarray(a1.attesting_indices, dtype=np.uint64)
+    i2 = np.asarray(a2.attesting_indices, dtype=np.uint64)
+    common = np.intersect1d(i1, i2)
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    v = state.validators
+    slashable = [
+        int(i) for i in common
+        if not bool(v.slashed[int(i)])
+        and (_active(state, int(i), epoch)
+             or epoch < int(v.withdrawable_epoch[int(i)]))
+    ]
+    if not slashable:
+        raise OperationError("no slashable indices")
+    try:
+        s1 = sigs.indexed_attestation_set(state, spec, a1)
+        s2 = sigs.indexed_attestation_set(state, spec, a2)
+    except BlockProcessingError as e:  # e.g. unsorted indices
+        raise OperationError(str(e)) from e
+    return SigVerifiedOp(slashing, [s1, s2], epoch)
+
+
+def verify_bls_to_execution_change_for_gossip(state, spec,
+                                              signed_change) -> SigVerifiedOp:
+    change = signed_change.message
+    index = int(change.validator_index)
+    if index >= len(state.validators):
+        raise OperationError("unknown validator")
+    creds = bytes(state.validators.withdrawal_credentials[index])
+    if creds[0] != BLS_WITHDRAWAL_PREFIX:
+        raise OperationError("not a BLS withdrawal credential")
+    import hashlib
+
+    from_pk = bytes(change.from_bls_pubkey)
+    if hashlib.sha256(from_pk).digest()[1:] != creds[1:]:
+        raise OperationError("from_bls_pubkey does not match credentials")
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    sset = sigs.bls_to_execution_change_set(state, spec, signed_change)
+    return SigVerifiedOp(signed_change, [sset], epoch)
+
+
+__all__ = [
+    "OperationError",
+    "SigVerifiedOp",
+    "verify_attester_slashing_for_gossip",
+    "verify_bls_to_execution_change_for_gossip",
+    "verify_proposer_slashing_for_gossip",
+    "verify_voluntary_exit_for_gossip",
+]

@@ -2,7 +2,13 @@
 (reference validator_client/)."""
 
 from lighthouse_tpu.validator.client import ValidatorClient
+from lighthouse_tpu.validator.doppelganger import DoppelgangerService
 from lighthouse_tpu.validator.duties import DutiesService
+from lighthouse_tpu.validator.fallback import BeaconNodeFallback
+from lighthouse_tpu.validator.remote_signer import (
+    RemoteSignerServer,
+    Web3SignerMethod,
+)
 from lighthouse_tpu.validator.slashing_protection import (
     SlashingProtectionDB,
     SlashingProtectionError,
@@ -10,9 +16,13 @@ from lighthouse_tpu.validator.slashing_protection import (
 from lighthouse_tpu.validator.validator_store import ValidatorStore
 
 __all__ = [
+    "BeaconNodeFallback",
+    "DoppelgangerService",
     "DutiesService",
+    "RemoteSignerServer",
     "SlashingProtectionDB",
     "SlashingProtectionError",
     "ValidatorClient",
     "ValidatorStore",
+    "Web3SignerMethod",
 ]

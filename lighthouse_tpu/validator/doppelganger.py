@@ -1,0 +1,96 @@
+"""Doppelganger protection: delay signing until liveness silence is proven.
+
+Rebuild of /root/reference/validator_client/src/doppelganger_service.rs:
+a freshly-started validator client must NOT sign for ~2 epochs while it
+watches the network for signs that the same keys are live elsewhere (a
+second VC with the same keystore would get both slashed).  Each key starts
+in `initializing`, transitions per-epoch through remaining detection
+epochs if no liveness is observed, and is permanently disabled if any
+doppelganger is detected.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# The reference checks the previous and current epoch for 2 full epochs
+# after startup (DEFAULT_REMAINING_DETECTION_EPOCHS = 1 plus the partial
+# startup epoch).
+DETECTION_EPOCHS = 2
+
+
+@dataclass
+class DoppelgangerState:
+    next_check_epoch: int
+    remaining_epochs: int
+
+    @property
+    def requires_further_checks(self) -> bool:
+        return self.remaining_epochs > 0
+
+
+class DoppelgangerService:
+    """Tracks per-validator detection state; the VC consults
+    `validator_should_sign` before every signing operation and feeds
+    observed liveness (gossip attestations/blocks by monitored indices)
+    via `observe_liveness`."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._states: dict[bytes, DoppelgangerState] = {}
+        self._detected: set[bytes] = set()
+
+    def register_validator(self, pubkey: bytes, current_epoch: int) -> None:
+        if pubkey in self._states:
+            return
+        self._states[pubkey] = DoppelgangerState(
+            next_check_epoch=current_epoch + 1,
+            remaining_epochs=DETECTION_EPOCHS if self.enabled else 0)
+
+    def validator_should_sign(self, pubkey: bytes) -> bool:
+        if pubkey in self._detected:
+            return False
+        st = self._states.get(pubkey)
+        if st is None:
+            # unregistered keys fail closed when protection is on
+            return not self.enabled
+        return not st.requires_further_checks
+
+    def doppelganger_detected(self) -> bool:
+        return bool(self._detected)
+
+    def observe_liveness(self, pubkey: bytes, epoch: int) -> bool:
+        """Report that `pubkey` was seen live on the network at `epoch`
+        (an attestation or block NOT produced by this VC).  Returns True
+        if this constitutes a doppelganger detection."""
+        st = self._states.get(pubkey)
+        if st is None or not st.requires_further_checks:
+            return False  # our own signing once enabled, or unmanaged
+        self._detected.add(pubkey)
+        return True
+
+    def advance_epoch(self, current_epoch: int,
+                      liveness_fn=None) -> list[bytes]:
+        """Per-epoch tick (reference's 75%-through-epoch poll): query
+        liveness for all still-checking keys via `liveness_fn(pubkeys,
+        epoch) -> set[pubkey_live]`, then either flag doppelgangers or
+        count the epoch as silent.  Returns newly-detected pubkeys."""
+        newly = []
+        checking = [pk for pk, st in self._states.items()
+                    if st.requires_further_checks
+                    and current_epoch >= st.next_check_epoch]
+        live = set()
+        if liveness_fn is not None and checking:
+            live = set(liveness_fn(checking, current_epoch))
+        for pk in checking:
+            st = self._states[pk]
+            if pk in live:
+                self._detected.add(pk)
+                newly.append(pk)
+                continue
+            st.remaining_epochs -= 1
+            st.next_check_epoch = current_epoch + 1
+        return newly
+
+
+__all__ = ["DETECTION_EPOCHS", "DoppelgangerService", "DoppelgangerState"]

@@ -1,0 +1,100 @@
+"""Multi-beacon-node fallback with health ranking.
+
+Rebuild of /root/reference/validator_client/src/beacon_node_fallback.rs:
+the VC holds an ordered list of candidate beacon nodes, health-checks
+them (synced / optimistic / offline), and routes every API call to the
+best healthy candidate, falling through on error.  Here a "node" is any
+object exposing the in-process BeaconApiClient surface
+(lighthouse_tpu/api/client.py); over the wire the same contract applies.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+
+class Health(IntEnum):
+    """Lower ranks first (reference BeaconNodeHealth tiers)."""
+
+    SYNCED = 0
+    OPTIMISTIC = 1
+    SYNCING = 2
+    OFFLINE = 3
+
+
+@dataclass
+class Candidate:
+    name: str
+    node: object
+    health: Health = Health.OFFLINE
+    last_check: float = 0.0
+    consecutive_failures: int = 0
+    latency_s: float | None = field(default=None)
+
+
+class AllNodesFailed(RuntimeError):
+    pass
+
+
+class BeaconNodeFallback:
+    def __init__(self, nodes: list[tuple[str, object]],
+                 sync_tolerance_slots: int = 8, clock=time.monotonic):
+        self.candidates = [Candidate(name, node) for name, node in nodes]
+        self.sync_tolerance_slots = sync_tolerance_slots
+        self.clock = clock
+
+    def check_health(self) -> None:
+        """Probe every candidate's syncing endpoint and rank it
+        (reference check_candidate / Health ordering)."""
+        for c in self.candidates:
+            t0 = self.clock()
+            try:
+                syncing = c.node.get_syncing()
+            except Exception:
+                c.health = Health.OFFLINE
+                c.consecutive_failures += 1
+                c.latency_s = None
+                continue
+            c.latency_s = self.clock() - t0
+            c.consecutive_failures = 0
+            distance = int(syncing.get("sync_distance", 0))
+            if syncing.get("is_optimistic"):
+                c.health = Health.OPTIMISTIC
+            elif distance <= self.sync_tolerance_slots:
+                c.health = Health.SYNCED
+            else:
+                c.health = Health.SYNCING
+            c.last_check = self.clock()
+
+    def _ranked(self) -> list[Candidate]:
+        # stable sort: health tier, then measured latency, then list order
+        return sorted(
+            self.candidates,
+            key=lambda c: (int(c.health),
+                           c.latency_s if c.latency_s is not None else 1e9))
+
+    def best(self) -> Candidate | None:
+        ranked = self._ranked()
+        return ranked[0] if ranked else None
+
+    def first_success(self, op, *args, require_synced: bool = False, **kw):
+        """Run `op(node, *args, **kw)` against candidates best-first,
+        returning the first success (reference first_success!)."""
+        errors = []
+        for c in self._ranked():
+            if require_synced and c.health not in (
+                    Health.SYNCED, Health.OPTIMISTIC):
+                continue
+            try:
+                out = op(c.node, *args, **kw)
+                c.consecutive_failures = 0
+                return out
+            except Exception as e:  # noqa: BLE001 — route to next node
+                c.consecutive_failures += 1
+                errors.append((c.name, repr(e)))
+        raise AllNodesFailed(f"all beacon nodes failed: {errors}")
+
+
+__all__ = ["AllNodesFailed", "BeaconNodeFallback", "Candidate", "Health"]

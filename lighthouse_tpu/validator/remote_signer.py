@@ -1,0 +1,158 @@
+"""Remote signing: a Web3Signer-shaped HTTP signer + client.
+
+Rebuild of /root/reference/validator_client/src/signing_method.rs:80-91
+(SigningMethod::Web3Signer) and the server half the reference tests
+against (testing/web3signer_tests): the VC holds only public keys and
+POSTs {type, fork_info, signing_root} to a remote signer which holds the
+secrets; the response carries the hex signature.  stdlib http.server on
+the server side, http.client on the client side, matching the repo's
+Beacon-API transport.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu.crypto import bls
+
+
+class RemoteSignerError(RuntimeError):
+    pass
+
+
+class RemoteSignerServer:
+    """Holds keys; serves POST /api/v1/eth2/sign/{pubkey_hex}."""
+
+    def __init__(self, port: int = 0):
+        self._keys: dict[bytes, bls.SecretKey] = {}
+        self._srv: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port = port
+
+    def add_key(self, secret_key: bls.SecretKey) -> bytes:
+        pk = secret_key.public_key().to_bytes()
+        self._keys[pk] = secret_key
+        return pk
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        sk = self._keys.get(pubkey)
+        if sk is None:
+            raise KeyError(pubkey.hex())
+        return sk.sign(signing_root).to_bytes()
+
+    def start(self) -> "RemoteSignerServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/upcheck":
+                    body = b"OK"
+                    self.send_response(200)
+                elif self.path == "/api/v1/eth2/publicKeys":
+                    body = json.dumps(
+                        ["0x" + pk.hex() for pk in outer._keys]).encode()
+                    self.send_response(200)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                prefix = "/api/v1/eth2/sign/"
+                if not self.path.startswith(prefix):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    pk = bytes.fromhex(
+                        self.path[len(prefix):].removeprefix("0x"))
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    root = bytes.fromhex(
+                        req["signing_root"].removeprefix("0x"))
+                    sig = outer.sign(pk, root)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                body = json.dumps({"signature": "0x" + sig.hex()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._srv.server_port
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+class Web3SignerMethod:
+    """Client-side signing method: same `sign(pubkey, signing_root)`
+    surface as a local keystore, but the secret never enters this
+    process."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def upcheck(self) -> bool:
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+            conn.request("GET", "/upcheck")
+            return conn.getresponse().status == 200
+        except OSError:
+            return False
+
+    def public_keys(self) -> list[bytes]:
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout)
+        conn.request("GET", "/api/v1/eth2/publicKeys")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RemoteSignerError(f"publicKeys -> {resp.status}")
+        return [bytes.fromhex(h.removeprefix("0x"))
+                for h in json.loads(resp.read())]
+
+    def sign(self, pubkey: bytes, signing_root: bytes,
+             sign_type: str = "BLOCK") -> bytes:
+        payload = json.dumps({
+            "type": sign_type,
+            "signing_root": "0x" + signing_root.hex(),
+        })
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout)
+        conn.request(
+            "POST", "/api/v1/eth2/sign/0x" + pubkey.hex(), body=payload,
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RemoteSignerError(
+                f"sign({pubkey.hex()[:16]}) -> {resp.status}")
+        return bytes.fromhex(
+            json.loads(resp.read())["signature"].removeprefix("0x"))
+
+
+__all__ = ["RemoteSignerError", "RemoteSignerServer", "Web3SignerMethod"]

@@ -1,0 +1,98 @@
+"""Generalized-index Merkle proofs + safe arithmetic tests."""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.common import safe_arith as sa
+from lighthouse_tpu.ssz import core as ssz
+from lighthouse_tpu.ssz.merkle_proof import (
+    MerkleTree,
+    ZERO_HASHES,
+    compute_root_from_proof,
+    gindex_branch_indices,
+    gindex_depth,
+    verify_merkle_proof,
+    verify_merkle_proofs_batch,
+)
+
+
+class TestGindex:
+    def test_depth_and_branch(self):
+        assert gindex_depth(1) == 0
+        assert gindex_depth(2) == 1
+        assert gindex_depth(16 + 3) == 4
+        assert gindex_branch_indices(0b1101) == [0b1100, 0b111, 0b10]
+
+
+class TestMerkleTree:
+    def test_root_matches_ssz_merkleize(self):
+        leaves = [hashlib.sha256(bytes([i])).digest() for i in range(11)]
+        t = MerkleTree.create(leaves, 4)
+        expected = ssz.merkleize_chunks(b"".join(leaves), limit=16)
+        assert t.root() == expected
+
+    def test_empty_tree_is_zero_ladder(self):
+        assert MerkleTree(5).root() == ZERO_HASHES[5]
+
+    def test_proofs_verify_and_reject(self):
+        leaves = [bytes([i]) * 32 for i in range(9)]
+        t = MerkleTree.create(leaves, 5)
+        for i in range(9):
+            leaf, branch = t.generate_proof(i)
+            g = (1 << 5) + i
+            assert verify_merkle_proof(leaf, branch, g, t.root())
+            assert not verify_merkle_proof(
+                b"\xff" * 32, branch, g, t.root())
+        # zero-padding positions also prove
+        leaf, branch = t.generate_proof(20)
+        assert leaf == b"\x00" * 32
+        assert verify_merkle_proof(leaf, branch, (1 << 5) + 20, t.root())
+
+    def test_push_past_capacity_raises(self):
+        t = MerkleTree.create([b"\x01" * 32] * 4, 2)
+        with pytest.raises(ValueError, match="full"):
+            t.push_leaf(b"\x02" * 32)
+
+    def test_proof_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="proof length"):
+            compute_root_from_proof(b"\x00" * 32, 8, [b"\x00" * 32])
+
+    def test_batch_verification_device_path(self):
+        leaves = [bytes([i + 1]) * 32 for i in range(13)]
+        t = MerkleTree.create(leaves, 6)
+        ls, prs, gs = [], [], []
+        for i in range(13):
+            leaf, br = t.generate_proof(i)
+            ls.append(leaf)
+            prs.append(br)
+            gs.append((1 << 6) + i)
+        assert verify_merkle_proofs_batch(ls, prs, gs, t.root())
+        bad = list(ls)
+        bad[7] = b"\xee" * 32
+        assert not verify_merkle_proofs_batch(bad, prs, gs, t.root())
+
+
+class TestSafeArith:
+    def test_checked_ops(self):
+        assert sa.safe_add(2**63, 2**63 - 1) == 2**64 - 1
+        with pytest.raises(sa.ArithError):
+            sa.safe_add(2**64 - 1, 1)
+        with pytest.raises(sa.ArithError):
+            sa.safe_sub(3, 5)
+        with pytest.raises(sa.ArithError):
+            sa.safe_mul(2**33, 2**33)
+        with pytest.raises(sa.ArithError):
+            sa.safe_div(1, 0)
+
+    def test_saturating(self):
+        assert sa.saturating_sub(3, 5) == 0
+        assert sa.saturating_add(2**64 - 1, 5) == 2**64 - 1
+
+    def test_integer_squareroot_matches_spec(self):
+        import math
+
+        for n in [0, 1, 2, 3, 4, 24, 25, 26, 10**12, 2**64 - 1]:
+            assert sa.integer_squareroot(n) == math.isqrt(n)
+        with pytest.raises(sa.ArithError):
+            sa.integer_squareroot(2**64)

@@ -1,0 +1,137 @@
+"""Doppelganger protection, multi-BN fallback, remote signing tests."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.validator.doppelganger import DoppelgangerService
+from lighthouse_tpu.validator.fallback import (
+    AllNodesFailed,
+    BeaconNodeFallback,
+    Health,
+)
+from lighthouse_tpu.validator.remote_signer import (
+    RemoteSignerServer,
+    Web3SignerMethod,
+)
+
+
+class TestDoppelganger:
+    def test_blocks_signing_until_detection_window_clears(self):
+        svc = DoppelgangerService()
+        pk = b"\x01" * 48
+        svc.register_validator(pk, current_epoch=10)
+        assert not svc.validator_should_sign(pk)
+        assert svc.advance_epoch(11) == []
+        assert not svc.validator_should_sign(pk)
+        assert svc.advance_epoch(12) == []
+        assert svc.validator_should_sign(pk)
+
+    def test_detection_disables_key_permanently(self):
+        svc = DoppelgangerService()
+        pk = b"\x02" * 48
+        svc.register_validator(pk, current_epoch=0)
+        newly = svc.advance_epoch(1, liveness_fn=lambda pks, e: set(pks))
+        assert newly == [pk]
+        assert svc.doppelganger_detected()
+        for epoch in range(2, 8):
+            svc.advance_epoch(epoch)
+        assert not svc.validator_should_sign(pk)
+
+    def test_observe_liveness_mid_window(self):
+        svc = DoppelgangerService()
+        pk = b"\x03" * 48
+        svc.register_validator(pk, current_epoch=0)
+        assert svc.observe_liveness(pk, 0)
+        assert not svc.validator_should_sign(pk)
+
+    def test_disabled_service_signs_immediately(self):
+        svc = DoppelgangerService(enabled=False)
+        pk = b"\x04" * 48
+        svc.register_validator(pk, current_epoch=0)
+        assert svc.validator_should_sign(pk)
+        # unregistered keys allowed when protection is off
+        assert svc.validator_should_sign(b"\x05" * 48)
+
+
+class _FakeNode:
+    def __init__(self, distance=0, optimistic=False, fail=False):
+        self.distance = distance
+        self.optimistic = optimistic
+        self.fail = fail
+        self.calls = 0
+
+    def get_syncing(self):
+        if self.fail:
+            raise ConnectionError("down")
+        return {"sync_distance": self.distance,
+                "is_optimistic": self.optimistic}
+
+    def op(self):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError("down")
+        return self
+
+
+class TestFallback:
+    def test_health_ranking(self):
+        synced, syncing, down = _FakeNode(), _FakeNode(99), _FakeNode(fail=True)
+        fb = BeaconNodeFallback(
+            [("down", down), ("syncing", syncing), ("synced", synced)])
+        fb.check_health()
+        by_name = {c.name: c.health for c in fb.candidates}
+        assert by_name == {"down": Health.OFFLINE,
+                           "syncing": Health.SYNCING,
+                           "synced": Health.SYNCED}
+        assert fb.best().name == "synced"
+
+    def test_first_success_falls_through(self):
+        bad, good = _FakeNode(fail=True), _FakeNode()
+        fb = BeaconNodeFallback([("bad", bad), ("good", good)])
+        fb.check_health()
+        got = fb.first_success(lambda n: n.op())
+        assert got is good
+
+    def test_all_failed_raises(self):
+        fb = BeaconNodeFallback([("a", _FakeNode(fail=True))])
+        fb.check_health()
+        with pytest.raises(AllNodesFailed):
+            fb.first_success(lambda n: n.op())
+
+    def test_require_synced_skips_stale(self):
+        syncing = _FakeNode(99)
+        fb = BeaconNodeFallback([("syncing", syncing)])
+        fb.check_health()
+        with pytest.raises(AllNodesFailed):
+            fb.first_success(lambda n: n.op(), require_synced=True)
+
+
+class TestRemoteSigner:
+    def test_sign_roundtrip_over_http(self):
+        server = RemoteSignerServer().start()
+        try:
+            sk = bls.SecretKey.from_bytes((41).to_bytes(32, "big"))
+            pk = server.add_key(sk)
+            method = Web3SignerMethod("127.0.0.1", server.port)
+            assert method.upcheck()
+            assert method.public_keys() == [pk]
+            root = b"\x07" * 32
+            sig = method.sign(pk, root)
+            assert sig == sk.sign(root).to_bytes()
+            # the signature actually verifies
+            assert bls.verify(bls.PublicKey(pk), root, bls.Signature(sig))
+        finally:
+            server.stop()
+
+    def test_unknown_key_404(self):
+        server = RemoteSignerServer().start()
+        try:
+            method = Web3SignerMethod("127.0.0.1", server.port)
+            from lighthouse_tpu.validator.remote_signer import (
+                RemoteSignerError,
+            )
+
+            with pytest.raises(RemoteSignerError):
+                method.sign(b"\x09" * 48, b"\x00" * 32)
+        finally:
+            server.stop()

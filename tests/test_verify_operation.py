@@ -1,0 +1,144 @@
+"""Gossip-level operation verification (verify_operation.py) tests."""
+
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.state_transition import misc
+from lighthouse_tpu.state_transition.verify_operation import (
+    OperationError,
+    verify_attester_slashing_for_gossip,
+    verify_proposer_slashing_for_gossip,
+    verify_voluntary_exit_for_gossip,
+)
+from lighthouse_tpu.testing import Harness
+
+
+def _signed_exit(h, index: int, epoch: int):
+    spec = h.spec
+    exit_msg = T.VoluntaryExit(epoch=epoch, validator_index=index)
+    domain = misc.get_domain(
+        h.state, spec, spec.domain_voluntary_exit, epoch)
+    sig = h.sk(index).sign(
+        misc.compute_signing_root(exit_msg.hash_tree_root(), domain))
+    return T.SignedVoluntaryExit(
+        message=exit_msg, signature=sig.to_bytes())
+
+
+class TestVoluntaryExit:
+    def test_valid_exit_verifies(self):
+        h = Harness(16)
+        spec = h.spec
+        target = spec.shard_committee_period
+        h.state.slot = spec.compute_start_slot_at_epoch(target)
+        op = verify_voluntary_exit_for_gossip(
+            h.state, spec, _signed_exit(h, 5, target))
+        assert op.verify_signatures()
+        assert op.validate_at(h.state, spec)
+
+    def test_young_validator_rejected(self):
+        h = Harness(16)
+        with pytest.raises(OperationError, match="too young"):
+            verify_voluntary_exit_for_gossip(
+                h.state, h.spec, _signed_exit(h, 5, 0))
+
+    def test_already_exiting_rejected(self):
+        h = Harness(16)
+        spec = h.spec
+        target = spec.shard_committee_period
+        h.state.slot = spec.compute_start_slot_at_epoch(target)
+        h.state.validators.exit_epoch[5] = target + 10
+        with pytest.raises(OperationError, match="already initiated"):
+            verify_voluntary_exit_for_gossip(
+                h.state, spec, _signed_exit(h, 5, target))
+
+    def test_state_not_mutated(self):
+        h = Harness(16)
+        spec = h.spec
+        target = spec.shard_committee_period
+        h.state.slot = spec.compute_start_slot_at_epoch(target)
+        before = int(h.state.validators.exit_epoch[5])
+        verify_voluntary_exit_for_gossip(
+            h.state, spec, _signed_exit(h, 5, target))
+        assert int(h.state.validators.exit_epoch[5]) == before
+
+
+class TestProposerSlashing:
+    def _make(self, h, proposer: int, same_header: bool = False):
+        spec = h.spec
+        st = h.state
+        epoch = misc.current_epoch(st, spec)
+        mk = lambda root: T.BeaconBlockHeader(
+            slot=int(st.slot), proposer_index=proposer, parent_root=root,
+            state_root=b"\x00" * 32, body_root=b"\x00" * 32)
+        h1 = mk(b"\x01" * 32)
+        h2 = h1 if same_header else mk(b"\x02" * 32)
+        sign = lambda hh: T.SignedBeaconBlockHeader(
+            message=hh, signature=h._sign(
+                h.sk(proposer), hh.hash_tree_root(),
+                spec.domain_beacon_proposer, epoch))
+        return T.ProposerSlashing(
+            signed_header_1=sign(h1), signed_header_2=sign(h2))
+
+    def test_valid_slashing(self):
+        h = Harness(16)
+        op = verify_proposer_slashing_for_gossip(
+            h.state, h.spec, self._make(h, 3))
+        assert len(op.sets) == 2
+        assert op.verify_signatures()
+
+    def test_identical_headers_rejected(self):
+        h = Harness(16)
+        with pytest.raises(OperationError, match="identical"):
+            verify_proposer_slashing_for_gossip(
+                h.state, h.spec, self._make(h, 3, same_header=True))
+
+    def test_already_slashed_rejected(self):
+        h = Harness(16)
+        slashing = self._make(h, 3)
+        h.state.validators.slashed[3] = True
+        with pytest.raises(OperationError, match="already slashed"):
+            verify_proposer_slashing_for_gossip(h.state, h.spec, slashing)
+
+
+class TestAttesterSlashing:
+    def _indexed(self, h, indices, source_epoch, target_root):
+        spec = h.spec
+        data = T.AttestationData(
+            slot=0, index=0,
+            beacon_block_root=b"\x11" * 32,
+            source=T.Checkpoint(epoch=source_epoch, root=b"\x00" * 32),
+            target=T.Checkpoint(epoch=0, root=target_root))
+        domain = misc.get_domain(
+            h.state, spec, spec.domain_beacon_attester, 0)
+        root = misc.compute_signing_root(data.hash_tree_root(), domain)
+        from lighthouse_tpu.crypto import bls
+
+        sigs = [h.sk(i).sign(root) for i in indices]
+        agg = bls.Signature.aggregate(sigs)
+        return h.t.IndexedAttestation(
+            attesting_indices=list(indices), data=data,
+            signature=agg.to_bytes())
+
+    def test_double_vote_slashing(self):
+        h = Harness(16)
+        a1 = self._indexed(h, [2, 5, 9], 0, b"\xaa" * 32)
+        a2 = self._indexed(h, [5, 9, 11], 0, b"\xbb" * 32)
+        sl = h.t.AttesterSlashing(attestation_1=a1, attestation_2=a2)
+        op = verify_attester_slashing_for_gossip(h.state, h.spec, sl)
+        assert op.verify_signatures()
+
+    def test_disjoint_indices_rejected(self):
+        h = Harness(16)
+        a1 = self._indexed(h, [2, 5], 0, b"\xaa" * 32)
+        a2 = self._indexed(h, [9, 11], 0, b"\xbb" * 32)
+        sl = h.t.AttesterSlashing(attestation_1=a1, attestation_2=a2)
+        with pytest.raises(OperationError, match="no slashable"):
+            verify_attester_slashing_for_gossip(h.state, h.spec, sl)
+
+    def test_non_slashable_data_rejected(self):
+        h = Harness(16)
+        a1 = self._indexed(h, [2, 5], 0, b"\xaa" * 32)
+        a2 = self._indexed(h, [2, 5], 0, b"\xaa" * 32)
+        sl = h.t.AttesterSlashing(attestation_1=a1, attestation_2=a2)
+        with pytest.raises(OperationError, match="not slashable"):
+            verify_attester_slashing_for_gossip(h.state, h.spec, sl)
