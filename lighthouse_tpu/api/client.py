@@ -68,6 +68,13 @@ class BeaconNodeClient:
         data = self._call("GET", f"/eth/v2/beacon/blocks/{block_id}")
         return bytes.fromhex(data["ssz_hex"])
 
+    def state_ssz(self, state_id="finalized") -> tuple[bytes, str]:
+        """(state_ssz, fork_name) from the debug endpoint — the
+        checkpoint-sync bootstrap download (reference client
+        get_debug_beacon_states)."""
+        data = self._call("GET", f"/eth/v2/debug/beacon/states/{state_id}")
+        return bytes.fromhex(data["ssz_hex"]), data["version"]
+
     def publish_block(self, signed_block) -> bytes | None:
         data = self._call("POST", "/eth/v1/beacon/blocks",
                           {"ssz_hex": signed_block.serialize().hex()})["data"]

@@ -57,6 +57,8 @@ class BeaconApi:
           self.lc_optimistic)
         r("GET", r"/eth/v1/beacon/light_client/finality_update",
           self.lc_finality)
+        r("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>\w+)",
+          self.debug_state_ssz)
         r("GET", r"/eth/v1/node/version", self.version)
         r("GET", r"/eth/v1/node/health", self.health)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
@@ -217,16 +219,18 @@ class BeaconApi:
         }, "signature": _hex(blk.signature)},
             "ssz_hex": blk.serialize().hex()}
 
+    def debug_state_ssz(self, state_id, body=None):
+        """Full-state SSZ download (the standard debug endpoint checkpoint
+        -sync providers serve; reference http_api debug routes)."""
+        st = self._state(state_id)
+        return {"ssz_hex": st.serialize().hex(),
+                "version": self.chain.spec.fork_at_epoch(
+                    self.chain.spec.compute_epoch_at_slot(int(st.slot)))}
+
     def publish_block(self, body=None):
         c = self.chain
         raw = bytes.fromhex(json.loads(body)["ssz_hex"])
-        block = None
-        for f in reversed(c.t.forks):
-            try:
-                block = c.t.signed_beacon_block_class(f).deserialize(raw)
-                break
-            except Exception:
-                continue
+        block = c.t.decode_signed_block(raw)
         if block is None:
             raise ApiError(400, "undecodable block")
         from lighthouse_tpu.chain.block_verification import BlockError

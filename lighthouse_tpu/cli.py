@@ -14,6 +14,13 @@ import sys
 import time
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise argparse.ArgumentTypeError("must be >= 1")
+    return n
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lighthouse-tpu",
@@ -75,8 +82,42 @@ def _build_parser() -> argparse.ArgumentParser:
     db_sub = db.add_subparsers(dest="db_command", required=True)
     db_sub.add_parser("inspect")
     db_sub.add_parser("compact")
+    db_sub.add_parser("version")
+    mig = db_sub.add_parser("migrate")
+    mig.add_argument("--to", type=int, default=None,
+                     help="target schema version (default: current)")
     prune = db_sub.add_parser("prune-states")
     prune.add_argument("--confirm", action="store_true")
+
+    # lcli-equivalent dev tooling (reference lcli/src/{transition_blocks,
+    # skip_slots,parse_ssz}.rs): timed state-transition runs over SSZ
+    # fixtures — the CPU-baseline measuring stick.
+    dev = sub.add_parser("dev", help="dev/benchmark tooling")
+    dev_sub = dev.add_subparsers(dest="dev_command", required=True)
+    tb = dev_sub.add_parser("transition-blocks",
+                            help="apply block(s) to a pre-state, timed")
+    tb.add_argument("--pre", required=True, help="pre-state SSZ path")
+    tb.add_argument("--blocks", required=True, nargs="+",
+                    help="signed-block SSZ path(s), in order")
+    tb.add_argument("--fork", default="capella")
+    tb.add_argument("--runs", type=_positive_int, default=1)
+    tb.add_argument("--no-signature-verification", action="store_true")
+    tb.add_argument("--post-out", default=None,
+                    help="write the post-state SSZ here")
+    sk = dev_sub.add_parser("skip-slots",
+                            help="advance a pre-state N slots, timed")
+    sk.add_argument("--pre", required=True)
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--fork", default="capella")
+    sk.add_argument("--runs", type=_positive_int, default=1)
+    sr = dev_sub.add_parser("state-root", help="hash_tree_root a state, timed")
+    sr.add_argument("--state", required=True)
+    sr.add_argument("--fork", default="capella")
+    sr.add_argument("--runs", type=_positive_int, default=1)
+    pz = dev_sub.add_parser("parse-ssz", help="decode an SSZ object to JSON")
+    pz.add_argument("--type", required=True,
+                    help="container name, e.g. SignedBeaconBlock:capella")
+    pz.add_argument("path")
     return p
 
 
@@ -222,6 +263,46 @@ def _run_db(args) -> int:
 
     if not args.datadir:
         raise SystemExit("db commands need --datadir")
+
+    if args.db_command in ("version", "migrate"):
+        # open the raw KV only — HotColdDB would auto-migrate on open,
+        # making 'version' destructive and 'migrate --to' uncontrollable
+        from lighthouse_tpu.store import migrate_schema, read_schema_version
+
+        hot_path = os.path.join(args.datadir, "hot.db")
+        if not os.path.exists(hot_path):
+            raise SystemExit(f"no database at {hot_path}")
+        hot = NativeKVStore(hot_path)
+
+        class _RawDB:  # the shim migrate_schema/read_schema_version need
+            def __init__(self):
+                self.hot = hot
+                # prefer the DB's own recorded config; fall back to the
+                # --network preset only for pre-v2 DBs that never stored
+                # one (the operator must pass the right --network then)
+                from lighthouse_tpu.store.migrations import read_db_config
+
+                cfg = read_db_config(self)
+                if cfg and "slots_per_restore_point" in cfg:
+                    self.slots_per_restore_point = cfg[
+                        "slots_per_restore_point"]
+                else:
+                    from lighthouse_tpu.client.network_config import (
+                        spec_for_network,
+                    )
+
+                    spec = spec_for_network(args.network)
+                    self.slots_per_restore_point = 2 * spec.slots_per_epoch
+
+        db = _RawDB()
+        if args.db_command == "migrate":
+            v = migrate_schema(db, target=args.to)
+        else:
+            v = read_schema_version(db)
+        hot.close()
+        print(json.dumps({"schema_version": v}))
+        return 0
+
     out = {}
     for name in ("hot.db", "cold.db"):
         path = os.path.join(args.datadir, name)
@@ -239,6 +320,99 @@ def _run_db(args) -> int:
     return 0
 
 
+def _run_dev(args) -> int:
+    """lcli-equivalent timed tools (reference lcli/src/transition_blocks.rs
+    :1-30 run/timing structure, skip_slots.rs)."""
+    from lighthouse_tpu import types as T
+    from lighthouse_tpu.client.network_config import spec_for_network
+
+    spec = spec_for_network(args.network)
+    t = T.make_types(spec.preset)
+
+    def load_state(path, fork):
+        with open(path, "rb") as f:
+            return t.beacon_state_class(fork).deserialize(f.read())
+
+    if args.dev_command == "parse-ssz":
+        name, _, fork = args.type.partition(":")
+        cls = (t.signed_beacon_block_class(fork or "capella")
+               if name == "SignedBeaconBlock"
+               else t.beacon_state_class(fork or "capella")
+               if name == "BeaconState"
+               else getattr(T, name))
+        with open(args.path, "rb") as f:
+            obj = cls.deserialize(f.read())
+        root = obj.hash_tree_root()
+        print(json.dumps({"type": args.type,
+                          "hash_tree_root": "0x" + root.hex()}))
+        return 0
+
+    if args.dev_command == "state-root":
+        state = load_state(args.state, args.fork)
+        times = []
+        for _ in range(args.runs):
+            state_copy = state.copy()
+            t0 = time.perf_counter()
+            root = state_copy.hash_tree_root()
+            times.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "state_root": "0x" + root.hex(),
+            "slot": int(state.slot),
+            "ms_per_run": round(min(times) * 1000, 3)}))
+        return 0
+
+    if args.dev_command == "skip-slots":
+        from lighthouse_tpu.state_transition import state_advance
+
+        state = load_state(args.pre, args.fork)
+        target = int(state.slot) + args.slots
+        times = []
+        for _ in range(args.runs):
+            st = state.copy()
+            t0 = time.perf_counter()
+            state_advance(st, spec, target)
+            times.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "slots": args.slots,
+            "post_root": "0x" + st.hash_tree_root().hex(),
+            "ms_per_run": round(min(times) * 1000, 3)}))
+        return 0
+
+    if args.dev_command == "transition-blocks":
+        from lighthouse_tpu.state_transition import (
+            SignatureStrategy,
+            state_transition,
+        )
+
+        state = load_state(args.pre, args.fork)
+        blocks = []
+        for path in args.blocks:
+            with open(path, "rb") as f:
+                blocks.append(
+                    t.signed_beacon_block_class(args.fork).deserialize(
+                        f.read()))
+        strategy = (SignatureStrategy.NO_VERIFICATION
+                    if args.no_signature_verification
+                    else SignatureStrategy.VERIFY_BULK)
+        times = []
+        for _ in range(args.runs):
+            st = state.copy()
+            t0 = time.perf_counter()
+            for blk in blocks:
+                state_transition(st, spec, blk, strategy,
+                                 validate_result=False)
+            times.append(time.perf_counter() - t0)
+        if args.post_out:
+            with open(args.post_out, "wb") as f:
+                f.write(st.serialize())
+        print(json.dumps({
+            "blocks": len(blocks),
+            "post_root": "0x" + st.hash_tree_root().hex(),
+            "ms_per_run": round(min(times) * 1000, 3)}))
+        return 0
+    raise SystemExit(f"unknown dev command {args.dev_command}")
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     return {
@@ -247,6 +421,7 @@ def main(argv: list[str] | None = None) -> int:
         "account-manager": _run_account_manager,
         "validator-manager": _run_validator_manager,
         "db": _run_db,
+        "dev": _run_dev,
     }[args.command](args)
 
 

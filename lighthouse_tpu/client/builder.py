@@ -31,6 +31,9 @@ class ClientConfig:
     genesis_fork: str = "capella"
     verify_signatures: bool = True
     sync_tolerance_slots: int = 1
+    # checkpoint sync: bootstrap from a remote node's finalized state
+    # instead of genesis (reference beacon_node/src/config.rs:506-527)
+    checkpoint_sync_url: str | None = None
 
 
 @dataclass
@@ -60,6 +63,7 @@ class ClientBuilder:
         self.executor = TaskExecutor("bn")
         self._el = None
         self._eth1 = None
+        self._anchor_block = None
 
     # -- stages (each returns self, builder-style) ------------------------
 
@@ -81,10 +85,45 @@ class ClientBuilder:
 
         if state is not None:
             self.genesis_state = state
+        elif self.config.checkpoint_sync_url:
+            return self.checkpoint_sync(self.config.checkpoint_sync_url)
         else:
             fork = self.config.genesis_fork
             self.genesis_state = genesis_state(
                 self.config.n_genesis_validators, self.spec, fork)
+        return self
+
+    def checkpoint_sync(self, url: str) -> "ClientBuilder":
+        """Bootstrap from a remote node's finalized state + block
+        (reference ClientBuilder checkpoint-sync path: download the
+        finalized pair, anchor the chain on it, backfill later)."""
+        from lighthouse_tpu import types as T
+        from lighthouse_tpu.api.client import BeaconNodeClient
+
+        remote = BeaconNodeClient(url)
+        state_raw, fork = remote.state_ssz("finalized")
+        t = T.make_types(self.spec.preset)
+        state = t.beacon_state_class(fork).deserialize(state_raw)
+        block_raw = remote.block_ssz("finalized")
+        block = t.decode_signed_block(block_raw)
+        if block is None:
+            raise RuntimeError("checkpoint block undecodable")
+        # the two 'finalized' fetches are not atomic — finalization may
+        # advance between them; the block MUST be the one the state's
+        # latest_block_header describes or the anchor is incoherent
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+
+        want = BeaconChain._anchor_block_root(state)
+        got = block.message.hash_tree_root()
+        if got != want:
+            raise RuntimeError(
+                f"checkpoint block {got.hex()[:16]} does not match the "
+                f"checkpoint state's anchor root {want.hex()[:16]} "
+                "(finalization advanced mid-download? retry)")
+        self.genesis_state = state
+        self._anchor_block = block
+        self.log.info(
+            "checkpoint sync bootstrap", slot=int(state.slot), fork=fork)
         return self
 
     def execution_layer(self) -> "ClientBuilder":
@@ -128,6 +167,10 @@ class ClientBuilder:
             self.spec, self.genesis_state, store=store,
             verify_signatures=self.config.verify_signatures,
             execution_layer=self._el)
+        if self._anchor_block is not None:
+            # persist the checkpoint anchor block so sync/API can serve it
+            self.chain.store.put_block(
+                self.chain.genesis_block_root, self._anchor_block)
         if self._eth1 is not None:
             self.chain.eth1_service = self._eth1
         if self.config.slasher_enabled:

@@ -1,0 +1,170 @@
+"""Backfill sync: reverse-fill history below a checkpoint anchor.
+
+Rebuild of /root/reference/beacon_node/network/src/sync/backfill_sync/:
+after checkpoint sync the chain starts at a finalized anchor with no
+history.  Backfill requests BlocksByRange batches walking BACKWARD from
+the anchor slot, verifies each batch by parent-root linkage against the
+known child (no state needed — the hash chain is the proof, which is why
+the reference can backfill without replaying), persists the blocks and
+records canonical block roots in the freezer so the API and sync can
+serve the full chain.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    P_BLOCKS_BY_RANGE,
+    RpcError,
+)
+from lighthouse_tpu.store.hot_cold import P_COLD_BLOCK_ROOT, _slot_key
+from lighthouse_tpu.store.kv import KeyValueOp
+
+BATCH_SIZE = 32
+
+
+class BackfillError(ValueError):
+    pass
+
+
+class BackfillSync:
+    """Walks from `chain.anchor_slot` down to genesis, one batch per
+    `process_batch` call (the reference paces batches through the
+    processor's own work queue)."""
+
+    def __init__(self, chain, rpc_ep, peer_manager,
+                 terminal_root: bytes | None = None):
+        self.chain = chain
+        self.rpc = rpc_ep
+        self.peers = peer_manager
+        # the network's true genesis block root (from network config /
+        # the operator), when known: backfill is only complete once the
+        # hash chain provably links to it.  Without it, completion falls
+        # back to reaching slot 0 / a parent-zero genesis block (trusted
+        # -peer mode) — a peer omitting early blocks is then undetectable.
+        self.terminal_root = terminal_root
+        anchor = chain.store.get_block(chain.genesis_block_root)
+        # the chain's anchor block ("genesis_block_root" is really the
+        # anchor root — equal to genesis for non-checkpoint nodes); the
+        # next block to fill is the anchor's PARENT
+        self.expected_root = (
+            bytes(anchor.message.parent_root) if anchor else b"\x00" * 32)
+        self.expected_slot = int(anchor.message.slot) if anchor else 0
+        # lowest slot whose freezer root entry is already written; slots
+        # below it are deferred until the covering block's slot is known
+        self._unfilled_upper = self.expected_slot
+        self._complete = self.expected_slot == 0 or (
+            terminal_root is not None and self.expected_root == terminal_root)
+        if self._complete and terminal_root is not None:
+            self._finalize_fill(terminal_root)
+
+    @property
+    def is_complete(self) -> bool:
+        return self._complete
+
+    def process_batch(self, peer: str) -> int:
+        """Fetch + verify + store one backward batch from `peer`.
+        Returns blocks imported (0 at completion)."""
+        if self._complete:
+            return 0
+        end = self.expected_slot  # exclusive: the anchor itself is stored
+        start = max(0, end - BATCH_SIZE)
+        req = BlocksByRangeRequest(start_slot=start, count=end - start, step=1)
+        try:
+            chunks = self.rpc.request(peer, P_BLOCKS_BY_RANGE, req.serialize())
+        except RpcError:
+            self.peers.report(peer, "mid")
+            return 0
+        blocks = []
+        for raw in chunks:
+            blk = self._decode(raw)
+            if blk is None:
+                self.peers.report(peer, "high")
+                return 0
+            blocks.append(blk)
+        # Phase 1 — verify the WHOLE batch's linkage newest-first before
+        # persisting anything: each block's root must equal the expected
+        # parent root carried down from the anchor.  A mid-batch break
+        # must not leave half-advanced state or unrecorded freezer roots.
+        verified: list[tuple[int, bytes, object]] = []
+        expected = self.expected_root
+        for blk in reversed(blocks):
+            root = blk.message.hash_tree_root()
+            if root != expected:
+                # peers may omit skipped slots; a root mismatch on a
+                # served block breaks the hash chain
+                self.peers.report(peer, "high")
+                raise BackfillError(
+                    f"backfill batch broke the hash chain at slot "
+                    f"{int(blk.message.slot)}")
+            verified.append((int(blk.message.slot), root, blk))
+            expected = bytes(blk.message.parent_root)
+        # Phase 2 — persist atomically, then advance the cursor.  The
+        # freezer invariant (root at slot s = latest block at or below s,
+        # matching migrate_to_finalized) needs an entry for EVERY slot —
+        # but a root is only written once the covering block's slot is
+        # KNOWN: each served block at slot b fills [b, lowest-filled),
+        # and slots below the oldest served block stay deferred until a
+        # later batch reveals their covering block (so a peer serving an
+        # empty window can never plant unverified root claims).
+        ops: list[KeyValueOp] = []
+        for _slot, root, blk in verified:
+            self.chain.store.put_block(root, blk)
+        for slot, root, _blk in verified:  # newest-first
+            for s in range(slot, self._unfilled_upper):
+                ops.append(
+                    KeyValueOp(_slot_key(P_COLD_BLOCK_ROOT, s), root))
+            self._unfilled_upper = min(self._unfilled_upper, slot)
+        if ops:
+            self.chain.store.cold.do_atomically(ops)
+        # the window is exhausted even when its tail (or all) was skipped
+        # slots: the next request starts below it.  Lies by omission are
+        # caught later — the next served block must match expected_root.
+        self.expected_slot = start
+        self.expected_root = expected
+        imported = len(verified)
+        self.peers.report(peer, "useful_response")
+
+        # Completion: provable when the chain links to the known terminal
+        # root; otherwise slot 0 / a parent-zero genesis block.
+        if self.terminal_root is not None:
+            if self.expected_root == self.terminal_root:
+                self._complete = True
+                self._finalize_fill(self.terminal_root)
+            elif start == 0:
+                self.peers.report(peer, "high")
+                raise BackfillError(
+                    "backfill reached slot 0 without linking to the "
+                    "genesis block root — peer withheld history")
+        elif (self.expected_slot == 0
+              or self.expected_root == b"\x00" * 32):
+            self._complete = True
+            if self.expected_root != b"\x00" * 32:
+                self._finalize_fill(self.expected_root)
+        return imported
+
+    def _finalize_fill(self, root: bytes) -> None:
+        """On completion, slots below the oldest served block are covered
+        by the terminal (genesis/anchor) block."""
+        ops = [KeyValueOp(_slot_key(P_COLD_BLOCK_ROOT, s), root)
+               for s in range(0, self._unfilled_upper)]
+        if ops:
+            self.chain.store.cold.do_atomically(ops)
+        self._unfilled_upper = 0
+
+    def run(self, peer: str, max_batches: int = 10_000) -> int:
+        total = 0
+        for _ in range(max_batches):
+            before = self.expected_slot
+            total += self.process_batch(peer)
+            if self._complete:
+                break
+            if self.expected_slot == before:
+                break  # rpc failure: no progress, caller retries/rotates
+        return total
+
+    def _decode(self, raw: bytes):
+        return self.chain.t.decode_signed_block(raw)
+
+
+__all__ = ["BackfillError", "BackfillSync", "BATCH_SIZE"]

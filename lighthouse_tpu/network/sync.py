@@ -143,10 +143,4 @@ class SyncManager:
         return imported
 
     def _decode_block(self, raw: bytes):
-        c = self.chain
-        for f in reversed(c.t.forks):
-            try:
-                return c.t.signed_beacon_block_class(f).deserialize(raw)
-            except Exception:
-                continue
-        return None
+        return self.chain.t.decode_signed_block(raw)

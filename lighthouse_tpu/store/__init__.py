@@ -4,7 +4,6 @@ Reference: /root/reference/beacon_node/store.
 """
 
 from lighthouse_tpu.store.hot_cold import (
-    SCHEMA_VERSION,
     HotColdDB,
     HotStateSummary,
     StoreError,
@@ -15,14 +14,23 @@ from lighthouse_tpu.store.kv import (
     MemoryStore,
     NativeKVStore,
 )
+from lighthouse_tpu.store.migrations import (
+    CURRENT_SCHEMA_VERSION,
+    MigrationError,
+    migrate_schema,
+    read_schema_version,
+)
 
 __all__ = [
+    "CURRENT_SCHEMA_VERSION",
     "HotColdDB",
     "HotStateSummary",
-    "StoreError",
-    "SCHEMA_VERSION",
-    "KeyValueStore",
     "KeyValueOp",
+    "KeyValueStore",
     "MemoryStore",
+    "MigrationError",
     "NativeKVStore",
+    "StoreError",
+    "migrate_schema",
+    "read_schema_version",
 ]

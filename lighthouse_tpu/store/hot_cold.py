@@ -26,8 +26,6 @@ from lighthouse_tpu.state_transition import (
 )
 from lighthouse_tpu.store.kv import KeyValueOp, KeyValueStore, MemoryStore
 
-SCHEMA_VERSION = 1
-
 # key prefixes (reference DBColumn)
 P_BLOCK = b"blk:"
 P_STATE = b"sta:"        # hot full states by state root
@@ -36,9 +34,10 @@ P_BLOBS = b"blb:"
 P_COLD_STATE = b"fzs:"   # freezer restore-point states by slot
 P_COLD_BLOCK_ROOT = b"fbr:"   # freezer canonical block root by slot
 P_COLD_STATE_ROOT = b"fsr:"   # freezer canonical state root by slot
-P_META = b"met:"
+# P_META / K_SCHEMA / K_DB_CONFIG are owned by store/migrations.py (one
+# definition of the on-disk key bytes); re-exported here for callers
+from lighthouse_tpu.store.migrations import K_SCHEMA, P_META  # noqa: E402
 
-K_SCHEMA = P_META + b"schema"
 K_SPLIT = P_META + b"split"
 K_GENESIS_STATE_ROOT = P_META + b"genesis_state_root"
 K_HEAD = P_META + b"head"
@@ -95,15 +94,28 @@ class HotColdDB:
     # -- schema / metadata -------------------------------------------------
 
     def _init_schema(self):
+        from lighthouse_tpu.store import migrations as mig
+
         existing = self.hot.get(K_SCHEMA)
         if existing is None:
-            self.hot.put(K_SCHEMA, SCHEMA_VERSION.to_bytes(8, "little"))
-        else:
-            found = int.from_bytes(existing, "little")
-            if found != SCHEMA_VERSION:
-                raise StoreError(
-                    f"schema version {found} != supported {SCHEMA_VERSION}"
-                    " (run the database manager migrate command)")
+            mig.initialize_fresh(self)
+            return
+        found = int.from_bytes(existing, "little")
+        if found > mig.CURRENT_SCHEMA_VERSION:
+            raise StoreError(
+                f"schema version {found} is newer than supported "
+                f"{mig.CURRENT_SCHEMA_VERSION} (downgrade via the database "
+                "manager)")
+        if found < mig.CURRENT_SCHEMA_VERSION:
+            # on-open auto-upgrade (reference schema_change.rs migrate path)
+            mig.migrate_schema(self)
+        cfg = mig.read_db_config(self)
+        if cfg is not None and cfg.get(
+                "slots_per_restore_point") != self.slots_per_restore_point:
+            raise StoreError(
+                "on-disk slots_per_restore_point "
+                f"{cfg.get('slots_per_restore_point')} != configured "
+                f"{self.slots_per_restore_point}")
 
     def _load_split(self) -> int:
         raw = self.hot.get(K_SPLIT)
@@ -288,6 +300,12 @@ class HotColdDB:
         ]
         if int(state.slot) == 0:
             ops.append(KeyValueOp(K_GENESIS_STATE_ROOT, state_root))
+        elif int(state.slot) > self.split_slot:
+            # checkpoint anchor: everything below the anchor is freezer
+            # territory (filled by backfill/reconstruction), so the
+            # hot/cold split starts at the anchor slot
+            self.split_slot = int(state.slot)
+            self._save_split(ops)
         self.hot.do_atomically(ops)
 
     # -- freezer -----------------------------------------------------------
@@ -474,8 +492,10 @@ class HotColdDB:
         ]:
             src = self.cold if prefix.startswith(b"f") else self.hot
             counts[name] = sum(1 for _ in src.iter_prefix(prefix))
+        from lighthouse_tpu.store import migrations as mig
+
         counts["split_slot"] = self.split_slot
-        counts["schema"] = SCHEMA_VERSION
+        counts["schema"] = mig.read_schema_version(self)
         return counts
 
     def compact(self):

@@ -1,0 +1,126 @@
+"""Store schema versioning and on-open migrations.
+
+Rebuild of /root/reference/beacon_node/store/src/metadata.rs +
+/root/reference/beacon_node/beacon_chain/src/schema_change.rs: the DB
+records its schema version; on open, registered migration steps upgrade
+it version-by-version (each step atomic), and an unknown/newer version is
+a hard error.  The database-manager CLI calls `migrate` explicitly for
+downgrades-by-tool or offline upgrades.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from lighthouse_tpu.store.kv import KeyValueOp
+
+# This module OWNS the schema/config keys; hot_cold.py imports them so the
+# on-disk key bytes have exactly one definition.
+P_META = b"met:"
+K_SCHEMA = P_META + b"schema"
+K_DB_CONFIG = P_META + b"db_config"
+
+CURRENT_SCHEMA_VERSION = 2
+
+
+class MigrationError(ValueError):
+    pass
+
+
+# registry: from_version -> (to_version, step). Steps receive the HotColdDB
+# and must apply their writes atomically.
+_UP: dict[int, tuple[int, Callable]] = {}
+_DOWN: dict[int, tuple[int, Callable]] = {}
+
+
+def register_migration(from_v: int, to_v: int, up: Callable,
+                       down: Callable | None = None) -> None:
+    _UP[from_v] = (to_v, up)
+    if down is not None:
+        _DOWN[to_v] = (from_v, down)
+
+
+def read_schema_version(db) -> int:
+    raw = db.hot.get(K_SCHEMA)
+    if raw is None:
+        return 0
+    return int.from_bytes(raw, "little")
+
+
+def _write_version(db, version: int, extra_ops=()) -> None:
+    ops = [KeyValueOp(K_SCHEMA, version.to_bytes(8, "little")), *extra_ops]
+    db.hot.do_atomically(ops)
+
+
+def initialize_fresh(db) -> int:
+    """Fresh DB: stamp v1 then walk the registry to current, so every
+    version's on-disk side effects are applied exactly as an upgrade
+    would (no hand-maintained 'fresh init' duplicating the steps)."""
+    _write_version(db, 1)
+    return migrate_schema(db)
+
+
+def migrate_schema(db, target: int | None = None) -> int:
+    """Walk registered steps from the stored version to `target`
+    (default: CURRENT_SCHEMA_VERSION).  Returns the final version."""
+    target = CURRENT_SCHEMA_VERSION if target is None else target
+    v = read_schema_version(db)
+    if v == 0:
+        # fresh DB: start from v1 and walk the registry like any upgrade
+        _write_version(db, 1)
+        v = 1
+    while v < target:
+        if v not in _UP:
+            raise MigrationError(
+                f"no migration path from schema v{v} toward v{target}")
+        to_v, step = _UP[v]
+        step(db)
+        _write_version(db, to_v)
+        v = to_v
+    while v > target:
+        if v not in _DOWN:
+            raise MigrationError(
+                f"no downgrade path from schema v{v} toward v{target}")
+        to_v, step = _DOWN[v]
+        step(db)
+        _write_version(db, to_v)
+        v = to_v
+    return v
+
+
+# --- v1 -> v2: persist the on-disk config ----------------------------------
+# The reference's OnDiskStoreConfig guards against reopening a freezer with
+# an incompatible slots_per_restore_point; v2 stores it in metadata and
+# HotColdDB.__init__ validates it on open.
+
+def _v1_to_v2(db) -> None:
+    import json
+
+    cfg = json.dumps({
+        "slots_per_restore_point": db.slots_per_restore_point,
+    }).encode()
+    db.hot.do_atomically([KeyValueOp(K_DB_CONFIG, cfg)])
+
+
+def _v2_to_v1(db) -> None:
+    db.hot.do_atomically([KeyValueOp(K_DB_CONFIG, None)])
+
+
+register_migration(1, 2, _v1_to_v2, _v2_to_v1)
+
+
+def read_db_config(db) -> dict | None:
+    import json
+
+    raw = db.hot.get(K_DB_CONFIG)
+    return None if raw is None else json.loads(raw)
+
+
+__all__ = [
+    "CURRENT_SCHEMA_VERSION",
+    "MigrationError",
+    "migrate_schema",
+    "read_db_config",
+    "read_schema_version",
+    "register_migration",
+]

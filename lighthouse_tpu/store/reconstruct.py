@@ -1,0 +1,111 @@
+"""Historic-state reconstruction for the freezer.
+
+Rebuild of /root/reference/beacon_node/store/src/reconstruct.rs: after a
+checkpoint sync the freezer holds block roots (from backfill) but no
+historic states.  Reconstruction replays forward from the oldest restore
+point (or genesis anchor), writing each restore point's full state and
+every slot's canonical state root, so `get_cold_state_by_slot` works for
+the whole chain.  Runs incrementally: each call processes up to
+`max_slots` and persists progress, mirroring the reference's batched
+background reconstruction.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.store.hot_cold import (
+    P_COLD_STATE,
+    P_COLD_STATE_ROOT,
+    StoreError,
+    _slot_key,
+)
+from lighthouse_tpu.store.kv import KeyValueOp
+
+
+def oldest_reconstructed_slot(db) -> int | None:
+    """Highest contiguous slot (from 0) whose cold state root exists."""
+    slot = 0
+    if db.cold.get(_slot_key(P_COLD_STATE, 0)) is None:
+        return None
+    while (slot + 1 < db.split_slot
+           and db.cold.get(_slot_key(P_COLD_STATE_ROOT, slot + 1)) is not None):
+        slot += 1
+    return slot
+
+
+def seed_genesis_restore_point(db, genesis_state) -> None:
+    """Install the network's genesis state as the slot-0 restore point.
+
+    A checkpoint-synced freezer has block roots (from backfill) but no
+    states at all — reconstruction must be seeded with the genesis state
+    from the network config (the reference requires the anchor's genesis
+    state the same way, reconstruct.rs)."""
+    if int(genesis_state.slot) != 0:
+        raise StoreError("genesis restore point must be a slot-0 state")
+    db.cold.do_atomically([
+        KeyValueOp(_slot_key(P_COLD_STATE, 0), db._encode_state(genesis_state)),
+        KeyValueOp(_slot_key(P_COLD_STATE_ROOT, 0),
+                   genesis_state.hash_tree_root()),
+    ])
+
+
+def reconstruct_historic_states(db, max_slots: int | None = None,
+                                genesis_state=None) -> int:
+    """Replay forward from the last reconstructed slot, filling cold state
+    roots and restore-point states.  Returns the number of slots
+    processed; 0 when reconstruction is complete or cannot start.
+    `genesis_state` seeds a stateless (checkpoint-synced) freezer."""
+    from lighthouse_tpu.state_transition import per_slot_processing
+
+    start = oldest_reconstructed_slot(db)
+    if start is None and genesis_state is not None:
+        seed_genesis_restore_point(db, genesis_state)
+        start = oldest_reconstructed_slot(db)
+    if start is None:
+        return 0
+    end = db.split_slot
+    if max_slots is not None:
+        # process exactly max_slots slots (start+1 .. start+max_slots)
+        end = min(end, start + max_slots + 1)
+    if start + 1 >= end:
+        return 0
+
+    state = db.get_cold_state_by_slot(start)
+    if state is None:
+        raise StoreError(f"restore point for slot {start} unloadable")
+    processed = 0
+    ops: list[KeyValueOp] = []
+    slot = start
+    while slot + 1 < end:
+        next_slot = slot + 1
+        block_root = db.cold_block_root_at_slot(next_slot)
+        block = db.get_block(block_root) if block_root is not None else None
+        per_slot_processing(state, db.spec)
+        if block is not None and int(block.message.slot) == next_slot:
+            from lighthouse_tpu.state_transition import (
+                SignatureStrategy,
+                process_block,
+            )
+
+            process_block(state, db.spec, block,
+                          SignatureStrategy.NO_VERIFICATION)
+        state_root = state.hash_tree_root()
+        ops.append(KeyValueOp(
+            _slot_key(P_COLD_STATE_ROOT, next_slot), state_root))
+        if next_slot % db.slots_per_restore_point == 0:
+            ops.append(KeyValueOp(
+                _slot_key(P_COLD_STATE, next_slot), db._encode_state(state)))
+        slot = next_slot
+        processed += 1
+        if len(ops) >= 256:
+            db.cold.do_atomically(ops)
+            ops = []
+    if ops:
+        db.cold.do_atomically(ops)
+    return processed
+
+
+__all__ = [
+    "oldest_reconstructed_slot",
+    "reconstruct_historic_states",
+    "seed_genesis_restore_point",
+]

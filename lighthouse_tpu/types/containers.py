@@ -475,4 +475,17 @@ def make_types(preset: Preset) -> SimpleNamespace:
     ns.signed_beacon_block_class = lambda fork: _by_fork[fork][2]
     ns.beacon_block_body_class = lambda fork: _by_fork[fork][3]
     ns.forks = tuple(_by_fork)
+
+    def decode_signed_block(raw: bytes):
+        """Decode a SignedBeaconBlock of unknown fork (newest first —
+        later forks are supersets, so they must be tried first).
+        Returns None if no fork's layout fits."""
+        for f in reversed(ns.forks):
+            try:
+                return ns.signed_beacon_block_class(f).deserialize(raw)
+            except Exception:
+                continue
+        return None
+
+    ns.decode_signed_block = decode_signed_block
     return ns

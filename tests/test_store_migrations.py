@@ -1,0 +1,120 @@
+"""Store schema versioning, migration, and historic-state reconstruction."""
+
+import pytest
+
+from lighthouse_tpu.state_transition import state_transition
+from lighthouse_tpu.store import (
+    CURRENT_SCHEMA_VERSION,
+    HotColdDB,
+    MemoryStore,
+    MigrationError,
+    StoreError,
+    migrate_schema,
+    read_schema_version,
+)
+from lighthouse_tpu.store.migrations import K_DB_CONFIG, K_SCHEMA, read_db_config
+from lighthouse_tpu.store.reconstruct import (
+    oldest_reconstructed_slot,
+    reconstruct_historic_states,
+)
+from lighthouse_tpu.testing import Harness
+
+
+class TestSchema:
+    def test_fresh_db_stamped_current(self):
+        db = HotColdDB(Harness(8, real_crypto=False).spec, MemoryStore())
+        assert read_schema_version(db) == CURRENT_SCHEMA_VERSION
+        assert read_db_config(db) is not None
+
+    def test_v1_db_auto_upgrades_on_open(self):
+        h = Harness(8, real_crypto=False)
+        kv = MemoryStore()
+        kv.put(K_SCHEMA, (1).to_bytes(8, "little"))
+        db = HotColdDB(h.spec, kv)
+        assert read_schema_version(db) == CURRENT_SCHEMA_VERSION
+        assert read_db_config(db)["slots_per_restore_point"] == \
+            db.slots_per_restore_point
+
+    def test_newer_schema_rejected(self):
+        h = Harness(8, real_crypto=False)
+        kv = MemoryStore()
+        kv.put(K_SCHEMA, (99).to_bytes(8, "little"))
+        with pytest.raises(StoreError, match="newer than supported"):
+            HotColdDB(h.spec, kv)
+
+    def test_incompatible_restore_point_config_rejected(self):
+        h = Harness(8, real_crypto=False)
+        kv = MemoryStore()
+        HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        with pytest.raises(StoreError, match="slots_per_restore_point"):
+            HotColdDB(h.spec, kv, slots_per_restore_point=16)
+
+    def test_explicit_downgrade_and_reupgrade(self):
+        h = Harness(8, real_crypto=False)
+        db = HotColdDB(h.spec, MemoryStore())
+        assert migrate_schema(db, target=1) == 1
+        assert db.hot.get(K_DB_CONFIG) is None
+        assert migrate_schema(db) == CURRENT_SCHEMA_VERSION
+        assert db.hot.get(K_DB_CONFIG) is not None
+
+    def test_unknown_path_raises(self):
+        h = Harness(8, real_crypto=False)
+        db = HotColdDB(h.spec, MemoryStore())
+        with pytest.raises(MigrationError):
+            migrate_schema(db, target=7)
+
+
+@pytest.fixture(scope="module")
+def finalized_db():
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    db = HotColdDB(h.spec, MemoryStore(), slots_per_restore_point=8)
+    db.store_anchor_state(h.state.hash_tree_root(), h.state)
+    posts = {}
+    for _ in range(20):
+        atts = [h.attest()] if int(h.state.slot) > 0 else []
+        signed = h.produce_block(attestations=atts)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        root = signed.message.hash_tree_root()
+        db.import_block(root, signed, h.state, bytes(signed.message.state_root))
+        posts[int(h.state.slot)] = h.state.copy()
+        if int(h.state.slot) == 16:
+            fin = (bytes(signed.message.state_root), root)
+    db.migrate_to_finalized(*fin)
+    return h, db, posts
+
+
+class TestReconstruction:
+    def test_fills_missing_cold_state_roots(self, finalized_db):
+        h, db, posts = finalized_db
+        # wipe non-restore-point cold state roots to simulate a
+        # checkpoint-synced freezer (roots known, states absent)
+        from lighthouse_tpu.store.hot_cold import P_COLD_STATE_ROOT, _slot_key
+
+        wiped = [s for s in range(1, 16) if s % 8]
+        for s in wiped:
+            db.cold.delete(_slot_key(P_COLD_STATE_ROOT, s))
+        assert oldest_reconstructed_slot(db) == 0
+        n = reconstruct_historic_states(db)
+        assert n >= len(wiped)
+        for s in wiped:
+            got = db.cold_state_root_at_slot(s)
+            assert got == posts[s].hash_tree_root(), f"slot {s}"
+
+    def test_incremental_batches(self, finalized_db):
+        h, db, posts = finalized_db
+        from lighthouse_tpu.store.hot_cold import P_COLD_STATE_ROOT, _slot_key
+
+        for s in range(1, 16):
+            if s % 8:
+                db.cold.delete(_slot_key(P_COLD_STATE_ROOT, s))
+        total = 0
+        while True:
+            # max_slots=1 pins the pacing contract: each call must make
+            # exactly one slot of progress until reconstruction completes
+            n = reconstruct_historic_states(db, max_slots=1)
+            if n == 0:
+                break
+            assert n == 1
+            total += n
+        assert total > 0
+        assert db.cold_state_root_at_slot(13) == posts[13].hash_tree_root()
