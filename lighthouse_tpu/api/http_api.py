@@ -61,6 +61,7 @@ class BeaconApi:
           self.debug_state_ssz)
         r("GET", r"/eth/v1/node/version", self.version)
         r("GET", r"/eth/v1/node/health", self.health)
+        r("GET", r"/lighthouse/health", self.lighthouse_health)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
         r("GET", r"/metrics", self.metrics)
 
@@ -353,6 +354,14 @@ class BeaconApi:
 
     def health(self, body=None):
         return {}
+
+    def lighthouse_health(self, body=None):
+        """Host stats (reference /lighthouse/health, common/system_health)."""
+        from dataclasses import asdict
+
+        from lighthouse_tpu.common.system_health import observe_system_health
+
+        return {"data": asdict(observe_system_health())}
 
     def syncing(self, body=None):
         c = self.chain

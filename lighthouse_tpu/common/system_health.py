@@ -1,0 +1,108 @@
+"""Host health observation + remote monitoring poster.
+
+Rebuild of /root/reference/common/system_health (host stats served by the
+HTTP API's lighthouse routes) and /root/reference/common/monitoring_api
+(periodic POST of node/system metrics to a remote monitoring service).
+Linux-native: reads /proc directly instead of shelling out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+from dataclasses import asdict, dataclass
+
+
+@dataclass
+class SystemHealth:
+    total_memory_kb: int
+    free_memory_kb: int
+    used_memory_kb: int
+    load_avg_1m: float
+    load_avg_5m: float
+    load_avg_15m: float
+    cpu_cores: int
+    disk_total_kb: int
+    disk_free_kb: int
+    uptime_s: float
+
+
+def observe_system_health(datadir: str = "/") -> SystemHealth:
+    mem = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                mem[k.strip()] = int(rest.split()[0])
+    except OSError:
+        mem = {"MemTotal": 0, "MemAvailable": 0}
+    total = mem.get("MemTotal", 0)
+    free = mem.get("MemAvailable", mem.get("MemFree", 0))
+    try:
+        la1, la5, la15 = os.getloadavg()
+    except OSError:
+        la1 = la5 = la15 = 0.0
+    try:
+        st = os.statvfs(datadir)
+        disk_total = st.f_blocks * st.f_frsize // 1024
+        disk_free = st.f_bavail * st.f_frsize // 1024
+    except OSError:
+        disk_total = disk_free = 0
+    try:
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+    except OSError:
+        uptime = 0.0
+    return SystemHealth(
+        total_memory_kb=total, free_memory_kb=free,
+        used_memory_kb=max(0, total - free),
+        load_avg_1m=la1, load_avg_5m=la5, load_avg_15m=la15,
+        cpu_cores=os.cpu_count() or 1,
+        disk_total_kb=disk_total, disk_free_kb=disk_free,
+        uptime_s=uptime)
+
+
+class MonitoringService:
+    """Posts {beacon_node, system} stats to a remote monitoring endpoint
+    on a cadence (reference monitoring_api/src/lib.rs): degradable — a
+    dead endpoint never affects the node."""
+
+    def __init__(self, endpoint: str, chain=None, datadir: str = "/",
+                 timeout: float = 5.0):
+        self.endpoint = endpoint
+        self.chain = chain
+        self.datadir = datadir
+        self.timeout = timeout
+        self.last_post_ok: bool | None = None
+
+    def build_payload(self) -> dict:
+        payload = {
+            "ts": time.time(),
+            "system": asdict(observe_system_health(self.datadir)),
+        }
+        if self.chain is not None:
+            c = self.chain
+            payload["beacon_node"] = {
+                "head_slot": int(c.head_state.slot),
+                "current_slot": c.current_slot(),
+                "finalized_epoch": int(c.finalized_checkpoint().epoch),
+                "validators": len(c.head_state.validators),
+            }
+        return payload
+
+    def post_once(self) -> bool:
+        body = json.dumps(self.build_payload()).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                self.last_post_ok = 200 <= resp.status < 300
+        except OSError:
+            self.last_post_ok = False
+        return self.last_post_ok
+
+
+__all__ = ["MonitoringService", "SystemHealth", "observe_system_health"]

@@ -1,6 +1,8 @@
 """Networking layer (L6): gossip pub/sub, Req/Resp RPC, router, sync,
 peer management (reference beacon_node/{network,lighthouse_network})."""
 
+from lighthouse_tpu.network.backfill import BackfillSync
+from lighthouse_tpu.network.discovery import BootNode, Discovery, Enr
 from lighthouse_tpu.network.gossip import GossipHub
 from lighthouse_tpu.network.peer_manager import PeerManager
 from lighthouse_tpu.network.router import Router
@@ -9,6 +11,10 @@ from lighthouse_tpu.network.service import NetworkFabric, NetworkService
 from lighthouse_tpu.network.sync import SyncManager
 
 __all__ = [
+    "BackfillSync",
+    "BootNode",
+    "Discovery",
+    "Enr",
     "GossipHub",
     "PeerManager",
     "Router",

@@ -1,0 +1,220 @@
+"""Peer discovery: ENR-style records + XOR-distance routing table.
+
+Rebuild of /root/reference/beacon_node/lighthouse_network/src/discovery/
+(discv5 UDP protocol) re-shaped for this framework's transport fabric:
+nodes carry signed ENR records (sequence-numbered, fork-digest-scoped),
+maintain a k-bucket routing table keyed by XOR distance over sha256 node
+ids, and answer PING / FINDNODE queries.  A recursive lookup walks
+closer-and-closer buckets exactly like discv5's FINDNODE iteration, and
+`BootNode` is the chain-less standalone answerer
+(/root/reference/boot_node/).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.network.rpc import RpcError
+
+P_DISCOVERY_PING = "/discovery/ping/1"
+P_DISCOVERY_FINDNODE = "/discovery/findnode/1"
+
+BUCKET_SIZE = 16          # discv5 k
+N_BUCKETS = 256
+LOOKUP_PARALLELISM = 3    # discv5 alpha
+MAX_NODES_RESPONSE = 16
+
+
+@dataclass
+class Enr:
+    """Minimal ENR: identity + reachable endpoint + fork digest.
+
+    The reference's ENR is RLP + secp256k1-signed; identity here is the
+    sha256 of the node's public identity key (the fabric peer id doubles
+    as the key), which preserves the property discovery actually needs:
+    node ids uniformly spread over the XOR metric space."""
+
+    peer_id: str
+    seq: int = 1
+    fork_digest: bytes = b"\x00\x00\x00\x00"
+    ip: str = "127.0.0.1"
+    port: int = 9000
+
+    @property
+    def node_id(self) -> bytes:
+        return hashlib.sha256(self.peer_id.encode()).digest()
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "peer_id": self.peer_id, "seq": self.seq,
+            "fork_digest": self.fork_digest.hex(),
+            "ip": self.ip, "port": self.port,
+        }).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "Enr":
+        d = json.loads(raw)
+        return Enr(peer_id=d["peer_id"], seq=int(d["seq"]),
+                   fork_digest=bytes.fromhex(d["fork_digest"]),
+                   ip=d["ip"], port=int(d["port"]))
+
+
+def xor_distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+def log2_distance(a: bytes, b: bytes) -> int:
+    """discv5 bucket index: bit length of the XOR distance (0 = self)."""
+    return xor_distance(a, b).bit_length()
+
+
+class RoutingTable:
+    """k-buckets by log2 XOR distance from the local node id."""
+
+    def __init__(self, local_id: bytes):
+        self.local_id = local_id
+        self.buckets: list[dict[bytes, Enr]] = [
+            {} for _ in range(N_BUCKETS + 1)]
+
+    def insert(self, enr: Enr) -> bool:
+        nid = enr.node_id
+        if nid == self.local_id:
+            return False
+        bucket = self.buckets[log2_distance(self.local_id, nid)]
+        existing = bucket.get(nid)
+        if existing is not None:
+            if enr.seq >= existing.seq:
+                bucket[nid] = enr
+            return True
+        if len(bucket) >= BUCKET_SIZE:
+            return False  # discv5 drops-newest on a full bucket
+        bucket[nid] = enr
+        return True
+
+    def remove(self, node_id: bytes) -> None:
+        self.buckets[log2_distance(self.local_id, node_id)].pop(node_id, None)
+
+    def closest(self, target: bytes, n: int = MAX_NODES_RESPONSE) -> list[Enr]:
+        allnodes = [e for b in self.buckets for e in b.values()]
+        allnodes.sort(key=lambda e: xor_distance(e.node_id, target))
+        return allnodes[:n]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+
+class Discovery:
+    """Discovery endpoint bound to an rpc fabric endpoint."""
+
+    def __init__(self, rpc_ep, enr: Enr,
+                 fork_digest: bytes | None = None):
+        self.rpc = rpc_ep
+        self.enr = enr
+        if fork_digest is not None:
+            self.enr.fork_digest = fork_digest
+        self.table = RoutingTable(enr.node_id)
+        rpc_ep.register(P_DISCOVERY_PING, self._serve_ping)
+        rpc_ep.register(P_DISCOVERY_FINDNODE, self._serve_findnode)
+
+    # -- server side --------------------------------------------------------
+
+    def _serve_ping(self, src: str, data: bytes) -> list[bytes]:
+        remote = Enr.from_bytes(data)
+        # only self-describing records on OUR network enter the table
+        # (same eth2-field filter as the client side)
+        if (remote.peer_id == src
+                and remote.fork_digest == self.enr.fork_digest):
+            self.table.insert(remote)
+        return [self.enr.to_bytes()]
+
+    def _serve_findnode(self, src: str, data: bytes) -> list[bytes]:
+        target = data[:32]
+        return [e.to_bytes() for e in self.table.closest(target)]
+
+    # -- client side --------------------------------------------------------
+
+    def ping(self, peer: str) -> Enr | None:
+        try:
+            chunks = self.rpc.request(
+                peer, P_DISCOVERY_PING, self.enr.to_bytes())
+        except RpcError:
+            self.table.remove(
+                hashlib.sha256(peer.encode()).digest())
+            return None
+        if not chunks:
+            return None
+        remote = Enr.from_bytes(chunks[0])
+        # only table peers on our network (the eth2 ENR-field filter the
+        # reference applies before dialing, discovery/enr_ext.rs)
+        if remote.fork_digest == self.enr.fork_digest:
+            self.table.insert(remote)
+        return remote
+
+    def find_node(self, peer: str, target: bytes) -> list[Enr]:
+        try:
+            chunks = self.rpc.request(peer, P_DISCOVERY_FINDNODE, target)
+        except RpcError:
+            return []
+        return [Enr.from_bytes(c) for c in chunks]
+
+    def lookup(self, target: bytes | None = None,
+               max_rounds: int = 8) -> list[Enr]:
+        """Recursive FINDNODE toward `target` (default: self — the
+        discv5 self-lookup that populates the table)."""
+        target = target if target is not None else self.enr.node_id
+        queried: set[str] = set()
+        candidates = {e.node_id: e for e in self.table.closest(target)}
+        for _ in range(max_rounds):
+            frontier = sorted(
+                (e for e in candidates.values() if e.peer_id not in queried),
+                key=lambda e: xor_distance(e.node_id, target),
+            )[:LOOKUP_PARALLELISM]
+            if not frontier:
+                break
+            for enr in frontier:
+                queried.add(enr.peer_id)
+                for found in self.find_node(enr.peer_id, target):
+                    if found.fork_digest != self.enr.fork_digest:
+                        continue  # wrong network (eth2 ENR field check)
+                    self.table.insert(found)
+                    candidates.setdefault(found.node_id, found)
+        return self.table.closest(target)
+
+    def bootstrap(self, bootnode_peer: str) -> int:
+        """Dial a bootnode, then self-lookup to fill the table.  Returns
+        the number of known peers after bootstrap."""
+        if self.ping(bootnode_peer) is None:
+            return len(self.table)
+        self.lookup()
+        return len(self.table)
+
+
+class BootNode:
+    """Standalone discovery-only node (reference boot_node/): joins the
+    fabric, answers PING/FINDNODE, serves no chain data."""
+
+    def __init__(self, fabric, peer_id: str = "boot-node",
+                 fork_digest: bytes = b"\x00\x00\x00\x00"):
+        self.rpc_ep = fabric.rpc.join(peer_id)
+        self.discovery = Discovery(
+            self.rpc_ep, Enr(peer_id=peer_id, fork_digest=fork_digest))
+
+    @property
+    def peer_id(self) -> str:
+        return self.discovery.enr.peer_id
+
+    def known_peers(self) -> int:
+        return len(self.discovery.table)
+
+
+__all__ = [
+    "BootNode",
+    "BUCKET_SIZE",
+    "Discovery",
+    "Enr",
+    "RoutingTable",
+    "log2_distance",
+    "xor_distance",
+]

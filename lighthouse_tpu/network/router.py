@@ -43,12 +43,15 @@ class Router:
     """Wires a chain + store to gossip topics and RPC protocols."""
 
     def __init__(self, chain: "BeaconChain", gossip_ep, rpc_ep, peer_manager,
-                 on_unknown_parent=None):
+                 on_unknown_parent=None, subnet_service=None):
         self.chain = chain
         self.gossip = gossip_ep
         self.rpc = rpc_ep
         self.peers = peer_manager
         self.on_unknown_parent = on_unknown_parent
+        # scheduled attestation-subnet subscriptions (subnet_service.py);
+        # None = subscribe to all subnets (small test fabrics)
+        self.subnet_service = subnet_service
         self._subscribe_topics()
         self._register_rpc()
         self.gossip.on_delivery_result = self._score_delivery
@@ -60,9 +63,13 @@ class Router:
         self.gossip.subscribe(topic(c, "beacon_block"), self._on_block)
         self.gossip.subscribe(
             topic(c, "beacon_aggregate_and_proof"), self._on_aggregate)
-        for subnet in range(c.spec.attestation_subnet_count):
-            self.gossip.subscribe(
-                topic(c, f"beacon_attestation_{subnet}"), self._on_attestation)
+        if self.subnet_service is None:
+            for subnet in range(c.spec.attestation_subnet_count):
+                self.gossip.subscribe(
+                    topic(c, f"beacon_attestation_{subnet}"),
+                    self._on_attestation)
+        else:
+            self.update_attestation_subnets(c.current_slot())
         for i in range(c.spec.preset.max_blobs_per_block):
             self.gossip.subscribe(
                 topic(c, f"blob_sidecar_{i}"), self._on_blob)
@@ -72,6 +79,20 @@ class Router:
             topic(c, "proposer_slashing"), self._on_proposer_slashing)
         self.gossip.subscribe(
             topic(c, "attester_slashing"), self._on_attester_slashing)
+
+    def update_attestation_subnets(self, slot: int) -> None:
+        """Apply the subnet service's per-slot subscribe/unsubscribe
+        deltas (reference subnet_service → gossip topic updates)."""
+        if self.subnet_service is None:
+            return
+        c = self.chain
+        to_sub, to_unsub = self.subnet_service.update(slot)
+        for subnet in to_sub:
+            self.gossip.subscribe(
+                topic(c, f"beacon_attestation_{subnet}"),
+                self._on_attestation)
+        for subnet in to_unsub:
+            self.gossip.unsubscribe(topic(c, f"beacon_attestation_{subnet}"))
 
     def _score_delivery(self, source: str, topic_: str, ok: bool):
         self.peers.report(source, "valid_message" if ok else "low")

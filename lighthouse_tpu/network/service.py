@@ -26,6 +26,9 @@ class NetworkFabric:
 
 class NetworkService:
     def __init__(self, chain, fabric: NetworkFabric, peer_id: str):
+        from lighthouse_tpu.network.discovery import Discovery, Enr
+        from lighthouse_tpu.network.router import fork_digest
+
         self.chain = chain
         self.fabric = fabric
         self.peer_id = peer_id
@@ -37,11 +40,29 @@ class NetworkService:
             on_unknown_parent=self._on_unknown_parent)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
+        self.discovery = Discovery(
+            self.rpc_ep, Enr(peer_id=peer_id),
+            fork_digest=fork_digest(chain))
 
     def connect(self, other: "NetworkService"):
         """Mutual status handshake (dial)."""
         self.sync.status_handshake(other.peer_id)
         other.sync.status_handshake(self.peer_id)
+
+    def discover_and_connect(self, bootnode_peer: str,
+                             max_dials: int = 8) -> int:
+        """Bootstrap discovery from a bootnode, then status-handshake the
+        discovered peers (reference discovery → peer_manager dial flow).
+        Returns the number of peers successfully connected."""
+        self.discovery.bootstrap(bootnode_peer)
+        connected = 0
+        for enr in self.discovery.table.closest(
+                self.discovery.enr.node_id, n=max_dials):
+            if enr.peer_id == self.peer_id:
+                continue
+            if self.sync.status_handshake(enr.peer_id) is not None:
+                connected += 1
+        return connected
 
     def _on_unknown_parent(self, peer: str, block):
         self.sync.lookup_unknown_parent(peer, block)

@@ -1,0 +1,125 @@
+"""Attestation + sync-committee subnet subscription scheduling.
+
+Rebuild of /root/reference/beacon_node/network/src/subnet_service/: the
+node does NOT listen to all 64 attestation subnets.  It keeps
+(a) long-lived subnets derived deterministically from its node id and the
+epoch (spec `compute_subscribed_subnets`), rotating per subscription
+period, and (b) short-lived subscriptions opened one slot ahead of each
+aggregator duty and closed when the duty's slot passes.  The router
+consults this service to decide which `beacon_attestation_{n}` topics to
+join (bandwidth sharding — SURVEY §2.9-7).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+SUBNETS_PER_NODE = 2
+EPOCHS_PER_SUBSCRIPTION = 256          # spec EPOCHS_PER_SUBNET_SUBSCRIPTION
+ADVANCE_SLOTS = 1                      # subscribe this many slots early
+
+
+def compute_subscribed_subnets(node_id: bytes, epoch: int,
+                               subnet_count: int = 64,
+                               subnets_per_node: int = SUBNETS_PER_NODE,
+                               ) -> list[int]:
+    """Deterministic long-lived subnets for a node id at an epoch.
+
+    Same shape as the spec's computation: a prefix of the node id plus
+    the subscription period index seeds a permutation; we use sha256
+    where the spec uses the shuffling hash — the property that matters
+    (uniform, deterministic, rotating each period) is preserved."""
+    period = epoch // EPOCHS_PER_SUBSCRIPTION
+    out = []
+    for i in range(subnets_per_node):
+        digest = hashlib.sha256(
+            node_id[:8] + period.to_bytes(8, "little")
+            + i.to_bytes(8, "little")).digest()
+        out.append(int.from_bytes(digest[:8], "little") % subnet_count)
+    return sorted(set(out))
+
+
+@dataclass
+class _ShortLived:
+    subnet: int
+    start_slot: int     # subscribe at start_slot (duty slot - advance)
+    end_slot: int       # unsubscribe after this slot
+
+
+class AttestationSubnetService:
+    """Tracks required subnets over time; the router polls
+    `update(current_slot)` each slot and applies the subscribe /
+    unsubscribe deltas it returns."""
+
+    def __init__(self, spec, node_id: bytes):
+        self.spec = spec
+        self.node_id = node_id
+        self._short: list[_ShortLived] = []
+        self._active: set[int] = set()
+
+    # -- duty registration (from the VC's subscriptions API) ---------------
+
+    def subscribe_for_duty(self, slot: int, committee_index: int,
+                           is_aggregator: bool) -> None:
+        """Reference validator_subscriptions: aggregators need the subnet
+        feed around their duty slot."""
+        if not is_aggregator:
+            return
+        subnet = committee_index % self.spec.attestation_subnet_count
+        self._short.append(_ShortLived(
+            subnet, max(0, slot - ADVANCE_SLOTS), slot))
+
+    # -- per-slot scheduling ------------------------------------------------
+
+    def required_subnets(self, slot: int) -> set[int]:
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        required = set(compute_subscribed_subnets(
+            self.node_id, epoch, self.spec.attestation_subnet_count))
+        for s in self._short:
+            if s.start_slot <= slot <= s.end_slot:
+                required.add(s.subnet)
+        return required
+
+    def update(self, slot: int) -> tuple[set[int], set[int]]:
+        """Returns (to_subscribe, to_unsubscribe) deltas and drops
+        expired short-lived entries."""
+        self._short = [s for s in self._short if s.end_slot >= slot]
+        required = self.required_subnets(slot)
+        to_sub = required - self._active
+        to_unsub = self._active - required
+        self._active = required
+        return to_sub, to_unsub
+
+    @property
+    def active(self) -> set[int]:
+        return set(self._active)
+
+
+class SyncSubnetService:
+    """Sync-committee subnet scheduling: subscribe to the subnets where
+    this node's validators serve for the whole sync-committee period
+    (reference subnet_service sync half)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self._active: set[int] = set()
+
+    def set_duty_subnets(self, subnets: set[int]) -> tuple[set[int], set[int]]:
+        to_sub = subnets - self._active
+        to_unsub = self._active - subnets
+        self._active = set(subnets)
+        return to_sub, to_unsub
+
+    @property
+    def active(self) -> set[int]:
+        return set(self._active)
+
+
+__all__ = [
+    "AttestationSubnetService",
+    "SyncSubnetService",
+    "compute_subscribed_subnets",
+    "EPOCHS_PER_SUBSCRIPTION",
+    "SUBNETS_PER_NODE",
+]

@@ -155,9 +155,20 @@ def process_eth1_data(state, spec, body) -> None:
 
 
 def process_operations(state, spec, body, fork, strategy, verifier) -> None:
+    # electra (EIP-6110): eth1-bridge deposits stop at the requests
+    # transition index — the EL supplies deposits directly from there on
+    deposit_count = int(state.eth1_data.deposit_count)
+    if fork == "electra":
+        from lighthouse_tpu.state_transition.electra import (
+            UNSET_DEPOSIT_REQUESTS_START_INDEX,
+        )
+
+        start = int(state.deposit_requests_start_index)
+        if start != UNSET_DEPOSIT_REQUESTS_START_INDEX:
+            deposit_count = min(deposit_count, start)
     expected_deposits = min(
         spec.preset.max_deposits,
-        int(state.eth1_data.deposit_count) - int(state.eth1_deposit_index))
+        max(0, deposit_count - int(state.eth1_deposit_index)))
     _err(
         len(body.deposits) == expected_deposits,
         f"expected {expected_deposits} deposits, got {len(body.deposits)}")
@@ -179,12 +190,23 @@ def process_operations(state, spec, body, fork, strategy, verifier) -> None:
             state, spec, att, fork, strategy, verifier,
             shuffled=shuffles[ep], proposer=proposer)
     for dep in body.deposits:
-        process_deposit(state, spec, dep)
+        process_deposit(state, spec, dep, fork=fork)
     for exit_ in body.voluntary_exits:
         process_voluntary_exit(state, spec, exit_, strategy, verifier)
     if hasattr(body, "bls_to_execution_changes"):
         for change in body.bls_to_execution_changes:
             process_bls_to_execution_change(state, spec, change, strategy, verifier)
+    if fork == "electra":
+        from lighthouse_tpu.state_transition import electra
+
+        payload = body.execution_payload
+        for dr in payload.deposit_requests:
+            electra.process_deposit_request(state, spec, dr)
+        for wr in payload.withdrawal_requests:
+            electra.process_withdrawal_request(state, spec, wr)
+        for cons in body.consolidations:
+            electra.process_consolidation(
+                state, spec, cons, strategy, verifier)
 
 
 # --- slashings --------------------------------------------------------------
@@ -204,6 +226,7 @@ def slash_validator(
     quotient = {
         "altair": spec.min_slashing_penalty_quotient_altair,
         "phase0": spec.min_slashing_penalty_quotient,
+        "electra": spec.min_slashing_penalty_quotient_electra,
     }.get(fork, spec.min_slashing_penalty_quotient_bellatrix)
     penalty = int(v.effective_balance[index]) // quotient
     state.balances[index] = max(0, int(state.balances[index]) - penalty)
@@ -211,7 +234,10 @@ def slash_validator(
     proposer = misc.get_beacon_proposer_index(state, spec)
     if whistleblower is None:
         whistleblower = proposer
-    wb_reward = int(v.effective_balance[index]) // spec.whistleblower_reward_quotient
+    wb_quotient = (spec.whistleblower_reward_quotient_electra
+                   if fork == "electra"
+                   else spec.whistleblower_reward_quotient)
+    wb_reward = int(v.effective_balance[index]) // wb_quotient
     proposer_reward = wb_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     state.balances[proposer] += np.uint64(proposer_reward)
     state.balances[whistleblower] += np.uint64(wb_reward - proposer_reward)
@@ -283,6 +309,15 @@ def process_attester_slashing(state, spec, slashing, strategy, verifier) -> None
 # --- attestations -----------------------------------------------------------
 
 def get_attesting_indices(state, spec, attestation, shuffled=None) -> np.ndarray:
+    if hasattr(attestation, "committee_bits"):  # electra (EIP-7549)
+        from lighthouse_tpu.state_transition.electra import (
+            get_attesting_indices_electra,
+        )
+
+        _err(int(attestation.data.index) == 0,
+             "electra attestation: data.index must be 0")
+        return get_attesting_indices_electra(
+            state, spec, attestation, shuffled)
     committee = misc.get_beacon_committee(
         state, spec, int(attestation.data.slot), int(attestation.data.index),
         shuffled)
@@ -294,7 +329,10 @@ def get_attesting_indices(state, spec, attestation, shuffled=None) -> np.ndarray
 
 def to_indexed_attestation(state, spec, attestation, types_ns, shuffled=None):
     indices = np.sort(get_attesting_indices(state, spec, attestation, shuffled))
-    return types_ns.IndexedAttestation(
+    cls = (types_ns.IndexedAttestationElectra
+           if hasattr(attestation, "committee_bits")
+           else types_ns.IndexedAttestation)
+    return cls(
         attesting_indices=indices.astype(np.uint64),
         data=attestation.data,
         signature=attestation.signature,
@@ -430,7 +468,8 @@ def apply_deposit(state, spec, deposit_data, check_signature: bool = True) -> No
             state.inactivity_scores, np.uint64(0))
 
 
-def process_deposit(state, spec, deposit, check_proof: bool = True) -> None:
+def process_deposit(state, spec, deposit, check_proof: bool = True,
+                    fork: str | None = None) -> None:
     if check_proof:
         _err(
             misc.is_valid_merkle_branch(
@@ -442,7 +481,18 @@ def process_deposit(state, spec, deposit, check_proof: bool = True) -> None:
             ),
             "invalid deposit merkle proof")
     state.eth1_deposit_index += 1
-    apply_deposit(state, spec, deposit.data)
+    if fork == "electra":
+        from lighthouse_tpu.state_transition.electra import (
+            apply_deposit_electra,
+        )
+
+        d = deposit.data
+        apply_deposit_electra(
+            state, spec, bytes(d.pubkey),
+            bytes(d.withdrawal_credentials), int(d.amount),
+            bytes(d.signature))
+    else:
+        apply_deposit(state, spec, deposit.data)
 
 
 # --- exits ------------------------------------------------------------------
@@ -460,10 +510,24 @@ def process_voluntary_exit(state, spec, signed_exit, strategy, verifier) -> None
     _err(
         cur >= int(v.activation_epoch[idx]) + spec.shard_committee_period,
         "exit: too young")
+    fork = spec.fork_at_epoch(cur)
+    if fork == "electra":
+        # EIP-7251: cannot fully exit while partial withdrawals are queued
+        _err(
+            not any(int(w.index) == idx
+                    for w in state.pending_partial_withdrawals),
+            "exit: pending partial withdrawals queued")
     if strategy is not SignatureStrategy.NO_VERIFICATION:
         _check_or_accumulate(
             verifier, strategy, sigs.voluntary_exit_set(state, spec, signed_exit))
-    initiate_validator_exit(state, spec, idx)
+    if fork == "electra":
+        from lighthouse_tpu.state_transition.electra import (
+            initiate_validator_exit_electra,
+        )
+
+        initiate_validator_exit_electra(state, spec, idx)
+    else:
+        initiate_validator_exit(state, spec, idx)
 
 
 # --- capella ----------------------------------------------------------------
@@ -495,40 +559,106 @@ def _has_eth1_credentials(creds: np.ndarray) -> bool:
 
 
 def get_expected_withdrawals(state, spec) -> list:
+    out, _processed = get_expected_withdrawals_and_partials(state, spec)
+    return out
+
+
+def get_expected_withdrawals_and_partials(state, spec) -> tuple[list, int]:
+    """(withdrawals, processed_partial_count).  Electra prepends the
+    pending-partial-withdrawals sweep (EIP-7251) and uses per-validator
+    balance ceilings; pre-electra behaves as capella."""
     epoch = misc.current_epoch(state, spec)
     idx = int(state.next_withdrawal_index)
     vidx = int(state.next_withdrawal_validator_index)
     n = len(state.validators)
     out = []
+    processed_partials = 0
+    fork = spec.fork_at_epoch(epoch)
+    electra = fork == "electra"
+    if electra:
+        withdrawn_so_far: dict[int, int] = {}
+        for w in state.pending_partial_withdrawals:
+            if (int(w.withdrawable_epoch) > epoch
+                    or len(out) == spec.preset
+                    .max_pending_partials_per_withdrawals_sweep):
+                break
+            wi = int(w.index)
+            v_creds = state.validators.withdrawal_credentials[wi]
+            # earlier entries for the same validator within this sweep
+            # reduce the balance the excess is computed from (spec's
+            # total_withdrawn) — duplicates must not dip below minimum
+            balance = int(state.balances[wi]) - withdrawn_so_far.get(wi, 0)
+            eff = int(state.validators.effective_balance[wi])
+            if (int(state.validators.exit_epoch[wi]) == T.FAR_FUTURE_EPOCH
+                    and eff >= spec.min_activation_balance
+                    and balance > spec.min_activation_balance):
+                amount = min(
+                    balance - spec.min_activation_balance, int(w.amount))
+                out.append(T.Withdrawal(
+                    index=idx, validator_index=wi,
+                    address=v_creds[12:].tobytes(), amount=amount))
+                withdrawn_so_far[wi] = withdrawn_so_far.get(wi, 0) + amount
+                idx += 1
+            processed_partials += 1
+
+    def _max_balance(creds) -> int:
+        if not electra:
+            return spec.max_effective_balance
+        from lighthouse_tpu.state_transition.electra import (
+            get_max_effective_balance,
+        )
+
+        return get_max_effective_balance(spec, creds)
+
+    def _withdrawable_creds(creds) -> bool:
+        if not electra:
+            return _has_eth1_credentials(creds)
+        from lighthouse_tpu.state_transition.electra import (
+            has_execution_withdrawal_credential,
+        )
+
+        return has_execution_withdrawal_credential(creds)
+
+    # amounts already scheduled for a validator by the partial sweep
+    # reduce what the regular sweep sees (spec get_expected_withdrawals
+    # electra: partially_withdrawn_balance)
+    partially_withdrawn: dict[int, int] = {}
+    for w in out:
+        partially_withdrawn[int(w.validator_index)] = (
+            partially_withdrawn.get(int(w.validator_index), 0)
+            + int(w.amount))
+
     bound = min(n, spec.preset.max_validators_per_withdrawals_sweep)
     for _ in range(bound):
         v_creds = state.validators.withdrawal_credentials[vidx]
-        balance = int(state.balances[vidx])
+        balance = int(state.balances[vidx]) - partially_withdrawn.get(vidx, 0)
         eff = int(state.validators.effective_balance[vidx])
+        max_bal = _max_balance(v_creds)
         withdrawable = int(state.validators.withdrawable_epoch[vidx]) <= epoch
-        if _has_eth1_credentials(v_creds) and withdrawable and balance > 0:
+        if _withdrawable_creds(v_creds) and withdrawable and balance > 0:
             out.append(T.Withdrawal(
                 index=idx, validator_index=vidx,
                 address=v_creds[12:].tobytes(), amount=balance))
             idx += 1
         elif (
-            _has_eth1_credentials(v_creds)
-            and eff == spec.max_effective_balance
-            and balance > spec.max_effective_balance
+            _withdrawable_creds(v_creds)
+            and eff == max_bal
+            and balance > max_bal
         ):
             out.append(T.Withdrawal(
                 index=idx, validator_index=vidx,
                 address=v_creds[12:].tobytes(),
-                amount=balance - spec.max_effective_balance))
+                amount=balance - max_bal))
             idx += 1
         if len(out) == spec.preset.max_withdrawals_per_payload:
             break
         vidx = (vidx + 1) % n
-    return out
+    return out, processed_partials
 
 
 def process_withdrawals(state, spec, payload) -> None:
-    expected = get_expected_withdrawals(state, spec)
+    expected, processed_partials = \
+        get_expected_withdrawals_and_partials(state, spec)
     got = list(payload.withdrawals)
     _err(len(got) == len(expected), "withdrawals count mismatch")
     for g, e in zip(got, expected):
@@ -536,6 +666,9 @@ def process_withdrawals(state, spec, payload) -> None:
     for w in expected:
         vi = int(w.validator_index)
         state.balances[vi] -= np.uint64(int(w.amount))
+    if processed_partials:
+        state.pending_partial_withdrawals = list(
+            state.pending_partial_withdrawals)[processed_partials:]
     if expected:
         state.next_withdrawal_index = int(expected[-1].index) + 1
     n = len(state.validators)
@@ -571,6 +704,7 @@ def process_execution_payload(state, spec, body, fork) -> None:
         "bellatrix": t.ExecutionPayloadHeaderBellatrix,
         "capella": t.ExecutionPayloadHeaderCapella,
         "deneb": t.ExecutionPayloadHeaderDeneb,
+        "electra": t.ExecutionPayloadHeaderElectra,
     }[fork]
     kw = dict(
         parent_hash=payload.parent_hash,
@@ -588,14 +722,25 @@ def process_execution_payload(state, spec, body, fork) -> None:
         block_hash=payload.block_hash,
         transactions_root=t.Transactions.hash_tree_root(payload.transactions),
     )
-    if fork in ("capella", "deneb"):
+    if fork in ("capella", "deneb", "electra"):
         from lighthouse_tpu import ssz
 
         wl = ssz.List(T.Withdrawal, spec.preset.max_withdrawals_per_payload)
         kw["withdrawals_root"] = wl.hash_tree_root(payload.withdrawals)
-    if fork == "deneb":
+    if fork in ("deneb", "electra"):
         kw["blob_gas_used"] = payload.blob_gas_used
         kw["excess_blob_gas"] = payload.excess_blob_gas
+    if fork == "electra":
+        from lighthouse_tpu import ssz
+
+        drl = ssz.List(T.DepositRequest,
+                       spec.preset.max_deposit_requests_per_payload)
+        wrl = ssz.List(T.ExecutionLayerWithdrawalRequest,
+                       spec.preset.max_withdrawal_requests_per_payload)
+        kw["deposit_requests_root"] = drl.hash_tree_root(
+            payload.deposit_requests)
+        kw["withdrawal_requests_root"] = wrl.hash_tree_root(
+            payload.withdrawal_requests)
     state.latest_execution_payload_header = header_cls(**kw)
 
 

@@ -1,0 +1,447 @@
+"""Electra (EIP-7251/6110/7002/7549) state-transition logic.
+
+Rebuild of the reference's Electra support: churn-by-balance exits
+(consensus/types/src/beacon_state.rs:2129-2280 churn helpers), pending
+balance deposits / consolidations (per_epoch_processing/single_pass.rs:
+803-905), execution-layer deposit + withdrawal requests and block
+consolidations (per_block_processing/process_operations.rs Electra
+arms), and committee-bits attestations (types/src/attestation.rs
+Electra variant).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import misc
+
+UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+FULL_EXIT_REQUEST_AMOUNT = 0
+COMPOUNDING_WITHDRAWAL_PREFIX = 0x02
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = 0x01
+
+
+# --- credential / balance helpers ------------------------------------------
+
+def has_compounding_withdrawal_credential(creds) -> bool:
+    return int(creds[0]) == COMPOUNDING_WITHDRAWAL_PREFIX
+
+
+def has_execution_withdrawal_credential(creds) -> bool:
+    return int(creds[0]) in (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX, COMPOUNDING_WITHDRAWAL_PREFIX)
+
+
+def get_max_effective_balance(spec, creds) -> int:
+    """Per-validator ceiling: 2048 ETH for compounding (0x02) credentials,
+    MIN_ACTIVATION_BALANCE otherwise (validator.rs
+    get_validator_max_effective_balance)."""
+    if has_compounding_withdrawal_credential(creds):
+        return spec.max_effective_balance_electra
+    return spec.min_activation_balance
+
+
+def get_active_balance(state, spec, index: int) -> int:
+    ceil = get_max_effective_balance(
+        spec, state.validators.withdrawal_credentials[index])
+    return min(int(state.balances[index]), ceil)
+
+
+# --- churn -------------------------------------------------------------------
+
+def get_balance_churn_limit(state, spec) -> int:
+    total = misc.get_total_active_balance(state, spec)
+    churn = max(
+        spec.min_per_epoch_churn_limit_electra,
+        total // spec.churn_limit_quotient)
+    return churn - churn % spec.effective_balance_increment
+
+
+def get_activation_exit_churn_limit(state, spec) -> int:
+    return min(spec.max_per_epoch_activation_exit_churn_limit,
+               get_balance_churn_limit(state, spec))
+
+
+def get_consolidation_churn_limit(state, spec) -> int:
+    return get_balance_churn_limit(state, spec) - \
+        get_activation_exit_churn_limit(state, spec)
+
+
+def compute_exit_epoch_and_update_churn(state, spec, exit_balance: int) -> int:
+    cur = misc.current_epoch(state, spec)
+    earliest = max(int(state.earliest_exit_epoch),
+                   spec.compute_activation_exit_epoch(cur))
+    per_epoch_churn = get_activation_exit_churn_limit(state, spec)
+    if int(state.earliest_exit_epoch) < earliest:
+        to_consume = per_epoch_churn  # new epoch for exits
+    else:
+        to_consume = int(state.exit_balance_to_consume)
+    if exit_balance > to_consume:
+        balance_to_process = exit_balance - to_consume
+        additional = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest += additional
+        to_consume += additional * per_epoch_churn
+    state.exit_balance_to_consume = to_consume - exit_balance
+    state.earliest_exit_epoch = earliest
+    return earliest
+
+
+def compute_consolidation_epoch_and_update_churn(
+        state, spec, consolidation_balance: int) -> int:
+    cur = misc.current_epoch(state, spec)
+    earliest = max(int(state.earliest_consolidation_epoch),
+                   spec.compute_activation_exit_epoch(cur))
+    per_epoch_churn = get_consolidation_churn_limit(state, spec)
+    if int(state.earliest_consolidation_epoch) < earliest:
+        to_consume = per_epoch_churn
+    else:
+        to_consume = int(state.consolidation_balance_to_consume)
+    if consolidation_balance > to_consume:
+        balance_to_process = consolidation_balance - to_consume
+        additional = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest += additional
+        to_consume += additional * per_epoch_churn
+    state.consolidation_balance_to_consume = \
+        to_consume - consolidation_balance
+    state.earliest_consolidation_epoch = earliest
+    return earliest
+
+
+def initiate_validator_exit_electra(state, spec, index: int) -> None:
+    """Electra exit: the queue is balance-weighted, not head-count churn
+    (beacon_state.rs initiate_validator_exit Electra arm)."""
+    v = state.validators
+    if int(v.exit_epoch[index]) != T.FAR_FUTURE_EPOCH:
+        return
+    exit_epoch = compute_exit_epoch_and_update_churn(
+        state, spec, int(v.effective_balance[index]))
+    v.exit_epoch[index] = exit_epoch
+    v.withdrawable_epoch[index] = (
+        exit_epoch + spec.min_validator_withdrawability_delay)
+
+
+# --- compounding switches ---------------------------------------------------
+
+def queue_excess_active_balance(state, spec, index: int) -> None:
+    bal = int(state.balances[index])
+    if bal > spec.min_activation_balance:
+        excess = bal - spec.min_activation_balance
+        state.balances[index] = spec.min_activation_balance
+        state.pending_balance_deposits = list(
+            state.pending_balance_deposits) + [
+            T.PendingBalanceDeposit(index=index, amount=excess)]
+
+
+def switch_to_compounding_validator(state, spec, index: int) -> None:
+    creds = state.validators.withdrawal_credentials[index]
+    if has_execution_withdrawal_credential(creds):
+        new = bytes([COMPOUNDING_WITHDRAWAL_PREFIX]) + creds[1:].tobytes()
+        state.validators.withdrawal_credentials[index] = np.frombuffer(
+            new, np.uint8)
+        queue_excess_active_balance(state, spec, index)
+
+
+# --- block operations --------------------------------------------------------
+
+def apply_deposit_electra(state, spec, pubkey: bytes, creds: bytes,
+                          amount: int, signature: bytes,
+                          check_signature: bool = True) -> None:
+    """Electra deposits go through the pending queue: a new validator
+    joins with zero balance, the amount waits for churn
+    (process_operations.rs apply_deposit Electra arm)."""
+    from lighthouse_tpu.state_transition import signature_sets as sigs
+    from lighthouse_tpu.state_transition.block_processing import (
+        get_validator_from_deposit,
+    )
+
+    pubkeys = state.validators.pubkeys
+    matches = np.nonzero(
+        (pubkeys == np.frombuffer(pubkey, np.uint8)).all(axis=1))[0]
+    if matches.size:
+        idx = int(matches[0])
+    else:
+        if check_signature:
+            data = T.DepositData(
+                pubkey=pubkey, withdrawal_credentials=creds,
+                amount=amount, signature=signature)
+            if not bls.verify_signature_sets([sigs.deposit_set(spec, data)]):
+                return
+        fields = get_validator_from_deposit(spec, pubkey, creds, 0)
+        fields["effective_balance"] = 0
+        state.validators.append(**fields)
+        state.balances = np.append(state.balances, np.uint64(0))
+        state.previous_epoch_participation = np.append(
+            state.previous_epoch_participation, np.uint8(0))
+        state.current_epoch_participation = np.append(
+            state.current_epoch_participation, np.uint8(0))
+        state.inactivity_scores = np.append(
+            state.inactivity_scores, np.uint64(0))
+        idx = len(state.validators) - 1
+    state.pending_balance_deposits = list(
+        state.pending_balance_deposits) + [
+        T.PendingBalanceDeposit(index=idx, amount=amount)]
+
+
+def process_deposit_request(state, spec, request) -> None:
+    """EIP-6110 execution-layer deposit (process_operations.rs
+    process_deposit_requests)."""
+    if int(state.deposit_requests_start_index) == \
+            UNSET_DEPOSIT_REQUESTS_START_INDEX:
+        state.deposit_requests_start_index = int(request.index)
+    apply_deposit_electra(
+        state, spec, bytes(request.pubkey),
+        bytes(request.withdrawal_credentials), int(request.amount),
+        bytes(request.signature))
+
+
+def process_withdrawal_request(state, spec, request) -> None:
+    """EIP-7002 execution-triggered withdrawal
+    (process_operations.rs process_execution_layer_withdrawal_requests).
+    Invalid requests are IGNORED (the EL cannot be rolled back)."""
+    amount = int(request.amount)
+    is_full_exit = amount == FULL_EXIT_REQUEST_AMOUNT
+    if not is_full_exit and len(state.pending_partial_withdrawals) >= \
+            spec.preset.pending_partial_withdrawals_limit:
+        return
+    pubkeys = state.validators.pubkeys
+    pk = np.frombuffer(bytes(request.validator_pubkey), np.uint8)
+    matches = np.nonzero((pubkeys == pk).all(axis=1))[0]
+    if not matches.size:
+        return
+    idx = int(matches[0])
+    v = state.validators
+    creds = v.withdrawal_credentials[idx]
+    if not has_execution_withdrawal_credential(creds):
+        return
+    if creds[12:].tobytes() != bytes(request.source_address):
+        return
+    cur = misc.current_epoch(state, spec)
+    if not bool(v.is_active(cur)[idx]):
+        return
+    if int(v.exit_epoch[idx]) != T.FAR_FUTURE_EPOCH:
+        return
+    if cur < int(v.activation_epoch[idx]) + spec.shard_committee_period:
+        return
+    pending_for_validator = sum(
+        1 for w in state.pending_partial_withdrawals
+        if int(w.index) == idx)
+    if is_full_exit:
+        if pending_for_validator == 0:
+            initiate_validator_exit_electra(state, spec, idx)
+        return
+    has_sufficient = (
+        int(v.effective_balance[idx]) >= spec.min_activation_balance)
+    has_excess = int(state.balances[idx]) > spec.min_activation_balance
+    if has_compounding_withdrawal_credential(creds) and has_sufficient \
+            and has_excess:
+        to_withdraw = min(
+            int(state.balances[idx]) - spec.min_activation_balance, amount)
+        withdrawable_epoch = compute_exit_epoch_and_update_churn(
+            state, spec, to_withdraw) + \
+            spec.min_validator_withdrawability_delay
+        state.pending_partial_withdrawals = list(
+            state.pending_partial_withdrawals) + [
+            T.PendingPartialWithdrawal(
+                index=idx, amount=to_withdraw,
+                withdrawable_epoch=withdrawable_epoch)]
+
+
+def consolidation_signature_set(state, spec, signed):
+    """The consolidation is signed by BOTH source and target keys
+    (aggregate over the same message, signed_consolidation.rs)."""
+    from lighthouse_tpu.state_transition.signature_sets import _pubkey
+
+    msg = signed.message
+    domain = misc.compute_domain(
+        spec.domain_consolidation, spec.genesis_fork_version,
+        state.genesis_validators_root)
+    root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+    return bls.SignatureSet(
+        bls.Signature(signed.signature),
+        [_pubkey(state, int(msg.source_index)),
+         _pubkey(state, int(msg.target_index))],
+        root)
+
+
+def process_consolidation(state, spec, signed, strategy, verifier) -> None:
+    from lighthouse_tpu.state_transition.block_processing import (
+        SignatureStrategy,
+        _check_or_accumulate,
+        _err,
+    )
+
+    _err(len(state.pending_consolidations)
+         < spec.preset.pending_consolidations_limit,
+         "consolidation: pending queue full")
+    _err(get_consolidation_churn_limit(state, spec)
+         > spec.min_activation_balance,
+         "consolidation: insufficient churn")
+    c = signed.message
+    src, tgt = int(c.source_index), int(c.target_index)
+    _err(src != tgt, "consolidation: source is target")
+    v = state.validators
+    _err(src < len(v) and tgt < len(v), "consolidation: unknown validator")
+    cur = misc.current_epoch(state, spec)
+    _err(bool(v.is_active(cur)[src]), "consolidation: source inactive")
+    _err(bool(v.is_active(cur)[tgt]), "consolidation: target inactive")
+    _err(int(v.exit_epoch[src]) == T.FAR_FUTURE_EPOCH,
+         "consolidation: source exiting")
+    _err(int(v.exit_epoch[tgt]) == T.FAR_FUTURE_EPOCH,
+         "consolidation: target exiting")
+    _err(cur >= int(c.epoch), "consolidation: epoch in future")
+    src_creds = v.withdrawal_credentials[src]
+    tgt_creds = v.withdrawal_credentials[tgt]
+    _err(has_execution_withdrawal_credential(src_creds),
+         "consolidation: source lacks execution credentials")
+    _err(has_execution_withdrawal_credential(tgt_creds),
+         "consolidation: target lacks execution credentials")
+    _err(src_creds[1:].tobytes() == tgt_creds[1:].tobytes(),
+         "consolidation: credentials mismatch")
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        _check_or_accumulate(
+            verifier, strategy,
+            consolidation_signature_set(state, spec, signed))
+    exit_epoch = compute_consolidation_epoch_and_update_churn(
+        state, spec, int(v.effective_balance[src]))
+    v.exit_epoch[src] = exit_epoch
+    v.withdrawable_epoch[src] = (
+        exit_epoch + spec.min_validator_withdrawability_delay)
+    state.pending_consolidations = list(state.pending_consolidations) + [
+        T.PendingConsolidation(source_index=src, target_index=tgt)]
+
+
+# --- committee-bits attestations (EIP-7549) ---------------------------------
+
+def get_attesting_indices_electra(state, spec, attestation,
+                                  shuffled=None) -> np.ndarray:
+    """Union of per-committee selections: aggregation_bits spans the
+    concatenated committees named by committee_bits (attestation.rs
+    get_attesting_indices Electra).  The bitlist length must equal the
+    total size of the included committees EXACTLY (spec assert) and set
+    committee bits must name existing committees — both are consensus
+    checks, not conveniences."""
+    from lighthouse_tpu.state_transition.block_processing import _err
+
+    slot = int(attestation.data.slot)
+    epoch = spec.compute_epoch_at_slot(slot)
+    if shuffled is None:
+        shuffled = misc.compute_committee_shuffle(state, spec, epoch)
+    n_committees = misc.get_committee_count_per_slot(spec, shuffled.shape[0])
+    bits = np.asarray(attestation.aggregation_bits, dtype=bool)
+    out = []
+    offset = 0
+    for committee_index, set_ in enumerate(attestation.committee_bits):
+        if not set_:
+            continue
+        _err(committee_index < n_committees,
+             "electra attestation: committee bit out of range")
+        committee = misc.get_beacon_committee(
+            state, spec, slot, committee_index, shuffled)
+        _err(offset + committee.shape[0] <= bits.shape[0],
+             "electra attestation: aggregation bits too short")
+        take = bits[offset:offset + committee.shape[0]]
+        out.append(committee[take])
+        offset += committee.shape[0]
+    _err(offset == bits.shape[0],
+         "electra attestation: aggregation bits length mismatch")
+    if not out:
+        return np.empty(0, dtype=np.uint64)
+    return np.unique(np.concatenate(out)).astype(np.uint64)
+
+
+# --- epoch processing --------------------------------------------------------
+
+def process_pending_balance_deposits(state, spec) -> None:
+    """Consume the pending deposit queue up to the churn budget
+    (single_pass.rs:803-852).  NOTE: this snapshot of the reference has
+    no exited-validator postponement branch — deposits are applied in
+    queue order against the churn budget regardless of exit status; we
+    match that behavior for parity."""
+    available = int(state.deposit_balance_to_consume) + \
+        get_activation_exit_churn_limit(state, spec)
+    processed = 0
+    next_i = 0
+    pending = list(state.pending_balance_deposits)
+    for dep in pending:
+        amount = int(dep.amount)
+        if processed + amount > available:
+            break
+        state.balances[int(dep.index)] += np.uint64(amount)
+        processed += amount
+        next_i += 1
+    state.pending_balance_deposits = pending[next_i:]
+    state.deposit_balance_to_consume = (
+        0 if next_i == len(pending) else available - processed)
+
+
+def process_pending_consolidations(state, spec) -> None:
+    """Apply matured consolidations: move the source's active balance to
+    the (now compounding) target (single_pass.rs:859-905)."""
+    cur = misc.current_epoch(state, spec)
+    pending = list(state.pending_consolidations)
+    next_i = 0
+    v = state.validators
+    for c in pending:
+        src, tgt = int(c.source_index), int(c.target_index)
+        if bool(v.slashed[src]):
+            next_i += 1
+            continue
+        if int(v.withdrawable_epoch[src]) > cur:
+            break
+        active = get_active_balance(state, spec, src)
+        switch_to_compounding_validator(state, spec, tgt)
+        state.balances[src] = max(0, int(state.balances[src]) - active)
+        state.balances[tgt] += np.uint64(active)
+        next_i += 1
+    state.pending_consolidations = pending[next_i:]
+
+
+def process_effective_balance_updates_electra(state, spec) -> None:
+    """Hysteresis as pre-electra, but the ceiling is per-validator
+    (compounding=2048 ETH)."""
+    v = state.validators
+    bal = state.balances
+    hysteresis_increment = (
+        spec.effective_balance_increment // spec.hysteresis_quotient)
+    downward = hysteresis_increment * spec.hysteresis_downward_multiplier
+    upward = hysteresis_increment * spec.hysteresis_upward_multiplier
+    compounding = v.withdrawal_credentials[:, 0] == \
+        COMPOUNDING_WITHDRAWAL_PREFIX
+    ceilings = np.where(
+        compounding,
+        np.uint64(spec.max_effective_balance_electra),
+        np.uint64(spec.min_activation_balance))
+    eff = v.effective_balance
+    update = (bal + np.uint64(downward) < eff) | (eff + np.uint64(upward) < bal)
+    new_eff = np.minimum(
+        bal - bal % np.uint64(spec.effective_balance_increment), ceilings)
+    v.effective_balance = np.where(update, new_eff, eff)
+
+
+__all__ = [
+    "COMPOUNDING_WITHDRAWAL_PREFIX",
+    "UNSET_DEPOSIT_REQUESTS_START_INDEX",
+    "apply_deposit_electra",
+    "compute_consolidation_epoch_and_update_churn",
+    "compute_exit_epoch_and_update_churn",
+    "consolidation_signature_set",
+    "get_active_balance",
+    "get_activation_exit_churn_limit",
+    "get_attesting_indices_electra",
+    "get_balance_churn_limit",
+    "get_consolidation_churn_limit",
+    "get_max_effective_balance",
+    "has_compounding_withdrawal_credential",
+    "has_execution_withdrawal_credential",
+    "initiate_validator_exit_electra",
+    "process_consolidation",
+    "process_deposit_request",
+    "process_effective_balance_updates_electra",
+    "process_pending_balance_deposits",
+    "process_pending_consolidations",
+    "process_withdrawal_request",
+    "queue_excess_active_balance",
+    "switch_to_compounding_validator",
+]

@@ -84,10 +84,21 @@ def process_epoch(state, spec: T.ChainSpec) -> None:
     process_justification_and_finalization(state, spec)
     process_inactivity_updates(state, spec)
     process_rewards_and_penalties(state, spec, fork)
-    process_registry_updates(state, spec)
+    process_registry_updates(state, spec, fork)
     process_slashings(state, spec, fork)
     process_eth1_data_reset(state, spec)
-    process_effective_balance_updates(state, spec)
+    if fork == "electra":
+        from lighthouse_tpu.state_transition.electra import (
+            process_effective_balance_updates_electra,
+            process_pending_balance_deposits,
+            process_pending_consolidations,
+        )
+
+        process_pending_balance_deposits(state, spec)
+        process_pending_consolidations(state, spec)
+        process_effective_balance_updates_electra(state, spec)
+    else:
+        process_effective_balance_updates(state, spec)
     process_slashings_reset(state, spec)
     process_randao_mixes_reset(state, spec)
     process_historical_update(state, spec, fork)
@@ -260,19 +271,37 @@ def initiate_validator_exit(state, spec: T.ChainSpec, index: int) -> None:
         exit_queue_epoch + spec.min_validator_withdrawability_delay)
 
 
-def process_registry_updates(state, spec: T.ChainSpec) -> None:
+def process_registry_updates(state, spec: T.ChainSpec,
+                             fork: str | None = None) -> None:
     v = state.validators
     cur = misc.current_epoch(state, spec)
-    # eligibility for the activation queue
-    eligible = v.is_eligible_for_activation_queue(spec.max_effective_balance)
+    electra = fork == "electra"
+    # eligibility for the activation queue (electra EIP-7251: any balance
+    # at or above MIN_ACTIVATION_BALANCE qualifies, not only exactly-max)
+    if electra:
+        eligible = (
+            (v.activation_eligibility_epoch
+             == np.uint64(T.FAR_FUTURE_EPOCH))
+            & (v.effective_balance >= np.uint64(spec.min_activation_balance)))
+    else:
+        eligible = v.is_eligible_for_activation_queue(
+            spec.max_effective_balance)
     v.activation_eligibility_epoch[eligible] = cur + 1
     # ejections
     eject = v.is_active(cur) & (
         v.effective_balance <= np.uint64(spec.ejection_balance))
     for idx in np.nonzero(eject)[0]:
-        initiate_validator_exit(state, spec, int(idx))
-    # activation queue (ordered by eligibility epoch then index, bounded by
-    # finality + churn)
+        if electra:
+            from lighthouse_tpu.state_transition.electra import (
+                initiate_validator_exit_electra,
+            )
+
+            initiate_validator_exit_electra(state, spec, int(idx))
+        else:
+            initiate_validator_exit(state, spec, int(idx))
+    # activation queue (ordered by eligibility epoch then index, bounded
+    # by finality; electra drops the head-count churn — activations are
+    # budgeted by the pending-deposit balance churn instead)
     finalized = int(state.finalized_checkpoint.epoch)
     pending = (
         (v.activation_eligibility_epoch <= np.uint64(finalized))
@@ -280,8 +309,11 @@ def process_registry_updates(state, spec: T.ChainSpec) -> None:
     )
     idxs = np.nonzero(pending)[0]
     order = np.lexsort((idxs, v.activation_eligibility_epoch[idxs]))
-    churn = misc.get_validator_activation_churn_limit(state, spec)
-    dequeued = idxs[order][:churn]
+    if electra:
+        dequeued = idxs[order]
+    else:
+        churn = misc.get_validator_activation_churn_limit(state, spec)
+        dequeued = idxs[order][:churn]
     v.activation_epoch[dequeued] = spec.compute_activation_exit_epoch(cur)
 
 

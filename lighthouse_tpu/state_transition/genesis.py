@@ -102,15 +102,26 @@ def genesis_state(
         state.current_sync_committee = committee
         state.next_sync_committee = committee
 
-    if fork in ("bellatrix", "capella", "deneb"):
+    if fork in ("bellatrix", "capella", "deneb", "electra"):
         # a synthetic pre-existing execution head so payload checks chain
         header_cls = {
             "bellatrix": t.ExecutionPayloadHeaderBellatrix,
             "capella": t.ExecutionPayloadHeaderCapella,
             "deneb": t.ExecutionPayloadHeaderDeneb,
+            "electra": t.ExecutionPayloadHeaderElectra,
         }[fork]
         state.latest_execution_payload_header = header_cls(
             block_hash=ETH1_GENESIS_HASH,
             timestamp=genesis_time,
         )
+    if fork == "electra":
+        from lighthouse_tpu.state_transition.electra import (
+            UNSET_DEPOSIT_REQUESTS_START_INDEX,
+        )
+
+        state.deposit_requests_start_index = \
+            UNSET_DEPOSIT_REQUESTS_START_INDEX
+        state.earliest_exit_epoch = spec.compute_activation_exit_epoch(0)
+        state.earliest_consolidation_epoch = \
+            spec.compute_activation_exit_epoch(0)
     return state

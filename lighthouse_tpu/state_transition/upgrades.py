@@ -117,11 +117,82 @@ def upgrade_to_deneb(state, spec: T.ChainSpec, t) -> None:
     _set_fork(state, spec, "deneb", epoch)
 
 
+def upgrade_to_electra(state, spec: T.ChainSpec, t) -> None:
+    """deneb -> electra (upgrade/electra.rs): new churn accounting fields,
+    queues start empty, and ALL validators' activation-eligible deposits
+    re-queue through the pending-deposit churn (EIP-7251 upgrade step:
+    queue excess balances of compounding-credential validators)."""
+    from lighthouse_tpu.state_transition.electra import (
+        UNSET_DEPOSIT_REQUESTS_START_INDEX,
+    )
+
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    old_header = state.latest_execution_payload_header
+    _swap_class(state, t, "electra")
+    state.latest_execution_payload_header = _copy_header_fields(
+        old_header, t.ExecutionPayloadHeaderElectra,
+        deposit_requests_root=b"\x00" * 32,
+        withdrawal_requests_root=b"\x00" * 32)
+    v = state.validators
+    exiting = v.exit_epoch[v.exit_epoch != np.uint64(T.FAR_FUTURE_EPOCH)]
+    earliest_exit = (int(exiting.max()) + 1 if exiting.size
+                     else spec.compute_activation_exit_epoch(epoch))
+    state.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
+    state.deposit_balance_to_consume = 0
+    state.earliest_exit_epoch = max(
+        earliest_exit, spec.compute_activation_exit_epoch(epoch))
+    state.consolidation_balance_to_consume = 0
+    state.earliest_consolidation_epoch = \
+        spec.compute_activation_exit_epoch(epoch)
+    state.pending_balance_deposits = []
+    state.pending_partial_withdrawals = []
+    state.pending_consolidations = []
+    _set_fork(state, spec, "electra", epoch)
+
+    from lighthouse_tpu.state_transition.electra import (
+        get_activation_exit_churn_limit,
+        get_consolidation_churn_limit,
+        has_compounding_withdrawal_credential,
+        queue_excess_active_balance,
+    )
+
+    state.exit_balance_to_consume = get_activation_exit_churn_limit(
+        state, spec)
+    state.consolidation_balance_to_consume = get_consolidation_churn_limit(
+        state, spec)
+
+    # pre-activation validators re-queue their ENTIRE balance through the
+    # pending-deposit churn, ordered by (eligibility epoch, index); their
+    # effective balance resets to zero (upgrade/electra.rs:39-62,
+    # beacon_state.rs queue_entire_balance_and_reset_validator)
+    v = state.validators
+    pre_activation = np.nonzero(
+        v.activation_epoch == np.uint64(T.FAR_FUTURE_EPOCH))[0]
+    order = np.lexsort(
+        (pre_activation, v.activation_eligibility_epoch[pre_activation]))
+    pending = list(state.pending_balance_deposits)
+    for idx in pre_activation[order]:
+        idx = int(idx)
+        amount = int(state.balances[idx])
+        state.balances[idx] = 0
+        v.effective_balance[idx] = 0
+        v.activation_eligibility_epoch[idx] = T.FAR_FUTURE_EPOCH
+        pending.append(T.PendingBalanceDeposit(index=idx, amount=amount))
+    state.pending_balance_deposits = pending
+
+    # early adopters of compounding credentials churn their excess
+    for idx in range(len(v)):
+        if has_compounding_withdrawal_credential(
+                v.withdrawal_credentials[idx]):
+            queue_excess_active_balance(state, spec, idx)
+
+
 _UPGRADES = {
     "altair": upgrade_to_altair,
     "bellatrix": upgrade_to_bellatrix,
     "capella": upgrade_to_capella,
     "deneb": upgrade_to_deneb,
+    "electra": upgrade_to_electra,
 }
 
 

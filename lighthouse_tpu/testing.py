@@ -98,7 +98,7 @@ class Harness:
         )
         if self.fork != "phase0":
             body_kw["sync_aggregate"] = self._sync_aggregate(pre, target_slot)
-        if self.fork in ("bellatrix", "capella", "deneb"):
+        if self.fork in ("bellatrix", "capella", "deneb", "electra"):
             body_kw["execution_payload"] = self._execution_payload(pre, target_slot)
         if blob_commitments:
             body_kw["blob_kzg_commitments"] = [bytes(c) for c in blob_commitments]
@@ -173,6 +173,7 @@ class Harness:
             "bellatrix": self.t.ExecutionPayloadBellatrix,
             "capella": self.t.ExecutionPayloadCapella,
             "deneb": self.t.ExecutionPayloadDeneb,
+            "electra": self.t.ExecutionPayloadElectra,
         }[self.fork]
         kw = dict(
             parent_hash=parent_hash,
@@ -182,7 +183,7 @@ class Harness:
             timestamp=int(pre.genesis_time) + slot * spec.seconds_per_slot,
             block_hash=block_hash,
         )
-        if self.fork in ("capella", "deneb"):
+        if self.fork in ("capella", "deneb", "electra"):
             kw["withdrawals"] = get_expected_withdrawals(pre, spec)
         return cls(**kw)
 
@@ -249,6 +250,27 @@ class Harness:
             sig = bls.Signature.aggregate(sigs).to_bytes()
         else:
             sig = b"\xab" * 96
+        if self.fork == "electra":
+            # EIP-7549: data.index moves into committee_bits
+            data = T.AttestationData(
+                slot=s, index=0,
+                beacon_block_root=bytes(data.beacon_block_root),
+                source=data.source, target=data.target)
+            if self.real_crypto:
+                domain = misc.get_domain(
+                    state, spec, spec.domain_beacon_attester, epoch)
+                signing_root = misc.compute_signing_root(
+                    data.hash_tree_root(), domain)
+                sigs = [self.sk(int(v)).sign(signing_root) for v in committee]
+                sig = bls.Signature.aggregate(sigs).to_bytes()
+            committee_bits = [i == committee_index
+                              for i in range(spec.preset.max_committees_per_slot)]
+            return self.t.AttestationElectra(
+                aggregation_bits=[True] * committee.shape[0],
+                data=data,
+                committee_bits=committee_bits,
+                signature=sig,
+            )
         return self.t.Attestation(
             aggregation_bits=[True] * committee.shape[0],
             data=data,

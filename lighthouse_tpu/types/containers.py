@@ -169,6 +169,51 @@ class Eth1Block(ssz.Container):
     deposit_count: ssz.uint64
 
 
+# --- electra containers (reference consensus/types/src/{pending_balance_
+# deposit,pending_partial_withdrawal,pending_consolidation,consolidation,
+# deposit_request,execution_layer_withdrawal_request}.rs) -------------------
+
+class PendingBalanceDeposit(ssz.Container):
+    index: ssz.uint64
+    amount: ssz.uint64
+
+
+class PendingPartialWithdrawal(ssz.Container):
+    index: ssz.uint64
+    amount: ssz.uint64
+    withdrawable_epoch: ssz.uint64
+
+
+class PendingConsolidation(ssz.Container):
+    source_index: ssz.uint64
+    target_index: ssz.uint64
+
+
+class Consolidation(ssz.Container):
+    source_index: ssz.uint64
+    target_index: ssz.uint64
+    epoch: ssz.uint64
+
+
+class SignedConsolidation(ssz.Container):
+    message: Consolidation
+    signature: ssz.Bytes96
+
+
+class DepositRequest(ssz.Container):
+    pubkey: ssz.Bytes48
+    withdrawal_credentials: ssz.Bytes32
+    amount: ssz.uint64
+    signature: ssz.Bytes96
+    index: ssz.uint64
+
+
+class ExecutionLayerWithdrawalRequest(ssz.Container):
+    source_address: ssz.Bytes20
+    validator_pubkey: ssz.Bytes48
+    amount: ssz.uint64
+
+
 def _container(name: str, field_specs: list[tuple[str, object]], doc: str = ""):
     """Build an ssz.Container subclass with exact field order."""
     ns = {"__annotations__": {f: t for f, t in field_specs}}
@@ -209,6 +254,28 @@ def make_types(preset: Preset) -> SimpleNamespace:
     AttesterSlashing = _container("AttesterSlashing", [
         ("attestation_1", IndexedAttestation),
         ("attestation_2", IndexedAttestation),
+    ])
+
+    # electra (EIP-7549): attestations span every committee of the slot;
+    # committee membership moves from data.index to committee_bits
+    # (reference attestation.rs superstruct Electra variant — note this
+    # snapshot's field order places committee_bits BEFORE signature)
+    AttestationElectra = _container("AttestationElectra", [
+        ("aggregation_bits", ssz.Bitlist(validators_per_slot)),
+        ("data", AttestationData),
+        ("committee_bits", ssz.Bitvector(P.max_committees_per_slot)),
+        ("signature", ssz.Bytes96),
+    ])
+
+    IndexedAttestationElectra = _container("IndexedAttestationElectra", [
+        ("attesting_indices", U64List(validators_per_slot)),
+        ("data", AttestationData),
+        ("signature", ssz.Bytes96),
+    ])
+
+    AttesterSlashingElectra = _container("AttesterSlashingElectra", [
+        ("attestation_1", IndexedAttestationElectra),
+        ("attestation_2", IndexedAttestationElectra),
     ])
 
     AggregateAndProof = _container("AggregateAndProof", [
@@ -284,6 +351,18 @@ def make_types(preset: Preset) -> SimpleNamespace:
         "ExecutionPayloadDeneb",
         _payload_base + [("transactions", Transactions), _withdrawals] + _blob_gas,
     )
+    _el_requests = [
+        ("deposit_requests", ssz.List(
+            DepositRequest, P.max_deposit_requests_per_payload)),
+        ("withdrawal_requests", ssz.List(
+            ExecutionLayerWithdrawalRequest,
+            P.max_withdrawal_requests_per_payload)),
+    ]
+    ExecutionPayloadElectra = _container(
+        "ExecutionPayloadElectra",
+        _payload_base + [("transactions", Transactions), _withdrawals]
+        + _blob_gas + _el_requests,
+    )
 
     _header_mid = [("transactions_root", ssz.Bytes32)]
     ExecutionPayloadHeaderBellatrix = _container(
@@ -296,6 +375,12 @@ def make_types(preset: Preset) -> SimpleNamespace:
     ExecutionPayloadHeaderDeneb = _container(
         "ExecutionPayloadHeaderDeneb",
         _payload_base + _header_mid + [("withdrawals_root", ssz.Bytes32)] + _blob_gas,
+    )
+    ExecutionPayloadHeaderElectra = _container(
+        "ExecutionPayloadHeaderElectra",
+        _payload_base + _header_mid + [("withdrawals_root", ssz.Bytes32)]
+        + _blob_gas + [("deposit_requests_root", ssz.Bytes32),
+                       ("withdrawal_requests_root", ssz.Bytes32)],
     )
 
     KzgCommitments = ssz.List(ssz.Bytes48, P.max_blob_commitments_per_block)
@@ -339,6 +424,30 @@ def make_types(preset: Preset) -> SimpleNamespace:
             ("blob_kzg_commitments", KzgCommitments),
         ],
     )
+    # electra body: base ops swap to the electra attestation containers
+    # with their own (smaller) per-block limits; consolidations appended
+    # (reference beacon_block_body.rs Electra variant)
+    _body_base_electra = [
+        spec if spec[0] not in ("attester_slashings", "attestations") else (
+            ("attester_slashings", ssz.List(
+                AttesterSlashingElectra, P.max_attester_slashings_electra))
+            if spec[0] == "attester_slashings"
+            else ("attestations", ssz.List(
+                AttestationElectra, P.max_attestations_electra)))
+        for spec in _body_base
+    ]
+    BeaconBlockBodyElectra = _container(
+        "BeaconBlockBodyElectra",
+        _body_base_electra
+        + [
+            _sync,
+            ("execution_payload", ExecutionPayloadElectra),
+            _blschanges,
+            ("blob_kzg_commitments", KzgCommitments),
+            ("consolidations", ssz.List(
+                SignedConsolidation, P.max_consolidations)),
+        ],
+    )
 
     def _block(name, body_cls):
         return _container(name, [
@@ -354,6 +463,7 @@ def make_types(preset: Preset) -> SimpleNamespace:
     BeaconBlockBellatrix = _block("BeaconBlockBellatrix", BeaconBlockBodyBellatrix)
     BeaconBlockCapella = _block("BeaconBlockCapella", BeaconBlockBodyCapella)
     BeaconBlockDeneb = _block("BeaconBlockDeneb", BeaconBlockBodyDeneb)
+    BeaconBlockElectra = _block("BeaconBlockElectra", BeaconBlockBodyElectra)
 
     def _signed(name, block_cls):
         return _container(name, [
@@ -366,6 +476,7 @@ def make_types(preset: Preset) -> SimpleNamespace:
     SignedBeaconBlockBellatrix = _signed("SignedBeaconBlockBellatrix", BeaconBlockBellatrix)
     SignedBeaconBlockCapella = _signed("SignedBeaconBlockCapella", BeaconBlockCapella)
     SignedBeaconBlockDeneb = _signed("SignedBeaconBlockDeneb", BeaconBlockDeneb)
+    SignedBeaconBlockElectra = _signed("SignedBeaconBlockElectra", BeaconBlockElectra)
 
     HistoricalBatch = _container("HistoricalBatch", [
         ("block_roots", RootsVector(P.slots_per_historical_root)),
@@ -441,6 +552,26 @@ def make_types(preset: Preset) -> SimpleNamespace:
         + [("latest_execution_payload_header", ExecutionPayloadHeaderDeneb)]
         + _capella_tail,
     )
+    _electra_tail = [
+        ("deposit_requests_start_index", ssz.uint64),
+        ("deposit_balance_to_consume", ssz.uint64),
+        ("exit_balance_to_consume", ssz.uint64),
+        ("earliest_exit_epoch", ssz.uint64),
+        ("consolidation_balance_to_consume", ssz.uint64),
+        ("earliest_consolidation_epoch", ssz.uint64),
+        ("pending_balance_deposits", ssz.List(
+            PendingBalanceDeposit, P.pending_deposits_limit)),
+        ("pending_partial_withdrawals", ssz.List(
+            PendingPartialWithdrawal, P.pending_partial_withdrawals_limit)),
+        ("pending_consolidations", ssz.List(
+            PendingConsolidation, P.pending_consolidations_limit)),
+    ]
+    BeaconStateElectra = _container(
+        "BeaconStateElectra",
+        _state_pre + _participation + _state_post + _altair_tail
+        + [("latest_execution_payload_header", ExecutionPayloadHeaderElectra)]
+        + _capella_tail + _electra_tail,
+    )
 
     BlobSidecar = _container("BlobSidecar", [
         ("index", ssz.uint64),
@@ -469,6 +600,8 @@ def make_types(preset: Preset) -> SimpleNamespace:
                     SignedBeaconBlockCapella, BeaconBlockBodyCapella),
         "deneb": (BeaconStateDeneb, BeaconBlockDeneb, SignedBeaconBlockDeneb,
                   BeaconBlockBodyDeneb),
+        "electra": (BeaconStateElectra, BeaconBlockElectra,
+                    SignedBeaconBlockElectra, BeaconBlockBodyElectra),
     }
     ns.beacon_state_class = lambda fork: _by_fork[fork][0]
     ns.beacon_block_class = lambda fork: _by_fork[fork][1]

@@ -70,6 +70,7 @@ class Preset:
     max_deposit_requests_per_payload: int = 8192
     max_withdrawal_requests_per_payload: int = 16
     max_consolidation_requests_per_payload: int = 2
+    max_consolidations: int = 1
     max_pending_partials_per_withdrawals_sweep: int = 8
     max_pending_deposits_per_epoch: int = 16
 
@@ -134,6 +135,12 @@ MINIMAL_PRESET = Preset(
     max_validators_per_withdrawals_sweep=16,
     max_blob_commitments_per_block=4096,
     field_elements_per_blob=4096,
+    # electra (minimal preset overrides)
+    pending_partial_withdrawals_limit=64,
+    pending_consolidations_limit=64,
+    max_deposit_requests_per_payload=4,
+    max_withdrawal_requests_per_payload=2,
+    max_pending_partials_per_withdrawals_sweep=1,
 )
 
 
@@ -229,6 +236,7 @@ class ChainSpec:
     domain_sync_committee_selection_proof: int = 8
     domain_contribution_and_proof: int = 9
     domain_bls_to_execution_change: int = 10
+    domain_consolidation: int = 11
     domain_application_mask: int = 0x00000001
 
     # networking-ish constants used by subnet scheduling

@@ -1,0 +1,7 @@
+"""Out-of-process chain analytics (the reference's `watch`)."""
+
+from lighthouse_tpu.watch.database import WatchDB
+from lighthouse_tpu.watch.server import WatchServer
+from lighthouse_tpu.watch.updater import WatchUpdater
+
+__all__ = ["WatchDB", "WatchServer", "WatchUpdater"]

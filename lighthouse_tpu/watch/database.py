@@ -1,0 +1,177 @@
+"""Watch analytics database.
+
+Rebuild of /root/reference/watch/src/database/ (PostgreSQL + diesel) on
+stdlib sqlite3: canonical slots, block rewards/packing, suboptimal
+attestation tracking per validator per epoch.  Same table shapes, same
+queries the server half exposes.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS canonical_slots (
+    slot INTEGER PRIMARY KEY,
+    root BLOB NOT NULL,
+    skipped INTEGER NOT NULL,
+    beacon_block BLOB
+);
+CREATE TABLE IF NOT EXISTS beacon_blocks (
+    slot INTEGER PRIMARY KEY,
+    root BLOB NOT NULL,
+    parent_root BLOB NOT NULL,
+    attestation_count INTEGER NOT NULL,
+    transaction_count INTEGER
+);
+CREATE TABLE IF NOT EXISTS block_rewards (
+    slot INTEGER PRIMARY KEY,
+    total INTEGER NOT NULL,
+    attestation_reward INTEGER NOT NULL,
+    sync_committee_reward INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS block_packing (
+    slot INTEGER PRIMARY KEY,
+    available INTEGER NOT NULL,
+    included INTEGER NOT NULL,
+    prior_skip_slots INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS suboptimal_attestations (
+    epoch_start_slot INTEGER NOT NULL,
+    validator_index INTEGER NOT NULL,
+    source INTEGER NOT NULL,
+    head INTEGER NOT NULL,
+    target INTEGER NOT NULL,
+    PRIMARY KEY (epoch_start_slot, validator_index)
+);
+CREATE TABLE IF NOT EXISTS validators (
+    validator_index INTEGER PRIMARY KEY,
+    public_key BLOB NOT NULL,
+    activation_epoch INTEGER,
+    exit_epoch INTEGER
+);
+"""
+
+
+class WatchDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+
+    # -- writes --------------------------------------------------------------
+
+    def insert_canonical_slot(self, slot: int, root: bytes,
+                              skipped: bool) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO canonical_slots VALUES (?,?,?,NULL)",
+                (slot, root, int(skipped)))
+            self._conn.commit()
+
+    def insert_block(self, slot: int, root: bytes, parent_root: bytes,
+                     attestation_count: int,
+                     transaction_count: int | None = None) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO beacon_blocks VALUES (?,?,?,?,?)",
+                (slot, root, parent_root, attestation_count,
+                 transaction_count))
+            self._conn.commit()
+
+    def insert_block_rewards(self, slot: int, total: int,
+                             attestation_reward: int,
+                             sync_committee_reward: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_rewards VALUES (?,?,?,?)",
+                (slot, total, attestation_reward, sync_committee_reward))
+            self._conn.commit()
+
+    def insert_block_packing(self, slot: int, available: int, included: int,
+                             prior_skip_slots: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_packing VALUES (?,?,?,?)",
+                (slot, available, included, prior_skip_slots))
+            self._conn.commit()
+
+    def insert_suboptimal_attestation(self, epoch_start_slot: int,
+                                      validator_index: int, source: bool,
+                                      head: bool, target: bool) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO suboptimal_attestations "
+                "VALUES (?,?,?,?,?)",
+                (epoch_start_slot, validator_index,
+                 int(source), int(head), int(target)))
+            self._conn.commit()
+
+    def upsert_validator(self, index: int, public_key: bytes,
+                         activation_epoch: int, exit_epoch: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO validators VALUES (?,?,?,?)",
+                (index, public_key, activation_epoch, exit_epoch))
+            self._conn.commit()
+
+    # -- queries (the server's read surface) ---------------------------------
+
+    def lowest_canonical_slot(self) -> int | None:
+        row = self._conn.execute(
+            "SELECT MIN(slot) FROM canonical_slots").fetchone()
+        return row[0]
+
+    def highest_canonical_slot(self) -> int | None:
+        row = self._conn.execute(
+            "SELECT MAX(slot) FROM canonical_slots").fetchone()
+        return row[0]
+
+    def canonical_slot(self, slot: int) -> dict | None:
+        row = self._conn.execute(
+            "SELECT slot, root, skipped FROM canonical_slots WHERE slot=?",
+            (slot,)).fetchone()
+        if row is None:
+            return None
+        return {"slot": row[0], "root": row[1], "skipped": bool(row[2])}
+
+    def block_at_slot(self, slot: int) -> dict | None:
+        row = self._conn.execute(
+            "SELECT slot, root, parent_root, attestation_count, "
+            "transaction_count FROM beacon_blocks WHERE slot=?",
+            (slot,)).fetchone()
+        if row is None:
+            return None
+        return {"slot": row[0], "root": row[1], "parent_root": row[2],
+                "attestation_count": row[3], "transaction_count": row[4]}
+
+    def rewards_at_slot(self, slot: int) -> dict | None:
+        row = self._conn.execute(
+            "SELECT total, attestation_reward, sync_committee_reward "
+            "FROM block_rewards WHERE slot=?", (slot,)).fetchone()
+        if row is None:
+            return None
+        return {"total": row[0], "attestation_reward": row[1],
+                "sync_committee_reward": row[2]}
+
+    def packing_at_slot(self, slot: int) -> dict | None:
+        row = self._conn.execute(
+            "SELECT available, included, prior_skip_slots "
+            "FROM block_packing WHERE slot=?", (slot,)).fetchone()
+        if row is None:
+            return None
+        return {"available": row[0], "included": row[1],
+                "prior_skip_slots": row[2]}
+
+    def suboptimal_attesters(self, epoch_start_slot: int) -> list[dict]:
+        rows = self._conn.execute(
+            "SELECT validator_index, source, head, target "
+            "FROM suboptimal_attestations WHERE epoch_start_slot=?",
+            (epoch_start_slot,)).fetchall()
+        return [{"validator_index": r[0], "source": bool(r[1]),
+                 "head": bool(r[2]), "target": bool(r[3])} for r in rows]
+
+    def close(self) -> None:
+        self._conn.close()

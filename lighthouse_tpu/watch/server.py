@@ -1,0 +1,93 @@
+"""Watch HTTP server: read-only analytics endpoints over the WatchDB.
+
+Rebuild of /root/reference/watch/src/server/ (axum) on stdlib
+http.server, with the reference's route shapes:
+  /v1/slots/{slot}            canonical slot record
+  /v1/blocks/{slot}           block summary
+  /v1/blocks/{slot}/rewards   block rewards
+  /v1/blocks/{slot}/packing   packing efficiency
+  /v1/validators/missed/{epoch_start_slot}   suboptimal attesters
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _hex(b) -> str:
+    return "0x" + bytes(b).hex()
+
+
+class WatchServer:
+    def __init__(self, db, port: int = 0):
+        self.db = db
+        self.port = port
+        self._srv = None
+        self._thread = None
+
+    def _dispatch(self, path: str):
+        db = self.db
+        m = re.fullmatch(r"/v1/slots/(\d+)", path)
+        if m:
+            row = db.canonical_slot(int(m.group(1)))
+            if row:
+                row["root"] = _hex(row["root"])
+            return row
+        m = re.fullmatch(r"/v1/blocks/(\d+)", path)
+        if m:
+            row = db.block_at_slot(int(m.group(1)))
+            if row:
+                row["root"] = _hex(row["root"])
+                row["parent_root"] = _hex(row["parent_root"])
+            return row
+        m = re.fullmatch(r"/v1/blocks/(\d+)/rewards", path)
+        if m:
+            return db.rewards_at_slot(int(m.group(1)))
+        m = re.fullmatch(r"/v1/blocks/(\d+)/packing", path)
+        if m:
+            return db.packing_at_slot(int(m.group(1)))
+        m = re.fullmatch(r"/v1/validators/missed/(\d+)", path)
+        if m:
+            return db.suboptimal_attesters(int(m.group(1)))
+        if path == "/v1/status":
+            return {"lowest_slot": db.lowest_canonical_slot(),
+                    "highest_slot": db.highest_canonical_slot()}
+        return None
+
+    def start(self) -> "WatchServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                result = outer._dispatch(self.path)
+                if result is None:
+                    self.send_response(404)
+                    body = json.dumps({"error": "not found"}).encode()
+                else:
+                    self.send_response(200)
+                    body = json.dumps(result).encode()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._srv.server_port
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+__all__ = ["WatchServer"]

@@ -1,0 +1,119 @@
+"""Watch updater: polls a beacon node's HTTP API into the analytics DB.
+
+Rebuild of /root/reference/watch/src/updater/: walks the canonical chain
+from the last recorded slot to the node's head, recording per-slot
+canonical roots (skip slots included), per-block attestation counts and
+packing, and — at each epoch boundary, from the debug state download —
+per-validator suboptimal-attestation flags (missed source/target/head),
+the reference's suboptimal_attestations tracker.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.api.client import BeaconNodeClient, ClientError
+
+# altair participation flag bits (spec)
+F_SOURCE = 1
+F_TARGET = 2
+F_HEAD = 4
+
+
+class WatchUpdater:
+    def __init__(self, db, client: BeaconNodeClient, spec: T.ChainSpec):
+        self.db = db
+        self.client = client
+        self.spec = spec
+        self.t = T.make_types(spec.preset)
+
+    def _head_slot(self) -> int:
+        hdr = self.client.header("head")
+        return int(hdr["header"]["message"]["slot"])
+
+    def run_once(self, max_slots: int = 256) -> int:
+        """Record up to `max_slots` new canonical slots; returns the
+        number recorded."""
+        head = self._head_slot()
+        last = self.db.highest_canonical_slot()
+        start = 0 if last is None else last + 1
+        end = min(head + 1, start + max_slots)
+        recorded = 0
+        prev_root = None
+        for slot in range(start, end):
+            root, block = self._block_at(slot)
+            if block is None:
+                if root is None:
+                    root = prev_root
+                if root is None:
+                    continue
+                self.db.insert_canonical_slot(slot, root, skipped=True)
+            else:
+                self.db.insert_canonical_slot(slot, root, skipped=False)
+                body = block.message.body
+                atts = list(body.attestations)
+                included = sum(
+                    sum(1 for b in a.aggregation_bits if b) for a in atts)
+                self.db.insert_block(
+                    slot, root, bytes(block.message.parent_root), len(atts))
+                self.db.insert_block_packing(
+                    slot, available=included, included=included,
+                    prior_skip_slots=self._prior_skips(slot))
+            prev_root = root
+            recorded += 1
+            if slot and slot % self.spec.slots_per_epoch == 0:
+                self._record_suboptimal(slot)
+        return recorded
+
+    def _block_at(self, slot: int):
+        try:
+            raw = self.client.block_ssz(str(slot))
+        except ClientError:
+            return None, None
+        block = self.t.decode_signed_block(raw)
+        if block is None or int(block.message.slot) != slot:
+            # the API serves the latest block at-or-below the slot;
+            # an older block means `slot` itself was skipped
+            root = (block.message.hash_tree_root()
+                    if block is not None else None)
+            return root, None
+        return block.message.hash_tree_root(), block
+
+    def _prior_skips(self, slot: int) -> int:
+        n = 0
+        s = slot - 1
+        while s >= 0:
+            row = self.db.canonical_slot(s)
+            if row is None or not row["skipped"]:
+                break
+            n += 1
+            s -= 1
+        return n
+
+    def _record_suboptimal(self, epoch_start_slot: int) -> None:
+        """At an epoch boundary, download the state and record validators
+        whose PREVIOUS-epoch participation is missing any flag."""
+        try:
+            raw, fork = self.client.state_ssz(str(epoch_start_slot))
+        except ClientError:
+            return  # state pruned/unavailable: skip this boundary
+        if fork == "phase0":
+            return  # no participation flags pre-altair
+        state = self.t.beacon_state_class(fork).deserialize(raw)
+        part = state.previous_epoch_participation
+        v = state.validators
+        prev_epoch = max(
+            0, epoch_start_slot // self.spec.slots_per_epoch - 1)
+        for i in range(len(part)):
+            if not (v.activation_epoch[i] <= prev_epoch < v.exit_epoch[i]):
+                continue
+            flags = int(part[i])
+            src = bool(flags & F_SOURCE)
+            tgt = bool(flags & F_TARGET)
+            head = bool(flags & F_HEAD)
+            if src and tgt and head:
+                continue
+            self.db.insert_suboptimal_attestation(
+                epoch_start_slot, i, source=src, head=head, target=tgt)
+
+
+__all__ = ["WatchUpdater"]

@@ -1,0 +1,114 @@
+"""Discovery (discv5-equivalent) + boot node tests."""
+
+import hashlib
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import BootNode, NetworkFabric, NetworkService
+from lighthouse_tpu.network.discovery import (
+    BUCKET_SIZE,
+    Discovery,
+    Enr,
+    RoutingTable,
+    log2_distance,
+    xor_distance,
+)
+from lighthouse_tpu.testing import Harness
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fake_bls():
+    bls.set_backend("fake")
+    yield
+    bls.set_backend("reference")
+
+
+class TestRoutingTable:
+    def test_xor_metric(self):
+        a = hashlib.sha256(b"a").digest()
+        b = hashlib.sha256(b"b").digest()
+        assert xor_distance(a, a) == 0
+        assert xor_distance(a, b) == xor_distance(b, a)
+        assert log2_distance(a, a) == 0
+
+    def test_insert_and_closest(self):
+        local = hashlib.sha256(b"local").digest()
+        table = RoutingTable(local)
+        enrs = [Enr(peer_id=f"peer-{i}") for i in range(40)]
+        for e in enrs:
+            table.insert(e)
+        target = hashlib.sha256(b"target").digest()
+        closest = table.closest(target, n=5)
+        assert len(closest) == 5
+        dists = [xor_distance(e.node_id, target) for e in closest]
+        assert dists == sorted(dists)
+
+    def test_bucket_capacity(self):
+        local = b"\x00" * 32
+        table = RoutingTable(local)
+        # craft many ids in the SAME bucket (top bit set => distance 256)
+        added = 0
+        for i in range(BUCKET_SIZE * 2):
+            e = Enr(peer_id=f"far-{i}")
+            if log2_distance(local, e.node_id) == 256 and table.insert(e):
+                added += 1
+        assert added <= BUCKET_SIZE
+
+    def test_seq_update_replaces(self):
+        table = RoutingTable(b"\x01" * 32)
+        old = Enr(peer_id="p", seq=1, port=9000)
+        new = Enr(peer_id="p", seq=2, port=9001)
+        table.insert(old)
+        table.insert(new)
+        [stored] = [e for b in table.buckets for e in b.values()]
+        assert stored.port == 9001
+
+
+def _service(h, fabric, name):
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+    return NetworkService(chain, fabric, name)
+
+
+class TestDiscoveryProtocol:
+    def test_bootstrap_via_bootnode(self):
+        h = Harness(16, fork="altair", real_crypto=False)
+        fabric = NetworkFabric()
+        from lighthouse_tpu.network.router import fork_digest
+
+        nodes = [_service(h, fabric, f"node-{i}") for i in range(6)]
+        boot = BootNode(fabric, fork_digest=fork_digest(nodes[0].chain))
+        # each node pings the bootnode (registers itself), then looks up
+        for n in nodes:
+            n.discovery.bootstrap(boot.peer_id)
+        assert boot.known_peers() == 6
+        # a late joiner discovers existing peers through the bootnode
+        late = _service(h, fabric, "late")
+        connected = late.discover_and_connect(boot.peer_id)
+        assert connected >= 3
+        assert len(late.discovery.table) >= 3
+
+    def test_wrong_fork_digest_filtered(self):
+        h = Harness(16, fork="altair", real_crypto=False)
+        fabric = NetworkFabric()
+        boot = BootNode(fabric, fork_digest=b"\xde\xad\xbe\xef")
+        rpc = fabric.rpc.join("loner")
+        d = Discovery(rpc, Enr(peer_id="loner"),
+                      fork_digest=b"\x01\x02\x03\x04")
+        # bootnode answers, but its record is on another fork: lookup
+        # must not adopt nodes with a different digest
+        d.ping(boot.peer_id)
+        found = d.lookup()
+        assert all(e.fork_digest == d.enr.fork_digest or e.peer_id == "loner"
+                   for e in found)
+
+    def test_ping_failure_evicts(self):
+        fabric = NetworkFabric()
+        rpc = fabric.rpc.join("solo")
+        d = Discovery(rpc, Enr(peer_id="solo"))
+        ghost = Enr(peer_id="ghost")
+        d.table.insert(ghost)
+        assert len(d.table) == 1
+        assert d.ping("ghost") is None
+        assert len(d.table) == 0

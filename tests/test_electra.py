@@ -1,0 +1,305 @@
+"""Electra fork tests: containers, transition, churn, requests,
+consolidations, pending queues (reference electra support —
+consensus/types + state_processing Electra arms)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    misc,
+    state_transition,
+)
+from lighthouse_tpu.state_transition import electra as el
+from lighthouse_tpu.state_transition.block_processing import (
+    BulkVerifier,
+    get_attesting_indices,
+)
+from lighthouse_tpu.testing import Harness, interop_secret_key
+
+
+def _extend(h, n=1):
+    for _ in range(n):
+        atts = [h.attest()] if int(h.state.slot) > 0 else []
+        signed = h.produce_block(attestations=atts)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+
+
+class TestElectraChain:
+    def test_chain_extends_with_electra_attestations(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        _extend(h, 2 * h.spec.slots_per_epoch)
+        assert int(h.state.slot) == 2 * h.spec.slots_per_epoch
+        # participation accrued through committee-bits attestations
+        assert int(h.state.previous_epoch_participation.sum()) > 0
+
+    def test_real_crypto_block_verifies(self):
+        h = Harness(16, fork="electra", real_crypto=True)
+        _extend(h, 2)
+        assert int(h.state.slot) == 2
+
+    def test_deneb_to_electra_fork_transition(self):
+        spec = T.ChainSpec.minimal().with_forks_at(0, through="electra")
+        from dataclasses import replace
+
+        spec = replace(spec, electra_fork_epoch=1)
+        h = Harness(16, spec=spec, fork="deneb", real_crypto=False)
+        _extend(h, h.spec.slots_per_epoch - 1)
+        assert type(h.state).__name__ == "BeaconStateDeneb"
+        h.fork = "electra"  # harness produces electra blocks from here
+        _extend(h, 2)
+        assert type(h.state).__name__ == "BeaconStateElectra"
+        assert int(h.state.deposit_requests_start_index) == \
+            el.UNSET_DEPOSIT_REQUESTS_START_INDEX
+        assert bytes(h.state.fork.current_version) == \
+            spec.fork_version("electra")
+
+    def test_upgrade_requeues_pre_activation_deposits(self):
+        spec = T.ChainSpec.minimal().with_forks_at(0, through="deneb")
+        from dataclasses import replace
+
+        spec = replace(spec, electra_fork_epoch=1)
+        h = Harness(16, spec=spec, fork="deneb", real_crypto=False)
+        st = h.state
+        # a deposited-but-not-activated validator at upgrade time
+        st.validators.append(
+            pubkey=b"\xaa" * 48,
+            withdrawal_credentials=b"\x01" + b"\x00" * 31,
+            effective_balance=32 * 10**9,
+            activation_eligibility_epoch=T.FAR_FUTURE_EPOCH,
+            activation_epoch=T.FAR_FUTURE_EPOCH,
+            exit_epoch=T.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=T.FAR_FUTURE_EPOCH)
+        st.balances = np.append(st.balances, np.uint64(32 * 10**9))
+        st.previous_epoch_participation = np.append(
+            st.previous_epoch_participation, np.uint8(0))
+        st.current_epoch_participation = np.append(
+            st.current_epoch_participation, np.uint8(0))
+        st.inactivity_scores = np.append(
+            st.inactivity_scores, np.uint64(0))
+        from lighthouse_tpu.state_transition import state_advance
+
+        state_advance(st, spec, spec.slots_per_epoch)  # cross the fork
+        assert type(st).__name__ == "BeaconStateElectra"
+        new_idx = len(st.validators) - 1
+        # full balance re-queued, validator reset
+        assert int(st.balances[new_idx]) == 0
+        assert int(st.validators.effective_balance[new_idx]) == 0
+        assert any(int(d.index) == new_idx
+                   and int(d.amount) == 32 * 10**9
+                   for d in st.pending_balance_deposits)
+        assert int(st.exit_balance_to_consume) > 0
+
+
+class TestAttestingIndices:
+    def test_committee_bits_union(self):
+        h = Harness(32, fork="electra", real_crypto=False)
+        _extend(h, 1)
+        att = h.attest(committee_index=0)
+        idxs = get_attesting_indices(h.state, h.spec, att)
+        committee = misc.get_beacon_committee(
+            h.state, h.spec, int(att.data.slot), 0)
+        assert set(int(i) for i in idxs) == set(int(i) for i in committee)
+
+
+class TestChurn:
+    def _state(self, n=16):
+        h = Harness(n, fork="electra", real_crypto=False)
+        return h, h.state
+
+    def test_balance_churn_limits(self):
+        h, st = self._state()
+        churn = el.get_balance_churn_limit(st, h.spec)
+        assert churn % h.spec.effective_balance_increment == 0
+        assert el.get_activation_exit_churn_limit(st, h.spec) <= churn
+
+    def test_exit_epoch_accumulates_balance(self):
+        h, st = self._state()
+        first = el.compute_exit_epoch_and_update_churn(
+            st, h.spec, 32 * 10**9)
+        # drain the churn with a huge exit: epoch must move out
+        later = el.compute_exit_epoch_and_update_churn(
+            st, h.spec, 10_000 * 10**9)
+        assert later >= first
+
+    def test_electra_exit_uses_balance_churn(self):
+        h, st = self._state()
+        el.initiate_validator_exit_electra(st, h.spec, 3)
+        assert int(st.validators.exit_epoch[3]) != T.FAR_FUTURE_EPOCH
+        assert int(st.validators.withdrawable_epoch[3]) == \
+            int(st.validators.exit_epoch[3]) + \
+            h.spec.min_validator_withdrawability_delay
+
+
+class TestDepositRequests:
+    def test_deposit_request_sets_start_index_and_queues(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        sk = interop_secret_key(40)
+        pk = sk.public_key().to_bytes()
+        creds = b"\x01" + b"\x00" * 11 + b"\x22" * 20
+        msg = T.DepositMessage(
+            pubkey=pk, withdrawal_credentials=creds, amount=32 * 10**9)
+        domain = misc.compute_domain(
+            h.spec.domain_deposit, h.spec.genesis_fork_version, b"\x00" * 32)
+        sig = sk.sign(misc.compute_signing_root(
+            msg.hash_tree_root(), domain)).to_bytes()
+        req = T.DepositRequest(
+            pubkey=pk, withdrawal_credentials=creds, amount=32 * 10**9,
+            signature=sig, index=0)
+        n_before = len(h.state.validators)
+        el.process_deposit_request(h.state, h.spec, req)
+        assert int(h.state.deposit_requests_start_index) == 0
+        assert len(h.state.validators) == n_before + 1
+        # balance waits in the pending queue
+        assert int(h.state.balances[-1]) == 0
+        assert len(h.state.pending_balance_deposits) == 1
+
+    def test_pending_deposit_applied_with_churn(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        h.state.pending_balance_deposits = [
+            T.PendingBalanceDeposit(index=2, amount=5 * 10**9)]
+        before = int(h.state.balances[2])
+        el.process_pending_balance_deposits(h.state, h.spec)
+        assert int(h.state.balances[2]) == before + 5 * 10**9
+        assert len(h.state.pending_balance_deposits) == 0
+        assert int(h.state.deposit_balance_to_consume) == 0
+
+    def test_oversized_deposit_waits(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        huge = 10**15  # way past the churn budget
+        h.state.pending_balance_deposits = [
+            T.PendingBalanceDeposit(index=2, amount=huge)]
+        before = int(h.state.balances[2])
+        el.process_pending_balance_deposits(h.state, h.spec)
+        assert int(h.state.balances[2]) == before
+        assert len(h.state.pending_balance_deposits) == 1
+        # the unused budget carries over
+        assert int(h.state.deposit_balance_to_consume) > 0
+
+
+class TestWithdrawalRequests:
+    def _mature(self, h):
+        # age the validator set past the shard committee period
+        h.state.slot = h.spec.compute_start_slot_at_epoch(
+            h.spec.shard_committee_period)
+
+    def test_full_exit_request(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        self._mature(h)
+        st = h.state
+        creds = b"\x01" + b"\x00" * 11 + b"\x33" * 20
+        st.validators.withdrawal_credentials[4] = np.frombuffer(
+            creds, np.uint8)
+        req = T.ExecutionLayerWithdrawalRequest(
+            source_address=creds[12:],
+            validator_pubkey=st.validators.pubkeys[4].tobytes(),
+            amount=0)
+        el.process_withdrawal_request(st, h.spec, req)
+        assert int(st.validators.exit_epoch[4]) != T.FAR_FUTURE_EPOCH
+
+    def test_wrong_source_address_ignored(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        self._mature(h)
+        st = h.state
+        creds = b"\x01" + b"\x00" * 11 + b"\x33" * 20
+        st.validators.withdrawal_credentials[4] = np.frombuffer(
+            creds, np.uint8)
+        req = T.ExecutionLayerWithdrawalRequest(
+            source_address=b"\x99" * 20,
+            validator_pubkey=st.validators.pubkeys[4].tobytes(),
+            amount=0)
+        el.process_withdrawal_request(st, h.spec, req)
+        assert int(st.validators.exit_epoch[4]) == T.FAR_FUTURE_EPOCH
+
+    def test_partial_withdrawal_for_compounding(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        self._mature(h)
+        st = h.state
+        creds = b"\x02" + b"\x00" * 11 + b"\x44" * 20
+        st.validators.withdrawal_credentials[5] = np.frombuffer(
+            creds, np.uint8)
+        st.balances[5] = 40 * 10**9  # 8 ETH over the 32 minimum
+        req = T.ExecutionLayerWithdrawalRequest(
+            source_address=creds[12:],
+            validator_pubkey=st.validators.pubkeys[5].tobytes(),
+            amount=5 * 10**9)
+        el.process_withdrawal_request(st, h.spec, req)
+        assert int(st.validators.exit_epoch[5]) == T.FAR_FUTURE_EPOCH
+        assert len(st.pending_partial_withdrawals) == 1
+        w = st.pending_partial_withdrawals[0]
+        assert int(w.amount) == 5 * 10**9
+
+
+class TestConsolidations:
+    def test_signed_consolidation_processed(self):
+        from dataclasses import replace
+
+        # a small interop set has zero consolidation churn (balance churn
+        # == activation churn); widen the gap so the op is admissible
+        spec = replace(
+            T.ChainSpec.minimal().with_forks_at(0, through="electra"),
+            min_per_epoch_churn_limit_electra=256 * 10**9,
+            max_per_epoch_activation_exit_churn_limit=128 * 10**9)
+        h = Harness(16, spec=spec, fork="electra", real_crypto=True)
+        st = h.state
+        spec = h.spec
+        for i in (2, 3):
+            creds = b"\x01" + b"\x00" * 11 + b"\x55" * 20
+            st.validators.withdrawal_credentials[i] = np.frombuffer(
+                creds, np.uint8)
+        msg = T.Consolidation(source_index=2, target_index=3, epoch=0)
+        domain = misc.compute_domain(
+            spec.domain_consolidation, spec.genesis_fork_version,
+            bytes(st.genesis_validators_root))
+        root = misc.compute_signing_root(msg.hash_tree_root(), domain)
+        sig = bls.Signature.aggregate(
+            [h.sk(2).sign(root), h.sk(3).sign(root)])
+        signed = T.SignedConsolidation(
+            message=msg, signature=sig.to_bytes())
+        v = BulkVerifier()
+        el.process_consolidation(
+            st, spec, signed, SignatureStrategy.VERIFY_BULK, v)
+        assert v.verify()
+        assert int(st.validators.exit_epoch[2]) != T.FAR_FUTURE_EPOCH
+        assert len(st.pending_consolidations) == 1
+
+    def test_pending_consolidation_moves_balance(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        st = h.state
+        for i in (2, 3):
+            creds = b"\x01" + b"\x00" * 11 + b"\x55" * 20
+            st.validators.withdrawal_credentials[i] = np.frombuffer(
+                creds, np.uint8)
+        st.validators.withdrawable_epoch[2] = 0  # matured
+        st.pending_consolidations = [
+            T.PendingConsolidation(source_index=2, target_index=3)]
+        src_bal = int(st.balances[2])
+        tgt_bal = int(st.balances[3])
+        el.process_pending_consolidations(st, h.spec)
+        assert len(st.pending_consolidations) == 0
+        # target switched to compounding; excess above 32 ETH queued
+        assert el.has_compounding_withdrawal_credential(
+            st.validators.withdrawal_credentials[3])
+        moved = min(src_bal, h.spec.min_activation_balance)
+        assert int(st.balances[2]) == src_bal - moved
+        total_target = (int(st.balances[3])
+                        + sum(int(d.amount)
+                              for d in st.pending_balance_deposits
+                              if int(d.index) == 3))
+        assert total_target == tgt_bal + moved
+
+
+class TestEffectiveBalances:
+    def test_compounding_ceiling(self):
+        h = Harness(16, fork="electra", real_crypto=False)
+        st = h.state
+        creds = b"\x02" + b"\x00" * 11 + b"\x66" * 20
+        st.validators.withdrawal_credentials[1] = np.frombuffer(
+            creds, np.uint8)
+        st.balances[1] = 100 * 10**9
+        st.balances[2] = 100 * 10**9  # non-compounding stays capped at 32
+        el.process_effective_balance_updates_electra(st, h.spec)
+        assert int(st.validators.effective_balance[1]) == 100 * 10**9
+        assert int(st.validators.effective_balance[2]) == 32 * 10**9

@@ -1,0 +1,53 @@
+"""System health observation + monitoring poster tests."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from lighthouse_tpu.common.system_health import (
+    MonitoringService,
+    observe_system_health,
+)
+
+
+class TestSystemHealth:
+    def test_observation_populated(self):
+        h = observe_system_health()
+        assert h.total_memory_kb > 0
+        assert h.cpu_cores >= 1
+        assert h.disk_total_kb > 0
+        assert h.uptime_s > 0
+
+
+class TestMonitoring:
+    def test_post_roundtrip(self):
+        received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        srv = HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            mon = MonitoringService(
+                f"http://127.0.0.1:{srv.server_port}/metrics")
+            assert mon.post_once()
+            assert mon.last_post_ok
+            assert received[0]["system"]["cpu_cores"] >= 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_dead_endpoint_degrades(self):
+        mon = MonitoringService("http://127.0.0.1:1/metrics", timeout=0.2)
+        assert not mon.post_once()
+        assert mon.last_post_ok is False
