@@ -1,4 +1,4 @@
-"""Inversion-free projective Miller loop with sparse line evaluation.
+r"""Inversion-free projective Miller loop with sparse line evaluation.
 
 This is the algorithm the batched device backend implements
 (lighthouse_tpu/ops/bls12_381.py); it lives here in scalar pure Python as

@@ -148,7 +148,7 @@ def _msm_host(points, scalars):
 _MSM_JIT = None  # jax.jit caches per input shape internally
 
 
-def _msm_device(points, scalars):
+def _msm_device(points, scalars, pad_to: int | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -158,6 +158,8 @@ def _msm_device(points, scalars):
 
     n = len(points)
     padded = 1 << max(n - 1, 0).bit_length()
+    if pad_to is not None:
+        padded = max(padded, pad_to)  # share one compiled MSM shape
     # infinity inputs get zero scalars (identity lanes)
     xs, ys, ks = [], [], []
     for p, k in zip(points, scalars):
@@ -183,12 +185,14 @@ def _msm_device(points, scalars):
     return (x * zi * zi % P, y * pow(zi, 3, P) % P)
 
 
-def g1_lincomb(points, scalars, *, device: bool | None = None):
-    """Σ k_i·P_i (the c-kzg g1_lincomb seam)."""
+def g1_lincomb(points, scalars, *, device: bool | None = None,
+               pad_to: int | None = None):
+    """Σ k_i·P_i (the c-kzg g1_lincomb seam).  `pad_to` rounds the lane
+    count up so differently-sized MSMs share one compiled program."""
     use_device = (device if device is not None
                   else len(points) >= _DEVICE_MSM_MIN)
     if use_device:
-        return _msm_device(points, scalars)
+        return _msm_device(points, scalars, pad_to=pad_to)
     return _msm_host(points, scalars)
 
 
@@ -316,6 +320,27 @@ def verify_blob_kzg_proof(blob: bytes, commitment_bytes: bytes,
     return verify_kzg_proof_impl(c, z, y, pi, settings)
 
 
+# below this many blobs the device round-trip is not worth it
+_DEVICE_EVAL_MIN = 8
+
+
+def _evaluate_polynomials(polys, zs, blobs, settings) -> list[int]:
+    """All blobs' barycentric evaluations; large batches run as one
+    device dispatch over every (blob, root) lane (ops/fr.py), small ones
+    on host."""
+    if len(polys) < _DEVICE_EVAL_MIN:
+        return [evaluate_polynomial_in_evaluation_form(p, z, settings)
+                for p, z in zip(polys, zs)]
+    import numpy as np
+
+    from lighthouse_tpu.ops import fr
+
+    raw = np.frombuffer(b"".join(blobs), np.uint8).reshape(
+        len(blobs), settings.width, 32)
+    limbs = fr.be32_bytes_to_limbs(raw)
+    return fr.evaluate_polynomials_batch(limbs, zs, settings.roots_brp)
+
+
 def verify_blob_kzg_proof_batch(
     blobs: list[bytes], commitment_bytes_list: list[bytes],
     proof_bytes_list: list[bytes], settings: KzgSettings
@@ -337,11 +362,9 @@ def verify_blob_kzg_proof_batch(
         polys = [blob_to_polynomial(b, settings) for b in blobs]
     except (ValueError, KzgError):
         return False
-    zs, ys = [], []
-    for blob, cb, poly in zip(blobs, commitment_bytes_list, polys):
-        z = compute_challenge(blob, cb, settings)
-        zs.append(z)
-        ys.append(evaluate_polynomial_in_evaluation_form(poly, z, settings))
+    zs = [compute_challenge(blob, cb, settings)
+          for blob, cb in zip(blobs, commitment_bytes_list)]
+    ys = _evaluate_polynomials(polys, zs, blobs, settings)
 
     # verifier-local random linear combination (domain-separated hash seed
     # + per-run entropy: r need only be unpredictable to the prover)
@@ -357,14 +380,16 @@ def verify_blob_kzg_proof_batch(
     r_pows = [pow(r, i, BLS_MODULUS) for i in range(n)]
 
     g1 = cv.g1_generator()
-    # Σ r^i·π_i  and  Σ r^i·(C_i − y_i·G1 + z_i·π_i)
-    proof_comb = g1_lincomb(pis, r_pows)
+    # Σ r^i·π_i  and  Σ r^i·(C_i − y_i·G1 + z_i·π_i); both MSMs padded to
+    # one lane count so the device compiles a single program shape
     lhs_points = cs + pis + [g1]
     lhs_scalars = list(r_pows) + [ri * z % BLS_MODULUS
                                   for ri, z in zip(r_pows, zs)]
     y_comb = sum(ri * y % BLS_MODULUS for ri, y in zip(r_pows, ys)) % BLS_MODULUS
     lhs_scalars.append((-y_comb) % BLS_MODULUS)
-    lhs = g1_lincomb(lhs_points, lhs_scalars)
+    shared_pad = 1 << max(len(lhs_points) - 1, 0).bit_length()
+    proof_comb = g1_lincomb(pis, r_pows, pad_to=shared_pad)
+    lhs = g1_lincomb(lhs_points, lhs_scalars, pad_to=shared_pad)
     # INF combinations are legal (e.g. constant blobs give zero quotients):
     # e(INF, ·) = 1, which multi_pairing_device models by masking the lane
     return _pairing_check([

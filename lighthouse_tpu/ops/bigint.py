@@ -33,6 +33,10 @@ asserts in tests/test_bigint.py):
 
 Reference counterpart: the limb arithmetic inside blst
 (/root/reference/crypto/bls/src/impls/blst.rs's FFI layer).
+
+NOTE: ops/fr.py instantiates this same construction (carry pass, REDC,
+fold, neg-const decomposition) for the 255-bit SCALAR field.  A bound or
+carry fix here almost certainly applies there too — patch both.
 """
 
 from __future__ import annotations

@@ -100,6 +100,21 @@ def _pipeline_a(pkx, pky, sxa, sxb, sya, syb, bits):
     return Xp, Yp, Zp, SX, SY, SZ
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(7,))
+def _pipeline_a_grouped(pkx, pky, sxa, sxb, sya, syb, bits, n_groups):
+    """Grouped variant: lanes are s-major over (segment, group); the G1
+    side folds per message group (Σ r_i·agg_pk_i per distinct message) so
+    the Miller loop runs one lane per GROUP, not per set."""
+    Xp, Yp, Zp = ec.g1_scalar_mul_batch(pkx, pky, bits)
+    Xg, Yg, Zg = ec.g1_segment_sum(Xp, Yp, Zp, n_groups)
+    SX, SY, SZ = ec.g2_scalar_mul_batch(sxa, sxb, sya, syb, bits)
+    SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
+    return Xg, Yg, Zg, SX, SY, SZ
+
+
 @jax.jit
 def _pipeline_b(Xp, Yp, Zp, hxa, hxb, hya, hyb,
                 g1x, g1y, sxa, sxb, sya, syb, mask):
@@ -168,25 +183,75 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
             r = secrets.randbits(RAND_BITS)
         scalars.append(r)
 
-    padded = max(4, 1 << max(n - 1, 0).bit_length())
-    pad = padded - n
+    # --- message grouping (the TPU-shaped fold): sets sharing a message
+    # satisfy Π e(r_i·pk_i, H(m)) = e(Σ r_i·pk_i, H(m)), so the expensive
+    # Miller lanes shrink from n sets to G distinct messages.  Lanes are
+    # laid out s-major over (segment, group) for g1_segment_sum; padding
+    # lanes carry zero scalars (infinity = group identity).  Guard: skew
+    # batches whose padded S·G layout would exceed twice the flat layout
+    # fall back to the ungrouped pipeline.
+    groups: dict[bytes, list[int]] = {}
+    for i, s in enumerate(sets):
+        groups.setdefault(s.message, []).append(i)
+    n_groups = len(groups)
+    max_sz = max(len(v) for v in groups.values())
+    seg = max(1, 1 << max(max_sz - 1, 0).bit_length())
+    g_pad = max(2, 1 << max(n_groups - 1, 0).bit_length())
+    padded_flat = max(4, 1 << max(n - 1, 0).bit_length())
+    use_grouped = (n_groups < n
+                   and seg * g_pad <= 2 * padded_flat)
 
-    pkx = ec.ints_to_mont_limbs([p[0] for p in agg_pks])
-    pky = ec.ints_to_mont_limbs([p[1] for p in agg_pks])
-    sg = _g2_limbs(sig_pts)
-    h2 = _g2_limbs(h2cs)
-    if pad:
-        ext = np.zeros((pad, bi.L), np.uint32)
-        pkx, pky = (np.concatenate([a, ext]) for a in (pkx, pky))
-        sg = [np.concatenate([a, ext]) for a in sg]
-        h2 = [np.concatenate([a, ext]) for a in h2]
-    # padded lanes get zero scalars -> scalar-mul leaves them at infinity,
-    # adding nothing to Σ r·sig; their Miller lanes are masked out below
-    bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
+    if use_grouped:
+        order = list(groups.values())  # group g -> member set indices
+        lane_of = np.full(seg * g_pad, -1, np.int64)
+        for g, members in enumerate(order):
+            for s_i, set_idx in enumerate(members):
+                lane_of[s_i * g_pad + g] = set_idx
 
-    Xp, Yp, Zp, SX, SY, SZ = _pipeline_a(
-        jnp.asarray(pkx), jnp.asarray(pky), *[jnp.asarray(a) for a in sg],
-        bits)
+        def scatter(rows, width=bi.L):
+            out = np.zeros((seg * g_pad, width), np.uint32)
+            src = np.nonzero(lane_of >= 0)[0]
+            out[src] = rows[lane_of[src]]
+            return out
+
+        pkx = scatter(ec.ints_to_mont_limbs([p[0] for p in agg_pks]))
+        pky = scatter(ec.ints_to_mont_limbs([p[1] for p in agg_pks]))
+        sg = [scatter(a) for a in _g2_limbs(sig_pts)]
+        lane_scalars = [0] * (seg * g_pad)
+        for lane, set_idx in enumerate(lane_of):
+            if set_idx >= 0:
+                lane_scalars[lane] = scalars[set_idx]
+        bits = jnp.asarray(ec.scalars_to_bits(lane_scalars))
+        h2 = _g2_limbs([h2cs[members[0]] for members in order])
+        ext = np.zeros((g_pad - n_groups, bi.L), np.uint32)
+        if g_pad != n_groups:
+            h2 = [np.concatenate([a, ext]) for a in h2]
+        Xp, Yp, Zp, SX, SY, SZ = _pipeline_a_grouped(
+            jnp.asarray(pkx), jnp.asarray(pky),
+            *[jnp.asarray(a) for a in sg], bits, g_pad)
+        padded = g_pad
+        n_real_lanes = n_groups
+    else:
+        pad = padded_flat - n
+        pkx = ec.ints_to_mont_limbs([p[0] for p in agg_pks])
+        pky = ec.ints_to_mont_limbs([p[1] for p in agg_pks])
+        sg = _g2_limbs(sig_pts)
+        h2 = _g2_limbs(h2cs)
+        if pad:
+            ext = np.zeros((pad, bi.L), np.uint32)
+            pkx, pky = (np.concatenate([a, ext]) for a in (pkx, pky))
+            sg = [np.concatenate([a, ext]) for a in sg]
+            h2 = [np.concatenate([a, ext]) for a in h2]
+        # padded lanes get zero scalars -> scalar-mul leaves them at
+        # infinity, adding nothing to Σ r·sig; their Miller lanes are
+        # masked out below
+        bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
+
+        Xp, Yp, Zp, SX, SY, SZ = _pipeline_a(
+            jnp.asarray(pkx), jnp.asarray(pky),
+            *[jnp.asarray(a) for a in sg], bits)
+        padded = padded_flat
+        n_real_lanes = n
 
     # host: Σ r·sig jacobian -> affine (one Fq2 inversion)
     def host_fq2(c):
@@ -206,7 +271,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         sum_affine = (sx * zi2, sy * zi2 * zi)
 
     mask = np.zeros(padded + 1, bool)
-    mask[:n] = True
+    mask[:n_real_lanes] = True
     if sum_affine is not None:
         mask[padded] = True
         sa = _g2_limbs([sum_affine])

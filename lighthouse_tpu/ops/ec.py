@@ -297,6 +297,25 @@ def g1_sum_reduce(X, Y, Z):
     return _sum_reduce(_FpAdapter, take, X, Y, Z, X.shape[0])
 
 
+def g1_segment_sum(X, Y, Z, n_segments: int):
+    """Segmented Jacobian tree-sum: lanes laid out s-major ([S*G] with
+    lane index s·G + g) reduce to one point per segment g.
+
+    The enabler for message-grouped batch verification: sets sharing a
+    message fold into Σ r_i·pk_i BEFORE the Miller loop
+    (e(Σ r_i·pk_i, H(m)) = Π e(r_i·pk_i, H(m))), shrinking the pairing
+    lane count from n sets to G distinct messages."""
+    total = X.shape[0]
+    assert total % n_segments == 0
+    S = total // n_segments
+    assert S & (S - 1) == 0, "segment size must be a power of two"
+    shape = (S, n_segments, bi.L)
+    Xr, Yr, Zr = (t.reshape(shape) for t in (X, Y, Z))
+    take = lambda t, sl: t[sl]  # noqa: E731
+    Xo, Yo, Zo = _sum_reduce(_FpAdapter, take, Xr, Yr, Zr, S)
+    return Xo[0], Yo[0], Zo[0]
+
+
 def g1_msm(xp, yp, bits):
     """Multi-scalar multiplication: Σ k_i·P_i over G1 lanes.
 

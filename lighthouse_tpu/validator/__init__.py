@@ -3,6 +3,10 @@
 
 from lighthouse_tpu.validator.client import ValidatorClient
 from lighthouse_tpu.validator.doppelganger import DoppelgangerService
+from lighthouse_tpu.validator.keymanager_api import (
+    KeymanagerApi,
+    KeymanagerServer,
+)
 from lighthouse_tpu.validator.duties import DutiesService
 from lighthouse_tpu.validator.fallback import BeaconNodeFallback
 from lighthouse_tpu.validator.remote_signer import (
@@ -19,6 +23,8 @@ __all__ = [
     "BeaconNodeFallback",
     "DoppelgangerService",
     "DutiesService",
+    "KeymanagerApi",
+    "KeymanagerServer",
     "RemoteSignerServer",
     "SlashingProtectionDB",
     "SlashingProtectionError",

@@ -1,0 +1,191 @@
+"""Validator-client keymanager HTTP API.
+
+Rebuild of /root/reference/validator_client/src/http_api/ (the standard
+eth keymanager-APIs surface the validator_manager tooling drives):
+list / import / delete local keystores, list remote keys, per-validator
+fee recipient and graffiti, and EIP-3076 slashing-protection export on
+delete.  stdlib http.server, bearer-token auth (the reference's
+api-token file), JSON envelopes.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+class KeymanagerApi:
+    def __init__(self, store, token: str | None = None):
+        self.store = store                    # ValidatorStore
+        self.token = token or secrets.token_hex(16)
+        self.fee_recipients: dict[bytes, str] = {}
+        self.graffiti: dict[bytes, str] = {}
+
+    # -- handlers ----------------------------------------------------------
+
+    def list_keystores(self):
+        return {"data": [
+            {"validating_pubkey": _hex(pk), "derivation_path": "",
+             "readonly": False}
+            for pk in self.store.voting_pubkeys()]}
+
+    def import_keystores(self, body: dict):
+        keystores = body.get("keystores", [])
+        passwords = body.get("passwords", [])
+        statuses = []
+        for ks_json, pw in zip(keystores, passwords):
+            try:
+                ks = (json.loads(ks_json) if isinstance(ks_json, str)
+                      else ks_json)
+                pk = self.store.import_keystore(ks, pw)
+                statuses.append({"status": "imported",
+                                 "message": _hex(pk)})
+            except Exception as e:  # noqa: BLE001 — per-item status
+                statuses.append({"status": "error", "message": str(e)})
+        # optional EIP-3076 import rides along (keymanager spec)
+        interchange = body.get("slashing_protection")
+        if interchange:
+            self.store.slashing_db.import_interchange(
+                json.loads(interchange) if isinstance(interchange, str)
+                else interchange)
+        return {"data": statuses}
+
+    def delete_keystores(self, body: dict):
+        pubkeys = [bytes.fromhex(p.removeprefix("0x"))
+                   for p in body.get("pubkeys", [])]
+        statuses = []
+        for pk in pubkeys:
+            v = self.store.validators.get(pk)
+            if v is None:
+                statuses.append({"status": "not_found"})
+                continue
+            del self.store.validators[pk]
+            statuses.append({"status": "deleted"})
+        # deletion MUST export the slashing-protection history for the
+        # deleted keys (keymanager spec / reference delete flow)
+        interchange = self.store.slashing_db.export_interchange()
+        interchange["data"] = [
+            r for r in interchange.get("data", [])
+            if bytes.fromhex(r["pubkey"].removeprefix("0x")) in pubkeys]
+        return {"data": statuses,
+                "slashing_protection": json.dumps(interchange)}
+
+    def get_fee_recipient(self, pubkey_hex: str):
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        addr = self.fee_recipients.get(pk)
+        if addr is None:
+            return None
+        return {"data": {"pubkey": _hex(pk), "ethaddress": addr}}
+
+    def set_fee_recipient(self, pubkey_hex: str, body: dict):
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        self.fee_recipients[pk] = body["ethaddress"]
+        return {}
+
+    def get_graffiti(self, pubkey_hex: str):
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        return {"data": {"pubkey": _hex(pk),
+                         "graffiti": self.graffiti.get(pk, "")}}
+
+    def set_graffiti(self, pubkey_hex: str, body: dict):
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        self.graffiti[pk] = body["graffiti"]
+        return {}
+
+
+class KeymanagerServer:
+    def __init__(self, api: KeymanagerApi, port: int = 0):
+        self.api = api
+        self.port = port
+        self._srv = None
+        self._thread = None
+
+    def start(self) -> "KeymanagerServer":
+        api = self.api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {api.token}"
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self, method: str):
+                if not self._authed():
+                    return self._reply(401, {"message": "unauthorized"})
+                path = self.path.rstrip("/")
+                try:
+                    if path == "/eth/v1/keystores":
+                        if method == "GET":
+                            return self._reply(200, api.list_keystores())
+                        if method == "POST":
+                            return self._reply(
+                                200, api.import_keystores(self._body()))
+                        if method == "DELETE":
+                            return self._reply(
+                                200, api.delete_keystores(self._body()))
+                    if path.startswith("/eth/v1/validator/"):
+                        parts = path.split("/")
+                        pk, leaf = parts[4], parts[5]
+                        if leaf == "feerecipient":
+                            if method == "GET":
+                                out = api.get_fee_recipient(pk)
+                                return self._reply(
+                                    200 if out else 404,
+                                    out or {"message": "not found"})
+                            if method == "POST":
+                                return self._reply(
+                                    202, api.set_fee_recipient(
+                                        pk, self._body()))
+                        if leaf == "graffiti":
+                            if method == "GET":
+                                return self._reply(200, api.get_graffiti(pk))
+                            if method == "POST":
+                                return self._reply(
+                                    202, api.set_graffiti(pk, self._body()))
+                except Exception as e:  # noqa: BLE001 — API boundary
+                    return self._reply(400, {"message": str(e)})
+                return self._reply(404, {"message": "unknown route"})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._srv.server_port
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+__all__ = ["KeymanagerApi", "KeymanagerServer"]

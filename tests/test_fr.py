@@ -1,0 +1,73 @@
+"""Scalar-field (Fr) device arithmetic + KZG barycentric evaluation
+(ops/fr.py) against independent Python big-int oracles."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import fr
+
+R = fr.R_INT
+
+
+@pytest.fixture(scope="module")
+def rand_pairs():
+    a = [secrets.randbelow(R) for _ in range(16)]
+    b = [secrets.randbelow(R) for _ in range(16)]
+    return a, b, jnp.asarray(fr.to_mont_host(a)), jnp.asarray(
+        fr.to_mont_host(b))
+
+
+class TestFieldOps:
+    def test_mont_mul(self, rand_pairs):
+        a, b, am, bm = rand_pairs
+        got = fr.from_mont_host(np.asarray(jax.jit(fr.mont_mul)(am, bm)))
+        assert all(int(g) == x * y % R for g, x, y in zip(got, a, b))
+
+    def test_add_sub(self, rand_pairs):
+        a, b, am, bm = rand_pairs
+        gs = fr.from_mont_host(np.asarray(jax.jit(fr.add)(am, bm)))
+        gd = fr.from_mont_host(np.asarray(jax.jit(fr.sub)(am, bm)))
+        assert all(int(g) == (x + y) % R for g, x, y in zip(gs, a, b))
+        assert all(int(g) == (x - y) % R for g, x, y in zip(gd, a, b))
+
+    def test_fermat_inverse(self, rand_pairs):
+        a, _, am, _ = rand_pairs
+        inv = fr.from_mont_host(np.asarray(jax.jit(fr.inv_mont)(am)))
+        assert all(int(g) == pow(x, -1, R) for g, x in zip(inv, a))
+
+    def test_edge_values(self):
+        vals = [0, 1, R - 1, R - 2, 2**254]
+        vm = jnp.asarray(fr.to_mont_host(vals))
+        sq = fr.from_mont_host(np.asarray(jax.jit(fr.mont_mul)(vm, vm)))
+        assert all(int(g) == v * v % R for g, v in zip(sq, vals))
+
+    def test_bytes_to_limbs(self):
+        raw = np.stack([
+            np.frombuffer(secrets.randbelow(R).to_bytes(32, "big"), np.uint8)
+            for _ in range(6)])
+        limbs = fr.be32_bytes_to_limbs(raw)
+        for row, lb in zip(raw, limbs):
+            assert fr._limbs_to_int(lb) == int.from_bytes(
+                row.tobytes(), "big")
+
+
+class TestBarycentricEval:
+    def test_matches_host_oracle_incl_root_hit(self):
+        from lighthouse_tpu.crypto import kzg
+
+        settings = kzg.KzgSettings.dev(width=8)
+        N = 5
+        polys = [[secrets.randbelow(R) for _ in range(8)] for _ in range(N)]
+        zs = [secrets.randbelow(R) for _ in range(N - 1)]
+        zs.append(settings.roots_brp[2])  # degenerate z == root case
+        want = [kzg.evaluate_polynomial_in_evaluation_form(p, z, settings)
+                for p, z in zip(polys, zs)]
+        raw = np.stack(
+            [np.stack([fr._int_to_limbs(v) for v in p]) for p in polys])
+        got = fr.evaluate_polynomials_batch(raw, zs, settings.roots_brp)
+        assert got == want

@@ -1,0 +1,95 @@
+"""VC keymanager API tests (reference validator_client/src/http_api/)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.crypto import bls, keystore as ks
+from lighthouse_tpu.validator import ValidatorStore
+from lighthouse_tpu.validator.keymanager_api import (
+    KeymanagerApi,
+    KeymanagerServer,
+)
+from lighthouse_tpu.testing import Harness
+
+
+@pytest.fixture()
+def km():
+    h = Harness(8, real_crypto=False)
+    store = ValidatorStore(
+        h.spec, bytes(h.state.genesis_validators_root))
+    api = KeymanagerApi(store)
+    server = KeymanagerServer(api).start()
+    yield h, store, api, server
+    server.stop()
+
+
+def _call(server, api, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Authorization": f"Bearer {token or api.token}",
+                 "Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+class TestKeymanager:
+    def test_auth_required(self, km):
+        h, store, api, server = km
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, api, "GET", "/eth/v1/keystores", token="wrong")
+        assert e.value.code == 401
+
+    def test_import_list_delete_roundtrip(self, km):
+        h, store, api, server = km
+        secret = bls.SecretKey.generate().to_bytes()
+        keystore = ks.encrypt(secret, "pw", kdf="pbkdf2")
+        out = _call(server, api, "POST", "/eth/v1/keystores",
+                    {"keystores": [keystore], "passwords": ["pw"]})
+        assert out["data"][0]["status"] == "imported"
+        listed = _call(server, api, "GET", "/eth/v1/keystores")
+        assert len(listed["data"]) == 1
+        pk_hex = listed["data"][0]["validating_pubkey"]
+        out = _call(server, api, "DELETE", "/eth/v1/keystores",
+                    {"pubkeys": [pk_hex]})
+        assert out["data"][0]["status"] == "deleted"
+        assert "slashing_protection" in out
+        assert _call(server, api, "GET", "/eth/v1/keystores")["data"] == []
+
+    def test_delete_exports_slashing_history(self, km):
+        h, store, api, server = km
+        sk = bls.SecretKey.generate()
+        pk = store.add_validator(sk)
+        # sign a block so the history is non-empty
+        blk = type("B", (), {"slot": 5, "hash_tree_root":
+                             staticmethod(lambda: b"\x11" * 32)})()
+        store.sign_block(pk, blk)
+        out = _call(server, api, "DELETE", "/eth/v1/keystores",
+                    {"pubkeys": ["0x" + pk.hex()]})
+        interchange = json.loads(out["slashing_protection"])
+        assert any(
+            r["pubkey"].removeprefix("0x") == pk.hex()
+            for r in interchange["data"])
+
+    def test_fee_recipient_and_graffiti(self, km):
+        h, store, api, server = km
+        pk = store.add_validator(bls.SecretKey.generate())
+        pk_hex = "0x" + pk.hex()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, api, "GET",
+                  f"/eth/v1/validator/{pk_hex}/feerecipient")
+        assert e.value.code == 404
+        _call(server, api, "POST",
+              f"/eth/v1/validator/{pk_hex}/feerecipient",
+              {"ethaddress": "0x" + "ab" * 20})
+        got = _call(server, api, "GET",
+                    f"/eth/v1/validator/{pk_hex}/feerecipient")
+        assert got["data"]["ethaddress"] == "0x" + "ab" * 20
+        _call(server, api, "POST", f"/eth/v1/validator/{pk_hex}/graffiti",
+              {"graffiti": "hello"})
+        got = _call(server, api, "GET",
+                    f"/eth/v1/validator/{pk_hex}/graffiti")
+        assert got["data"]["graffiti"] == "hello"
