@@ -46,11 +46,14 @@ class Client:
     processor: object | None = None
     network: object | None = None
     services: dict = field(default_factory=dict)
+    lockfile: object | None = None
 
     def stop(self) -> None:
         if self.http_server is not None:
             self.http_server.stop()
         self.executor.shutdown("client stop")
+        if self.lockfile is not None:
+            self.lockfile.release()
 
 
 class ClientBuilder:
@@ -64,6 +67,7 @@ class ClientBuilder:
         self._el = None
         self._eth1 = None
         self._anchor_block = None
+        self._lockfile = None
 
     # -- stages (each returns self, builder-style) ------------------------
 
@@ -157,6 +161,12 @@ class ClientBuilder:
         store = None
         if self.config.datadir:
             os.makedirs(self.config.datadir, exist_ok=True)
+            # exclusive datadir ownership: two nodes sharing one DB would
+            # corrupt it (reference common/lockfile)
+            from lighthouse_tpu.common.utils import Lockfile
+
+            self._lockfile = Lockfile(
+                os.path.join(self.config.datadir, "beacon.lock")).acquire()
             store = HotColdDB(
                 self.spec,
                 hot=NativeKVStore(
@@ -180,6 +190,17 @@ class ClientBuilder:
         return self
 
     def build(self) -> Client:
+        try:
+            return self._build()
+        except Exception:
+            # a failed assembly must not leave the datadir locked against
+            # the caller's own retry
+            if self._lockfile is not None:
+                self._lockfile.release()
+                self._lockfile = None
+            raise
+
+    def _build(self) -> Client:
         from lighthouse_tpu.processor import BeaconProcessor
 
         if self.spec is None:
@@ -193,7 +214,8 @@ class ClientBuilder:
         if self.chain is None:
             self.beacon_chain()
 
-        client = Client(self.config, self.spec, self.chain, self.executor)
+        client = Client(self.config, self.spec, self.chain, self.executor,
+                        lockfile=self._lockfile)
         client.processor = BeaconProcessor()
 
         if self.config.http_enabled:

@@ -89,11 +89,71 @@ def load_network_config(path: str) -> T.ChainSpec:
     return spec_from_config_dict(cfg)
 
 
+def _holesky() -> T.ChainSpec:
+    """Public Holesky testnet constants (reference
+    built_in_network_configs/holesky/config.yaml)."""
+    return dataclasses.replace(
+        T.ChainSpec.mainnet(),
+        config_name="holesky",
+        min_genesis_active_validator_count=16384,
+        min_genesis_time=1695902100,
+        genesis_delay=300,
+        genesis_fork_version=bytes.fromhex("01017000"),
+        altair_fork_version=bytes.fromhex("02017000"),
+        altair_fork_epoch=0,
+        bellatrix_fork_version=bytes.fromhex("03017000"),
+        bellatrix_fork_epoch=0,
+        capella_fork_version=bytes.fromhex("04017000"),
+        capella_fork_epoch=256,
+        deneb_fork_version=bytes.fromhex("05017000"),
+        deneb_fork_epoch=29696,
+        electra_fork_version=bytes.fromhex("06017000"),
+        # unscheduled at the reference snapshot (config.yaml pins
+        # FAR_FUTURE); operators on live networks override via
+        # --network-config with the scheduled epoch
+        electra_fork_epoch=T.FAR_FUTURE_EPOCH,
+        ejection_balance=28_000_000_000,
+        deposit_chain_id=17000,
+        deposit_network_id=17000,
+        deposit_contract_address=bytes.fromhex(
+            "4242424242424242424242424242424242424242"),
+    )
+
+
+def _sepolia() -> T.ChainSpec:
+    """Public Sepolia testnet constants (reference
+    built_in_network_configs/sepolia/config.yaml)."""
+    return dataclasses.replace(
+        T.ChainSpec.mainnet(),
+        config_name="sepolia",
+        min_genesis_active_validator_count=1300,
+        min_genesis_time=1655647200,
+        genesis_delay=86400,
+        genesis_fork_version=bytes.fromhex("90000069"),
+        altair_fork_version=bytes.fromhex("90000070"),
+        altair_fork_epoch=50,
+        bellatrix_fork_version=bytes.fromhex("90000071"),
+        bellatrix_fork_epoch=100,
+        capella_fork_version=bytes.fromhex("90000072"),
+        capella_fork_epoch=56832,
+        deneb_fork_version=bytes.fromhex("90000073"),
+        deneb_fork_epoch=132608,
+        electra_fork_version=bytes.fromhex("90000074"),
+        electra_fork_epoch=T.FAR_FUTURE_EPOCH,  # unscheduled at snapshot
+        deposit_chain_id=11155111,
+        deposit_network_id=11155111,
+        deposit_contract_address=bytes.fromhex(
+            "7f02C3E3c98b133055B8B348B2Ac625669Ed295D"),
+    )
+
+
 # Built-in networks (reference built_in_network_configs/): the spec values
 # the client can run without external files.
 _BUILT_IN = {
     "mainnet": lambda: T.ChainSpec.mainnet(),
     "minimal": lambda: T.ChainSpec.minimal(),
+    "holesky": _holesky,
+    "sepolia": _sepolia,
     # devnet: minimal preset with all forks from genesis — the config the
     # in-process simulator and tests run
     "devnet": lambda: T.ChainSpec.minimal().with_forks_at(

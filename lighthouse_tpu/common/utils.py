@@ -1,0 +1,249 @@
+"""Small shared utilities mirroring the reference's glue crates.
+
+- `LruCache`        — /root/reference/common/lru_cache (time/space-bounded;
+                      bounds the hash-to-curve memo in ops/bls_backend.py)
+- `OneshotBroadcast`— /root/reference/common/oneshot_broadcast (one sender,
+                      many waiters — the reference's concurrent-state-load
+                      dedup primitive, offered for the same pattern here)
+- `Lockfile`        — /root/reference/common/lockfile (exclusive datadir
+                      ownership; wired into client/builder.py)
+- `SensitiveUrl`    — /root/reference/common/sensitive_url (URLs whose
+                      userinfo/keys must never reach logs; engine-API repr)
+- `compare_fields`  — /root/reference/common/compare_fields(_derive):
+                      field-by-field state diff for test debugging
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from urllib.parse import urlparse, urlunparse
+
+import numpy as np
+
+
+class LruCache:
+    """Size-bounded LRU with optional per-entry TTL."""
+
+    def __init__(self, capacity: int, ttl_s: float | None = None,
+                 clock=time.monotonic):
+        self.capacity = capacity
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            item = self._d.get(key)
+            if item is None:
+                return None
+            value, ts = item
+            if self.ttl_s is not None and self.clock() - ts > self.ttl_s:
+                del self._d[key]
+                return None
+            self._d.move_to_end(key)
+            return value
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = (value, self.clock())
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class OneshotBroadcast:
+    """One sender, many receivers: receivers block until `send` fires.
+    The reference uses this to collapse concurrent loads of the same
+    state into one computation."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+
+    def send(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def recv(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("oneshot sender dropped/never fired")
+        return self._value
+
+
+class LockfileError(RuntimeError):
+    pass
+
+
+class Lockfile:
+    """Exclusive ownership of a datadir (reference lockfile behavior).
+
+    Race-safe construction: the pid file is created ATOMICALLY with its
+    content via link(tempfile, lock) — the lock can never be observed
+    empty — and a stale (dead-pid) lock is reclaimed by an atomic rename
+    to a unique name, so exactly one of several concurrent reclaimers
+    wins; the losers re-enter the acquisition loop and see the winner's
+    fresh, live lock."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._acquired = False
+
+    def acquire(self, retries: int = 16) -> "Lockfile":
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            for _ in range(retries):
+                try:
+                    os.link(tmp, self.path)
+                    self._acquired = True
+                    return self
+                except FileExistsError:
+                    pass
+                holder = self._holder_pid()
+                if holder is not None and self._pid_alive(holder):
+                    raise LockfileError(
+                        f"datadir locked by live pid {holder} "
+                        f"({self.path})")
+                # stale: atomically claim the corpse; only one
+                # concurrent reclaimer's rename succeeds
+                corpse = f"{self.path}.stale.{os.getpid()}"
+                try:
+                    os.rename(self.path, corpse)
+                    os.unlink(corpse)
+                except FileNotFoundError:
+                    pass  # another reclaimer won; just retry
+            raise LockfileError(
+                f"could not acquire {self.path} after {retries} attempts")
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+
+    def _holder_pid(self) -> int | None:
+        try:
+            with open(self.path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+
+    def release(self) -> None:
+        if not self._acquired:
+            return  # never ours: do NOT delete a live holder's lock
+        self._acquired = False
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class SensitiveUrl:
+    """A URL whose credentials must never be logged: `str()` and repr
+    redact userinfo and everything after the host; `.full` is the only
+    accessor for the real URL."""
+
+    def __init__(self, url: str):
+        self.full = url
+        p = urlparse(url)
+        host = p.hostname or ""
+        port = f":{p.port}" if p.port else ""
+        self._redacted = urlunparse(
+            (p.scheme, f"{host}{port}", "", "", "", ""))
+
+    def __str__(self) -> str:
+        return self._redacted
+
+    def __repr__(self) -> str:
+        return f"SensitiveUrl({self._redacted})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SensitiveUrl) and self.full == other.full
+
+    def __hash__(self) -> int:
+        return hash(self.full)
+
+
+def compare_fields(a, b, prefix: str = "") -> list[str]:
+    """Field-by-field diff of two SSZ containers / registries; returns
+    human-readable difference paths (reference compare_fields derive,
+    used to debug state mismatches in tests)."""
+    diffs: list[str] = []
+    fields = getattr(type(a), "fields", None)
+    if fields is None or type(a) is not type(b):
+        if not _values_equal(a, b):
+            diffs.append(f"{prefix or 'value'}: {a!r} != {b!r}")
+        return diffs
+    for name in fields:
+        va, vb = getattr(a, name), getattr(b, name)
+        path = f"{prefix}.{name}" if prefix else name
+        if getattr(type(va), "fields", None) is not None \
+                and type(va) is type(vb):
+            diffs.extend(compare_fields(va, vb, path))
+        elif not _values_equal(va, vb):
+            diffs.append(_describe(path, va, vb))
+    return diffs
+
+
+def _values_equal(a, b) -> bool:
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a_arr, b_arr = np.asarray(a), np.asarray(b)
+        return a_arr.shape == b_arr.shape and bool((a_arr == b_arr).all())
+    if hasattr(a, "hash_tree_root") and hasattr(b, "hash_tree_root"):
+        return a.hash_tree_root() == b.hash_tree_root()
+    try:
+        return bool(a == b)
+    except Exception:
+        return a is b
+
+
+def _describe(path: str, a, b) -> str:
+    if isinstance(a, np.ndarray) and isinstance(b, np.ndarray) \
+            and a.shape == b.shape:
+        idx = np.nonzero(a != b)
+        first = tuple(int(x[0]) for x in idx) if idx[0].size else ()
+        return (f"{path}: arrays differ at {idx[0].size} positions "
+                f"(first {first})")
+    return f"{path}: {_short(a)} != {_short(b)}"
+
+
+def _short(v) -> str:
+    s = repr(v)
+    return s if len(s) <= 48 else s[:45] + "..."
+
+
+__all__ = [
+    "LruCache",
+    "Lockfile",
+    "LockfileError",
+    "OneshotBroadcast",
+    "SensitiveUrl",
+    "compare_fields",
+]

@@ -126,6 +126,12 @@ class EngineApiClient:
         self.timeout_s = timeout_s
         self._id = 0
 
+    def __repr__(self) -> str:
+        # engine URLs may embed credentials: redact in logs/errors
+        from lighthouse_tpu.common.utils import SensitiveUrl
+
+        return f"EngineApiClient({SensitiveUrl(self.url)})"
+
     def _call(self, method: str, params: list):
         self._id += 1
         body = json.dumps({

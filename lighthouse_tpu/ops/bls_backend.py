@@ -46,7 +46,11 @@ from lighthouse_tpu.ops.bls12_381 import (
 RAND_BITS = 64
 
 # distinct messages hash to the same G2 point; memoize across batches
-_H2C_CACHE: dict[bytes, object] = {}
+# (LRU-bounded: a flood of unique messages evicts oldest, never clears
+# the hot set wholesale)
+from lighthouse_tpu.common.utils import LruCache
+
+_H2C_CACHE = LruCache(capacity=1 << 16)
 
 
 def _hash_to_g2_cached(message: bytes):
@@ -54,10 +58,8 @@ def _hash_to_g2_cached(message: bytes):
 
     pt = _H2C_CACHE.get(message)
     if pt is None:
-        if len(_H2C_CACHE) > 1 << 16:
-            _H2C_CACHE.clear()
         pt = hash_to_g2(message)
-        _H2C_CACHE[message] = pt
+        _H2C_CACHE.put(message, pt)
     return pt
 
 
