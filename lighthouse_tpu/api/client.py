@@ -92,6 +92,42 @@ class BeaconNodeClient:
         return self._call(
             "GET", f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
 
+    def attester_duties(self, epoch: int, indices: list[int]):
+        return self._call(
+            "POST", f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices])["data"]
+
+    def produce_block(self, slot: int, randao_reveal: bytes,
+                      graffiti: bytes = b"") -> tuple[bytes, str]:
+        """(unsigned_block_ssz, fork_name)."""
+        out = self._call(
+            "GET",
+            f"/eth/v3/validator/blocks/{slot}"
+            f"?randao_reveal=0x{randao_reveal.hex()}"
+            f"&graffiti=0x{graffiti.hex()}")
+        return bytes.fromhex(out["ssz_hex"]), out["version"]
+
+    def attestation_data(self, slot: int, committee_index: int) -> bytes:
+        out = self._call(
+            "GET", f"/eth/v1/validator/attestation_data?slot={slot}"
+                   f"&committee_index={committee_index}")
+        return bytes.fromhex(out["ssz_hex"])
+
+    def aggregate_attestation(self, slot: int, data_root: bytes,
+                              committee_index: int | None = None):
+        path = (f"/eth/v1/validator/aggregate_attestation?slot={slot}"
+                f"&attestation_data_root=0x{data_root.hex()}")
+        if committee_index is not None:
+            path += f"&committee_index={committee_index}"
+        out = self._call("GET", path)
+        return bytes.fromhex(out["ssz_hex"]), int(out["committee_index"])
+
+    def publish_aggregates(self, signed_aggregates) -> int:
+        out = self._call(
+            "POST", "/eth/v1/validator/aggregate_and_proofs",
+            {"ssz_hex": [a.serialize().hex() for a in signed_aggregates]})
+        return out["data"]["accepted"]
+
     # -- node ----------------------------------------------------------------
 
     def version(self) -> str:

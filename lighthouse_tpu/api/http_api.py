@@ -51,6 +51,16 @@ class BeaconApi:
         r("POST", r"/eth/v1/beacon/pool/voluntary_exits", self.submit_exit)
         r("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)",
           self.proposer_duties)
+        r("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)",
+          self.attester_duties)
+        r("GET", r"/eth/v3/validator/blocks/(?P<slot>\d+)",
+          self.produce_block)
+        r("GET", r"/eth/v1/validator/attestation_data",
+          self.attestation_data)
+        r("GET", r"/eth/v1/validator/aggregate_attestation",
+          self.aggregate_attestation)
+        r("POST", r"/eth/v1/validator/aggregate_and_proofs",
+          self.publish_aggregates)
         r("GET", r"/eth/v1/beacon/light_client/bootstrap/(?P<block_root>0x\w+)",
           self.lc_bootstrap)
         r("GET", r"/eth/v1/beacon/light_client/optimistic_update",
@@ -69,12 +79,20 @@ class BeaconApi:
         self.routes.append((method, re.compile("^" + pattern + "$"), fn))
 
     def dispatch(self, method: str, path: str, body: bytes):
+        import inspect
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         for m, pat, fn in self.routes:
             if m != method:
                 continue
-            match = pat.match(path)
+            match = pat.match(parsed.path)
             if match:
-                return fn(body=body, **match.groupdict())
+                kw = dict(match.groupdict())
+                if "query" in inspect.signature(fn).parameters:
+                    kw["query"] = query
+                return fn(body=body, **kw)
         raise ApiError(404, f"route not found: {method} {path}")
 
     # -- helpers -------------------------------------------------------------
@@ -153,9 +171,25 @@ class BeaconApi:
 
     def validator_info(self, state_id, vid, body=None):
         st = self._state(state_id)
-        if not vid.isdigit() or int(vid) >= len(st.validators):
+        if vid.startswith("0x"):  # lookup by pubkey (standard API form)
+            import numpy as np
+
+            try:
+                pk = bytes.fromhex(vid[2:])
+            except ValueError:
+                raise ApiError(400, f"bad validator id {vid}")
+            if len(pk) != 48:
+                raise ApiError(400, f"bad validator id {vid}")
+            matches = np.nonzero(
+                (st.validators.pubkeys
+                 == np.frombuffer(pk, np.uint8)).all(axis=1))[0]
+            if not matches.size:
+                raise ApiError(404, "unknown validator")
+            i = int(matches[0])
+        elif not vid.isdigit() or int(vid) >= len(st.validators):
             raise ApiError(404, "unknown validator")
-        i = int(vid)
+        else:
+            i = int(vid)
         v = st.validators
         return {"data": {
             "index": str(i),
@@ -244,7 +278,11 @@ class BeaconApi:
 
     def pool_attestations(self, body=None):
         c = self.chain
-        atts = [c.t.Attestation.deserialize(bytes.fromhex(h))
+        electra = c.spec.fork_at_least(
+            c.spec.fork_at_epoch(
+                c.spec.compute_epoch_at_slot(c.current_slot())), "electra")
+        cls = c.t.AttestationElectra if electra else c.t.Attestation
+        atts = [cls.deserialize(bytes.fromhex(h))
                 for h in json.loads(body)["ssz_hex"]]
         verified, rejects = c.verify_attestations_for_gossip(atts)
         if rejects:
@@ -295,6 +333,143 @@ class BeaconApi:
                 "slot": str(slot),
             })
         return {"data": duties}
+
+    def attester_duties(self, epoch, body=None):
+        """Standard POST attester duties: body = list of validator-index
+        strings (reference http_api/src/attester_duties.rs)."""
+        c = self.chain
+        spec = c.spec
+        epoch = int(epoch)
+        from lighthouse_tpu.state_transition import misc, state_advance
+
+        st = c.head_state
+        current = spec.compute_epoch_at_slot(int(st.slot))
+        if epoch > current + 1:
+            raise ApiError(
+                400, f"epoch {epoch} beyond next epoch {current + 1}")
+        if current < epoch:
+            st = st.copy()
+            state_advance(st, spec,
+                          spec.compute_start_slot_at_epoch(epoch))
+        wanted = {int(v) for v in json.loads(body or b"[]")}
+        shuffle = c.committee_shuffle(st, epoch)
+        per_slot = misc.get_committee_count_per_slot(spec, shuffle.shape[0])
+        start = spec.compute_start_slot_at_epoch(epoch)
+        duties = []
+        for slot in range(start, start + spec.slots_per_epoch):
+            for index in range(per_slot):
+                committee = misc.get_beacon_committee(
+                    st, spec, slot, index, shuffle)
+                for pos, vidx in enumerate(committee):
+                    if int(vidx) not in wanted:
+                        continue
+                    duties.append({
+                        "pubkey": _hex(
+                            st.validators.pubkeys[int(vidx)].tobytes()),
+                        "validator_index": str(int(vidx)),
+                        "committee_index": str(index),
+                        "committee_length": str(committee.shape[0]),
+                        "committees_at_slot": str(per_slot),
+                        "validator_committee_index": str(pos),
+                        "slot": str(slot),
+                    })
+        return {"data": duties}
+
+    def produce_block(self, slot, body=None, query=None):
+        """Block production (v3 flavor): randao_reveal + graffiti query
+        params; returns the unsigned block SSZ
+        (reference http_api block production)."""
+        q = query or {}
+        randao = bytes.fromhex(
+            q.get("randao_reveal", "00" * 96).removeprefix("0x"))
+        graffiti = bytes.fromhex(
+            q.get("graffiti", "").removeprefix("0x") or "")
+        block, proposer = self.chain.produce_block_on(
+            int(slot), randao, graffiti=graffiti)
+        fork = self.chain.spec.fork_at_epoch(
+            self.chain.spec.compute_epoch_at_slot(int(slot)))
+        return {"version": fork,
+                "data": {"proposer_index": str(proposer)},
+                "ssz_hex": block.serialize().hex()}
+
+    def attestation_data(self, body=None, query=None):
+        """Unsigned AttestationData for (slot, committee_index) — the BN
+        computes head/target/source (reference produce_attestation_data);
+        the VC only signs."""
+        q = query or {}
+        slot = int(q.get("slot", 0))
+        ci = int(q.get("committee_index", 0))
+        c = self.chain
+        spec = c.spec
+        epoch = spec.compute_epoch_at_slot(slot)
+        head_root = c.head_root
+        state = c.head_state
+        target_slot = spec.compute_start_slot_at_epoch(epoch)
+        target_root = (head_root if target_slot >= int(state.slot)
+                       else c.block_root_at_slot(target_slot))
+        from lighthouse_tpu.types.containers import (
+            AttestationData,
+            Checkpoint,
+        )
+
+        # electra (EIP-7549): signatures commit to index=0; the VC gets
+        # the committee back out-of-band and encodes it in committee_bits
+        electra = spec.fork_at_least(spec.fork_at_epoch(epoch), "electra")
+        data = AttestationData(
+            slot=slot, index=0 if electra else ci,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root or head_root))
+        return {"ssz_hex": data.serialize().hex(),
+                "committee_index": str(ci),
+                "version": "electra" if electra else "legacy"}
+
+    def aggregate_attestation(self, body=None, query=None):
+        """Best aggregate for (slot, attestation_data_root[, committee])
+        from the naive pool (reference get_aggregate_attestation)."""
+        q = query or {}
+        slot = int(q.get("slot", 0))
+        want_root = bytes.fromhex(
+            q.get("attestation_data_root", "").removeprefix("0x"))
+        ci = q.get("committee_index")
+        for data, bits, sig, got_ci in self.chain.naive_pool.iter_aggregates():
+            if int(data.slot) != slot:
+                continue
+            if data.hash_tree_root() != want_root:
+                continue
+            if ci is not None and got_ci != int(ci):
+                continue
+            c = self.chain
+            sig_bytes = (sig.to_bytes() if hasattr(sig, "to_bytes")
+                         else bytes(sig))
+            if c.spec.fork_at_least(
+                    c.spec.fork_at_epoch(
+                        c.spec.compute_epoch_at_slot(slot)), "electra"):
+                att = c.t.AttestationElectra(
+                    aggregation_bits=[bool(b) for b in bits], data=data,
+                    committee_bits=[
+                        i == got_ci
+                        for i in range(c.spec.preset.max_committees_per_slot)],
+                    signature=sig_bytes)
+            else:
+                att = c.t.Attestation(
+                    aggregation_bits=[bool(b) for b in bits], data=data,
+                    signature=sig_bytes)
+            return {"ssz_hex": att.serialize().hex(),
+                    "committee_index": str(got_ci)}
+        raise ApiError(404, "no matching aggregate")
+
+    def publish_aggregates(self, body=None):
+        raws = json.loads(body or b"{}").get("ssz_hex", [])
+        c = self.chain
+        electra = c.spec.fork_at_least(
+            c.spec.fork_at_epoch(
+                c.spec.compute_epoch_at_slot(c.current_slot())), "electra")
+        cls = (c.t.SignedAggregateAndProofElectra if electra
+               else c.t.SignedAggregateAndProof)
+        aggs = [cls.deserialize(bytes.fromhex(r)) for r in raws]
+        verified, rejects = c.verify_aggregates_for_gossip(aggs)
+        return {"data": {"accepted": len(verified)}}
 
     def lc_bootstrap(self, block_root, body=None):
         try:

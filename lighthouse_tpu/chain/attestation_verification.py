@@ -152,9 +152,13 @@ def verify_aggregated_for_gossip(chain, signed_aggregate, state) -> VerifiedAtte
         raise AttestationError("aggregate_already_known")
     if aggregator not in set(int(i) for i in indices):
         raise AttestationError("aggregator_not_in_committee")
+    from lighthouse_tpu.state_transition.misc import (
+        attestation_committee_index,
+    )
+
     slot = int(aggregate.data.slot)
     committee = get_beacon_committee(
-        state, chain.spec, slot, int(aggregate.data.index),
+        state, chain.spec, slot, attestation_committee_index(aggregate),
         chain.committee_shuffle(state, epoch))
     if not is_aggregator(
             chain.spec, committee.shape[0], bytes(msg.selection_proof)):
@@ -176,7 +180,10 @@ def verify_aggregated_for_gossip(chain, signed_aggregate, state) -> VerifiedAtte
 
 def _as_indexed(chain, attestation, indices: np.ndarray):
     t = chain.t
-    return t.IndexedAttestation(
+    cls = (t.IndexedAttestationElectra
+           if hasattr(attestation, "committee_bits")
+           else t.IndexedAttestation)
+    return cls(
         attesting_indices=[int(i) for i in np.sort(indices)],
         data=attestation.data,
         signature=attestation.signature,

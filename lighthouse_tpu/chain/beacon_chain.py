@@ -453,9 +453,14 @@ class BeaconChain:
             aggregates, att_verify.verify_aggregated_for_gossip)
         for v in verified:
             att = v.attestation
+            from lighthouse_tpu.state_transition.misc import (
+                attestation_committee_index,
+            )
+
             self.op_pool.insert_attestation(
                 att.data, np.asarray(att.aggregation_bits, bool),
-                bytes(att.signature))
+                bytes(att.signature),
+                committee_index=attestation_committee_index(att))
         return verified, rejects
 
     def _batch_pipeline(self, items, verify_fn):
@@ -658,8 +663,9 @@ class BeaconChain:
         pool_kw = {}
         if attestations is None:
             # fold the naive pool's current aggregates in before packing
-            for data, bits, sig in self.naive_pool.iter_aggregates():
-                self.op_pool.insert_attestation(data, bits, sig)
+            for data, bits, sig, ci in self.naive_pool.iter_aggregates():
+                self.op_pool.insert_attestation(
+                    data, bits, sig, committee_index=ci)
             attestations = self.op_pool.get_attestations(
                 pre, spec, lambda e: self.committee_shuffle(pre, e), t=self.t)
             prop_sl, att_sl = self.op_pool.get_slashings(pre, spec)
@@ -668,7 +674,7 @@ class BeaconChain:
                 attester_slashings=att_sl,
                 voluntary_exits=self.op_pool.get_voluntary_exits(pre, spec),
             )
-            if fork in ("capella", "deneb", "electra"):
+            if T.ChainSpec.fork_at_least(fork, "capella"):
                 pool_kw["bls_to_execution_changes"] = (
                     self.op_pool.get_bls_to_execution_changes(pre, spec))
 
@@ -703,9 +709,12 @@ class BeaconChain:
                 sync_aggregate = self.sync_pool.produce_sync_aggregate(
                     slot - 1, head_root, spec, self.t)
             body_kw["sync_aggregate"] = sync_aggregate
-        if fork in ("bellatrix", "capella", "deneb"):
+        if T.ChainSpec.fork_at_least(fork, "bellatrix"):
             if execution_payload is None and self.execution_layer is not None:
                 execution_payload = self._produce_payload(pre, slot, fork)
+            if execution_payload is None and hasattr(self, "mock_payload"):
+                # dev/sim nodes without an EL self-build payloads
+                execution_payload = self.mock_payload(slot)
             if execution_payload is None:
                 raise BlockError("execution_payload_required")
             body_kw["execution_payload"] = execution_payload

@@ -42,6 +42,9 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--interop-validators", type=int, default=64,
                     help="interop genesis validator count (dev networks)")
     bn.add_argument("--genesis-fork", default="capella")
+    bn.add_argument("--genesis-time", type=int, default=None,
+                    help="interop genesis time (default: now); nodes "
+                         "sharing a devnet must pass the same value")
     bn.add_argument("--run-seconds", type=float, default=None,
                     help="exit after N seconds (default: run forever)")
 
@@ -136,6 +139,7 @@ def _run_bn(args) -> int:
         slasher_enabled=args.slasher,
         n_genesis_validators=args.interop_validators,
         genesis_fork=args.genesis_fork,
+        genesis_time=args.genesis_time,
     )
     client = ClientBuilder(cfg).build()
     print(json.dumps({

@@ -34,6 +34,16 @@ class ClientConfig:
     # checkpoint sync: bootstrap from a remote node's finalized state
     # instead of genesis (reference beacon_node/src/config.rs:506-527)
     checkpoint_sync_url: str | None = None
+    # tests/simulators drive slots manually; real nodes follow the wall
+    # clock (reference SystemTimeSlotClock vs TestingSlotClock)
+    manual_slot_clock: bool = False
+    # interop genesis time; None = now.  Nodes that must share a devnet
+    # genesis pass the same explicit value (determinism)
+    genesis_time: int | None = None
+    # dev-only: build deterministic mock payloads when no EL is
+    # configured.  None = auto (dev networks only); production networks
+    # without an EL must FAIL to propose, not forge payloads
+    dev_mock_payloads: bool | None = None
 
 
 @dataclass
@@ -85,6 +95,8 @@ class ClientBuilder:
         return self
 
     def genesis(self, state=None) -> "ClientBuilder":
+        import time
+
         from lighthouse_tpu.state_transition import genesis_state
 
         if state is not None:
@@ -93,8 +105,16 @@ class ClientBuilder:
             return self.checkpoint_sync(self.config.checkpoint_sync_url)
         else:
             fork = self.config.genesis_fork
+            # interop genesis anchored NOW by default so a wall-clock
+            # slot clock starts at slot 0 (the reference's interop
+            # genesis_time); explicit genesis_time keeps multi-node
+            # devnets deterministic
+            g_time = (self.config.genesis_time
+                      if self.config.genesis_time is not None
+                      else int(time.time()))
             self.genesis_state = genesis_state(
-                self.config.n_genesis_validators, self.spec, fork)
+                self.config.n_genesis_validators, self.spec, fork,
+                genesis_time=g_time)
         return self
 
     def checkpoint_sync(self, url: str) -> "ClientBuilder":
@@ -173,10 +193,32 @@ class ClientBuilder:
                     os.path.join(self.config.datadir, "hot.db")),
                 cold=NativeKVStore(
                     os.path.join(self.config.datadir, "cold.db")))
+        from lighthouse_tpu.common.slot_clock import (
+            ManualSlotClock,
+            SystemTimeSlotClock,
+        )
+
+        clock_cls = (ManualSlotClock if self.config.manual_slot_clock
+                     else SystemTimeSlotClock)
         self.chain = BeaconChain(
             self.spec, self.genesis_state, store=store,
+            slot_clock=clock_cls(
+                int(self.genesis_state.genesis_time),
+                self.spec.seconds_per_slot),
             verify_signatures=self.config.verify_signatures,
             execution_layer=self._el)
+        allow_mock = self.config.dev_mock_payloads
+        if allow_mock is None:
+            allow_mock = self.config.network in ("devnet", "minimal")
+        if self._el is None and allow_mock:
+            # dev networks without an EL build deterministic mock
+            # payloads (the reference test/sim mock EL); production
+            # networks keep the execution_payload_required failure
+            from lighthouse_tpu.execution.mock_el import build_mock_payload
+
+            chain = self.chain
+            chain.mock_payload = (
+                lambda slot, c=chain: build_mock_payload(c, slot))
         if self._anchor_block is not None:
             # persist the checkpoint anchor block so sync/API can serve it
             self.chain.store.put_block(

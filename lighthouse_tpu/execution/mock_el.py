@@ -216,3 +216,44 @@ __all__ = [
     "json_to_payload_kwargs",
     "payload_to_json",
 ]
+
+
+def build_mock_payload(chain, slot: int):
+    """Deterministic execution payload for a chain head (dev/sim nodes
+    without a real EL — the reference's mock-EL payload production,
+    execution_layer/src/test_utils/execution_block_generator.rs)."""
+    import hashlib
+
+    from lighthouse_tpu.state_transition import misc, state_advance
+
+    spec = chain.spec
+    fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(slot))
+    if fork in ("phase0", "altair"):
+        return None
+    pre = chain.state_for_block(chain.head_root).copy()
+    if int(pre.slot) < slot:
+        state_advance(pre, spec, slot)
+    parent_hash = bytes(pre.latest_execution_payload_header.block_hash)
+    block_hash = hashlib.sha256(
+        parent_hash + slot.to_bytes(8, "little")).digest()
+    cls = {
+        "bellatrix": chain.t.ExecutionPayloadBellatrix,
+        "capella": chain.t.ExecutionPayloadCapella,
+        "deneb": chain.t.ExecutionPayloadDeneb,
+        "electra": chain.t.ExecutionPayloadElectra,
+    }[fork]
+    kw = dict(
+        parent_hash=parent_hash,
+        prev_randao=misc.get_randao_mix(
+            pre, spec, spec.compute_epoch_at_slot(slot)),
+        block_number=slot,
+        timestamp=int(pre.genesis_time) + slot * spec.seconds_per_slot,
+        block_hash=block_hash,
+    )
+    if fork in ("capella", "deneb", "electra"):
+        from lighthouse_tpu.state_transition.block_processing import (
+            get_expected_withdrawals,
+        )
+
+        kw["withdrawals"] = get_expected_withdrawals(pre, spec)
+    return cls(**kw)

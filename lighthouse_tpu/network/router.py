@@ -28,15 +28,44 @@ if TYPE_CHECKING:
 MAX_REQUEST_BLOCKS = 1024
 
 
+def _compute_digest(fork_version: bytes, genesis_validators_root: bytes
+                    ) -> bytes:
+    """THE fork-digest formula (spec compute_fork_digest) — single
+    definition shared by the current-head and all-scheduled paths so
+    subscribe/publish topics can never diverge."""
+    return hashlib.sha256(
+        fork_version + genesis_validators_root).digest()[:4]
+
+
 def fork_digest(chain) -> bytes:
-    """4-byte fork digest (spec compute_fork_digest)."""
-    cur = bytes(chain.head_state.fork.current_version)
-    root = bytes(chain.head_state.genesis_validators_root)
-    return hashlib.sha256(cur + root).digest()[:4]
+    """4-byte fork digest of the chain's CURRENT head fork."""
+    return _compute_digest(
+        bytes(chain.head_state.fork.current_version),
+        bytes(chain.head_state.genesis_validators_root))
+
+
+def _topic_str(digest: bytes, kind: str) -> str:
+    """THE topic encoding — shared by publish (current digest) and
+    subscribe (all scheduled digests)."""
+    return f"/eth2/{digest.hex()}/{kind}/ssz"
 
 
 def topic(chain, kind: str) -> str:
-    return f"/eth2/{fork_digest(chain).hex()}/{kind}/ssz"
+    return _topic_str(fork_digest(chain), kind)
+
+
+def scheduled_fork_digests(chain) -> list[bytes]:
+    """Digests of every fork actually scheduled in the spec.  Gossip
+    topics embed the digest, so a node must listen on the NEXT fork's
+    topics around the boundary or it goes deaf the moment a peer's head
+    crosses first (the reference subscribes new-fork topics ahead of the
+    fork, network/src/service.rs fork watcher)."""
+    from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, FORKS
+
+    spec = chain.spec
+    root = bytes(chain.head_state.genesis_validators_root)
+    return [_compute_digest(spec.fork_version(f), root)
+            for f in FORKS if spec.fork_epoch(f) != FAR_FUTURE_EPOCH]
 
 
 class Router:
@@ -52,6 +81,19 @@ class Router:
         # scheduled attestation-subnet subscriptions (subnet_service.py);
         # None = subscribe to all subnets (small test fabrics)
         self.subnet_service = subnet_service
+        # fork digests are immutable for the chain's lifetime: compute
+        # once, not per subscribe/per-slot subnet update.  The digest in
+        # an incoming message's TOPIC names the sender's fork — decode
+        # wire payloads by it, not by the local clock (boundary messages
+        # arrive from peers whose head crossed first).
+        self._fork_digests = scheduled_fork_digests(chain)
+        from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, FORKS
+
+        root = bytes(chain.head_state.genesis_validators_root)
+        self._fork_of_digest = {
+            _compute_digest(chain.spec.fork_version(f), root).hex(): f
+            for f in FORKS
+            if chain.spec.fork_epoch(f) != FAR_FUTURE_EPOCH}
         self._subscribe_topics()
         self._register_rpc()
         self.gossip.on_delivery_result = self._score_delivery
@@ -59,26 +101,30 @@ class Router:
     # -- gossip -------------------------------------------------------------
 
     def _subscribe_topics(self):
+        """Subscribe every scheduled fork's digest for each kind (the
+        reference's fork watcher subscribes next-fork topics ahead of the
+        boundary; scheduled forks are known up front here)."""
         c = self.chain
-        self.gossip.subscribe(topic(c, "beacon_block"), self._on_block)
-        self.gossip.subscribe(
-            topic(c, "beacon_aggregate_and_proof"), self._on_aggregate)
+
+        def sub(kind: str, handler):
+            for t in self._topics(kind):
+                self.gossip.subscribe(t, handler)
+
+        sub("beacon_block", self._on_block)
+        sub("beacon_aggregate_and_proof", self._on_aggregate)
         if self.subnet_service is None:
             for subnet in range(c.spec.attestation_subnet_count):
-                self.gossip.subscribe(
-                    topic(c, f"beacon_attestation_{subnet}"),
-                    self._on_attestation)
+                sub(f"beacon_attestation_{subnet}", self._on_attestation)
         else:
             self.update_attestation_subnets(c.current_slot())
         for i in range(c.spec.preset.max_blobs_per_block):
-            self.gossip.subscribe(
-                topic(c, f"blob_sidecar_{i}"), self._on_blob)
-        self.gossip.subscribe(
-            topic(c, "voluntary_exit"), self._on_voluntary_exit)
-        self.gossip.subscribe(
-            topic(c, "proposer_slashing"), self._on_proposer_slashing)
-        self.gossip.subscribe(
-            topic(c, "attester_slashing"), self._on_attester_slashing)
+            sub(f"blob_sidecar_{i}", self._on_blob)
+        sub("voluntary_exit", self._on_voluntary_exit)
+        sub("proposer_slashing", self._on_proposer_slashing)
+        sub("attester_slashing", self._on_attester_slashing)
+
+    def _topics(self, kind: str) -> list[str]:
+        return [_topic_str(d, kind) for d in self._fork_digests]
 
     def update_attestation_subnets(self, slot: int) -> None:
         """Apply the subnet service's per-slot subscribe/unsubscribe
@@ -88,11 +134,33 @@ class Router:
         c = self.chain
         to_sub, to_unsub = self.subnet_service.update(slot)
         for subnet in to_sub:
-            self.gossip.subscribe(
-                topic(c, f"beacon_attestation_{subnet}"),
-                self._on_attestation)
+            for t in self._topics(f"beacon_attestation_{subnet}"):
+                self.gossip.subscribe(t, self._on_attestation)
         for subnet in to_unsub:
-            self.gossip.unsubscribe(topic(c, f"beacon_attestation_{subnet}"))
+            for t in self._topics(f"beacon_attestation_{subnet}"):
+                self.gossip.unsubscribe(t)
+
+    def _topic_fork(self, topic_str: str) -> str:
+        """Fork named by the digest embedded in a gossip topic; falls
+        back to the local clock's fork for unknown digests."""
+        from lighthouse_tpu.types.spec import ChainSpec
+
+        c = self.chain
+        try:
+            digest_hex = topic_str.split("/")[2]
+        except IndexError:
+            digest_hex = ""
+        fork = self._fork_of_digest.get(digest_hex)
+        if fork is None:
+            fork = c.spec.fork_at_epoch(
+                c.spec.compute_epoch_at_slot(c.current_slot()))
+        return fork
+
+    def _topic_electra(self, topic_str: str) -> bool:
+        from lighthouse_tpu.types.spec import ChainSpec
+
+        return ChainSpec.fork_at_least(self._topic_fork(topic_str),
+                                       "electra")
 
     def _score_delivery(self, source: str, topic_: str, ok: bool):
         self.peers.report(source, "valid_message" if ok else "low")
@@ -125,7 +193,9 @@ class Router:
 
     def _on_attestation(self, msg):
         c = self.chain
-        att = c.t.Attestation.deserialize(msg.data)
+        att_cls = (c.t.AttestationElectra if self._topic_electra(msg.topic)
+                   else c.t.Attestation)
+        att = att_cls.deserialize(msg.data)
         verified, rejects = c.verify_attestations_for_gossip([att])
         if rejects:
             reasons = {r for _, r in rejects}
@@ -135,7 +205,10 @@ class Router:
 
     def _on_aggregate(self, msg):
         c = self.chain
-        agg = c.t.SignedAggregateAndProof.deserialize(msg.data)
+        agg_cls = (c.t.SignedAggregateAndProofElectra
+                   if self._topic_electra(msg.topic)
+                   else c.t.SignedAggregateAndProof)
+        agg = agg_cls.deserialize(msg.data)
         c.verify_aggregates_for_gossip([agg])
 
     def _on_blob(self, msg):

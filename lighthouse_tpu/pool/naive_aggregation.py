@@ -29,25 +29,33 @@ def _aggregate(sigs):
 class NaiveAggregationPool:
     def __init__(self, retained_slots: int = 32):
         self.retained_slots = retained_slots
-        # slot -> data_root -> (data, bits np.bool_, [signatures])
-        self._slots: dict[int, dict[bytes, tuple]] = {}
+        # slot -> (data_root, committee) -> (data, bits, [sigs], committee)
+        # keyed on the committee TOO: electra attestation data carries
+        # index=0 for every committee (EIP-7549), so the data root alone
+        # would merge different committees' bitfields
+        self._slots: dict[int, dict[tuple, tuple]] = {}
 
     def insert(self, attestation) -> bool:
         """Fold one (single-bit or partial) attestation in.  Returns True
         if it contributed at least one new bit."""
+        from lighthouse_tpu.state_transition.misc import (
+            attestation_committee_index,
+        )
+
         data = attestation.data
         slot = int(data.slot)
-        data_root = data.hash_tree_root()
+        committee = attestation_committee_index(attestation)
+        key = (data.hash_tree_root(), committee)
         per_slot = self._slots.setdefault(slot, {})
         bits = np.asarray(attestation.aggregation_bits, dtype=bool)
-        entry = per_slot.get(data_root)
+        entry = per_slot.get(key)
         if entry is None:
-            per_slot[data_root] = (
+            per_slot[key] = (
                 data, bits.copy(),
-                [bls.Signature(bytes(attestation.signature))])
+                [bls.Signature(bytes(attestation.signature))], committee)
             self._prune()
             return True
-        _, agg_bits, sigs = entry
+        _, agg_bits, sigs, _ci = entry
         fresh = bits & ~agg_bits
         if not fresh.any():
             return False
@@ -58,18 +66,20 @@ class NaiveAggregationPool:
         sigs.append(bls.Signature(bytes(attestation.signature)))
         return True
 
-    def get_aggregate(self, data) -> "object | None":
+    def get_aggregate(self, data, committee_index: int | None = None):
         """Best aggregate for this AttestationData (or None)."""
-        entry = self._slots.get(int(data.slot), {}).get(data.hash_tree_root())
+        ci = int(data.index) if committee_index is None else committee_index
+        entry = self._slots.get(int(data.slot), {}).get(
+            (data.hash_tree_root(), ci))
         if entry is None:
             return None
-        data, bits, sigs = entry
+        data, bits, sigs, _ci = entry
         return data, bits.copy(), _aggregate(sigs)
 
     def iter_aggregates(self):
         for per_slot in self._slots.values():
-            for data, bits, sigs in per_slot.values():
-                yield data, bits.copy(), _aggregate(sigs)
+            for data, bits, sigs, ci in per_slot.values():
+                yield data, bits.copy(), _aggregate(sigs), ci
 
     def _prune(self):
         if len(self._slots) <= self.retained_slots:

@@ -27,6 +27,7 @@ class _StoredAttestation:
     data: object
     bits: np.ndarray
     signature: object  # bls.Signature
+    committee: int = 0  # electra data carries index=0; track it here
 
 
 @dataclass
@@ -42,10 +43,14 @@ class OperationPool:
 
     # -- attestations -------------------------------------------------------
 
-    def insert_attestation(self, data, bits: np.ndarray, signature) -> bool:
+    def insert_attestation(self, data, bits: np.ndarray, signature,
+                           committee_index: int | None = None) -> bool:
         """Insert an aggregate (from the naive pool or gossip aggregates).
-        Keeps up to `max_variants_per_data` non-subsumed bitsets per data."""
-        root = data.hash_tree_root()
+        Keeps up to `max_variants_per_data` non-subsumed bitsets per data.
+        `committee_index` must be passed for electra-format entries whose
+        data.index is 0 (EIP-7549); defaults to data.index."""
+        ci = int(data.index) if committee_index is None else committee_index
+        root = (data.hash_tree_root(), ci)
         bits = np.asarray(bits, dtype=bool)
         variants = self.attestations.setdefault(root, [])
         for v in variants:
@@ -54,7 +59,7 @@ class OperationPool:
         variants[:] = [v for v in variants if (v.bits & ~bits).any()]
         variants.append(_StoredAttestation(
             data, bits, signature if isinstance(signature, bls.Signature)
-            else bls.Signature(bytes(signature))))
+            else bls.Signature(bytes(signature)), ci))
         if len(variants) > self.max_variants_per_data:
             variants.sort(key=lambda v: int(v.bits.sum()), reverse=True)
             del variants[self.max_variants_per_data:]
@@ -69,14 +74,22 @@ class OperationPool:
         shuffling cache hook).  Weight = effective balance of attesters
         whose TIMELY_TARGET flag is still unset for the matching epoch.
         """
-        limit = limit if limit is not None else spec.preset.max_attestations
         slot = int(state.slot)
         cur_epoch = spec.compute_epoch_at_slot(slot)
+        fork_now = spec.fork_at_epoch(cur_epoch)
+        electra = spec.fork_at_least(fork_now, "electra")
+        if limit is None:
+            # electra blocks carry fewer, wider attestations (EIP-7549)
+            limit = (spec.preset.max_attestations_electra if electra
+                     else spec.preset.max_attestations)
         prev_epoch = max(cur_epoch - 1, 0)
         items = []
         eb = np.asarray(state.validators.effective_balance, np.int64)
         cur_part = np.asarray(state.current_epoch_participation, np.uint8)
         prev_part = np.asarray(state.previous_epoch_participation, np.uint8)
+        # pre-deneb inclusion window: delay <= SLOTS_PER_EPOCH (deneb
+        # removed the upper bound, EIP-7045); constant per call, hoisted
+        post_7045 = spec.fork_at_least(fork_now, "deneb")
         for variants in self.attestations.values():
             for stored in variants:
                 att_slot = int(stored.data.slot)
@@ -85,11 +98,22 @@ class OperationPool:
                     continue
                 if att_slot + spec.min_attestation_inclusion_delay > slot:
                     continue
+                if (not post_7045
+                        and slot - att_slot > spec.preset.slots_per_epoch):
+                    continue
+                # format boundary (EIP-7549): the signature commits to
+                # data.index, so electra blocks can only carry entries
+                # signed over index=0, and legacy blocks only entries
+                # whose index matches their committee
+                if electra and int(stored.data.index) != 0:
+                    continue
+                if not electra and stored.committee != int(stored.data.index):
+                    continue
                 part = cur_part if target_epoch == cur_epoch else prev_part
                 try:
                     shuffle = shuffle_for_epoch(target_epoch)
                     committee = get_beacon_committee(
-                        state, spec, att_slot, int(stored.data.index), shuffle)
+                        state, spec, att_slot, stored.committee, shuffle)
                 except Exception:
                     continue
                 if committee.shape[0] != stored.bits.shape[0]:
@@ -107,10 +131,22 @@ class OperationPool:
         out = []
         for c in chosen:
             s = c.item
-            att = t.Attestation(
-                aggregation_bits=[bool(b) for b in s.bits],
-                data=s.data,
-                signature=s.signature.to_bytes())
+            if electra:
+                # on-chain electra format (EIP-7549): data.index is
+                # already 0 (filtered above — the SIGNATURE commits to
+                # it); the committee rides in committee_bits
+                committee_bits = [
+                    i == s.committee
+                    for i in range(spec.preset.max_committees_per_slot)]
+                att = t.AttestationElectra(
+                    aggregation_bits=[bool(b) for b in s.bits],
+                    data=s.data, committee_bits=committee_bits,
+                    signature=s.signature.to_bytes())
+            else:
+                att = t.Attestation(
+                    aggregation_bits=[bool(b) for b in s.bits],
+                    data=s.data,
+                    signature=s.signature.to_bytes())
             out.append(att)
         return out
 

@@ -213,3 +213,15 @@ def is_valid_merkle_branch(
         else:
             value = hashlib.sha256(value + branch[i]).digest()
     return value == root
+
+
+def attestation_committee_index(attestation) -> int:
+    """The committee an attestation covers: data.index pre-electra,
+    the one-hot committee_bits position for electra (EIP-7549)."""
+    bits = getattr(attestation, "committee_bits", None)
+    if bits is None:
+        return int(attestation.data.index)
+    for i, b in enumerate(bits):
+        if b:
+            return int(i)
+    return 0

@@ -289,6 +289,18 @@ def make_types(preset: Preset) -> SimpleNamespace:
         ("signature", ssz.Bytes96),
     ])
 
+    AggregateAndProofElectra = _container("AggregateAndProofElectra", [
+        ("aggregator_index", ssz.uint64),
+        ("aggregate", AttestationElectra),
+        ("selection_proof", ssz.Bytes96),
+    ])
+
+    SignedAggregateAndProofElectra = _container(
+        "SignedAggregateAndProofElectra", [
+            ("message", AggregateAndProofElectra),
+            ("signature", ssz.Bytes96),
+        ])
+
     SyncAggregate = _container("SyncAggregate", [
         ("sync_committee_bits", ssz.Bitvector(P.sync_committee_size)),
         ("sync_committee_signature", ssz.Bytes96),

@@ -284,6 +284,13 @@ class ChainSpec:
                 current = f
         return current
 
+    @staticmethod
+    def fork_at_least(fork: str, base: str) -> bool:
+        """fork >= base in activation order.  Use this instead of
+        hardcoded suffix tuples like `fork in ("deneb", "electra")` —
+        those silently exclude every later fork added to FORKS."""
+        return FORKS.index(fork) >= FORKS.index(base)
+
     def compute_epoch_at_slot(self, slot: int) -> int:
         return slot // self.slots_per_epoch
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from lighthouse_tpu.types.spec import ChainSpec
 from lighthouse_tpu.validator.duties import DutiesService
 from lighthouse_tpu.validator.slashing_protection import (
     SlashingProtectionError,
@@ -53,7 +54,7 @@ class ValidatorClient:
             randao = self.store.sign_randao_reveal(duty.pubkey, epoch)
             kwargs = {}
             fork = spec.fork_at_epoch(epoch)
-            if fork in ("bellatrix", "capella", "deneb"):
+            if ChainSpec.fork_at_least(fork, "bellatrix"):
                 kwargs["execution_payload"] = (
                     chain.mock_payload(slot) if hasattr(chain, "mock_payload")
                     else None)
@@ -88,9 +89,13 @@ class ValidatorClient:
             Checkpoint,
         )
 
+        electra = ChainSpec.fork_at_least(
+            spec.fork_at_epoch(epoch), "electra")
         for duty in duties:
             data = AttestationData(
-                slot=slot, index=duty.committee_index,
+                # EIP-7549: electra signs over index=0; the committee
+                # rides in committee_bits on the wire
+                slot=slot, index=0 if electra else duty.committee_index,
                 beacon_block_root=head_root,
                 source=state.current_justified_checkpoint,
                 target=Checkpoint(epoch=epoch, root=target_root or head_root),
@@ -102,8 +107,16 @@ class ValidatorClient:
                 continue
             bits = [False] * duty.committee_length
             bits[duty.committee_position] = True
-            att = chain.t.Attestation(
-                aggregation_bits=bits, data=data, signature=sig)
+            if electra:
+                att = chain.t.AttestationElectra(
+                    aggregation_bits=bits, data=data,
+                    committee_bits=[
+                        i == duty.committee_index
+                        for i in range(spec.preset.max_committees_per_slot)],
+                    signature=sig)
+            else:
+                att = chain.t.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig)
             verified, _rejects = chain.verify_attestations_for_gossip([att])
             if not verified:
                 continue
@@ -178,26 +191,48 @@ class ValidatorClient:
             if not duty.is_aggregator:
                 continue
             agg = None
-            for data_agg, bits, sig in self.chain.naive_pool.iter_aggregates():
+            for data_agg, bits, sig, ci in \
+                    self.chain.naive_pool.iter_aggregates():
                 if (int(data_agg.slot) == slot
-                        and int(data_agg.index) == duty.committee_index):
+                        and ci == duty.committee_index):
                     agg = (data_agg, bits, sig)
                     break
             if agg is None:
                 continue
             data_agg, bits, sig = agg
-            aggregate = chain.t.Attestation(
-                aggregation_bits=[bool(b) for b in bits], data=data_agg,
-                signature=sig.to_bytes() if hasattr(sig, "to_bytes")
-                else bytes(sig))
-            message = chain.t.AggregateAndProof(
-                aggregator_index=duty.validator_index,
-                aggregate=aggregate,
-                selection_proof=duty.selection_proof)
-            proof_sig = self.store.sign_aggregate_and_proof(
-                duty.pubkey, message)
-            signed = chain.t.SignedAggregateAndProof(
-                message=message, signature=proof_sig)
+            spec = chain.spec
+            electra = ChainSpec.fork_at_least(
+                spec.fork_at_epoch(spec.compute_epoch_at_slot(slot)),
+                "electra")
+            sig_bytes = (sig.to_bytes() if hasattr(sig, "to_bytes")
+                         else bytes(sig))
+            if electra:
+                aggregate = chain.t.AttestationElectra(
+                    aggregation_bits=[bool(b) for b in bits], data=data_agg,
+                    committee_bits=[
+                        i == duty.committee_index
+                        for i in range(spec.preset.max_committees_per_slot)],
+                    signature=sig_bytes)
+                message = chain.t.AggregateAndProofElectra(
+                    aggregator_index=duty.validator_index,
+                    aggregate=aggregate,
+                    selection_proof=duty.selection_proof)
+                proof_sig = self.store.sign_aggregate_and_proof(
+                    duty.pubkey, message)
+                signed = chain.t.SignedAggregateAndProofElectra(
+                    message=message, signature=proof_sig)
+            else:
+                aggregate = chain.t.Attestation(
+                    aggregation_bits=[bool(b) for b in bits], data=data_agg,
+                    signature=sig_bytes)
+                message = chain.t.AggregateAndProof(
+                    aggregator_index=duty.validator_index,
+                    aggregate=aggregate,
+                    selection_proof=duty.selection_proof)
+                proof_sig = self.store.sign_aggregate_and_proof(
+                    duty.pubkey, message)
+                signed = chain.t.SignedAggregateAndProof(
+                    message=message, signature=proof_sig)
             verified, _rejects = chain.verify_aggregates_for_gossip([signed])
             if not verified:
                 continue

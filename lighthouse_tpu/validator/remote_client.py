@@ -1,0 +1,153 @@
+"""Remote validator client: duties over the Beacon HTTP API.
+
+Rebuild of the reference's actual BN⇄VC process split
+(/root/reference/validator_client/src/{duties_service,block_service,
+attestation_service}.rs over common/eth2): the VC holds only keys and a
+`BeaconNodeClient` (or a `BeaconNodeFallback` of several); every duty —
+duties lookup, block production, attestation data, publication — crosses
+the HTTP API.  The in-process `ValidatorClient` shares the signing store
+and slashing gate; this class is the over-the-wire twin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.api.client import BeaconNodeClient, ClientError
+from lighthouse_tpu.validator.slashing_protection import (
+    SlashingProtectionError,
+)
+
+
+@dataclass
+class RemoteSlotSummary:
+    slot: int
+    blocks_proposed: int = 0
+    attestations_published: int = 0
+    aggregates_published: int = 0
+    slashing_refusals: int = 0
+
+
+class RemoteValidatorClient:
+    def __init__(self, bn: BeaconNodeClient, store, spec: T.ChainSpec):
+        self.bn = bn
+        self.store = store          # ValidatorStore (keys + slashing gate)
+        self.spec = spec
+        self.t = T.make_types(spec.preset)
+        self._index_of: dict[bytes, int] = {}
+        # duties are stable within an epoch: one fetch per epoch, not per
+        # slot (the server recomputes full-epoch committees per request)
+        self._duties_cache: tuple[int, list] | None = None
+
+    # -- indices ------------------------------------------------------------
+
+    def resolve_indices(self) -> dict[bytes, int]:
+        """pubkey -> validator index via the state validators endpoint."""
+        for pk in self.store.voting_pubkeys():
+            if pk in self._index_of:
+                continue
+            try:
+                info = self.bn.validator("0x" + pk.hex())
+                self._index_of[pk] = int(info["index"])
+            except ClientError:
+                continue
+        return dict(self._index_of)
+
+    def _pk_of_index(self, index: int) -> bytes | None:
+        for pk, i in self._index_of.items():
+            if i == index:
+                return pk
+        return None
+
+    # -- per-slot tick ------------------------------------------------------
+
+    def run_slot(self, slot: int) -> RemoteSlotSummary:
+        summary = RemoteSlotSummary(slot)
+        self.resolve_indices()
+        self._propose(slot, summary)
+        self._attest(slot, summary)
+        return summary
+
+    def _propose(self, slot: int, summary: RemoteSlotSummary) -> None:
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        try:
+            duties = self.bn.proposer_duties(epoch)
+        except ClientError:
+            return
+        mine = {pk.hex() for pk in self.store.voting_pubkeys()}
+        for duty in duties:
+            if int(duty["slot"]) != slot:
+                continue
+            pk_hex = duty["pubkey"].removeprefix("0x")
+            if pk_hex not in mine:
+                continue
+            pk = bytes.fromhex(pk_hex)
+            randao = self.store.sign_randao_reveal(pk, epoch)
+            raw, fork = self.bn.produce_block(slot, randao)
+            block = self.t.beacon_block_class(fork).deserialize(raw)
+            try:
+                sig = self.store.sign_block(pk, block)
+            except SlashingProtectionError:
+                summary.slashing_refusals += 1
+                continue
+            signed = self.t.signed_beacon_block_class(fork)(
+                message=block, signature=sig)
+            self.bn.publish_block(signed)
+            summary.blocks_proposed += 1
+
+    def _attest(self, slot: int, summary: RemoteSlotSummary) -> None:
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        indices = list(self._index_of.values())
+        if not indices:
+            return
+        if self._duties_cache is not None \
+                and self._duties_cache[0] == epoch:
+            duties = self._duties_cache[1]
+        else:
+            try:
+                duties = self.bn.attester_duties(epoch, indices)
+            except ClientError:
+                return
+            self._duties_cache = (epoch, duties)
+        # one BN-computed AttestationData per committee (the reference's
+        # produce_attestation_data flow: the BN picks head/target/source)
+        data_cache: dict[int, T.AttestationData] = {}
+        atts = []
+        for duty in duties:
+            if int(duty["slot"]) != slot:
+                continue
+            pk = bytes.fromhex(duty["pubkey"].removeprefix("0x"))
+            ci = int(duty["committee_index"])
+            data = data_cache.get(ci)
+            if data is None:
+                try:
+                    raw = self.bn.attestation_data(slot, ci)
+                except ClientError:
+                    continue
+                data = T.AttestationData.deserialize(raw)
+                data_cache[ci] = data
+            try:
+                sig = self.store.sign_attestation(pk, data)
+            except SlashingProtectionError:
+                summary.slashing_refusals += 1
+                continue
+            bits = [False] * int(duty["committee_length"])
+            bits[int(duty["validator_committee_index"])] = True
+            if T.ChainSpec.fork_at_least(
+                    self.spec.fork_at_epoch(epoch), "electra"):
+                atts.append(self.t.AttestationElectra(
+                    aggregation_bits=bits, data=data,
+                    committee_bits=[
+                        i == ci for i in range(
+                            self.spec.preset.max_committees_per_slot)],
+                    signature=sig))
+            else:
+                atts.append(self.t.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig))
+        if atts:
+            summary.attestations_published += self.bn.submit_attestations(
+                atts)
+
+
+__all__ = ["RemoteSlotSummary", "RemoteValidatorClient"]

@@ -68,7 +68,8 @@ class TestCheckpointBootstrap:
 
         cfg = ClientConfig(
             checkpoint_sync_url=f"http://127.0.0.1:{server.port}",
-            verify_signatures=False, http_enabled=False)
+            verify_signatures=False, http_enabled=False,
+            manual_slot_clock=True)
         b = ClientBuilder(cfg)
         b.spec = h.spec
         b.genesis()

@@ -1,0 +1,106 @@
+"""Remote validator client over the real HTTP API (BN⇄VC process split,
+reference validator_client over common/eth2)."""
+
+import pytest
+
+from lighthouse_tpu.api import HttpServer
+from lighthouse_tpu.api.client import BeaconNodeClient
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.testing import Harness, interop_secret_key
+from lighthouse_tpu.validator import ValidatorStore
+from lighthouse_tpu.validator.remote_client import RemoteValidatorClient
+
+
+@pytest.fixture()
+def remote_setup():
+    bls.set_backend("fake")
+    h = Harness(n_validators=16, fork="altair", real_crypto=False)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=True)
+    server = HttpServer(chain, port=0).start()
+    bn = BeaconNodeClient(f"http://127.0.0.1:{server.port}")
+    store = ValidatorStore(h.spec, bytes(h.state.genesis_validators_root))
+    for i in range(16):
+        store.add_validator(interop_secret_key(i), index=i)
+    vc = RemoteValidatorClient(bn, store, h.spec)
+    yield h, chain, server, vc
+    server.stop()
+    bls.set_backend("reference")
+
+
+class TestRemoteVC:
+    def test_index_resolution_over_http(self, remote_setup):
+        h, chain, server, vc = remote_setup
+        idx = vc.resolve_indices()
+        assert len(idx) == 16
+        assert set(idx.values()) == set(range(16))
+
+    def test_propose_and_attest_over_http(self, remote_setup):
+        h, chain, server, vc = remote_setup
+        chain.slot_clock.set_slot(1)
+        s1 = vc.run_slot(1)
+        assert s1.blocks_proposed == 1
+        assert int(chain.head_state.slot) == 1
+        assert s1.attestations_published >= 1
+        chain.slot_clock.set_slot(2)
+        s2 = vc.run_slot(2)
+        assert s2.blocks_proposed == 1
+        assert int(chain.head_state.slot) == 2
+        # the slot-2 block packed the slot-1 attestations submitted via
+        # the pool endpoint
+        blk = chain.store.get_block(chain.head_root)
+        assert len(list(blk.message.body.attestations)) >= 1
+
+    def test_aggregate_endpoints(self, remote_setup):
+        h, chain, server, vc = remote_setup
+        chain.slot_clock.set_slot(1)
+        vc.run_slot(1)
+        # an aggregate exists in the naive pool for slot 1
+        found = None
+        for data, bits, sig, ci in chain.naive_pool.iter_aggregates():
+            if int(data.slot) == 1:
+                found = (data, ci)
+                break
+        assert found is not None
+        data, ci = found
+        raw, got_ci = vc.bn.aggregate_attestation(
+            1, data.hash_tree_root(), ci)
+        att = chain.t.Attestation.deserialize(raw)
+        assert int(att.data.slot) == 1
+        assert got_ci == ci
+
+
+def test_remote_vc_electra_attestations_pack():
+    """EIP-7549 over HTTP: the BN serves index=0 data at electra, the VC
+    submits AttestationElectra, and the next block packs them."""
+    bls.set_backend("fake")
+    try:
+        from lighthouse_tpu.execution.mock_el import build_mock_payload
+
+        h = Harness(n_validators=16, fork="electra", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=True)
+        chain.mock_payload = lambda slot: build_mock_payload(chain, slot)
+        server = HttpServer(chain, port=0).start()
+        try:
+            bn = BeaconNodeClient(f"http://127.0.0.1:{server.port}")
+            store = ValidatorStore(
+                h.spec, bytes(h.state.genesis_validators_root))
+            for i in range(16):
+                store.add_validator(interop_secret_key(i), index=i)
+            vc = RemoteValidatorClient(bn, store, h.spec)
+            chain.slot_clock.set_slot(1)
+            s1 = vc.run_slot(1)
+            assert s1.blocks_proposed == 1
+            assert s1.attestations_published >= 1
+            chain.slot_clock.set_slot(2)
+            s2 = vc.run_slot(2)
+            assert s2.blocks_proposed == 1
+            blk = chain.store.get_block(chain.head_root)
+            atts = list(blk.message.body.attestations)
+            assert atts and all(
+                hasattr(a, "committee_bits") for a in atts)
+            assert all(int(a.data.index) == 0 for a in atts)
+        finally:
+            server.stop()
+    finally:
+        bls.set_backend("reference")

@@ -137,3 +137,36 @@ class TestValidatorClient:
             vc.store.sign_block(proposer.pubkey, conflicting)
         # re-signing the SAME block is idempotent (same signing root)
         assert vc.store.sign_block(proposer.pubkey, block)
+
+
+def test_electra_slot_loop_real_crypto():
+    """EIP-7549 regression: electra attestations are SIGNED over
+    index=0 data; the packed AttestationElectra must verify with real
+    BLS end-to-end (signature/index mismatch would reject every block
+    carrying pool attestations)."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.testing import Harness, interop_secret_key
+
+    from lighthouse_tpu.simulator import LocalNetwork
+
+    h = Harness(n_validators=16, fork="electra", real_crypto=True)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=True)
+    chain.mock_payload = lambda slot: LocalNetwork._mock_payload(chain, slot)
+    store = ValidatorStore(
+        h.spec, bytes(h.state.genesis_validators_root))
+    for i in range(16):
+        store.add_validator(interop_secret_key(i), index=i)
+    vc = ValidatorClient(chain, store)
+    chain.slot_clock.set_slot(1)
+    s1 = vc.run_slot(1)
+    assert s1.blocks_proposed == 1
+    assert s1.attestations_published >= 1
+    chain.slot_clock.set_slot(2)
+    s2 = vc.run_slot(2)
+    assert s2.blocks_proposed == 1
+    blk = chain.store.get_block(chain.head_root)
+    # the slot-2 block packed slot-1 electra attestations and passed
+    # full signature verification on import
+    atts = list(blk.message.body.attestations)
+    assert atts and all(hasattr(a, "committee_bits") for a in atts)
+    assert all(int(a.data.index) == 0 for a in atts)
