@@ -327,9 +327,17 @@ def gen_transitions(root: str, config: str, spec: T.ChainSpec,
         exit_msg = T.VoluntaryExit(
             epoch=spec.shard_committee_period, validator_index=3)
         sk = interop_secret_key(3)
-        domain = misc.get_domain(
-            st, spec, spec.domain_voluntary_exit,
-            int(exit_msg.epoch))
+        # deneb rule: exits are signed with the CAPELLA fork domain from
+        # deneb onward (signature_sets.voluntary_exit_set)
+        if T.ChainSpec.fork_at_least(fork, "deneb"):
+            domain = misc.compute_domain(
+                spec.domain_voluntary_exit,
+                spec.fork_version("capella"),
+                bytes(st.genesis_validators_root))
+        else:
+            domain = misc.get_domain(
+                st, spec, spec.domain_voluntary_exit,
+                int(exit_msg.epoch))
         sig = sk.sign(misc.compute_signing_root(
             exit_msg.hash_tree_root(), domain))
         signed_exit = T.SignedVoluntaryExit(
@@ -354,7 +362,7 @@ def gen_transitions(root: str, config: str, spec: T.ChainSpec,
         _w(path, "voluntary_exit.ssz", bad_exit.serialize())
 
     # fork upgrade: previous fork -> this fork
-    order = ["phase0", "altair", "bellatrix", "capella", "deneb"]
+    order = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
     if fork != "phase0":
         prev = order[order.index(fork) - 1]
         from lighthouse_tpu.state_transition import genesis_state, upgrades
@@ -377,7 +385,9 @@ def gen_transitions(root: str, config: str, spec: T.ChainSpec,
         _w(path, "meta.yaml", {"fork": fork})
 
 
-def generate_tree(root: str, forks: tuple = ("phase0", "altair"),
+def generate_tree(root: str,
+                  forks: tuple = ("phase0", "altair", "bellatrix",
+                                  "capella", "deneb", "electra"),
                   config: str = "minimal") -> str:
     """Emit the full local vector tree; returns `root`."""
     spec_base = (T.ChainSpec.minimal() if config == "minimal"

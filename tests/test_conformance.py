@@ -12,7 +12,7 @@ from lighthouse_tpu.conformance.generate import generate_tree
 @pytest.fixture(scope="module")
 def vector_tree(tmp_path_factory):
     root = tmp_path_factory.mktemp("vectors")
-    generate_tree(str(root), forks=("phase0", "altair"))
+    generate_tree(str(root), forks=("phase0", "altair", "capella", "electra"))
     return str(root)
 
 
