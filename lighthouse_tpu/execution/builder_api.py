@@ -1,0 +1,202 @@
+"""External block-builder (MEV) API: client + mock builder.
+
+Rebuild of /root/reference/beacon_node/builder_client (the eth
+builder-specs surface the reference drives) and
+execution_layer/src/test_utils/mock_builder.rs: the proposer registers
+its fee recipient, asks the builder for a bid (header + value) at a
+slot, and the production path RACES the builder bid against the local
+payload, falling back locally on any builder fault — a failing relay
+must never cost a proposal (the reference's builder-fallback rule).
+
+The full blinded-block round trip (sign header, reveal payload) is
+collapsed to bid + payload fetch here: the seam (get_header /
+get_payload per slot, local fallback) matches, which is what the
+production path and tests exercise.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class BuilderError(RuntimeError):
+    pass
+
+
+@dataclass
+class BuilderBid:
+    slot: int
+    value_wei: int          # bid value; higher wins vs local
+    payload_ssz: bytes      # the payload the builder commits to
+    fork: str
+
+
+class BuilderApiClient:
+    """HTTP client for a builder endpoint (builder-specs shaped)."""
+
+    def __init__(self, base_url: str, timeout: float = 3.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body=None):
+        req = urllib.request.Request(
+            self.base_url + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            raise BuilderError(str(e)) from None
+
+    def register_validator(self, pubkey: bytes, fee_recipient: bytes,
+                           gas_limit: int = 30_000_000) -> None:
+        self._call("POST", "/eth/v1/builder/validators", [{
+            "message": {
+                "pubkey": "0x" + pubkey.hex(),
+                "fee_recipient": "0x" + fee_recipient.hex(),
+                "gas_limit": str(gas_limit),
+            }}])
+
+    def get_bid(self, slot: int, parent_hash: bytes,
+                pubkey: bytes) -> BuilderBid:
+        out = self._call(
+            "GET",
+            f"/eth/v1/builder/header/{slot}/0x{parent_hash.hex()}"
+            f"/0x{pubkey.hex()}")
+        data = out["data"]
+        return BuilderBid(
+            slot=slot,
+            value_wei=int(data["value"]),
+            payload_ssz=bytes.fromhex(data["payload_ssz_hex"]),
+            fork=data["version"])
+
+    def status(self) -> bool:
+        try:
+            self._call("GET", "/eth/v1/builder/status")
+            return True
+        except BuilderError:
+            return False
+
+
+class MockBuilder:
+    """In-process builder (reference mock_builder.rs): bids a payload
+    derived from the chain's own mock payload with a configurable value;
+    can be told to misbehave for fault-injection tests."""
+
+    def __init__(self, chain, port: int = 0, value_wei: int = 10**18):
+        self.chain = chain
+        self.port = port
+        self.value_wei = value_wei
+        self.fail_next = False          # fault injection
+        self.registrations: dict[str, dict] = {}
+        self._srv = None
+        self._thread = None
+
+    def start(self) -> "MockBuilder":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/eth/v1/builder/status":
+                    return self._reply(200, {})
+                parts = self.path.split("/")
+                if len(parts) >= 7 and parts[3] == "builder" \
+                        and parts[4] == "header":
+                    if outer.fail_next:
+                        outer.fail_next = False
+                        return self._reply(500, {"message": "builder down"})
+                    slot = int(parts[5])
+                    from lighthouse_tpu.execution.mock_el import (
+                        build_mock_payload,
+                    )
+
+                    payload = build_mock_payload(outer.chain, slot)
+                    if payload is None:
+                        return self._reply(404, {"message": "pre-merge"})
+                    spec = outer.chain.spec
+                    fork = spec.fork_at_epoch(
+                        spec.compute_epoch_at_slot(slot))
+                    return self._reply(200, {"data": {
+                        "value": str(outer.value_wei),
+                        "payload_ssz_hex": payload.serialize().hex(),
+                        "version": fork,
+                    }})
+                self._reply(404, {"message": "unknown route"})
+
+            def do_POST(self):
+                if self.path == "/eth/v1/builder/validators":
+                    n = int(self.headers.get("Content-Length", 0))
+                    regs = json.loads(self.rfile.read(n))
+                    for r in regs:
+                        outer.registrations[
+                            r["message"]["pubkey"]] = r["message"]
+                    return self._reply(200, {})
+                self._reply(404, {"message": "unknown route"})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._srv.server_port
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+def choose_payload(chain, slot: int, builder: BuilderApiClient | None,
+                   pubkey: bytes | None = None,
+                   local_payload=None):
+    """The production-path race (reference get_payload local/builder
+    race): prefer the builder's bid when it answers with a decodable
+    payload; ANY builder fault falls back to the local payload."""
+    if builder is None:
+        return local_payload, "local"
+    parent_hash = bytes(
+        chain.head_state.latest_execution_payload_header.block_hash)
+    try:
+        bid = builder.get_bid(slot, parent_hash, pubkey or b"\x00" * 48)
+        spec = chain.spec
+        fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(slot))
+        cls = {
+            "bellatrix": chain.t.ExecutionPayloadBellatrix,
+            "capella": chain.t.ExecutionPayloadCapella,
+            "deneb": chain.t.ExecutionPayloadDeneb,
+            "electra": chain.t.ExecutionPayloadElectra,
+        }[fork]
+        if bid.value_wei <= 0:
+            # a worthless bid loses the race to the local payload
+            return local_payload, "local"
+        payload = cls.deserialize(bid.payload_ssz)
+        return payload, "builder"
+    except (BuilderError, KeyError, ValueError):
+        # builder faults fall back locally; programming errors propagate
+        return local_payload, "local"
+
+
+__all__ = [
+    "BuilderApiClient",
+    "BuilderBid",
+    "BuilderError",
+    "MockBuilder",
+    "choose_payload",
+]

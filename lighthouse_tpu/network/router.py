@@ -16,8 +16,12 @@ from lighthouse_tpu.network import rpc as rpc_mod
 from lighthouse_tpu.network.rpc import (
     BlocksByRangeRequest,
     P_BLOBS_BY_RANGE,
+    P_BLOBS_BY_ROOT,
     P_BLOCKS_BY_RANGE,
     P_BLOCKS_BY_ROOT,
+    P_LC_BOOTSTRAP,
+    P_LC_FINALITY,
+    P_LC_OPTIMISTIC,
     P_STATUS,
     StatusMessage,
 )
@@ -233,6 +237,60 @@ class Router:
         self.chain.op_pool.insert_attester_slashing(
             c.t.AttesterSlashing.deserialize(msg.data))
 
+
+    def _serve_blobs_by_root(self, src: str, data: bytes) -> list[bytes]:
+        """Blob sidecar bundles by block root (reference
+        rpc blob_sidecars_by_root protocol)."""
+        if len(data) % 32:
+            raise rpc_mod.RpcError("malformed roots request")
+        out = []
+        for i in range(0, min(len(data), 32 * MAX_REQUEST_BLOCKS), 32):
+            blobs = self.chain.store.get_blobs(data[i:i + 32])
+            if blobs:
+                out.append(blobs)
+        return out
+
+    def _serve_lc_bootstrap(self, src: str, data: bytes) -> list[bytes]:
+        """Light-client bootstrap by block root (reference rpc
+        light_client_bootstrap; JSON-encoded over the fabric — the
+        transport codec seam)."""
+        import json as _json
+
+        if len(data) != 32:
+            raise rpc_mod.RpcError("malformed bootstrap request")
+        bs = self.chain.light_client.bootstrap(data)
+        if bs is None:
+            return []
+        return [_json.dumps({
+            "header": bs.header.to_json(),
+            "current_sync_committee_branch": [
+                "0x" + b.hex() for b in bs.current_sync_committee_branch],
+        }).encode()]
+
+    def _serve_lc_optimistic(self, src: str, data: bytes) -> list[bytes]:
+        import json as _json
+
+        upd = self.chain.light_client.latest_optimistic
+        if upd is None:
+            return []
+        return [_json.dumps({
+            "attested_header": upd.attested_header.to_json(),
+            "signature_slot": upd.signature_slot,
+        }).encode()]
+
+    def _serve_lc_finality(self, src: str, data: bytes) -> list[bytes]:
+        import json as _json
+
+        upd = self.chain.light_client.latest_finality
+        if upd is None:
+            return []
+        return [_json.dumps({
+            "attested_header": upd.attested_header.to_json(),
+            "finalized_header": (upd.finalized_header.to_json()
+                                 if upd.finalized_header else None),
+            "signature_slot": upd.signature_slot,
+        }).encode()]
+
     # -- publishing ---------------------------------------------------------
 
     def publish_block(self, signed_block):
@@ -256,6 +314,10 @@ class Router:
         self.rpc.register(P_BLOCKS_BY_RANGE, self._serve_blocks_by_range)
         self.rpc.register(P_BLOCKS_BY_ROOT, self._serve_blocks_by_root)
         self.rpc.register(P_BLOBS_BY_RANGE, self._serve_blobs_by_range)
+        self.rpc.register(P_BLOBS_BY_ROOT, self._serve_blobs_by_root)
+        self.rpc.register(P_LC_BOOTSTRAP, self._serve_lc_bootstrap)
+        self.rpc.register(P_LC_OPTIMISTIC, self._serve_lc_optimistic)
+        self.rpc.register(P_LC_FINALITY, self._serve_lc_finality)
 
     def local_status(self) -> StatusMessage:
         c = self.chain

@@ -31,25 +31,62 @@ class SlotSummary:
 
 
 class ValidatorClient:
-    def __init__(self, chain, store, router=None):
+    def __init__(self, chain, store, router=None, doppelganger=None):
         self.chain = chain
         self.store = store
         self.router = router
+        # optional DoppelgangerService: keys sign only once their
+        # detection window clears (reference doppelganger_service.rs)
+        self.doppelganger = doppelganger
         self.duties = DutiesService(chain, store)
+        self._dg_epoch = -1
+
+    def _may_sign(self, pubkey: bytes) -> bool:
+        return (self.doppelganger is None
+                or self.doppelganger.validator_should_sign(pubkey))
 
     # -- per-slot tick ------------------------------------------------------
 
     def run_slot(self, slot: int) -> SlotSummary:
         summary = SlotSummary(slot)
+        if self.doppelganger is not None:
+            epoch = self.chain.spec.compute_epoch_at_slot(slot)
+            for pk in self.store.voting_pubkeys():
+                self.doppelganger.register_validator(pk, epoch)
+            if epoch > self._dg_epoch:
+                # per-epoch liveness poll over the COMPLETED previous
+                # epoch — polling the brand-new epoch would always see
+                # silence and clear the window unsafely (reference
+                # doppelganger_service prior-epoch liveness query)
+                self.doppelganger.advance_epoch(
+                    epoch,
+                    liveness_fn=lambda pks, e: self._liveness(
+                        pks, max(epoch - 1, 0)))
+                self._dg_epoch = epoch
         self._propose(slot, summary)
         self._attest(slot, summary)
         self._sync_committee(slot, summary)
         return summary
 
+    def _liveness(self, pubkeys, epoch):
+        """Keys observed attesting this epoch that we did not sign for
+        (the chain's observed-attesters cache is the liveness oracle)."""
+        seen = []
+        by_pk = self.duties._indices_by_pubkey(self.chain.head_state)
+        for pk in pubkeys:
+            idx = by_pk.get(pk)
+            if idx is None:
+                continue
+            if self.chain.observed_attesters.is_seen(epoch, idx):
+                seen.append(pk)
+        return seen
+
     def _propose(self, slot: int, summary: SlotSummary):
         chain = self.chain
         spec = chain.spec
         for duty in self.duties.proposers_at_slot(slot):
+            if not self._may_sign(duty.pubkey):
+                continue
             epoch = spec.compute_epoch_at_slot(slot)
             randao = self.store.sign_randao_reveal(duty.pubkey, epoch)
             kwargs = {}
@@ -92,6 +129,8 @@ class ValidatorClient:
         electra = ChainSpec.fork_at_least(
             spec.fork_at_epoch(epoch), "electra")
         for duty in duties:
+            if not self._may_sign(duty.pubkey):
+                continue
             data = AttestationData(
                 # EIP-7549: electra signs over index=0; the committee
                 # rides in committee_bits on the wire
@@ -133,7 +172,8 @@ class ValidatorClient:
         head root; elected aggregators publish contributions
         (reference sync_committee_service.rs)."""
         chain = self.chain
-        duties = self.duties.sync_duties_at_slot(slot)
+        duties = [d for d in self.duties.sync_duties_at_slot(slot)
+                  if self._may_sign(d.pubkey)]
         if not duties:
             return
         head_root = chain.head_root
@@ -188,7 +228,7 @@ class ValidatorClient:
         # aggregation duties (attestation_service.rs:234-519 flow)
         chain = self.chain
         for duty in duties:
-            if not duty.is_aggregator:
+            if not duty.is_aggregator or not self._may_sign(duty.pubkey):
                 continue
             agg = None
             for data_agg, bits, sig, ci in \

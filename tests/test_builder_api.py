@@ -1,0 +1,91 @@
+"""External builder (MEV) API tests: registration, bids, local fallback
+(reference builder_client + mock_builder.rs)."""
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.execution.builder_api import (
+    BuilderApiClient,
+    BuilderError,
+    MockBuilder,
+    choose_payload,
+)
+from lighthouse_tpu.execution.mock_el import build_mock_payload
+from lighthouse_tpu.testing import Harness
+
+
+@pytest.fixture()
+def builder_setup():
+    bls.set_backend("fake")
+    h = Harness(16, fork="capella", real_crypto=False)
+    chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+    mock = MockBuilder(chain).start()
+    client = BuilderApiClient(f"http://127.0.0.1:{mock.port}")
+    yield h, chain, mock, client
+    mock.stop()
+    bls.set_backend("reference")
+
+
+class TestBuilderApi:
+    def test_status_and_registration(self, builder_setup):
+        h, chain, mock, client = builder_setup
+        assert client.status()
+        pk = b"\x11" * 48
+        client.register_validator(pk, b"\x22" * 20)
+        assert "0x" + pk.hex() in mock.registrations
+        reg = mock.registrations["0x" + pk.hex()]
+        assert reg["fee_recipient"] == "0x" + ("22" * 20)
+
+    def test_bid_round_trip(self, builder_setup):
+        h, chain, mock, client = builder_setup
+        parent = bytes(
+            chain.head_state.latest_execution_payload_header.block_hash)
+        bid = client.get_bid(1, parent, b"\x11" * 48)
+        assert bid.value_wei == mock.value_wei
+        payload = chain.t.ExecutionPayloadCapella.deserialize(
+            bid.payload_ssz)
+        assert bytes(payload.parent_hash) == parent
+
+    def test_choose_payload_prefers_builder(self, builder_setup):
+        h, chain, mock, client = builder_setup
+        local = build_mock_payload(chain, 1)
+        payload, source = choose_payload(chain, 1, client,
+                                         local_payload=local)
+        assert source == "builder"
+        assert payload is not None
+
+    def test_builder_fault_falls_back_local(self, builder_setup):
+        h, chain, mock, client = builder_setup
+        local = build_mock_payload(chain, 1)
+        mock.fail_next = True
+        payload, source = choose_payload(chain, 1, client,
+                                         local_payload=local)
+        assert source == "local"
+        assert payload is local
+
+    def test_dead_builder_falls_back_local(self, builder_setup):
+        h, chain, mock, client = builder_setup
+        dead = BuilderApiClient("http://127.0.0.1:1", timeout=0.2)
+        assert not dead.status()
+        local = build_mock_payload(chain, 1)
+        payload, source = choose_payload(chain, 1, dead,
+                                         local_payload=local)
+        assert source == "local"
+
+    def test_builder_payload_produces_valid_block(self, builder_setup):
+        """The chosen builder payload flows through block production and
+        imports cleanly (end-to-end race integration)."""
+        h, chain, mock, client = builder_setup
+        payload, source = choose_payload(chain, 1, client)
+        assert source == "builder"
+        from lighthouse_tpu.state_transition import misc
+
+        chain.slot_clock.set_slot(1)
+        block, proposer = chain.produce_block_on(
+            1, b"\xab" * 96, execution_payload=payload)
+        signed = chain.t.signed_beacon_block_class("capella")(
+            message=block, signature=b"\xab" * 96)
+        root = chain.process_block(signed)
+        assert root is not None
+        assert chain.head_root == root

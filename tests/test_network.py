@@ -148,3 +148,46 @@ class TestPartition:
         b.connect(a)
         assert b.sync.sync() == 1
         assert b.chain.head_root == signed.message.hash_tree_root()
+
+
+class TestLightClientRpc:
+    def test_lc_and_blobs_by_root_protocols(self, two_nodes):
+        from lighthouse_tpu.network.rpc import (
+            P_BLOBS_BY_ROOT,
+            P_LC_BOOTSTRAP,
+        )
+
+        h, a, b = two_nodes
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        slot = int(signed.message.slot)
+        for n in (a, b):
+            n.chain.slot_clock.set_slot(slot)
+            n.chain.process_block(signed)
+        root = signed.message.hash_tree_root()
+        # light-client bootstrap served over Req/Resp (must answer for a
+        # known block — a silent [] here would mask a broken handler)
+        chunks = a.rpc_ep.request(b.peer_id, P_LC_BOOTSTRAP, root)
+        assert chunks, "lc bootstrap returned no reply for a known block"
+        import json
+
+        payload = json.loads(chunks[0])
+        assert "header" in payload
+        # optimistic/finality update protocols answer without error
+        # (empty until a sync aggregate lands — never AttributeError)
+        from lighthouse_tpu.network.rpc import (
+            P_LC_FINALITY,
+            P_LC_OPTIMISTIC,
+        )
+
+        a.rpc_ep.request(b.peer_id, P_LC_OPTIMISTIC, b"")
+        a.rpc_ep.request(b.peer_id, P_LC_FINALITY, b"")
+        # blobs-by-root: empty reply for a blobless block, not an error
+        chunks = a.rpc_ep.request(b.peer_id, P_BLOBS_BY_ROOT, root)
+        assert chunks == []
+        # malformed request length is rejected
+        from lighthouse_tpu.network.rpc import RpcError
+        import pytest as _pytest
+
+        with _pytest.raises(RpcError):
+            a.rpc_ep.request(b.peer_id, P_BLOBS_BY_ROOT, b"\x01" * 31)

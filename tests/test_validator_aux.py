@@ -135,3 +135,48 @@ class TestRemoteSigner:
                 method.sign(b"\x09" * 48, b"\x00" * 32)
         finally:
             server.stop()
+
+
+class TestDoppelgangerWiredVC:
+    def test_vc_holds_signing_until_window_clears(self):
+        """A freshly-started VC with doppelganger protection signs
+        NOTHING for the detection window, then resumes (reference
+        doppelganger_service gating in the VC)."""
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.testing import Harness, interop_secret_key
+        from lighthouse_tpu.validator import (
+            DoppelgangerService,
+            ValidatorClient,
+            ValidatorStore,
+        )
+
+        bls.set_backend("fake")
+        try:
+            h = Harness(16, fork="altair", real_crypto=False)
+            chain = BeaconChain(
+                h.spec, h.state.copy(), verify_signatures=False)
+            store = ValidatorStore(
+                h.spec, bytes(h.state.genesis_validators_root))
+            for i in range(16):
+                store.add_validator(interop_secret_key(i), index=i)
+            vc = ValidatorClient(
+                chain, store, doppelganger=DoppelgangerService())
+            spe = h.spec.slots_per_epoch
+            # epoch 0: registration epoch, nothing signs
+            chain.slot_clock.set_slot(1)
+            s = vc.run_slot(1)
+            assert s.blocks_proposed == 0
+            assert s.attestations_published == 0
+            assert s.sync_messages_published == 0
+            assert s.aggregates_published == 0
+            # two silent epochs clear the window
+            for slot in (spe, 2 * spe):
+                chain.slot_clock.set_slot(slot)
+                vc.run_slot(slot)
+            slot = 2 * spe + 1
+            chain.slot_clock.set_slot(slot)
+            s = vc.run_slot(slot)
+            assert s.blocks_proposed == 1
+            assert s.attestations_published >= 1
+        finally:
+            bls.set_backend("reference")
