@@ -150,7 +150,17 @@ class BeaconChain:
 
         seed = misc.get_seed(state, self.spec, epoch,
                              self.spec.domain_beacon_attester)
-        n_active = int(state.validators.is_active(epoch).sum())
+        # active count per (epoch, registry len, slot) is stable: exits/
+        # activations only take effect at future epochs, so the O(n)
+        # is_active scan runs once per state, not once per attestation
+        memo = state.__dict__.setdefault("_active_count_memo", {})
+        mkey = (epoch, len(state.validators), int(state.slot))
+        n_active = memo.get(mkey)
+        if n_active is None:
+            n_active = int(state.validators.is_active(epoch).sum())
+            if len(memo) > 8:   # prev/current epochs interleave: keep both
+                memo.clear()
+            memo[mkey] = n_active
         key = seed + n_active.to_bytes(8, "little")
         shuffle = self.shuffling_cache.get(epoch, key)
         if shuffle is None:

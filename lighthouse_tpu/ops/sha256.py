@@ -297,10 +297,38 @@ def merkleize_words(
     return level[0]
 
 
+def _merkleize_small(data: bytes, limit: int | None) -> bytes:
+    """Scalar hashlib fold for tiny trees.  The word-plane path below
+    costs ~30 µs of numpy plumbing per call; control-plane containers
+    (AttestationData & co, <= 8 chunks) hash thousands of times per
+    gossip batch, so this fast path matters for slot-time budgets."""
+    n_chunks = max(len(data) // 32, 1)
+    if limit is not None and len(data) // 32 > limit:
+        # same contract as merkleize_words: overfull input is an error,
+        # never a plausible-looking root
+        raise ValueError(f"{len(data) // 32} leaves exceed limit {limit}")
+    n_leaves = max(limit if limit is not None else n_chunks, 1)
+    depth = max(n_leaves - 1, 0).bit_length()
+    nodes = [data[i:i + 32] for i in range(0, len(data), 32)] or [
+        b"\x00" * 32]
+    for d in range(depth):
+        nxt = []
+        for i in range(0, len(nodes), 2):
+            left = nodes[i]
+            right = (nodes[i + 1] if i + 1 < len(nodes)
+                     else ZERO_HASHES[d])
+            nxt.append(hashlib.sha256(left + right).digest())
+        nodes = nxt
+    return nodes[0]
+
+
 def merkleize(data: bytes, limit: int | None = None, *, device: bool | None = None) -> bytes:
     """SSZ merkleize over packed 32-byte chunks -> 32-byte root."""
     if len(data) % 32:
         data = data + b"\x00" * (32 - len(data) % 32)
+    if device is not True and len(data) <= 512 and (
+            limit is None or limit <= 16):
+        return _merkleize_small(data, limit)
     leaves = chunks_to_words(data) if data else np.zeros((0, 8), np.uint32)
     return words_to_bytes(merkleize_words(leaves, limit, device=device))
 

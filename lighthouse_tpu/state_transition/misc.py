@@ -19,18 +19,40 @@ from lighthouse_tpu.state_transition.shuffle import (
 )
 
 
-def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _fork_data_root_cached(current_version: bytes,
+                           genesis_validators_root: bytes) -> bytes:
     return T.ForkData(
         current_version=current_version,
         genesis_validators_root=genesis_validators_root,
     ).hash_tree_root()
 
 
-def compute_domain(
+def compute_fork_data_root(current_version, genesis_validators_root) -> bytes:
+    return _fork_data_root_cached(
+        bytes(current_version), bytes(genesis_validators_root))
+
+
+@functools.lru_cache(maxsize=256)
+def _compute_domain_cached(
     domain_type: int, fork_version: bytes, genesis_validators_root: bytes
 ) -> bytes:
     root = compute_fork_data_root(fork_version, genesis_validators_root)
     return domain_type.to_bytes(4, "little") + root[:28]
+
+
+def compute_domain(
+    domain_type: int, fork_version, genesis_validators_root
+) -> bytes:
+    """Memoized: one value per (domain, fork, network) triple, hit once
+    per attestation in gossip batches.  Inputs are coerced to bytes so
+    numpy-backed fields stay hashable for the cache."""
+    return _compute_domain_cached(
+        int(domain_type), bytes(fork_version),
+        bytes(genesis_validators_root))
 
 
 def get_domain(state, spec: T.ChainSpec, domain_type: int, epoch: int | None = None) -> bytes:
