@@ -418,6 +418,40 @@ class BeaconChain:
         except Exception:
             pass
 
+    def persist(self) -> None:
+        """Snapshot fork choice + head for restart resume (reference
+        PersistedForkChoice written on shutdown/finalization)."""
+        self.store.persist_fork_choice(self.fork_choice.to_bytes())
+        self.store.persist_head(self.head_root)
+
+    def try_resume(self) -> bool:
+        """Restore fork choice + head from a previous run's snapshot.
+        Returns True when the snapshot was coherent and adopted."""
+        from lighthouse_tpu.fork_choice.fork_choice import ForkChoice
+
+        blob = self.store.load_fork_choice()
+        head = self.store.load_head()
+        if blob is None or head is None:
+            return False
+        try:
+            fc = ForkChoice.from_bytes(
+                self.spec, blob, balances_fn=self._balances_for_checkpoint)
+            if head not in fc.proto:
+                return False
+            head_state = self.state_for_block(head)
+            if head_state is None:
+                return False
+        except Exception:
+            return False  # corrupt snapshot: fall back to fresh sync
+        self.fork_choice = fc
+        self.head_root = head
+        self.head_state = head_state
+        # finalization migration already ran for the persisted epoch; a
+        # stale marker would re-migrate (and re-prune) on the very first
+        # head recompute after every restart
+        self._migrated_finalized_epoch = fc.finalized.epoch
+        return True
+
     def _on_finalized(self):
         """Prune fork choice + migrate the store (reference migrate.rs)."""
         fin = self.fork_choice.finalized
@@ -427,6 +461,7 @@ class BeaconChain:
         self.fork_choice.prune()
         self.store.migrate_to_finalized(
             bytes(fin_block.message.state_root), fin.root)
+        self.persist()
         self._migrated_finalized_epoch = fin.epoch
         fin_slot = self.spec.compute_start_slot_at_epoch(fin.epoch)
         self.da_checker.prune_finalized(fin_slot)

@@ -62,6 +62,13 @@ class Client:
         if self.http_server is not None:
             self.http_server.stop()
         self.executor.shutdown("client stop")
+        # snapshot fork choice + head AFTER the workers stop so a
+        # mid-import mutation can't tear the snapshot (reference persists
+        # on shutdown)
+        try:
+            self.chain.persist()
+        except Exception:
+            pass
         if self.lockfile is not None:
             self.lockfile.release()
 
@@ -223,6 +230,20 @@ class ClientBuilder:
             # persist the checkpoint anchor block so sync/API can serve it
             self.chain.store.put_block(
                 self.chain.genesis_block_root, self._anchor_block)
+        if self.config.datadir:
+            # disk-backed nodes resume a prior run's fork choice + head
+            if self.chain.try_resume():
+                # the fresh interop genesis above may carry a NEW
+                # genesis_time; the resumed chain's slots are anchored at
+                # the PERSISTED genesis — realign the wall clock or every
+                # duty/sync computation runs against the wrong slot
+                chain = self.chain
+                chain.slot_clock = type(chain.slot_clock)(
+                    chain.fork_choice.genesis_time,
+                    self.spec.seconds_per_slot)
+                self.log.info(
+                    "resumed from disk",
+                    head_slot=int(self.chain.head_state.slot))
         if self._eth1 is not None:
             self.chain.eth1_service = self._eth1
         if self.config.slasher_enabled:

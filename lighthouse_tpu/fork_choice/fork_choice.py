@@ -432,3 +432,145 @@ class ForkChoice:
     def block_slot(self, root: bytes) -> int | None:
         i = self.proto.indices.get(root)
         return int(self.proto.slots[i]) if i is not None else None
+
+    # -- persistence (reference PersistedForkChoice / proto_array
+    # ssz_container.rs — here the columnar arrays snapshot as npz + json
+    # since the store IS struct-of-arrays) --------------------------------
+
+    def to_bytes(self) -> bytes:
+        import io
+        import json as _json
+
+        import numpy as _np
+
+        # an applied proposer boost lives inside proto.weights; a restart
+        # must not inherit it (boosts are one-slot) — unapply via the
+        # same delta path get_head uses before snapshotting
+        if self._applied_boost_root is not None:
+            i = self.proto.indices.get(self._applied_boost_root)
+            if i is not None:
+                deltas = _np.zeros(self.proto.n_nodes, _np.int64)
+                deltas[i] = -self._applied_boost_amount
+                self.proto.apply_score_changes(
+                    deltas, self.justified, self.finalized,
+                    self.spec.compute_epoch_at_slot(self.time_slot))
+            self._applied_boost_root = None
+            self._applied_boost_amount = 0
+            self.proposer_boost_root = None
+
+        n = self.proto.n_nodes
+        buf = io.BytesIO()
+        _np.savez(
+            buf,
+            slots=self.proto.slots[:n],
+            parents=self.proto.parents[:n],
+            weights=self.proto.weights[:n],
+            best_child=self.proto.best_child[:n],
+            best_descendant=self.proto.best_descendant[:n],
+            justified_epoch=self.proto.justified_epoch[:n],
+            finalized_epoch=self.proto.finalized_epoch[:n],
+            unrealized_justified_epoch=(
+                self.proto.unrealized_justified_epoch[:n]),
+            unrealized_finalized_epoch=(
+                self.proto.unrealized_finalized_epoch[:n]),
+            execution_status=self.proto.execution_status[:n],
+            vote_current=self._vote_current,
+            vote_next=self._vote_next,
+            vote_next_epoch=self._vote_next_epoch,
+            old_balances=self._old_balances,
+            equivocating=self.equivocating,
+            justified_balances=self.justified_balances,
+        )
+        meta = _json.dumps({
+            "roots": [r.hex() for r in self.proto.roots[:n]],
+            "justified_roots": [
+                r.hex() for r in self.proto.justified_roots[:n]],
+            "unrealized_justified_roots": [
+                r.hex() for r in self.proto.unrealized_justified_roots[:n]],
+            "justified": [self.justified.epoch, self.justified.root.hex()],
+            "finalized": [self.finalized.epoch, self.finalized.root.hex()],
+            "best_unrealized_j": [self._best_unrealized_j.epoch,
+                                  self._best_unrealized_j.root.hex()],
+            "best_unrealized_f": [self._best_unrealized_f.epoch,
+                                  self._best_unrealized_f.root.hex()],
+            "time_slot": self.time_slot,
+            "genesis_time": self.genesis_time,
+        }).encode()
+        arrays = buf.getvalue()
+        return len(meta).to_bytes(8, "little") + meta + arrays
+
+    @classmethod
+    def from_bytes(cls, spec, data: bytes,
+                   balances_fn=None) -> "ForkChoice":
+        import io
+        import json as _json
+
+        import numpy as _np
+
+        meta_len = int.from_bytes(data[:8], "little")
+        meta = _json.loads(data[8:8 + meta_len])
+        arrays = _np.load(io.BytesIO(data[8 + meta_len:]))
+
+        fc = cls.__new__(cls)
+        fc.spec = spec
+        fc.proto = ProtoArray()
+        n = len(meta["roots"])
+        grow = max(((n + ProtoArray._GROW - 1)
+                    // ProtoArray._GROW) * ProtoArray._GROW,
+                   ProtoArray._GROW)
+
+        def col(name, dtype, fill=0):
+            out = _np.full(grow, fill, dtype)
+            out[:n] = arrays[name]
+            return out
+
+        p = fc.proto
+        p.n_nodes = n
+        p.slots = col("slots", _np.int64)
+        p.parents = col("parents", _np.int32, NONE)
+        p.weights = col("weights", _np.int64)
+        p.best_child = col("best_child", _np.int32, NONE)
+        p.best_descendant = col("best_descendant", _np.int32, NONE)
+        p.justified_epoch = col("justified_epoch", _np.int64)
+        p.finalized_epoch = col("finalized_epoch", _np.int64)
+        p.unrealized_justified_epoch = col(
+            "unrealized_justified_epoch", _np.int64)
+        p.unrealized_finalized_epoch = col(
+            "unrealized_finalized_epoch", _np.int64)
+        p.execution_status = col("execution_status", _np.int8)
+        p.roots = [bytes.fromhex(r) for r in meta["roots"]]
+        p.indices = {r: i for i, r in enumerate(p.roots)}
+        p.justified_roots = [
+            bytes.fromhex(r) for r in meta["justified_roots"]]
+        p.unrealized_justified_roots = [
+            bytes.fromhex(r) for r in meta["unrealized_justified_roots"]]
+
+        fc.time_slot = int(meta["time_slot"])
+        fc.genesis_time = int(meta["genesis_time"])
+        fc.justified = CheckpointKey(
+            int(meta["justified"][0]), bytes.fromhex(meta["justified"][1]))
+        fc.finalized = CheckpointKey(
+            int(meta["finalized"][0]), bytes.fromhex(meta["finalized"][1]))
+        fc._best_unrealized_j = CheckpointKey(
+            int(meta["best_unrealized_j"][0]),
+            bytes.fromhex(meta["best_unrealized_j"][1]))
+        fc._best_unrealized_f = CheckpointKey(
+            int(meta["best_unrealized_f"][0]),
+            bytes.fromhex(meta["best_unrealized_f"][1]))
+        fc._balances_fn = balances_fn
+        fc.justified_balances = _np.asarray(
+            arrays["justified_balances"], _np.int64)
+        # checkpoint-balances cache: reseeded from the snapshot's
+        # justified balances, refilled lazily via balances_fn
+        fc._balance_snapshots = {fc.justified.root: fc.justified_balances}
+        fc._vote_current = _np.asarray(arrays["vote_current"], _np.int32)
+        fc._vote_next = _np.asarray(arrays["vote_next"], _np.int32)
+        fc._vote_next_epoch = _np.asarray(
+            arrays["vote_next_epoch"], _np.int64)
+        fc._old_balances = _np.asarray(arrays["old_balances"], _np.int64)
+        fc.equivocating = _np.asarray(arrays["equivocating"], bool)
+        fc.proposer_boost_root = None       # boosts never survive restart
+        fc._applied_boost_root = None
+        fc._applied_boost_amount = 0
+        fc._queued = []
+        return fc
