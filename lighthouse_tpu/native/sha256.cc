@@ -1,0 +1,242 @@
+// Batched SHA-256 pair hashing for the host merkleization path.
+//
+// TPU-native counterpart of the reference's `ethereum_hashing` CPU
+// backends (vectorized sha2 under /root/reference's tree_hash stack):
+// the device folds big trees (ops/sha256.py); THIS is the host half that
+// hashes small/irregular worklists — dirty tree-cache nodes, proof
+// checks, control-plane containers — where a Python/numpy SHA round
+// trip costs more than the hash.  One FFI crossing per BATCH of 64-byte
+// inputs; x86 SHA-NI when the CPU has it, portable C++ otherwise.
+//
+// exported ABI:
+//   int sha256_pairs(const uint8_t* in, size_t n, uint8_t* out)
+//     in:  n * 64 bytes (pairs of 32-byte nodes)
+//     out: n * 32 bytes
+//   int sha256_has_ni(void)
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t H0[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+// the (fixed) padding block for a 64-byte message: 0x80, zeros, len=512
+constexpr uint32_t PAD_W[16] = {
+    0x80000000, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 512};
+
+inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+void compress_portable(uint32_t state[8], const uint32_t w_in[16]) {
+  uint32_t w[64];
+  std::memcpy(w, w_in, 64);
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+    uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[t] + w[t];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + mj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+inline uint32_t load_be(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline void store_be(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24); p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8); p[3] = uint8_t(v);
+}
+
+void hash_one_portable(const uint8_t* in, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, H0, 32);
+  uint32_t w[16];
+  for (int i = 0; i < 16; ++i) w[i] = load_be(in + 4 * i);
+  compress_portable(st, w);
+  compress_portable(st, PAD_W);
+  for (int i = 0; i < 8; ++i) store_be(out + 4 * i, st[i]);
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sha,sse4.1")))
+void compress_ni(uint32_t state[8], const uint8_t* data, const bool pad) {
+  // SHA-NI two-lane message schedule (standard intrinsic pattern)
+  __m128i STATE0, STATE1, MSG, TMP, MSG0, MSG1, MSG2, MSG3;
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+
+  TMP = _mm_loadu_si128((const __m128i*)&state[0]);
+  STATE1 = _mm_loadu_si128((const __m128i*)&state[4]);
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);
+  STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);
+
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+
+  if (pad) {
+    // the fixed padding block, already big-endian words
+    MSG0 = _mm_set_epi32(0, 0, 0, 0x80000000);
+    MSG1 = _mm_setzero_si128();
+    MSG2 = _mm_setzero_si128();
+    MSG3 = _mm_set_epi32(512, 0, 0, 0);
+  } else {
+    MSG0 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 0)), MASK);
+    MSG1 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 16)), MASK);
+    MSG2 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 32)), MASK);
+    MSG3 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 48)), MASK);
+  }
+
+#define KPAIR(i) \
+  ((int64_t(int64_t(K[2 * (i) + 1]) << 32) | uint32_t(K[2 * (i)])))
+#define RND4(M, i)                                              \
+  MSG = _mm_add_epi32(M, _mm_set_epi64x(KPAIR(2 * (i) + 1), KPAIR(2 * (i)))); \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);          \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                           \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG)
+
+  RND4(MSG0, 0);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+  RND4(MSG1, 1);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+  RND4(MSG2, 2);
+  MSG0 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG0, _mm_alignr_epi8(MSG3, MSG2, 4)), MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+  RND4(MSG3, 3);
+  MSG1 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG1, _mm_alignr_epi8(MSG0, MSG3, 4)), MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+  RND4(MSG0, 4);
+  MSG2 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG2, _mm_alignr_epi8(MSG1, MSG0, 4)), MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+  RND4(MSG1, 5);
+  MSG3 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG3, _mm_alignr_epi8(MSG2, MSG1, 4)), MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+  RND4(MSG2, 6);
+  MSG0 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG0, _mm_alignr_epi8(MSG3, MSG2, 4)), MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+  RND4(MSG3, 7);
+  MSG1 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG1, _mm_alignr_epi8(MSG0, MSG3, 4)), MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+  RND4(MSG0, 8);
+  MSG2 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG2, _mm_alignr_epi8(MSG1, MSG0, 4)), MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+  RND4(MSG1, 9);
+  MSG3 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG3, _mm_alignr_epi8(MSG2, MSG1, 4)), MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+  RND4(MSG2, 10);
+  MSG0 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG0, _mm_alignr_epi8(MSG3, MSG2, 4)), MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+  RND4(MSG3, 11);
+  MSG1 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG1, _mm_alignr_epi8(MSG0, MSG3, 4)), MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+  RND4(MSG0, 12);
+  MSG2 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG2, _mm_alignr_epi8(MSG1, MSG0, 4)), MSG1);
+  RND4(MSG1, 13);
+  MSG3 = _mm_sha256msg2_epu32(
+      _mm_add_epi32(MSG3, _mm_alignr_epi8(MSG2, MSG1, 4)), MSG2);
+  RND4(MSG2, 14);
+  RND4(MSG3, 15);
+#undef RND4
+#undef KPAIR
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);
+
+  _mm_storeu_si128((__m128i*)&state[0], STATE0);
+  _mm_storeu_si128((__m128i*)&state[4], STATE1);
+}
+
+__attribute__((target("sha,sse4.1")))
+void hash_one_ni(const uint8_t* in, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, H0, 32);
+  compress_ni(st, in, false);
+  compress_ni(st, nullptr, true);
+  for (int i = 0; i < 8; ++i) store_be(out + 4 * i, st[i]);
+}
+
+bool cpu_has_sha() {
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("sha");
+}
+#else
+bool cpu_has_sha() { return false; }
+#endif
+
+}  // namespace
+
+extern "C" {
+
+int sha256_has_ni() { return cpu_has_sha() ? 1 : 0; }
+
+int sha256_pairs(const uint8_t* in, size_t n, uint8_t* out) {
+  if (!in || !out) return -1;
+#if defined(__x86_64__)
+  if (cpu_has_sha()) {
+    for (size_t i = 0; i < n; ++i)
+      hash_one_ni(in + 64 * i, out + 32 * i);
+    return 0;
+  }
+#endif
+  for (size_t i = 0; i < n; ++i)
+    hash_one_portable(in + 64 * i, out + 32 * i);
+  return 0;
+}
+
+}  // extern "C"

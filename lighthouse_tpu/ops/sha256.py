@@ -190,11 +190,48 @@ def fold_levels(leaves: np.ndarray, *, device: bool | None = None) -> list[np.nd
     return out
 
 
+# native SHA-NI batch hasher (native/sha256.cc): ~8x a hashlib loop on
+# x86 with the sha extension; loaded lazily, any failure leaves the
+# hashlib path in place
+_NATIVE_SHA = None
+_NATIVE_SHA_TRIED = False
+
+
+def _native_sha():
+    global _NATIVE_SHA, _NATIVE_SHA_TRIED
+    if _NATIVE_SHA_TRIED:
+        return _NATIVE_SHA
+    _NATIVE_SHA_TRIED = True
+    try:
+        import ctypes
+
+        from lighthouse_tpu.native import build_shared_lib
+
+        lib = ctypes.CDLL(str(build_shared_lib("sha256.cc")))
+        lib.sha256_pairs.restype = ctypes.c_int
+        lib.sha256_pairs.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        _NATIVE_SHA = lib
+    except Exception:
+        _NATIVE_SHA = None
+    return _NATIVE_SHA
+
+
 def hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
-    """hashlib fallback with identical semantics (uint32[N,16] -> uint32[N,8])."""
-    out = np.empty((pairs.shape[0], 8), dtype=np.uint32)
+    """Host pair hashing (uint32[N,16] -> uint32[N,8]): one FFI crossing
+    into the SHA-NI batch kernel, hashlib loop as the fallback."""
+    n = pairs.shape[0]
     data = pairs.astype(">u4").tobytes()
-    for i in range(pairs.shape[0]):
+    lib = _native_sha()
+    if lib is not None and n:
+        import ctypes
+
+        out_buf = ctypes.create_string_buffer(n * 32)
+        if lib.sha256_pairs(data, n, out_buf) == 0:
+            return np.frombuffer(
+                out_buf.raw, dtype=">u4").astype(np.uint32).reshape(n, 8)
+    out = np.empty((n, 8), dtype=np.uint32)
+    for i in range(n):
         out[i] = np.frombuffer(
             hashlib.sha256(data[64 * i: 64 * (i + 1)]).digest(), dtype=">u4"
         )
