@@ -64,6 +64,20 @@ def _build_parser() -> argparse.ArgumentParser:
     wc.add_argument("--name", required=True)
     wc.add_argument("--password", required=True)
     wc.add_argument("--out", required=True, help="wallet JSON output path")
+    wr = am_sub.add_parser("wallet-recover",
+                           help="recover a wallet from a BIP-39 mnemonic")
+    wr.add_argument("--name", required=True)
+    wr.add_argument("--password", required=True)
+    wr.add_argument("--mnemonic", required=True)
+    wr.add_argument("--passphrase", default="")
+    wr.add_argument("--out", required=True)
+    vexit = am_sub.add_parser(
+        "validator-exit", help="sign + publish a voluntary exit")
+    vexit.add_argument("--keystore", required=True)
+    vexit.add_argument("--password", required=True)
+    vexit.add_argument("--validator-index", type=int, required=True)
+    vexit.add_argument("--epoch", type=int, required=True)
+    vexit.add_argument("--beacon-node", default="http://127.0.0.1:5052")
     vcreate = am_sub.add_parser("validator-create")
     vcreate.add_argument("--wallet", required=True)
     vcreate.add_argument("--wallet-password", required=True)
@@ -80,6 +94,17 @@ def _build_parser() -> argparse.ArgumentParser:
     imp.add_argument("--out", required=True,
                      help="validator_definitions.json output")
     vm_sub.add_parser("list").add_argument("--definitions", required=True)
+    mv = vm_sub.add_parser(
+        "move", help="move validators between VCs via their keymanager "
+                     "APIs (delete+export from source, import to dest)")
+    mv.add_argument("--src-url", required=True)
+    mv.add_argument("--src-token", required=True)
+    mv.add_argument("--dest-url", required=True)
+    mv.add_argument("--dest-token", required=True)
+    mv.add_argument("--pubkeys", required=True, nargs="+")
+    mv.add_argument("--password", required=True,
+                    help="transport password the moved keystores are "
+                         "re-encrypted under")
 
     db = sub.add_parser("db", help="database inspection/maintenance")
     db_sub = db.add_subparsers(dest="db_command", required=True)
@@ -205,6 +230,58 @@ def _run_account_manager(args) -> int:
             json.dump(w.data, f)
         print(json.dumps({"wallet": args.name, "path": args.out}))
         return 0
+    if args.am_command == "wallet-recover":
+        w = Wallet.recover(args.name, args.password, args.mnemonic,
+                           args.passphrase)
+        with open(args.out, "w") as f:
+            json.dump(w.data, f)
+        print(json.dumps({"wallet": args.name, "path": args.out,
+                          "recovered": True}))
+        return 0
+    if args.am_command == "validator-exit":
+        from lighthouse_tpu.api import BeaconNodeClient
+        from lighthouse_tpu.client.network_config import spec_for_network
+        from lighthouse_tpu.crypto import bls, keystore as ks
+        from lighthouse_tpu import types as T
+        from lighthouse_tpu.state_transition import misc
+
+        with open(args.keystore) as f:
+            keystore = json.load(f)
+        sk = bls.SecretKey.from_bytes(ks.decrypt(keystore, args.password))
+        bn = BeaconNodeClient(args.beacon_node)
+        genesis = bn.genesis()
+        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+        spec = spec_for_network(args.network)
+        exit_msg = T.VoluntaryExit(
+            epoch=args.epoch, validator_index=args.validator_index)
+        # the NODE verifies with the domain rule for ITS current fork
+        # (signature_sets.voluntary_exit_set), so the signer must key off
+        # the chain head, not the exit's epoch
+        head = bn.header("head")
+        head_slot = int(head["header"]["message"]["slot"])
+        fork_now = spec.fork_at_epoch(
+            spec.compute_epoch_at_slot(head_slot))
+        if T.ChainSpec.fork_at_least(fork_now, "deneb"):
+            version = spec.fork_version("capella")  # EIP-7044
+        elif args.epoch < spec.fork_epoch(fork_now):
+            # server get_domain: previous fork version for pre-boundary
+            # epochs
+            from lighthouse_tpu.types.spec import FORKS
+
+            prev = FORKS[max(FORKS.index(fork_now) - 1, 0)]
+            version = spec.fork_version(prev)
+        else:
+            version = spec.fork_version(fork_now)
+        domain = misc.compute_domain(
+            spec.domain_voluntary_exit, version, gvr)
+        root = misc.compute_signing_root(exit_msg.hash_tree_root(), domain)
+        signed = T.SignedVoluntaryExit(
+            message=exit_msg, signature=sk.sign(root).to_bytes())
+        bn._call("POST", "/eth/v1/beacon/pool/voluntary_exits",
+                 {"ssz_hex": signed.serialize().hex()})
+        print(json.dumps({"exit_published": args.validator_index,
+                          "epoch": args.epoch}))
+        return 0
     if args.am_command == "validator-create":
         import os
 
@@ -250,6 +327,53 @@ def _run_validator_manager(args) -> int:
         with open(args.out, "w") as f:
             json.dump(defs, f, indent=2)
         print(json.dumps({"imported": len(defs)}))
+        return 0
+    if args.vm_command == "move":
+        import urllib.request
+
+        def call(url, token, method, path, body=None):
+            req = urllib.request.Request(
+                url + path, method=method,
+                data=json.dumps(body).encode() if body else None,
+                headers={"Authorization": f"Bearer {token}",
+                         "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        # 1. export from the source VC (keys re-encrypted + EIP-3076);
+        # keep (pubkey, keystore) ALIGNED — a missing key must not shift
+        # later pairings
+        exported = call(args.src_url, args.src_token, "POST",
+                        "/lighthouse/validators/export",
+                        {"pubkeys": args.pubkeys,
+                         "password": args.password})
+        pairs = [(pk, k) for pk, k in zip(args.pubkeys, exported["data"])
+                 if k is not None]
+        if not pairs:
+            raise SystemExit("no requested keys exist on the source VC")
+        # 2. import to the destination VC with the slashing history
+        imported = call(args.dest_url, args.dest_token, "POST",
+                        "/eth/v1/keystores",
+                        {"keystores": [k for _, k in pairs],
+                         "passwords": [args.password] * len(pairs),
+                         "slashing_protection":
+                             exported["slashing_protection"]})
+        # 3. delete from the source ONLY the keys the destination
+        # confirmed — a failed import must never orphan a key
+        confirmed = [pk for (pk, _), st_ in
+                     zip(pairs, imported["data"])
+                     if st_["status"] == "imported"]
+        deleted = {"data": []}
+        if confirmed:
+            deleted = call(args.src_url, args.src_token, "DELETE",
+                           "/eth/v1/keystores", {"pubkeys": confirmed})
+        print(json.dumps({
+            "moved": len(confirmed),
+            "deleted": sum(1 for s_ in deleted["data"]
+                           if s_["status"] == "deleted"),
+            "failed": [st_ for st_ in imported["data"]
+                       if st_["status"] != "imported"],
+        }))
         return 0
     if args.vm_command == "list":
         with open(args.definitions) as f:

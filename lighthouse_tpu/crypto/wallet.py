@@ -37,6 +37,35 @@ class Wallet:
             "version": 1,
         })
 
+    @staticmethod
+    def recover(name: str, password: str, mnemonic: str,
+                passphrase: str = "") -> "Wallet":
+        """Recover a wallet from a BIP-39 mnemonic: the seed is
+        PBKDF2-HMAC-SHA512(mnemonic, "mnemonic"+passphrase, 2048) per the
+        BIP-39 derivation (the wordlist is only needed to GENERATE
+        phrases, not to derive the seed), so real mnemonics recover the
+        same keys here as in the reference's account manager."""
+        import hashlib as _hashlib
+        import unicodedata
+
+        words = mnemonic.split()
+        # structural BIP-39 validation: valid phrases are 12..24 words in
+        # steps of 3, lowercase ascii.  (Checksum validation needs the
+        # 2048-word list, which is not embedded — a wrong word therefore
+        # derives a DIFFERENT wallet rather than erroring; spot-check the
+        # first derived pubkey against your records.)
+        if len(words) not in (12, 15, 18, 21, 24):
+            raise WalletError(
+                f"mnemonic must be 12/15/18/21/24 words, got {len(words)}")
+        if not all(w.isalpha() and w.islower() and w.isascii()
+                   for w in words):
+            raise WalletError("mnemonic words must be lowercase ascii")
+        norm = unicodedata.normalize("NFKD", " ".join(words))
+        salt = unicodedata.normalize("NFKD", "mnemonic" + passphrase)
+        seed = _hashlib.pbkdf2_hmac(
+            "sha512", norm.encode(), salt.encode(), 2048)
+        return Wallet.create(name, password, seed=seed)
+
     def decrypt_seed(self, password: str) -> bytes:
         shell = {"crypto": self.data["crypto"], "version": 4}
         return ks.decrypt(shell, password)

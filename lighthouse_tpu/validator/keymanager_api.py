@@ -56,6 +56,32 @@ class KeymanagerApi:
                 else interchange)
         return {"data": statuses}
 
+    def export_keystores(self, body: dict):
+        """Lighthouse-style export (the reference's lighthouse_vc
+        extension backing `validator-manager move`): re-encrypt the
+        requested keys under the caller's password + attach the EIP-3076
+        history.  Keys remain in the store (the mover deletes after a
+        successful import on the destination)."""
+        from lighthouse_tpu.crypto import keystore as ks
+
+        pubkeys = [bytes.fromhex(p.removeprefix("0x"))
+                   for p in body.get("pubkeys", [])]
+        password = body["password"]
+        out = []
+        for pk in pubkeys:
+            v = self.store.validators.get(pk)
+            if v is None:
+                out.append(None)
+                continue
+            out.append(ks.encrypt(
+                v.secret_key.to_bytes(), password, kdf="pbkdf2"))
+        interchange = self.store.slashing_db.export_interchange()
+        interchange["data"] = [
+            r for r in interchange.get("data", [])
+            if bytes.fromhex(r["pubkey"].removeprefix("0x")) in pubkeys]
+        return {"data": out,
+                "slashing_protection": json.dumps(interchange)}
+
     def delete_keystores(self, body: dict):
         pubkeys = [bytes.fromhex(p.removeprefix("0x"))
                    for p in body.get("pubkeys", [])]
@@ -134,6 +160,10 @@ class KeymanagerServer:
                     return self._reply(401, {"message": "unauthorized"})
                 path = self.path.rstrip("/")
                 try:
+                    if path == "/lighthouse/validators/export":
+                        if method == "POST":
+                            return self._reply(
+                                200, api.export_keystores(self._body()))
                     if path == "/eth/v1/keystores":
                         if method == "GET":
                             return self._reply(200, api.list_keystores())

@@ -93,3 +93,55 @@ class TestKeymanager:
         got = _call(server, api, "GET",
                     f"/eth/v1/validator/{pk_hex}/graffiti")
         assert got["data"]["graffiti"] == "hello"
+
+
+def test_validator_manager_move_between_vcs():
+    """`validator-manager move`: export (re-encrypted keys + EIP-3076)
+    from one VC, import to another, delete from the source."""
+    from lighthouse_tpu.cli import main as cli_main
+    from lighthouse_tpu.testing import Harness
+
+    h = Harness(8, real_crypto=False)
+    gvr = bytes(h.state.genesis_validators_root)
+    src_store = ValidatorStore(h.spec, gvr)
+    dst_store = ValidatorStore(h.spec, gvr)
+    sk = bls.SecretKey.generate()
+    pk = src_store.add_validator(sk)
+    # sign a block so slashing history must travel
+    blk = type("B", (), {"slot": 7, "hash_tree_root":
+                         staticmethod(lambda: b"\x21" * 32)})()
+    src_store.sign_block(pk, blk)
+
+    src_api = KeymanagerApi(src_store)
+    dst_api = KeymanagerApi(dst_store)
+    src_srv = KeymanagerServer(src_api).start()
+    dst_srv = KeymanagerServer(dst_api).start()
+    try:
+        rc = cli_main([
+            "validator-manager", "move",
+            "--src-url", f"http://127.0.0.1:{src_srv.port}",
+            "--src-token", src_api.token,
+            "--dest-url", f"http://127.0.0.1:{dst_srv.port}",
+            "--dest-token", dst_api.token,
+            "--pubkeys", "0x" + pk.hex(),
+            "--password", "movepw"])
+        assert rc == 0
+        assert pk not in src_store.validators
+        assert pk in dst_store.validators
+        # the moved key signs with the same secret
+        assert dst_store.validators[pk].secret_key.to_bytes() == \
+            sk.to_bytes()
+        # slashing history traveled: double-signing a DIFFERENT block at
+        # the same slot on the destination is refused
+        from lighthouse_tpu.validator.slashing_protection import (
+            SlashingProtectionError,
+        )
+        import pytest as _pytest
+
+        other = type("B", (), {"slot": 7, "hash_tree_root":
+                               staticmethod(lambda: b"\x22" * 32)})()
+        with _pytest.raises(SlashingProtectionError):
+            dst_store.sign_block(pk, other)
+    finally:
+        src_srv.stop()
+        dst_srv.stop()
