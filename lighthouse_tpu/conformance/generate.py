@@ -385,6 +385,130 @@ def gen_transitions(root: str, config: str, spec: T.ChainSpec,
         _w(path, "meta.yaml", {"fork": fork})
 
 
+
+
+# -- fork_choice scripted cases ---------------------------------------------
+
+def gen_fork_choice(root: str, config: str, spec: T.ChainSpec,
+                    fork: str) -> None:
+    """Scripted on_block/on_attestation sequences with head/justified
+    checks (reference ef_tests fork_choice handler).  Outcomes are
+    regression pins recorded from a live harness chain; the scripted
+    REPLAY in the runner re-drives them through the full import
+    pipeline."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.state_transition import state_transition
+    from lighthouse_tpu.testing import Harness
+
+    prev_backend = bls.get_backend()
+    bls.set_backend("fake")
+    try:
+        t = T.make_types(spec.preset)
+        state_t = t.beacon_state_class(fork).as_ssz_type()
+        signed_t = t.signed_beacon_block_class(fork).as_ssz_type()
+
+        # case 1: linear chain, head follows each block
+        h = Harness(n_validators=16, spec=spec, fork=fork,
+                    real_crypto=False)
+        anchor = h.state.copy()
+        chain = BeaconChain(spec, h.state.copy(), verify_signatures=False)
+        steps = []
+        path = _case(root, config, fork, "fork_choice", "on_block",
+                     "pyspec_tests", "linear_chain")
+        for i in range(3):
+            signed = h.produce_block()
+            state_transition(h.state, spec, signed, h._verify_strategy())
+            slot = int(signed.message.slot)
+            chain.slot_clock.set_slot(slot)
+            root_hex = chain.process_block(signed).hex()
+            _w(path, f"block_{i}.ssz", signed_t.serialize(signed))
+            steps.append({"tick_slot": slot})
+            steps.append({"block": f"block_{i}",
+                          "checks": {"head": "0x" + root_hex}})
+        _w(path, "anchor_state.ssz", state_t.serialize(anchor))
+        _w(path, "steps.yaml", steps)
+        _w(path, "meta.yaml", {"fork": fork})
+
+        # case 2: competing blocks; attestations decide the head
+        h2 = Harness(n_validators=16, spec=spec, fork=fork,
+                     real_crypto=False)
+        anchor2 = h2.state.copy()
+        chain2 = BeaconChain(spec, h2.state.copy(),
+                             verify_signatures=False)
+        pre = h2.state.copy()
+        block_a = h2.produce_block()
+        # a competing variant at the same slot (different graffiti)
+        h2.state = pre.copy()
+        b_msg = t.beacon_block_class(fork).as_ssz_type().deserialize(
+            t.beacon_block_class(fork).as_ssz_type().serialize(
+                block_a.message))
+        b_msg.body.graffiti = b"fork-b".ljust(32, b"\x00")
+        # recompute the post-state root for the altered body
+        trial = pre.copy()
+        from lighthouse_tpu.state_transition import (
+            SignatureStrategy,
+            process_block,
+            state_advance,
+        )
+
+        state_advance(trial, spec, int(b_msg.slot))
+        b_msg.state_root = b"\x00" * 32
+        trial_signed = t.signed_beacon_block_class(fork)(
+            message=b_msg, signature=b"\xab" * 96)
+        process_block(trial, spec, trial_signed,
+                      SignatureStrategy.NO_VERIFICATION)
+        b_msg.state_root = trial.hash_tree_root()
+        block_b = t.signed_beacon_block_class(fork)(
+            message=b_msg, signature=b"\xab" * 96)
+
+        slot = int(block_a.message.slot)
+        chain2.slot_clock.set_slot(slot)
+        chain2.process_block(block_a, source="rpc")
+        chain2.process_block(block_b, source="rpc")
+        head_pre_votes = chain2.head_root
+        # every committee member attests to the OTHER branch
+        loser = (block_b if head_pre_votes
+                 == block_a.message.hash_tree_root() else block_a)
+        h2.state = pre.copy()
+        state_transition(h2.state, spec, loser, h2._verify_strategy())
+        chain2.slot_clock.set_slot(slot + 1)
+        att = h2.attest(slot=slot)
+        # single-committee aggregate split into per-validator bits for
+        # the gossip pipeline
+        att_files = []
+        n_bits = len(att.aggregation_bits)
+        for pos in range(n_bits):
+            bits = [i == pos for i in range(n_bits)]
+            single = type(att)(aggregation_bits=bits, data=att.data,
+                              signature=bytes(att.signature))
+            verified, _ = chain2.verify_attestations_for_gossip([single])
+            if verified:
+                att_files.append(single)
+        head_post = chain2.fork_choice.get_head(slot + 1)
+        path2 = _case(root, config, fork, "fork_choice", "on_attestation",
+                      "pyspec_tests", "attestations_reorg")
+        _w(path2, "anchor_state.ssz", state_t.serialize(anchor2))
+        _w(path2, "block_a.ssz", signed_t.serialize(block_a))
+        _w(path2, "block_b.ssz", signed_t.serialize(block_b))
+        steps2 = [
+            {"tick_slot": slot},
+            {"block": "block_a"},
+            {"block": "block_b",
+             "checks": {"head": "0x" + head_pre_votes.hex()}},
+            {"tick_slot": slot + 1},
+        ]
+        for i, single in enumerate(att_files):
+            att_t = type(single).as_ssz_type()
+            _w(path2, f"att_{i}.ssz", att_t.serialize(single))
+            steps2.append({"attestation": f"att_{i}"})
+        steps2.append({"tick_slot": slot + 2,
+                       "checks": {"head": "0x" + head_post.hex()}})
+        _w(path2, "steps.yaml", steps2)
+        _w(path2, "meta.yaml", {"fork": fork})
+    finally:
+        bls.set_backend(prev_backend)
+
+
 def generate_tree(root: str,
                   forks: tuple = ("phase0", "altair", "bellatrix",
                                   "capella", "deneb", "electra"),
@@ -398,6 +522,8 @@ def generate_tree(root: str,
         spec = spec_base.with_forks_at(0, through=fork)
         gen_ssz_static(root, config, spec, fork)
         gen_transitions(root, config, spec, fork)
+        if fork == "altair":
+            gen_fork_choice(root, config, spec, fork)
     return root
 
 
