@@ -61,6 +61,8 @@ class BeaconApi:
           self.aggregate_attestation)
         r("POST", r"/eth/v1/validator/aggregate_and_proofs",
           self.publish_aggregates)
+        r("POST", r"/eth/v1/validator/beacon_committee_subscriptions",
+          self.committee_subscriptions)
         r("GET", r"/eth/v1/beacon/light_client/bootstrap/(?P<block_root>0x\w+)",
           self.lc_bootstrap)
         r("GET", r"/eth/v1/beacon/light_client/optimistic_update",
@@ -470,6 +472,19 @@ class BeaconApi:
         aggs = [cls.deserialize(bytes.fromhex(r)) for r in raws]
         verified, rejects = c.verify_aggregates_for_gossip(aggs)
         return {"data": {"accepted": len(verified)}}
+
+    def committee_subscriptions(self, body=None):
+        """VC subnet subscriptions (reference subnet_service
+        validator_subscriptions): aggregator duties open short-lived
+        subnet windows on the scheduler."""
+        svc = getattr(self.chain, "subnet_service", None)
+        subs = json.loads(body or b"[]")
+        if svc is not None:
+            for sub in subs:
+                svc.subscribe_for_duty(
+                    int(sub["slot"]), int(sub["committee_index"]),
+                    bool(sub.get("is_aggregator", False)))
+        return {"data": {"accepted": len(subs)}}
 
     def lc_bootstrap(self, block_root, body=None):
         try:

@@ -439,9 +439,7 @@ def gen_fork_choice(root: str, config: str, spec: T.ChainSpec,
         block_a = h2.produce_block()
         # a competing variant at the same slot (different graffiti)
         h2.state = pre.copy()
-        b_msg = t.beacon_block_class(fork).as_ssz_type().deserialize(
-            t.beacon_block_class(fork).as_ssz_type().serialize(
-                block_a.message))
+        b_msg = block_a.message.copy()
         b_msg.body.graffiti = b"fork-b".ljust(32, b"\x00")
         # recompute the post-state root for the altered body
         trial = pre.copy()

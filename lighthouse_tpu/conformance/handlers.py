@@ -392,7 +392,12 @@ def fork_choice_scripted(ctx: Ctx, case, _name):
             elif "attestation" in step:
                 raw = case.ssz(step["attestation"])
                 att = _as_type(t.Attestation).deserialize(raw)
-                chain.verify_attestations_for_gossip([att])
+                verified, rejects = \
+                    chain.verify_attestations_for_gossip([att])
+                ok = bool(verified)
+                assert ok == step.get("valid", True), (
+                    f"attestation {step['attestation']} validity "
+                    f"mismatch: {[r for _, r in rejects]}")
             if "checks" in step:
                 checks = step["checks"]
                 if "head" in checks:

@@ -25,7 +25,8 @@ class NetworkFabric:
 
 
 class NetworkService:
-    def __init__(self, chain, fabric: NetworkFabric, peer_id: str):
+    def __init__(self, chain, fabric: NetworkFabric, peer_id: str,
+                 scheduled_subnets: bool = False):
         from lighthouse_tpu.network.discovery import Discovery, Enr
         from lighthouse_tpu.network.router import fork_digest
 
@@ -35,14 +36,38 @@ class NetworkService:
         self.peer_manager = PeerManager()
         self.gossip_ep = fabric.gossip.join(peer_id)
         self.rpc_ep = fabric.rpc.join(peer_id)
+        subnet_service = None
+        if scheduled_subnets:
+            # production bandwidth sharding: listen on the node's
+            # long-lived subnets + short-lived duty subnets only, not
+            # all 64 (reference subnet_service)
+            import hashlib as _hashlib
+
+            from lighthouse_tpu.network.subnet_service import (
+                AttestationSubnetService,
+            )
+
+            subnet_service = AttestationSubnetService(
+                chain.spec, _hashlib.sha256(peer_id.encode()).digest())
+        self.subnet_service = subnet_service
+        if subnet_service is not None:
+            # the HTTP API's beacon_committee_subscriptions endpoint
+            # reaches the scheduler through the chain handle; never
+            # clobber an existing scheduler with None
+            chain.subnet_service = subnet_service
         self.router = Router(
             chain, self.gossip_ep, self.rpc_ep, self.peer_manager,
-            on_unknown_parent=self._on_unknown_parent)
+            on_unknown_parent=self._on_unknown_parent,
+            subnet_service=subnet_service)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
         self.discovery = Discovery(
             self.rpc_ep, Enr(peer_id=peer_id),
             fork_digest=fork_digest(chain))
+
+    def on_slot(self, slot: int) -> None:
+        """Per-slot tick: apply subnet subscription deltas."""
+        self.router.update_attestation_subnets(slot)
 
     def connect(self, other: "NetworkService"):
         """Mutual status handshake (dial)."""

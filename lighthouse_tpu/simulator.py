@@ -77,6 +77,7 @@ class LocalNetwork:
     def _set_slot(self, slot: int) -> None:
         for node in self.nodes:
             node.chain.slot_clock.set_slot(slot)
+            node.net.on_slot(slot)
 
     def run_slot(self, slot: int, summary: SimSummary) -> None:
         self._set_slot(slot)

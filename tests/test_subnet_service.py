@@ -98,3 +98,31 @@ class TestSyncSubnets:
         assert to_sub == {0, 2} and not to_unsub
         to_sub, to_unsub = svc.set_duty_subnets({2, 3})
         assert to_sub == {3} and to_unsub == {0}
+
+
+class TestScheduledNetworkService:
+    def test_scheduled_node_listens_selectively_and_opens_duty_windows(self):
+        from lighthouse_tpu.network import NetworkFabric, NetworkService
+        from lighthouse_tpu.network.router import topic
+
+        h = Harness(16, fork="altair", real_crypto=False)
+        fabric = NetworkFabric()
+        a = NetworkService(
+            BeaconChain(h.spec, h.state.copy(), verify_signatures=False),
+            fabric, "sched-a", scheduled_subnets=True)
+        # selective: far fewer than all 64 subnets
+        att_topics = [t for t in a.gossip_ep.handlers
+                      if "beacon_attestation" in t]
+        assert 0 < len(att_topics) < h.spec.attestation_subnet_count
+        # a duty subscription opens the window via the chain handle (the
+        # HTTP endpoint's path) and the per-slot tick applies it
+        base = a.subnet_service.active
+        target = next(s for s in range(64) if s not in base)
+        a.chain.subnet_service.subscribe_for_duty(
+            5, target, is_aggregator=True)
+        a.on_slot(5)
+        assert topic(a.chain, f"beacon_attestation_{target}") \
+            in a.gossip_ep.handlers
+        a.on_slot(6)
+        assert topic(a.chain, f"beacon_attestation_{target}") \
+            not in a.gossip_ep.handlers
