@@ -554,10 +554,6 @@ def process_bls_to_execution_change(state, spec, signed_change, strategy, verifi
     state.validators.withdrawal_credentials[idx] = np.frombuffer(new_creds, np.uint8)
 
 
-def _has_eth1_credentials(creds: np.ndarray) -> bool:
-    return int(creds[0]) == ETH1_ADDRESS_WITHDRAWAL_PREFIX
-
-
 def get_expected_withdrawals(state, spec) -> list:
     out, _processed = get_expected_withdrawals_and_partials(state, spec)
     return out
@@ -611,12 +607,13 @@ def get_expected_withdrawals_and_partials(state, spec) -> tuple[list, int]:
         return get_max_effective_balance(spec, creds)
 
     def _withdrawable_creds(creds) -> bool:
-        if not electra:
-            return _has_eth1_credentials(creds)
         from lighthouse_tpu.state_transition.electra import (
+            has_eth1_withdrawal_credential,
             has_execution_withdrawal_credential,
         )
 
+        if not electra:
+            return has_eth1_withdrawal_credential(creds)
         return has_execution_withdrawal_credential(creds)
 
     # amounts already scheduled for a validator by the partial sweep

@@ -29,6 +29,10 @@ def has_compounding_withdrawal_credential(creds) -> bool:
     return int(creds[0]) == COMPOUNDING_WITHDRAWAL_PREFIX
 
 
+def has_eth1_withdrawal_credential(creds) -> bool:
+    return int(creds[0]) == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
 def has_execution_withdrawal_credential(creds) -> bool:
     return int(creds[0]) in (
         ETH1_ADDRESS_WITHDRAWAL_PREFIX, COMPOUNDING_WITHDRAWAL_PREFIX)
@@ -135,8 +139,12 @@ def queue_excess_active_balance(state, spec, index: int) -> None:
 
 
 def switch_to_compounding_validator(state, spec, index: int) -> None:
+    # Only 0x01 credentials switch (beacon_state.rs:2221
+    # has_eth1_withdrawal_credential); a validator that is already
+    # compounding must be a no-op — re-queueing its excess balance
+    # would strip it into the pending-deposit queue.
     creds = state.validators.withdrawal_credentials[index]
-    if has_execution_withdrawal_credential(creds):
+    if has_eth1_withdrawal_credential(creds):
         new = bytes([COMPOUNDING_WITHDRAWAL_PREFIX]) + creds[1:].tobytes()
         state.validators.withdrawal_credentials[index] = np.frombuffer(
             new, np.uint8)
@@ -224,20 +232,23 @@ def process_withdrawal_request(state, spec, request) -> None:
         return
     if cur < int(v.activation_epoch[idx]) + spec.shard_committee_period:
         return
-    pending_for_validator = sum(
-        1 for w in state.pending_partial_withdrawals
+    pending_balance_to_withdraw = sum(
+        int(w.amount) for w in state.pending_partial_withdrawals
         if int(w.index) == idx)
     if is_full_exit:
-        if pending_for_validator == 0:
+        if pending_balance_to_withdraw == 0:
             initiate_validator_exit_electra(state, spec, idx)
         return
     has_sufficient = (
         int(v.effective_balance[idx]) >= spec.min_activation_balance)
-    has_excess = int(state.balances[idx]) > spec.min_activation_balance
+    # Excess is measured net of withdrawals already queued for this
+    # validator (process_operations.rs:585-610); otherwise repeated
+    # EIP-7002 requests could queue more than the actual excess.
+    excess = (int(state.balances[idx]) - spec.min_activation_balance
+              - pending_balance_to_withdraw)
     if has_compounding_withdrawal_credential(creds) and has_sufficient \
-            and has_excess:
-        to_withdraw = min(
-            int(state.balances[idx]) - spec.min_activation_balance, amount)
+            and excess > 0:
+        to_withdraw = min(excess, amount)
         withdrawable_epoch = compute_exit_epoch_and_update_churn(
             state, spec, to_withdraw) + \
             spec.min_validator_withdrawability_delay

@@ -134,13 +134,14 @@ def upgrade_to_electra(state, spec: T.ChainSpec, t) -> None:
         deposit_requests_root=b"\x00" * 32,
         withdrawal_requests_root=b"\x00" * 32)
     v = state.validators
+    # upgrade/electra.rs:15-22: max(exit_epochs).unwrap_or(current) + 1,
+    # with NO activation-exit clamp — the raw field is part of the
+    # post-upgrade state root even though churn math clamps later.
     exiting = v.exit_epoch[v.exit_epoch != np.uint64(T.FAR_FUTURE_EPOCH)]
-    earliest_exit = (int(exiting.max()) + 1 if exiting.size
-                     else spec.compute_activation_exit_epoch(epoch))
+    earliest_exit = (int(exiting.max()) if exiting.size else epoch) + 1
     state.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
     state.deposit_balance_to_consume = 0
-    state.earliest_exit_epoch = max(
-        earliest_exit, spec.compute_activation_exit_epoch(epoch))
+    state.earliest_exit_epoch = earliest_exit
     state.consolidation_balance_to_consume = 0
     state.earliest_consolidation_epoch = \
         spec.compute_activation_exit_epoch(epoch)

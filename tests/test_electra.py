@@ -291,6 +291,57 @@ class TestConsolidations:
         assert total_target == tgt_bal + moved
 
 
+class TestWithdrawalRequestAccounting:
+    """Reference process_operations.rs:585-610 — excess is net of the
+    balance already queued for the validator."""
+
+    def _compounding(self, bal_eth=40):
+        h = Harness(16, fork="electra", real_crypto=False)
+        h.state.slot = h.spec.compute_start_slot_at_epoch(
+            h.spec.shard_committee_period)
+        st = h.state
+        creds = b"\x02" + b"\x00" * 11 + b"\x44" * 20
+        st.validators.withdrawal_credentials[5] = np.frombuffer(
+            creds, np.uint8)
+        st.balances[5] = bal_eth * 10**9
+        def req(amt):
+            return T.ExecutionLayerWithdrawalRequest(
+                source_address=creds[12:],
+                validator_pubkey=st.validators.pubkeys[5].tobytes(),
+                amount=amt)
+        return h, st, req
+
+    def test_repeated_requests_net_out_pending_balance(self):
+        h, st, req = self._compounding(bal_eth=40)  # 8 ETH excess
+        el.process_withdrawal_request(st, h.spec, req(5 * 10**9))
+        el.process_withdrawal_request(st, h.spec, req(5 * 10**9))
+        amts = [int(w.amount) for w in st.pending_partial_withdrawals]
+        assert amts == [5 * 10**9, 3 * 10**9]  # min(40-32-5, 5) == 3
+        # third request: no excess left above queued balance -> ignored
+        el.process_withdrawal_request(st, h.spec, req(5 * 10**9))
+        assert len(st.pending_partial_withdrawals) == 2
+
+    def test_full_exit_blocked_while_balance_pending(self):
+        h, st, req = self._compounding(bal_eth=40)
+        el.process_withdrawal_request(st, h.spec, req(5 * 10**9))
+        el.process_withdrawal_request(
+            st, h.spec, req(el.FULL_EXIT_REQUEST_AMOUNT))
+        assert int(st.validators.exit_epoch[5]) == T.FAR_FUTURE_EPOCH
+
+    def test_switch_to_compounding_noop_for_compounding(self):
+        # beacon_state.rs:2221 guards on 0x01 only; a matured
+        # consolidation into an already-compounding target must not
+        # strip its balance into the pending-deposit queue
+        h = Harness(16, fork="electra", real_crypto=False)
+        st = h.state
+        st.validators.withdrawal_credentials[3] = np.frombuffer(
+            b"\x02" + b"\x00" * 11 + b"\x55" * 20, np.uint8)
+        st.balances[3] = 50 * 10**9
+        el.switch_to_compounding_validator(st, h.spec, 3)
+        assert int(st.balances[3]) == 50 * 10**9
+        assert len(st.pending_balance_deposits) == 0
+
+
 class TestEffectiveBalances:
     def test_compounding_ceiling(self):
         h = Harness(16, fork="electra", real_crypto=False)

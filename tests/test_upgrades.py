@@ -80,3 +80,24 @@ def test_upgrade_preserves_balances_and_validators():
     # and field integrity survive the class swap
     assert len(h.state.validators) == before_n
     assert np.asarray(h.state.balances).shape == before_bal.shape
+
+
+def test_upgrade_to_electra_earliest_exit_epoch_unclamped():
+    # upgrade/electra.rs:15-22: max(exit_epochs).unwrap_or(current) + 1,
+    # with no activation-exit clamp — the raw field enters the state root
+    from lighthouse_tpu.state_transition import upgrades
+
+    h = Harness(n_validators=16, fork="deneb", real_crypto=False)
+    st = h.state
+    epoch = h.spec.compute_epoch_at_slot(int(st.slot))
+    upgrades.upgrade_to_electra(st, h.spec, T.make_types(h.spec.preset))
+    assert int(st.earliest_exit_epoch) == epoch + 1
+    assert int(st.earliest_exit_epoch) < \
+        h.spec.compute_activation_exit_epoch(epoch)
+
+    h2 = Harness(n_validators=16, fork="deneb", real_crypto=False)
+    st2 = h2.state
+    st2.validators.exit_epoch[3] = 7
+    st2.validators.exit_epoch[9] = 12
+    upgrades.upgrade_to_electra(st2, h2.spec, T.make_types(h2.spec.preset))
+    assert int(st2.earliest_exit_epoch) == 13
