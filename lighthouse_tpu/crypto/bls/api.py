@@ -78,21 +78,47 @@ class PublicKey:
 
 
 class Signature:
-    """Compressed G2 signature with lazy decompression."""
+    """Compressed G2 signature with lazy decompression.
 
-    __slots__ = ("_bytes", "_point")
+    Subgroup checking is split from decompression so batch verifiers can
+    run the ψ membership test for MANY fresh signatures in one device
+    program (ops/ec.g2_subgroup_check_batch) instead of a per-signature
+    host scalar mul; `point_unchecked` + `mark_subgroup_checked` is that
+    seam.  The `point` property remains the safe single-signature path."""
+
+    __slots__ = ("_bytes", "_point", "_subgroup_ok")
 
     def __init__(self, data: bytes, point=None):
         if len(data) != 96:
             raise BlsError("signature must be 96 bytes")
         self._bytes = bytes(data)
         self._point = point
+        self._subgroup_ok = point is not None
 
     @property
     def point(self):
         if self._point is None:
             self._point = cv.g2_from_bytes(self._bytes)
+            self._subgroup_ok = True
+        elif not self._subgroup_ok:
+            if not cv.g2_in_subgroup_fast(self._point):
+                raise BlsError("signature not in G2 subgroup")
+            self._subgroup_ok = True
         return self._point
+
+    def point_unchecked(self):
+        """Decompressed point WITHOUT the subgroup check (on-curve only).
+        Callers must complete the membership test (device batch) before
+        treating the signature as valid."""
+        if self._point is None:
+            self._point = cv.g2_from_bytes(self._bytes, subgroup_check=False)
+        return self._point
+
+    def subgroup_checked(self) -> bool:
+        return self._subgroup_ok
+
+    def mark_subgroup_checked(self):
+        self._subgroup_ok = True
 
     def to_bytes(self) -> bytes:
         return self._bytes

@@ -248,7 +248,42 @@ def g2_is_on_curve(p) -> bool:
     return y.square() == x.square() * x + B2
 
 def g2_in_subgroup(p) -> bool:
+    """Definitional subgroup check [r]Q == INF (the slow oracle; the
+    production path is g2_in_subgroup_fast)."""
     return g2_is_on_curve(p) and g2_mul(p, R) is INF
+
+
+# ψ: the untwist-Frobenius-twist endomorphism on E'(Fq2),
+# ψ(x, y) = (c_x·x̄, c_y·ȳ) with c_x = ξ^(-(p-1)/3), c_y = ξ^(-(p-1)/2)
+# (x̄ = Frobenius conjugate).  On G2 it acts as multiplication by p ≡ x
+# (mod r), giving the fast membership test ψ(Q) == [x]Q — proven complete
+# for BLS12-381 by Scott 2021 ("A note on group membership tests", and
+# what blst ships); tests/test_ec.py pins it against the [r]Q oracle on
+# both members and cofactor points.
+from lighthouse_tpu.crypto.bls.fields import XI
+
+PSI_CX = XI.pow((P - 1) // 3).inv()   # ξ^(-(p-1)/3)
+PSI_CY = XI.pow((P - 1) // 2).inv()   # ξ^(-(p-1)/2)
+
+
+def g2_psi(p):
+    if p is INF:
+        return INF
+    x, y = p
+    return (x.conj() * PSI_CX, y.conj() * PSI_CY)
+
+
+def g2_in_subgroup_fast(p) -> bool:
+    """ψ(Q) == [x]Q (x the signed curve parameter): a 64-bit scalar mul
+    instead of the 255-bit [r]Q — ~4x faster on the host, and the form
+    the batched device check mirrors (ops/ec.g2_subgroup_check_batch)."""
+    if p is INF:
+        return True
+    if not g2_is_on_curve(p):
+        return False
+    lhs = g2_psi(p)
+    rhs = g2_mul(p, -BLS_X if BLS_X_IS_NEG else BLS_X)
+    return lhs == rhs
 
 def g2_generator():
     return G2_GEN
@@ -325,7 +360,7 @@ def g2_from_bytes(data: bytes, *, subgroup_check: bool = True):
     if bool(flags & 0x20) != y_big:
         y = -y
     pt = (x, y)
-    if subgroup_check and not g2_in_subgroup(pt):
+    if subgroup_check and not g2_in_subgroup_fast(pt):
         raise ValueError("G2 point not in subgroup")
     return pt
 

@@ -318,10 +318,18 @@ def _pow_u_cyc(f: Fq12) -> Fq12:
     return out
 
 
-def final_exponentiation_fast(f: Fq12) -> Fq12:
-    """(f^((p^12-1)/r))^3 — same is_one() verdict, ~25x faster hard part."""
-    t = f.conj() * f.inv()            # easy: f^(p^6 - 1) …
-    m = frobenius(t, 2) * t           # … ^(p^2 + 1); now cyclotomic
+def final_exp_easy(f: Fq12) -> Fq12:
+    """Easy part f^((p^6-1)(p^2+1)): one inversion, lands in the
+    cyclotomic subgroup (where conj() is inversion)."""
+    t = f.conj() * f.inv()            # f^(p^6 - 1)
+    return frobenius(t, 2) * t        # ^(p^2 + 1)
+
+
+def final_exp_hard(m: Fq12) -> Fq12:
+    """Hard part (m^((p^4-p^2+1)/r))^3 via the x-ladder (m cyclotomic).
+
+    This is the host oracle for ops/bls12_381.final_exp_hard_device —
+    the device mirror runs the identical ladder."""
     # x < 0: f^x = conj(f^|x|) (conj inverts in the cyclotomic subgroup)
     px = lambda g: _pow_u_cyc(g).conj()   # noqa: E731  g^x
     t1 = px(m)                            # m^x
@@ -330,3 +338,8 @@ def final_exponentiation_fast(f: Fq12) -> Fq12:
     g1 = px(g2) * g3.conj()               # m^(x*c2 - c3)
     g0 = px(g1) * m.square() * m          # m^(x*c1 + 3)
     return g0 * frobenius(g1, 1) * frobenius(g2, 2) * frobenius(g3, 3)
+
+
+def final_exponentiation_fast(f: Fq12) -> Fq12:
+    """(f^((p^12-1)/r))^3 — same is_one() verdict, ~25x faster hard part."""
+    return final_exp_hard(final_exp_easy(f))

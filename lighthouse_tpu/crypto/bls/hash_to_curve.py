@@ -350,8 +350,27 @@ def iso_map(x: Fq2, y: Fq2) -> tuple[Fq2, Fq2]:
     return (xn * xd.inv(), y * yn * yd.inv())
 
 
-def clear_cofactor(pt):
+def clear_cofactor_slow(pt):
+    """Effective-cofactor multiplication (RFC 9380 §8.8.2) — the oracle."""
     return cv.g2_mul(pt, H_EFF)
+
+
+def clear_cofactor(pt):
+    """ψ-based fast clearing (Budroni–Pintore, the form RFC 9380 §8.8.2's
+    h_eff was chosen to equal exactly):
+
+        [h_eff]Q = [x²-x-1]Q + [x-1]ψ(Q) + ψ²([2]Q)
+
+    Two short scalar muls (127- and 64-bit, x the signed parameter)
+    instead of one 636-bit — ~3x less host work per fresh message;
+    pinned bit-for-bit against clear_cofactor_slow in tests/test_bls.py."""
+    from lighthouse_tpu.crypto.bls.fields import BLS_X
+
+    x = -BLS_X  # signed parameter
+    t1 = cv.g2_mul(pt, x * x - x - 1)
+    t2 = cv.g2_mul(cv.g2_psi(pt), x - 1)
+    t3 = cv.g2_psi(cv.g2_psi(cv.g2_double(pt)))
+    return cv.g2_add(cv.g2_add(t1, t2), t3)
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2):

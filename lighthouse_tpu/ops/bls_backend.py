@@ -38,7 +38,9 @@ from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import ec
 from lighthouse_tpu.ops.bls12_381 import (
     batch_miller_loop,
+    final_exp_hard_device,
     fq12_from_device,
+    fq12_to_device,
     multi_pairing_device,
     reduce_product,
 )
@@ -133,6 +135,51 @@ def _pipeline_b(Xp, Yp, Zp, hxa, hxb, hya, hyb,
     return reduce_product(f, mask)
 
 
+@jax.jit
+def _g2_subgroup_kernel(xqa, xqb, yqa, yqb):
+    return ec.g2_subgroup_check_batch(xqa, xqb, yqa, yqb)
+
+
+def batch_subgroup_check_g2(points) -> np.ndarray:
+    """Device ψ membership test over a list of affine G2 points.
+
+    Returns bool[n].  Lanes are padded to a power of two (floor 4) with
+    the generator so small batches share compiled shapes."""
+    n = len(points)
+    if n == 0:
+        return np.zeros(0, bool)
+    padded = max(4, 1 << max(n - 1, 0).bit_length())
+    pts = list(points) + [cv.g2_generator()] * (padded - n)
+    xqa, xqb, yqa, yqb = (jnp.asarray(a) for a in _g2_limbs(pts))
+    d1, d2, Z = jax.tree_util.tree_map(
+        np.asarray, _g2_subgroup_kernel(xqa, xqb, yqa, yqb))
+    ok = np.ones(padded, bool)
+    for d in (d1, d2):
+        ok &= ec.is_zero_mod_p(d[0]) & ec.is_zero_mod_p(d[1])
+    ok &= ~(ec.is_zero_mod_p(Z[0]) & ec.is_zero_mod_p(Z[1]))
+    return ok[:n]
+
+
+def _ensure_subgroup_checked(sigs) -> bool:
+    """Batch-check any signatures whose G2 membership is still pending.
+    Returns False if any fails (callers bisect to attribute)."""
+    pending = [s for s in sigs if not s.subgroup_checked()]
+    if not pending:
+        return True
+    pts = []
+    for s in pending:
+        pt = s.point_unchecked()
+        if pt is cv.INF:
+            return False
+        pts.append(pt)
+    ok = batch_subgroup_check_g2(pts)
+    if not bool(ok.all()):
+        return False
+    for s in pending:
+        s.mark_subgroup_checked()
+    return True
+
+
 def _g2_limbs(points) -> list[np.ndarray]:
     return [ec.ints_to_mont_limbs(v) for v in (
         [p[0].a for p in points], [p[0].b for p in points],
@@ -150,10 +197,61 @@ def _g1_neg_limbs():
     return _G1_NEG_LIMBS
 
 
-def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
-    """Batch verification with the scalar work on device (see module doc)."""
-    from lighthouse_tpu.crypto.bls.fields import Fq2, P, final_exponentiation_fast
+_final_exp_hard_jit = jax.jit(final_exp_hard_device)
+_DEVICE_FINAL_EXP: bool | None = None
 
+
+def _use_device_final_exp() -> bool:
+    """Hard part on device on TPU (it removes the ~32 ms host Python tail
+    from the batch critical path); XLA-CPU runs the limb ladder slower
+    than host Python, so the CPU fallback keeps the host path.
+    Override with LHTPU_DEVICE_FINAL_EXP=0/1."""
+    global _DEVICE_FINAL_EXP
+    if _DEVICE_FINAL_EXP is None:
+        import os
+
+        env = os.environ.get("LHTPU_DEVICE_FINAL_EXP")
+        if env is not None:
+            _DEVICE_FINAL_EXP = env.lower() in ("1", "true")
+        else:
+            _DEVICE_FINAL_EXP = jax.devices()[0].platform == "tpu"
+    return _DEVICE_FINAL_EXP
+
+
+def _final_exp_is_one(f_host) -> bool:
+    """Full final exponentiation of the batch product, result == 1?"""
+    from lighthouse_tpu.crypto.bls.fields import (
+        Fq12,
+        final_exp_easy,
+        final_exponentiation_fast,
+    )
+
+    if not _use_device_final_exp():
+        return final_exponentiation_fast(f_host).is_one()
+    m = final_exp_easy(f_host)        # one host inversion (~µs, ext-gcd)
+    out = _final_exp_hard_jit(fq12_to_device(m))
+    return fq12_from_device(
+        jax.tree_util.tree_map(np.asarray, out)) == Fq12.ONE
+
+
+def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
+                         ledger: dict | None = None) -> bool:
+    """Batch verification with the scalar work on device (see module doc).
+
+    With ``ledger`` given, per-stage wall times (seconds) are recorded under
+    keys prep_host / limbs / pipeline_a / sum_affine / pipeline_b /
+    final_exp — device stages are synchronized before timing, so only pass
+    a ledger when profiling (it serializes the pipeline)."""
+    import time as _time
+
+    from lighthouse_tpu.crypto.bls.fields import Fq2
+
+    def _mark(key, t0):
+        if ledger is not None:
+            ledger[key] = ledger.get(key, 0.0) + (_time.perf_counter() - t0)
+        return _time.perf_counter()
+
+    t0 = _time.perf_counter()
     n = len(sets)
     agg_pks = []
     sig_pts = []
@@ -162,7 +260,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         if not s.pubkeys:
             return False
         try:
-            sig_pt = s.signature.point
+            sig_pt = s.signature.point_unchecked()
             agg_pk = s.aggregate_pubkey()
         except (api.BlsError, ValueError):
             return False
@@ -171,6 +269,12 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         sig_pts.append(sig_pt)
         agg_pks.append(agg_pk)
         h2cs.append(_hash_to_g2_cached(s.message))
+
+    # G2 membership for fresh signatures: one batched device ψ test
+    # instead of a per-signature host scalar mul
+    if not _ensure_subgroup_checked([s.signature for s in sets]):
+        return False
+    t0 = _mark("subgroup", t0)
 
     # an aggregate pubkey CAN be the identity (opposing keys); such a set
     # can never verify (its signature would have to be infinity, which was
@@ -184,6 +288,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         while r == 0:
             r = secrets.randbits(RAND_BITS)
         scalars.append(r)
+    t0 = _mark("prep_host", t0)
 
     # --- message grouping (the TPU-shaped fold): sets sharing a message
     # satisfy Π e(r_i·pk_i, H(m)) = e(Σ r_i·pk_i, H(m)), so the expensive
@@ -228,9 +333,13 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         ext = np.zeros((g_pad - n_groups, bi.L), np.uint32)
         if g_pad != n_groups:
             h2 = [np.concatenate([a, ext]) for a in h2]
+        t0 = _mark("limbs", t0)
         Xp, Yp, Zp, SX, SY, SZ = _pipeline_a_grouped(
             jnp.asarray(pkx), jnp.asarray(pky),
             *[jnp.asarray(a) for a in sg], bits, g_pad)
+        if ledger is not None:
+            jax.block_until_ready(SZ)
+        t0 = _mark("pipeline_a", t0)
         padded = g_pad
         n_real_lanes = n_groups
     else:
@@ -248,10 +357,14 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
         # infinity, adding nothing to Σ r·sig; their Miller lanes are
         # masked out below
         bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
+        t0 = _mark("limbs", t0)
 
         Xp, Yp, Zp, SX, SY, SZ = _pipeline_a(
             jnp.asarray(pkx), jnp.asarray(pky),
             *[jnp.asarray(a) for a in sg], bits)
+        if ledger is not None:
+            jax.block_until_ready(SZ)
+        t0 = _mark("pipeline_a", t0)
         padded = padded_flat
         n_real_lanes = n
 
@@ -280,12 +393,18 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet]) -> bool:
     else:
         sa = [np.zeros((1, bi.L), np.uint32) for _ in range(4)]
     g1x, g1y = _g1_neg_limbs()
+    t0 = _mark("sum_affine", t0)
 
     f = _pipeline_b(Xp, Yp, Zp, *[jnp.asarray(a) for a in h2],
               jnp.asarray(g1x), jnp.asarray(g1y),
               *[jnp.asarray(a) for a in sa], jnp.asarray(mask))
+    if ledger is not None:
+        jax.block_until_ready(f)
+    t0 = _mark("pipeline_b", t0)
     f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
-    return final_exponentiation_fast(f_host).is_one()
+    ok = _final_exp_is_one(f_host)
+    _mark("final_exp", t0)
+    return ok
 
 
 def verify_signature_sets_device(sets: Sequence[api.SignatureSet]) -> bool:

@@ -221,3 +221,68 @@ def test_fq2_sqrt_total():
             assert not x.legendre_is_square()
         else:
             assert s.square() == x
+
+
+def test_fast_cofactor_clearing_matches_h_eff():
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.crypto.bls import hash_to_curve as h2c
+    from lighthouse_tpu.crypto.bls.fields import Fq2, P
+
+    rng = np.random.default_rng(11)
+    done = 0
+    while done < 3:
+        x = Fq2(int.from_bytes(rng.bytes(47), "big") % P,
+                int.from_bytes(rng.bytes(47), "big") % P)
+        y = (x.square() * x + cv.B2).sqrt()
+        if y is None:
+            continue
+        assert h2c.clear_cofactor((x, y)) == h2c.clear_cofactor_slow((x, y))
+        done += 1
+
+
+def test_deferred_subgroup_check_semantics():
+    # point_unchecked defers membership; .point completes it and raises
+    # for a cofactor point
+    import numpy as np
+    import pytest
+
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.crypto.bls.fields import Fq2, P
+
+    rng = np.random.default_rng(13)
+    while True:
+        x = Fq2(int.from_bytes(rng.bytes(47), "big") % P,
+                int.from_bytes(rng.bytes(47), "big") % P)
+        y = (x.square() * x + cv.B2).sqrt()
+        if y is not None and not cv.g2_in_subgroup((x, y)):
+            break
+    raw = cv.g2_to_bytes((x, y))
+    sig = bls.Signature(raw)
+    assert not sig.subgroup_checked()
+    assert sig.point_unchecked() is not None  # decompresses fine
+    with pytest.raises(bls.BlsError):
+        _ = sig.point
+
+
+def test_device_final_exp_matches_host():
+    import jax
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls.fields import (
+        Fq2, Fq6, Fq12, P, final_exp_easy, final_exp_hard,
+    )
+    from lighthouse_tpu.ops import bls12_381 as dev
+
+    rng = np.random.default_rng(7)
+
+    def f2():
+        return Fq2(int.from_bytes(rng.bytes(47), "big") % P,
+                   int.from_bytes(rng.bytes(47), "big") % P)
+
+    f = Fq12(Fq6(f2(), f2(), f2()), Fq6(f2(), f2(), f2()))
+    m = final_exp_easy(f)
+    out = jax.jit(dev.final_exp_hard_device)(dev.fq12_to_device(m))
+    got = dev.fq12_from_device(jax.tree_util.tree_map(np.asarray, out))
+    assert got == final_exp_hard(m)

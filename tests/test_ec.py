@@ -116,3 +116,72 @@ def test_scalars_to_bits_roundtrip():
     for i, s in enumerate(scalars):
         back = int("".join(str(b) for b in bits[:, i]), 2)
         assert back == s
+
+
+class TestPsiSubgroupCheck:
+    """The ψ membership test (curve.py g2_in_subgroup_fast + the batched
+    device mirror) vs the definitional [r]Q oracle."""
+
+    def _cofactor_points(self, n=2):
+        from lighthouse_tpu.crypto.bls.fields import Fq2, P
+
+        rng = np.random.default_rng(9)
+        out = []
+        while len(out) < n:
+            x = Fq2(int.from_bytes(rng.bytes(47), "big") % P,
+                    int.from_bytes(rng.bytes(47), "big") % P)
+            y = (x.square() * x + cv.B2).sqrt()
+            if y is not None and not cv.g2_in_subgroup((x, y)):
+                out.append((x, y))
+        return out
+
+    def test_host_fast_check_agrees_with_oracle(self):
+        g = cv.g2_generator()
+        for k in (1, 7, 123456789):
+            q = cv.g2_mul(g, k)
+            assert cv.g2_in_subgroup_fast(q)
+            assert cv.g2_in_subgroup(q)
+        for pt in self._cofactor_points():
+            assert not cv.g2_in_subgroup_fast(pt)
+        assert cv.g2_in_subgroup_fast(cv.INF)
+
+    def test_psi_eigenvalue_is_x(self):
+        from lighthouse_tpu.crypto.bls.fields import BLS_X
+
+        g = cv.g2_generator()
+        q = cv.g2_mul(g, 424242)
+        assert cv.g2_psi(q) == cv.g2_mul(q, -BLS_X)
+
+    def test_device_batch_check(self):
+        from lighthouse_tpu.ops.bls_backend import batch_subgroup_check_g2
+
+        g = cv.g2_generator()
+        members = [cv.g2_mul(g, k) for k in (1, 5, 7)]
+        bad = self._cofactor_points(2)
+        ok = batch_subgroup_check_g2(members[:2] + bad + members[2:])
+        assert list(ok) == [True, True, False, False, True]
+
+
+def test_small_order_point_fails_closed():
+    """g2_subgroup_check_batch's fail-closed invariant (see its docstring):
+    a small-order twist point can hit the degenerate H == 0 addition chord
+    inside the fixed-|x| scalar mul; the resulting Z ≡ 0 lane must REJECT.
+
+    The pinned point has exact order 13 (13² | h2, the Sylow-13 subgroup
+    of E'(Fq2) has rank 2; constructed as [n2/13²]·random then reduced by
+    13 until order 13)."""
+    from lighthouse_tpu.crypto.bls.fields import Fq2
+    from lighthouse_tpu.ops.bls_backend import batch_subgroup_check_g2
+
+    pt = (
+        Fq2(0x50c3dd2263b07fd4c50559754c4f0d4c4ab0cdc4a685b8b5cab7bd39bd46ceda6663d15c194176fc6e15f40a70b76bc,
+            0x2fce515472b308fa3da1ac9a6fa4019d7a8700cb6ca215771c98d4bc59edddbedf882c6cae0f702b73c6bdcb93746ac),
+        Fq2(0xdc3af5921e8ecd27695da0f537a9197d849deabb8cf404f28ba31790ce2e89a26bb85188dab735e6782210cd0a30381,
+            0x2eaa3a19068450560e6cc5788d89c55226e62b286277cecfaa019ad4712e2db26a4495408885d5923bed176515a1bb1),
+    )
+    assert cv.g2_is_on_curve(pt)
+    assert cv.g2_mul(pt, 13) is cv.INF          # exact small order
+    assert not cv.g2_in_subgroup(pt)            # oracle
+    assert not cv.g2_in_subgroup_fast(pt)       # host ψ test
+    ok = batch_subgroup_check_g2([pt, cv.g2_generator(), pt, pt])
+    assert list(ok) == [False, True, False, False]
