@@ -261,6 +261,80 @@ def _bench_attestation_flood() -> dict:
     }
 
 
+def _bench_block_verify() -> dict:
+    """BASELINE config #2: one mainnet-preset Capella block through
+    per_block_processing with VerifyBulk (all signature sets), p50 ms
+    (reference state_processing/src/per_block_processing.rs:100, timed
+    like lcli transition-blocks).
+
+    The block carries full-committee aggregate attestations from the
+    preceding slots (the mainnet shape: each attestation is one signature
+    set whose pubkey aggregates over ~committee-size keys), the sync
+    aggregate, randao and the proposer signature.  The XLA-CPU fallback
+    shrinks the registry so the child stays inside its timeout."""
+    import jax
+
+    from lighthouse_tpu import types as T
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition import (
+        SignatureStrategy,
+        process_block,
+        state_advance,
+    )
+    from lighthouse_tpu.testing import Harness
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    n_validators = 32768 if on_tpu else 512
+    att_slots = 4 if on_tpu else 2
+
+    spec = T.ChainSpec.mainnet().with_forks_at(0, through="capella")
+    t_build0 = time.perf_counter()
+    h = Harness(n_validators=n_validators, spec=spec, fork="capella",
+                real_crypto=True)
+    from lighthouse_tpu.state_transition import misc
+
+    # skip ahead so attestations reference existing block roots, then
+    # attest every committee of the last `att_slots` slots
+    target_slot = att_slots + 1
+    state_advance(h.state, spec, target_slot)
+    atts = []
+    per_slot = misc.get_committee_count_per_slot(
+        spec, len(h.state.validators))
+    for s in range(1, att_slots + 1):
+        for ci in range(per_slot):
+            atts.append(h.attest(slot=s, committee_index=ci))
+    signed = h.produce_block(slot=target_slot, attestations=atts)
+    build_s = time.perf_counter() - t_build0
+
+    # produce_block leaves h.state at the pre-block state; advance a copy
+    # to the block's slot once, then time process_block on fresh copies
+    base = h.state.copy()
+    state_advance(base, spec, int(signed.message.slot))
+
+    bls.set_backend("tpu")
+    times = []
+    n_iters = 7
+    for i in range(n_iters + 1):
+        st = base.copy()
+        t0 = time.perf_counter()
+        process_block(st, spec, signed, SignatureStrategy.VERIFY_BULK)
+        dt = time.perf_counter() - t0
+        if i > 0:          # first pass pays compiles + h2c cache fills
+            times.append(dt)
+    p50 = sorted(times)[len(times) // 2]
+    sets_pre = len(atts) + 3  # proposal + randao + sync aggregate
+    return {
+        "block_verify_p50_ms": round(p50 * 1000, 1),
+        "block_verify_runs": n_iters,
+        "block_atts": len(atts),
+        "block_sig_sets": sets_pre,
+        "block_validators": n_validators,
+        "block_build_s": round(build_s, 1),
+        "block_platform": platform,
+    }
+
+
 def _bench_merkleize() -> dict:
     import jax
     import numpy as np
@@ -380,6 +454,8 @@ def _child_main() -> int:
         result = _bench_state_root_incremental()
     elif "--child-flood" in sys.argv:
         result = _bench_attestation_flood()
+    elif "--child-blockverify" in sys.argv:
+        result = _bench_block_verify()
     else:
         result = _bench_bls_1k()
     print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
@@ -428,7 +504,8 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
 
 
 _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
-                "--child-probe", "--child-stateroot", "--child-flood")
+                "--child-probe", "--child-stateroot", "--child-flood",
+                "--child-blockverify")
 
 
 def main() -> int:
@@ -486,6 +563,10 @@ def main() -> int:
                         timeout_s=min(300, CHILD_TIMEOUT_S))
         if sr:
             result.update(sr)
+        # single-block verify p50 (BASELINE #2)
+        bv = _run_child(working_env, child_flag="--child-blockverify")
+        if bv:
+            result.update(bv)
         # gossip attestation flood (BASELINE #3)
         fl = _run_child(working_env, child_flag="--child-flood")
         if fl:

@@ -47,6 +47,10 @@ def _build_parser() -> argparse.ArgumentParser:
                          "sharing a devnet must pass the same value")
     bn.add_argument("--run-seconds", type=float, default=None,
                     help="exit after N seconds (default: run forever)")
+    bn.add_argument("--bls-backend", default="auto",
+                    choices=["auto", "tpu", "reference", "fake"],
+                    help="BLS data plane: auto = device pipeline when a "
+                         "TPU is attached, pure-Python reference otherwise")
 
     vc = sub.add_parser("vc", help="run a validator client")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -165,6 +169,7 @@ def _run_bn(args) -> int:
         n_genesis_validators=args.interop_validators,
         genesis_fork=args.genesis_fork,
         genesis_time=args.genesis_time,
+        bls_backend=args.bls_backend,
     )
     client = ClientBuilder(cfg).build()
     print(json.dumps({

@@ -44,6 +44,10 @@ class ClientConfig:
     # configured.  None = auto (dev networks only); production networks
     # without an EL must FAIL to propose, not forge payloads
     dev_mock_payloads: bool | None = None
+    # BLS data plane: "auto" = device pipeline when a TPU is attached,
+    # pure-Python reference otherwise; or force tpu/reference/fake
+    # (reference seam: crypto/bls/src/lib.rs:86-141 backend selection)
+    bls_backend: str = "auto"
 
 
 @dataclass
@@ -93,6 +97,17 @@ class ClientBuilder:
             load_network_config,
             spec_for_network,
         )
+        from lighthouse_tpu.crypto import bls
+
+        # pin "auto" to its resolution at startup: validates the choice
+        # once and keeps per-batch verify calls resolution-free
+        backend = self.config.bls_backend
+        if backend == "auto":
+            backend = bls.resolve_auto_backend()
+            self.log.info("bls backend: auto -> %s" % backend)
+        else:
+            self.log.info("bls backend: %s" % backend)
+        bls.set_backend(backend)
 
         cfg = self.config
         if cfg.network_config_path:

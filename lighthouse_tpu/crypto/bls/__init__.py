@@ -15,6 +15,7 @@ from lighthouse_tpu.crypto.bls.api import (
     fast_aggregate_verify,
     get_backend,
     register_backend,
+    resolve_auto_backend,
     set_backend,
     verify,
     verify_signature_sets,
@@ -24,6 +25,6 @@ from lighthouse_tpu.crypto.bls.hash_to_curve import DST_G2, hash_to_g2
 __all__ = [
     "BlsError", "PublicKey", "SecretKey", "Signature", "SignatureSet",
     "aggregate_verify", "fast_aggregate_verify", "get_backend",
-    "register_backend", "set_backend", "verify", "verify_signature_sets",
+    "register_backend", "resolve_auto_backend", "set_backend", "verify", "verify_signature_sets",
     "DST_G2", "hash_to_g2",
 ]

@@ -302,12 +302,32 @@ def _resolve_backend(name: str) -> Callable[[Sequence[SignatureSet]], bool]:
 
 def set_backend(name: str):
     global _active_backend
-    _resolve_backend(name)
+    if name != "auto":
+        _resolve_backend(name)  # validate eagerly ("auto" resolves per call)
     _active_backend = name
 
 
 def get_backend() -> str:
     return _active_backend
+
+
+def resolve_auto_backend() -> str:
+    """'auto' policy: the device pipeline when a TPU is attached, the
+    pure-Python reference otherwise (XLA-CPU runs the limb programs slower
+    than host Python at node batch sizes).  LHTPU_BLS_BACKEND overrides."""
+    import os
+
+    env = os.environ.get("LHTPU_BLS_BACKEND")
+    if env:
+        _resolve_backend(env)  # fail fast on a typo'd override
+        return env
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        return "reference"
+    return "tpu" if platform == "tpu" else "reference"
 
 
 def verify_signature_sets(
@@ -319,5 +339,19 @@ def verify_signature_sets(
     and call this once — mirroring the reference call site
     state_processing/src/per_block_processing/block_signature_verifier.rs:396.
     """
-    fn = _resolve_backend(backend or _active_backend)
+    name = backend or _active_backend
+    if name == "auto":
+        name = resolve_auto_backend()
+    fn = _resolve_backend(name)
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            f"bls_verify_batches_{name}_total",
+            "batches handed to this BLS backend").inc()
+        REGISTRY.counter(
+            f"bls_verify_sets_{name}_total",
+            "signature sets handed to this BLS backend").inc(len(sets))
+    except Exception:
+        pass
     return fn(sets)
