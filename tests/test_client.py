@@ -176,6 +176,7 @@ class TestBlsBackendWiring:
         from lighthouse_tpu.crypto import bls
 
         # on this (CPU) test platform auto must resolve to the reference
+        monkeypatch.delenv("LHTPU_BLS_BACKEND", raising=False)
         assert bls.resolve_auto_backend() == "reference"
         monkeypatch.setenv("LHTPU_BLS_BACKEND", "fake")
         assert bls.resolve_auto_backend() == "fake"
