@@ -75,6 +75,9 @@ class BeaconApi:
         r("GET", r"/eth/v1/node/health", self.health)
         r("GET", r"/lighthouse/health", self.lighthouse_health)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
+        r("GET", r"/eth/v1/node/identity", self.node_identity)
+        r("GET", r"/eth/v1/node/peers", self.node_peers)
+        r("GET", r"/eth/v1/node/peer_count", self.node_peer_count)
         r("GET", r"/metrics", self.metrics)
 
     def _route(self, method, pattern, fn):
@@ -276,6 +279,15 @@ class BeaconApi:
             root = c.process_block(block)
         except BlockError as e:
             raise ApiError(400, f"invalid block: {e}")
+        # broadcast locally-imported blocks (reference publish_block:
+        # gossip first, then import; the single-writer chain here imports
+        # first, publishing only blocks that held up)
+        svc = self._network()
+        if svc is not None:
+            try:
+                svc.router.publish_block(block)
+            except Exception:
+                pass
         return {"data": {"root": _hex(root) if root else None}}
 
     def pool_attestations(self, body=None):
@@ -552,6 +564,53 @@ class BeaconApi:
         from lighthouse_tpu.common.system_health import observe_system_health
 
         return {"data": asdict(observe_system_health())}
+
+    def _network(self):
+        """The NetworkService attached by the builder (None standalone)."""
+        return getattr(self.chain, "network_service", None)
+
+    def node_identity(self, body=None):
+        svc = self._network()
+        enr = svc.discovery.enr if svc is not None else None
+        return {"data": {
+            "peer_id": svc.peer_id if svc is not None else "standalone",
+            "enr": enr.to_bytes().hex() if enr is not None else "",
+            "p2p_addresses": (
+                [f"/ip4/{enr.ip}/tcp/{enr.port}"] if enr is not None else []),
+            "discovery_addresses": (
+                [f"/ip4/{enr.ip}/udp/{enr.port}"] if enr is not None else []),
+            "metadata": {"seq_number": str(enr.seq if enr else 0),
+                         "attnets": "0x" + "00" * 8},
+        }}
+
+    def _peer_rows(self):
+        svc = self._network()
+        if svc is None:
+            return []
+        wire = getattr(svc.fabric, "node", None)
+        peers = wire.peers if wire is not None else \
+            svc.peer_manager.good_peers()
+        rows = []
+        for pid in peers:
+            addr = wire.peer_addr(pid) if wire is not None else None
+            rows.append({
+                "peer_id": pid,
+                "enr": "",
+                "last_seen_p2p_address": (
+                    f"/ip4/{addr[0]}/tcp/{addr[1]}" if addr else ""),
+                "state": "connected",
+                "direction": "outbound",
+            })
+        return rows
+
+    def node_peers(self, body=None):
+        rows = self._peer_rows()
+        return {"data": rows, "meta": {"count": len(rows)}}
+
+    def node_peer_count(self, body=None):
+        n = len(self._peer_rows())
+        return {"data": {"disconnected": "0", "connecting": "0",
+                         "connected": str(n), "disconnecting": "0"}}
 
     def syncing(self, body=None):
         c = self.chain

@@ -10,6 +10,7 @@ production (beacon_chain.rs:4224).
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -52,6 +53,12 @@ class BeaconChain:
     ):
         self.spec = spec
         self.t = T.make_types(spec.preset)
+        # import serialization: gossip/RPC/HTTP callers arrive on
+        # different threads (wire worker pool, beacon processor, API
+        # server) but chain mutation is single-writer by design — the
+        # reference's equivalent is the per-chain write lock
+        # (beacon_chain.rs canonical_head write lock)
+        self._import_lock = threading.RLock()
         self.store = store if store is not None else HotColdDB(spec)
         self.slot_clock = slot_clock or ManualSlotClock(
             int(genesis_state.genesis_time), spec.seconds_per_slot)
@@ -199,6 +206,10 @@ class BeaconChain:
         (skips gossip-only checks).  Returns None when the block carries
         blob commitments whose sidecars have not all arrived yet — it
         waits in the DA checker and imports when they do."""
+        with self._import_lock:
+            return self._process_block_locked(signed_block, blobs_ssz, source)
+
+    def _process_block_locked(self, signed_block, blobs_ssz, source):
         t_start = time.perf_counter()
         gossip = verify_block_for_gossip(self, signed_block, source)
         sigv = verify_block_signatures(self, gossip)
@@ -237,6 +248,10 @@ class BeaconChain:
     def process_gossip_blob(self, sidecar) -> bytes | None:
         """Verify one gossip blob sidecar and import its block if that
         completes availability (blob_verification.rs + DA checker)."""
+        with self._import_lock:
+            return self._process_gossip_blob_locked(sidecar)
+
+    def _process_gossip_blob_locked(self, sidecar) -> bytes | None:
         from lighthouse_tpu.chain.blob_verification import (
             BlobError,
             validate_blobs,
@@ -483,6 +498,10 @@ class BeaconChain:
         beacon_chain.rs:1961 + batch.rs:133).  Returns
         (verified, rejects) — verified items are already applied to fork
         choice."""
+        with self._import_lock:
+            return self._verify_attestations_locked(attestations)
+
+    def _verify_attestations_locked(self, attestations: list):
         verified, rejects = self._batch_pipeline(
             attestations, att_verify.verify_unaggregated_for_gossip)
         for v in verified:

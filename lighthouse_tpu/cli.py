@@ -51,6 +51,12 @@ def _build_parser() -> argparse.ArgumentParser:
                     choices=["auto", "tpu", "reference", "fake"],
                     help="BLS data plane: auto = device pipeline when a "
                          "TPU is attached, pure-Python reference otherwise")
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="TCP+UDP wire port (0 = ephemeral); omit to run "
+                         "without the socket network stack")
+    bn.add_argument("--boot-nodes", default=None,
+                    help="comma-separated host:port discovery bootstrap "
+                         "addresses")
 
     vc = sub.add_parser("vc", help="run a validator client")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -170,12 +176,18 @@ def _run_bn(args) -> int:
         genesis_fork=args.genesis_fork,
         genesis_time=args.genesis_time,
         bls_backend=args.bls_backend,
+        listen_port=args.listen_port,
+        boot_nodes=tuple(a.strip() for a in args.boot_nodes.split(",")
+                         if a.strip()) if args.boot_nodes else (),
     )
     client = ClientBuilder(cfg).build()
+    wire = client.services.get("wire")
     print(json.dumps({
         "running": "bn", "network": client.spec.config_name,
         "http_port": client.http_server.port if client.http_server else None,
         "genesis_root": "0x" + client.chain.genesis_block_root.hex(),
+        "wire_port": wire.listen_port if wire else None,
+        "peer_id": wire.peer_id if wire else None,
     }), flush=True)
     try:
         deadline = (time.time() + args.run_seconds
@@ -218,11 +230,36 @@ def _run_vc(args) -> int:
         "running": "vc", "validators": len(store.voting_pubkeys()),
         "beacon_node": args.beacon_node,
     }), flush=True)
-    # duty loop over the HTTP API is driven by the in-process
-    # ValidatorClient when embedded; standalone mode polls the BN health
+    # standalone duty loop: the remote VC drives propose/attest per slot
+    # over the standard HTTP API (validator/remote_client.py)
+    from lighthouse_tpu.validator.remote_client import RemoteValidatorClient
+
+    rvc = RemoteValidatorClient(bn, store, spec)
+    rvc.resolve_indices()
+    genesis_time = int(genesis["genesis_time"])
     deadline = time.time() + args.run_seconds if args.run_seconds else None
+    last_slot = None
     while deadline is None or time.time() < deadline:
-        time.sleep(0.5)
+        now = time.time()
+        if now < genesis_time:
+            # pre-genesis: wait without consuming slot 0, so slot-0
+            # duties run when genesis actually arrives
+            time.sleep(min(0.25, genesis_time - now))
+            continue
+        slot = int((now - genesis_time) // spec.seconds_per_slot)
+        if slot != last_slot:
+            last_slot = slot
+            try:
+                summary = rvc.run_slot(slot)
+                print(json.dumps({
+                    "slot": slot,
+                    "proposed": summary.blocks_proposed,
+                    "attested": summary.attestations_published,
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({"slot": slot, "error": str(e)}),
+                      flush=True)
+        time.sleep(0.25)
     return 0
 
 

@@ -48,6 +48,12 @@ class ClientConfig:
     # pure-Python reference otherwise; or force tpu/reference/fake
     # (reference seam: crypto/bls/src/lib.rs:86-141 backend selection)
     bls_backend: str = "auto"
+    # socket networking: None = no wire stack (in-process fabric only,
+    # the simulator's mode); 0 = ephemeral port.  boot_nodes are
+    # "host:port" UDP discovery addresses to bootstrap from
+    # (reference beacon_node/src/config.rs listen-address/boot-nodes)
+    listen_port: int | None = None
+    boot_nodes: tuple = ()
 
 
 @dataclass
@@ -65,6 +71,9 @@ class Client:
     def stop(self) -> None:
         if self.http_server is not None:
             self.http_server.stop()
+        wire = self.services.get("wire")
+        if wire is not None:
+            wire.stop()
         self.executor.shutdown("client stop")
         # snapshot fork choice + head AFTER the workers stop so a
         # mid-import mutation can't tear the snapshot (reference persists
@@ -296,6 +305,9 @@ class ClientBuilder:
                         lockfile=self._lockfile)
         client.processor = BeaconProcessor()
 
+        if self.config.listen_port is not None:
+            self._wire_network(client)
+
         if self.config.http_enabled:
             from lighthouse_tpu.api import HttpServer
 
@@ -327,3 +339,47 @@ class ClientBuilder:
         self.executor.spawn_periodic(
             notify, self.spec.seconds_per_slot, "notifier")
         return client
+
+    def _wire_network(self, client: Client) -> None:
+        """Socket network stack: TCP gossip/RPC + UDP discovery
+        (reference network service assembly, network/src/service.rs:160)."""
+        from lighthouse_tpu.network.router import fork_digest
+        from lighthouse_tpu.network.service import NetworkService
+        from lighthouse_tpu.network.wire import WireFabric
+
+        fabric = WireFabric(
+            listen_port=self.config.listen_port,
+            fork_digest=fork_digest(self.chain))
+        svc = NetworkService(self.chain, fabric, fabric.peer_id,
+                             scheduled_subnets=False)
+        client.network = svc
+        client.services["wire"] = fabric
+        # the HTTP API's node/* endpoints read peers/identity through the
+        # chain handle (same pattern as subnet_service)
+        self.chain.network_service = svc
+        self.log.info("wire network up", peer_id=fabric.peer_id,
+                      port=fabric.listen_port)
+
+        boot_nodes = tuple(self.config.boot_nodes)
+
+        def bootstrap(_exit_event):
+            for addr in boot_nodes:
+                try:
+                    n = svc.discover_and_connect(addr)
+                    self.log.info("bootstrap done", boot=addr, peers=n)
+                except Exception as e:
+                    self.log.warn("bootstrap failed", boot=addr, err=str(e))
+
+        if boot_nodes:
+            self.executor.spawn(bootstrap, "wire-bootstrap")
+
+        def net_tick():
+            svc.on_slot(self.chain.current_slot())
+            try:
+                # chase any peer that is ahead (reference range-sync tick)
+                svc.sync.sync()
+            except Exception as e:
+                self.log.warn("range sync tick failed", err=str(e))
+
+        self.executor.spawn_periodic(
+            net_tick, self.spec.seconds_per_slot, "net-slot")

@@ -61,9 +61,15 @@ class NetworkService:
             subnet_service=subnet_service)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
+        # socket fabrics carry discovery over UDP datagrams and advertise
+        # a real (host, port); the in-process fabric reuses the rpc seam
+        disc_ep = getattr(fabric, "discovery_ep", None) or self.rpc_ep
+        enr = Enr(peer_id=peer_id)
+        if hasattr(fabric, "listen_port"):
+            enr.port = fabric.listen_port
+            enr.ip = getattr(fabric.node, "listen_host", "127.0.0.1")
         self.discovery = Discovery(
-            self.rpc_ep, Enr(peer_id=peer_id),
-            fork_digest=fork_digest(chain))
+            disc_ep, enr, fork_digest=fork_digest(chain))
 
     def on_slot(self, slot: int) -> None:
         """Per-slot tick: apply subnet subscription deltas."""

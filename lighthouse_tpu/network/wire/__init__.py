@@ -1,0 +1,16 @@
+"""Socket wire stack: the bytes-on-the-wire half of the network layer.
+
+The in-process fabric (network/gossip.py, network/rpc.py) defines the
+seams — topic pub/sub and protocol req/resp; this package implements the
+same seams over real sockets so two OS processes can peer:
+
+- snappy.py: snappy block + frame formats with CRC32C (the reference
+  wire compression, lighthouse_network/src/rpc/codec/ssz_snappy.rs)
+- codec.py: length-prefixed ssz_snappy request/response framing
+- transport.py: asyncio TCP mux (gossip + RPC streams) and the UDP
+  discovery datagram endpoint, exposed as `WireFabric`
+"""
+
+from lighthouse_tpu.network.wire.transport import WireFabric
+
+__all__ = ["WireFabric"]

@@ -1,0 +1,65 @@
+"""ssz_snappy wire codec: the reference's req/resp payload framing.
+
+Request payload  = uvarint(ssz_len) ‖ snappy-frame(ssz_bytes)
+Response chunk   = result_byte ‖ uvarint(ssz_len) ‖ snappy-frame(ssz_bytes)
+Gossip payload   = snappy-block(ssz_bytes)
+
+(/root/reference/beacon_node/lighthouse_network/src/rpc/codec/ssz_snappy.rs:1
+— the varint is of the UNCOMPRESSED length, bounding decompression before
+it runs.)
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.network.wire import snappy
+
+MAX_PAYLOAD = 10 * 1024 * 1024  # spec max_chunk_size ballpark
+
+RESP_SUCCESS = 0x00
+RESP_INVALID_REQUEST = 0x01
+RESP_SERVER_ERROR = 0x02
+RESP_RESOURCE_UNAVAILABLE = 0x03
+
+
+class CodecError(ValueError):
+    pass
+
+
+def encode_payload(ssz_bytes: bytes) -> bytes:
+    return snappy.uvarint_encode(len(ssz_bytes)) + \
+        snappy.frame_compress(ssz_bytes)
+
+
+def decode_payload(data: bytes) -> bytes:
+    try:
+        declared, off = snappy.uvarint_decode(data)
+        if declared > MAX_PAYLOAD:
+            raise CodecError(f"declared payload {declared} over limit")
+        out = snappy.frame_decompress(data[off:], max_len=declared)
+    except snappy.SnappyError as e:
+        raise CodecError(str(e)) from e
+    if len(out) != declared:
+        raise CodecError(
+            f"payload length {len(out)} != declared {declared}")
+    return out
+
+
+def encode_response_chunk(result: int, ssz_bytes: bytes) -> bytes:
+    return bytes([result]) + encode_payload(ssz_bytes)
+
+
+def decode_response_chunk(data: bytes) -> tuple[int, bytes]:
+    if not data:
+        raise CodecError("empty response chunk")
+    return data[0], decode_payload(data[1:])
+
+
+def encode_gossip(ssz_bytes: bytes) -> bytes:
+    return snappy.compress_block(ssz_bytes)
+
+
+def decode_gossip(data: bytes) -> bytes:
+    try:
+        return snappy.decompress_block(data, max_len=MAX_PAYLOAD)
+    except snappy.SnappyError as e:
+        raise CodecError(str(e)) from e

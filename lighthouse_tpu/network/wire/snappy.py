@@ -1,0 +1,228 @@
+"""Snappy compression: block format + framing format, from scratch.
+
+The eth2 wire protocol compresses gossip payloads with the snappy BLOCK
+format and req/resp payloads with the snappy FRAME format
+(/root/reference/beacon_node/lighthouse_network/src/rpc/codec/ssz_snappy.rs:1,
+via the `snap` crate).  No snappy library ships in this environment, so
+this module implements both:
+
+- the DECOMPRESSOR handles the full tag set (literals + all three copy
+  element widths), i.e. it decodes streams from any conformant encoder;
+- the COMPRESSOR emits literal-only streams (always valid snappy —
+  compression ratio 1, honesty over micro-optimizing a cold path; swap in
+  a matching emitter later without touching callers);
+- the frame format carries masked CRC32C checksums per chunk, verified on
+  decode (the spec's crc32c(data) mask/rotate).
+"""
+
+from __future__ import annotations
+
+import struct
+
+MAX_FRAME_DATA = 65536  # max uncompressed bytes per frame chunk
+_STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
+
+
+class SnappyError(ValueError):
+    pass
+
+
+# --- CRC32C (Castagnoli, reflected poly 0x82F63B78) -------------------------
+
+def _make_table():
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _make_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    c = crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --- varint ------------------------------------------------------------------
+
+def uvarint_encode(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def uvarint_decode(data: bytes, offset: int = 0) -> tuple[int, int]:
+    """Returns (value, next_offset)."""
+    shift = 0
+    value = 0
+    while True:
+        if offset >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[offset]
+        offset += 1
+        value |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return value, offset
+        shift += 7
+        if shift > 63:
+            raise SnappyError("varint too long")
+
+
+# --- block format ------------------------------------------------------------
+
+def compress_block(data: bytes) -> bytes:
+    """Literal-only snappy block stream (valid for any decoder)."""
+    out = bytearray(uvarint_encode(len(data)))
+    i = 0
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    while i < n:
+        chunk = data[i:i + (1 << 24)]  # 3-byte length field bound
+        ln = len(chunk) - 1
+        if ln < 60:
+            out.append(ln << 2)
+        elif ln < (1 << 8):
+            out.append(60 << 2)
+            out += struct.pack("<B", ln)
+        elif ln < (1 << 16):
+            out.append(61 << 2)
+            out += struct.pack("<H", ln)
+        else:
+            out.append(62 << 2)
+            out += struct.pack("<I", ln)[:3]
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def decompress_block(data: bytes, max_len: int | None = None) -> bytes:
+    """Full block-format decoder (literals + copy1/2/4)."""
+    expected, i = uvarint_decode(data)
+    if max_len is not None and expected > max_len:
+        raise SnappyError(f"declared length {expected} > limit {max_len}")
+    out = bytearray()
+    n = len(data)
+    while i < n:
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:                      # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if i + extra > n:
+                    raise SnappyError("truncated literal length")
+                ln = int.from_bytes(data[i:i + extra], "little")
+                i += extra
+            ln += 1
+            if i + ln > n:
+                raise SnappyError("truncated literal")
+            out += data[i:i + ln]
+            i += ln
+        else:                              # copy
+            if kind == 1:
+                if i >= n:
+                    raise SnappyError("truncated copy1")
+                ln = ((tag >> 2) & 0x7) + 4
+                off = ((tag >> 5) << 8) | data[i]
+                i += 1
+            elif kind == 2:
+                if i + 2 > n:
+                    raise SnappyError("truncated copy2")
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[i:i + 2], "little")
+                i += 2
+            else:
+                if i + 4 > n:
+                    raise SnappyError("truncated copy4")
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[i:i + 4], "little")
+                i += 4
+            if off == 0 or off > len(out):
+                raise SnappyError("copy offset out of range")
+            # overlapping copies are defined byte-by-byte
+            for _ in range(ln):
+                out.append(out[-off])
+        if len(out) > expected:
+            raise SnappyError("output exceeds declared length")
+    if len(out) != expected:
+        raise SnappyError(
+            f"declared {expected} bytes, produced {len(out)}")
+    return bytes(out)
+
+
+# --- framing format ----------------------------------------------------------
+
+def frame_compress(data: bytes) -> bytes:
+    """Snappy framing-format stream: stream id + uncompressed chunks
+    (type 0x01) with masked CRC32C, ≤65536 uncompressed bytes each."""
+    out = bytearray(_STREAM_ID)
+    offsets = range(0, len(data), MAX_FRAME_DATA) if data else (0,)
+    for i in offsets:
+        chunk = data[i:i + MAX_FRAME_DATA]
+        body = struct.pack("<I", _masked_crc(chunk)) + chunk
+        out.append(0x01)
+        out += struct.pack("<I", len(body))[:3]
+        out += body
+    return bytes(out)
+
+
+def frame_decompress(data: bytes, max_len: int | None = None) -> bytes:
+    """Decode a framing-format stream (compressed + uncompressed chunks,
+    skippable chunks ignored), verifying each chunk's CRC32C."""
+    out = bytearray()
+    i = 0
+    n = len(data)
+    seen_stream_id = False
+    while i < n:
+        if i + 4 > n:
+            raise SnappyError("truncated chunk header")
+        ctype = data[i]
+        clen = int.from_bytes(data[i + 1:i + 4], "little")
+        i += 4
+        if i + clen > n:
+            raise SnappyError("truncated chunk body")
+        body = data[i:i + clen]
+        i += clen
+        if ctype == 0xFF:
+            if body != b"sNaPpY":
+                raise SnappyError("bad stream identifier")
+            seen_stream_id = True
+            continue
+        if not seen_stream_id:
+            raise SnappyError("chunk before stream identifier")
+        if ctype == 0x00 or ctype == 0x01:
+            if clen < 4:
+                raise SnappyError("chunk too short for checksum")
+            want_crc = int.from_bytes(body[:4], "little")
+            payload = body[4:]
+            if ctype == 0x00:
+                payload = decompress_block(payload, max_len=MAX_FRAME_DATA)
+            if _masked_crc(payload) != want_crc:
+                raise SnappyError("chunk checksum mismatch")
+            out += payload
+            if max_len is not None and len(out) > max_len:
+                raise SnappyError("frame stream exceeds limit")
+        elif 0x80 <= ctype <= 0xFE:
+            continue                       # skippable
+        else:
+            raise SnappyError(f"unknown unskippable chunk 0x{ctype:02x}")
+    return bytes(out)

@@ -1,0 +1,659 @@
+"""Socket transport: asyncio TCP mux + UDP discovery, behind the fabric seams.
+
+`WireFabric` is the drop-in for `network/service.NetworkFabric`: its
+`.gossip.join(peer_id)` / `.rpc.join(peer_id)` return endpoints with the
+SAME interfaces as the in-process `GossipEndpoint` / `RpcEndpoint`
+(subscribe/unsubscribe/publish + register/request), so the router, sync
+manager and discovery logic run unchanged over real sockets.  Rebuild of
+the reference's libp2p service at this framework's altitude
+(/root/reference/beacon_node/lighthouse_network/src/service/mod.rs:112):
+
+- ONE TCP connection per peer pair, length-prefixed binary frames
+  multiplexing gossip pushes and RPC request/response streams; RPC
+  payloads use the ssz_snappy codec (wire/codec.py), gossip payloads the
+  snappy block format — the reference codec's framing
+  (rpc/codec/ssz_snappy.rs:1).
+- Gossip is mesh-limited flood: peers announce topic subscriptions on
+  HELLO and on change; a publisher/forwarder sends to at most D=8
+  subscribed peers (gossipsub's mesh degree,
+  .../gossipsub/src/behaviour.rs), with the seen-cache stopping loops.
+- Discovery is ping/findnode over UDP datagrams (discv5's transport
+  shape, .../src/discovery/mod.rs:1): `WireDiscoveryEndpoint` speaks the
+  same `register/request` protocol as the in-process rpc endpoint, so
+  network/discovery.py's Enr + k-bucket + lookup logic is reused as-is;
+  peer addresses learned from Enrs feed the TCP dialer.
+
+The asyncio loop runs in a daemon thread; the node's (synchronous)
+callers block on futures with timeouts.  Everything here is host-side IO
+— no device work — so plain asyncio is the right tool (the TPU data
+plane stays in ops/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import secrets
+import struct
+import threading
+import time
+from typing import Callable
+
+from lighthouse_tpu.common.logging import Logger
+from lighthouse_tpu.network.gossip import _SeenCache, message_id
+from lighthouse_tpu.network.rpc import RateLimiter, RpcError
+from lighthouse_tpu.network.wire import codec
+
+MESH_DEGREE = 8          # gossipsub D
+REQUEST_TIMEOUT_S = 10.0
+MAX_FRAME = 16 * 1024 * 1024
+
+# frame kinds
+K_HELLO = 0x01
+K_SUBSCRIBE = 0x02
+K_UNSUBSCRIBE = 0x03
+K_GOSSIP = 0x04
+K_RPC_REQ = 0x05
+K_RPC_CHUNK = 0x06
+K_RPC_END = 0x07
+K_RPC_ERR = 0x08
+K_GOODBYE = 0x09
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(data: bytes, off: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from("<H", data, off)
+    off += 2
+    return data[off:off + n].decode(), off + n
+
+
+class _Conn:
+    """One live TCP connection to a peer."""
+
+    def __init__(self, reader, writer, outbound: bool = False):
+        self.reader = reader
+        self.writer = writer
+        self.peer_id: str | None = None
+        self.topics: set[str] = set()
+        self.addr: tuple[str, int] | None = None   # their LISTEN addr
+        self.outbound = outbound                   # we initiated the dial
+        self.alive = True
+
+
+class WireNode:
+    """The per-process socket node: TCP listener + dialer + UDP discovery."""
+
+    def __init__(self, peer_id: str, listen_port: int = 0,
+                 fork_digest: bytes = b"\x00\x00\x00\x00",
+                 listen_host: str = "127.0.0.1"):
+        import concurrent.futures
+
+        self.peer_id = peer_id
+        self.fork_digest = fork_digest
+        self.listen_host = listen_host
+        # handlers run OFF the event loop: block import and RPC serving
+        # are heavyweight and may issue nested wire requests (parent
+        # lookups) — on the loop thread that deadlocks the loop against
+        # its own response frames
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="wire-worker")
+        self.listen_port = listen_port      # 0 = ephemeral, read back after start
+        self.log = Logger("wire")
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server = None
+        self._udp_transport = None
+        self._conns: dict[str, _Conn] = {}           # peer_id -> conn
+        self._topics: dict[str, Callable] = {}       # local subscriptions
+        self._rpc_handlers: dict[str, Callable] = {}
+        self._rpc_limiter = RateLimiter()
+        self._streams: dict[int, dict] = {}          # stream id -> state
+        self._next_stream = iter(range(1, 1 << 62))
+        self._seen = _SeenCache(capacity=8192)
+        self._udp_waiters: dict[bytes, asyncio.Future] = {}
+        self._udp_handlers: dict[str, Callable] = {}
+        self.on_delivery_result: Callable[[str, str, bool], None] | None = None
+        self.on_peer_connected: Callable[[str], None] | None = None
+        self.on_peer_disconnected: Callable[[str], None] | None = None
+        self._started = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "WireNode":
+        self._thread = threading.Thread(
+            target=self._run_loop, name="wire-loop", daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("wire node failed to start")
+        return self
+
+    def _run_loop(self):
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self._start_servers())
+        self._started.set()
+        try:
+            self.loop.run_forever()
+        finally:
+            self.loop.close()
+
+    async def _start_servers(self):
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.listen_host, self.listen_port)
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        self._udp_transport, _ = await self.loop.create_datagram_endpoint(
+            lambda: _UdpProtocol(self),
+            local_addr=(self.listen_host, self.listen_port))
+        self.log.info("listening", tcp=self.listen_port,
+                      udp=self.listen_port)
+
+    def stop(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self.loop is None:
+            return
+
+        async def _shutdown():
+            for conn in list(self._conns.values()):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            if self._server is not None:
+                self._server.close()
+            if self._udp_transport is not None:
+                self._udp_transport.close()
+            self.loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+            self._thread.join(timeout=5)
+        except Exception:
+            pass
+
+    def _call(self, coro, timeout=REQUEST_TIMEOUT_S):
+        """Run a coroutine on the wire loop from a foreign thread."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # -- connections ---------------------------------------------------------
+
+    async def _on_inbound(self, reader, writer):
+        await self._serve_conn(_Conn(reader, writer))
+
+    async def _dial(self, host: str, port: int) -> str:
+        """Open a connection; returns the remote peer id."""
+        reader, writer = await asyncio.open_connection(host, port)
+        conn = _Conn(reader, writer, outbound=True)
+        await self._send_hello(conn)
+        # the serve loop fills in peer_id on receiving their HELLO
+        task = asyncio.ensure_future(self._serve_conn(conn, said_hello=True))
+        for _ in range(200):
+            if conn.peer_id is not None or task.done():
+                break
+            await asyncio.sleep(0.025)
+        if conn.peer_id is None:
+            writer.close()
+            raise RpcError(f"handshake with {host}:{port} timed out")
+        return conn.peer_id
+
+    def connect(self, host: str, port: int) -> str:
+        """Dial a peer (sync facade).  Returns the remote peer id."""
+        return self._call(self._dial(host, port))
+
+    async def _send_hello(self, conn: _Conn):
+        hello = json.dumps({
+            "peer_id": self.peer_id,
+            "fork_digest": self.fork_digest.hex(),
+            "topics": sorted(self._topics),
+            "listen_port": self.listen_port,
+        }).encode()
+        await self._send_frame(conn, bytes([K_HELLO]) + hello)
+
+    async def _send_frame(self, conn: _Conn, frame: bytes):
+        conn.writer.write(struct.pack("<I", len(frame)) + frame)
+        await conn.writer.drain()
+
+    async def _serve_conn(self, conn: _Conn, said_hello: bool = False):
+        try:
+            if not said_hello:
+                await self._send_hello(conn)
+            while True:
+                hdr = await conn.reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                if n > MAX_FRAME:
+                    raise RpcError(f"oversized frame {n}")
+                frame = await conn.reader.readexactly(n)
+                await self._on_frame(conn, frame)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception as e:
+            self.log.warn("connection error", peer=conn.peer_id, err=str(e))
+        finally:
+            conn.alive = False
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+            if conn.peer_id and self._conns.get(conn.peer_id) is conn:
+                del self._conns[conn.peer_id]
+                if self.on_peer_disconnected:
+                    try:
+                        self.on_peer_disconnected(conn.peer_id)
+                    except Exception:
+                        pass
+
+    # -- frame handling ------------------------------------------------------
+
+    async def _on_frame(self, conn: _Conn, frame: bytes):
+        kind = frame[0]
+        body = frame[1:]
+        if kind == K_HELLO:
+            d = json.loads(body)
+            if bytes.fromhex(d["fork_digest"]) != self.fork_digest:
+                raise RpcError("wrong network (fork digest mismatch)")
+            conn.peer_id = d["peer_id"]
+            conn.topics = set(d.get("topics", ()))
+            peer_host = conn.writer.get_extra_info("peername")[0]
+            conn.addr = (peer_host, int(d.get("listen_port", 0)))
+            old = self._conns.get(conn.peer_id)
+            if old is not None and old is not conn and old.alive:
+                # simultaneous dial: both sides keep the connection the
+                # lexicographically smaller PEER initiated — a direction-
+                # based rule both ends compute identically (tiebreaking
+                # on local arrival order closes opposite connections and
+                # strands both peers)
+                keep_outbound = self.peer_id < conn.peer_id
+                keep, drop = ((conn, old)
+                              if conn.outbound == keep_outbound
+                              else (old, conn))
+                drop.alive = False
+                drop.writer.close()
+                if keep is old:
+                    return
+            self._conns[conn.peer_id] = conn
+            if self.on_peer_connected:
+                try:
+                    self.on_peer_connected(conn.peer_id)
+                except Exception:
+                    pass
+        elif kind == K_SUBSCRIBE:
+            conn.topics.add(body.decode())
+        elif kind == K_UNSUBSCRIBE:
+            conn.topics.discard(body.decode())
+        elif kind == K_GOSSIP:
+            # a malformed payload penalizes the message/peer, it does NOT
+            # sever the connection (gossipsub drops invalid messages)
+            topic, off = _unpack_str(body, 0)
+            try:
+                data = codec.decode_gossip(body[off:])
+            except codec.CodecError:
+                if self.on_delivery_result is not None:
+                    try:
+                        self.on_delivery_result(conn.peer_id, topic, False)
+                    except Exception:
+                        pass
+                return
+            self._on_gossip(conn.peer_id, topic, data)
+        elif kind == K_RPC_REQ:
+            (stream,) = struct.unpack_from("<Q", body, 0)
+            proto, off = _unpack_str(body, 8)
+            try:
+                payload = codec.decode_payload(body[off:])
+            except codec.CodecError as e:
+                await self._send_frame(
+                    conn, bytes([K_RPC_ERR]) + struct.pack("<Q", stream)
+                    + f"bad request payload: {e}".encode())
+                return
+            asyncio.ensure_future(
+                self._serve_rpc(conn, stream, proto, payload))
+        elif kind == K_RPC_CHUNK:
+            (stream,) = struct.unpack_from("<Q", body, 0)
+            result, chunk = codec.decode_response_chunk(body[8:])
+            st = self._streams.get(stream)
+            if st is not None:
+                if result == codec.RESP_SUCCESS:
+                    st["chunks"].append(chunk)
+                else:
+                    st["error"] = chunk.decode(errors="replace")
+        elif kind == K_RPC_END:
+            (stream,) = struct.unpack_from("<Q", body, 0)
+            st = self._streams.pop(stream, None)
+            if st is not None and not st["future"].done():
+                if st.get("error"):
+                    st["future"].set_exception(RpcError(st["error"]))
+                else:
+                    st["future"].set_result(st["chunks"])
+        elif kind == K_RPC_ERR:
+            (stream,) = struct.unpack_from("<Q", body, 0)
+            st = self._streams.pop(stream, None)
+            if st is not None and not st["future"].done():
+                st["future"].set_exception(
+                    RpcError(body[8:].decode(errors="replace")))
+        elif kind == K_GOODBYE:
+            conn.writer.close()
+
+    # -- gossip --------------------------------------------------------------
+
+    def _on_gossip(self, src: str, topic: str, data: bytes):
+        if not self._seen.observe(message_id(topic, data)):
+            return
+        handler = self._topics.get(topic)
+
+        async def run():
+            ok = True
+            if handler is not None:
+                try:
+                    await self.loop.run_in_executor(
+                        self._pool, handler, topic, data, src)
+                except Exception:
+                    ok = False
+            if self.on_delivery_result is not None:
+                try:
+                    self.on_delivery_result(src, topic, ok)
+                except Exception:
+                    pass
+            # forward valid messages on (mesh flood with dedup); invalid
+            # messages are NOT propagated (gossipsub validation gating)
+            if ok:
+                await self._fanout(topic, data, exclude={src})
+
+        asyncio.ensure_future(run())
+
+    async def _fanout(self, topic: str, data: bytes, exclude: set[str]):
+        wire = bytes([K_GOSSIP]) + _pack_str(topic) + codec.encode_gossip(data)
+        targets = [c for pid, c in self._conns.items()
+                   if pid not in exclude and topic in c.topics and c.alive]
+        for conn in targets[:MESH_DEGREE]:
+            try:
+                await self._send_frame(conn, wire)
+            except Exception:
+                pass
+
+    def publish(self, topic: str, data: bytes):
+        self._seen.observe(message_id(topic, data))  # don't re-deliver to self
+        asyncio.run_coroutine_threadsafe(
+            self._fanout(topic, data, exclude=set()), self.loop)
+
+    def subscribe(self, topic: str, handler: Callable):
+        self._topics[topic] = handler
+        self._announce(K_SUBSCRIBE, topic)
+
+    def unsubscribe(self, topic: str):
+        self._topics.pop(topic, None)
+        self._announce(K_UNSUBSCRIBE, topic)
+
+    def _announce(self, kind: int, topic: str):
+        if self.loop is None:
+            return
+
+        async def _do():
+            frame = bytes([kind]) + topic.encode()
+            for conn in list(self._conns.values()):
+                try:
+                    await self._send_frame(conn, frame)
+                except Exception:
+                    pass
+
+        asyncio.run_coroutine_threadsafe(_do(), self.loop)
+
+    # -- rpc -----------------------------------------------------------------
+
+    def register_rpc(self, protocol: str, handler: Callable):
+        self._rpc_handlers[protocol] = handler
+
+    async def _serve_rpc(self, conn: _Conn, stream: int, proto: str,
+                         payload: bytes):
+        try:
+            if not self._rpc_limiter.allow(conn.peer_id or "?", proto):
+                raise RpcError(f"rate-limited on {proto}")
+            handler = self._rpc_handlers.get(proto)
+            if handler is None:
+                raise RpcError(f"unsupported protocol {proto}")
+            chunks = await self.loop.run_in_executor(
+                self._pool, handler, conn.peer_id, payload)
+            for c in chunks:
+                await self._send_frame(conn, bytes([K_RPC_CHUNK])
+                                       + struct.pack("<Q", stream)
+                                       + codec.encode_response_chunk(
+                                           codec.RESP_SUCCESS, c))
+            await self._send_frame(
+                conn, bytes([K_RPC_END]) + struct.pack("<Q", stream))
+        except Exception as e:
+            try:
+                await self._send_frame(
+                    conn, bytes([K_RPC_ERR]) + struct.pack("<Q", stream)
+                    + str(e).encode())
+            except Exception:
+                pass
+
+    def request(self, dst_peer: str, protocol: str,
+                data: bytes) -> list[bytes]:
+        """Sync RPC call over the peer's connection."""
+        async def _do():
+            conn = self._conns.get(dst_peer)
+            if conn is None or not conn.alive:
+                raise RpcError(f"not connected to {dst_peer}")
+            stream = next(self._next_stream)
+            fut = self.loop.create_future()
+            self._streams[stream] = {"future": fut, "chunks": [],
+                                     "error": None}
+            await self._send_frame(
+                conn, bytes([K_RPC_REQ]) + struct.pack("<Q", stream)
+                + _pack_str(protocol) + codec.encode_payload(data))
+            try:
+                return await asyncio.wait_for(fut, REQUEST_TIMEOUT_S)
+            finally:
+                self._streams.pop(stream, None)
+
+        return self._call(_do(), timeout=REQUEST_TIMEOUT_S + 2)
+
+    # -- udp discovery -------------------------------------------------------
+
+    def register_udp(self, protocol: str, handler: Callable):
+        """Serve a discovery protocol over UDP datagrams."""
+        self._udp_handlers[protocol] = handler
+
+    def udp_request(self, addr: tuple[str, int], protocol: str,
+                    data: bytes, timeout: float = 3.0) -> list[bytes]:
+        async def _do():
+            nonce = secrets.token_bytes(8)
+            fut = self.loop.create_future()
+            self._udp_waiters[nonce] = fut
+            msg = json.dumps({
+                "t": "req", "n": nonce.hex(), "p": protocol,
+                "d": data.hex(), "from": self.peer_id,
+            }).encode()
+            self._udp_transport.sendto(msg, addr)
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            finally:
+                self._udp_waiters.pop(nonce, None)
+
+        return self._call(_do(), timeout=timeout + 1)
+
+    def _on_datagram(self, data: bytes, addr):
+        try:
+            d = json.loads(data)
+        except ValueError:
+            return
+        if d.get("t") == "req":
+            handler = self._udp_handlers.get(d.get("p"))
+            if handler is None:
+                return
+            try:
+                chunks = handler(d.get("from", "?"),
+                                 bytes.fromhex(d.get("d", "")))
+            except Exception:
+                return
+            resp = json.dumps({
+                "t": "resp", "n": d["n"],
+                "c": [c.hex() for c in chunks],
+            }).encode()
+            self._udp_transport.sendto(resp, addr)
+        elif d.get("t") == "resp":
+            fut = self._udp_waiters.pop(bytes.fromhex(d.get("n", "")), None)
+            if fut is not None and not fut.done():
+                fut.set_result([bytes.fromhex(c) for c in d.get("c", ())])
+
+    @property
+    def peers(self) -> list[str]:
+        return [pid for pid, c in self._conns.items() if c.alive]
+
+    def peer_addr(self, peer_id: str) -> tuple[str, int] | None:
+        conn = self._conns.get(peer_id)
+        return conn.addr if conn else None
+
+
+class _UdpProtocol(asyncio.DatagramProtocol):
+    def __init__(self, node: WireNode):
+        self.node = node
+
+    def datagram_received(self, data, addr):
+        self.node._on_datagram(data, addr)
+
+
+# --- fabric seams ------------------------------------------------------------
+
+
+class WireGossipEndpoint:
+    """GossipEndpoint seam over the socket node."""
+
+    def __init__(self, node: WireNode):
+        self.node = node
+        self.peer_id = node.peer_id
+        self._handlers: dict[str, Callable] = {}
+
+    @property
+    def on_delivery_result(self):
+        return self.node.on_delivery_result
+
+    @on_delivery_result.setter
+    def on_delivery_result(self, fn):
+        self.node.on_delivery_result = fn
+
+    def subscribe(self, topic: str, handler):
+        from lighthouse_tpu.network.gossip import GossipMessage
+
+        def _adapt(t, data, src):
+            handler(GossipMessage(t, data, src))
+
+        self._handlers[topic] = handler
+        self.node.subscribe(topic, _adapt)
+
+    def unsubscribe(self, topic: str):
+        self._handlers.pop(topic, None)
+        self.node.unsubscribe(topic)
+
+    def publish(self, topic: str, data: bytes):
+        self.node.publish(topic, data)
+
+
+class WireRpcEndpoint:
+    """RpcEndpoint seam over the socket node; dials on demand via the
+    address book the discovery layer maintains."""
+
+    def __init__(self, node: WireNode, resolve_addr: Callable | None = None):
+        self.node = node
+        self.peer_id = node.peer_id
+        self._resolve_addr = resolve_addr
+
+    def register(self, protocol: str, handler):
+        self.node.register_rpc(protocol, handler)
+
+    def request(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        if dst not in self.node.peers and self._resolve_addr is not None:
+            addr = self._resolve_addr(dst)
+            if addr is not None:
+                try:
+                    self.node.connect(*addr)
+                except Exception as e:
+                    raise RpcError(f"dial {dst} failed: {e}") from e
+        return self.node.request(dst, protocol, data)
+
+
+class WireDiscoveryEndpoint:
+    """The rpc-endpoint seam network/discovery.py binds to, carried over
+    UDP datagrams.  Peer ids resolve to (host, port) through the address
+    book populated from Enr records seen in responses."""
+
+    def __init__(self, node: WireNode):
+        self.node = node
+        self.peer_id = node.peer_id
+        self.addr_book: dict[str, tuple[str, int]] = {}
+
+    def register(self, protocol: str, handler):
+        self.node.register_udp(protocol, handler)
+
+    def _sniff_enrs(self, chunks: list[bytes]):
+        from lighthouse_tpu.network.discovery import Enr
+
+        for c in chunks:
+            try:
+                enr = Enr.from_bytes(c)
+            except Exception:
+                continue
+            self.addr_book[enr.peer_id] = (enr.ip, enr.port)
+
+    def resolve(self, peer_id: str) -> tuple[str, int] | None:
+        if ":" in peer_id:                      # "host:port" bootstrap form
+            host, port = peer_id.rsplit(":", 1)
+            return host, int(port)
+        return self.addr_book.get(peer_id)
+
+    def request(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        addr = self.resolve(dst)
+        if addr is None:
+            raise RpcError(f"no address for {dst}")
+        try:
+            chunks = self.node.udp_request(addr, protocol, data)
+        except (TimeoutError, asyncio.TimeoutError) as e:
+            raise RpcError(f"udp request to {dst} timed out") from e
+        self._sniff_enrs(chunks)
+        return chunks
+
+
+class WireFabric:
+    """Drop-in for service.NetworkFabric backed by sockets.
+
+    One per process; `.gossip.join()` / `.rpc.join()` hand out the seam
+    endpoints (join is a no-op rendezvous — the node IS the process)."""
+
+    def __init__(self, peer_id: str | None = None, listen_port: int = 0,
+                 fork_digest: bytes = b"\x00\x00\x00\x00",
+                 listen_host: str = "127.0.0.1"):
+        self.node = WireNode(
+            peer_id or ("peer-" + secrets.token_hex(8)),
+            listen_port=listen_port, fork_digest=fork_digest,
+            listen_host=listen_host).start()
+        self.discovery_ep = WireDiscoveryEndpoint(self.node)
+        self.gossip = _JoinShim(
+            lambda pid: WireGossipEndpoint(self.node))
+        self.rpc = _JoinShim(
+            lambda pid: WireRpcEndpoint(
+                self.node, resolve_addr=self.discovery_ep.resolve))
+
+    @property
+    def peer_id(self) -> str:
+        return self.node.peer_id
+
+    @property
+    def listen_port(self) -> int:
+        return self.node.listen_port
+
+    def connect(self, host: str, port: int) -> str:
+        return self.node.connect(host, port)
+
+    def stop(self):
+        self.node.stop()
+
+
+class _JoinShim:
+    def __init__(self, factory):
+        self._factory = factory
+
+    def join(self, peer_id: str):
+        return self._factory(peer_id)
