@@ -107,6 +107,22 @@ class BeaconNodeClient:
             f"&graffiti=0x{graffiti.hex()}")
         return bytes.fromhex(out["ssz_hex"]), out["version"]
 
+    def produce_blinded_block(self, slot: int, randao_reveal: bytes,
+                              graffiti: bytes = b"") -> tuple[bytes, str]:
+        """(unsigned_blinded_block_ssz, fork_name) — builder round trip."""
+        out = self._call(
+            "GET",
+            f"/eth/v1/validator/blinded_blocks/{slot}"
+            f"?randao_reveal=0x{randao_reveal.hex()}"
+            f"&graffiti=0x{graffiti.hex()}")
+        return bytes.fromhex(out["ssz_hex"]), out["version"]
+
+    def publish_blinded_block(self, signed_blinded) -> bytes | None:
+        out = self._call("POST", "/eth/v1/beacon/blinded_blocks",
+                         {"ssz_hex": signed_blinded.serialize().hex()})
+        root = out["data"]["root"]
+        return bytes.fromhex(root[2:]) if root else None
+
     def attestation_data(self, slot: int, committee_index: int) -> bytes:
         out = self._call(
             "GET", f"/eth/v1/validator/attestation_data?slot={slot}"

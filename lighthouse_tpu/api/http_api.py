@@ -55,6 +55,10 @@ class BeaconApi:
           self.attester_duties)
         r("GET", r"/eth/v3/validator/blocks/(?P<slot>\d+)",
           self.produce_block)
+        r("GET", r"/eth/v1/validator/blinded_blocks/(?P<slot>\d+)",
+          self.produce_blinded_block)
+        r("POST", r"/eth/v1/beacon/blinded_blocks",
+          self.publish_blinded_block)
         r("GET", r"/eth/v1/validator/attestation_data",
           self.attestation_data)
         r("GET", r"/eth/v1/validator/aggregate_attestation",
@@ -405,6 +409,52 @@ class BeaconApi:
         return {"version": fork,
                 "data": {"proposer_index": str(proposer)},
                 "ssz_hex": block.serialize().hex()}
+
+    def produce_blinded_block(self, slot, body=None, query=None):
+        """Blinded production (builder round trip; reference http_api
+        v1/validator/blinded_blocks)."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+
+        q = query or {}
+        randao = bytes.fromhex(
+            q.get("randao_reveal", "00" * 96).removeprefix("0x"))
+        graffiti = bytes.fromhex(
+            q.get("graffiti", "").removeprefix("0x") or "")
+        try:
+            blinded, proposer, source = self.chain.produce_blinded_block_on(
+                int(slot), randao, graffiti=graffiti)
+        except BlockError as e:
+            raise ApiError(400, str(e))
+        fork = self.chain.spec.fork_at_epoch(
+            self.chain.spec.compute_epoch_at_slot(int(slot)))
+        return {"version": fork,
+                "data": {"proposer_index": str(proposer),
+                         "payload_source": source},
+                "ssz_hex": blinded.serialize().hex()}
+
+    def publish_blinded_block(self, body=None):
+        """Unblind (local book or builder reveal) + import + broadcast."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+        from lighthouse_tpu.execution.blinded import (
+            decode_signed_blinded_block,
+        )
+
+        c = self.chain
+        raw = bytes.fromhex(json.loads(body)["ssz_hex"])
+        fork, sb = decode_signed_blinded_block(c.t, raw)
+        if sb is None:
+            raise ApiError(400, "undecodable blinded block")
+        try:
+            root, full = c.submit_blinded_block(sb)
+        except BlockError as e:
+            raise ApiError(400, f"invalid blinded block: {e}")
+        svc = self._network()
+        if svc is not None:
+            try:
+                svc.router.publish_block(full)
+            except Exception:
+                pass
+        return {"data": {"root": _hex(root) if root else None}}
 
     def attestation_data(self, body=None, query=None):
         """Unsigned AttestationData for (slot, committee_index) — the BN

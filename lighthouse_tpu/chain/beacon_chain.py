@@ -97,6 +97,10 @@ class BeaconChain:
         self.da_checker = DataAvailabilityChecker(spec)
         self.kzg_settings = kzg_settings
         self.execution_layer = execution_layer
+        # external builder (MEV) client + the payload book for the
+        # blinded round trip: block_hash -> ("local"|"builder", payload)
+        self.builder_client = None
+        self._blinded_payloads: dict[bytes, tuple[str, object]] = {}
         self.slasher = None  # attach a SlasherService to enable slashing detection
         self.eth1_service = None  # attach an Eth1Service for eth1data voting
         self.state_advance_timer = None  # StateAdvanceTimer.install()
@@ -794,6 +798,75 @@ class BeaconChain:
             SignatureStrategy.NO_VERIFICATION)
         block.state_root = trial.hash_tree_root()
         return block, proposer
+
+    def produce_blinded_block_on(self, slot: int, randao_reveal: bytes,
+                                 graffiti: bytes = b""):
+        """Blinded production for the builder round trip: race the
+        builder's bid against the local payload, build the full block on
+        the winner, return its BLINDED form + the payload source.  The
+        payload book remembers how to unblind on submission
+        (reference http_api produce_blinded_block + execution_layer
+        get_payload builder/local race)."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+        from lighthouse_tpu.execution.blinded import blind_block
+        from lighthouse_tpu.execution.builder_api import choose_payload
+
+        spec = self.spec
+        fork = spec.fork_at_epoch(spec.compute_epoch_at_slot(slot))
+        if fork in ("phase0", "altair"):
+            raise BlockError(
+                f"blinded production needs an execution fork, slot {slot} "
+                f"is {fork}")
+        payload, source = choose_payload(
+            self, slot, self.builder_client, local_payload=None)
+        block, proposer = self.produce_block_on(
+            slot, randao_reveal, graffiti=graffiti,
+            execution_payload=payload)
+        used = block.body.execution_payload
+        self._blinded_payloads[bytes(used.block_hash)] = (source, used)
+        while len(self._blinded_payloads) > 8:
+            self._blinded_payloads.pop(next(iter(self._blinded_payloads)))
+        return blind_block(self.t, fork, block), proposer, source
+
+    def submit_blinded_block(self, signed_blinded):
+        """Unblind a signed blinded block and import it: local payloads
+        come from the payload book, builder payloads are revealed by
+        POSTing the signed block to the builder.  A builder that fails
+        to reveal loses the proposal (the signature commits to ITS
+        payload header; nothing else can be substituted)."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+        from lighthouse_tpu.execution.blinded import (
+            UnblindError,
+            unblind_block,
+        )
+        from lighthouse_tpu.execution.builder_api import BuilderError
+
+        blinded = signed_blinded.message
+        spec = self.spec
+        fork = spec.fork_at_epoch(
+            spec.compute_epoch_at_slot(int(blinded.slot)))
+        header = blinded.body.execution_payload_header
+        entry = self._blinded_payloads.get(bytes(header.block_hash))
+        if entry is None:
+            raise BlockError("unknown blinded payload (not produced here)")
+        source, payload = entry
+        if source == "builder":
+            if self.builder_client is None:
+                raise BlockError("builder payload but no builder client")
+            try:
+                raw = self.builder_client.submit_blinded_block(
+                    signed_blinded.serialize())
+                payload = type(payload).deserialize(raw)
+            except (BuilderError, KeyError, ValueError) as e:
+                # same fault class the bid path tolerates: transport
+                # errors AND malformed 200 bodies (missing keys, bad hex,
+                # undecodable SSZ) are all "the builder failed us"
+                raise BlockError(f"builder failed to reveal: {e}") from e
+        try:
+            full = unblind_block(self.t, fork, signed_blinded, payload)
+        except UnblindError as e:
+            raise BlockError(str(e)) from e
+        return self.process_block(full), full
 
     def get_proposer_head(self, slot: int) -> bytes:
         """Head to build on, with the late-block re-org rule

@@ -57,12 +57,16 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--boot-nodes", default=None,
                     help="comma-separated host:port discovery bootstrap "
                          "addresses")
+    bn.add_argument("--builder", default=None,
+                    help="external block-builder (MEV) endpoint URL")
 
     vc = sub.add_parser("vc", help="run a validator client")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
     vc.add_argument("--validators-dir", default=None,
                     help="directory of EIP-2335 keystores")
     vc.add_argument("--keystore-password", default="")
+    vc.add_argument("--builder-blocks", action="store_true",
+                    help="propose via the blinded (builder) round trip")
     vc.add_argument("--interop-range", default=None,
                     help="START:END interop validator indices (dev)")
     vc.add_argument("--run-seconds", type=float, default=None)
@@ -179,6 +183,7 @@ def _run_bn(args) -> int:
         listen_port=args.listen_port,
         boot_nodes=tuple(a.strip() for a in args.boot_nodes.split(",")
                          if a.strip()) if args.boot_nodes else (),
+        builder_url=args.builder,
     )
     client = ClientBuilder(cfg).build()
     wire = client.services.get("wire")
@@ -234,7 +239,8 @@ def _run_vc(args) -> int:
     # over the standard HTTP API (validator/remote_client.py)
     from lighthouse_tpu.validator.remote_client import RemoteValidatorClient
 
-    rvc = RemoteValidatorClient(bn, store, spec)
+    rvc = RemoteValidatorClient(bn, store, spec,
+                                builder_blocks=args.builder_blocks)
     rvc.resolve_indices()
     genesis_time = int(genesis["genesis_time"])
     deadline = time.time() + args.run_seconds if args.run_seconds else None

@@ -54,6 +54,8 @@ class ClientConfig:
     # (reference beacon_node/src/config.rs listen-address/boot-nodes)
     listen_port: int | None = None
     boot_nodes: tuple = ()
+    # external block builder (MEV) endpoint; None = local payloads only
+    builder_url: str | None = None
 
 
 @dataclass
@@ -238,6 +240,12 @@ class ClientBuilder:
                 self.spec.seconds_per_slot),
             verify_signatures=self.config.verify_signatures,
             execution_layer=self._el)
+        if self.config.builder_url:
+            from lighthouse_tpu.execution.builder_api import BuilderApiClient
+
+            self.chain.builder_client = BuilderApiClient(
+                self.config.builder_url)
+            self.log.info("builder attached", url=self.config.builder_url)
         allow_mock = self.config.dev_mock_payloads
         if allow_mock is None:
             allow_mock = self.config.network in ("devnet", "minimal")

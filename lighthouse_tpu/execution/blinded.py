@@ -1,0 +1,100 @@
+"""Blinded-block plumbing: payload↔header conversion, blind/unblind.
+
+The builder (MEV) round trip signs a block that carries only the
+execution payload HEADER; the builder reveals the payload after seeing
+the signature.  Because an ExecutionPayloadHeader is exactly the
+payload's field-root vector, the blinded block's hash_tree_root — hence
+its signing root — equals the full block's, so one signature covers both
+forms (reference consensus/types/src/beacon_block_body.rs blinded
+variants + execution_layer/src/lib.rs propose_blinded_beacon_block).
+"""
+
+from __future__ import annotations
+
+_ROOT_FIELDS = {
+    "transactions_root": "transactions",
+    "withdrawals_root": "withdrawals",
+    "deposit_requests_root": "deposit_requests",
+    "withdrawal_requests_root": "withdrawal_requests",
+}
+
+
+class UnblindError(ValueError):
+    pass
+
+
+def payload_to_header(t, fork: str, payload):
+    """ExecutionPayload -> ExecutionPayloadHeader (field roots for the
+    variable-size fields, verbatim copies for the rest)."""
+    header_cls = t.execution_payload_header_class(fork)
+    pf = type(payload).fields
+    kwargs = {}
+    for name in header_cls.fields:
+        src = _ROOT_FIELDS.get(name)
+        if src is not None:
+            kwargs[name] = pf[src].hash_tree_root(getattr(payload, src))
+        else:
+            kwargs[name] = getattr(payload, name)
+    return header_cls(**kwargs)
+
+
+def blind_block(t, fork: str, block):
+    """Full BeaconBlock -> BlindedBeaconBlock (same hash_tree_root)."""
+    blinded_cls = t.blinded_beacon_block_class(fork)
+    body_cls = blinded_cls.fields["body"].cls
+    body_kwargs = {}
+    for name in body_cls.fields:
+        if name == "execution_payload_header":
+            body_kwargs[name] = payload_to_header(
+                t, fork, block.body.execution_payload)
+        else:
+            body_kwargs[name] = getattr(block.body, name)
+    return blinded_cls(
+        slot=block.slot, proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body=body_cls(**body_kwargs))
+
+
+def unblind_block(t, fork: str, signed_blinded, payload):
+    """SignedBlindedBeaconBlock + revealed payload -> SignedBeaconBlock.
+
+    Raises UnblindError unless the payload matches the header the
+    proposer signed (the trust boundary: a builder cannot swap payloads,
+    execution_layer/src/lib.rs header equality check)."""
+    blinded = signed_blinded.message
+    want = blinded.body.execution_payload_header
+    got = payload_to_header(t, fork, payload)
+    if want.hash_tree_root() != got.hash_tree_root():
+        raise UnblindError("revealed payload does not match signed header")
+    block_cls = t.beacon_block_class(fork)
+    body_cls = t.beacon_block_body_class(fork)
+    body_kwargs = {}
+    for name in body_cls.fields:
+        if name == "execution_payload":
+            body_kwargs[name] = payload
+        else:
+            body_kwargs[name] = getattr(blinded.body, name)
+    full = block_cls(
+        slot=blinded.slot, proposer_index=blinded.proposer_index,
+        parent_root=bytes(blinded.parent_root),
+        state_root=bytes(blinded.state_root),
+        body=body_cls(**body_kwargs))
+    signed_cls = t.signed_beacon_block_class(fork)
+    out = signed_cls(message=full,
+                     signature=bytes(signed_blinded.signature))
+    # invariant: one signature covers both forms
+    assert full.hash_tree_root() == blinded.hash_tree_root()
+    return out
+
+
+def decode_signed_blinded_block(t, raw: bytes):
+    """Decode a SignedBlindedBeaconBlock of unknown fork (newest-first,
+    like decode_signed_block)."""
+    for fork in ("electra", "deneb", "capella", "bellatrix"):
+        try:
+            return fork, t.signed_blinded_beacon_block_class(
+                fork).deserialize(raw)
+        except Exception:
+            continue
+    return None, None

@@ -83,6 +83,14 @@ class BuilderApiClient:
         except BuilderError:
             return False
 
+    def submit_blinded_block(self, signed_blinded_ssz: bytes) -> bytes:
+        """Reveal: POST the signed blinded block, get the payload SSZ
+        (builder-specs submit_blinded_block; the builder publishes the
+        full block itself in real life — the BN also imports locally)."""
+        out = self._call("POST", "/eth/v1/builder/blinded_blocks",
+                         {"ssz_hex": signed_blinded_ssz.hex()})
+        return bytes.fromhex(out["data"]["payload_ssz_hex"])
+
 
 class MockBuilder:
     """In-process builder (reference mock_builder.rs): bids a payload
@@ -93,8 +101,10 @@ class MockBuilder:
         self.chain = chain
         self.port = port
         self.value_wei = value_wei
-        self.fail_next = False          # fault injection
+        self.fail_next = False          # fault injection (bid)
+        self.fail_unblind = False       # fault injection (reveal)
         self.registrations: dict[str, dict] = {}
+        self._bid_payloads: dict[str, tuple[str, bytes]] = {}  # hash->(fork, ssz)
         self._srv = None
         self._thread = None
 
@@ -133,6 +143,11 @@ class MockBuilder:
                     spec = outer.chain.spec
                     fork = spec.fork_at_epoch(
                         spec.compute_epoch_at_slot(slot))
+                    # remember the payload behind the bid so the reveal
+                    # endpoint can serve the unblinding request
+                    outer._bid_payloads[
+                        bytes(payload.block_hash).hex()] = (
+                        fork, payload.serialize())
                     return self._reply(200, {"data": {
                         "value": str(outer.value_wei),
                         "payload_ssz_hex": payload.serialize().hex(),
@@ -148,6 +163,31 @@ class MockBuilder:
                         outer.registrations[
                             r["message"]["pubkey"]] = r["message"]
                     return self._reply(200, {})
+                if self.path == "/eth/v1/builder/blinded_blocks":
+                    if outer.fail_unblind:
+                        outer.fail_unblind = False
+                        return self._reply(500, {"message": "reveal down"})
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = bytes.fromhex(
+                        json.loads(self.rfile.read(n))["ssz_hex"])
+                    from lighthouse_tpu.execution.blinded import (
+                        decode_signed_blinded_block,
+                    )
+
+                    _, sb = decode_signed_blinded_block(outer.chain.t, raw)
+                    if sb is None:
+                        return self._reply(400, {"message": "undecodable"})
+                    key = bytes(sb.message.body.execution_payload_header
+                                .block_hash).hex()
+                    hit = outer._bid_payloads.get(key)
+                    if hit is None:
+                        return self._reply(
+                            404, {"message": "unknown payload header"})
+                    fork, ssz_bytes = hit
+                    return self._reply(200, {"data": {
+                        "payload_ssz_hex": ssz_bytes.hex(),
+                        "version": fork,
+                    }})
                 self._reply(404, {"message": "unknown route"})
 
         self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)

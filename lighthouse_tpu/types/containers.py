@@ -490,6 +490,52 @@ def make_types(preset: Preset) -> SimpleNamespace:
     SignedBeaconBlockDeneb = _signed("SignedBeaconBlockDeneb", BeaconBlockDeneb)
     SignedBeaconBlockElectra = _signed("SignedBeaconBlockElectra", BeaconBlockElectra)
 
+    # --- blinded blocks (builder/MEV path) --------------------------------
+    # The body swaps execution_payload for its HEADER; since an
+    # ExecutionPayloadHeader's hash_tree_root equals the payload's (the
+    # header IS the payload's field-root vector), a blinded block's
+    # hash_tree_root — hence its signing root — equals the full block's
+    # (reference consensus/types/src/beacon_block_body.rs blinded variants)
+
+    def _blinded_body(name, full_body_cls, header_cls):
+        # derive from the BUILT full body so the field lists can never
+        # drift (the root-equality invariant depends on identical order)
+        return _container(name, [
+            ("execution_payload_header", header_cls)
+            if fname == "execution_payload" else (fname, ftype)
+            for fname, ftype in full_body_cls.fields.items()])
+
+    BlindedBeaconBlockBodyBellatrix = _blinded_body(
+        "BlindedBeaconBlockBodyBellatrix", BeaconBlockBodyBellatrix,
+        ExecutionPayloadHeaderBellatrix)
+    BlindedBeaconBlockBodyCapella = _blinded_body(
+        "BlindedBeaconBlockBodyCapella", BeaconBlockBodyCapella,
+        ExecutionPayloadHeaderCapella)
+    BlindedBeaconBlockBodyDeneb = _blinded_body(
+        "BlindedBeaconBlockBodyDeneb", BeaconBlockBodyDeneb,
+        ExecutionPayloadHeaderDeneb)
+    BlindedBeaconBlockBodyElectra = _blinded_body(
+        "BlindedBeaconBlockBodyElectra", BeaconBlockBodyElectra,
+        ExecutionPayloadHeaderElectra)
+
+    BlindedBeaconBlockBellatrix = _block(
+        "BlindedBeaconBlockBellatrix", BlindedBeaconBlockBodyBellatrix)
+    BlindedBeaconBlockCapella = _block(
+        "BlindedBeaconBlockCapella", BlindedBeaconBlockBodyCapella)
+    BlindedBeaconBlockDeneb = _block(
+        "BlindedBeaconBlockDeneb", BlindedBeaconBlockBodyDeneb)
+    BlindedBeaconBlockElectra = _block(
+        "BlindedBeaconBlockElectra", BlindedBeaconBlockBodyElectra)
+
+    SignedBlindedBeaconBlockBellatrix = _signed(
+        "SignedBlindedBeaconBlockBellatrix", BlindedBeaconBlockBellatrix)
+    SignedBlindedBeaconBlockCapella = _signed(
+        "SignedBlindedBeaconBlockCapella", BlindedBeaconBlockCapella)
+    SignedBlindedBeaconBlockDeneb = _signed(
+        "SignedBlindedBeaconBlockDeneb", BlindedBeaconBlockDeneb)
+    SignedBlindedBeaconBlockElectra = _signed(
+        "SignedBlindedBeaconBlockElectra", BlindedBeaconBlockElectra)
+
     HistoricalBatch = _container("HistoricalBatch", [
         ("block_roots", RootsVector(P.slots_per_historical_root)),
         ("state_roots", RootsVector(P.slots_per_historical_root)),
@@ -620,6 +666,25 @@ def make_types(preset: Preset) -> SimpleNamespace:
     ns.signed_beacon_block_class = lambda fork: _by_fork[fork][2]
     ns.beacon_block_body_class = lambda fork: _by_fork[fork][3]
     ns.forks = tuple(_by_fork)
+
+    _blinded_by_fork = {
+        "bellatrix": (BlindedBeaconBlockBellatrix,
+                      SignedBlindedBeaconBlockBellatrix,
+                      ExecutionPayloadHeaderBellatrix),
+        "capella": (BlindedBeaconBlockCapella,
+                    SignedBlindedBeaconBlockCapella,
+                    ExecutionPayloadHeaderCapella),
+        "deneb": (BlindedBeaconBlockDeneb, SignedBlindedBeaconBlockDeneb,
+                  ExecutionPayloadHeaderDeneb),
+        "electra": (BlindedBeaconBlockElectra,
+                    SignedBlindedBeaconBlockElectra,
+                    ExecutionPayloadHeaderElectra),
+    }
+    ns.blinded_beacon_block_class = lambda fork: _blinded_by_fork[fork][0]
+    ns.signed_blinded_beacon_block_class = \
+        lambda fork: _blinded_by_fork[fork][1]
+    ns.execution_payload_header_class = \
+        lambda fork: _blinded_by_fork[fork][2]
 
     def decode_signed_block(raw: bytes):
         """Decode a SignedBeaconBlock of unknown fork (newest first —

@@ -30,10 +30,14 @@ class RemoteSlotSummary:
 
 
 class RemoteValidatorClient:
-    def __init__(self, bn: BeaconNodeClient, store, spec: T.ChainSpec):
+    def __init__(self, bn: BeaconNodeClient, store, spec: T.ChainSpec,
+                 builder_blocks: bool = False):
         self.bn = bn
         self.store = store          # ValidatorStore (keys + slashing gate)
         self.spec = spec
+        # propose via the blinded (builder) round trip; the BN still
+        # falls back to a local payload when the builder has no bid
+        self.builder_blocks = builder_blocks
         self.t = T.make_types(spec.preset)
         self._index_of: dict[bytes, int] = {}
         # duties are stable within an epoch: one fetch per epoch, not per
@@ -84,6 +88,28 @@ class RemoteValidatorClient:
                 continue
             pk = bytes.fromhex(pk_hex)
             randao = self.store.sign_randao_reveal(pk, epoch)
+            if self.builder_blocks:
+                # blinded round trip: sign the header-carrying block
+                # (same signing root as the full block), the BN unblinds
+                raw, fork = self.bn.produce_blinded_block(slot, randao)
+                block = self.t.blinded_beacon_block_class(
+                    fork).deserialize(raw)
+                try:
+                    sig = self.store.sign_block(pk, block)
+                except SlashingProtectionError:
+                    summary.slashing_refusals += 1
+                    continue
+                signed = self.t.signed_blinded_beacon_block_class(fork)(
+                    message=block, signature=sig)
+                try:
+                    self.bn.publish_blinded_block(signed)
+                except ClientError:
+                    # builder failed to reveal: the proposal is lost (the
+                    # signature commits to the builder's payload header);
+                    # the duty loop must survive to the next slot
+                    continue
+                summary.blocks_proposed += 1
+                continue
             raw, fork = self.bn.produce_block(slot, randao)
             block = self.t.beacon_block_class(fork).deserialize(raw)
             try:
