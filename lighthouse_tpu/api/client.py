@@ -146,6 +146,21 @@ class BeaconNodeClient:
 
     # -- node ----------------------------------------------------------------
 
+    def block_root(self, block_id="head") -> bytes:
+        out = self._call("GET", f"/eth/v1/beacon/headers/{block_id}")
+        return bytes.fromhex(out["data"]["root"][2:])
+
+    def sync_duties(self, epoch: int, indices: list[int]):
+        out = self._call("POST", f"/eth/v1/validator/duties/sync/{epoch}",
+                         [str(i) for i in indices])
+        return out["data"]
+
+    def publish_sync_messages(self, msgs) -> None:
+        """msgs: [(SyncCommitteeMessage, subnet_id)]."""
+        self._call("POST", "/eth/v1/beacon/pool/sync_committees", [
+            {"ssz_hex": m.serialize().hex(), "subnet": subnet}
+            for m, subnet in msgs])
+
     def version(self) -> str:
         return self._call("GET", "/eth/v1/node/version")["data"]["version"]
 

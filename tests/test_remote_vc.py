@@ -42,6 +42,10 @@ class TestRemoteVC:
         assert s1.blocks_proposed == 1
         assert int(chain.head_state.slot) == 1
         assert s1.attestations_published >= 1
+        # sync committee messages flowed over the standard routes into
+        # the contribution pool (duties/sync + pool/sync_committees)
+        assert s1.sync_messages_published >= 1
+        assert len(chain.sync_pool) >= 1
         chain.slot_clock.set_slot(2)
         s2 = vc.run_slot(2)
         assert s2.blocks_proposed == 1
@@ -104,3 +108,26 @@ def test_remote_vc_electra_attestations_pack():
             server.stop()
     finally:
         bls.set_backend("reference")
+
+
+def test_sync_contribution_endpoint(remote_setup):
+    # after sync messages flow, the aggregator route must serve a
+    # decodable contribution (regression: the pool returns a raw
+    # (bits, signature) tuple, not a container)
+    import json
+    import urllib.request
+
+    h, chain, server, vc = remote_setup
+    chain.slot_clock.set_slot(1)
+    s = vc.run_slot(1)
+    assert s.sync_messages_published >= 1
+    root = chain.head_root
+    url = (f"http://127.0.0.1:{server.port}"
+           f"/eth/v1/validator/sync_committee_contribution"
+           f"?slot=1&beacon_block_root=0x{root.hex()}&subcommittee_index=0")
+    with urllib.request.urlopen(url, timeout=5) as r:
+        out = json.loads(r.read())
+    contrib = chain.t.SyncCommitteeContribution.deserialize(
+        bytes.fromhex(out["ssz_hex"]))
+    assert int(contrib.slot) == 1
+    assert any(contrib.aggregation_bits)
