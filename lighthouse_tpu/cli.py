@@ -59,6 +59,9 @@ def _build_parser() -> argparse.ArgumentParser:
                          "addresses")
     bn.add_argument("--builder", default=None,
                     help="external block-builder (MEV) endpoint URL")
+    bn.add_argument("--trusted-setup", default=None,
+                    help="path to the KZG ceremony trusted_setup.json "
+                         "(consensus-specs format)")
 
     vc = sub.add_parser("vc", help="run a validator client")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -184,6 +187,7 @@ def _run_bn(args) -> int:
         boot_nodes=tuple(a.strip() for a in args.boot_nodes.split(",")
                          if a.strip()) if args.boot_nodes else (),
         builder_url=args.builder,
+        trusted_setup_path=args.trusted_setup,
     )
     client = ClientBuilder(cfg).build()
     wire = client.services.get("wire")

@@ -56,6 +56,10 @@ class ClientConfig:
     boot_nodes: tuple = ()
     # external block builder (MEV) endpoint; None = local payloads only
     builder_url: str | None = None
+    # KZG ceremony output (consensus-specs trusted_setup_4096.json
+    # format) for deneb blob verification; None = no KZG (dev networks
+    # can run pre-deneb or pass a dev setup programmatically)
+    trusted_setup_path: str | None = None
 
 
 @dataclass
@@ -231,6 +235,15 @@ class ClientBuilder:
             SystemTimeSlotClock,
         )
 
+        kzg_settings = None
+        if self.config.trusted_setup_path:
+            from lighthouse_tpu.crypto.kzg import KzgSettings
+
+            kzg_settings = KzgSettings.load_trusted_setup(
+                self.config.trusted_setup_path)
+            self.log.info("trusted setup loaded",
+                          path=self.config.trusted_setup_path,
+                          width=kzg_settings.width)
         clock_cls = (ManualSlotClock if self.config.manual_slot_clock
                      else SystemTimeSlotClock)
         self.chain = BeaconChain(
@@ -239,6 +252,7 @@ class ClientBuilder:
                 int(self.genesis_state.genesis_time),
                 self.spec.seconds_per_slot),
             verify_signatures=self.config.verify_signatures,
+            kzg_settings=kzg_settings,
             execution_layer=self._el)
         if self.config.builder_url:
             from lighthouse_tpu.execution.builder_api import BuilderApiClient

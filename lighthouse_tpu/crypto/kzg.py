@@ -104,6 +104,57 @@ class KzgSettings:
         return KzgSettings(width, g1_lagrange_brp,
                            g2_tau, _bit_reversal_permutation(roots))
 
+    @staticmethod
+    def load_trusted_setup(source, validate: bool = True) -> "KzgSettings":
+        """Load the ceremony output (consensus-specs
+        trusted_setup_4096.json format: g1_lagrange in natural order +
+        g2_monomial, compressed hex — the file the reference embeds at
+        common/eth2_network_config/built_in_network_configs/trusted_setup.json
+        and parses in crypto/kzg/src/trusted_setup.rs).
+
+        The lagrange points are bit-reversal-permuted at load (c-kzg
+        load_trusted_setup does the same).  With validate=True (the
+        default, matching c-kzg) every G1 point passes the batched
+        device membership test; validate=False skips that and only
+        checks on-curve decompression + g1_lagrange[0]'s membership."""
+        import json as _json
+
+        if isinstance(source, dict):
+            d = source
+        else:
+            with open(source) as f:        # str / bytes / os.PathLike
+                d = _json.load(f)
+        n = len(d.get("g1_lagrange", ()))
+        if n == 0 or n & (n - 1):
+            raise KzgError(
+                f"g1_lagrange length {n} is not a power of two "
+                "(truncated trusted-setup file?)")
+        g1 = [cv.g1_from_bytes(bytes.fromhex(h.removeprefix("0x")),
+                               subgroup_check=False)
+              for h in d["g1_lagrange"]]
+        g2_tau = cv.g2_from_bytes(
+            bytes.fromhex(d["g2_monomial"][1].removeprefix("0x")))
+        # structural pins run in every mode: g2_monomial[0] must be THE
+        # G2 generator, and at least one lagrange point must be a member
+        if bytes.fromhex(d["g2_monomial"][0].removeprefix("0x")) != \
+                cv.g2_to_bytes(cv.g2_generator()):
+            raise KzgError("g2_monomial[0] is not the G2 generator")
+        if validate:
+            from lighthouse_tpu.ops.bls_backend import (
+                batch_subgroup_check_g1,
+            )
+
+            ok = batch_subgroup_check_g1(g1)
+            if not bool(ok.all()):
+                bad = [i for i, v in enumerate(ok) if not v]
+                raise KzgError(
+                    f"{len(bad)} g1_lagrange points fail the subgroup "
+                    f"check (first: index {bad[0]})")
+        elif not cv.g1_in_subgroup(g1[0]):
+            raise KzgError("g1_lagrange[0] fails the subgroup check")
+        return KzgSettings.from_setup_points(
+            _bit_reversal_permutation(g1), g2_tau)
+
 
 # --- field element / blob codecs -------------------------------------------
 

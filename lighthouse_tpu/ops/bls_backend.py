@@ -160,6 +160,28 @@ def batch_subgroup_check_g2(points) -> np.ndarray:
     return ok[:n]
 
 
+@jax.jit
+def _g1_subgroup_kernel(xp, yp):
+    return ec.g1_subgroup_check_batch(xp, yp)
+
+
+def batch_subgroup_check_g1(points) -> np.ndarray:
+    """Device [r-1]P membership test over affine G1 points -> bool[n]
+    (the trusted-setup validator and cold-pubkey batch path)."""
+    n = len(points)
+    if n == 0:
+        return np.zeros(0, bool)
+    padded = max(4, 1 << max(n - 1, 0).bit_length())
+    pts = list(points) + [cv.g1_generator()] * (padded - n)
+    xp = jnp.asarray(ec.ints_to_mont_limbs([p[0] for p in pts]))
+    yp = jnp.asarray(ec.ints_to_mont_limbs([p[1] for p in pts]))
+    d1, d2, Z = jax.tree_util.tree_map(
+        np.asarray, _g1_subgroup_kernel(xp, yp))
+    ok = ec.is_zero_mod_p(d1) & ec.is_zero_mod_p(d2) \
+        & ~ec.is_zero_mod_p(Z)
+    return ok[:n]
+
+
 def _ensure_subgroup_checked(sigs) -> bool:
     """Batch-check any signatures whose G2 membership is still pending.
     Returns False if any fails (callers bisect to attribute)."""

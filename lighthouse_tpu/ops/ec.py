@@ -412,6 +412,47 @@ def g2_subgroup_check_batch(xqa, xqb, yqa, yqb):
     return d1, d2, Z
 
 
+@_functools.cache
+def _r_minus_1_bits_const():
+    from lighthouse_tpu.crypto.bls.fields import R
+
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(
+            [[int(b)] for b in bin(R - 1)[2:]], jnp.uint32)  # [255, 1]
+
+
+def g1_subgroup_check_batch(xp, yp):
+    """Device half of the batched G1 membership test: [r-1]P == -P.
+
+    For P of order r, [r-1]P = -P exactly; for a cofactor-order point d,
+    (r-1) ≡ -1 (mod d) would force d | r.  Returns the residues
+
+        d1 = x_P·Z² - X_S,   d2 = y_P·Z³ + Y_S,   Z
+
+    for S = [r-1]P: a lane is in G1 iff d1 ≡ d2 ≡ 0 (mod P) and Z ≢ 0.
+    Same fail-closed shape as g2_subgroup_check_batch: a small-order lane
+    that hits the degenerate H == 0 chord mid-scan drives Z ≡ 0 and lands
+    in the reject branch."""
+    bits = jnp.broadcast_to(_r_minus_1_bits_const(), (255, xp.shape[0]))
+    X, Y, Z = _scalar_mul_batch(_FpAdapter, xp, yp, bits)
+
+    q = _MulQueue()
+    i_z2 = q.fp(Z, Z)
+    q.run()
+    z2 = q[i_z2]
+    q = _MulQueue()
+    i_xz = q.fp(xp, z2)
+    i_z3 = q.fp(z2, Z)
+    q.run()
+    xz, z3 = q[i_xz], q[i_z3]
+    q = _MulQueue()
+    i_yz = q.fp(yp, z3)
+    q.run()
+    d1 = bi.sub(xz, X)
+    d2 = bi.add(q[i_yz], Y)
+    return d1, d2, Z
+
+
 # --- host boundary helpers --------------------------------------------------
 
 

@@ -99,3 +99,160 @@ def test_constant_blob_infinity_proof(settings):
     commitment = kzg.blob_to_kzg_commitment(blob, settings)
     proof = kzg.compute_blob_kzg_proof(blob, commitment, settings)
     assert kzg.verify_blob_kzg_proof(blob, commitment, proof, settings)
+
+
+class TestTrustedSetupLoading:
+    def _ceremony_fixture(self, width=16, tau=0x123456789ABCDEF):
+        """Ceremony-FORMAT fixture from the dev τ: g1_lagrange in natural
+        order (loader applies the bit-reversal permutation, like c-kzg)."""
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.crypto.kzg import (
+            BLS_MODULUS,
+            _compute_roots_of_unity,
+        )
+
+        roots = _compute_roots_of_unity(width)
+        tau_pow = pow(tau, width, BLS_MODULUS)
+        g1 = cv.g1_generator()
+        lagrange_natural = []
+        for w_i in roots:
+            num = w_i * (tau_pow - 1) % BLS_MODULUS
+            den = width * (tau - w_i) % BLS_MODULUS
+            l_i = num * pow(den, -1, BLS_MODULUS) % BLS_MODULUS
+            lagrange_natural.append(cv.g1_mul(g1, l_i))
+        return {
+            "g1_lagrange": ["0x" + cv.g1_to_bytes(p).hex()
+                            for p in lagrange_natural],
+            "g2_monomial": [
+                "0x" + cv.g2_to_bytes(cv.g2_generator()).hex(),
+                "0x" + cv.g2_to_bytes(
+                    cv.g2_mul(cv.g2_generator(), tau)).hex(),
+            ],
+        }
+
+    def test_load_matches_dev_setup(self, tmp_path):
+        import json as _json
+
+        from lighthouse_tpu.crypto import kzg
+
+        fixture = self._ceremony_fixture()
+        path = tmp_path / "trusted_setup.json"
+        path.write_text(_json.dumps(fixture))
+        loaded = kzg.KzgSettings.load_trusted_setup(path, validate=True)
+        dev = kzg.KzgSettings.dev(width=16)
+        assert loaded.width == dev.width
+        assert loaded.g1_lagrange_brp == dev.g1_lagrange_brp
+        assert loaded.g2_tau == dev.g2_tau
+
+    def test_loaded_setup_verifies_blobs(self, tmp_path):
+        import json as _json
+
+        import numpy as np
+
+        from lighthouse_tpu.crypto import kzg
+        from lighthouse_tpu.crypto.bls.fields import R
+
+        fixture = self._ceremony_fixture()
+        path = tmp_path / "trusted_setup.json"
+        path.write_text(_json.dumps(fixture))
+        s = kzg.KzgSettings.load_trusted_setup(str(path), validate=False)
+        rng = np.random.default_rng(3)
+        blob = b"".join(kzg.bls_field_to_bytes(int(v) % R)
+                        for v in rng.integers(0, 2**62, size=s.width))
+        c = kzg.blob_to_kzg_commitment(blob, s)
+        proof = kzg.compute_blob_kzg_proof(blob, c, s)
+        assert kzg.verify_blob_kzg_proof(blob, c, proof, s)
+        bad = bytearray(blob)
+        bad[5] ^= 1
+        assert not kzg.verify_blob_kzg_proof(bytes(bad), c, proof, s)
+
+    def test_generator_check_rejects_forged_file(self, tmp_path):
+        import json as _json
+
+        import pytest
+
+        from lighthouse_tpu.crypto import kzg
+        from lighthouse_tpu.crypto.bls import curve as cv
+
+        fixture = self._ceremony_fixture()
+        fixture["g2_monomial"][0] = "0x" + cv.g2_to_bytes(
+            cv.g2_mul(cv.g2_generator(), 7)).hex()
+        path = tmp_path / "bad.json"
+        path.write_text(_json.dumps(fixture))
+        with pytest.raises(kzg.KzgError):
+            kzg.KzgSettings.load_trusted_setup(str(path))
+
+    def test_official_ceremony_file(self):
+        """The real mainnet ceremony output (the file the reference
+        embeds): lagrange basis must sum to G1 (Σ L_i(τ) = 1)."""
+        import os
+
+        import pytest
+
+        from lighthouse_tpu.crypto import kzg
+        from lighthouse_tpu.crypto.bls import curve as cv
+
+        path = ("/root/reference/common/eth2_network_config/"
+                "built_in_network_configs/trusted_setup.json")
+        if not os.path.exists(path):
+            pytest.skip("official ceremony file not available")
+        # validate=False: the full 4096-lane device check is the TPU
+        # path; the lagrange-sum identity below is the stronger oracle
+        s = kzg.KzgSettings.load_trusted_setup(path, validate=False)
+        assert s.width == 4096
+        acc = cv.INF
+        for p in s.g1_lagrange_brp:
+            acc = cv.g1_add(acc, p)
+        assert acc == cv.g1_generator()
+        assert cv.g2_in_subgroup_fast(s.g2_tau)
+
+
+# order-3 point on E(Fq) (NOT in G1; 3 | h1) — the adversarial case the
+# [r-1]P membership test must reject fail-closed
+G1_ORDER3_POINT = (
+    0x0,
+    0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAA9,
+)
+
+
+class TestDeviceG1SubgroupCheck:
+    def test_members_pass_cofactor_fails(self):
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.ops.bls_backend import batch_subgroup_check_g1
+
+        g = cv.g1_generator()
+        pts = [g, cv.g1_mul(g, 7), G1_ORDER3_POINT, cv.g1_mul(g, 12345)]
+        assert cv.g1_is_on_curve(G1_ORDER3_POINT)
+        assert not cv.g1_in_subgroup(G1_ORDER3_POINT)
+        ok = batch_subgroup_check_g1(pts)
+        assert list(ok) == [True, True, False, True]
+
+    def test_validate_rejects_corrupt_setup(self, tmp_path):
+        import json as _json
+
+        import pytest
+
+        from lighthouse_tpu.crypto import kzg
+        from lighthouse_tpu.crypto.bls import curve as cv
+
+        fixture = TestTrustedSetupLoading()._ceremony_fixture()
+        fixture["g1_lagrange"][5] = "0x" + cv.g1_to_bytes(
+            G1_ORDER3_POINT).hex()
+        path = tmp_path / "corrupt.json"
+        path.write_text(_json.dumps(fixture))
+        with pytest.raises(kzg.KzgError, match="subgroup"):
+            kzg.KzgSettings.load_trusted_setup(str(path), validate=True)
+
+    def test_truncated_setup_rejected(self, tmp_path):
+        import json as _json
+
+        import pytest
+
+        from lighthouse_tpu.crypto import kzg
+
+        fixture = TestTrustedSetupLoading()._ceremony_fixture()
+        fixture["g1_lagrange"] = fixture["g1_lagrange"][:15]
+        path = tmp_path / "trunc.json"
+        path.write_text(_json.dumps(fixture))
+        with pytest.raises(kzg.KzgError, match="power of two"):
+            kzg.KzgSettings.load_trusted_setup(str(path))
