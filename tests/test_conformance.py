@@ -97,3 +97,47 @@ class TestCliEntry:
         assert rc == 0
         out = capsys.readouterr().out
         assert '"failed": 0' in out
+
+
+class TestDifferentialBackends:
+    """VERDICT r2 #8: the same vector tree must pass under BOTH BLS
+    backends (pure-Python reference and the device pipeline) — a shared
+    logic bug in one data plane can't hide behind self-generated
+    expected values that the other plane reproduces independently."""
+
+    def test_tree_passes_under_both_bls_backends(self, vector_tree):
+        from lighthouse_tpu.crypto import bls
+
+        old = bls.get_backend()
+        reports = {}
+        try:
+            for backend in ("reference", "tpu"):
+                bls.set_backend(backend)
+                reports[backend] = run_tree(vector_tree)
+        finally:
+            bls.set_backend(old)
+        for backend, report in reports.items():
+            assert report.failed == 0, (backend, report.to_json())
+        assert reports["reference"].passed == reports["tpu"].passed
+
+    def test_state_roots_agree_across_merkleize_paths(self):
+        """Both merkleization routes (scalar host small-tree path and the
+        batched device fold) produce identical roots for a real state."""
+        import numpy as np
+
+        from lighthouse_tpu.ops import sha256 as sha_ops
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=32, fork="capella", real_crypto=False)
+        root_default = h.state.hash_tree_root()
+
+        # force the DEVICE path for every pair count, recompute, restore
+        old_min = sha_ops._DEVICE_MIN_PAIRS
+        try:
+            sha_ops._DEVICE_MIN_PAIRS = 1
+            st2 = h.state.copy()
+            st2._tree_cache = None   # drop the copied cache: force a
+            root_device = st2.hash_tree_root()  # full device recompute
+        finally:
+            sha_ops._DEVICE_MIN_PAIRS = old_min
+        assert root_default == root_device
