@@ -38,13 +38,14 @@ class BlsError(ValueError):
 class PublicKey:
     """Compressed G1 public key with lazy decompression + caching."""
 
-    __slots__ = ("_bytes", "_point")
+    __slots__ = ("_bytes", "_point", "_limbs")
 
     def __init__(self, data: bytes, point=None):
         if len(data) != 48:
             raise BlsError("public key must be 48 bytes")
         self._bytes = bytes(data)
         self._point = point
+        self._limbs = None
 
     @property
     def point(self):
@@ -54,6 +55,18 @@ class PublicKey:
                 raise BlsError("infinity public key rejected (eth2 KeyValidate)")
             self._point = pt
         return self._point
+
+    def mont_limbs(self):
+        """(x, y) Montgomery limb rows, cached — validator pubkeys recur
+        across every slot, so the int->limb conversion amortizes to zero
+        on the batch-aggregation device path."""
+        if self._limbs is None:
+            from lighthouse_tpu.ops import ec as _ec
+
+            x, y = self.point
+            self._limbs = (_ec.ints_to_mont_limbs([x])[0],
+                           _ec.ints_to_mont_limbs([y])[0])
+        return self._limbs
 
     def to_bytes(self) -> bytes:
         return self._bytes

@@ -413,6 +413,52 @@ def g2_subgroup_check_batch(xqa, xqb, yqa, yqb):
 
 
 @_functools.cache
+def _p_minus_2_bits_const():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(
+            [[int(b)] for b in bin(bi.P_INT - 2)[2:]], jnp.uint32)
+
+
+def fq_inv_batch(a):
+    """Batched Fq inversion by Fermat: a^(P-2), Montgomery domain.
+
+    One fixed-exponent square-and-multiply scan shared by all lanes
+    (381 steps × 2 mont_muls); a ≡ 0 lanes produce 0 — callers that can
+    meet zero must detect it separately (is_zero_mod_p on the host)."""
+    bits = jnp.broadcast_to(_p_minus_2_bits_const(),
+                            (_p_minus_2_bits_const().shape[0], a.shape[0]))
+    one = jnp.broadcast_to(bi._jconst("one_m"), a.shape)
+
+    def step(out, bit):
+        sq = bi.mont_mul(out, out)
+        withmul = bi.mont_mul(sq, a)
+        return jnp.where((bit != 0)[:, None], withmul, sq), None
+
+    out, _ = jax.lax.scan(step, one, bits)
+    return out
+
+
+def g1_jacobian_to_affine_batch(X, Y, Z):
+    """Jacobian -> affine over G1 lanes: (X/Z², Y/Z³) via one Fermat
+    inversion chain.  Z ≡ 0 (infinity) lanes come out as garbage — the
+    caller tests Z on the host (is_zero_mod_p)."""
+    zi = fq_inv_batch(Z)
+    q = _MulQueue()
+    i_zi2 = q.fp(zi, zi)
+    q.run()
+    zi2 = q[i_zi2]
+    q = _MulQueue()
+    i_x = q.fp(X, zi2)
+    i_zi3 = q.fp(zi2, zi)
+    q.run()
+    x, zi3 = q[i_x], q[i_zi3]
+    q = _MulQueue()
+    i_y = q.fp(Y, zi3)
+    q.run()
+    return x, q[i_y]
+
+
+@_functools.cache
 def _r_minus_1_bits_const():
     from lighthouse_tpu.crypto.bls.fields import R
 

@@ -172,3 +172,85 @@ class TestMessageGroupedPipeline:
             for s in range(4):
                 want = cv.g1_add(want, pts[s * 2 + g])
             assert aff == want, f"group {g} mismatch"
+
+
+class TestDevicePubkeyAggregation:
+    """aggregate_pubkeys_device vs the host per-set aggregation oracle."""
+
+    def _keys(self, n=12):
+        from lighthouse_tpu.crypto import bls
+
+        sks = [bls.SecretKey.from_bytes(int(500 + i).to_bytes(32, "big"))
+               for i in range(n)]
+        return sks, [sk.public_key() for sk in sks]
+
+    def test_matches_host_oracle_ragged(self):
+        import numpy as np
+
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.ops import bigint as bi
+        from lighthouse_tpu.ops.bls_backend import aggregate_pubkeys_device
+
+        sks, pks = self._keys()
+        msg = b"\x11" * 32
+        sig = sks[0].sign(msg)
+        sets = [bls.SignatureSet(sig, pks[:k], msg) for k in (1, 5, 12, 3)]
+        xa, ya, inf = aggregate_pubkeys_device(sets)
+        assert not inf.any()
+        for i, s in enumerate(sets):
+            want = s.aggregate_pubkey()
+            got = (int(bi.from_mont(xa[i])), int(bi.from_mont(ya[i])))
+            assert got == want, i
+
+    def test_identity_aggregate_flagged(self):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.ops.bls_backend import aggregate_pubkeys_device
+
+        sks, pks = self._keys(4)
+        msg = b"\x22" * 32
+        sig = sks[0].sign(msg)
+        neg = bls.PublicKey(cv.g1_to_bytes(cv.g1_neg(pks[1].point)))
+        sets = [bls.SignatureSet(sig, pks[:3], msg),
+                bls.SignatureSet(sig, [pks[1], neg] * 9, msg)]
+        _, _, inf = aggregate_pubkeys_device(sets)
+        assert list(inf) == [False, True]
+
+    def test_pipeline_end_to_end_with_aggregation(self):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.ops.bls_backend import verify_sets_pipeline
+
+        sks, pks = self._keys()
+        msg = b"\x33" * 32
+        sets = []
+        for lo, hi in ((0, 8), (1, 12), (2, 9)):
+            sig = bls.Signature.aggregate(
+                [sks[k].sign(msg) for k in range(lo, hi)])
+            sets.append(bls.SignatureSet(
+                bls.Signature(sig.to_bytes()), pks[lo:hi], msg))
+        assert verify_sets_pipeline(sets)
+        bad = list(sets)
+        bad[1] = bls.SignatureSet(sets[0].signature, sets[1].pubkeys, msg)
+        assert not verify_sets_pipeline(bad)
+
+    def test_duplicate_keys_aggregate_correctly(self):
+        # sync committees sample with replacement: duplicate member keys
+        # are honest inputs and must not hit the incomplete H == 0 chord
+        # (the blinding-lane design in aggregate_pubkeys_device)
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.ops import bigint as bi
+        from lighthouse_tpu.ops.bls_backend import aggregate_pubkeys_device
+
+        sks, pks = self._keys(8)
+        msg = b"\x44" * 32
+        sig = sks[0].sign(msg)
+        sets = [
+            bls.SignatureSet(sig, [pks[2], pks[2]], msg),
+            bls.SignatureSet(sig, [pks[1]] * 8 + pks[3:7], msg),
+        ]
+        xa, ya, inf = aggregate_pubkeys_device(sets)
+        assert not inf.any()
+        for i, s in enumerate(sets):
+            want = s.aggregate_pubkey()
+            got = (int(bi.from_mont(xa[i])), int(bi.from_mont(ya[i])))
+            assert got == want, i
