@@ -84,7 +84,7 @@ class ValidatorPubkeyCache:
         for i in range(len(self._keys), n):
             pk_bytes = bytes(pubkeys[i].tobytes()
                              if hasattr(pubkeys[i], "tobytes") else pubkeys[i])
-            self._keys.append(self._bls.PublicKey(pk_bytes))
+            self._keys.append(self._bls.PublicKey.interned(pk_bytes))
 
     def get(self, index: int):
         if 0 <= index < len(self._keys):

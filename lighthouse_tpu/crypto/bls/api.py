@@ -35,6 +35,13 @@ class BlsError(ValueError):
     pass
 
 
+from lighthouse_tpu.common.utils import LruCache  # noqa: E402
+
+# bounded so a hostile stream of unique keys cannot exhaust memory;
+# ~1M validators fit (mainnet registry scale)
+_PK_INTERN = LruCache(capacity=1 << 20)
+
+
 class PublicKey:
     """Compressed G1 public key with lazy decompression + caching."""
 
@@ -79,6 +86,18 @@ class PublicKey:
 
     def __repr__(self):
         return f"PublicKey({self._bytes.hex()[:16]}…)"
+
+    @staticmethod
+    def interned(data: bytes) -> "PublicKey":
+        """Process-wide interning: one PublicKey object per key, so the
+        decompression/subgroup/limb caches riding on it are paid once
+        per VALIDATOR (the reference's validator_pubkey_cache effect),
+        no matter which state or batch the key appears in."""
+        pk = _PK_INTERN.get(data)
+        if pk is None:
+            pk = PublicKey(data)
+            _PK_INTERN.put(bytes(data), pk)
+        return pk
 
     @staticmethod
     def aggregate(pubkeys: Sequence["PublicKey"]) -> "PublicKey":

@@ -1,0 +1,238 @@
+"""PeerDAS data-availability-sampling cells (EIP-7594 shape).
+
+The reference's cell functions are TODO stubs returning zeros
+(/root/reference/crypto/kzg/src/lib.rs:169-216, "use proper crypto once
+ckzg merges das branch"); this module implements the real polynomial
+math: a blob's evaluations extend onto the doubled domain (Reed-Solomon
+rate-1/2), cells are the bit-reversal-permuted cosets of that extended
+domain, and any half of the cells recovers the rest via the
+vanishing-polynomial / coset-division algorithm.
+
+Cell KZG multi-proofs follow the reference's snapshot state (not yet
+carried); `verify_cells_match_blob` is the data-level check available
+without them.  Corruption among RECEIVED cells is detected whenever the
+caller supplies more than the minimum half (at exactly half there is no
+redundancy — real PeerDAS proof-verifies cells before recovery).
+
+All arithmetic is over the BLS scalar field; the FFTs are host-side
+python ints today (the fr limb kernel in ops/fr.py is the device path
+for these butterflies when DAS hits the hot path).
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.crypto.kzg import (
+    BLS_MODULUS,
+    KzgError,
+    _bit_reversal_permutation,
+    _compute_roots_of_unity,
+    bls_field_to_bytes,
+    bytes_to_bls_field,
+)
+
+
+def _bytes_to_field_elements(data: bytes, count: int) -> list[int]:
+    if len(data) != count * 32:
+        raise KzgError(f"expected {count} field elements")
+    return [bytes_to_bls_field(data[i:i + 32])
+            for i in range(0, len(data), 32)]
+
+# mainnet: 4096-wide blobs -> 8192 extended evaluations -> 128 cells of
+# 64 field elements.  Smaller (dev) widths scale the cell size down,
+# keeping 128 cells whenever the extension has at least 128 points.
+CELLS_PER_EXT_BLOB = 128
+
+
+def _cell_geometry(width: int) -> tuple[int, int]:
+    ext = 2 * width
+    n_cells = min(CELLS_PER_EXT_BLOB, ext)
+    return n_cells, ext // n_cells
+
+
+def _fft(vals: list[int], roots: list[int], inverse: bool = False) -> list[int]:
+    """Iterative radix-2 NTT over the scalar field; `roots` is the full
+    n-th root-of-unity list for n == len(vals)."""
+    n = len(vals)
+    if n == 1:
+        return list(vals)
+    assert n & (n - 1) == 0
+    out = _bit_reversal_permutation(list(vals))
+    step = 1
+    while step < n:
+        stride = n // (2 * step)
+        for start in range(0, n, 2 * step):
+            for k in range(step):
+                idx = (n - k * stride) % n if inverse else k * stride
+                w = roots[idx]
+                a = out[start + k]
+                b = out[start + k + step] * w % BLS_MODULUS
+                out[start + k] = (a + b) % BLS_MODULUS
+                out[start + k + step] = (a - b) % BLS_MODULUS
+        step *= 2
+    if inverse:
+        n_inv = pow(n, -1, BLS_MODULUS)
+        out = [v * n_inv % BLS_MODULUS for v in out]
+    return out
+
+
+def _poly_coeffs_from_blob(blob: bytes, width: int) -> list[int]:
+    """Blob evaluations (brp domain order) -> monomial coefficients."""
+    evals_brp = _bytes_to_field_elements(blob, width)
+    evals = _bit_reversal_permutation(evals_brp)   # brp is an involution
+    roots = _compute_roots_of_unity(width)
+    return _fft(evals, roots, inverse=True)
+
+
+def compute_cells(blob: bytes, settings) -> list[bytes]:
+    """Extend the blob onto the doubled domain and split into cells.
+
+    Cell c holds the extended evaluations at positions
+    [c·cell_size, (c+1)·cell_size) of the BIT-REVERSED extended domain
+    (so each cell is a coset — the structure recovery relies on)."""
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    coeffs = _poly_coeffs_from_blob(blob, width)
+    ext_roots = _compute_roots_of_unity(2 * width)
+    ext_evals = _fft(coeffs + [0] * width, ext_roots)
+    ext_brp = _bit_reversal_permutation(ext_evals)
+    return [
+        b"".join(bls_field_to_bytes(v)
+                 for v in ext_brp[c * cell_size:(c + 1) * cell_size])
+        for c in range(n_cells)
+    ]
+
+
+def cells_to_blob(cells: list[bytes], settings) -> bytes:
+    """First half of the (brp) extended evaluations IS the blob."""
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    if len(cells) != n_cells:
+        raise KzgError(f"need all {n_cells} cells, got {len(cells)}")
+    joined = b"".join(cells)
+    return joined[: width * 32]
+
+
+def _cell_field_elements(cell: bytes, cell_size: int) -> list[int]:
+    if len(cell) != cell_size * 32:
+        raise KzgError("cell has the wrong size")
+    return _bytes_to_field_elements(cell, cell_size)
+
+
+def recover_all_cells(cell_ids: list[int], cells: list[bytes],
+                      settings) -> list[bytes]:
+    """Erasure recovery: any >= half of the cells reconstructs all of
+    them (vanishing-polynomial + coset-division, the c-kzg das
+    algorithm the reference is waiting on).
+
+    Steps: build Z(x) vanishing on the missing cells' cosets (each coset
+    is {h·w : w^cell_size = 1}, so its vanishing factor is the sparse
+    x^cell_size - h^cell_size); FFT-multiply E·Z, divide on a shifted
+    coset where Z has no roots, and re-extend."""
+    width = settings.width
+    ext = 2 * width
+    n_cells, cell_size = _cell_geometry(width)
+    if len(cell_ids) != len(cells):
+        raise KzgError("cell_ids and cells length mismatch")
+    if len(set(cell_ids)) != len(cell_ids):
+        raise KzgError("duplicate cell ids")
+    if any(not 0 <= c < n_cells for c in cell_ids):
+        raise KzgError("cell id out of range")
+    if len(cell_ids) < n_cells // 2:
+        raise KzgError(
+            f"need at least {n_cells // 2} cells, got {len(cell_ids)}")
+    have = dict(zip(cell_ids, cells))
+    if len(have) == n_cells:
+        return [have[c] for c in range(n_cells)]
+
+    ext_roots = _compute_roots_of_unity(ext)
+    # brp position -> natural extended-domain position
+    nat_of_brp = _bit_reversal_permutation(list(range(ext)))
+
+    # received evaluations in NATURAL order (0 at missing positions)
+    e_nat = [0] * ext
+    for cid, cell in have.items():
+        for k, v in enumerate(_cell_field_elements(cell, cell_size)):
+            e_nat[nat_of_brp[cid * cell_size + k]] = v
+
+    # Z(x) = prod over missing cells of (x^cell_size - h_c^cell_size),
+    # h_c the first root of the cell's coset
+    z = [1]
+    for cid in range(n_cells):
+        if cid in have:
+            continue
+        h = ext_roots[nat_of_brp[cid * cell_size]]
+        hc = pow(h, cell_size, BLS_MODULUS)
+        nz = [0] * (len(z) + cell_size)
+        for i, c in enumerate(z):
+            nz[i] = (nz[i] - c * hc) % BLS_MODULUS
+            nz[i + cell_size] = (nz[i + cell_size] + c) % BLS_MODULUS
+        z = nz
+    z_coeffs = z + [0] * (ext - len(z))
+
+    z_evals = _fft(z_coeffs, ext_roots)
+    ez_evals = [e * zv % BLS_MODULUS for e, zv in zip(e_nat, z_evals)]
+    ez_coeffs = _fft(ez_evals, ext_roots, inverse=True)
+
+    # divide on the coset g·domain (g a non-root shift): DZ/Z there,
+    # then unshift (the primitive root is outside every power-of-two
+    # root subgroup, so Z has no roots on the shifted coset)
+    from lighthouse_tpu.crypto.kzg import PRIMITIVE_ROOT_OF_UNITY
+
+    shift = PRIMITIVE_ROOT_OF_UNITY
+    shift_pows = [pow(shift, i, BLS_MODULUS) for i in range(ext)]
+    ezc_shift = [c * s % BLS_MODULUS for c, s in zip(ez_coeffs, shift_pows)]
+    zc_shift = [c * s % BLS_MODULUS
+                for c, s in zip(z_coeffs, shift_pows)]
+    ez_on_coset = _fft(ezc_shift, ext_roots)
+    z_on_coset = _fft(zc_shift, ext_roots)
+    d_on_coset = [
+        e * pow(zv, -1, BLS_MODULUS) % BLS_MODULUS
+        for e, zv in zip(ez_on_coset, z_on_coset)
+    ]
+    d_shift = _fft(d_on_coset, ext_roots, inverse=True)
+    shift_inv = pow(shift, -1, BLS_MODULUS)
+    inv_pows = [pow(shift_inv, i, BLS_MODULUS) for i in range(ext)]
+    d_coeffs = [c * s % BLS_MODULUS for c, s in zip(d_shift, inv_pows)]
+    if any(v != 0 for v in d_coeffs[width:]):
+        raise KzgError("recovered polynomial exceeds blob degree "
+                       "(inconsistent cells)")
+
+    full_evals = _fft(d_coeffs, ext_roots)
+    full_brp = _bit_reversal_permutation(full_evals)
+    out = []
+    for c in range(n_cells):
+        got = have.get(c)
+        if got is None:
+            got = b"".join(
+                bls_field_to_bytes(v)
+                for v in full_brp[c * cell_size:(c + 1) * cell_size])
+        out.append(got)
+    # received cells must be consistent with the recovered polynomial
+    for cid, cell in have.items():
+        want = full_brp[cid * cell_size:(cid + 1) * cell_size]
+        if _cell_field_elements(cell, cell_size) != want:
+            raise KzgError(f"cell {cid} inconsistent with recovery")
+    return out
+
+
+def verify_cells_match_blob(cells: list[bytes], cell_ids: list[int],
+                            blob: bytes, settings) -> bool:
+    """Check cells against the blob they claim to extend (the data-level
+    check available without cell multi-proofs)."""
+    n_cells, _ = _cell_geometry(settings.width)
+    if len(cells) != len(cell_ids):
+        return False
+    if any(not 0 <= cid < n_cells for cid in cell_ids):
+        return False
+    expected = compute_cells(blob, settings)
+    return all(expected[cid] == cell
+               for cid, cell in zip(cell_ids, cells))
+
+
+__all__ = [
+    "CELLS_PER_EXT_BLOB",
+    "cells_to_blob",
+    "compute_cells",
+    "recover_all_cells",
+    "verify_cells_match_blob",
+]

@@ -148,7 +148,7 @@ def batch_subgroup_check_g2(points) -> np.ndarray:
     n = len(points)
     if n == 0:
         return np.zeros(0, bool)
-    padded = max(4, 1 << max(n - 1, 0).bit_length())
+    padded = _next_pow2(n, floor=4)
     pts = list(points) + [cv.g2_generator()] * (padded - n)
     xqa, xqb, yqa, yqb = (jnp.asarray(a) for a in _g2_limbs(pts))
     d1, d2, Z = jax.tree_util.tree_map(
@@ -193,9 +193,17 @@ def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
 _BLIND_U: list[int] = []
 _BLIND_POINTS: list[tuple] = []
 _BLIND_NEG_TOTAL: dict[int, tuple] = {}     # max_k -> -[Σ_{j<k} u_j]G limbs
+import threading as _threading
+
+_BLIND_LOCK = _threading.Lock()
 
 
 def _blinding(max_k: int):
+    with _BLIND_LOCK:
+        return _blinding_locked(max_k)
+
+
+def _blinding_locked(max_k: int):
     while len(_BLIND_U) < max_k:
         u = 0
         while u == 0:
@@ -262,7 +270,7 @@ def batch_subgroup_check_g1(points) -> np.ndarray:
     n = len(points)
     if n == 0:
         return np.zeros(0, bool)
-    padded = max(4, 1 << max(n - 1, 0).bit_length())
+    padded = _next_pow2(n, floor=4)
     pts = list(points) + [cv.g1_generator()] * (padded - n)
     xp = jnp.asarray(ec.ints_to_mont_limbs([p[0] for p in pts]))
     yp = jnp.asarray(ec.ints_to_mont_limbs([p[1] for p in pts]))

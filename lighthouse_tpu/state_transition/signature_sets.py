@@ -17,7 +17,10 @@ from lighthouse_tpu.state_transition import misc
 
 
 def _pubkey(state, index: int) -> bls.PublicKey:
-    return bls.PublicKey(state.validators.pubkeys[int(index)].tobytes())
+    # interned: the same validator key across batches/states shares one
+    # object, so decompression + limb caches amortize per validator
+    return bls.PublicKey.interned(
+        state.validators.pubkeys[int(index)].tobytes())
 
 
 def block_proposal_set(state, spec, signed_block, block_root: bytes | None = None):
