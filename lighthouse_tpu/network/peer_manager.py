@@ -1,12 +1,17 @@
-"""Peer scoring and ban management.
+"""Peer scoring, ban management and connection bookkeeping.
 
 Rebuild of /root/reference/beacon_node/lighthouse_network/src/peer_manager/
-peerdb/score.rs:3-32: scores live in [-100, 100], decay toward zero, and
-crossing the ban threshold disconnects the peer.
-"""
+(peerdb/score.rs:3-32 + peerdb.rs connection states): scores live in
+[-100, 100] and decay toward zero with a 10-minute half-life; crossing
+the disconnect threshold sheds the peer, crossing the ban threshold bans
+it until the decayed score recovers (the reference's
+score-based-unban-after-decay behaviour); the manager also tracks
+connection state and picks pruning victims when over the target peer
+count (peer_manager/mod.rs prune_excess_peers)."""
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -15,6 +20,7 @@ MIN_SCORE = -100.0
 BAN_THRESHOLD = -50.0
 DISCONNECT_THRESHOLD = -20.0
 HALFLIFE_S = 600.0
+TARGET_PEERS = 64
 
 # standard penalty/reward magnitudes (peer_manager score actions)
 PENALTIES = {
@@ -34,12 +40,20 @@ class PeerInfo:
     score: float = 0.0
     last_update: float = field(default_factory=time.monotonic)
     banned: bool = False
+    connected: bool = False
+    # per-topic invalid-message counts (gossipsub scoring's per-topic
+    # mesh penalties, service/gossipsub_scoring_parameters.rs)
+    topic_penalties: dict = field(default_factory=dict)
 
 
 class PeerManager:
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=time.monotonic, target_peers: int = TARGET_PEERS):
         self.peers: dict[str, PeerInfo] = {}
         self.clock = clock
+        self.target_peers = target_peers
+        # report()/score() are read-modify-write and callers arrive on
+        # the wire event loop, the wire worker pool AND the slot thread
+        self._lock = threading.RLock()
 
     def _info(self, peer: str) -> PeerInfo:
         info = self.peers.get(peer)
@@ -53,25 +67,67 @@ class PeerManager:
         if dt > 0:
             info.score *= 0.5 ** (dt / HALFLIFE_S)
             info.last_update = now
+        # score-based unban: a banned peer whose decayed score recovered
+        # above the threshold is eligible again (score.rs unban flow)
+        if info.banned and info.score > BAN_THRESHOLD:
+            info.banned = False
 
-    def report(self, peer: str, action: str):
+    def report(self, peer: str, action: str, topic: str | None = None):
+      with self._lock:
         info = self._info(peer)
         self._decay(info)
         delta = PENALTIES.get(action, REWARDS.get(action, 0.0))
+        if topic is not None and delta < 0:
+            info.topic_penalties[topic] = \
+                info.topic_penalties.get(topic, 0) + 1
         info.score = max(MIN_SCORE, min(MAX_SCORE, info.score + delta))
         if info.score <= BAN_THRESHOLD:
             info.banned = True
 
     def score(self, peer: str) -> float:
-        info = self._info(peer)
-        self._decay(info)
-        return info.score
+        with self._lock:
+            info = self._info(peer)
+            self._decay(info)
+            return info.score
 
     def is_banned(self, peer: str) -> bool:
-        return self._info(peer).banned
+        with self._lock:
+            info = self._info(peer)
+            self._decay(info)
+            return info.banned
 
     def should_disconnect(self, peer: str) -> bool:
         return self.score(peer) <= DISCONNECT_THRESHOLD
 
+    def accept_connection(self, peer: str) -> bool:
+        """Gate for inbound dials: banned peers are refused at the door
+        (peerdb.rs BanResult)."""
+        return not self.is_banned(peer)
+
+    # -- connection bookkeeping -------------------------------------------
+
+    def mark_connected(self, peer: str):
+        with self._lock:
+            self._info(peer).connected = True
+
+    def mark_disconnected(self, peer: str):
+        with self._lock:
+            self._info(peer).connected = False
+
+    def connected_peers(self) -> list[str]:
+        return [p for p, i in self.peers.items() if i.connected]
+
+    def excess_peers(self) -> list[str]:
+        """Worst-scoring connected peers beyond the target count — the
+        pruning victims (peer_manager/mod.rs prune_excess_peers)."""
+        connected = self.connected_peers()
+        n_excess = len(connected) - self.target_peers
+        if n_excess <= 0:
+            return []
+        connected.sort(key=lambda p: self.score(p))
+        return connected[:n_excess]
+
     def good_peers(self) -> list[str]:
-        return [p for p, i in self.peers.items() if not i.banned]
+        # decay-aware: a long-quiet banned peer is eligible again, the
+        # same verdict is_banned()/accept_connection() would give
+        return [p for p in list(self.peers) if not self.is_banned(p)]

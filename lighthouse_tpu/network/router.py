@@ -167,7 +167,8 @@ class Router:
                                        "electra")
 
     def _score_delivery(self, source: str, topic_: str, ok: bool):
-        self.peers.report(source, "valid_message" if ok else "low")
+        self.peers.report(source, "valid_message" if ok else "low",
+                          topic=topic_)
 
     def _on_block(self, msg):
         c = self.chain

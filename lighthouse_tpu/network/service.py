@@ -61,6 +61,14 @@ class NetworkService:
             subnet_service=subnet_service)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
+        # socket fabrics: bind the peer manager to the transport — ban
+        # gate at the HELLO door, connection bookkeeping for pruning
+        node = getattr(fabric, "node", None)
+        if node is not None:
+            node.accept_peer = self.peer_manager.accept_connection
+            node.on_peer_connected = self.peer_manager.mark_connected
+            node.on_peer_disconnected = self.peer_manager.mark_disconnected
+
         # socket fabrics carry discovery over UDP datagrams and advertise
         # a real (host, port); the in-process fabric reuses the rpc seam
         disc_ep = getattr(fabric, "discovery_ep", None) or self.rpc_ep
@@ -72,8 +80,18 @@ class NetworkService:
             disc_ep, enr, fork_digest=fork_digest(chain))
 
     def on_slot(self, slot: int) -> None:
-        """Per-slot tick: apply subnet subscription deltas."""
+        """Per-slot tick: subnet subscription deltas + peer enforcement
+        (disconnect bad scores, prune beyond the target peer count)."""
         self.router.update_attestation_subnets(slot)
+        node = getattr(self.fabric, "node", None)
+        if node is None:
+            return
+        pm = self.peer_manager
+        for peer in list(node.peers):
+            if pm.is_banned(peer) or pm.should_disconnect(peer):
+                node.disconnect(peer)
+        for peer in pm.excess_peers():
+            node.disconnect(peer)
 
     def connect(self, other: "NetworkService"):
         """Mutual status handshake (dial)."""

@@ -119,6 +119,9 @@ class WireNode:
         self.on_delivery_result: Callable[[str, str, bool], None] | None = None
         self.on_peer_connected: Callable[[str], None] | None = None
         self.on_peer_disconnected: Callable[[str], None] | None = None
+        # ban gate: return False to refuse a peer at the HELLO door
+        # (peer_manager.accept_connection when a NetworkService attaches)
+        self.accept_peer: Callable[[str], bool] | None = None
         self._started = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
@@ -255,7 +258,14 @@ class WireNode:
             d = json.loads(body)
             if bytes.fromhex(d["fork_digest"]) != self.fork_digest:
                 raise RpcError("wrong network (fork digest mismatch)")
-            conn.peer_id = d["peer_id"]
+            pid = d["peer_id"]
+            if self.accept_peer is not None and not self.accept_peer(pid):
+                # refuse BEFORE exposing peer_id: the dialer's connect()
+                # polls conn.peer_id as its success signal
+                conn.alive = False
+                conn.writer.close()
+                return
+            conn.peer_id = pid
             conn.topics = set(d.get("topics", ()))
             peer_host = conn.writer.get_extra_info("peername")[0]
             conn.addr = (peer_host, int(d.get("listen_port", 0)))
@@ -498,6 +508,21 @@ class WireNode:
             fut = self._udp_waiters.pop(bytes.fromhex(d.get("n", "")), None)
             if fut is not None and not fut.done():
                 fut.set_result([bytes.fromhex(c) for c in d.get("c", ())])
+
+    def disconnect(self, peer_id: str):
+        """Drop a peer's connection (scoring/pruning enforcement)."""
+        conn = self._conns.get(peer_id)
+        if conn is None or self.loop is None:
+            return
+
+        async def _close():
+            conn.alive = False
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+        asyncio.run_coroutine_threadsafe(_close(), self.loop)
 
     @property
     def peers(self) -> list[str]:

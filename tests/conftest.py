@@ -24,6 +24,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: the BLS12-381 Miller program costs ~1 min of
@@ -39,3 +40,14 @@ try:
         _xb._backend_factories.pop("axon", None)
 except Exception:  # private API may move across jax versions; best-effort only
     pass
+
+
+@pytest.fixture(autouse=True)
+def _restore_bls_backend():
+    """ClientBuilder pins the process-global BLS backend (auto/fake/...);
+    restore it around every test so suites stay order-independent."""
+    from lighthouse_tpu.crypto import bls
+
+    old = bls.get_backend()
+    yield
+    bls.set_backend(old)

@@ -188,3 +188,64 @@ def _signed_exit(client):
     return T.SignedVoluntaryExit(
         message=T.VoluntaryExit(epoch=0, validator_index=3),
         signature=b"\xcc" * 96)
+
+
+class TestPeerEnforcement:
+    def test_banned_peer_refused_at_hello(self):
+        a, b = _mk_node("EA"), _mk_node("EB")
+        try:
+            a.accept_peer = lambda pid: pid != "EB"
+            # the dialer's handshake may transiently succeed (A's HELLO
+            # goes out on accept); the door slams when A reads B's HELLO
+            try:
+                b.connect("127.0.0.1", a.listen_port)
+            except Exception:
+                pass
+            time.sleep(0.3)
+            assert "EB" not in a.peers
+            assert _wait(lambda: "EA" not in b.peers)
+            # an acceptable peer still connects
+            c = _mk_node("EC")
+            try:
+                c.connect("127.0.0.1", a.listen_port)
+                assert _wait(lambda: "EC" in a.peers)
+            finally:
+                c.stop()
+        finally:
+            a.stop(), b.stop()
+
+    def test_disconnect_enforcement(self):
+        a, b = _mk_node("ED"), _mk_node("EE")
+        try:
+            a.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: "EE" in a.peers)
+            a.disconnect("EE")
+            assert _wait(lambda: "EE" not in a.peers)
+        finally:
+            a.stop(), b.stop()
+
+
+class TestPeerManagerScoring:
+    def test_score_decay_unbans(self):
+        from lighthouse_tpu.network.peer_manager import PeerManager
+
+        t = [0.0]
+        pm = PeerManager(clock=lambda: t[0])
+        for _ in range(4):
+            pm.report("p1", "high")      # 4 x -25 -> banned
+        assert pm.is_banned("p1")
+        assert not pm.accept_connection("p1")
+        t[0] += 3600                     # 6 half-lives: score ~ -1.5
+        assert not pm.is_banned("p1")
+        assert pm.accept_connection("p1")
+
+    def test_excess_peer_pruning_picks_worst(self):
+        from lighthouse_tpu.network.peer_manager import PeerManager
+
+        pm = PeerManager(target_peers=2)
+        for p in ("w", "x", "y", "z"):
+            pm.mark_connected(p)
+        pm.report("x", "mid")
+        pm.report("z", "high")
+        victims = pm.excess_peers()
+        assert victims == ["z", "x"]     # worst scores first
