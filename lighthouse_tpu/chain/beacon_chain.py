@@ -383,6 +383,7 @@ class BeaconChain:
         self.state_cache.insert(pending.state_root, state)
         self.pubkey_cache.import_new(state.validators)
         self.validator_monitor.on_block_imported(block, self.spec)
+        self._note_missed_proposals(block, state)
         try:
             self.light_client.on_block_imported(pending.signed_block)
         except Exception:
@@ -392,6 +393,29 @@ class BeaconChain:
             "execution_optimistic": pending.execution_status == 1})
         self.recompute_head()
         return root
+
+    def _note_missed_proposals(self, block, post_state) -> None:
+        """Feed skipped slots between a block and its parent to the
+        monitor (reference missed-block tracking).  Only pays the parent
+        lookup + proposer shuffles when someone is actually monitored."""
+        vm = self.validator_monitor
+        if not (vm.auto_register or vm.registered):
+            return
+        parent = self.store.get_block(bytes(block.parent_root))
+        if parent is None:
+            return
+        from lighthouse_tpu.state_transition import misc
+
+        epoch = self.spec.compute_epoch_at_slot(int(block.slot))
+        for slot in range(int(parent.message.slot) + 1, int(block.slot)):
+            if self.spec.compute_epoch_at_slot(slot) != epoch:
+                continue  # proposer shuffle differs across the boundary
+            try:
+                proposer = misc.get_beacon_proposer_index(
+                    post_state, self.spec, slot)
+            except Exception:
+                continue
+            vm.on_block_missed(slot, int(proposer), self.spec)
 
     def recompute_head(self) -> bytes:
         """Fork-choice get_head + head snapshot update + finality pruning
@@ -409,6 +433,21 @@ class BeaconChain:
                         self._state_root_of_block.get(head, b"")).hex(),
                     "epoch_transition": int(st.slot)
                     % self.spec.slots_per_epoch == 0})
+                epoch = self.spec.compute_epoch_at_slot(int(st.slot))
+                if epoch > getattr(self, "_monitor_epoch", -1):
+                    self._monitor_epoch = epoch
+                    self.validator_monitor.on_epoch_boundary(
+                        epoch, st, self.spec)
+                    # operator digest for the epoch just finished
+                    # (registered validators only — auto_register at
+                    # registry scale would flood the log)
+                    if self.validator_monitor.registered:
+                        from lighthouse_tpu.common.logging import Logger
+
+                        log = Logger("validator_monitor")
+                        for line in self.validator_monitor.log_lines(
+                                epoch - 1):
+                            log.info(line)
                 self._notify_forkchoice_updated(st)
         if self.fork_choice.finalized.epoch > self._migrated_finalized_epoch:
             self._on_finalized()
@@ -512,6 +551,8 @@ class BeaconChain:
             # feed the naive aggregation pool; its aggregates in turn feed
             # block packing via the operation pool
             self.naive_pool.insert(v.attestation)
+            self.validator_monitor.on_gossip_attestation(
+                v.indexed_indices, v.attestation.data, self.spec)
         return verified, rejects
 
     def verify_aggregates_for_gossip(self, aggregates: list):
@@ -521,6 +562,8 @@ class BeaconChain:
             aggregates, att_verify.verify_aggregated_for_gossip)
         for v in verified:
             att = v.attestation
+            self.validator_monitor.on_gossip_aggregate(
+                int(v.item.message.aggregator_index), att.data, self.spec)
             from lighthouse_tpu.state_transition.misc import (
                 attestation_committee_index,
             )

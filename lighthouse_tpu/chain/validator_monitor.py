@@ -20,7 +20,15 @@ class ValidatorEpochSummary:
     attestation_misses: int = 0
     inclusion_delays: list = field(default_factory=list)
     blocks_proposed: int = 0
+    blocks_missed: int = 0
     sync_signatures: int = 0
+    # gossip-level sightings (seen on the wire before inclusion — the
+    # reference distinguishes "seen" from "included")
+    attestations_seen: int = 0
+    aggregates_seen: int = 0
+    # balance tracking at the epoch boundary
+    balance_gwei: int = 0
+    balance_delta_gwei: int = 0
 
 
 class ValidatorMonitor:
@@ -29,6 +37,9 @@ class ValidatorMonitor:
         self.registered: set[int] = set()
         # epoch -> validator -> summary
         self._epochs: dict[int, dict[int, ValidatorEpochSummary]] = {}
+        # epoch -> balances snapshot (numpy; presence == recorded, so a
+        # legitimate 0 balance still yields a delta)
+        self._balances: dict[int, np.ndarray] = {}
         self._att_hits = REGISTRY.counter(
             "validator_monitor_attestation_hits_total",
             "attestations by monitored validators seen on chain")
@@ -75,6 +86,34 @@ class ValidatorMonitor:
             epoch = spec.compute_epoch_at_slot(int(slot))
             self._summary(epoch, validator).sync_signatures += 1
 
+    def on_gossip_attestation(self, indices, data, spec) -> None:
+        """Unaggregated attestations seen on gossip (pre-inclusion) —
+        the reference's register_gossip_unaggregated_attestation."""
+        epoch = int(data.target.epoch)
+        for v in np.asarray(indices).reshape(-1).tolist():
+            if self._monitored(v):
+                self._summary(epoch, v).attestations_seen += 1
+
+    def on_gossip_aggregate(self, aggregator_index: int, data, spec) -> None:
+        epoch = int(data.target.epoch)
+        if self._monitored(aggregator_index):
+            self._summary(epoch, aggregator_index).aggregates_seen += 1
+
+    def on_block_missed(self, slot: int, expected_proposer: int,
+                        spec) -> None:
+        """An empty slot whose duty belonged to a monitored validator
+        (the reference's missed-block tracking)."""
+        if self._monitored(expected_proposer):
+            epoch = spec.compute_epoch_at_slot(int(slot))
+            self._summary(epoch, expected_proposer).blocks_missed += 1
+
+    def on_epoch_boundary(self, epoch: int, state, spec) -> None:
+        """Snapshot the balances array (one vectorized copy — this runs
+        on the head-update path, a per-validator Python loop at registry
+        scale would stall imports).  Per-validator balance/delta fields
+        are filled lazily on read (epoch_summary / log_lines)."""
+        self._balances[int(epoch)] = np.asarray(state.balances).copy()
+
     def note_misses(self, epoch: int, expected: list[int]) -> None:
         """Called at epoch end with the validators that SHOULD have
         attested; anyone with zero hits is a miss."""
@@ -89,8 +128,40 @@ class ValidatorMonitor:
     # -- reads ------------------------------------------------------------
 
     def epoch_summary(self, epoch: int) -> dict[int, ValidatorEpochSummary]:
-        return dict(self._epochs.get(int(epoch), {}))
+        epoch = int(epoch)
+        out = dict(self._epochs.get(epoch, {}))
+        bal = self._balances.get(epoch)
+        if bal is not None:
+            prev = self._balances.get(epoch - 1)
+            targets = (range(len(bal)) if self.auto_register
+                       else [i for i in self.registered if i < len(bal)])
+            for v in targets:
+                s = out.get(int(v))
+                if s is None:
+                    s = out[int(v)] = ValidatorEpochSummary()
+                s.balance_gwei = int(bal[v])
+                if prev is not None and v < len(prev):
+                    s.balance_delta_gwei = int(bal[v]) - int(prev[v])
+        return out
+
+    def log_lines(self, epoch: int) -> list[str]:
+        """Operator-readable per-validator epoch digests (the reference's
+        'Previous epoch attestation(s) success' log family)."""
+        out = []
+        for v, s in sorted(self.epoch_summary(epoch).items()):
+            delay = (sum(s.inclusion_delays) / len(s.inclusion_delays)
+                     if s.inclusion_delays else 0.0)
+            out.append(
+                f"validator {v} epoch {epoch}: "
+                f"att hit={s.attestation_hits} miss={s.attestation_misses} "
+                f"seen={s.attestations_seen} delay={delay:.2f} "
+                f"blocks={s.blocks_proposed} missed={s.blocks_missed} "
+                f"sync={s.sync_signatures} "
+                f"balance={s.balance_gwei} Δ={s.balance_delta_gwei:+d}")
+        return out
 
     def prune_below(self, epoch: int) -> None:
         for e in [e for e in self._epochs if e < epoch]:
             del self._epochs[e]
+        for e in [e for e in self._balances if e < epoch - 1]:
+            del self._balances[e]

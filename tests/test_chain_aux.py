@@ -194,3 +194,71 @@ class TestLightClient:
                 == h.spec.preset.sync_committee_size
         finally:
             server.stop()
+
+
+class TestValidatorMonitorDepth:
+    def test_gossip_seen_and_balance_tracking(self):
+        import numpy as np
+
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=16, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        chain.validator_monitor.auto_register = True
+        chain.slot_clock.advance_slot()
+        signed = h.produce_block(slot=1, attestations=[])
+        from lighthouse_tpu.state_transition import state_transition
+
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        chain.process_block(signed)
+        att = h.attest(slot=1)
+        # split into unaggregated singles for the gossip path
+        singles = []
+        bits = list(att.aggregation_bits)
+        for pos in range(len(bits)):
+            sb = [i == pos for i in range(len(bits))]
+            singles.append(h.t.Attestation(
+                aggregation_bits=sb, data=att.data,
+                signature=att.signature))
+        verified, rejects = chain.verify_attestations_for_gossip(singles)
+        assert verified
+        epoch = int(att.data.target.epoch)
+        seen = sum(s.attestations_seen
+                   for s in chain.validator_monitor.epoch_summary(
+                       epoch).values())
+        assert seen == len(verified)
+
+    def test_missed_block_and_log_lines(self):
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        vm = ValidatorMonitor()
+        vm.register(3)
+        vm.on_block_missed(5, 3, h.spec)
+        vm.on_epoch_boundary(0, h.state, h.spec)
+        s = vm.epoch_summary(0)[3]
+        assert s.blocks_missed == 1
+        assert s.balance_gwei == int(h.state.balances[3])
+        lines = vm.log_lines(0)
+        assert len(lines) == 1 and "missed=1" in lines[0]
+
+    def test_missed_proposals_detected_on_import(self):
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.state_transition import state_transition
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        chain.validator_monitor.auto_register = True
+        # block at slot 1, then skip 2 and 3, block at slot 4
+        for s in (1, 4):
+            chain.slot_clock.set_slot(s)
+            signed = h.produce_block(slot=s)
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            chain.process_block(signed)
+        missed = sum(x.blocks_missed
+                     for x in chain.validator_monitor.epoch_summary(
+                         0).values())
+        assert missed == 2  # slots 2 and 3
