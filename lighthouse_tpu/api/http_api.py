@@ -105,6 +105,7 @@ class BeaconApi:
           self.committee_subscriptions)
         r("GET", r"/eth/v1/beacon/light_client/bootstrap/(?P<block_root>0x\w+)",
           self.lc_bootstrap)
+        r("GET", r"/eth/v1/beacon/light_client/updates", self.lc_updates)
         r("GET", r"/eth/v1/beacon/light_client/optimistic_update",
           self.lc_optimistic)
         r("GET", r"/eth/v1/beacon/light_client/finality_update",
@@ -928,16 +929,11 @@ class BeaconApi:
         }}
 
     def _lc_update_json(self, upd, with_finality: bool):
-        import numpy as np
+        from lighthouse_tpu.chain.light_client import sync_aggregate_json
 
-        bits = np.asarray(upd.sync_aggregate.sync_committee_bits, bool)
         out = {
             "attested_header": upd.attested_header.to_json(),
-            "sync_aggregate": {
-                "sync_committee_bits": _hex(
-                    np.packbits(bits, bitorder="little").tobytes()),
-                "sync_committee_signature": _hex(
-                    upd.sync_aggregate.sync_committee_signature)},
+            "sync_aggregate": sync_aggregate_json(upd.sync_aggregate),
             "signature_slot": str(upd.signature_slot),
         }
         if with_finality:
@@ -946,6 +942,17 @@ class BeaconApi:
                 if upd.finalized_header else None)
             out["finality_branch"] = [_hex(b) for b in upd.finality_branch]
         return {"data": out}
+
+    def lc_updates(self, body=None, query=None):
+        """Best update per sync-committee period (reference
+        /eth/v1/beacon/light_client/updates)."""
+        q = query or {}
+        start = int(q.get("start_period", 0))
+        count = int(q.get("count", 1))
+        ups = self.chain.light_client.updates_by_range(start, count)
+        # spec: this route returns a TOP-LEVEL array of {version, data}
+        # (the one light-client route without the data envelope)
+        return [{"version": "altair", "data": u.to_json()} for u in ups]
 
     def lc_optimistic(self, body=None):
         upd = self.chain.light_client.latest_optimistic

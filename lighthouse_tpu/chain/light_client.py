@@ -105,13 +105,70 @@ def _header_for(chain, root: bytes) -> LightClientHeader | None:
         bytes(m.state_root), m.body.hash_tree_root())
 
 
+def sync_aggregate_json(agg) -> dict:
+    """THE wire serialization of a SyncAggregate (packed SSZ bitvector)
+    — shared by the chain-layer update JSON and the HTTP API so the two
+    formats cannot diverge."""
+    import numpy as np
+
+    bits = np.asarray(agg.sync_committee_bits, bool)
+    return {
+        "sync_committee_bits":
+            "0x" + np.packbits(bits, bitorder="little").tobytes().hex(),
+        "sync_committee_signature":
+            "0x" + bytes(agg.sync_committee_signature).hex(),
+    }
+
+
+def sync_committee_json(committee) -> dict:
+    return {
+        "aggregate_pubkey":
+            "0x" + bytes(committee.aggregate_pubkey).hex(),
+        "pubkeys": ["0x" + bytes(pk).hex() for pk in committee.pubkeys],
+    }
+
+
+@dataclass
+class LightClientUpdate:
+    """Full period update: the attested header plus the NEXT sync
+    committee under proof — what a light client needs to advance one
+    sync-committee period (reference light_client_update.rs)."""
+
+    attested_header: LightClientHeader
+    next_sync_committee: object
+    next_sync_committee_branch: list[bytes]
+    finalized_header: LightClientHeader | None
+    finality_branch: list[bytes]
+    sync_aggregate: object
+    signature_slot: int
+
+    def to_json(self) -> dict:
+        return {
+            "attested_header": self.attested_header.to_json(),
+            "next_sync_committee": sync_committee_json(
+                self.next_sync_committee),
+            "next_sync_committee_branch": [
+                "0x" + b.hex() for b in self.next_sync_committee_branch],
+            "finalized_header": (self.finalized_header.to_json()
+                                 if self.finalized_header else None),
+            "finality_branch": [
+                "0x" + b.hex() for b in self.finality_branch],
+            "sync_aggregate": sync_aggregate_json(self.sync_aggregate),
+            "signature_slot": str(self.signature_slot),
+        }
+
+
 class LightClientServerCache:
     """Tracks the best sync-aggregate-attested header per slot."""
+
+    MAX_STORED_PERIODS = 128
 
     def __init__(self, chain):
         self.chain = chain
         self.latest_optimistic: LightClientOptimisticUpdate | None = None
         self.latest_finality: LightClientFinalityUpdate | None = None
+        # sync-committee period -> best (most participation) update
+        self._updates: dict[int, tuple[int, LightClientUpdate]] = {}
 
     def on_block_imported(self, signed_block) -> None:
         """Feed each imported block: its sync aggregate attests the
@@ -143,6 +200,36 @@ class LightClientServerCache:
         finality_branch = [epoch_leaf] + branch
         self.latest_finality = LightClientFinalityUpdate(
             attested, fin_header, finality_branch, agg, sig_slot)
+
+        # period update: prove the attested state's NEXT sync committee;
+        # keep the best-participation update per period
+        if hasattr(state, "next_sync_committee"):
+            spec = chain.spec
+            period = (spec.compute_epoch_at_slot(attested.slot)
+                      // spec.preset.epochs_per_sync_committee_period)
+            participation = sum(
+                1 for b in agg.sync_committee_bits if b)
+            best = self._updates.get(period)
+            if best is None or participation > best[0]:
+                _, nsc_branch, _ = _field_proof(
+                    state, "next_sync_committee")
+                self._updates[period] = (participation, LightClientUpdate(
+                    attested, state.next_sync_committee, nsc_branch,
+                    fin_header, finality_branch, agg, sig_slot))
+                while len(self._updates) > self.MAX_STORED_PERIODS:
+                    self._updates.pop(min(self._updates))
+
+    def updates_by_range(self, start_period: int,
+                         count: int) -> list[LightClientUpdate]:
+        """Best update per sync-committee period in [start, start+count)
+        (reference light_client_updates_by_range RPC + API)."""
+        out = []
+        for period in range(int(start_period),
+                            int(start_period) + min(int(count), 128)):
+            hit = self._updates.get(period)
+            if hit is not None:
+                out.append(hit[1])
+        return out
 
     def bootstrap(self, block_root: bytes) -> LightClientBootstrap | None:
         chain = self.chain

@@ -268,6 +268,20 @@ class Router:
                 "0x" + b.hex() for b in bs.current_sync_committee_branch],
         }).encode()]
 
+    def _serve_lc_updates_by_range(self, src: str,
+                                   data: bytes) -> list[bytes]:
+        """Period updates [start, start+count) — one response chunk per
+        update (reference light_client_updates_by_range)."""
+        import json as _json
+
+        if len(data) != 16:
+            raise rpc_mod.RpcError("malformed updates_by_range request")
+        start = int.from_bytes(data[:8], "little")
+        count = int.from_bytes(data[8:], "little")
+        return [_json.dumps(u.to_json()).encode()
+                for u in self.chain.light_client.updates_by_range(
+                    start, count)]
+
     def _serve_lc_optimistic(self, src: str, data: bytes) -> list[bytes]:
         import json as _json
 
@@ -317,6 +331,8 @@ class Router:
         self.rpc.register(P_BLOBS_BY_RANGE, self._serve_blobs_by_range)
         self.rpc.register(P_BLOBS_BY_ROOT, self._serve_blobs_by_root)
         self.rpc.register(P_LC_BOOTSTRAP, self._serve_lc_bootstrap)
+        self.rpc.register(
+            rpc_mod.P_LC_UPDATES_BY_RANGE, self._serve_lc_updates_by_range)
         self.rpc.register(P_LC_OPTIMISTIC, self._serve_lc_optimistic)
         self.rpc.register(P_LC_FINALITY, self._serve_lc_finality)
 

@@ -123,5 +123,6 @@ P_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2"
 P_BLOBS_BY_RANGE = "/eth2/beacon_chain/req/blob_sidecars_by_range/1"
 P_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1"
 P_LC_BOOTSTRAP = "/eth2/beacon_chain/req/light_client_bootstrap/1"
+P_LC_UPDATES_BY_RANGE = "/eth2/beacon_chain/req/light_client_updates_by_range/1"
 P_LC_OPTIMISTIC = "/eth2/beacon_chain/req/light_client_optimistic_update/1"
 P_LC_FINALITY = "/eth2/beacon_chain/req/light_client_finality_update/1"

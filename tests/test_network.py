@@ -191,3 +191,32 @@ class TestLightClientRpc:
 
         with _pytest.raises(RpcError):
             a.rpc_ep.request(b.peer_id, P_BLOBS_BY_ROOT, b"\x01" * 31)
+
+
+class TestLightClientUpdatesByRange:
+    def test_period_updates_served(self, two_nodes):
+        import json
+
+        from lighthouse_tpu.network.rpc import P_LC_UPDATES_BY_RANGE
+
+        h, a, b = two_nodes
+        # two blocks so the second carries a sync aggregate attesting a
+        # known parent with a stored state
+        for s in (1, 2):
+            signed = h.produce_block(slot=s)
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            for n in (a, b):
+                n.chain.slot_clock.set_slot(s)
+                n.chain.process_block(signed)
+        ups = b.chain.light_client.updates_by_range(0, 4)
+        assert ups, "no period update cached"
+        u = ups[0]
+        assert u.next_sync_committee_branch
+        assert any(u.sync_aggregate.sync_committee_bits)
+        # over Req/Resp: [start, count] little-endian u64 pair
+        req = (0).to_bytes(8, "little") + (4).to_bytes(8, "little")
+        chunks = a.rpc_ep.request(b.peer_id, P_LC_UPDATES_BY_RANGE, req)
+        assert chunks
+        payload = json.loads(chunks[0])
+        assert "next_sync_committee" in payload
+        assert payload["next_sync_committee"]["pubkeys"]
