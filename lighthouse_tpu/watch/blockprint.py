@@ -1,0 +1,89 @@
+"""Blockprint analogue: classify the proposer's client from block shape.
+
+The reference's watch integrates with the external `blockprint` ML
+service (watch/src/blockprint/); self-contained here: a deterministic
+fingerprint classifier over the strongest of blockprint's signals —
+graffiti client tags and EL extra_data tags.  Honest about
+uncertainty: anything unmatched is "Unknown" with a confidence score,
+never a guess dressed as fact.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+
+# graffiti self-identification tags the major clients emit by default
+_GRAFFITI_TAGS = [
+    # most-specific first: "lighthouse_tpu" must not fall into the
+    # plain-Lighthouse bucket
+    (re.compile(rb"lighthouse[-_]tpu|lhtpu", re.I), "LighthouseTpu"),
+    (re.compile(rb"lighthouse|\bLH\b", re.I), "Lighthouse"),
+    (re.compile(rb"prysm", re.I), "Prysm"),
+    (re.compile(rb"teku", re.I), "Teku"),
+    (re.compile(rb"nimbus", re.I), "Nimbus"),
+    (re.compile(rb"lodestar", re.I), "Lodestar"),
+    (re.compile(rb"grandine", re.I), "Grandine"),
+]
+
+# version-string shapes like "client/v1.2.3" without a known name
+_VERSIONED = re.compile(rb"^([A-Za-z][\w-]{2,16})/v?\d+\.\d+")
+
+
+@dataclass
+class BlockPrint:
+    best_guess: str
+    confidence: float          # 0..1
+    graffiti: bytes
+
+
+def classify_block(graffiti: bytes,
+                   extra_data: bytes = b"") -> BlockPrint:
+    g = bytes(graffiti).rstrip(b"\x00")
+    for pat, name in _GRAFFITI_TAGS:
+        if pat.search(g):
+            return BlockPrint(name, 0.9, g)
+    m = _VERSIONED.match(g)
+    if m:
+        return BlockPrint(m.group(1).decode(errors="replace").capitalize(),
+                          0.6, g)
+    # EL extra_data sometimes carries the builder/EL tag; a weak signal
+    for pat, name in _GRAFFITI_TAGS:
+        if pat.search(bytes(extra_data)):
+            return BlockPrint(name + "?", 0.3, g)
+    return BlockPrint("Unknown", 0.0, g)
+
+
+class BlockprintTracker:
+    """Per-proposer rolling classification (the watch updater feeds each
+    canonical block; reads aggregate like blockprint's /blocks_per_client)."""
+
+    def __init__(self):
+        # proposer -> {client: count}; shared between the updater thread
+        # and the watch server's handler threads
+        self._counts: dict[int, dict[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, proposer: int, print_: BlockPrint) -> None:
+        with self._lock:
+            per = self._counts.setdefault(int(proposer), {})
+            per[print_.best_guess] = per.get(print_.best_guess, 0) + 1
+
+    def proposer_client(self, proposer: int) -> str:
+        with self._lock:
+            per = self._counts.get(int(proposer))
+            if not per:
+                return "Unknown"
+            return max(per.items(), key=lambda kv: kv[1])[0]
+
+    def blocks_per_client(self) -> dict[str, int]:
+        with self._lock:
+            out: dict[str, int] = {}
+            for per in self._counts.values():
+                for client, n in per.items():
+                    out[client] = out.get(client, 0) + n
+            return out
+
+
+__all__ = ["BlockPrint", "BlockprintTracker", "classify_block"]

@@ -22,9 +22,10 @@ def _hex(b) -> str:
 
 
 class WatchServer:
-    def __init__(self, db, port: int = 0):
+    def __init__(self, db, port: int = 0, blockprint=None):
         self.db = db
         self.port = port
+        self.blockprint = blockprint   # BlockprintTracker (updater's)
         self._srv = None
         self._thread = None
 
@@ -52,6 +53,16 @@ class WatchServer:
         m = re.fullmatch(r"/v1/validators/missed/(\d+)", path)
         if m:
             return db.suboptimal_attesters(int(m.group(1)))
+        if path == "/v1/blockprint/blocks_per_client":
+            if self.blockprint is None:
+                return {}
+            return self.blockprint.blocks_per_client()
+        m = re.fullmatch(r"/v1/blockprint/proposer/(\d+)", path)
+        if m:
+            if self.blockprint is None:
+                return {"client": "Unknown"}
+            return {"client":
+                    self.blockprint.proposer_client(int(m.group(1)))}
         if path == "/v1/status":
             return {"lowest_slot": db.lowest_canonical_slot(),
                     "highest_slot": db.highest_canonical_slot()}

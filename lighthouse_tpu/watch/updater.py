@@ -11,6 +11,7 @@ the reference's suboptimal_attestations tracker.
 from __future__ import annotations
 
 from lighthouse_tpu import types as T
+from lighthouse_tpu.watch.blockprint import BlockprintTracker, classify_block
 from lighthouse_tpu.api.client import BeaconNodeClient, ClientError
 
 # altair participation flag bits (spec)
@@ -25,6 +26,7 @@ class WatchUpdater:
         self.client = client
         self.spec = spec
         self.t = T.make_types(spec.preset)
+        self.blockprint = BlockprintTracker()
 
     def _head_slot(self) -> int:
         hdr = self.client.header("head")
@@ -58,6 +60,13 @@ class WatchUpdater:
                 self.db.insert_block_packing(
                     slot, available=included, included=included,
                     prior_skip_slots=self._prior_skips(slot))
+                payload = getattr(body, "execution_payload", None)
+                self.blockprint.observe(
+                    int(block.message.proposer_index),
+                    classify_block(
+                        bytes(body.graffiti),
+                        bytes(payload.extra_data) if payload is not None
+                        else b""))
             prev_root = root
             recorded += 1
             if slot and slot % self.spec.slots_per_epoch == 0:

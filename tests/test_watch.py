@@ -100,3 +100,64 @@ class TestWatchServer:
                 assert e.code == 404
         finally:
             ws.stop()
+
+
+class TestBlockprint:
+    def test_graffiti_classification(self):
+        from lighthouse_tpu.watch.blockprint import classify_block
+
+        p = classify_block(b"Lighthouse/v4.5.0" + b"\x00" * 15)
+        assert p.best_guess == "Lighthouse" and p.confidence >= 0.9
+        assert classify_block(b"prysm-v5" + b"\x00" * 24).best_guess == "Prysm"
+        v = classify_block(b"somefork/v1.2.3" + b"\x00" * 17)
+        assert v.best_guess == "Somefork" and 0 < v.confidence < 0.9
+        u = classify_block(b"\x00" * 32)
+        assert u.best_guess == "Unknown" and u.confidence == 0.0
+
+    def test_updater_feeds_tracker(self, watched_node):
+        h, chain, db, updater, n = watched_node
+        # the harness stamps graffiti b"lighthouse-tpu" on every block;
+        # the updater must have fed each canonical block through
+        assert n > 0
+        per_client = updater.blockprint.blocks_per_client()
+        assert sum(per_client.values()) >= n - 1  # skipped slots excluded
+        # the harness's own graffiti tag classifies as this client
+        assert per_client.get("LighthouseTpu", 0) >= 1
+
+    def test_tracker_majority_vote(self):
+        from lighthouse_tpu.watch.blockprint import (
+            BlockprintTracker,
+            classify_block,
+        )
+
+        t = BlockprintTracker()
+        for _ in range(3):
+            t.observe(7, classify_block(b"teku/v24.1" + b"\x00" * 21))
+        t.observe(7, classify_block(b"\x00" * 32))
+        assert t.proposer_client(7) == "Teku"
+        assert t.blocks_per_client() == {"Teku": 3, "Unknown": 1}
+
+    def test_watch_server_blockprint_routes(self, watched_node):
+        import json
+        import urllib.request
+
+        from lighthouse_tpu.watch import WatchServer
+
+        h, chain, db, updater, n = watched_node
+        srv = WatchServer(db, port=0, blockprint=updater.blockprint).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(
+                    base + "/v1/blockprint/blocks_per_client",
+                    timeout=5) as r:
+                per = json.loads(r.read())
+            assert per.get("LighthouseTpu", 0) >= 1, per
+            prop = int(chain.store.get_block(
+                chain.head_root).message.proposer_index)
+            with urllib.request.urlopen(
+                    base + f"/v1/blockprint/proposer/{prop}",
+                    timeout=5) as r:
+                out = json.loads(r.read())
+            assert out["client"] == "LighthouseTpu"
+        finally:
+            srv.stop()
