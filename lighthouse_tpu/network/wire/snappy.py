@@ -8,11 +8,12 @@ this module implements both:
 
 - the DECOMPRESSOR handles the full tag set (literals + all three copy
   element widths), i.e. it decodes streams from any conformant encoder;
-- the COMPRESSOR emits literal-only streams (always valid snappy —
-  compression ratio 1, honesty over micro-optimizing a cold path; swap in
-  a matching emitter later without touching callers);
-- the frame format carries masked CRC32C checksums per chunk, verified on
-  decode (the spec's crc32c(data) mask/rotate).
+- the COMPRESSOR runs the standard greedy hash-table matcher over
+  4-byte anchors (copy1/copy2 emission, skip acceleration on
+  incompressible input);
+- the frame format carries masked CRC32C checksums per chunk, verified
+  on decode (the spec's crc32c(data) mask/rotate), shipping each chunk
+  compressed when that wins.
 """
 
 from __future__ import annotations
@@ -87,16 +88,10 @@ def uvarint_decode(data: bytes, offset: int = 0) -> tuple[int, int]:
 
 # --- block format ------------------------------------------------------------
 
-def compress_block(data: bytes) -> bytes:
-    """Literal-only snappy block stream (valid for any decoder)."""
-    out = bytearray(uvarint_encode(len(data)))
-    i = 0
-    n = len(data)
-    if n == 0:
-        return bytes(out)
-    while i < n:
-        chunk = data[i:i + (1 << 24)]  # 3-byte length field bound
-        ln = len(chunk) - 1
+def _emit_literal(out: bytearray, data: bytes, start: int, end: int):
+    while start < end:
+        chunk_end = min(end, start + (1 << 24))  # 3-byte length bound
+        ln = chunk_end - start - 1
         if ln < 60:
             out.append(ln << 2)
         elif ln < (1 << 8):
@@ -108,8 +103,65 @@ def compress_block(data: bytes) -> bytes:
         else:
             out.append(62 << 2)
             out += struct.pack("<I", ln)[:3]
-        out += chunk
-        i += len(chunk)
+        out += data[start:chunk_end]
+        start = chunk_end
+
+
+def _emit_copy(out: bytearray, offset: int, length: int):
+    # copy1 for short near matches (len 4-11, offset < 2048), copy2
+    # chunks of <= 64 otherwise (copy2 expresses any length >= 1, so
+    # remainders never strand; same offset per chunk keeps overlapping
+    # pattern-repeat semantics)
+    while length > 0:
+        if 4 <= length <= 11 and offset < 2048:
+            out.append(((offset >> 8) << 5) | ((length - 4) << 2) | 1)
+            out.append(offset & 0xFF)
+            return
+        step = min(length, 64)
+        out.append(((step - 1) << 2) | 2)
+        out += struct.pack("<H", offset)
+        length -= step
+
+
+def compress_block(data: bytes) -> bytes:
+    """Snappy block compression with hash-table match finding (the
+    standard greedy matcher over 4-byte anchors; the decoder is the
+    conformance oracle — tests roundtrip both paths)."""
+    n = len(data)
+    out = bytearray(uvarint_encode(n))
+    if n == 0:
+        return bytes(out)
+    if n < 16:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+
+    table: dict[bytes, int] = {}
+    i = 0
+    lit_start = 0
+    limit = n - 4
+    misses = 0          # skip acceleration: incompressible regions stride
+    while i <= limit:
+        key = data[i:i + 4]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand <= 0xFFFF:
+            # the dict is keyed by the literal bytes: a hit IS a match
+            m = i + 4
+            c = cand + 4
+            while m < n and data[m] == data[c]:
+                m += 1
+                c += 1
+            if lit_start < i:
+                _emit_literal(out, data, lit_start, i)
+            _emit_copy(out, i - cand, m - i)
+            i = m
+            lit_start = m
+            misses = 0
+        else:
+            misses += 1
+            i += 1 + (misses >> 5)   # reference snappy's growing stride
+    if lit_start < n:
+        _emit_literal(out, data, lit_start, n)
     return bytes(out)
 
 
@@ -172,16 +224,22 @@ def decompress_block(data: bytes, max_len: int | None = None) -> bytes:
 # --- framing format ----------------------------------------------------------
 
 def frame_compress(data: bytes) -> bytes:
-    """Snappy framing-format stream: stream id + uncompressed chunks
-    (type 0x01) with masked CRC32C, ≤65536 uncompressed bytes each."""
+    """Snappy framing-format stream: stream id + per-chunk masked
+    CRC32C; each ≤65536-byte chunk ships block-compressed (type 0x00)
+    when that wins, raw (type 0x01) otherwise."""
     out = bytearray(_STREAM_ID)
     offsets = range(0, len(data), MAX_FRAME_DATA) if data else (0,)
     for i in offsets:
         chunk = data[i:i + MAX_FRAME_DATA]
-        body = struct.pack("<I", _masked_crc(chunk)) + chunk
-        out.append(0x01)
-        out += struct.pack("<I", len(body))[:3]
-        out += body
+        crc = struct.pack("<I", _masked_crc(chunk))
+        packed = compress_block(chunk)
+        if len(packed) < len(chunk):
+            ctype, payload = 0x00, packed
+        else:
+            ctype, payload = 0x01, chunk
+        out.append(ctype)
+        out += struct.pack("<I", 4 + len(payload))[:3]
+        out += crc + payload
     return bytes(out)
 
 

@@ -249,3 +249,28 @@ class TestPeerManagerScoring:
         pm.report("z", "high")
         victims = pm.excess_peers()
         assert victims == ["z", "x"]     # worst scores first
+
+
+class TestSnappyCompression:
+    def test_matcher_roundtrip_and_ratio(self):
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        cases = [
+            b"", b"a", b"abcd" * 1000, b"\x00" * 100_000,
+            bytes(rng.integers(0, 256, 5000, dtype=np.uint8)),
+            b"hello world " * 500,
+        ]
+        for data in cases:
+            assert snappy.decompress_block(
+                snappy.compress_block(data)) == data
+        # compressible inputs genuinely shrink; random stays ~1x
+        assert len(snappy.compress_block(b"\x00" * 100_000)) < 6000
+        rnd = bytes(rng.integers(0, 256, 5000, dtype=np.uint8))
+        assert len(snappy.compress_block(rnd)) <= len(rnd) + 16
+
+    def test_frame_uses_compressed_chunks(self):
+        data = b"\xab" * 50_000
+        framed = snappy.frame_compress(data)
+        assert len(framed) < 3000            # compressed chunk won
+        assert snappy.frame_decompress(framed) == data
