@@ -586,15 +586,53 @@ def fp12_frobenius(f, n: int = 1):
     return f
 
 
+def fp12_cyclotomic_sqr(x):
+    """Granger–Scott squaring for cyclotomic-subgroup elements: 9 Fq2
+    squarings instead of the generic 18 Fq2 products (~2.4x fewer Fp
+    muls per square — the final-exp ladder is ~315 squarings deep).
+    Coefficient basis: x = (g0,g1,g2) + (g3,g4,g5)·w."""
+    (g0, g1, g2), (g3, g4, g5) = x
+    q = _MulQueue()
+    r_t0 = q.fp2(g4, g4)
+    r_t1 = q.fp2(g0, g0)
+    s04 = fp2_add(g4, g0)
+    r_s04 = q.fp2(s04, s04)
+    r_t2 = q.fp2(g2, g2)
+    r_t3 = q.fp2(g3, g3)
+    s23 = fp2_add(g2, g3)
+    r_s23 = q.fp2(s23, s23)
+    r_t4 = q.fp2(g5, g5)
+    r_t5 = q.fp2(g1, g1)
+    s51 = fp2_add(g5, g1)
+    r_s51 = q.fp2(s51, s51)
+    q.run()
+    t0, t1 = r_t0(), r_t1()
+    t6 = fp2_sub(fp2_sub(r_s04(), t0), t1)        # 2 g0 g4
+    t2, t3 = r_t2(), r_t3()
+    t7 = fp2_sub(fp2_sub(r_s23(), t2), t3)        # 2 g2 g3
+    t4, t5 = r_t4(), r_t5()
+    t8 = fp2_mul_by_xi(fp2_sub(fp2_sub(r_s51(), t4), t5))  # 2 g1 g5 ξ
+    a0 = fp2_add(fp2_mul_by_xi(t0), t1)           # g4² ξ + g0²
+    a2 = fp2_add(fp2_mul_by_xi(t2), t3)
+    a4 = fp2_add(fp2_mul_by_xi(t4), t5)
+    z0 = fp2_add(fp2_scale(fp2_sub(a0, g0), 2), a0)
+    z1 = fp2_add(fp2_scale(fp2_sub(a2, g1), 2), a2)
+    z2 = fp2_add(fp2_scale(fp2_sub(a4, g2), 2), a4)
+    z3 = fp2_add(fp2_scale(fp2_add(t8, g3), 2), t8)
+    z4 = fp2_add(fp2_scale(fp2_add(t6, g4), 2), t6)
+    z5 = fp2_add(fp2_scale(fp2_add(t7, g5), 2), t7)
+    return ((z0, z1, z2), (z3, z4, z5))
+
+
 def _cyc_exp_x(f):
     """f^x for the (negative) curve parameter x, f cyclotomic.
 
-    Square-and-multiply-always over the 63 static bits of |x| with a
-    per-step select (the Miller loop's uniform-control-flow trick), then
-    one conjugation for the sign of x."""
+    Cyclotomic-square-and-multiply-always over the 63 static bits of |x|
+    with a per-step select (the Miller loop's uniform-control-flow
+    trick), then one conjugation for the sign of x."""
 
     def step(out, bit):
-        sq = _fp12_sqr_q(out)
+        sq = fp12_cyclotomic_sqr(out)
         return _select(bit, _fp12_mul_q(sq, f), sq), None
 
     out, _ = jax.lax.scan(step, f, jnp.asarray(_X_BITS))
@@ -608,10 +646,11 @@ def final_exp_hard_device(m):
     easy part: full final exp == final_exp_hard_device(final_exp_easy(f))."""
     t1 = _cyc_exp_x(m)                                   # m^x
     g3 = _fp12_mul_q(
-        _fp12_mul_q(_cyc_exp_x(t1), fp12_conj(_fp12_sqr_q(t1))), m)
+        _fp12_mul_q(_cyc_exp_x(t1), fp12_conj(fp12_cyclotomic_sqr(t1))), m)
     g2 = _cyc_exp_x(g3)
     g1 = _fp12_mul_q(_cyc_exp_x(g2), fp12_conj(g3))
-    g0 = _fp12_mul_q(_fp12_mul_q(_cyc_exp_x(g1), _fp12_sqr_q(m)), m)
+    g0 = _fp12_mul_q(
+        _fp12_mul_q(_cyc_exp_x(g1), fp12_cyclotomic_sqr(m)), m)
     out = _fp12_mul_q(g0, fp12_frobenius(g1, 1))
     out = _fp12_mul_q(out, fp12_frobenius(g2, 2))
     return _fp12_mul_q(out, fp12_frobenius(g3, 3))
