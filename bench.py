@@ -328,7 +328,10 @@ def _bench_block_verify() -> dict:
 
     bls.set_backend("tpu")
     times = []
-    n_iters = 7
+    # the XLA-CPU fallback runs the device programs ~100x slower; fewer
+    # timed repeats keep the child inside its timeout (p50 of 3 is still
+    # a median)
+    n_iters = 7 if on_tpu else 3
     for i in range(n_iters + 1):
         st = base.copy()
         t0 = time.perf_counter()
