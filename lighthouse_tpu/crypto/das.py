@@ -8,11 +8,12 @@ rate-1/2), cells are the bit-reversal-permuted cosets of that extended
 domain, and any half of the cells recovers the rest via the
 vanishing-polynomial / coset-division algorithm.
 
-Cell KZG multi-proofs follow the reference's snapshot state (not yet
-carried); `verify_cells_match_blob` is the data-level check available
-without them.  Corruption among RECEIVED cells is detected whenever the
-caller supplies more than the minimum half (at exactly half there is no
-redundancy — real PeerDAS proof-verifies cells before recovery).
+Cell KZG multi-proofs ride the setup's monomial halves
+(compute_cells_and_kzg_proofs / verify_cell_kzg_proof below);
+`verify_cells_match_blob` remains the data-level check for callers
+holding the blob.  Corruption among RECEIVED cells during recovery is
+detected whenever the caller supplies more than the minimum half (at
+exactly half there is no redundancy — proof-verify cells first).
 
 All arithmetic is over the BLS scalar field; the FFTs are host-side
 python ints today (the fr limb kernel in ops/fr.py is the device path
@@ -213,6 +214,136 @@ def recover_all_cells(cell_ids: list[int], cells: list[bytes],
         if _cell_field_elements(cell, cell_size) != want:
             raise KzgError(f"cell {cid} inconsistent with recovery")
     return out
+
+
+# --- cell KZG multi-proofs ---------------------------------------------------
+#
+# Proof for cell c: π_c = [q_c(τ)]₁ with q_c = (p − I_c) / Z_c, where
+# I_c interpolates p on cell c's coset and Z_c(x) = x^cs − h_c^cs is the
+# coset's vanishing polynomial (sparse — synthetic division is O(n)).
+# Verification: e(C − [I_c(τ)]₁, −G₂) · e(π_c, [Z_c(τ)]₂) == 1 with
+# [Z_c(τ)]₂ = τ^cs·G₂ − h_c^cs·G₂ from the setup's G2 monomials.
+# (The functions the reference stubs out pending c-kzg's das branch.)
+
+
+def _coset_start(cid: int, cell_size: int, ext_roots, nat_of_brp) -> int:
+    return ext_roots[nat_of_brp[cid * cell_size]]
+
+
+def _require_monomials(settings, cell_size: int):
+    if settings.g1_monomial is None or settings.g2_monomial is None \
+            or len(settings.g2_monomial) <= cell_size:
+        raise KzgError(
+            "cell proofs need the setup's monomial points "
+            "(g1_monomial/g2_monomial in the ceremony file)")
+
+
+def compute_cells_and_kzg_proofs(blob: bytes, settings
+                                 ) -> tuple[list[bytes], list[bytes]]:
+    """Cells + one KZG multi-proof per cell."""
+    from lighthouse_tpu.crypto import kzg as _kzg
+    from lighthouse_tpu.crypto.bls import curve as cv
+
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    _require_monomials(settings, cell_size)
+    cells = compute_cells(blob, settings)
+    coeffs = _poly_coeffs_from_blob(blob, width)
+    ext_roots = _compute_roots_of_unity(2 * width)
+    nat_of_brp = _bit_reversal_permutation(list(range(2 * width)))
+    proofs = []
+    for cid in range(n_cells):
+        h = _coset_start(cid, cell_size, ext_roots, nat_of_brp)
+        a = pow(h, cell_size, BLS_MODULUS)
+        # synthetic division by x^cs − a: top-down, q_j = p_{j+cs} + a·q_{j+cs}
+        q = [0] * max(width - cell_size, 1)
+        for j in range(width - cell_size - 1, -1, -1):
+            carry = q[j + cell_size] if j + cell_size < len(q) else 0
+            q[j] = (coeffs[j + cell_size] + a * carry) % BLS_MODULUS
+        proofs.append(cv.g1_to_bytes(
+            _kzg.g1_lincomb(settings.g1_monomial[:len(q)], q)))
+    return cells, proofs
+
+
+def _interpolation_commitment(cell: bytes, cid: int, settings):
+    """[I_c(τ)]₁ for the cell's claimed evaluations (coset inverse-NTT,
+    cs ≤ 64 so the O(cs²) direct transform is fine)."""
+    from lighthouse_tpu.crypto import kzg as _kzg
+
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    ext_roots = _compute_roots_of_unity(2 * width)
+    nat_of_brp = _bit_reversal_permutation(list(range(2 * width)))
+    vals = _cell_field_elements(cell, cell_size)
+    # evaluation points: x_k = ext_roots[nat_of_brp[cid*cs + k]] = h·ω^{e_k}
+    h = _coset_start(cid, cell_size, ext_roots, nat_of_brp)
+    h_inv = pow(h, -1, BLS_MODULUS)
+    # coset exponents e_k with x_k = h·ω^{e_k}, ω of order cs on the
+    # doubled domain: ω = ext_roots[2*width // cell_size ... ] — recover
+    # e_k directly from the position ratio
+    omega = ext_roots[(2 * width // cell_size) % (2 * width)]
+    # map each point to its ω-power via a lookup (cs entries)
+    pow_of = {pow(omega, j, BLS_MODULUS): j for j in range(cell_size)}
+    reordered = [0] * cell_size
+    for k in range(cell_size):
+        x = ext_roots[nat_of_brp[cid * cell_size + k]]
+        j = pow_of[x * h_inv % BLS_MODULUS]
+        reordered[j] = vals[k]
+    cs_inv = pow(cell_size, -1, BLS_MODULUS)
+    coeffs = []
+    for m in range(cell_size):
+        acc = 0
+        for j, v in enumerate(reordered):
+            acc = (acc + v * pow(omega, (-j * m) % cell_size, BLS_MODULUS)
+                   ) % BLS_MODULUS
+        coeffs.append(acc * cs_inv % BLS_MODULUS
+                      * pow(h_inv, m, BLS_MODULUS) % BLS_MODULUS)
+    return _kzg.g1_lincomb(settings.g1_monomial[:cell_size], coeffs)
+
+
+def verify_cell_kzg_proof(commitment_bytes: bytes, cell_id: int,
+                          cell: bytes, proof_bytes: bytes,
+                          settings) -> bool:
+    """e(C − [I(τ)]₁, −G₂) · e(π, [Z(τ)]₂) == 1."""
+    from lighthouse_tpu.crypto.bls import curve as cv
+
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    _require_monomials(settings, cell_size)
+    if not 0 <= int(cell_id) < n_cells:
+        return False
+    try:
+        commitment = cv.g1_from_bytes(commitment_bytes)
+        proof = cv.g1_from_bytes(proof_bytes)
+        interp = _interpolation_commitment(cell, int(cell_id), settings)
+    except (ValueError, KzgError):
+        return False
+    ext_roots = _compute_roots_of_unity(2 * width)
+    nat_of_brp = _bit_reversal_permutation(list(range(2 * width)))
+    h = _coset_start(int(cell_id), cell_size, ext_roots, nat_of_brp)
+    a = pow(h, cell_size, BLS_MODULUS)
+    z_tau_g2 = cv.g2_add(
+        settings.g2_monomial[cell_size],
+        cv.g2_neg(cv.g2_mul(cv.g2_generator(), a)))
+    c_minus_i = cv.g1_add(commitment, cv.g1_neg(interp)) \
+        if interp is not cv.INF else commitment
+    from lighthouse_tpu.crypto.kzg import _pairing_check
+
+    return _pairing_check([
+        (c_minus_i, cv.g2_neg(cv.g2_generator())),
+        (proof, z_tau_g2),
+    ])
+
+
+def verify_cell_kzg_proof_batch(commitments: list[bytes],
+                                cell_ids: list[int], cells: list[bytes],
+                                proofs: list[bytes], settings) -> bool:
+    """Per-cell verification over a batch (every triplet must hold)."""
+    if not (len(commitments) == len(cell_ids) == len(cells) == len(proofs)):
+        return False
+    return all(
+        verify_cell_kzg_proof(c, cid, cell, pf, settings)
+        for c, cid, cell, pf in zip(commitments, cell_ids, cells, proofs))
 
 
 def verify_cells_match_blob(cells: list[bytes], cell_ids: list[int],

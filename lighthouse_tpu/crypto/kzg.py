@@ -66,12 +66,16 @@ def _compute_roots_of_unity(order: int) -> list[int]:
 class KzgSettings:
     """Trusted setup in Lagrange form (bit-reversed order, like the spec).
 
-    g1_lagrange_brp[i] = L_brp(i)(τ)·G1;  g2_tau = τ·G2."""
+    g1_lagrange_brp[i] = L_brp(i)(τ)·G1;  g2_tau = τ·G2.  The optional
+    monomial halves (τ^i·G1 and τ^i·G2) power the PeerDAS cell proofs
+    (crypto/das.py); the ceremony file carries both."""
 
     width: int
     g1_lagrange_brp: list          # affine G1 points (int pairs)
     g2_tau: object                 # τ·G2 (affine Fq2 point)
     roots_brp: list[int]
+    g1_monomial: list | None = None    # τ^i·G1, i < width
+    g2_monomial: list | None = None    # τ^i·G2, i <= 64
 
     @staticmethod
     @lru_cache(maxsize=4)
@@ -94,7 +98,20 @@ class KzgSettings:
             l_i = num * pow(den, -1, BLS_MODULUS) % BLS_MODULUS
             lagrange.append(cv.g1_mul(g1, l_i))
         g2_tau = cv.g2_mul(cv.g2_generator(), tau)
-        return KzgSettings(width, lagrange, g2_tau, roots_brp)
+        # monomial halves for the cell-proof paths (τ^i·G1 / τ^i·G2)
+        g1_monomial = []
+        acc = 1
+        for _ in range(width):
+            g1_monomial.append(cv.g1_mul(g1, acc))
+            acc = acc * tau % BLS_MODULUS
+        g2_monomial = []
+        acc = 1
+        g2 = cv.g2_generator()
+        for _ in range(min(width, 64) + 1):
+            g2_monomial.append(cv.g2_mul(g2, acc))
+            acc = acc * tau % BLS_MODULUS
+        return KzgSettings(width, lagrange, g2_tau, roots_brp,
+                           g1_monomial=g1_monomial, g2_monomial=g2_monomial)
 
     @staticmethod
     def from_setup_points(g1_lagrange_brp: list, g2_tau) -> "KzgSettings":
@@ -134,6 +151,17 @@ class KzgSettings:
               for h in d["g1_lagrange"]]
         g2_tau = cv.g2_from_bytes(
             bytes.fromhex(d["g2_monomial"][1].removeprefix("0x")))
+        # monomial halves power the PeerDAS cell proofs; decompression is
+        # deferred skip-checked like the lagrange points
+        g1_monomial = None
+        if "g1_monomial" in d:
+            g1_monomial = [
+                cv.g1_from_bytes(bytes.fromhex(h.removeprefix("0x")),
+                                 subgroup_check=False)
+                for h in d["g1_monomial"]]
+        g2_monomial = [
+            cv.g2_from_bytes(bytes.fromhex(h.removeprefix("0x")))
+            for h in d["g2_monomial"]]
         # structural pins run in every mode: g2_monomial[0] must be THE
         # G2 generator, and at least one lagrange point must be a member
         if bytes.fromhex(d["g2_monomial"][0].removeprefix("0x")) != \
@@ -152,8 +180,11 @@ class KzgSettings:
                     f"check (first: index {bad[0]})")
         elif not cv.g1_in_subgroup(g1[0]):
             raise KzgError("g1_lagrange[0] fails the subgroup check")
-        return KzgSettings.from_setup_points(
+        s = KzgSettings.from_setup_points(
             _bit_reversal_permutation(g1), g2_tau)
+        s.g1_monomial = g1_monomial
+        s.g2_monomial = g2_monomial
+        return s
 
 
 # --- field element / blob codecs -------------------------------------------

@@ -71,3 +71,34 @@ def test_extension_is_polynomial(setup):
     ids = list(range(n // 2, n))
     rec = das.recover_all_cells(ids, [cells[i] for i in ids], s)
     assert das.cells_to_blob(rec, s) == blob
+
+
+class TestCellProofs:
+    def test_compute_and_verify(self, setup):
+        s, blob, cells = setup
+        commitment = kzg.blob_to_kzg_commitment(blob, s)
+        cells2, proofs = das.compute_cells_and_kzg_proofs(blob, s)
+        assert cells2 == cells
+        n_cells, _ = das._cell_geometry(s.width)
+        for cid in (0, 1, n_cells // 2, n_cells - 1):
+            assert das.verify_cell_kzg_proof(
+                commitment, cid, cells[cid], proofs[cid], s)
+        assert das.verify_cell_kzg_proof_batch(
+            [commitment] * 3, [0, 5, 9],
+            [cells[i] for i in (0, 5, 9)],
+            [proofs[i] for i in (0, 5, 9)], s)
+
+    def test_rejections(self, setup):
+        s, blob, cells = setup
+        commitment = kzg.blob_to_kzg_commitment(blob, s)
+        _, proofs = das.compute_cells_and_kzg_proofs(blob, s)
+        bad = bytearray(cells[0])
+        bad[5] ^= 1
+        assert not das.verify_cell_kzg_proof(
+            commitment, 0, bytes(bad), proofs[0], s)
+        assert not das.verify_cell_kzg_proof(
+            commitment, 0, cells[0], proofs[1], s)   # wrong proof
+        assert not das.verify_cell_kzg_proof(
+            commitment, 1, cells[0], proofs[0], s)   # wrong id
+        assert not das.verify_cell_kzg_proof_batch(
+            [commitment], [0, 1], [cells[0]], [proofs[0]], s)  # ragged
