@@ -172,12 +172,13 @@ class KzgSettings:
                 batch_subgroup_check_g1,
             )
 
-            ok = batch_subgroup_check_g1(g1)
+            pts = g1 if g1_monomial is None else g1 + g1_monomial
+            ok = batch_subgroup_check_g1(pts)
             if not bool(ok.all()):
                 bad = [i for i, v in enumerate(ok) if not v]
                 raise KzgError(
-                    f"{len(bad)} g1_lagrange points fail the subgroup "
-                    f"check (first: index {bad[0]})")
+                    f"{len(bad)} trusted-setup G1 points fail the subgroup "
+                    f"check (first: index {bad[0]} of lagrange+monomial)")
         elif not cv.g1_in_subgroup(g1[0]):
             raise KzgError("g1_lagrange[0] fails the subgroup check")
         s = KzgSettings.from_setup_points(

@@ -41,16 +41,50 @@ class Enr:
     fork_digest: bytes = b"\x00\x00\x00\x00"
     ip: str = "127.0.0.1"
     port: int = 9000
+    identity_pub: bytes = b""     # Ed25519 pub of the record's owner
+    sig: bytes = b""              # signature over signed_content()
 
     @property
     def node_id(self) -> bytes:
         return hashlib.sha256(self.peer_id.encode()).digest()
+
+    def signed_content(self) -> bytes:
+        return json.dumps({
+            "peer_id": self.peer_id, "seq": self.seq,
+            "fork_digest": self.fork_digest.hex(),
+            "ip": self.ip, "port": self.port,
+            "identity_pub": self.identity_pub.hex(),
+        }).encode()
+
+    def sign(self, identity) -> "Enr":
+        """Sign in place with an Ed25519 identity key; the record's
+        peer_id must be that key's fingerprint for verify() to accept."""
+        from lighthouse_tpu.network.wire import noise
+
+        self.identity_pub = noise.identity_pub(identity)
+        self.sig = noise.sign_enr(identity, self.signed_content())
+        return self
+
+    def verify(self) -> bool:
+        """True iff signed by the key whose fingerprint is peer_id —
+        an unsigned or forged record fails (discv5 ENRs are signed:
+        reference .../discovery/enr.rs)."""
+        from lighthouse_tpu.network.wire import noise
+
+        if not self.identity_pub or not self.sig:
+            return False
+        if self.peer_id != noise.peer_id_of(self.identity_pub):
+            return False
+        return noise.verify_enr(self.identity_pub, self.signed_content(),
+                                self.sig)
 
     def to_bytes(self) -> bytes:
         return json.dumps({
             "peer_id": self.peer_id, "seq": self.seq,
             "fork_digest": self.fork_digest.hex(),
             "ip": self.ip, "port": self.port,
+            "identity_pub": self.identity_pub.hex(),
+            "sig": self.sig.hex(),
         }).encode()
 
     @staticmethod
@@ -58,7 +92,9 @@ class Enr:
         d = json.loads(raw)
         return Enr(peer_id=d["peer_id"], seq=int(d["seq"]),
                    fork_digest=bytes.fromhex(d["fork_digest"]),
-                   ip=d["ip"], port=int(d["port"]))
+                   ip=d["ip"], port=int(d["port"]),
+                   identity_pub=bytes.fromhex(d.get("identity_pub", "")),
+                   sig=bytes.fromhex(d.get("sig", "")))
 
 
 def xor_distance(a: bytes, b: bytes) -> int:
@@ -109,14 +145,38 @@ class Discovery:
     """Discovery endpoint bound to an rpc fabric endpoint."""
 
     def __init__(self, rpc_ep, enr: Enr,
-                 fork_digest: bytes | None = None):
+                 fork_digest: bytes | None = None,
+                 require_signed: bool | None = None):
         self.rpc = rpc_ep
         self.enr = enr
+        locally_signed = bool(enr.sig)
         if fork_digest is not None:
             self.enr.fork_digest = fork_digest
+        # fail CLOSED: a signed local record that no longer verifies
+        # (e.g. a field mutated after signing) must not silently turn
+        # signature checking off for remote records
+        if locally_signed and not self.enr.verify():
+            raise ValueError(
+                "local ENR signature invalid — was a field mutated "
+                "after sign()? re-sign with the current contents")
+        # over real sockets every field of a record (including the "src"
+        # it claims to be from) is attacker-controlled: only admit ENRs
+        # signed by the key whose fingerprint is their peer id, or an
+        # attacker fills target buckets with fabricated records and the
+        # table serves poison to every FINDNODE querier.  The in-process
+        # fabric (trusted, same interpreter) keeps unsigned records.
+        if require_signed is None:
+            require_signed = locally_signed
+        self.require_signed = require_signed
         self.table = RoutingTable(enr.node_id)
         rpc_ep.register(P_DISCOVERY_PING, self._serve_ping)
         rpc_ep.register(P_DISCOVERY_FINDNODE, self._serve_findnode)
+
+    def _admissible(self, enr: Enr) -> bool:
+        """The one ENR admission rule: on our network, and (over
+        sockets) signed by the key its peer id fingerprints."""
+        return (enr.fork_digest == self.enr.fork_digest
+                and (not self.require_signed or enr.verify()))
 
     # -- server side --------------------------------------------------------
 
@@ -124,8 +184,7 @@ class Discovery:
         remote = Enr.from_bytes(data)
         # only self-describing records on OUR network enter the table
         # (same eth2-field filter as the client side)
-        if (remote.peer_id == src
-                and remote.fork_digest == self.enr.fork_digest):
+        if remote.peer_id == src and self._admissible(remote):
             self.table.insert(remote)
         return [self.enr.to_bytes()]
 
@@ -148,7 +207,7 @@ class Discovery:
         remote = Enr.from_bytes(chunks[0])
         # only table peers on our network (the eth2 ENR-field filter the
         # reference applies before dialing, discovery/enr_ext.rs)
-        if remote.fork_digest == self.enr.fork_digest:
+        if self._admissible(remote):
             self.table.insert(remote)
         return remote
 
@@ -176,8 +235,8 @@ class Discovery:
             for enr in frontier:
                 queried.add(enr.peer_id)
                 for found in self.find_node(enr.peer_id, target):
-                    if found.fork_digest != self.enr.fork_digest:
-                        continue  # wrong network (eth2 ENR field check)
+                    if not self._admissible(found):
+                        continue
                     self.table.insert(found)
                     candidates.setdefault(found.node_id, found)
         return self.table.closest(target)
@@ -197,9 +256,17 @@ class BootNode:
 
     def __init__(self, fabric, peer_id: str = "boot-node",
                  fork_digest: bytes = b"\x00\x00\x00\x00"):
-        self.rpc_ep = fabric.rpc.join(peer_id)
-        self.discovery = Discovery(
-            self.rpc_ep, Enr(peer_id=peer_id, fork_digest=fork_digest))
+        node = getattr(fabric, "node", None)
+        if node is not None:
+            peer_id = node.peer_id        # socket fabric: key-derived id
+        self.rpc_ep = (getattr(fabric, "discovery_ep", None)
+                       or fabric.rpc.join(peer_id))
+        enr = Enr(peer_id=peer_id, fork_digest=fork_digest)
+        if node is not None:
+            enr.ip = node.listen_host
+            enr.port = fabric.listen_port
+            enr.sign(node.identity)
+        self.discovery = Discovery(self.rpc_ep, enr)
 
     @property
     def peer_id(self) -> str:

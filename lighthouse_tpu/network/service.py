@@ -76,6 +76,12 @@ class NetworkService:
         if hasattr(fabric, "listen_port"):
             enr.port = fabric.listen_port
             enr.ip = getattr(fabric.node, "listen_host", "127.0.0.1")
+        if node is not None:
+            # socket fabric: sign our record so remote nodes accept it
+            # (fork digest first — Discovery must not mutate it after
+            # signing, or the record self-invalidates)
+            enr.fork_digest = fork_digest(chain)
+            enr.sign(node.identity)
         self.discovery = Discovery(
             disc_ep, enr, fork_digest=fork_digest(chain))
 

@@ -1,0 +1,294 @@
+"""Noise_XX_25519_ChaChaPoly_SHA256 channel security for the wire stack.
+
+The reference authenticates every libp2p connection with the Noise XX
+handshake over the node's identity key
+(/root/reference/beacon_node/lighthouse_network/src/service/utils.rs:40-56);
+this module is the same capability built directly on the Noise spec
+(rev 34) with the `cryptography` primitives:
+
+- X25519 ephemeral + static Diffie-Hellman, HKDF-SHA256 key chaining,
+  ChaCha20-Poly1305 AEAD with the Noise nonce layout (4 zero bytes +
+  64-bit little-endian counter).
+- XX pattern:  -> e   <- e, ee, s, es   -> s, se.  Both static keys are
+  transmitted encrypted and are mutually authenticated by the `es`/`se`
+  DH results; the final handshake hash `h` binds the full transcript.
+- libp2p-style identity binding: each node holds an Ed25519 identity
+  key; its peer id IS the fingerprint of that public key.  The HELLO
+  payload (sent over the encrypted channel) carries the identity public
+  key and a signature over the Noise static key, so a peer cannot claim
+  an identity whose private key it does not hold — the same binding the
+  reference's noise payload makes between the libp2p identity key and
+  the Noise static key.
+
+Everything here is host-side session crypto — tiny, latency-bound, and
+per-connection — so it stays off the device on purpose; the TPU planes
+are for the bulk verification math in ops/.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+DHLEN = 32
+TAGLEN = 16
+# domain separator for the identity->static-key binding signature
+BINDING_PREFIX = b"lighthouse-tpu-noise-static-key:"
+
+
+class NoiseError(Exception):
+    pass
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hkdf(chaining_key: bytes, ikm: bytes, n: int) -> tuple[bytes, ...]:
+    """Noise-spec HKDF: HMAC-SHA256 extract + n expand rounds (n in 2,3)."""
+    temp = _hmac.new(chaining_key, ikm, hashlib.sha256).digest()
+    out1 = _hmac.new(temp, b"\x01", hashlib.sha256).digest()
+    out2 = _hmac.new(temp, out1 + b"\x02", hashlib.sha256).digest()
+    if n == 2:
+        return out1, out2
+    out3 = _hmac.new(temp, out2 + b"\x03", hashlib.sha256).digest()
+    return out1, out2, out3
+
+
+class CipherState:
+    """AEAD key + nonce counter (Noise spec §5.1); the AEAD object is
+    built once per key — this sits on the per-frame transport path."""
+
+    def __init__(self, key: bytes | None = None):
+        self.k = key
+        self.n = 0
+        self._aead = ChaCha20Poly1305(key) if key is not None else None
+
+    def _nonce(self) -> bytes:
+        return b"\x00\x00\x00\x00" + self.n.to_bytes(8, "little")
+
+    def encrypt_with_ad(self, ad: bytes, plaintext: bytes) -> bytes:
+        if self._aead is None:
+            return plaintext
+        if self.n >= (1 << 64) - 1:
+            raise NoiseError("nonce exhausted")
+        ct = self._aead.encrypt(self._nonce(), plaintext, ad)
+        self.n += 1
+        return ct
+
+    def decrypt_with_ad(self, ad: bytes, ciphertext: bytes) -> bytes:
+        if self._aead is None:
+            return ciphertext
+        if self.n >= (1 << 64) - 1:
+            raise NoiseError("nonce exhausted")
+        try:
+            pt = self._aead.decrypt(self._nonce(), ciphertext, ad)
+        except Exception as e:          # cryptography raises InvalidTag
+            raise NoiseError("AEAD authentication failed") from e
+        self.n += 1
+        return pt
+
+
+class SymmetricState:
+    """Chaining key + handshake hash (Noise spec §5.2)."""
+
+    def __init__(self):
+        # len(PROTOCOL_NAME) == 32 == HASHLEN, so h = the name itself
+        self.h = PROTOCOL_NAME
+        self.ck = PROTOCOL_NAME
+        self.cipher = CipherState()
+
+    def mix_key(self, ikm: bytes):
+        self.ck, temp_k = _hkdf(self.ck, ikm, 2)
+        self.cipher = CipherState(temp_k)
+
+    def mix_hash(self, data: bytes):
+        self.h = _sha256(self.h + data)
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        ct = self.cipher.encrypt_with_ad(self.h, plaintext)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        pt = self.cipher.decrypt_with_ad(self.h, ciphertext)
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> tuple[CipherState, CipherState]:
+        k1, k2 = _hkdf(self.ck, b"", 2)
+        return CipherState(k1), CipherState(k2)
+
+
+def _dh(priv: X25519PrivateKey, pub_bytes: bytes) -> bytes:
+    return priv.exchange(X25519PublicKey.from_public_bytes(pub_bytes))
+
+
+def _pub_bytes(priv: X25519PrivateKey) -> bytes:
+    return priv.public_key().public_bytes_raw()
+
+
+class NoiseXX:
+    """One XX handshake; drive with read_message/write_message in pattern
+    order, then take (send, recv, handshake_hash, remote_static)."""
+
+    def __init__(self, initiator: bool,
+                 static: X25519PrivateKey | None = None):
+        self.initiator = initiator
+        self.s = static or X25519PrivateKey.generate()
+        self.e: X25519PrivateKey | None = None
+        self.rs: bytes | None = None     # remote static pub (authenticated)
+        self.re: bytes | None = None
+        self.ss = SymmetricState()
+        self.ss.mix_hash(b"")            # empty prologue
+        self._msg = 0
+
+    @property
+    def static_pub(self) -> bytes:
+        return _pub_bytes(self.s)
+
+    # -- message 1: -> e ----------------------------------------------------
+
+    def write_msg1(self, payload: bytes = b"") -> bytes:
+        assert self.initiator and self._msg == 0
+        self.e = X25519PrivateKey.generate()
+        e_pub = _pub_bytes(self.e)
+        self.ss.mix_hash(e_pub)
+        out = e_pub + self.ss.encrypt_and_hash(payload)
+        self._msg = 1
+        return out
+
+    def read_msg1(self, msg: bytes) -> bytes:
+        assert not self.initiator and self._msg == 0
+        if len(msg) < DHLEN:
+            raise NoiseError("short handshake message 1")
+        self.re = msg[:DHLEN]
+        self.ss.mix_hash(self.re)
+        payload = self.ss.decrypt_and_hash(msg[DHLEN:])
+        self._msg = 1
+        return payload
+
+    # -- message 2: <- e, ee, s, es -----------------------------------------
+
+    def write_msg2(self, payload: bytes = b"") -> bytes:
+        assert not self.initiator and self._msg == 1
+        self.e = X25519PrivateKey.generate()
+        e_pub = _pub_bytes(self.e)
+        self.ss.mix_hash(e_pub)
+        self.ss.mix_key(_dh(self.e, self.re))            # ee
+        s_ct = self.ss.encrypt_and_hash(self.static_pub)  # s
+        self.ss.mix_key(_dh(self.s, self.re))            # es (resp: s, re)
+        out = e_pub + s_ct + self.ss.encrypt_and_hash(payload)
+        self._msg = 2
+        return out
+
+    def read_msg2(self, msg: bytes) -> bytes:
+        assert self.initiator and self._msg == 1
+        if len(msg) < DHLEN + DHLEN + TAGLEN:
+            raise NoiseError("short handshake message 2")
+        self.re = msg[:DHLEN]
+        self.ss.mix_hash(self.re)
+        self.ss.mix_key(_dh(self.e, self.re))            # ee
+        self.rs = self.ss.decrypt_and_hash(
+            msg[DHLEN:DHLEN + DHLEN + TAGLEN])           # s
+        self.ss.mix_key(_dh(self.e, self.rs))            # es (init: e, rs)
+        payload = self.ss.decrypt_and_hash(msg[DHLEN + DHLEN + TAGLEN:])
+        self._msg = 2
+        return payload
+
+    # -- message 3: -> s, se --------------------------------------------------
+
+    def write_msg3(self, payload: bytes = b"") -> bytes:
+        assert self.initiator and self._msg == 2
+        s_ct = self.ss.encrypt_and_hash(self.static_pub)  # s
+        self.ss.mix_key(_dh(self.s, self.re))            # se (init: s, re)
+        out = s_ct + self.ss.encrypt_and_hash(payload)
+        self._msg = 3
+        return out
+
+    def read_msg3(self, msg: bytes) -> bytes:
+        assert not self.initiator and self._msg == 2
+        if len(msg) < DHLEN + TAGLEN:
+            raise NoiseError("short handshake message 3")
+        self.rs = self.ss.decrypt_and_hash(msg[:DHLEN + TAGLEN])  # s
+        self.ss.mix_key(_dh(self.e, self.rs))            # se (resp: e, rs)
+        payload = self.ss.decrypt_and_hash(msg[DHLEN + TAGLEN:])
+        self._msg = 3
+        return payload
+
+    # -- transport ------------------------------------------------------------
+
+    def finalize(self) -> tuple[CipherState, CipherState, bytes]:
+        """Returns (send_cipher, recv_cipher, handshake_hash)."""
+        if self._msg != 3:
+            raise NoiseError("handshake incomplete")
+        c1, c2 = self.ss.split()
+        if self.initiator:
+            return c1, c2, self.ss.h
+        return c2, c1, self.ss.h
+
+
+# --- identity: Ed25519 key, fingerprint peer ids, static-key binding ---------
+
+def generate_identity(seed: bytes | None = None) -> Ed25519PrivateKey:
+    """A node identity key; pass a 32-byte seed for deterministic tests."""
+    if seed is None:
+        return Ed25519PrivateKey.generate()
+    if len(seed) != 32:
+        seed = _sha256(seed)
+    return Ed25519PrivateKey.from_private_bytes(seed)
+
+
+def identity_pub(identity: Ed25519PrivateKey) -> bytes:
+    return identity.public_key().public_bytes_raw()
+
+
+def peer_id_of(identity_pub_bytes: bytes) -> str:
+    """Peer id = fingerprint of the identity public key (libp2p PeerId
+    analogue): the only unforgeable name for a node."""
+    return _sha256(identity_pub_bytes)[:16].hex()
+
+
+def sign_static_binding(identity: Ed25519PrivateKey,
+                        noise_static_pub: bytes) -> bytes:
+    return identity.sign(BINDING_PREFIX + noise_static_pub)
+
+
+def verify_static_binding(identity_pub_bytes: bytes, noise_static_pub: bytes,
+                          signature: bytes) -> bool:
+    try:
+        Ed25519PublicKey.from_public_bytes(identity_pub_bytes).verify(
+            signature, BINDING_PREFIX + noise_static_pub)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def sign_enr(identity: Ed25519PrivateKey, content: bytes) -> bytes:
+    return identity.sign(b"lighthouse-tpu-enr:" + content)
+
+
+def verify_enr(identity_pub_bytes: bytes, content: bytes,
+               signature: bytes) -> bool:
+    try:
+        Ed25519PublicKey.from_public_bytes(identity_pub_bytes).verify(
+            signature, b"lighthouse-tpu-enr:" + content)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def new_random_static() -> X25519PrivateKey:
+    return X25519PrivateKey.generate()

@@ -274,6 +274,9 @@ def frame_decompress(data: bytes, max_len: int | None = None) -> bytes:
             payload = body[4:]
             if ctype == 0x00:
                 payload = decompress_block(payload, max_len=MAX_FRAME_DATA)
+            elif len(payload) > MAX_FRAME_DATA:
+                # framing format caps uncompressed chunk payloads at 65536
+                raise SnappyError("uncompressed chunk exceeds 65536 bytes")
             if _masked_crc(payload) != want_crc:
                 raise SnappyError("chunk checksum mismatch")
             out += payload

@@ -42,11 +42,13 @@ from typing import Callable
 from lighthouse_tpu.common.logging import Logger
 from lighthouse_tpu.network.gossip import _SeenCache, message_id
 from lighthouse_tpu.network.rpc import RateLimiter, RpcError
-from lighthouse_tpu.network.wire import codec
+from lighthouse_tpu.network.wire import codec, noise
 
 MESH_DEGREE = 8          # gossipsub D
 REQUEST_TIMEOUT_S = 10.0
 MAX_FRAME = 16 * 1024 * 1024
+HANDSHAKE_TIMEOUT_S = 5.0
+MAX_HANDSHAKE_FRAME = 4096
 
 # frame kinds
 K_HELLO = 0x01
@@ -82,17 +84,35 @@ class _Conn:
         self.addr: tuple[str, int] | None = None   # their LISTEN addr
         self.outbound = outbound                   # we initiated the dial
         self.alive = True
+        # Noise session state (set by the handshake before any frame flows)
+        self.send_cs: noise.CipherState | None = None
+        self.recv_cs: noise.CipherState | None = None
+        self.remote_static: bytes | None = None    # authenticated X25519 pub
 
 
 class WireNode:
     """The per-process socket node: TCP listener + dialer + UDP discovery."""
 
-    def __init__(self, peer_id: str, listen_port: int = 0,
+    def __init__(self, identity_seed: "bytes | str | None" = None,
+                 listen_port: int = 0,
                  fork_digest: bytes = b"\x00\x00\x00\x00",
                  listen_host: str = "127.0.0.1"):
         import concurrent.futures
 
-        self.peer_id = peer_id
+        # Node identity: an Ed25519 key; the peer id IS its fingerprint,
+        # so identity cannot be claimed without the private key (libp2p
+        # PeerId semantics — reference utils.rs:40).  A seed (str/bytes)
+        # gives deterministic test identities; production passes None.
+        if isinstance(identity_seed, str):
+            identity_seed = identity_seed.encode()
+        self.identity = noise.generate_identity(identity_seed)
+        self.identity_pub = noise.identity_pub(self.identity)
+        self.peer_id = noise.peer_id_of(self.identity_pub)
+        # per-node Noise static key, bound to the identity by signature
+        self._noise_static = noise.new_random_static()
+        self._static_binding = noise.sign_static_binding(
+            self.identity,
+            self._noise_static.public_key().public_bytes_raw())
         self.fork_digest = fork_digest
         self.listen_host = listen_host
         # handlers run OFF the event loop: block import and RPC serving
@@ -185,12 +205,56 @@ class WireNode:
     # -- connections ---------------------------------------------------------
 
     async def _on_inbound(self, reader, writer):
-        await self._serve_conn(_Conn(reader, writer))
+        conn = _Conn(reader, writer)
+        try:
+            await asyncio.wait_for(self._handshake(conn),
+                                   HANDSHAKE_TIMEOUT_S)
+        except Exception as e:
+            self.log.warn("inbound handshake failed", err=str(e))
+            writer.close()
+            return
+        await self._serve_conn(conn)
+
+    # -- noise handshake ------------------------------------------------------
+
+    async def _hs_send(self, conn: _Conn, data: bytes):
+        conn.writer.write(struct.pack("<I", len(data)) + data)
+        await conn.writer.drain()
+
+    async def _hs_recv(self, conn: _Conn) -> bytes:
+        hdr = await conn.reader.readexactly(4)
+        (n,) = struct.unpack("<I", hdr)
+        if n > MAX_HANDSHAKE_FRAME:
+            raise noise.NoiseError(f"oversized handshake frame {n}")
+        return await conn.reader.readexactly(n)
+
+    async def _handshake(self, conn: _Conn):
+        """Noise XX before anything else flows; a peer that cannot
+        complete it never reaches the frame loop (fail-closed)."""
+        hs = noise.NoiseXX(initiator=conn.outbound,
+                           static=self._noise_static)
+        if conn.outbound:
+            await self._hs_send(conn, hs.write_msg1())
+            hs.read_msg2(await self._hs_recv(conn))
+            await self._hs_send(conn, hs.write_msg3())
+        else:
+            hs.read_msg1(await self._hs_recv(conn))
+            await self._hs_send(conn, hs.write_msg2())
+            hs.read_msg3(await self._hs_recv(conn))
+        conn.send_cs, conn.recv_cs, _hs_hash = hs.finalize()
+        conn.remote_static = hs.rs
 
     async def _dial(self, host: str, port: int) -> str:
         """Open a connection; returns the remote peer id."""
         reader, writer = await asyncio.open_connection(host, port)
         conn = _Conn(reader, writer, outbound=True)
+        try:
+            await asyncio.wait_for(self._handshake(conn),
+                                   HANDSHAKE_TIMEOUT_S)
+        except Exception as e:
+            writer.close()
+            raise RpcError(f"noise handshake with {host}:{port} "
+                           f"failed: {e}") from e
         await self._send_hello(conn)
         # the serve loop fills in peer_id on receiving their HELLO
         task = asyncio.ensure_future(self._serve_conn(conn, said_hello=True))
@@ -210,6 +274,8 @@ class WireNode:
     async def _send_hello(self, conn: _Conn):
         hello = json.dumps({
             "peer_id": self.peer_id,
+            "identity_pub": self.identity_pub.hex(),
+            "static_sig": self._static_binding.hex(),
             "fork_digest": self.fork_digest.hex(),
             "topics": sorted(self._topics),
             "listen_port": self.listen_port,
@@ -217,7 +283,11 @@ class WireNode:
         await self._send_frame(conn, bytes([K_HELLO]) + hello)
 
     async def _send_frame(self, conn: _Conn, frame: bytes):
-        conn.writer.write(struct.pack("<I", len(frame)) + frame)
+        # encrypt-then-frame; the counter nonce and the write share one
+        # synchronous block, so concurrent senders on the loop cannot
+        # reorder ciphertexts relative to their nonces
+        ct = conn.send_cs.encrypt_with_ad(b"", frame)
+        conn.writer.write(struct.pack("<I", len(ct)) + ct)
         await conn.writer.drain()
 
     async def _serve_conn(self, conn: _Conn, said_hello: bool = False):
@@ -229,7 +299,10 @@ class WireNode:
                 (n,) = struct.unpack("<I", hdr)
                 if n > MAX_FRAME:
                     raise RpcError(f"oversized frame {n}")
-                frame = await conn.reader.readexactly(n)
+                ct = await conn.reader.readexactly(n)
+                # AEAD failure (tamper / injection / desync) severs the
+                # connection: NoiseError propagates to the finally below
+                frame = conn.recv_cs.decrypt_with_ad(b"", ct)
                 await self._on_frame(conn, frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
@@ -251,14 +324,39 @@ class WireNode:
 
     # -- frame handling ------------------------------------------------------
 
+    def _stream_for(self, conn: _Conn, stream: int) -> dict | None:
+        """Stream state iff it belongs to THIS connection: response
+        frames only resolve requests actually sent to that peer (stream
+        ids are sequential, so any connected peer could guess them)."""
+        st = self._streams.get(stream)
+        if st is None or st.get("conn") is not conn:
+            return None
+        return st
+
     async def _on_frame(self, conn: _Conn, frame: bytes):
         kind = frame[0]
         body = frame[1:]
+        if kind != K_HELLO and conn.peer_id is None:
+            # no frames before the authenticated HELLO: otherwise a peer
+            # could skip the identity binding / ban gate entirely and
+            # push gossip or RPC anonymously
+            raise RpcError("frame before HELLO")
         if kind == K_HELLO:
             d = json.loads(body)
             if bytes.fromhex(d["fork_digest"]) != self.fork_digest:
                 raise RpcError("wrong network (fork digest mismatch)")
             pid = d["peer_id"]
+            # authenticate the claimed identity: the Ed25519 key must
+            # sign the Noise static key the handshake proved possession
+            # of, and the peer id must be that key's fingerprint — a
+            # mismatch on either is an impersonation attempt
+            ipub = bytes.fromhex(d.get("identity_pub", ""))
+            sig = bytes.fromhex(d.get("static_sig", ""))
+            if not noise.verify_static_binding(
+                    ipub, conn.remote_static, sig):
+                raise RpcError("identity binding signature invalid")
+            if pid != noise.peer_id_of(ipub):
+                raise RpcError("peer id does not match identity key")
             if self.accept_peer is not None and not self.accept_peer(pid):
                 # refuse BEFORE exposing peer_id: the dialer's connect()
                 # polls conn.peer_id as its success signal
@@ -322,8 +420,19 @@ class WireNode:
                 self._serve_rpc(conn, stream, proto, payload))
         elif kind == K_RPC_CHUNK:
             (stream,) = struct.unpack_from("<Q", body, 0)
-            result, chunk = codec.decode_response_chunk(body[8:])
-            st = self._streams.get(stream)
+            st = self._stream_for(conn, stream)
+            try:
+                result, chunk = codec.decode_response_chunk(body[8:])
+            except codec.CodecError as e:
+                # fail the waiting request fast instead of letting the
+                # malformed chunk tear down the whole peer connection and
+                # the caller ride out the full request timeout
+                if st is not None:
+                    self._streams.pop(stream, None)
+                    if not st["future"].done():
+                        st["future"].set_exception(
+                            RpcError(f"malformed response chunk: {e}"))
+                return
             if st is not None:
                 if result == codec.RESP_SUCCESS:
                     st["chunks"].append(chunk)
@@ -331,18 +440,22 @@ class WireNode:
                     st["error"] = chunk.decode(errors="replace")
         elif kind == K_RPC_END:
             (stream,) = struct.unpack_from("<Q", body, 0)
-            st = self._streams.pop(stream, None)
-            if st is not None and not st["future"].done():
-                if st.get("error"):
-                    st["future"].set_exception(RpcError(st["error"]))
-                else:
-                    st["future"].set_result(st["chunks"])
+            st = self._stream_for(conn, stream)
+            if st is not None:
+                self._streams.pop(stream, None)
+                if not st["future"].done():
+                    if st.get("error"):
+                        st["future"].set_exception(RpcError(st["error"]))
+                    else:
+                        st["future"].set_result(st["chunks"])
         elif kind == K_RPC_ERR:
             (stream,) = struct.unpack_from("<Q", body, 0)
-            st = self._streams.pop(stream, None)
-            if st is not None and not st["future"].done():
-                st["future"].set_exception(
-                    RpcError(body[8:].decode(errors="replace")))
+            st = self._stream_for(conn, stream)
+            if st is not None:
+                self._streams.pop(stream, None)
+                if not st["future"].done():
+                    st["future"].set_exception(
+                        RpcError(body[8:].decode(errors="replace")))
         elif kind == K_GOODBYE:
             conn.writer.close()
 
@@ -384,9 +497,11 @@ class WireNode:
                 pass
 
     def publish(self, topic: str, data: bytes):
-        self._seen.observe(message_id(topic, data))  # don't re-deliver to self
-        asyncio.run_coroutine_threadsafe(
-            self._fanout(topic, data, exclude=set()), self.loop)
+        async def run():
+            # observe on the loop thread: _SeenCache is mutated only there
+            self._seen.observe(message_id(topic, data))
+            await self._fanout(topic, data, exclude=set())
+        asyncio.run_coroutine_threadsafe(run(), self.loop)
 
     def subscribe(self, topic: str, handler: Callable):
         self._topics[topic] = handler
@@ -450,7 +565,7 @@ class WireNode:
             stream = next(self._next_stream)
             fut = self.loop.create_future()
             self._streams[stream] = {"future": fut, "chunks": [],
-                                     "error": None}
+                                     "error": None, "conn": conn}
             await self._send_frame(
                 conn, bytes([K_RPC_REQ]) + struct.pack("<Q", stream)
                 + _pack_str(protocol) + codec.encode_payload(data))
@@ -621,6 +736,10 @@ class WireDiscoveryEndpoint:
                 enr = Enr.from_bytes(c)
             except Exception:
                 continue
+            # records learned over UDP are untrusted: only admit ENRs
+            # signed by the key whose fingerprint is the record's peer id
+            if not enr.verify():
+                continue
             self.addr_book[enr.peer_id] = (enr.ip, enr.port)
 
     def resolve(self, peer_id: str) -> tuple[str, int] | None:
@@ -647,11 +766,12 @@ class WireFabric:
     One per process; `.gossip.join()` / `.rpc.join()` hand out the seam
     endpoints (join is a no-op rendezvous — the node IS the process)."""
 
-    def __init__(self, peer_id: str | None = None, listen_port: int = 0,
+    def __init__(self, identity_seed: "bytes | str | None" = None,
+                 listen_port: int = 0,
                  fork_digest: bytes = b"\x00\x00\x00\x00",
                  listen_host: str = "127.0.0.1"):
         self.node = WireNode(
-            peer_id or ("peer-" + secrets.token_hex(8)),
+            identity_seed,
             listen_port=listen_port, fork_digest=fork_digest,
             listen_host=listen_host).start()
         self.discovery_ep = WireDiscoveryEndpoint(self.node)

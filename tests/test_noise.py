@@ -1,0 +1,263 @@
+"""Wire channel security: Noise XX handshake, AEAD framing, identity
+binding, signed ENRs.
+
+The adversarial cases mirror what the reference gets from libp2p Noise
+(/root/reference/beacon_node/lighthouse_network/src/service/utils.rs:40-56):
+an on-path attacker can neither read frames (eavesdrop test), alter them
+(tamper test fails closed), nor claim another node's identity
+(impersonation test), and discovery records cannot be forged (ENR test).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.network.wire import noise
+from lighthouse_tpu.network.wire.transport import WireNode
+
+
+def _wait(cond, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestNoiseXX:
+    def test_handshake_keys_agree_and_transport_works(self):
+        ini, res = noise.NoiseXX(True), noise.NoiseXX(False)
+        res.read_msg1(ini.write_msg1())
+        ini.read_msg2(res.write_msg2(b"resp-payload"))
+        res.read_msg3(ini.write_msg3(b"init-payload"))
+        si, ri, hi = ini.finalize()
+        sr, rr, hr = res.finalize()
+        assert hi == hr                                  # transcript binds
+        assert ini.rs == res.static_pub and res.rs == ini.static_pub
+        ct = si.encrypt_with_ad(b"", b"hello over the wire")
+        assert ct != b"hello over the wire"              # actually encrypted
+        assert rr.decrypt_with_ad(b"", ct) == b"hello over the wire"
+        ct2 = sr.encrypt_with_ad(b"", b"reply")
+        assert ri.decrypt_with_ad(b"", ct2) == b"reply"
+
+    def test_tampered_ciphertext_rejected(self):
+        ini, res = noise.NoiseXX(True), noise.NoiseXX(False)
+        res.read_msg1(ini.write_msg1())
+        ini.read_msg2(res.write_msg2())
+        res.read_msg3(ini.write_msg3())
+        si, _, _ = ini.finalize()
+        _, rr, _ = res.finalize()
+        ct = bytearray(si.encrypt_with_ad(b"", b"payload"))
+        ct[len(ct) // 2] ^= 0x01
+        with pytest.raises(noise.NoiseError):
+            rr.decrypt_with_ad(b"", bytes(ct))
+
+    def test_payloads_encrypted_from_message_two(self):
+        ini, res = noise.NoiseXX(True), noise.NoiseXX(False)
+        msg1 = ini.write_msg1(b"msg1-cleartext")          # no key yet
+        assert b"msg1-cleartext" in msg1
+        res.read_msg1(msg1)
+        msg2 = res.write_msg2(b"msg2-secret")
+        assert b"msg2-secret" not in msg2                 # under ee key
+        ini.read_msg2(msg2)
+        msg3 = ini.write_msg3(b"msg3-secret")
+        assert b"msg3-secret" not in msg3
+        assert res.read_msg3(msg3) == b"msg3-secret"
+
+    def test_identity_binding(self):
+        ident = noise.generate_identity(b"test-identity-seed")
+        static = noise.new_random_static()
+        spub = static.public_key().public_bytes_raw()
+        sig = noise.sign_static_binding(ident, spub)
+        ipub = noise.identity_pub(ident)
+        assert noise.verify_static_binding(ipub, spub, sig)
+        other = noise.new_random_static().public_key().public_bytes_raw()
+        assert not noise.verify_static_binding(ipub, other, sig)
+        mallory = noise.identity_pub(noise.generate_identity(b"mallory"))
+        assert not noise.verify_static_binding(mallory, spub, sig)
+
+
+class _Relay:
+    """On-path TCP attacker: captures everything, optionally corrupts the
+    Nth length-prefixed frame in the dialer->listener direction."""
+
+    def __init__(self, dst_port: int, corrupt_frame: int | None = None):
+        self.dst_port = dst_port
+        self.corrupt_frame = corrupt_frame
+        self.captured = bytearray()
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while True:
+            try:
+                cli, _ = self._srv.accept()
+            except OSError:
+                return
+            dst = socket.socket()
+            dst.connect(("127.0.0.1", self.dst_port))
+            for (src, sink, mangle) in ((cli, dst, True), (dst, cli, False)):
+                t = threading.Thread(
+                    target=self._pump, args=(src, sink, mangle), daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src, sink, mangle: bool):
+        buf = bytearray()
+        n_frames = 0
+        while True:
+            try:
+                data = src.recv(65536)
+            except OSError:
+                data = b""
+            if not data:
+                # shutdown (not just close): the peer must see FIN even
+                # while the sibling pump thread is blocked in recv()
+                for s in (sink, src):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                try:
+                    sink.close()
+                except OSError:
+                    pass
+                return
+            self.captured += data
+            if not (mangle and self.corrupt_frame is not None):
+                try:
+                    sink.sendall(data)
+                except OSError:
+                    return
+                continue
+            # reframe so exactly one frame gets a bit flipped
+            buf += data
+            out = bytearray()
+            while len(buf) >= 4:
+                ln = int.from_bytes(buf[:4], "little")
+                if len(buf) < 4 + ln:
+                    break
+                frame = bytearray(buf[4:4 + ln])
+                del buf[:4 + ln]
+                if n_frames == self.corrupt_frame and ln > 0:
+                    frame[ln // 2] ^= 0x01
+                n_frames += 1
+                out += ln.to_bytes(4, "little") + frame
+            if out:
+                try:
+                    sink.sendall(bytes(out))
+                except OSError:
+                    return
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TestWireChannelSecurity:
+    def test_eavesdropper_sees_no_plaintext(self):
+        a, b = WireNode("EV-A").start(), WireNode("EV-B").start()
+        relay = _Relay(b.listen_port)
+        try:
+            got = []
+            b.subscribe("sec/topic", lambda t, d, s: got.append(d))
+            a.connect("127.0.0.1", relay.port)
+            assert _wait(lambda: b.peer_id in a.peers)
+            secret = b"SECRET-ATTESTATION-PAYLOAD-7f3a" * 4
+            a.publish("sec/topic", secret)
+            assert _wait(lambda: got)
+            assert got[0] == secret
+            assert secret not in bytes(relay.captured)
+            assert b"sec/topic" not in bytes(relay.captured)
+        finally:
+            relay.close()
+            a.stop(), b.stop()
+
+    def test_tampered_frame_fails_closed(self):
+        a, b = WireNode("TP-A").start(), WireNode("TP-B").start()
+        # dialer->listener frames: 0=noise msg1, 1=noise msg3, 2=first
+        # encrypted frame (HELLO) — corrupt that one
+        relay = _Relay(b.listen_port, corrupt_frame=2)
+        try:
+            try:
+                a.connect("127.0.0.1", relay.port)
+            except Exception:
+                pass                     # dial may observe the teardown
+            assert _wait(lambda: a.peer_id not in b.peers)  # B dropped it
+            assert _wait(lambda: b.peer_id not in a.peers)
+        finally:
+            relay.close()
+            a.stop(), b.stop()
+
+    def test_corrupted_handshake_fails_closed(self):
+        a, b = WireNode("HS-A").start(), WireNode("HS-B").start()
+        relay = _Relay(b.listen_port, corrupt_frame=1)   # noise msg3
+        try:
+            with pytest.raises(Exception):
+                a.connect("127.0.0.1", relay.port)
+            time.sleep(0.3)
+            assert b.peers == [] and a.peers == []
+        finally:
+            relay.close()
+            a.stop(), b.stop()
+
+    def test_impersonation_rejected(self):
+        """A node claiming a peer id it has no identity key for is
+        refused at the HELLO door (fingerprint mismatch)."""
+        a, b = WireNode("IM-A").start(), WireNode("IM-B").start()
+        victim = WireNode("IM-VICTIM")   # not started; we steal its name
+        try:
+            a.peer_id = victim.peer_id   # forged label, wrong key
+            try:
+                a.connect("127.0.0.1", b.listen_port)
+            except Exception:
+                pass
+            time.sleep(0.3)
+            assert b.peers == []
+        finally:
+            a.stop(), b.stop()
+
+    def test_identity_is_stable_under_seed(self):
+        n1, n2 = WireNode("same-seed"), WireNode("same-seed")
+        assert n1.peer_id == n2.peer_id
+        n3 = WireNode("other-seed")
+        assert n3.peer_id != n1.peer_id
+
+
+class TestSignedEnrs:
+    def test_forged_and_unsigned_enrs_dropped(self):
+        from lighthouse_tpu.network.discovery import Enr
+        from lighthouse_tpu.network.wire.transport import (
+            WireDiscoveryEndpoint,
+        )
+
+        node = WireNode("ENR-N")
+        ep = WireDiscoveryEndpoint(node)
+        good = Enr(peer_id=node.peer_id, port=1234).sign(node.identity)
+        assert good.verify()
+        unsigned = Enr(peer_id="nobody", port=4321)
+        mallory = WireNode("ENR-M")
+        forged = Enr(peer_id=node.peer_id, port=6666).sign(mallory.identity)
+        assert not unsigned.verify() and not forged.verify()
+        ep._sniff_enrs([good.to_bytes(), unsigned.to_bytes(),
+                        forged.to_bytes()])
+        assert ep.addr_book == {node.peer_id: ("127.0.0.1", 1234)}
+
+    def test_enr_tamper_breaks_signature(self):
+        node = WireNode("ENR-T")
+        from lighthouse_tpu.network.discovery import Enr
+
+        e = Enr(peer_id=node.peer_id, port=7777).sign(node.identity)
+        e.port = 8888                    # attacker rewrites the endpoint
+        assert not e.verify()

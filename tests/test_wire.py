@@ -89,7 +89,7 @@ class TestTcpTransport:
             assert _wait(lambda: got["b"] and got["c"])
             assert got["b"][0][0] == b"\xaa" * 40
             assert got["c"][0][0] == b"\xaa" * 40
-            assert got["c"][0][1] == "B"          # forwarded by B
+            assert got["c"][0][1] == b.peer_id     # forwarded by B
             # dedup: republishing the same bytes is dropped everywhere
             a.publish("topic/x", b"\xaa" * 40)
             time.sleep(0.3)
@@ -103,13 +103,13 @@ class TestTcpTransport:
             b.register_rpc("/test/echo/1",
                            lambda src, data: [data, data[::-1]])
             a.connect("127.0.0.1", b.listen_port)
-            assert _wait(lambda: "B2" in a.peers)
-            chunks = a.request("B2", "/test/echo/1", b"ping")
+            assert _wait(lambda: b.peer_id in a.peers)
+            chunks = a.request(b.peer_id, "/test/echo/1", b"ping")
             assert chunks == [b"ping", b"gnip"]
             from lighthouse_tpu.network.rpc import RpcError
 
             with pytest.raises(RpcError):
-                a.request("B2", "/test/nope/1", b"")
+                a.request(b.peer_id, "/test/nope/1", b"")
         finally:
             a.stop(), b.stop()
 
@@ -135,12 +135,14 @@ class TestUdpDiscovery:
         try:
             ep_a = WireDiscoveryEndpoint(a)
             ep_b = WireDiscoveryEndpoint(b)
-            disc_a = Discovery(ep_a, Enr(peer_id="DA", port=a.listen_port))
-            disc_b = Discovery(ep_b, Enr(peer_id="DB", port=b.listen_port))
+            disc_a = Discovery(ep_a, Enr(
+                peer_id=a.peer_id, port=a.listen_port).sign(a.identity))
+            disc_b = Discovery(ep_b, Enr(
+                peer_id=b.peer_id, port=b.listen_port).sign(b.identity))
             n = disc_b.bootstrap(f"127.0.0.1:{a.listen_port}")
             assert n >= 1                      # B learned A
             assert disc_a.table.closest(disc_a.enr.node_id)  # A learned B back
-            assert ep_b.resolve("DA") == ("127.0.0.1", a.listen_port)
+            assert ep_b.resolve(a.peer_id) == ("127.0.0.1", a.listen_port)
             assert disc_b is not None
         finally:
             a.stop(), b.stop()
@@ -194,7 +196,7 @@ class TestPeerEnforcement:
     def test_banned_peer_refused_at_hello(self):
         a, b = _mk_node("EA"), _mk_node("EB")
         try:
-            a.accept_peer = lambda pid: pid != "EB"
+            a.accept_peer = lambda pid: pid != b.peer_id
             # the dialer's handshake may transiently succeed (A's HELLO
             # goes out on accept); the door slams when A reads B's HELLO
             try:
@@ -202,13 +204,13 @@ class TestPeerEnforcement:
             except Exception:
                 pass
             time.sleep(0.3)
-            assert "EB" not in a.peers
-            assert _wait(lambda: "EA" not in b.peers)
+            assert b.peer_id not in a.peers
+            assert _wait(lambda: a.peer_id not in b.peers)
             # an acceptable peer still connects
             c = _mk_node("EC")
             try:
                 c.connect("127.0.0.1", a.listen_port)
-                assert _wait(lambda: "EC" in a.peers)
+                assert _wait(lambda: c.peer_id in a.peers)
             finally:
                 c.stop()
         finally:
@@ -218,9 +220,9 @@ class TestPeerEnforcement:
         a, b = _mk_node("ED"), _mk_node("EE")
         try:
             a.connect("127.0.0.1", b.listen_port)
-            assert _wait(lambda: "EE" in a.peers)
-            a.disconnect("EE")
-            assert _wait(lambda: "EE" not in a.peers)
+            assert _wait(lambda: b.peer_id in a.peers)
+            a.disconnect(b.peer_id)
+            assert _wait(lambda: b.peer_id not in a.peers)
         finally:
             a.stop(), b.stop()
 
