@@ -37,6 +37,9 @@ class _SeenCache:
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         self.capacity = capacity
 
+    def __contains__(self, mid: bytes) -> bool:
+        return mid in self._seen
+
     def observe(self, mid: bytes) -> bool:
         """True if newly seen."""
         if mid in self._seen:

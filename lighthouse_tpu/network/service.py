@@ -66,6 +66,12 @@ class NetworkService:
         node = getattr(fabric, "node", None)
         if node is not None:
             node.accept_peer = self.peer_manager.accept_connection
+            # gossipsub topic scoring feeds the ban gate: a peer whose
+            # mesh score crosses the graylist floor is penalized once
+            # per crossing (gossipsub_scoring_parameters.rs wires the
+            # same signal into libp2p's connection scoring)
+            self._graylisted_gossip: set[str] = set()
+            node.on_gossip_score = self._on_gossip_score
             node.on_peer_connected = self.peer_manager.mark_connected
             node.on_peer_disconnected = self.peer_manager.mark_disconnected
 
@@ -84,6 +90,16 @@ class NetworkService:
             enr.sign(node.identity)
         self.discovery = Discovery(
             disc_ep, enr, fork_digest=fork_digest(chain))
+
+    def _on_gossip_score(self, peer: str, score: float) -> None:
+        from lighthouse_tpu.network.wire.gossipsub import SCORE_GRAYLIST
+
+        if score < SCORE_GRAYLIST:
+            if peer not in self._graylisted_gossip:
+                self._graylisted_gossip.add(peer)
+                self.peer_manager.report(peer, "high", topic="gossipsub")
+        else:
+            self._graylisted_gossip.discard(peer)
 
     def on_slot(self, slot: int) -> None:
         """Per-slot tick: subnet subscription deltas + peer enforcement

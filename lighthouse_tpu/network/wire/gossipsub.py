@@ -1,0 +1,408 @@
+"""Gossipsub mesh machinery: heartbeat graft/prune, IHAVE/IWANT lazy
+gossip, and per-topic peer scoring.
+
+Rebuild of the reference's vendored gossipsub behaviour at this
+framework's altitude (/root/reference/beacon_node/lighthouse_network/
+gossipsub/src/behaviour.rs:2098 `heartbeat`, and the eth2 scoring
+parameters in src/service/gossipsub_scoring_parameters.rs):
+
+- Each subscribed topic keeps a **mesh** — the D peers full messages are
+  eagerly pushed to.  A once-per-second heartbeat grafts random eligible
+  peers when the mesh is under D_LOW, and prunes the worst-scored peers
+  when over D_HIGH (score ties broken randomly, exactly the pressure
+  direction the reference applies).
+- A windowed **message cache** (mcache) holds recent full messages; the
+  heartbeat advances the window and announces the last GOSSIP_WINDOW
+  worth of message ids to D_LAZY non-mesh subscribers (IHAVE).  A peer
+  missing a message answers with IWANT and receives the full payload —
+  the lazy pull path that heals mesh partitions.
+- **Per-topic scoring** (P1 time-in-mesh, P2 first-deliveries, P3 mesh
+  delivery deficit, P4 invalid messages) aggregates into a peer score;
+  negative peers are pruned from meshes and refused GRAFT, and the
+  existing peer-manager ban gate consumes the same signal.
+
+The engine is transport-agnostic: `WireNode` feeds it events (peer
+connect/disconnect, subscription changes, message arrivals, control
+frames) and supplies async send callbacks; all state mutation happens on
+the wire node's asyncio loop thread.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import OrderedDict
+from typing import Callable
+
+# gossipsub v1.1 mainnet-ish parameters (behaviour.rs defaults)
+D = 8                 # mesh target
+D_LOW = 6             # graft below
+D_HIGH = 12           # prune above
+D_LAZY = 6            # IHAVE fanout per heartbeat
+HEARTBEAT_S = 1.0
+MCACHE_LEN = 5        # history windows kept
+GOSSIP_WINDOW = 3     # windows announced in IHAVE
+MAX_IHAVE_IDS = 5000
+MAX_IWANT_IDS = 500
+IWANT_SERVE_BUDGET = 1000     # full messages served per peer per heartbeat
+IWANT_RETRANSMIT = 3          # times one message is re-served to one peer
+PRUNE_BACKOFF_S = 60.0
+
+# scoring weights (shaped like gossipsub_scoring_parameters.rs, scaled
+# to this engine's units)
+W_TIME_IN_MESH = 0.01         # per second, capped
+TIME_IN_MESH_CAP = 300.0
+W_FIRST_DELIVERY = 1.0
+FIRST_DELIVERY_CAP = 100.0
+W_MESH_DEFICIT = -1.0         # squared deficit vs expected deliveries
+# a mesh peer should relay at least this share of the topic's ACTUAL
+# traffic while it is in the mesh; tying the expectation to observed
+# traffic (not wall clock) keeps quiet topics (a block every 12s, idle
+# subnets) from penalizing healthy peers — the same role as the
+# reference's mesh_message_deliveries activation/decay parameters
+MESH_DELIVERY_SHARE = 0.25
+MESH_ACTIVATION_MSGS = 4      # grace: no deficit until this much traffic
+MESH_DEFICIT_CAP = 16.0       # bound the per-topic deficit window
+W_INVALID = -10.0
+SCORE_PRUNE = -4.0            # below: pruned from mesh, GRAFT refused
+SCORE_GRAYLIST = -16.0        # below: all gossip from peer ignored
+
+
+class TopicScore:
+    """Per-peer per-topic counters (behaviour.rs peer_score topic stats)."""
+
+    __slots__ = ("mesh_since", "first_deliveries", "mesh_deliveries",
+                 "invalid", "topic_msgs_at_join")
+
+    def __init__(self):
+        self.mesh_since: float | None = None
+        self.first_deliveries = 0.0
+        self.mesh_deliveries = 0.0
+        self.invalid = 0.0
+        self.topic_msgs_at_join = 0
+
+    def value(self, now: float, topic_msgs: int = 0) -> float:
+        s = 0.0
+        if self.mesh_since is not None:
+            s += W_TIME_IN_MESH * min(now - self.mesh_since,
+                                      TIME_IN_MESH_CAP)
+        s += W_FIRST_DELIVERY * min(self.first_deliveries,
+                                    FIRST_DELIVERY_CAP)
+        if self.mesh_since is not None:
+            # deficit vs the topic's OBSERVED traffic while in mesh
+            window = topic_msgs - self.topic_msgs_at_join
+            if window > MESH_ACTIVATION_MSGS:
+                expected = min(MESH_DELIVERY_SHARE
+                               * (window - MESH_ACTIVATION_MSGS),
+                               MESH_DEFICIT_CAP)
+                deficit = max(0.0, expected - self.mesh_deliveries)
+                s += W_MESH_DEFICIT * deficit * deficit
+        s += W_INVALID * self.invalid
+        return s
+
+
+class MessageCache:
+    """Windowed recent-message store (mcache.rs): put() on arrival,
+    shift() each heartbeat, gossip_ids() for IHAVE."""
+
+    def __init__(self, history: int = MCACHE_LEN,
+                 gossip_window: int = GOSSIP_WINDOW):
+        self.windows: list[list[tuple[str, bytes]]] = [
+            [] for _ in range(history)]
+        self.msgs: dict[bytes, tuple[str, bytes]] = {}   # id -> (topic, data)
+        self.gossip_window = gossip_window
+
+    def put(self, mid: bytes, topic: str, data: bytes):
+        if mid in self.msgs:
+            return
+        self.msgs[mid] = (topic, data)
+        self.windows[0].append((topic, mid))
+
+    def get(self, mid: bytes) -> tuple[str, bytes] | None:
+        return self.msgs.get(mid)
+
+    def gossip_ids(self, topic: str) -> list[bytes]:
+        out = []
+        for w in self.windows[:self.gossip_window]:
+            out.extend(m for t, m in w if t == topic)
+        return out[:MAX_IHAVE_IDS]
+
+    def shift(self):
+        dropped = self.windows.pop()
+        self.windows.insert(0, [])
+        for _, mid in dropped:
+            self.msgs.pop(mid, None)
+
+
+class GossipsubEngine:
+    """Mesh + scoring + lazy-gossip state machine.
+
+    The owner wires in:
+      send_graft/send_prune/send_ihave/send_iwant/send_msg — async
+        callbacks (peer_id, ...) that emit control/data frames;
+      peers_on_topic(topic) -> set[str] — connected peers subscribed;
+      on_score(peer_id, score) — scoring feed (peer-manager ban gate).
+    """
+
+    def __init__(self, local_id: str, rng: random.Random | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.local_id = local_id
+        self.clock = clock
+        self.rng = rng or random.Random()
+        self.mesh: dict[str, set[str]] = {}              # topic -> peers
+        self.topic_msgs: dict[str, int] = {}             # topic -> count
+        self.scores: dict[str, dict[str, TopicScore]] = {}  # peer->topic->
+        self.mcache = MessageCache()
+        self.backoff: dict[tuple[str, str], float] = {}  # (peer,topic)->until
+        self.iwant_budget: dict[str, int] = {}           # peer -> ids left
+        self.iwant_serve: dict[str, int] = {}            # peer -> serves left
+        self._retransmits: dict[tuple[str, bytes], int] = {}
+        # delivery bookkeeping: which peers already delivered an id
+        self._delivered: dict[bytes, set[str]] = {}
+        self._delivered_order: OrderedDict[bytes, None] = OrderedDict()
+        # owner callbacks (set after construction)
+        self.send_graft = None
+        self.send_prune = None
+        self.send_ihave = None
+        self.send_iwant = None
+        self.send_msg = None
+        self.peers_on_topic: Callable[[str], set[str]] = lambda t: set()
+        self.on_score: Callable[[str, float], None] | None = None
+
+    # -- scoring -------------------------------------------------------------
+
+    def _tscore(self, peer: str, topic: str) -> TopicScore:
+        return self.scores.setdefault(peer, {}).setdefault(
+            topic, TopicScore())
+
+    def score(self, peer: str) -> float:
+        now = self.clock()
+        return sum(ts.value(now, self.topic_msgs.get(topic, 0))
+                   for topic, ts in self.scores.get(peer, {}).items())
+
+    def mark_invalid(self, peer: str, topic: str):
+        """Validation failed on a message this peer delivered."""
+        self._tscore(peer, topic).invalid += 1.0
+        self._push_score(peer)
+
+    def _push_score(self, peer: str):
+        if self.on_score is not None:
+            self.on_score(peer, self.score(peer))
+
+    def graylisted(self, peer: str) -> bool:
+        return self.score(peer) < SCORE_GRAYLIST
+
+    # -- membership ----------------------------------------------------------
+
+    def join(self, topic: str):
+        """Local subscribe: build an initial mesh from eligible peers."""
+        if topic in self.mesh:
+            return
+        elig = [p for p in self.peers_on_topic(topic)
+                if self.score(p) >= SCORE_PRUNE]
+        self.rng.shuffle(elig)
+        self.mesh[topic] = set(elig[:D])
+        now = self.clock()
+        for p in self.mesh[topic]:
+            ts = self._tscore(p, topic)
+            ts.mesh_since = now
+            ts.topic_msgs_at_join = self.topic_msgs.get(topic, 0)
+        return list(self.mesh[topic])
+
+    def leave(self, topic: str) -> list[str]:
+        """Local unsubscribe: returns peers to PRUNE."""
+        peers = list(self.mesh.pop(topic, ()))
+        for p in peers:
+            ts = self._tscore(p, topic)
+            ts.mesh_since = None
+        return peers
+
+    def peer_disconnected(self, peer: str):
+        for topic, members in self.mesh.items():
+            members.discard(peer)
+        self.scores.pop(peer, None)
+        self.iwant_budget.pop(peer, None)
+        self.iwant_serve.pop(peer, None)
+        for key in [k for k in self._retransmits if k[0] == peer]:
+            del self._retransmits[key]
+
+    # -- inbound control -----------------------------------------------------
+
+    def handle_graft(self, peer: str, topic: str) -> bool:
+        """True = accepted; False = caller should PRUNE back."""
+        if topic not in self.mesh:
+            return False                      # not subscribed
+        now = self.clock()
+        if self.backoff.get((peer, topic), 0.0) > now:
+            return False                      # grafting through backoff
+        if self.score(peer) < SCORE_PRUNE:
+            return False
+        if peer not in self.peers_on_topic(topic):
+            return False
+        self.mesh[topic].add(peer)
+        ts = self._tscore(peer, topic)
+        if ts.mesh_since is None:
+            ts.mesh_since = now
+            ts.topic_msgs_at_join = self.topic_msgs.get(topic, 0)
+        return True
+
+    def handle_prune(self, peer: str, topic: str):
+        if topic not in self.mesh:
+            return             # unknown topic: no state for an attacker
+        self.mesh[topic].discard(peer)
+        ts = self.scores.get(peer, {}).get(topic)
+        if ts is not None:
+            ts.mesh_since = None
+        self.backoff[(peer, topic)] = self.clock() + PRUNE_BACKOFF_S
+
+    def handle_ihave(self, peer: str, topic: str,
+                     mids: list[bytes],
+                     seen: Callable[[bytes], bool]) -> list[bytes]:
+        """Returns the ids to IWANT from this peer."""
+        if self.graylisted(peer) or topic not in self.mesh:
+            return []
+        budget = self.iwant_budget.setdefault(peer, MAX_IWANT_IDS)
+        want = []
+        for mid in mids[:MAX_IHAVE_IDS]:
+            if budget <= 0:
+                break
+            if not seen(mid) and self.mcache.get(mid) is None:
+                want.append(mid)
+                budget -= 1
+        self.iwant_budget[peer] = budget
+        return want
+
+    def handle_iwant(self, peer: str,
+                     mids: list[bytes]) -> list[tuple[bytes, str, bytes]]:
+        """Returns (id, topic, data) for cached messages to send back.
+
+        Bandwidth-amplification guards: a per-peer serve budget per
+        heartbeat window, and a cap on how many times one message is
+        re-served to the same peer (one small IWANT frame must not be
+        able to elicit unbounded full-payload retransmission)."""
+        if self.graylisted(peer):
+            return []
+        budget = self.iwant_serve.setdefault(peer, IWANT_SERVE_BUDGET)
+        out = []
+        for mid in mids[:MAX_IWANT_IDS]:
+            if budget <= 0:
+                break
+            m = self.mcache.get(mid)
+            if m is None:
+                continue
+            key = (peer, mid)
+            sent = self._retransmits.get(key, 0)
+            if sent >= IWANT_RETRANSMIT:
+                continue
+            if len(self._retransmits) > 16384:
+                self._retransmits.clear()     # coarse bound; ids expire fast
+            self._retransmits[key] = sent + 1
+            budget -= 1
+            out.append((mid, m[0], m[1]))
+        self.iwant_serve[peer] = budget
+        return out
+
+    # -- inbound data --------------------------------------------------------
+
+    def on_message(self, src: str | None, topic: str, mid: bytes,
+                   data: bytes, first_time: bool):
+        """Record a message arrival (src=None for locally published)."""
+        self.mcache.put(mid, topic, data)
+        if first_time:
+            self.topic_msgs[topic] = self.topic_msgs.get(topic, 0) + 1
+        if src is None:
+            return
+        delivered = self._delivered.get(mid)
+        if delivered is None:
+            delivered = self._delivered[mid] = set()
+            self._delivered_order[mid] = None
+            while len(self._delivered_order) > 8192:
+                old, _ = self._delivered_order.popitem(last=False)
+                self._delivered.pop(old, None)
+        if src in delivered:
+            return
+        delivered.add(src)
+        ts = self._tscore(src, topic)
+        if first_time:
+            ts.first_deliveries += 1
+        if src in self.mesh.get(topic, ()):
+            ts.mesh_deliveries += 1
+
+    def eager_targets(self, topic: str, exclude: set[str]) -> list[str]:
+        """Mesh peers to push a full message to (fanout for unsubscribed
+        topics: random D from the subscriber set)."""
+        members = self.mesh.get(topic)
+        if members is None:
+            cands = [p for p in self.peers_on_topic(topic)
+                     if p not in exclude and not self.graylisted(p)]
+            self.rng.shuffle(cands)
+            return cands[:D]
+        return [p for p in members
+                if p not in exclude and not self.graylisted(p)]
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def heartbeat(self) -> dict:
+        """One tick: maintain meshes, emit IHAVE plan, advance mcache.
+
+        Returns {"graft": [(peer, topic)], "prune": [(peer, topic)],
+                 "ihave": [(peer, topic, [mid, ...])]}.
+        """
+        now = self.clock()
+        plan = {"graft": [], "prune": [], "ihave": []}
+        # expire backoffs
+        for key in [k for k, until in self.backoff.items() if until <= now]:
+            del self.backoff[key]
+        for topic, members in self.mesh.items():
+            on_topic = self.peers_on_topic(topic)
+            # lazy gossip FIRST, to the peers outside the mesh as it was
+            # when recent messages were (not) pushed — a peer grafted
+            # below would otherwise neither have been pushed the message
+            # nor hear the IHAVE that lets it IWANT-recover
+            mids = self.mcache.gossip_ids(topic)
+            if mids:
+                lazies = [p for p in on_topic
+                          if p not in members and not self.graylisted(p)]
+                self.rng.shuffle(lazies)
+                for p in lazies[:D_LAZY]:
+                    plan["ihave"].append((p, topic, mids))
+            # drop peers that fell below the prune threshold or left
+            bad = [p for p in members
+                   if self.score(p) < SCORE_PRUNE or p not in on_topic]
+            for p in bad:
+                members.discard(p)
+                self._tscore(p, topic).mesh_since = None
+                self.backoff[(p, topic)] = now + PRUNE_BACKOFF_S
+                if p in on_topic:
+                    plan["prune"].append((p, topic))
+            # under-populated: graft random eligible non-members
+            if len(members) < D_LOW:
+                cands = [p for p in on_topic
+                         if p not in members
+                         and self.score(p) >= SCORE_PRUNE
+                         and self.backoff.get((p, topic), 0.0) <= now]
+                self.rng.shuffle(cands)
+                for p in cands[:D - len(members)]:
+                    members.add(p)
+                    ts = self._tscore(p, topic)
+                    if ts.mesh_since is None:
+                        ts.mesh_since = now
+                        ts.topic_msgs_at_join = self.topic_msgs.get(topic, 0)
+                    plan["graft"].append((p, topic))
+            # over-populated: prune worst-scored down to D
+            elif len(members) > D_HIGH:
+                ranked = sorted(members,
+                                key=lambda p: (self.score(p),
+                                               self.rng.random()))
+                for p in ranked[:len(members) - D]:
+                    members.discard(p)
+                    self._tscore(p, topic).mesh_since = None
+                    self.backoff[(p, topic)] = now + PRUNE_BACKOFF_S
+                    plan["prune"].append((p, topic))
+        self.mcache.shift()
+        # refresh iwant budgets + push scores to the ban gate
+        self.iwant_budget.clear()
+        self.iwant_serve.clear()
+        for peer in list(self.scores):
+            self._push_score(peer)
+        return plan

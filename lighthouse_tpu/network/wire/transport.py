@@ -13,10 +13,12 @@ the reference's libp2p service at this framework's altitude
   payloads use the ssz_snappy codec (wire/codec.py), gossip payloads the
   snappy block format — the reference codec's framing
   (rpc/codec/ssz_snappy.rs:1).
-- Gossip is mesh-limited flood: peers announce topic subscriptions on
-  HELLO and on change; a publisher/forwarder sends to at most D=8
-  subscribed peers (gossipsub's mesh degree,
-  .../gossipsub/src/behaviour.rs), with the seen-cache stopping loops.
+- Gossip is real gossipsub (wire/gossipsub.py): per-topic meshes
+  maintained by a 1 Hz heartbeat (graft under D_LOW, prune worst-scored
+  over D_HIGH), IHAVE/IWANT lazy gossip from a windowed message cache,
+  flood-publish for locally-originated messages, and per-topic peer
+  scoring feeding the ban gate (.../gossipsub/src/behaviour.rs:2098);
+  the seen-cache stops forwarding loops.
 - Discovery is ping/findnode over UDP datagrams (discv5's transport
   shape, .../src/discovery/mod.rs:1): `WireDiscoveryEndpoint` speaks the
   same `register/request` protocol as the in-process rpc endpoint, so
@@ -42,9 +44,8 @@ from typing import Callable
 from lighthouse_tpu.common.logging import Logger
 from lighthouse_tpu.network.gossip import _SeenCache, message_id
 from lighthouse_tpu.network.rpc import RateLimiter, RpcError
-from lighthouse_tpu.network.wire import codec, noise
+from lighthouse_tpu.network.wire import codec, gossipsub, noise
 
-MESH_DEGREE = 8          # gossipsub D
 REQUEST_TIMEOUT_S = 10.0
 MAX_FRAME = 16 * 1024 * 1024
 HANDSHAKE_TIMEOUT_S = 5.0
@@ -60,6 +61,25 @@ K_RPC_CHUNK = 0x06
 K_RPC_END = 0x07
 K_RPC_ERR = 0x08
 K_GOODBYE = 0x09
+K_GRAFT = 0x0A
+K_PRUNE = 0x0B
+K_IHAVE = 0x0C
+K_IWANT = 0x0D
+
+MSG_ID_LEN = 20          # gossip.message_id output width
+
+
+def _pack_mids(mids: list[bytes]) -> bytes:
+    return struct.pack("<H", len(mids)) + b"".join(mids)
+
+
+def _unpack_mids(data: bytes, off: int) -> list[bytes]:
+    (n,) = struct.unpack_from("<H", data, off)
+    off += 2
+    if len(data) < off + n * MSG_ID_LEN:
+        raise RpcError("malformed message-id list")
+    return [data[off + i * MSG_ID_LEN: off + (i + 1) * MSG_ID_LEN]
+            for i in range(n)]
 
 
 def _pack_str(s: str) -> bytes:
@@ -134,6 +154,15 @@ class WireNode:
         self._streams: dict[int, dict] = {}          # stream id -> state
         self._next_stream = iter(range(1, 1 << 62))
         self._seen = _SeenCache(capacity=8192)
+        # gossipsub mesh machinery: graft/prune + IHAVE/IWANT + scoring
+        self._gs = gossipsub.GossipsubEngine(self.peer_id)
+        self._gs.peers_on_topic = lambda t: {
+            pid for pid, c in self._conns.items()
+            if t in c.topics and c.alive}
+        self._gs.on_score = lambda peer, score: (
+            self.on_gossip_score(peer, score)
+            if self.on_gossip_score is not None else None)
+        self.on_gossip_score: Callable[[str, float], None] | None = None
         self._udp_waiters: dict[bytes, asyncio.Future] = {}
         self._udp_handlers: dict[str, Callable] = {}
         self.on_delivery_result: Callable[[str, str, bool], None] | None = None
@@ -173,6 +202,7 @@ class WireNode:
             local_addr=(self.listen_host, self.listen_port))
         self.log.info("listening", tcp=self.listen_port,
                       udp=self.listen_port)
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
 
     def stop(self):
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -180,6 +210,8 @@ class WireNode:
             return
 
         async def _shutdown():
+            if getattr(self, "_hb_task", None) is not None:
+                self._hb_task.cancel()
             for conn in list(self._conns.values()):
                 try:
                     conn.writer.close()
@@ -316,6 +348,7 @@ class WireNode:
                 pass
             if conn.peer_id and self._conns.get(conn.peer_id) is conn:
                 del self._conns[conn.peer_id]
+                self._gs.peer_disconnected(conn.peer_id)
                 if self.on_peer_disconnected:
                     try:
                         self.on_peer_disconnected(conn.peer_id)
@@ -456,13 +489,41 @@ class WireNode:
                 if not st["future"].done():
                     st["future"].set_exception(
                         RpcError(body[8:].decode(errors="replace")))
+        elif kind == K_GRAFT:
+            topic = body.decode()
+            if not self._gs.handle_graft(conn.peer_id, topic):
+                await self._send_frame(
+                    conn, bytes([K_PRUNE]) + topic.encode())
+        elif kind == K_PRUNE:
+            self._gs.handle_prune(conn.peer_id, body.decode())
+        elif kind == K_IHAVE:
+            topic, off = _unpack_str(body, 0)
+            mids = _unpack_mids(body, off)
+            want = self._gs.handle_ihave(
+                conn.peer_id, topic, mids,
+                seen=lambda mid: mid in self._seen)
+            if want:
+                await self._send_frame(
+                    conn, bytes([K_IWANT]) + _pack_mids(want))
+        elif kind == K_IWANT:
+            mids = _unpack_mids(body, 0)
+            for mid, topic, data in self._gs.handle_iwant(
+                    conn.peer_id, mids):
+                await self._send_frame(
+                    conn, bytes([K_GOSSIP]) + _pack_str(topic)
+                    + codec.encode_gossip(data))
         elif kind == K_GOODBYE:
             conn.writer.close()
 
     # -- gossip --------------------------------------------------------------
 
     def _on_gossip(self, src: str, topic: str, data: bytes):
-        if not self._seen.observe(message_id(topic, data)):
+        if self._gs.graylisted(src):
+            return                        # scoring floor: ignore entirely
+        mid = message_id(topic, data)
+        first = self._seen.observe(mid)
+        self._gs.on_message(src, topic, mid, data, first_time=first)
+        if not first:
             return
         handler = self._topics.get(topic)
 
@@ -474,23 +535,36 @@ class WireNode:
                         self._pool, handler, topic, data, src)
                 except Exception:
                     ok = False
+            if not ok:
+                self._gs.mark_invalid(src, topic)
             if self.on_delivery_result is not None:
                 try:
                     self.on_delivery_result(src, topic, ok)
                 except Exception:
                     pass
-            # forward valid messages on (mesh flood with dedup); invalid
-            # messages are NOT propagated (gossipsub validation gating)
+            # forward valid messages to OUR mesh; invalid messages are
+            # NOT propagated (gossipsub validation gating)
             if ok:
                 await self._fanout(topic, data, exclude={src})
 
         asyncio.ensure_future(run())
 
-    async def _fanout(self, topic: str, data: bytes, exclude: set[str]):
+    async def _fanout(self, topic: str, data: bytes, exclude: set[str],
+                      flood: bool = False):
+        """flood=True (local publish): push to every subscribed peer —
+        gossipsub's flood_publish, which closes the window where the
+        mesh hasn't converged around a fresh publisher.  flood=False
+        (forwarding): push to the topic mesh only."""
         wire = bytes([K_GOSSIP]) + _pack_str(topic) + codec.encode_gossip(data)
-        targets = [c for pid, c in self._conns.items()
-                   if pid not in exclude and topic in c.topics and c.alive]
-        for conn in targets[:MESH_DEGREE]:
+        if flood:
+            targets = [p for p in self._gs.peers_on_topic(topic)
+                       if p not in exclude and not self._gs.graylisted(p)]
+        else:
+            targets = self._gs.eager_targets(topic, exclude)
+        for pid in targets:
+            conn = self._conns.get(pid)
+            if conn is None or not conn.alive:
+                continue
             try:
                 await self._send_frame(conn, wire)
             except Exception:
@@ -499,17 +573,46 @@ class WireNode:
     def publish(self, topic: str, data: bytes):
         async def run():
             # observe on the loop thread: _SeenCache is mutated only there
-            self._seen.observe(message_id(topic, data))
-            await self._fanout(topic, data, exclude=set())
+            mid = message_id(topic, data)
+            self._seen.observe(mid)
+            self._gs.on_message(None, topic, mid, data, first_time=True)
+            await self._fanout(topic, data, exclude=set(), flood=True)
         asyncio.run_coroutine_threadsafe(run(), self.loop)
 
     def subscribe(self, topic: str, handler: Callable):
         self._topics[topic] = handler
         self._announce(K_SUBSCRIBE, topic)
+        if self.loop is None:
+            # pre-start subscribe (supported everywhere else in this
+            # file): no peers exist yet, but the mesh entry must, or
+            # inbound GRAFT/IHAVE for the topic are refused forever
+            self._gs.join(topic)
+        else:
+            async def _join():
+                for p in (self._gs.join(topic) or ()):
+                    conn = self._conns.get(p)
+                    if conn is not None and conn.alive:
+                        try:
+                            await self._send_frame(
+                                conn, bytes([K_GRAFT]) + topic.encode())
+                        except Exception:
+                            pass
+            asyncio.run_coroutine_threadsafe(_join(), self.loop)
 
     def unsubscribe(self, topic: str):
         self._topics.pop(topic, None)
         self._announce(K_UNSUBSCRIBE, topic)
+        if self.loop is not None:
+            async def _leave():
+                for p in self._gs.leave(topic):
+                    conn = self._conns.get(p)
+                    if conn is not None and conn.alive:
+                        try:
+                            await self._send_frame(
+                                conn, bytes([K_PRUNE]) + topic.encode())
+                        except Exception:
+                            pass
+            asyncio.run_coroutine_threadsafe(_leave(), self.loop)
 
     def _announce(self, kind: int, topic: str):
         if self.loop is None:
@@ -524,6 +627,35 @@ class WireNode:
                     pass
 
         asyncio.run_coroutine_threadsafe(_do(), self.loop)
+
+    async def _heartbeat_loop(self):
+        """Once-per-second gossipsub heartbeat (behaviour.rs:2098):
+        mesh maintenance (graft/prune) + lazy IHAVE gossip."""
+        while True:
+            await asyncio.sleep(gossipsub.HEARTBEAT_S)
+            try:
+                plan = self._gs.heartbeat()
+            except Exception as e:
+                self.log.warn("heartbeat error", err=str(e))
+                continue
+            for peer, topic in plan["graft"]:
+                await self._send_ctrl(peer, bytes([K_GRAFT])
+                                      + topic.encode())
+            for peer, topic in plan["prune"]:
+                await self._send_ctrl(peer, bytes([K_PRUNE])
+                                      + topic.encode())
+            for peer, topic, mids in plan["ihave"]:
+                await self._send_ctrl(peer, bytes([K_IHAVE])
+                                      + _pack_str(topic) + _pack_mids(mids))
+
+    async def _send_ctrl(self, peer: str, frame: bytes):
+        conn = self._conns.get(peer)
+        if conn is None or not conn.alive:
+            return
+        try:
+            await self._send_frame(conn, frame)
+        except Exception:
+            pass
 
     # -- rpc -----------------------------------------------------------------
 

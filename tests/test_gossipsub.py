@@ -1,0 +1,241 @@
+"""Gossipsub mesh machinery: heartbeat graft/prune, IHAVE/IWANT lazy
+gossip, per-topic scoring (reference gossipsub/src/behaviour.rs:2098 +
+service/gossipsub_scoring_parameters.rs)."""
+
+import random
+import time
+
+from lighthouse_tpu.network.wire import gossipsub as gs
+from lighthouse_tpu.network.wire.transport import WireNode
+
+
+def _wait(cond, timeout=8.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _engine(peers, clock):
+    e = gs.GossipsubEngine("self", rng=random.Random(7), clock=clock)
+    e.peers_on_topic = lambda t: set(peers)
+    return e
+
+
+class TestEngineMesh:
+    def test_join_builds_mesh_capped_at_d(self):
+        t = [0.0]
+        e = _engine([f"p{i}" for i in range(20)], lambda: t[0])
+        e.join("top")
+        assert len(e.mesh["top"]) == gs.D
+
+    def test_heartbeat_grafts_under_dlow(self):
+        t = [0.0]
+        peers = [f"p{i}" for i in range(10)]
+        e = _engine(peers, lambda: t[0])
+        e.mesh["top"] = {"p0"}                     # under D_LOW
+        plan = e.heartbeat()
+        grafted = [p for p, _ in plan["graft"]]
+        assert len(e.mesh["top"]) == gs.D
+        assert len(grafted) == gs.D - 1
+
+    def test_heartbeat_prunes_worst_over_dhigh(self):
+        t = [0.0]
+        peers = [f"p{i}" for i in range(16)]
+        e = _engine(peers, lambda: t[0])
+        e.mesh["top"] = set(peers)                 # 16 > D_HIGH
+        for _ in range(3):
+            e.mark_invalid("p3", "top")            # worst peer
+        plan = e.heartbeat()
+        pruned = [p for p, _ in plan["prune"]]
+        assert "p3" in pruned                      # worst goes first
+        assert len(e.mesh["top"]) == gs.D
+        # pruned peers get a backoff: no immediate re-graft
+        assert e.backoff[("p3", "top")] > t[0]
+        assert not e.handle_graft("p3", "top")
+
+    def test_low_score_peer_pruned_and_graft_refused(self):
+        t = [0.0]
+        e = _engine(["good", "bad"], lambda: t[0])
+        e.mesh["top"] = {"good", "bad"}
+        for _ in range(2):
+            e.mark_invalid("bad", "top")           # -20 < SCORE_PRUNE
+        plan = e.heartbeat()
+        assert ("bad", "top") in plan["prune"]
+        assert "bad" not in e.mesh["top"]
+        t[0] += gs.PRUNE_BACKOFF_S + 1             # backoff expires...
+        assert not e.handle_graft("bad", "top")    # ...score still bars it
+
+    def test_ihave_goes_to_non_mesh_peers_before_graft(self):
+        t = [0.0]
+        e = _engine(["m", "lazy"], lambda: t[0])
+        e.mesh["top"] = {"m"}
+        e.on_message(None, "top", b"i" * 20, b"payload", first_time=True)
+        plan = e.heartbeat()
+        ihave_peers = [p for p, _, mids in plan["ihave"] if b"i" * 20 in mids]
+        # "lazy" was outside the mesh when the message flowed: it MUST
+        # hear the IHAVE even though this same tick grafts it
+        assert ihave_peers == ["lazy"]
+        assert "lazy" in e.mesh["top"]             # grafted after
+
+    def test_iwant_serves_from_mcache_and_windows_expire(self):
+        t = [0.0]
+        e = _engine(["p"], lambda: t[0])
+        e.mesh["top"] = set()
+        e.on_message(None, "top", b"w" * 20, b"data", first_time=True)
+        assert e.handle_iwant("p", [b"w" * 20]) == [
+            (b"w" * 20, "top", b"data")]
+        for _ in range(gs.MCACHE_LEN):
+            e.heartbeat()
+        assert e.handle_iwant("p", [b"w" * 20]) == []   # expired
+
+    def test_ihave_budget_limits_iwant(self):
+        t = [0.0]
+        e = _engine(["spammer"], lambda: t[0])
+        e.mesh["top"] = set()
+        e.join("top")
+        mids = [i.to_bytes(20, "big") for i in range(gs.MAX_IWANT_IDS + 100)]
+        want = e.handle_ihave("spammer", "top", mids, seen=lambda m: False)
+        assert len(want) == gs.MAX_IWANT_IDS
+        # budget exhausted until the next heartbeat refreshes it
+        assert e.handle_ihave("spammer", "top", mids,
+                              seen=lambda m: False) == []
+        e.heartbeat()
+        assert len(e.handle_ihave("spammer", "top", mids,
+                                  seen=lambda m: False)) > 0
+
+    def test_graylisted_peer_fully_ignored(self):
+        t = [0.0]
+        e = _engine(["evil"], lambda: t[0])
+        e.mesh["top"] = set()
+        for _ in range(2):                         # -20 graylist floor...
+            e.mark_invalid("evil", "top")
+        assert e.score("evil") < gs.SCORE_PRUNE
+        t2 = [0.0]
+        e2 = _engine(["evil"], lambda: t2[0])
+        e2.mesh["top"] = set()
+        for _ in range(5):                         # < SCORE_GRAYLIST
+            e2.mark_invalid("evil", "top")
+        assert e2.graylisted("evil")
+        assert e2.handle_ihave("evil", "top", [b"x" * 20],
+                               seen=lambda m: False) == []
+        assert e2.handle_iwant("evil", [b"x" * 20]) == []
+
+    def test_mesh_delivery_deficit_penalizes_silent_mesh_peer(self):
+        """A mesh peer that relays nothing WHILE TRAFFIC FLOWS loses
+        score; the expectation tracks observed topic traffic."""
+        t = [0.0]
+        e = _engine(["quiet", "busy"], lambda: t[0])
+        e.mesh["top"] = {"quiet", "busy"}
+        for p in ("quiet", "busy"):
+            e._tscore(p, "top").mesh_since = 0.0
+        for i in range(24):                        # busy relays everything
+            e.on_message("busy", "top", bytes([i]) * 20, b"d",
+                         first_time=True)
+        assert e.score("quiet") < gs.SCORE_PRUNE
+        assert e.score("busy") > 0
+
+    def test_quiet_topic_does_not_penalize_mesh_peers(self):
+        """No traffic -> no deficit: a beacon topic that is simply idle
+        (a block every 12s, empty subnets) must not erode mesh peers."""
+        t = [0.0]
+        e = _engine(["p"], lambda: t[0])
+        e.mesh["top"] = {"p"}
+        e._tscore("p", "top").mesh_since = 0.0
+        t[0] = 600.0                               # long silence
+        assert e.score("p") >= 0.0
+
+    def test_first_delivery_rewards(self):
+        t = [0.0]
+        e = _engine(["fast"], lambda: t[0])
+        e.mesh["top"] = set()
+        for i in range(5):
+            e.on_message("fast", "top", bytes([i]) * 20, b"d",
+                         first_time=True)
+        assert e.score("fast") >= 5 * gs.W_FIRST_DELIVERY
+
+
+class TestSocketGossipsub:
+    def test_missed_message_recovered_via_iwant(self):
+        """Line A-B-C.  B's forward runs over its mesh; with C forced
+        out of B's mesh the message misses C, and C must recover it
+        through B's heartbeat IHAVE -> IWANT -> full frame."""
+        a = WireNode("GS-A").start()
+        b = WireNode("GS-B").start()
+        c = WireNode("GS-C").start()
+        try:
+            got = []
+            for n in (a, b):
+                n.subscribe("gs/x", lambda t, d, s: None)
+            c.subscribe("gs/x", lambda t, d, s: got.append(d))
+            a.connect("127.0.0.1", b.listen_port)
+            c.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: len(b.peers) == 2)
+
+            def starve_and_publish():
+                # C out of B's mesh: B's forward will miss it, and only
+                # the IHAVE computed before re-grafting can heal it
+                b._gs.mesh["gs/x"] = {a.peer_id}
+                a.publish("gs/x", b"needs-lazy-recovery")
+            b.loop.call_soon_threadsafe(starve_and_publish)
+            assert _wait(lambda: got == [b"needs-lazy-recovery"])
+        finally:
+            a.stop(), b.stop(), c.stop()
+
+    def test_low_scored_peer_pruned_from_mesh_over_sockets(self):
+        """Three real-socket nodes: the peer that keeps delivering
+        invalid messages is pruned from the mesh (K_PRUNE on the wire)
+        and its re-GRAFT is refused."""
+        a = WireNode("GS3-A").start()
+        b = WireNode("GS3-B").start()
+        c = WireNode("GS3-C").start()
+        try:
+            for n in (a, b, c):
+                n.subscribe("gs/score", lambda t, d, s: None)
+            b.connect("127.0.0.1", a.listen_port)
+            c.connect("127.0.0.1", a.listen_port)
+            assert _wait(lambda: len(a.peers) == 2)
+            # meshes converge via heartbeat
+            assert _wait(lambda: b.peer_id in a._gs.mesh.get("gs/score",
+                                                             set()))
+            # B turns out to be a bad relay: invalid deliveries
+            def poison():
+                for _ in range(3):
+                    a._gs.mark_invalid(b.peer_id, "gs/score")
+            a.loop.call_soon_threadsafe(poison)
+            # heartbeat prunes B; C stays
+            assert _wait(lambda: b.peer_id not in a._gs.mesh["gs/score"])
+            assert _wait(lambda: c.peer_id in a._gs.mesh["gs/score"])
+            # B's side got the PRUNE: A left B's mesh + backoff set
+            assert _wait(lambda: a.peer_id not in b._gs.mesh["gs/score"])
+            assert (a.peer_id, "gs/score") in b._gs.backoff
+            # a GRAFT from B is refused (score floor): A prunes back
+            def regraft():
+                b._gs.backoff.pop((a.peer_id, "gs/score"), None)
+                b._gs.mesh["gs/score"].add(a.peer_id)
+            b.loop.call_soon_threadsafe(regraft)
+            time.sleep(2.5)                        # heartbeats pass
+            assert b.peer_id not in a._gs.mesh["gs/score"]
+        finally:
+            a.stop(), b.stop(), c.stop()
+
+    def test_invalid_gossip_feeds_scoring(self):
+        """A handler that rejects messages drives the sender's score
+        down through the engine's invalid counter."""
+        a, b = WireNode("GS-I-A").start(), WireNode("GS-I-B").start()
+        try:
+            def reject(t, d, s):
+                raise ValueError("bad message")
+            a.subscribe("gs/v", reject)
+            b.subscribe("gs/v", lambda t, d, s: None)
+            a.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: b.peer_id in a.peers)
+            for i in range(3):
+                b.publish("gs/v", b"junk-%d" % i)
+            # two invalids already cross the graylist floor; further
+            # frames from B are dropped before they can even be counted
+            assert _wait(lambda: a._gs.graylisted(b.peer_id))
+        finally:
+            a.stop(), b.stop()
