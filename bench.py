@@ -87,7 +87,7 @@ def _bench_bls_1k() -> dict:
     ledger_ok = _bb.verify_sets_pipeline(_fresh(sets), ledger=ledger)
     assert ledger_ok, "profiled ledger pass failed to verify"
     return {
-        "metric": "bls_verify_1k_sets",
+        "metric": f"bls_verify_{n_sets}_sets",
         "value": round(sets_per_s, 1),
         "unit": "sets/s",
         "vs_baseline": round(sets_per_s / 120_000.0, 4),
@@ -379,10 +379,15 @@ def _bench_merkleize() -> dict:
     dev_leaves = jax.device_put(jnp.asarray(leaves))  # keep off the clock:
     device_merkle_root(dev_leaves).block_until_ready()  # compile warm-up
     n_iters = 3
+    roots = []
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        root = device_merkle_root(dev_leaves).block_until_ready()
+        # MATERIALIZE to host inside the timed loop: under the axon
+        # tunnel block_until_ready alone is not trusted evidence that
+        # the device actually finished the fold
+        roots.append(np.asarray(device_merkle_root(dev_leaves)))
     dt_device = (time.perf_counter() - t0) / n_iters
+    assert all(np.array_equal(r, roots[0]) for r in roots[1:])
     n_hashes = n_leaves - 1
     device_rate = n_hashes / dt_device
 

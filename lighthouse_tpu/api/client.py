@@ -61,6 +61,25 @@ class BeaconNodeClient:
             "GET",
             f"/eth/v1/beacon/states/{state_id}/validators/{vid}")["data"]
 
+    def block_rewards(self, block_id) -> dict:
+        return self._call(
+            "GET", f"/eth/v1/beacon/rewards/blocks/{block_id}")["data"]
+
+    def attestation_rewards(self, epoch: int, validators=()) -> dict:
+        return self._call(
+            "POST", f"/eth/v1/beacon/rewards/attestations/{epoch}",
+            list(validators))["data"]
+
+    def sync_committee_rewards(self, block_id, validators=()) -> list:
+        return self._call(
+            "POST", f"/eth/v1/beacon/rewards/sync_committee/{block_id}",
+            list(validators))["data"]
+
+    def block_packing(self, start_epoch: int, end_epoch: int) -> list:
+        return self._call(
+            "GET", "/lighthouse/analysis/block_packing_efficiency"
+            f"?start_epoch={start_epoch}&end_epoch={end_epoch}")["data"]
+
     def header(self, block_id="head"):
         return self._call("GET", f"/eth/v1/beacon/headers/{block_id}")["data"]
 

@@ -112,6 +112,19 @@ class BeaconApi:
           self.lc_finality)
         r("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>\w+)",
           self.debug_state_ssz)
+        r("GET", r"/eth/v1/beacon/rewards/blocks/(?P<block_id>\w+)",
+          self.block_rewards)
+        r("POST", r"/eth/v1/beacon/rewards/attestations/(?P<epoch>\d+)",
+          self.attestation_rewards)
+        r("POST", r"/eth/v1/beacon/rewards/sync_committee/(?P<block_id>\w+)",
+          self.sync_committee_rewards)
+        r("GET", r"/lighthouse/validator_inclusion/(?P<epoch>\d+)/global",
+          self.validator_inclusion_global)
+        r("GET",
+          r"/lighthouse/validator_inclusion/(?P<epoch>\d+)/(?P<vid>\w+)",
+          self.validator_inclusion_one)
+        r("GET", r"/lighthouse/analysis/block_packing_efficiency",
+          self.block_packing)
         r("GET", r"/eth/v1/node/version", self.version)
         r("GET", r"/eth/v1/node/health", self.health)
         r("GET", r"/lighthouse/health", self.lighthouse_health)
@@ -965,6 +978,91 @@ class BeaconApi:
         if upd is None:
             raise ApiError(404, "no finality update yet")
         return self._lc_update_json(upd, with_finality=True)
+
+    # -- rewards family (standard_block_rewards.rs, lib.rs:2510,
+    #    sync_committee_rewards.rs, validator_inclusion.rs,
+    #    block_packing_efficiency.rs) -------------------------------------
+
+    def block_rewards(self, block_id, body=None):
+        from lighthouse_tpu.api import rewards as R
+
+        _, blk = self._block(block_id)
+        try:
+            data = R.compute_block_rewards(self.chain, blk)
+        except R.RewardsError as e:
+            raise ApiError(404, str(e))
+        return {"execution_optimistic": False, "finalized": False,
+                "data": data}
+
+    def attestation_rewards(self, epoch, body=None):
+        from lighthouse_tpu.api import rewards as R
+
+        try:
+            validators = json.loads(body) if body else []
+        except ValueError:
+            raise ApiError(400, "body must be a JSON list of indices")
+        try:
+            data = R.compute_attestation_rewards(
+                self.chain, int(epoch), validators)
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        except R.RewardsError as e:
+            raise ApiError(404, str(e))
+        return {"execution_optimistic": False, "finalized": False,
+                "data": data}
+
+    def sync_committee_rewards(self, block_id, body=None):
+        from lighthouse_tpu.api import rewards as R
+
+        _, blk = self._block(block_id)
+        try:
+            validators = json.loads(body) if body else []
+        except ValueError:
+            raise ApiError(400, "body must be a JSON list of indices")
+        try:
+            data = R.compute_sync_committee_rewards(
+                self.chain, blk, validators)
+        except R.RewardsError as e:
+            raise ApiError(404, str(e))
+        return {"execution_optimistic": False, "finalized": False,
+                "data": data}
+
+    def validator_inclusion_global(self, epoch, body=None):
+        from lighthouse_tpu.api import rewards as R
+
+        try:
+            return {"data": R.validator_inclusion_global(
+                self.chain, int(epoch))}
+        except R.RewardsError as e:
+            raise ApiError(404, str(e))
+
+    def validator_inclusion_one(self, epoch, vid, body=None):
+        from lighthouse_tpu.api import rewards as R
+
+        if not vid.isdigit():
+            raise ApiError(400, "validator id must be an index")
+        try:
+            return {"data": R.validator_inclusion_one(
+                self.chain, int(epoch), int(vid))}
+        except R.RewardsError as e:
+            raise ApiError(404, str(e))
+
+    def block_packing(self, body=None, query=None):
+        from lighthouse_tpu.api import rewards as R
+
+        q = query or {}
+        head_epoch = self.chain.spec.compute_epoch_at_slot(
+            int(self.chain.head_state.slot))
+        try:
+            end = int(q.get("end_epoch", head_epoch))
+            start = int(q.get("start_epoch", max(0, end - 63)))
+        except ValueError:
+            raise ApiError(400, "epochs must be integers")
+        if start < 0 or end < start:
+            raise ApiError(400, "bad epoch range")
+        if end - start > 64:
+            raise ApiError(400, "epoch range too wide (max 64)")
+        return {"data": R.block_packing_efficiency(self.chain, start, end)}
 
     def version(self, body=None):
         return {"data": {"version": "lighthouse-tpu/0.2.0"}}

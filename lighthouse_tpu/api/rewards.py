@@ -1,0 +1,437 @@
+"""Rewards & analytics computations behind the standard Beacon API
+rewards family and the lighthouse analysis routes.
+
+Rebuild of the reference's reward endpoints at this framework's
+altitude:
+- standard block rewards:
+  /root/reference/beacon_node/http_api/src/standard_block_rewards.rs:10
+  + beacon_chain/src/beacon_block_reward.rs:22 — proposer reward split
+  into attestations / sync_aggregate / proposer_slashings /
+  attester_slashings, computed against the state BEFORE the block.
+- attestation rewards:
+  /root/reference/beacon_node/http_api/src/lib.rs:2510
+  (beacon_chain compute_attestation_rewards) — per-validator
+  head/target/source/inactivity deltas for an epoch plus the
+  ideal-reward table per effective-balance tier.
+- sync committee rewards: http_api/src/sync_committee_rewards.rs:11 —
+  per-participant reward (positive for set bits, negative for missed).
+- validator inclusion + block packing efficiency:
+  http_api/src/validator_inclusion.rs, block_packing_efficiency.rs.
+
+The heavy math rides the SAME tested state-transition helpers the import
+pipeline uses (block_processing / epoch_processing); block rewards are
+measured as proposer-balance deltas while replaying the block's
+operations with signatures off — the one observable the spec guarantees
+to equal the reward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    misc,
+    state_advance,
+)
+from lighthouse_tpu.state_transition.block_processing import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    WEIGHT_DENOMINATOR,
+    process_attestation,
+    process_attester_slashing,
+    process_block_header,
+    process_proposer_slashing,
+)
+from lighthouse_tpu.state_transition.epoch_processing import (
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    _eligible_validator_mask,
+    _inactivity_penalty_quotient,
+    base_reward_per_increment,
+    has_flag,
+    is_in_inactivity_leak,
+)
+
+
+class RewardsError(Exception):
+    pass
+
+
+def state_before_block(chain, signed_block):
+    """Parent post-state advanced (slots only) to the block's slot —
+    sync_committee_rewards.rs get_state_before_applying_block."""
+    parent_root = bytes(signed_block.message.parent_root)
+    st = chain.state_for_block(parent_root)
+    if st is None:
+        raise RewardsError("parent state unavailable")
+    st = st.copy()
+    state_advance(st, chain.spec, int(signed_block.message.slot))
+    return st
+
+
+def _fork_at(chain, slot: int) -> str:
+    return chain.spec.fork_at_epoch(
+        chain.spec.compute_epoch_at_slot(int(slot)))
+
+
+def compute_block_rewards(chain, signed_block) -> dict:
+    """StandardBlockReward: the proposer's reward for each block
+    component, measured as balance deltas over a replay with
+    signatures off (beacon_block_reward.rs:22)."""
+    spec = chain.spec
+    block = signed_block.message
+    body = block.body
+    fork = _fork_at(chain, int(block.slot))
+    st = state_before_block(chain, signed_block)
+    proposer = int(block.proposer_index)
+    strategy = SignatureStrategy.NO_VERIFICATION
+
+    process_block_header(st, spec, block)
+
+    def bal() -> int:
+        return int(st.balances[proposer])
+
+    before = bal()
+    for slashing in body.proposer_slashings:
+        process_proposer_slashing(st, spec, slashing, strategy, None)
+    proposer_slashing_reward = bal() - before
+
+    before = bal()
+    for slashing in body.attester_slashings:
+        process_attester_slashing(st, spec, slashing, strategy, None)
+    attester_slashing_reward = bal() - before
+
+    before = bal()
+    for att in body.attestations:
+        process_attestation(st, spec, att, fork, strategy, None,
+                            proposer=proposer)
+    attestation_reward = bal() - before
+
+    sync_reward = 0
+    if fork != "phase0" and hasattr(body, "sync_aggregate"):
+        # analytically, NOT as a balance delta: when the proposer is
+        # itself a committee member its participant reward would leak
+        # into the measurement (the reference counts only the
+        # per-set-bit proposer cut, beacon_block_reward.rs
+        # compute_beacon_block_sync_aggregate_reward)
+        from lighthouse_tpu.state_transition.epoch_processing import (
+            SYNC_REWARD_WEIGHT,
+        )
+
+        total_ab = misc.get_total_active_balance(st, spec)
+        brpi = base_reward_per_increment(spec, total_ab)
+        total_increments = total_ab // spec.effective_balance_increment
+        max_participant_rewards = (
+            brpi * total_increments * SYNC_REWARD_WEIGHT
+            // WEIGHT_DENOMINATOR // spec.preset.slots_per_epoch)
+        participant_reward = (max_participant_rewards
+                              // spec.preset.sync_committee_size)
+        proposer_cut = (participant_reward * PROPOSER_WEIGHT
+                        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+        n_bits = sum(1 for b in body.sync_aggregate.sync_committee_bits
+                     if b)
+        sync_reward = proposer_cut * n_bits
+
+    total = (attestation_reward + sync_reward
+             + proposer_slashing_reward + attester_slashing_reward)
+    return {
+        "proposer_index": str(proposer),
+        "total": str(total),
+        "attestations": str(attestation_reward),
+        "sync_aggregate": str(sync_reward),
+        "proposer_slashings": str(proposer_slashing_reward),
+        "attester_slashings": str(attester_slashing_reward),
+    }
+
+
+def compute_sync_committee_rewards(chain, signed_block,
+                                   validators: list | None = None) -> list:
+    """Per-participant sync committee reward for one block
+    (sync_committee_rewards.rs:11): +participant_reward for a set bit,
+    -participant_reward for a miss."""
+    spec = chain.spec
+    block = signed_block.message
+    fork = _fork_at(chain, int(block.slot))
+    if fork == "phase0" or not hasattr(block.body, "sync_aggregate"):
+        return []
+    st = state_before_block(chain, signed_block)
+
+    from lighthouse_tpu.state_transition.block_processing import (
+        _sync_committee_validator_indices,
+    )
+
+    total = misc.get_total_active_balance(st, spec)
+    brpi = base_reward_per_increment(spec, total)
+    total_increments = total // spec.effective_balance_increment
+    total_base_rewards = brpi * total_increments
+    from lighthouse_tpu.state_transition.epoch_processing import (
+        SYNC_REWARD_WEIGHT,
+    )
+
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR
+        // spec.preset.slots_per_epoch)
+    participant_reward = (max_participant_rewards
+                          // spec.preset.sync_committee_size)
+
+    committee = _sync_committee_validator_indices(st)
+    bits = block.body.sync_aggregate.sync_committee_bits
+    wanted = set(int(v) for v in validators) if validators else None
+    out = []
+    for vidx, bit in zip(committee, bits):
+        if wanted is not None and int(vidx) not in wanted:
+            continue
+        out.append({
+            "validator_index": str(int(vidx)),
+            "reward": str(participant_reward if bit
+                          else -participant_reward),
+        })
+    return out
+
+
+def _state_for_epoch_rewards(chain, epoch: int):
+    """A state inside epoch+1, whose previous_epoch_participation is the
+    requested epoch's — what the end-of-(epoch+1) processing consumes."""
+    spec = chain.spec
+    target_slot = (int(epoch) + 2) * spec.preset.slots_per_epoch - 1
+    head = chain.head_state
+    if target_slot > int(head.slot):
+        # refusing future/incomplete epochs also bounds the work: a
+        # huge epoch must not slot-walk the request thread for hours
+        raise RewardsError(
+            f"rewards for epoch {epoch} are not final yet")
+    if int(head.slot) >= target_slot:
+        root = chain.block_root_at_slot(target_slot)
+        st = chain.state_for_block(root) if root is not None else None
+        if st is None:
+            st = head
+        if int(st.slot) < target_slot:
+            st = st.copy()
+            state_advance(st, spec, target_slot)
+    if misc.previous_epoch(st, spec) != int(epoch):
+        raise RewardsError(
+            f"epoch {epoch} participation not derivable from head")
+    return st
+
+
+def compute_attestation_rewards(chain, epoch: int,
+                                validators: list | None = None) -> dict:
+    """Per-validator head/target/source/inactivity deltas for `epoch` +
+    the ideal-rewards table (lib.rs:2510, altair+ only).
+
+    Vectorized re-expression of process_rewards_and_penalties with the
+    per-flag components kept separate instead of summed."""
+    spec = chain.spec
+    st = _state_for_epoch_rewards(chain, epoch)
+    fork = chain.spec.fork_at_epoch(int(epoch))
+    if fork == "phase0":
+        raise RewardsError("attestation rewards API is altair+")
+    v = st.validators
+    n = len(v)
+    prev = misc.previous_epoch(st, spec)
+    total = misc.get_total_active_balance(st, spec)
+    brpi = base_reward_per_increment(spec, total)
+    increments = (v.effective_balance
+                  // np.uint64(spec.effective_balance_increment)
+                  ).astype(np.int64)
+    base_rewards = increments * brpi
+    eligible = _eligible_validator_mask(st, spec)
+    active_prev_unslashed = v.is_active(prev) & ~v.slashed
+    leak = is_in_inactivity_leak(st, spec)
+    total_increments = total // spec.effective_balance_increment
+
+    names = {0: "source", 1: "target", 2: "head"}
+    comp = {name: np.zeros(n, dtype=np.int64) for name in names.values()}
+    ideal_comp: dict[str, dict[int, int]] = {
+        name: {} for name in names.values()}
+    max_eb = (spec.max_effective_balance_electra if fork == "electra"
+              else spec.max_effective_balance)
+    max_increments = max_eb // spec.effective_balance_increment
+    tier_increments = np.arange(0, max_increments + 1, dtype=np.int64)
+    tier_base = tier_increments * brpi
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        name = names[flag_index]
+        participated = active_prev_unslashed & has_flag(
+            st.previous_epoch_participation, flag_index)
+        unslashed_bal = int(v.effective_balance[participated].sum())
+        unslashed_increments = max(
+            unslashed_bal, spec.effective_balance_increment
+        ) // spec.effective_balance_increment
+        if not leak:
+            reward_num = base_rewards * weight * unslashed_increments
+            comp[name] += np.where(
+                eligible & participated,
+                reward_num // (total_increments * WEIGHT_DENOMINATOR), 0)
+            ideal = (tier_base * weight * unslashed_increments
+                     // (total_increments * WEIGHT_DENOMINATOR))
+        else:
+            ideal = np.zeros_like(tier_base)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            comp[name] -= np.where(
+                eligible & ~participated,
+                base_rewards * weight // WEIGHT_DENOMINATOR, 0)
+        for i, inc in enumerate(tier_increments):
+            ideal_comp[name][int(inc)] = int(ideal[i])
+
+    # inactivity: penalties for target non-participants
+    target_participant = active_prev_unslashed & has_flag(
+        st.previous_epoch_participation, TIMELY_TARGET_FLAG_INDEX)
+    ipq = _inactivity_penalty_quotient(spec, fork)
+    scores = st.inactivity_scores.astype(object)
+    eff_obj = v.effective_balance.astype(object)
+    penalty = (eff_obj * scores) // (spec.inactivity_score_bias * ipq)
+    inactivity = -np.where(eligible & ~target_participant,
+                           penalty.astype(np.int64), 0)
+
+    if validators:
+        idxs = [int(x) for x in validators]
+        bad = [i for i in idxs if i < 0 or i >= n]
+        if bad:
+            raise ValueError(f"unknown validator index {bad[0]}")
+        rows = idxs                       # explicit ask: every row answered
+    else:
+        rows = [i for i in range(n) if eligible[i]]
+    total_rewards = [{
+        "validator_index": str(i),
+        "head": str(int(comp["head"][i])),
+        "target": str(int(comp["target"][i])),
+        "source": str(int(comp["source"][i])),
+        "inactivity": str(int(inactivity[i])),
+    } for i in rows]
+
+    ideal_rewards = [{
+        "effective_balance": str(int(inc) * spec.effective_balance_increment),
+        "head": str(ideal_comp["head"][int(inc)]),
+        "target": str(ideal_comp["target"][int(inc)]),
+        "source": str(ideal_comp["source"][int(inc)]),
+        "inactivity": "0",
+    } for inc in tier_increments]
+
+    return {"ideal_rewards": ideal_rewards, "total_rewards": total_rewards}
+
+
+# --- validator inclusion (lighthouse analytics) -----------------------------
+
+def _state_at_end_of_epoch(chain, epoch: int):
+    """State at the last slot of `epoch` — validator_inclusion.rs
+    end_of_epoch_state: current epoch IS the requested one, previous_*
+    participation refers to epoch-1."""
+    spec = chain.spec
+    target_slot = (int(epoch) + 1) * spec.preset.slots_per_epoch - 1
+    head = chain.head_state
+    if target_slot > int(head.slot):
+        raise RewardsError(f"epoch {epoch} is not complete yet")
+    root = chain.block_root_at_slot(target_slot)
+    st = chain.state_for_block(root) if root is not None else None
+    if st is None:
+        st = head
+    if int(st.slot) < target_slot:
+        st = st.copy()
+        state_advance(st, spec, target_slot)
+    if misc.current_epoch(st, spec) != int(epoch):
+        raise RewardsError(f"state for epoch {epoch} unavailable")
+    return st
+
+
+def validator_inclusion_global(chain, epoch: int) -> dict:
+    """Epoch-level participation totals
+    (http_api/src/validator_inclusion.rs global route): previous_*
+    fields are the PRIOR epoch's participation, per the reference."""
+    spec = chain.spec
+    st = _state_at_end_of_epoch(chain, epoch)
+    v = st.validators
+    cur = misc.current_epoch(st, spec)
+    prev = misc.previous_epoch(st, spec)
+    active = v.is_active(cur)
+    prev_unslashed = v.is_active(prev) & ~v.slashed
+    eff = v.effective_balance
+    part = st.previous_epoch_participation
+    tgt = prev_unslashed & has_flag(part, TIMELY_TARGET_FLAG_INDEX)
+    head = prev_unslashed & has_flag(part, TIMELY_HEAD_FLAG_INDEX)
+    return {
+        "current_epoch_active_gwei": str(int(eff[active].sum())),
+        "previous_epoch_target_attesting_gwei": str(int(eff[tgt].sum())),
+        "previous_epoch_head_attesting_gwei": str(int(eff[head].sum())),
+    }
+
+
+def validator_inclusion_one(chain, epoch: int, vid: int) -> dict:
+    spec = chain.spec
+    st = _state_at_end_of_epoch(chain, epoch)
+    v = st.validators
+    if vid >= len(v):
+        raise RewardsError(f"unknown validator {vid}")
+    cur = misc.current_epoch(st, spec)
+    prev = misc.previous_epoch(st, spec)
+    part = st.previous_epoch_participation
+    return {
+        "is_slashed": bool(v.slashed[vid]),
+        "is_withdrawable_in_current_epoch":
+            int(v.withdrawable_epoch[vid]) <= cur,
+        "is_active_unslashed_in_current_epoch":
+            bool(v.is_active(cur)[vid]) and not bool(v.slashed[vid]),
+        "is_active_unslashed_in_previous_epoch":
+            bool(v.is_active(prev)[vid]) and not bool(v.slashed[vid]),
+        "current_epoch_effective_balance_gwei":
+            str(int(v.effective_balance[vid])),
+        "is_previous_epoch_source_attester":
+            bool(has_flag(part, 0)[vid]),
+        "is_previous_epoch_target_attester":
+            bool(has_flag(part, TIMELY_TARGET_FLAG_INDEX)[vid]),
+        "is_previous_epoch_head_attester":
+            bool(has_flag(part, TIMELY_HEAD_FLAG_INDEX)[vid]),
+    }
+
+
+# --- block packing efficiency -----------------------------------------------
+
+def block_packing_efficiency(chain, start_epoch: int,
+                             end_epoch: int) -> list:
+    """Per-block packing: how many of the attester-slots available to
+    the proposer made it into the block
+    (http_api/src/block_packing_efficiency.rs).  'Available' is the set
+    of active validators attesting in the inclusion window; 'included'
+    counts distinct (validator, attested-slot) pairs in the block."""
+    spec = chain.spec
+    spe = spec.preset.slots_per_epoch
+    out = []
+    for slot in range(start_epoch * spe, (end_epoch + 1) * spe):
+        root = chain.block_root_at_slot(slot)
+        if root is None:
+            continue
+        blk = chain.store.get_block(root)
+        if blk is None or int(blk.message.slot) != slot:
+            continue          # skipped slot: the root is an ancestor's
+        st = chain.state_for_block(root)
+        if st is None:
+            continue
+        included: set[tuple[int, int]] = set()
+        fork = _fork_at(chain, slot)
+        for att in blk.message.body.attestations:
+            from lighthouse_tpu.state_transition.block_processing import (
+                get_attesting_indices,
+            )
+
+            try:
+                idxs = get_attesting_indices(st, spec, att)
+            except Exception:
+                continue
+            a_slot = int(att.data.slot)
+            included.update((int(i), a_slot) for i in idxs)
+        epoch = spec.compute_epoch_at_slot(slot)
+        n_active = misc.get_active_validator_indices(st, epoch).shape[0]
+        # the proposer could have included up to one epoch of attesting
+        # validators (bounded by what had time to propagate)
+        available = max(1, n_active * min(spe, slot) // spe)
+        out.append({
+            "slot": str(slot),
+            "block_root": "0x" + root.hex(),
+            "proposer_index": str(int(blk.message.proposer_index)),
+            "included_attestations": str(len(included)),
+            "available_attestations": str(available),
+            "efficiency": round(len(included) / available, 6),
+        })
+    return out

@@ -45,6 +45,15 @@ CREATE TABLE IF NOT EXISTS suboptimal_attestations (
     target INTEGER NOT NULL,
     PRIMARY KEY (epoch_start_slot, validator_index)
 );
+CREATE TABLE IF NOT EXISTS validator_rewards (
+    epoch INTEGER NOT NULL,
+    validator_index INTEGER NOT NULL,
+    head INTEGER NOT NULL,
+    target INTEGER NOT NULL,
+    source INTEGER NOT NULL,
+    inactivity INTEGER NOT NULL,
+    PRIMARY KEY (epoch, validator_index)
+);
 CREATE TABLE IF NOT EXISTS validators (
     validator_index INTEGER PRIMARY KEY,
     public_key BLOB NOT NULL,
@@ -96,6 +105,16 @@ class WatchDB:
             self._conn.execute(
                 "INSERT OR REPLACE INTO block_packing VALUES (?,?,?,?)",
                 (slot, available, included, prior_skip_slots))
+            self._conn.commit()
+
+    def insert_validator_rewards(self, epoch: int, validator_index: int,
+                                 head: int, target: int, source: int,
+                                 inactivity: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO validator_rewards VALUES "
+                "(?, ?, ?, ?, ?, ?)",
+                (epoch, validator_index, head, target, source, inactivity))
             self._conn.commit()
 
     def insert_suboptimal_attestation(self, epoch_start_slot: int,
@@ -164,6 +183,18 @@ class WatchDB:
             return None
         return {"available": row[0], "included": row[1],
                 "prior_skip_slots": row[2]}
+
+    def validator_rewards(self, epoch: int,
+                          validator_index: int | None = None) -> list[dict]:
+        q = "SELECT * FROM validator_rewards WHERE epoch = ?"
+        args = [epoch]
+        if validator_index is not None:
+            q += " AND validator_index = ?"
+            args.append(validator_index)
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [dict(zip(("epoch", "validator_index", "head", "target",
+                          "source", "inactivity"), r)) for r in rows]
 
     def suboptimal_attesters(self, epoch_start_slot: int) -> list[dict]:
         rows = self._conn.execute(

@@ -53,13 +53,9 @@ class WatchUpdater:
                 self.db.insert_canonical_slot(slot, root, skipped=False)
                 body = block.message.body
                 atts = list(body.attestations)
-                included = sum(
-                    sum(1 for b in a.aggregation_bits if b) for a in atts)
                 self.db.insert_block(
                     slot, root, bytes(block.message.parent_root), len(atts))
-                self.db.insert_block_packing(
-                    slot, available=included, included=included,
-                    prior_skip_slots=self._prior_skips(slot))
+                self._record_block_rewards(slot, root)
                 payload = getattr(body, "execution_payload", None)
                 self.blockprint.observe(
                     int(block.message.proposer_index),
@@ -71,7 +67,48 @@ class WatchUpdater:
             recorded += 1
             if slot and slot % self.spec.slots_per_epoch == 0:
                 self._record_suboptimal(slot)
+                self._record_epoch_analytics(slot)
         return recorded
+
+    def _record_block_rewards(self, slot: int, root: bytes) -> None:
+        """Standard block rewards for one imported block
+        (consumes /eth/v1/beacon/rewards/blocks)."""
+        try:
+            r = self.client.block_rewards("0x" + root.hex())
+        except ClientError:
+            return
+        self.db.insert_block_rewards(
+            slot, total=int(r["total"]),
+            attestation_reward=int(r["attestations"]),
+            sync_committee_reward=int(r["sync_aggregate"]))
+
+    def _record_epoch_analytics(self, boundary_slot: int) -> None:
+        """At the boundary into epoch E: per-block packing for epoch
+        E-1 (analysis route) and per-validator attestation rewards for
+        epoch E-2 (the last epoch whose rewards are final)."""
+        spe = self.spec.slots_per_epoch
+        epoch = boundary_slot // spe
+        try:
+            for row in self.client.block_packing(epoch - 1, epoch - 1):
+                self.db.insert_block_packing(
+                    int(row["slot"]),
+                    available=int(row["available_attestations"]),
+                    included=int(row["included_attestations"]),
+                    prior_skip_slots=self._prior_skips(int(row["slot"])))
+        except ClientError:
+            pass
+        if epoch < 2:
+            return
+        try:
+            rewards = self.client.attestation_rewards(epoch - 2)
+        except ClientError:
+            return
+        for row in rewards["total_rewards"]:
+            self.db.insert_validator_rewards(
+                epoch - 2, int(row["validator_index"]),
+                head=int(row["head"]), target=int(row["target"]),
+                source=int(row["source"]),
+                inactivity=int(row["inactivity"]))
 
     def _block_at(self, slot: int):
         try:
