@@ -217,7 +217,9 @@ def _state_for_epoch_rewards(chain, epoch: int):
 
 
 def compute_attestation_rewards(chain, epoch: int,
-                                validators: list | None = None) -> dict:
+                                validators: list | None = None,
+                                include_effective_balance: bool = False
+                                ) -> dict:
     """Per-validator head/target/source/inactivity deltas for `epoch` +
     the ideal-rewards table (lib.rs:2510, altair+ only).
 
@@ -301,6 +303,14 @@ def compute_attestation_rewards(chain, epoch: int,
         "source": str(int(comp["source"][i])),
         "inactivity": str(int(inactivity[i])),
     } for i in rows]
+    if include_effective_balance:
+        # internal consumers (validator monitor) key the ideal-rewards
+        # tier off the EB the calc actually used — the replayed state's,
+        # not whatever the head registry says today.  Not part of the
+        # standard API response shape, hence opt-in.
+        for row in total_rewards:
+            row["effective_balance"] = str(
+                int(v.effective_balance[int(row["validator_index"])]))
 
     ideal_rewards = [{
         "effective_balance": str(int(inc) * spec.effective_balance_increment),

@@ -424,6 +424,7 @@ class BeaconChain:
         if head != self.head_root:
             st = self.state_for_block(head)
             if st is not None:
+                old_head_state = self.head_state
                 self.head_root = head
                 self.head_state = st
                 self.store.persist_head(head)
@@ -436,17 +437,24 @@ class BeaconChain:
                 epoch = self.spec.compute_epoch_at_slot(int(st.slot))
                 if epoch > getattr(self, "_monitor_epoch", -1):
                     self._monitor_epoch = epoch
+                    # old_head_state (the last head of the finished
+                    # epoch) carries the FINAL participation flags for
+                    # the epoch before it — see on_epoch_boundary
                     self.validator_monitor.on_epoch_boundary(
-                        epoch, st, self.spec)
-                    # operator digest for the epoch just finished
+                        epoch, st, self.spec, prev_state=old_head_state)
+                    # operator digest for the newest COMPLETE epoch:
+                    # epoch-2's flags and rewards are final here, while
+                    # epoch-1 attestations can still be included
                     # (registered validators only — auto_register at
                     # registry scale would flood the log)
-                    if self.validator_monitor.registered:
+                    if self.validator_monitor.registered and epoch >= 2:
                         from lighthouse_tpu.common.logging import Logger
 
+                        self.validator_monitor.record_rewards(
+                            self, epoch - 2)
                         log = Logger("validator_monitor")
                         for line in self.validator_monitor.log_lines(
-                                epoch - 1):
+                                epoch - 2):
                             log.info(line)
                 self._notify_forkchoice_updated(st)
         if self.fork_choice.finalized.epoch > self._migrated_finalized_epoch:

@@ -29,6 +29,22 @@ class ValidatorEpochSummary:
     # balance tracking at the epoch boundary
     balance_gwei: int = 0
     balance_delta_gwei: int = 0
+    # on-chain participation truth, read from the NEXT epoch's state
+    # (previous_epoch_participation): the reference's per-flag
+    # attestation_{source,target,head}_hit metrics.  None = not yet
+    # finalized into the participation registry
+    source_hit: bool | None = None
+    target_hit: bool | None = None
+    head_hit: bool | None = None
+    # per-flag reward attribution (api/rewards attestation-rewards calc):
+    # actual gwei earned per component + the ideal for this validator's
+    # effective-balance tier (reference validator_monitor.rs
+    # attestations_rewards family)
+    reward_source_gwei: int = 0
+    reward_target_gwei: int = 0
+    reward_head_gwei: int = 0
+    reward_inactivity_gwei: int = 0
+    ideal_reward_gwei: int = 0
 
 
 class ValidatorMonitor:
@@ -40,12 +56,24 @@ class ValidatorMonitor:
         # epoch -> balances snapshot (numpy; presence == recorded, so a
         # legitimate 0 balance still yields a delta)
         self._balances: dict[int, np.ndarray] = {}
+        # epoch -> FINAL participation-flag array for that epoch (numpy;
+        # per-validator flags materialize lazily in epoch_summary) and
+        # the matching duty-eligibility mask (active & unslashed)
+        self._participation: dict[int, np.ndarray] = {}
+        self._part_eligible: dict[int, np.ndarray] = {}
         self._att_hits = REGISTRY.counter(
             "validator_monitor_attestation_hits_total",
             "attestations by monitored validators seen on chain")
         self._blocks = REGISTRY.counter(
             "validator_monitor_blocks_total",
             "blocks proposed by monitored validators")
+        self._att_misses = REGISTRY.counter(
+            "validator_monitor_attestation_misses_total",
+            "epochs where a monitored validator missed the target vote")
+        self._delay_hist = REGISTRY.histogram(
+            "validator_monitor_inclusion_distance_slots",
+            "slots between attestation and its including block",
+            buckets=(1, 2, 3, 4, 8, 16, 32))
 
     def register(self, *indices: int) -> None:
         self.registered.update(int(i) for i in indices)
@@ -80,6 +108,7 @@ class ValidatorMonitor:
             s.attestation_hits += 1
             s.inclusion_delays.append(delay)
             self._att_hits.inc()
+            self._delay_hist.observe(delay)
 
     def on_sync_signature(self, validator: int, slot: int, spec) -> None:
         if self._monitored(validator):
@@ -107,12 +136,93 @@ class ValidatorMonitor:
             epoch = spec.compute_epoch_at_slot(int(slot))
             self._summary(epoch, expected_proposer).blocks_missed += 1
 
-    def on_epoch_boundary(self, epoch: int, state, spec) -> None:
+    def on_epoch_boundary(self, epoch: int, state, spec,
+                          prev_state=None) -> None:
         """Snapshot the balances array (one vectorized copy — this runs
         on the head-update path, a per-validator Python loop at registry
         scale would stall imports).  Per-validator balance/delta fields
-        are filled lazily on read (epoch_summary / log_lines)."""
-        self._balances[int(epoch)] = np.asarray(state.balances).copy()
+        are filled lazily on read (epoch_summary / log_lines).
+
+        Also reads the on-chain participation truth out of
+        previous_epoch_participation (altair+): per-flag hit/miss — the
+        reference's authoritative missed-attestation detection
+        (validator_monitor.rs process_validator_statuses).
+
+        FINALITY: an epoch's flags keep accumulating through the NEXT
+        epoch (late inclusions), so the read must come from a state LATE
+        in the following epoch.  `prev_state` — the head state the chain
+        held just before crossing the boundary, i.e. the last head of
+        the previous epoch — provides exactly that: its
+        previous_epoch_participation is the FINAL record for the epoch
+        before it.  Reading the fresh boundary state instead would mark
+        false misses for every attestation included late.  The epoch the
+        flags belong to is derived from the participation state's own
+        slot, so skipped epochs can never mislabel."""
+        epoch = int(epoch)
+        self._balances[epoch] = np.asarray(state.balances).copy()
+        if not (self.auto_register or self.registered):
+            return
+        part_state = prev_state if prev_state is not None else state
+        part = getattr(part_state, "previous_epoch_participation", None)
+        if part is None:       # phase0 state: no participation registry
+            return
+        part = np.asarray(part).copy()
+        rec_epoch = int(part_state.slot) // spec.slots_per_epoch - 1
+        if rec_epoch < 0:
+            return
+        # only active-unslashed validators had attestation duties in
+        # rec_epoch; zero flags on a pending/exited validator are not
+        # misses (reference process_validator_statuses eligibility)
+        v = part_state.validators
+        eligible = (np.asarray(v.activation_epoch) <= rec_epoch) \
+            & (np.asarray(v.exit_epoch) > rec_epoch) \
+            & ~np.asarray(v.slashed)
+        # keep the raw arrays; flags materialize lazily on read so the
+        # auto_register path stays vectorized at registry scale
+        self._participation[rec_epoch] = part
+        self._part_eligible[rec_epoch] = eligible
+        # eager miss counting for the explicit watch list only (small);
+        # epoch_summary answers for the rest
+        for i in [i for i in self.registered if i < len(part)]:
+            if eligible[i] and not (int(part[i]) & 0b010):  # target unset
+                s = self._summary(rec_epoch, int(i))
+                if s.attestation_misses == 0:
+                    s.attestation_misses += 1
+                    self._att_misses.inc()
+
+    def record_rewards(self, chain, epoch: int) -> None:
+        """Per-validator reward attribution for `epoch` via the same
+        calculator that serves the standard attestation-rewards API
+        (api/rewards.compute_attestation_rewards; reference
+        validator_monitor.rs attestations reward logging).  Called for
+        registered sets only — the calc is vectorized over the whole
+        registry, so cost is one rewards pass per epoch."""
+        if not self.registered:
+            return
+        from lighthouse_tpu.api.rewards import compute_attestation_rewards
+
+        epoch = int(epoch)
+        idxs = sorted(self.registered)
+        try:
+            data = compute_attestation_rewards(
+                chain, epoch, idxs, include_effective_balance=True)
+        except Exception:
+            return                       # pre-altair / state unavailable
+        ideal_by_eb = {int(r["effective_balance"]): r
+                       for r in data.get("ideal_rewards", [])}
+        for row in data.get("total_rewards", []):
+            v = int(row["validator_index"])
+            s = self._summary(epoch, v)
+            s.reward_source_gwei = int(row["source"])
+            s.reward_target_gwei = int(row["target"])
+            s.reward_head_gwei = int(row["head"])
+            s.reward_inactivity_gwei = int(row.get("inactivity", 0))
+            # tier keyed on the EB the calc itself used (replayed state)
+            ideal = ideal_by_eb.get(int(row.get("effective_balance", -1)))
+            if ideal is not None:
+                s.ideal_reward_gwei = (int(ideal["source"])
+                                       + int(ideal["target"])
+                                       + int(ideal["head"]))
 
     def note_misses(self, epoch: int, expected: list[int]) -> None:
         """Called at epoch end with the validators that SHOULD have
@@ -131,17 +241,27 @@ class ValidatorMonitor:
         epoch = int(epoch)
         out = dict(self._epochs.get(epoch, {}))
         bal = self._balances.get(epoch)
-        if bal is not None:
-            prev = self._balances.get(epoch - 1)
-            targets = (range(len(bal)) if self.auto_register
-                       else [i for i in self.registered if i < len(bal)])
-            for v in targets:
-                s = out.get(int(v))
-                if s is None:
-                    s = out[int(v)] = ValidatorEpochSummary()
+        part = self._participation.get(epoch)
+        elig = self._part_eligible.get(epoch)
+        n = max(len(bal) if bal is not None else 0,
+                len(part) if part is not None else 0)
+        targets = (range(n) if self.auto_register
+                   else [i for i in self.registered if i < n])
+        prev = self._balances.get(epoch - 1)
+        for v in targets:
+            s = out.get(int(v))
+            if s is None:
+                s = out[int(v)] = ValidatorEpochSummary()
+            if bal is not None and v < len(bal):
                 s.balance_gwei = int(bal[v])
                 if prev is not None and v < len(prev):
                     s.balance_delta_gwei = int(bal[v]) - int(prev[v])
+            if part is not None and v < len(part) and (
+                    elig is None or (v < len(elig) and elig[v])):
+                bits = int(part[v])
+                s.source_hit = bool(bits & 0b001)   # TIMELY_SOURCE
+                s.target_hit = bool(bits & 0b010)   # TIMELY_TARGET
+                s.head_hit = bool(bits & 0b100)     # TIMELY_HEAD
         return out
 
     def log_lines(self, epoch: int) -> list[str]:
@@ -151,12 +271,24 @@ class ValidatorMonitor:
         for v, s in sorted(self.epoch_summary(epoch).items()):
             delay = (sum(s.inclusion_delays) / len(s.inclusion_delays)
                      if s.inclusion_delays else 0.0)
+            flags = "".join(
+                "-" if hit is None else ("Y" if hit else "n")
+                for hit in (s.source_hit, s.target_hit, s.head_hit))
+            # attestation reward vs its like-for-like ideal; the
+            # inactivity-leak penalty is reported separately (the ideal
+            # table has no inactivity component by construction)
+            reward = (s.reward_source_gwei + s.reward_target_gwei
+                      + s.reward_head_gwei)
+            leak = (f" leak={s.reward_inactivity_gwei}"
+                    if s.reward_inactivity_gwei else "")
             out.append(
                 f"validator {v} epoch {epoch}: "
                 f"att hit={s.attestation_hits} miss={s.attestation_misses} "
+                f"sth={flags} "
                 f"seen={s.attestations_seen} delay={delay:.2f} "
                 f"blocks={s.blocks_proposed} missed={s.blocks_missed} "
                 f"sync={s.sync_signatures} "
+                f"reward={reward:+d}/{s.ideal_reward_gwei}{leak} "
                 f"balance={s.balance_gwei} Δ={s.balance_delta_gwei:+d}")
         return out
 
@@ -165,3 +297,7 @@ class ValidatorMonitor:
             del self._epochs[e]
         for e in [e for e in self._balances if e < epoch - 1]:
             del self._balances[e]
+        for e in [e for e in self._participation if e < epoch - 1]:
+            del self._participation[e]
+        for e in [e for e in self._part_eligible if e < epoch - 1]:
+            del self._part_eligible[e]

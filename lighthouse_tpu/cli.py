@@ -62,6 +62,9 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--trusted-setup", default=None,
                     help="path to the KZG ceremony trusted_setup.json "
                          "(consensus-specs format)")
+    bn.add_argument("--monitoring-endpoint", default=None,
+                    help="remote monitoring service URL to POST "
+                         "node/system metrics to every 60s")
 
     vc = sub.add_parser("vc", help="run a validator client")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -73,6 +76,9 @@ def _build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--interop-range", default=None,
                     help="START:END interop validator indices (dev)")
     vc.add_argument("--run-seconds", type=float, default=None)
+    vc.add_argument("--monitoring-endpoint", default=None,
+                    help="remote monitoring service URL to POST "
+                         "validator/system metrics to every 60s")
 
     am = sub.add_parser("account-manager",
                         help="wallet + validator key tooling")
@@ -188,6 +194,7 @@ def _run_bn(args) -> int:
                          if a.strip()) if args.boot_nodes else (),
         builder_url=args.builder,
         trusted_setup_path=args.trusted_setup,
+        monitoring_endpoint=args.monitoring_endpoint,
     )
     client = ClientBuilder(cfg).build()
     wire = client.services.get("wire")
@@ -246,11 +253,32 @@ def _run_vc(args) -> int:
     rvc = RemoteValidatorClient(bn, store, spec,
                                 builder_blocks=args.builder_blocks)
     rvc.resolve_indices()
+    mon = None
+    mon_next = 0.0
+    mon_thread = None
+    if args.monitoring_endpoint:
+        from lighthouse_tpu.common.system_health import MonitoringHttpClient
+
+        mon = MonitoringHttpClient(args.monitoring_endpoint,
+                                   validator_store=store)
     genesis_time = int(genesis["genesis_time"])
     deadline = time.time() + args.run_seconds if args.run_seconds else None
     last_slot = None
     while deadline is None or time.time() < deadline:
         now = time.time()
+        if mon is not None and now >= mon_next and not (
+                mon_thread is not None and mon_thread.is_alive()):
+            # post off-thread: a dead endpoint's 5s timeout must never
+            # delay slot duties (the bn path gets this from the
+            # executor).  Runs pre-genesis too — operators want the VC
+            # visible while it waits.
+            import threading as _threading
+
+            mon_thread = _threading.Thread(
+                target=mon.send_metrics, args=(("validator", "system"),),
+                daemon=True)
+            mon_thread.start()
+            mon_next = now + mon.update_period_s
         if now < genesis_time:
             # pre-genesis: wait without consuming slot 0, so slot-0
             # duties run when genesis actually arrives

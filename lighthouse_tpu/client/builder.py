@@ -60,6 +60,9 @@ class ClientConfig:
     # format) for deneb blob verification; None = no KZG (dev networks
     # can run pre-deneb or pass a dev setup programmatically)
     trusted_setup_path: str | None = None
+    # remote monitoring service URL; None = disabled (reference
+    # --monitoring-endpoint, common/monitoring_api/src/lib.rs:51)
+    monitoring_endpoint: str | None = None
 
 
 @dataclass
@@ -360,6 +363,23 @@ class ClientBuilder:
 
         self.executor.spawn_periodic(
             notify, self.spec.seconds_per_slot, "notifier")
+
+        if self.config.monitoring_endpoint:
+            from lighthouse_tpu.common.system_health import (
+                MonitoringHttpClient,
+            )
+
+            mon = MonitoringHttpClient(
+                self.config.monitoring_endpoint,
+                chain=self.chain,
+                store=getattr(self.chain, "store", None),
+                network=getattr(client.network, "peer_manager", None),
+                eth1=self.chain.eth1_service,
+                datadir=self.config.datadir or "/")
+            mon.auto_update(self.executor, ("beaconnode", "system"))
+            client.services["monitoring"] = mon
+            self.log.info("remote monitoring enabled",
+                          endpoint=self.config.monitoring_endpoint)
         return client
 
     def _wire_network(self, client: Client) -> None:

@@ -2,7 +2,8 @@
 
 Rebuild of /root/reference/common/system_health (host stats served by the
 HTTP API's lighthouse routes) and /root/reference/common/monitoring_api
-(periodic POST of node/system metrics to a remote monitoring service).
+(periodic POST of node/validator/system metrics to a remote monitoring
+service, lib.rs:51-120, types.rs:1-190, gather.rs:58-120).
 Linux-native: reads /proc directly instead of shelling out.
 """
 
@@ -10,9 +11,43 @@ from __future__ import annotations
 
 import json
 import os
+import platform as _platform
 import time
+import urllib.error
 import urllib.request
-from dataclasses import asdict, dataclass
+from dataclasses import dataclass, field
+
+MONITORING_VERSION = 1           # types.rs:6 VERSION
+CLIENT_NAME = "lighthouse_tpu"   # types.rs:7 CLIENT_NAME
+DEFAULT_UPDATE_PERIOD_S = 60     # lib.rs:19 DEFAULT_UPDATE_DURATION
+POST_TIMEOUT_S = 5               # lib.rs:21 TIMEOUT_DURATION
+
+
+@dataclass
+class ProcessHealth:
+    """This process's own cpu/memory (reference eth2::lighthouse
+    ProcessHealth, feeding types.rs ProcessMetrics)."""
+
+    pid: int
+    cpu_process_seconds_total: float
+    memory_process_bytes: int
+
+
+def observe_process_health() -> ProcessHealth:
+    cpu_s = 0.0
+    rss = 0
+    try:
+        with open("/proc/self/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        hz = os.sysconf("SC_CLK_TCK") or 100
+        # fields 14/15 (utime/stime) land at rsplit indices 11/12
+        cpu_s = (int(parts[11]) + int(parts[12])) / hz
+        rss = int(parts[21]) * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, IndexError, ValueError):
+        pass
+    return ProcessHealth(pid=os.getpid(),
+                         cpu_process_seconds_total=cpu_s,
+                         memory_process_bytes=rss)
 
 
 @dataclass
@@ -27,6 +62,95 @@ class SystemHealth:
     disk_total_kb: int
     disk_free_kb: int
     uptime_s: float
+    # -- extended counters for the remote monitoring export
+    # (reference types.rs SystemMetrics); defaulted so older callers
+    # constructing the dataclass directly keep working
+    cpu_node_user_seconds_total: int = 0
+    cpu_node_system_seconds_total: int = 0
+    cpu_node_iowait_seconds_total: int = 0
+    cpu_node_idle_seconds_total: int = 0
+    memory_cached_kb: int = 0
+    memory_buffers_kb: int = 0
+    disk_reads_total: int = 0
+    disk_writes_total: int = 0
+    network_rx_bytes_total: int = 0
+    network_tx_bytes_total: int = 0
+    boot_ts_seconds: int = 0
+    os_name: str = field(default_factory=lambda: _platform.system().lower())
+
+
+def _read_proc_stat_cpu() -> tuple[int, int, int, int]:
+    """(user, system, iowait, idle) seconds from /proc/stat's cpu line."""
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu "):
+                    v = [int(x) for x in line.split()[1:]]
+                    hz = os.sysconf("SC_CLK_TCK") or 100
+                    user = (v[0] + v[1]) // hz       # user+nice
+                    system = v[2] // hz
+                    idle = v[3] // hz
+                    iowait = (v[4] if len(v) > 4 else 0) // hz
+                    return user, system, iowait, idle
+    except (OSError, ValueError):
+        pass
+    return 0, 0, 0, 0
+
+
+_PARTITION_RE = None
+
+
+def _is_partition(name: str) -> bool:
+    """Partition (vs whole-disk) device name: sda1, vdb2, nvme0n1p3,
+    mmcblk0p1 — but NOT mmcblk0, md0, nbd0, nvme0n1, which are whole
+    devices whose names merely end in a digit."""
+    global _PARTITION_RE
+    if _PARTITION_RE is None:
+        import re
+
+        _PARTITION_RE = re.compile(
+            r"^(?:(?:s|h|v|xv)d[a-z]+\d+"        # sda1 / vdb2 / xvda1
+            r"|nvme\d+n\d+p\d+"                  # nvme0n1p3
+            r"|mmcblk\d+p\d+)$")                 # mmcblk0p1
+    return _PARTITION_RE.match(name) is not None
+
+
+def _read_diskstats() -> tuple[int, int]:
+    """Total (reads, writes) completed across whole-disk devices."""
+    reads = writes = 0
+    try:
+        with open("/proc/diskstats") as f:
+            for line in f:
+                p = line.split()
+                if len(p) < 10:
+                    continue
+                name = p[2]
+                if name.startswith(("loop", "ram", "dm-")):
+                    continue
+                if _is_partition(name):
+                    continue
+                reads += int(p[3])
+                writes += int(p[7])
+    except (OSError, ValueError, IndexError):
+        pass
+    return reads, writes
+
+
+def _read_net_dev() -> tuple[int, int]:
+    """Total (rx, tx) bytes across non-loopback interfaces."""
+    rx = tx = 0
+    try:
+        with open("/proc/net/dev") as f:
+            for line in f.readlines()[2:]:
+                name, _, rest = line.partition(":")
+                if name.strip() == "lo":
+                    continue
+                v = rest.split()
+                rx += int(v[0])
+                tx += int(v[8])
+    except (OSError, ValueError, IndexError):
+        pass
+    return rx, tx
 
 
 def observe_system_health(datadir: str = "/") -> SystemHealth:
@@ -55,54 +179,212 @@ def observe_system_health(datadir: str = "/") -> SystemHealth:
             uptime = float(f.read().split()[0])
     except OSError:
         uptime = 0.0
+    user, system, iowait, idle = _read_proc_stat_cpu()
+    reads, writes = _read_diskstats()
+    rx, tx = _read_net_dev()
     return SystemHealth(
         total_memory_kb=total, free_memory_kb=free,
         used_memory_kb=max(0, total - free),
         load_avg_1m=la1, load_avg_5m=la5, load_avg_15m=la15,
         cpu_cores=os.cpu_count() or 1,
         disk_total_kb=disk_total, disk_free_kb=disk_free,
-        uptime_s=uptime)
+        uptime_s=uptime,
+        cpu_node_user_seconds_total=user,
+        cpu_node_system_seconds_total=system,
+        cpu_node_iowait_seconds_total=iowait,
+        cpu_node_idle_seconds_total=idle,
+        memory_cached_kb=mem.get("Cached", 0),
+        memory_buffers_kb=mem.get("Buffers", 0),
+        disk_reads_total=reads, disk_writes_total=writes,
+        network_rx_bytes_total=rx, network_tx_bytes_total=tx,
+        boot_ts_seconds=int(time.time() - uptime),
+    )
 
 
-class MonitoringService:
-    """Posts {beacon_node, system} stats to a remote monitoring endpoint
-    on a cadence (reference monitoring_api/src/lib.rs): degradable — a
-    dead endpoint never affects the node."""
+def _client_version() -> str:
+    try:
+        from lighthouse_tpu import __version__
+        return __version__
+    except Exception:
+        return "0.0.0"
 
-    def __init__(self, endpoint: str, chain=None, datadir: str = "/",
-                 timeout: float = 5.0):
+
+def _process_metrics() -> dict:
+    """Reference types.rs ProcessMetrics (flattened into each payload)."""
+    h = observe_process_health()
+    return {
+        "cpu_process_seconds_total": int(h.cpu_process_seconds_total),
+        "memory_process_bytes": h.memory_process_bytes,
+        "client_name": CLIENT_NAME,
+        "client_version": _client_version(),
+        "client_build": 0,
+    }
+
+
+def _system_metrics(datadir: str = "/") -> dict:
+    """Reference types.rs SystemMetrics with its exact JSON keys."""
+    h = observe_system_health(datadir)
+    return {
+        "cpu_cores": h.cpu_cores,
+        "cpu_threads": h.cpu_cores,
+        "cpu_node_system_seconds_total": h.cpu_node_system_seconds_total,
+        "cpu_node_user_seconds_total": h.cpu_node_user_seconds_total,
+        "cpu_node_iowait_seconds_total": h.cpu_node_iowait_seconds_total,
+        "cpu_node_idle_seconds_total": h.cpu_node_idle_seconds_total,
+        "memory_node_bytes_total": h.total_memory_kb * 1024,
+        "memory_node_bytes_free": h.free_memory_kb * 1024,
+        "memory_node_bytes_cached": h.memory_cached_kb * 1024,
+        "memory_node_bytes_buffers": h.memory_buffers_kb * 1024,
+        "disk_node_bytes_total": h.disk_total_kb * 1024,
+        "disk_node_bytes_free": h.disk_free_kb * 1024,
+        "disk_node_io_seconds": 0,
+        "disk_node_reads_total": h.disk_reads_total,
+        "disk_node_writes_total": h.disk_writes_total,
+        "network_node_bytes_total_receive": h.network_rx_bytes_total,
+        "network_node_bytes_total_transmit": h.network_tx_bytes_total,
+        "misc_node_boot_ts_seconds": h.boot_ts_seconds,
+        "misc_os": (h.os_name or "unk")[:3],
+    }
+
+
+def _metadata(process: str) -> dict:
+    """Reference types.rs Metadata, serde-flattened."""
+    return {
+        "version": MONITORING_VERSION,
+        "timestamp": int(time.time() * 1000),
+        "process": process,
+    }
+
+
+class MonitoringHttpClient:
+    """Reference-shaped remote monitoring poster
+    (monitoring_api/src/lib.rs:63-200): collects beaconnode / validator /
+    system payloads and POSTs them as one JSON list on a cadence.
+    Degradable — a dead endpoint never affects the node."""
+
+    def __init__(self, endpoint: str, chain=None, store=None,
+                 network=None, validator_store=None, eth1=None,
+                 datadir: str = "/", timeout: float = POST_TIMEOUT_S,
+                 update_period_s: float = DEFAULT_UPDATE_PERIOD_S):
         self.endpoint = endpoint
         self.chain = chain
+        self.store = store
+        self.network = network
+        self.validator_store = validator_store
+        self.eth1 = eth1
         self.datadir = datadir
         self.timeout = timeout
+        self.update_period_s = update_period_s
         self.last_post_ok: bool | None = None
+        self.last_error: str | None = None
+        self.posts_total = 0
 
-    def build_payload(self) -> dict:
-        payload = {
-            "ts": time.time(),
-            "system": asdict(observe_system_health(self.datadir)),
-        }
+    # -- gather (reference gather.rs) -----------------------------------
+
+    def beacon_metrics(self) -> dict:
+        m = dict(_metadata("beaconnode"))
+        m.update(_process_metrics())
+        # gather.rs BEACON_PROCESS_METRICS json keys
+        db_bytes = 0
+        if self.store is not None:
+            try:
+                db_bytes = int(self.store.disk_size_bytes())
+            except Exception:
+                db_bytes = 0
+        peers = 0
+        if self.network is not None:
+            try:
+                peers = len(self.network.connected_peers())
+            except Exception:
+                pass
+        m.update({
+            "disk_beaconchain_bytes_total": db_bytes,
+            "network_peers_connected": peers,
+            "sync_eth1_connected": bool(self.eth1 is not None),
+            "sync_eth1_fallback_configured": False,
+            "sync_eth1_fallback_connected": False,
+        })
         if self.chain is not None:
-            c = self.chain
-            payload["beacon_node"] = {
-                "head_slot": int(c.head_state.slot),
-                "current_slot": c.current_slot(),
-                "finalized_epoch": int(c.finalized_checkpoint().epoch),
-                "validators": len(c.head_state.validators),
-            }
-        return payload
+            try:
+                m["sync_beacon_head_slot"] = int(self.chain.head_state.slot)
+                m["beacon_finalized_epoch"] = int(
+                    self.chain.finalized_checkpoint().epoch)
+                m["beacon_validator_count"] = len(
+                    self.chain.head_state.validators)
+            except Exception:
+                pass
+        return m
 
-    def post_once(self) -> bool:
-        body = json.dumps(self.build_payload()).encode()
+    def validator_metrics(self) -> dict:
+        m = dict(_metadata("validator"))
+        m.update(_process_metrics())
+        total = active = 0
+        if self.validator_store is not None:
+            try:
+                total = len(self.validator_store.voting_pubkeys())
+                active = total
+            except Exception:
+                pass
+        # gather.rs VALIDATOR_PROCESS_METRICS json keys
+        m.update({"vc_validators_enabled_count": active,
+                  "vc_validators_total_count": total})
+        return m
+
+    def system_metrics(self) -> dict:
+        m = dict(_metadata("system"))
+        m.update(_system_metrics(self.datadir))
+        return m
+
+    # -- post (reference lib.rs send_metrics/post) ----------------------
+
+    def collect(self, processes: tuple = ("beaconnode", "system")) -> list:
+        out = []
+        for p in processes:
+            try:
+                if p == "beaconnode":
+                    out.append(self.beacon_metrics())
+                elif p == "validator":
+                    out.append(self.validator_metrics())
+                elif p == "system":
+                    out.append(self.system_metrics())
+            except Exception as e:      # gather failure skips that process
+                self.last_error = f"gather {p}: {e}"
+        return out
+
+    def send_metrics(self, processes: tuple = ("beaconnode", "system")
+                     ) -> bool:
+        body = json.dumps(self.collect(processes)).encode()
         req = urllib.request.Request(
             self.endpoint, data=body,
             headers={"Content-Type": "application/json"}, method="POST")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 self.last_post_ok = 200 <= resp.status < 300
-        except OSError:
+                self.last_error = None
+        except urllib.error.HTTPError as e:
+            # parse the server's ErrorMessage body when it has one
+            # (lib.rs ok_or_error)
             self.last_post_ok = False
-        return self.last_post_ok
+            try:
+                msg = json.loads(e.read() or b"{}")
+                self.last_error = f"{e.code}: {msg.get('message', '')}"
+            except Exception:
+                self.last_error = f"status {e.code}"
+        except OSError as e:
+            self.last_post_ok = False
+            self.last_error = str(e)
+        self.posts_total += 1
+        return bool(self.last_post_ok)
+
+    def auto_update(self, executor,
+                    processes: tuple = ("beaconnode", "system")) -> None:
+        """Spawn the periodic poster on the node's task executor
+        (lib.rs auto_update: initial delay then fixed cadence)."""
+        executor.spawn_periodic(
+            lambda: self.send_metrics(processes),
+            self.update_period_s, "monitoring_api")
 
 
-__all__ = ["MonitoringService", "SystemHealth", "observe_system_health"]
+__all__ = ["MonitoringHttpClient", "ProcessHealth",
+           "SystemHealth", "observe_process_health",
+           "observe_system_health"]

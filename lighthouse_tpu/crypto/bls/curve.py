@@ -303,9 +303,38 @@ def g1_to_bytes(p) -> bytes:
     return bytes([raw[0] | flags]) + raw[1:]
 
 
+_NATIVE = None
+
+
+def _native():
+    """Native decompression module (ops/native_bls), resolved once.
+    False when the C++ build is unavailable — callers keep the python
+    path (identical semantics, differentially tested)."""
+    global _NATIVE
+    if _NATIVE is None:
+        try:
+            from lighthouse_tpu.ops import native_bls
+
+            _NATIVE = native_bls if native_bls.available() else False
+        except Exception:
+            _NATIVE = False
+    return _NATIVE
+
+
 def g1_from_bytes(data: bytes, *, subgroup_check: bool = True):
     if len(data) != 48:
         raise ValueError("G1 compressed point must be 48 bytes")
+    nb = _native()
+    if nb:
+        res = nb.g1_decompress(data)
+        if res is None:
+            raise ValueError("invalid G1 compressed point")
+        if res == nb.G1_INF:
+            return INF
+        pt = res
+        if subgroup_check and not g1_in_subgroup(pt):
+            raise ValueError("G1 point not in subgroup")
+        return pt
     flags = data[0]
     if not flags & 0x80:
         raise ValueError("uncompressed G1 not supported")
@@ -341,6 +370,18 @@ def g2_to_bytes(p) -> bytes:
 def g2_from_bytes(data: bytes, *, subgroup_check: bool = True):
     if len(data) != 96:
         raise ValueError("G2 compressed point must be 96 bytes")
+    nb = _native()
+    if nb:
+        res = nb.g2_decompress(data)
+        if res is None:
+            raise ValueError("invalid G2 compressed point")
+        if res == nb.G2_INF:
+            return INF
+        (xa, xb), (ya, yb) = res
+        pt = (Fq2(xa, xb), Fq2(ya, yb))
+        if subgroup_check and not g2_in_subgroup_fast(pt):
+            raise ValueError("G2 point not in subgroup")
+        return pt
     flags = data[0]
     if not flags & 0x80:
         raise ValueError("uncompressed G2 not supported")

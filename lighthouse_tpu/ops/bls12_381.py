@@ -738,4 +738,10 @@ def multi_pairing_device(pairs) -> "object":
     fn = _miller_reduce_jit(padded)
     f = fn(*[jnp.asarray(c) for c in cols], jnp.asarray(mask))
     f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
+    try:
+        from lighthouse_tpu.ops import native_bls
+        if native_bls.available():
+            return native_bls.final_exp(f_host)
+    except Exception:
+        pass
     return final_exponentiation_fast(f_host)

@@ -340,13 +340,25 @@ def _use_device_final_exp() -> bool:
 
 
 def _final_exp_is_one(f_host) -> bool:
-    """Full final exponentiation of the batch product, result == 1?"""
+    """Full final exponentiation of the batch product, result == 1?
+
+    Path order (round-4 TPU ledger, BLS_LEDGER_TPU_r04.json): native C++
+    (~ms) > host python (~32 ms) > device single-lane ladder (measured
+    1.9 s on the v5e — one lane through a 315-step sequential scan keeps
+    the device idle; it only made sense before the native layer)."""
     from lighthouse_tpu.crypto.bls.fields import (
         Fq12,
         final_exp_easy,
         final_exponentiation_fast,
     )
 
+    try:
+        from lighthouse_tpu.ops import native_bls
+
+        if native_bls.available():
+            return native_bls.final_exp_is_one(f_host)
+    except Exception:
+        pass
     if not _use_device_final_exp():
         return final_exponentiation_fast(f_host).is_one()
     m = final_exp_easy(f_host)        # one host inversion (~µs, ext-gcd)

@@ -1,0 +1,183 @@
+"""ctypes bindings for the native host BLS helpers (native/bls_host.cc).
+
+Covers the two host stages the round-4 TPU ledger showed dominating
+batch verification (BLS_LEDGER_TPU_r04.json): G1/G2 point decompression
+(pure-python Fq2 sqrt ≈ ms/point) and the final exponentiation (~32 ms
+python, ~2 s as a single-lane device ladder).  The reference gets both
+from blst (crypto/bls/src/impls/blst.rs:37-119).
+
+Degradable: if g++ or the build is unavailable, `available()` returns
+False and callers keep the pure-python path.  All verdicts are
+differential-tested against the python oracle (tests/test_native_bls.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_lib = None
+_lib_err: str | None = None
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("LHTPU_NATIVE_BLS", "1").lower() in ("0", "false"):
+            _lib_err = "disabled via LHTPU_NATIVE_BLS=0"
+            return None
+        try:
+            from lighthouse_tpu.native import build_shared_lib
+
+            path = build_shared_lib("bls_host.cc")
+            lib = ctypes.CDLL(str(path))
+        except Exception as e:          # missing toolchain, bad build...
+            _lib_err = str(e)
+            return None
+        lib.lhbls_init.restype = ctypes.c_int
+        lib.lhbls_g1_decompress.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_char_p]
+        lib.lhbls_g1_decompress.restype = ctypes.c_int
+        lib.lhbls_g2_decompress.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_char_p]
+        lib.lhbls_g2_decompress.restype = ctypes.c_int
+        lib.lhbls_g2_decompress_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int8)]
+        lib.lhbls_g2_decompress_batch.restype = ctypes.c_long
+        lib.lhbls_g1_decompress_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int8)]
+        lib.lhbls_g1_decompress_batch.restype = ctypes.c_long
+        lib.lhbls_final_exp.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.lhbls_final_exp.restype = ctypes.c_int
+        lib.lhbls_final_exp_is_one.argtypes = [ctypes.c_char_p]
+        lib.lhbls_final_exp_is_one.restype = ctypes.c_int
+        lib.lhbls_init()
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _lib_err
+
+
+# -- decompression -----------------------------------------------------------
+# Return values mirror crypto/bls/curve.py: INF sentinel for the infinity
+# encoding, ValueError (caller-raised) for invalid points.
+
+G1_INF = "inf"          # sentinel strings keep this module import-light
+G2_INF = "inf"
+
+
+def g1_decompress(data: bytes):
+    """48-byte compressed G1 -> (x, y) ints, "inf", or None (invalid)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(96)
+    r = lib.lhbls_g1_decompress(bytes(data), out)
+    if r < 0:
+        return None
+    if r == 1:
+        return G1_INF
+    raw = out.raw
+    return (int.from_bytes(raw[:48], "big"),
+            int.from_bytes(raw[48:], "big"))
+
+
+def g2_decompress(data: bytes):
+    """96-byte compressed G2 -> ((x.a, x.b), (y.a, y.b)) ints, "inf",
+    or None (invalid)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(192)
+    r = lib.lhbls_g2_decompress(bytes(data), out)
+    if r < 0:
+        return None
+    if r == 1:
+        return G2_INF
+    raw = out.raw
+    return ((int.from_bytes(raw[0:48], "big"),
+             int.from_bytes(raw[48:96], "big")),
+            (int.from_bytes(raw[96:144], "big"),
+             int.from_bytes(raw[144:192], "big")))
+
+
+def g2_decompress_batch(blobs: list[bytes]):
+    """Batched G2 decompression: list of results as in g2_decompress."""
+    lib = _load()
+    n = len(blobs)
+    if n == 0:
+        return []
+    inp = b"".join(bytes(b) for b in blobs)
+    out = ctypes.create_string_buffer(192 * n)
+    st = (ctypes.c_int8 * n)()
+    lib.lhbls_g2_decompress_batch(inp, n, out, st)
+    raw = out.raw
+    res = []
+    for i in range(n):
+        if st[i] < 0:
+            res.append(None)
+        elif st[i] == 1:
+            res.append(G2_INF)
+        else:
+            o = raw[i * 192:(i + 1) * 192]
+            res.append(((int.from_bytes(o[0:48], "big"),
+                         int.from_bytes(o[48:96], "big")),
+                        (int.from_bytes(o[96:144], "big"),
+                         int.from_bytes(o[144:192], "big"))))
+    return res
+
+
+# -- final exponentiation ----------------------------------------------------
+
+def _fq12_bytes(f) -> bytes:
+    out = []
+    for c6 in (f.c0, f.c1):
+        for c2 in (c6.c0, c6.c1, c6.c2):
+            out.append(c2.a.to_bytes(48, "big"))
+            out.append(c2.b.to_bytes(48, "big"))
+    return b"".join(out)
+
+
+def final_exp(f):
+    """Cubed final exponentiation of a python Fq12, as python Fq12
+    (identical verdict semantics to fields.final_exponentiation_fast)."""
+    from lighthouse_tpu.crypto.bls.fields import Fq2, Fq6, Fq12
+
+    lib = _load()
+    out = ctypes.create_string_buffer(576)
+    r = lib.lhbls_final_exp(_fq12_bytes(f), out)
+    if r != 0:
+        raise ValueError("non-canonical Fq12 input")
+    raw = out.raw
+    vals = [int.from_bytes(raw[i * 48:(i + 1) * 48], "big")
+            for i in range(12)]
+
+    def fq6(k):
+        return Fq6(Fq2(vals[k], vals[k + 1]), Fq2(vals[k + 2], vals[k + 3]),
+                   Fq2(vals[k + 4], vals[k + 5]))
+
+    return Fq12(fq6(0), fq6(6))
+
+
+def final_exp_is_one(f) -> bool:
+    """final_exp(f) == 1, without the device round trip or python tail."""
+    lib = _load()
+    r = lib.lhbls_final_exp_is_one(_fq12_bytes(f))
+    if r < 0:
+        raise ValueError("non-canonical Fq12 input")
+    return bool(r)
+
+
+__all__ = ["available", "build_error", "final_exp", "final_exp_is_one",
+           "g1_decompress", "g2_decompress", "g2_decompress_batch"]

@@ -91,6 +91,13 @@ class HotColdDB:
         self._init_schema()
         self.split_slot = self._load_split()
 
+    def disk_size_bytes(self) -> int:
+        """Hot+cold on-disk footprint (reference store_disk_db_size)."""
+        n = self.hot.disk_size_bytes()
+        if self.cold is not self.hot:
+            n += self.cold.disk_size_bytes()
+        return n
+
     # -- schema / metadata -------------------------------------------------
 
     def _init_schema(self):

@@ -50,6 +50,11 @@ class KeyValueStore:
     def close(self) -> None:
         pass
 
+    def disk_size_bytes(self) -> int:
+        """On-disk footprint (reference store_disk_db_size metric,
+        exported by the remote monitoring poster); 0 when ephemeral."""
+        return 0
+
     def __len__(self) -> int:
         raise NotImplementedError
 
@@ -145,6 +150,7 @@ class NativeKVStore(KeyValueStore):
     """Persistent store over the C++ log engine."""
 
     def __init__(self, path: str, sync: bool = False):
+        self.path = str(path)
         self._lib = _load_native()
         self._h = self._lib.kv_open(str(path).encode())
         if not self._h:
@@ -152,6 +158,17 @@ class NativeKVStore(KeyValueStore):
         if sync:
             # fdatasync every COMMIT: committed batches survive power loss
             self._lib.kv_set_sync(self._h, 1)
+
+    def disk_size_bytes(self) -> int:
+        import os as _os
+        try:
+            if _os.path.isdir(self.path):
+                return sum(
+                    _os.path.getsize(_os.path.join(r, f))
+                    for r, _, fs in _os.walk(self.path) for f in fs)
+            return _os.path.getsize(self.path)
+        except OSError:
+            return 0
 
     def get(self, key):
         n = ctypes.c_size_t(0)

@@ -262,3 +262,80 @@ class TestValidatorMonitorDepth:
                      for x in chain.validator_monitor.epoch_summary(
                          0).values())
         assert missed == 2  # slots 2 and 3
+
+    def test_participation_flags_detect_missed_attestation(self):
+        """on_epoch_boundary reads the FINAL participation flags from
+        the last head state of the finished epoch (prev_state): set
+        flags → per-flag hits; cleared target → an authoritative miss
+        (reference validator_monitor.rs process_validator_statuses).
+        The flags belong to current_epoch(prev_state) - 1."""
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        spe = h.spec.slots_per_epoch
+        prev = h.state.copy()
+        prev.slot = 3 * spe - 1      # last slot of epoch 2: its
+        part = np.asarray(prev.previous_epoch_participation).copy()
+        part[2] = 0b111              # previous participation = epoch 1
+        part[5] = 0b001              # source only: target missed
+        prev.previous_epoch_participation = part
+        cur = h.state.copy()
+        cur.slot = 3 * spe           # boundary head of epoch 3
+        vm = ValidatorMonitor()
+        vm.register(2, 5)
+        vm.on_epoch_boundary(3, cur, h.spec, prev_state=prev)
+        s2 = vm.epoch_summary(1)[2]
+        assert (s2.source_hit, s2.target_hit, s2.head_hit) == (
+            True, True, True)
+        assert s2.attestation_misses == 0
+        s5 = vm.epoch_summary(1)[5]
+        assert s5.target_hit is False and s5.source_hit is True
+        assert s5.attestation_misses == 1
+        line = [ln for ln in vm.log_lines(1) if "validator 5 " in ln][0]
+        assert "sth=Yn" in line
+
+    def test_inactive_validator_not_marked_missed(self):
+        """A registered validator with no duty in the epoch (pending
+        activation or exited) has zero flags but must NOT count as a
+        miss, and its flags stay None (eligibility filter)."""
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        spe = h.spec.slots_per_epoch
+        prev = h.state.copy()
+        prev.slot = 3 * spe - 1
+        prev.validators.activation_epoch[6] = 10    # pending in epoch 1
+        prev.validators.exit_epoch[7] = 1           # exited before 1
+        vm = ValidatorMonitor()
+        vm.register(6, 7)
+        vm.on_epoch_boundary(3, h.state.copy(), h.spec, prev_state=prev)
+        for v in (6, 7):
+            s = vm.epoch_summary(1).get(v)
+            assert s is None or (s.attestation_misses == 0
+                                 and s.target_hit is None), v
+
+    def test_reward_attribution_from_rewards_calc(self):
+        """record_rewards fills per-flag gwei + the ideal for the
+        validator's EB tier from the attestation-rewards calculator."""
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=16, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        chain.validator_monitor.register(1, 4)
+        # advance two epochs with full-participation blocks so epoch 0
+        # has attestations on chain
+        spe = h.spec.slots_per_epoch
+        for s in range(1, 2 * spe + 1):
+            chain.slot_clock.set_slot(s)
+            atts = [h.attest(slot=s - 1)] if s > 1 else []
+            signed = h.produce_block(slot=s, attestations=atts)
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            chain.process_block(signed)
+        chain.validator_monitor.record_rewards(chain, 0)
+        s = chain.validator_monitor.epoch_summary(0)[1]
+        total = (s.reward_source_gwei + s.reward_target_gwei
+                 + s.reward_head_gwei)
+        assert total > 0, "full participation must earn positive rewards"
+        assert s.ideal_reward_gwei >= total

@@ -5,9 +5,35 @@ import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 from lighthouse_tpu.common.system_health import (
-    MonitoringService,
+    MonitoringHttpClient,
+    observe_process_health,
     observe_system_health,
 )
+
+
+def _capture_server(received, status=200):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            if status >= 400:
+                body = json.dumps({"code": status,
+                                   "message": "nope"}).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
 
 
 class TestSystemHealth:
@@ -22,32 +48,98 @@ class TestSystemHealth:
 class TestMonitoring:
     def test_post_roundtrip(self):
         received = []
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                received.append(json.loads(self.rfile.read(n)))
-                self.send_response(200)
-                self.send_header("Content-Length", "0")
-                self.end_headers()
-
-        srv = HTTPServer(("127.0.0.1", 0), Handler)
-        t = threading.Thread(target=srv.serve_forever, daemon=True)
-        t.start()
+        srv = _capture_server(received)
         try:
-            mon = MonitoringService(
+            mon = MonitoringHttpClient(
                 f"http://127.0.0.1:{srv.server_port}/metrics")
-            assert mon.post_once()
+            assert mon.send_metrics(("system",))
             assert mon.last_post_ok
-            assert received[0]["system"]["cpu_cores"] >= 1
+            assert received[0][0]["cpu_cores"] >= 1
         finally:
             srv.shutdown()
             srv.server_close()
 
     def test_dead_endpoint_degrades(self):
-        mon = MonitoringService("http://127.0.0.1:1/metrics", timeout=0.2)
-        assert not mon.post_once()
+        mon = MonitoringHttpClient("http://127.0.0.1:1/metrics",
+                                   timeout=0.2)
+        assert not mon.send_metrics(("system",))
         assert mon.last_post_ok is False
+        assert mon.last_error
+
+
+class TestMonitoringHttpClient:
+    """Reference-shaped poster (monitoring_api/src/lib.rs:51-200)."""
+
+    def test_payload_shape_matches_reference(self):
+        received = []
+        srv = _capture_server(received)
+        try:
+            mon = MonitoringHttpClient(
+                f"http://127.0.0.1:{srv.server_port}/metrics")
+            assert mon.send_metrics(("beaconnode", "system"))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        # one POST, a JSON LIST of MonitoringMetrics with flattened
+        # metadata (types.rs Metadata: version/timestamp/process)
+        (body,) = received
+        assert isinstance(body, list) and len(body) == 2
+        beacon, system = body
+        assert beacon["process"] == "beaconnode"
+        assert beacon["version"] == 1
+        assert beacon["timestamp"] > 1_600_000_000_000   # ms epoch
+        # ProcessMetrics keys (types.rs:63-70)
+        for k in ("cpu_process_seconds_total", "memory_process_bytes",
+                  "client_name", "client_version", "client_build"):
+            assert k in beacon, k
+        # gather.rs BEACON_PROCESS_METRICS json keys
+        for k in ("disk_beaconchain_bytes_total", "network_peers_connected",
+                  "sync_eth1_connected"):
+            assert k in beacon, k
+        assert system["process"] == "system"
+        # SystemMetrics keys (types.rs:86-112)
+        for k in ("cpu_cores", "cpu_node_user_seconds_total",
+                  "memory_node_bytes_total", "disk_node_bytes_total",
+                  "network_node_bytes_total_receive",
+                  "misc_node_boot_ts_seconds", "misc_os"):
+            assert k in system, k
+        assert len(system["misc_os"]) == 3
+        assert system["memory_node_bytes_total"] > 0
+
+    def test_validator_payload(self):
+        class FakeStore:
+            def voting_pubkeys(self):
+                return [b"\x01" * 48, b"\x02" * 48]
+
+        received = []
+        srv = _capture_server(received)
+        try:
+            mon = MonitoringHttpClient(
+                f"http://127.0.0.1:{srv.server_port}/metrics",
+                validator_store=FakeStore())
+            assert mon.send_metrics(("validator",))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        (body,) = received
+        assert body[0]["process"] == "validator"
+        assert body[0]["vc_validators_total_count"] == 2
+        assert body[0]["vc_validators_enabled_count"] == 2
+
+    def test_server_error_message_parsed(self):
+        received = []
+        srv = _capture_server(received, status=500)
+        try:
+            mon = MonitoringHttpClient(
+                f"http://127.0.0.1:{srv.server_port}/metrics")
+            assert not mon.send_metrics(("system",))
+            assert mon.last_post_ok is False
+            assert "nope" in mon.last_error
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_process_health(self):
+        h = observe_process_health()
+        assert h.pid > 0
+        assert h.memory_process_bytes > 0
