@@ -165,6 +165,46 @@ class Signature:
         return f"Signature({self._bytes.hex()[:16]}…)"
 
     @staticmethod
+    def decompress_batch(sigs: Sequence["Signature"]) -> bool:
+        """Fill `_point` for every not-yet-decompressed signature in ONE
+        native batch call (ops/native_bls.g2_decompress_batch) — one
+        ctypes crossing instead of one per signature, and the C++ layer
+        amortizes its field-constant setup.  Subgroup checks are NOT
+        performed (the batch verifier's device ψ test covers them).
+        Returns False if any signature fails decompression (not on
+        curve / malformed); a valid INFINITY encoding decompresses to
+        cv.INF and returns True — callers that must reject infinity
+        signatures (all verifiers) check the cached point, as
+        verify_sets_pipeline does.  Signatures before a failing one
+        keep their decompressed points cached."""
+        pending = [s for s in sigs if s._point is None]
+        if not pending:
+            return True
+        try:
+            from lighthouse_tpu.ops import native_bls
+
+            native = native_bls if native_bls.available() else None
+        except Exception:
+            native = None
+        if native is None:
+            try:
+                for s in pending:
+                    s.point_unchecked()
+            except (BlsError, ValueError):
+                return False
+            return True
+        res = native.g2_decompress_batch([s._bytes for s in pending])
+        for s, r in zip(pending, res):
+            if r is None:
+                return False
+            if r == native.G2_INF:
+                s._point = cv.INF
+            else:
+                (xa, xb), (ya, yb) = r
+                s._point = (cv.Fq2(xa, xb), cv.Fq2(ya, yb))
+        return True
+
+    @staticmethod
     def aggregate(sigs: Sequence["Signature"]) -> "Signature":
         if not sigs:
             raise BlsError("cannot aggregate zero signatures")

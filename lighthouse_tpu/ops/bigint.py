@@ -142,7 +142,8 @@ def _jconst(name: str) -> jax.Array:
     with jax.ensure_compile_time_eval():
         return jnp.asarray(
             {"p": P_LIMBS, "nprime": NPRIME_LIMBS, "foldq": FOLDQ_LIMBS,
-             "neg": NEG_CONST, "one_m": ONE_M}[name], jnp.uint32)
+             "neg": NEG_CONST, "one_m": ONE_M,
+             "one_plain": _int_to_limbs(1)}[name], jnp.uint32)
 
 
 def _set_top(x: jax.Array, top: jax.Array) -> jax.Array:
@@ -248,6 +249,56 @@ def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
 
 def mont_sqr(a: jax.Array) -> jax.Array:
     return mont_mul(a, a)
+
+
+# --- device-side canonical tests --------------------------------------------
+#
+# Redundant limbs can't be compared directly (one value, many encodings),
+# which is why verdicts historically came home as residue limbs for host
+# zero-tests — at one device->host fetch per leaf (~80 ms over the axon
+# relay; BLS_LEDGER_TPU_r04.json's subgroup stage).  A value-preserving
+# sequential carry pass makes the encoding unique, so the verdict itself
+# can be computed on device and fetched as one bool row.
+
+
+def canon_digits(x: jax.Array) -> jax.Array:
+    """Value-preserving full carry propagation -> unique base-2^15 digits.
+
+    Input: limbs < 2^16 with value < 2^405 (any _carry output qualifies);
+    output limbs < 2^15, same value, one encoding per value — safe for
+    equality against precomputed digit vectors."""
+    xt = jnp.moveaxis(x, -1, 0)
+
+    def step(c, limb):
+        s = limb + c
+        return s >> B, s & MASK
+
+    _, digits = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+    return jnp.moveaxis(digits, 0, -1)
+
+
+@functools.cache
+def _kp_digit_consts() -> jax.Array:
+    """Digit vectors of {0, P, 2P, 3P, 4P}: every multiple of P up to and
+    including the 2^383 mont_mul output bound (4P ≈ 2^382.7 — included
+    for margin even though the only caller multiplies by plain 1, whose
+    output is far smaller)."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(
+            np.stack([_int_to_limbs(k * P_INT) for k in range(5)]),
+            jnp.uint32)
+
+
+def is_zero_mod_p_device(x: jax.Array) -> jax.Array:
+    """Per-lane x ≡ 0 (mod P) for redundant limb rows, ON DEVICE.
+
+    Lowers x through one Montgomery mul by plain 1 (out ≡ x·R⁻¹ mod P,
+    value ≤ the 2^383 mul bound), canonicalizes, and compares against
+    every multiple of P up to that bound.  x ≡ 0 ⟺ x·R⁻¹ ≡ 0 (R
+    invertible).  Returns bool[...] (limb axis reduced)."""
+    w = mont_mul(x, jnp.broadcast_to(_jconst("one_plain"), x.shape))
+    d = canon_digits(w)
+    return (d[..., None, :] == _kp_digit_consts()).all(-1).any(-1)
 
 
 # --- host boundary ----------------------------------------------------------

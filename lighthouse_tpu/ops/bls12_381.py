@@ -737,7 +737,7 @@ def multi_pairing_device(pairs) -> "object":
         mask = np.concatenate([mask, np.zeros(padded - n, bool)])
     fn = _miller_reduce_jit(padded)
     f = fn(*[jnp.asarray(c) for c in cols], jnp.asarray(mask))
-    f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
+    f_host = fq12_from_device(jax.device_get(f))
     try:
         from lighthouse_tpu.ops import native_bls
         if native_bls.available():

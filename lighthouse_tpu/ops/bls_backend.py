@@ -6,18 +6,20 @@ Mirrors the reference blst backend's batch semantics
 
     e(-g1, Σ r_i·sig_i) · Π e(r_i·agg_pk_i, H(m_i)) == 1
 
-Division of labour (round 2 — VERDICT weak #5 moved the per-set scalar
-work off pure Python):
+Division of labour (round 4 — the axon relay charges ~80 ms per
+dispatch/fetch round trip, so the data plane is ONE device program and
+host crossings are counted on fingers):
 
-- host: decompression + subgroup checks (cached on key objects), per-set
-  pubkey aggregation, random scalars, hash-to-curve (memoized per
-  message), ONE Fq2 inversion (Σ r·sig → affine), one fast final
-  exponentiation per batch;
-- device program A (ops/ec.py): r_i·agg_pk_i over G1 lanes and r_i·sig_i
-  over G2 lanes — 64-step double-and-add scans — plus the G2 tree-sum;
-- device program B (ops/bls12_381.py): all Miller loops batched, with the
-  G1 lanes consumed in JACOBIAN form via subfield line scaling (no
-  per-lane host inversions), and the product tree.
+- host: native-C++ batch decompression (ops/native_bls), random scalars,
+  hash-to-curve (memoized per message), native final exponentiation of
+  the one fetched Fq12;
+- device, one fused jit (_pipeline_fused): r_i·agg_pk_i over G1 lanes and
+  r_i·sig_i over G2 lanes (64-step double-and-add scans), the G2 tree-sum
+  with its affine conversion by Fermat inversion, per-message-group G1
+  segment folds, every Miller loop (G1 lanes consumed in JACOBIAN form
+  via subfield line scaling), and the product tree;
+- device, one more jit when signatures are fresh: the batched ψ subgroup
+  verdict (bool row home — ec.g2_subgroup_verdict_batch).
 
 Registered as backend "tpu" on import (see crypto/bls/api.py
 _resolve_backend's lazy hook).
@@ -39,6 +41,8 @@ from lighthouse_tpu.ops import ec
 from lighthouse_tpu.ops.bls12_381 import (
     batch_miller_loop,
     final_exp_hard_device,
+    fp2_mul,
+    fp2_sqr,
     fq12_from_device,
     fq12_to_device,
     multi_pairing_device,
@@ -94,75 +98,84 @@ def prepare_pairs(sets: Sequence[api.SignatureSet]):
 # --- device pipeline --------------------------------------------------------
 # (single jitted callables: jax.jit keys its compile cache on input shapes)
 
-
-@jax.jit
-def _pipeline_a(pkx, pky, sxa, sxb, sya, syb, bits):
-    """Scalar-mult G1 + G2 lanes and tree-sum the G2 side."""
-    Xp, Yp, Zp = ec.g1_scalar_mul_batch(pkx, pky, bits)
-    SX, SY, SZ = ec.g2_scalar_mul_batch(sxa, sxb, sya, syb, bits)
-    SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
-    return Xp, Yp, Zp, SX, SY, SZ
-
-
 from functools import partial
 
 
-@partial(jax.jit, static_argnums=(7,))
-def _pipeline_a_grouped(pkx, pky, sxa, sxb, sya, syb, bits, n_groups):
-    """Grouped variant: lanes are s-major over (segment, group); the G1
-    side folds per message group (Σ r_i·agg_pk_i per distinct message) so
-    the Miller loop runs one lane per GROUP, not per set."""
+def _fq2_jac_to_affine(X, Y, Z):
+    """Jacobian -> affine over Fq2 lanes: (X/Z², Y/Z³) via one Fermat
+    inversion chain on the norm.  Z ≡ 0 lanes come out as garbage zeros —
+    callers must mask them (the fused pipeline computes ~is_zero(Z) on
+    device for exactly that)."""
+    norm = bi.add(bi.mont_mul(Z[0], Z[0]), bi.mont_mul(Z[1], Z[1]))
+    ni = ec.fq_inv_batch(norm)
+    zi = (bi.mont_mul(Z[0], ni), bi.mont_mul(bi.neg(Z[1]), ni))
+    zi2 = fp2_sqr(zi)
+    zi3 = fp2_mul(zi2, zi)
+    return fp2_mul(X, zi2), fp2_mul(Y, zi3)
+
+
+@partial(jax.jit, static_argnums=(14,))
+def _pipeline_fused(pkx, pky, sxa, sxb, sya, syb,
+                    hxa, hxb, hya, hyb, bits, lane_mask,
+                    g1x, g1y, n_groups):
+    """The WHOLE batch-verify data plane as ONE device program.
+
+    Scalar-mults the G1 pubkey and G2 signature lanes, tree-sums Σ r·sig,
+    converts it to affine ON DEVICE (Fermat inversion — the round-3 split
+    pipeline came home for one host Fq2 inversion here, paying two relay
+    round trips ~80 ms each), folds per-message groups when n_groups > 0,
+    then runs every Miller loop and the product tree.  Host boundary:
+    uploads in, ONE Fq12 pytree out (final exp is native C++).
+
+    The Σ r·sig lane's mask bit is resolved on device too: an identity
+    sum degenerates the check to Π e(r·pk_i, H(m_i)) == 1 with the sum
+    lane masked out — same semantics the host branch used to implement."""
     Xp, Yp, Zp = ec.g1_scalar_mul_batch(pkx, pky, bits)
-    Xg, Yg, Zg = ec.g1_segment_sum(Xp, Yp, Zp, n_groups)
+    if n_groups:
+        Xp, Yp, Zp = ec.g1_segment_sum(Xp, Yp, Zp, n_groups)
     SX, SY, SZ = ec.g2_scalar_mul_batch(sxa, sxb, sya, syb, bits)
     SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
-    return Xg, Yg, Zg, SX, SY, SZ
-
-
-@jax.jit
-def _pipeline_b(Xp, Yp, Zp, hxa, hxb, hya, hyb,
-                g1x, g1y, sxa, sxb, sya, syb, mask):
-    """Miller loops over n jacobian-P lanes + 1 affine (-g1, Σ) lane."""
+    sum_ok = ~(bi.is_zero_mod_p_device(SZ[0])
+               & bi.is_zero_mod_p_device(SZ[1]))
+    ax, ay = _fq2_jac_to_affine(SX, SY, SZ)
     one = jnp.broadcast_to(bi._jconst("one_m"), (1, bi.L))
     xp = jnp.concatenate([Xp, g1x])
     yp = jnp.concatenate([Yp, g1y])
     zp = jnp.concatenate([Zp, one])
-    xqa = jnp.concatenate([hxa, sxa])
-    xqb = jnp.concatenate([hxb, sxb])
-    yqa = jnp.concatenate([hya, sya])
-    yqb = jnp.concatenate([hyb, syb])
+    xqa = jnp.concatenate([hxa, ax[0]])
+    xqb = jnp.concatenate([hxb, ax[1]])
+    yqa = jnp.concatenate([hya, ay[0]])
+    yqb = jnp.concatenate([hyb, ay[1]])
+    mask = jnp.concatenate([lane_mask, sum_ok])
     f = batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=zp)
     return reduce_product(f, mask)
 
 
 @jax.jit
 def _g2_subgroup_kernel(xqa, xqb, yqa, yqb):
-    return ec.g2_subgroup_check_batch(xqa, xqb, yqa, yqb)
+    return ec.g2_subgroup_verdict_batch(xqa, xqb, yqa, yqb)
 
 
 def batch_subgroup_check_g2(points) -> np.ndarray:
     """Device ψ membership test over a list of affine G2 points.
 
     Returns bool[n].  Lanes are padded to a power of two (floor 4) with
-    the generator so small batches share compiled shapes."""
+    the generator so small batches share compiled shapes.  The verdict is
+    computed on device (ec.g2_subgroup_verdict_batch) — one bool-row
+    fetch, not six limb rows at ~80 ms of relay latency each."""
     n = len(points)
     if n == 0:
         return np.zeros(0, bool)
     padded = _next_pow2(n, floor=4)
     pts = list(points) + [cv.g2_generator()] * (padded - n)
     xqa, xqb, yqa, yqb = (jnp.asarray(a) for a in _g2_limbs(pts))
-    d1, d2, Z = jax.tree_util.tree_map(
-        np.asarray, _g2_subgroup_kernel(xqa, xqb, yqa, yqb))
-    ok = np.ones(padded, bool)
-    for d in (d1, d2):
-        ok &= ec.is_zero_mod_p(d[0]) & ec.is_zero_mod_p(d[1])
-    ok &= ~(ec.is_zero_mod_p(Z[0]) & ec.is_zero_mod_p(Z[1]))
+    ok = np.asarray(_g2_subgroup_kernel(xqa, xqb, yqa, yqb))
     return ok[:n]
 
 
 @jax.jit
 def _g1_subgroup_kernel(xp, yp):
-    return ec.g1_subgroup_check_batch(xp, yp)
+    return ec.g1_subgroup_verdict_batch(xp, yp)
 
 
 def _next_pow2(x: int, floor: int = 1) -> int:
@@ -172,7 +185,8 @@ def _next_pow2(x: int, floor: int = 1) -> int:
 @partial(jax.jit, static_argnums=(5,))
 def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
     """Segmented G1 sum over (pubkey + blinding) lanes, minus the
-    blinding total, then affine conversion."""
+    blinding total, then affine conversion.  The infinity flag (Z ≡ 0)
+    is resolved on device — one bool row home, not a limb row."""
     Xg, Yg, Zg = ec.g1_segment_sum(X, Y, Z, n_sets)
     one = jnp.broadcast_to(bi._jconst("one_m"), Xg.shape)
     Xr, Yr, Zr = ec._jac_add_full(
@@ -180,7 +194,7 @@ def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
         (jnp.broadcast_to(ux, Xg.shape), jnp.broadcast_to(uy, Yg.shape),
          one))
     xa, ya = ec.g1_jacobian_to_affine_batch(Xr, Yr, Zr)
-    return xa, ya, Zr
+    return xa, ya, bi.is_zero_mod_p_device(Zr)
 
 
 # blinding pool: lane j carries B_j = [u_j]G alongside the pubkeys, and
@@ -257,11 +271,10 @@ def aggregate_pubkeys_device(sets):
         X[lanes] = bx
         Y[lanes] = by
         Z[lanes] = one
-    xa, ya, Zr = jax.tree_util.tree_map(np.asarray, _aggregate_kernel(
+    xa, ya, inf = jax.device_get(_aggregate_kernel(
         jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z),
         neg_total[0], neg_total[1], n_pad))
-    inf = ec.is_zero_mod_p(Zr[:n])
-    return xa[:n], ya[:n], inf
+    return xa[:n], ya[:n], inf[:n]
 
 
 def batch_subgroup_check_g1(points) -> np.ndarray:
@@ -274,10 +287,7 @@ def batch_subgroup_check_g1(points) -> np.ndarray:
     pts = list(points) + [cv.g1_generator()] * (padded - n)
     xp = jnp.asarray(ec.ints_to_mont_limbs([p[0] for p in pts]))
     yp = jnp.asarray(ec.ints_to_mont_limbs([p[1] for p in pts]))
-    d1, d2, Z = jax.tree_util.tree_map(
-        np.asarray, _g1_subgroup_kernel(xp, yp))
-    ok = ec.is_zero_mod_p(d1) & ec.is_zero_mod_p(d2) \
-        & ~ec.is_zero_mod_p(Z)
+    ok = np.asarray(_g1_subgroup_kernel(xp, yp))
     return ok[:n]
 
 
@@ -363,8 +373,7 @@ def _final_exp_is_one(f_host) -> bool:
         return final_exponentiation_fast(f_host).is_one()
     m = final_exp_easy(f_host)        # one host inversion (~µs, ext-gcd)
     out = _final_exp_hard_jit(fq12_to_device(m))
-    return fq12_from_device(
-        jax.tree_util.tree_map(np.asarray, out)) == Fq12.ONE
+    return fq12_from_device(jax.device_get(out)) == Fq12.ONE
 
 
 def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
@@ -372,12 +381,10 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     """Batch verification with the scalar work on device (see module doc).
 
     With ``ledger`` given, per-stage wall times (seconds) are recorded under
-    keys prep_host / limbs / pipeline_a / sum_affine / pipeline_b /
-    final_exp — device stages are synchronized before timing, so only pass
-    a ledger when profiling (it serializes the pipeline)."""
+    keys subgroup / aggregate / prep_host / limbs / pipeline / final_exp —
+    device stages are synchronized before timing, so only pass a ledger
+    when profiling (it serializes the pipeline)."""
     import time as _time
-
-    from lighthouse_tpu.crypto.bls.fields import Fq2
 
     def _mark(key, t0):
         if ledger is not None:
@@ -386,6 +393,10 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
 
     t0 = _time.perf_counter()
     n = len(sets)
+    # one native batch call decompresses every fresh signature (vs one
+    # ctypes crossing + C++ setup per signature)
+    if not api.Signature.decompress_batch([s.signature for s in sets]):
+        return False
     sig_pts = []
     h2cs = []
     for s in sets:
@@ -477,13 +488,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         ext = np.zeros((g_pad - n_groups, bi.L), np.uint32)
         if g_pad != n_groups:
             h2 = [np.concatenate([a, ext]) for a in h2]
-        t0 = _mark("limbs", t0)
-        Xp, Yp, Zp, SX, SY, SZ = _pipeline_a_grouped(
-            jnp.asarray(pkx), jnp.asarray(pky),
-            *[jnp.asarray(a) for a in sg], bits, g_pad)
-        if ledger is not None:
-            jax.block_until_ready(SZ)
-        t0 = _mark("pipeline_a", t0)
+        n_seg_static = g_pad
         padded = g_pad
         n_real_lanes = n_groups
     else:
@@ -500,51 +505,25 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         # infinity, adding nothing to Σ r·sig; their Miller lanes are
         # masked out below
         bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
-        t0 = _mark("limbs", t0)
-
-        Xp, Yp, Zp, SX, SY, SZ = _pipeline_a(
-            jnp.asarray(pkx), jnp.asarray(pky),
-            *[jnp.asarray(a) for a in sg], bits)
-        if ledger is not None:
-            jax.block_until_ready(SZ)
-        t0 = _mark("pipeline_a", t0)
+        n_seg_static = 0
         padded = padded_flat
         n_real_lanes = n
 
-    # host: Σ r·sig jacobian -> affine (one Fq2 inversion)
-    def host_fq2(c):
-        return Fq2(int(bi.from_mont(np.asarray(c[0])[0])),
-                   int(bi.from_mont(np.asarray(c[1])[0])))
-
-    sz = host_fq2((SZ[0], SZ[1]))
-    if sz.is_zero():
-        # Σ r·sig = identity: the pairing check degenerates to
-        # Π e(r·pk_i, H(m_i)) == 1, still handled by the product below —
-        # but an all-masked batch verifies vacuously like the oracle
-        sum_affine = None
-    else:
-        sx, sy = host_fq2((SX[0], SX[1])), host_fq2((SY[0], SY[1]))
-        zi = sz.inv()
-        zi2 = zi.square()
-        sum_affine = (sx * zi2, sy * zi2 * zi)
-
-    mask = np.zeros(padded + 1, bool)
-    mask[:n_real_lanes] = True
-    if sum_affine is not None:
-        mask[padded] = True
-        sa = _g2_limbs([sum_affine])
-    else:
-        sa = [np.zeros((1, bi.L), np.uint32) for _ in range(4)]
+    lane_mask = np.zeros(padded, bool)
+    lane_mask[:n_real_lanes] = True
     g1x, g1y = _g1_neg_limbs()
-    t0 = _mark("sum_affine", t0)
+    t0 = _mark("limbs", t0)
 
-    f = _pipeline_b(Xp, Yp, Zp, *[jnp.asarray(a) for a in h2],
-              jnp.asarray(g1x), jnp.asarray(g1y),
-              *[jnp.asarray(a) for a in sa], jnp.asarray(mask))
+    f = _pipeline_fused(
+        jnp.asarray(pkx), jnp.asarray(pky),
+        *[jnp.asarray(a) for a in sg],
+        *[jnp.asarray(a) for a in h2],
+        bits, jnp.asarray(lane_mask),
+        jnp.asarray(g1x), jnp.asarray(g1y), n_seg_static)
     if ledger is not None:
         jax.block_until_ready(f)
-    t0 = _mark("pipeline_b", t0)
-    f_host = fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
+    t0 = _mark("pipeline", t0)
+    f_host = fq12_from_device(jax.device_get(f))
     ok = _final_exp_is_one(f_host)
     _mark("final_exp", t0)
     return ok

@@ -412,6 +412,28 @@ def g2_subgroup_check_batch(xqa, xqb, yqa, yqb):
     return d1, d2, Z
 
 
+def _fq2_zero_mod_p(c) -> jax.Array:
+    return bi.is_zero_mod_p_device(c[0]) & bi.is_zero_mod_p_device(c[1])
+
+
+def g2_subgroup_verdict_batch(xqa, xqb, yqa, yqb) -> jax.Array:
+    """Full ψ membership verdict per lane, ON DEVICE -> bool[n].
+
+    Folds the residue zero-tests (bi.is_zero_mod_p_device) into the same
+    program as g2_subgroup_check_batch so callers fetch one bool row
+    instead of six Fq limb rows (one ~80 ms relay round trip each)."""
+    d1, d2, Z = g2_subgroup_check_batch(xqa, xqb, yqa, yqb)
+    return (_fq2_zero_mod_p(d1) & _fq2_zero_mod_p(d2)
+            & ~_fq2_zero_mod_p(Z))
+
+
+def g1_subgroup_verdict_batch(xp, yp) -> jax.Array:
+    """Device [r-1]P membership verdict per lane -> bool[n]."""
+    d1, d2, Z = g1_subgroup_check_batch(xp, yp)
+    return (bi.is_zero_mod_p_device(d1) & bi.is_zero_mod_p_device(d2)
+            & ~bi.is_zero_mod_p_device(Z))
+
+
 @_functools.cache
 def _p_minus_2_bits_const():
     with jax.ensure_compile_time_eval():

@@ -81,7 +81,7 @@ def multi_pairing_sharded(pairs, mesh) -> "object":
     shm = NamedSharding(mesh, P("data"))
     args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
     f = fn(*args, jax.device_put(jnp.asarray(mask), shm))
-    f_host = dev.fq12_from_device(jax.tree_util.tree_map(np.asarray, f))
+    f_host = dev.fq12_from_device(jax.device_get(f))
     return final_exponentiation_fast(f_host)
 
 
