@@ -1106,13 +1106,15 @@ class BeaconApi:
         rows = []
         for pid in peers:
             addr = wire.peer_addr(pid) if wire is not None else None
+            outbound = wire.peer_outbound(pid) if wire is not None else True
             rows.append({
                 "peer_id": pid,
                 "enr": "",
                 "last_seen_p2p_address": (
                     f"/ip4/{addr[0]}/tcp/{addr[1]}" if addr else ""),
                 "state": "connected",
-                "direction": "outbound",
+                "direction": "outbound" if outbound else "inbound",
+                "agent": (wire.peer_agent(pid) if wire is not None else ""),
             })
         return rows
 

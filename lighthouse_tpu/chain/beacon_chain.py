@@ -368,12 +368,22 @@ class BeaconChain:
                     is_from_block=True)
             except Exception:
                 pass  # invalid-for-fork-choice attestations are skippable
+        block_epoch = self.spec.compute_epoch_at_slot(int(block.slot))
         for slashing in block.body.attester_slashings:
             a1 = set(int(i) for i in slashing.attestation_1.attesting_indices)
             a2 = set(int(i) for i in slashing.attestation_2.attesting_indices)
             both = np.array(sorted(a1 & a2), np.int64)
             if both.size:
                 self.fork_choice.on_attester_slashing(both)
+                self.validator_monitor.on_attester_slashing(
+                    both, block_epoch)
+        for ps in block.body.proposer_slashings:
+            self.validator_monitor.on_proposer_slashing(
+                int(ps.signed_header_1.message.proposer_index), block_epoch)
+        for ex in block.body.voluntary_exits:
+            self.validator_monitor.on_exit(
+                int(ex.message.validator_index), block_epoch)
+        self._note_sync_aggregate(block, state)
 
         if self.slasher is not None:
             self.slasher.on_block(pending.signed_block)
@@ -393,6 +403,31 @@ class BeaconChain:
             "execution_optimistic": pending.execution_status == 1})
         self.recompute_head()
         return root
+
+    def _note_sync_aggregate(self, block, state) -> None:
+        """Attribute a block's sync-aggregate bits to validator indices
+        for the monitor (reference register_sync_aggregate_in_block).
+        Pays the committee-row + pubkey-index lookups only when someone
+        is monitored; altair- blocks have no aggregate."""
+        vm = self.validator_monitor
+        if not (vm.auto_register or vm.registered):
+            return
+        agg = getattr(block.body, "sync_aggregate", None)
+        if agg is None:
+            return
+        try:
+            rows = self.sync_committee_rows(state, int(block.slot))
+            included = []
+            for i, bit in enumerate(agg.sync_committee_bits):
+                if not bit:
+                    continue
+                idx = self.pubkey_cache.index_of(rows[i].tobytes())
+                if idx is not None:
+                    included.append(idx)
+            vm.on_sync_aggregate_included(
+                included, int(block.slot), self.spec)
+        except Exception:
+            pass  # observability only, never blocks import
 
     def _note_missed_proposals(self, block, post_state) -> None:
         """Feed skipped slots between a block and its parent to the

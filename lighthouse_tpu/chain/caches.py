@@ -76,6 +76,7 @@ class ValidatorPubkeyCache:
 
         self._bls = bls
         self._keys: list = []
+        self._index: dict[bytes, int] = {}   # pubkey bytes -> validator idx
 
     def import_new(self, validators) -> None:
         """Extend with any registry entries beyond the cache length."""
@@ -85,11 +86,18 @@ class ValidatorPubkeyCache:
             pk_bytes = bytes(pubkeys[i].tobytes()
                              if hasattr(pubkeys[i], "tobytes") else pubkeys[i])
             self._keys.append(self._bls.PublicKey.interned(pk_bytes))
+            self._index[pk_bytes] = i
 
     def get(self, index: int):
         if 0 <= index < len(self._keys):
             return self._keys[index]
         return None
+
+    def index_of(self, pubkey_bytes: bytes) -> int | None:
+        """Validator index for a compressed pubkey (reference
+        validator_pubkey_cache.rs get_index — sync-aggregate attribution
+        maps committee pubkeys back to indices through this)."""
+        return self._index.get(bytes(pubkey_bytes))
 
     def __len__(self):
         return len(self._keys)

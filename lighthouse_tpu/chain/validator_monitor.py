@@ -22,10 +22,16 @@ class ValidatorEpochSummary:
     blocks_proposed: int = 0
     blocks_missed: int = 0
     sync_signatures: int = 0
+    # sync-committee signatures of this validator INCLUDED in blocks'
+    # sync aggregates (distinct from gossip sightings)
+    sync_aggregate_inclusions: int = 0
     # gossip-level sightings (seen on the wire before inclusion — the
     # reference distinguishes "seen" from "included")
     attestations_seen: int = 0
     aggregates_seen: int = 0
+    # lifecycle events observed on chain this epoch
+    slashed: bool = False
+    exited: bool = False
     # balance tracking at the epoch boundary
     balance_gwei: int = 0
     balance_delta_gwei: int = 0
@@ -74,6 +80,9 @@ class ValidatorMonitor:
             "validator_monitor_inclusion_distance_slots",
             "slots between attestation and its including block",
             buckets=(1, 2, 3, 4, 8, 16, 32))
+        self._slashings = REGISTRY.counter(
+            "validator_monitor_slashings_total",
+            "slashings of monitored validators observed on chain")
 
     def register(self, *indices: int) -> None:
         self.registered.update(int(i) for i in indices)
@@ -127,6 +136,34 @@ class ValidatorMonitor:
         epoch = int(data.target.epoch)
         if self._monitored(aggregator_index):
             self._summary(epoch, aggregator_index).aggregates_seen += 1
+
+    def on_sync_aggregate_included(self, indices, slot: int, spec) -> None:
+        """Monitored validators whose sync signature made a block's
+        sync aggregate (reference register_sync_aggregate_in_block)."""
+        epoch = spec.compute_epoch_at_slot(int(slot))
+        for v in indices:
+            if self._monitored(v):
+                self._summary(epoch, v).sync_aggregate_inclusions += 1
+
+    def on_attester_slashing(self, indices, epoch: int) -> None:
+        """A block carried an attester slashing covering monitored
+        validators (reference register_attester_slashing) — the highest-
+        severity signal the monitor emits."""
+        for v in np.asarray(indices).reshape(-1).tolist():
+            if self._monitored(v):
+                self._summary(epoch, int(v)).slashed = True
+                self._slashings.inc()
+
+    def on_proposer_slashing(self, proposer: int, epoch: int) -> None:
+        if self._monitored(proposer):
+            self._summary(epoch, int(proposer)).slashed = True
+            self._slashings.inc()
+
+    def on_exit(self, validator: int, epoch: int) -> None:
+        """A voluntary exit for a monitored validator was included on
+        chain (reference register_block_voluntary_exit)."""
+        if self._monitored(validator):
+            self._summary(epoch, int(validator)).exited = True
 
     def on_block_missed(self, slot: int, expected_proposer: int,
                         spec) -> None:
@@ -281,15 +318,18 @@ class ValidatorMonitor:
                       + s.reward_head_gwei)
             leak = (f" leak={s.reward_inactivity_gwei}"
                     if s.reward_inactivity_gwei else "")
+            events = ("" + (" SLASHED" if s.slashed else "")
+                      + (" exited" if s.exited else ""))
             out.append(
                 f"validator {v} epoch {epoch}: "
                 f"att hit={s.attestation_hits} miss={s.attestation_misses} "
                 f"sth={flags} "
                 f"seen={s.attestations_seen} delay={delay:.2f} "
                 f"blocks={s.blocks_proposed} missed={s.blocks_missed} "
-                f"sync={s.sync_signatures} "
+                f"sync={s.sync_signatures}/{s.sync_aggregate_inclusions} "
                 f"reward={reward:+d}/{s.ideal_reward_gwei}{leak} "
-                f"balance={s.balance_gwei} Δ={s.balance_delta_gwei:+d}")
+                f"balance={s.balance_gwei} Δ={s.balance_delta_gwei:+d}"
+                f"{events}")
         return out
 
     def prune_below(self, epoch: int) -> None:

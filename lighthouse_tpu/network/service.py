@@ -72,7 +72,15 @@ class NetworkService:
             # same signal into libp2p's connection scoring)
             self._graylisted_gossip: set[str] = set()
             node.on_gossip_score = self._on_gossip_score
-            node.on_peer_connected = self.peer_manager.mark_connected
+
+            def _on_connected(pid, _node=node, _pm=self.peer_manager):
+                addr = _node.peer_addr(pid)
+                _pm.mark_connected(
+                    pid, ip=addr[0] if addr else None,
+                    outbound=_node.peer_outbound(pid),
+                    agent=_node.peer_agent(pid))
+
+            node.on_peer_connected = _on_connected
             node.on_peer_disconnected = self.peer_manager.mark_disconnected
 
         # socket fabrics carry discovery over UDP datagrams and advertise
@@ -102,18 +110,49 @@ class NetworkService:
             self._graylisted_gossip.discard(peer)
 
     def on_slot(self, slot: int) -> None:
-        """Per-slot tick: subnet subscription deltas + peer enforcement
-        (disconnect bad scores, prune beyond the target peer count)."""
+        """Per-slot tick: subnet subscription deltas + the peer-manager
+        heartbeat (disconnect bad scores, prune beyond the target peer
+        count with sole-subnet-provider protection, refill the dial
+        deficit from the discovery table)."""
         self.router.update_attestation_subnets(slot)
         node = getattr(self.fabric, "node", None)
         if node is None:
             return
+        # both args are callables: the candidate scan and the provider
+        # map only run when the heartbeat actually dials or prunes
+        self.peer_manager.heartbeat(
+            node,
+            dial_candidates=lambda: self._dial_candidates(node),
+            protected=lambda: self._sole_subnet_providers(node))
+
+    def _sole_subnet_providers(self, node) -> set[str]:
+        """Peers that are the ONLY provider of a topic we subscribe —
+        pruning them last keeps rare subnets reachable (reference
+        prune_excess_peers' subnet protection)."""
+        providers: dict[str, list[str]] = {}
+        for pid in node.peers:
+            for t in node.peer_topics(pid):
+                providers.setdefault(t, []).append(pid)
+        return {ps[0] for t, ps in providers.items() if len(ps) == 1}
+
+    def _dial_candidates(self, node) -> list:
+        """Discovery-table ENRs we are not connected to, as (host, port)
+        dial targets (discovery → peer_manager dial flow).  Banned peers
+        and banned IPs are skipped — a doomed dial would burn a slot of
+        the capped deficit only for our own accept gate to refuse it."""
+        connected = set(node.peers)
         pm = self.peer_manager
-        for peer in list(node.peers):
-            if pm.is_banned(peer) or pm.should_disconnect(peer):
-                node.disconnect(peer)
-        for peer in pm.excess_peers():
-            node.disconnect(peer)
+        banned_ips = pm.banned_ips
+        out = []
+        for enr in self.discovery.table.closest(
+                self.discovery.enr.node_id, n=16):
+            if enr.peer_id in connected or enr.peer_id == self.peer_id:
+                continue
+            if pm.is_banned(enr.peer_id) or enr.ip in banned_ips:
+                continue
+            if enr.ip and enr.port:
+                out.append((enr.ip, enr.port))
+        return out
 
     def connect(self, other: "NetworkService"):
         """Mutual status handshake (dial)."""

@@ -101,6 +101,7 @@ class _Conn:
         self.writer = writer
         self.peer_id: str | None = None
         self.topics: set[str] = set()
+        self.agent: str = ""                       # their HELLO agent string
         self.addr: tuple[str, int] | None = None   # their LISTEN addr
         self.outbound = outbound                   # we initiated the dial
         self.alive = True
@@ -169,8 +170,13 @@ class WireNode:
         self.on_peer_connected: Callable[[str], None] | None = None
         self.on_peer_disconnected: Callable[[str], None] | None = None
         # ban gate: return False to refuse a peer at the HELLO door
-        # (peer_manager.accept_connection when a NetworkService attaches)
-        self.accept_peer: Callable[[str], bool] | None = None
+        # (peer_manager.accept_connection when a NetworkService attaches);
+        # called with (peer_id, remote_ip) so IP-collated bans apply
+        self.accept_peer: Callable[[str, str], bool] | None = None
+        # agent string advertised in HELLO (identify protocol analogue)
+        from lighthouse_tpu import __version__ as _v
+
+        self.agent = f"lighthouse_tpu/{_v}"
         self._started = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
@@ -311,6 +317,7 @@ class WireNode:
             "fork_digest": self.fork_digest.hex(),
             "topics": sorted(self._topics),
             "listen_port": self.listen_port,
+            "agent": self.agent,
         }).encode()
         await self._send_frame(conn, bytes([K_HELLO]) + hello)
 
@@ -324,8 +331,13 @@ class WireNode:
 
     async def _serve_conn(self, conn: _Conn, said_hello: bool = False):
         try:
-            if not said_hello:
+            if not said_hello and conn.outbound:
                 await self._send_hello(conn)
+            # inbound connections stay silent until the remote's HELLO
+            # passes the accept gate (_on_frame replies there): a banned
+            # dialer learns nothing — not even our peer id — and its
+            # connect() times out instead of reading a success signal.
+            # No deadlock: the OUTBOUND side always speaks first.
             while True:
                 hdr = await conn.reader.readexactly(4)
                 (n,) = struct.unpack("<I", hdr)
@@ -390,7 +402,9 @@ class WireNode:
                 raise RpcError("identity binding signature invalid")
             if pid != noise.peer_id_of(ipub):
                 raise RpcError("peer id does not match identity key")
-            if self.accept_peer is not None and not self.accept_peer(pid):
+            peer_host = conn.writer.get_extra_info("peername")[0]
+            if self.accept_peer is not None \
+                    and not self.accept_peer(pid, peer_host):
                 # refuse BEFORE exposing peer_id: the dialer's connect()
                 # polls conn.peer_id as its success signal
                 conn.alive = False
@@ -398,8 +412,15 @@ class WireNode:
                 return
             conn.peer_id = pid
             conn.topics = set(d.get("topics", ()))
-            peer_host = conn.writer.get_extra_info("peername")[0]
+            conn.agent = str(d.get("agent", ""))
             conn.addr = (peer_host, int(d.get("listen_port", 0)))
+            if not conn.outbound:
+                # deferred HELLO reply: an inbound peer only hears from
+                # us once its HELLO has passed the gate (see _serve_conn).
+                # Sent BEFORE the dedup tie-break below — a simultaneous
+                # dialer that loses the tie still deserves the reply its
+                # (healthy) dial is polling for
+                await self._send_hello(conn)
             old = self._conns.get(conn.peer_id)
             if old is not None and old is not conn and old.alive:
                 # simultaneous dial: both sides keep the connection the
@@ -778,6 +799,18 @@ class WireNode:
     def peer_addr(self, peer_id: str) -> tuple[str, int] | None:
         conn = self._conns.get(peer_id)
         return conn.addr if conn else None
+
+    def peer_agent(self, peer_id: str) -> str:
+        conn = self._conns.get(peer_id)
+        return conn.agent if conn else ""
+
+    def peer_outbound(self, peer_id: str) -> bool:
+        conn = self._conns.get(peer_id)
+        return bool(conn and conn.outbound)
+
+    def peer_topics(self, peer_id: str) -> set[str]:
+        conn = self._conns.get(peer_id)
+        return set(conn.topics) if conn else set()
 
 
 class _UdpProtocol(asyncio.DatagramProtocol):

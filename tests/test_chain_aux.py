@@ -263,6 +263,46 @@ class TestValidatorMonitorDepth:
                          0).values())
         assert missed == 2  # slots 2 and 3
 
+    def test_slashing_exit_feed_points(self):
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        vm = ValidatorMonitor()
+        vm.register(2, 5)
+        vm.on_attester_slashing([1, 2, 3], epoch=4)   # only 2 monitored
+        vm.on_proposer_slashing(5, epoch=4)
+        vm.on_exit(2, epoch=4)
+        vm.on_exit(7, epoch=4)                        # unmonitored: ignored
+        summ = vm.epoch_summary(4)
+        assert summ[2].slashed and summ[2].exited
+        assert summ[5].slashed and not summ[5].exited
+        assert 7 not in summ
+        lines = {ln.split()[1]: ln for ln in vm.log_lines(4)}
+        assert "SLASHED" in lines["2"] and "exited" in lines["2"]
+
+    def test_sync_aggregate_attribution_on_import(self):
+        """A block's sync-aggregate bits attribute to validator indices
+        through the pubkey cache (register_sync_aggregate_in_block)."""
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.state_transition import state_transition
+        from lighthouse_tpu.testing import Harness
+
+        h = Harness(n_validators=8, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        chain.validator_monitor.auto_register = True
+        chain.slot_clock.advance_slot()
+        signed = h.produce_block(slot=1)
+        n_bits = sum(
+            1 for b in signed.message.body.sync_aggregate.sync_committee_bits
+            if b)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        chain.process_block(signed)
+        total = sum(
+            s.sync_aggregate_inclusions
+            for s in chain.validator_monitor.epoch_summary(0).values())
+        assert total == n_bits and n_bits > 0
+
     def test_participation_flags_detect_missed_attestation(self):
         """on_epoch_boundary reads the FINAL participation flags from
         the last head state of the finished epoch (prev_state): set

@@ -130,6 +130,124 @@ class TestPeerScoring:
         t[0] += 1.0  # one token refilled
         assert rl.allow("p", "proto")
 
+    def test_ip_collated_ban(self):
+        # enough banned peers behind one IP ban the IP itself; a NEW
+        # peer from that IP is refused at the door (peerdb.rs BannedIp)
+        pm = PeerManager()
+        for k in range(5):
+            pid = f"sybil-{k}"
+            assert pm.accept_connection(pid, ip="10.0.0.9")
+            for _ in range(5):
+                pm.report(pid, "high")
+        assert "10.0.0.9" in pm.banned_ips
+        assert not pm.accept_connection("fresh-face", ip="10.0.0.9")
+        # other IPs are unaffected
+        assert pm.accept_connection("elsewhere", ip="10.0.0.10")
+
+    def test_ip_ban_lifts_with_score_decay(self):
+        # the IP ban is live collation, not a permanent blocklist: once
+        # the sybils' scores decay above the ban threshold the IP frees
+        t = [0.0]
+        pm = PeerManager(clock=lambda: t[0])
+        for k in range(5):
+            pid = f"sybil-{k}"
+            pm.accept_connection(pid, ip="10.0.0.9")
+            for _ in range(5):
+                pm.report(pid, "high")
+        assert "10.0.0.9" in pm.banned_ips
+        t[0] += 3600.0  # six half-lives: -100 -> ~-1.6
+        assert "10.0.0.9" not in pm.banned_ips
+        assert pm.accept_connection("fresh-face", ip="10.0.0.9")
+
+    def test_outbound_quota_dials_at_target(self):
+        # at target with all-inbound peers the heartbeat still dials to
+        # fill the outbound quota (MIN_OUTBOUND_FRACTION enforcement)
+        pm = PeerManager(target_peers=10)
+        for k in range(10):
+            pm.mark_connected(f"in{k}", outbound=False)
+
+        class FakeNode:
+            peers: list = []
+
+            def __init__(self):
+                self.dialed = []
+
+            def disconnect(self, pid):
+                pass
+
+            def connect(self, host, port):
+                self.dialed.append((host, port))
+
+        node = FakeNode()
+        dials = pm.heartbeat(node, dial_candidates=[("h", p)
+                                                    for p in range(5)])
+        assert dials == 2  # 20% of 10 outbound wanted, 0 present
+
+    def test_trusted_peer_exempt(self):
+        pm = PeerManager()
+        pm.set_trusted("friend")
+        for _ in range(10):
+            pm.report("friend", "fatal")
+        assert not pm.is_banned("friend")
+        assert not pm.should_disconnect("friend")
+        # trusted peers are never pruning victims
+        pm.target_peers = 0
+        pm.mark_connected("friend")
+        assert "friend" not in pm.excess_peers()
+
+    def test_client_identification_and_census(self):
+        from lighthouse_tpu.network.peer_manager import client_kind
+
+        assert client_kind("Lighthouse/v4.5.0") == "Lighthouse"
+        assert client_kind("teku/23.1") == "Teku"
+        assert client_kind("lighthouse_tpu/0.1.0") == "LighthouseTpu"
+        assert client_kind(None) == "Unknown"
+        pm = PeerManager()
+        pm.mark_connected("p1", agent="Prysm/v4")
+        pm.mark_connected("p2", agent="Prysm/v4")
+        pm.mark_connected("p3", agent="nimbus-eth2/v23")
+        assert pm.client_counts() == {"Prysm": 2, "Nimbus": 1}
+
+    def test_subnet_protected_pruning(self):
+        t = [0.0]
+        pm = PeerManager(clock=lambda: t[0], target_peers=2)
+        for pid, score_hits in (("sole", 2), ("dup1", 0), ("dup2", 1)):
+            pm.mark_connected(pid)
+            for _ in range(score_hits):
+                pm.report(pid, "low")
+        # worst-scored peer is 'sole', but it is protected: the prune
+        # victim must be the worst UNPROTECTED peer
+        assert pm.excess_peers() == ["sole"]
+        assert pm.excess_peers(protected={"sole"}) == ["dup2"]
+
+    def test_dial_deficit_and_heartbeat(self):
+        pm = PeerManager(target_peers=4)
+        pm.mark_connected("in1", outbound=False)
+        total, outbound = pm.dial_deficit()
+        assert total == 3
+        assert outbound == 0  # 20% of 4 rounds down to 0
+
+        class FakeNode:
+            def __init__(self):
+                self.peers = ["in1", "bad"]
+                self.dropped = []
+                self.dialed = []
+
+            def disconnect(self, pid):
+                self.dropped.append(pid)
+
+            def connect(self, host, port):
+                self.dialed.append((host, port))
+
+        node = FakeNode()
+        for _ in range(3):
+            pm.report("bad", "mid")
+        dials = pm.heartbeat(
+            node, dial_candidates=[("h1", 1), ("h2", 2), ("h3", 3),
+                                   ("h4", 4)])
+        assert "bad" in node.dropped
+        assert dials == 3 and len(node.dialed) == 3  # capped at deficit
+
 
 class TestPartition:
     def test_partitioned_peer_misses_gossip_then_syncs(self, two_nodes):

@@ -196,7 +196,7 @@ class TestPeerEnforcement:
     def test_banned_peer_refused_at_hello(self):
         a, b = _mk_node("EA"), _mk_node("EB")
         try:
-            a.accept_peer = lambda pid: pid != b.peer_id
+            a.accept_peer = lambda pid, ip=None: pid != b.peer_id
             # the dialer's handshake may transiently succeed (A's HELLO
             # goes out on accept); the door slams when A reads B's HELLO
             try:
