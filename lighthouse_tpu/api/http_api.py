@@ -57,7 +57,9 @@ class BeaconApi:
         r("GET", r"/eth/v1/config/spec", self.config_spec)
         r("GET", r"/eth/v1/config/fork_schedule", self.fork_schedule)
         r("GET", r"/eth/v1/config/deposit_contract", self.deposit_contract)
+        r("GET", r"/eth/v1/beacon/headers", self.headers_list)
         r("GET", r"/eth/v1/beacon/headers/(?P<block_id>\w+)", self.header)
+        r("GET", r"/eth/v1/beacon/deposit_snapshot", self.deposit_snapshot)
         r("GET", r"/eth/v2/beacon/blocks/(?P<block_id>\w+)", self.block)
         r("POST", r"/eth/v1/beacon/blocks", self.publish_block)
         r("POST", r"/eth/v1/beacon/pool/attestations", self.pool_attestations)
@@ -305,6 +307,91 @@ class BeaconApi:
                 "state_root": _hex(msg.state_root),
                 "body_root": _hex(msg.body.hash_tree_root()),
             }, "signature": _hex(blk.signature)},
+        }}
+
+    def headers_list(self, body=None, query=None):
+        """Standard headers LIST route: ?slot= and/or ?parent_root=
+        filters over canonical blocks; bare = the head header
+        (reference http_api get_beacon_headers)."""
+        query = query or {}
+        c = self.chain
+        roots: list[bytes] = []
+        want_slot = None
+        if "slot" in query:
+            try:
+                want_slot = int(query["slot"])
+            except ValueError:
+                raise ApiError(400, "invalid slot")
+            root = c.block_root_at_slot(want_slot)
+            if root is not None:
+                roots.append(root)
+        elif "parent_root" in query:
+            try:
+                want = bytes.fromhex(
+                    query["parent_root"].removeprefix("0x"))
+            except ValueError:
+                raise ApiError(400, "invalid parent_root")
+            # the canonical child sits within the skip-slot gap after the
+            # parent: bound the scan there instead of walking the whole
+            # chain from head
+            parent_blk = c.store.get_block(want)
+            if parent_blk is not None:
+                p_slot = int(parent_blk.message.slot)
+                head_slot = int(c.head_state.slot)
+                for s in range(p_slot + 1, min(
+                        p_slot + 1 + c.spec.preset.slots_per_historical_root,
+                        head_slot + 1)):
+                    root = c.block_root_at_slot(s)
+                    if root is None or root == want:
+                        continue
+                    blk = c.store.get_block(root)
+                    if blk is not None and \
+                            bytes(blk.message.parent_root) == want:
+                        roots.append(root)
+                    break
+        else:
+            roots.append(c.head_root)
+        rows = []
+        for root in roots:
+            blk = c.store.get_block(root)
+            if blk is None:
+                continue
+            m = blk.message
+            if want_slot is not None and int(m.slot) != want_slot:
+                # block_root_at_slot returns the latest block AT-OR-BEFORE
+                # the slot; a skipped slot has no header (empty list)
+                continue
+            rows.append({
+                "root": _hex(root),
+                "canonical": True,
+                "header": {"message": {
+                    "slot": str(int(m.slot)),
+                    "proposer_index": str(int(m.proposer_index)),
+                    "parent_root": _hex(m.parent_root),
+                    "state_root": _hex(m.state_root),
+                    "body_root": _hex(m.body.hash_tree_root()),
+                }, "signature": _hex(blk.signature)},
+            })
+        return {"data": rows,
+                "execution_optimistic": False, "finalized": False}
+
+    def deposit_snapshot(self, body=None):
+        """EIP-4881 deposit tree snapshot
+        (/eth/v1/beacon/deposit_snapshot; reference http_api
+        get_beacon_deposit_snapshot + deposit_snapshot.rs)."""
+        svc = self.chain.eth1_service
+        if svc is None or getattr(svc, "tree", None) is None:
+            raise ApiError(404, "no eth1 service attached")
+        snap = svc.tree.snapshot()
+        block = svc.blocks[-1] if getattr(svc, "blocks", None) else None
+        return {"data": {
+            "finalized": [_hex(h) for h in snap["finalized"]],
+            "deposit_root": _hex(snap["deposit_root"]),
+            "deposit_count": str(snap["deposit_count"]),
+            "execution_block_hash": _hex(
+                block.hash if block is not None else b"\x00" * 32),
+            "execution_block_height": str(
+                block.number if block is not None else 0),
         }}
 
     def block(self, block_id, body=None):
@@ -941,21 +1028,6 @@ class BeaconApi:
                 _hex(b) for b in bs.current_sync_committee_branch],
         }}
 
-    def _lc_update_json(self, upd, with_finality: bool):
-        from lighthouse_tpu.chain.light_client import sync_aggregate_json
-
-        out = {
-            "attested_header": upd.attested_header.to_json(),
-            "sync_aggregate": sync_aggregate_json(upd.sync_aggregate),
-            "signature_slot": str(upd.signature_slot),
-        }
-        if with_finality:
-            out["finalized_header"] = (
-                upd.finalized_header.to_json()
-                if upd.finalized_header else None)
-            out["finality_branch"] = [_hex(b) for b in upd.finality_branch]
-        return {"data": out}
-
     def lc_updates(self, body=None, query=None):
         """Best update per sync-committee period (reference
         /eth/v1/beacon/light_client/updates)."""
@@ -967,17 +1039,20 @@ class BeaconApi:
         # (the one light-client route without the data envelope)
         return [{"version": "altair", "data": u.to_json()} for u in ups]
 
+    # the HTTP, gossip and SSE paths all serialize through the update
+    # classes' to_json — one wire format, no drift
+
     def lc_optimistic(self, body=None):
         upd = self.chain.light_client.latest_optimistic
         if upd is None:
             raise ApiError(404, "no optimistic update yet")
-        return self._lc_update_json(upd, with_finality=False)
+        return {"data": upd.to_json()}
 
     def lc_finality(self, body=None):
         upd = self.chain.light_client.latest_finality
         if upd is None:
             raise ApiError(404, "no finality update yet")
-        return self._lc_update_json(upd, with_finality=True)
+        return {"data": upd.to_json()}
 
     # -- rewards family (standard_block_rewards.rs, lib.rs:2510,
     #    sync_committee_rewards.rs, validator_inclusion.rs,

@@ -13,7 +13,9 @@ class EventStream:
     """Bounded fan-out of chain events to SSE subscribers."""
 
     TOPICS = ("head", "block", "attestation", "finalized_checkpoint",
-              "voluntary_exit", "contribution_and_proof")
+              "voluntary_exit", "contribution_and_proof",
+              "light_client_finality_update",
+              "light_client_optimistic_update")
 
     def __init__(self, capacity: int = 256):
         self.capacity = capacity
@@ -33,6 +35,12 @@ class EventStream:
     def unsubscribe(self, q: queue.Queue) -> None:
         with self._lock:
             self._subs = [(t, s) for t, s in self._subs if s is not q]
+
+    def has_subscribers(self, topic: str) -> bool:
+        """Producers with non-trivial serialization cost gate on this so
+        the import hot path never serializes into the void."""
+        with self._lock:
+            return any(topic in topics for topics, _ in self._subs)
 
     def publish(self, topic: str, data: dict) -> None:
         with self._lock:

@@ -85,6 +85,13 @@ class LightClientOptimisticUpdate:
     sync_aggregate: object
     signature_slot: int
 
+    def to_json(self) -> dict:
+        return {
+            "attested_header": self.attested_header.to_json(),
+            "sync_aggregate": sync_aggregate_json(self.sync_aggregate),
+            "signature_slot": str(self.signature_slot),
+        }
+
 
 @dataclass
 class LightClientFinalityUpdate:
@@ -93,6 +100,17 @@ class LightClientFinalityUpdate:
     finality_branch: list
     sync_aggregate: object
     signature_slot: int
+
+    def to_json(self) -> dict:
+        return {
+            "attested_header": self.attested_header.to_json(),
+            "finalized_header": (self.finalized_header.to_json()
+                                 if self.finalized_header else None),
+            "finality_branch": [
+                "0x" + b.hex() for b in self.finality_branch],
+            "sync_aggregate": sync_aggregate_json(self.sync_aggregate),
+            "signature_slot": str(self.signature_slot),
+        }
 
 
 def _header_for(chain, root: bytes) -> LightClientHeader | None:
@@ -158,6 +176,17 @@ class LightClientUpdate:
         }
 
 
+def _update_rank(participation: int, committee_size: int,
+                 has_finality: bool, attested_slot: int) -> tuple:
+    """Spec `is_better_update` ranking for per-period best updates
+    (sync-protocol.md): supermajority first, then finality presence,
+    then raw participation, then OLDER attested header (earlier proof
+    of the same committee is strictly more useful).  Encoded as a
+    sortable tuple: bigger wins."""
+    supermajority = participation * 3 >= committee_size * 2
+    return (supermajority, has_finality, participation, -attested_slot)
+
+
 class LightClientServerCache:
     """Tracks the best sync-aggregate-attested header per slot."""
 
@@ -167,8 +196,14 @@ class LightClientServerCache:
         self.chain = chain
         self.latest_optimistic: LightClientOptimisticUpdate | None = None
         self.latest_finality: LightClientFinalityUpdate | None = None
-        # sync-committee period -> best (most participation) update
-        self._updates: dict[int, tuple[int, LightClientUpdate]] = {}
+        # sync-committee period -> (rank tuple, best update) — ranked by
+        # the spec's is_better_update ordering, not bare participation
+        self._updates: dict[int, tuple[tuple, LightClientUpdate]] = {}
+        # NetworkService hooks these to gossip fresh updates to the
+        # light_client_{finality,optimistic}_update topics (the
+        # reference's --light-client-server gossip publication)
+        self.on_finality_update = None
+        self.on_optimistic_update = None
 
     def on_block_imported(self, signed_block) -> None:
         """Feed each imported block: its sync aggregate attests the
@@ -185,6 +220,16 @@ class LightClientServerCache:
         sig_slot = int(signed_block.message.slot)
         self.latest_optimistic = LightClientOptimisticUpdate(
             attested, agg, sig_slot)
+        # to_json costs packbits + hex over the committee bits; only pay
+        # it when an SSE subscriber is actually listening
+        if chain.events.has_subscribers("light_client_optimistic_update"):
+            chain.events.publish("light_client_optimistic_update",
+                                 self.latest_optimistic.to_json())
+        if self.on_optimistic_update is not None:
+            try:
+                self.on_optimistic_update(self.latest_optimistic)
+            except Exception:
+                pass
 
         state = chain.state_for_block(attested_root)
         if state is None:
@@ -200,20 +245,31 @@ class LightClientServerCache:
         finality_branch = [epoch_leaf] + branch
         self.latest_finality = LightClientFinalityUpdate(
             attested, fin_header, finality_branch, agg, sig_slot)
+        if chain.events.has_subscribers("light_client_finality_update"):
+            chain.events.publish("light_client_finality_update",
+                                 self.latest_finality.to_json())
+        if self.on_finality_update is not None:
+            try:
+                self.on_finality_update(self.latest_finality)
+            except Exception:
+                pass
 
         # period update: prove the attested state's NEXT sync committee;
-        # keep the best-participation update per period
+        # keep the spec-ranked best update per period (is_better_update)
         if hasattr(state, "next_sync_committee"):
             spec = chain.spec
             period = (spec.compute_epoch_at_slot(attested.slot)
                       // spec.preset.epochs_per_sync_committee_period)
             participation = sum(
                 1 for b in agg.sync_committee_bits if b)
+            rank = _update_rank(
+                participation, spec.preset.sync_committee_size,
+                fin_header is not None, attested.slot)
             best = self._updates.get(period)
-            if best is None or participation > best[0]:
+            if best is None or rank > best[0]:
                 _, nsc_branch, _ = _field_proof(
                     state, "next_sync_committee")
-                self._updates[period] = (participation, LightClientUpdate(
+                self._updates[period] = (rank, LightClientUpdate(
                     attested, state.next_sync_committee, nsc_branch,
                     fin_header, finality_branch, agg, sig_slot))
                 while len(self._updates) > self.MAX_STORED_PERIODS:

@@ -20,12 +20,20 @@ def _h(a: bytes, b: bytes) -> bytes:
     return hashlib.sha256(a + b).digest()
 
 
+def _zero_hashes() -> list[bytes]:
+    out = [b"\x00" * 32]
+    for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+        out.append(_h(out[-1], out[-1]))
+    return out
+
+
+_ZEROS = _zero_hashes()
+
+
 class DepositTree:
     def __init__(self):
         self.leaves: list[bytes] = []
-        self._zeros = [b"\x00" * 32]
-        for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH):
-            self._zeros.append(_h(self._zeros[-1], self._zeros[-1]))
+        self._zeros = _ZEROS
 
     def push(self, deposit_data_root: bytes) -> None:
         self.leaves.append(bytes(deposit_data_root))
@@ -51,6 +59,44 @@ class DepositTree:
         n = len(self.leaves) if count is None else count
         return _h(self._root_at(n), n.to_bytes(32, "little"))
 
+    def _subtree_root(self, offset: int, size: int) -> bytes:
+        """Root of the FULL subtree over leaves[offset:offset+size]
+        (size a power of two)."""
+        level = [bytes(x) for x in self.leaves[offset:offset + size]]
+        while len(level) > 1:
+            level = [_h(level[i], level[i + 1])
+                     for i in range(0, len(level), 2)]
+        return level[0]
+
+    def snapshot(self, count: int | None = None) -> dict:
+        """EIP-4881 deposit tree snapshot: the minimal set of finalized
+        node hashes (full-subtree roots, left to right — one per set bit
+        of count) from which the tree over the first `count` deposits is
+        reconstructible, plus the summary fields the standard
+        /eth/v1/beacon/deposit_snapshot endpoint serves (reference
+        deposit_snapshot.rs / the eip_4881 crate)."""
+        n = len(self.leaves) if count is None else count
+        finalized = []
+        offset = 0
+        for bit in reversed(range(max(n.bit_length(), 1))):
+            size = 1 << bit
+            if n & size:
+                finalized.append(self._subtree_root(offset, size))
+                offset += size
+        return {
+            "finalized": finalized,
+            "deposit_root": self.root(n),
+            "deposit_count": n,
+        }
+
+    @staticmethod
+    def from_snapshot(snapshot: dict) -> "DepositTreeSummary":
+        """Reconstruct a verifier for the snapshot (root recomputation —
+        the EIP-4881 resume path)."""
+        return DepositTreeSummary(
+            [bytes(h) for h in snapshot["finalized"]],
+            int(snapshot["deposit_count"]))
+
     def proof(self, index: int, count: int | None = None) -> list[bytes]:
         """33-element branch (32 siblings + length mix-in) proving leaf
         `index` against root(count)."""
@@ -73,3 +119,35 @@ class DepositTree:
             idx //= 2
         path.append(n.to_bytes(32, "little"))
         return path
+
+
+class DepositTreeSummary:
+    """Deposit tree reconstructed from an EIP-4881 snapshot: enough to
+    recompute deposit_root and keep appending new deposits WITHOUT the
+    pre-snapshot leaves (the whole point of the format — a checkpoint-
+    synced node never replays historical deposit logs)."""
+
+    def __init__(self, finalized: list[bytes], deposit_count: int):
+        self.finalized = list(finalized)
+        self.deposit_count = int(deposit_count)
+        self._zeros = _ZEROS
+
+    def root(self) -> bytes:
+        """deposit_root from the finalized subtree roots alone (must
+        equal DepositTree.root(count)).
+
+        Depth walk: `node` is the root of the rightmost partial region
+        at depth d.  A set bit of count at depth d means a full finalized
+        subtree sits to the LEFT (consume the next ascending-size root);
+        a clear bit means the region's right sibling is all zeros."""
+        n = self.deposit_count
+        fin = list(reversed(self.finalized))      # ascending sizes
+        node = self._zeros[0]
+        i = 0
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if (n >> d) & 1:
+                node = _h(fin[i], node)
+                i += 1
+            else:
+                node = _h(node, self._zeros[d])
+        return _h(node, n.to_bytes(32, "little"))

@@ -288,10 +288,7 @@ class Router:
         upd = self.chain.light_client.latest_optimistic
         if upd is None:
             return []
-        return [_json.dumps({
-            "attested_header": upd.attested_header.to_json(),
-            "signature_slot": upd.signature_slot,
-        }).encode()]
+        return [_json.dumps(upd.to_json()).encode()]
 
     def _serve_lc_finality(self, src: str, data: bytes) -> list[bytes]:
         import json as _json
@@ -299,14 +296,26 @@ class Router:
         upd = self.chain.light_client.latest_finality
         if upd is None:
             return []
-        return [_json.dumps({
-            "attested_header": upd.attested_header.to_json(),
-            "finalized_header": (upd.finalized_header.to_json()
-                                 if upd.finalized_header else None),
-            "signature_slot": upd.signature_slot,
-        }).encode()]
+        return [_json.dumps(upd.to_json()).encode()]
 
     # -- publishing ---------------------------------------------------------
+
+    def publish_lc_finality_update(self, update):
+        """Gossip a fresh finality update to subscribed light clients
+        (reference light_client_finality_update topic, gated behind
+        --light-client-server)."""
+        import json as _json
+
+        self.gossip.publish(
+            topic(self.chain, "light_client_finality_update"),
+            _json.dumps(update.to_json()).encode())
+
+    def publish_lc_optimistic_update(self, update):
+        import json as _json
+
+        self.gossip.publish(
+            topic(self.chain, "light_client_optimistic_update"),
+            _json.dumps(update.to_json()).encode())
 
     def publish_block(self, signed_block):
         self.gossip.publish(

@@ -61,6 +61,12 @@ class NetworkService:
             subnet_service=subnet_service)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
+        # gossip fresh light-client updates as the chain mints them
+        # (reference --light-client-server gossip publication)
+        chain.light_client.on_finality_update = \
+            self.router.publish_lc_finality_update
+        chain.light_client.on_optimistic_update = \
+            self.router.publish_lc_optimistic_update
         # socket fabrics: bind the peer manager to the transport — ban
         # gate at the HELLO door, connection bookkeeping for pruning
         node = getattr(fabric, "node", None)

@@ -110,6 +110,64 @@ class TestStandardApiBreadth:
         with urllib.request.urlopen(req, timeout=5) as r:
             return json.loads(r.read())
 
+    def test_headers_list(self, api_setup):
+        h, chain, client = api_setup
+        out = self._get(client, "/eth/v1/beacon/headers")
+        assert out["data"], "headers list empty"
+        head_row = out["data"][0]
+        assert head_row["root"] == "0x" + chain.head_root.hex()
+        slot = int(head_row["header"]["message"]["slot"])
+        by_slot = self._get(client, f"/eth/v1/beacon/headers?slot={slot}")
+        assert by_slot["data"] and by_slot["data"][0]["root"] == \
+            head_row["root"]
+        parent = head_row["header"]["message"]["parent_root"]
+        by_parent = self._get(
+            client, f"/eth/v1/beacon/headers?parent_root={parent}")
+        assert (not by_parent["data"]
+                or by_parent["data"][0]["root"] == head_row["root"])
+        # a skipped slot has no header: empty list, not the previous
+        # block echoed back (at-or-before semantics must not leak)
+        empty = self._get(client,
+                          f"/eth/v1/beacon/headers?slot={slot + 1}")
+        assert empty["data"] == []
+        # malformed query values are 400, not 500
+        import urllib.error
+        try:
+            self._get(client, "/eth/v1/beacon/headers?slot=abc")
+            assert False, "expected HTTP error"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_deposit_snapshot(self, api_setup):
+        from lighthouse_tpu.eth1.deposit_tree import DepositTree
+        from lighthouse_tpu.eth1.service import (
+            Eth1Service,
+            MockEth1Endpoint,
+        )
+
+        h, chain, client = api_setup
+        ep = MockEth1Endpoint()
+        for i in range(5):
+            ep.add_deposit(bytes([i]) * 48, bytes(32), 32 * 10**9,
+                           bytes([i]) * 96)
+            ep.mine_block()
+        for _ in range(20):
+            ep.mine_block()   # clear the follow distance
+        svc = Eth1Service(ep, h.spec)
+        svc.update()
+        chain.eth1_service = svc
+        try:
+            out = self._get(client, "/eth/v1/beacon/deposit_snapshot")["data"]
+            assert out["deposit_count"] == "5"
+            snap = {"finalized": [bytes.fromhex(x[2:])
+                                  for x in out["finalized"]],
+                    "deposit_count": int(out["deposit_count"])}
+            rebuilt = DepositTree.from_snapshot(snap)
+            assert "0x" + rebuilt.root().hex() == out["deposit_root"]
+            assert int(out["execution_block_height"]) >= 0
+        finally:
+            chain.eth1_service = None
+
     def test_state_fork(self, api_setup):
         h, chain, client = api_setup
         out = self._get(client, "/eth/v1/beacon/states/head/fork")["data"]

@@ -168,6 +168,47 @@ class TestLightClient:
             len(bs.current_sync_committee_branch), idx,
             state.hash_tree_root())
 
+    def test_update_ranking_spec_order(self):
+        """is_better_update ordering: supermajority beats participation,
+        finality beats none, older attested header wins ties."""
+        from lighthouse_tpu.chain.light_client import _update_rank
+
+        size = 32
+        super_no_fin = _update_rank(22, size, False, 10)
+        minority_fin = _update_rank(12, size, True, 10)
+        assert super_no_fin > minority_fin          # supermajority first
+        fin = _update_rank(22, size, True, 10)
+        assert fin > super_no_fin                   # then finality
+        more_part = _update_rank(30, size, True, 10)
+        assert more_part > fin                      # then participation
+        older = _update_rank(22, size, True, 8)
+        assert older > fin                          # then older attested
+
+    def test_sse_and_gossip_publication(self, node):
+        import json
+
+        h, chain, vc = node
+        q = chain.events.subscribe(["light_client_finality_update",
+                                    "light_client_optimistic_update"])
+        published = []
+        chain.light_client.on_finality_update = \
+            lambda u: published.append(("fin", u))
+        chain.light_client.on_optimistic_update = \
+            lambda u: published.append(("opt", u))
+        for slot in (1, 2):
+            chain.slot_clock.set_slot(slot)
+            vc.run_slot(slot)
+        kinds = [k for k, _ in published]
+        assert "opt" in kinds and "fin" in kinds
+        topics = set()
+        while not q.empty():
+            topic, data = q.get_nowait()
+            topics.add(topic)
+            assert "attested_header" in data and "sync_aggregate" in data
+            json.dumps(data)  # SSE-serializable
+        assert topics == {"light_client_finality_update",
+                          "light_client_optimistic_update"}
+
     def test_lc_http_endpoints(self, node):
         import json
 

@@ -52,6 +52,22 @@ class TestDepositTree:
                     datas[idx].hash_tree_root(), proof, 33, idx, root), \
                     (idx, count)
 
+    def test_snapshot_roundtrip(self):
+        """EIP-4881: the snapshot's finalized subtree roots alone must
+        reproduce deposit_root, at every tree size including powers of
+        two and zero."""
+        from lighthouse_tpu.eth1.deposit_tree import DepositTree
+
+        t = DepositTree()
+        for n in (0, 1, 2, 3, 4, 7, 8, 13, 16, 21):
+            while len(t) < n:
+                t.push(bytes([len(t) + 1] * 32))
+            snap = t.snapshot()
+            assert snap["deposit_count"] == n
+            assert bin(n).count("1") == len(snap["finalized"])
+            rebuilt = DepositTree.from_snapshot(snap)
+            assert rebuilt.root() == t.root(), f"mismatch at n={n}"
+
     def test_proof_outside_count_rejected(self):
         tree = DepositTree()
         tree.push(b"\x01" * 32)
