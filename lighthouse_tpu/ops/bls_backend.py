@@ -182,6 +182,28 @@ def _next_pow2(x: int, floor: int = 1) -> int:
     return max(floor, 1 << max(x - 1, 0).bit_length())
 
 
+def _grouped_layout(n: int, n_groups: int,
+                    max_sz: int) -> tuple[int | None, int, int]:
+    """(seg, g_pad, padded_flat) for the grouped pipeline; seg is None
+    when the batch should use the flat layout.
+
+    QUANTIZED so jit shapes cannot churn with batch composition: seg is
+    forced to exactly padded_flat//g_pad or 2·padded_flat//g_pad (lane
+    total == one or two flat layouts), never to next_pow2(max group
+    size).  Before this, a 32k-attestation flood compiled a fresh fused
+    program per batch whose committee mix shifted seg — each XLA compile
+    costs minutes and the bench child has a hard timeout."""
+    g_pad = _next_pow2(n_groups, floor=2)
+    padded_flat = _next_pow2(n, floor=4)
+    if n_groups >= n:
+        return None, g_pad, padded_flat
+    for total in (padded_flat, 2 * padded_flat):
+        seg = total // g_pad
+        if seg >= max_sz:
+            return seg, g_pad, padded_flat
+    return None, g_pad, padded_flat
+
+
 @partial(jax.jit, static_argnums=(5,))
 def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
     """Segmented G1 sum over (pubkey + blinding) lanes, minus the
@@ -449,19 +471,14 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     # satisfy Π e(r_i·pk_i, H(m)) = e(Σ r_i·pk_i, H(m)), so the expensive
     # Miller lanes shrink from n sets to G distinct messages.  Lanes are
     # laid out s-major over (segment, group) for g1_segment_sum; padding
-    # lanes carry zero scalars (infinity = group identity).  Guard: skew
-    # batches whose padded S·G layout would exceed twice the flat layout
-    # fall back to the ungrouped pipeline.
+    # lanes carry zero scalars (infinity = group identity).
     groups: dict[bytes, list[int]] = {}
     for i, s in enumerate(sets):
         groups.setdefault(s.message, []).append(i)
     n_groups = len(groups)
     max_sz = max(len(v) for v in groups.values())
-    seg = _next_pow2(max_sz)
-    g_pad = _next_pow2(n_groups, floor=2)
-    padded_flat = _next_pow2(n, floor=4)
-    use_grouped = (n_groups < n
-                   and seg * g_pad <= 2 * padded_flat)
+    seg, g_pad, padded_flat = _grouped_layout(n, n_groups, max_sz)
+    use_grouped = seg is not None
 
     if use_grouped:
         order = list(groups.values())  # group g -> member set indices

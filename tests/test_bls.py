@@ -286,3 +286,37 @@ def test_device_final_exp_matches_host():
     out = jax.jit(dev.final_exp_hard_device)(dev.fq12_to_device(m))
     got = dev.fq12_from_device(jax.tree_util.tree_map(np.asarray, out))
     assert got == final_exp_hard(m)
+
+
+def test_grouped_layout_quantized():
+    """jit shapes must not churn with batch composition: the grouped
+    layout's lane total is exactly one or two flat layouts, seg stays a
+    power of two (g1_segment_sum's contract), and unquantizable batches
+    fall back to flat (seg None)."""
+    from lighthouse_tpu.ops.bls_backend import _grouped_layout
+
+    # the canonical ledger shape: 1024 sets over 64 messages, 16 each
+    seg, g_pad, flat = _grouped_layout(1024, 64, 16)
+    assert (seg, g_pad, flat) == (16, 64, 1024)
+    # a skewed committee mix bumps seg to the 2x bucket, not to
+    # next_pow2(max_sz)
+    seg2, g_pad2, flat2 = _grouped_layout(2048, 64, 40)
+    assert (seg2, g_pad2, flat2) == (64, 64, 2048)
+    assert seg2 * g_pad2 == 2 * flat2
+    # only two possible lane totals for any composition at this size
+    totals = {
+        _grouped_layout(2048, 64, m)[0] * 64
+        for m in (1, 7, 20, 32, 33, 64)}
+    assert totals <= {2048, 4096}
+    # hopelessly skewed: one group holds nearly everything -> flat
+    assert _grouped_layout(2048, 64, 100)[0] is None
+    # degenerate: all distinct messages -> flat
+    assert _grouped_layout(64, 64, 1)[0] is None
+    # seg power-of-two invariant across a sweep
+    for n in (8, 64, 512, 4096):
+        for g in (2, 8, 32):
+            for m in (1, 3, n // g if g < n else 1):
+                seg_i, g_i, _ = _grouped_layout(n, min(g, n - 1), m)
+                if seg_i is not None:
+                    assert seg_i & (seg_i - 1) == 0
+                    assert seg_i >= m
