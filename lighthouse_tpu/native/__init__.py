@@ -33,7 +33,7 @@ def build_shared_lib(source_name: str) -> Path:
         return out
     _BUILD.mkdir(exist_ok=True)
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
         str(src), "-o", str(out),
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)

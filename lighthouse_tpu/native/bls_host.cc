@@ -38,6 +38,8 @@ Fp ZERO = {{0, 0, 0, 0, 0, 0}};
 // big-endian byte exponents, filled by init
 uint8_t EXP_P_MINUS_2[48];   // for Fermat inversion
 uint8_t EXP_SQRT[48];        // (p+1)/4
+uint8_t EXP_PM3_4[48];       // (p-3)/4: u = t^((p-3)/4) gives sqrt AND
+                             // inverse at once (ya = u·t, 1/ya = u)
 uint8_t EXP_FROB[48];        // (p-1)/6
 
 inline bool geq(const Fp& a, const Fp& b) {
@@ -136,16 +138,28 @@ void mont_mul(Fp& out, const Fp& a, const Fp& b) {
 
 inline void mont_sqr(Fp& out, const Fp& a) { mont_mul(out, a, a); }
 
-// modexp over a big-endian byte exponent (value in Montgomery domain)
+// modexp over a big-endian byte exponent (value in Montgomery domain).
+// Fixed 4-bit window: 14 table muls + 1 mul per nonzero nibble beats the
+// ~190 muls of bit-at-a-time for the 381-bit exponents every
+// decompression runs (sqrt + inversion are the host hot path).
 void fp_pow(Fp& out, const Fp& base, const uint8_t* exp, int nbytes) {
+    Fp tbl[16];
+    tbl[1] = base;
+    for (int i = 2; i < 16; i++) mont_mul(tbl[i], tbl[i - 1], base);
     Fp acc = ONE_M;
     bool started = false;
     for (int i = 0; i < nbytes; i++) {
-        for (int bit = 7; bit >= 0; bit--) {
-            if (started) mont_sqr(acc, acc);
-            if ((exp[i] >> bit) & 1) {
-                if (started) mont_mul(acc, acc, base);
-                else { acc = base; started = true; }
+        for (int half = 1; half >= 0; half--) {
+            int nib = (exp[i] >> (4 * half)) & 0xF;
+            if (started) {
+                mont_sqr(acc, acc);
+                mont_sqr(acc, acc);
+                mont_sqr(acc, acc);
+                mont_sqr(acc, acc);
+                if (nib) mont_mul(acc, acc, tbl[nib]);
+            } else if (nib) {
+                acc = tbl[nib];
+                started = true;
             }
         }
     }
@@ -305,8 +319,12 @@ bool f2_sqrt(Fp2& out, const Fp2& x) {
         if (sign == 0) add(base, x.a, s);
         else sub(base, x.a, s);
         mont_mul(base, base, INV2_M);       // t = (a ± s)/2
-        Fp ya;
-        fp_pow(ya, base, EXP_SQRT, 48);
+        // ONE exponentiation gives both the sqrt and the inverse:
+        // u = t^((p-3)/4)  =>  ya = u·t = t^((p+1)/4), and for a QR t,
+        // ya·u = t^((p-1)/2) = 1 so 1/ya = u — no Fermat inversion pow
+        Fp u, ya;
+        fp_pow(u, base, EXP_PM3_4, 48);
+        mont_mul(ya, u, base);
         mont_sqr(chk, ya);
         if (!eq(chk, base)) continue;
         if (is_zero(ya)) {
@@ -321,11 +339,9 @@ bool f2_sqrt(Fp2& out, const Fp2& x) {
             if (f2_eq(sq, x)) { out = cand; return true; }
             continue;
         }
-        Fp two_ya, inv;
-        add(two_ya, ya, ya);
-        fp_inv(inv, two_ya);
-        Fp yb;
-        mont_mul(yb, x.b, inv);
+        Fp yb;                              // yb = b/(2 ya) = b·u·2^-1
+        mont_mul(yb, x.b, u);
+        mont_mul(yb, yb, INV2_M);
         Fp2 cand = {ya, yb};
         Fp2 sq;
         f2_sqr(sq, cand);
@@ -589,6 +605,10 @@ void do_init() {
     p1.l[0] += 1;
     limbs_div_small(e, p1, 4);
     limbs_to_be_bytes(EXP_SQRT, e);
+    Fp pm3;
+    sub_nored(pm3, P, Fp{{3, 0, 0, 0, 0, 0}});
+    limbs_div_small(e, pm3, 4);
+    limbs_to_be_bytes(EXP_PM3_4, e);
     Fp pm1;
     sub_nored(pm1, P, Fp{{1, 0, 0, 0, 0, 0}});
     limbs_div_small(e, pm1, 6);

@@ -51,3 +51,22 @@ def _restore_bls_backend():
     old = bls.get_backend()
     yield
     bls.set_backend(old)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_vma_growth():
+    """One full-suite process accumulates a memory map per JIT-loaded
+    executable; at ~150 tests the count crosses vm.max_map_count (65530)
+    and the NEXT XLA compile dies with SIGABRT/SIGSEGV inside mmap
+    (reproduced: the maps monitor read 61k lines right before the
+    crash).  Dropping jax's in-process executable caches when the map
+    count runs high keeps the suite under the ceiling; the persistent
+    compile cache makes any re-load cheap."""
+    yield
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:
+        return
+    if n > 40_000:
+        jax.clear_caches()
