@@ -311,59 +311,79 @@ class BeaconApi:
 
     def headers_list(self, body=None, query=None):
         """Standard headers LIST route: ?slot= and/or ?parent_root=
-        filters over canonical blocks; bare = the head header
+        filters over ALL known headers (canonical and not, with the
+        canonical flag set per fork choice); bare = the head header
         (reference http_api get_beacon_headers)."""
         query = query or {}
         c = self.chain
-        roots: list[bytes] = []
         want_slot = None
+        want_parent = None
         if "slot" in query:
             try:
                 want_slot = int(query["slot"])
             except ValueError:
                 raise ApiError(400, "invalid slot")
-            root = c.block_root_at_slot(want_slot)
-            if root is not None:
-                roots.append(root)
-        elif "parent_root" in query:
+        if "parent_root" in query:
             try:
-                want = bytes.fromhex(
+                want_parent = bytes.fromhex(
                     query["parent_root"].removeprefix("0x"))
             except ValueError:
                 raise ApiError(400, "invalid parent_root")
-            # the canonical child sits within the skip-slot gap after the
-            # parent: bound the scan there instead of walking the whole
-            # chain from head
-            parent_blk = c.store.get_block(want)
-            if parent_blk is not None:
-                p_slot = int(parent_blk.message.slot)
-                head_slot = int(c.head_state.slot)
-                for s in range(p_slot + 1, min(
-                        p_slot + 1 + c.spec.preset.slots_per_historical_root,
-                        head_slot + 1)):
-                    root = c.block_root_at_slot(s)
-                    if root is None or root == want:
-                        continue
-                    blk = c.store.get_block(root)
-                    if blk is not None and \
-                            bytes(blk.message.parent_root) == want:
-                        roots.append(root)
-                    break
-        else:
-            roots.append(c.head_root)
-        rows = []
-        for root in roots:
+        def _matches(m) -> bool:
+            return ((want_slot is None or int(m.slot) == want_slot) and
+                    (want_parent is None or
+                     bytes(m.parent_root) == want_parent))
+
+        candidates: list[tuple[bytes, object]] = []
+        seen: set[bytes] = set()
+
+        def _add(root: bytes) -> bool:
+            if root in seen:
+                return False
             blk = c.store.get_block(root)
-            if blk is None:
-                continue
+            if blk is not None and _matches(blk.message):
+                seen.add(root)
+                candidates.append((root, blk))
+                return True
+            return False
+
+        if want_slot is None and want_parent is None:
+            _add(c.head_root)
+        else:
+            if want_slot is not None:
+                # canonical fast path covers finalized history too
+                root = c.block_root_at_slot(want_slot)
+                if root is not None:
+                    _add(root)
+            # fork headers from the hot DB (all non-finalized blocks);
+            # summary-level filters avoid deserializing every block
+            for root, slot, parent in c.store.iter_hot_block_summaries():
+                if want_slot is not None and slot != want_slot:
+                    continue
+                if want_parent is not None and parent != want_parent:
+                    continue
+                _add(root)
+            if want_parent is not None and not candidates:
+                # parent already finalized: its canonical child sits in
+                # the skip-slot gap after it — bounded forward scan
+                parent_blk = c.store.get_block(want_parent)
+                if parent_blk is not None:
+                    p_slot = int(parent_blk.message.slot)
+                    sphr = c.spec.preset.slots_per_historical_root
+                    head_slot = int(c.head_state.slot)
+                    for s in range(p_slot + 1,
+                                   min(p_slot + 1 + sphr, head_slot + 1)):
+                        root = c.block_root_at_slot(s)
+                        if root is None or root == want_parent:
+                            continue
+                        _add(root)
+                        break
+        rows = []
+        for root, blk in candidates:
             m = blk.message
-            if want_slot is not None and int(m.slot) != want_slot:
-                # block_root_at_slot returns the latest block AT-OR-BEFORE
-                # the slot; a skipped slot has no header (empty list)
-                continue
             rows.append({
                 "root": _hex(root),
-                "canonical": True,
+                "canonical": self._is_canonical(root, int(m.slot)),
                 "header": {"message": {
                     "slot": str(int(m.slot)),
                     "proposer_index": str(int(m.proposer_index)),
@@ -375,6 +395,20 @@ class BeaconApi:
         return {"data": rows,
                 "execution_optimistic": False, "finalized": False}
 
+    def _is_canonical(self, root: bytes, slot: int) -> bool:
+        """Is `root` the canonical block at `slot`?  block_root_at_slot
+        covers finalized history and the head state's block_roots
+        window; during long non-finality a canonical hot block can fall
+        outside both, so fall back to fork-choice ancestry of head."""
+        c = self.chain
+        r = c.block_root_at_slot(slot)
+        if r is not None:
+            return r == root
+        try:
+            return c.fork_choice.proto.is_descendant(root, c.head_root)
+        except Exception:
+            return False
+
     def deposit_snapshot(self, body=None):
         """EIP-4881 deposit tree snapshot
         (/eth/v1/beacon/deposit_snapshot; reference http_api
@@ -382,16 +416,47 @@ class BeaconApi:
         svc = self.chain.eth1_service
         if svc is None or getattr(svc, "tree", None) is None:
             raise ApiError(404, "no eth1 service attached")
-        snap = svc.tree.snapshot()
-        block = svc.blocks[-1] if getattr(svc, "blocks", None) else None
+        # EIP-4881: the snapshot covers FINALIZED deposits only — a
+        # follow-head snapshot could be invalidated by an eth1 reorg
+        # deeper than the follow distance; the finalized checkpoint's
+        # eth1_data is reorg-immune
+        try:
+            fin_state = self._state("finalized")
+        except ApiError:
+            fin_state = None
+        fin_count = 0
+        fin_hash = b"\x00" * 32
+        if fin_state is not None:
+            fin_count = int(fin_state.eth1_data.deposit_count)
+            fin_hash = bytes(fin_state.eth1_data.block_hash)
+        if fin_count == 0:
+            raise ApiError(404, "no finalized deposit snapshot available")
+        if fin_count > len(svc.tree):
+            # a clamped snapshot would advertise the finalized block hash
+            # while covering fewer deposits than that block commits to —
+            # a resuming client would permanently skip the gap
+            raise ApiError(
+                404, "deposit tree not yet synced to the finalized count")
+        snap = svc.tree.snapshot(count=fin_count)
+        blocks = getattr(svc, "blocks", []) or []
+        block = next((b for b in blocks
+                      if bytes(b.hash) == fin_hash), None)
+        if block is None:
+            # finalized hash not in the followed window (e.g. an anchor
+            # state's pre-follow hash): any followed block committing to
+            # exactly fin_count deposits pairs consistently (EIP-4881
+            # requires hash and height to describe the SAME block)
+            block = next((b for b in blocks
+                          if int(b.deposit_count) == fin_count), None)
+        if block is None:
+            raise ApiError(
+                404, "finalized execution block not in the followed range")
         return {"data": {
             "finalized": [_hex(h) for h in snap["finalized"]],
             "deposit_root": _hex(snap["deposit_root"]),
             "deposit_count": str(snap["deposit_count"]),
-            "execution_block_hash": _hex(
-                block.hash if block is not None else b"\x00" * 32),
-            "execution_block_height": str(
-                block.number if block is not None else 0),
+            "execution_block_hash": _hex(block.hash),
+            "execution_block_height": str(block.number),
         }}
 
     def block(self, block_id, body=None):

@@ -656,13 +656,11 @@ class BeaconChain:
 
     def sync_committee_rows(self, state, slot: int) -> np.ndarray:
         """Cached uint8[size, 48] pubkey rows of the committee at `slot`."""
-        epoch = self.spec.compute_epoch_at_slot(int(slot))
-        period = epoch // self.spec.preset.epochs_per_sync_committee_period
-        state_epoch = self.spec.compute_epoch_at_slot(int(state.slot))
+        period = self.spec.sync_committee_period_at_slot(int(slot))
         committee = (
             state.current_sync_committee
-            if period == state_epoch
-            // self.spec.preset.epochs_per_sync_committee_period
+            if period == self.spec.sync_committee_period_at_slot(
+                int(state.slot))
             else state.next_sync_committee)
         key = bytes(committee.aggregate_pubkey)
         rows = self._sync_rows_cache.get(key)

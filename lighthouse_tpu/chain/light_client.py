@@ -176,15 +176,28 @@ class LightClientUpdate:
         }
 
 
-def _update_rank(participation: int, committee_size: int,
-                 has_finality: bool, attested_slot: int) -> tuple:
-    """Spec `is_better_update` ranking for per-period best updates
-    (sync-protocol.md): supermajority first, then finality presence,
-    then raw participation, then OLDER attested header (earlier proof
-    of the same committee is strictly more useful).  Encoded as a
-    sortable tuple: bigger wins."""
+def _update_rank(spec, participation: int, committee_size: int,
+                 attested_slot: int, signature_slot: int,
+                 finalized_slot: int | None) -> tuple:
+    """Spec `is_better_update` ordering for per-period best updates
+    (sync-protocol.md), encoded as a sortable tuple (bigger wins),
+    field for field: supermajority; participation when neither side has
+    supermajority (the spec compares it early only in that branch — a
+    zero placeholder keeps supermajority pairs falling through);
+    relevance (attested period == signature period); finality presence;
+    sync-committee finality (finalized period == attested period); raw
+    participation; then OLDER attested header and OLDER signature slot
+    (earlier proof of the same committee is strictly more useful)."""
+    _period_at = spec.sync_committee_period_at_slot
     supermajority = participation * 3 >= committee_size * 2
-    return (supermajority, has_finality, participation, -attested_slot)
+    relevant = _period_at(attested_slot) == _period_at(signature_slot)
+    has_finality = finalized_slot is not None
+    sync_committee_finality = has_finality and (
+        _period_at(finalized_slot) == _period_at(attested_slot))
+    return (supermajority,
+            0 if supermajority else participation,
+            relevant, has_finality, sync_committee_finality,
+            participation, -int(attested_slot), -int(signature_slot))
 
 
 class LightClientServerCache:
@@ -258,13 +271,13 @@ class LightClientServerCache:
         # keep the spec-ranked best update per period (is_better_update)
         if hasattr(state, "next_sync_committee"):
             spec = chain.spec
-            period = (spec.compute_epoch_at_slot(attested.slot)
-                      // spec.preset.epochs_per_sync_committee_period)
+            period = spec.sync_committee_period_at_slot(attested.slot)
             participation = sum(
                 1 for b in agg.sync_committee_bits if b)
             rank = _update_rank(
-                participation, spec.preset.sync_committee_size,
-                fin_header is not None, attested.slot)
+                spec, participation, spec.preset.sync_committee_size,
+                attested.slot, sig_slot,
+                fin_header.slot if fin_header is not None else None)
             best = self._updates.get(period)
             if best is None or rank > best[0]:
                 _, nsc_branch, _ = _field_proof(

@@ -177,6 +177,21 @@ class HotColdDB:
             yield key[len(P_BLOCK):], self._block_cls(slot).deserialize(
                 raw[8:])
 
+    def iter_hot_block_summaries(self):
+        """(root, slot, parent_root) for every hot block WITHOUT a full
+        SSZ decode: the 8-byte slot prefix plus the fixed SSZ layout of
+        SignedBeaconBlock — [message offset u32][signature 96B][message
+        ...] with BeaconBlock's fixed head slot u64, proposer u64,
+        parent_root 32B, so parent_root sits at message+16.  Filtered
+        header/admin scans use this to avoid deserializing every block
+        (the full decode costs ~1000x the prefix parse)."""
+        for key, raw in self.hot.iter_prefix(P_BLOCK):
+            slot = int.from_bytes(raw[:8], "little")
+            body = raw[8:]
+            moff = int.from_bytes(body[:4], "little")
+            parent = bytes(body[moff + 16: moff + 48])
+            yield key[len(P_BLOCK):], slot, parent
+
     def delete_block(self, root: bytes) -> None:
         self.hot.delete(P_BLOCK + root)
 

@@ -300,6 +300,11 @@ class ChainSpec:
     def compute_activation_exit_epoch(self, epoch: int) -> int:
         return epoch + 1 + self.max_seed_lookahead
 
+    def sync_committee_period_at_slot(self, slot: int) -> int:
+        """compute_sync_committee_period_at_slot (altair validator.md)."""
+        return (self.compute_epoch_at_slot(int(slot))
+                // self.preset.epochs_per_sync_committee_period)
+
     def balance_churn_limit(self, active_validator_count: int) -> int:
         return max(
             self.min_per_epoch_churn_limit,

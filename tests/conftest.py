@@ -42,6 +42,93 @@ except Exception:  # private API may move across jax versions; best-effort only
     pass
 
 
+def pytest_runtestloop(session):
+    """Per-file process isolation for multi-file suite runs.
+
+    A single long-lived process that JIT-loads every executable the suite
+    compiles crosses the kernel's vm.max_map_count ceiling (~test 167 of
+    571 on this image) and the next XLA compile segfaults inside mmap;
+    in-process cache clearing (the module fixture below) only delays the
+    ceiling and was judged not to hold.  So when one pytest invocation
+    spans more than one test file, each file's selected tests run in a
+    short-lived child process — `pytest tests` stays the reference's
+    one-command UX (/root/reference/Makefile:105-119) while every child
+    stays far below the map ceiling.  Single-file invocations (and the
+    children themselves, marked by LHTPU_ISOLATED) run in-process as
+    usual.  The persistent .jax_cache keeps re-compiles across children
+    cheap.
+    """
+    if os.environ.get("LHTPU_ISOLATED") == "1":
+        return None  # already inside a per-file child
+    if session.config.getoption("collectonly", default=False):
+        return None
+    by_file: dict[str, list] = {}
+    for item in session.items:
+        by_file.setdefault(str(item.path), []).append(item)
+    if len(by_file) <= 1:
+        return None
+
+    import re
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ)
+    env["LHTPU_ISOLATED"] = "1"
+    rootdir = str(session.config.rootpath)
+    # -x / --maxfail store into the `maxfail` dest (0 = unlimited)
+    maxfail = int(session.config.getoption("maxfail", default=0) or 0)
+    # forward the user-visible run options children would otherwise lose
+    opt = session.config.option
+    extra: list[str] = []
+    verbose = int(getattr(opt, "verbose", 0) or 0)
+    extra += ["-v"] * verbose if verbose > 0 else ["-q"]
+    tb = getattr(opt, "tbstyle", "auto")
+    if tb and tb != "auto":
+        extra.append(f"--tb={tb}")
+    for w in session.config.getoption("pythonwarnings", default=None) or []:
+        extra += ["-W", w]
+    child_base = [sys.executable, "-m", "pytest", "--no-header", *extra]
+    failed: list[tuple[str, int]] = []
+    remaining = maxfail
+    files = sorted(by_file)
+    t0 = time.time()
+    for i, path in enumerate(files, 1):
+        ids = [it.nodeid for it in by_file[path]]
+        rel = os.path.relpath(path, rootdir)
+        sys.stdout.write(
+            f"[isolated {i}/{len(files)}] {rel} ({len(ids)} tests)\n")
+        sys.stdout.flush()
+        cmd = [*child_base,
+               *([f"--maxfail={remaining}"] if maxfail else []), *ids]
+        proc = subprocess.run(cmd, cwd=rootdir, env=env,
+                              capture_output=True, text=True)
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        sys.stdout.flush()
+        if proc.returncode != 0:
+            # count the child's failed+errored TESTS against the budget
+            # (a crashed child with no summary line counts as 1)
+            counted = sum(int(n) for n in re.findall(
+                r"(\d+) (?:failed|error)", proc.stdout)) or 1
+            failed.append((rel, proc.returncode))
+            session.testsfailed += counted
+            if maxfail:
+                remaining -= counted
+                if remaining <= 0:
+                    break
+    dt = time.time() - t0
+    if failed:
+        sys.stdout.write(
+            f"[isolated] {len(failed)}/{len(files)} files FAILED "
+            f"in {dt:.0f}s: {', '.join(f for f, _ in failed)}\n")
+    else:
+        sys.stdout.write(
+            f"[isolated] all {len(files)} files passed in {dt:.0f}s\n")
+    sys.stdout.flush()
+    return True
+
+
 @pytest.fixture(autouse=True)
 def _restore_bls_backend():
     """ClientBuilder pins the process-global BLS backend (auto/fake/...);

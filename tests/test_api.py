@@ -145,8 +145,13 @@ class TestStandardApiBreadth:
             MockEth1Endpoint,
         )
 
+        import urllib.error
+
         h, chain, client = api_setup
         ep = MockEth1Endpoint()
+        # fewer deposits than the finalized (genesis) eth1_data count
+        # (= 32 validators): the snapshot must 404, not clamp — a
+        # clamped snapshot would skip deposits on resume (EIP-4881)
         for i in range(5):
             ep.add_deposit(bytes([i]) * 48, bytes(32), 32 * 10**9,
                            bytes([i]) * 96)
@@ -157,8 +162,22 @@ class TestStandardApiBreadth:
         svc.update()
         chain.eth1_service = svc
         try:
+            try:
+                self._get(client, "/eth/v1/beacon/deposit_snapshot")
+                assert False, "expected 404 for under-synced tree"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            # sync the tree past the finalized count: snapshot covers
+            # exactly the finalized deposits, not the follow head
+            for i in range(5, 40):
+                ep.add_deposit(bytes([i % 256]) * 48, bytes(32),
+                               32 * 10**9, bytes([i % 256]) * 96)
+                ep.mine_block()
+            for _ in range(20):
+                ep.mine_block()
+            svc.update()
             out = self._get(client, "/eth/v1/beacon/deposit_snapshot")["data"]
-            assert out["deposit_count"] == "5"
+            assert out["deposit_count"] == "32"   # finalized, not 40
             snap = {"finalized": [bytes.fromhex(x[2:])
                                   for x in out["finalized"]],
                     "deposit_count": int(out["deposit_count"])}

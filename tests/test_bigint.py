@@ -103,6 +103,53 @@ def test_deep_chain_keeps_bounds(rand_vals):
     assert maxlimb < (1 << 15) + (1 << 12), maxlimb
 
 
+def _spread_limbs(v: int,
+                  limit: int = (1 << 15) + (1 << 11) - 1) -> np.ndarray:
+    """Worst-case redundant encoding of v: same value, limbs pushed to
+    the op-invariant bound by borrowing 2^15-units from higher limbs."""
+    d = [int(x) for x in bi._int_to_limbs(v)]
+    for i in range(bi.L - 1):
+        m = min(d[i + 1], (limit - d[i]) >> bi.B)
+        d[i] += m << bi.B
+        d[i + 1] -= m
+    out = np.array(d, np.uint32)
+    assert bi._limbs_to_int(out) == v
+    return out
+
+
+def test_is_zero_mod_p_device_bound_coupling():
+    """is_zero_mod_p_device's completeness rests on the mont-mul-by-one
+    output staying inside the {0..4P} comparison set; exercise redundant
+    encodings of kP and kP+eps (k=0..4, worst-case limb spreads, plus a
+    near-2^394 value at the documented input bound) and assert both the
+    verdicts and the <5P output-value bound directly, so a future
+    mont_mul bound regression fails HERE instead of silently corrupting
+    subgroup/infinity verdicts."""
+    eps = (1 << 380) % P  # nonzero residue
+    rows, want = [], []
+    for k in range(5):
+        rows.append(_spread_limbs(k * P))
+        want.append(True)
+        rows.append(bi._int_to_limbs(k * P))
+        want.append(True)
+        rows.append(_spread_limbs(k * P + 1))
+        want.append(False)
+        rows.append(_spread_limbs(k * P + eps))
+        want.append(False)
+    near_bound = (1 << 394) - 12345
+    assert near_bound % P != 0
+    rows.append(bi._int_to_limbs(near_bound))
+    want.append(False)
+    x = jnp.asarray(np.stack(rows))
+    got = np.asarray(bi.is_zero_mod_p_device(x))
+    assert got.tolist() == want
+
+    one = jnp.broadcast_to(jnp.asarray(bi._int_to_limbs(1)), x.shape)
+    w = np.asarray(bi.mont_mul(x, one))
+    worst = max(bi._limbs_to_int(r) for r in w)
+    assert worst < 5 * P, hex(worst)
+
+
 def test_fp2_tower_ops(rand_vals):
     """Spot-check the Fq2 layer against the python field."""
     from lighthouse_tpu.crypto.bls.fields import Fq2

@@ -169,20 +169,43 @@ class TestLightClient:
             state.hash_tree_root())
 
     def test_update_ranking_spec_order(self):
-        """is_better_update ordering: supermajority beats participation,
-        finality beats none, older attested header wins ties."""
+        """is_better_update ordering (sync-protocol.md): supermajority,
+        participation-if-no-supermajority, period relevance, finality,
+        sync-committee finality, participation, older attested header,
+        older signature slot."""
+        from lighthouse_tpu import types as T
         from lighthouse_tpu.chain.light_client import _update_rank
 
+        spec = T.ChainSpec.minimal().with_forks_at(0, through="altair")
         size = 32
-        super_no_fin = _update_rank(22, size, False, 10)
-        minority_fin = _update_rank(12, size, True, 10)
+        spe = spec.preset.epochs_per_sync_committee_period * \
+            spec.slots_per_epoch  # slots per sync-committee period
+
+        def rank(part, att_slot, sig_slot, fin_slot):
+            return _update_rank(spec, part, size, att_slot, sig_slot,
+                                fin_slot)
+
+        super_no_fin = rank(22, 10, 11, None)
+        minority_fin = rank(12, 10, 11, 10)
         assert super_no_fin > minority_fin          # supermajority first
-        fin = _update_rank(22, size, True, 10)
+        # neither side supermajority: participation decides BEFORE
+        # relevance/finality (the spec's early compare)
+        assert rank(13, 10, spe + 1, None) > rank(12, 10, 11, 10)
+        # relevance: attested period == signature period outranks a
+        # cross-period signature even with finality
+        assert rank(22, 10, 11, None) > rank(22, 10, spe + 1, 10)
+        fin = rank(22, 10, 11, 10)
         assert fin > super_no_fin                   # then finality
-        more_part = _update_rank(30, size, True, 10)
+        # sync-committee finality: finalized in the attested period
+        # outranks finalized in an older period
+        att2, sig2 = spe + 10, spe + 11
+        assert rank(22, att2, sig2, spe + 2) > rank(22, att2, sig2, 2)
+        more_part = rank(30, 10, 11, 10)
         assert more_part > fin                      # then participation
-        older = _update_rank(22, size, True, 8)
+        older = rank(22, 8, 9, 8)
         assert older > fin                          # then older attested
+        # final tiebreak: older signature slot
+        assert rank(22, 10, 11, 10) > rank(22, 10, 12, 10)
 
     def test_sse_and_gossip_publication(self, node):
         import json

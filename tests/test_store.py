@@ -91,6 +91,18 @@ class TestHotColdDB:
         assert got is not None
         assert got.hash_tree_root() == signed.hash_tree_root()
 
+    def test_hot_block_summaries_match_full_decode(self, chain_db):
+        """The summary iterator parses slot/parent_root from raw bytes
+        at fixed SSZ offsets — pin that layout against the full
+        decoder."""
+        h, db, imported = chain_db
+        full = {root: (int(blk.message.slot),
+                       bytes(blk.message.parent_root))
+                for root, blk in db.iter_hot_blocks()}
+        summ = {root: (slot, parent)
+                for root, slot, parent in db.iter_hot_block_summaries()}
+        assert summ == full and len(summ) > 0
+
     def test_full_state_at_epoch_boundary(self, chain_db):
         h, db, imported = chain_db
         # block at slot 8 (epoch boundary, minimal preset) stored in full
