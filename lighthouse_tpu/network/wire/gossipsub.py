@@ -47,6 +47,14 @@ MAX_IWANT_IDS = 500
 IWANT_SERVE_BUDGET = 1000     # full messages served per peer per heartbeat
 IWANT_RETRANSMIT = 3          # times one message is re-served to one peer
 PRUNE_BACKOFF_S = 60.0
+PX_PEERS = 16                 # peer-exchange sample attached to PRUNE
+GOSSIP_FACTOR = 0.25          # adaptive IHAVE fanout share of non-mesh
+# opportunistic grafting (behaviour.rs:2305): when the mesh's median
+# score stagnates below the threshold, graft a couple of better-scored
+# outsiders to break a low-quality (or eclipse-captured) mesh
+OPPORTUNISTIC_GRAFT_TICKS = 60
+OPPORTUNISTIC_GRAFT_PEERS = 2
+OPPORTUNISTIC_GRAFT_THRESHOLD = 1.0
 
 # scoring weights (shaped like gossipsub_scoring_parameters.rs, scaled
 # to this engine's units)
@@ -255,6 +263,23 @@ class GossipsubEngine:
             ts.mesh_since = None
         self.backoff[(peer, topic)] = self.clock() + PRUNE_BACKOFF_S
 
+    def accept_px(self, peer: str) -> bool:
+        """Peer-exchange records are only honoured from peers whose score
+        is non-negative (behaviour.rs: px processing gated on the prune
+        sender's score) — a negative-scored peer steering us toward its
+        accomplices is the eclipse entry-point."""
+        return self.score(peer) >= 0.0
+
+    def px_for_prune(self, topic: str, exclude: str) -> list[str]:
+        """Up to PX_PEERS well-scored topic peers to attach to a PRUNE
+        (peer exchange, behaviour.rs:1091,1420): the pruned peer can
+        re-mesh elsewhere instead of losing the topic."""
+        cands = [p for p in self.peers_on_topic(topic)
+                 if p != exclude and p != self.local_id
+                 and self.score(p) >= 0.0]
+        self.rng.shuffle(cands)
+        return cands[:PX_PEERS]
+
     def handle_ihave(self, peer: str, topic: str,
                      mids: list[bytes],
                      seen: Callable[[bytes], bool]) -> list[bytes]:
@@ -349,6 +374,8 @@ class GossipsubEngine:
                  "ihave": [(peer, topic, [mid, ...])]}.
         """
         now = self.clock()
+        self._hb_count = getattr(self, "_hb_count", 0) + 1
+        opportunistic = self._hb_count % OPPORTUNISTIC_GRAFT_TICKS == 0
         plan = {"graft": [], "prune": [], "ihave": []}
         # expire backoffs
         for key in [k for k, until in self.backoff.items() if until <= now]:
@@ -364,7 +391,10 @@ class GossipsubEngine:
                 lazies = [p for p in on_topic
                           if p not in members and not self.graylisted(p)]
                 self.rng.shuffle(lazies)
-                for p in lazies[:D_LAZY]:
+                # adaptive gossip: fanout grows with the non-mesh
+                # population so large topics still hear announcements
+                n_lazy = max(D_LAZY, int(GOSSIP_FACTOR * len(lazies)))
+                for p in lazies[:n_lazy]:
                     plan["ihave"].append((p, topic, mids))
             # drop peers that fell below the prune threshold or left
             bad = [p for p in members
@@ -399,6 +429,29 @@ class GossipsubEngine:
                     self._tscore(p, topic).mesh_since = None
                     self.backoff[(p, topic)] = now + PRUNE_BACKOFF_S
                     plan["prune"].append((p, topic))
+            # opportunistic grafting (behaviour.rs:2305-2352): a mesh
+            # whose MEDIAN score sits below the threshold is dominated
+            # by low-quality (or adversarial) peers that deliver little;
+            # periodically graft a couple of outsiders scoring above the
+            # median so an eclipse-captured mesh can recover without
+            # waiting for every captor to cross the prune floor
+            if opportunistic and members:
+                med = sorted(self.score(p) for p in members)[
+                    len(members) // 2]
+                if med < OPPORTUNISTIC_GRAFT_THRESHOLD:
+                    cands = [p for p in on_topic
+                             if p not in members
+                             and self.score(p) > max(med, 0.0)
+                             and self.backoff.get((p, topic), 0.0) <= now]
+                    self.rng.shuffle(cands)
+                    for p in cands[:OPPORTUNISTIC_GRAFT_PEERS]:
+                        members.add(p)
+                        ts = self._tscore(p, topic)
+                        if ts.mesh_since is None:
+                            ts.mesh_since = now
+                            ts.topic_msgs_at_join = self.topic_msgs.get(
+                                topic, 0)
+                        plan["graft"].append((p, topic))
         self.mcache.shift()
         # refresh iwant budgets + push scores to the ban gate
         self.iwant_budget.clear()

@@ -514,9 +514,33 @@ class WireNode:
             topic = body.decode()
             if not self._gs.handle_graft(conn.peer_id, topic):
                 await self._send_frame(
-                    conn, bytes([K_PRUNE]) + topic.encode())
+                    conn, self._prune_frame(topic, conn.peer_id))
         elif kind == K_PRUNE:
-            self._gs.handle_prune(conn.peer_id, body.decode())
+            topic, off = _unpack_str(body, 0)
+            self._gs.handle_prune(conn.peer_id, topic)
+            # peer exchange (behaviour.rs px handling): re-mesh through
+            # the pruner's candidates — only from non-negative-scored
+            # peers, capacity- and count-gated against eclipse steering
+            rest = body[off:]
+            if rest and self._gs.accept_px(conn.peer_id):
+                try:
+                    px = json.loads(rest.decode())
+                except (ValueError, UnicodeDecodeError):
+                    px = []
+                if not isinstance(px, list):
+                    px = []          # tolerate any malformed px payload
+                dialed = 0
+                for ent in px[:gossipsub.PX_PEERS]:
+                    if dialed >= 2:
+                        break
+                    try:
+                        pid, host, port = ent[0], ent[1], int(ent[2])
+                    except (TypeError, ValueError, IndexError):
+                        continue
+                    if pid == self.peer_id or pid in self._conns:
+                        continue
+                    dialed += 1
+                    asyncio.ensure_future(self._dial_quiet(host, port))
         elif kind == K_IHAVE:
             topic, off = _unpack_str(body, 0)
             mids = _unpack_mids(body, off)
@@ -630,7 +654,7 @@ class WireNode:
                     if conn is not None and conn.alive:
                         try:
                             await self._send_frame(
-                                conn, bytes([K_PRUNE]) + topic.encode())
+                                conn, self._prune_frame(topic, p))
                         except Exception:
                             pass
             asyncio.run_coroutine_threadsafe(_leave(), self.loop)
@@ -663,11 +687,26 @@ class WireNode:
                 await self._send_ctrl(peer, bytes([K_GRAFT])
                                       + topic.encode())
             for peer, topic in plan["prune"]:
-                await self._send_ctrl(peer, bytes([K_PRUNE])
-                                      + topic.encode())
+                await self._send_ctrl(peer, self._prune_frame(topic, peer))
             for peer, topic, mids in plan["ihave"]:
                 await self._send_ctrl(peer, bytes([K_IHAVE])
                                       + _pack_str(topic) + _pack_mids(mids))
+
+    def _prune_frame(self, topic: str, pruned_peer: str) -> bytes:
+        """PRUNE with peer exchange: attach (id, host, port) records of
+        well-scored topic peers so the pruned side can re-mesh."""
+        px = []
+        for pid in self._gs.px_for_prune(topic, exclude=pruned_peer):
+            c = self._conns.get(pid)
+            if c is not None and c.alive and c.addr is not None:
+                px.append([pid, c.addr[0], c.addr[1]])
+        return bytes([K_PRUNE]) + _pack_str(topic) + json.dumps(px).encode()
+
+    async def _dial_quiet(self, host: str, port: int):
+        try:
+            await self._dial(host, port)
+        except Exception:
+            pass
 
     async def _send_ctrl(self, peer: str, frame: bytes):
         conn = self._conns.get(peer)

@@ -224,17 +224,104 @@ def _shift_pad(x: jax.Array, off: int, width: int) -> jax.Array:
     return jax.lax.pad(x, jnp.uint32(0), pads)
 
 
-def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Montgomery product a·b·R⁻¹ (mod P, redundant representation)."""
-    t_cols = _mul_cols(a, b, 2 * L)            # 54 columns < 2^24
-    t = _carry(t_cols)                         # 54 limbs < 2^16
-    m_cols = _mul_cols(t[..., :L], _jconst("nprime"), L)  # low product (mod R)
+# --- MXU constant-multiplicand products -------------------------------------
+#
+# Two of mont_mul's three big products have a FIXED multiplicand (N' and
+# P, the separated REDC).  A fixed c turns the schoolbook column sum
+# into a matmul:  col_k = Σ_i a_i·c_{k-i}  =  (a @ M_c)_k  with
+# M_c[i, k] = c_{k-i} — which the TPU runs on the MXU instead of
+# materializing the [.., 27, 54] schoolbook intermediate on the VPU
+# (~20 KB of HBM traffic per product-lane; the fused BLS pipeline is
+# memory-bound on exactly this).  Exactness comes from int8 chunking:
+# a limbs (< 2^16) split 6|6|4 bits, c limbs (< 2^15) split 5|5|5, so
+# every dot product is ≤ 27·63·31 < 2^16 in an int32 accumulator.  The
+# nine (i, j) chunk blocks recombine on the VPU with weight
+# 2^(6i+5j) = 2^(15q + s): shift s bits and q columns — column sums stay
+# < 9·2^28 < 2^32.  Env LHTPU_MXU_REDC=0/1 forces the path; default is
+# on for TPU, off for CPU (XLA-CPU's int8 matmul is slower than its
+# fused schoolbook).
+
+_A_SHIFTS = (0, 6, 12)          # lhs chunk bit offsets (6|6|5 split:
+_A_MASKS = (63, 63, 31)         # the top chunk covers limbs < 2^17 —
+#                                 m's limbs after carrying ~2^31 columns
+#                                 land just above 2^16)
+_C_SHIFTS = (0, 5, 10)          # rhs chunk bit offsets (5|5|5 split)
+
+
+def make_const_mul(limb_count: int, consts: dict[str, np.ndarray]):
+    """Factory for fixed-multiplicand column products as int8 MXU
+    matmuls — ONE copy of the exactness-critical chunk/recombination
+    construction, instantiated by the base field (L=27) and by ops/fr
+    (L=18).  Any bound or chunk-split change lands here for both.
+
+    The returned fn(a, name, out_cols): a uint32[..., limb_count] with
+    limbs < 2^17 -> uint32[..., out_cols] columns < 9·2^28 (callers
+    must _carry before further multiplies; out_cols == limb_count drops
+    the k >= L columns — the mod-radix truncation the separated REDC
+    needs).  Exact because every int8 chunk product is ≤ 63·31 and a
+    dot accumulates ≤ limb_count of them in int32."""
+
+    @functools.cache
+    def rhs(name: str, out_cols: int) -> jax.Array:
+        c = consts[name]
+        m = np.zeros((limb_count, 3 * out_cols), np.int8)
+        for j, sh in enumerate(_C_SHIFTS):
+            for i in range(limb_count):
+                for k in range(i, min(i + limb_count, out_cols)):
+                    m[i, j * out_cols + k] = (int(c[k - i]) >> sh) & 31
+        with jax.ensure_compile_time_eval():
+            return jnp.asarray(m)
+
+    def mul_cols_const(a: jax.Array, name: str,
+                       out_cols: int) -> jax.Array:
+        lhs = jnp.stack(
+            [((a >> sh) & msk).astype(jnp.int8)
+             for sh, msk in zip(_A_SHIFTS, _A_MASKS)],
+            axis=-2)                            # [..., 3, L]
+        out = jax.lax.dot_general(
+            lhs, rhs(name, out_cols),
+            dimension_numbers=(((lhs.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)   # [..., 3, 3·out]
+        out = out.astype(jnp.uint32).reshape(
+            out.shape[:-2] + (3, 3, out_cols))  # [..., i, j, out]
+        cols = jnp.zeros(out.shape[:-3] + (out_cols,), jnp.uint32)
+        for i in range(3):
+            for j in range(3):
+                q, s = divmod(_A_SHIFTS[i] + _C_SHIFTS[j], B)
+                blk = out[..., i, j, :] << s
+                if q:           # one-column shift (2^B per column)
+                    blk = jnp.concatenate(
+                        [jnp.zeros_like(blk[..., :q]), blk[..., :-q]],
+                        axis=-1)
+                cols = cols + blk
+        return cols
+
+    return mul_cols_const
+
+
+_mul_cols_const = make_const_mul(L, {"p": P_LIMBS,
+                                     "nprime": NPRIME_LIMBS})
+
+
+def _redc(t: jax.Array, mxu: bool) -> jax.Array:
+    """Separated Montgomery reduction of carried columns t (54 limbs,
+    < 2^16): out = (t + (t·N' mod R)·P) / R."""
+    if mxu:
+        m_cols = _mul_cols_const(t[..., :L], "nprime", L)
+    else:
+        m_cols = _mul_cols(t[..., :L], _jconst("nprime"), L)
     m = _carry(m_cols)                         # limbs < 2^16 (redundant)
     # mod R: mask ONLY the top limb (drops multiples of R = 2^405, legal;
     # masking other limbs would change m mod R and break divisibility)
     m = _set_top(m, m[..., -1:] & MASK)
-    mn_cols = _mul_cols(m, _jconst("p"), 2 * L)  # 54 columns
-    s = mn_cols + t                            # < 2^25 ✓ uint32
+    if mxu:
+        mn_cols = _mul_cols_const(m, "p", 2 * L)
+        # MXU columns reach ~2^31; one value-preserving carry pass brings
+        # them under 2^17 so the 0-or-R low-half residual argument below
+        # holds (residual < R + 2^392 < 2R)
+        s = _carry(mn_cols + t)
+    else:
+        s = _mul_cols(m, _jconst("p"), 2 * L) + t  # < 2^25 ✓ uint32
     # low half of s has value ≡ 0 (mod R): carry into the high half is
     # (s_26 >> B) + (1 iff any low residue bits remain)
     low_resid = jnp.concatenate(
@@ -245,6 +332,34 @@ def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
     out_cols = jnp.concatenate(
         [out_cols[..., :1] + c, out_cols[..., 1:]], axis=-1)
     return _carry(out_cols)
+
+
+_MXU_REDC: bool | None = None
+
+
+def _use_mxu_redc() -> bool:
+    global _MXU_REDC
+    if _MXU_REDC is None:
+        import os
+
+        env = os.environ.get("LHTPU_MXU_REDC", "auto").lower()
+        if env in ("0", "false"):
+            _MXU_REDC = False
+        elif env in ("1", "true"):
+            _MXU_REDC = True
+        else:
+            try:
+                _MXU_REDC = jax.default_backend() == "tpu"
+            except Exception:
+                _MXU_REDC = False
+    return _MXU_REDC
+
+
+def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Montgomery product a·b·R⁻¹ (mod P, redundant representation)."""
+    t_cols = _mul_cols(a, b, 2 * L)            # 54 columns < 2^24
+    t = _carry(t_cols)                         # 54 limbs < 2^16
+    return _redc(t, _use_mxu_redc())
 
 
 def mont_sqr(a: jax.Array) -> jax.Array:

@@ -161,15 +161,29 @@ def _mul_cols(a: jax.Array, b: jax.Array, out_cols: int) -> jax.Array:
     return (lo + hi).sum(axis=-2, dtype=jnp.uint32)
 
 
-def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """a·b·RADIX⁻¹ (mod R), redundant representation."""
-    t_cols = _mul_cols(a, b, 2 * L)
-    t = _carry(t_cols)
-    m_cols = _mul_cols(t[..., :L], _jconst("nprime"), L)
+# MXU constant-multiplicand REDC: the int8-chunk matmul construction is
+# shared with the base field — ONE implementation in
+# bigint.make_const_mul (same B; this module only supplies its limb
+# count and constant tables).  Fr is the KZG batch verifier's hot field
+# (per-blob barycentric evaluation lanes).
+
+from lighthouse_tpu.ops.bigint import make_const_mul as _make_const_mul
+
+_mul_cols_const = _make_const_mul(L, {"r": R_LIMBS,
+                                      "nprime": NPRIME_LIMBS})
+
+
+def _redc(t: jax.Array, mxu: bool) -> jax.Array:
+    if mxu:
+        m_cols = _mul_cols_const(t[..., :L], "nprime", L)
+    else:
+        m_cols = _mul_cols(t[..., :L], _jconst("nprime"), L)
     m = _carry(m_cols)
     m = _set_top(m, m[..., -1:] & MASK)
-    mn_cols = _mul_cols(m, _jconst("r"), 2 * L)
-    s = mn_cols + t
+    if mxu:
+        s = _carry(_mul_cols_const(m, "r", 2 * L) + t)
+    else:
+        s = _mul_cols(m, _jconst("r"), 2 * L) + t
     low_resid = jnp.concatenate(
         [s[..., :L - 1], (s[..., L - 1:L] & MASK)], axis=-1)
     delta = jnp.any(low_resid != 0, axis=-1, keepdims=True).astype(jnp.uint32)
@@ -178,6 +192,15 @@ def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
     out_cols = jnp.concatenate(
         [out_cols[..., :1] + c, out_cols[..., 1:]], axis=-1)
     return _carry(out_cols)
+
+
+def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a·b·RADIX⁻¹ (mod R), redundant representation."""
+    from lighthouse_tpu.ops.bigint import _use_mxu_redc
+
+    t_cols = _mul_cols(a, b, 2 * L)
+    t = _carry(t_cols)
+    return _redc(t, _use_mxu_redc())
 
 
 # --- host boundary ----------------------------------------------------------

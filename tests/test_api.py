@@ -187,6 +187,72 @@ class TestStandardApiBreadth:
         finally:
             chain.eth1_service = None
 
+    def test_randao(self, api_setup):
+        import urllib.error
+
+        h, chain, client = api_setup
+        out = self._get(client,
+                        "/eth/v1/beacon/states/head/randao")["data"]
+        spec = chain.spec
+        st = chain.head_state
+        epoch = spec.compute_epoch_at_slot(int(st.slot))
+        want = bytes(st.randao_mixes[
+            epoch % spec.preset.epochs_per_historical_vector].tobytes())
+        assert out["randao"] == "0x" + want.hex()
+        # future epochs 400, not 500
+        try:
+            self._get(client,
+                      f"/eth/v1/beacon/states/head/randao?epoch={epoch+9}")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_liveness(self, api_setup):
+        h, chain, client = api_setup
+        epoch = chain.spec.compute_epoch_at_slot(
+            int(chain.head_state.slot))
+        out = self._post(client, f"/eth/v1/validator/liveness/{epoch}",
+                         ["0", "1", "2"])["data"]
+        assert [r["index"] for r in out] == ["0", "1", "2"]
+        assert all(isinstance(r["is_live"], bool) for r in out)
+
+    def test_debug_fork_choice(self, api_setup):
+        h, chain, client = api_setup
+        out = self._get(client, "/eth/v1/debug/fork_choice")
+        nodes = out["fork_choice_nodes"]
+        assert nodes, "no fork choice nodes"
+        roots = {n["block_root"] for n in nodes}
+        assert "0x" + chain.head_root.hex() in roots
+        assert all(n["validity"] in ("valid", "invalid", "optimistic")
+                   for n in nodes)
+        assert "epoch" in out["finalized_checkpoint"]
+
+    def test_node_peer_one_404(self, api_setup):
+        import urllib.error
+
+        h, chain, client = api_setup
+        try:
+            self._get(client, "/eth/v1/node/peers/nobody")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+    def test_pool_attestations_get(self, api_setup):
+        h, chain, client = api_setup
+        att = h.attest()
+        bits = [False] * len(att.aggregation_bits)
+        bits[0] = True
+        single = type(att)(aggregation_bits=bits, data=att.data,
+                           signature=bytes(att.signature))
+        chain.slot_clock.set_slot(int(att.data.slot) + 1)
+        chain.naive_pool.insert(single)
+        rows = self._get(client, "/eth/v1/beacon/pool/attestations")["data"]
+        assert len(rows) == 1
+        assert rows[0]["data"]["slot"] == str(int(att.data.slot))
+        empty = self._get(
+            client, "/eth/v1/beacon/pool/attestations?slot=99")["data"]
+        assert empty == []
+
     def test_state_fork(self, api_setup):
         h, chain, client = api_setup
         out = self._get(client, "/eth/v1/beacon/states/head/fork")["data"]

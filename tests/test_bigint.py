@@ -103,6 +103,46 @@ def test_deep_chain_keeps_bounds(rand_vals):
     assert maxlimb < (1 << 15) + (1 << 12), maxlimb
 
 
+def _mont_mul_mxu(a, b):
+    """mont_mul with the MXU REDC path forced (matmul constant products),
+    bypassing the platform default — the differential oracle below must
+    hold on every platform."""
+    t = bi._carry(bi._mul_cols(a, b, 2 * bi.L))
+    return bi._redc(t, mxu=True)
+
+
+def test_mxu_redc_matches_schoolbook(rand_vals):
+    """The int8-matmul REDC is bit-value-equal to the schoolbook REDC on
+    random, edge and worst-case-spread inputs, and keeps the output limb
+    bound (the fused BLS pipeline switches paths by platform — both must
+    be the same function)."""
+    xs, ys = rand_vals
+    edge = [0, 1, 2, P - 1, P - 2, (P + 1) // 2, (1 << 380) % P, 12345]
+    ax = jnp.concatenate([_batch(xs), _batch(edge)])
+    ay = jnp.concatenate([_batch(ys), _batch(edge[::-1])])
+    want = np.asarray(jax.jit(bi.mont_mul)(ax, ay))
+    got = np.asarray(jax.jit(_mont_mul_mxu)(ax, ay))
+    assert (bi.from_mont(got) == bi.from_mont(want)).all()
+    assert got.max() < (1 << 15) + (1 << 12), got.max()
+
+    # worst-case redundant encodings (limbs at the op-invariant bound)
+    rows = np.stack([_spread_limbs(x + (x % 4) * P) for x in xs[:8]])
+    aw = jnp.asarray(rows)
+    got2 = bi.from_mont(np.asarray(_mont_mul_mxu(aw, ay[:8])))
+    want2 = bi.from_mont(np.asarray(bi.mont_mul(aw, ay[:8])))
+    assert (got2 == want2).all()
+
+    # deep chain through the MXU path: bounds must not drift
+    z = ax
+    maxlimb = 0
+    mm = jax.jit(_mont_mul_mxu)
+    for _ in range(30):
+        z = mm(z, ay)
+        z = bi.add(z, ax)
+        maxlimb = max(maxlimb, int(np.asarray(z).max()))
+    assert maxlimb < (1 << 15) + (1 << 12), maxlimb
+
+
 def _spread_limbs(v: int,
                   limit: int = (1 << 15) + (1 << 11) - 1) -> np.ndarray:
     """Worst-case redundant encoding of v: same value, limbs pushed to

@@ -28,6 +28,26 @@ class TestFieldOps:
         got = fr.from_mont_host(np.asarray(jax.jit(fr.mont_mul)(am, bm)))
         assert all(int(g) == x * y % R for g, x, y in zip(got, a, b))
 
+    def test_mxu_redc_matches_schoolbook(self, rand_pairs):
+        """The int8-matmul REDC (TPU default) must be value-equal to the
+        schoolbook REDC and keep the limb bound — mirrors the bigint
+        differential."""
+        a, b, am, bm = rand_pairs
+
+        def mxu(x, y):
+            return fr._redc(fr._carry(fr._mul_cols(x, y, 2 * fr.L)),
+                            mxu=True)
+
+        got = np.asarray(jax.jit(mxu)(am, bm))
+        want = np.asarray(jax.jit(fr.mont_mul)(am, bm))
+        assert (fr.from_mont_host(got) == fr.from_mont_host(want)).all()
+        assert got.max() < (1 << 15) + (1 << 12)
+        edge = jnp.asarray(fr.to_mont_host(
+            [0, 1, 2, R - 1, R - 2, (1 << 254) % R, 7, R // 2]))
+        ge = fr.from_mont_host(np.asarray(mxu(edge, edge)))
+        we = fr.from_mont_host(np.asarray(fr.mont_mul(edge, edge)))
+        assert (ge == we).all()
+
     def test_add_sub(self, rand_pairs):
         a, b, am, bm = rand_pairs
         gs = fr.from_mont_host(np.asarray(jax.jit(fr.add)(am, bm)))

@@ -157,6 +157,107 @@ class TestEngineMesh:
         assert e.score("fast") >= 5 * gs.W_FIRST_DELIVERY
 
 
+class TestResilience:
+    """The v1.1 resilience tail: opportunistic grafting, peer exchange,
+    adaptive gossip, recovery after score collapse (reference
+    behaviour.rs:642 flood_publish, :1091/:1420 px, :2305 opportunistic
+    grafting)."""
+
+    def test_opportunistic_graft_breaks_eclipse(self):
+        """Eclipse attempt: the mesh is captured by silent peers whose
+        scores hover BELOW the opportunistic threshold but above the
+        prune floor — plain maintenance never evicts them.  The periodic
+        opportunistic graft must pull better-scored outsiders in."""
+        t = [0.0]
+        captors = [f"evil{i}" for i in range(gs.D)]
+        good = [f"good{i}" for i in range(4)]
+        e = _engine(captors + good, lambda: t[0])
+        e.mesh["top"] = set(captors)
+        for p in captors:
+            e._tscore(p, "top").mesh_since = 0.0
+        # traffic flows via the good outsiders: captors deliver nothing
+        # but stay above the prune floor (small deficit after the
+        # activation grace), goods earn first-delivery credit
+        mi = 0
+        for i in range(3):
+            for g in good:
+                e.on_message(g, "top", bytes([mi, 7]) * 10, b"d",
+                             first_time=True)
+                mi += 1
+        assert all(e.score(p) >= gs.SCORE_PRUNE for p in captors)
+        assert all(e.score(g) > 0 for g in good)
+        grafted = []
+        for _ in range(gs.OPPORTUNISTIC_GRAFT_TICKS):
+            t[0] += 1.0
+            plan = e.heartbeat()
+            grafted += [p for p, _ in plan["graft"]]
+        assert any(p in good for p in grafted), \
+            "opportunistic graft never pulled a good peer into the mesh"
+        assert any(p in good for p in e.mesh["top"])
+
+    def test_opportunistic_graft_skips_healthy_mesh(self):
+        t = [0.0]
+        peers = [f"p{i}" for i in range(gs.D + 4)]
+        e = _engine(peers, lambda: t[0])
+        e.mesh["top"] = set(peers[:gs.D])
+        for p in peers[:gs.D]:
+            ts = e._tscore(p, "top")
+            ts.mesh_since = 0.0
+            ts.first_deliveries = 50.0              # well above threshold
+        for _ in range(gs.OPPORTUNISTIC_GRAFT_TICKS):
+            t[0] += 1.0
+            plan = e.heartbeat()
+        assert e.mesh["top"] == set(peers[:gs.D])
+
+    def test_px_sample_excludes_pruned_and_bad_peers(self):
+        t = [0.0]
+        peers = [f"p{i}" for i in range(6)] + ["bad"]
+        e = _engine(peers, lambda: t[0])
+        e._tscore("bad", "top").invalid = 5.0       # negative score
+        px = e.px_for_prune("top", exclude="p0")
+        assert "p0" not in px and "bad" not in px
+        assert set(px) <= set(peers)
+
+    def test_px_only_honoured_from_non_negative_peers(self):
+        t = [0.0]
+        e = _engine(["ok", "bad"], lambda: t[0])
+        e._tscore("bad", "top").invalid = 1.0
+        assert e.accept_px("ok")
+        assert not e.accept_px("bad")
+
+    def test_adaptive_gossip_fanout_scales_with_population(self):
+        """IHAVE fanout must grow past D_LAZY on big topics (gossip
+        factor), not stay pinned at the floor."""
+        t = [0.0]
+        peers = [f"p{i}" for i in range(100)]
+        e = _engine(peers, lambda: t[0])
+        e.mesh["top"] = set(peers[:gs.D])
+        e.on_message(None, "top", b"m" * 20, b"d", first_time=True)
+        plan = e.heartbeat()
+        targets = {p for p, _, _ in plan["ihave"]}
+        expect = int(gs.GOSSIP_FACTOR * (100 - gs.D))
+        assert len(targets) >= expect > gs.D_LAZY
+
+    def test_score_collapse_recovery_via_backoff_expiry(self):
+        """A peer pruned for bad score must be re-graftable after its
+        score decays back (invalid counters are per-session here: clear
+        on disconnect) AND its backoff expires — not banned forever."""
+        t = [0.0]
+        e = _engine(["p", "q"], lambda: t[0])
+        e.mesh["top"] = {"p", "q"}
+        for x in ("p", "q"):
+            e._tscore(x, "top").mesh_since = 0.0
+        e.mark_invalid("p", "top")
+        plan = e.heartbeat()
+        assert ("p", "top") in plan["prune"]
+        assert not e.handle_graft("p", "top")       # still backed off
+        # disconnect+reconnect clears session counters; backoff expires
+        e.peer_disconnected("p")
+        t[0] += gs.PRUNE_BACKOFF_S + 1
+        assert e.handle_graft("p", "top")
+        assert "p" in e.mesh["top"]
+
+
 class TestSocketGossipsub:
     def test_missed_message_recovered_via_iwant(self):
         """Line A-B-C.  B's forward runs over its mesh; with C forced

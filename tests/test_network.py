@@ -268,6 +268,105 @@ class TestPartition:
         assert b.chain.head_root == signed.message.hash_tree_root()
 
 
+class TestSyncHardening:
+    """Range-sync batch retry/downscore + lookup dedup (reference
+    range_sync/batch.rs retry machine, chain_collection.rs chain
+    grouping, block_lookups dedup)."""
+
+    def _three_nodes(self):
+        h = Harness(n_validators=32, fork="altair", real_crypto=False)
+        fabric = NetworkFabric()
+        a = _node(h, fabric, "node-a")
+        b = _node(h, fabric, "node-b")
+        liar = _node(h, fabric, "node-liar")
+        for _ in range(12):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            for n in (a, liar):
+                n.chain.slot_clock.set_slot(int(signed.message.slot))
+                try:
+                    n.chain.process_block(signed)
+                except Exception:
+                    pass
+        return h, a, b, liar
+
+    def test_lying_peer_downscored_and_batch_retried(self):
+        from lighthouse_tpu.network.rpc import P_BLOCKS_BY_RANGE
+
+        h, a, b, liar = self._three_nodes()
+        # the liar serves a real-looking but WRONG response: the same
+        # early block for every requested slot (non-ascending, outside
+        # the window) — batch validation must reject it before import
+        early = a.chain.store.get_block(a.chain.block_root_at_slot(1))
+        raw = early.serialize()
+
+        def lying(src, data):
+            return [raw, raw, raw]
+
+        liar.router.rpc.register(P_BLOCKS_BY_RANGE, lying)
+        b.chain.slot_clock.set_slot(12)
+        b.connect(a)
+        b.connect(liar)
+        score_before = b.peer_manager.score("node-liar")
+        imported = b.sync.sync()
+        assert imported == 12
+        assert b.chain.head_root == a.chain.head_root
+        assert b.peer_manager.score("node-liar") < score_before, \
+            "lying peer was not downscored"
+
+    def test_peers_with_same_target_pool_into_one_chain(self, two_nodes):
+        h, a, b = two_nodes
+        fabric = a.fabric
+        c = _node(h, fabric, "node-c")
+        for _ in range(3):
+            signed = h.produce_block()
+            state_transition(h.state, h.spec, signed, h._verify_strategy())
+            for n in (a, c):
+                n.chain.slot_clock.set_slot(int(signed.message.slot))
+                try:
+                    n.chain.process_block(signed)
+                except Exception:
+                    pass
+        b.chain.slot_clock.set_slot(3)
+        b.connect(a)
+        b.connect(c)
+        pools = []
+        orig = b.sync._sync_chain
+
+        def capture(pool, target_slot):
+            pools.append(sorted(pool))
+            return orig(pool, target_slot)
+
+        b.sync._sync_chain = capture
+        assert b.sync.sync() == 3
+        # ONE chain attempt, with both same-target peers pooled
+        assert pools == [["node-a", "node-c"]]
+
+    def test_failed_lookup_cached_and_single_flight(self, two_nodes):
+        h, a, b = two_nodes
+        signed = h.produce_block()   # NOT imported anywhere: parent chase
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        orphan = h.produce_block()   # parent (signed) unknown to b
+        b.chain.slot_clock.set_slot(int(orphan.message.slot))
+        b.connect(a)
+        calls = {"n": 0}
+        orig = b.sync.rpc.request
+
+        def counting(peer, proto, payload):
+            calls["n"] += 1
+            return orig(peer, proto, payload)
+
+        b.sync.rpc.request = counting
+        # node A never saw `signed` either: the chase dead-ends with an
+        # empty BlocksByRoot answer and must cache the failure
+        assert b.sync.lookup_unknown_parent("node-a", orphan) == 0
+        first_calls = calls["n"]
+        assert first_calls >= 1
+        assert b.sync.lookup_unknown_parent("node-a", orphan) == 0
+        assert calls["n"] == first_calls, \
+            "failed chase was re-run instead of served from the cache"
+
+
 class TestLightClientRpc:
     def test_lc_and_blobs_by_root_protocols(self, two_nodes):
         from lighthouse_tpu.network.rpc import (
