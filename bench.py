@@ -26,6 +26,14 @@ import time
 _REPO = os.path.dirname(os.path.abspath(__file__))
 CHILD_TIMEOUT_S = int(os.environ.get("LHTPU_BENCH_TIMEOUT", "420"))
 
+try:  # raise vm.max_map_count before any XLA compile (see ops/cache_guard)
+    sys.path.insert(0, _REPO)
+    from lighthouse_tpu.ops import cache_guard as _cg
+
+    _cg.install()
+except Exception:
+    pass
+
 
 def _emit_partial(result: dict) -> None:
     """Progressive capture: every milestone prints a full JSON line; the
@@ -83,6 +91,27 @@ def _bench_bls_1k() -> dict:
         return [bls.SignatureSet(bls.Signature(s.signature.to_bytes()),
                                  s.pubkeys, s.message) for s in ss]
 
+    # FIRST: an 8-set mini batch, timed, emitted as a real (small-batch)
+    # number.  The main batch's cold compile can outlive the child
+    # timeout (it did in r4, losing the headline); after this point the
+    # child always carries value > 0 with honest batch-size provenance.
+    if n_sets > 8:
+        mini = sets[:8]
+        ok = bls.verify_signature_sets(_fresh(mini), backend="tpu")
+        assert ok, "mini warm-up batch failed to verify"
+        t0 = time.perf_counter()
+        assert bls.verify_signature_sets(mini, backend="tpu")
+        mini_dt = time.perf_counter() - t0
+        result["metric"] = "bls_verify_8_sets"
+        result["value"] = round(8 / mini_dt, 1)
+        result["vs_baseline"] = round(8 / mini_dt / 120_000.0, 4)
+        result["batch_ms"] = round(mini_dt * 1000, 1)
+        result["stage"] = "mini_timed"
+        _emit_partial(result)
+        # the 8-set metric name/values stay until the first FULL-batch
+        # timed emit overwrites them together — a child killed during
+        # the main warm-up still reports honest batch-size provenance
+
     # warm-up compiles every kernel the ledger pass meets (incl. the
     # batched subgroup check, which only fresh signature objects hit);
     # the persistent .jax_cache turns this into a load on later runs
@@ -99,6 +128,7 @@ def _bench_bls_1k() -> dict:
     for i in range(n_iters):
         assert bls.verify_signature_sets(sets, backend="tpu")
         dt = (time.perf_counter() - t0) / (i + 1)
+        result["metric"] = f"bls_verify_{n_sets}_sets"
         result["value"] = round(n_sets / dt, 1)
         result["vs_baseline"] = round(n_sets / dt / 120_000.0, 4)
         result["batch_ms"] = round(dt * 1000, 1)

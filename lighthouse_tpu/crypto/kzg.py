@@ -6,17 +6,20 @@ et al.), math per the consensus specs' polynomial-commitments.md, riding
 this repo's own BLS12-381 core:
 
 - commitments / proofs are multi-scalar multiplications over the
-  Lagrange-basis setup points — batched on device via ops/ec.g1_msm for
-  production sizes, with a host Jacobian path for tiny dev setups;
-- proof verification is ONE multi-pairing on the existing batched device
-  Miller loop (ops/bls12_381.multi_pairing_device) + the fast host final
-  exponentiation;
+  Lagrange-basis setup points — batched on device (windowed scan,
+  ops/ec.g1_msm_windowed) for production sizes, with a host Jacobian
+  path for tiny dev setups;
+- single-proof verification is ONE multi-pairing on the batched device
+  Miller loop (ops/bls12_381.multi_pairing_device);
 - `verify_blob_kzg_proof_batch` folds n proofs into a single 2-pairing
-  check by a random linear combination (the verifier-local scalar r), the
-  same shape as the reference's batch path.
-
-Fr (scalar field) arithmetic is host-side python ints — only bit planes
-of scalars reach the device.
+  check by a random linear combination (the verifier-local scalar r),
+  and for production batch sizes rides the FUSED device plane: one
+  dispatch evaluates every blob barycentrically (product-tree
+  denominator inversion, ops/fr.py) and one dispatch runs both RLC MSMs
+  + the pairing, with the folded points entering the Miller loop in
+  Jacobian form (zp path) so no affine conversion or host crossing sits
+  between MSM and pairing.  Host work: challenges, r-powers, limb
+  packing, and the native final exponentiation.
 """
 
 from __future__ import annotations
@@ -255,11 +258,11 @@ def _msm_device(points, scalars, pad_to: int | None = None):
     ks += [0] * (padded - n)
     xp = ec.ints_to_mont_limbs(xs)
     yp = ec.ints_to_mont_limbs(ys)
-    bits = ec.scalars_to_bits(ks, n_bits=256)
+    bits = ec.scalars_to_digits(ks, n_bits=256)
 
     global _MSM_JIT
     if _MSM_JIT is None:
-        _MSM_JIT = jax.jit(ec.g1_msm)
+        _MSM_JIT = jax.jit(ec.g1_msm_windowed)
     X, Y, Z = _MSM_JIT(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(bits))
     x, y, z = (int(bi.from_mont(np.asarray(c))) for c in (X, Y, Z))
     if z == 0:
@@ -424,6 +427,99 @@ def _evaluate_polynomials(polys, zs, blobs, settings) -> list[int]:
     return fr.evaluate_polynomials_batch(limbs, zs, settings.roots_brp)
 
 
+def _blob_fields_canonical(raw: "np.ndarray") -> bool:
+    """Vectorized canonicity check of [N, W, 32] big-endian field bytes
+    (< BLS_MODULUS) — replaces per-element python parsing on the batch
+    path (3.1M ints for a 768-blob batch)."""
+    words = np.ascontiguousarray(raw).reshape(-1, 32).view(">u8")
+    m = np.frombuffer(BLS_MODULUS.to_bytes(32, "big"), ">u8")
+    lt = words < m
+    eq = words == m
+    ok = lt[:, 0] | (eq[:, 0] & (lt[:, 1] | (eq[:, 1] & (
+        lt[:, 2] | (eq[:, 2] & lt[:, 3])))))
+    return bool(ok.all())
+
+
+_KZG_FUSED_JIT = None
+
+
+def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
+                     settings) -> bool:
+    """BOTH RLC MSMs and the 2-lane pairing as ONE device dispatch.
+
+    Lanes interleave s-major (even = lhs MSM, odd = proof MSM) through
+    one windowed scalar-mul scan + a 2-segment sum; the two folded
+    points feed the Miller loop DIRECTLY in Jacobian form (zp path), so
+    no affine conversion — and no host crossing — exists between MSM
+    and pairing.  Σ-lanes that legally fold to infinity (zero quotient
+    polynomials) are masked on device: e(INF, ·) = 1."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.ops import ec
+    from lighthouse_tpu.ops.bls12_381 import (
+        batch_miller_loop,
+        fq12_from_device,
+        reduce_product,
+    )
+    from lighthouse_tpu.ops.bls_backend import _final_exp_is_one
+
+    from lighthouse_tpu.ops import cache_guard
+
+    cache_guard.install()
+    global _KZG_FUSED_JIT
+    if _KZG_FUSED_JIT is None:
+        def _kzg_fused(xs, ys, digits, xqa, xqb, yqa, yqb):
+            X, Y, Z = ec.g1_scalar_mul_windowed(xs, ys, digits)
+            Xg, Yg, Zg = ec.g1_segment_sum(X, Y, Z, 2)
+            ok = ~bi.is_zero_mod_p_device(Zg)
+            f = batch_miller_loop(Xg, Yg, xqa, xqb, yqa, yqb, zp=Zg)
+            return reduce_product(f, ok)
+
+        _KZG_FUSED_JIT = jax.jit(_kzg_fused)
+
+    m = 1 << max(len(lhs_points) - 1, 0).bit_length()
+
+    def lane_arrays(points, scalars):
+        xs, ys, ks = [], [], []
+        for p, k in zip(points, scalars):
+            if p is cv.INF or k % BLS_MODULUS == 0:
+                xs.append(0), ys.append(0), ks.append(0)
+            else:
+                xs.append(p[0]), ys.append(p[1]), ks.append(
+                    k % BLS_MODULUS)
+            if len(xs) > m:
+                raise KzgError("lane overflow")
+        pad = m - len(xs)
+        return (ec.ints_to_mont_limbs(xs + [0] * pad),
+                ec.ints_to_mont_limbs(ys + [0] * pad),
+                ec.scalars_to_digits(ks + [0] * pad, n_bits=256))
+
+    lx, ly, ld = lane_arrays(lhs_points, lhs_scalars)
+    px_, py_, pd = lane_arrays(pis, r_pows)
+    xs = np.empty((2 * m, lx.shape[-1]), np.uint32)
+    ys = np.empty_like(xs)
+    xs[0::2], xs[1::2] = lx, px_
+    ys[0::2], ys[1::2] = ly, py_
+    digits = np.empty((ld.shape[0], 2 * m), np.uint32)
+    digits[:, 0::2], digits[:, 1::2] = ld, pd
+
+    g2rows = getattr(settings, "_fused_g2_rows", None)
+    if g2rows is None:  # constants per settings: pack once, reuse per call
+        neg_g2 = cv.g2_neg(cv.g2_generator())
+        tau_g2 = settings.g2_tau
+        g2rows = [jnp.asarray(ec.ints_to_mont_limbs(v)) for v in (
+            [neg_g2[0].a, tau_g2[0].a], [neg_g2[0].b, tau_g2[0].b],
+            [neg_g2[1].a, tau_g2[1].a], [neg_g2[1].b, tau_g2[1].b])]
+        settings._fused_g2_rows = g2rows
+
+    f = _KZG_FUSED_JIT(jnp.asarray(xs), jnp.asarray(ys),
+                       jnp.asarray(digits), *g2rows)
+    f_host = fq12_from_device(jax.device_get(f))
+    return _final_exp_is_one(f_host)
+
+
 def verify_blob_kzg_proof_batch(
     blobs: list[bytes], commitment_bytes_list: list[bytes],
     proof_bytes_list: list[bytes], settings: KzgSettings
@@ -433,21 +529,44 @@ def verify_blob_kzg_proof_batch(
 
     With challenges z_i, evaluations y_i and verifier powers r^i:
       e(Σ r^i(C_i − y_i·G1 + z_i·π_i), −G2) · e(Σ r^i·π_i, τ·G2) == 1.
-    """
+
+    Batches of >= _DEVICE_EVAL_MIN blobs ride the fused device plane:
+    vectorized canonicity validation, one dispatch for every
+    barycentric evaluation (product-tree denominator inversion), and
+    one dispatch for both MSMs + the pairing (_kzg_fused_check) —
+    host work shrinks to challenges, r-powers and limb packing."""
     n = len(blobs)
     if not (n == len(commitment_bytes_list) == len(proof_bytes_list)):
         return False
     if n == 0:
         return True
+    fused = n >= _DEVICE_EVAL_MIN
     try:
         cs = [cv.g1_from_bytes(b) for b in commitment_bytes_list]
         pis = [cv.g1_from_bytes(b) for b in proof_bytes_list]
-        polys = [blob_to_polynomial(b, settings) for b in blobs]
+        if fused:
+            width = settings.width
+            if any(len(b) != width * BYTES_PER_FIELD_ELEMENT
+                   for b in blobs):
+                return False
+            raw = np.frombuffer(b"".join(blobs), np.uint8).reshape(
+                n, width, 32)
+            if not _blob_fields_canonical(raw):
+                return False
+            polys = None
+        else:
+            polys = [blob_to_polynomial(b, settings) for b in blobs]
     except (ValueError, KzgError):
         return False
     zs = [compute_challenge(blob, cb, settings)
           for blob, cb in zip(blobs, commitment_bytes_list)]
-    ys = _evaluate_polynomials(polys, zs, blobs, settings)
+    if fused:
+        from lighthouse_tpu.ops import fr
+
+        ys = fr.evaluate_polynomials_batch(
+            fr.be32_bytes_to_limbs(raw), zs, settings.roots_brp)
+    else:
+        ys = _evaluate_polynomials(polys, zs, blobs, settings)
 
     # verifier-local random linear combination (domain-separated hash seed
     # + per-run entropy: r need only be unpredictable to the prover)
@@ -470,6 +589,12 @@ def verify_blob_kzg_proof_batch(
                                   for ri, z in zip(r_pows, zs)]
     y_comb = sum(ri * y % BLS_MODULUS for ri, y in zip(r_pows, ys)) % BLS_MODULUS
     lhs_scalars.append((-y_comb) % BLS_MODULUS)
+    if fused:
+        try:
+            return _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
+                                    settings)
+        except KzgError:  # defensive lane-overflow guard: bad input -> False
+            return False
     shared_pad = 1 << max(len(lhs_points) - 1, 0).bit_length()
     proof_comb = g1_lincomb(pis, r_pows, pad_to=shared_pad)
     lhs = g1_lincomb(lhs_points, lhs_scalars, pad_to=shared_pad)

@@ -338,7 +338,7 @@ def _select(bit, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(m, x, y), a, b)
 
 
-def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
+def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None, zq=None):
     """Batched Miller loops: lane i computes miller(P_i, Q_i).
 
     xp, yp: uint32[N, 27] (G1 Montgomery limbs); (xqa+xqb·u, yqa+yqb·u):
@@ -351,14 +351,23 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
     factors reach the chord line through the loop-invariant products
     zxq = xq·Zp³ / zyq = yq·Zp³, so the dependency-round structure is
     unchanged.  This lets r·agg_pk lanes flow straight from the device
-    scalar-mul kernel (ops/ec.py) without per-lane host inversions."""
+    scalar-mul kernel (ops/ec.py) without per-lane host inversions.
+
+    With ``zq`` given (an Fq2 limb pair), Q lanes are JACOBIAN too: every
+    Q interaction is rewritten over U1 = X·Zq², S1 = Y·Zq³ with the chord
+    line scaled by the Fq2 factor Zq⁵ — also killed by the final
+    exponentiation, since r has embedding degree 12 so (p¹²−1)/r is
+    divisible by p²−1 and any Fq2* factor maps to 1.  The T+Q update
+    becomes a full Jacobian add (Z3a gains a ·Zq).  This removes the
+    Σ r·sig affine conversion — a 381-step width-1 Fermat inversion —
+    from the fused verify pipeline's critical path."""
     xq = (xqa, xqb)
     yq = (yqa, yqb)
     batch = xp.shape[:-1]
     f = _ones_like_fp12(batch)
     zero = jnp.zeros_like(xp)
     one = jnp.broadcast_to(bi._jconst("one_m"), xp.shape)
-    X, Y, Z = xq, yq, (one, zero)
+    X, Y, Z = xq, yq, ((one, zero) if zq is None else zq)
 
     if zp is None:
         zp3 = one
@@ -383,11 +392,33 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
         zxq = (q0[i_zxa], q0[i_zxb])
         zyq = (q0[i_zya], q0[i_zyb])
 
+    if zq is not None:
+        # loop invariants for the Jacobian-Q chord: Zq², Zq³, and the
+        # P-side line factors pre-scaled so all three chord coefficients
+        # share the single overall Zq⁵ (xz·Zq² for c1, yp·Zq³ for d1)
+        qz = _MulQueue()
+        r_zq2 = qz.fp2(zq, zq)
+        qz.run()
+        zq2 = r_zq2()
+        qz = _MulQueue()
+        r_zq3 = qz.fp2(zq2, zq)
+        i_xzq2a = qz.fp(xz, zq2[0])
+        i_xzq2b = qz.fp(xz, zq2[1])
+        qz.run()
+        zq3 = r_zq3()
+        xzq2 = (qz[i_xzq2a], qz[i_xzq2b])
+        qz = _MulQueue()
+        i_ypq3a = qz.fp(yp, zq3[0])
+        i_ypq3b = qz.fp(yp, zq3[1])
+        qz.run()
+        ypq3 = (qz[i_ypq3a], qz[i_ypq3b])
+
     def step(carry, bit):
         # 7 dependency rounds, each one stacked mont_mul.  Formula-for-
         # formula identical to pairing_fast.miller_loop_fast's sequence:
         # tangent line at T → f²·l → double T → chord line → f·l' →
-        # mixed-add T+Q, with the add half masked by the bit.
+        # add T+Q (mixed for affine Q, full Jacobian for zq lanes), with
+        # the add half masked by the bit.
         f, X, Y, Z = carry
 
         q1 = _MulQueue()
@@ -411,9 +442,12 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
         r_t = q2.fp2(xb, xb)           # (X + Y²)²
         r_ff = q2.fp2(E, E)            # (3X²)²
         r_zz2 = q2.fp2(Z3, Z3)         # new Z² (for the add step)
+        if zq is not None:
+            r_z3zq = q2.fp2(Z3, zq)    # toward Z3a = 2·(Z3·Zq)·H
         q2.run()
         xxx, xxzz, yzzz, c4, t, ff, zz2 = (
             r_xxx(), r_xxzz(), r_yzzz(), r_c4(), r_t(), r_ff(), r_zz2())
+        z3zq = r_z3zq() if zq is not None else None
         D = fp2_scale(fp2_sub(fp2_sub(t, xx), c4), 2)
         X3 = fp2_sub(ff, fp2_scale(D, 2))
         a0 = fp2_sub(fp2_scale(xxx, 3), fp2_scale(yy, 2))
@@ -429,47 +463,62 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
         i_a0a = q3.fp(a0[0], zp3)
         i_a0b = q3.fp(a0[1], zp3)
         r_zzz = q3.fp2(Z3, zz2)
-        r_xqzz2 = q3.fp2(xq, zz2)
+        r_xqzz2 = q3.fp2(xq, zz2)      # U2 = Xq·Z3²
+        if zq is not None:
+            r_u1 = q3.fp2(X3, zq2)     # U1 = X3·Zq²
         q3.run()
         Y3 = fp2_sub(r_ey(), fp2_scale(c4, 8))
         a1 = (bi.neg(q3[i_a1a]), bi.neg(q3[i_a1b]))
         b1 = (q3[i_b1a], q3[i_b1b])
         a0s = (q3[i_a0a], q3[i_a0b])
         zzz, xqzz2 = r_zzz(), r_xqzz2()
+        u1 = r_u1() if zq is not None else X3
+        H = fp2_sub(xqzz2, u1)          # U2 - U1
         # (X3, Y3, Z3) is the doubled point; (a0s, a1, b1) the tangent line
         # (scaled by the subfield factor Zp³ — a no-op for affine P)
 
         q4 = _MulQueue()
         r_fd = q4.sparse(fsq, a0s, a1, b1)
-        r_yqzzz = q4.fp2(yq, zzz)
-        r_dl = q4.fp2(fp2_sub(X3, xqzz2), Z3)
+        r_yqzzz = q4.fp2(yq, zzz)      # S2 = Yq·Z3³
+        r_dl = q4.fp2(fp2_neg(H), Z3)  # dl = (U1 - U2)·Z3
+        if zq is not None:
+            r_s1 = q4.fp2(Y3, zq3)     # S1 = Y3·Zq³
+            r_z3ah = q4.fp2(z3zq, H)   # (Z3·Zq)·H
         q4.run()
         f_dbl = r_fd()
         yqzzz = r_yqzzz()
         dl = r_dl()
-        Nl = fp2_sub(Y3, yqzzz)
-        H = fp2_sub(xqzz2, X3)          # U2 - X (mixed add)
+        s1 = r_s1() if zq is not None else Y3
+        Nl = fp2_sub(s1, yqzzz)        # S1 - S2
 
         q5 = _MulQueue()
         r_nxq = q5.fp2(Nl, zxq)
         r_dyq = q5.fp2(dl, zyq)
-        i_c1a = q5.fp(Nl[0], xz)
-        i_c1b = q5.fp(Nl[1], xz)
-        i_d1a = q5.fp(dl[0], yp)
-        i_d1b = q5.fp(dl[1], yp)
+        if zq is not None:
+            r_c1 = q5.fp2(Nl, xzq2)    # c1 = -Nl·(xz·Zq²)
+            r_d1 = q5.fp2(dl, ypq3)    # d1 = dl·(yp·Zq³)
+        else:
+            i_c1a = q5.fp(Nl[0], xz)
+            i_c1b = q5.fp(Nl[1], xz)
+            i_d1a = q5.fp(dl[0], yp)
+            i_d1b = q5.fp(dl[1], yp)
         r_hh = q5.fp2(H, H)
         q5.run()
         c0a = fp2_sub(r_nxq(), r_dyq())
-        c1a = (bi.neg(q5[i_c1a]), bi.neg(q5[i_c1b]))
-        d1a = (q5[i_d1a], q5[i_d1b])
+        if zq is not None:
+            c1a = fp2_neg(r_c1())
+            d1a = r_d1()
+        else:
+            c1a = (bi.neg(q5[i_c1a]), bi.neg(q5[i_c1b]))
+            d1a = (q5[i_d1a], q5[i_d1b])
         hh = r_hh()
         I = fp2_scale(hh, 4)
-        r_vec = fp2_scale(fp2_sub(yqzzz, Y3), 2)  # r = 2(S2 - Y)
+        r_vec = fp2_scale(fp2_sub(yqzzz, s1), 2)  # r = 2(S2 - S1)
 
         q6 = _MulQueue()
         r_fa = q6.sparse(f_dbl, c0a, c1a, d1a)
         r_j = q6.fp2(H, I)
-        r_v = q6.fp2(X3, I)
+        r_v = q6.fp2(u1, I)            # V = U1·I
         r_rr = q6.fp2(r_vec, r_vec)
         q6.run()
         f_add = r_fa()
@@ -478,12 +527,16 @@ def batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=None):
 
         q7 = _MulQueue()
         r_rv = q7.fp2(r_vec, fp2_sub(v, X3a))
-        r_yj = q7.fp2(Y3, j)
-        zph = fp2_add(Z3, H)
-        r_zph2 = q7.fp2(zph, zph)
+        r_yj = q7.fp2(s1, j)           # S1·J
+        if zq is None:
+            zph = fp2_add(Z3, H)
+            r_zph2 = q7.fp2(zph, zph)
         q7.run()
         Y3a = fp2_sub(r_rv(), fp2_scale(r_yj(), 2))
-        Z3a = fp2_sub(fp2_sub(r_zph2(), zz2), hh)
+        if zq is None:
+            Z3a = fp2_sub(fp2_sub(r_zph2(), zz2), hh)
+        else:
+            Z3a = fp2_scale(r_z3ah(), 2)   # 2·Z3·Zq·H
 
         f = _select(bit, f_add, f_dbl)
         X, Y, Z = _select(bit, (X3a, Y3a, Z3a), (X3, Y3, Z3))

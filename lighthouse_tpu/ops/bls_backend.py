@@ -14,10 +14,11 @@ host crossings are counted on fingers):
   hash-to-curve (memoized per message), native final exponentiation of
   the one fetched Fq12;
 - device, one fused jit (_pipeline_fused): r_i·agg_pk_i over G1 lanes and
-  r_i·sig_i over G2 lanes (64-step double-and-add scans), the G2 tree-sum
-  with its affine conversion by Fermat inversion, per-message-group G1
-  segment folds, every Miller loop (G1 lanes consumed in JACOBIAN form
-  via subfield line scaling), and the product tree;
+  r_i·sig_i over G2 lanes in ONE merged 4-bit-windowed scan (16 steps of
+  shared mul-queue rounds), the G2 tree-sum, per-message-group G1 segment
+  folds, every Miller loop (G1 lanes in JACOBIAN form via subfield line
+  scaling; the Σ r·sig lane in Jacobian Fq2 form via the zq path — no
+  Fermat inversion anywhere), and the product tree;
 - device, one more jit when signatures are fresh: the batched ψ subgroup
   verdict (bool row home — ec.g2_subgroup_verdict_batch).
 
@@ -37,12 +38,11 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.bls import api, curve as cv
 from lighthouse_tpu.ops import bigint as bi
+from lighthouse_tpu.ops import cache_guard
 from lighthouse_tpu.ops import ec
 from lighthouse_tpu.ops.bls12_381 import (
     batch_miller_loop,
     final_exp_hard_device,
-    fp2_mul,
-    fp2_sqr,
     fq12_from_device,
     fq12_to_device,
     multi_pairing_device,
@@ -101,19 +101,6 @@ def prepare_pairs(sets: Sequence[api.SignatureSet]):
 from functools import partial
 
 
-def _fq2_jac_to_affine(X, Y, Z):
-    """Jacobian -> affine over Fq2 lanes: (X/Z², Y/Z³) via one Fermat
-    inversion chain on the norm.  Z ≡ 0 lanes come out as garbage zeros —
-    callers must mask them (the fused pipeline computes ~is_zero(Z) on
-    device for exactly that)."""
-    norm = bi.add(bi.mont_mul(Z[0], Z[0]), bi.mont_mul(Z[1], Z[1]))
-    ni = ec.fq_inv_batch(norm)
-    zi = (bi.mont_mul(Z[0], ni), bi.mont_mul(bi.neg(Z[1]), ni))
-    zi2 = fp2_sqr(zi)
-    zi3 = fp2_mul(zi2, zi)
-    return fp2_mul(X, zi2), fp2_mul(Y, zi3)
-
-
 @partial(jax.jit, static_argnums=(14,))
 def _pipeline_fused(pkx, pky, sxa, sxb, sya, syb,
                     hxa, hxb, hya, hyb, bits, lane_mask,
@@ -121,33 +108,46 @@ def _pipeline_fused(pkx, pky, sxa, sxb, sya, syb,
     """The WHOLE batch-verify data plane as ONE device program.
 
     Scalar-mults the G1 pubkey and G2 signature lanes, tree-sums Σ r·sig,
-    converts it to affine ON DEVICE (Fermat inversion — the round-3 split
-    pipeline came home for one host Fq2 inversion here, paying two relay
-    round trips ~80 ms each), folds per-message groups when n_groups > 0,
-    then runs every Miller loop and the product tree.  Host boundary:
-    uploads in, ONE Fq12 pytree out (final exp is native C++).
+    folds per-message groups when n_groups > 0, then runs every Miller
+    loop and the product tree.  Host boundary: uploads in, ONE Fq12
+    pytree out (final exp is native C++).
+
+    The Σ r·sig lane enters the Miller loop in JACOBIAN form (zq path —
+    its Zq⁵ line factors die in the final exponentiation), so no affine
+    conversion runs at all: the round-4 pipeline spent a 381-step
+    width-1 Fermat-inversion scan here, ~half its sequential depth.
 
     The Σ r·sig lane's mask bit is resolved on device too: an identity
     sum degenerates the check to Π e(r·pk_i, H(m_i)) == 1 with the sum
-    lane masked out — same semantics the host branch used to implement."""
-    Xp, Yp, Zp = ec.g1_scalar_mul_batch(pkx, pky, bits)
+    lane masked out — same semantics the host branch used to implement.
+
+    `bits` carries MSB-first base-16 WINDOW DIGITS (ec.scalars_to_digits):
+    the G1 pubkey and G2 signature lanes share their blinding scalars, so
+    both run through ONE merged windowed scan (4 bits per step from
+    16-entry Jacobian tables, shared mul-queue rounds — ~2.5x fewer
+    sequential rounds than the two binary scans it replaces)."""
+    (Xp, Yp, Zp), (SX, SY, SZ) = ec.gj_scalar_mul_windowed(
+        pkx, pky, (sxa, sxb), (sya, syb), bits)
     if n_groups:
         Xp, Yp, Zp = ec.g1_segment_sum(Xp, Yp, Zp, n_groups)
-    SX, SY, SZ = ec.g2_scalar_mul_batch(sxa, sxb, sya, syb, bits)
     SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
     sum_ok = ~(bi.is_zero_mod_p_device(SZ[0])
                & bi.is_zero_mod_p_device(SZ[1]))
-    ax, ay = _fq2_jac_to_affine(SX, SY, SZ)
     one = jnp.broadcast_to(bi._jconst("one_m"), (1, bi.L))
+    ones_q = jnp.broadcast_to(bi._jconst("one_m"), hxa.shape)
+    zeros_q = jnp.zeros_like(hxa)
     xp = jnp.concatenate([Xp, g1x])
     yp = jnp.concatenate([Yp, g1y])
     zp = jnp.concatenate([Zp, one])
-    xqa = jnp.concatenate([hxa, ax[0]])
-    xqb = jnp.concatenate([hxb, ax[1]])
-    yqa = jnp.concatenate([hya, ay[0]])
-    yqb = jnp.concatenate([hyb, ay[1]])
+    xqa = jnp.concatenate([hxa, SX[0]])
+    xqb = jnp.concatenate([hxb, SX[1]])
+    yqa = jnp.concatenate([hya, SY[0]])
+    yqb = jnp.concatenate([hyb, SY[1]])
+    zqa = jnp.concatenate([ones_q, SZ[0]])
+    zqb = jnp.concatenate([zeros_q, SZ[1]])
     mask = jnp.concatenate([lane_mask, sum_ok])
-    f = batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb, zp=zp)
+    f = batch_miller_loop(xp, yp, xqa, xqb, yqa, yqb,
+                          zp=zp, zq=(zqa, zqb))
     return reduce_product(f, mask)
 
 
@@ -272,6 +272,7 @@ def aggregate_pubkeys_device(sets):
     second half the blinding lanes B_0..B_{k-1} (see _blinding) — every
     level-0 pair joins a pubkey with a distinct blinding point, so
     duplicate keys never produce the degenerate H == 0 chord."""
+    cache_guard.install()   # mmap headroom before any XLA compile
     n = len(sets)
     max_k = _next_pow2(max(len(s.pubkeys) for s in sets))
     n_pad = _next_pow2(n)              # bound the jit shape cache
@@ -302,6 +303,7 @@ def aggregate_pubkeys_device(sets):
 def batch_subgroup_check_g1(points) -> np.ndarray:
     """Device [r-1]P membership test over affine G1 points -> bool[n]
     (the trusted-setup validator and cold-pubkey batch path)."""
+    cache_guard.install()   # mmap headroom before any XLA compile
     n = len(points)
     if n == 0:
         return np.zeros(0, bool)
@@ -408,6 +410,8 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     when profiling (it serializes the pipeline)."""
     import time as _time
 
+    cache_guard.install()   # mmap headroom before any XLA compile
+
     def _mark(key, t0):
         if ledger is not None:
             ledger[key] = ledger.get(key, 0.0) + (_time.perf_counter() - t0)
@@ -500,7 +504,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         for lane, set_idx in enumerate(lane_of):
             if set_idx >= 0:
                 lane_scalars[lane] = scalars[set_idx]
-        bits = jnp.asarray(ec.scalars_to_bits(lane_scalars))
+        bits = jnp.asarray(ec.scalars_to_digits(lane_scalars))
         h2 = _g2_limbs([h2cs[members[0]] for members in order])
         ext = np.zeros((g_pad - n_groups, bi.L), np.uint32)
         if g_pad != n_groups:
@@ -521,7 +525,7 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         # padded lanes get zero scalars -> scalar-mul leaves them at
         # infinity, adding nothing to Σ r·sig; their Miller lanes are
         # masked out below
-        bits = jnp.asarray(ec.scalars_to_bits(scalars + [0] * pad))
+        bits = jnp.asarray(ec.scalars_to_digits(scalars + [0] * pad))
         n_seg_static = 0
         padded = padded_flat
         n_real_lanes = n

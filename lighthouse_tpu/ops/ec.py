@@ -211,6 +211,279 @@ def g1_scalar_mul_batch(xp, yp, bits):
     return _scalar_mul_batch(_FpAdapter, xp, yp, bits)
 
 
+# --- merged windowed scalar mul (the fused pipeline's production path) ------
+#
+# The binary double-and-add scan above runs 6 mul rounds per scalar bit
+# per group; the blinded batch-verify scalars drive BOTH a G1 lane set
+# (r·agg_pk) and a G2 lane set (r·sig) with the SAME scalars, so the
+# production path (a) processes 4 bits per step from a 16-entry Jacobian
+# table (4 cheap doublings + 1 table add ≈ 40% fewer field products) and
+# (b) runs the two groups through SHARED mul-queue rounds, halving the
+# sequential round count again.  The binary scan stays for the subgroup
+# checks, whose fail-closed behaviour on adversarial points is pinned to
+# its formulas (g2_subgroup_check_batch docstring).
+
+
+def _jac_double_multi(items):
+    """One Jacobian doubling (2007 Bernstein–Lange a=0) per (F, (X,Y,Z))
+    item, all tracks sharing the 3 mul-queue rounds.  Z == 0 lanes keep
+    an EXACT-zero Z (Y·Z products stay exact zeros), so infinity flows
+    through scan steps without an explicit flag."""
+    q1 = _MulQueue()
+    rs1 = [(F.mul(q1, X, X), F.mul(q1, Y, Y), F.mul(q1, Y, Z))
+           for F, (X, Y, Z) in items]
+    q1.run()
+    mids = []
+    q2 = _MulQueue()
+    for (F, (X, Y, Z)), (r_xx, r_yy, r_yz) in zip(items, rs1):
+        xx, yy, yz = r_xx(), r_yy(), r_yz()
+        E = F.scale(xx, 3)
+        Z3 = F.scale(yz, 2)
+        xb = F.add(X, yy)
+        mids.append((F, xx, yy, E, Z3,
+                     F.mul(q2, yy, yy), F.mul(q2, xb, xb),
+                     F.mul(q2, E, E)))
+    q2.run()
+    outs = []
+    q3 = _MulQueue()
+    for F, xx, yy, E, Z3, r_c4, r_t, r_ff in mids:
+        c4, t, ff = r_c4(), r_t(), r_ff()
+        D = F.scale(F.sub(F.sub(t, xx), c4), 2)
+        X3 = F.sub(ff, F.scale(D, 2))
+        outs.append((F, X3, Z3, c4, F.mul(q3, E, F.sub(D, X3))))
+    q3.run()
+    return [(X3, F.sub(r_ey(), F.scale(c4, 8)), Z3)
+            for F, X3, Z3, c4, r_ey in outs]
+
+
+def _jac_add_full_multi(items, infs=None):
+    """_jac_add_full for several (F, p, q) tracks over shared queues.
+
+    ``infs``: optional per-item (p_inf, q_inf) bool lanes REPLACING the
+    Z exact-zero probes.  The windowed scan needs this: over Fq2 a
+    doubling of an infinity lane runs fp2_mul, whose internal
+    subtractions render the value-zero Z as a nonzero multiple of P —
+    exact-zero testing only works when infinity provably flows through
+    plain mont_muls (see _scalar_mul_batch's explicit-flag note)."""
+    q = _MulQueue()
+    rs = [(F.mul(q, p[2], p[2]), F.mul(q, q2_[2], q2_[2]))
+          for F, p, q2_ in items]
+    q.run()
+    st1 = []
+    q = _MulQueue()
+    for (F, p, q2_), (r_z11, r_z22) in zip(items, rs):
+        z11, z22 = r_z11(), r_z22()
+        zs = F.add(p[2], q2_[2])
+        st1.append((F, p, q2_, z11, z22,
+                    F.mul(q, p[0], z22), F.mul(q, q2_[0], z11),
+                    F.mul(q, p[2], z11), F.mul(q, q2_[2], z22),
+                    F.mul(q, zs, zs)))
+    q.run()
+    st2 = []
+    q = _MulQueue()
+    for F, p, q2_, z11, z22, r_u1, r_u2, r_z1c, r_z2c, r_zz12 in st1:
+        u1, u2 = r_u1(), r_u2()
+        z1c, z2c, zz12 = r_z1c(), r_z2c(), r_zz12()
+        h = F.sub(u2, u1)
+        st2.append((F, p, q2_, z11, z22, u1, u2, h, zz12,
+                    F.mul(q, p[1], z2c), F.mul(q, q2_[1], z1c),
+                    F.mul(q, h, h)))
+    q.run()
+    st3 = []
+    q = _MulQueue()
+    for F, p, q2_, z11, z22, u1, u2, h, zz12, r_s1, r_s2, r_hh in st2:
+        s1, s2, hh = r_s1(), r_s2(), r_hh()
+        rv = F.scale(F.sub(s2, s1), 2)
+        i4 = F.scale(hh, 4)
+        zmul = F.sub(F.sub(zz12, z11), z22)
+        st3.append((F, p, q2_, s1, rv,
+                    F.mul(q, h, i4), F.mul(q, u1, i4),
+                    F.mul(q, rv, rv), F.mul(q, zmul, h)))
+    q.run()
+    st4 = []
+    q = _MulQueue()
+    for F, p, q2_, s1, rv, r_j, r_v, r_rr, r_z3 in st3:
+        j, v, rr, Z3 = r_j(), r_v(), r_rr(), r_z3()
+        X3 = F.sub(F.sub(rr, j), F.scale(v, 2))
+        st4.append((F, p, q2_, X3, Z3,
+                    F.mul(q, rv, F.sub(v, X3)), F.mul(q, s1, j)))
+    q.run()
+    outs = []
+    for i, (F, p, q2_, X3, Z3, r_ry, r_sj) in enumerate(st4):
+        Y3 = F.sub(r_ry(), F.scale(r_sj(), 2))
+        if infs is not None:
+            p_inf, q_inf = infs[i]
+        else:
+            p_inf = F.is_zero(p[2])
+            q_inf = F.is_zero(q2_[2])
+        X3 = F.select(p_inf, q2_[0], F.select(q_inf, p[0], X3))
+        Y3 = F.select(p_inf, q2_[1], F.select(q_inf, p[1], Y3))
+        Z3 = F.select(p_inf, q2_[2], F.select(q_inf, p[2], Z3))
+        outs.append((X3, Y3, Z3))
+    return outs
+
+
+def _window_tables(bases, width: int = 4):
+    """Per-track Jacobian tables [0·P .. (2^w-1)·P], built level by level
+    (double all existing entries, add the base) with all tracks stacked
+    through shared queues — ~24 mul rounds total.
+
+    bases: [(F, (xb, yb))].  Returns per track a (X, Y, Z) tuple whose
+    leaves are [2^w, N, L] stacks (Fq2 leaves are pairs of stacks)."""
+    n_entries = 1 << width
+
+    def cat(F, entries, coord):
+        if F is _Fq2Adapter:
+            return (jnp.concatenate([e[coord][0] for e in entries]),
+                    jnp.concatenate([e[coord][1] for e in entries]))
+        return jnp.concatenate([e[coord] for e in entries])
+
+    def split(F, arr, count):
+        if F is _Fq2Adapter:
+            a0 = jnp.split(arr[0], count)
+            a1 = jnp.split(arr[1], count)
+            return list(zip(a0, a1))
+        return jnp.split(arr, count)
+
+    tabs = []
+    for F, (xb, yb) in bases:
+        inf = (F.zeros_like(xb), F.zeros_like(yb), F.zeros_like(xb))
+        tabs.append([inf, (xb, yb, F.one_like(xb))])
+    level = 0
+    while len(tabs[0]) < n_entries:
+        lo = 1 << level
+        count = lo
+        items = []
+        for (F, _), tab in zip(bases, tabs):
+            ent = tab[lo:lo + count]
+            items.append((F, (cat(F, ent, 0), cat(F, ent, 1),
+                              cat(F, ent, 2))))
+        doubles = _jac_double_multi(items)
+        add_items = []
+        for (F, (xb, yb)), dbl in zip(bases, doubles):
+            if F is _Fq2Adapter:
+                base_j = ((jnp.tile(xb[0], (count, 1)),
+                           jnp.tile(xb[1], (count, 1))),
+                          (jnp.tile(yb[0], (count, 1)),
+                           jnp.tile(yb[1], (count, 1))),
+                          F.one_like((jnp.tile(xb[0], (count, 1)),
+                                      jnp.tile(xb[1], (count, 1)))))
+            else:
+                base_j = (jnp.tile(xb, (count, 1)),
+                          jnp.tile(yb, (count, 1)),
+                          F.one_like(jnp.tile(xb, (count, 1))))
+            add_items.append((F, dbl, base_j))
+        odds = _jac_add_full_multi(add_items)
+        for (F, _), tab, dbl, odd in zip(bases, tabs, doubles, odds):
+            dbl_s = split(F, dbl[0], count), split(F, dbl[1], count), \
+                split(F, dbl[2], count)
+            odd_s = split(F, odd[0], count), split(F, odd[1], count), \
+                split(F, odd[2], count)
+            for k in range(count):
+                tab.append((dbl_s[0][k], dbl_s[1][k], dbl_s[2][k]))
+                tab.append((odd_s[0][k], odd_s[1][k], odd_s[2][k]))
+        # append order per k is (2·(lo+k), 2·(lo+k)+1) = tab indices
+        # (2lo+2k, 2lo+2k+1): list index == multiple by construction
+        level += 1
+    out = []
+    for (F, _), tab in zip(bases, tabs):
+        if F is _Fq2Adapter:
+            stack = lambda c: (jnp.stack([e[c][0] for e in tab]),  # noqa: E731
+                               jnp.stack([e[c][1] for e in tab]))
+        else:
+            stack = lambda c: jnp.stack([e[c] for e in tab])  # noqa: E731
+        out.append((stack(0), stack(1), stack(2)))
+    return out
+
+
+def _table_pick(F, tab, digit):
+    """Per-lane table pick: tab leaves [2^w, N, L], digit uint32[N].
+
+    One-hot select chain instead of a dynamic gather: XLA:CPU's AOT
+    serializer (the persistent compile-cache writer) segfaults on
+    executables containing the gather (jax 0.9.0,
+    compilation_cache.put_executable_and_time), and 15 masked selects
+    over [N, L] rows are noise next to the field products anyway."""
+    def g(arr):
+        out = arr[0]
+        for d in range(1, arr.shape[0]):
+            out = jnp.where((digit == d)[:, None], arr[d], out)
+        return out
+
+    def pick(coord):
+        return (g(coord[0]), g(coord[1])) if F is _Fq2Adapter else g(coord)
+
+    return (pick(tab[0]), pick(tab[1]), pick(tab[2]))
+
+
+def g1_scalar_mul_windowed(xp, yp, digits):
+    """Single-track windowed scalar mul over G1 lanes (the MSM's form:
+    arbitrary-width scalars as [W, N] window digits).  Same table/flag
+    machinery as the merged scan."""
+    F1 = _FpAdapter
+    (tab1,) = _window_tables([(F1, (xp, yp))])
+    s1 = (F1.zeros_like(xp), F1.zeros_like(yp), F1.zeros_like(xp))
+    inf = jnp.ones(digits.shape[1:], bool)
+
+    def step(carry, digit):
+        t1, inf = carry
+        for _ in range(4):
+            (t1,) = _jac_double_multi([(F1, t1)])
+        p1 = _table_pick(F1, tab1, digit)
+        pick_inf = digit == 0
+        (t1,) = _jac_add_full_multi([(F1, t1, p1)],
+                                    infs=[(inf, pick_inf)])
+        return (t1, inf & pick_inf), None
+
+    (s1, inf), _ = jax.lax.scan(step, (s1, inf), digits)
+    zero = F1.zeros_like(s1[0])
+    return tuple(F1.select(inf, zero, c) for c in s1)
+
+
+def gj_scalar_mul_windowed(xp, yp, xq, yq, digits):
+    """r_i·P_i (G1) and r_i·Q_i (G2) in ONE windowed scan.
+
+    xp, yp: uint32[N, L] G1 affine; xq, yq: Fq2 limb pairs; digits:
+    uint32[W, N] MSB-first base-16 window digits of the shared scalars
+    (ec.scalars_to_digits).  Returns (G1 Jacobian, G2 Jacobian); zero-
+    scalar lanes come back as exact-zero-limb infinity (the
+    g2_sum_reduce identity form).  Collision (H == 0) chords carry the
+    same honest-random-blinding contract as the binary scan — do NOT
+    feed adversarial scalars (subgroup checks keep the binary path)."""
+    F1, F2 = _FpAdapter, _Fq2Adapter
+    tab1, tab2 = _window_tables([(F1, (xp, yp)), (F2, ((xq[0], xq[1]),
+                                                       (yq[0], yq[1])))])
+
+    s1 = (F1.zeros_like(xp), F1.zeros_like(yp), F1.zeros_like(xp))
+    zq = (jnp.zeros_like(xq[0]), jnp.zeros_like(xq[1]))
+    s2 = (zq, zq, zq)
+    # EXPLICIT infinity flag shared by both tracks (same scalars):
+    # fp2_mul's internal subtractions destroy exact-zero Z limbs on the
+    # Fq2 track, so Z probing cannot detect accumulator infinity here
+    inf = jnp.ones(digits.shape[1:], bool)
+
+    def step(carry, digit):
+        t1, t2, inf = carry
+        for _ in range(4):
+            t1, t2 = _jac_double_multi([(F1, t1), (F2, t2)])
+        p1 = _table_pick(F1, tab1, digit)
+        p2 = _table_pick(F2, tab2, digit)
+        pick_inf = digit == 0          # entry 0 is the only INF entry
+        t1, t2 = _jac_add_full_multi(
+            [(F1, t1, p1), (F2, t2, p2)],
+            infs=[(inf, pick_inf), (inf, pick_inf)])
+        return (t1, t2, inf & pick_inf), None
+
+    (s1, s2, inf), _ = jax.lax.scan(step, (s1, s2, inf), digits)
+    # canonicalize never-added lanes to exact-zero limbs (the
+    # g2_sum_reduce identity form)
+    out = []
+    for F, s in ((F1, s1), (F2, s2)):
+        zero = F.zeros_like(s[0])
+        out.append(tuple(F.select(inf, zero, c) for c in s))
+    return out[0], out[1]
+
+
 def g2_scalar_mul_batch(xqa, xqb, yqa, yqb, bits):
     """r_i·Q_i over G2 lanes (Fq2 coords as limb pairs)."""
     X, Y, Z = _scalar_mul_batch(_Fq2Adapter, (xqa, xqb), (yqa, yqb), bits)
@@ -317,7 +590,10 @@ def g1_segment_sum(X, Y, Z, n_segments: int):
 
 
 def g1_msm(xp, yp, bits):
-    """Multi-scalar multiplication: Σ k_i·P_i over G1 lanes.
+    """Multi-scalar multiplication: Σ k_i·P_i over G1 lanes (binary-scan
+    form — production MSMs use g1_msm_windowed; this stays as the
+    independent cross-check oracle for it, see
+    tests/test_ec.py::test_g1_windowed_msm_matches_binary).
 
     xp, yp: uint32[N, 27] affine Montgomery limbs (N a power of two);
     bits: uint32[n_bits, N] MSB-first scalar bit planes (zero scalars give
@@ -325,6 +601,14 @@ def g1_msm(xp, yp, bits):
     the KZG commitment/verification workhorse (reference c-kzg's
     g1_lincomb, consumed via /root/reference/crypto/kzg/src/lib.rs)."""
     X, Y, Z = _scalar_mul_batch(_FpAdapter, xp, yp, bits)
+    return g1_sum_reduce(X, Y, Z)
+
+
+def g1_msm_windowed(xp, yp, digits):
+    """g1_msm over window digits ([W, N] from scalars_to_digits): ~40%
+    fewer products and ~1.4x fewer sequential rounds than the binary
+    scan for the KZG MSM's 255-bit scalars."""
+    X, Y, Z = g1_scalar_mul_windowed(xp, yp, digits)
     return g1_sum_reduce(X, Y, Z)
 
 
@@ -559,6 +843,23 @@ def ints_to_limbs(vals) -> np.ndarray:
 def ints_to_mont_limbs(vals) -> np.ndarray:
     """Vectorized to_mont: ints -> Montgomery limb rows uint32[n, 27]."""
     return ints_to_limbs([(int(v) * bi.R_INT) % bi.P_INT for v in vals])
+
+
+def scalars_to_digits(scalars, n_bits: int = 64, w: int = 4) -> np.ndarray:
+    """Scalars -> uint32[n_bits//w, n] MSB-first base-2^w window digits
+    (the gj_scalar_mul_windowed input form)."""
+    n = len(scalars)
+    n_dig = n_bits // w
+    if n == 0:
+        return np.zeros((n_dig, 0), np.uint32)
+    n_bytes = (n_bits + 7) // 8
+    buf = b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars)
+    byts = np.frombuffer(buf, np.uint8).reshape(n, n_bytes)
+    bits = np.unpackbits(byts, axis=1, bitorder="big")[:, -n_bits:]
+    weights = 1 << np.arange(w - 1, -1, -1, dtype=np.uint32)
+    digs = (bits.reshape(n, n_dig, w).astype(np.uint32) * weights).sum(
+        axis=2, dtype=np.uint32)
+    return np.ascontiguousarray(digs.T)
 
 
 def scalars_to_bits(scalars, n_bits: int = 64) -> np.ndarray:

@@ -26,6 +26,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# XLA:CPU mmaps >60k regions compiling this suite's fused programs; past
+# vm.max_map_count the process segfaults in whatever XLA path is active
+# (the rounds-4/5 "cache segfault" in all its guises).  Raise the ceiling
+# up front — root-only; on non-root hosts install() falls back to cache
+# filtering for the heaviest programs.
+from lighthouse_tpu.ops import cache_guard  # noqa: E402
+
+cache_guard.install()
+
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: the BLS12-381 Miller program costs ~1 min of
 # XLA compile; cache it across test runs (repo-local, gitignored)
@@ -47,9 +56,13 @@ def pytest_runtestloop(session):
 
     A single long-lived process that JIT-loads every executable the suite
     compiles crosses the kernel's vm.max_map_count ceiling (~test 167 of
-    571 on this image) and the next XLA compile segfaults inside mmap;
-    in-process cache clearing (the module fixture below) only delays the
-    ceiling and was judged not to hold.  So when one pytest invocation
+    571 on this image at the 65,530 default) and the next XLA compile
+    segfaults inside mmap; in-process cache clearing (the module fixture
+    below) only delays the ceiling and was judged not to hold.  The
+    PRIMARY fix is cache_guard.ensure_map_headroom() above (raise the
+    ceiling 4x); per-file children remain as defense in depth — they
+    also bound each process's RSS on this 1-core box and keep one bad
+    file from killing the whole run.  So when one pytest invocation
     spans more than one test file, each file's selected tests run in a
     short-lived child process — `pytest tests` stays the reference's
     one-command UX (/root/reference/Makefile:105-119) while every child

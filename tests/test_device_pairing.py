@@ -36,6 +36,56 @@ def test_batch_miller_matches_scalar_oracle():
         assert dev.fq12_from_device(fl) == miller_loop_fast(*pairs[lane])
 
 
+@slow
+def test_jacobian_q_miller_matches_affine():
+    """The zq path: Q lanes given in randomized Jacobian coordinates
+    (X·Z², Y·Z³, Z) must produce the same FINAL-EXPONENTIATED value as
+    the affine run — the Zq⁵ line factors must die in the final exp.
+    This is the soundness base for the fused pipeline's inversion-free
+    Σ r·sig lane."""
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.crypto.bls.fields import P, final_exponentiation_fast
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.ops import bls12_381 as dev
+
+    g1, g2 = cv.g1_generator(), cv.g2_generator()
+    pairs = [(cv.g1_mul(g1, 7), cv.g2_mul(g2, 9)),
+             (cv.g1_mul(g1, 31), cv.g2_mul(g2, 5)),
+             (cv.g1_neg(cv.g1_mul(g1, 63)), g2),
+             (cv.g1_neg(cv.g1_mul(g1, 155)), g2)]
+    cols, _ = dev.points_to_device(pairs)
+    n = len(pairs)
+
+    # scale Q lanes into Jacobian form by per-lane Fq2 factors z_i
+    zs = [cv.Fq2(3 + i, 11 * i + 1) for i in range(n)]
+    xq = [p[1][0] * z * z for p, z in zip(pairs, zs)]
+    yq = [p[1][1] * z * z * z for p, z in zip(pairs, zs)]
+
+    def fq2_rows(vals):
+        from lighthouse_tpu.ops import ec
+        return (jnp.asarray(ec.ints_to_mont_limbs([v.a for v in vals])),
+                jnp.asarray(ec.ints_to_mont_limbs([v.b for v in vals])))
+
+    xqa, xqb = fq2_rows(xq)
+    yqa, yqb = fq2_rows(yq)
+    zqa, zqb = fq2_rows(zs)
+    f_jac = jax.jit(lambda *a: dev.batch_miller_loop(*a[:6], zq=(a[6], a[7])))(
+        jnp.asarray(cols[0]), jnp.asarray(cols[1]),
+        xqa, xqb, yqa, yqb, zqa, zqb)
+    f_aff = jax.jit(dev.batch_miller_loop)(*[jnp.asarray(c) for c in cols])
+    # per-lane miller values differ by Fq2 factors; after the final exp
+    # the products over any sub-batch must agree exactly
+    mask = jnp.ones(n, bool)
+    pj = dev.fq12_from_device(
+        jax.tree_util.tree_map(np.asarray, dev.reduce_product(f_jac, mask)))
+    pa = dev.fq12_from_device(
+        jax.tree_util.tree_map(np.asarray, dev.reduce_product(f_aff, mask)))
+    assert final_exponentiation_fast(pj) == final_exponentiation_fast(pa)
+    # this specific product cancels: e(7G1,9G2)·e(-63G1,G2) != 1 but the
+    # 4-lane set (7·9 + 31·5 - 63 - 155 = 0) is a valid cancellation
+    assert final_exponentiation_fast(pj).is_one()
+
+
 def test_multi_pairing_cancellation():
     from lighthouse_tpu.crypto.bls import curve as cv
     from lighthouse_tpu.ops import bls12_381 as dev

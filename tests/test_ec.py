@@ -69,6 +69,46 @@ def test_g2_scalar_mul_batch_matches_oracle():
         assert _jac_to_affine_fq2(X, Y, Z, i) == cv.g2_mul(p, k), f"lane {i}"
 
 
+def test_windowed_merged_scalar_mul_matches_oracle():
+    """gj_scalar_mul_windowed (the fused pipeline's production scan):
+    both tracks, window-edge scalars, zero-scalar infinity lanes, and
+    the exact-zero canonical form the sum reduce requires."""
+    g1, g2 = cv.g1_generator(), cv.g2_generator()
+    scalars = [1, 0, 16, 15, 0xD201000000010000, 0xFFFFFFFFFFFFFFFF,
+               0x8000000000000000, 0x9AB]
+    p1 = [cv.g1_mul(g1, 3 + i) for i in range(8)]
+    p2 = [cv.g2_mul(g2, 5 + i) for i in range(8)]
+    xs, ys = _g1_lanes(p1)
+    xqa, xqb, yqa, yqb = _g2_lanes(p2)
+    digits = jnp.asarray(ec.scalars_to_digits(scalars))
+    (X1, Y1, Z1), (X2, Y2, Z2) = jax.jit(ec.gj_scalar_mul_windowed)(
+        xs, ys, (xqa, xqb), (yqa, yqb), digits)
+    for i, k in enumerate(scalars):
+        want1 = cv.g1_mul(p1[i], k) if k else cv.INF
+        assert _jac_to_affine_fp(X1, Y1, Z1, i) == want1, f"g1 lane {i}"
+        want2 = cv.g2_mul(p2[i], k) if k else cv.INF
+        assert _jac_to_affine_fq2(X2, Y2, Z2, i) == want2, f"g2 lane {i}"
+    # zero-scalar lanes canonicalize to EXACT zero limbs (identity form)
+    assert not np.asarray(X2[0])[1].any() and not np.asarray(Z1)[1].any()
+
+
+def test_g1_windowed_msm_matches_binary():
+    g = cv.g1_generator()
+    pts = [cv.g1_mul(g, 7 + i) for i in range(8)]
+    scalars = [3, 0, (1 << 255) - 19, 5, 1, 2, 12345, 99]
+    xs, ys = _g1_lanes(pts)
+    Xw, Yw, Zw = jax.jit(ec.g1_msm_windowed)(
+        xs, ys, jnp.asarray(ec.scalars_to_digits(scalars, n_bits=256)))
+    want = cv.INF
+    for p, k in zip(pts, scalars):
+        want = cv.g1_add(want, cv.g1_mul(p, k))
+    assert _jac_to_affine_fp(Xw, Yw, Zw, 0) == want
+    # and against the binary-scan MSM (two independent device paths)
+    Xb, Yb, Zb = jax.jit(ec.g1_msm)(
+        xs, ys, jnp.asarray(ec.scalars_to_bits(scalars, n_bits=256)))
+    assert _jac_to_affine_fp(Xb, Yb, Zb, 0) == want
+
+
 def test_g2_sum_reduce_matches_oracle():
     g = cv.g2_generator()
     pts = [cv.g2_mul(g, k) for k in (11, 22, 33, 44)]

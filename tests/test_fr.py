@@ -55,6 +55,19 @@ class TestFieldOps:
         assert all(int(g) == (x + y) % R for g, x, y in zip(gs, a, b))
         assert all(int(g) == (x - y) % R for g, x, y in zip(gd, a, b))
 
+    def test_batch_inverse_tree(self, rand_pairs):
+        """Product-tree simultaneous inversion == per-lane Fermat ==
+        python pow, over a [N, W] grid (the barycentric denominator
+        shape)."""
+        a, b, am, bm = rand_pairs
+        vals = [(x * y + 1 + i) % R or 1
+                for i, (x, y) in enumerate(zip(a * 2, b * 2))]
+        grid = jnp.asarray(fr.to_mont_host(vals)).reshape(4, 8, fr.L)
+        got = fr.from_mont_host(np.asarray(
+            jax.jit(fr.batch_inv_mont)(grid)).reshape(32, fr.L))
+        assert all(int(g) == pow(v, -1, R)
+                   for g, v in zip(got, vals))
+
     def test_fermat_inverse(self, rand_pairs):
         a, _, am, _ = rand_pairs
         inv = fr.from_mont_host(np.asarray(jax.jit(fr.inv_mont)(am)))

@@ -92,6 +92,26 @@ def test_blob_proof_batch(settings):
     assert kzg.verify_blob_kzg_proof_batch([], [], [], settings)
 
 
+def test_blob_proof_batch_fused_device_path(settings):
+    """>= _DEVICE_EVAL_MIN blobs ride the fused one-dispatch plane
+    (device barycentric eval + both MSMs + pairing in one jit): valid
+    batch accepts, one tampered proof rejects, and a non-canonical blob
+    field is caught by the vectorized validity check."""
+    n = kzg._DEVICE_EVAL_MIN
+    blobs = [_blob(settings, 30 + i) for i in range(n)]
+    cs = [kzg.blob_to_kzg_commitment(b, settings) for b in blobs]
+    proofs = [kzg.compute_blob_kzg_proof(b, c, settings)
+              for b, c in zip(blobs, cs)]
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cs, proofs, settings)
+    bad = list(proofs)
+    bad[3] = proofs[2]
+    assert not kzg.verify_blob_kzg_proof_batch(blobs, cs, bad, settings)
+    # non-canonical field element (>= BLS_MODULUS) rejected up front
+    evil = list(blobs)
+    evil[1] = b"\xff" * 32 + blobs[1][32:]
+    assert not kzg.verify_blob_kzg_proof_batch(evil, cs, proofs, settings)
+
+
 def test_constant_blob_infinity_proof(settings):
     """Constant polynomial -> zero quotient -> infinity proof point."""
     vals = [42] * settings.width
