@@ -39,6 +39,9 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--execution-jwt", default=None,
                     help="hex JWT secret for the engine API")
     bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--slasher-backend", default="native",
+                    choices=("memory", "native", "sqlite"),
+                    help="slasher DB engine (reference --slasher-backend)")
     bn.add_argument("--interop-validators", type=int, default=64,
                     help="interop genesis validator count (dev networks)")
     bn.add_argument("--genesis-fork", default="capella")
@@ -185,6 +188,7 @@ def _run_bn(args) -> int:
         execution_endpoint=args.execution_endpoint,
         execution_jwt_hex=args.execution_jwt,
         slasher_enabled=args.slasher,
+        slasher_backend=args.slasher_backend,
         n_genesis_validators=args.interop_validators,
         genesis_fork=args.genesis_fork,
         genesis_time=args.genesis_time,

@@ -27,6 +27,7 @@ class ClientConfig:
     execution_jwt_hex: str | None = None
     eth1_endpoint: object | None = None  # in-process endpoint object
     slasher_enabled: bool = False
+    slasher_backend: str = "native"
     n_genesis_validators: int = 64
     genesis_fork: str = "capella"
     verify_signatures: bool = True
@@ -296,9 +297,22 @@ class ClientBuilder:
         if self._eth1 is not None:
             self.chain.eth1_service = self._eth1
         if self.config.slasher_enabled:
-            from lighthouse_tpu.slasher import SlasherService
+            import os as _os
 
-            self.chain.slasher = SlasherService(self.chain)
+            from lighthouse_tpu.slasher import SlasherService
+            from lighthouse_tpu.slasher.slasher import (
+                Slasher,
+                SlasherConfig,
+            )
+
+            cfg = SlasherConfig(
+                backend=self.config.slasher_backend,
+                db_path=None if self.config.slasher_backend == "memory"
+                else _os.path.join(self.config.datadir, "slasher.db"))
+            self.chain.slasher = SlasherService(
+                self.chain, slasher=Slasher(
+                    self.chain.spec, self.chain.t, config=cfg,
+                    n_validators=len(self.chain.head_state.validators)))
         return self
 
     def build(self) -> Client:

@@ -3,40 +3,68 @@
 Rebuild of /root/reference/slasher/src/array.rs, redesigned columnar:
 the reference keeps chunked (validator × epoch) u16 min/max-target-
 distance arrays with per-chunk disk pages and lazy running extremes;
-here the whole window lives as two numpy (validator × history) planes
-and every check/update is a vectorized slice over the attesting
-committee — one numpy reduction per (source, target) group instead of
-per-validator chunk walks.
+here the whole window lives as two numpy (validator × history) uint16
+DISTANCE planes and every check/update is a vectorized slice over the
+attesting committee — one numpy reduction per (source, target) group
+instead of per-validator chunk walks.
 
-min_plane[v, e % H] = min attestation target by v with source epoch e
-max_plane[v, e % H] = max target likewise (NOVAL sentinels when empty).
+Encoding (matches the reference's u16 distance choice,
+slasher/src/array.rs): for a column holding source epoch e,
+
+  min_plane[v, e % H] = min (target - e) over v's attestations with
+                        source epoch e          (0xFFFF when empty)
+  max_plane[v, e % H] = max (target - e) likewise  (0 when empty)
+
+Distances within the detection window are <= H + 1 << 0xFFFE, so u16
+never saturates in reachable states; uint16 halves resident memory vs
+a target-epoch encoding (16 MB per 1k validators at H=4096 -> 8 MB,
+and zlib compresses the NOVAL-dominated planes ~100x on disk).
 
 For a new attestation (s, t) by committee V:
-  * it SURROUNDS an earlier vote  iff min over e in (s, t) of
-    min_plane[V, e] is < t        (victim has s' > s, t' < t)
-  * it is SURROUNDED by one       iff max over e in (max(0, t-H), s) of
-    max_plane[V, e] is > t        (attacker has s' < s, t' > t)
+  * it SURROUNDS an earlier vote   iff  min_plane[V, e] < t - e for
+    some column e in (s, t)         (victim has s' > s, t' < t)
+  * it is SURROUNDED by one        iff  max_plane[V, e] > t - e for
+    some column e in (max(0, t-H), s)  (attacker has s' < s, t' > t)
 
 Epoch indices wrap modulo the history length; advancing the current
 epoch clears the recycled columns (the reference's chunk pruning).
+
+Persistence (reference array.rs chunked zlib pages): the planes save
+to any KeyValueStore as per-(validator-chunk × epoch-chunk) zlib blobs
+— 256 validators × 16 columns per blob, the reference's
+DEFAULT_VALIDATOR_CHUNK_SIZE × DEFAULT_CHUNK_SIZE — with each blob
+carrying its own column-epoch snapshot so stale blobs self-invalidate
+on load.  Only dirty chunks rewrite (save() after each batch is an
+incremental flush, not a full dump).
 """
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
 
-MIN_NOVAL = np.uint32(0xFFFFFFFF)
-MAX_NOVAL = np.uint32(0)
+from lighthouse_tpu.store.kv import KeyValueOp, KeyValueStore
+
+MIN_NOVAL = np.uint16(0xFFFF)
+MAX_NOVAL = np.uint16(0)
+
+CHUNK_V = 256   # validators per persisted blob (ref validator_chunk_size)
+CHUNK_E = 16    # columns per persisted blob (ref chunk_size)
+
+P_CHUNK = b"sc:"   # (vchunk, echunk) -> zlib(col_epochs || min || max)
+P_META = b"sce:"   # global column-epoch array + validator count
 
 
 class SurroundArray:
     def __init__(self, n_validators: int, history_length: int = 4096):
         self.H = int(history_length)
         self.n = int(n_validators)
-        self.min_plane = np.full((self.n, self.H), MIN_NOVAL, np.uint32)
-        self.max_plane = np.full((self.n, self.H), MAX_NOVAL, np.uint32)
+        self.min_plane = np.full((self.n, self.H), MIN_NOVAL, np.uint16)
+        self.max_plane = np.full((self.n, self.H), MAX_NOVAL, np.uint16)
         # absolute source epoch stored in each column, NONE = -1
         self.col_epoch = np.full(self.H, -1, np.int64)
+        self._dirty: set[tuple[int, int]] = set()
 
     def _ensure_validators(self, max_index: int) -> None:
         if max_index < self.n:
@@ -59,14 +87,16 @@ class SurroundArray:
             self.col_epoch[col] = epoch
         return col
 
-    def _columns_range(self, lo: int, hi: int) -> np.ndarray:
-        """Valid columns holding sources in [lo, hi) (absolute epochs)."""
+    def _columns_range(self, lo: int, hi: int
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """(columns, their absolute epochs) holding sources in [lo, hi)."""
         if hi <= lo:
-            return np.zeros(0, np.int64)
+            z = np.zeros(0, np.int64)
+            return z, z
         epochs = np.arange(max(lo, 0), hi, dtype=np.int64)
         cols = epochs % self.H
         live = self.col_epoch[cols] == epochs
-        return cols[live]
+        return cols[live], epochs[live]
 
     def check_and_insert(
         self, indices: np.ndarray, source: int, target: int
@@ -83,27 +113,34 @@ class SurroundArray:
             self._ensure_validators(int(indices.max()))
         s, t = int(source), int(target)
 
-        # victims of the new vote: sources strictly inside (s, t)
-        cols_in = self._columns_range(s + 1, t)
+        # victims of the new vote: sources strictly inside (s, t); the
+        # per-column threshold is the new vote's distance from THAT column
+        cols_in, eps_in = self._columns_range(s + 1, t)
         if cols_in.size and indices.size:
             window = self.min_plane[np.ix_(indices, cols_in)]
-            surrounds = window.min(axis=1) < np.uint32(t)
+            thresh = (t - eps_in).astype(np.uint16)  # in (0, H)
+            surrounds = (window < thresh[None, :]).any(axis=1)
         else:
             surrounds = np.zeros(indices.shape[0], bool)
 
         # attackers of the new vote: sources strictly before s, targets > t
-        cols_before = self._columns_range(t - self.H + 1, s)
+        cols_before, eps_before = self._columns_range(t - self.H + 1, s)
         if cols_before.size and indices.size:
             window = self.max_plane[np.ix_(indices, cols_before)]
-            surrounded = window.max(axis=1) > np.uint32(t)
+            thresh = np.minimum(t - eps_before, 0xFFFE).astype(np.uint16)
+            surrounded = (window > thresh[None, :]).any(axis=1)
         else:
             surrounded = np.zeros(indices.shape[0], bool)
 
         col = self._column(s)
+        d = np.uint16(min(t - s, 0xFFFE))  # unreachable clip, belt only
         cur_min = self.min_plane[indices, col]
         cur_max = self.max_plane[indices, col]
-        self.min_plane[indices, col] = np.minimum(cur_min, np.uint32(t))
-        self.max_plane[indices, col] = np.maximum(cur_max, np.uint32(t))
+        self.min_plane[indices, col] = np.minimum(cur_min, d)
+        self.max_plane[indices, col] = np.maximum(cur_max, d)
+        ec = col // CHUNK_E
+        for vc in np.unique(indices // CHUNK_V):
+            self._dirty.add((int(vc), ec))
         return surrounds, surrounded
 
     def lookup_source_epochs(self, validator: int, lo: int, hi: int
@@ -119,5 +156,87 @@ class SurroundArray:
             mn = int(self.min_plane[validator, col])
             mx = int(self.max_plane[validator, col])
             if mn != int(MIN_NOVAL):
-                out.append((e, mn, mx))
+                out.append((e, e + mn, e + mx))
         return out
+
+    # -- chunked persistence ----------------------------------------------
+
+    def _chunk_key(self, vc: int, ec: int) -> bytes:
+        return P_CHUNK + int(vc).to_bytes(4, "little") + \
+            int(ec).to_bytes(4, "little")
+
+    def save(self, db: KeyValueStore, full: bool = False) -> int:
+        """Flush dirty (or all non-empty, when ``full``) chunks as zlib
+        blobs + the global column-epoch metadata.  Returns the number of
+        chunk blobs written."""
+        if full:
+            todo = {(vc, ec)
+                    for vc in range((self.n + CHUNK_V - 1) // CHUNK_V)
+                    for ec in range((self.H + CHUNK_E - 1) // CHUNK_E)}
+        else:
+            todo = set(self._dirty)
+        ops = []
+        for vc, ec in sorted(todo):
+            v0, v1 = vc * CHUNK_V, min((vc + 1) * CHUNK_V, self.n)
+            c0, c1 = ec * CHUNK_E, min((ec + 1) * CHUNK_E, self.H)
+            if v0 >= self.n or c0 >= self.H:
+                continue
+            mn = self.min_plane[v0:v1, c0:c1]
+            mx = self.max_plane[v0:v1, c0:c1]
+            if full and (mn == MIN_NOVAL).all() and (mx == MAX_NOVAL).all():
+                continue  # nothing recorded; skip the empty blob
+            raw = (self.col_epoch[c0:c1].tobytes()
+                   + np.ascontiguousarray(mn).tobytes()
+                   + np.ascontiguousarray(mx).tobytes())
+            ops.append(KeyValueOp(self._chunk_key(vc, ec),
+                                  zlib.compress(raw)))
+        meta = (int(self.n).to_bytes(8, "little")
+                + int(self.H).to_bytes(8, "little")
+                + self.col_epoch.tobytes())
+        ops.append(KeyValueOp(P_META, zlib.compress(meta)))
+        db.do_atomically(ops)
+        self._dirty.clear()
+        return len(ops) - 1
+
+    @classmethod
+    def load(cls, db: KeyValueStore,
+             history_length: int = 4096) -> "SurroundArray | None":
+        """Rebuild from chunk blobs; None when the store holds no array.
+
+        Each blob self-invalidates per column: rows whose embedded
+        column epoch disagrees with the global metadata (the column was
+        recycled after that blob's last write) reset to NOVAL."""
+        raw_meta = db.get(P_META)
+        if raw_meta is None:
+            return None
+        meta = zlib.decompress(raw_meta)
+        n = int.from_bytes(meta[:8], "little")
+        h = int.from_bytes(meta[8:16], "little")
+        if h != history_length:
+            raise ValueError(
+                f"stored history_length {h} != configured {history_length}")
+        arr = cls(n, h)
+        arr.col_epoch = np.frombuffer(meta[16:], np.int64).copy()
+        for key, blob in db.iter_prefix(P_CHUNK):
+            vc = int.from_bytes(key[len(P_CHUNK):len(P_CHUNK) + 4], "little")
+            ec = int.from_bytes(key[len(P_CHUNK) + 4:len(P_CHUNK) + 8],
+                                "little")
+            v0, v1 = vc * CHUNK_V, min((vc + 1) * CHUNK_V, n)
+            c0, c1 = ec * CHUNK_E, min((ec + 1) * CHUNK_E, h)
+            if v0 >= n or c0 >= h:
+                continue
+            raw = zlib.decompress(blob)
+            rows, cols = v1 - v0, c1 - c0
+            eb = cols * 8
+            blk = rows * cols * 2
+            blob_eps = np.frombuffer(raw[:eb], np.int64)
+            mn = np.frombuffer(raw[eb:eb + blk], np.uint16).reshape(
+                rows, cols)
+            mx = np.frombuffer(raw[eb + blk:eb + 2 * blk],
+                               np.uint16).reshape(rows, cols)
+            live = blob_eps == arr.col_epoch[c0:c1]
+            mn = np.where(live[None, :], mn, MIN_NOVAL)
+            mx = np.where(live[None, :], mx, MAX_NOVAL)
+            arr.min_plane[v0:v1, c0:c1] = mn
+            arr.max_plane[v0:v1, c0:c1] = mx
+        return arr

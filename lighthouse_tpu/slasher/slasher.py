@@ -28,7 +28,32 @@ P_BLOCK = b"sb:"    # (proposer, slot) -> signed header ssz
 @dataclass
 class SlasherConfig:
     history_length: int = 4096
+    # flush dirty min/max chunks to the KV store after every batch
+    # (reference: chunks write back to the slasher DB per update)
     chunk_persist: bool = True
+    # "memory" | "native" | "sqlite" — the reference swaps MDBX/LMDB/redb
+    # behind one interface (slasher/src/config.rs DEFAULT_BACKEND); the
+    # equivalent seam here picks the KeyValueStore implementation
+    backend: str = "memory"
+    db_path: str | None = None
+
+
+def open_slasher_db(config: SlasherConfig):
+    """Backend seam: build the KeyValueStore named by the config
+    (reference DatabaseBackend::{Mdbx,Lmdb,Redb} selection)."""
+    if config.backend == "memory":
+        return MemoryStore()
+    if config.db_path is None:
+        raise ValueError(f"backend {config.backend!r} needs db_path")
+    if config.backend == "native":
+        from lighthouse_tpu.store.kv import NativeKVStore
+
+        return NativeKVStore(config.db_path)
+    if config.backend == "sqlite":
+        from lighthouse_tpu.store.kv import SqliteStore
+
+        return SqliteStore(config.db_path)
+    raise ValueError(f"unknown slasher backend {config.backend!r}")
 
 
 @dataclass
@@ -43,8 +68,11 @@ class Slasher:
         self.spec = spec
         self.t = t
         self.config = config or SlasherConfig()
-        self.db = db if db is not None else MemoryStore()
-        self.array = SurroundArray(
+        self.db = db if db is not None else open_slasher_db(self.config)
+        # resume the min/max planes from a prior run's chunk blobs
+        # (reference: the arrays ARE the DB; here they load from it)
+        self.array = SurroundArray.load(
+            self.db, self.config.history_length) or SurroundArray(
             n_validators, self.config.history_length)
         self._att_queue: list = []
         self._block_queue: list = []
@@ -91,6 +119,8 @@ class Slasher:
 
         for header in blocks:
             self._detect_double_proposal(header, found)
+        if self.config.chunk_persist and groups:
+            self.array.save(self.db)  # incremental: dirty chunks only
         return found
 
     # -- double votes -----------------------------------------------------

@@ -13,6 +13,7 @@ from lighthouse_tpu.store.kv import (
     KeyValueStore,
     MemoryStore,
     NativeKVStore,
+    SqliteStore,
 )
 from lighthouse_tpu.store.migrations import (
     CURRENT_SCHEMA_VERSION,
@@ -30,6 +31,7 @@ __all__ = [
     "MemoryStore",
     "MigrationError",
     "NativeKVStore",
+    "SqliteStore",
     "StoreError",
     "migrate_schema",
     "read_schema_version",

@@ -93,6 +93,100 @@ class MemoryStore(KeyValueStore):
         return len(self._d)
 
 
+class SqliteStore(KeyValueStore):
+    """SQLite-backed store (stdlib, zero native deps).
+
+    Third swappable backend behind the KeyValueStore seam — the
+    reference ships three embedded engines behind one trait
+    (slasher/Cargo.toml mdbx/lmdb/redb feature trio) and this plays the
+    same role: transactional, ordered, single-file, available
+    everywhere the interpreter runs.  The native log store stays the
+    default for the hot beacon DB; SQLite suits the slasher/tooling
+    workloads where ACID batches and ad-hoc inspection matter more
+    than raw write throughput."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv "
+            "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.commit()
+
+    def get(self, key):
+        row = self._conn.execute(
+            "SELECT v FROM kv WHERE k = ?", (bytes(key),)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def put(self, key, value):
+        self._conn.execute(
+            "INSERT OR REPLACE INTO kv VALUES (?, ?)",
+            (bytes(key), bytes(value)))
+        self._conn.commit()
+
+    def delete(self, key):
+        self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+        self._conn.commit()
+
+    def exists(self, key):
+        return self._conn.execute(
+            "SELECT 1 FROM kv WHERE k = ?",
+            (bytes(key),)).fetchone() is not None
+
+    def do_atomically(self, ops):
+        with self._conn:  # one transaction: all or nothing
+            for op in ops:
+                if op.value is None:
+                    self._conn.execute(
+                        "DELETE FROM kv WHERE k = ?", (bytes(op.key),))
+                else:
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO kv VALUES (?, ?)",
+                        (bytes(op.key), bytes(op.value)))
+
+    def iter_prefix(self, prefix):
+        prefix = bytes(prefix)
+        # upper bound: increment the last non-0xFF byte and truncate;
+        # an all-0xFF prefix has no bound (scan to the end)
+        hi = None
+        for i in range(len(prefix) - 1, -1, -1):
+            if prefix[i] != 0xFF:
+                hi = prefix[:i] + bytes([prefix[i] + 1])
+                break
+        if prefix and hi is not None:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                (prefix, hi))
+        elif prefix:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (prefix,))
+        else:
+            rows = self._conn.execute("SELECT k, v FROM kv ORDER BY k")
+        for k, v in rows:
+            if not bytes(k).startswith(prefix):
+                continue
+            yield bytes(k), bytes(v)
+
+    def compact(self):
+        self._conn.execute("VACUUM")
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+    def disk_size_bytes(self) -> int:
+        (pages,) = self._conn.execute("PRAGMA page_count").fetchone()
+        (size,) = self._conn.execute("PRAGMA page_size").fetchone()
+        return int(pages) * int(size)
+
+    def __len__(self):
+        (n,) = self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()
+        return int(n)
+
+
 _lib = None
 
 

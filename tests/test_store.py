@@ -62,6 +62,43 @@ class TestNativeKV:
         db.close()
 
 
+class TestSqliteKV:
+    """Same contract as the native store, third backend of the seam
+    (reference ships mdbx/lmdb/redb behind one trait)."""
+
+    def test_roundtrip_and_persistence(self, tmp_path):
+        from lighthouse_tpu.store import SqliteStore
+
+        db = SqliteStore(str(tmp_path / "db.sqlite"))
+        db.put(b"a", b"1")
+        db.put(b"b", b"")
+        db.do_atomically([KeyValueOp(b"c", b"3"), KeyValueOp(b"a", None)])
+        assert db.get(b"a") is None
+        assert db.get(b"b") == b""
+        assert db.get(b"c") == b"3"
+        db.close()
+        db2 = SqliteStore(str(tmp_path / "db.sqlite"))
+        assert db2.get(b"c") == b"3"
+        assert db2.get(b"a") is None
+        assert len(db2) == 2
+        assert db2.disk_size_bytes() > 0
+        db2.close()
+
+    def test_prefix_iteration_is_ordered(self, tmp_path):
+        from lighthouse_tpu.store import SqliteStore
+
+        db = SqliteStore(str(tmp_path / "db.sqlite"))
+        for i in [3, 1, 2]:
+            db.put(b"p:" + bytes([i]), bytes([i]))
+        db.put(b"q:x", b"other")
+        db.put(b"p\xff" + b"z", b"edge")  # 0xff byte inside a key
+        got = list(db.iter_prefix(b"p:"))
+        assert got == [(b"p:\x01", b"\x01"), (b"p:\x02", b"\x02"),
+                       (b"p:\x03", b"\x03")]
+        assert list(db.iter_prefix(b"p\xff")) == [(b"p\xffz", b"edge")]
+        db.close()
+
+
 @pytest.fixture(scope="module")
 def chain_db():
     """A 2.5-epoch chain imported into a memory-backed HotColdDB."""
