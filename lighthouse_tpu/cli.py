@@ -39,6 +39,8 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--execution-jwt", default=None,
                     help="hex JWT secret for the engine API")
     bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--disable-upnp", action="store_true",
+                    help="skip UPnP gateway port mapping (reference flag)")
     bn.add_argument("--slasher-backend", default="native",
                     choices=("memory", "native", "sqlite"),
                     help="slasher DB engine (reference --slasher-backend)")
@@ -188,6 +190,7 @@ def _run_bn(args) -> int:
         execution_endpoint=args.execution_endpoint,
         execution_jwt_hex=args.execution_jwt,
         slasher_enabled=args.slasher,
+        upnp_enabled=not args.disable_upnp and args.listen_port is not None,
         slasher_backend=args.slasher_backend,
         n_genesis_validators=args.interop_validators,
         genesis_fork=args.genesis_fork,

@@ -49,6 +49,9 @@ class ClientConfig:
     # pure-Python reference otherwise; or force tpu/reference/fake
     # (reference seam: crypto/bls/src/lib.rs:86-141 backend selection)
     bls_backend: str = "auto"
+    # UPnP NAT traversal for the discovery port (reference enables by
+    # default with --disable-upnp as the opt-out)
+    upnp_enabled: bool = False
     # socket networking: None = no wire stack (in-process fabric only,
     # the simulator's mode); 0 = ephemeral port.  boot_nodes are
     # "host:port" UDP discovery addresses to bootstrap from
@@ -81,6 +84,9 @@ class Client:
     def stop(self) -> None:
         if self.http_server is not None:
             self.http_server.stop()
+        upnp = self.services.get("upnp")
+        if upnp is not None:
+            upnp.stop()
         wire = self.services.get("wire")
         if wire is not None:
             wire.stop()
@@ -415,6 +421,19 @@ class ClientBuilder:
         self.chain.network_service = svc
         self.log.info("wire network up", peer_id=fabric.peer_id,
                       port=fabric.listen_port)
+
+        if self.config.upnp_enabled:
+            # hold a UDP mapping for the discovery port on the LAN
+            # gateway (reference nat.rs construct_upnp_mappings)
+            import socket as _socket
+
+            from lighthouse_tpu.network.upnp import UpnpService
+
+            local_ip = _socket.gethostbyname(_socket.gethostname())
+            upnp_svc = UpnpService(local_ip, fabric.listen_port)
+            upnp_svc.start()
+            svc.upnp = upnp_svc
+            client.services["upnp"] = upnp_svc
 
         boot_nodes = tuple(self.config.boot_nodes)
 

@@ -34,6 +34,7 @@ class NetworkService:
         self.fabric = fabric
         self.peer_id = peer_id
         self.peer_manager = PeerManager()
+        self.upnp = None                 # UpnpService when NAT mapping is on
         self.gossip_ep = fabric.gossip.join(peer_id)
         self.rpc_ep = fabric.rpc.join(peer_id)
         subnet_service = None

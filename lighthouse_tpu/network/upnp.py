@@ -1,0 +1,226 @@
+"""UPnP IGD port mapping (NAT traversal).
+
+Rebuild of the reference's NAT strategy
+(/root/reference/beacon_node/network/src/nat.rs:20-60, which drives the
+igd crate): discover the Internet Gateway Device over SSDP, read its
+external IP, refuse to advertise through a gateway whose external
+address is itself private (double NAT), then hold a UDP discovery-port
+mapping with a 3600 s lease renewed at half-life.  The SSDP/SOAP
+protocol work the reference delegates to `igd_next` is implemented
+here directly on the stdlib (socket + http.client + ElementTree).
+
+Offline posture: this box has zero egress, so production behaviour is
+exercised against an in-process fake gateway (tests/test_upnp.py); a
+real LAN gateway speaks the same two messages (M-SEARCH, SOAP POST).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import socket
+import threading
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+
+from lighthouse_tpu.common.logging import Logger
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+IGD_SEARCH_TARGET = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+WAN_SERVICES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+# reference nat.rs MAPPING_DURATION / MAPPING_TIMEOUT
+MAPPING_DURATION_S = 3600
+RENEW_EVERY_S = MAPPING_DURATION_S / 2
+
+
+class UpnpError(Exception):
+    pass
+
+
+@dataclass
+class Gateway:
+    """One WAN*Connection control endpoint on a discovered IGD."""
+
+    control_url: str
+    service_type: str
+
+    def _soap(self, action: str, args: dict[str, str]) -> dict[str, str]:
+        body_args = "".join(
+            f"<{k}>{v}</{k}>" for k, v in args.items())
+        envelope = (
+            '<?xml version="1.0"?>'
+            '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/"'
+            ' s:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+            f'<s:Body><u:{action} xmlns:u="{self.service_type}">'
+            f'{body_args}</u:{action}></s:Body></s:Envelope>')
+        req = urllib.request.Request(
+            self.control_url, data=envelope.encode(),
+            headers={
+                "Content-Type": 'text/xml; charset="utf-8"',
+                "SOAPAction": f'"{self.service_type}#{action}"',
+            }, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                raw = resp.read()
+        except Exception as e:  # HTTPError carries the UPnPError body
+            raise UpnpError(f"SOAP {action} failed: {e}") from e
+        out: dict[str, str] = {}
+        for el in ET.fromstring(raw).iter():
+            tag = el.tag.rsplit("}", 1)[-1]
+            if el.text is not None and not tag.endswith(("Envelope", "Body")):
+                out[tag] = el.text
+        return out
+
+    def external_ip(self) -> str:
+        resp = self._soap("GetExternalIPAddress", {})
+        ip = resp.get("NewExternalIPAddress")
+        if not ip:
+            raise UpnpError("gateway returned no external IP")
+        return ip
+
+    def add_port(self, proto: str, external_port: int, internal_ip: str,
+                 internal_port: int, lease_s: int = MAPPING_DURATION_S,
+                 description: str = "lighthouse_tpu discovery") -> None:
+        self._soap("AddPortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": str(external_port),
+            "NewProtocol": proto.upper(),
+            "NewInternalPort": str(internal_port),
+            "NewInternalClient": internal_ip,
+            "NewEnabled": "1",
+            "NewPortMappingDescription": description,
+            "NewLeaseDuration": str(lease_s),
+        })
+
+    def delete_port(self, proto: str, external_port: int) -> None:
+        self._soap("DeletePortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": str(external_port),
+            "NewProtocol": proto.upper(),
+        })
+
+
+def discover_gateway(timeout: float = 3.0,
+                     ssdp_addr: tuple[str, int] = SSDP_ADDR) -> Gateway:
+    """SSDP M-SEARCH for an IGD, then fetch + parse its description to
+    the WAN*Connection control URL.  ``ssdp_addr`` is parameterized so
+    tests (and UPnP 1.1 unicast search) can target a specific responder.
+    """
+    msg = ("M-SEARCH * HTTP/1.1\r\n"
+           f"HOST: {ssdp_addr[0]}:{ssdp_addr[1]}\r\n"
+           'MAN: "ssdp:discover"\r\n'
+           "MX: 2\r\n"
+           f"ST: {IGD_SEARCH_TARGET}\r\n\r\n")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        sock.settimeout(timeout)
+        sock.sendto(msg.encode(), ssdp_addr)
+        while True:
+            try:
+                data, _ = sock.recvfrom(4096)
+            except socket.timeout:
+                raise UpnpError("no UPnP gateway responded") from None
+            location = None
+            for line in data.decode(errors="replace").split("\r\n"):
+                k, _, v = line.partition(":")
+                if k.strip().lower() == "location":
+                    location = v.strip()
+            if location:
+                break
+    finally:
+        sock.close()
+    return _gateway_from_description(location)
+
+
+def _gateway_from_description(location: str) -> Gateway:
+    try:
+        with urllib.request.urlopen(location, timeout=5) as resp:
+            desc = resp.read()
+    except Exception as e:
+        raise UpnpError(f"cannot fetch device description: {e}") from e
+    root = ET.fromstring(desc)
+
+    def findall(tag):
+        return [el for el in root.iter() if el.tag.rsplit("}", 1)[-1] == tag]
+
+    for svc in findall("service"):
+        st = ctl = None
+        for child in svc:
+            tag = child.tag.rsplit("}", 1)[-1]
+            if tag == "serviceType":
+                st = (child.text or "").strip()
+            elif tag == "controlURL":
+                ctl = (child.text or "").strip()
+        if st in WAN_SERVICES and ctl:
+            return Gateway(urllib.parse.urljoin(location, ctl), st)
+    raise UpnpError("gateway advertises no WAN*Connection service")
+
+
+class UpnpService:
+    """Holds the discovery-port UDP mapping alive (reference
+    construct_upnp_mappings' loop), exposing a status string for the
+    node API / logs: mapped | no_gateway | double_nat | error."""
+
+    def __init__(self, internal_ip: str, port: int,
+                 ssdp_addr: tuple[str, int] = SSDP_ADDR,
+                 renew_every_s: float = RENEW_EVERY_S):
+        self.internal_ip = internal_ip
+        self.port = int(port)
+        self.ssdp_addr = ssdp_addr
+        self.renew_every_s = renew_every_s
+        self.log = Logger("upnp")
+        self.status = "idle"
+        self.external_ip: str | None = None
+        self.renewals = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def map_once(self) -> bool:
+        """One discover → external-ip → map pass.  Returns mapped?"""
+        try:
+            gw = discover_gateway(ssdp_addr=self.ssdp_addr)
+        except UpnpError as e:
+            self.status = "no_gateway"
+            self.log.debug(f"no gateway: {e}")
+            return False
+        try:
+            ext = gw.external_ip()
+            if ipaddress.ip_address(ext).is_private:
+                # reference nat.rs: a private external address means
+                # double NAT — mapping there advertises a dead address
+                self.status = "double_nat"
+                self.log.warn(f"gateway external address {ext} is private")
+                return False
+            gw.add_port("UDP", self.port, self.internal_ip, self.port,
+                        MAPPING_DURATION_S)
+        except UpnpError as e:
+            self.status = "error"
+            self.log.warn(f"mapping failed: {e}")
+            return False
+        self.external_ip = ext
+        self.status = "mapped"
+        self.renewals += 1
+        self.log.info(
+            f"discovery UDP port {self.port} mapped (external {ext})")
+        return True
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.is_set():
+                self.map_once()
+                if self._stop.wait(self.renew_every_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="upnp", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

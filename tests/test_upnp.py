@@ -1,0 +1,188 @@
+"""UPnP NAT traversal against an in-process fake IGD.
+
+The fake gateway speaks the two real protocol surfaces the service
+needs (SSDP M-SEARCH response, SOAP control actions), so discovery,
+external-IP lookup, double-NAT refusal, mapping and lease renewal all
+run the production code paths end-to-end (reference
+/root/reference/beacon_node/network/src/nat.rs behaviours).
+"""
+
+import http.server
+import socket
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.network import upnp
+
+DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device><deviceList><device>
+   <serviceList>
+    <service>
+     <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+     <controlURL>/ctl</controlURL>
+    </service>
+   </serviceList>
+  </device></deviceList></device></deviceList>
+ </device>
+</root>"""
+
+SOAP_OK = ('<?xml version="1.0"?>'
+           '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/">'
+           "<s:Body><u:{action}Response "
+           'xmlns:u="urn:schemas-upnp-org:service:WANIPConnection:1">'
+           "{body}</u:{action}Response></s:Body></s:Envelope>")
+
+
+class FakeIgd:
+    """SSDP responder (UDP) + SOAP control endpoint (HTTP)."""
+
+    def __init__(self, external_ip="93.184.216.34"):
+        self.external_ip = external_ip
+        self.mappings: list[dict] = []
+        self.deleted: list[tuple] = []
+
+        igd = self
+
+        class Ctl(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/xml")
+                self.end_headers()
+                self.wfile.write(DESC_XML.encode())
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))).decode()
+                action = self.headers.get("SOAPAction", "").split("#")[-1].strip('"')
+                if action == "GetExternalIPAddress":
+                    payload = ("<NewExternalIPAddress>"
+                               f"{igd.external_ip}</NewExternalIPAddress>")
+                elif action == "AddPortMapping":
+                    rec = {}
+                    for field in ("NewExternalPort", "NewProtocol",
+                                  "NewInternalClient", "NewInternalPort",
+                                  "NewLeaseDuration"):
+                        a, _, b = body.partition(f"<{field}>")
+                        rec[field] = b.partition(f"</{field}>")[0]
+                    igd.mappings.append(rec)
+                    payload = ""
+                elif action == "DeletePortMapping":
+                    igd.deleted.append((action,))
+                    payload = ""
+                else:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/xml")
+                self.end_headers()
+                self.wfile.write(
+                    SOAP_OK.format(action=action, body=payload).encode())
+
+        self.http = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Ctl)
+        self.http_port = self.http.server_address[1]
+        threading.Thread(target=self.http.serve_forever, daemon=True).start()
+
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+        self._stop = False
+
+        def ssdp_loop():
+            self.udp.settimeout(0.2)
+            while not self._stop:
+                try:
+                    data, addr = self.udp.recvfrom(2048)
+                except socket.timeout:
+                    continue
+                if b"M-SEARCH" not in data:
+                    continue
+                resp = ("HTTP/1.1 200 OK\r\n"
+                        "CACHE-CONTROL: max-age=120\r\n"
+                        f"ST: {upnp.IGD_SEARCH_TARGET}\r\n"
+                        "LOCATION: http://127.0.0.1:"
+                        f"{self.http_port}/desc.xml\r\n\r\n")
+                self.udp.sendto(resp.encode(), addr)
+
+        threading.Thread(target=ssdp_loop, daemon=True).start()
+
+    def close(self):
+        self._stop = True
+        self.http.shutdown()
+        self.udp.close()
+
+
+@pytest.fixture()
+def igd():
+    g = FakeIgd()
+    yield g
+    g.close()
+
+
+def test_discover_and_map(igd):
+    svc = upnp.UpnpService("192.168.1.50", 9000, ssdp_addr=igd.ssdp_addr)
+    assert svc.map_once()
+    assert svc.status == "mapped"
+    assert svc.external_ip == "93.184.216.34"
+    (m,) = igd.mappings
+    assert m["NewExternalPort"] == "9000"
+    assert m["NewProtocol"] == "UDP"
+    assert m["NewInternalClient"] == "192.168.1.50"
+    assert m["NewInternalPort"] == "9000"
+    # reference nat.rs MAPPING_DURATION
+    assert m["NewLeaseDuration"] == "3600"
+
+
+def test_double_nat_refused(igd):
+    igd.external_ip = "10.0.0.2"  # private: gateway is itself NATed
+    svc = upnp.UpnpService("192.168.1.50", 9000, ssdp_addr=igd.ssdp_addr)
+    assert not svc.map_once()
+    assert svc.status == "double_nat"
+    assert not igd.mappings
+
+
+def test_no_gateway_times_out():
+    # a bound-but-silent UDP socket: the search must time out cleanly
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    try:
+        svc = upnp.UpnpService("192.168.1.50", 9000,
+                               ssdp_addr=sink.getsockname())
+        t0 = time.monotonic()
+        with pytest.raises(upnp.UpnpError):
+            upnp.discover_gateway(timeout=0.3, ssdp_addr=sink.getsockname())
+        assert time.monotonic() - t0 < 2
+        assert not svc.map_once.__self__ is None  # service object intact
+    finally:
+        sink.close()
+
+
+def test_renewal_loop(igd):
+    svc = upnp.UpnpService("192.168.1.50", 9001, ssdp_addr=igd.ssdp_addr,
+                           renew_every_s=0.2)
+    svc.start()
+    try:
+        deadline = time.monotonic() + 5
+        while len(igd.mappings) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        svc.stop()
+    # the half-life loop re-issued AddPortMapping (reference: renew at
+    # MAPPING_TIMEOUT = duration/2)
+    assert len(igd.mappings) >= 2
+    assert svc.renewals >= 2
+
+
+def test_gateway_delete_port(igd):
+    gw = upnp.discover_gateway(timeout=2, ssdp_addr=igd.ssdp_addr)
+    gw.add_port("UDP", 9002, "192.168.1.50", 9002)
+    gw.delete_port("UDP", 9002)
+    assert igd.deleted
