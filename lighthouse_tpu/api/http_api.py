@@ -1019,7 +1019,10 @@ class BeaconApi:
                 "validator_index": str(idx),
                 "slot": str(slot),
             })
-        return {"data": duties}
+        # proposer shuffling decision root: last block before the epoch
+        dep = c.block_root_at_slot(start - 1) if start > 0 else c.head_root
+        return {"dependent_root": _hex(dep or b"\x00" * 32),
+                "execution_optimistic": False, "data": duties}
 
     def attester_duties(self, epoch, body=None):
         """Standard POST attester duties: body = list of validator-index
@@ -1060,7 +1063,12 @@ class BeaconApi:
                         "validator_committee_index": str(pos),
                         "slot": str(slot),
                     })
-        return {"data": duties}
+        # attester shuffling decision root: last block of epoch - 2
+        dep_slot = spec.compute_start_slot_at_epoch(max(epoch - 1, 0)) - 1
+        dep = (c.block_root_at_slot(dep_slot) if dep_slot >= 0
+               else c.head_root)
+        return {"dependent_root": _hex(dep or b"\x00" * 32),
+                "execution_optimistic": False, "data": duties}
 
     def produce_block(self, slot, body=None, query=None):
         """Block production (v3 flavor): randao_reveal + graffiti query

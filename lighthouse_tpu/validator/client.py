@@ -49,6 +49,9 @@ class ValidatorClient:
 
     def run_slot(self, slot: int) -> SlotSummary:
         summary = SlotSummary(slot)
+        # duty upkeep first: re-org invalidation, next-epoch lookahead,
+        # subnet subscriptions (reference duties_service poll loops)
+        self.duties.poll(slot)
         if self.doppelganger is not None:
             epoch = self.chain.spec.compute_epoch_at_slot(slot)
             for pk in self.store.voting_pubkeys():

@@ -48,14 +48,31 @@ class EpochDuties:
     epoch: int
     attesters: list[AttesterDuty] = field(default_factory=list)
     proposers: list[ProposerDuty] = field(default_factory=list)
+    # shuffling decision roots (reference DutyAndProof dependent_root):
+    # attester duties of epoch N are pinned by the block root at the
+    # last slot of epoch N-2, proposer duties by the root at the last
+    # slot of N-1.  A head re-org past one of these roots changes the
+    # shuffling, so the cached duties are WRONG and must recompute —
+    # the reference re-polls on every "dependent root changed" event
+    # (duties_service.rs attester/proposer poll loops).
+    attester_dependent_root: bytes | None = None
+    proposer_dependent_root: bytes | None = None
 
 
 class DutiesService:
+    #: how many epochs ahead duties are pre-computed at each poll
+    #: (reference polls current + next epoch)
+    LOOKAHEAD_EPOCHS = 1
+
     def __init__(self, chain, store):
         self.chain = chain
         self.store = store  # ValidatorStore
         self._cache: dict[int, EpochDuties] = {}
         self._indices_cache: tuple[int, int, dict] | None = None
+        #: (slot, committee_index) pairs already pushed to the subnet
+        #: scheduler, so re-polls don't duplicate subscriptions
+        self._subscribed: set[tuple[int, int]] = set()
+        self.reorg_recomputes = 0   # observability: duty invalidations
 
     def _indices_by_pubkey(self, state) -> dict[bytes, int]:
         """Managed-validator index map, cached until the registry grows or
@@ -76,6 +93,58 @@ class DutiesService:
         self._indices_cache = (n, len(managed), out)
         return out
 
+    def _dependent_roots(self, epoch: int) -> tuple[bytes | None,
+                                                    bytes | None]:
+        """(attester_root, proposer_root) shuffling decision roots for
+        ``epoch`` per the standard duties API semantics."""
+        spec = self.chain.spec
+        att_slot = spec.compute_start_slot_at_epoch(max(epoch - 1, 0)) - 1
+        prop_slot = spec.compute_start_slot_at_epoch(epoch) - 1
+        att = (self.chain.block_root_at_slot(att_slot)
+               if att_slot >= 0 else None)
+        prop = (self.chain.block_root_at_slot(prop_slot)
+                if prop_slot >= 0 else None)
+        return att, prop
+
+    def poll(self, slot: int) -> None:
+        """Per-slot duty upkeep (reference duties_service.rs poll loops):
+
+        1. re-org check: recompute any cached epoch whose dependent
+           roots no longer match the canonical chain (the shuffling
+           those duties were computed under is gone);
+        2. lookahead: make sure duties exist for the current epoch and
+           LOOKAHEAD_EPOCHS beyond it;
+        3. subscriptions: push upcoming attester duties to the subnet
+           scheduler so aggregator subnets are joined ahead of the duty
+           (reference validator_subscriptions flow)."""
+        spec = self.chain.spec
+        epoch = spec.compute_epoch_at_slot(slot)
+        for e in list(self._cache):
+            ent = self._cache[e]
+            att, prop = self._dependent_roots(e)
+            if (ent.attester_dependent_root is not None
+                    and att is not None
+                    and ent.attester_dependent_root != att) or (
+                    ent.proposer_dependent_root is not None
+                    and prop is not None
+                    and ent.proposer_dependent_root != prop):
+                del self._cache[e]
+                self.reorg_recomputes += 1
+        for e in range(epoch, epoch + 1 + self.LOOKAHEAD_EPOCHS):
+            self.duties_for_epoch(e)
+        svc = getattr(self.chain, "subnet_service", None)
+        if svc is not None:
+            for e in range(epoch, epoch + 1 + self.LOOKAHEAD_EPOCHS):
+                for d in self._cache[e].attesters:
+                    key = (d.slot, d.committee_index)
+                    if d.slot >= slot and key not in self._subscribed:
+                        svc.subscribe_for_duty(
+                            d.slot, d.committee_index, d.is_aggregator)
+                        self._subscribed.add(key)
+            if len(self._subscribed) > 4096:
+                self._subscribed = {
+                    k for k in self._subscribed if k[0] >= slot}
+
     def duties_for_epoch(self, epoch: int) -> EpochDuties:
         cached = self._cache.get(epoch)
         if cached is not None:
@@ -91,7 +160,9 @@ class DutiesService:
                           spec.compute_start_slot_at_epoch(epoch))
         by_pk = self._indices_by_pubkey(state)
         by_idx = {v: k for k, v in by_pk.items()}
-        duties = EpochDuties(epoch)
+        att_root, prop_root = self._dependent_roots(epoch)
+        duties = EpochDuties(epoch, attester_dependent_root=att_root,
+                             proposer_dependent_root=prop_root)
 
         shuffle = chain.committee_shuffle(state, epoch)
         n_active = shuffle.shape[0]

@@ -170,3 +170,79 @@ def test_electra_slot_loop_real_crypto():
     atts = list(blk.message.body.attestations)
     assert atts and all(hasattr(a, "committee_bits") for a in atts)
     assert all(int(a.data.index) == 0 for a in atts)
+
+
+class TestDutiesUpkeep:
+    """Dependent-root tracking + re-org invalidation + lookahead +
+    subscriptions (reference duties_service.rs poll loops)."""
+
+    def test_poll_lookahead_and_dependent_roots(self, vc_setup):
+        h, chain, vc = vc_setup
+        chain.slot_clock.set_slot(1)
+        vc.duties.poll(1)
+        # current AND next epoch cached
+        assert 0 in vc.duties._cache and 1 in vc.duties._cache
+        ent = vc.duties._cache[0]
+        # genesis epoch: both decision roots resolve (head/genesis root)
+        assert ent.epoch == 0
+
+    def test_reorg_invalidates_cached_duties(self, vc_setup):
+        h, chain, vc = vc_setup
+        spec = chain.spec
+        # progress into epoch 2 so epoch-2 duties have real decision roots
+        vc_slot = 2 * spec.slots_per_epoch + 1
+        for s in range(1, vc_slot):
+            chain.slot_clock.set_slot(s)
+            vc.run_slot(s)
+        chain.slot_clock.set_slot(vc_slot)
+        vc.duties.poll(vc_slot)
+        epoch = spec.compute_epoch_at_slot(vc_slot)
+        ent = vc.duties._cache[epoch]
+        assert ent.attester_dependent_root is not None
+        before = vc.duties.reorg_recomputes
+        # simulate a re-org past the proposer decision root: falsify the
+        # canonical root the chain reports for that slot
+        orig = chain.block_root_at_slot
+
+        def forked(slot, _orig=orig):
+            r = _orig(slot)
+            return b"\xab" * 32 if r is not None else None
+
+        chain.block_root_at_slot = forked
+        try:
+            vc.duties.poll(vc_slot)
+        finally:
+            chain.block_root_at_slot = orig
+        assert vc.duties.reorg_recomputes > before
+        # recomputed entry pinned to the (forked) roots it saw
+        assert vc.duties._cache[epoch].proposer_dependent_root == b"\xab" * 32
+
+    def test_subscriptions_pushed_to_subnet_service(self, vc_setup):
+        h, chain, vc = vc_setup
+
+        class RecordingSvc:
+            def __init__(self):
+                self.calls = []
+
+            def subscribe_for_duty(self, slot, committee_index, is_agg):
+                self.calls.append((slot, committee_index, is_agg))
+
+        svc = RecordingSvc()
+        chain.subnet_service = svc
+        chain.slot_clock.set_slot(1)
+        vc.duties.poll(1)
+        assert svc.calls  # upcoming duties were pushed
+        n = len(svc.calls)
+        vc.duties.poll(1)  # idempotent: no duplicate subscriptions
+        assert len(svc.calls) == n
+
+    def test_duties_api_returns_dependent_root(self, vc_setup):
+        h, chain, vc = vc_setup
+        from lighthouse_tpu.api.http_api import BeaconApi
+
+        handlers = BeaconApi(chain)
+        resp = handlers.proposer_duties("0")
+        assert resp["dependent_root"].startswith("0x")
+        resp = handlers.attester_duties("0", body=b"[0, 1, 2]")
+        assert resp["dependent_root"].startswith("0x")
+        assert resp["data"]
