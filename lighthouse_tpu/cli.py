@@ -39,6 +39,10 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--execution-jwt", default=None,
                     help="hex JWT secret for the engine API")
     bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--wire-transport", default="tcp",
+                    choices=("tcp", "quic"),
+                    help="stream transport for gossip/RPC "
+                         "(quic = the UDP stream transport)")
     bn.add_argument("--disable-upnp", action="store_true",
                     help="skip UPnP gateway port mapping (reference flag)")
     bn.add_argument("--slasher-backend", default="native",
@@ -191,6 +195,7 @@ def _run_bn(args) -> int:
         execution_jwt_hex=args.execution_jwt,
         slasher_enabled=args.slasher,
         upnp_enabled=not args.disable_upnp and args.listen_port is not None,
+        wire_transport=args.wire_transport,
         slasher_backend=args.slasher_backend,
         n_genesis_validators=args.interop_validators,
         genesis_fork=args.genesis_fork,

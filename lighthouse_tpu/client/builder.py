@@ -57,6 +57,9 @@ class ClientConfig:
     # "host:port" UDP discovery addresses to bootstrap from
     # (reference beacon_node/src/config.rs listen-address/boot-nodes)
     listen_port: int | None = None
+    # "tcp" | "quic" — the stream transport under the wire stack
+    # (reference runs TCP and QUIC listeners side by side)
+    wire_transport: str = "tcp"
     boot_nodes: tuple = ()
     # external block builder (MEV) endpoint; None = local payloads only
     builder_url: str | None = None
@@ -411,7 +414,8 @@ class ClientBuilder:
 
         fabric = WireFabric(
             listen_port=self.config.listen_port,
-            fork_digest=fork_digest(self.chain))
+            fork_digest=fork_digest(self.chain),
+            transport=self.config.wire_transport)
         svc = NetworkService(self.chain, fabric, fabric.peer_id,
                              scheduled_subnets=False)
         client.network = svc

@@ -1,0 +1,324 @@
+"""QUIC-role UDP transport: reliable ordered streams over datagrams.
+
+The reference dials peers over genuine QUIC alongside TCP
+(/root/reference/beacon_node/lighthouse_network/src/service/mod.rs:352-390
+— libp2p's quic transport) for lower connection latency and userspace
+congestion control.  This module fills the same role in this stack's
+wire fabric: a UDP transport carrying the node's ordered byte stream,
+so the Noise handshake, HELLO exchange, gossip and RPC framing all run
+unchanged over it (WireNode's `transport="quic"`).
+
+Honest interop note (see README "wire interoperability"): this is NOT
+wire-format QUIC (no TLS 1.3, no varint packet encoding) — like the
+rest of the wire stack it is a from-scratch protocol in the same ROLE.
+Frame: [magic u8][type u8][cid 8B][seq u32 BE][payload].  Reliability
+is per-packet ARQ: cumulative ACKs, fixed-window flow control, RTO
+retransmission with exponential backoff.  One ordered stream per
+connection — the wire protocol already multiplexes streams above this
+layer, which is also why a single stream suffices.
+
+Surface: `start_listener(host, port, on_conn)` mirrors
+`asyncio.start_server` (the callback receives (reader, writer));
+`open_connection(host, port)` mirrors `asyncio.open_connection`.  The
+reader IS an `asyncio.StreamReader`; the writer implements the subset
+of `StreamWriter` the wire node uses (write/drain/close/is_closing/
+wait_closed/get_extra_info).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import struct
+
+MAGIC = 0xD7
+T_INIT = 1       # open: payload empty; cid chosen by the dialer
+T_INIT_ACK = 2   # accept
+T_DATA = 3       # seq + stream bytes
+T_ACK = 4        # seq = highest in-order DATA delivered
+T_FIN = 5        # reliable end-of-stream (carries a seq like DATA)
+T_RST = 6        # abort
+
+MAX_PAYLOAD = 1200          # stay under typical MTU
+WINDOW_PACKETS = 256        # in-flight cap before drain() blocks
+RTO_S = 0.2                 # initial retransmission timeout
+MAX_RETRIES = 8             # ~51 s of backoff before the conn errors
+HDR = struct.Struct("!BB8sI")
+
+
+class QuicError(ConnectionError):
+    pass
+
+
+def _pack(ptype: int, cid: bytes, seq: int, payload: bytes = b"") -> bytes:
+    return HDR.pack(MAGIC, ptype, cid, seq) + payload
+
+
+class _QuicConn:
+    """One connection's reliability state, shared by both directions."""
+
+    def __init__(self, proto: "_Endpoint", cid: bytes,
+                 addr: tuple[str, int]):
+        self.proto = proto
+        self.cid = cid
+        self.addr = addr
+        self.reader = asyncio.StreamReader()
+        self.established = asyncio.get_event_loop().create_future()
+        # send side
+        self.next_seq = 0
+        self.unacked: dict[int, list] = {}   # seq -> [bytes, deadline, tries]
+        self.window_free = asyncio.Event()
+        self.window_free.set()
+        self.fin_sent = False
+        self.closed = False
+        self.close_waiter = asyncio.get_event_loop().create_future()
+        # receive side
+        self.rcv_next = 0
+        self.rcv_buf: dict[int, tuple[int, bytes]] = {}  # seq -> (type, data)
+        self._retx_task = asyncio.ensure_future(self._retx_loop())
+
+    # -- send path ---------------------------------------------------------
+
+    def _transmit(self, ptype: int, seq: int, payload: bytes) -> None:
+        self.proto.sendto(_pack(ptype, self.cid, seq, payload), self.addr)
+
+    def send_segmented(self, data: bytes) -> None:
+        for off in range(0, len(data), MAX_PAYLOAD):
+            chunk = data[off:off + MAX_PAYLOAD]
+            seq = self.next_seq
+            self.next_seq += 1
+            self.unacked[seq] = [
+                chunk, asyncio.get_event_loop().time() + RTO_S, 0, T_DATA]
+            self._transmit(T_DATA, seq, chunk)
+        if len(self.unacked) >= WINDOW_PACKETS:
+            self.window_free.clear()
+
+    def send_fin(self) -> None:
+        if self.fin_sent or self.closed:
+            return
+        self.fin_sent = True
+        seq = self.next_seq
+        self.next_seq += 1
+        self.unacked[seq] = [
+            b"", asyncio.get_event_loop().time() + RTO_S, 0, T_FIN]
+        self._transmit(T_FIN, seq, b"")
+
+    async def _retx_loop(self):
+        try:
+            while not self.closed:
+                await asyncio.sleep(RTO_S / 4)
+                now = asyncio.get_event_loop().time()
+                for seq, ent in list(self.unacked.items()):
+                    chunk, deadline, tries, ptype = ent
+                    if now < deadline:
+                        continue
+                    if tries >= MAX_RETRIES:
+                        self._die(QuicError(
+                            f"retransmission limit for seq {seq}"))
+                        return
+                    ent[1] = now + RTO_S * (2 ** (tries + 1))
+                    ent[2] = tries + 1
+                    self._transmit(ptype, seq, chunk)
+        except asyncio.CancelledError:
+            pass
+
+    # -- receive path ------------------------------------------------------
+
+    def on_packet(self, ptype: int, seq: int, payload: bytes) -> None:
+        if ptype == T_ACK:
+            for s in [s for s in self.unacked if s < seq]:
+                del self.unacked[s]
+            if len(self.unacked) < WINDOW_PACKETS:
+                self.window_free.set()
+            if self.fin_sent and not self.unacked:
+                self._finish_close()
+            return
+        if ptype == T_RST:
+            self._die(QuicError("connection reset by peer"))
+            return
+        if ptype in (T_DATA, T_FIN):
+            if seq >= self.rcv_next and seq not in self.rcv_buf:
+                self.rcv_buf[seq] = (ptype, payload)
+            # deliver everything now in order
+            while self.rcv_next in self.rcv_buf:
+                pt, data = self.rcv_buf.pop(self.rcv_next)
+                self.rcv_next += 1
+                if pt == T_FIN:
+                    self.reader.feed_eof()
+                elif data:
+                    self.reader.feed_data(data)
+            # cumulative ACK (covers duplicates and reordering)
+            self._transmit(T_ACK, self.rcv_next, b"")
+
+    # -- teardown ----------------------------------------------------------
+
+    def _finish_close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._retx_task.cancel()
+            if not self.close_waiter.done():
+                self.close_waiter.set_result(None)
+            self.proto.forget(self)
+
+    def _die(self, exc: Exception) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._retx_task.cancel()
+        self.window_free.set()          # release any blocked drain()
+        self.reader.feed_eof()
+        if not self.established.done():
+            self.established.set_exception(exc)
+        if not self.close_waiter.done():
+            self.close_waiter.set_result(None)
+        self.proto.forget(self)
+
+
+class _Writer:
+    """StreamWriter-shaped facade over a _QuicConn's send side."""
+
+    def __init__(self, conn: _QuicConn):
+        self._conn = conn
+
+    def write(self, data: bytes) -> None:
+        if self._conn.closed:
+            raise QuicError("write on closed quic connection")
+        self._conn.send_segmented(bytes(data))
+
+    async def drain(self) -> None:
+        await self._conn.window_free.wait()
+        if self._conn.closed and self._conn.unacked:
+            raise QuicError("quic connection lost")
+
+    def close(self) -> None:
+        self._conn.send_fin()
+        # a peer that is gone never ACKs the FIN; the retx loop gives up
+        # and tears the state down after MAX_RETRIES backoffs
+
+    def is_closing(self) -> bool:
+        return self._conn.fin_sent or self._conn.closed
+
+    async def wait_closed(self) -> None:
+        await self._conn.close_waiter
+
+    def get_extra_info(self, name: str, default=None):
+        if name == "peername":
+            return self._conn.addr
+        return default
+
+
+class _Endpoint(asyncio.DatagramProtocol):
+    """One UDP socket demuxing many connections by (addr, cid)."""
+
+    def __init__(self, on_conn=None, fallback=None):
+        self.on_conn = on_conn          # set on listeners
+        # non-MAGIC datagrams hand off here: in quic mode the node's
+        # UDP discovery protocol shares this one socket/port
+        self.fallback = fallback
+        self.transport = None
+        self.conns: dict[tuple, _QuicConn] = {}
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def sendto(self, data: bytes, addr) -> None:
+        if self.transport is not None:
+            self.transport.sendto(data, addr)
+
+    def forget(self, conn: _QuicConn) -> None:
+        self.conns.pop((conn.addr, conn.cid), None)
+
+    def datagram_received(self, data: bytes, addr):
+        if len(data) >= HDR.size:
+            magic, ptype, cid, seq = HDR.unpack_from(data)
+        else:
+            magic = None
+        if magic != MAGIC:
+            if self.fallback is not None:
+                self.fallback(data, addr)
+            return
+        payload = data[HDR.size:]
+        key = (addr, cid)
+        conn = self.conns.get(key)
+        if conn is None:
+            if ptype == T_INIT and self.on_conn is not None:
+                conn = _QuicConn(self, cid, addr)
+                self.conns[key] = conn
+                conn._transmit(T_INIT_ACK, 0, b"")
+                self.on_conn(conn.reader, _Writer(conn))
+            elif ptype == T_INIT_ACK:
+                pass  # dialer conns are pre-registered; nothing to do
+            elif ptype not in (T_RST, T_ACK):
+                # unknown conn: tell the peer to stop retransmitting
+                self.sendto(_pack(T_RST, cid, 0), addr)
+            return
+        if ptype == T_INIT:
+            # duplicate INIT (our INIT_ACK was lost): re-accept
+            conn._transmit(T_INIT_ACK, 0, b"")
+            return
+        if ptype == T_INIT_ACK:
+            if not conn.established.done():
+                conn.established.set_result(None)
+            return
+        conn.on_packet(ptype, seq, payload)
+
+
+class QuicListener:
+    def __init__(self, transport, endpoint: _Endpoint):
+        self._transport = transport
+        self.endpoint = endpoint
+
+    @property
+    def port(self) -> int:
+        return self._transport.get_extra_info("sockname")[1]
+
+    def close(self) -> None:
+        for conn in list(self.endpoint.conns.values()):
+            conn._die(QuicError("listener closed"))
+        self._transport.close()
+
+
+async def start_listener(host: str, port: int, on_conn,
+                         fallback=None) -> QuicListener:
+    """`asyncio.start_server` analogue: on_conn(reader, writer) fires per
+    accepted connection.  ``fallback(data, addr)`` receives datagrams
+    that are not QUIC-role frames (shared-port discovery)."""
+    loop = asyncio.get_event_loop()
+    transport, endpoint = await loop.create_datagram_endpoint(
+        lambda: _Endpoint(on_conn, fallback), local_addr=(host, port))
+    return QuicListener(transport, endpoint)
+
+
+async def open_connection(host: str, port: int, timeout: float = 5.0):
+    """`asyncio.open_connection` analogue over the QUIC-role transport."""
+    loop = asyncio.get_event_loop()
+    transport, endpoint = await loop.create_datagram_endpoint(
+        lambda: _Endpoint(None), remote_addr=(host, port))
+    cid = secrets.token_bytes(8)
+    addr = transport.get_extra_info("peername") or (host, port)
+    conn = _QuicConn(endpoint, cid, addr)
+    endpoint.conns[(addr, cid)] = conn
+    # INIT until accepted (lost-INIT recovery)
+    deadline = loop.time() + timeout
+    while True:
+        conn._transmit(T_INIT, 0, b"")
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(conn.established),
+                min(0.25, max(0.01, deadline - loop.time())))
+            break
+        except asyncio.TimeoutError:
+            if loop.time() >= deadline:
+                transport.close()
+                raise QuicError(f"quic dial to {host}:{port} timed out"
+                                ) from None
+    writer = _Writer(conn)
+    # the dialer owns its socket: close it with the connection
+    orig_finish = conn._finish_close
+
+    def finish_and_close():
+        orig_finish()
+        transport.close()
+
+    conn._finish_close = finish_and_close
+    return conn.reader, writer

@@ -117,8 +117,16 @@ class WireNode:
     def __init__(self, identity_seed: "bytes | str | None" = None,
                  listen_port: int = 0,
                  fork_digest: bytes = b"\x00\x00\x00\x00",
-                 listen_host: str = "127.0.0.1"):
+                 listen_host: str = "127.0.0.1",
+                 transport: str = "tcp"):
         import concurrent.futures
+
+        if transport not in ("tcp", "quic"):
+            raise ValueError(f"unknown transport {transport!r}")
+        # "quic" = the QUIC-role UDP stream transport (wire/quic.py);
+        # the whole protocol stack above (Noise, HELLO, gossip, RPC)
+        # is transport-agnostic and runs unchanged over either
+        self.transport = transport
 
         # Node identity: an Ed25519 key; the peer id IS its fingerprint,
         # so identity cannot be claimed without the private key (libp2p
@@ -200,12 +208,25 @@ class WireNode:
             self.loop.close()
 
     async def _start_servers(self):
-        self._server = await asyncio.start_server(
-            self._on_inbound, self.listen_host, self.listen_port)
-        self.listen_port = self._server.sockets[0].getsockname()[1]
-        self._udp_transport, _ = await self.loop.create_datagram_endpoint(
-            lambda: _UdpProtocol(self),
-            local_addr=(self.listen_host, self.listen_port))
+        if self.transport == "quic":
+            from lighthouse_tpu.network.wire import quic
+
+            # stream frames and UDP discovery share ONE socket: quic's
+            # endpoint demuxes by magic byte and hands discovery
+            # datagrams through the fallback
+            self._server = await quic.start_listener(
+                self.listen_host, self.listen_port,
+                lambda r, w: asyncio.ensure_future(self._on_inbound(r, w)),
+                fallback=self._on_datagram)
+            self.listen_port = self._server.port
+            self._udp_transport = self._server._transport
+        else:
+            self._server = await asyncio.start_server(
+                self._on_inbound, self.listen_host, self.listen_port)
+            self.listen_port = self._server.sockets[0].getsockname()[1]
+            self._udp_transport, _ = await self.loop.create_datagram_endpoint(
+                lambda: _UdpProtocol(self),
+                local_addr=(self.listen_host, self.listen_port))
         self.log.info("listening", tcp=self.listen_port,
                       udp=self.listen_port)
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
@@ -284,7 +305,12 @@ class WireNode:
 
     async def _dial(self, host: str, port: int) -> str:
         """Open a connection; returns the remote peer id."""
-        reader, writer = await asyncio.open_connection(host, port)
+        if self.transport == "quic":
+            from lighthouse_tpu.network.wire import quic
+
+            reader, writer = await quic.open_connection(host, port)
+        else:
+            reader, writer = await asyncio.open_connection(host, port)
         conn = _Conn(reader, writer, outbound=True)
         try:
             await asyncio.wait_for(self._handshake(conn),
@@ -973,11 +999,12 @@ class WireFabric:
     def __init__(self, identity_seed: "bytes | str | None" = None,
                  listen_port: int = 0,
                  fork_digest: bytes = b"\x00\x00\x00\x00",
-                 listen_host: str = "127.0.0.1"):
+                 listen_host: str = "127.0.0.1",
+                 transport: str = "tcp"):
         self.node = WireNode(
             identity_seed,
             listen_port=listen_port, fork_digest=fork_digest,
-            listen_host=listen_host).start()
+            listen_host=listen_host, transport=transport).start()
         self.discovery_ep = WireDiscoveryEndpoint(self.node)
         self.gossip = _JoinShim(
             lambda pid: WireGossipEndpoint(self.node))
