@@ -358,6 +358,62 @@ def _bench_attestation_flood() -> dict:
     }
 
 
+def _bench_slasher() -> dict:
+    """BASELINE table row "slasher batch update": the reference's sample
+    log processes 1 block + 279 attestations in 1,821 ms on a commodity
+    node (/root/reference/book/src/slasher.md:149).  Same shape here:
+    279 distinct indexed attestations (128-validator committees over a
+    64k registry, staggered surround-prone (source, target) pairs) plus
+    one block header through Slasher.process_queued — columnar numpy
+    planes + chunked zlib persistence, no device involved."""
+    import numpy as np
+
+    from lighthouse_tpu import types as T
+    from lighthouse_tpu.slasher import Slasher, SlasherConfig
+    from lighthouse_tpu.types.containers import (
+        AttestationData,
+        BeaconBlockHeader,
+        Checkpoint,
+        SignedBeaconBlockHeader,
+    )
+
+    spec = T.ChainSpec.minimal().with_forks_at(0, through="altair")
+    tt = T.make_types(spec.preset)
+    s = Slasher(spec, tt, config=SlasherConfig(history_length=4096),
+                n_validators=65536)
+    rng = np.random.default_rng(11)
+    t0 = time.perf_counter()
+    for i in range(279):
+        target = 1000 + (i % 7)
+        source = target - 1 - (i % 3)
+        committee = np.sort(rng.choice(65536, size=128, replace=False))
+        s.accept_attestation(tt.IndexedAttestation(
+            attesting_indices=[int(v) for v in committee],
+            data=AttestationData(
+                slot=target * spec.slots_per_epoch, index=i % 64,
+                beacon_block_root=bytes([i % 256, i // 256]) * 16,
+                source=Checkpoint(epoch=source, root=b"\x01" * 32),
+                target=Checkpoint(epoch=target, root=b"\x02" * 32)),
+            signature=b"\xcc" * 96))
+    s.accept_block_header(SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=8000, proposer_index=7, parent_root=b"\x03" * 32,
+            state_root=b"\x04" * 32, body_root=b"\x05" * 32),
+        signature=b"\xcc" * 96))
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    s.process_queued(current_epoch=1008)
+    dt = (time.perf_counter() - t0) * 1000
+    return {
+        "slasher_batch_ms": round(dt, 1),
+        "slasher_atts": 279,
+        "slasher_build_s": round(build_s, 2),
+        # reference sample log: 1,821 ms for the same batch shape
+        "slasher_vs_ref": round(1821.0 / max(dt, 1e-6), 1),
+        "slasher_platform": "cpu",
+    }
+
+
 def _bench_block_verify() -> dict:
     """BASELINE config #2: one mainnet-preset Capella block through
     per_block_processing with VerifyBulk (all signature sets), p50 ms
@@ -572,6 +628,8 @@ def _child_main() -> int:
         result = _bench_attestation_flood()
     elif "--child-blockverify" in sys.argv:
         result = _bench_block_verify()
+    elif "--child-slasher" in sys.argv:
+        result = _bench_slasher()
     else:
         result = _bench_bls_1k()
     print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
@@ -637,7 +695,7 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
 
 _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-probe", "--child-stateroot", "--child-flood",
-                "--child-blockverify")
+                "--child-blockverify", "--child-slasher")
 
 
 def main() -> int:
@@ -708,7 +766,9 @@ def main() -> int:
                 ("--child-stateroot", "state_root",
                  min(300, CHILD_TIMEOUT_S)),
                 ("--child-blockverify", "block_verify", None),
-                ("--child-flood", "flood", None)):
+                ("--child-flood", "flood", None),
+                ("--child-slasher", "slasher",
+                 min(120, CHILD_TIMEOUT_S))):
             r = _run_child(working_env, child_flag=flag, timeout_s=timeout)
             if r:
                 r.setdefault(

@@ -147,17 +147,17 @@ class SurroundArray:
                              ) -> list[tuple[int, int, int]]:
         """(source, min_target, max_target) entries for one validator with
         source in [lo, hi) — used to locate the countervote when building
-        a slashing."""
-        out = []
-        for e in range(max(lo, 0), hi):
-            col = e % self.H
-            if self.col_epoch[col] != e:
-                continue
-            mn = int(self.min_plane[validator, col])
-            mx = int(self.max_plane[validator, col])
-            if mn != int(MIN_NOVAL):
-                out.append((e, e + mn, e + mx))
-        return out
+        a slashing.  One vectorized pass over the live columns (an
+        8k-epoch window scanned per offender was the profile's hottest
+        python loop)."""
+        cols, eps = self._columns_range(lo, hi)
+        if cols.size == 0:
+            return []
+        mn = self.min_plane[validator, cols]
+        has = mn != MIN_NOVAL
+        mx = self.max_plane[validator, cols]
+        return [(int(e), int(e) + int(a), int(e) + int(b))
+                for e, a, b in zip(eps[has], mn[has], mx[has])]
 
     # -- chunked persistence ----------------------------------------------
 
