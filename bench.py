@@ -176,6 +176,8 @@ def _bench_kzg_batch() -> dict:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     width = 4096 if on_tpu else 256
+    plat = "tpu" if on_tpu else "cpu"
+    _emit_partial({"kzg_platform": plat, "stage": "setup"})
     settings = kzg.KzgSettings.dev(width=width)
     rng = np.random.default_rng(11)
     uniq = []
@@ -190,14 +192,26 @@ def _bench_kzg_batch() -> dict:
     commits = cs * n_blocks
     prfs = proofs * n_blocks
 
+    # cold pass pays the fused-program compile at this batch shape; its
+    # number is emitted as a survivable partial, then a warm pass gives
+    # the steady-state throughput the baseline is about
+    t0 = time.perf_counter()
+    ok = kzg.verify_blob_kzg_proof_batch(blobs, commits, prfs, settings)
+    cold_s = time.perf_counter() - t0
+    assert ok, "kzg batch failed to verify"
+    _emit_partial({"kzg_blobs_per_s": round(len(blobs) / cold_s, 1),
+                   "kzg_batch_s": round(cold_s, 2), "kzg_platform": plat,
+                   "kzg_n_blobs": len(blobs), "stage": "cold"})
     t0 = time.perf_counter()
     ok = kzg.verify_blob_kzg_proof_batch(blobs, commits, prfs, settings)
     dt = time.perf_counter() - t0
-    assert ok, "kzg batch failed to verify"
+    assert ok, "kzg warm batch failed to verify"
     return {
         "kzg_blobs_per_s": round(len(blobs) / dt, 1),
         "kzg_batch_s": round(dt, 2),
-        "kzg_platform": "tpu" if on_tpu else "cpu",
+        "kzg_cold_s": round(cold_s, 2),
+        "kzg_n_blobs": len(blobs),
+        "kzg_platform": plat,
     }
 
 
@@ -438,8 +452,14 @@ def _bench_block_verify() -> dict:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    n_validators = 32768 if on_tpu else 512
-    att_slots = 4 if on_tpu else 2
+    # 16k validators keeps the real-crypto block BUILD (python-side
+    # signing, not the thing being measured) safely inside the child
+    # timeout on this 1-core box; the per-block set count is what the
+    # p50 measures and it is committee-bound either way
+    n_validators = 16384 if on_tpu else 512
+    att_slots = 2
+    _emit_partial({"block_platform": platform, "stage": "building",
+                   "block_validators": n_validators})
 
     spec = T.ChainSpec.mainnet().with_forks_at(0, through="capella")
     t_build0 = time.perf_counter()
@@ -810,6 +830,7 @@ def main() -> int:
                  min(120, CHILD_TIMEOUT_S))):
             r = _run_child(working_env, child_flag=flag, timeout_s=timeout)
             if r:
+                r.pop("stage", None)  # keep the BLS child's stage field
                 r.setdefault(
                     f"{key}_platform",
                     "cpu" if working_env is not None else "tpu")
