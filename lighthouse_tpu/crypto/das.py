@@ -266,10 +266,18 @@ def compute_cells_and_kzg_proofs(blob: bytes, settings
 
 
 def _interpolation_commitment(cell: bytes, cid: int, settings):
-    """[I_c(τ)]₁ for the cell's claimed evaluations (coset inverse-NTT,
-    cs ≤ 64 so the O(cs²) direct transform is fine)."""
+    """[I_c(τ)]₁ for the cell's claimed evaluations."""
     from lighthouse_tpu.crypto import kzg as _kzg
 
+    n_cells, cell_size = _cell_geometry(settings.width)
+    coeffs = _interpolation_coeffs(cell, cid, settings)
+    return _kzg.g1_lincomb(settings.g1_monomial[:cell_size], coeffs)
+
+
+def _interpolation_coeffs(cell: bytes, cid: int, settings) -> list[int]:
+    """Monomial coefficients of I_c (coset inverse-NTT, cs ≤ 64 so the
+    O(cs²) direct transform is fine) — split out so the fused batch
+    verifier can fold them straight onto the monomial setup points."""
     width = settings.width
     n_cells, cell_size = _cell_geometry(width)
     ext_roots = _compute_roots_of_unity(2 * width)
@@ -298,7 +306,7 @@ def _interpolation_commitment(cell: bytes, cid: int, settings):
                    ) % BLS_MODULUS
         coeffs.append(acc * cs_inv % BLS_MODULUS
                       * pow(h_inv, m, BLS_MODULUS) % BLS_MODULUS)
-    return _kzg.g1_lincomb(settings.g1_monomial[:cell_size], coeffs)
+    return coeffs
 
 
 def verify_cell_kzg_proof(commitment_bytes: bytes, cell_id: int,
@@ -338,12 +346,84 @@ def verify_cell_kzg_proof(commitment_bytes: bytes, cell_id: int,
 def verify_cell_kzg_proof_batch(commitments: list[bytes],
                                 cell_ids: list[int], cells: list[bytes],
                                 proofs: list[bytes], settings) -> bool:
-    """Per-cell verification over a batch (every triplet must hold)."""
-    if not (len(commitments) == len(cell_ids) == len(cells) == len(proofs)):
+    """Batch cell-proof verification (every triplet must hold).
+
+    Production batches (>= 8 cells — a PeerDAS sampling round checks
+    hundreds) fold into ONE fused dispatch by random linear combination:
+    each cell check  e(Cᵢ − Iᵢ, −G₂)·e(πᵢ, (τⁿ − aᵢ)G₂) == 1  (n =
+    cell_size, aᵢ = hᵢⁿ the coset vanishing constant) rewrites as
+    e(Cᵢ − Iᵢ + aᵢπᵢ, −G₂)·e(πᵢ, τⁿG₂) == 1, so with verifier scalars
+    rᵢ the whole batch is
+
+      e(Σ rᵢ(Cᵢ − Iᵢ + aᵢπᵢ), −G₂) · e(Σ rᵢπᵢ, τⁿG₂) == 1
+
+    — the exact 2-MSM + 2-pairing shape of kzg._kzg_fused_check (the
+    blob batch path), with τⁿG₂ = g2_monomial[cell_size] in the second
+    slot.  The interpolation commitments Iᵢ never materialize: their
+    monomial coefficients fold onto the g1_monomial setup points with
+    AGGREGATED scalars −Σᵢ rᵢ·coeffᵢₘ (cell_size extra lanes total, not
+    per cell).  Small batches keep the per-cell loop.  Matches the
+    reference's c-kzg verify_cell_kzg_proof_batch fold
+    (/root/reference/crypto/kzg/src/lib.rs cell-proof surface)."""
+    n = len(commitments)
+    if not (n == len(cell_ids) == len(cells) == len(proofs)):
         return False
-    return all(
-        verify_cell_kzg_proof(c, cid, cell, pf, settings)
-        for c, cid, cell, pf in zip(commitments, cell_ids, cells, proofs))
+    if n < 8:
+        return all(
+            verify_cell_kzg_proof(c, cid, cell, pf, settings)
+            for c, cid, cell, pf in zip(commitments, cell_ids, cells,
+                                        proofs))
+
+    import hashlib
+    import secrets
+
+    from lighthouse_tpu.crypto import kzg as _kzg
+    from lighthouse_tpu.crypto.bls import curve as cv
+
+    width = settings.width
+    n_cells, cell_size = _cell_geometry(width)
+    try:
+        _require_monomials(settings, cell_size)
+    except KzgError:
+        return False
+    try:
+        cs_pts = [cv.g1_from_bytes(b) for b in commitments]
+        pi_pts = [cv.g1_from_bytes(b) for b in proofs]
+        coeffs = []
+        for cid, cell in zip(cell_ids, cells):
+            if not 0 <= int(cid) < n_cells:
+                return False
+            coeffs.append(_interpolation_coeffs(cell, int(cid), settings))
+    except (ValueError, KzgError):
+        return False
+
+    seed = hashlib.sha256(
+        b"LHTPU_RLC_CELL_BATCH_" + width.to_bytes(16, "big")
+        + n.to_bytes(16, "big") + b"".join(commitments)
+        + b"".join(proofs)
+        + b"".join(int(c).to_bytes(8, "big") for c in cell_ids)
+        + secrets.token_bytes(32)).digest()
+    r = int.from_bytes(seed, "big") % BLS_MODULUS
+    r_list = [pow(r, i + 1, BLS_MODULUS) for i in range(n)]
+
+    ext_roots = _compute_roots_of_unity(2 * width)
+    nat_of_brp = _bit_reversal_permutation(list(range(2 * width)))
+    lhs_points = list(cs_pts)
+    lhs_scalars = list(r_list)
+    mono_scalars = [0] * cell_size
+    for ri, cid, cf, pi in zip(r_list, cell_ids, coeffs, pi_pts):
+        for m_i, cm in enumerate(cf):
+            mono_scalars[m_i] = (mono_scalars[m_i] - ri * cm) % BLS_MODULUS
+        h = _coset_start(int(cid), cell_size, ext_roots, nat_of_brp)
+        a = pow(h, cell_size, BLS_MODULUS)
+        lhs_points.append(pi)
+        lhs_scalars.append(ri * a % BLS_MODULUS)
+    lhs_points.extend(settings.g1_monomial[:cell_size])
+    lhs_scalars.extend(mono_scalars)
+    return _kzg._kzg_fused_check(
+        lhs_points, lhs_scalars, pi_pts, r_list, settings,
+        tau_g2=settings.g2_monomial[cell_size],
+        cache_attr="_fused_g2_rows_cell")
 
 
 def verify_cells_match_blob(cells: list[bytes], cell_ids: list[int],

@@ -444,7 +444,8 @@ _KZG_FUSED_JIT = None
 
 
 def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
-                     settings) -> bool:
+                     settings, tau_g2=None,
+                     cache_attr: str = "_fused_g2_rows") -> bool:
     """BOTH RLC MSMs and the 2-lane pairing as ONE device dispatch.
 
     Lanes interleave s-major (even = lhs MSM, odd = proof MSM) through
@@ -505,14 +506,15 @@ def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
     digits = np.empty((ld.shape[0], 2 * m), np.uint32)
     digits[:, 0::2], digits[:, 1::2] = ld, pd
 
-    g2rows = getattr(settings, "_fused_g2_rows", None)
+    g2rows = getattr(settings, cache_attr, None)
     if g2rows is None:  # constants per settings: pack once, reuse per call
         neg_g2 = cv.g2_neg(cv.g2_generator())
-        tau_g2 = settings.g2_tau
+        if tau_g2 is None:
+            tau_g2 = settings.g2_tau
         g2rows = [jnp.asarray(ec.ints_to_mont_limbs(v)) for v in (
             [neg_g2[0].a, tau_g2[0].a], [neg_g2[0].b, tau_g2[0].b],
             [neg_g2[1].a, tau_g2[1].a], [neg_g2[1].b, tau_g2[1].b])]
-        settings._fused_g2_rows = g2rows
+        setattr(settings, cache_attr, g2rows)
 
     f = _KZG_FUSED_JIT(jnp.asarray(xs), jnp.asarray(ys),
                        jnp.asarray(digits), *g2rows)

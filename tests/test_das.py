@@ -186,3 +186,73 @@ class TestCellProofKnownAnswers:
         forged = cv.g1_to_bytes(cv.g1_mul(cv.g1_generator(), 2))
         assert not das.verify_cell_kzg_proof(
             commitment, 0, cells[0], forged, s)
+
+
+class TestFusedCellBatch:
+    """The >=8-cell RLC fold (one fused dispatch) must agree with the
+    per-cell pairing loop and reject forgeries."""
+
+    def test_fused_batch_verifies_and_matches_percell(self, setup):
+        s, blob, cells = setup
+        commitment = kzg.blob_to_kzg_commitment(blob, s)
+        _, proofs = das.compute_cells_and_kzg_proofs(blob, s)
+        ids = [0, 3, 5, 9, 17, 31, 64, 100, 127]  # 9 >= fused threshold
+        cms = [commitment] * len(ids)
+        cls = [cells[i] for i in ids]
+        pfs = [proofs[i] for i in ids]
+        assert das.verify_cell_kzg_proof_batch(cms, ids, cls, pfs, s)
+        # per-cell oracle agrees
+        assert all(das.verify_cell_kzg_proof(commitment, i, cells[i],
+                                             proofs[i], s) for i in ids)
+
+    def test_fused_batch_rejects_forgery(self, setup):
+        s, blob, cells = setup
+        commitment = kzg.blob_to_kzg_commitment(blob, s)
+        _, proofs = das.compute_cells_and_kzg_proofs(blob, s)
+        ids = list(range(8))
+        cms = [commitment] * 8
+        cls = [cells[i] for i in ids]
+        pfs = [proofs[i] for i in ids]
+        # one tampered cell poisons the whole batch
+        bad_cells = list(cls)
+        bad = bytearray(bad_cells[4])
+        bad[1] ^= 1
+        bad_cells[4] = bytes(bad)
+        assert not das.verify_cell_kzg_proof_batch(
+            cms, ids, bad_cells, pfs, s)
+        # swapped proofs poison it too
+        pfs_sw = list(pfs)
+        pfs_sw[0], pfs_sw[1] = pfs_sw[1], pfs_sw[0]
+        assert not das.verify_cell_kzg_proof_batch(
+            cms, ids, cls, pfs_sw, s)
+        # wrong cell id
+        bad_ids = list(ids)
+        bad_ids[2] = 99
+        assert not das.verify_cell_kzg_proof_batch(
+            cms, bad_ids, cls, pfs, s)
+        # out-of-range id fails closed
+        assert not das.verify_cell_kzg_proof_batch(
+            cms, [0, 1, 2, 3, 4, 5, 6, 999], cls, pfs, s)
+
+    def test_fused_batch_multi_element_cells(self):
+        """Width 256 -> cell_size 4: the monomial-coefficient fold
+        covers more than one lane per cell."""
+        import numpy as np
+
+        s = kzg.KzgSettings.dev(width=256)
+        rng = np.random.default_rng(23)
+        blob = b"".join(kzg.bls_field_to_bytes(int(v))
+                        for v in rng.integers(0, 2**62, size=s.width))
+        commitment = kzg.blob_to_kzg_commitment(blob, s)
+        cells, proofs = das.compute_cells_and_kzg_proofs(blob, s)
+        ids = list(range(0, 96, 12))  # 8 cells
+        assert das.verify_cell_kzg_proof_batch(
+            [commitment] * len(ids), ids, [cells[i] for i in ids],
+            [proofs[i] for i in ids], s)
+        bad = bytearray(cells[ids[3]])
+        bad[33] ^= 1
+        cls = [cells[i] for i in ids]
+        cls[3] = bytes(bad)
+        assert not das.verify_cell_kzg_proof_batch(
+            [commitment] * len(ids), ids, cls,
+            [proofs[i] for i in ids], s)
