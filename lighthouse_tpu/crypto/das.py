@@ -238,9 +238,88 @@ def _require_monomials(settings, cell_size: int):
             "(g1_monomial/g2_monomial in the ceremony file)")
 
 
+_CELL_PROOF_FUSED_MIN_WIDTH = 256   # device-batch at production widths
+_CELL_PROOF_MAX_LANES = 1 << 17     # chunk cells to bound HBM footprint
+_CELL_PROOFS_JIT = None
+
+
+def _batched_cell_proof_msms(q_lists: list[list[int]], settings
+                             ) -> list:
+    """All cells' quotient MSMs as chunked fused dispatches.
+
+    The per-cell loop below issues one device MSM PER CELL (128
+    dispatches per blob on a proposer).  Here lanes lay out s-major
+    (lane s·G + g = monomial point s weighted by cell g's coefficient)
+    through ONE windowed scan + segment sum per chunk; chunk size caps
+    resident lanes so the 16-entry per-lane window tables stay inside
+    HBM.  Returns affine (x, y) int pairs or cv.INF per cell."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.ops import cache_guard, ec
+
+    cache_guard.install()
+    global _CELL_PROOFS_JIT
+    if _CELL_PROOFS_JIT is None:
+        def _f(xs, ys, digits, n_seg):
+            X, Y, Z = ec.g1_scalar_mul_windowed(xs, ys, digits)
+            return ec.g1_segment_sum(X, Y, Z, n_seg)
+
+        _CELL_PROOFS_JIT = jax.jit(_f, static_argnums=(3,))
+
+    seg_pad = 1 << max(len(q_lists[0]) - 1, 0).bit_length()
+    chunk = max(1, _CELL_PROOF_MAX_LANES // seg_pad)
+    chunk = 1 << (chunk.bit_length() - 1)   # floor to a power of two
+    mono = settings.g1_monomial[:seg_pad] + [None] * max(
+        0, seg_pad - len(settings.g1_monomial))
+    mx = ec.ints_to_mont_limbs(
+        [p[0] if p is not None else 0 for p in mono])
+    my = ec.ints_to_mont_limbs(
+        [p[1] if p is not None else 0 for p in mono])
+    out = []
+    for c0 in range(0, len(q_lists), chunk):
+        qs = q_lists[c0:c0 + chunk]
+        g = len(qs)
+        g_pad = 1 << max(g - 1, 0).bit_length()
+        lanes = seg_pad * g_pad
+        xs = np.zeros((lanes, bi.L), np.uint32)
+        ys = np.zeros((lanes, bi.L), np.uint32)
+        scalars = [0] * lanes
+        for s in range(seg_pad):
+            base = s * g_pad
+            row_x, row_y = mx[s], my[s]
+            for gi, q in enumerate(qs):
+                k = q[s] if s < len(q) else 0
+                if k and mono[s] is not None:
+                    xs[base + gi] = row_x
+                    ys[base + gi] = row_y
+                    scalars[base + gi] = k
+        digits = jnp.asarray(ec.scalars_to_digits(scalars, n_bits=256))
+        X, Y, Z = jax.device_get(_CELL_PROOFS_JIT(
+            jnp.asarray(xs), jnp.asarray(ys), digits, g_pad))
+        for gi in range(g):
+            z = int(bi.from_mont(np.asarray(Z[gi])))
+            if z == 0:
+                out.append(cv.INF)
+                continue
+            x = int(bi.from_mont(np.asarray(X[gi])))
+            y = int(bi.from_mont(np.asarray(Y[gi])))
+            zi = pow(z, -1, cv.P)
+            out.append((x * zi * zi % cv.P,
+                        y * zi * zi % cv.P * zi % cv.P))
+    return out
+
+
 def compute_cells_and_kzg_proofs(blob: bytes, settings
                                  ) -> tuple[list[bytes], list[bytes]]:
-    """Cells + one KZG multi-proof per cell."""
+    """Cells + one KZG multi-proof per cell.
+
+    Production widths batch ALL cells' quotient MSMs into chunked fused
+    dispatches (_batched_cell_proof_msms) instead of one device MSM per
+    cell; dev widths keep the per-cell g1_lincomb path."""
     from lighthouse_tpu.crypto import kzg as _kzg
     from lighthouse_tpu.crypto.bls import curve as cv
 
@@ -251,7 +330,7 @@ def compute_cells_and_kzg_proofs(blob: bytes, settings
     coeffs = _poly_coeffs_from_blob(blob, width)
     ext_roots = _compute_roots_of_unity(2 * width)
     nat_of_brp = _bit_reversal_permutation(list(range(2 * width)))
-    proofs = []
+    q_lists = []
     for cid in range(n_cells):
         h = _coset_start(cid, cell_size, ext_roots, nat_of_brp)
         a = pow(h, cell_size, BLS_MODULUS)
@@ -260,8 +339,14 @@ def compute_cells_and_kzg_proofs(blob: bytes, settings
         for j in range(width - cell_size - 1, -1, -1):
             carry = q[j + cell_size] if j + cell_size < len(q) else 0
             q[j] = (coeffs[j + cell_size] + a * carry) % BLS_MODULUS
-        proofs.append(cv.g1_to_bytes(
-            _kzg.g1_lincomb(settings.g1_monomial[:len(q)], q)))
+        q_lists.append(q)
+    if width >= _CELL_PROOF_FUSED_MIN_WIDTH:
+        pts = _batched_cell_proof_msms(q_lists, settings)
+        proofs = [cv.g1_to_bytes(p) for p in pts]
+    else:
+        proofs = [cv.g1_to_bytes(
+            _kzg.g1_lincomb(settings.g1_monomial[:len(q)], q))
+            for q in q_lists]
     return cells, proofs
 
 
