@@ -151,6 +151,10 @@ def _bench_bls_1k() -> dict:
     ledger_ok = _bb.verify_sets_pipeline(_fresh(sets), ledger=ledger)
     assert ledger_ok, "profiled ledger pass failed to verify"
     result["stage_ms"] = {k: round(v * 1000, 2) for k, v in ledger.items()}
+    # the cross-bench stage breakdown object (BENCH_*.json consumers read
+    # result["stages"][<bench>][<stage>] in ms); per-bench children merge
+    # their own sub-dicts in main()
+    result["stages"] = {"bls_verify": dict(result["stage_ms"])}
     # host<->device crossings per batch on the warm path: pipeline
     # dispatch + one fused-product fetch, the subgroup kernel dispatch +
     # one bool-row fetch, and the aggregate kernel's dispatch + fetch
@@ -587,7 +591,9 @@ def _bench_merkleize() -> dict:
     device_merkle_root = jax.jit(sha_ops.fold_to_root_device)
 
     dev_leaves = jax.device_put(jnp.asarray(leaves))  # keep off the clock:
+    t0 = time.perf_counter()
     device_merkle_root(dev_leaves).block_until_ready()  # compile warm-up
+    compile_s = time.perf_counter() - t0
     n_iters = 3
     roots = []
     t0 = time.perf_counter()
@@ -618,6 +624,12 @@ def _bench_merkleize() -> dict:
         "unit": "Mhash/s",
         "vs_baseline": round(device_rate / host_rate, 3),
         "platform": platform,
+        # compile = first whole-fold dispatch at this shape (XLA compile
+        # or persistent-cache load); execute = steady-state per-fold time
+        "stages": {"merkleize": {
+            "compile_ms": round(compile_s * 1000, 1),
+            "execute_ms": round(dt_device * 1000, 1),
+        }},
     }
 
 
@@ -812,6 +824,8 @@ def main() -> int:
             result["merkle_Mhash_s"] = merkle["value"]
             result["merkle_vs_host"] = merkle["vs_baseline"]
             result["merkle_platform"] = merkle.get("platform", "?")
+            result.setdefault("stages", {}).update(
+                merkle.get("stages") or {})
     elif merkle is not None:
         result = merkle
         result["note"] = "bls bench child failed; merkle headline"
@@ -840,10 +854,15 @@ def main() -> int:
             r = _run_child(working_env, child_flag=flag, timeout_s=timeout)
             if r:
                 r.pop("stage", None)  # keep the BLS child's stage field
+                # per-child stage breakdowns merge under one "stages"
+                # object instead of overwriting each other
+                result.setdefault("stages", {}).update(
+                    r.pop("stages", None) or {})
                 r.setdefault(
                     f"{key}_platform",
                     "cpu" if working_env is not None else "tpu")
                 result.update(r)
+    result.setdefault("stages", {})
     print(json.dumps(result))
     return 0
 

@@ -141,6 +141,8 @@ class BeaconApi:
         r("GET", r"/eth/v1/node/version", self.version)
         r("GET", r"/eth/v1/node/health", self.health)
         r("GET", r"/lighthouse/health", self.lighthouse_health)
+        r("GET", r"/lighthouse/tracing", self.tracing_slots)
+        r("GET", r"/lighthouse/tracing/(?P<slot>-?\d+)", self.tracing_slot)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
         r("GET", r"/eth/v1/node/identity", self.node_identity)
         r("GET", r"/eth/v1/node/peers", self.node_peers)
@@ -1434,6 +1436,23 @@ class BeaconApi:
 
     def metrics(self, body=None):
         return REGISTRY.render()
+
+    def tracing_slots(self, body=None):
+        """Slots with recorded span timelines (newest tracing-ring view)."""
+        from lighthouse_tpu.common.tracing import TRACER
+
+        return {"data": {"slots": TRACER.slots()}}
+
+    def tracing_slot(self, slot, body=None):
+        """Nested span timeline for one slot (common/tracing ring): the
+        block-delay breakdown gossip-arrival -> verified -> head-updated
+        plus any device-plane spans filed under the slot."""
+        from lighthouse_tpu.common.tracing import TRACER
+
+        timeline = TRACER.timeline(int(slot))
+        if timeline is None:
+            raise ApiError(404, f"no timeline recorded for slot {slot}")
+        return {"data": timeline}
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -35,6 +35,8 @@ from lighthouse_tpu.chain.caches import (
     ValidatorPubkeyCache,
 )
 from lighthouse_tpu.chain.data_availability import DataAvailabilityChecker
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
 from lighthouse_tpu.fork_choice import ForkChoice
 from lighthouse_tpu.store import HotColdDB
@@ -215,38 +217,56 @@ class BeaconChain:
 
     def _process_block_locked(self, signed_block, blobs_ssz, source):
         t_start = time.perf_counter()
-        gossip = verify_block_for_gossip(self, signed_block, source)
-        sigv = verify_block_signatures(self, gossip)
+        slot = int(signed_block.message.slot)
+        # the per-slot timeline root (Lighthouse block-delay analogue):
+        # gossip arrival -> verified -> executed -> head updated; served
+        # by GET /lighthouse/tracing/{slot}
+        with tracing.span("block_import", slot=slot, source=source):
+            with tracing.span("gossip_verify"):
+                gossip = verify_block_for_gossip(self, signed_block, source)
+            with tracing.span("signature_verify"):
+                sigv = verify_block_signatures(self, gossip)
 
-        # payload verification runs CONCURRENTLY with the state transition
-        # (reference block_verification.rs:1342-1415 payload future;
-        # SURVEY §2.9-5 pipeline overlap), joined below
-        payload_future = self._spawn_payload_verification(signed_block)
-        pending = execute_block(self, sigv)
-        pending.execution_status = self._join_payload_verification(
-            payload_future)
+            # payload verification runs CONCURRENTLY with the state
+            # transition (reference block_verification.rs:1342-1415 payload
+            # future; SURVEY §2.9-5 pipeline overlap), joined below
+            payload_future = self._spawn_payload_verification(signed_block)
+            with tracing.span("state_transition"):
+                pending = execute_block(self, sigv)
+            with tracing.span("payload_join"):
+                pending.execution_status = self._join_payload_verification(
+                    payload_future)
 
-        # Deneb data-availability gate (data_availability_checker.rs:32).
-        # Callers that ALREADY hold the block's blob data (RPC/backfill
-        # sync, which verifies sidecars out-of-band) pass blobs_ssz and
-        # import directly — only gossip blocks wait on gossip sidecars.
-        commitments = getattr(signed_block.message.body,
-                              "blob_kzg_commitments", None)
-        if (commitments is not None and len(commitments) > 0
-                and blobs_ssz is None):
-            self._pending_executed[pending.block_root] = pending
-            while len(self._pending_executed) > self.da_checker.capacity:
-                # stay in lockstep with the DA checker's LRU bound
-                oldest = next(iter(self._pending_executed))
-                del self._pending_executed[oldest]
-            availability = self.da_checker.put_pending_executed_block(
-                pending.block_root, pending.signed_block)
-            if not availability.is_available:
-                return None
-            return self._import_available(availability)
-
-        root = self.import_block(pending, blobs_ssz)
-        self.block_times.record(root, "total", time.perf_counter() - t_start)
+            # Deneb data-availability gate (data_availability_checker.rs:32).
+            # Callers that ALREADY hold the block's blob data (RPC/backfill
+            # sync, which verifies sidecars out-of-band) pass blobs_ssz and
+            # import directly — only gossip blocks wait on gossip sidecars.
+            commitments = getattr(signed_block.message.body,
+                                  "blob_kzg_commitments", None)
+            if (commitments is not None and len(commitments) > 0
+                    and blobs_ssz is None):
+                self._pending_executed[pending.block_root] = pending
+                while len(self._pending_executed) > self.da_checker.capacity:
+                    # stay in lockstep with the DA checker's LRU bound
+                    oldest = next(iter(self._pending_executed))
+                    del self._pending_executed[oldest]
+                availability = self.da_checker.put_pending_executed_block(
+                    pending.block_root, pending.signed_block)
+                if not availability.is_available:
+                    return None
+                # sidecars all arrived already: the import completes in
+                # THIS call, so it must hit the timing sinks below too —
+                # post-Deneb every gossip block takes this branch
+                root = self._import_available(availability)
+            else:
+                root = self.import_block(pending, blobs_ssz)
+        total = time.perf_counter() - t_start
+        if root is not None:
+            self.block_times.record(root, "total", total)
+            REGISTRY.histogram(
+                "block_import_seconds",
+                "full block import pipeline wall time, by source",
+            ).labels(source=source).observe(total)
         return root
 
     def process_gossip_blob(self, sidecar) -> bytes | None:
@@ -339,59 +359,74 @@ class BeaconChain:
         """Fork choice + atomic DB write + head recompute
         (reference chain.import_block, beacon_chain.rs:3449)."""
         block = pending.signed_block.message
+        # nests under the block_import root on the direct path; on the
+        # blob-availability path this IS the slot-timeline root
+        with tracing.span("import_block", slot=int(block.slot)):
+            return self._import_block_spanned(pending, blobs_ssz)
+
+    def _import_block_spanned(self, pending: ExecutionPendingBlock,
+                              blobs_ssz: bytes | None = None) -> bytes:
+        block = pending.signed_block.message
         root = pending.block_root
         state = pending.post_state
         current_slot = max(self.current_slot(), int(block.slot))
 
-        is_timely = (
-            int(block.slot) == self.slot_clock.current_slot()
-            and self.slot_clock.is_timely_for_boost())
-        self.fork_choice.on_block(
-            current_slot, block, root, state, is_timely=is_timely,
-            execution_status=getattr(pending, "execution_status", 0))
+        with tracing.span("fork_choice"):
+            is_timely = (
+                int(block.slot) == self.slot_clock.current_slot()
+                and self.slot_clock.is_timely_for_boost())
+            self.fork_choice.on_block(
+                current_slot, block, root, state, is_timely=is_timely,
+                execution_status=getattr(pending, "execution_status", 0))
 
-        # apply the block's attestations/slashings to fork choice
-        # (block_verification.rs:1654-1688)
-        from lighthouse_tpu.state_transition.block_processing import (
-            get_attesting_indices,
-        )
-        for att in block.body.attestations:
-            try:
-                shuffle = self.committee_shuffle(
-                    state, int(att.data.target.epoch))
-                indices = get_attesting_indices(state, self.spec, att, shuffle)
-                self.validator_monitor.on_attestation_included(
-                    indices, att.data, int(block.slot), self.spec)
-                self.fork_choice.on_attestation(
-                    current_slot, indices, bytes(att.data.beacon_block_root),
-                    int(att.data.target.epoch), int(att.data.slot),
-                    is_from_block=True)
-            except Exception:
-                pass  # invalid-for-fork-choice attestations are skippable
-        block_epoch = self.spec.compute_epoch_at_slot(int(block.slot))
-        for slashing in block.body.attester_slashings:
-            a1 = set(int(i) for i in slashing.attestation_1.attesting_indices)
-            a2 = set(int(i) for i in slashing.attestation_2.attesting_indices)
-            both = np.array(sorted(a1 & a2), np.int64)
-            if both.size:
-                self.fork_choice.on_attester_slashing(both)
-                self.validator_monitor.on_attester_slashing(
-                    both, block_epoch)
-        for ps in block.body.proposer_slashings:
-            self.validator_monitor.on_proposer_slashing(
-                int(ps.signed_header_1.message.proposer_index), block_epoch)
-        for ex in block.body.voluntary_exits:
-            self.validator_monitor.on_exit(
-                int(ex.message.validator_index), block_epoch)
-        self._note_sync_aggregate(block, state)
+            # apply the block's attestations/slashings to fork choice
+            # (block_verification.rs:1654-1688)
+            from lighthouse_tpu.state_transition.block_processing import (
+                get_attesting_indices,
+            )
+            for att in block.body.attestations:
+                try:
+                    shuffle = self.committee_shuffle(
+                        state, int(att.data.target.epoch))
+                    indices = get_attesting_indices(
+                        state, self.spec, att, shuffle)
+                    self.validator_monitor.on_attestation_included(
+                        indices, att.data, int(block.slot), self.spec)
+                    self.fork_choice.on_attestation(
+                        current_slot, indices,
+                        bytes(att.data.beacon_block_root),
+                        int(att.data.target.epoch), int(att.data.slot),
+                        is_from_block=True)
+                except Exception:
+                    pass  # invalid-for-fork-choice attestations skippable
+            block_epoch = self.spec.compute_epoch_at_slot(int(block.slot))
+            for slashing in block.body.attester_slashings:
+                a1 = set(int(i)
+                         for i in slashing.attestation_1.attesting_indices)
+                a2 = set(int(i)
+                         for i in slashing.attestation_2.attesting_indices)
+                both = np.array(sorted(a1 & a2), np.int64)
+                if both.size:
+                    self.fork_choice.on_attester_slashing(both)
+                    self.validator_monitor.on_attester_slashing(
+                        both, block_epoch)
+            for ps in block.body.proposer_slashings:
+                self.validator_monitor.on_proposer_slashing(
+                    int(ps.signed_header_1.message.proposer_index),
+                    block_epoch)
+            for ex in block.body.voluntary_exits:
+                self.validator_monitor.on_exit(
+                    int(ex.message.validator_index), block_epoch)
+            self._note_sync_aggregate(block, state)
 
         if self.slasher is not None:
             self.slasher.on_block(pending.signed_block)
-        self.store.import_block(root, pending.signed_block, state,
-                                pending.state_root, blobs_ssz)
-        self._state_root_of_block[root] = pending.state_root
-        self.state_cache.insert(pending.state_root, state)
-        self.pubkey_cache.import_new(state.validators)
+        with tracing.span("store_import"):
+            self.store.import_block(root, pending.signed_block, state,
+                                    pending.state_root, blobs_ssz)
+            self._state_root_of_block[root] = pending.state_root
+            self.state_cache.insert(pending.state_root, state)
+            self.pubkey_cache.import_new(state.validators)
         self.validator_monitor.on_block_imported(block, self.spec)
         self._note_missed_proposals(block, state)
         try:
@@ -401,7 +436,8 @@ class BeaconChain:
         self.events.publish("block", {
             "slot": str(int(block.slot)), "block": "0x" + root.hex(),
             "execution_optimistic": pending.execution_status == 1})
-        self.recompute_head()
+        with tracing.span("head_update"):
+            self.recompute_head()
         return root
 
     def _note_sync_aggregate(self, block, state) -> None:

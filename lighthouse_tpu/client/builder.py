@@ -429,15 +429,20 @@ class ClientBuilder:
         if self.config.upnp_enabled:
             # hold a UDP mapping for the discovery port on the LAN
             # gateway (reference nat.rs construct_upnp_mappings)
-            import socket as _socket
+            from lighthouse_tpu.network.upnp import (
+                UpnpService,
+                discover_internal_ip,
+            )
 
-            from lighthouse_tpu.network.upnp import UpnpService
-
-            local_ip = _socket.gethostbyname(_socket.gethostname())
-            upnp_svc = UpnpService(local_ip, fabric.listen_port)
-            upnp_svc.start()
-            svc.upnp = upnp_svc
-            client.services["upnp"] = upnp_svc
+            local_ip = discover_internal_ip()
+            if local_ip is None:
+                self.log.warn(
+                    "upnp disabled: no routable LAN interface address")
+            else:
+                upnp_svc = UpnpService(local_ip, fabric.listen_port)
+                upnp_svc.start()
+                svc.upnp = upnp_svc
+                client.services["upnp"] = upnp_svc
 
         boot_nodes = tuple(self.config.boot_nodes)
 

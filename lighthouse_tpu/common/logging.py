@@ -37,8 +37,8 @@ class Logger:
     def _log(self, level: str, msg: str, **fields):
         if LEVELS[level] < self.level:
             return
-        REGISTRY.counter(f"log_events_{level}_total",
-                         "log events by level").inc()
+        REGISTRY.counter("log_events_total",
+                         "log events by level").labels(level=level).inc()
         record = {
             "ts": round(time.time(), 3),
             "level": level,

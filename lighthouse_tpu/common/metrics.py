@@ -3,6 +3,14 @@
 Rebuild of /root/reference/common/lighthouse_metrics/src/lib.rs:1-18: a
 process-global registry of counters/gauges/histograms with a text
 exposition renderer (scraped by the http_metrics endpoint).
+
+Label support: every metric is a FAMILY.  The bare object keeps the
+original unlabeled API (`REGISTRY.counter(n).inc()`), and
+`REGISTRY.counter(n).labels(work_type="gossip_block").inc()` returns a
+per-label-set child rendered as `n{work_type="gossip_block"} v` in the
+same exposition block.  The unlabeled sample is emitted only when it was
+actually used (or the family has no children), so a family used purely
+through labels renders clean labeled series.
 """
 
 from __future__ import annotations
@@ -12,48 +20,95 @@ import time
 from dataclasses import dataclass, field
 
 
+def _escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_labels(items: tuple) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+
+
 class _Metric:
     def __init__(self, name: str, help_: str):
         self.name = name
         self.help = help_
         self._lock = threading.Lock()
+        self._children: dict[tuple, "_Metric"] = {}
+        self._label_str = ""   # set on labeled children
+        self._touched = False  # unlabeled sample was actually used
+
+    def labels(self, **labelset) -> "_Metric":
+        """Per-label-set child (created on first use, then cached)."""
+        if not labelset:
+            return self
+        key = tuple(sorted((k, str(v)) for k, v in labelset.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                child._label_str = _format_labels(key)
+                self._children[key] = child
+            return child
+
+    def render(self) -> str:
+        with self._lock:
+            children = list(self._children.values())
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self._TYPE}"]
+        if self._touched or not children:
+            out.extend(self._sample_lines())
+        for child in children:
+            out.extend(child._sample_lines())
+        return "\n".join(out) + "\n"
 
 
 class Counter(_Metric):
+    _TYPE = "counter"
+
     def __init__(self, name, help_=""):
         super().__init__(name, help_)
         self.value = 0.0
+
+    def _new_child(self) -> "Counter":
+        return Counter(self.name, self.help)
 
     def inc(self, by: float = 1.0):
         with self._lock:
+            self._touched = True
             self.value += by
 
-    def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self.value}\n")
+    def _sample_lines(self) -> list[str]:
+        lab = "{%s}" % self._label_str if self._label_str else ""
+        return [f"{self.name}{lab} {self.value}"]
 
 
 class Gauge(_Metric):
+    _TYPE = "gauge"
+
     def __init__(self, name, help_=""):
         super().__init__(name, help_)
         self.value = 0.0
 
+    def _new_child(self) -> "Gauge":
+        return Gauge(self.name, self.help)
+
     def set(self, v: float):
         with self._lock:
+            self._touched = True
             self.value = float(v)
 
     def inc(self, by: float = 1.0):
         with self._lock:
+            self._touched = True
             self.value += by
 
     def dec(self, by: float = 1.0):
         self.inc(-by)
 
-    def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self.value}\n")
+    def _sample_lines(self) -> list[str]:
+        lab = "{%s}" % self._label_str if self._label_str else ""
+        return [f"{self.name}{lab} {self.value}"]
 
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -61,6 +116,8 @@ _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
 
 
 class Histogram(_Metric):
+    _TYPE = "histogram"
+
     def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS):
         super().__init__(name, help_)
         self.buckets = tuple(sorted(buckets))
@@ -68,8 +125,12 @@ class Histogram(_Metric):
         self.total = 0.0
         self.n = 0
 
+    def _new_child(self) -> "Histogram":
+        return Histogram(self.name, self.help, self.buckets)
+
     def observe(self, v: float):
         with self._lock:
+            self._touched = True
             self.total += v
             self.n += 1
             for i, b in enumerate(self.buckets):
@@ -93,18 +154,22 @@ class Histogram(_Metric):
 
         return _Timer()
 
-    def render(self) -> str:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} histogram"]
+    def _sample_lines(self) -> list[str]:
+        pre = self._label_str + "," if self._label_str else ""
+        suf = "{%s}" % self._label_str if self._label_str else ""
+        with self._lock:
+            counts = list(self.counts)
+            total, n = self.total, self.n
+        out = []
         cum = 0
-        for b, c in zip(self.buckets, self.counts):
+        for b, c in zip(self.buckets, counts):
             cum += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        cum += self.counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {self.total}")
-        out.append(f"{self.name}_count {self.n}")
-        return "\n".join(out) + "\n"
+            out.append(f'{self.name}_bucket{{{pre}le="{b}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{self.name}_bucket{{{pre}le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum{suf} {total}")
+        out.append(f"{self.name}_count{suf} {n}")
+        return out
 
 
 @dataclass
@@ -131,7 +196,8 @@ class Registry:
 
     def render(self) -> str:
         with self._lock:
-            return "".join(m.render() for m in self.metrics.values())
+            families = list(self.metrics.values())
+        return "".join(m.render() for m in families)
 
 
 REGISTRY = Registry()

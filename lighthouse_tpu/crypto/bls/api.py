@@ -21,6 +21,7 @@ Batch semantics mirror blst's verify_multiple_aggregate_signatures
 from __future__ import annotations
 
 import secrets
+import time
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
@@ -95,8 +96,11 @@ class PublicKey:
         no matter which state or batch the key appears in."""
         pk = _PK_INTERN.get(data)
         if pk is None:
+            record_cache("pk_intern", hit=False)
             pk = PublicKey(data)
             _PK_INTERN.put(bytes(data), pk)
+        else:
+            record_cache("pk_intern", hit=True)
         return pk
 
     @staticmethod
@@ -306,12 +310,84 @@ def aggregate_verify(
 
 # --- batch verification backends -------------------------------------------
 
+# buckets sized for the spread between a 1-set host batch (ms) and a cold
+# device compile (minutes) — the default 10 s ceiling would flatten it
+_STAGE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                  10.0, 60.0, 300.0)
+
+
+def record_batch(backend: str, n_sets: int) -> None:
+    """Count one verification batch against a backend (single owner of
+    the bls_verify_batches/sets series — the lint in tools/check_metrics
+    rejects the same name registered from two modules)."""
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "bls_verify_batches_total",
+            "batches handed to a BLS backend").labels(backend=backend).inc()
+        REGISTRY.counter(
+            "bls_verify_sets_total",
+            "signature sets handed to a BLS backend",
+        ).labels(backend=backend).inc(n_sets)
+        REGISTRY.histogram(
+            "bls_verify_sets_per_batch",
+            "signature sets per verification batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                     4096),
+        ).labels(backend=backend).observe(n_sets)
+    except Exception:
+        pass  # metrics must never take down a verifier
+
+
+# labeled children memoized here: interned() runs per gossip signature
+# at flood scale, so the per-call cost must stay one counter.inc()
+_CACHE_COUNTERS: dict = {}
+
+
+def record_cache(cache: str, hit: bool) -> None:
+    """Hit/miss accounting for the verify-path caches (pubkey interning,
+    hash-to-curve): amortization is the whole argument for the steady-
+    state batch numbers, so the ratio must be observable."""
+    key = (cache, hit)
+    child = _CACHE_COUNTERS.get(key)
+    if child is None:
+        try:
+            from lighthouse_tpu.common.metrics import REGISTRY
+
+            child = REGISTRY.counter(
+                "bls_cache_requests_total",
+                "verify-path cache lookups by cache and outcome",
+            ).labels(cache=cache, outcome="hit" if hit else "miss")
+        except Exception:
+            return  # metrics must never take down a verifier
+        _CACHE_COUNTERS[key] = child
+    child.inc()
+
+
+def record_stage(backend: str, stage: str, seconds: float) -> None:
+    """File one verify-pipeline stage wall time under the shared labeled
+    histogram — every BLS backend (reference, tpu, sharded) reports its
+    decompress/h2d/kernel/d2h-style breakdown through this one seam."""
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.histogram(
+            "bls_verify_stage_seconds",
+            "per-stage wall time inside BLS batch verification "
+            "(device stages time dispatch unless the caller syncs)",
+            buckets=_STAGE_BUCKETS,
+        ).labels(backend=backend, stage=stage).observe(seconds)
+    except Exception:
+        pass  # metrics must never take down a verifier
+
+
 def _verify_signature_sets_reference(sets: Sequence[SignatureSet]) -> bool:
     """Randomized batch verification (one multi-pairing for the batch)."""
     if not sets:
         return False
-    pairs = []
-    sig_acc = cv.INF
+    t0 = time.perf_counter()
+    prepared = []
     for s in sets:
         if not s.pubkeys:
             return False
@@ -322,13 +398,25 @@ def _verify_signature_sets_reference(sets: Sequence[SignatureSet]) -> bool:
             return False
         if sig_pt is cv.INF:
             return False
+        prepared.append((sig_pt, agg_pk, s.message))
+    now = time.perf_counter()
+    record_stage("reference", "decompress", now - t0)
+    t0 = now
+    pairs = []
+    sig_acc = cv.INF
+    for sig_pt, agg_pk, message in prepared:
         rand = 0
         while rand == 0:
             rand = secrets.randbits(RAND_BITS)
         sig_acc = cv.g2_add(sig_acc, cv.g2_mul(sig_pt, rand))
-        pairs.append((cv.g1_mul(agg_pk, rand), hash_to_g2(s.message)))
+        pairs.append((cv.g1_mul(agg_pk, rand), hash_to_g2(message)))
     pairs.append((cv.g1_neg(cv.g1_generator()), sig_acc))
-    return cv.multi_pairing(pairs).is_one()
+    now = time.perf_counter()
+    record_stage("reference", "accumulate", now - t0)
+    t0 = now
+    ok = cv.multi_pairing(pairs).is_one()
+    record_stage("reference", "pairing", time.perf_counter() - t0)
+    return ok
 
 
 def _verify_signature_sets_fake(sets: Sequence[SignatureSet]) -> bool:
@@ -415,15 +503,20 @@ def verify_signature_sets(
     if name == "auto":
         name = resolve_auto_backend()
     fn = _resolve_backend(name)
+    record_batch(name, len(sets))
     try:
         from lighthouse_tpu.common.metrics import REGISTRY
 
-        REGISTRY.counter(
-            f"bls_verify_batches_{name}_total",
-            "batches handed to this BLS backend").inc()
-        REGISTRY.counter(
-            f"bls_verify_sets_{name}_total",
-            "signature sets handed to this BLS backend").inc(len(sets))
+        timer = REGISTRY.histogram(
+            "bls_verify_seconds",
+            "wall time of one batch verification call",
+            buckets=_STAGE_BUCKETS).labels(backend=name).time()
     except Exception:
-        pass
-    return fn(sets)
+        from contextlib import nullcontext
+
+        timer = nullcontext()
+    from lighthouse_tpu.common import tracing
+
+    with tracing.span("bls.verify", backend=name, sets=len(sets)):
+        with timer:
+            return fn(sets)

@@ -42,6 +42,32 @@ class UpnpError(Exception):
     pass
 
 
+def discover_internal_ip() -> str | None:
+    """LAN-facing source IP for port-mapping requests.
+
+    A UDP socket "connected" toward the SSDP multicast group makes the
+    kernel pick the interface it would route UPnP traffic through — no
+    packet is sent (UDP connect only sets the destination).  This beats
+    ``gethostbyname(gethostname())``, which on many hosts resolves to
+    127.0.x.x via /etc/hosts and would register a useless loopback
+    mapping on the gateway.  Returns None when no usable (non-loopback,
+    specified) LAN address exists; callers skip UPnP rather than map a
+    wrong address."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(SSDP_ADDR)
+            ip = s.getsockname()[0]
+    except OSError:
+        return None
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return None
+    if addr.is_loopback or addr.is_unspecified:
+        return None
+    return ip
+
+
 @dataclass
 class Gateway:
     """One WAN*Connection control endpoint on a discovered IGD."""

@@ -137,6 +137,19 @@ class _QuicConn:
             self._die(QuicError("connection reset by peer"))
             return
         if ptype in (T_DATA, T_FIN):
+            if seq >= self.rcv_next + WINDOW_PACKETS:
+                # bound the reorder buffer: connections exist BEFORE the
+                # Noise handshake, so an unauthenticated peer spraying
+                # far-future seqs must not grow rcv_buf without limit.
+                # Silently dropped segments are retransmitted (RTO) once
+                # the window advances.
+                from lighthouse_tpu.common.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "quic_rx_window_dropped_total",
+                    "segments dropped beyond the receive reorder window",
+                ).inc()
+                return
             if seq >= self.rcv_next and seq not in self.rcv_buf:
                 self.rcv_buf[seq] = (ptype, payload)
             # deliver everything now in order

@@ -64,8 +64,11 @@ def _hash_to_g2_cached(message: bytes):
 
     pt = _H2C_CACHE.get(message)
     if pt is None:
+        api.record_cache("h2c", hit=False)
         pt = hash_to_g2(message)
         _H2C_CACHE.put(message, pt)
+    else:
+        api.record_cache("h2c", hit=True)
     return pt
 
 
@@ -407,14 +410,28 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     With ``ledger`` given, per-stage wall times (seconds) are recorded under
     keys subgroup / aggregate / prep_host / limbs / pipeline / final_exp —
     device stages are synchronized before timing, so only pass a ledger
-    when profiling (it serializes the pipeline)."""
+    when profiling (it serializes the pipeline).  Every stage also feeds
+    the labeled ``bls_verify_stage_seconds{backend="tpu"}`` histogram; on
+    the async (no-ledger) path the device ``pipeline`` stage times
+    dispatch, not execution (see api.record_stage help)."""
+    from lighthouse_tpu.common import tracing
+
+    with tracing.span("bls.verify_pipeline", sets=len(sets),
+                      profiled=ledger is not None):
+        return _verify_sets_pipeline(sets, ledger)
+
+
+def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
+                          ledger: dict | None = None) -> bool:
     import time as _time
 
     cache_guard.install()   # mmap headroom before any XLA compile
 
     def _mark(key, t0):
+        now = _time.perf_counter()
         if ledger is not None:
-            ledger[key] = ledger.get(key, 0.0) + (_time.perf_counter() - t0)
+            ledger[key] = ledger.get(key, 0.0) + (now - t0)
+        api.record_stage("tpu", key, now - t0)
         return _time.perf_counter()
 
     t0 = _time.perf_counter()

@@ -26,9 +26,19 @@ Fix layers:
 
 from __future__ import annotations
 
+import os
+
 _GUARDED_NAMES = ("_pipeline_fused", "_kzg_fused", "_aggregate_kernel")
 _MAP_TARGET = 262144
 _MAP_PATH = "/proc/sys/vm/max_map_count"
+
+
+def _log():
+    # lazy: common.logging pulls in the metrics registry, and cache_guard
+    # must stay importable before anything else in the package
+    from lighthouse_tpu.common.logging import Logger
+
+    return Logger("cache_guard")
 
 
 def ensure_map_headroom() -> bool:
@@ -44,19 +54,49 @@ def ensure_map_headroom() -> bool:
         with open(_MAP_PATH, "w") as f:
             f.write(str(_MAP_TARGET))
         with open(_MAP_PATH) as f:
-            return int(f.read()) >= _MAP_TARGET
+            raised = int(f.read()) >= _MAP_TARGET
+        if raised:
+            # one line per boot in practice: later processes see the
+            # raised ceiling and return above without writing
+            _log().info("raised vm.max_map_count sysctl",
+                        target=_MAP_TARGET, path=_MAP_PATH)
+        return raised
     except Exception:
         return False
 
 
 def install() -> None:
-    """Raise the map ceiling; install the cache filter only if that fails."""
+    """Raise the map ceiling; install the cache filter only if that fails.
+
+    LHTPU_NO_CACHE_GUARD=1 opts out of both layers (for debugging the
+    guard itself, or on hosts where the operator manages the sysctl)."""
+    if os.environ.get("LHTPU_NO_CACHE_GUARD"):
+        return
     if ensure_map_headroom():
         return
+    # The fallback monkey-patches jax PRIVATE internals; a jax upgrade
+    # that moves/resignatures them must degrade to a logged no-op, not
+    # an ImportError at process start.
     try:
         from jax._src import compilation_cache as cc
         from jax._src import compiler as jc
     except Exception:
+        _log().warn("jax._src internals unavailable; "
+                    "compile-cache guard degraded to no-op")
+        return
+    import inspect
+
+    try:
+        n_put = len(inspect.signature(cc.put_executable_and_time).parameters)
+        n_read = len(inspect.signature(jc._cache_read).parameters)
+    except (AttributeError, TypeError, ValueError):
+        n_put = n_read = -1
+    # the wrappers below replicate these exact signatures (jax 0.4.x);
+    # this check is what surfaced an earlier arity drift in _cache_read
+    if n_put != 5 or n_read != 4:
+        _log().warn("jax._src compile-cache API changed; "
+                    "compile-cache guard degraded to no-op",
+                    put_params=n_put, read_params=n_read)
         return
     if not getattr(cc, "_lhtpu_write_guard", False):
         orig_put = cc.put_executable_and_time
@@ -79,8 +119,7 @@ def install() -> None:
     if not getattr(jc, "_lhtpu_read_guard", False):
         orig_read = jc._cache_read
 
-        def guarded_read(module_name, cache_key, compile_options, backend,
-                         executable_devices):
+        def guarded_read(module_name, cache_key, compile_options, backend):
             try:
                 platform = backend.platform
             except Exception:
@@ -89,7 +128,7 @@ def install() -> None:
                                          for n in _GUARDED_NAMES):
                 return None, None
             return orig_read(module_name, cache_key, compile_options,
-                             backend, executable_devices)
+                             backend)
 
         jc._cache_read = guarded_read
         jc._lhtpu_read_guard = True

@@ -24,11 +24,35 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import hashlib
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+# shapes whose whole-fold device program has already been dispatched in
+# this process: the first call at a shape pays tracing + XLA compile (or
+# a persistent-cache load), later calls are pure execution — the metric
+# splits the two so "compile storms" are visible per-process
+_FOLD_SHAPES_SEEN: set = set()
+
+
+def _record_fold_dispatch(shape_key, seconds: float) -> None:
+    phase = "execute" if shape_key in _FOLD_SHAPES_SEEN else "compile"
+    _FOLD_SHAPES_SEEN.add(shape_key)
+    try:
+        REGISTRY.histogram(
+            "sha256_fold_dispatch_seconds",
+            "whole-fold device program wall time; compile = first call "
+            "at this shape (includes XLA compile / cache load)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+                     120.0),
+        ).labels(phase=phase).observe(seconds)
+    except Exception:
+        pass  # metrics must never take down the hasher
 
 # FIPS 180-4 round constants.
 _K = np.array(
@@ -177,8 +201,14 @@ def fold_levels(leaves: np.ndarray, *, device: bool | None = None) -> list[np.nd
     if n == 1:
         return []
     use_device = device if device is not None else n // 2 >= _DEVICE_MIN_PAIRS
+    REGISTRY.counter(
+        "sha256_merkle_chunks_total",
+        "leaf chunks merkleized, by fold path").labels(
+        path="levels_device" if use_device else "levels_host").inc(n)
     if use_device:
+        t0 = time.perf_counter()
         levels = _fold_levels_device(jnp.asarray(leaves))
+        _record_fold_dispatch(("levels", n), time.perf_counter() - t0)
         # np.array (not asarray): device transfers are read-only views and
         # the incremental cache scatters into these levels
         return [np.array(lv) for lv in levels]
@@ -317,6 +347,31 @@ def merkleize_words(
     semantics (reference consumer: consensus/types tree-hash caches).
     """
     n = leaves.shape[0]
+    n_pow2 = 1 << max(n - 1, 0).bit_length()
+    # THE device-vs-host fold decision; the impl takes it as a flag so
+    # the metric's "path" label can never desynchronize from the branch
+    # actually executed
+    fold_device = (device is not False and n > 0
+                   and n_pow2 >= _DEVICE_FOLD_MIN_LEAVES)
+    path = "fold_device" if fold_device else "level_loop"
+    t0 = time.perf_counter()
+    out = _merkleize_words_impl(leaves, limit, device=device,
+                                fold_device=fold_device)
+    REGISTRY.counter(
+        "sha256_merkle_chunks_total",
+        "leaf chunks merkleized, by fold path").labels(path=path).inc(n)
+    REGISTRY.histogram(
+        "sha256_merkleize_seconds",
+        "one merkleize_words call, by fold path",
+    ).labels(path=path).observe(time.perf_counter() - t0)
+    return out
+
+
+def _merkleize_words_impl(
+    leaves: np.ndarray, limit: int | None = None, *,
+    device: bool | None = None, fold_device: bool = False,
+) -> np.ndarray:
+    n = leaves.shape[0]
     size = max(limit if limit is not None else n, 1)
     depth = max(size - 1, 0).bit_length()
     if limit is not None and n > limit:
@@ -326,7 +381,7 @@ def merkleize_words(
 
     level = np.ascontiguousarray(leaves, dtype=np.uint32)
     n_pow2 = 1 << max(n - 1, 0).bit_length()
-    if device is not False and n_pow2 >= _DEVICE_FOLD_MIN_LEAVES:
+    if fold_device:
         # big trees: ONE whole-fold dispatch (padding the leaf level
         # with zero chunks is ladder-equivalent), then the remaining
         # zero-subtree ladder on host.  The per-level loop below costs
@@ -336,7 +391,9 @@ def merkleize_words(
         if n_pow2 != n:
             level = np.concatenate(
                 [level, np.zeros((n_pow2 - n, 8), np.uint32)])
+        t0 = time.perf_counter()
         node = np.asarray(_fold_to_root_jit(jnp.asarray(level)))[0]
+        _record_fold_dispatch(("root", n_pow2), time.perf_counter() - t0)
         for dd in range(n_pow2.bit_length() - 1, depth):
             pair = np.concatenate([node, ZERO_HASH_WORDS[dd]])[None, :]
             node = hash_pairs_np(pair)[0]

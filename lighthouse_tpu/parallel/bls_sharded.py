@@ -62,27 +62,55 @@ def _sharded_miller_reduce(mesh, per_dev: int):
 
 
 def multi_pairing_sharded(pairs, mesh) -> "object":
-    """Device multi-pairing over a mesh: prod Miller(P_i, Q_i), host final exp."""
+    """Device multi-pairing over a mesh: prod Miller(P_i, Q_i), host final exp.
+
+    Stage wall times land in ``bls_verify_stage_seconds{backend="sharded"}``
+    (prep_host / h2d / kernel / d2h / final_exp).  The kernel stage syncs
+    the sharded result before timing — one batch-level sync the d2h fetch
+    right after would pay anyway, so the pipeline is not serialized."""
+    import time
+
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.crypto.bls.api import record_stage
     from lighthouse_tpu.crypto.bls.fields import final_exponentiation_fast
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_dev = mesh.devices.size
-    cols, mask = dev.points_to_device(pairs)
-    n = len(pairs)
-    # pad so every device holds a power-of-two lane count
-    per_dev = 1 << max((n + n_dev - 1) // n_dev - 1, 0).bit_length()
-    padded = per_dev * n_dev
-    if padded != n:
-        cols = [np.concatenate([c, np.tile(c[-1:], (padded - n, 1))])
-                for c in cols]
-        mask = np.concatenate([mask, np.zeros(padded - n, bool)])
-    fn = _sharded_miller_reduce(mesh, per_dev)
-    sh = NamedSharding(mesh, P("data", None))
-    shm = NamedSharding(mesh, P("data"))
-    args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
-    f = fn(*args, jax.device_put(jnp.asarray(mask), shm))
-    f_host = dev.fq12_from_device(jax.device_get(f))
-    return final_exponentiation_fast(f_host)
+    with tracing.span("bls.multi_pairing_sharded", lanes=len(pairs),
+                      devices=int(mesh.devices.size)):
+        n_dev = mesh.devices.size
+        t0 = time.perf_counter()
+        cols, mask = dev.points_to_device(pairs)
+        n = len(pairs)
+        # pad so every device holds a power-of-two lane count
+        per_dev = 1 << max((n + n_dev - 1) // n_dev - 1, 0).bit_length()
+        padded = per_dev * n_dev
+        if padded != n:
+            cols = [np.concatenate([c, np.tile(c[-1:], (padded - n, 1))])
+                    for c in cols]
+            mask = np.concatenate([mask, np.zeros(padded - n, bool)])
+        fn = _sharded_miller_reduce(mesh, per_dev)
+        now = time.perf_counter()
+        record_stage("sharded", "prep_host", now - t0)
+        t0 = now
+        sh = NamedSharding(mesh, P("data", None))
+        shm = NamedSharding(mesh, P("data"))
+        args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
+        mask_dev = jax.device_put(jnp.asarray(mask), shm)
+        now = time.perf_counter()
+        record_stage("sharded", "h2d", now - t0)
+        t0 = now
+        f = fn(*args, mask_dev)
+        jax.block_until_ready(f)
+        now = time.perf_counter()
+        record_stage("sharded", "kernel", now - t0)
+        t0 = now
+        f_host = dev.fq12_from_device(jax.device_get(f))
+        now = time.perf_counter()
+        record_stage("sharded", "d2h", now - t0)
+        t0 = now
+        out = final_exponentiation_fast(f_host)
+        record_stage("sharded", "final_exp", time.perf_counter() - t0)
+        return out
 
 
 def verify_signature_sets_sharded(
@@ -95,10 +123,12 @@ def verify_signature_sets_sharded(
     lane placement differs.
     """
     from jax.sharding import Mesh
+    from lighthouse_tpu.crypto.bls.api import record_batch
     from lighthouse_tpu.ops.bls_backend import prepare_pairs
 
     if not sets:
         return False
+    record_batch("sharded", len(sets))
     pairs = prepare_pairs(sets)
     if pairs is None:
         return False

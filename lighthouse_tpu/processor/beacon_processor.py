@@ -30,6 +30,9 @@ from dataclasses import dataclass, field
 from enum import Enum, auto
 from typing import Any, Awaitable, Callable
 
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.common.metrics import REGISTRY
+
 
 class WorkType(Enum):
     """Work taxonomy (reference Work enum, lib.rs:552-618)."""
@@ -202,6 +205,33 @@ class BeaconProcessor:
         self._inflight: set[asyncio.Task] = set()
         # first-seen timestamps for batch flush decisions
         self._batch_deadline: dict[WorkType, float] = {}
+        # labeled registry families (one series per WorkType label);
+        # ProcessorMetrics above stays as the in-process test surface
+        self._wait_hist = REGISTRY.histogram(
+            "beacon_processor_queue_wait_seconds",
+            "enqueue->dequeue wait per work event, by work type")
+        self._batch_hist = REGISTRY.histogram(
+            "beacon_processor_batch_size_lanes",
+            "lanes per formed device batch, by work type",
+            buckets=(1, 8, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+        self._event_counter = REGISTRY.counter(
+            "beacon_processor_events_total",
+            "work events by work type and outcome "
+            "(enqueued/dropped/processed)")
+        # labeled children memoized per (family, type[, outcome]):
+        # submit()/dequeue run once per gossip event at flood scale, so
+        # the per-call cost must stay one observe()/inc()
+        self._label_memo: dict[tuple, Any] = {}
+
+    def _labeled(self, family, wt: WorkType, outcome: str | None = None):
+        key = (family.name, wt, outcome)
+        child = self._label_memo.get(key)
+        if child is None:
+            labels = {"work_type": wt.name.lower()}
+            if outcome is not None:
+                labels["outcome"] = outcome
+            child = self._label_memo[key] = family.labels(**labels)
+        return child
 
     # -- submission (any task/thread) -------------------------------------
 
@@ -212,9 +242,11 @@ class BeaconProcessor:
         q = self._queues[wt]
         limit = self._lengths.get(wt, 1024)
         self.metrics.bump(self.metrics.enqueued, wt)
+        self._labeled(self._event_counter, wt, "enqueued").inc()
         accepted = True
         if len(q) >= limit:
             self.metrics.bump(self.metrics.dropped, wt)
+            self._labeled(self._event_counter, wt, "dropped").inc()
             if wt in _LIFO_TYPES:
                 q.popleft()  # drop oldest, keep newest
             else:
@@ -291,18 +323,25 @@ class BeaconProcessor:
                     events = [q.popleft() for _ in range(take)]
                     if not q:
                         self._batch_deadline.pop(wt, None)
+                    wait_child = self._labeled(self._wait_hist, wt)
+                    for e in events:
+                        wait_child.observe(now - e.enqueued_at)
                     if take == 1:
                         self._journal_emit(wt.name)
                         return events[0]
                     self.metrics.batches_formed += 1
                     self.metrics.batch_lanes += take
+                    self._labeled(self._batch_hist, wt).observe(take)
                     self._journal_emit(f"{_BATCHABLE[wt].name}({take})")
                     return events
                 # not enough lanes yet and deadline pending: let lower
                 # priorities run while the batch accumulates
                 continue
             self._journal_emit(wt.name)
-            return q.popleft()
+            event = q.popleft()
+            self._labeled(self._wait_hist, wt).observe(
+                now - event.enqueued_at)
+            return event
         return None
 
     async def _run_work(self, work):
@@ -319,17 +358,21 @@ class BeaconProcessor:
         fn = event.process
         if fn is None:
             return
+        wt_label = event.work_type.name.lower()
         try:
-            if asyncio.iscoroutinefunction(fn):
-                await fn()
-            else:
-                loop = asyncio.get_running_loop()
-                res = await loop.run_in_executor(self._executor, fn)
-                if asyncio.iscoroutine(res):
-                    await res
+            with tracing.span("beacon_processor.work", work_type=wt_label):
+                if asyncio.iscoroutinefunction(fn):
+                    await fn()
+                else:
+                    loop = asyncio.get_running_loop()
+                    res = await loop.run_in_executor(self._executor, fn)
+                    if asyncio.iscoroutine(res):
+                        await res
         except Exception:  # worker panics must not kill the manager
             pass
         self.metrics.bump(self.metrics.processed, event.work_type)
+        self._labeled(self._event_counter, event.work_type,
+                      "processed").inc()
 
     async def _run_batch(self, events: list[WorkEvent]):
         wt = events[0].work_type
@@ -340,8 +383,12 @@ class BeaconProcessor:
             return
         payloads = [e.payload for e in events]
         try:
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            with tracing.span("beacon_processor.batch",
+                              work_type=wt.name.lower(),
+                              lanes=len(events)):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(self._executor, batch_fn, payloads)
         except Exception:
             pass
         self.metrics.bump(self.metrics.processed, wt, len(events))
+        self._labeled(self._event_counter, wt, "processed").inc(len(events))

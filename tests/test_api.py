@@ -332,3 +332,45 @@ class TestStandardApiBreadth:
         h, chain, client = api_setup
         out = self._get(client, "/eth/v1/beacon/blob_sidecars/head")["data"]
         assert out == []
+
+
+# keep last in the module: imports a fresh block through the shared
+# module-scoped chain, which advances h.state for everything after it
+def test_tracing_endpoint_serves_block_timeline(api_setup):
+    """GET /lighthouse/tracing/{slot}: nested span timeline for an
+    imported block (observability acceptance)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    h, chain, client = api_setup
+    signed = h.produce_block()
+    state_transition(h.state, h.spec, signed, h._verify_strategy())
+    slot = int(signed.message.slot)
+    chain.slot_clock.set_slot(slot)
+    client.publish_block(signed)
+
+    def get(path):
+        with urllib.request.urlopen(client.base_url + path, timeout=5) as r:
+            return _json.loads(r.read())
+
+    timeline = get(f"/lighthouse/tracing/{slot}")["data"]
+    assert timeline["slot"] == slot
+    root = next(s for s in timeline["spans"]
+                if s["name"] == "block_import")
+    assert root["attrs"]["slot"] == slot
+    assert root["attrs"]["source"] == "gossip"
+    names = [c["name"] for c in root["children"]]
+    for expected in ("gossip_verify", "signature_verify",
+                     "state_transition", "import_block"):
+        assert expected in names, names
+    import_span = root["children"][names.index("import_block")]
+    inner = [c["name"] for c in import_span["children"]]
+    assert "fork_choice" in inner and "head_update" in inner
+    assert root["duration_ms"] >= 0.0
+    assert slot in get("/lighthouse/tracing")["data"]["slots"]
+    try:
+        get("/lighthouse/tracing/999999")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

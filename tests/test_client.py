@@ -162,12 +162,14 @@ class TestBlsBackendWiring:
             h = Harness(n_validators=8, fork="altair", real_crypto=True)
             chain = BeaconChain(h.spec, h.state.copy(),
                                 verify_signatures=True)
-            before = REGISTRY.counter("bls_verify_batches_tpu_total").value
+            before = REGISTRY.counter(
+                "bls_verify_batches_total").labels(backend="tpu").value
             chain.slot_clock.advance_slot()
             signed = h.produce_block()
             state_transition(h.state, h.spec, signed, h._verify_strategy())
             chain.process_block(signed)
-            after = REGISTRY.counter("bls_verify_batches_tpu_total").value
+            after = REGISTRY.counter(
+                "bls_verify_batches_total").labels(backend="tpu").value
             assert after > before, "block import did not hit the tpu backend"
         finally:
             bls.set_backend(old)

@@ -1,0 +1,161 @@
+"""Labeled metrics: exposition format, instrumented hot paths, name lint."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+from lighthouse_tpu.common.metrics import REGISTRY, Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestLabeledExposition:
+    def test_counter_labels(self):
+        reg = Registry()
+        c = reg.counter("work_total", "work items")
+        c.labels(work_type="gossip_block").inc()
+        c.labels(work_type="rpc_block").inc(2)
+        text = reg.render()
+        assert "# HELP work_total work items" in text
+        assert "# TYPE work_total counter" in text
+        assert 'work_total{work_type="gossip_block"} 1.0' in text
+        assert 'work_total{work_type="rpc_block"} 2.0' in text
+        # unlabeled sample suppressed when the family is used via labels
+        assert "\nwork_total 0" not in text
+
+    def test_unlabeled_api_unchanged(self):
+        reg = Registry()
+        reg.counter("plain_total", "h").inc(3)
+        g = reg.gauge("depth", "h")
+        g.set(7)
+        text = reg.render()
+        assert "plain_total 3.0" in text
+        assert "depth 7.0" in text
+
+    def test_mixed_labeled_and_unlabeled_samples(self):
+        reg = Registry()
+        c = reg.counter("mixed_total", "h")
+        c.inc()
+        c.labels(kind="a").inc(2)
+        text = reg.render()
+        assert "mixed_total 1.0" in text
+        assert 'mixed_total{kind="a"} 2.0' in text
+        # one family header, not one per sample
+        assert text.count("# TYPE mixed_total counter") == 1
+
+    def test_same_labelset_returns_same_child(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "h")
+        a = h.labels(stage="h2d", backend="tpu")
+        b = h.labels(backend="tpu", stage="h2d")  # order-insensitive
+        assert a is b
+        a.observe(0.002)
+        assert b.n == 1
+
+    def test_histogram_label_exposition(self):
+        reg = Registry()
+        h = reg.histogram("dur_seconds", "h", buckets=(0.1, 1.0))
+        h.labels(stage="kernel").observe(0.05)
+        h.labels(stage="kernel").observe(5.0)
+        text = reg.render()
+        assert 'dur_seconds_bucket{stage="kernel",le="0.1"} 1' in text
+        assert 'dur_seconds_bucket{stage="kernel",le="+Inf"} 2' in text
+        assert 'dur_seconds_sum{stage="kernel"} 5.05' in text
+        assert 'dur_seconds_count{stage="kernel"} 2' in text
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        reg.counter("esc_total", "h").labels(v='a"b\\c\nd').inc()
+        assert r'esc_total{v="a\"b\\c\nd"} 1.0' in reg.render()
+
+    def test_gauge_labels(self):
+        reg = Registry()
+        g = reg.gauge("queue_depth", "h")
+        g.labels(queue="att").set(4)
+        g.labels(queue="att").dec()
+        assert 'queue_depth{queue="att"} 3.0' in reg.render()
+
+
+class TestInstrumentedPaths:
+    def test_beacon_processor_emits_labeled_queue_wait(self):
+        from lighthouse_tpu.processor import (
+            BeaconProcessor,
+            WorkEvent,
+            WorkType,
+        )
+
+        async def main():
+            bp = BeaconProcessor(max_workers=2, batch_flush_ms=5)
+            bp.submit(WorkEvent(WorkType.GOSSIP_BLOCK,
+                                process=lambda: None))
+            for _ in range(3):
+                bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION,
+                                    payload=1,
+                                    process_batch=lambda payloads: None))
+            await bp.start()
+            await bp.drain()
+            await bp.stop()
+
+        asyncio.run(main())
+        text = REGISTRY.render()
+        assert ('beacon_processor_queue_wait_seconds_bucket'
+                '{work_type="gossip_block",le="+Inf"}') in text
+        assert 'work_type="gossip_attestation"' in text
+        assert ('beacon_processor_batch_size_lanes_count'
+                '{work_type="gossip_attestation"}') in text
+        assert ('beacon_processor_events_total'
+                '{outcome="processed",work_type="gossip_block"}') in text
+
+    def test_bls_verify_path_emits_labeled_stage_timings(self):
+        from lighthouse_tpu.crypto import bls
+
+        sk = bls.SecretKey.from_bytes((7).to_bytes(32, "big"))
+        msg = b"\x05" * 32
+        s = bls.SignatureSet(sk.sign(msg), [sk.public_key()], msg)
+        assert bls.verify_signature_sets([s], backend="reference")
+        text = REGISTRY.render()
+        for stage in ("decompress", "accumulate", "pairing"):
+            assert (f'bls_verify_stage_seconds_count'
+                    f'{{backend="reference",stage="{stage}"}}') in text
+        assert 'bls_verify_batches_total{backend="reference"}' in text
+        assert ('bls_verify_sets_per_batch_count'
+                '{backend="reference"}') in text
+
+    def test_merkleize_emits_chunk_and_path_metrics(self):
+        from lighthouse_tpu.ops import sha256 as sha_ops
+
+        sha_ops.merkleize(os.urandom(32 * 64), limit=128)
+        text = REGISTRY.render()
+        assert 'sha256_merkle_chunks_total{path="level_loop"}' in text
+        assert 'sha256_merkleize_seconds_count{path="level_loop"}' in text
+
+
+def test_check_metrics_lint_passes():
+    """tools/check_metrics.py is part of tier-1: every in-tree metric
+    name must be literal, well-formed, single-kind and single-module."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metrics.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "ok" in proc.stdout
+
+
+def test_check_metrics_lint_catches_problems(tmp_path):
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        'REGISTRY.counter(f"dyn_{x}_total", "h")\n'
+        'REGISTRY.gauge("Bad-Name", "h")\n'
+        'REGISTRY.counter("twice_total", "h")\n'
+        'REGISTRY.histogram("twice_total", "h")\n')
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    regs, errors = check_metrics.collect(bad)
+    text = "\n".join(errors)
+    assert "dynamic metric name" in text
+    assert "invalid metric name 'Bad-Name'" in text
+    assert "multiple kinds" in text

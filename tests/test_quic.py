@@ -120,6 +120,26 @@ class TestRawStream:
 
         _run(main(), timeout=60)
 
+    def test_reorder_buffer_bounded(self):
+        """Segments at/beyond rcv_next + WINDOW_PACKETS are dropped, so a
+        pre-handshake peer cannot grow rcv_buf without bound; in-window
+        reordering still buffers and delivers."""
+        async def main():
+            lst = await quic.start_listener("127.0.0.1", 0, lambda r, w: None)
+            try:
+                _, w = await quic.open_connection("127.0.0.1", lst.port)
+                conn = next(iter(lst.endpoint.conns.values()))
+                for i in range(quic.WINDOW_PACKETS, quic.WINDOW_PACKETS + 64):
+                    conn.on_packet(quic.T_DATA, i, b"x")
+                assert not conn.rcv_buf  # far-future seqs all dropped
+                conn.on_packet(quic.T_DATA, 1, b"b")  # in-window gap buffers
+                assert 1 in conn.rcv_buf
+                w.close()
+            finally:
+                lst.close()
+
+        _run(main())
+
     def test_dial_nobody_times_out(self):
         import socket
 

@@ -186,3 +186,35 @@ def test_gateway_delete_port(igd):
     gw.add_port("UDP", 9002, "192.168.1.50", 9002)
     gw.delete_port("UDP", 9002)
     assert igd.deleted
+
+
+def test_discover_internal_ip_rejects_loopback(monkeypatch):
+    """The UDP-connect trick must yield a routable LAN address and never
+    hand a loopback/unspecified IP to AddPortMapping."""
+    import socket
+
+    ip = upnp.discover_internal_ip()
+    if ip is not None:  # host has a LAN-facing interface
+        import ipaddress
+
+        addr = ipaddress.ip_address(ip)
+        assert not addr.is_loopback and not addr.is_unspecified
+
+    class FakeSock:
+        def __init__(self, *a, **kw):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def connect(self, addr):
+            pass
+
+        def getsockname(self):
+            return ("127.0.0.1", 12345)
+
+    monkeypatch.setattr(socket, "socket", FakeSock)
+    assert upnp.discover_internal_ip() is None
