@@ -548,7 +548,54 @@ def _bench_block_verify() -> dict:
     # for committee attestations) — see ops/bls_backend module doc
     crossings = 6
     bulk_ms = max(p50 * 1000 - transition_ms, 0.0)
+
+    # --- chunked vs monolithic bulk verify (dispatch-pipeline PR): the
+    # same block, same host, chunking forced OFF then forced to split, so
+    # the BENCH JSON carries the overlap comparison even where the
+    # default chunk size would not engage (CPU-fallback set counts).
+    from lighthouse_tpu.ops import dispatch_pipeline as dp_mod
+
+    def _timed_bulk(chunk_env: str) -> float:
+        old = os.environ.get("LHTPU_BLS_CHUNK")
+        os.environ["LHTPU_BLS_CHUNK"] = chunk_env
+        try:
+            ts = []
+            for _ in range(2):
+                st2 = base.copy()
+                t_b = time.perf_counter()
+                process_block(st2, spec, signed,
+                              SignatureStrategy.VERIFY_BULK)
+                ts.append(time.perf_counter() - t_b)
+            return max(min(ts) * 1000 - transition_ms, 0.0)
+        finally:
+            if old is None:
+                os.environ.pop("LHTPU_BLS_CHUNK", None)
+            else:
+                os.environ["LHTPU_BLS_CHUNK"] = old
+
+    mono_ms = _timed_bulk("0")
+    # split at the largest power of two BELOW the set count: two chunks
+    # whose padded lane totals equal the monolithic program's, so the
+    # comparison isolates overlap + dispatch cost, not padding waste
+    split = 1 << (max(sets_pre - 1, 2).bit_length() - 1)
+    chunked_ms = _timed_bulk(str(split))
+    overlap_ms = dp_mod.LAST_BATCH["overlap_s"] * 1000.0
+    n_chunks = dp_mod.LAST_BATCH["chunks"]
+    _emit_partial({"block_bulk_verify_mono_ms": round(mono_ms, 1),
+                   "block_bulk_verify_chunked_ms": round(chunked_ms, 1),
+                   "pipeline_overlap_ms": round(overlap_ms, 2),
+                   "stage": "chunk_compare"})
     return {
+        "stages": {"block_verify": {
+            "bulk_mono_ms": round(mono_ms, 1),
+            "bulk_chunked_ms": round(chunked_ms, 1),
+            "pipeline_overlap_ms": round(overlap_ms, 2),
+            "pipeline_chunks": n_chunks,
+            "chunk_sets": split,
+        }},
+        "block_bulk_verify_mono_ms": round(mono_ms, 1),
+        "block_bulk_verify_chunked_ms": round(chunked_ms, 1),
+        "pipeline_overlap_ms": round(overlap_ms, 2),
         "block_verify_p50_ms": round(p50 * 1000, 1),
         "block_verify_runs": n_iters,
         "block_atts": len(atts),
@@ -618,17 +665,24 @@ def _bench_merkleize() -> dict:
     dev_sample = np.asarray(sha_ops.hash_pairs_device(jnp.asarray(sample)))
     assert np.array_equal(out, dev_sample), "device/host SHA-256 mismatch"
 
+    # startup micro-calibration: the routing threshold a node on THIS
+    # host would pick (merkle_vs_host < 1 on XLA-CPU means the static
+    # TPU-tuned thresholds mis-route mid-sized trees)
+    calib = sha_ops.calibrate_device_thresholds(force=True)
+
     return {
         "metric": "sha256_merkleize_1M_leaf_fold",
         "value": round(device_rate / 1e6, 4),
         "unit": "Mhash/s",
         "vs_baseline": round(device_rate / host_rate, 3),
         "platform": platform,
+        "sha_device_threshold_pairs": calib.get("threshold_pairs"),
         # compile = first whole-fold dispatch at this shape (XLA compile
         # or persistent-cache load); execute = steady-state per-fold time
         "stages": {"merkleize": {
             "compile_ms": round(compile_s * 1000, 1),
             "execute_ms": round(dt_device * 1000, 1),
+            "device_threshold_pairs": calib.get("threshold_pairs"),
         }},
     }
 

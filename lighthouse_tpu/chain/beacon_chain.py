@@ -619,53 +619,85 @@ class BeaconChain:
         (reference batch_verify_unaggregated_attestations,
         beacon_chain.rs:1961 + batch.rs:133).  Returns
         (verified, rejects) — verified items are already applied to fork
-        choice."""
-        with self._import_lock:
-            return self._verify_attestations_locked(attestations)
+        choice.
 
-    def _verify_attestations_locked(self, attestations: list):
-        verified, rejects = self._batch_pipeline(
-            attestations, att_verify.verify_unaggregated_for_gossip)
-        for v in verified:
-            # feed the naive aggregation pool; its aggregates in turn feed
-            # block packing via the operation pool
+        Locking contract (dispatch-pipeline PR): the import lock is held
+        only for the prepare phase (state/cache reads) and the commit
+        phase (dup-cache marks, fork choice, pools).  The BLS batch
+        verification — seconds of device work for a full sweep — runs
+        UNLOCKED, so block imports and head updates proceed while the
+        device grinds; cross-batch duplicates are still caught because
+        observation marks are claimed atomically under the commit lock."""
+
+        def insert(v):
+            # feed the naive aggregation pool; its aggregates in turn
+            # feed block packing via the operation pool
             self.naive_pool.insert(v.attestation)
             self.validator_monitor.on_gossip_attestation(
                 v.indexed_indices, v.attestation.data, self.spec)
-        return verified, rejects
+
+        return self._batch_pipeline(
+            attestations, att_verify.verify_unaggregated_for_gossip,
+            on_verified=insert)
 
     def verify_aggregates_for_gossip(self, aggregates: list):
         """Batch-verify SignedAggregateAndProofs (3 sets each,
-        batch.rs:62-102)."""
-        verified, rejects = self._batch_pipeline(
-            aggregates, att_verify.verify_aggregated_for_gossip)
-        for v in verified:
+        batch.rs:62-102).  Same locking contract as
+        verify_attestations_for_gossip: BLS runs outside the import lock."""
+        from lighthouse_tpu.state_transition.misc import (
+            attestation_committee_index,
+        )
+
+        def insert(v):
             att = v.attestation
             self.validator_monitor.on_gossip_aggregate(
                 int(v.item.message.aggregator_index), att.data, self.spec)
-            from lighthouse_tpu.state_transition.misc import (
-                attestation_committee_index,
-            )
-
             self.op_pool.insert_attestation(
                 att.data, np.asarray(att.aggregation_bits, bool),
                 bytes(att.signature),
                 committee_index=attestation_committee_index(att))
-        return verified, rejects
 
-    def _batch_pipeline(self, items, verify_fn):
-        candidates, rejects = [], []
-        for item in items:
-            state = self._attestation_state(item)
-            try:
-                candidates.append(verify_fn(self, item, state))
-            except att_verify.AttestationError as e:
-                rejects.append((item, e.reason))
+        return self._batch_pipeline(
+            aggregates, att_verify.verify_aggregated_for_gossip,
+            on_verified=insert)
+
+    def _batch_pipeline(self, items, verify_fn, on_verified=None):
+        candidates, rejects = self._prepare_batch(items, verify_fn)
+        # signature verification OUTSIDE the import lock: pure crypto
+        # over already-extracted sets, no chain state touched
         if self.verify_signatures:
             att_verify.batch_verify(self, candidates)
         else:
             for c in candidates:
                 c.ok = True
+        with self._import_lock:
+            verified = self._commit_batch(candidates, rejects)
+            # pool/monitor inserts ride the SAME lock hold as the commit:
+            # a finalization pruning the pools must not interleave between
+            # a batch's fork-choice commit and its pool inserts
+            if on_verified is not None:
+                for v in verified:
+                    on_verified(v)
+        return verified, rejects
+
+    def _prepare_batch(self, items, verify_fn):
+        """Gossip checks + signature-set extraction, under the import
+        lock (reads states, shuffles and dup caches)."""
+        candidates, rejects = [], []
+        with self._import_lock:
+            for item in items:
+                state = self._attestation_state(item)
+                try:
+                    candidates.append(verify_fn(self, item, state))
+                except att_verify.AttestationError as e:
+                    rejects.append((item, e.reason))
+        return candidates, rejects
+
+    def _commit_batch(self, candidates, rejects):
+        """Claim dup-cache marks and apply survivors to fork choice /
+        slasher.  Caller holds the import lock: observation marks are
+        claimed atomically here, so batches whose BLS ran concurrently
+        (unlocked) still reject cross-batch duplicates."""
         verified = []
         for c in candidates:
             if not c.ok:
@@ -686,7 +718,7 @@ class BeaconChain:
                     int(c.attestation.data.slot))
             except Exception:
                 pass
-        return verified, rejects
+        return verified
 
     # -- sync-committee pipelines -------------------------------------------
 

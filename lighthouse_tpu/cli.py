@@ -186,6 +186,18 @@ def _build_parser() -> argparse.ArgumentParser:
 def _run_bn(args) -> int:
     from lighthouse_tpu.client.builder import ClientBuilder, ClientConfig
 
+    # one-shot routing calibration: measure host-vs-device pair-hash
+    # rates and pick the merkle device thresholds for THIS host (the
+    # static defaults assume a real TPU; an XLA-CPU fallback node would
+    # route mid-sized trees to the slower path).  LHTPU_SHA_DEVICE_MIN
+    # pins the threshold and skips the measurement.
+    try:
+        from lighthouse_tpu.ops import sha256 as _sha_ops
+
+        _sha_ops.calibrate_device_thresholds()
+    except Exception:
+        pass  # never block node startup on a calibration failure
+
     cfg = ClientConfig(
         network=args.network,
         network_config_path=args.network_config,

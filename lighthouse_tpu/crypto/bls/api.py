@@ -382,8 +382,11 @@ def record_stage(backend: str, stage: str, seconds: float) -> None:
         pass  # metrics must never take down a verifier
 
 
-def _verify_signature_sets_reference(sets: Sequence[SignatureSet]) -> bool:
-    """Randomized batch verification (one multi-pairing for the batch)."""
+def _verify_signature_sets_reference(sets: Sequence[SignatureSet],
+                                     chunk_size: int | None = None) -> bool:
+    """Randomized batch verification (one multi-pairing for the batch).
+    ``chunk_size`` is accepted for seam compatibility and ignored: the
+    host path has no device to overlap with."""
     if not sets:
         return False
     t0 = time.perf_counter()
@@ -419,7 +422,8 @@ def _verify_signature_sets_reference(sets: Sequence[SignatureSet]) -> bool:
     return ok
 
 
-def _verify_signature_sets_fake(sets: Sequence[SignatureSet]) -> bool:
+def _verify_signature_sets_fake(sets: Sequence[SignatureSet],
+                                chunk_size: int | None = None) -> bool:
     """Structure checks only; all well-formed signatures verify (reference
     fake_crypto backend, crypto/bls/src/impls/fake_crypto.rs)."""
     if not sets:
@@ -491,18 +495,28 @@ def resolve_auto_backend() -> str:
 
 
 def verify_signature_sets(
-    sets: Sequence[SignatureSet], *, backend: str | None = None
+    sets: Sequence[SignatureSet], *, backend: str | None = None,
+    chunk_size: int | None = None
 ) -> bool:
     """THE seam: batch-verify many signature sets on the active backend.
 
     Callers (block signature verifier, attestation batches) accumulate sets
     and call this once — mirroring the reference call site
     state_processing/src/per_block_processing/block_signature_verifier.rs:396.
+
+    ``chunk_size`` tunes the overlapped dispatch pipeline (see
+    ops/dispatch_pipeline): batches above it split into fixed
+    power-of-two chunks whose host prep overlaps device execution.  None
+    defers to LHTPU_BLS_CHUNK / the pipeline default; 0 forces the
+    monolithic single-dispatch path.  It is only forwarded when set, so
+    custom-registered backends with a bare ``fn(sets)`` signature keep
+    working.
     """
     name = backend or _active_backend
     if name == "auto":
         name = resolve_auto_backend()
     fn = _resolve_backend(name)
+    kwargs = {} if chunk_size is None else {"chunk_size": chunk_size}
     record_batch(name, len(sets))
     try:
         from lighthouse_tpu.common.metrics import REGISTRY
@@ -519,4 +533,4 @@ def verify_signature_sets(
 
     with tracing.span("bls.verify", backend=name, sets=len(sets)):
         with timer:
-            return fn(sets)
+            return fn(sets, **kwargs)

@@ -48,6 +48,10 @@ IWANT_SERVE_BUDGET = 1000     # full messages served per peer per heartbeat
 IWANT_RETRANSMIT = 3          # times one message is re-served to one peer
 PRUNE_BACKOFF_S = 60.0
 PX_PEERS = 16                 # peer-exchange sample attached to PRUNE
+# minimum sender score before px records are DIALED: strictly positive,
+# so the pruner must have delivered scored-valid traffic first — a fresh
+# (score 0) or negative peer cannot steer our outbound dials
+PX_DIAL_SCORE = 1.0
 GOSSIP_FACTOR = 0.25          # adaptive IHAVE fanout share of non-mesh
 # opportunistic grafting (behaviour.rs:2305): when the mesh's median
 # score stagnates below the threshold, graft a couple of better-scored
@@ -263,12 +267,15 @@ class GossipsubEngine:
             ts.mesh_since = None
         self.backoff[(peer, topic)] = self.clock() + PRUNE_BACKOFF_S
 
-    def accept_px(self, peer: str) -> bool:
+    def accept_px(self, peer: str, threshold: float = 0.0) -> bool:
         """Peer-exchange records are only honoured from peers whose score
-        is non-negative (behaviour.rs: px processing gated on the prune
-        sender's score) — a negative-scored peer steering us toward its
-        accomplices is the eclipse entry-point."""
-        return self.score(peer) >= 0.0
+        clears ``threshold`` (behaviour.rs: px processing gated on the
+        prune sender's score) — a peer steering us toward its accomplices
+        is the eclipse entry-point.  The transport dials px targets only
+        above PX_DIAL_SCORE (strictly positive): every FRESH peer scores
+        exactly 0, so a zero threshold would let any just-connected
+        stranger direct our dials."""
+        return self.score(peer) >= threshold
 
     def px_for_prune(self, topic: str, exclude: str) -> list[str]:
         """Up to PX_PEERS well-scored topic peers to attach to a PRUNE

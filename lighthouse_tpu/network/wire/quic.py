@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import secrets
 import struct
+from collections import deque
 
 MAGIC = 0xD7
 T_INIT = 1       # open: payload empty; cid chosen by the dialer
@@ -67,6 +68,10 @@ class _QuicConn:
         # send side
         self.next_seq = 0
         self.unacked: dict[int, list] = {}   # seq -> [bytes, deadline, tries]
+        # pacing queue: chunks with assigned seqs NOT yet transmitted —
+        # released into the wire window as ACKs free slots, so a
+        # multi-MB write can never burst thousands of datagrams
+        self.pending: "deque[tuple[int, bytes, int]]" = deque()
         self.window_free = asyncio.Event()
         self.window_free.set()
         self.fin_sent = False
@@ -83,15 +88,34 @@ class _QuicConn:
         self.proto.sendto(_pack(ptype, self.cid, seq, payload), self.addr)
 
     def send_segmented(self, data: bytes) -> None:
+        """Segment + transmit, paced to the window: at most WINDOW_PACKETS
+        in flight; excess chunks queue unsent and are released by ACKs
+        (on_packet -> _release_window).  A big write therefore never
+        bursts past the window, and retransmits under loss cannot amplify
+        an already-oversized flight."""
         for off in range(0, len(data), MAX_PAYLOAD):
             chunk = data[off:off + MAX_PAYLOAD]
             seq = self.next_seq
             self.next_seq += 1
-            self.unacked[seq] = [
-                chunk, asyncio.get_event_loop().time() + RTO_S, 0, T_DATA]
-            self._transmit(T_DATA, seq, chunk)
-        if len(self.unacked) >= WINDOW_PACKETS:
+            if self.pending or len(self.unacked) >= WINDOW_PACKETS:
+                self.pending.append((seq, chunk, T_DATA))
+            else:
+                self.unacked[seq] = [
+                    chunk, asyncio.get_event_loop().time() + RTO_S, 0,
+                    T_DATA]
+                self._transmit(T_DATA, seq, chunk)
+        if self.pending or len(self.unacked) >= WINDOW_PACKETS:
             self.window_free.clear()
+
+    def _release_window(self) -> None:
+        """Move queued chunks into freed window slots (ACK-clocked)."""
+        now = asyncio.get_event_loop().time()
+        while self.pending and len(self.unacked) < WINDOW_PACKETS:
+            seq, chunk, ptype = self.pending.popleft()
+            self.unacked[seq] = [chunk, now + RTO_S, 0, ptype]
+            self._transmit(ptype, seq, chunk)
+        if not self.pending and len(self.unacked) < WINDOW_PACKETS:
+            self.window_free.set()
 
     def send_fin(self) -> None:
         if self.fin_sent or self.closed:
@@ -99,6 +123,13 @@ class _QuicConn:
         self.fin_sent = True
         seq = self.next_seq
         self.next_seq += 1
+        if self.pending or len(self.unacked) >= WINDOW_PACKETS:
+            # FIN rides the pacing queue behind the unsent data; it must
+            # also queue at an exactly-full window — transmitted there it
+            # would land at rcv_next + WINDOW and the receiver's reorder
+            # bound would silently drop it (an RTO-stalled close)
+            self.pending.append((seq, b"", T_FIN))
+            return
         self.unacked[seq] = [
             b"", asyncio.get_event_loop().time() + RTO_S, 0, T_FIN]
         self._transmit(T_FIN, seq, b"")
@@ -128,9 +159,8 @@ class _QuicConn:
         if ptype == T_ACK:
             for s in [s for s in self.unacked if s < seq]:
                 del self.unacked[s]
-            if len(self.unacked) < WINDOW_PACKETS:
-                self.window_free.set()
-            if self.fin_sent and not self.unacked:
+            self._release_window()
+            if self.fin_sent and not self.unacked and not self.pending:
                 self._finish_close()
             return
         if ptype == T_RST:
@@ -200,7 +230,7 @@ class _Writer:
 
     async def drain(self) -> None:
         await self._conn.window_free.wait()
-        if self._conn.closed and self._conn.unacked:
+        if self._conn.closed and (self._conn.unacked or self._conn.pending):
             raise QuicError("quic connection lost")
 
     def close(self) -> None:

@@ -62,9 +62,17 @@ K_RPC_END = 0x07
 K_RPC_ERR = 0x08
 K_GOODBYE = 0x09
 K_GRAFT = 0x0A
-K_PRUNE = 0x0B
+K_PRUNE = 0x0B       # compat PRUNE: topic honoured, px tail IGNORED
 K_IHAVE = 0x0C
 K_IWANT = 0x0D
+# PRUNE with peer exchange, under its OWN wire identifier (same
+# length-prefixed topic + JSON px body as late K_PRUNE frames).  The
+# px-bearing format needs a distinct kind so a peer's capability is
+# explicit: px records are only ever DIALED when they arrive under
+# K_PRUNE_PX, while compat K_PRUNE frames still prune the topic but
+# their px tail is dropped — an un-bumped (or downgrade-spoofing) peer
+# cannot steer dials.  Old nodes ignore the unknown kind entirely.
+K_PRUNE_PX = 0x0E
 
 MSG_ID_LEN = 20          # gossip.message_id output width
 
@@ -542,13 +550,26 @@ class WireNode:
                 await self._send_frame(
                     conn, self._prune_frame(topic, conn.peer_id))
         elif kind == K_PRUNE:
+            # compat PRUNE: prior versions sent length-prefixed topic +
+            # px JSON under THIS kind, so parse the same layout — but
+            # the px tail is deliberately IGNORED here (dialing
+            # attacker-supplied addresses from the un-bumped frame is
+            # the hole the K_PRUNE_PX identifier closes)
+            try:
+                topic, _ = _unpack_str(body, 0)
+            except (struct.error, UnicodeDecodeError):
+                return
+            self._gs.handle_prune(conn.peer_id, topic)
+        elif kind == K_PRUNE_PX:
             topic, off = _unpack_str(body, 0)
             self._gs.handle_prune(conn.peer_id, topic)
             # peer exchange (behaviour.rs px handling): re-mesh through
-            # the pruner's candidates — only from non-negative-scored
-            # peers, capacity- and count-gated against eclipse steering
+            # the pruner's candidates — only from POSITIVELY-scored peers
+            # (a fresh peer scores 0 and must not steer our dials),
+            # capacity-, count- and address-gated against eclipse steering
             rest = body[off:]
-            if rest and self._gs.accept_px(conn.peer_id):
+            if rest and self._gs.accept_px(conn.peer_id,
+                                           gossipsub.PX_DIAL_SCORE):
                 try:
                     px = json.loads(rest.decode())
                 except (ValueError, UnicodeDecodeError):
@@ -560,10 +581,12 @@ class WireNode:
                     if dialed >= 2:
                         break
                     try:
-                        pid, host, port = ent[0], ent[1], int(ent[2])
+                        pid, host, port = ent[0], str(ent[1]), int(ent[2])
                     except (TypeError, ValueError, IndexError):
                         continue
                     if pid == self.peer_id or pid in self._conns:
+                        continue
+                    if not self._px_target_allowed(host, port):
                         continue
                     dialed += 1
                     asyncio.ensure_future(self._dial_quiet(host, port))
@@ -720,13 +743,55 @@ class WireNode:
 
     def _prune_frame(self, topic: str, pruned_peer: str) -> bytes:
         """PRUNE with peer exchange: attach (id, host, port) records of
-        well-scored topic peers so the pruned side can re-mesh."""
+        well-scored topic peers so the pruned side can re-mesh.  Sent
+        under K_PRUNE_PX, the length-prefixed format's own identifier
+        (K_PRUNE stays the legacy raw-topic frame)."""
         px = []
         for pid in self._gs.px_for_prune(topic, exclude=pruned_peer):
             c = self._conns.get(pid)
             if c is not None and c.alive and c.addr is not None:
                 px.append([pid, c.addr[0], c.addr[1]])
-        return bytes([K_PRUNE]) + _pack_str(topic) + json.dumps(px).encode()
+        return bytes([K_PRUNE_PX]) + _pack_str(topic) + json.dumps(px).encode()
+
+    @staticmethod
+    def _is_loopback(host: str) -> bool | None:
+        """True/False for a parseable target, None = unparseable/refuse.
+        Numeric forms only (px records carry socket addresses), plus the
+        literal \"localhost\"; ipaddress handles IPv4-mapped IPv6 and
+        rejects exotic spellings (decimal/hex ints) that getaddrinfo
+        would quietly resolve to 127.0.0.1."""
+        import ipaddress
+
+        if host == "localhost":
+            return True
+        try:
+            ip = ipaddress.ip_address(host)
+        except ValueError:
+            return None
+        mapped = getattr(ip, "ipv4_mapped", None)
+        if mapped is not None:
+            ip = mapped
+        if ip.is_unspecified:
+            return None       # 0.0.0.0 / :: connect to localhost
+        return ip.is_loopback
+
+    def _px_target_allowed(self, host: str, port: int) -> bool:
+        """Address sanity for peer-exchange dials: refuse our own listen
+        address (self-dial loops), anything that is not a plain numeric
+        address, and loopback targets from a node that is itself
+        non-loopback (an external peer has no business pointing us at
+        127.0.0.1 — a classic rebind/steering primitive).  Local test
+        deployments where WE listen on loopback keep working."""
+        if not 0 < port < 65536:
+            return False
+        loopback = self._is_loopback(host)
+        if loopback is None:
+            return False
+        if host == self.listen_host and port == self.listen_port:
+            return False
+        if loopback and self._is_loopback(self.listen_host) is not True:
+            return False
+        return True
 
     async def _dial_quiet(self, host: str, port: int):
         try:

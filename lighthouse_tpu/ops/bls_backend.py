@@ -159,21 +159,26 @@ def _g2_subgroup_kernel(xqa, xqb, yqa, yqb):
     return ec.g2_subgroup_verdict_batch(xqa, xqb, yqa, yqb)
 
 
-def batch_subgroup_check_g2(points) -> np.ndarray:
-    """Device ψ membership test over a list of affine G2 points.
+def _dispatch_g2_subgroup_kernel(points):
+    """Dispatch (no host sync) the batched ψ verdict kernel over affine
+    G2 points, generator-padded to a power of two (floor 4) so small
+    batches share compiled shapes.  Returns the device bool row; callers
+    read [:len(points)] when they sync.  The verdict is computed on
+    device (ec.g2_subgroup_verdict_batch) — one bool-row fetch, not six
+    limb rows at ~80 ms of relay latency each."""
+    padded = _next_pow2(len(points), floor=4)
+    pts = list(points) + [cv.g2_generator()] * (padded - len(points))
+    xqa, xqb, yqa, yqb = (jnp.asarray(a) for a in _g2_limbs(pts))
+    return _g2_subgroup_kernel(xqa, xqb, yqa, yqb)
 
-    Returns bool[n].  Lanes are padded to a power of two (floor 4) with
-    the generator so small batches share compiled shapes.  The verdict is
-    computed on device (ec.g2_subgroup_verdict_batch) — one bool-row
-    fetch, not six limb rows at ~80 ms of relay latency each."""
+
+def batch_subgroup_check_g2(points) -> np.ndarray:
+    """Device ψ membership test over a list of affine G2 points ->
+    bool[n] (synchronous; see _dispatch_g2_subgroup_kernel)."""
     n = len(points)
     if n == 0:
         return np.zeros(0, bool)
-    padded = _next_pow2(n, floor=4)
-    pts = list(points) + [cv.g2_generator()] * (padded - n)
-    xqa, xqb, yqa, yqb = (jnp.asarray(a) for a in _g2_limbs(pts))
-    ok = np.asarray(_g2_subgroup_kernel(xqa, xqb, yqa, yqb))
-    return ok[:n]
+    return np.asarray(_dispatch_g2_subgroup_kernel(points))[:n]
 
 
 @jax.jit
@@ -318,24 +323,40 @@ def batch_subgroup_check_g1(points) -> np.ndarray:
     return ok[:n]
 
 
-def _ensure_subgroup_checked(sigs) -> bool:
-    """Batch-check any signatures whose G2 membership is still pending.
-    Returns False if any fails (callers bisect to attribute)."""
+def _dispatch_subgroup_check(sigs):
+    """Dispatch the batched ψ verdict kernel WITHOUT a host sync.
+
+    Returns an AsyncVerdict whose commit() reads the bool row (and marks
+    the signatures checked on a pass), or None when a pending signature
+    decompressed to infinity (the batch can never verify).  The host
+    keeps running aggregate/limb prep while the kernel executes."""
+    from lighthouse_tpu.ops import dispatch_pipeline as dp
+
     pending = [s for s in sigs if not s.subgroup_checked()]
     if not pending:
-        return True
+        return dp.AsyncVerdict.immediate(True)
     pts = []
     for s in pending:
         pt = s.point_unchecked()
         if pt is cv.INF:
-            return False
+            return None
         pts.append(pt)
-    ok = batch_subgroup_check_g2(pts)
-    if not bool(ok.all()):
-        return False
-    for s in pending:
-        s.mark_subgroup_checked()
-    return True
+    dev_ok = _dispatch_g2_subgroup_kernel(pts)
+
+    def mark():
+        for s in pending:
+            s.mark_subgroup_checked()
+
+    return dp.AsyncVerdict(dev_ok, len(pts), on_pass=mark)
+
+
+def _ensure_subgroup_checked(sigs) -> bool:
+    """Batch-check any signatures whose G2 membership is still pending,
+    synchronously.  Returns False if any fails (callers bisect to
+    attribute).  The pipeline uses the async form above; this wrapper
+    remains for callers that need the verdict immediately."""
+    verdict = _dispatch_subgroup_check(sigs)
+    return verdict is not None and verdict.commit()
 
 
 def _g2_limbs(points) -> list[np.ndarray]:
@@ -404,8 +425,20 @@ def _final_exp_is_one(f_host) -> bool:
 
 
 def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
-                         ledger: dict | None = None) -> bool:
+                         ledger: dict | None = None,
+                         chunk_size: int | None = None) -> bool:
     """Batch verification with the scalar work on device (see module doc).
+
+    Batches larger than the chunk size (``chunk_size`` arg >
+    LHTPU_BLS_CHUNK env > dispatch_pipeline.DEFAULT_CHUNK_SETS; 0
+    disables) run the OVERLAPPED path: fixed power-of-two chunks are
+    dispatched back-to-back, host limb prep for chunk k+1 runs while
+    chunk k's fused kernel executes, per-chunk Fq12 partials multiply
+    down on device, and the batch still pays ONE d2h fetch and ONE final
+    exponentiation.  The ψ subgroup kernel is dispatched without a host
+    sync and its verdict row is only read at the commit point.  Chunked
+    and single-shot verdicts are identical by construction (the combined
+    check is multiplicative over chunks).
 
     With ``ledger`` given, per-stage wall times (seconds) are recorded under
     keys subgroup / aggregate / prep_host / limbs / pipeline / final_exp —
@@ -418,12 +451,15 @@ def verify_sets_pipeline(sets: Sequence[api.SignatureSet],
 
     with tracing.span("bls.verify_pipeline", sets=len(sets),
                       profiled=ledger is not None):
-        return _verify_sets_pipeline(sets, ledger)
+        return _verify_sets_pipeline(sets, ledger, chunk_size)
 
 
 def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
-                          ledger: dict | None = None) -> bool:
+                          ledger: dict | None = None,
+                          chunk_size: int | None = None) -> bool:
     import time as _time
+
+    from lighthouse_tpu.ops import dispatch_pipeline as dp
 
     cache_guard.install()   # mmap headroom before any XLA compile
 
@@ -436,6 +472,8 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
 
     t0 = _time.perf_counter()
     n = len(sets)
+    if n == 0:
+        return False
     # one native batch call decompresses every fresh signature (vs one
     # ctypes crossing + C++ setup per signature)
     if not api.Signature.decompress_batch([s.signature for s in sets]):
@@ -454,9 +492,16 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         sig_pts.append(sig_pt)
         h2cs.append(_hash_to_g2_cached(s.message))
 
-    # G2 membership for fresh signatures: one batched device ψ test
-    # instead of a per-signature host scalar mul
-    if not _ensure_subgroup_checked([s.signature for s in sets]):
+    # G2 membership for fresh signatures: one batched device ψ kernel,
+    # DISPATCHED here but not synced — the verdict row is read at the
+    # commit point below, after the Miller chunks are in flight, so the
+    # aggregate/limb host work runs concurrently with the membership
+    # test.  Profiled (ledger) runs commit immediately: the ledger's
+    # whole point is serialized per-stage attribution.
+    verdict = _dispatch_subgroup_check([s.signature for s in sets])
+    if verdict is None:
+        return False
+    if ledger is not None and not verdict.commit():
         return False
     t0 = _mark("subgroup", t0)
 
@@ -488,11 +533,66 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
         scalars.append(r)
     t0 = _mark("prep_host", t0)
 
-    # --- message grouping (the TPU-shaped fold): sets sharing a message
-    # satisfy Π e(r_i·pk_i, H(m)) = e(Σ r_i·pk_i, H(m)), so the expensive
-    # Miller lanes shrink from n sets to G distinct messages.  Lanes are
-    # laid out s-major over (segment, group) for g1_segment_sum; padding
-    # lanes carry zero scalars (infinity = group identity).
+    # --- chunked double-buffered dispatch: each chunk's host layout runs
+    # while the previous chunk's fused kernel is in flight (async JAX
+    # dispatch); per-chunk Fq12 partials multiply down on device and the
+    # batch pays ONE fetch + ONE final exponentiation.  A single chunk
+    # (the default for node-sized batches) is exactly the old
+    # single-shot path.
+    chunks = dp.plan_chunks(n, dp.chunk_size(chunk_size))
+    partials = []
+    limbs_s = 0.0
+    pipeline_s = 0.0
+    overlap_s = 0.0
+    inflight = False
+    for lo, hi in chunks:
+        tc = _time.perf_counter()
+        args = _chunk_layout(sets[lo:hi], sig_pts[lo:hi], h2cs[lo:hi],
+                             pk_rows_x[lo:hi], pk_rows_y[lo:hi],
+                             scalars[lo:hi])
+        td = _time.perf_counter()
+        limbs_s += td - tc
+        f = _pipeline_fused(*args)
+        if ledger is not None:
+            jax.block_until_ready(f)
+        now = _time.perf_counter()
+        pipeline_s += now - td
+        if inflight and ledger is None:
+            # host work done while a dispatched chunk was executing —
+            # meaningless on the profiled path, whose per-chunk sync
+            # serializes everything
+            overlap_s += now - tc
+        inflight = True
+        partials.append(f)
+    if ledger is not None:
+        ledger["limbs"] = ledger.get("limbs", 0.0) + limbs_s
+        ledger["pipeline"] = ledger.get("pipeline", 0.0) + pipeline_s
+    api.record_stage("tpu", "limbs", limbs_s)
+    api.record_stage("tpu", "pipeline", pipeline_s)
+    dp.record_pipeline(len(chunks), overlap_s, n)
+    t0 = _time.perf_counter()
+
+    # commit point: the subgroup verdict row is read only now, with the
+    # Miller chunks already in flight behind it in the device queue
+    if not verdict.commit():
+        return False
+    f = dp.combine_partials(partials)
+    f_host = fq12_from_device(jax.device_get(f))
+    ok = _final_exp_is_one(f_host)
+    _mark("final_exp", t0)
+    return ok
+
+
+def _chunk_layout(sets, sig_pts, h2cs, pk_rows_x, pk_rows_y, scalars):
+    """Host-side lane layout for ONE chunk -> _pipeline_fused argument
+    tuple (uploads + static group count).
+
+    Message grouping (the TPU-shaped fold): sets sharing a message
+    satisfy Π e(r_i·pk_i, H(m)) = e(Σ r_i·pk_i, H(m)), so the expensive
+    Miller lanes shrink from n sets to G distinct messages.  Lanes are
+    laid out s-major over (segment, group) for g1_segment_sum; padding
+    lanes carry zero scalars (infinity = group identity)."""
+    n = len(sets)
     groups: dict[bytes, list[int]] = {}
     for i, s in enumerate(sets):
         groups.setdefault(s.message, []).append(i)
@@ -550,27 +650,18 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     lane_mask = np.zeros(padded, bool)
     lane_mask[:n_real_lanes] = True
     g1x, g1y = _g1_neg_limbs()
-    t0 = _mark("limbs", t0)
-
-    f = _pipeline_fused(
-        jnp.asarray(pkx), jnp.asarray(pky),
-        *[jnp.asarray(a) for a in sg],
-        *[jnp.asarray(a) for a in h2],
-        bits, jnp.asarray(lane_mask),
-        jnp.asarray(g1x), jnp.asarray(g1y), n_seg_static)
-    if ledger is not None:
-        jax.block_until_ready(f)
-    t0 = _mark("pipeline", t0)
-    f_host = fq12_from_device(jax.device_get(f))
-    ok = _final_exp_is_one(f_host)
-    _mark("final_exp", t0)
-    return ok
+    return (jnp.asarray(pkx), jnp.asarray(pky),
+            *[jnp.asarray(a) for a in sg],
+            *[jnp.asarray(a) for a in h2],
+            bits, jnp.asarray(lane_mask),
+            jnp.asarray(g1x), jnp.asarray(g1y), n_seg_static)
 
 
-def verify_signature_sets_device(sets: Sequence[api.SignatureSet]) -> bool:
+def verify_signature_sets_device(sets: Sequence[api.SignatureSet],
+                                 chunk_size: int | None = None) -> bool:
     if not sets:
         return False
-    return verify_sets_pipeline(sets)
+    return verify_sets_pipeline(sets, chunk_size=chunk_size)
 
 
 api.register_backend("tpu", verify_signature_sets_device)

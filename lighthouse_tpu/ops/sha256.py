@@ -304,7 +304,11 @@ ZERO_HASH_WORDS: np.ndarray = np.stack(
 # Below this many pairs a device dispatch costs more than hashlib (measured:
 # XLA-CPU ≈ hashlib ≈ 0.55 Mhash/s, but per-call dispatch ~100µs; small tree
 # levels are pure overhead).  Also bounds the jit compile cache to the few
-# large power-of-two shapes.
+# large power-of-two shapes.  These STATIC defaults assume a real TPU;
+# calibrate_device_thresholds (run once at node startup / bench setup)
+# replaces them with measured values — on an XLA-CPU fallback host the
+# device path is SLOWER than hashlib+SHA-NI (BENCH merkle_vs_host ≈ 0.29),
+# so the static numbers mis-route mid-sized trees to the slow path.
 _DEVICE_MIN_PAIRS = 2048
 
 
@@ -334,6 +338,106 @@ def _hash_level(pairs: np.ndarray, *, device: bool | None = None) -> np.ndarray:
 _DEVICE_FOLD_MIN_LEAVES = 1 << 12
 _fold_to_root_jit = jax.jit(
     lambda leaves: fold_to_root_device(leaves))
+
+# --- startup micro-calibration ---------------------------------------------
+
+_CALIBRATED = False
+_THRESHOLD_CEIL = 1 << 22     # "device never wins here": route all to host
+
+
+def _measure_rate(fn, pairs, min_s: float = 0.02) -> float:
+    """pairs hashed per second, repeating until min_s of wall time."""
+    n = pairs.shape[0]
+    done = 0
+    t0 = time.perf_counter()
+    while True:
+        fn(pairs)
+        done += n
+        dt = time.perf_counter() - t0
+        if dt >= min_s:
+            return done / max(dt, 1e-9)
+
+
+def calibrate_device_thresholds(sample_pairs: int = 2048,
+                                force: bool = False) -> dict:
+    """One-shot startup micro-calibration of the device-vs-host routing.
+
+    Measures the host pair-hash rate (SHA-NI/hashlib) and the device
+    rate + per-dispatch overhead on a small power-of-two sample, then
+    solves the break-even pair count  n* = overhead / (1/host - 1/device)
+    — below n* a device dispatch loses even if its asymptotic rate wins.
+    Sets _DEVICE_MIN_PAIRS (rounded up to a power of two, floored at the
+    static default's scale) and _DEVICE_FOLD_MIN_LEAVES (= 2·pairs
+    threshold), publishes the choice as the
+    ``sha256_device_threshold_pairs`` gauge, and returns the measurements.
+
+    ``LHTPU_SHA_DEVICE_MIN`` overrides measurement entirely (operator
+    pin, also the escape hatch when calibration itself is unwanted).
+    Runs once per process unless ``force``; callers that monkeypatch
+    _DEVICE_MIN_PAIRS directly (tests) are unaffected because nothing
+    here runs implicitly on the hash path."""
+    global _DEVICE_MIN_PAIRS, _DEVICE_FOLD_MIN_LEAVES, _CALIBRATED
+    import os
+
+    if _CALIBRATED and not force:
+        return {"threshold_pairs": _DEVICE_MIN_PAIRS, "cached": True}
+    _CALIBRATED = True
+    env = os.environ.get("LHTPU_SHA_DEVICE_MIN")
+    if env:
+        try:
+            _DEVICE_MIN_PAIRS = max(1, int(env))
+            _DEVICE_FOLD_MIN_LEAVES = 2 * _DEVICE_MIN_PAIRS
+            _publish_threshold()
+            return {"threshold_pairs": _DEVICE_MIN_PAIRS, "source": "env"}
+        except ValueError:
+            pass
+    n = 1 << max(sample_pairs - 1, 1).bit_length()
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, 2**32, size=(n, 16), dtype=np.uint64).astype(
+        np.uint32)
+    dev_pairs = jnp.asarray(pairs)
+    # compile outside the timing (persistent cache makes this a load)
+    jax.block_until_ready(hash_pairs_device(dev_pairs))
+    host_rate = _measure_rate(hash_pairs_np, pairs)
+    dev_rate = _measure_rate(
+        lambda p: jax.block_until_ready(hash_pairs_device(p)), dev_pairs)
+    # per-dispatch overhead: a tiny (already-compiled small shape) call
+    tiny = jnp.asarray(pairs[:4])
+    jax.block_until_ready(hash_pairs_device(tiny))
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        jax.block_until_ready(hash_pairs_device(tiny))
+    overhead_s = (time.perf_counter() - t0) / reps
+    if dev_rate <= host_rate:
+        # the device asymptote loses outright (XLA-CPU fallback):
+        # route everything realistic to the host path
+        threshold = _THRESHOLD_CEIL
+    else:
+        n_star = overhead_s / (1.0 / host_rate - 1.0 / dev_rate)
+        threshold = 1 << max(int(n_star) - 1, 1).bit_length()
+        threshold = min(max(threshold, 256), _THRESHOLD_CEIL)
+    _DEVICE_MIN_PAIRS = threshold
+    _DEVICE_FOLD_MIN_LEAVES = min(2 * threshold, _THRESHOLD_CEIL)
+    _publish_threshold()
+    return {
+        "threshold_pairs": threshold,
+        "host_pairs_per_s": round(host_rate, 1),
+        "device_pairs_per_s": round(dev_rate, 1),
+        "dispatch_overhead_ms": round(overhead_s * 1000, 3),
+        "source": "measured",
+    }
+
+
+def _publish_threshold() -> None:
+    try:
+        REGISTRY.gauge(
+            "sha256_device_threshold_pairs",
+            "pair count above which merkle levels route to the device "
+            "(static default or startup calibration)",
+        ).set(_DEVICE_MIN_PAIRS)
+    except Exception:
+        pass  # metrics must never take down the hasher
 
 
 def merkleize_words(

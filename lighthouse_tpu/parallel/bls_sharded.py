@@ -61,45 +61,80 @@ def _sharded_miller_reduce(mesh, per_dev: int):
     return fn
 
 
-def multi_pairing_sharded(pairs, mesh) -> "object":
+def _dispatch_chunk(pairs, mesh, stage):
+    """Prep + h2d + dispatch for one lane chunk; returns the (not yet
+    synced) replicated Fq12 partial.  ``stage`` accumulates prep_host/h2d
+    wall seconds so chunked runs report per-stage totals."""
+    import time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = mesh.devices.size
+    t0 = time.perf_counter()
+    cols, mask = dev.points_to_device(pairs)
+    n = len(pairs)
+    # pad so every device holds a power-of-two lane count
+    per_dev = 1 << max((n + n_dev - 1) // n_dev - 1, 0).bit_length()
+    padded = per_dev * n_dev
+    if padded != n:
+        cols = [np.concatenate([c, np.tile(c[-1:], (padded - n, 1))])
+                for c in cols]
+        mask = np.concatenate([mask, np.zeros(padded - n, bool)])
+    fn = _sharded_miller_reduce(mesh, per_dev)
+    now = time.perf_counter()
+    stage["prep_host"] += now - t0
+    t0 = now
+    sh = NamedSharding(mesh, P("data", None))
+    shm = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
+    mask_dev = jax.device_put(jnp.asarray(mask), shm)
+    stage["h2d"] += time.perf_counter() - t0
+    return fn(*args, mask_dev)
+
+
+def multi_pairing_sharded(pairs, mesh, chunk_size: int | None = None
+                          ) -> "object":
     """Device multi-pairing over a mesh: prod Miller(P_i, Q_i), host final exp.
+
+    Lane sets above the pipeline chunk size (chunk_size arg >
+    LHTPU_BLS_CHUNK > default) split into fixed power-of-two chunks
+    dispatched back-to-back: the host preps and uploads chunk k+1 while
+    chunk k's Miller program runs on the mesh, the per-chunk replicated
+    partials multiply down on device, and the batch pays ONE d2h fetch +
+    ONE final exponentiation — the single-device overlap model of
+    ops/dispatch_pipeline applied across chips.
 
     Stage wall times land in ``bls_verify_stage_seconds{backend="sharded"}``
     (prep_host / h2d / kernel / d2h / final_exp).  The kernel stage syncs
-    the sharded result before timing — one batch-level sync the d2h fetch
-    right after would pay anyway, so the pipeline is not serialized."""
+    the (combined) sharded result before timing — one batch-level sync the
+    d2h fetch right after would pay anyway, so the pipeline is not
+    serialized."""
     import time
 
     from lighthouse_tpu.common import tracing
     from lighthouse_tpu.crypto.bls.api import record_stage
     from lighthouse_tpu.crypto.bls.fields import final_exponentiation_fast
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from lighthouse_tpu.ops import dispatch_pipeline as dp
 
     with tracing.span("bls.multi_pairing_sharded", lanes=len(pairs),
                       devices=int(mesh.devices.size)):
-        n_dev = mesh.devices.size
+        chunks = dp.plan_chunks(len(pairs), dp.chunk_size(chunk_size))
+        stage = {"prep_host": 0.0, "h2d": 0.0}
+        partials = []
+        overlap_s = 0.0
+        t_prev = None
+        for lo, hi in chunks:
+            tc = time.perf_counter()
+            partials.append(_dispatch_chunk(pairs[lo:hi], mesh, stage))
+            now = time.perf_counter()
+            if t_prev is not None:
+                overlap_s += now - tc
+            t_prev = now
+        record_stage("sharded", "prep_host", stage["prep_host"])
+        record_stage("sharded", "h2d", stage["h2d"])
+        dp.record_pipeline(len(chunks), overlap_s, len(pairs))
         t0 = time.perf_counter()
-        cols, mask = dev.points_to_device(pairs)
-        n = len(pairs)
-        # pad so every device holds a power-of-two lane count
-        per_dev = 1 << max((n + n_dev - 1) // n_dev - 1, 0).bit_length()
-        padded = per_dev * n_dev
-        if padded != n:
-            cols = [np.concatenate([c, np.tile(c[-1:], (padded - n, 1))])
-                    for c in cols]
-            mask = np.concatenate([mask, np.zeros(padded - n, bool)])
-        fn = _sharded_miller_reduce(mesh, per_dev)
-        now = time.perf_counter()
-        record_stage("sharded", "prep_host", now - t0)
-        t0 = now
-        sh = NamedSharding(mesh, P("data", None))
-        shm = NamedSharding(mesh, P("data"))
-        args = [jax.device_put(jnp.asarray(c), sh) for c in cols]
-        mask_dev = jax.device_put(jnp.asarray(mask), shm)
-        now = time.perf_counter()
-        record_stage("sharded", "h2d", now - t0)
-        t0 = now
-        f = fn(*args, mask_dev)
+        f = dp.combine_partials(partials)
         jax.block_until_ready(f)
         now = time.perf_counter()
         record_stage("sharded", "kernel", now - t0)
@@ -114,7 +149,8 @@ def multi_pairing_sharded(pairs, mesh) -> "object":
 
 
 def verify_signature_sets_sharded(
-    sets: Sequence, *, n_devices: int | None = None, mesh=None
+    sets: Sequence, *, n_devices: int | None = None, mesh=None,
+    chunk_size: int | None = None
 ) -> bool:
     """Batch-verify signature sets with Miller-loop lanes sharded over a mesh.
 
@@ -136,4 +172,4 @@ def verify_signature_sets_sharded(
         devs = jax.devices()
         n = n_devices or len(devs)
         mesh = Mesh(np.array(devs[:n]), axis_names=("data",))
-    return multi_pairing_sharded(pairs, mesh).is_one()
+    return multi_pairing_sharded(pairs, mesh, chunk_size=chunk_size).is_one()

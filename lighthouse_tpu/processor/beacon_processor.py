@@ -115,11 +115,28 @@ _LIFO_TYPES = {
     WorkType.GOSSIP_SYNC_CONTRIBUTION,
 }
 
+# longest a deadline flush may be held for coalescing while the dispatch
+# thread is busy: bounds queue wait for sub-max batches when back-to-back
+# flights of another work type keep the thread saturated
+_COALESCE_HOLD_MAX_S = 0.5
+
 # work types eligible for batch formation: (batch type, per-event lanes)
 _BATCHABLE = {
     WorkType.GOSSIP_ATTESTATION: WorkType.GOSSIP_ATTESTATION_BATCH,
     WorkType.GOSSIP_AGGREGATE: WorkType.GOSSIP_AGGREGATE_BATCH,
 }
+
+
+def _record_inflight(n: int) -> None:
+    """Mirror the dispatch-thread occupancy into the
+    bls_pipeline_inflight_batches gauge (owned by ops/dispatch_pipeline;
+    lazy import keeps this module importable without jax)."""
+    try:
+        from lighthouse_tpu.ops.dispatch_pipeline import record_inflight
+
+        record_inflight(n)
+    except Exception:
+        pass
 
 
 def default_queue_lengths(active_validator_count: int) -> dict[WorkType, int]:
@@ -202,6 +219,17 @@ class BeaconProcessor:
         self._stopped = False
         self._manager_task: asyncio.Task | None = None
         self._executor = ThreadPoolExecutor(max_workers=self.max_workers)
+        # ONE dedicated dispatch thread for device batches: batch work
+        # from every batchable type serializes here back-to-back, so the
+        # device stays saturated while the manager keeps draining queues
+        # on the loop and the general pool serves per-event work.  The
+        # thread count is the contract — two concurrent device batch
+        # dispatches would interleave their host/device stages.
+        self._dispatch_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bp-dispatch")
+        # batches currently on (or queued for) the dispatch thread;
+        # mutated only on the event loop
+        self._dispatch_inflight = 0
         self._inflight: set[asyncio.Task] = set()
         # first-seen timestamps for batch flush decisions
         self._batch_deadline: dict[WorkType, float] = {}
@@ -318,7 +346,20 @@ class BeaconProcessor:
             if wt in _BATCHABLE:
                 n = len(q)
                 deadline = self._batch_deadline.get(wt, 0.0)
-                if n >= self.max_batch or now >= deadline:
+                # cross-batch coalescing: while a batch is in flight on
+                # the dispatch thread, deadline flushes HOLD — events
+                # arriving during the flight merge into one next sweep
+                # (bounded by max_batch) instead of trickling out as
+                # many small batches queued behind the device.  A full
+                # queue still forms immediately: a max_batch sweep is
+                # already maximal and keeps the device fed back-to-back.
+                # The hold is time-bounded (_COALESCE_HOLD_MAX_S past
+                # the deadline): under a sustained flood of another
+                # work type the dispatch thread may never go idle, and
+                # a sub-max queue must not be starved forever.
+                if n >= self.max_batch or (now >= deadline and (
+                        self._dispatch_inflight == 0
+                        or now - deadline >= _COALESCE_HOLD_MAX_S)):
                     take = min(n, self.max_batch)
                     events = [q.popleft() for _ in range(take)]
                     if not q:
@@ -382,13 +423,19 @@ class BeaconProcessor:
                 await self._run_one(e)
             return
         payloads = [e.payload for e in events]
+        self._dispatch_inflight += 1
+        _record_inflight(self._dispatch_inflight)
         try:
             with tracing.span("beacon_processor.batch",
                               work_type=wt.name.lower(),
                               lanes=len(events)):
                 loop = asyncio.get_running_loop()
-                await loop.run_in_executor(self._executor, batch_fn, payloads)
+                await loop.run_in_executor(
+                    self._dispatch_executor, batch_fn, payloads)
         except Exception:
             pass
+        finally:
+            self._dispatch_inflight -= 1
+            _record_inflight(self._dispatch_inflight)
         self.metrics.bump(self.metrics.processed, wt, len(events))
         self._labeled(self._event_counter, wt, "processed").inc(len(events))

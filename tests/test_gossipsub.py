@@ -225,6 +225,20 @@ class TestResilience:
         assert e.accept_px("ok")
         assert not e.accept_px("bad")
 
+    def test_px_dial_threshold_excludes_fresh_peers(self):
+        """The transport dials px targets only above PX_DIAL_SCORE
+        (strictly positive): a FRESH peer scores exactly 0 and must not
+        be able to steer our outbound dials."""
+        t = [0.0]
+        e = _engine(["fresh", "proven"], lambda: t[0])
+        ts = e._tscore("proven", "top")
+        ts.mesh_since = 0.0
+        ts.first_deliveries = 50.0              # positive score history
+        assert gs.PX_DIAL_SCORE > 0.0
+        assert not e.accept_px("fresh", gs.PX_DIAL_SCORE)
+        assert e.accept_px("proven", gs.PX_DIAL_SCORE)
+
+
     def test_adaptive_gossip_fanout_scales_with_population(self):
         """IHAVE fanout must grow past D_LAZY on big topics (gossip
         factor), not stay pinned at the floor."""
@@ -256,6 +270,65 @@ class TestResilience:
         t[0] += gs.PRUNE_BACKOFF_S + 1
         assert e.handle_graft("p", "top")
         assert "p" in e.mesh["top"]
+
+
+class TestPrunePxHardening:
+    """PRUNE wire-format bump + px address sanity (transport level)."""
+
+    def test_px_format_has_its_own_frame_kind(self):
+        """The length-prefixed topic + px format must NOT reuse the
+        legacy K_PRUNE identifier (raw topic bytes): a mixed-version
+        deployment would mis-parse the length prefix as topic text."""
+        from lighthouse_tpu.network.wire import transport as tp
+
+        assert tp.K_PRUNE_PX != tp.K_PRUNE
+        node = WireNode("PX-FMT")
+        frame = node._prune_frame("some/topic", "peer-x")
+        assert frame[0] == tp.K_PRUNE_PX
+        topic, off = tp._unpack_str(frame[1:], 0)
+        assert topic == "some/topic"
+
+    def test_compat_prune_topic_parses_px_ignored(self):
+        """Frames from un-upgraded peers (K_PRUNE, same length-prefixed
+        topic + px layout) must still prune the right topic; their px
+        tail is dropped rather than dialed."""
+        import json
+        import struct
+
+        from lighthouse_tpu.network.wire import transport as tp
+
+        body = (struct.pack("<H", len(b"beacon_block")) + b"beacon_block"
+                + json.dumps([["pid", "1.2.3.4", 9000]]).encode())
+        topic, off = tp._unpack_str(body, 0)
+        assert topic == "beacon_block"      # the layout K_PRUNE decodes
+
+    def test_px_target_address_sanity(self):
+        node = WireNode("PX-ADDR", listen_host="10.0.0.5")
+        node.listen_port = 9000
+        # own listen address: refused (self-dial loop)
+        assert not node._px_target_allowed("10.0.0.5", 9000)
+        # loopback / unspecified from a non-loopback node: refused
+        # (rebind steering — 0.0.0.0/:: connect to localhost too), in
+        # every spelling getaddrinfo would resolve to 127.0.0.1
+        for host in ("127.0.0.1", "127.9.9.9", "localhost", "::1",
+                     "::ffff:127.0.0.1", "2130706433", "0x7f000001",
+                     "0.0.0.0", "::", ""):
+            assert not node._px_target_allowed(host, 9100), host
+        # out-of-range port: refused
+        assert not node._px_target_allowed("10.0.0.9", 0)
+        # normal remote targets: allowed
+        assert node._px_target_allowed("10.0.0.9", 9100)
+        assert node._px_target_allowed("2001:db8::5", 9100)
+
+    def test_px_loopback_ok_for_loopback_node(self):
+        """Local test deployments (we listen on 127.0.0.1) keep
+        exchanging loopback addresses — but never the unspecified
+        address."""
+        node = WireNode("PX-LO", listen_host="127.0.0.1")
+        node.listen_port = 9000
+        assert node._px_target_allowed("127.0.0.1", 9001)
+        assert not node._px_target_allowed("127.0.0.1", 9000)  # self
+        assert not node._px_target_allowed("0.0.0.0", 9001)
 
 
 class TestSocketGossipsub:

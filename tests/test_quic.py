@@ -120,6 +120,49 @@ class TestRawStream:
 
         _run(main(), timeout=60)
 
+    def test_send_pacing_caps_inflight_at_window(self):
+        """A single multi-hundred-KiB write must not burst past
+        WINDOW_PACKETS datagrams: chunks beyond the window queue unsent
+        and are released as ACKs free slots (ADVICE r5 pacing)."""
+        blob = bytes(range(256)) * 2048          # 512 KiB ≈ 437 packets
+
+        async def main():
+            got = asyncio.get_event_loop().create_future()
+
+            async def on_conn(reader, writer):
+                data = await reader.readexactly(len(blob))
+                got.set_result(data)
+
+            lst = await quic.start_listener(
+                "127.0.0.1", 0,
+                lambda r, w: asyncio.ensure_future(on_conn(r, w)))
+            try:
+                r, w = await quic.open_connection("127.0.0.1", lst.port)
+                conn = w._conn
+                max_inflight = 0
+                orig = conn._transmit
+
+                def spy(ptype, seq, payload):
+                    nonlocal max_inflight
+                    max_inflight = max(max_inflight, len(conn.unacked))
+                    orig(ptype, seq, payload)
+
+                conn._transmit = spy
+                w.write(blob)
+                # the write itself must not exceed the window
+                assert len(conn.unacked) <= quic.WINDOW_PACKETS
+                assert conn.pending           # excess queued, not sent
+                await w.drain()
+                assert await got == blob
+                assert max_inflight <= quic.WINDOW_PACKETS
+                assert not conn.pending       # fully released by ACKs
+                w.close()
+                await w.wait_closed()
+            finally:
+                lst.close()
+
+        _run(main(), timeout=60)
+
     def test_reorder_buffer_bounded(self):
         """Segments at/beyond rcv_next + WINDOW_PACKETS are dropped, so a
         pre-handshake peer cannot grow rcv_buf without bound; in-window

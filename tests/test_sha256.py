@@ -67,3 +67,54 @@ def test_zero_hashes():
 def test_mix_in_length():
     root = b"\x11" * 32
     assert s.mix_in_length(root, 5) == hashlib.sha256(root + (5).to_bytes(32, "little")).digest()
+
+
+class TestDeviceThresholdCalibration:
+    """Startup micro-calibration of the device-vs-host merkle routing."""
+
+    def test_env_override_pins_threshold(self, monkeypatch):
+        saved = (s._DEVICE_MIN_PAIRS, s._DEVICE_FOLD_MIN_LEAVES,
+                 s._CALIBRATED)
+        try:
+            monkeypatch.setenv("LHTPU_SHA_DEVICE_MIN", "4096")
+            out = s.calibrate_device_thresholds(force=True)
+            assert out["source"] == "env"
+            assert s._DEVICE_MIN_PAIRS == 4096
+            assert s._DEVICE_FOLD_MIN_LEAVES == 8192
+            from lighthouse_tpu.common.metrics import REGISTRY
+
+            assert REGISTRY.gauge(
+                "sha256_device_threshold_pairs").value == 4096
+        finally:
+            (s._DEVICE_MIN_PAIRS, s._DEVICE_FOLD_MIN_LEAVES,
+             s._CALIBRATED) = saved
+
+    def test_measured_calibration_sets_pow2_threshold(self, monkeypatch):
+        saved = (s._DEVICE_MIN_PAIRS, s._DEVICE_FOLD_MIN_LEAVES,
+                 s._CALIBRATED)
+        try:
+            monkeypatch.delenv("LHTPU_SHA_DEVICE_MIN", raising=False)
+            out = s.calibrate_device_thresholds(sample_pairs=256,
+                                                force=True)
+            assert out["source"] == "measured"
+            t = out["threshold_pairs"]
+            assert t & (t - 1) == 0                 # power of two
+            assert s._DEVICE_MIN_PAIRS == t
+            assert s._DEVICE_FOLD_MIN_LEAVES <= 2 * t
+            # one-shot: a second call without force is a cached no-op
+            again = s.calibrate_device_thresholds()
+            assert again.get("cached")
+        finally:
+            (s._DEVICE_MIN_PAIRS, s._DEVICE_FOLD_MIN_LEAVES,
+             s._CALIBRATED) = saved
+
+    def test_routing_decision_uses_calibrated_threshold(self):
+        saved = (s._DEVICE_MIN_PAIRS, s._CALIBRATED)
+        try:
+            s._DEVICE_MIN_PAIRS = 1 << 30            # force host path
+            rng = np.random.default_rng(3)
+            pairs = rng.integers(0, 2**32, size=(64, 16), dtype=np.uint32)
+            np.testing.assert_array_equal(
+                s.batch_hash_pairs(pairs), _ref_hash_pairs(pairs))
+        finally:
+            s._DEVICE_MIN_PAIRS, s._CALIBRATED = saved

@@ -9,7 +9,10 @@ Fails (exit 1) on:
   histogram): the registry's get-or-create would silently return the
   first kind;
 - one name registered from more than one module: series ownership must
-  be unambiguous (share a handle or a helper instead).
+  be unambiguous (share a handle or a helper instead);
+- a name under a PINNED family prefix registered outside that family's
+  owner module (FAMILY_OWNERS below): cross-layer consumers must go
+  through the owner's helpers, never re-register the series.
 
 Run directly (``python tools/check_metrics.py``) or via the tier-1 test
 in tests/test_metrics.py.
@@ -24,6 +27,16 @@ import sys
 
 KINDS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# family prefix -> sole owner module (repo-relative).  The dispatch
+# pipeline's bls_pipeline_* series are recorded from the BLS backends AND
+# the beacon processor; pinning the owner here keeps every registration
+# funneled through ops/dispatch_pipeline's record_* helpers.
+FAMILY_OWNERS = {
+    "bls_pipeline_": "lighthouse_tpu/ops/dispatch_pipeline.py",
+    "bls_verify_": "lighthouse_tpu/crypto/bls/api.py",
+    "bls_cache_": "lighthouse_tpu/crypto/bls/api.py",
+}
 
 
 def collect(package_root: pathlib.Path):
@@ -74,6 +87,14 @@ def collect(package_root: pathlib.Path):
         if len(modules) > 1:
             errors.append(
                 f"{name}: registered from multiple modules {modules}")
+        for prefix, owner in FAMILY_OWNERS.items():
+            if name.startswith(prefix):
+                outside = [m for m in modules
+                           if not m.replace("\\", "/").endswith(owner)]
+                if outside:
+                    errors.append(
+                        f"{name}: family {prefix}* is owned by {owner}, "
+                        f"but registered from {outside}")
     return regs, errors
 
 
