@@ -211,55 +211,33 @@ class BeaconChain:
         beacon_chain.rs:3089).  source="rpc" for sync-fetched blocks
         (skips gossip-only checks).  Returns None when the block carries
         blob commitments whose sidecars have not all arrived yet — it
-        waits in the DA checker and imports when they do."""
-        with self._import_lock:
-            return self._process_block_locked(signed_block, blobs_ssz, source)
+        waits in the DA checker and imports when they do.
 
-    def _process_block_locked(self, signed_block, blobs_ssz, source):
+        Locking contract (lhlint LH102): the import lock is held for the
+        gossip stage (state/dup-cache reads + the 1-set proposer-sig
+        check that authenticates the dup-cache mark) and for the
+        execute/import stage — the full-block BLS signature batch, the
+        single heaviest device dispatch on this path, runs UNLOCKED
+        between the two holds, same contract as the attestation
+        pipelines below."""
         t_start = time.perf_counter()
         slot = int(signed_block.message.slot)
         # the per-slot timeline root (Lighthouse block-delay analogue):
         # gossip arrival -> verified -> executed -> head updated; served
         # by GET /lighthouse/tracing/{slot}
         with tracing.span("block_import", slot=slot, source=source):
-            with tracing.span("gossip_verify"):
-                gossip = verify_block_for_gossip(self, signed_block, source)
+            with self._import_lock:
+                with tracing.span("gossip_verify"):
+                    gossip = verify_block_for_gossip(
+                        self, signed_block, source)
+            # pure crypto over already-extracted sets, no chain state
+            # touched: block imports on other threads proceed while the
+            # device grinds this block's signature batch
             with tracing.span("signature_verify"):
                 sigv = verify_block_signatures(self, gossip)
-
-            # payload verification runs CONCURRENTLY with the state
-            # transition (reference block_verification.rs:1342-1415 payload
-            # future; SURVEY §2.9-5 pipeline overlap), joined below
-            payload_future = self._spawn_payload_verification(signed_block)
-            with tracing.span("state_transition"):
-                pending = execute_block(self, sigv)
-            with tracing.span("payload_join"):
-                pending.execution_status = self._join_payload_verification(
-                    payload_future)
-
-            # Deneb data-availability gate (data_availability_checker.rs:32).
-            # Callers that ALREADY hold the block's blob data (RPC/backfill
-            # sync, which verifies sidecars out-of-band) pass blobs_ssz and
-            # import directly — only gossip blocks wait on gossip sidecars.
-            commitments = getattr(signed_block.message.body,
-                                  "blob_kzg_commitments", None)
-            if (commitments is not None and len(commitments) > 0
-                    and blobs_ssz is None):
-                self._pending_executed[pending.block_root] = pending
-                while len(self._pending_executed) > self.da_checker.capacity:
-                    # stay in lockstep with the DA checker's LRU bound
-                    oldest = next(iter(self._pending_executed))
-                    del self._pending_executed[oldest]
-                availability = self.da_checker.put_pending_executed_block(
-                    pending.block_root, pending.signed_block)
-                if not availability.is_available:
-                    return None
-                # sidecars all arrived already: the import completes in
-                # THIS call, so it must hit the timing sinks below too —
-                # post-Deneb every gossip block takes this branch
-                root = self._import_available(availability)
-            else:
-                root = self.import_block(pending, blobs_ssz)
+            with self._import_lock:
+                root = self._execute_and_import_locked(
+                    sigv, signed_block, blobs_ssz)
         total = time.perf_counter() - t_start
         if root is not None:
             self.block_times.record(root, "total", total)
@@ -269,36 +247,92 @@ class BeaconChain:
             ).labels(source=source).observe(total)
         return root
 
+    def _execute_and_import_locked(self, sigv, signed_block, blobs_ssz):
+        # re-check the dup gate under THIS hold: a concurrent copy of the
+        # same block (two sync workers racing an RPC fetch) can pass the
+        # gossip stage before either imports, because the BLS batch now
+        # runs between the two lock holds.  Exactly the pre-split
+        # semantics: the loser fails with "duplicate".
+        if self.store.block_exists(sigv.block_root):
+            raise BlockError("duplicate")
+        # payload verification runs CONCURRENTLY with the state
+        # transition (reference block_verification.rs:1342-1415 payload
+        # future; SURVEY §2.9-5 pipeline overlap), joined below
+        payload_future = self._spawn_payload_verification(signed_block)
+        with tracing.span("state_transition"):
+            pending = execute_block(self, sigv)
+        with tracing.span("payload_join"):
+            pending.execution_status = self._join_payload_verification(
+                payload_future)
+
+        # Deneb data-availability gate (data_availability_checker.rs:32).
+        # Callers that ALREADY hold the block's blob data (RPC/backfill
+        # sync, which verifies sidecars out-of-band) pass blobs_ssz and
+        # import directly — only gossip blocks wait on gossip sidecars.
+        commitments = getattr(signed_block.message.body,
+                              "blob_kzg_commitments", None)
+        if (commitments is not None and len(commitments) > 0
+                and blobs_ssz is None):
+            self._pending_executed[pending.block_root] = pending
+            while len(self._pending_executed) > self.da_checker.capacity:
+                # stay in lockstep with the DA checker's LRU bound
+                oldest = next(iter(self._pending_executed))
+                del self._pending_executed[oldest]
+            availability = self.da_checker.put_pending_executed_block(
+                pending.block_root, pending.signed_block)
+            if not availability.is_available:
+                return None
+            # sidecars all arrived already: the import completes in
+            # THIS call, so it must hit the timing sinks in the caller
+            # too — post-Deneb every gossip block takes this branch
+            return self._import_available(availability)
+        # direct import (no DA wait): drop any copy of this block parked
+        # awaiting sidecars under the SAME hold, or late-arriving gossip
+        # sidecars would complete availability and re-import the root
+        self._pending_executed.pop(pending.block_root, None)
+        return self.import_block(pending, blobs_ssz)
+
     def process_gossip_blob(self, sidecar) -> bytes | None:
         """Verify one gossip blob sidecar and import its block if that
-        completes availability (blob_verification.rs + DA checker)."""
-        with self._import_lock:
-            return self._process_gossip_blob_locked(sidecar)
+        completes availability (blob_verification.rs + DA checker).
 
-    def _process_gossip_blob_locked(self, sidecar) -> bytes | None:
+        Locking contract (lhlint LH102): gossip checks (state + dup-cache
+        reads, header-signature authentication) hold the import lock; the
+        KZG proof verification — a device multi-pairing — runs UNLOCKED;
+        the dup-cache mark + DA-checker commit re-acquire the lock.  The
+        mark lands only after the FULL verification (incl. KZG) passed,
+        so a corrupted copy cannot block the honest sidecar, and marks
+        are claimed atomically under the commit hold, so concurrent
+        copies of one sidecar cannot both commit."""
         from lighthouse_tpu.chain.blob_verification import (
             BlobError,
             validate_blobs,
             verify_blob_sidecar_for_gossip,
         )
 
-        verified = verify_blob_sidecar_for_gossip(self, sidecar,
-                                                  self.kzg_settings)
+        with self._import_lock:
+            verified = verify_blob_sidecar_for_gossip(self, sidecar,
+                                                      self.kzg_settings)
         if not validate_blobs(
                 self.kzg_settings, [sidecar.kzg_commitment],
                 [sidecar.blob], [sidecar.kzg_proof]):
             raise BlobError("invalid_kzg_proof")
-        # mark the dup cache only now that the FULL verification (incl.
-        # KZG) passed — a corrupted copy must not block the honest sidecar
-        epoch = self.spec.compute_epoch_at_slot(
-            int(sidecar.signed_block_header.message.slot))
-        self.observed_blob_sidecars.observe(
-            epoch,
-            verified.block_root + int(sidecar.index).to_bytes(8, "little"))
-        availability = self.da_checker.put_verified_blobs(
-            verified.block_root, [verified])
-        if availability.is_available:
-            return self._import_available(availability)
+        with self._import_lock:
+            epoch = self.spec.compute_epoch_at_slot(
+                int(sidecar.signed_block_header.message.slot))
+            if self.observed_blob_sidecars.observe(
+                    epoch,
+                    verified.block_root
+                    + int(sidecar.index).to_bytes(8, "little")):
+                # a concurrent copy of this sidecar won the commit race
+                # while our KZG check ran unlocked — only the first mark
+                # may feed the DA checker (a second put could recreate a
+                # ghost pending entry for an already-imported block)
+                return None
+            availability = self.da_checker.put_verified_blobs(
+                verified.block_root, [verified])
+            if availability.is_available:
+                return self._import_available(availability)
         return None
 
     def _import_available(self, availability) -> bytes | None:

@@ -1,0 +1,107 @@
+"""Central registry of every ``LHTPU_*`` environment variable.
+
+One definition per knob: name, default, and an operator-facing
+description.  Call sites read through :func:`get` / :func:`get_int` /
+:func:`get_bool` instead of ``os.environ`` directly, so the full tuning
+surface is enumerable (the README env-var table is generated from this
+registry) and machine-checked: lhlint's env pass (rule LH401) flags any
+``os.environ``/``os.getenv`` read of an ``LHTPU_*`` name that is not
+registered here, and LH402 flags registry entries missing from the
+README.
+
+This module must stay importable before anything else in the package
+(cache_guard reads it pre-XLA): stdlib only, no jax, no numpy, no other
+lighthouse_tpu imports.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str | None
+    description: str
+
+
+ENV_VARS: dict[str, EnvVar] = {}
+
+
+def _register(name: str, default: str | None, description: str) -> None:
+    ENV_VARS[name] = EnvVar(name, default, description)
+
+
+# -- the registry (one _register call per knob; lhlint parses these) ----------
+
+_register("LHTPU_BLS_BACKEND", None,
+          "Force the BLS backend (tpu|reference|fake|sharded); unset = "
+          "auto (device pipeline on TPU, pure-Python reference on CPU).")
+_register("LHTPU_BLS_CHUNK", None,
+          "Overlapped-pipeline chunk size in signature sets; unset = "
+          "512 (dispatch_pipeline.DEFAULT_CHUNK_SETS), 0 disables "
+          "chunking (monolithic single-dispatch).")
+_register("LHTPU_DEVICE_FINAL_EXP", None,
+          "1/0 forces the final-exponentiation hard part on/off device; "
+          "unset = on for TPU, host path for XLA-CPU.")
+_register("LHTPU_NO_CACHE_GUARD", None,
+          "Any non-empty value disables the XLA mmap-headroom raise and "
+          "the compile-cache fallback guard (ops/cache_guard).")
+_register("LHTPU_SHA_DEVICE_MIN", None,
+          "Pin the device-vs-host SHA-256 routing threshold (pair "
+          "count); unset = one-shot startup micro-calibration.")
+_register("LHTPU_MXU_REDC", "auto",
+          "1/0 forces the MXU Montgomery-reduction path on/off; "
+          "auto picks by platform (ops/bigint).")
+_register("LHTPU_NATIVE_BLS", "1",
+          "0/false disables the native C++ BLS helper library "
+          "(decompression, final exp); falls back to pure Python.")
+_register("LHTPU_DRYRUN_BLS", "1",
+          "0 skips the sharded-BLS compile in the multi-chip dryrun "
+          "worker (the first-ever compile costs minutes on CPU).")
+_register("LHTPU_BENCH_TIMEOUT", "420",
+          "Per-child timeout in seconds for bench.py stage children.")
+_register("LHTPU_BLS_SETS", None,
+          "bench.py BLS child batch size (the parent walks a "
+          "degradation ladder when unset).")
+_register("LHTPU_FULL_SCALE", None,
+          "1 forces bench.py spec-scale runs (32k-attestation flood, "
+          "1M-validator registry).")
+_register("LHTPU_SLOW", None,
+          "1 enables slow opt-in tests that compile extra device "
+          "shapes (test_das 32k scan, test_device_pairing).")
+_register("LHTPU_ISOLATED", None,
+          "Set by the test conftest in per-file child processes; marks "
+          "a child so it runs tests in-process instead of re-forking.")
+
+
+# -- typed readers ------------------------------------------------------------
+
+
+def get(name: str) -> str | None:
+    """Raw string value: process environment first, registry default
+    otherwise.  Raises KeyError on unregistered names — reads of
+    unknown knobs are programming errors, not operator errors."""
+    var = ENV_VARS[name]
+    val = os.environ.get(name)
+    return val if val is not None else var.default
+
+
+def get_int(name: str, fallback: int | None = None) -> int | None:
+    """Integer value, or ``fallback`` when unset or unparseable."""
+    val = get(name)
+    if val is None:
+        return fallback
+    try:
+        return int(val)
+    except ValueError:
+        return fallback
+
+
+def table() -> list[EnvVar]:
+    """Registry entries sorted by name — the source of truth the README
+    env-var table is checked against (lhlint LH402 both ways, plus the
+    row-level sync test in tests/test_lint.py)."""
+    return [ENV_VARS[k] for k in sorted(ENV_VARS)]

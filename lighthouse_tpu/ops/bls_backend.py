@@ -387,9 +387,9 @@ def _use_device_final_exp() -> bool:
     Override with LHTPU_DEVICE_FINAL_EXP=0/1."""
     global _DEVICE_FINAL_EXP
     if _DEVICE_FINAL_EXP is None:
-        import os
+        from lighthouse_tpu.common import env as envreg
 
-        env = os.environ.get("LHTPU_DEVICE_FINAL_EXP")
+        env = envreg.get("LHTPU_DEVICE_FINAL_EXP")
         if env is not None:
             _DEVICE_FINAL_EXP = env.lower() in ("1", "true")
         else:

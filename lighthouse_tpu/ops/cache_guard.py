@@ -26,7 +26,7 @@ Fix layers:
 
 from __future__ import annotations
 
-import os
+from lighthouse_tpu.common import env as envreg
 
 _GUARDED_NAMES = ("_pipeline_fused", "_kzg_fused", "_aggregate_kernel")
 _MAP_TARGET = 262144
@@ -70,7 +70,7 @@ def install() -> None:
 
     LHTPU_NO_CACHE_GUARD=1 opts out of both layers (for debugging the
     guard itself, or on hosts where the operator manages the sysctl)."""
-    if os.environ.get("LHTPU_NO_CACHE_GUARD"):
+    if envreg.get("LHTPU_NO_CACHE_GUARD"):
         return
     if ensure_map_headroom():
         return

@@ -29,12 +29,11 @@ enforces that ownership).
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax
 
+from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.ops.bls12_381 import _fp12_mul_q
 
@@ -53,12 +52,9 @@ def chunk_size(override: int | None = None) -> int:
     """Effective chunk size: explicit override > env > default."""
     if override is not None:
         return int(override)
-    env = os.environ.get("LHTPU_BLS_CHUNK")
-    if env:
-        try:
-            return int(env)
-        except ValueError:
-            pass
+    env = envreg.get_int("LHTPU_BLS_CHUNK")
+    if env is not None:
+        return env
     return DEFAULT_CHUNK_SETS
 
 

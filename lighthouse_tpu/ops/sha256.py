@@ -377,20 +377,17 @@ def calibrate_device_thresholds(sample_pairs: int = 2048,
     _DEVICE_MIN_PAIRS directly (tests) are unaffected because nothing
     here runs implicitly on the hash path."""
     global _DEVICE_MIN_PAIRS, _DEVICE_FOLD_MIN_LEAVES, _CALIBRATED
-    import os
+    from lighthouse_tpu.common import env as envreg
 
     if _CALIBRATED and not force:
         return {"threshold_pairs": _DEVICE_MIN_PAIRS, "cached": True}
     _CALIBRATED = True
-    env = os.environ.get("LHTPU_SHA_DEVICE_MIN")
-    if env:
-        try:
-            _DEVICE_MIN_PAIRS = max(1, int(env))
-            _DEVICE_FOLD_MIN_LEAVES = 2 * _DEVICE_MIN_PAIRS
-            _publish_threshold()
-            return {"threshold_pairs": _DEVICE_MIN_PAIRS, "source": "env"}
-        except ValueError:
-            pass
+    env = envreg.get_int("LHTPU_SHA_DEVICE_MIN")
+    if env is not None:
+        _DEVICE_MIN_PAIRS = max(1, env)
+        _DEVICE_FOLD_MIN_LEAVES = 2 * _DEVICE_MIN_PAIRS
+        _publish_threshold()
+        return {"threshold_pairs": _DEVICE_MIN_PAIRS, "source": "env"}
     n = 1 << max(sample_pairs - 1, 1).bit_length()
     rng = np.random.default_rng(7)
     pairs = rng.integers(0, 2**32, size=(n, 16), dtype=np.uint64).astype(

@@ -1,118 +1,26 @@
 #!/usr/bin/env python
-"""Metric-name lint: walk the package, collect every REGISTRY registration.
-
-Fails (exit 1) on:
-- dynamic metric names (f-strings/concatenation): unbounded series
-  cardinality belongs in LABELS, not in the metric name;
-- names not matching ``[a-z][a-z0-9_]*`` (Prometheus-safe subset);
-- one name registered as two different metric kinds (counter vs gauge vs
-  histogram): the registry's get-or-create would silently return the
-  first kind;
-- one name registered from more than one module: series ownership must
-  be unambiguous (share a handle or a helper instead);
-- a name under a PINNED family prefix registered outside that family's
-  owner module (FAMILY_OWNERS below): cross-layer consumers must go
-  through the owner's helpers, never re-register the series.
-
-Run directly (``python tools/check_metrics.py``) or via the tier-1 test
-in tests/test_metrics.py.
-"""
+"""Compat shim: the metric-name lint now lives in tools/lint (lhlint
+pass LH501, ``python -m tools.lint``).  This entry point keeps the
+original CLI (``python tools/check_metrics.py``) and the importable
+``collect()`` API byte-compatible for existing callers and tier-1
+tests."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-KINDS = ("counter", "gauge", "histogram")
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-# family prefix -> sole owner module (repo-relative).  The dispatch
-# pipeline's bls_pipeline_* series are recorded from the BLS backends AND
-# the beacon processor; pinning the owner here keeps every registration
-# funneled through ops/dispatch_pipeline's record_* helpers.
-FAMILY_OWNERS = {
-    "bls_pipeline_": "lighthouse_tpu/ops/dispatch_pipeline.py",
-    "bls_verify_": "lighthouse_tpu/crypto/bls/api.py",
-    "bls_cache_": "lighthouse_tpu/crypto/bls/api.py",
-}
-
-
-def collect(package_root: pathlib.Path):
-    """-> (registrations {name: set[(kind, module)]}, errors [str])."""
-    regs: dict[str, set[tuple[str, str]]] = {}
-    errors: list[str] = []
-    for path in sorted(package_root.rglob("*.py")):
-        rel = path.relative_to(package_root.parent)
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except SyntaxError as e:
-            errors.append(f"{rel}: unparseable: {e}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if not (isinstance(func, ast.Attribute) and func.attr in KINDS):
-                continue
-            base = func.value
-            # REGISTRY.counter(...) and reg.counter(...) alike: any
-            # receiver whose name ends with "registry" (case-insensitive)
-            if not (isinstance(base, ast.Name)
-                    and base.id.lower().endswith("registry")):
-                continue
-            loc = f"{rel}:{node.lineno}"
-            if not node.args:
-                errors.append(f"{loc}: {func.attr}() with no name argument")
-                continue
-            arg = node.args[0]
-            if not (isinstance(arg, ast.Constant)
-                    and isinstance(arg.value, str)):
-                errors.append(
-                    f"{loc}: dynamic metric name {ast.unparse(arg)!r} — "
-                    "move the variable part into .labels(...)")
-                continue
-            name = arg.value
-            if not NAME_RE.match(name):
-                errors.append(f"{loc}: invalid metric name {name!r} "
-                              "(must match [a-z][a-z0-9_]*)")
-            regs.setdefault(name, set()).add((func.attr, str(rel)))
-    for name in sorted(regs):
-        sites = regs[name]
-        kinds = sorted({k for k, _ in sites})
-        if len(kinds) > 1:
-            errors.append(f"{name}: registered as multiple kinds {kinds}")
-        modules = sorted({m for _, m in sites})
-        if len(modules) > 1:
-            errors.append(
-                f"{name}: registered from multiple modules {modules}")
-        for prefix, owner in FAMILY_OWNERS.items():
-            if name.startswith(prefix):
-                outside = [m for m in modules
-                           if not m.replace("\\", "/").endswith(owner)]
-                if outside:
-                    errors.append(
-                        f"{name}: family {prefix}* is owned by {owner}, "
-                        f"but registered from {outside}")
-    return regs, errors
-
-
-def main(argv: list[str]) -> int:
-    root = pathlib.Path(
-        argv[1] if len(argv) > 1
-        else pathlib.Path(__file__).resolve().parent.parent
-        / "lighthouse_tpu")
-    regs, errors = collect(root)
-    for err in errors:
-        print(f"check_metrics: {err}", file=sys.stderr)
-    if errors:
-        print(f"check_metrics: FAILED ({len(errors)} problem(s), "
-              f"{len(regs)} metric(s) scanned)", file=sys.stderr)
-        return 1
-    print(f"check_metrics: ok ({len(regs)} metric names)")
-    return 0
-
+from tools.lint.metrics_pass import (  # noqa: E402,F401  (re-exports)
+    FAMILY_OWNERS,
+    KINDS,
+    NAME_RE,
+    collect,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

@@ -1,0 +1,166 @@
+"""lhlint — repo-specific static analysis for lighthouse_tpu.
+
+PR 2 made the hot path fast by making it fragile: the import lock is
+held only for prepare/commit while BLS runs unlocked, batches pay
+exactly ONE device fetch, and the jit cache stays warm only under
+strict shape discipline.  None of those invariants is visible to a
+generic linter, so this suite parses the package with ``ast``, builds a
+module-level call graph, and machine-checks them:
+
+==========  =====================  =========================================
+rule id     name                   what it flags
+==========  =====================  =========================================
+LH001       unparseable            file fails to parse (everything else is
+                                   blind there)
+LH101       blocking-under-lock    blocking op (device fetch, time.sleep,
+                                   file/socket I/O) reachable inside a
+                                   ``with <lock>:`` body of the known locks
+                                   in chain/beacon_chain.py,
+                                   processor/beacon_processor.py,
+                                   store/hot_cold.py
+LH102       bls-under-lock         BLS/KZG verify entry point reachable
+                                   inside those same lock bodies
+LH103       lock-order-cycle       nested lock acquisitions A→B and B→A
+                                   both present (package-wide)
+LH201       stray-fetch            device→host materialization outside the
+                                   allowlisted commit points in
+                                   ops/dispatch_pipeline.py,
+                                   ops/bls_backend.py,
+                                   parallel/bls_sharded.py
+LH301       traced-python-branch   Python ``if``/``while`` on a traced
+                                   (non-static) parameter of a jitted
+                                   function
+LH302       jit-in-function        ``jax.jit`` constructed per-call inside
+                                   a function without a memo (compile-cache
+                                   churn / .jax_cache cold starts)
+LH401       unregistered-env       ``os.environ``/``os.getenv`` read of an
+                                   LHTPU_* name absent from
+                                   common/env.py's registry
+LH402       env-readme-drift       registry entry not documented in README
+LH501       metric-discipline      the absorbed tools/check_metrics pass
+                                   (dynamic names, kind/module conflicts,
+                                   family-ownership violations)
+==========  =====================  =========================================
+
+Suppression: a ``# lhlint: allow(<rule-id-or-name>[, ...])`` comment on
+the flagged line (or, for under-lock findings, on the ``with`` line)
+silences that finding; ``allow(*)`` silences all rules on the line.
+
+Pre-existing violations live in ``tools/lint/baseline.json`` keyed by
+(rule, file, symbol) — line numbers are deliberately NOT part of the
+key, so unrelated edits don't churn the baseline.  The gate is
+new-regression-only: a finding whose key exceeds its baselined count
+fails the run; stale baseline entries only warn.
+
+Run ``python -m tools.lint`` from the repo root (see README "Static
+analysis").  Stdlib-only by design: the analyzer never imports
+lighthouse_tpu or jax, so it runs in milliseconds anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str      # "LH101"
+    name: str      # "blocking-under-lock"
+    file: str      # path relative to the package root's parent
+    line: int
+    symbol: str    # stable baseline-key component (no line numbers)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.file}::{self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule}({self.name}) {self.message}"
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, path: pathlib.Path, rel: str, pkg_rel: str,
+                 source: str):
+        self.path = path
+        self.rel = rel          # e.g. "lighthouse_tpu/chain/beacon_chain.py"
+        self.pkg_rel = pkg_rel  # e.g. "chain/beacon_chain.py"
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+
+
+_ALLOW_RE = re.compile(r"#\s*lhlint:\s*allow\(([^)]*)\)")
+
+
+def line_allows(line_text: str, rule: str, name: str) -> bool:
+    m = _ALLOW_RE.search(line_text)
+    if not m:
+        return False
+    tokens = {t.strip() for t in m.group(1).split(",")}
+    return bool(tokens & {rule, name, "*"})
+
+
+class Context:
+    """Shared pass inputs: parsed modules, call graph, doc locations."""
+
+    def __init__(self, pkg_root: pathlib.Path, modules: list[Module],
+                 readme: pathlib.Path | None):
+        from tools.lint.callgraph import CallGraph
+
+        self.pkg_root = pkg_root
+        self.modules = modules
+        self.by_pkg_rel = {m.pkg_rel: m for m in modules}
+        self.readme = readme
+        self.graph = CallGraph(modules)
+
+    def suppressed(self, module: Module, rule: str, name: str,
+                   *linenos: int) -> bool:
+        """True when ANY of the candidate anchor lines carries an
+        ``# lhlint: allow(...)`` matching this rule."""
+        for ln in linenos:
+            if 1 <= ln <= len(module.lines) and line_allows(
+                    module.lines[ln - 1], rule, name):
+                return True
+        return False
+
+
+def load_package(pkg_root: pathlib.Path
+                 ) -> tuple[list[Module], list[Finding]]:
+    pkg_root = pathlib.Path(pkg_root).resolve()
+    modules: list[Module] = []
+    errors: list[Finding] = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = str(path.relative_to(pkg_root.parent))
+        pkg_rel = str(path.relative_to(pkg_root)).replace("\\", "/")
+        try:
+            source = path.read_text()
+            modules.append(Module(path, rel.replace("\\", "/"),
+                                  pkg_rel, source))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(Finding(
+                "LH001", "unparseable", rel.replace("\\", "/"),
+                getattr(e, "lineno", 0) or 0, "parse",
+                f"failed to parse: {e}"))
+    return modules, errors
+
+
+def analyze(pkg_root, readme=None) -> list[Finding]:
+    """Run every pass over the package rooted at ``pkg_root``; returns
+    suppression-filtered findings (baseline NOT applied — that's the
+    CLI/baseline layer's job)."""
+    from tools.lint import envpass, fetch, locks, metrics_pass, shapes
+
+    modules, findings = load_package(pathlib.Path(pkg_root))
+    readme = pathlib.Path(readme) if readme is not None else None
+    ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
+    for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
+                     metrics_pass.run):
+        findings.extend(pass_run(ctx))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
+    return findings

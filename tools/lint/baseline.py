@@ -1,0 +1,51 @@
+"""Baseline gate: pre-existing findings are tolerated, new ones fail.
+
+``baseline.json`` maps finding keys (``rule::file::symbol`` — no line
+numbers, so unrelated edits don't churn it) to tolerated counts.  The
+comparison is one-way by design:
+
+- a key whose current count EXCEEDS its baseline count (or a brand-new
+  key) is a regression → the excess findings are returned as ``new``;
+- a key whose current count is BELOW baseline is stale → returned in
+  ``stale`` for a warning (and cleaned up by ``--update-baseline``).
+
+The baseline can therefore only shrink over time; tests assert it never
+grows (tests/test_lint.py).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from collections import Counter
+
+
+def load(path: pathlib.Path) -> dict[str, int]:
+    path = pathlib.Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in data.items()}
+
+
+def save(path: pathlib.Path, findings) -> dict[str, int]:
+    counts = Counter(f.key for f in findings)
+    data = dict(sorted(counts.items()))
+    pathlib.Path(path).write_text(json.dumps(data, indent=1) + "\n")
+    return data
+
+
+def compare(findings, baseline: dict[str, int]
+            ) -> tuple[list, dict[str, int]]:
+    """-> (new findings beyond the baseline allowance, stale entries
+    {key: unused_allowance})."""
+    seen: Counter = Counter()
+    new = []
+    for f in findings:
+        seen[f.key] += 1
+        if seen[f.key] > baseline.get(f.key, 0):
+            new.append(f)
+    stale = {k: allowed - seen.get(k, 0)
+             for k, allowed in sorted(baseline.items())
+             if seen.get(k, 0) < allowed}
+    return new, stale

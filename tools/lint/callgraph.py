@@ -1,0 +1,223 @@
+"""Module-level call graph over the parsed package.
+
+Nodes are ``pkg_rel::qualname`` (e.g. ``chain/beacon_chain.py::
+BeaconChain.process_block``); edges come from three statically
+resolvable call shapes:
+
+- ``name(...)`` where ``name`` is a function defined in the same module
+  or imported via ``from pkg.mod import name``;
+- ``alias.attr(...)`` where ``alias`` is an imported package module
+  (``import pkg.mod as alias`` / ``from pkg import mod``);
+- ``self.attr(...)`` resolved to a method of a class in the same module
+  (the enclosing class first, then any unique ``*.attr`` match).
+
+Unresolvable calls keep their dotted text (``jax.device_get``,
+``time.sleep``, ``bls.verify_signature_sets``) so passes can classify
+blocking primitives by name even without an edge.  The graph is
+deliberately conservative: a missing edge can only cause a missed
+finding, never a false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+def dotted_name(expr: ast.expr) -> str | None:
+    """``a.b.c`` -> "a.b.c"; plain names -> "a"; anything else None."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    line: int
+    dotted: str | None       # textual dotted name, if expressible
+    resolved: str | None     # "pkg_rel::qualname" node key, if resolvable
+    node: ast.Call = field(repr=False, default=None)
+
+    @property
+    def terminal(self) -> str | None:
+        return self.dotted.rsplit(".", 1)[-1] if self.dotted else None
+
+
+@dataclass
+class FunctionInfo:
+    key: str                 # "pkg_rel::qualname"
+    module: object           # Module
+    qualname: str
+    node: ast.AST
+    calls: list[CallSite] = field(default_factory=list)
+
+
+def _module_key(dotted_module: str, pkg_name: str,
+                known: set[str]) -> str | None:
+    """"pkg.chain.block_verification" -> "chain/block_verification.py"
+    when that file exists in the package (or its __init__.py)."""
+    if dotted_module == pkg_name:
+        return "__init__.py" if "__init__.py" in known else None
+    prefix = pkg_name + "."
+    if not dotted_module.startswith(prefix):
+        return None
+    rel = dotted_module[len(prefix):].replace(".", "/")
+    if rel + ".py" in known:
+        return rel + ".py"
+    if rel + "/__init__.py" in known:
+        return rel + "/__init__.py"
+    return None
+
+
+class _Imports:
+    """Per-module import resolution tables."""
+
+    def __init__(self):
+        self.module_alias: dict[str, str] = {}   # local name -> module key
+        self.members: dict[str, tuple[str, str]] = {}  # name -> (mod key, member)
+
+
+class CallGraph:
+    def __init__(self, modules: list):
+        self.functions: dict[str, FunctionInfo] = {}
+        known = {m.pkg_rel for m in modules}
+        pkg_names = {m.path.parent for m in modules}
+        # package import name == the root directory name
+        pkg_name = modules[0].path.parents[
+            len(modules[0].pkg_rel.split("/")) - 1].name if modules else ""
+        del pkg_names
+        self._by_module: dict[str, list[FunctionInfo]] = {}
+        # two phases: register EVERY function first, resolve calls
+        # second — resolution must see functions from modules that sort
+        # after the caller
+        per_module_imports = {
+            m.pkg_rel: self._collect_imports(m, pkg_name, known)
+            for m in modules}
+        for m in modules:
+            self._collect_functions(m)
+        for m in modules:
+            imports = per_module_imports[m.pkg_rel]
+            local_names = {f.qualname: f.key
+                           for f in self._by_module[m.pkg_rel]}
+            for info in self._by_module[m.pkg_rel]:
+                info.calls = self._calls_of(info, m, imports, local_names)
+
+    # -- construction ------------------------------------------------------
+
+    def _collect_imports(self, m, pkg_name: str, known: set[str]) -> _Imports:
+        imp = _Imports()
+        own_pkg = "/".join(m.pkg_rel.split("/")[:-1])
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    key = _module_key(alias.name, pkg_name, known)
+                    if key:
+                        imp.module_alias[alias.asname
+                                         or alias.name.split(".")[0]] = key
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative: resolve against our package dir
+                    base = own_pkg.split("/") if own_pkg else []
+                    base = base[: len(base) - (node.level - 1)] \
+                        if node.level > 1 else base
+                    mod_dotted = ".".join(
+                        [pkg_name] + base + (node.module or "").split(".")
+                    ).rstrip(".")
+                else:
+                    mod_dotted = node.module or ""
+                key = _module_key(mod_dotted, pkg_name, known)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # "from pkg.mod import sub" may name a submodule
+                    sub = _module_key(f"{mod_dotted}.{alias.name}",
+                                      pkg_name, known)
+                    if sub:
+                        imp.module_alias[local] = sub
+                    elif key:
+                        imp.members[local] = (key, alias.name)
+        return imp
+
+    def _collect_functions(self, m):
+        mod_fns: list[FunctionInfo] = []
+
+        def visit(node, stack: list[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    info = FunctionInfo(f"{m.pkg_rel}::{qual}", m, qual,
+                                        child)
+                    self.functions[info.key] = info
+                    mod_fns.append(info)
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+
+        visit(m.tree, [])
+        self._by_module[m.pkg_rel] = mod_fns
+
+    def _calls_of(self, info: FunctionInfo, m, imports: _Imports,
+                  local_names: dict[str, str]) -> list[CallSite]:
+        out: list[CallSite] = []
+        cls_prefix = info.qualname.rsplit(".", 1)[0] \
+            if "." in info.qualname else None
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue  # nested defs own their call sites
+                if isinstance(child, ast.Call):
+                    out.append(self._resolve(child, m, imports, local_names,
+                                             cls_prefix))
+                walk(child)
+
+        walk(info.node)
+        return out
+
+    def _resolve(self, call: ast.Call, m, imports: _Imports,
+                 local_names: dict[str, str],
+                 cls_prefix: str | None) -> CallSite:
+        dotted = dotted_name(call.func)
+        resolved = None
+        if isinstance(call.func, ast.Name):
+            n = call.func.id
+            if n in local_names:
+                resolved = local_names[n]
+            elif n in imports.members:
+                mod_key, member = imports.members[n]
+                resolved = self._lookup(mod_key, member)
+        elif isinstance(call.func, ast.Attribute) and dotted:
+            parts = dotted.split(".")
+            if len(parts) == 2:
+                root, attr = parts
+                if root == "self":
+                    resolved = self._self_method(m.pkg_rel, cls_prefix, attr)
+                elif root in imports.module_alias:
+                    resolved = self._lookup(imports.module_alias[root], attr)
+                elif root in imports.members:
+                    # "from pkg import mod" landed in members when mod
+                    # wasn't recognizably a module; no resolution
+                    pass
+        return CallSite(call.lineno, dotted, resolved, call)
+
+    def _lookup(self, mod_key: str, name: str) -> str | None:
+        key = f"{mod_key}::{name}"
+        return key if key in self.functions else None
+
+    def _self_method(self, pkg_rel: str, cls_prefix: str | None,
+                     attr: str) -> str | None:
+        if cls_prefix:
+            key = f"{pkg_rel}::{cls_prefix}.{attr}"
+            if key in self.functions:
+                return key
+        suffix = f".{attr}"
+        matches = [f.key for f in self._by_module.get(pkg_rel, ())
+                   if f.qualname.endswith(suffix)]
+        return matches[0] if len(matches) == 1 else None

@@ -1,0 +1,74 @@
+"""``python -m tools.lint`` — run the analyzer, apply the baseline.
+
+Exit status: 0 when no NEW findings (stale baseline entries only warn),
+1 on any regression.  ``--update-baseline`` rewrites baseline.json from
+the current tree (use after consciously fixing or accepting findings —
+the tier-1 test asserts the file never grows).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    if str(_REPO) not in sys.path:  # direct script invocation
+        sys.path.insert(0, str(_REPO))
+    from tools.lint import analyze
+    from tools.lint import baseline as bl
+
+    parser = argparse.ArgumentParser(
+        prog="lhlint",
+        description="lighthouse-tpu concurrency & dispatch-discipline "
+                    "static analyzer")
+    parser.add_argument("--root", type=pathlib.Path,
+                        default=_REPO / "lighthouse_tpu",
+                        help="package root to analyze")
+    parser.add_argument("--readme", type=pathlib.Path,
+                        default=_REPO / "README.md",
+                        help="README checked by the env-registry pass")
+    parser.add_argument("--baseline", type=pathlib.Path,
+                        default=pathlib.Path(__file__).parent
+                        / "baseline.json")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite baseline.json from the current tree")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baseline ignored")
+    args = parser.parse_args(argv)
+
+    findings = analyze(args.root, readme=args.readme)
+
+    if args.update_baseline:
+        data = bl.save(args.baseline, findings)
+        print(f"lhlint: baseline updated — {len(data)} key(s), "
+              f"{len(findings)} finding(s) at {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(f"lhlint: {len(findings)} finding(s), baseline ignored")
+        return 1 if findings else 0
+
+    new, stale = bl.compare(findings, bl.load(args.baseline))
+    for f in new:
+        print(f"lhlint: NEW {f.render()}", file=sys.stderr)
+    for key, unused in stale.items():
+        print(f"lhlint: stale baseline entry ({unused} unused): {key} — "
+              f"run --update-baseline to shrink", file=sys.stderr)
+    if new:
+        print(f"lhlint: FAILED — {len(new)} new finding(s) "
+              f"({len(findings)} total, "
+              f"{len(findings) - len(new)} baselined)", file=sys.stderr)
+        return 1
+    print(f"lhlint: ok ({len(findings)} baselined finding(s), "
+          f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Pass 4 — LHTPU_* env-var registry (LH401, LH402).
+
+Every ``LHTPU_*`` knob must be declared once in
+``lighthouse_tpu/common/env.py`` (name, default, description) so the
+tuning surface is enumerable and documented.  This pass:
+
+- **LH401 unregistered-env**: flags any ``os.environ[...]`` /
+  ``os.environ.get`` / ``os.getenv`` read of a literal ``LHTPU_*`` name
+  that is not ``_register``-ed in the registry module (the registry
+  itself is exempt — it is the one place allowed to touch environ).
+- **LH402 env-readme-drift**: flags registry entries whose name does
+  not appear in the README, README mentions of ``LHTPU_*`` names that
+  are not registered (a deleted knob must lose its README row), and
+  registrations missing a description.
+
+The registry is parsed with ``ast`` — never imported — so the analyzer
+stays independent of the package's import-time behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint import Context, Finding
+from tools.lint.callgraph import dotted_name
+
+REGISTRY_MODULE = "common/env.py"
+ENV_PREFIX = "LHTPU_"
+
+_READ_DOTTED = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+
+
+def _registered_names(module) -> dict[str, tuple[int, bool]]:
+    """name -> (line, has_description) from _register(...) calls."""
+    out: dict[str, tuple[int, bool]] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_register"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        desc_ok = (len(node.args) >= 3
+                   and isinstance(node.args[2], ast.Constant)
+                   and isinstance(node.args[2].value, str)
+                   and bool(node.args[2].value.strip()))
+        out[node.args[0].value] = (node.lineno, desc_ok)
+    return out
+
+
+def _env_reads(module) -> list[tuple[str, int]]:
+    """(name, line) for every literal LHTPU_* environ read."""
+    reads: list[tuple[str, int]] = []
+    for node in ast.walk(module.tree):
+        name = None
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func)
+            if dotted in _READ_DOTTED and node.args:
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    name = arg.value
+        elif isinstance(node, ast.Subscript):
+            base = dotted_name(node.value)
+            if base in ("os.environ", "environ"):
+                sl = node.slice
+                if (isinstance(sl, ast.Constant)
+                        and isinstance(sl.value, str)):
+                    name = sl.value
+        if name is not None and name.startswith(ENV_PREFIX):
+            reads.append((name, node.lineno))
+    return reads
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    registry = ctx.by_pkg_rel.get(REGISTRY_MODULE)
+    registered = _registered_names(registry) if registry else {}
+
+    for module in ctx.modules:
+        if module.pkg_rel == REGISTRY_MODULE:
+            continue
+        for name, line in _env_reads(module):
+            if name in registered:
+                continue
+            if ctx.suppressed(module, "LH401", "unregistered-env", line):
+                continue
+            findings.append(Finding(
+                "LH401", "unregistered-env", module.rel, line, name,
+                f"env read of {name} not registered in "
+                f"lighthouse_tpu/{REGISTRY_MODULE} — add a _register() "
+                f"entry (and prefer reading through common.env)"))
+
+    if registry is not None:
+        readme_text = None
+        if ctx.readme is not None and ctx.readme.exists():
+            readme_text = ctx.readme.read_text()
+        for name, (line, desc_ok) in sorted(registered.items()):
+            if not desc_ok and not ctx.suppressed(
+                    registry, "LH402", "env-readme-drift", line):
+                findings.append(Finding(
+                    "LH402", "env-readme-drift", registry.rel, line,
+                    f"{name}:description",
+                    f"{name} registered without a description"))
+            # whole-name match: LHTPU_BLS must not count as documented
+            # because LHTPU_BLS_CHUNK appears in the table
+            documented = readme_text is not None and re.search(
+                rf"\b{re.escape(name)}\b(?!_)", readme_text)
+            if (readme_text is not None and not documented
+                    and not ctx.suppressed(registry, "LH402",
+                                           "env-readme-drift", line)):
+                findings.append(Finding(
+                    "LH402", "env-readme-drift", registry.rel, line,
+                    name,
+                    f"{name} is registered but undocumented in "
+                    f"{ctx.readme.name} — regenerate the env-var table"))
+        # the reverse direction: a README mention of a knob that no
+        # longer exists in the registry is stale documentation
+        if readme_text is not None:
+            for name in sorted(set(re.findall(
+                    rf"{ENV_PREFIX}\w+", readme_text))):
+                if name not in registered:
+                    findings.append(Finding(
+                        "LH402", "env-readme-drift", registry.rel, 0,
+                        f"readme:{name}",
+                        f"{ctx.readme.name} documents {name}, which is "
+                        f"not registered in lighthouse_tpu/"
+                        f"{REGISTRY_MODULE} — remove the stale row or "
+                        f"register it"))
+    return findings

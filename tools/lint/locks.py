@@ -1,0 +1,302 @@
+"""Pass 1 — lock discipline (LH101 / LH102 / LH103).
+
+PR 2's contract: the import/queue locks are held only for prepare and
+commit; device work, sleeps and I/O run unlocked.  This pass walks
+every ``with <lock>:`` body in the modules that own those locks and
+flags blocking operations reachable from the body — directly, or
+through up to ``MAX_DEPTH`` statically resolvable calls on the package
+call graph.  Separately (and package-wide) it records every lexically
+nested lock-acquisition pair and flags A→B / B→A cycles.
+
+A context expression counts as a lock when its terminal identifier
+contains "lock" (``self._import_lock``, ``_BLIND_LOCK``, ``self.lock``).
+Blocking classification is by name, not by type inference: the
+primitive sets below can only miss renamed primitives, not invent
+false structure.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Context, Finding
+from tools.lint.callgraph import CallSite, dotted_name
+
+# with-lock bodies are scanned in the modules that own the hot-path
+# locks; the call graph underneath spans the whole package
+TARGET_MODULES = (
+    "chain/beacon_chain.py",
+    "processor/beacon_processor.py",
+    "store/hot_cold.py",
+)
+
+MAX_DEPTH = 3
+
+DEVICE_FETCH_DOTTED = {"jax.device_get", "jax.block_until_ready",
+                       "np.asarray", "numpy.asarray", "float"}
+DEVICE_FETCH_METHODS = {"block_until_ready", "item"}
+SLEEP_DOTTED = {"time.sleep", "sleep"}
+FILE_IO_NAMES = {"open"}
+SOCKET_METHODS = {"recv", "recvfrom", "accept", "connect", "sendall",
+                  "sendto"}
+# BLS/KZG verify entry points: seconds of device work per call
+BLS_ENTRY_NAMES = {
+    "verify_signature_sets", "verify_signature_sets_device",
+    "verify_signature_sets_sharded", "verify_sets_pipeline",
+    "verify_signature_sets_with_bisection", "batch_verify",
+    "validate_blobs", "verify_blob_kzg_proof_batch",
+    "multi_pairing_device", "multi_pairing_sharded",
+    "batch_subgroup_check_g1", "batch_subgroup_check_g2",
+    "aggregate_pubkeys_device",
+}
+
+
+def classify(site: CallSite) -> tuple[str, str, str] | None:
+    """-> (rule, rule-name, description) for blocking calls, else None."""
+    dotted = site.dotted
+    terminal = site.terminal
+    if terminal is None:
+        return None
+    if dotted in DEVICE_FETCH_DOTTED or (
+            "." in (dotted or "") and terminal in DEVICE_FETCH_METHODS):
+        return ("LH101", "blocking-under-lock",
+                f"device fetch `{dotted}`")
+    if dotted in SLEEP_DOTTED:
+        return ("LH101", "blocking-under-lock", f"`{dotted}` sleep")
+    if dotted in FILE_IO_NAMES:
+        return ("LH101", "blocking-under-lock", "file I/O `open`")
+    if "." in (dotted or "") and terminal in SOCKET_METHODS:
+        return ("LH101", "blocking-under-lock",
+                f"socket I/O `{dotted}`")
+    if terminal in BLS_ENTRY_NAMES:
+        return ("LH102", "bls-under-lock",
+                f"BLS/KZG verify entry `{dotted}`")
+    return None
+
+
+def _is_lock_expr(expr: ast.expr) -> str | None:
+    """Lock context-expression text, or None when not lock-shaped."""
+    text = dotted_name(expr)
+    if text is None and isinstance(expr, ast.Call):
+        # `with lock_factory():` — classify by the callee's name
+        text = dotted_name(expr.func)
+    if text is None:
+        return None
+    terminal = text.rsplit(".", 1)[-1]
+    return text if "lock" in terminal.lower() else None
+
+
+def _direct_calls(body_nodes: list[ast.stmt]) -> list[ast.Call]:
+    """Call nodes lexically within the statements, skipping nested
+    function/class bodies (their calls belong to those functions)."""
+    out: list[ast.Call] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            walk(child)
+
+    for stmt in body_nodes:
+        if isinstance(stmt, ast.Call):
+            out.append(stmt)
+        walk(stmt)
+    return out
+
+
+def _scan_reachable(ctx: Context, start_sites: list[CallSite],
+                    on_hit) -> None:
+    """BFS the call graph from the with-body's resolvable calls; invoke
+    ``on_hit(path, site, classification)`` for each blocking call found
+    in a visited function."""
+    queue = [(site.resolved, (site.terminal or site.dotted or "?",))
+             for site in start_sites if site.resolved]
+    seen: set[str] = set()
+    depth = 1
+    while queue and depth <= MAX_DEPTH:
+        next_queue = []
+        for key, path in queue:
+            if key in seen:
+                continue
+            seen.add(key)
+            info = ctx.graph.functions.get(key)
+            if info is None:
+                continue
+            for site in info.calls:
+                hit = classify(site)
+                if hit is not None:
+                    on_hit(path, info, site, hit)
+                elif site.resolved:
+                    next_queue.append(
+                        (site.resolved,
+                         path + (site.terminal or site.dotted or "?",)))
+        queue = next_queue
+        depth += 1
+
+
+def _with_lock_blocks(module) -> list[tuple[ast.AST, str, str]]:
+    """Every (with-node, lock-text, enclosing-qualname) in the module."""
+    out = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            new_stack = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                new_stack = stack + [child.name]
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    lock = _is_lock_expr(item.context_expr)
+                    if lock:
+                        out.append((child, lock,
+                                    ".".join(stack) or "<module>"))
+                        break
+            visit(child, new_stack)
+
+    visit(module.tree, [])
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    findings.extend(_blocking_under_locks(ctx))
+    findings.extend(_lock_order_cycles(ctx))
+    return findings
+
+
+def _blocking_under_locks(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for pkg_rel in TARGET_MODULES:
+        module = ctx.by_pkg_rel.get(pkg_rel)
+        if module is None:
+            continue
+        for with_node, lock_text, qual in _with_lock_blocks(module):
+            emitted: set[str] = set()
+
+            def emit(rule, name, line, symbol, message):
+                if symbol in emitted:
+                    return
+                emitted.add(symbol)
+                if ctx.suppressed(module, rule, name, line,
+                                  with_node.lineno):
+                    return
+                findings.append(Finding(rule, name, module.rel, line,
+                                        symbol, message))
+
+            body_calls = _direct_calls(with_node.body)
+            sites = []
+            for call in body_calls:
+                site = _site_for(ctx, module, qual, call)
+                sites.append(site)
+                hit = classify(site)
+                if hit is not None:
+                    rule, name, desc = hit
+                    emit(rule, name, call.lineno,
+                         f"{qual}:{site.terminal}",
+                         f"{desc} inside `with {lock_text}:`")
+
+            def on_hit(path, info, site, hit, _lock=lock_text,
+                       _qual=qual, _emit=emit, _line=with_node.lineno):
+                rule, name, desc = hit
+                chain = "->".join(path)
+                _emit(rule, name, _line,
+                      f"{_qual}:{chain}->{site.terminal}",
+                      f"{desc} reachable under `with {_lock}:` via "
+                      f"{chain} ({info.module.rel}:{site.line})")
+
+            _scan_reachable(ctx, sites, on_hit)
+    return findings
+
+
+def _site_for(ctx: Context, module, qual: str, call: ast.Call) -> CallSite:
+    """Match a with-body call back to the enclosing function's resolved
+    call sites (the graph already did the import resolution)."""
+    info = ctx.graph.functions.get(f"{module.pkg_rel}::{qual}")
+    if info is not None:
+        for site in info.calls:
+            if site.node is call:
+                return site
+    return CallSite(call.lineno, dotted_name(call.func), None, call)
+
+
+def _lock_identity(module, lock_text: str) -> str:
+    """Baseline identity for lock-order matching.
+
+    Module-level lock constants are routinely shared across modules
+    (defined in one, imported or module-qualified in another), so bare
+    names and CONSTANT_CASE terminals match package-wide on their
+    unqualified name; instance locks (``self._lock`` and friends) stay
+    module-prefixed — two classes' private ``self._lock`` attributes
+    are different locks."""
+    terminal = lock_text.rsplit(".", 1)[-1]
+    if "." not in lock_text:
+        return terminal                 # bare global: package-wide
+    if terminal.upper() == terminal:    # alias.DB_LOCK style constant
+        return terminal
+    return f"{module.pkg_rel}:{lock_text}"
+
+
+def _lock_order_cycles(ctx: Context) -> list[Finding]:
+    # ordered nesting pairs: (outer id, inner id) -> first site
+    pairs: dict[tuple[str, str], tuple[object, int, str]] = {}
+    for module in ctx.modules:
+        for with_node, lock_text, qual in _with_lock_blocks(module):
+            outer_id = _lock_identity(module, lock_text)
+            # multiple locks in one `with a, b:` nest left-to-right
+            items = [t for t in (_is_lock_expr(i.context_expr)
+                                 for i in with_node.items) if t]
+            for inner_text in items[1:]:
+                _note_pair(pairs, module, qual, with_node.lineno,
+                           outer_id, _lock_identity(module, inner_text))
+            for inner, inner_text, _q in _with_lock_blocks_in(
+                    with_node.body, module):
+                _note_pair(pairs, module, qual, inner.lineno,
+                           outer_id, _lock_identity(module, inner_text))
+    findings: list[Finding] = []
+    for (a, b), (module, line, qual) in sorted(pairs.items()):
+        if a == b or (b, a) not in pairs:
+            continue
+        if ctx.suppressed(module, "LH103", "lock-order-cycle", line):
+            continue
+        findings.append(Finding(
+            "LH103", "lock-order-cycle", module.rel, line,
+            f"{qual}:{a.split(':', 1)[-1]}->{b.split(':', 1)[-1]}",
+            f"lock order {a} -> {b} conflicts with the reverse nesting "
+            f"elsewhere (deadlock risk)"))
+    return findings
+
+
+def _with_lock_blocks_in(body: list[ast.stmt], module):
+    """Nested with-lock blocks lexically inside the given statements
+    (including the statements themselves)."""
+    out = []
+
+    def note(node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lock = _is_lock_expr(item.context_expr)
+                if lock:
+                    out.append((node, lock, ""))
+                    break
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            note(child)
+            visit(child)
+
+    for stmt in body:
+        note(stmt)
+        visit(stmt)
+    return out
+
+
+def _note_pair(pairs, module, qual, line, outer_id, inner_id):
+    key = (outer_id, inner_id)
+    if key not in pairs:
+        pairs[key] = (module, line, qual)

@@ -1,0 +1,165 @@
+"""Pass 5 — metric-name discipline (LH501), absorbed from
+tools/check_metrics.py (which remains as a compat shim).
+
+Walks the package, collects every REGISTRY registration, and flags:
+
+- dynamic metric names (f-strings/concatenation): unbounded series
+  cardinality belongs in LABELS, not in the metric name;
+- names not matching ``[a-z][a-z0-9_]*`` (Prometheus-safe subset);
+- one name registered as two different metric kinds (counter vs gauge
+  vs histogram): the registry's get-or-create would silently return
+  the first kind;
+- one name registered from more than one module: series ownership must
+  be unambiguous (share a handle or a helper instead);
+- a name under a PINNED family prefix registered outside that family's
+  owner module (FAMILY_OWNERS below): cross-layer consumers must go
+  through the owner's helpers, never re-register the series.
+
+``collect()`` keeps the original (regs, errors) shape so the
+check_metrics shim and its tests stay byte-compatible; ``run()`` wraps
+the errors as lhlint findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+KINDS = ("counter", "gauge", "histogram")
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# family prefix -> sole owner module (repo-relative).  The dispatch
+# pipeline's bls_pipeline_* series are recorded from the BLS backends AND
+# the beacon processor; pinning the owner here keeps every registration
+# funneled through ops/dispatch_pipeline's record_* helpers.
+FAMILY_OWNERS = {
+    "bls_pipeline_": "lighthouse_tpu/ops/dispatch_pipeline.py",
+    "bls_verify_": "lighthouse_tpu/crypto/bls/api.py",
+    "bls_cache_": "lighthouse_tpu/crypto/bls/api.py",
+}
+
+
+def _scan_tree(rel: str, tree, regs, errors) -> None:
+    """One file's REGISTRY registrations -> regs/errors (shared by the
+    path-based collect() and the pre-parsed lhlint run())."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in KINDS):
+            continue
+        base = func.value
+        # REGISTRY.counter(...) and reg.counter(...) alike: any
+        # receiver whose name ends with "registry" (case-insensitive)
+        if not (isinstance(base, ast.Name)
+                and base.id.lower().endswith("registry")):
+            continue
+        loc = f"{rel}:{node.lineno}"
+        if not node.args:
+            errors.append(f"{loc}: {func.attr}() with no name argument")
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            errors.append(
+                f"{loc}: dynamic metric name {ast.unparse(arg)!r} — "
+                "move the variable part into .labels(...)")
+            continue
+        name = arg.value
+        if not NAME_RE.match(name):
+            errors.append(f"{loc}: invalid metric name {name!r} "
+                          "(must match [a-z][a-z0-9_]*)")
+        regs.setdefault(name, set()).add((func.attr, rel))
+
+
+def collect(package_root: pathlib.Path):
+    """-> (registrations {name: set[(kind, module)]}, errors [str])."""
+    regs: dict[str, set[tuple[str, str]]] = {}
+    errors: list[str] = []
+    package_root = pathlib.Path(package_root)
+    for path in sorted(package_root.rglob("*.py")):
+        rel = path.relative_to(package_root.parent)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            errors.append(f"{rel}: unparseable: {e}")
+            continue
+        _scan_tree(str(rel), tree, regs, errors)
+    _cross_checks(regs, errors)
+    return regs, errors
+
+
+def _cross_checks(regs, errors) -> None:
+    for name in sorted(regs):
+        sites = regs[name]
+        kinds = sorted({k for k, _ in sites})
+        if len(kinds) > 1:
+            errors.append(f"{name}: registered as multiple kinds {kinds}")
+        modules = sorted({m for _, m in sites})
+        if len(modules) > 1:
+            errors.append(
+                f"{name}: registered from multiple modules {modules}")
+        for prefix, owner in FAMILY_OWNERS.items():
+            if name.startswith(prefix):
+                outside = [m for m in modules
+                           if not m.replace("\\", "/").endswith(owner)]
+                if outside:
+                    errors.append(
+                        f"{name}: family {prefix}* is owned by {owner}, "
+                        f"but registered from {outside}")
+
+
+_LOC_RE = re.compile(r"^(?P<file>[^:]+\.py):(?P<line>\d+): (?P<msg>.*)$",
+                     re.DOTALL)
+
+
+def run(ctx) -> list:
+    """lhlint pass wrapper: collect() errors -> LH501 findings."""
+    from tools.lint import Finding
+
+    # reuse the Context's already-parsed trees — no second rglob/parse
+    # of the package (unparseable files are LH001 from load_package)
+    regs: dict[str, set[tuple[str, str]]] = {}
+    errors: list[str] = []
+    for module in ctx.modules:
+        _scan_tree(module.rel, module.tree, regs, errors)
+    _cross_checks(regs, errors)
+    findings = []
+    pkg_file = ctx.pkg_root.name
+    for err in errors:
+        m = _LOC_RE.match(err)
+        if m:
+            file, line, msg = (m.group("file").replace("\\", "/"),
+                               int(m.group("line")), m.group("msg"))
+            symbol = re.sub(r"\d+", "", msg)[:80]
+            # honor inline suppression at the flagged line
+            pkg_rel = file.split("/", 1)[1] if "/" in file else file
+            module = ctx.by_pkg_rel.get(pkg_rel)
+            if module is not None and ctx.suppressed(
+                    module, "LH501", "metric-discipline", line):
+                continue
+        else:
+            file, line, msg = pkg_file, 0, err
+            symbol = re.sub(r"\d+", "", err)[:80]
+        findings.append(Finding("LH501", "metric-discipline", file, line,
+                                symbol, msg))
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    """The original check_metrics CLI (kept for the compat shim)."""
+    root = pathlib.Path(
+        argv[1] if len(argv) > 1
+        else pathlib.Path(__file__).resolve().parent.parent.parent
+        / "lighthouse_tpu")
+    regs, errors = collect(root)
+    for err in errors:
+        print(f"check_metrics: {err}", file=sys.stderr)
+    if errors:
+        print(f"check_metrics: FAILED ({len(errors)} problem(s), "
+              f"{len(regs)} metric(s) scanned)", file=sys.stderr)
+        return 1
+    print(f"check_metrics: ok ({len(regs)} metric names)")
+    return 0

@@ -1,0 +1,162 @@
+"""Pass 3 — shape / jit discipline (LH301, LH302).
+
+XLA compiles of the fused pipelines cost minutes per shape on CPU; the
+repo-local ``.jax_cache`` only stays warm when jit programs and their
+shapes are stable.  Two ways that regresses:
+
+- **LH301 traced-python-branch**: Python ``if``/``while`` on a traced
+  parameter of a jitted function.  Tracing either fails outright or —
+  worse — silently bakes the branch into the compiled program so every
+  new truth value recompiles.  Parameters named in ``static_argnums`` /
+  ``static_argnames`` are exempt (branching on statics is the point).
+- **LH302 jit-in-function**: ``jax.jit(...)`` constructed inside a
+  function body.  A fresh jit wrapper per call means a fresh compile
+  per call.  Exempt when the enclosing function visibly memoizes — it
+  stores into a ``*CACHE*``-named mapping or declares a ``global``
+  (the module-level-singleton pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Context, Finding
+from tools.lint.callgraph import dotted_name
+
+
+def _jit_decoration(node) -> tuple[bool, set[str]]:
+    """(is_jitted, static_param_names) from the decorator list."""
+    args = [a.arg for a in node.args.posonlyargs + node.args.args]
+    for dec in node.decorator_list:
+        d = dotted_name(dec)
+        if d in ("jax.jit", "jit"):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            fn = dotted_name(dec.func)
+            statics: set[str] = set()
+            target = None
+            if fn in ("jax.jit", "jit"):
+                target = dec
+            elif fn in ("partial", "functools.partial") and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner in ("jax.jit", "jit"):
+                    target = dec
+            if target is None:
+                continue
+            for kw in target.keywords:
+                if kw.arg == "static_argnums":
+                    for idx in _const_ints(kw.value):
+                        if 0 <= idx < len(args):
+                            statics.add(args[idx])
+                elif kw.arg == "static_argnames":
+                    statics.update(_const_strs(kw.value))
+            return True, statics
+    return False, set()
+
+
+def _const_ints(node) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_ints(elt))
+        return out
+    return []
+
+
+def _const_strs(node) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_strs(elt))
+        return out
+    return []
+
+
+def _names_in(node) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for module in ctx.modules:
+        findings.extend(_traced_branches(ctx, module))
+        findings.extend(_jit_in_functions(ctx, module))
+    return findings
+
+
+def _traced_branches(ctx: Context, module) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jitted, statics = _jit_decoration(node)
+        if not jitted:
+            continue
+        params = {a.arg for a in node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs} - statics - {"self"}
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            traced = sorted(_names_in(stmt.test) & params)
+            if not traced:
+                continue
+            if ctx.suppressed(module, "LH301", "traced-python-branch",
+                              stmt.lineno):
+                continue
+            kind = "if" if isinstance(stmt, ast.If) else "while"
+            findings.append(Finding(
+                "LH301", "traced-python-branch", module.rel, stmt.lineno,
+                f"{node.name}:{kind}:{','.join(traced)}",
+                f"Python `{kind}` on traced parameter(s) "
+                f"{', '.join(traced)} of jitted `{node.name}` — mark "
+                f"them static_argnums or use lax.cond/while_loop"))
+    return findings
+
+
+def _jit_in_functions(ctx: Context, module) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str], fn_node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, stack + [child.name], child)
+                continue
+            if isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name], fn_node)
+                continue
+            if (isinstance(child, ast.Call)
+                    and dotted_name(child.func) in ("jax.jit", "jit")
+                    and fn_node is not None
+                    and not _memoizes(fn_node)):
+                qual = ".".join(stack)
+                if not ctx.suppressed(module, "LH302", "jit-in-function",
+                                      child.lineno):
+                    findings.append(Finding(
+                        "LH302", "jit-in-function", module.rel,
+                        child.lineno, f"{qual}:jit",
+                        f"`jax.jit` constructed per-call inside "
+                        f"`{qual}` with no visible memo — hoist to "
+                        f"module level or store in a *_CACHE map"))
+            visit(child, stack, fn_node)
+
+    visit(module.tree, [], None)
+    return findings
+
+
+def _memoizes(fn_node) -> bool:
+    """Heuristic: the function stores into a *CACHE*-named mapping or
+    declares a global (module-singleton memo pattern)."""
+    for stmt in ast.walk(fn_node):
+        if isinstance(stmt, ast.Global):
+            return True
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and "CACHE" in tgt.value.id.upper()):
+                    return True
+    return False
