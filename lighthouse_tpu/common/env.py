@@ -76,8 +76,74 @@ _register("LHTPU_ISOLATED", None,
           "Set by the test conftest in per-file child processes; marks "
           "a child so it runs tests in-process instead of re-forking.")
 
+# -- fault injection + offload supervisor (ops/faults, crypto/bls/api,
+#    processor/beacon_processor) ----------------------------------------------
+
+_register("LHTPU_FAULT_MODE", None,
+          "Inject device faults (raise|hang|corrupt|compile) at the "
+          "instrumented offload sites (ops/faults); unset disables "
+          "injection.")
+_register("LHTPU_FAULT_SITE", "tpu",
+          "Comma-separated sites the injected fault fires at "
+          "(tpu, sharded, chunk, subgroup, verdict).")
+_register("LHTPU_FAULT_INDICES", None,
+          "Comma-separated chunk/batch indices the fault fires at; "
+          "unset = every matching hit.")
+_register("LHTPU_FAULT_HANG_S", "30",
+          "Stall seconds for mode=hang before the injected fault is "
+          "raised (the watchdog should cut the stall off first).")
+_register("LHTPU_FAULT_MAX_FIRES", None,
+          "Stop injecting after N fires; unset = unlimited.")
+_register("LHTPU_SUPERVISOR", "1",
+          "0 disables the BLS offload supervisor (watchdog, backend "
+          "health ladder, reference recovery) — device backends are "
+          "then called directly and their faults propagate.")
+_register("LHTPU_WATCHDOG_S", "900",
+          "Watchdog deadline in seconds for one supervised device batch "
+          "and for deferred verdict fetches; 0 disables the deadline.")
+_register("LHTPU_SUPERVISOR_AUDIT", "0",
+          "Probability [0..1] that a supervised device verdict is "
+          "cross-checked against the reference backend (a mismatch "
+          "counts as a corrupt-verdict fault and opens the circuit).")
+_register("LHTPU_SUPERVISOR_FAILS", "1",
+          "Consecutive device-backend faults that open its circuit "
+          "breaker.")
+_register("LHTPU_SUPERVISOR_BACKOFF_S", "1",
+          "Initial circuit-breaker backoff seconds; doubles on every "
+          "re-open (half-open probe failure).")
+_register("LHTPU_SUPERVISOR_BACKOFF_MAX_S", "60",
+          "Circuit-breaker backoff ceiling in seconds.")
+_register("LHTPU_SUPERVISOR_LADDER", "tpu,sharded,reference",
+          "Degradation ladder for supervised batch verification, "
+          "healthiest first; reference is always the implicit last "
+          "rung.")
+_register("LHTPU_DISPATCH_WEDGE_S", "600",
+          "Beacon-processor dispatch-thread wedge deadline in seconds; "
+          "0 disables the dispatch-thread supervisor.")
+_register("LHTPU_DISPATCH_RESTART_MAX", "3",
+          "Dispatch-thread restarts allowed per window before batch "
+          "work pins to the synchronous worker-pool path.")
+_register("LHTPU_DISPATCH_RESTART_WINDOW_S", "300",
+          "Restart-storm window seconds for the dispatch-thread "
+          "limiter.")
+
 
 # -- typed readers ------------------------------------------------------------
+
+# operator typos must not be silent: an unparseable SET value falls back,
+# but says so once (per name per process) on stderr — stdlib-only module,
+# so no structured logger here
+_WARNED_UNPARSEABLE: set[str] = set()
+
+
+def _warn_unparseable(name: str, val: str, expected: str) -> None:
+    if name in _WARNED_UNPARSEABLE:
+        return
+    _WARNED_UNPARSEABLE.add(name)
+    import sys
+
+    print(f"lighthouse_tpu: ignoring unparseable {name}={val!r} "
+          f"(expected {expected}); using the fallback", file=sys.stderr)
 
 
 def get(name: str) -> str | None:
@@ -90,14 +156,46 @@ def get(name: str) -> str | None:
 
 
 def get_int(name: str, fallback: int | None = None) -> int | None:
-    """Integer value, or ``fallback`` when unset or unparseable."""
+    """Integer value, or ``fallback`` when unset or unparseable (a set
+    but unparseable value warns once on stderr)."""
     val = get(name)
     if val is None:
         return fallback
     try:
         return int(val)
     except ValueError:
+        _warn_unparseable(name, val, "an integer")
         return fallback
+
+
+def get_float(name: str, fallback: float | None = None) -> float | None:
+    """Float value, or ``fallback`` when unset or unparseable."""
+    val = get(name)
+    if val is None:
+        return fallback
+    try:
+        return float(val)
+    except ValueError:
+        _warn_unparseable(name, val, "a number")
+        return fallback
+
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off", "")
+
+
+def get_bool(name: str, fallback: bool | None = None) -> bool | None:
+    """Boolean value, or ``fallback`` when unset or unparseable."""
+    val = get(name)
+    if val is None:
+        return fallback
+    low = val.strip().lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    _warn_unparseable(name, val, "a boolean (1/0/true/false)")
+    return fallback
 
 
 def table() -> list[EnvVar]:

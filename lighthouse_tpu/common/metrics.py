@@ -201,3 +201,34 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+# -- swallowed-error accounting -----------------------------------------------
+# Some offload-path sites deliberately survive internal errors (metric
+# recording inside a verifier, worker exceptions inside the manager
+# loop).  "Deliberately non-fatal" must not mean invisible: every such
+# site routes through record_swallowed, which counts the error under
+# offload_swallowed_errors_total{site} (this module is the family's
+# single owner) and prints the FIRST occurrence per site to stderr.
+
+_SWALLOWED_LOGGED: set[str] = set()
+
+
+def record_swallowed(site: str, exc: BaseException) -> None:
+    """Account one swallowed (non-fatal by design) error at ``site``."""
+    try:
+        REGISTRY.counter(
+            "offload_swallowed_errors_total",
+            "errors swallowed (non-fatal by design) on the offload path, "
+            "by site",
+        ).labels(site=site).inc()
+    except Exception:
+        pass  # the terminal sink: accounting must never re-raise
+    if site not in _SWALLOWED_LOGGED:
+        _SWALLOWED_LOGGED.add(site)
+        import sys
+
+        print(f"lighthouse_tpu: swallowed {type(exc).__name__} at {site}: "
+              f"{exc} (logged once; further occurrences counted in "
+              f'offload_swallowed_errors_total{{site="{site}"}})',
+              file=sys.stderr)

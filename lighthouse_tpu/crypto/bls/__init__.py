@@ -12,9 +12,11 @@ from lighthouse_tpu.crypto.bls.api import (
     Signature,
     SignatureSet,
     aggregate_verify,
+    backend_health,
     fast_aggregate_verify,
     get_backend,
     register_backend,
+    reset_supervisor,
     resolve_auto_backend,
     set_backend,
     verify,
@@ -24,7 +26,8 @@ from lighthouse_tpu.crypto.bls.hash_to_curve import DST_G2, hash_to_g2
 
 __all__ = [
     "BlsError", "PublicKey", "SecretKey", "Signature", "SignatureSet",
-    "aggregate_verify", "fast_aggregate_verify", "get_backend",
-    "register_backend", "resolve_auto_backend", "set_backend", "verify", "verify_signature_sets",
+    "aggregate_verify", "backend_health", "fast_aggregate_verify",
+    "get_backend", "register_backend", "reset_supervisor",
+    "resolve_auto_backend", "set_backend", "verify", "verify_signature_sets",
     "DST_G2", "hash_to_g2",
 ]

@@ -20,7 +20,9 @@ Batch semantics mirror blst's verify_multiple_aggregate_signatures
 
 from __future__ import annotations
 
+import random
 import secrets
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Sequence
@@ -449,13 +451,18 @@ def register_backend(name: str, fn: Callable[[Sequence[SignatureSet]], bool]):
 
 
 def _resolve_backend(name: str) -> Callable[[Sequence[SignatureSet]], bool]:
-    if name == "tpu" and name not in _BACKENDS:
-        # lazy registration: importing the device backend pulls in jax
+    if name in ("tpu", "sharded") and name not in _BACKENDS:
+        # lazy registration: importing a device backend pulls in jax
         # (explicit re-register in case the module was already imported)
         import importlib
 
-        mod = importlib.import_module("lighthouse_tpu.ops.bls_backend")
-        _BACKENDS.setdefault("tpu", mod.verify_signature_sets_device)
+        if name == "tpu":
+            mod = importlib.import_module("lighthouse_tpu.ops.bls_backend")
+            _BACKENDS.setdefault("tpu", mod.verify_signature_sets_device)
+        else:
+            mod = importlib.import_module(
+                "lighthouse_tpu.parallel.bls_sharded")
+            _BACKENDS.setdefault("sharded", mod.verify_signature_sets_sharded)
     try:
         return _BACKENDS[name]
     except KeyError:
@@ -494,6 +501,296 @@ def resolve_auto_backend() -> str:
     return "tpu" if platform == "tpu" else "reference"
 
 
+# --- offload supervisor: backend health ladder + crash-safe recovery ---------
+#
+# A single device fault (XLA compile error, wedged kernel, relay drop,
+# corrupt readback) must never surface to a verification caller as an
+# exception or a wrong verdict: consensus work bounds LIVENESS on
+# verification availability, not just throughput.  The supervisor wraps
+# the device backends ("tpu", "sharded") behind:
+#
+# - a per-backend CIRCUIT BREAKER: closed -> open (exponential backoff)
+#   -> half-open probe -> closed, so a faulting backend is benched and
+#   automatically re-promoted after a successful probe;
+# - a WATCHDOG: each supervised batch runs on a daemon thread with an
+#   LHTPU_WATCHDOG_S deadline — a hang becomes a recoverable
+#   WatchdogTimeout instead of a stuck verifier (the wedged thread is
+#   abandoned; its late result is discarded);
+# - CRASH-SAFE RECOVERY: on any fault the batch is re-verified on the
+#   pure-Python reference backend, the ladder's terminal rung, which is
+#   authoritative and never circuit-broken — callers always get a
+#   correct verdict, never a torn partial;
+# - an optional AUDIT (LHTPU_SUPERVISOR_AUDIT probability): a device
+#   verdict is cross-checked against the reference; a mismatch counts
+#   as a corrupt-verdict fault, opens the circuit, and the reference
+#   verdict is returned.
+#
+# Health is observable as bls_backend_health{backend,state} gauges and
+# zero-duration "bls.backend_health" slot-timeline trace events (the
+# PR 1 tracing ring), faults as bls_supervisor_faults_total{backend,kind}.
+
+from lighthouse_tpu.ops import faults as _faults  # stdlib-only module
+
+_DEVICE_BACKENDS = ("tpu", "sharded")
+_HEALTH_STATES = ("closed", "open", "half_open")
+
+_FAULT_LOGGED: set[tuple[str, str]] = set()
+
+
+def _set_health_gauge(backend: str, state: str) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        g = REGISTRY.gauge(
+            "bls_backend_health",
+            "backend circuit-breaker state (1 = current): "
+            "closed|open|half_open")
+        for st in _HEALTH_STATES:
+            g.labels(backend=backend, state=st).set(
+                1.0 if st == state else 0.0)
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.supervisor.health_gauge", e)
+
+
+def _note_transition(backend: str, old: str, new: str) -> None:
+    _set_health_gauge(backend, new)
+    from lighthouse_tpu.common import tracing
+
+    # zero-duration event in the slot timeline: health flips show up in
+    # the same per-slot breakdown as the batches they affected
+    with tracing.span("bls.backend_health", backend=backend,
+                      transition=f"{old}->{new}"):
+        pass
+
+
+def _record_fault(backend: str, kind: str, exc: BaseException | None) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "bls_supervisor_faults_total",
+            "device-backend faults absorbed by the offload supervisor, "
+            "by backend and kind",
+        ).labels(backend=backend, kind=kind).inc()
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.supervisor.fault_counter", e)
+    if (backend, kind) not in _FAULT_LOGGED:
+        _FAULT_LOGGED.add((backend, kind))
+        import sys
+
+        print(f"lighthouse_tpu: BLS backend {backend!r} fault ({kind}): "
+              f"{exc!r} — degrading; further occurrences counted in "
+              f"bls_supervisor_faults_total", file=sys.stderr)
+
+
+def _record_recovery(entry_backend: str) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "bls_supervisor_recoveries_total",
+            "supervised batches served by the reference backend after "
+            "device faults or degradation, by requested backend",
+        ).labels(backend=entry_backend).inc()
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.supervisor.recovery_counter", e)
+
+
+class _CircuitBreaker:
+    """Per-backend health state machine.
+
+    closed (healthy) -> open on LHTPU_SUPERVISOR_FAILS consecutive
+    faults; open -> half_open when the backoff expires (exactly ONE
+    probe batch rides through); half_open -> closed on probe success,
+    or back to open with DOUBLED backoff (capped) on probe failure."""
+
+    def __init__(self, backend: str, fail_threshold: int,
+                 backoff_s: float, backoff_max_s: float):
+        self.backend = backend
+        self.fail_threshold = fail_threshold
+        self.base_backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.failures = 0
+        self.backoff_s = backoff_s
+        self.open_until = 0.0
+        _set_health_gauge(backend, "closed")
+
+    def allow(self) -> bool:
+        """May a batch be attempted on this backend right now?"""
+        transition = None
+        with self._lock:
+            if self.state == "closed":
+                ok = True
+            elif self.state == "open":
+                if time.monotonic() >= self.open_until:
+                    transition = (self.state, "half_open")
+                    self.state = "half_open"
+                    ok = True  # the probe
+                else:
+                    ok = False
+            else:  # half_open: a probe is already in flight elsewhere
+                ok = False
+        if transition is not None:
+            _note_transition(self.backend, *transition)
+        return ok
+
+    def record_success(self) -> None:
+        with self._lock:
+            old = self.state
+            self.state = "closed"
+            self.failures = 0
+            self.backoff_s = self.base_backoff_s
+        if old != "closed":
+            _note_transition(self.backend, old, "closed")
+
+    def record_failure(self, kind: str) -> None:
+        now = time.monotonic()
+        opened = None
+        with self._lock:
+            old = self.state
+            self.failures += 1
+            if old == "half_open" or self.failures >= self.fail_threshold:
+                self.state = "open"
+                self.open_until = now + self.backoff_s
+                if old == "half_open":  # failed probe: back off harder
+                    self.backoff_s = min(self.backoff_s * 2,
+                                         self.backoff_max_s)
+                if old != "open":
+                    opened = (old, "open")
+        if opened is not None:
+            _note_transition(self.backend, *opened)
+
+
+class _Supervisor:
+    """Config snapshot + breakers; rebuilt by :func:`reset_supervisor`."""
+
+    def __init__(self):
+        from lighthouse_tpu.common import env as envreg
+
+        self.enabled = envreg.get_bool("LHTPU_SUPERVISOR", True)
+        self.watchdog_s = envreg.get_float("LHTPU_WATCHDOG_S", 900.0)
+        self.audit = min(max(
+            envreg.get_float("LHTPU_SUPERVISOR_AUDIT", 0.0), 0.0), 1.0)
+        raw = envreg.get("LHTPU_SUPERVISOR_LADDER") or ""
+        ladder = [r.strip() for r in raw.split(",") if r.strip()]
+        self.ladder = ladder or ["tpu", "sharded", "reference"]
+        if "reference" not in self.ladder:
+            self.ladder.append("reference")
+        threshold = max(1, envreg.get_int("LHTPU_SUPERVISOR_FAILS", 1))
+        backoff = max(0.0, envreg.get_float(
+            "LHTPU_SUPERVISOR_BACKOFF_S", 1.0))
+        backoff_max = max(backoff, envreg.get_float(
+            "LHTPU_SUPERVISOR_BACKOFF_MAX_S", 60.0))
+        self.breakers = {
+            b: _CircuitBreaker(b, threshold, backoff, backoff_max)
+            for b in _DEVICE_BACKENDS}
+
+    def ladder_from(self, entry: str) -> list[str]:
+        if entry in self.ladder:
+            return self.ladder[self.ladder.index(entry):]
+        return [entry, "reference"]
+
+    def _should_audit(self) -> bool:
+        if self.audit >= 1.0:
+            return True
+        if self.audit <= 0.0:
+            return False
+        return random.random() < self.audit
+
+    def _call_with_watchdog(self, rung: str, fn, sets, kwargs):
+        timeout = self.watchdog_s
+        if not timeout or timeout <= 0:
+            return fn(sets, **kwargs)
+        return _faults.run_with_deadline(
+            lambda: fn(sets, **kwargs), timeout,
+            f"lhtpu-bls-{rung}", f"{rung} batch")
+
+    def verify(self, entry: str, sets, chunk_size) -> bool:
+        """Walk the health ladder from ``entry``; the reference rung is
+        the unconditional, never-raising terminal."""
+        kwargs = {} if chunk_size is None else {"chunk_size": chunk_size}
+        for rung in self.ladder_from(entry):
+            if rung == "reference":
+                break
+            breaker = self.breakers.get(rung)
+            if breaker is None or not breaker.allow():
+                continue  # benched (or unknown): next rung
+            try:
+                fn = _resolve_backend(rung)
+                ok = self._call_with_watchdog(rung, fn, sets, kwargs)
+            except Exception as e:
+                kind = _faults.classify(e)
+                breaker.record_failure(kind)
+                _record_fault(rung, kind, e)
+                continue
+            except BaseException:
+                # KeyboardInterrupt/SystemExit surfacing from the
+                # watchdog thread must propagate — but not leave a
+                # half-open probe wedged forever (allow() would return
+                # False with no backoff expiry to clear it)
+                breaker.record_failure("raise")
+                raise
+            from lighthouse_tpu.common import tracing
+
+            if self._should_audit():
+                ref = _verify_signature_sets_reference(sets)
+                if ref != ok:
+                    breaker.record_failure("corrupt")
+                    _record_fault(rung, "corrupt", None)
+                    _record_recovery(entry)
+                    tracing.add_attrs(served="reference")
+                    return ref
+            breaker.record_success()
+            tracing.add_attrs(served=rung)
+            return ok
+        # every device rung faulted or is benched: the in-flight sets are
+        # re-verified whole on the authoritative CPU path — the caller
+        # gets a correct verdict, never an exception or a torn partial
+        from lighthouse_tpu.common import tracing
+
+        _record_recovery(entry)
+        tracing.add_attrs(served="reference")
+        return _verify_signature_sets_reference(sets)
+
+
+_SUPERVISOR: _Supervisor | None = None
+_SUPERVISOR_LOCK = threading.Lock()
+
+
+def _get_supervisor() -> _Supervisor:
+    global _SUPERVISOR
+    s = _SUPERVISOR
+    if s is None:
+        with _SUPERVISOR_LOCK:
+            if _SUPERVISOR is None:
+                _SUPERVISOR = _Supervisor()
+            s = _SUPERVISOR
+    return s
+
+
+def reset_supervisor() -> None:
+    """Drop the supervisor singleton so the next verify re-reads the
+    LHTPU_SUPERVISOR_* / LHTPU_WATCHDOG_S knobs (tests; SIGHUP-style
+    reconfiguration)."""
+    global _SUPERVISOR
+    with _SUPERVISOR_LOCK:
+        _SUPERVISOR = None
+
+
+def backend_health() -> dict[str, str]:
+    """Current circuit-breaker state per device backend."""
+    sup = _get_supervisor()
+    return {b: br.state for b, br in sup.breakers.items()}
+
+
 def verify_signature_sets(
     sets: Sequence[SignatureSet], *, backend: str | None = None,
     chunk_size: int | None = None
@@ -511,11 +808,21 @@ def verify_signature_sets(
     monolithic single-dispatch path.  It is only forwarded when set, so
     custom-registered backends with a bare ``fn(sets)`` signature keep
     working.
+
+    Device backends ("tpu", "sharded") run SUPERVISED: watchdogged, on
+    the backend health ladder, and recovered onto the reference backend
+    on any fault — this call returns a correct verdict and never raises
+    for device-side reasons (see the supervisor block above; opt out
+    with LHTPU_SUPERVISOR=0).  Custom-registered backends and the
+    reference/fake backends are invoked directly, unchanged.
     """
     name = backend or _active_backend
     if name == "auto":
         name = resolve_auto_backend()
-    fn = _resolve_backend(name)
+    sup = _get_supervisor()
+    supervised = sup.enabled and name in _DEVICE_BACKENDS
+    if not supervised:
+        fn = _resolve_backend(name)
     kwargs = {} if chunk_size is None else {"chunk_size": chunk_size}
     record_batch(name, len(sets))
     try:
@@ -531,6 +838,9 @@ def verify_signature_sets(
         timer = nullcontext()
     from lighthouse_tpu.common import tracing
 
-    with tracing.span("bls.verify", backend=name, sets=len(sets)):
+    with tracing.span("bls.verify", backend=name, sets=len(sets),
+                      supervised=supervised):
         with timer:
+            if supervised:
+                return sup.verify(name, sets, chunk_size)
             return fn(sets, **kwargs)

@@ -13,7 +13,17 @@ import secrets
 import unicodedata
 import uuid
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+# `cryptography` is an optional dependency (AES-128-CTR only): importing
+# this module must not fail where it is absent — keystore tests
+# importorskip on it, and everything else in crypto/ stays usable.
+try:
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+        modes,
+    )
+except ImportError:  # pragma: no cover - environment-dependent
+    Cipher = algorithms = modes = None
 
 
 class KeystoreError(ValueError):
@@ -43,6 +53,11 @@ def _kdf(password: bytes, params: dict) -> bytes:
 
 
 def _aes128ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    if Cipher is None:
+        raise KeystoreError(
+            "the optional `cryptography` package is required for "
+            "EIP-2335 keystore encryption/decryption and is not "
+            "installed")
     cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
     enc = cipher.encryptor()
     return enc.update(data) + enc.finalize()

@@ -40,6 +40,7 @@ from lighthouse_tpu.crypto.bls import api, curve as cv
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import cache_guard
 from lighthouse_tpu.ops import ec
+from lighthouse_tpu.ops import faults
 from lighthouse_tpu.ops.bls12_381 import (
     batch_miller_loop,
     final_exp_hard_device,
@@ -319,7 +320,10 @@ def batch_subgroup_check_g1(points) -> np.ndarray:
     pts = list(points) + [cv.g1_generator()] * (padded - n)
     xp = jnp.asarray(ec.ints_to_mont_limbs([p[0] for p in pts]))
     yp = jnp.asarray(ec.ints_to_mont_limbs([p[1] for p in pts]))
-    ok = np.asarray(_g1_subgroup_kernel(xp, yp))
+    # deliberately outside the supervised verify path: startup-time
+    # trusted-setup validation and cold-pubkey checks are synchronous by
+    # contract and their callers handle errors directly
+    ok = np.asarray(_g1_subgroup_kernel(xp, yp))  # lhlint: allow(LH601)
     return ok[:n]
 
 
@@ -332,6 +336,7 @@ def _dispatch_subgroup_check(sigs):
     keeps running aggregate/limb prep while the kernel executes."""
     from lighthouse_tpu.ops import dispatch_pipeline as dp
 
+    faults.fire("subgroup")
     pending = [s for s in sigs if not s.subgroup_checked()]
     if not pending:
         return dp.AsyncVerdict.immediate(True)
@@ -501,7 +506,8 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     verdict = _dispatch_subgroup_check([s.signature for s in sets])
     if verdict is None:
         return False
-    if ledger is not None and not verdict.commit():
+    if ledger is not None and not verdict.commit(
+            timeout=dp.watchdog_deadline_s()):
         return False
     t0 = _mark("subgroup", t0)
 
@@ -545,7 +551,8 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     pipeline_s = 0.0
     overlap_s = 0.0
     inflight = False
-    for lo, hi in chunks:
+    for ci, (lo, hi) in enumerate(chunks):
+        faults.fire("chunk", index=ci)
         tc = _time.perf_counter()
         args = _chunk_layout(sets[lo:hi], sig_pts[lo:hi], h2cs[lo:hi],
                              pk_rows_x[lo:hi], pk_rows_y[lo:hi],
@@ -573,8 +580,9 @@ def _verify_sets_pipeline(sets: Sequence[api.SignatureSet],
     t0 = _time.perf_counter()
 
     # commit point: the subgroup verdict row is read only now, with the
-    # Miller chunks already in flight behind it in the device queue
-    if not verdict.commit():
+    # Miller chunks already in flight behind it in the device queue (a
+    # wedged kernel surfaces as WatchdogTimeout for the supervisor)
+    if not verdict.commit(timeout=dp.watchdog_deadline_s()):
         return False
     f = dp.combine_partials(partials)
     f_host = fq12_from_device(jax.device_get(f))
@@ -661,6 +669,11 @@ def verify_signature_sets_device(sets: Sequence[api.SignatureSet],
                                  chunk_size: int | None = None) -> bool:
     if not sets:
         return False
+    # the supervisor-visible dispatch boundary: an injected entry fault
+    # fires before ANY device work, and a corrupt-mode plan substitutes
+    # its verdict outright (modelling a device that returned garbage)
+    if faults.fire("tpu") == "corrupt":
+        return faults.corrupt_verdict()
     return verify_sets_pipeline(sets, chunk_size=chunk_size)
 
 

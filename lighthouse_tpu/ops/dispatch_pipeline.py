@@ -34,7 +34,8 @@ import numpy as np
 import jax
 
 from lighthouse_tpu.common import env as envreg
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+from lighthouse_tpu.ops import faults
 from lighthouse_tpu.ops.bls12_381 import _fp12_mul_q
 
 # default split point: batches at or below this verify single-shot (the
@@ -56,6 +57,21 @@ def chunk_size(override: int | None = None) -> int:
     if env is not None:
         return env
     return DEFAULT_CHUNK_SETS
+
+
+def watchdog_deadline_s() -> float | None:
+    """Per-fetch watchdog deadline for deferred verdicts (LHTPU_WATCHDOG_S);
+    None disables when: the value is 0; the caller already runs under an
+    outer watchdog thread (the supervisor's deadline covers the whole
+    batch, so a nested per-fetch thread would be pure churn); or the
+    supervisor is opted out entirely (LHTPU_SUPERVISOR=0 promises raw
+    pre-supervisor behavior — blocking fetches, no WatchdogTimeout)."""
+    if faults.under_watchdog():
+        return None
+    if envreg.get_bool("LHTPU_SUPERVISOR", True) is False:
+        return None
+    s = envreg.get_float("LHTPU_WATCHDOG_S", 0.0)
+    return s if s and s > 0 else None
 
 
 def plan_chunks(n: int, chunk: int) -> list[tuple[int, int]]:
@@ -102,12 +118,35 @@ class AsyncVerdict:
         v._result = bool(value)
         return v
 
-    def commit(self) -> bool:
-        """Read the verdict row (blocks until the kernel finishes)."""
+    def commit(self, timeout: float | None = None) -> bool:
+        """Read the verdict row (blocks until the kernel finishes).
+
+        With ``timeout`` (seconds), the blocking fetch runs on a helper
+        thread and a fetch that outlives the deadline raises
+        :class:`~lighthouse_tpu.ops.faults.WatchdogTimeout` — the seam
+        the offload supervisor uses to turn a wedged kernel into a
+        recoverable fault instead of a stuck verifier.  The abandoned
+        fetch thread is daemonic; its late result is discarded."""
         if self._result is None:
-            ok = np.asarray(self._dev_ok)[: self._n]
-            self._result = bool(ok.all())
-            if self._result and self._on_pass is not None:
+            mode = faults.fire("verdict")
+            if timeout is not None and timeout > 0:
+                def _fetch():
+                    return np.asarray(self._dev_ok)[: self._n]
+
+                ok = faults.run_with_deadline(
+                    _fetch, timeout, "lhtpu-verdict-fetch",
+                    "deferred verdict fetch")
+            else:
+                ok = np.asarray(self._dev_ok)[: self._n]
+            result = bool(ok.all())
+            if mode == "corrupt":
+                result = not result
+            self._result = result
+            # a corrupted flip must NOT run on_pass: marking signatures
+            # subgroup-checked off a falsified verdict would poison
+            # state beyond the injection's scope
+            if (self._result and self._on_pass is not None
+                    and mode != "corrupt"):
                 self._on_pass()
             self._dev_ok = None  # release the device buffer
         return self._result
@@ -150,8 +189,9 @@ def record_pipeline(chunks: int, overlap_s: float, lanes: int) -> None:
             "per batch",
             buckets=_OVERLAP_BUCKETS,
         ).observe(overlap_s)
-    except Exception:
-        pass  # metrics must never take down a verifier
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        # metrics must never take down a verifier — but say so, once
+        record_swallowed("dispatch_pipeline.record_pipeline", e)
 
 
 def record_inflight(n: int) -> None:
@@ -162,5 +202,5 @@ def record_inflight(n: int) -> None:
             "bls_pipeline_inflight_batches",
             "batches in flight on the dedicated dispatch executor",
         ).set(n)
-    except Exception:
-        pass
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        record_swallowed("dispatch_pipeline.record_inflight", e)

@@ -1,0 +1,276 @@
+"""Deterministic fault injection for the device offload path.
+
+The fault-tolerant supervisor (crypto/bls/api.py) is only trustworthy if
+its failure handling is exercised, and real device faults (XLA compile
+errors, wedged kernels, relay drops) are neither deterministic nor
+available on CI hardware.  This module is the switchboard: an installed
+:class:`FaultPlan` makes the instrumented dispatch sites in
+ops/bls_backend.py, parallel/bls_sharded.py and ops/dispatch_pipeline.py
+fail on command — raise, stall past a watchdog deadline, return a
+corrupt verdict, or fail "compilation" — at chosen chunk/batch indices.
+
+Plans come from two places:
+
+- **programmatic** (tests): :func:`install_plan` /
+  ``lighthouse_tpu.testing.inject_fault`` — exact, per-test control;
+- **environment** (operator chaos drills): the ``LHTPU_FAULT_*`` knobs
+  registered in common/env.py, loaded lazily on first :func:`fire`.
+
+Fault classes (``FaultPlan.mode``):
+
+==========  =================================================================
+mode        behaviour at a matching site
+==========  =================================================================
+raise       raise :class:`InjectedFault` (a generic device dispatch error)
+compile     raise :class:`InjectedCompileFault` (an XLA compile failure)
+hang        sleep ``hang_s`` seconds, then raise — the stall is what the
+            caller's watchdog must cut off; the terminal raise guarantees
+            an abandoned watchdog thread never continues into real device
+            work (deterministic teardown for tests)
+corrupt     return ``"corrupt"`` — the site substitutes
+            :func:`corrupt_verdict` (or flips its computed verdict) to
+            model a device that silently returned garbage
+==========  =================================================================
+
+This module is deliberately stdlib-only (no jax, no numpy): the BLS API
+facade and the beacon processor import it without dragging in the device
+stack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.common import env as envreg
+
+
+class DeviceFault(RuntimeError):
+    """Base class for device-offload faults the supervisor recovers from."""
+
+
+class InjectedFault(DeviceFault):
+    """Raised by an installed :class:`FaultPlan` (mode raise / hang)."""
+
+
+class InjectedCompileFault(InjectedFault):
+    """Simulates an XLA compilation failure at dispatch time."""
+
+
+class WatchdogTimeout(DeviceFault):
+    """A supervised device call or verdict fetch exceeded its deadline."""
+
+
+VALID_MODES = ("raise", "hang", "corrupt", "compile")
+
+# sites instrumented in the offload modules (documented for operators;
+# fire() accepts any string so tests can add ad-hoc sites)
+KNOWN_SITES = ("tpu", "sharded", "chunk", "subgroup", "verdict")
+
+
+@dataclass
+class FaultPlan:
+    """One injection directive; see the module table for ``mode``."""
+
+    mode: str
+    sites: frozenset = frozenset({"tpu"})
+    indices: frozenset | None = None   # chunk/batch indices; None = every hit
+    hang_s: float = 0.05
+    max_fires: int | None = None       # stop injecting after N fires
+    corrupt_value: bool = True         # verdict substituted on mode=corrupt
+    fires: int = field(default=0)      # mutated under _LOCK
+
+    def __post_init__(self):
+        if self.mode not in VALID_MODES:
+            raise ValueError(
+                f"fault mode {self.mode!r} not in {VALID_MODES}")
+        self.sites = frozenset(self.sites)
+        if self.indices is not None:
+            self.indices = frozenset(int(i) for i in self.indices)
+
+
+_LOCK = threading.Lock()
+_PLAN: FaultPlan | None = None
+_ENV_LOADED = False
+
+
+def install_plan(plan: FaultPlan | None) -> None:
+    """Install (or, with None, clear) the process-wide fault plan.
+    A programmatic plan always wins over the env-derived one."""
+    global _PLAN, _ENV_LOADED
+    with _LOCK:
+        _PLAN = plan
+        _ENV_LOADED = True  # explicit install suppresses the env load
+
+
+def clear() -> None:
+    """Remove any plan AND forget the env snapshot (next fire re-reads)."""
+    global _PLAN, _ENV_LOADED
+    with _LOCK:
+        _PLAN = None
+        _ENV_LOADED = False
+
+
+_WARNED_ENV_PLAN = False
+
+
+def plan_from_env() -> FaultPlan | None:
+    """Build a plan from the LHTPU_FAULT_* knobs; None when unset.
+
+    A malformed value (unknown mode, non-integer index) warns ONCE and
+    disables injection — a typo'd chaos knob must not turn every
+    dispatch site into a permanent fault generator."""
+    global _WARNED_ENV_PLAN
+    mode = envreg.get("LHTPU_FAULT_MODE")
+    if not mode:
+        return None
+    sites = frozenset(
+        s.strip() for s in (envreg.get("LHTPU_FAULT_SITE") or "tpu").split(",")
+        if s.strip())
+    try:
+        raw_idx = envreg.get("LHTPU_FAULT_INDICES")
+        indices = None
+        if raw_idx:
+            indices = frozenset(
+                int(i) for i in raw_idx.split(",") if i.strip())
+        return FaultPlan(
+            mode=mode.strip(),
+            sites=sites,
+            indices=indices,
+            hang_s=envreg.get_float("LHTPU_FAULT_HANG_S", 30.0),
+            max_fires=envreg.get_int("LHTPU_FAULT_MAX_FIRES"),
+        )
+    except ValueError as e:
+        if not _WARNED_ENV_PLAN:
+            _WARNED_ENV_PLAN = True
+            import sys
+
+            print(f"lighthouse_tpu: ignoring malformed LHTPU_FAULT_* "
+                  f"configuration ({e}); fault injection disabled",
+                  file=sys.stderr)
+        return None
+
+
+def refresh_from_env() -> FaultPlan | None:
+    """Force a re-read of the env knobs (tests mutate os.environ)."""
+    global _PLAN, _ENV_LOADED
+    plan = plan_from_env()
+    with _LOCK:
+        _PLAN = plan
+        _ENV_LOADED = True
+    return plan
+
+
+def active_plan() -> FaultPlan | None:
+    global _PLAN, _ENV_LOADED
+    if _ENV_LOADED:
+        return _PLAN
+    with _LOCK:
+        if not _ENV_LOADED:
+            _PLAN = plan_from_env()
+            _ENV_LOADED = True
+        return _PLAN
+
+
+def corrupt_verdict() -> bool:
+    """The verdict a corrupt-mode site substitutes for its real answer."""
+    plan = active_plan()
+    return plan.corrupt_value if plan is not None else True
+
+
+def _record_injection(site: str, mode: str) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "offload_injected_faults_total",
+            "faults injected by ops/faults, by site and mode",
+        ).labels(site=site, mode=mode).inc()
+    except (AttributeError, KeyError, TypeError, ValueError):
+        pass  # injection accounting must never mask the injected fault
+
+
+def fire(site: str, index: int = 0) -> str | None:
+    """Consult the active plan at an instrumented dispatch site.
+
+    Returns None (no fault), returns ``"corrupt"`` (caller substitutes /
+    flips its verdict), or raises the planned fault.  ``index`` is the
+    chunk/batch ordinal at looped sites (site "chunk")."""
+    plan = active_plan()
+    if plan is None or site not in plan.sites:
+        return None
+    with _LOCK:
+        if plan is not _PLAN:
+            return None  # plan swapped underneath us; stale hit
+        if plan.indices is not None and int(index) not in plan.indices:
+            return None
+        if plan.max_fires is not None and plan.fires >= plan.max_fires:
+            return None
+        plan.fires += 1
+    _record_injection(site, plan.mode)
+    if plan.mode == "corrupt":
+        return "corrupt"
+    if plan.mode == "compile":
+        raise InjectedCompileFault(
+            f"injected XLA compile failure at {site}[{index}]")
+    if plan.mode == "hang":
+        # stall (the watchdog's job is to cut this off), then fail: an
+        # abandoned watchdog thread must never continue into device work
+        time.sleep(plan.hang_s)
+        raise InjectedFault(
+            f"injected hang released after {plan.hang_s}s at {site}[{index}]")
+    raise InjectedFault(f"injected device fault at {site}[{index}]")
+
+
+# --- watchdog execution ------------------------------------------------------
+
+_UNDER_WATCHDOG = threading.local()
+
+
+def under_watchdog() -> bool:
+    """True on a thread spawned by :func:`run_with_deadline` — nested
+    deadlines are redundant there (the outer watchdog already converts a
+    hang into a recoverable fault)."""
+    return getattr(_UNDER_WATCHDOG, "value", False)
+
+
+def run_with_deadline(fn, timeout_s: float, thread_name: str, what: str):
+    """Run ``fn()`` on a daemon watchdog thread; raise
+    :class:`WatchdogTimeout` after ``timeout_s``.
+
+    The single implementation of the deadline idiom (supervised backend
+    calls, deferred verdict fetches).  On timeout the thread is
+    abandoned — daemonic, its late result or exception is discarded.
+    Exceptions from ``fn`` re-raise on the caller."""
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        _UNDER_WATCHDOG.value = True
+        try:
+            box["ok"] = fn()
+        except BaseException as e:  # re-raised on the caller thread
+            box["exc"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=_run, daemon=True, name=thread_name).start()
+    if not done.wait(timeout_s):
+        raise WatchdogTimeout(
+            f"{what} exceeded its {timeout_s:.3f}s watchdog deadline")
+    if "exc" in box:
+        raise box["exc"]
+    return box.get("ok")
+
+
+def classify(exc: BaseException) -> str:
+    """Fault taxonomy for metrics/health accounting: hang | compile | raise."""
+    if isinstance(exc, WatchdogTimeout):
+        return "hang"
+    if isinstance(exc, InjectedCompileFault):
+        return "compile"
+    text = f"{type(exc).__name__}: {exc}"
+    if "compil" in text.lower():  # XlaRuntimeError compile failures
+        return "compile"
+    return "raise"

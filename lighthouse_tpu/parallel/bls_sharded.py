@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.ops import bls12_381 as dev
 from lighthouse_tpu.ops import bigint as bi
+from lighthouse_tpu.ops import faults
 
 
 _SHARDED_JIT_CACHE: dict = {}
@@ -123,7 +124,8 @@ def multi_pairing_sharded(pairs, mesh, chunk_size: int | None = None
         partials = []
         overlap_s = 0.0
         t_prev = None
-        for lo, hi in chunks:
+        for ci, (lo, hi) in enumerate(chunks):
+            faults.fire("chunk", index=ci)
             tc = time.perf_counter()
             partials.append(_dispatch_chunk(pairs[lo:hi], mesh, stage))
             now = time.perf_counter()
@@ -164,6 +166,9 @@ def verify_signature_sets_sharded(
 
     if not sets:
         return False
+    # supervisor-visible dispatch boundary (see bls_backend's twin hook)
+    if faults.fire("sharded") == "corrupt":
+        return faults.corrupt_verdict()
     record_batch("sharded", len(sets))
     pairs = prepare_pairs(sets)
     if pairs is None:

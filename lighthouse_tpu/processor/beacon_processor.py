@@ -30,8 +30,9 @@ from dataclasses import dataclass, field
 from enum import Enum, auto
 from typing import Any, Awaitable, Callable
 
+from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common import tracing
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 
 
 class WorkType(Enum):
@@ -135,8 +136,9 @@ def _record_inflight(n: int) -> None:
         from lighthouse_tpu.ops.dispatch_pipeline import record_inflight
 
         record_inflight(n)
-    except Exception:
-        pass
+    except (ImportError, AttributeError, KeyError, TypeError,
+            ValueError) as e:
+        record_swallowed("beacon_processor.record_inflight", e)
 
 
 def default_queue_lengths(active_validator_count: int) -> dict[WorkType, int]:
@@ -203,6 +205,9 @@ class BeaconProcessor:
         batch_flush_ms: float = 50.0,
         queue_lengths: dict[WorkType, int] | None = None,
         work_journal: Callable[[str], None] | None = None,
+        dispatch_wedge_s: float | None = None,
+        dispatch_restart_max: int | None = None,
+        dispatch_restart_window_s: float | None = None,
     ):
         self.max_workers = max(2, max_workers)
         self.max_batch = max_batch
@@ -227,6 +232,30 @@ class BeaconProcessor:
         # dispatches would interleave their host/device stages.
         self._dispatch_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="bp-dispatch")
+        # --- dispatch-thread supervisor: a wedged or dead dispatch
+        # thread must not stall batch verification forever.  Each batch
+        # awaits its executor future under a wedge deadline; on timeout
+        # (or a dead executor) the batch re-runs on the general worker
+        # pool (the synchronous path) and the dispatch executor is
+        # replaced — storm-limited so a persistently wedging device
+        # pins batch work to the synchronous path instead of spawning
+        # threads unboundedly.  Restart bookkeeping is mutated only on
+        # the event loop.
+        # explicit zeros are honored: wedge 0 disables the supervisor,
+        # restart-max 0 means never restart (sync-only recovery)
+        self.dispatch_wedge_s = (
+            dispatch_wedge_s if dispatch_wedge_s is not None
+            else envreg.get_float("LHTPU_DISPATCH_WEDGE_S", 600.0))
+        self.dispatch_restart_max = (
+            dispatch_restart_max if dispatch_restart_max is not None
+            else envreg.get_int("LHTPU_DISPATCH_RESTART_MAX", 3))
+        self.dispatch_restart_window_s = (
+            dispatch_restart_window_s
+            if dispatch_restart_window_s is not None
+            else envreg.get_float("LHTPU_DISPATCH_RESTART_WINDOW_S", 300.0))
+        self._dispatch_restarts: deque[float] = deque()  # restart stamps
+        self._dispatch_generation = 0
+        self.dispatch_restart_count = 0  # lifetime total (test surface)
         # batches currently on (or queued for) the dispatch thread;
         # mutated only on the event loop
         self._dispatch_inflight = 0
@@ -398,6 +427,11 @@ class BeaconProcessor:
     async def _run_one(self, event: WorkEvent):
         fn = event.process
         if fn is None:
+            if event.process_batch is not None:
+                # a deadline flush can hand over a single batchable
+                # event; it must still ride the dispatch thread as a
+                # 1-lane batch, not be dropped for lacking `process`
+                await self._run_batch([event])
             return
         wt_label = event.work_type.name.lower()
         try:
@@ -409,8 +443,8 @@ class BeaconProcessor:
                     res = await loop.run_in_executor(self._executor, fn)
                     if asyncio.iscoroutine(res):
                         await res
-        except Exception:  # worker panics must not kill the manager
-            pass
+        except Exception as e:  # worker panics must not kill the manager
+            record_swallowed("beacon_processor.worker", e)
         self.metrics.bump(self.metrics.processed, event.work_type)
         self._labeled(self._event_counter, event.work_type,
                       "processed").inc()
@@ -429,13 +463,101 @@ class BeaconProcessor:
             with tracing.span("beacon_processor.batch",
                               work_type=wt.name.lower(),
                               lanes=len(events)):
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(
-                    self._dispatch_executor, batch_fn, payloads)
-        except Exception:
-            pass
+                await self._dispatch_batch(batch_fn, payloads)
+        except Exception as e:  # batch panics must not kill the manager
+            record_swallowed("beacon_processor.batch", e)
         finally:
             self._dispatch_inflight -= 1
             _record_inflight(self._dispatch_inflight)
         self.metrics.bump(self.metrics.processed, wt, len(events))
         self._labeled(self._event_counter, wt, "processed").inc(len(events))
+
+    # -- dispatch-thread supervisor ----------------------------------------
+
+    async def _dispatch_batch(self, batch_fn, payloads):
+        """Run one batch on the dedicated dispatch thread under the wedge
+        deadline; recover through the synchronous worker-pool path when
+        the thread is dead or wedged.
+
+        Recovery RE-RUNS the batch callable: batch handlers must
+        tolerate re-execution INCLUDING concurrent execution — the
+        abandoned thread, if merely slow rather than dead, may still be
+        inside the same batch while the synchronous copy runs.  That is
+        the same contract concurrent gossip/RPC copies of one block
+        already impose (verification is idempotent; dup gates and
+        observed-caches absorb the replay, and the verify paths are
+        thread-safe per tests/test_lock_contracts.py)."""
+        loop = asyncio.get_running_loop()
+        if self._restart_budget_exhausted():
+            # PINNED: the storm limiter is saturated, so the current
+            # dispatch executor is presumed wedged-and-unreplaceable —
+            # go straight to the synchronous path instead of queueing
+            # behind it for another full wedge deadline per batch
+            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            return
+        gen = self._dispatch_generation
+        try:
+            fut = loop.run_in_executor(
+                self._dispatch_executor, batch_fn, payloads)
+        except RuntimeError as e:
+            # executor shut down / thread unspawnable: a DEAD dispatch
+            # thread — replace it and serve this batch synchronously
+            self._recover_dispatch("dead", gen, e)
+            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            return
+        wedge = self.dispatch_wedge_s
+        if not wedge or wedge <= 0:
+            await fut
+            return
+        try:
+            await asyncio.wait_for(fut, timeout=wedge)
+        except asyncio.TimeoutError:
+            # WEDGED: the thread has been inside one batch past the
+            # deadline.  Abandon it (the cancelled future detaches; the
+            # thread keeps its GIL turns until it dies with the old
+            # executor), restart, and drain this batch synchronously.
+            self._recover_dispatch("wedged", gen, None)
+            await loop.run_in_executor(self._executor, batch_fn, payloads)
+
+    def _restart_budget_exhausted(self) -> bool:
+        """True while the restart-storm limiter is saturated (prunes
+        stamps older than the window first)."""
+        now = time.monotonic()
+        while (self._dispatch_restarts
+               and now - self._dispatch_restarts[0]
+               > self.dispatch_restart_window_s):
+            self._dispatch_restarts.popleft()
+        return len(self._dispatch_restarts) >= self.dispatch_restart_max
+
+    def _recover_dispatch(self, reason: str, gen: int,
+                          exc: BaseException | None) -> None:
+        """Replace the dispatch executor (restart-storm-limited) and
+        account the fault.  ``gen`` is the generation the failing batch
+        was submitted under: if another batch already triggered the
+        restart, this one only falls back synchronously."""
+        restarted = False
+        if gen == self._dispatch_generation:
+            if not self._restart_budget_exhausted():
+                self._dispatch_restarts.append(time.monotonic())
+                self._dispatch_generation += 1
+                self.dispatch_restart_count += 1
+                old = self._dispatch_executor
+                self._dispatch_executor = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=(
+                        f"bp-dispatch-{self._dispatch_generation}"))
+                old.shutdown(wait=False)  # abandon the wedged thread
+                restarted = True
+            # else: storm limiter — queued batches keep timing out onto
+            # the synchronous path until the window drains
+        try:
+            REGISTRY.counter(
+                "beacon_processor_dispatch_restarts_total",
+                "dispatch-thread supervisor interventions, by reason and "
+                "action",
+            ).labels(reason=reason,
+                     action="restarted" if restarted else "sync_only").inc()
+        except (AttributeError, KeyError, TypeError, ValueError) as e:
+            record_swallowed("beacon_processor.dispatch_restart_counter", e)
+        if exc is not None:
+            record_swallowed(f"beacon_processor.dispatch_{reason}", exc)

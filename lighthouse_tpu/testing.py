@@ -4,11 +4,18 @@ Rebuild of the reference's `BeaconChainHarness`
 (/root/reference/beacon_node/beacon_chain/src/test_utils.rs:611): extend a
 chain block-by-block with correctly signed randao/proposals/sync
 aggregates/attestations, entirely in-process, no network.
+
+Also home of the fault-injection test seams (:func:`inject_fault`,
+:func:`supervised_bls`) over ops/faults and the offload supervisor —
+the deterministic stand-ins for device faults that real hardware won't
+produce on demand.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
+import os
 
 import numpy as np
 
@@ -26,6 +33,52 @@ from lighthouse_tpu.state_transition import (
 from lighthouse_tpu.state_transition.block_processing import (
     get_expected_withdrawals,
 )
+
+
+# --- fault-injection seams ---------------------------------------------------
+
+
+@contextlib.contextmanager
+def inject_fault(mode: str, sites=("tpu",), indices=None, hang_s: float = 0.05,
+                 max_fires: int | None = None, corrupt_value: bool = True):
+    """Install a deterministic device-fault plan for the `with` body.
+
+        with inject_fault("raise", sites={"chunk"}, indices={1}):
+            bls.verify_signature_sets(sets, backend="tpu")
+
+    See ops/faults for the mode taxonomy.  The previous plan (usually
+    none) is restored on exit, so tests cannot leak faults."""
+    from lighthouse_tpu.ops import faults
+
+    prev = faults.active_plan()
+    faults.install_plan(faults.FaultPlan(
+        mode=mode, sites=frozenset(sites), indices=indices, hang_s=hang_s,
+        max_fires=max_fires, corrupt_value=corrupt_value))
+    try:
+        yield
+    finally:
+        faults.install_plan(prev)
+
+
+@contextlib.contextmanager
+def supervised_bls(**env):
+    """Pin the offload supervisor's knobs for the `with` body and rebuild
+    it (LHTPU_WATCHDOG_S, LHTPU_SUPERVISOR_LADDER, ...); restores the
+    previous environment and resets the supervisor again on exit."""
+    from lighthouse_tpu.crypto.bls import api
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    api.reset_supervisor()
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        api.reset_supervisor()
 
 
 class Harness:

@@ -112,6 +112,7 @@ class TestCli:
         assert out["genesis_root"].startswith("0x")
 
     def test_key_tooling_roundtrip(self, tmp_path, capsys):
+        pytest.importorskip("cryptography")  # EIP-2335 AES is optional
         wallet = tmp_path / "wallet.json"
         keys = tmp_path / "keys"
         rc = cli_main(["account-manager", "wallet-create",

@@ -383,6 +383,76 @@ def test_check_metrics_shim_collect_still_works(tmp_path):
     assert any("dynamic metric name" in e for e in errors)
 
 
+# -- pass 6: supervised dispatch discipline -----------------------------------
+
+
+def test_supervisor_pass_flags_unsupervised_dispatch(tmp_path):
+    # _kernel reached from the supervised entry through a helper is
+    # fine; the same kernel dispatched from a stray probe is flagged
+    pkg, _ = make_pkg(tmp_path, {"ops/bls_backend.py": """
+        import jax
+
+        @jax.jit
+        def _kernel(x):
+            return x
+
+        def verify_signature_sets_device(sets):
+            return _helper(sets)
+
+        def _helper(sets):
+            return _kernel(sets)
+
+        def rogue_probe(x):
+            return _kernel(x)
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH601"]
+    assert findings[0].symbol == "rogue_probe:_kernel"
+    assert "not reachable from a supervisor-wrapped entry" \
+        in findings[0].message
+
+
+def test_supervisor_pass_assignment_jit_and_suppression(tmp_path):
+    # jax.jit bound by assignment counts as a dispatch callable; an
+    # explicit allow() waives the finding
+    pkg, _ = make_pkg(tmp_path, {"ops/dispatch_pipeline.py": """
+        import jax
+
+        def _mul(a, b):
+            return a * b
+
+        _mul_jit = jax.jit(_mul)
+
+        def stray(a, b):
+            return _mul_jit(a, b)  # lhlint: allow(LH601)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_supervisor_pass_negative_supervised_chain(tmp_path):
+    # cross-module: the sharded entry reaches the shared combine helper
+    pkg, _ = make_pkg(tmp_path, {
+        "parallel/bls_sharded.py": """
+            from pkg.ops import dispatch_pipeline as dp
+
+            def verify_signature_sets_sharded(sets):
+                return dp.combine(sets)
+        """,
+        "ops/dispatch_pipeline.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def _pair(a, n):
+                return a
+
+            def combine(parts):
+                return _pair(parts, 2)
+        """,
+    })
+    assert analyze(pkg) == []
+
+
 # -- baseline machinery -------------------------------------------------------
 
 

@@ -40,6 +40,11 @@ LH402       env-readme-drift       registry entry not documented in README
 LH501       metric-discipline      the absorbed tools/check_metrics pass
                                    (dynamic names, kind/module conflicts,
                                    family-ownership violations)
+LH601       unsupervised-dispatch  device dispatch call site (a jitted
+                                   callable) in the offload modules not
+                                   reachable from a supervisor-wrapped
+                                   entry point (the crypto/bls/api fault
+                                   supervisor's watchdog + health ladder)
 ==========  =====================  =========================================
 
 Suppression: a ``# lhlint: allow(<rule-id-or-name>[, ...])`` comment on
@@ -154,13 +159,14 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     """Run every pass over the package rooted at ``pkg_root``; returns
     suppression-filtered findings (baseline NOT applied — that's the
     CLI/baseline layer's job)."""
-    from tools.lint import envpass, fetch, locks, metrics_pass, shapes
+    from tools.lint import (envpass, fetch, locks, metrics_pass, shapes,
+                            supervisor_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
     ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
-                     metrics_pass.run):
+                     metrics_pass.run, supervisor_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

@@ -38,6 +38,13 @@ FAMILY_OWNERS = {
     "bls_pipeline_": "lighthouse_tpu/ops/dispatch_pipeline.py",
     "bls_verify_": "lighthouse_tpu/crypto/bls/api.py",
     "bls_cache_": "lighthouse_tpu/crypto/bls/api.py",
+    # the offload supervisor's health/fault series (PR 4): the breaker
+    # transitions are the only legitimate writer
+    "bls_backend_health": "lighthouse_tpu/crypto/bls/api.py",
+    "bls_supervisor_": "lighthouse_tpu/crypto/bls/api.py",
+    # swallowed-error accounting funnels through the one helper
+    "offload_swallowed_": "lighthouse_tpu/common/metrics.py",
+    "offload_injected_": "lighthouse_tpu/ops/faults.py",
 }
 
 

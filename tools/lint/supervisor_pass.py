@@ -1,0 +1,136 @@
+"""Pass 6 — supervised dispatch discipline (LH601).
+
+PR 4's recovery guarantee only holds for device work the supervisor can
+see: a jitted kernel dispatched from a code path that is NOT reachable
+from a supervisor-wrapped entry point fails raw — its exceptions
+propagate to the caller and its hangs wedge a thread nobody watchdogs.
+
+This pass finds every *device dispatch call site* in the offload
+modules — a call to a name bound to ``jax.jit(...)`` (decorator form,
+``partial(jax.jit, ...)`` form, or ``X = jax.jit(f)`` assignment) — and
+requires the enclosing function to be reachable, through the package
+call graph, from one of the SUPERVISED_ENTRIES (the functions the
+crypto/bls/api supervisor wraps with its watchdog + health ladder).
+
+Deliberately unsupervised dispatch (synchronous convenience wrappers,
+startup calibration) is annotated ``# lhlint: allow(LH601)`` at the call
+line — a conscious, reviewable waiver, exactly like the other passes.
+
+Jitted callables that flow through variables (e.g. the sharded path's
+memoized ``fn = _sharded_miller_reduce(...)``) are not resolvable
+statically and are skipped; the function HOLDING the memo is still
+covered when it is itself called by name.  Conservative by design: a
+missed edge can only miss a finding, never invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Context, Finding
+from tools.lint.callgraph import dotted_name
+
+TARGET_MODULES = (
+    "ops/dispatch_pipeline.py",
+    "ops/bls_backend.py",
+    "parallel/bls_sharded.py",
+)
+
+# the functions the offload supervisor (crypto/bls/api.py) wraps: every
+# device dispatch must be reachable from one of these (or carry an
+# explicit allow)
+SUPERVISED_ENTRIES = (
+    "ops/bls_backend.py::verify_signature_sets_device",
+    "parallel/bls_sharded.py::verify_signature_sets_sharded",
+)
+
+
+def _is_jax_jit_call(node: ast.AST) -> bool:
+    """jax.jit(...) or functools.partial(jax.jit, ...)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = dotted_name(node.func)
+    if dotted in ("jax.jit", "jit"):
+        return True
+    if dotted in ("partial", "functools.partial") and node.args:
+        return dotted_name(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def _jitted_names(module) -> set[str]:
+    """Module-level names bound to jitted callables."""
+    out: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jax_jit_call(d) or dotted_name(d) in
+                   ("jax.jit", "jit") for d in node.decorator_list):
+                out.add(node.name)
+        elif isinstance(node, ast.Assign) and _is_jax_jit_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _reachable_from_entries(ctx: Context) -> set[str]:
+    """Function keys reachable from SUPERVISED_ENTRIES via resolved
+    call-graph edges (BFS, package-wide)."""
+    seen: set[str] = set()
+    frontier = [k for k in SUPERVISED_ENTRIES if k in ctx.graph.functions]
+    seen.update(frontier)
+    while frontier:
+        nxt: list[str] = []
+        for key in frontier:
+            for call in ctx.graph.functions[key].calls:
+                if call.resolved and call.resolved not in seen:
+                    seen.add(call.resolved)
+                    nxt.append(call.resolved)
+        frontier = nxt
+    return seen
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    reachable = _reachable_from_entries(ctx)
+    for pkg_rel in TARGET_MODULES:
+        module = ctx.by_pkg_rel.get(pkg_rel)
+        if module is None:
+            continue
+        jitted = _jitted_names(module)
+        if not jitted:
+            continue
+        findings.extend(_scan_module(ctx, module, jitted, reachable))
+    return findings
+
+
+def _scan_module(ctx: Context, module, jitted: set[str],
+                 reachable: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                visit(child, stack + [child.name])
+                continue
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id in jitted):
+                qual = ".".join(stack) or "<module>"
+                key = f"{module.pkg_rel}::{qual}"
+                if (key not in reachable
+                        and not ctx.suppressed(module, "LH601",
+                                               "unsupervised-dispatch",
+                                               child.lineno)):
+                    findings.append(Finding(
+                        "LH601", "unsupervised-dispatch", module.rel,
+                        child.lineno, f"{qual}:{child.func.id}",
+                        f"device dispatch `{child.func.id}` in `{qual}` is "
+                        f"not reachable from a supervisor-wrapped entry "
+                        f"point ({', '.join(SUPERVISED_ENTRIES)}) — route "
+                        f"it through the supervised verify path or waive "
+                        f"with `# lhlint: allow(LH601)`"))
+            visit(child, stack)
+
+    visit(module.tree, [])
+    return findings
