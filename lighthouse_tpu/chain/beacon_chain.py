@@ -36,7 +36,7 @@ from lighthouse_tpu.chain.caches import (
 )
 from lighthouse_tpu.chain.data_availability import DataAvailabilityChecker
 from lighthouse_tpu.common import tracing
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
 from lighthouse_tpu.fork_choice import ForkChoice
 from lighthouse_tpu.store import HotColdDB
@@ -128,20 +128,16 @@ class BeaconChain:
         self.metrics: dict[str, float] = {}
         self._migrated_finalized_epoch = self.fork_choice.finalized.epoch
         self._advanced_states: dict[bytes, object] = {}
+        # how the last try_resume concluded: "fresh" | "snapshot" | "rebuilt"
+        self.resume_mode = "fresh"
 
     # -- plumbing ----------------------------------------------------------
 
     @staticmethod
     def _anchor_block_root(state) -> bytes:
-        header = state.latest_block_header
-        if bytes(header.state_root) == b"\x00" * 32:
-            hdr = T.BeaconBlockHeader(
-                slot=header.slot, proposer_index=header.proposer_index,
-                parent_root=header.parent_root,
-                state_root=state.hash_tree_root(),
-                body_root=header.body_root)
-            return hdr.hash_tree_root()
-        return header.hash_tree_root()
+        from lighthouse_tpu.store.hot_cold import anchor_block_root
+
+        return anchor_block_root(state)
 
     def current_slot(self) -> int:
         return self.slot_clock.current_slot()
@@ -591,36 +587,119 @@ class BeaconChain:
 
     def persist(self) -> None:
         """Snapshot fork choice + head for restart resume (reference
-        PersistedForkChoice written on shutdown/finalization)."""
-        self.store.persist_fork_choice(self.fork_choice.to_bytes())
-        self.store.persist_head(self.head_root)
+        PersistedForkChoice written on shutdown/finalization).  One
+        atomic frame: a crash can never pair the head of one snapshot
+        with the fork choice of another."""
+        self.store.persist_frame(
+            fork_choice=self.fork_choice.to_bytes(), head=self.head_root)
 
     def try_resume(self) -> bool:
-        """Restore fork choice + head from a previous run's snapshot.
-        Returns True when the snapshot was coherent and adopted."""
+        """Restore fork choice + head from a previous run's snapshot;
+        when the snapshot is missing, corrupt, or incoherent but the
+        store still holds blocks, fall back to rebuilding fork choice
+        from them.  Returns True when a prior run's chain was adopted.
+        ``resume_mode`` records how: "snapshot" | "rebuilt" | "fresh"."""
         from lighthouse_tpu.fork_choice.fork_choice import ForkChoice
+        from lighthouse_tpu.store import StoreCorruptionError
 
-        blob = self.store.load_fork_choice()
-        head = self.store.load_head()
-        if blob is None or head is None:
-            return False
+        self.resume_mode = "fresh"
         try:
-            fc = ForkChoice.from_bytes(
-                self.spec, blob, balances_fn=self._balances_for_checkpoint)
-            if head not in fc.proto:
+            blob = self.store.load_fork_choice()
+            head = self.store.load_head()
+        except StoreCorruptionError:
+            # detected (not silently deserialized); the startup sweep
+            # normally drops these — reaching here means the sweep was
+            # disabled, so treat it exactly like a missing snapshot
+            blob = head = None
+        if blob is not None and head is not None:
+            try:
+                fc = ForkChoice.from_bytes(
+                    self.spec, blob,
+                    balances_fn=self._balances_for_checkpoint)
+                if head in fc.proto:
+                    head_state = self.state_for_block(head)
+                    if head_state is not None:
+                        self.fork_choice = fc
+                        self.head_root = head
+                        self.head_state = head_state
+                        # finalization migration already ran for the
+                        # persisted epoch; a stale marker would re-migrate
+                        # (and re-prune) on the very first head recompute
+                        # after every restart
+                        self._migrated_finalized_epoch = fc.finalized.epoch
+                        self.resume_mode = "snapshot"
+                        return True
+            except Exception as e:
+                # torn/incoherent snapshot: rebuild from blocks — but
+                # leave a signal distinguishing "snapshot corrupt" from
+                # "resume code broken"
+                record_swallowed("chain.try_resume", e)
+        return self.rebuild_fork_choice()
+
+    def rebuild_fork_choice(self) -> bool:
+        """Repair path: reconstruct fork choice by replaying every
+        stored hot block into a fresh instance (reference fork_revert /
+        reset_fork_choice tooling, here automatic).  Anchored at genesis
+        pre-finality, else at the finalization boundary state the prune
+        keeps (store.anchor_at_split)."""
+        from lighthouse_tpu.fork_choice.fork_choice import (
+            ForkChoice,
+            ForkChoiceError,
+        )
+
+        store = self.store
+        blocks = sorted(
+            ((int(blk.message.slot), root, blk)
+             for root, blk in store.iter_hot_blocks()),
+            key=lambda x: x[0])
+        if not any(root != self.genesis_block_root for _, root, _ in blocks):
+            return False  # nothing to rebuild from (fresh store)
+        if store.split_slot > 0:
+            anchor = store.anchor_at_split()
+            if anchor is None:
                 return False
-            head_state = self.state_for_block(head)
-            if head_state is None:
-                return False
-        except Exception:
-            return False  # corrupt snapshot: fall back to fresh sync
+            anchor_state_root, anchor_root = anchor
+            anchor_state = store.get_hot_state(anchor_state_root)
+        else:
+            anchor_root = self.genesis_block_root
+            anchor_state_root = self._anchor_state_root
+            anchor_state = self.state_cache.get(anchor_state_root)
+            if anchor_state is None:
+                anchor_state = store.get_hot_state(anchor_state_root)
+        if anchor_state is None:
+            return False
+        fc = ForkChoice(self.spec, anchor_root, anchor_state,
+                        balances_fn=self._balances_for_checkpoint)
+        top = max(slot for slot, _, _ in blocks)
+        applied = 0
+        for slot, root, blk in blocks:
+            if root in fc.proto:
+                continue
+            state = self.state_for_block(root)
+            if state is None:
+                continue  # torn import: block landed, state didn't
+            try:
+                fc.on_block(top, blk.message, root, state)
+                applied += 1
+            except ForkChoiceError:
+                continue  # pruned parent / pre-anchor block: skip
+        head = fc.get_head(top)
+        head_state = (anchor_state if head == anchor_root
+                      else self.state_for_block(head))
+        if head_state is None:
+            return False
         self.fork_choice = fc
         self.head_root = head
         self.head_state = head_state
-        # finalization migration already ran for the persisted epoch; a
-        # stale marker would re-migrate (and re-prune) on the very first
-        # head recompute after every restart
         self._migrated_finalized_epoch = fc.finalized.epoch
+        self.persist()  # re-snapshot the rebuilt instance atomically
+        self.resume_mode = "rebuilt"
+        REGISTRY.counter(
+            "store_recovery_fork_choice_rebuilds_total",
+            "fork-choice instances rebuilt from stored blocks").inc()
+        with tracing.span("store.fork_choice_rebuild", blocks=applied,
+                          head_slot=int(head_state.slot)):
+            pass
         return True
 
     def _on_finalized(self):

@@ -96,9 +96,14 @@ class Client:
         self.executor.shutdown("client stop")
         # snapshot fork choice + head AFTER the workers stop so a
         # mid-import mutation can't tear the snapshot (reference persists
-        # on shutdown)
+        # on shutdown), then close the store so the dirty-shutdown marker
+        # flips to clean — the next open skips the integrity sweep
         try:
             self.chain.persist()
+        except Exception:
+            pass
+        try:
+            self.chain.store.close()
         except Exception:
             pass
         if self.lockfile is not None:
@@ -237,12 +242,22 @@ class ClientBuilder:
 
             self._lockfile = Lockfile(
                 os.path.join(self.config.datadir, "beacon.lock")).acquire()
-            store = HotColdDB(
-                self.spec,
-                hot=NativeKVStore(
-                    os.path.join(self.config.datadir, "hot.db")),
-                cold=NativeKVStore(
-                    os.path.join(self.config.datadir, "cold.db")))
+            hot = NativeKVStore(os.path.join(self.config.datadir, "hot.db"))
+            cold = NativeKVStore(os.path.join(self.config.datadir, "cold.db"))
+            from lighthouse_tpu.common import env as envreg
+
+            if envreg.get("LHTPU_STORE_FAULT_MODE"):
+                # operator chaos drill: deterministic crash/corruption
+                # injection at the store commit points (store/crash)
+                from lighthouse_tpu.store import CrashPointStore
+
+                hot = CrashPointStore.from_env(hot)
+                self.log.warn("store fault injection armed",
+                              mode=envreg.get("LHTPU_STORE_FAULT_MODE"))
+            store = HotColdDB(self.spec, hot=hot, cold=cold)
+            if store.recovery:
+                self.log.warn("store integrity sweep repaired records",
+                              repairs=store.recovery)
         from lighthouse_tpu.common.slot_clock import (
             ManualSlotClock,
             SystemTimeSlotClock,
@@ -302,7 +317,8 @@ class ClientBuilder:
                     self.spec.seconds_per_slot)
                 self.log.info(
                     "resumed from disk",
-                    head_slot=int(self.chain.head_state.slot))
+                    head_slot=int(self.chain.head_state.slot),
+                    mode=self.chain.resume_mode)
         if self._eth1 is not None:
             self.chain.eth1_service = self._eth1
         if self.config.slasher_enabled:

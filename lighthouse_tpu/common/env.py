@@ -127,6 +127,26 @@ _register("LHTPU_DISPATCH_RESTART_WINDOW_S", "300",
           "Restart-storm window seconds for the dispatch-thread "
           "limiter.")
 
+# -- store crash injection + startup recovery (store/crash, store/hot_cold) ---
+
+_register("LHTPU_STORE_FAULT_MODE", None,
+          "Inject store faults (crash|drop|flip|io) through "
+          "CrashPointStore (store/crash); unset disables injection.")
+_register("LHTPU_STORE_FAULT_BATCH", None,
+          "Write-commit ordinal a crash/drop store fault fires at; "
+          "unset = never (flip/io match by key instead).")
+_register("LHTPU_STORE_FAULT_OP", "0",
+          "For mode=drop: ops of the matching batch applied before the "
+          "simulated death (0 = die at the boundary, nothing applied).")
+_register("LHTPU_STORE_FAULT_KEY", None,
+          "Substring a key must contain for flip/io store faults; "
+          "unset = any key.")
+_register("LHTPU_STORE_FAULT_BIT", "0",
+          "For mode=flip: bit index flipped in the stored value.")
+_register("LHTPU_STORE_SWEEP", None,
+          "1 forces the store integrity sweep on every open, 0 disables "
+          "it; unset = sweep only after a dirty shutdown.")
+
 
 # -- typed readers ------------------------------------------------------------
 

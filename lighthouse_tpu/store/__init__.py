@@ -3,6 +3,13 @@
 Reference: /root/reference/beacon_node/store.
 """
 
+from lighthouse_tpu.store.crash import (
+    CrashPointStore,
+    InjectedCrash,
+    InjectedIOError,
+    StoreFaultPlan,
+)
+from lighthouse_tpu.store.envelope import StoreCorruptionError
 from lighthouse_tpu.store.hot_cold import (
     HotColdDB,
     HotStateSummary,
@@ -24,15 +31,20 @@ from lighthouse_tpu.store.migrations import (
 
 __all__ = [
     "CURRENT_SCHEMA_VERSION",
+    "CrashPointStore",
     "HotColdDB",
     "HotStateSummary",
+    "InjectedCrash",
+    "InjectedIOError",
     "KeyValueOp",
     "KeyValueStore",
     "MemoryStore",
     "MigrationError",
     "NativeKVStore",
     "SqliteStore",
+    "StoreCorruptionError",
     "StoreError",
+    "StoreFaultPlan",
     "migrate_schema",
     "read_schema_version",
 ]

@@ -1,0 +1,260 @@
+"""Deterministic crash/corruption injection for the persistence layer.
+
+The extension of the ops/faults.py pattern into the store: the crash
+sweep (tests/test_crash_sweep.py) and operator chaos drills are only
+trustworthy if a node can be killed at EVERY commit boundary on
+command, and real power loss is neither deterministic nor available on
+CI.  :class:`CrashPointStore` wraps any :class:`KeyValueStore` and
+counts every write commit (``put``/``delete``/``do_atomically``); an
+installed :class:`StoreFaultPlan` fires at a chosen ordinal:
+
+==========  =================================================================
+mode        behaviour at the matching commit
+==========  =================================================================
+crash       raise :class:`InjectedCrash` BEFORE anything is applied — the
+            process died at the batch boundary; the committed prefix of
+            history survives in the inner store
+drop        apply only the first ``op`` ops of the batch key-by-key (a torn
+            write on a non-atomic engine), then die — models exactly the
+            failure ``do_atomically`` is supposed to rule out, so the
+            recovery sweep is tested against WORSE than the real engines
+flip        silently flip bit ``bit`` of the value being written for a key
+            containing ``key`` — storage rot; detection must happen on READ
+            (the checksum envelope's job)
+io          raise :class:`InjectedIOError` at a matching read/write —
+            transient I/O failure, the store stays usable
+==========  =================================================================
+
+After ``crash``/``drop`` fire the wrapper is dead: every further access
+raises :class:`InjectedCrash`.  Tests then reopen a fresh HotColdDB
+over the INNER store, exactly like a process restart over the surviving
+disk image.
+
+Plans come programmatically (tests) or from the ``LHTPU_STORE_FAULT_*``
+env knobs (operator drills; client/builder.py wraps the hot engine when
+``LHTPU_STORE_FAULT_MODE`` is set).  Stdlib-only, like ops/faults.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.store.kv import KeyValueStore
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated process death at a store commit point."""
+
+
+class InjectedIOError(OSError):
+    """Simulated transient I/O failure (mode=io)."""
+
+
+VALID_MODES = ("crash", "drop", "flip", "io")
+
+
+@dataclass
+class StoreFaultPlan:
+    """One injection directive; see the module table for ``mode``."""
+
+    mode: str
+    batch: int | None = None   # commit ordinal for crash/drop; None = never
+    op: int = 0                # drop: ops applied before the death
+    key: bytes | None = None   # flip/io: substring a key must contain
+    bit: int = 0               # flip: bit index in the stored value
+    max_fires: int = 1         # flip/io fire at most this many times
+
+    def __post_init__(self):
+        if self.mode not in VALID_MODES:
+            raise ValueError(f"store fault mode {self.mode!r} "
+                             f"not in {VALID_MODES}")
+
+
+_WARNED_ENV_PLAN = False
+
+
+def plan_from_env() -> StoreFaultPlan | None:
+    """Build a plan from the LHTPU_STORE_FAULT_* knobs; None when unset.
+    A malformed value warns once and disables injection (a typo'd chaos
+    knob must not brick every store open)."""
+    global _WARNED_ENV_PLAN
+    mode = envreg.get("LHTPU_STORE_FAULT_MODE")
+    if not mode:
+        return None
+    try:
+        raw_key = envreg.get("LHTPU_STORE_FAULT_KEY")
+        return StoreFaultPlan(
+            mode=mode.strip(),
+            batch=envreg.get_int("LHTPU_STORE_FAULT_BATCH"),
+            op=envreg.get_int("LHTPU_STORE_FAULT_OP", 0),
+            key=raw_key.encode() if raw_key else None,
+            bit=envreg.get_int("LHTPU_STORE_FAULT_BIT", 0),
+        )
+    except ValueError as e:
+        if not _WARNED_ENV_PLAN:
+            _WARNED_ENV_PLAN = True
+            import sys
+
+            print("lighthouse_tpu: ignoring malformed LHTPU_STORE_FAULT_* "
+                  f"configuration ({e}); store fault injection disabled",
+                  file=sys.stderr)
+        return None
+
+
+def _record_injection(mode: str) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "store_fault_injections_total",
+            "faults injected by store/crash, by mode",
+        ).labels(mode=mode).inc()
+    except (AttributeError, KeyError, TypeError, ValueError):
+        pass  # injection accounting must never mask the injected fault
+
+
+class CrashPointStore(KeyValueStore):
+    """KV wrapper that dies, tears, rots, or errors on command.
+
+    With ``plan=None`` it is a pure recorder: ``commits`` counts write
+    batches and ``batch_log`` holds each batch's op count — the crash
+    sweep's enumeration of every boundary and intra-batch drop point.
+    """
+
+    def __init__(self, inner: KeyValueStore,
+                 plan: StoreFaultPlan | None = None):
+        self.inner = inner
+        self.plan = plan
+        self.commits = 0             # committed write batches
+        self.batch_log: list[int] = []   # ops per committed batch
+        self.fires = 0
+        self.dead = False
+
+    @classmethod
+    def from_env(cls, inner: KeyValueStore) -> "CrashPointStore":
+        return cls(inner, plan_from_env())
+
+    # -- fault machinery ---------------------------------------------------
+
+    def _check_alive(self):
+        if self.dead:
+            raise InjectedCrash(
+                "store is dead (crashed at commit "
+                f"{self.commits}); reopen over the inner store")
+
+    def _die(self, what: str):
+        self.dead = True
+        _record_injection(self.plan.mode)
+        raise InjectedCrash(
+            f"injected {self.plan.mode} at commit {self.commits} ({what})")
+
+    def _key_matches(self, key: bytes) -> bool:
+        return self.plan.key is None or self.plan.key in bytes(key)
+
+    def _maybe_io(self, key: bytes):
+        p = self.plan
+        if (p is not None and p.mode == "io" and self._key_matches(key)
+                and self.fires < p.max_fires):
+            self.fires += 1
+            _record_injection("io")
+            raise InjectedIOError(
+                f"injected I/O failure at key {bytes(key)[:16]!r}")
+
+    def _maybe_flip(self, key: bytes, value: bytes) -> bytes:
+        p = self.plan
+        if (p is not None and p.mode == "flip" and self._key_matches(key)
+                and self.fires < p.max_fires and len(value) > 0):
+            self.fires += 1
+            _record_injection("flip")
+            value = bytearray(value)
+            i = p.bit % (len(value) * 8)
+            value[i // 8] ^= 1 << (i % 8)
+            return bytes(value)
+        return value
+
+    def _commit_gate(self, n_ops: int):
+        """Called once per write batch BEFORE it is applied; fires
+        crash/drop when this commit's ordinal matches the plan."""
+        self._check_alive()
+        p = self.plan
+        if p is None or p.batch is None or p.mode not in ("crash", "drop"):
+            return None
+        if self.commits != p.batch:
+            return None
+        if p.mode == "crash" or p.op <= 0:
+            self._die("nothing applied")
+        return min(p.op, n_ops)  # drop: ops to apply before dying
+
+    # -- KeyValueStore interface -------------------------------------------
+
+    def get(self, key):
+        self._check_alive()
+        self._maybe_io(key)
+        return self.inner.get(key)
+
+    def exists(self, key):
+        self._check_alive()
+        return self.inner.exists(key)
+
+    def put(self, key, value):
+        keep = self._commit_gate(1)
+        self._maybe_io(key)
+        if keep is not None:  # drop on a single put: it lands, then death
+            self.inner.put(key, bytes(value))
+            self._die("single put applied")
+        self.inner.put(key, self._maybe_flip(key, bytes(value)))
+        self.commits += 1
+        self.batch_log.append(1)
+
+    def delete(self, key):
+        keep = self._commit_gate(1)
+        self._maybe_io(key)
+        if keep is not None:
+            self.inner.delete(key)
+            self._die("single delete applied")
+        self.inner.delete(key)
+        self.commits += 1
+        self.batch_log.append(1)
+
+    def do_atomically(self, ops):
+        keep = self._commit_gate(len(ops))
+        for op in ops:
+            self._maybe_io(op.key)
+        if keep is not None:
+            # torn write: land the prefix key-by-key (NOT atomically —
+            # that is the point), then die mid-batch
+            for op in ops[:keep]:
+                if op.value is None:
+                    self.inner.delete(op.key)
+                else:
+                    self.inner.put(op.key, bytes(op.value))
+            self._die(f"{keep}/{len(ops)} ops applied")
+        if self.plan is not None and self.plan.mode == "flip":
+            ops = [type(op)(op.key, self._maybe_flip(op.key, bytes(op.value))
+                            if op.value is not None else None)
+                   for op in ops]
+        self.inner.do_atomically(ops)
+        self.commits += 1
+        self.batch_log.append(len(ops))
+
+    def iter_prefix(self, prefix):
+        self._check_alive()
+        return self.inner.iter_prefix(prefix)
+
+    def compact(self):
+        self._check_alive()
+        self.inner.compact()
+
+    def close(self):
+        # closing a dead store is a no-op (the "process" already died);
+        # tests reopen over the inner store afterwards
+        if not self.dead:
+            self.inner.close()
+
+    def disk_size_bytes(self):
+        return self.inner.disk_size_bytes()
+
+    def __len__(self):
+        self._check_alive()
+        return len(self.inner)

@@ -1,0 +1,75 @@
+"""Versioned checksum envelope for store meta records.
+
+The hot/cold DB's meta records (split, head, fork-choice snapshot,
+op-pool snapshot, schema version, db config) are the records a
+recovering node trusts FIRST on restart — a silently corrupted value
+there deserializes into garbage and takes the whole resume path down
+with a cryptic unpickle/decode error, or worse, adopts a wrong head.
+From schema v3 on, every such record is wrapped in this envelope so
+corruption is detected at the read boundary and surfaces as a
+:class:`StoreCorruptionError` the startup repair sweep (hot_cold.py)
+knows how to act on.
+
+Format (12-byte header + payload)::
+
+    MAGIC(4) = b"LHE\\x01"          format tag + envelope version
+    CRC(4)   = crc32(payload) LE    detects bit flips AND truncation
+    LEN(4)   = len(payload)   LE    detects appended garbage
+    payload  = the raw record bytes
+
+Deliberately crc32, not sha256: the envelope defends against torn
+writes and storage rot, not adversaries — an attacker with write access
+to the DB file can rewrite the checksum too.  crc32 is stdlib, fast,
+and catches every single-bit and truncation fault the crash sweep
+injects.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+MAGIC = b"LHE\x01"
+_HEADER = len(MAGIC) + 4 + 4  # magic + crc + len
+
+
+class StoreCorruptionError(ValueError):
+    """A stored record failed its integrity check.
+
+    Raised instead of whatever decode error the corrupt payload would
+    have produced; the message always names the record so an operator
+    (or the startup repair sweep) knows exactly what was damaged.
+    """
+
+
+def wrap(payload: bytes) -> bytes:
+    """Wrap a record payload in a checksum envelope."""
+    payload = bytes(payload)
+    return (MAGIC + zlib.crc32(payload).to_bytes(4, "little")
+            + len(payload).to_bytes(4, "little") + payload)
+
+
+def is_enveloped(data: bytes) -> bool:
+    """True when the bytes carry an envelope header (legacy records —
+    pre-v3 schemas — are raw and migrate on open)."""
+    return len(data) >= _HEADER and data[:len(MAGIC)] == MAGIC
+
+
+def unwrap(data: bytes, what: str = "record") -> bytes:
+    """Validate and strip the envelope; ``what`` names the record in
+    the :class:`StoreCorruptionError` raised on any mismatch."""
+    if not is_enveloped(data):
+        raise StoreCorruptionError(
+            f"{what}: missing or damaged envelope header "
+            f"({len(data)} byte(s), expected magic {MAGIC!r})")
+    want_crc = int.from_bytes(data[4:8], "little")
+    want_len = int.from_bytes(data[8:12], "little")
+    payload = data[_HEADER:]
+    if len(payload) != want_len:
+        raise StoreCorruptionError(
+            f"{what}: truncated or padded payload "
+            f"({len(payload)} byte(s), envelope says {want_len})")
+    if zlib.crc32(payload) != want_crc:
+        raise StoreCorruptionError(
+            f"{what}: checksum mismatch "
+            f"(crc32 {zlib.crc32(payload):#010x} != stored {want_crc:#010x})")
+    return payload

@@ -11,6 +11,19 @@ Storage engine: any KeyValueStore (the C++ log store for persistence,
 MemoryStore for tests) — the reference's LevelDB/memory split behind the
 same trait.  All import writes go through one atomic batch
 (do_atomically_with_block_and_blobs_cache, hot_cold_store.rs).
+
+Crash consistency (schema v3): meta records are wrapped in the
+checksummed envelope (store/envelope.py) so torn or rotted values are
+DETECTED on read (StoreCorruptionError) instead of silently
+deserialized; a dirty-shutdown marker triggers an integrity sweep on
+reopen that repairs what it can (split recomputed from the freezer
+boundary, corrupt head/fork-choice/op-pool snapshots dropped for the
+chain layer to rebuild, torn hot summaries pruned) and refuses — with a
+record-naming error — what it can't (the schema stamp).  Related meta
+mutations commit in single ``do_atomically`` batches: the split rides
+FIRST in the finalization prune batch (a torn prune leaves unpruned
+garbage, never unreadable state), and fork choice + head snapshot as
+one frame (persist_frame).
 """
 
 from __future__ import annotations
@@ -19,11 +32,15 @@ import json
 from dataclasses import dataclass
 
 from lighthouse_tpu import types as T
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.state_transition import (
     SignatureStrategy,
     process_block,
     state_advance,
 )
+from lighthouse_tpu.store.envelope import StoreCorruptionError, unwrap, wrap
 from lighthouse_tpu.store.kv import KeyValueOp, KeyValueStore, MemoryStore
 
 # key prefixes (reference DBColumn)
@@ -34,19 +51,36 @@ P_BLOBS = b"blb:"
 P_COLD_STATE = b"fzs:"   # freezer restore-point states by slot
 P_COLD_BLOCK_ROOT = b"fbr:"   # freezer canonical block root by slot
 P_COLD_STATE_ROOT = b"fsr:"   # freezer canonical state root by slot
-# P_META / K_SCHEMA / K_DB_CONFIG are owned by store/migrations.py (one
-# definition of the on-disk key bytes); re-exported here for callers
-from lighthouse_tpu.store.migrations import K_SCHEMA, P_META  # noqa: E402
-
-K_SPLIT = P_META + b"split"
-K_GENESIS_STATE_ROOT = P_META + b"genesis_state_root"
-K_HEAD = P_META + b"head"
-K_FORK_CHOICE = P_META + b"fork_choice"
-K_OP_POOL = P_META + b"op_pool"
+# the met:* key bytes are owned by store/migrations.py (one definition
+# of the on-disk encoding); re-exported here for callers
+from lighthouse_tpu.store.migrations import (  # noqa: E402
+    K_DIRTY,
+    K_FORK_CHOICE,
+    K_GENESIS_STATE_ROOT,
+    K_HEAD,
+    K_OP_POOL,
+    K_SCHEMA,
+    K_SPLIT,
+    P_META,
+)
 
 
 def _slot_key(prefix: bytes, slot: int) -> bytes:
     return prefix + int(slot).to_bytes(8, "big")
+
+
+def anchor_block_root(state) -> bytes:
+    """Block root an anchor state answers to (reference
+    anchor_block_root): the latest block header with its state_root
+    patched in when the state was taken at the block's own slot."""
+    header = state.latest_block_header
+    if bytes(header.state_root) == b"\x00" * 32:
+        return T.BeaconBlockHeader(
+            slot=header.slot, proposer_index=header.proposer_index,
+            parent_root=header.parent_root,
+            state_root=state.hash_tree_root(),
+            body_root=header.body_root).hash_tree_root()
+    return header.hash_tree_root()
 
 
 class StoreError(ValueError):
@@ -88,8 +122,23 @@ class HotColdDB:
             slots_per_restore_point
             if slots_per_restore_point is not None
             else 2 * spec.slots_per_epoch)
+        self._closed = False
+        fresh = self.hot.get(K_SCHEMA) is None
         self._init_schema()
+        # integrity sweep: a reopen after a crash (marker not "clean")
+        # repairs torn/corrupt meta records BEFORE anything reads them;
+        # LHTPU_STORE_SWEEP=1 forces it, =0 disables it (corruption then
+        # surfaces as StoreCorruptionError at the read site instead)
+        self.recovery: dict[str, str] = {}
+        knob = envreg.get("LHTPU_STORE_SWEEP")
+        dirty = (not fresh) and self.hot.get(K_DIRTY) != b"clean"
+        if knob != "0" and (dirty or knob == "1"):
+            self.recovery = self._startup_repair(dirty=dirty)
+        self._check_db_config()
         self.split_slot = self._load_split()
+        # marker goes dirty while we are open; an orderly close() (and
+        # only that) flips it back to clean
+        self._commit([KeyValueOp(K_DIRTY, b"dirty")])
 
     def disk_size_bytes(self) -> int:
         """Hot+cold on-disk footprint (reference store_disk_db_size)."""
@@ -103,11 +152,12 @@ class HotColdDB:
     def _init_schema(self):
         from lighthouse_tpu.store import migrations as mig
 
-        existing = self.hot.get(K_SCHEMA)
-        if existing is None:
+        if self.hot.get(K_SCHEMA) is None:
             mig.initialize_fresh(self)
             return
-        found = int.from_bytes(existing, "little")
+        # envelope-aware; corrupt stamps refuse the open with a clear
+        # StoreCorruptionError — we cannot know which migrations ran
+        found = mig.read_schema_version(self)
         if found > mig.CURRENT_SCHEMA_VERSION:
             raise StoreError(
                 f"schema version {found} is newer than supported "
@@ -116,6 +166,10 @@ class HotColdDB:
         if found < mig.CURRENT_SCHEMA_VERSION:
             # on-open auto-upgrade (reference schema_change.rs migrate path)
             mig.migrate_schema(self)
+
+    def _check_db_config(self):
+        from lighthouse_tpu.store import migrations as mig
+
         cfg = mig.read_db_config(self)
         if cfg is not None and cfg.get(
                 "slots_per_restore_point") != self.slots_per_restore_point:
@@ -124,22 +178,209 @@ class HotColdDB:
                 f"{cfg.get('slots_per_restore_point')} != configured "
                 f"{self.slots_per_restore_point}")
 
+    def _commit(self, ops: list[KeyValueOp]) -> None:
+        """THE hot-DB commit point: every meta/batch write funnels
+        through one atomic batch (lhlint LH701 enforces this for all of
+        store/ and chain/)."""
+        if self._closed:
+            raise StoreError("store is closed")
+        self.hot.do_atomically(ops)
+
+    def _get_meta_checked(self, key: bytes, what: str) -> bytes | None:
+        """Read an enveloped meta record; StoreCorruptionError names the
+        record instead of letting a torn value hit a deserializer."""
+        raw = self.hot.get(key)
+        if raw is None:
+            return None
+        return unwrap(raw, what)
+
     def _load_split(self) -> int:
-        raw = self.hot.get(K_SPLIT)
+        raw = self._get_meta_checked(K_SPLIT, "met:split")
         return int.from_bytes(raw, "little") if raw else 0
 
     def _save_split(self, ops: list[KeyValueOp] | None = None):
-        data = int(self.split_slot).to_bytes(8, "little")
+        data = wrap(int(self.split_slot).to_bytes(8, "little"))
         if ops is None:
-            self.hot.put(K_SPLIT, data)
+            self._commit([KeyValueOp(K_SPLIT, data)])
         else:
             ops.append(KeyValueOp(K_SPLIT, data))
 
     def put_metadata(self, key: bytes, value: bytes):
-        self.hot.put(P_META + key, value)
+        self._commit([KeyValueOp(P_META + key, value)])
 
     def get_metadata(self, key: bytes) -> bytes | None:
         return self.hot.get(P_META + key)
+
+    # -- startup recovery --------------------------------------------------
+
+    def recompute_split_from_freezer(self) -> int:
+        """The split is re-derivable: it is exactly one past the highest
+        slot the freezer holds a canonical block-root entry for (the
+        finalization migration commits the freezer batch BEFORE the hot
+        prune batch, so the freezer is never behind the split)."""
+        last = None
+        for key, _ in self.cold.iter_prefix(P_COLD_BLOCK_ROOT):
+            last = key
+        if last is None:
+            return 0
+        return int.from_bytes(last[len(P_COLD_BLOCK_ROOT):], "big") + 1
+
+    def anchor_at_split(self) -> tuple[bytes, bytes] | None:
+        """(state_root, block_root) of the finalization boundary state —
+        the replay anchor a fork-choice rebuild starts from.  The
+        finalized state's summary is the only one the prune keeps at
+        the split slot whose block is still stored."""
+        if self.split_slot == 0:
+            return None
+        for key, raw in self.hot.iter_prefix(P_SUMMARY):
+            s = HotStateSummary.from_bytes(raw)
+            if s.slot != self.split_slot:
+                continue
+            blk = self.get_block(s.latest_block_root)
+            if blk is not None and bytes(
+                    blk.message.state_root) == key[len(P_SUMMARY):]:
+                return key[len(P_SUMMARY):], s.latest_block_root
+        return None
+
+    def _head_known(self, head: bytes) -> bool:
+        """True when the chain layer can act on this head root: a stored
+        hot block, or an anchor root — genesis / checkpoint-sync anchors
+        store only state + summary, never a block record, yet are
+        perfectly valid persisted heads (a dirty shutdown before the
+        first block import must not cost the node its snapshot)."""
+        if self.hot.get(P_BLOCK + head) is not None:
+            return True
+        for _, raw in self.hot.iter_prefix(P_SUMMARY):
+            if HotStateSummary.from_bytes(raw).latest_block_root == head:
+                return True
+        # the genesis summary's latest_block_root is zeroed (the genesis
+        # header has no state_root at store time): recompute the root
+        # from the stored genesis state before condemning the head
+        try:
+            gsr = self._get_meta_checked(
+                K_GENESIS_STATE_ROOT, "met:genesis_state_root")
+        except StoreCorruptionError:
+            return False
+        if gsr is None:
+            return False
+        try:
+            state = self.get_hot_state(gsr)
+        except (StoreError, ValueError):
+            return False
+        if state is None:
+            return False
+        return anchor_block_root(state) == head
+
+    def _record_repair(self, report: dict, record: str, action: str):
+        report[record] = action
+        REGISTRY.counter(
+            "store_recovery_repairs_total",
+            "meta records repaired/dropped by the startup sweep",
+        ).labels(record=record, action=action).inc()
+
+    def _startup_repair(self, dirty: bool) -> dict[str, str]:
+        """Integrity sweep after a dirty shutdown: validate every meta
+        record, repair what is re-derivable, drop what the chain layer
+        can rebuild, prune hot summaries/states a torn finalization
+        prune left below the split.  Returns {record: action}."""
+        report: dict[str, str] = {}
+        ops: list[KeyValueOp] = []
+
+        # split: recomputable when corrupt or lost.  The freezer
+        # boundary is only the truth if the hot prune ran (the split
+        # advances inside the prune batch, AFTER the freezer commits) —
+        # a hot summary still sitting below the boundary means the
+        # migration never completed, so the split legitimately never
+        # moved: repairing it forward would prune live replay bases.
+        corrupt = False
+        try:
+            raw = self._get_meta_checked(K_SPLIT, "met:split")
+            split = int.from_bytes(raw, "little") if raw else 0
+            torn = raw is None
+        except StoreCorruptionError:
+            split = 0
+            torn = corrupt = True
+        if torn:
+            boundary = self.recompute_split_from_freezer()
+            if boundary > 0 and not any(
+                    HotStateSummary.from_bytes(raw).slot < boundary
+                    for _, raw in self.hot.iter_prefix(P_SUMMARY)):
+                split = boundary
+            if split > 0:
+                ops.append(KeyValueOp(
+                    K_SPLIT, wrap(int(split).to_bytes(8, "little"))))
+                self._record_repair(report, "split", "recomputed")
+            elif corrupt:
+                # even when the recompute is declined the damaged record
+                # must not outlive the sweep: the very next _load_split
+                # would re-raise and brick every subsequent open
+                ops.append(KeyValueOp(K_SPLIT, None))
+                self._record_repair(report, "split", "reset")
+
+        # head: must checksum AND name a root the chain can act on;
+        # otherwise the chain rebuilds its head from fork choice / the
+        # store
+        try:
+            head = self._get_meta_checked(K_HEAD, "met:head")
+            if head is not None and not self._head_known(head):
+                ops.append(KeyValueOp(K_HEAD, None))
+                self._record_repair(report, "head", "dropped")
+        except StoreCorruptionError:
+            ops.append(KeyValueOp(K_HEAD, None))
+            self._record_repair(report, "head", "dropped")
+
+        # opaque snapshots: drop on corruption, the owners re-derive
+        # (fork choice rebuilds from stored blocks, op pool starts empty)
+        for key, name in ((K_FORK_CHOICE, "fork_choice"),
+                          (K_OP_POOL, "op_pool"),
+                          (K_GENESIS_STATE_ROOT, "genesis_state_root")):
+            try:
+                self._get_meta_checked(key, "met:" + name)
+            except StoreCorruptionError:
+                ops.append(KeyValueOp(key, None))
+                self._record_repair(report, name, "dropped")
+
+        # db config: re-derivable from the configured open parameters
+        from lighthouse_tpu.store import migrations as mig
+
+        try:
+            mig.read_db_config(self)
+        except StoreCorruptionError:
+            cfg = json.dumps({
+                "slots_per_restore_point": self.slots_per_restore_point,
+            }).encode()
+            ops.append(KeyValueOp(mig.K_DB_CONFIG, wrap(cfg)))
+            self._record_repair(report, "db_config", "rewritten")
+
+        # torn finalization prune: the split commits FIRST in the prune
+        # batch, so leftovers are summaries/states BELOW it — re-delete
+        pruned = 0
+        for key, raw in list(self.hot.iter_prefix(P_SUMMARY)):
+            if HotStateSummary.from_bytes(raw).slot < split:
+                ops.append(KeyValueOp(key, None))
+                pruned += 1
+        for key, raw in list(self.hot.iter_prefix(P_STATE)):
+            if int.from_bytes(raw[:8], "little") < split:
+                ops.append(KeyValueOp(key, None))
+                pruned += 1
+        if pruned:
+            # fixed label value (counts go in a dedicated counter: a
+            # per-count label would mint a new series per sweep)
+            self._record_repair(report, "hot_prune", "pruned")
+            REGISTRY.counter(
+                "store_recovery_pruned_total",
+                "torn-prune leftovers re-deleted by the startup sweep",
+            ).inc(pruned)
+
+        if ops:
+            self._commit(ops)
+        REGISTRY.counter(
+            "store_recovery_sweeps_total",
+            "startup integrity sweeps over the meta records").inc()
+        with tracing.span("store.recovery", dirty=dirty,
+                          repairs=len(report), pruned=pruned):
+            pass
+        return report
 
     # -- fork helpers ------------------------------------------------------
 
@@ -292,7 +533,7 @@ class HotColdDB:
             epoch_boundary_state_root=boundary_root,
         )
         ops.append(KeyValueOp(P_SUMMARY + state_root, summary.to_bytes()))
-        self.hot.do_atomically(ops)
+        self._commit(ops)
 
     def _epoch_boundary_root(self, state, slot: int) -> bytes | None:
         """State root at this epoch's first slot, from state.state_roots."""
@@ -321,14 +562,14 @@ class HotColdDB:
             ).to_bytes()),
         ]
         if int(state.slot) == 0:
-            ops.append(KeyValueOp(K_GENESIS_STATE_ROOT, state_root))
+            ops.append(KeyValueOp(K_GENESIS_STATE_ROOT, wrap(state_root)))
         elif int(state.slot) > self.split_slot:
             # checkpoint anchor: everything below the anchor is freezer
             # territory (filled by backfill/reconstruction), so the
             # hot/cold split starts at the anchor slot
             self.split_slot = int(state.slot)
             self._save_split(ops)
-        self.hot.do_atomically(ops)
+        self._commit(ops)
 
     # -- freezer -----------------------------------------------------------
 
@@ -410,7 +651,16 @@ class HotColdDB:
         # not on the canonical chain (orphans die at finalization).  A
         # canonical block may only be dropped once its root is recorded in
         # the freezer — never lose canonical chain data.
+        #
+        # Crash ordering: the freezer batch above committed FIRST, and the
+        # split rides at the HEAD of this prune batch — on a torn prune
+        # (non-atomic engine dying mid-batch) the worst case is an
+        # advanced split with unpruned hot garbage, which the startup
+        # sweep re-deletes; the split can never point past data that is
+        # not yet in the freezer.
         hot_ops: list[KeyValueOp] = []
+        self.split_slot = fin_slot
+        self._save_split(hot_ops)
         canonical_set = set(canonical_block_roots.values())
         canonical_set.update(block_at_slot.values())
         canonical_set.add(finalized_block_root)
@@ -431,9 +681,7 @@ class HotColdDB:
                     and slot in canonical_block_roots):
                 hot_ops.append(KeyValueOp(key, None))
 
-        self.split_slot = fin_slot
-        self._save_split(hot_ops)
-        self.hot.do_atomically(hot_ops)
+        self._commit(hot_ops)
 
     def get_cold_state_by_slot(self, slot: int):
         """Restore-point load + replay (reference load_cold_state)."""
@@ -485,23 +733,43 @@ class HotColdDB:
 
     # -- persistence of auxiliary components ------------------------------
 
+    def persist_frame(
+        self,
+        fork_choice: bytes | None = None,
+        head: bytes | None = None,
+        op_pool: bytes | None = None,
+    ) -> None:
+        """Commit a restart-resume frame as ONE atomic batch: a crash
+        can never persist a head from one snapshot with the fork choice
+        of another (the torn-resume window the reference closes with
+        PersistedBeaconChain)."""
+        ops: list[KeyValueOp] = []
+        if fork_choice is not None:
+            ops.append(KeyValueOp(K_FORK_CHOICE, wrap(fork_choice)))
+        if head is not None:
+            ops.append(KeyValueOp(K_HEAD, wrap(head)))
+        if op_pool is not None:
+            ops.append(KeyValueOp(K_OP_POOL, wrap(op_pool)))
+        if ops:
+            self._commit(ops)
+
     def persist_fork_choice(self, blob: bytes):
-        self.hot.put(K_FORK_CHOICE, blob)
+        self.persist_frame(fork_choice=blob)
 
     def load_fork_choice(self) -> bytes | None:
-        return self.hot.get(K_FORK_CHOICE)
+        return self._get_meta_checked(K_FORK_CHOICE, "met:fork_choice")
 
     def persist_op_pool(self, blob: bytes):
-        self.hot.put(K_OP_POOL, blob)
+        self.persist_frame(op_pool=blob)
 
     def load_op_pool(self) -> bytes | None:
-        return self.hot.get(K_OP_POOL)
+        return self._get_meta_checked(K_OP_POOL, "met:op_pool")
 
     def persist_head(self, head_root: bytes):
-        self.hot.put(K_HEAD, head_root)
+        self.persist_frame(head=head_root)
 
     def load_head(self) -> bytes | None:
-        return self.hot.get(K_HEAD)
+        return self._get_meta_checked(K_HEAD, "met:head")
 
     # -- inspection (database manager support) ----------------------------
 
@@ -526,6 +794,12 @@ class HotColdDB:
             self.cold.compact()
 
     def close(self):
+        """Orderly shutdown: mark the DB clean, then close the engines.
+        Idempotent — recovery paths may unwind through here twice."""
+        if self._closed:
+            return
+        self._commit([KeyValueOp(K_DIRTY, b"clean")])
+        self._closed = True
         self.hot.close()
         if self.cold is not self.hot:
             self.cold.close()

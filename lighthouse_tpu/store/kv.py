@@ -108,7 +108,10 @@ class SqliteStore(KeyValueStore):
     def __init__(self, path: str):
         import sqlite3
 
-        self._conn = sqlite3.connect(path)
+        # autocommit connection: single put/delete statements commit on
+        # their own, and do_atomically owns its transaction explicitly —
+        # the driver's implicit-BEGIN magic can't interleave with it
+        self._conn = sqlite3.connect(path, isolation_level=None)
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS kv "
             "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
@@ -137,7 +140,14 @@ class SqliteStore(KeyValueStore):
             (bytes(key),)).fetchone() is not None
 
     def do_atomically(self, ops):
-        with self._conn:  # one transaction: all or nothing
+        # explicit BEGIN/COMMIT/ROLLBACK, not `with self._conn`: the
+        # context manager's implicit transaction depends on the
+        # connection's isolation/autocommit mode, and a batch that dies
+        # mid-loop (bad key type, full disk) must NEVER leave a prefix
+        # applied.  BEGIN IMMEDIATE also takes the write lock up front,
+        # so a concurrent reader can't wedge the batch halfway.
+        self._conn.execute("BEGIN IMMEDIATE")
+        try:
             for op in ops:
                 if op.value is None:
                     self._conn.execute(
@@ -146,6 +156,10 @@ class SqliteStore(KeyValueStore):
                     self._conn.execute(
                         "INSERT OR REPLACE INTO kv VALUES (?, ?)",
                         (bytes(op.key), bytes(op.value)))
+        except BaseException:
+            self._conn.rollback()
+            raise
+        self._conn.commit()
 
     def iter_prefix(self, prefix):
         prefix = bytes(prefix)
@@ -175,7 +189,11 @@ class SqliteStore(KeyValueStore):
         self._conn.commit()
 
     def close(self):
-        self._conn.close()
+        # idempotent: a crash-recovery path may close the store a second
+        # time while unwinding (mirrors NativeKVStore's handle guard)
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
 
     def disk_size_bytes(self) -> int:
         (pages,) = self._conn.execute("PRAGMA page_count").fetchone()

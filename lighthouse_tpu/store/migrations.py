@@ -3,32 +3,56 @@
 Rebuild of /root/reference/beacon_node/store/src/metadata.rs +
 /root/reference/beacon_node/beacon_chain/src/schema_change.rs: the DB
 records its schema version; on open, registered migration steps upgrade
-it version-by-version (each step atomic), and an unknown/newer version is
-a hard error.  The database-manager CLI calls `migrate` explicitly for
+it version-by-version, and an unknown/newer version is a hard error.
+The database-manager CLI calls `migrate` explicitly for
 downgrades-by-tool or offline upgrades.
+
+Crash consistency: every step's writes AND its ``K_SCHEMA`` stamp
+commit in ONE ``do_atomically`` batch — a crash anywhere inside the
+walk leaves the stored version pointing at the last fully applied step,
+and the next open simply resumes the walk from there.  Steps therefore
+do not write directly: they append :class:`KeyValueOp` entries to the
+batch they are handed.
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
+from lighthouse_tpu.store import envelope
+from lighthouse_tpu.store.envelope import StoreCorruptionError
 from lighthouse_tpu.store.kv import KeyValueOp
 
-# This module OWNS the schema/config keys; hot_cold.py imports them so the
-# on-disk key bytes have exactly one definition.
+# This module OWNS the meta key bytes; hot_cold.py imports them so the
+# on-disk encoding has exactly one definition.
 P_META = b"met:"
 K_SCHEMA = P_META + b"schema"
 K_DB_CONFIG = P_META + b"db_config"
+K_SPLIT = P_META + b"split"
+K_GENESIS_STATE_ROOT = P_META + b"genesis_state_root"
+K_HEAD = P_META + b"head"
+K_FORK_CHOICE = P_META + b"fork_choice"
+K_OP_POOL = P_META + b"op_pool"
+# dirty-shutdown marker: b"dirty" while a HotColdDB is open, b"clean"
+# after an orderly close; anything else (or absent on a non-fresh DB)
+# triggers the startup integrity sweep.  Raw bytes, no envelope — a
+# corrupt marker must read as "dirty", never as an error.
+K_DIRTY = P_META + b"dirty"
 
-CURRENT_SCHEMA_VERSION = 2
+# every meta record wrapped in the checksum envelope from v3 on
+ENVELOPED_META = (K_SPLIT, K_GENESIS_STATE_ROOT, K_HEAD, K_FORK_CHOICE,
+                  K_OP_POOL, K_DB_CONFIG)
+
+CURRENT_SCHEMA_VERSION = 3
 
 
 class MigrationError(ValueError):
     pass
 
 
-# registry: from_version -> (to_version, step). Steps receive the HotColdDB
-# and must apply their writes atomically.
+# registry: from_version -> (to_version, step). Steps receive
+# (HotColdDB, ops) and append their writes to `ops`; the walk commits
+# ops + the version stamp as one atomic batch.
 _UP: dict[int, tuple[int, Callable]] = {}
 _DOWN: dict[int, tuple[int, Callable]] = {}
 
@@ -40,15 +64,33 @@ def register_migration(from_v: int, to_v: int, up: Callable,
         _DOWN[to_v] = (from_v, down)
 
 
+def _encode_version(version: int) -> bytes:
+    raw = version.to_bytes(8, "little")
+    # pre-v3 schemas store the raw integer (that is what their readers
+    # expect after a downgrade); v3+ wraps it like every meta record
+    return envelope.wrap(raw) if version >= 3 else raw
+
+
 def read_schema_version(db) -> int:
     raw = db.hot.get(K_SCHEMA)
     if raw is None:
         return 0
-    return int.from_bytes(raw, "little")
+    if envelope.is_enveloped(raw):
+        payload = envelope.unwrap(raw, "met:schema")
+        if len(payload) != 8:
+            raise StoreCorruptionError(
+                f"met:schema: version payload is {len(payload)} byte(s), "
+                "expected 8")
+        return int.from_bytes(payload, "little")
+    if len(raw) == 8:  # legacy pre-v3 stamp
+        return int.from_bytes(raw, "little")
+    raise StoreCorruptionError(
+        f"met:schema: {len(raw)} byte(s), neither an envelope nor a "
+        "legacy 8-byte version stamp — refusing to guess what ran here")
 
 
-def _write_version(db, version: int, extra_ops=()) -> None:
-    ops = [KeyValueOp(K_SCHEMA, version.to_bytes(8, "little")), *extra_ops]
+def _commit_step(db, version: int, extra_ops=()) -> None:
+    ops = [*extra_ops, KeyValueOp(K_SCHEMA, _encode_version(version))]
     db.hot.do_atomically(ops)
 
 
@@ -56,34 +98,39 @@ def initialize_fresh(db) -> int:
     """Fresh DB: stamp v1 then walk the registry to current, so every
     version's on-disk side effects are applied exactly as an upgrade
     would (no hand-maintained 'fresh init' duplicating the steps)."""
-    _write_version(db, 1)
+    _commit_step(db, 1)
     return migrate_schema(db)
 
 
 def migrate_schema(db, target: int | None = None) -> int:
     """Walk registered steps from the stored version to `target`
-    (default: CURRENT_SCHEMA_VERSION).  Returns the final version."""
+    (default: CURRENT_SCHEMA_VERSION).  Returns the final version.
+
+    Each step's writes and its version stamp are one atomic batch, so
+    an interrupted walk resumes from the stored version on reopen."""
     target = CURRENT_SCHEMA_VERSION if target is None else target
     v = read_schema_version(db)
     if v == 0:
         # fresh DB: start from v1 and walk the registry like any upgrade
-        _write_version(db, 1)
+        _commit_step(db, 1)
         v = 1
     while v < target:
         if v not in _UP:
             raise MigrationError(
                 f"no migration path from schema v{v} toward v{target}")
         to_v, step = _UP[v]
-        step(db)
-        _write_version(db, to_v)
+        ops: list[KeyValueOp] = []
+        step(db, ops)
+        _commit_step(db, to_v, ops)
         v = to_v
     while v > target:
         if v not in _DOWN:
             raise MigrationError(
                 f"no downgrade path from schema v{v} toward v{target}")
         to_v, step = _DOWN[v]
-        step(db)
-        _write_version(db, to_v)
+        ops = []
+        step(db, ops)
+        _commit_step(db, to_v, ops)
         v = to_v
     return v
 
@@ -91,34 +138,66 @@ def migrate_schema(db, target: int | None = None) -> int:
 # --- v1 -> v2: persist the on-disk config ----------------------------------
 # The reference's OnDiskStoreConfig guards against reopening a freezer with
 # an incompatible slots_per_restore_point; v2 stores it in metadata and
-# HotColdDB.__init__ validates it on open.
+# HotColdDB validates it on open.
 
-def _v1_to_v2(db) -> None:
+def _v1_to_v2(db, ops) -> None:
     import json
 
     cfg = json.dumps({
         "slots_per_restore_point": db.slots_per_restore_point,
     }).encode()
-    db.hot.do_atomically([KeyValueOp(K_DB_CONFIG, cfg)])
+    # raw at v2; the v3 step wraps it (matching what a real v2 DB holds)
+    ops.append(KeyValueOp(K_DB_CONFIG, cfg))
 
 
-def _v2_to_v1(db) -> None:
-    db.hot.do_atomically([KeyValueOp(K_DB_CONFIG, None)])
+def _v2_to_v1(db, ops) -> None:
+    ops.append(KeyValueOp(K_DB_CONFIG, None))
 
 
 register_migration(1, 2, _v1_to_v2, _v2_to_v1)
+
+
+# --- v2 -> v3: checksum envelopes on meta records ---------------------------
+# Wrap every existing meta record; the stamp commits in the same batch,
+# so a reopened half-migrated DB re-runs the wrap (idempotent: already
+# enveloped records are skipped).
+
+def _v2_to_v3(db, ops) -> None:
+    for key in ENVELOPED_META:
+        raw = db.hot.get(key)
+        if raw is not None and not envelope.is_enveloped(raw):
+            ops.append(KeyValueOp(key, envelope.wrap(raw)))
+
+
+def _v3_to_v2(db, ops) -> None:
+    for key in ENVELOPED_META:
+        raw = db.hot.get(key)
+        if raw is not None and envelope.is_enveloped(raw):
+            ops.append(KeyValueOp(key, envelope.unwrap(raw, key.decode())))
+
+
+register_migration(2, 3, _v2_to_v3, _v3_to_v2)
 
 
 def read_db_config(db) -> dict | None:
     import json
 
     raw = db.hot.get(K_DB_CONFIG)
-    return None if raw is None else json.loads(raw)
+    if raw is None:
+        return None
+    payload = (envelope.unwrap(raw, "met:db_config")
+               if envelope.is_enveloped(raw) else raw)
+    try:
+        return json.loads(payload)
+    except ValueError as e:
+        raise StoreCorruptionError(f"met:db_config: undecodable ({e})")
 
 
 __all__ = [
     "CURRENT_SCHEMA_VERSION",
+    "ENVELOPED_META",
     "MigrationError",
+    "StoreCorruptionError",
     "migrate_schema",
     "read_db_config",
     "read_schema_version",

@@ -1,9 +1,8 @@
 """lhlint (tools/lint) — fixture coverage for every pass + the real-tree
 baseline gate.
 
-Each of the five passes gets at least one positive fixture (the rule
-must fire) and one negative fixture (the compliant twin must stay
-silent).  Fixtures are tiny synthesized packages mirroring the real
+Every pass gets at least one positive fixture (the rule must fire) and
+one negative fixture (the compliant twin must stay silent).  Fixtures are tiny synthesized packages mirroring the real
 layout (``chain/beacon_chain.py``, ``ops/dispatch_pipeline.py``,
 ``common/env.py``…) so the passes' real module-targeting config applies
 unchanged.  The real-tree tests are the tier-1 wiring: the analyzer
@@ -450,6 +449,67 @@ def test_supervisor_pass_negative_supervised_chain(tmp_path):
                 return _pair(parts, 2)
         """,
     })
+    assert analyze(pkg) == []
+
+
+# -- pass 7: store commit discipline ------------------------------------------
+
+
+def test_store_pass_flags_raw_engine_write(tmp_path):
+    # a raw hot.put next to other mutations is exactly the torn window
+    pkg, _ = make_pkg(tmp_path, {"store/hot_cold.py": """
+        class DB:
+            def sneaky_meta_write(self, key, value):
+                self.hot.put(key, value)
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH701"]
+    assert findings[0].symbol == "DB.sneaky_meta_write:hot.put"
+    assert "do_atomically" in findings[0].message
+
+
+def test_store_pass_flags_chain_modules_and_bare_names(tmp_path):
+    # chain/ is in scope too, and `cold` bound to a bare name still hits
+    pkg, _ = make_pkg(tmp_path, {"chain/beacon_chain.py": """
+        def prune(store):
+            cold = store.cold
+            cold.delete(b"fbr:0")
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH701"]
+    assert findings[0].symbol == "prune:cold.delete"
+
+
+def test_store_pass_negative_commit_points_and_batches(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"store/hot_cold.py": """
+        class DB:
+            def put_block(self, root, payload):
+                self.hot.put(b"blk:" + root, payload)
+
+            def delete_block(self, root):
+                self.hot.delete(b"blk:" + root)
+
+            def migrate(self, ops):
+                self.hot.do_atomically(ops)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_store_pass_out_of_scope_modules_ignored(tmp_path):
+    # network/backfill-style writers are outside the pass's modules
+    pkg, _ = make_pkg(tmp_path, {"network/backfill.py": """
+        def backfill(store):
+            store.cold.put(b"fbr:0", b"x")
+    """})
+    assert analyze(pkg) == []
+
+
+def test_store_pass_suppression(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"store/hot_cold.py": """
+        class DB:
+            def waived(self, key, value):
+                self.hot.put(key, value)  # lhlint: allow(LH701)
+    """})
     assert analyze(pkg) == []
 
 

@@ -92,3 +92,131 @@ class TestNodeResume:
         chain = BeaconChain(h.spec, h.state.copy(),
                             verify_signatures=True, store=store)
         assert not chain.try_resume()
+        assert chain.resume_mode == "fresh"
+
+    def test_genesis_head_survives_dirty_restart(self):
+        """A dirty shutdown BEFORE the first block import must not cost
+        the node its snapshot: the persisted head names the genesis
+        anchor root, which has state + summary but no block record —
+        the startup sweep must not condemn it."""
+        h = Harness(16, fork="altair", real_crypto=False)
+        kv = MemoryStore()
+        chain = BeaconChain(h.spec, h.state.copy(),
+                            verify_signatures=True,
+                            store=HotColdDB(h.spec, kv))
+        chain.persist()
+        # crash: never closed, the marker stays dirty
+
+        h2 = Harness(16, fork="altair", real_crypto=False)
+        store2 = HotColdDB(h.spec, kv)
+        assert store2.recovery.get("head") is None  # sweep kept it
+        chain2 = BeaconChain(h.spec, h2.state.copy(),
+                             verify_signatures=True, store=store2)
+        assert chain2.try_resume()
+        assert chain2.resume_mode == "snapshot"
+        assert chain2.head_root == chain.head_root
+
+    def test_snapshot_resume_reports_mode(self):
+        h = Harness(16, fork="altair", real_crypto=False)
+        kv = MemoryStore()
+        chain = _build_chain(h, store=HotColdDB(h.spec, kv), n_blocks=4)
+        chain.persist()
+        h2 = Harness(16, fork="altair", real_crypto=False)
+        chain2 = BeaconChain(h.spec, h2.state.copy(),
+                             verify_signatures=True,
+                             store=HotColdDB(h.spec, kv))
+        assert chain2.try_resume()
+        assert chain2.resume_mode == "snapshot"
+
+
+class TestForkChoiceRebuild:
+    """The repair rung below snapshot resume: when the snapshot is
+    missing or corrupt, fork choice is reconstructed by replaying the
+    stored blocks (README "Crash consistency" repair ladder)."""
+
+    def _crashed_node(self, h, kv, n_blocks=12, persist=True):
+        chain = _build_chain(h, store=HotColdDB(h.spec, kv),
+                             n_blocks=n_blocks)
+        if persist:
+            chain.persist()
+        return chain  # never closed: the marker stays dirty
+
+    def test_rebuild_when_snapshot_missing(self):
+        """A node killed before its first persist still recovers its
+        head from the stored blocks alone."""
+        h = Harness(16, fork="altair", real_crypto=False)
+        kv = MemoryStore()
+        chain = self._crashed_node(h, kv, persist=False)
+        head, head_slot = chain.head_root, int(chain.head_state.slot)
+
+        h2 = Harness(16, fork="altair", real_crypto=False)
+        chain2 = BeaconChain(h.spec, h2.state.copy(),
+                             verify_signatures=True,
+                             store=HotColdDB(h.spec, kv))
+        assert chain2.try_resume()
+        assert chain2.resume_mode == "rebuilt"
+        assert chain2.head_root == head
+        assert int(chain2.head_state.slot) == head_slot
+        # the rebuild re-persisted atomically: next open resumes fast
+        h3 = Harness(16, fork="altair", real_crypto=False)
+        chain3 = BeaconChain(h.spec, h3.state.copy(),
+                             verify_signatures=True,
+                             store=HotColdDB(h.spec, kv))
+        assert chain3.try_resume()
+        assert chain3.resume_mode == "snapshot"
+        assert chain3.head_root == head
+
+    def test_rebuild_when_snapshot_corrupt(self):
+        """A bit-flipped fork-choice snapshot is detected by the
+        envelope, dropped by the dirty-open sweep, and rebuilt — and the
+        node keeps importing afterwards."""
+        from lighthouse_tpu.store.migrations import K_FORK_CHOICE
+
+        h = Harness(16, fork="altair", real_crypto=False)
+        kv = MemoryStore()
+        chain = self._crashed_node(h, kv)
+        head, head_slot = chain.head_root, int(chain.head_state.slot)
+        blob = kv.get(K_FORK_CHOICE)
+        corrupt = bytearray(blob)
+        corrupt[len(corrupt) // 2] ^= 0x40
+        kv.put(K_FORK_CHOICE, bytes(corrupt))
+
+        h2 = Harness(16, fork="altair", real_crypto=False)
+        store2 = HotColdDB(h.spec, kv)  # dirty open: sweep drops the blob
+        assert store2.recovery.get("fork_choice") == "dropped"
+        chain2 = BeaconChain(h.spec, h2.state.copy(),
+                             verify_signatures=True, store=store2)
+        assert chain2.try_resume()
+        assert chain2.resume_mode == "rebuilt"
+        assert chain2.head_root == head
+        chain2.slot_clock.set_slot(head_slot + 1)
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        assert chain2.process_block(signed) == chain2.head_root
+
+    def test_rebuild_after_finalization_anchors_at_split(self):
+        """Post-finalization stores have pruned cold-era states; the
+        rebuild must anchor at the finalization-boundary state the
+        prune keeps, not at genesis."""
+        h = Harness(32, fork="altair", real_crypto=False)
+        kv = MemoryStore()
+        chain = self._crashed_node(h, kv, n_blocks=12, persist=False)
+        head = chain.head_root
+        # force the store-level finalization migration at slot 8
+        slot8_root = None
+        for root, blk in chain.store.iter_hot_blocks():
+            if int(blk.message.slot) == 8:
+                slot8_root = root
+                slot8_state_root = bytes(blk.message.state_root)
+        assert slot8_root is not None
+        chain.store.migrate_to_finalized(slot8_state_root, slot8_root)
+        assert chain.store.split_slot == 8
+
+        h2 = Harness(32, fork="altair", real_crypto=False)
+        store2 = HotColdDB(h.spec, kv)
+        chain2 = BeaconChain(h.spec, h2.state.copy(),
+                             verify_signatures=True, store=store2)
+        assert chain2.try_resume()
+        assert chain2.resume_mode == "rebuilt"
+        assert chain2.head_root == head
+        assert chain2.fork_choice.finalized.root == slot8_root

@@ -9,10 +9,24 @@ import numpy as np
 import pytest
 
 from lighthouse_tpu.store import (
+    CrashPointStore,
     HotColdDB,
+    InjectedCrash,
+    InjectedIOError,
     KeyValueOp,
     MemoryStore,
     NativeKVStore,
+    StoreCorruptionError,
+    StoreFaultPlan,
+)
+from lighthouse_tpu.store.hot_cold import P_SUMMARY, HotStateSummary
+from lighthouse_tpu.store.migrations import (
+    K_DIRTY,
+    K_FORK_CHOICE,
+    K_HEAD,
+    K_OP_POOL,
+    K_SCHEMA,
+    K_SPLIT,
 )
 from lighthouse_tpu.testing import Harness
 
@@ -96,6 +110,52 @@ class TestSqliteKV:
         assert got == [(b"p:\x01", b"\x01"), (b"p:\x02", b"\x02"),
                        (b"p:\x03", b"\x03")]
         assert list(db.iter_prefix(b"p\xff")) == [(b"p\xffz", b"edge")]
+        db.close()
+
+    def test_midbatch_failure_applies_nothing(self, tmp_path):
+        """A batch that dies mid-loop must roll back its prefix — the
+        whole point of do_atomically (real BEGIN/ROLLBACK, not best
+        effort)."""
+        from lighthouse_tpu.store import SqliteStore
+
+        db = SqliteStore(str(tmp_path / "db.sqlite"))
+        db.put(b"pre", b"kept")
+        with pytest.raises(TypeError):
+            db.do_atomically([
+                KeyValueOp(b"a", b"1"),
+                KeyValueOp(b"b", b"2"),
+                KeyValueOp(b"c", object()),  # bytes() raises mid-batch
+            ])
+        assert db.get(b"a") is None and db.get(b"b") is None
+        assert db.get(b"pre") == b"kept"
+        # the connection is usable again (transaction fully unwound)
+        db.do_atomically([KeyValueOp(b"a", b"1")])
+        assert db.get(b"a") == b"1"
+        db.close()
+
+
+class TestEngineClose:
+    """close() is idempotent for all three engines: crash-recovery
+    paths may unwind through a close twice."""
+
+    def test_memory(self):
+        db = MemoryStore()
+        db.put(b"k", b"v")
+        db.close()
+        db.close()
+
+    def test_sqlite(self, tmp_path):
+        from lighthouse_tpu.store import SqliteStore
+
+        db = SqliteStore(str(tmp_path / "db.sqlite"))
+        db.put(b"k", b"v")
+        db.close()
+        db.close()
+
+    def test_native(self, tmp_path):
+        db = NativeKVStore(str(tmp_path / "db"))
+        db.put(b"k", b"v")
+        db.close()
         db.close()
 
 
@@ -247,6 +307,212 @@ class TestHotColdMetadata:
         stats = db.summary_stats()
         assert stats["blocks"] >= 15
         assert stats["cold_block_roots"] == 16
+
+
+def _fork_kv(db) -> MemoryStore:
+    """Copy-on-write snapshot of a memory-backed DB so corruption tests
+    never mutate the shared fixture."""
+    kv = MemoryStore()
+    kv._d = dict(db.hot._d)
+    return kv
+
+
+def _flip_bit(value: bytes, bit: int = 12) -> bytes:
+    out = bytearray(value)
+    out[bit // 8] ^= 1 << (bit % 8)
+    return bytes(out)
+
+
+META_RECORDS = [
+    (K_SPLIT, "split"),
+    (K_HEAD, "head"),
+    (K_FORK_CHOICE, "fork_choice"),
+    (K_OP_POOL, "op_pool"),
+]
+
+
+class TestCorruptionMatrix:
+    """Every checksummed meta record x {truncated, bit-flipped, missing}
+    is detected, repaired, or refused with a record-naming
+    StoreCorruptionError — never a cryptic deserializer crash."""
+
+    def _snapshot(self, chain_db) -> MemoryStore:
+        """A finalized store (split=16) with every meta record
+        populated, cleanly closed."""
+        h, db, imported = chain_db
+        kv = _fork_kv(db)
+        db2 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        if db2.split_slot == 0:  # fixture not yet migrated by the
+            # earlier test class: finalize the fork ourselves
+            db2.migrate_to_finalized(imported[15][1], imported[15][0])
+        db2.persist_frame(fork_choice=b"fc-blob", head=imported[-1][0],
+                          op_pool=b"op-blob")
+        db2.close()
+        return kv
+
+    @pytest.mark.parametrize("key,name", META_RECORDS)
+    @pytest.mark.parametrize("kind", ["truncated", "bitflip", "missing"])
+    def test_dirty_reopen_repairs(self, chain_db, key, name, kind):
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        if kind == "missing":
+            kv.delete(key)
+        elif kind == "truncated":
+            kv.put(key, kv.get(key)[:-3])
+        else:
+            kv.put(key, _flip_bit(kv.get(key)))
+        kv.put(K_DIRTY, b"dirty")  # crash-marked: the sweep must run
+
+        db3 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        if key == K_SPLIT:
+            # re-derivable: recomputed from the freezer boundary
+            assert db3.split_slot == 16
+            if kind != "missing":
+                assert db3.recovery.get("split") == "recomputed"
+        elif kind != "missing":
+            # dropped for the owner to rebuild
+            assert db3.recovery.get(name) == "dropped"
+            loader = getattr(db3, f"load_{name}")
+            assert loader() is None
+        db3.close()
+
+    @pytest.mark.parametrize("key,name", META_RECORDS)
+    def test_clean_reopen_detects_on_read(self, chain_db, key, name):
+        """With a clean marker the sweep is skipped; corruption that
+        happened at rest must still surface as StoreCorruptionError
+        naming the record."""
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        kv.put(key, _flip_bit(kv.get(key)))
+        if key == K_SPLIT:
+            with pytest.raises(StoreCorruptionError, match="met:split"):
+                HotColdDB(h.spec, kv, slots_per_restore_point=8)
+            return
+        db3 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        with pytest.raises(StoreCorruptionError, match=f"met:{name}"):
+            getattr(db3, f"load_{name}")()
+        db3.close()
+
+    @pytest.mark.parametrize("dirty", [True, False])
+    def test_corrupt_schema_refuses_open(self, chain_db, dirty):
+        """The schema stamp is the one record with no repair: we cannot
+        know which migrations ran, so the open must refuse loudly."""
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        kv.put(K_SCHEMA, _flip_bit(kv.get(K_SCHEMA)))
+        if dirty:
+            kv.put(K_DIRTY, b"dirty")
+        with pytest.raises(StoreCorruptionError, match="met:schema"):
+            HotColdDB(h.spec, kv, slots_per_restore_point=8)
+
+    def test_forced_sweep_repairs_at_rest_corruption(self, chain_db,
+                                                     monkeypatch):
+        """LHTPU_STORE_SWEEP=1: offline disk surgery, operator wants the
+        ladder to run despite the clean marker."""
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        kv.put(K_FORK_CHOICE, _flip_bit(kv.get(K_FORK_CHOICE)))
+        monkeypatch.setenv("LHTPU_STORE_SWEEP", "1")
+        db3 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        assert db3.recovery.get("fork_choice") == "dropped"
+        assert db3.load_fork_choice() is None
+        db3.close()
+
+    def test_corrupt_split_with_declined_recompute_resets(self, chain_db):
+        """When the freezer boundary can NOT be adopted (a hot summary
+        below it proves the prune never ran) the corrupt split record
+        must still be cleared — left on disk it would re-raise at
+        _load_split and brick every subsequent open."""
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        kv.put(K_SPLIT, _flip_bit(kv.get(K_SPLIT)))
+        # a surviving hot summary below the freezer boundary: the
+        # migration "never completed", so the recompute is declined
+        kv.put(P_SUMMARY + b"\xab" * 32, HotStateSummary(
+            slot=5, latest_block_root=b"\xcd" * 32,
+            epoch_boundary_state_root=b"\xab" * 32).to_bytes())
+        kv.put(K_DIRTY, b"dirty")
+
+        db3 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        assert db3.recovery.get("split") == "reset"
+        assert db3.split_slot == 0
+        db3.close()
+        # the store reopens cleanly afterwards — no lingering corruption
+        db4 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        assert db4.split_slot == 0
+        db4.close()
+
+    def test_head_naming_a_lost_block_is_dropped(self, chain_db):
+        """A head record that checksums fine but points at a block the
+        store no longer holds is as useless as a corrupt one."""
+        h, db, imported = chain_db
+        kv = self._snapshot(chain_db)
+        db3 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        db3.persist_head(b"\xee" * 32)  # no such block
+        db3.close()
+        kv.put(K_DIRTY, b"dirty")
+        db4 = HotColdDB(h.spec, kv, slots_per_restore_point=8)
+        assert db4.recovery.get("head") == "dropped"
+        assert db4.load_head() is None
+        db4.close()
+
+
+class TestCrashPointStore:
+    def test_flip_plants_detectable_corruption(self, chain_db):
+        """A bit flipped at WRITE time (device/disk lying) is caught at
+        READ time by the envelope — the end-to-end checksum story."""
+        h, db, imported = chain_db
+        kv = _fork_kv(db)
+        crash = CrashPointStore(kv, StoreFaultPlan(
+            mode="flip", key=b"met:head", bit=40))
+        db2 = HotColdDB(h.spec, crash, slots_per_restore_point=8)
+        db2.persist_head(imported[-1][0])
+        with pytest.raises(StoreCorruptionError, match="met:head"):
+            db2.load_head()
+
+    def test_io_fault_is_transient(self):
+        kv = MemoryStore()
+        kv.put(b"k", b"v")
+        crash = CrashPointStore(kv, StoreFaultPlan(mode="io", key=b"k"))
+        with pytest.raises(InjectedIOError):
+            crash.get(b"k")
+        assert crash.get(b"k") == b"v"  # max_fires=1: store survives
+
+    def test_dead_store_blocks_everything(self):
+        kv = MemoryStore()
+        crash = CrashPointStore(kv, StoreFaultPlan(mode="crash", batch=1))
+        crash.put(b"a", b"1")
+        with pytest.raises(InjectedCrash):
+            crash.put(b"b", b"2")
+        with pytest.raises(InjectedCrash):
+            crash.get(b"a")
+        assert kv.get(b"a") == b"1"   # the surviving disk image
+        assert kv.get(b"b") is None
+
+    def test_drop_applies_exactly_the_prefix(self):
+        kv = MemoryStore()
+        crash = CrashPointStore(kv, StoreFaultPlan(
+            mode="drop", batch=0, op=2))
+        with pytest.raises(InjectedCrash):
+            crash.do_atomically([KeyValueOp(b"a", b"1"),
+                                 KeyValueOp(b"b", b"2"),
+                                 KeyValueOp(b"c", b"3")])
+        assert kv.get(b"a") == b"1" and kv.get(b"b") == b"2"
+        assert kv.get(b"c") is None
+
+    def test_env_plan(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_STORE_FAULT_MODE", "crash")
+        monkeypatch.setenv("LHTPU_STORE_FAULT_BATCH", "0")
+        crash = CrashPointStore.from_env(MemoryStore())
+        with pytest.raises(InjectedCrash):
+            crash.put(b"k", b"v")
+
+    def test_malformed_env_plan_disables_injection(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_STORE_FAULT_MODE", "explode")
+        crash = CrashPointStore.from_env(MemoryStore())
+        assert crash.plan is None
+        crash.put(b"k", b"v")
+        assert crash.get(b"k") == b"v"
 
 
 class TestHotColdOnNativeKV:

@@ -5,14 +5,26 @@ import pytest
 from lighthouse_tpu.state_transition import state_transition
 from lighthouse_tpu.store import (
     CURRENT_SCHEMA_VERSION,
+    CrashPointStore,
     HotColdDB,
+    InjectedCrash,
+    KeyValueOp,
     MemoryStore,
     MigrationError,
     StoreError,
+    StoreFaultPlan,
     migrate_schema,
     read_schema_version,
 )
-from lighthouse_tpu.store.migrations import K_DB_CONFIG, K_SCHEMA, read_db_config
+from lighthouse_tpu.store import envelope, migrations
+from lighthouse_tpu.store.migrations import (
+    K_DB_CONFIG,
+    K_DIRTY,
+    K_HEAD,
+    K_SCHEMA,
+    K_SPLIT,
+    read_db_config,
+)
 from lighthouse_tpu.store.reconstruct import (
     oldest_reconstructed_slot,
     reconstruct_historic_states,
@@ -62,6 +74,91 @@ class TestSchema:
         db = HotColdDB(h.spec, MemoryStore())
         with pytest.raises(MigrationError):
             migrate_schema(db, target=7)
+
+
+class _BatchRecorder(MemoryStore):
+    """MemoryStore that remembers each atomic batch's key set."""
+
+    def __init__(self):
+        super().__init__()
+        self.batches: list[set] = []
+
+    def do_atomically(self, ops):
+        self.batches.append({op.key for op in ops})
+        super().do_atomically(ops)
+
+
+class TestCrashConsistentWalk:
+    def test_every_step_stamps_schema_in_its_own_batch(self):
+        """Each migration step's writes commit WITH their version stamp:
+        a crash between 'apply step' and 'record that it ran' is exactly
+        the torn window the walk must not have."""
+        h = Harness(8, real_crypto=False)
+        kv = _BatchRecorder()
+        HotColdDB(h.spec, kv)  # fresh init walks v1 -> current
+        config_batches = [b for b in kv.batches if K_DB_CONFIG in b]
+        assert config_batches, "v1->v2 never wrote the db config"
+        for batch in config_batches:
+            assert K_SCHEMA in batch, \
+                "step writes and schema stamp committed separately"
+
+    def test_interrupted_walk_resumes_from_stored_version(self):
+        """Kill the walk so a step's writes tear in without the stamp
+        (MemoryStore is non-atomic under drop faults); the reopened walk
+        must re-run that step from the STORED version, not skip it."""
+        h = Harness(8, real_crypto=False)
+        kv = MemoryStore()
+        db = HotColdDB(h.spec, kv)
+        marker = b"met:v4_marker"
+
+        def _up(db, ops):
+            ops.append(KeyValueOp(marker, b"x"))
+
+        def _down(db, ops):
+            ops.append(KeyValueOp(marker, None))
+
+        migrations.register_migration(3, 4, _up, _down)
+        try:
+            # ops = [marker, stamp]; drop after 1 op: marker lands,
+            # stamp does not — the torn walk
+            crash = CrashPointStore(
+                kv, StoreFaultPlan(mode="drop", batch=0, op=1))
+            db.hot = crash
+            db.cold = crash
+            with pytest.raises(InjectedCrash):
+                migrate_schema(db, target=4)
+            db.hot = kv
+            db.cold = kv
+            assert read_schema_version(db) == 3   # stamp never landed
+            assert kv.get(marker) == b"x"         # but the write tore in
+            # reopen-equivalent: the walk resumes from the stored version
+            assert migrate_schema(db, target=4) == 4
+            assert kv.get(marker) == b"x"
+            assert migrate_schema(db, target=3) == 3  # and downgrades
+            assert kv.get(marker) is None
+        finally:
+            migrations._UP.pop(3, None)
+            migrations._DOWN.pop(4, None)
+
+    def test_legacy_v2_records_get_enveloped_on_open(self):
+        """A pre-envelope (v2) DB upgrades in place: raw meta records
+        come out wrapped, values preserved."""
+        import json
+
+        h = Harness(8, real_crypto=False)
+        kv = MemoryStore()
+        kv.put(K_SCHEMA, (2).to_bytes(8, "little"))
+        kv.put(K_SPLIT, (5).to_bytes(8, "little"))
+        kv.put(K_HEAD, b"\x11" * 32)
+        kv.put(K_DB_CONFIG, json.dumps(
+            {"slots_per_restore_point": 16}).encode())
+        kv.put(K_DIRTY, b"clean")  # orderly-shutdown v2 node
+        db = HotColdDB(h.spec, kv, slots_per_restore_point=16)
+        assert read_schema_version(db) == CURRENT_SCHEMA_VERSION
+        assert db.split_slot == 5
+        assert db.load_head() == b"\x11" * 32
+        for key in (K_SPLIT, K_HEAD, K_DB_CONFIG, K_SCHEMA):
+            assert envelope.is_enveloped(kv.get(key)), key
 
 
 @pytest.fixture(scope="module")

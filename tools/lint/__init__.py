@@ -45,6 +45,11 @@ LH601       unsupervised-dispatch  device dispatch call site (a jitted
                                    reachable from a supervisor-wrapped
                                    entry point (the crypto/bls/api fault
                                    supervisor's watchdog + health ladder)
+LH701       unbatched-store-write  raw ``hot.put``/``cold.put``/``delete``
+                                   in store/ or chain/ outside the
+                                   single-key commit-point allowlist —
+                                   related mutations must batch through
+                                   ``do_atomically`` (crash consistency)
 ==========  =====================  =========================================
 
 Suppression: a ``# lhlint: allow(<rule-id-or-name>[, ...])`` comment on
@@ -160,13 +165,14 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     suppression-filtered findings (baseline NOT applied — that's the
     CLI/baseline layer's job)."""
     from tools.lint import (envpass, fetch, locks, metrics_pass, shapes,
-                            supervisor_pass)
+                            store_pass, supervisor_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
     ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
-                     metrics_pass.run, supervisor_pass.run):
+                     metrics_pass.run, supervisor_pass.run,
+                     store_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

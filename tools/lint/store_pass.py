@@ -1,0 +1,95 @@
+"""Pass 7 — store commit discipline (LH701).
+
+The crash-consistency invariant: related store mutations commit in ONE
+``do_atomically`` batch.  A direct ``hot.put`` / ``cold.put`` /
+``delete`` sprinkled next to other writes re-opens exactly the torn
+window the persistence PR closed — half the mutation lands, the process
+dies, and the reopened node reads a split that disagrees with its
+freezer (or a head with no fork choice).
+
+This pass restricts raw engine writes in the ``store/`` and ``chain/``
+modules to an allowlist of designated single-key commit points (one
+self-contained record per call, atomic at the engine level).  Anything
+else must build a :class:`KeyValueOp` batch and go through
+``do_atomically`` (in ``store/hot_cold.py``, via ``_commit``).  The
+allowlist is by function name, so a refactor that MOVES a raw write
+into a new helper trips the gate and forces a conscious decision.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Context, Finding
+
+TARGET_PREFIXES = ("store/", "chain/")
+
+ENGINES = {"hot", "cold"}
+WRITE_METHODS = {"put", "delete"}
+
+# designated commit points: single-key records whose write IS the whole
+# mutation (atomic at the engine level, no related records to tear from)
+ALLOWED_FUNCTIONS = {
+    "put_block",     # one block record by root
+    "put_blobs",     # one blob bundle by block root
+    "put_state",     # one full state by state root
+    "delete_block",  # admin/fork-revert single-record removal
+}
+
+
+def _engine_write(call: ast.Call) -> str | None:
+    """"hot.put" when the call is ``<...>.hot.put(...)``/``cold.delete``
+    etc., whether the engine is an attribute (``self.hot``, ``db.cold``)
+    or a bare name (``hot.put`` after ``hot = db.hot``)."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in WRITE_METHODS:
+        return None
+    obj = func.value
+    if isinstance(obj, ast.Attribute) and obj.attr in ENGINES:
+        return f"{obj.attr}.{func.attr}"
+    if isinstance(obj, ast.Name) and obj.id in ENGINES:
+        return f"{obj.id}.{func.attr}"
+    return None
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for module in ctx.modules:
+        if not module.pkg_rel.startswith(TARGET_PREFIXES):
+            continue
+        findings.extend(_scan_module(ctx, module))
+    return findings
+
+
+def _scan_module(ctx: Context, module) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child.name in ALLOWED_FUNCTIONS:
+                    continue  # designated single-key commit point
+                visit(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.Call):
+                write = _engine_write(child)
+                if write is not None:
+                    qual = ".".join(stack) or "<module>"
+                    if not ctx.suppressed(module, "LH701",
+                                          "unbatched-store-write",
+                                          child.lineno):
+                        findings.append(Finding(
+                            "LH701", "unbatched-store-write", module.rel,
+                            child.lineno, f"{qual}:{write}",
+                            f"raw engine write `{write}` outside the "
+                            f"designated commit points (allowed: "
+                            f"{', '.join(sorted(ALLOWED_FUNCTIONS))}) — "
+                            "batch related mutations through "
+                            "do_atomically"))
+            visit(child, stack)
+
+    visit(module.tree, [])
+    return findings
