@@ -127,6 +127,23 @@ _register("LHTPU_DISPATCH_RESTART_WINDOW_S", "300",
           "Restart-storm window seconds for the dispatch-thread "
           "limiter.")
 
+# -- device epoch processing (state_transition/epoch_processing seam,
+#    state_transition/epoch_device, ops/epoch_kernels) -------------------------
+
+_register("LHTPU_EPOCH_BACKEND", None,
+          "Force the epoch-processing backend (device|sharded|"
+          "reference); unset = auto (fused device pass on TPU above "
+          "the device-min threshold, numpy reference otherwise).")
+_register("LHTPU_EPOCH_BUCKET_FLOOR", "256",
+          "Minimum pow2 shape bucket for the fused epoch pass and the "
+          "device shuffle (smaller registries pad up to it; rounded up "
+          "to a power of two, floored at 256).")
+_register("LHTPU_EPOCH_DEVICE_MIN", "131072",
+          "Registry size at or above which the epoch/shuffle auto "
+          "routing picks the device backend (TPU platforms only; the "
+          "XLA-CPU fallback always stays on the numpy reference "
+          "unless LHTPU_EPOCH_BACKEND forces a device rung).")
+
 # -- store crash injection + startup recovery (store/crash, store/hot_cold) ---
 
 _register("LHTPU_STORE_FAULT_MODE", None,
@@ -215,6 +232,21 @@ def get_bool(name: str, fallback: bool | None = None) -> bool | None:
     if low in _FALSE:
         return False
     _warn_unparseable(name, val, "a boolean (1/0/true/false)")
+    return fallback
+
+
+def get_choice(name: str, choices: tuple[str, ...],
+               fallback: str | None = None) -> str | None:
+    """Enum value normalized to lowercase/stripped, or ``fallback`` when
+    unset or not one of ``choices`` (a set but invalid value warns once
+    on stderr — same discipline as the numeric readers)."""
+    val = get(name)
+    if val is None:
+        return fallback
+    low = val.strip().lower()
+    if low in choices:
+        return low
+    _warn_unparseable(name, val, "one of " + "|".join(choices))
     return fallback
 
 

@@ -268,6 +268,46 @@ def hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
     return out
 
 
+def sha256_msgs(msgs: np.ndarray, *, device: bool | None = None) -> np.ndarray:
+    """Batched SHA-256 of N equal-length short messages: uint8[N, L] ->
+    uint8[N, 32], L <= 55 (one padded compression block per message).
+
+    The shuffle's per-round source sweeps (hash(seed ‖ round ‖ chunk)
+    for every round × chunk at once) ride this instead of a host
+    hashlib loop: each message is padded into a single 64-byte block
+    host-side and the whole batch is ONE ``sha256_block`` dispatch.
+    Lane counts are padded to a power of two so the jit cache stays
+    bounded exactly like the pair-hash path.
+    """
+    n, length = msgs.shape
+    if length > 55:
+        raise ValueError("sha256_msgs handles single-block messages only")
+    use_device = device if device is not None else n >= _DEVICE_MIN_PAIRS
+    if not use_device or n == 0:
+        out = np.empty((n, 32), dtype=np.uint8)
+        data = np.ascontiguousarray(msgs, dtype=np.uint8)
+        for i in range(n):
+            out[i] = np.frombuffer(
+                hashlib.sha256(data[i].tobytes()).digest(), np.uint8)
+        return out
+    blocks = np.zeros((n, 64), dtype=np.uint8)
+    blocks[:, :length] = msgs
+    blocks[:, length] = 0x80
+    blocks[:, 56:64] = np.frombuffer(
+        (length * 8).to_bytes(8, "big"), np.uint8)
+    words = np.frombuffer(blocks.tobytes(), dtype=">u4").astype(
+        np.uint32).reshape(n, 16)
+    padded = 1 << max(n - 1, 0).bit_length()
+    if padded != n:
+        words = np.concatenate(
+            [words, np.zeros((padded - n, 16), np.uint32)], axis=0)
+    state = np.broadcast_to(_H0, (padded, 8))
+    out_words = np.asarray(sha256_block(
+        jnp.asarray(state), jnp.asarray(words)))[:n]
+    return np.frombuffer(
+        out_words.astype(">u4").tobytes(), np.uint8).reshape(n, 32).copy()
+
+
 # --------------------------------------------------------------------------
 # Byte <-> word helpers (SSZ chunks are 32-byte little-endian-agnostic blobs;
 # SHA-256 words are big-endian).

@@ -1,0 +1,52 @@
+"""Registry columns sharded over the device mesh for the epoch pass.
+
+The fused epoch program (ops/epoch_kernels) is pure lane parallelism —
+every validator's update depends only on its own columns plus small
+replicated gather tables — so sharding is exactly the bls_sharded
+model with the roles swapped: signature *lanes* there, registry *rows*
+here.  Columns are placed with ``NamedSharding(P("data"))``, the
+reward/penalty/slashing tables and the packed scalar vector are
+replicated, and GSPMD partitions the one fused program across the mesh
+with zero cross-chip traffic (table gathers read replicated operands).
+
+The pow2 shape buckets (≥ 256) are always divisible by a pow2 mesh, so
+no per-device re-padding is needed — the same jit program and the same
+masked-tail semantics as the single-device path apply unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from lighthouse_tpu.ops import epoch_kernels
+
+
+def epoch_mesh(n_devices: int | None = None):
+    """A pow2-sized 1-D mesh over the available devices."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    n = 1 << max(n.bit_length() - 1, 0)  # round DOWN to a power of two
+    return Mesh(np.array(devs[:n]), axis_names=("data",))
+
+
+def epoch_pass_sharded(columns: dict, tables: dict, params: np.ndarray, *,
+                       apply_eb: bool, mesh=None):
+    """Mesh-sharded fused epoch pass; same contract as
+    ops/epoch_kernels.epoch_pass_device (host numpy in/out, one
+    dispatch, all fetches before return)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = epoch_mesh()
+    n_dev = int(mesh.devices.size)
+    bucket = columns["balances"].shape[0]
+    assert bucket % n_dev == 0, "pow2 bucket must cover the pow2 mesh"
+    col_sh = NamedSharding(mesh, P("data"))
+    tbl_sh = NamedSharding(mesh, P())
+    return epoch_kernels.epoch_pass_device(
+        columns, tables, params, apply_eb=apply_eb,
+        shardings=(col_sh, tbl_sh))

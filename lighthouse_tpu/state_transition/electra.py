@@ -73,11 +73,14 @@ def get_consolidation_churn_limit(state, spec) -> int:
         get_activation_exit_churn_limit(state, spec)
 
 
-def compute_exit_epoch_and_update_churn(state, spec, exit_balance: int) -> int:
+def compute_exit_epoch_and_update_churn(state, spec, exit_balance: int, *,
+                                        per_epoch_churn: int | None = None
+                                        ) -> int:
     cur = misc.current_epoch(state, spec)
     earliest = max(int(state.earliest_exit_epoch),
                    spec.compute_activation_exit_epoch(cur))
-    per_epoch_churn = get_activation_exit_churn_limit(state, spec)
+    if per_epoch_churn is None:
+        per_epoch_churn = get_activation_exit_churn_limit(state, spec)
     if int(state.earliest_exit_epoch) < earliest:
         to_consume = per_epoch_churn  # new epoch for exits
     else:
@@ -113,14 +116,20 @@ def compute_consolidation_epoch_and_update_churn(
     return earliest
 
 
-def initiate_validator_exit_electra(state, spec, index: int) -> None:
+def initiate_validator_exit_electra(state, spec, index: int, *,
+                                    per_epoch_churn: int | None = None
+                                    ) -> None:
     """Electra exit: the queue is balance-weighted, not head-count churn
-    (beacon_state.rs initiate_validator_exit Electra arm)."""
+    (beacon_state.rs initiate_validator_exit Electra arm).
+    ``per_epoch_churn`` lets a mass-ejection sweep hoist the O(n)
+    churn-limit scan out of its loop — the active set it derives from
+    is invariant across the sweep."""
     v = state.validators
     if int(v.exit_epoch[index]) != T.FAR_FUTURE_EPOCH:
         return
     exit_epoch = compute_exit_epoch_and_update_churn(
-        state, spec, int(v.effective_balance[index]))
+        state, spec, int(v.effective_balance[index]),
+        per_epoch_churn=per_epoch_churn)
     v.exit_epoch[index] = exit_epoch
     v.withdrawable_epoch[index] = (
         exit_epoch + spec.min_validator_withdrawability_delay)

@@ -9,13 +9,41 @@ registry updates, slashings, effective-balance hysteresis) is expressed as
 numpy column arithmetic over the whole registry at once — the exact shape a
 jax.jit/device version takes (no per-validator Python loop anywhere except
 the strictly-ordered activation queue and exit churn serialization).
+
+Backend seam (mirrors the crypto/bls ladder): the per-validator core of
+the transition — inactivity updates, rewards/penalties, slashings and
+(non-electra) effective-balance hysteresis — can run as ONE fused
+device program (ops/epoch_kernels via state_transition/epoch_device,
+optionally mesh-sharded through parallel/epoch_sharded).  The ladder is
+``device → reference`` (``sharded`` sits beside ``device`` as a forced
+or mesh-auto rung): any device fault is recovered by re-running the
+numpy reference on the untouched state, a consecutive-fault circuit
+breaker (same LHTPU_SUPERVISOR_* knobs as the BLS supervisor) parks a
+flapping device path on the reference rung, and the device write-back
+is all-or-nothing so a mid-dispatch fault can never leave a torn state.
+
+Why the reordering is verdict-identical: the spec order is inactivity →
+rewards → registry-updates → slashings → effective-balance, and the
+fused pass computes slashings before the host's registry updates run.
+Registry updates mutate only activation/exit/withdrawable epochs of
+validators whose ``exit_epoch`` is unset — and a slashed validator's
+exit epoch is ALWAYS set (slash_validator initiates the exit), so the
+slashings mask (slashed ∧ withdrawable == target) reads columns the
+registry pass can never touch, and registry updates read only
+effective balances, which the fused pass defers (hysteresis output is
+applied after registry updates, matching spec order exactly).
 """
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from lighthouse_tpu import types as T
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.state_transition import misc
 
 # Participation flag indices / weights (altair).
@@ -71,6 +99,158 @@ def is_in_inactivity_leak(state, spec: T.ChainSpec) -> bool:
     return prev - int(state.finalized_checkpoint.epoch) > spec.min_epochs_to_inactivity_penalty
 
 
+# --- device backend seam (ladder: device/sharded -> reference) --------------
+
+#: auto-routing floor: below this many validators a device dispatch costs
+#: more than the numpy pass (and tier-1 test registries must never compile
+#: XLA); override with LHTPU_EPOCH_DEVICE_MIN
+_DEVICE_MIN_DEFAULT = 1 << 17
+
+# consecutive-fault circuit breaker for the device rung (one per
+# process).  The epoch pass itself is serialized under the chain's
+# import commit points, but shuffle_list shares this breaker and runs
+# from beacon-processor worker threads during concurrent verification,
+# so every read-modify-write holds the lock — same discipline as the
+# BLS supervisor state in crypto/bls/api
+_BREAKER_LOCK = threading.Lock()
+_BREAKER = {"fails": 0, "open_until": 0.0, "backoff": 0.0}
+
+_EPOCH_BACKENDS = ("device", "sharded", "reference")
+
+# memoized auto-routing rung for at-threshold registries (None = not
+# yet probed; probing imports jax and initializes the platform)
+_AUTO_RUNG: str | None = None
+
+
+def record_epoch_stage(stage: str, seconds: float) -> None:
+    """Per-stage wall time of the device epoch pass (sole registration
+    site of the epoch_* metric family — lhlint LH501 FAMILY_OWNERS)."""
+    try:
+        REGISTRY.histogram(
+            "epoch_stage_seconds",
+            "device epoch-pass stage wall time",
+        ).labels(stage=stage).observe(seconds)
+    except Exception:
+        pass  # metrics must never take down the transition
+
+
+def record_epoch_fault(backend: str, kind: str) -> None:
+    """Count a device epoch/shuffle fault recovered by the reference rung."""
+    try:
+        REGISTRY.counter(
+            "epoch_supervisor_faults_total",
+            "device epoch faults recovered on the reference backend",
+        ).labels(backend=backend, kind=kind).inc()
+    except Exception:
+        pass
+
+
+def _record_epoch_batch(backend: str, seconds: float) -> None:
+    try:
+        REGISTRY.counter(
+            "epoch_backend_batches_total",
+            "epoch core passes by executing backend",
+        ).labels(backend=backend).inc()
+        REGISTRY.histogram(
+            "epoch_transition_seconds",
+            "epoch core pass wall time by backend",
+        ).labels(backend=backend).observe(seconds)
+    except Exception:
+        pass
+
+
+def reset_epoch_supervisor() -> None:
+    """Close the breaker and drop the memoized auto rung (tests /
+    operator reset)."""
+    global _AUTO_RUNG
+    with _BREAKER_LOCK:
+        _BREAKER.update(fails=0, open_until=0.0, backoff=0.0)
+    _AUTO_RUNG = None
+
+
+def resolve_epoch_backend(n_validators: int) -> str:
+    """Which rung runs the fused epoch core for an ``n_validators``
+    registry: LHTPU_EPOCH_BACKEND force first, then the breaker, then
+    auto (device only on a real TPU at or above LHTPU_EPOCH_DEVICE_MIN —
+    the XLA-CPU fallback defaults to the numpy reference: first-dispatch
+    compiles dominate short-lived processes, though the warm fused
+    program beats numpy there too, so operators can force the device
+    rung on long-lived fallback nodes).  Small registries return
+    "reference" without touching jax at all (zero-XLA fast tests)."""
+    forced = envreg.get_choice("LHTPU_EPOCH_BACKEND", _EPOCH_BACKENDS)
+    if forced:
+        return forced
+    with _BREAKER_LOCK:
+        open_until = _BREAKER["open_until"]
+    if open_until > time.monotonic():
+        return "reference"
+    device_min = envreg.get_int("LHTPU_EPOCH_DEVICE_MIN",
+                                _DEVICE_MIN_DEFAULT)
+    if n_validators < max(device_min, 1):
+        return "reference"
+    global _AUTO_RUNG
+    if _AUTO_RUNG is None:
+        # probing the platform imports jax (multi-second XLA init on a
+        # cold process); memoize so a CPU-fallback node pays it once,
+        # not on every large committee shuffle in the worker threads
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            _AUTO_RUNG = "reference"
+        else:
+            _AUTO_RUNG = "sharded" if len(jax.devices()) > 1 else "device"
+    return _AUTO_RUNG
+
+
+def _breaker_ok() -> None:
+    """A successful device dispatch (epoch pass OR shuffle — they share
+    the breaker) closes the consecutive-fault count and the backoff."""
+    with _BREAKER_LOCK:
+        _BREAKER["fails"] = 0
+        _BREAKER["backoff"] = 0.0
+
+
+def _breaker_fault() -> None:
+    threshold = envreg.get_int("LHTPU_SUPERVISOR_FAILS", 1) or 1
+    backoff_init = float(
+        envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_S", 1.0) or 1.0)
+    ceiling = float(
+        envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_MAX_S", 60.0) or 60.0)
+    with _BREAKER_LOCK:
+        fails = _BREAKER["fails"] = _BREAKER["fails"] + 1
+        if fails >= threshold:
+            backoff = _BREAKER["backoff"] or backoff_init
+            _BREAKER["open_until"] = time.monotonic() + backoff
+            _BREAKER["backoff"] = min(backoff * 2, ceiling)
+            _BREAKER["fails"] = 0
+
+
+def _maybe_device_epoch(state, spec: T.ChainSpec, fork: str):
+    """Try the fused device pass; None means the caller must run the
+    numpy reference sub-transitions (not applicable, guarded out, or a
+    recovered device fault — state is untouched in every failure case)."""
+    n = len(state.validators)
+    backend = resolve_epoch_backend(n)
+    if backend == "reference":
+        return None
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.state_transition import epoch_device
+
+    t0 = time.perf_counter()
+    try:
+        with tracing.span("epoch.device_pass", backend=backend, n=n):
+            out = epoch_device.prepare_and_run(state, spec, fork, backend)
+    except Exception as exc:  # device fault: recover on reference
+        record_epoch_fault(backend, type(exc).__name__)
+        _breaker_fault()
+        return None
+    if out is None:
+        return None
+    _breaker_ok()
+    _record_epoch_batch(backend, time.perf_counter() - t0)
+    return out
+
+
 def process_epoch(state, spec: T.ChainSpec) -> None:
     """Full epoch transition, mutating `state` in place (altair+ forks)."""
     fork = spec.fork_at_epoch(misc.current_epoch(state, spec))
@@ -82,10 +262,22 @@ def process_epoch(state, spec: T.ChainSpec) -> None:
         process_epoch_phase0(state, spec)
         return
     process_justification_and_finalization(state, spec)
-    process_inactivity_updates(state, spec)
-    process_rewards_and_penalties(state, spec, fork)
+    dev = _maybe_device_epoch(state, spec, fork)
+    if dev is None:
+        t0 = time.perf_counter()
+        process_inactivity_updates(state, spec)
+        process_rewards_and_penalties(state, spec, fork)
+        core_s = time.perf_counter() - t0
     process_registry_updates(state, spec, fork)
-    process_slashings(state, spec, fork)
+    if dev is None:
+        # epoch_transition_seconds{backend=reference} spans exactly the
+        # stages the device pass covers (inactivity, rewards/penalties,
+        # slashings) — registry updates run on the host under EVERY
+        # backend and are excluded, so the two series are comparable
+        t0 = time.perf_counter()
+        process_slashings(state, spec, fork)
+        _record_epoch_batch("reference",
+                            core_s + (time.perf_counter() - t0))
     process_eth1_data_reset(state, spec)
     if fork == "electra":
         from lighthouse_tpu.state_transition.electra import (
@@ -97,6 +289,10 @@ def process_epoch(state, spec: T.ChainSpec) -> None:
         process_pending_balance_deposits(state, spec)
         process_pending_consolidations(state, spec)
         process_effective_balance_updates_electra(state, spec)
+    elif dev is not None and dev.deferred_eff is not None:
+        # the fused pass's hysteresis output, applied at the spec's
+        # effective-balance-update point (after registry updates)
+        state.validators.effective_balance = dev.deferred_eff
     else:
         process_effective_balance_updates(state, spec)
     process_slashings_reset(state, spec)
@@ -271,6 +467,38 @@ def initiate_validator_exit(state, spec: T.ChainSpec, index: int) -> None:
         exit_queue_epoch + spec.min_validator_withdrawability_delay)
 
 
+def initiate_validator_exits(state, spec: T.ChainSpec, indices) -> None:
+    """Batched `initiate_validator_exit` over `indices` (ascending
+    registry order), with identical sequential queue semantics.
+
+    The scalar function re-scans every exit epoch AND re-counts the
+    active set per call; under a mass ejection (a leak pushing lanes to
+    the ejection balance) that is O(ejections x n) — minutes at 2^20.
+    The queue state the scan derives (current tail epoch + occupancy)
+    and the churn limit (active count at the current epoch, which an
+    ejection never changes: exit epochs land strictly in the future)
+    are loop-invariant, so one O(n) setup feeds an O(1) walk."""
+    v = state.validators
+    far = np.uint64(T.FAR_FUTURE_EPOCH)
+    activation_exit = spec.compute_activation_exit_epoch(
+        misc.current_epoch(state, spec))
+    churn = misc.get_validator_churn_limit(state, spec)
+    exiting = v.exit_epoch[v.exit_epoch != far]
+    queue_epoch = max(int(exiting.max()) if exiting.size else 0,
+                      activation_exit)
+    queue_count = int((exiting == np.uint64(queue_epoch)).sum())
+    delay = spec.min_validator_withdrawability_delay
+    for idx in indices:
+        if v.exit_epoch[idx] != far:
+            continue
+        if queue_count >= churn:
+            queue_epoch += 1
+            queue_count = 0
+        v.exit_epoch[idx] = queue_epoch
+        v.withdrawable_epoch[idx] = queue_epoch + delay
+        queue_count += 1
+
+
 def process_registry_updates(state, spec: T.ChainSpec,
                              fork: str | None = None) -> None:
     v = state.validators
@@ -290,15 +518,24 @@ def process_registry_updates(state, spec: T.ChainSpec,
     # ejections
     eject = v.is_active(cur) & (
         v.effective_balance <= np.uint64(spec.ejection_balance))
-    for idx in np.nonzero(eject)[0]:
+    eject_idx = np.nonzero(eject)[0]
+    if eject_idx.size:
         if electra:
             from lighthouse_tpu.state_transition.electra import (
+                get_activation_exit_churn_limit,
                 initiate_validator_exit_electra,
             )
 
-            initiate_validator_exit_electra(state, spec, int(idx))
+            # the balance-weighted churn limit scans the active set;
+            # ejections never change it (exit epochs land in the
+            # future, effective balances are untouched) — one scan
+            # serves the whole sweep
+            per_epoch_churn = get_activation_exit_churn_limit(state, spec)
+            for idx in eject_idx:
+                initiate_validator_exit_electra(
+                    state, spec, int(idx), per_epoch_churn=per_epoch_churn)
         else:
-            initiate_validator_exit(state, spec, int(idx))
+            initiate_validator_exits(state, spec, eject_idx)
     # activation queue (ordered by eligibility epoch then index, bounded
     # by finality; electra drops the head-count churn — activations are
     # budgeted by the pending-deposit balance churn instead)

@@ -134,12 +134,19 @@ def get_committee_count_per_slot(spec: T.ChainSpec, active_count: int) -> int:
     )
 
 
-def compute_committee_shuffle(state, spec: T.ChainSpec, epoch: int) -> np.ndarray:
+def compute_committee_shuffle(state, spec: T.ChainSpec, epoch: int, *,
+                              device: bool | None = None) -> np.ndarray:
     """The full shuffled active-validator list for `epoch` (one vectorized
-    shuffle; committees are contiguous slices of this)."""
+    shuffle; committees are contiguous slices of this).
+
+    This is THE 1M-validator shuffle call site: ``device=None`` routes
+    through the epoch backend seam (shuffle.shuffle_list), so mainnet-
+    scale registries run the 90 rounds as one device program while
+    committee lookups on small test registries stay pure numpy."""
     indices = get_active_validator_indices(state, epoch)
     seed = get_seed(state, spec, epoch, spec.domain_beacon_attester)
-    return shuffle_list(indices, seed, spec.preset.shuffle_round_count)
+    return shuffle_list(indices, seed, spec.preset.shuffle_round_count,
+                        device=device)
 
 
 def get_beacon_committee(

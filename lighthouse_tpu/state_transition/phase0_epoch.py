@@ -182,7 +182,17 @@ def process_participation_record_updates(state) -> None:
 
 def process_epoch_phase0(state, spec) -> None:
     """Full phase0 epoch transition (counterpart of the altair+
-    process_epoch in epoch_processing.py)."""
+    process_epoch in epoch_processing.py).
+
+    Backend seam position: phase0's registry math derives participation
+    from PendingAttestations, so the fused device pass (which reads
+    participation-flag columns) does not apply — the core always runs
+    on the reference rung and is recorded as such.  The heavy
+    vectorizable piece, the committee shuffle behind
+    ``_EpochAttestations``, still rides the device seam through
+    misc.compute_committee_shuffle/shuffle_list automatically."""
+    import time as _time
+
     from lighthouse_tpu.state_transition import epoch_processing as ep
 
     # previous-epoch attestations resolve ONCE, shared by both passes
@@ -191,12 +201,23 @@ def process_epoch_phase0(state, spec) -> None:
         state, spec, prev, state.previous_epoch_attestations)
     process_justification_and_finalization_phase0(
         state, spec, prev_atts=prev_atts)
+    # epoch_transition_seconds{backend=reference} spans exactly the
+    # stages the altair+ device pass covers (rewards/penalties and
+    # slashings; phase0 has no inactivity pass) — justification,
+    # registry updates and the bookkeeping resets run on the host under
+    # every backend and are excluded, so the series stays comparable
+    # with the altair+ recording in epoch_processing.process_epoch
+    _t0 = _time.perf_counter()
     process_rewards_and_penalties_phase0(state, spec, atts=prev_atts)
+    core_s = _time.perf_counter() - _t0
     ep.process_registry_updates(state, spec)
+    _t0 = _time.perf_counter()
     ep.process_slashings(state, spec, "phase0")
+    core_s += _time.perf_counter() - _t0
     ep.process_eth1_data_reset(state, spec)
     ep.process_effective_balance_updates(state, spec)
     ep.process_slashings_reset(state, spec)
     ep.process_randao_mixes_reset(state, spec)
     ep.process_historical_update(state, spec, "phase0")
     process_participation_record_updates(state)
+    ep._record_epoch_batch("reference", core_s)

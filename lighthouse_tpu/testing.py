@@ -347,3 +347,97 @@ class Harness:
                              self._verify_strategy())
             blocks.append(signed)
         return blocks
+
+
+# --- randomized epoch-transition registries ----------------------------------
+
+
+def randomized_registry_state(n: int, fork: str, seed: int, *,
+                              leak: bool = False,
+                              eject_frac: float = 0.02):
+    """A coherent randomized registry: balances, flags, slashings and
+    churn boundaries — respecting the invariants real states carry
+    (slashed ⇒ exit epoch set; withdrawable tracks exit; effective
+    balances are increment multiples at or below the fork's max).
+
+    The single source for epoch-backend verdict tests, the pinned
+    digests in tests/test_epoch_pins.py (bodies here are digest-load-
+    bearing: any change to the RNG draw sequence moves the pins) and
+    bench.py --child-epoch, so the device rung always faces the same
+    stage-engaging workload the reference was pinned against.
+
+    ``eject_frac`` sets the fraction of lanes parked at the ejection
+    balance.  Every ejection pays an O(n) host exit-queue scan in
+    process_registry_updates, so the bench child passes 0.0 to keep the
+    host registry stage (excluded from backend comparisons) from
+    drowning the device-covered core at n = 2^16+.  The draw is
+    consumed either way — changing the fraction never shifts the RNG
+    stream the pins were frozen against."""
+    from lighthouse_tpu.types.registry import Validators
+
+    far = np.uint64(T.FAR_FUTURE_EPOCH)
+    h = Harness(n_validators=8, fork=fork, real_crypto=False)
+    spec, st = h.spec, h.state
+    rng = np.random.default_rng(seed)
+    v = Validators(n)
+    v.pubkeys[...] = rng.integers(0, 256, (n, 48), dtype=np.uint8)
+    v.withdrawal_credentials[...] = rng.integers(0, 256, (n, 32), np.uint8)
+    if fork == "electra":
+        v.withdrawal_credentials[:, 0] = rng.choice(
+            [0, 1, 2], n).astype(np.uint8)
+        max_eb = spec.max_effective_balance_electra
+    else:
+        max_eb = spec.max_effective_balance
+    incr = spec.effective_balance_increment
+    v.effective_balance[...] = rng.integers(
+        0, max_eb // incr + 1, n).astype(np.uint64) * np.uint64(incr)
+    v.activation_eligibility_epoch[...] = np.where(
+        rng.random(n) < 0.2, far, np.uint64(0))
+    v.activation_epoch[...] = np.where(
+        rng.random(n) < 0.1, far, rng.integers(0, 3, n).astype(np.uint64))
+    exit_far = rng.random(n) < 0.85
+    v.exit_epoch[...] = np.where(
+        exit_far, far, rng.integers(3, 50, n).astype(np.uint64))
+    v.withdrawable_epoch[...] = np.where(
+        v.exit_epoch == far, far,
+        v.exit_epoch + np.uint64(spec.min_validator_withdrawability_delay))
+    slashed = rng.random(n) < 0.08
+    v.slashed[...] = slashed
+    v.exit_epoch[slashed] = np.uint64(5)
+    # derive the slashings-target epoch from the epoch the state will
+    # actually transition at (leak states sit at epoch 9, not 1) so the
+    # proportional-slashings stage engages in BOTH leak variants
+    cur = (10 if leak else 2) - 1
+    target = cur + spec.preset.epochs_per_slashings_vector // 2
+    idx = np.nonzero(slashed)[0]
+    # half the slashed land exactly on the slashings target epoch
+    v.withdrawable_epoch[idx] = rng.choice(
+        [target, target + 3], idx.size).astype(np.uint64)
+    # churn boundaries: some active lanes sit at the ejection balance
+    eject = rng.random(n) < eject_frac
+    v.effective_balance[eject] = np.uint64(spec.ejection_balance)
+    st.validators = v
+    st.balances = (v.effective_balance.astype(np.int64)
+                   + rng.integers(-10**9, 2 * 10**9, n)
+                   ).clip(0).astype(np.uint64)
+    st.previous_epoch_participation = rng.integers(0, 8, n, dtype=np.uint8)
+    st.current_epoch_participation = rng.integers(0, 8, n, dtype=np.uint8)
+    st.inactivity_scores = rng.integers(0, 200, n).astype(np.uint64)
+    st.slashings[0] = np.uint64(int(rng.integers(0, 64)) * incr)
+    st.slot = spec.slots_per_epoch * (10 if leak else 2) - 1
+    return st, spec
+
+
+def registry_state_digest(st) -> str:
+    """Hex digest of every column an epoch transition mutates."""
+    h = hashlib.sha256()
+    v = st.validators
+    for arr in (st.balances, v.effective_balance, st.inactivity_scores,
+                v.activation_eligibility_epoch, v.activation_epoch,
+                v.exit_epoch, v.withdrawable_epoch, v.slashed,
+                st.previous_epoch_participation,
+                st.current_epoch_participation, st.slashings):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update(int(st.finalized_checkpoint.epoch).to_bytes(8, "little"))
+    h.update(int(st.current_justified_checkpoint.epoch).to_bytes(8, "little"))
+    return h.hexdigest()

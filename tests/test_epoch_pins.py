@@ -8,11 +8,18 @@ Each pin states the arithmetic in the comment; nothing here calls the
 code under test to derive an expectation.
 """
 
+import os
+
 import numpy as np
+import pytest
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.state_transition import state_advance
 from lighthouse_tpu.testing import Harness
+
+slow = pytest.mark.skipif(
+    os.environ.get("LHTPU_SLOW") != "1",
+    reason="compiles the fused epoch program; set LHTPU_SLOW=1")
 
 
 def _advance_one_epoch(h):
@@ -386,3 +393,70 @@ class TestCompoundingSwitchGuard:
         el.switch_to_compounding_validator(st, h.spec, 3)
         assert int(st.validators.withdrawal_credentials[3][0]) == 0x00
         assert len(st.pending_balance_deposits) == 0
+
+
+# --- large-registry electra digests (PR 6) -----------------------------------
+# Unlike everything above, these pins ARE code-derived: the post-state
+# digest of one full electra epoch transition over a seeded randomized
+# 4096-validator registry, computed ONCE from the numpy reference and
+# frozen here.  They serve a different purpose than the hand-computed
+# pins — (a) any drift in the reference epoch math or in the state
+# builder at a realistic registry size fails fast, and (b) the device
+# backend is anchored to the same frozen digest, so reference and
+# fused-JAX paths cannot drift apart without one of them tripping a pin.
+
+class TestElectraLargeRegistryDigest:
+    """One electra epoch at n=4096 (pow2 bucket boundary, all epoch
+    stages exercised: inactivity, rewards/penalties, registry
+    hysteresis, slashings, electra churn/pending queues)."""
+
+    N = 4096
+    # registry_state_digest(post) after process_epoch on the numpy
+    # reference, for randomized_registry_state(4096, "electra",
+    # seed=4096+leak, leak=leak).
+    # The pre-state comes from np.random.default_rng (PCG64), whose
+    # stream NEP 19 only guarantees within a numpy feature release —
+    # PINNED_NUMPY records the version the digests were frozen under so
+    # a mismatch after an upgrade reads as RNG drift, not epoch math.
+    PINNED_NUMPY = "2.0.2"
+    PINS = {
+        False: "6eab9dc181f7b8130612764edb11a8f6842334a51d7ce7a7b894691659eea33c",
+        True: "9370ed66ba0d9cdd41fd8ff3823b7aa919fa3fe8b73ada49ac9ff37e9ba2ea28",
+    }
+
+    def _run(self, backend, leak, monkeypatch):
+        from lighthouse_tpu.state_transition import epoch_processing as ep
+        from lighthouse_tpu.testing import (
+            randomized_registry_state,
+            registry_state_digest,
+        )
+
+        monkeypatch.setenv("LHTPU_EPOCH_BACKEND", backend)
+        ep.reset_epoch_supervisor()
+        try:
+            st, spec = randomized_registry_state(
+                self.N, "electra", seed=self.N + leak, leak=leak)
+            ep.process_epoch(st, spec)
+            return registry_state_digest(st)
+        finally:
+            ep.reset_epoch_supervisor()
+
+    def _mismatch_msg(self, backend):
+        return (f"{backend} digest drifted from the frozen pin "
+                f"(numpy {np.__version__}; pins frozen under numpy "
+                f"{self.PINNED_NUMPY} — a version change means RNG "
+                f"stream drift, same version means epoch-math drift)")
+
+    @pytest.mark.parametrize("leak", [False, True])
+    def test_reference_matches_pin(self, leak, monkeypatch):
+        assert self._run("reference", leak, monkeypatch) \
+            == self.PINS[leak], self._mismatch_msg("reference")
+
+    @slow
+    @pytest.mark.parametrize("leak", [False, True])
+    def test_device_matches_pin(self, leak, monkeypatch):
+        # the fused device program must land on the SAME frozen digest
+        # the reference is pinned to — not merely agree with whatever
+        # the reference computes today
+        assert self._run("device", leak, monkeypatch) \
+            == self.PINS[leak], self._mismatch_msg("device")

@@ -275,6 +275,87 @@ def test_shape_pass_memoized_jit_negative(tmp_path):
     assert analyze(pkg) == []
 
 
+def test_shape_pass_scans_epoch_modules(tmp_path):
+    # PR 6 wiring: the shape passes must reach state_transition/ and the
+    # epoch kernel module, not just the BLS offload files.  A jitted
+    # epoch pass branching on a traced column and a per-round jit built
+    # inside the shuffle sweep are both the exact mistakes the fused
+    # epoch program must never reintroduce.
+    pkg, _ = make_pkg(tmp_path, {
+        "state_transition/epoch_device.py": """
+            import jax
+
+            @jax.jit
+            def epoch_pass(balances, leak):
+                if leak:
+                    return balances - 1
+                return balances
+        """,
+        "ops/epoch_kernels.py": """
+            import jax
+
+            def shuffle_rounds(lanes, rounds):
+                for r in range(rounds):
+                    lanes = jax.jit(_round)(lanes, r)
+                return lanes
+
+            def _round(lanes, r):
+                return lanes
+        """,
+    })
+    findings = analyze(pkg)
+    by_file = {f.file: f.rule for f in findings}
+    assert by_file == {
+        "pkg/state_transition/epoch_device.py": "LH301",
+        "pkg/ops/epoch_kernels.py": "LH302",
+    }
+
+
+def test_shape_pass_epoch_modules_compliant_twin(tmp_path):
+    # the compliant shapes: leak/fork are static_argnames (per-truth
+    # compile is intended — two programs, cached), and the per-fork jit
+    # is memoized in a module cache keyed by (fork, bucket)
+    pkg, _ = make_pkg(tmp_path, {
+        "state_transition/epoch_device.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("leak",))
+            def epoch_pass(balances, leak):
+                if leak:
+                    return balances - 1
+                return balances
+        """,
+        "ops/epoch_kernels.py": """
+            import jax
+
+            _EPOCH_JIT_CACHE = {}
+
+            def compiled_pass(fork, bucket):
+                got = _EPOCH_JIT_CACHE.get((fork, bucket))
+                if got is None:
+                    got = _EPOCH_JIT_CACHE[(fork, bucket)] = jax.jit(_pass)
+                return got
+
+            def _pass(cols):
+                return cols
+        """,
+    })
+    assert analyze(pkg) == []
+
+
+def test_shape_pass_real_epoch_tree_is_clean():
+    # the shipped epoch/shuffle call sites obey LH301/302 with NO
+    # baseline debt: scan the real package and assert zero shape
+    # findings anywhere in state_transition/ or the epoch kernel module
+    findings = analyze(REPO / "lighthouse_tpu")
+    shape = [f for f in findings
+             if f.rule in ("LH301", "LH302")
+             and (f.file.startswith("lighthouse_tpu/state_transition/")
+                  or f.file == "lighthouse_tpu/ops/epoch_kernels.py")]
+    assert shape == []
+
+
 # -- pass 4: env registry -----------------------------------------------------
 
 ENV_REGISTRY = """

@@ -1,0 +1,160 @@
+"""Swap-or-not shuffle cross-checks (scalar vs vectorized vs device).
+
+The scalar ``compute_shuffled_index`` is the spec-literal transcription;
+the vectorized ``shuffle_list`` is the production committee path; the
+device rung runs the 90 rounds as one jitted program with its hash
+sweeps batched through ops/sha256.  Property: for every position i,
+``shuffle_list(indices)[i] == indices[compute_shuffled_index(i)]`` —
+seeded rounds ∈ {10, 90}, counts including non-powers-of-two.  The
+device rung (extra compile shapes) sits behind LHTPU_SLOW=1; its
+batched hash sweep is additionally pinned against hashlib here in the
+fast tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.state_transition import shuffle as sh
+
+slow = pytest.mark.skipif(
+    os.environ.get("LHTPU_SLOW") != "1",
+    reason="compiles the device shuffle program; set LHTPU_SLOW=1")
+
+COUNTS = (2, 7, 100, 256, 333, 1000)
+ROUNDS = (10, 90)
+
+
+def _seed(count: int, rounds: int) -> bytes:
+    return hashlib.sha256(f"shuffle:{count}:{rounds}".encode()).digest()
+
+
+def _expected(indices: np.ndarray, count: int, seed: bytes,
+              rounds: int) -> np.ndarray:
+    return np.array([
+        indices[sh.compute_shuffled_index(i, count, seed, rounds)]
+        for i in range(count)])
+
+
+@pytest.mark.parametrize("rounds", ROUNDS)
+def test_vectorized_matches_scalar_forward_map(rounds):
+    for count in COUNTS:
+        seed = _seed(count, rounds)
+        indices = np.arange(count, dtype=np.int64) * 3 + 1
+        got = sh.shuffle_list(indices, seed, rounds, device=False)
+        assert np.array_equal(got, _expected(indices, count, seed, rounds)), \
+            (count, rounds)
+
+
+def test_shuffle_is_a_permutation():
+    for count in (1, 2, 333, 1000):
+        seed = _seed(count, 90)
+        out = sh.shuffle_list(np.arange(count, dtype=np.int64), seed, 90,
+                              device=False)
+        assert sorted(out.tolist()) == list(range(count))
+
+
+def test_hash_sweep_matches_hashlib():
+    count, rounds = 777, 90
+    seed = _seed(count, rounds)
+    pivots, src = sh._shuffle_hash_sweep(seed, rounds, count, device=False)
+    n_chunks = (count - 1) // 256 + 1
+    for r in (0, 1, rounds - 1):
+        assert pivots[r] == int.from_bytes(
+            hashlib.sha256(seed + bytes([r])).digest()[:8], "little") % count
+        for c in range(n_chunks):
+            expect = hashlib.sha256(
+                seed + bytes([r]) + c.to_bytes(4, "little")).digest()
+            assert src[r][c * 32:(c + 1) * 32].tobytes() == expect
+
+
+def test_small_counts_and_identity():
+    seed = _seed(1, 90)
+    one = np.array([42], np.int64)
+    assert np.array_equal(sh.shuffle_list(one, seed, 90), one)
+    empty = np.array([], np.int64)
+    assert sh.shuffle_list(empty, seed, 90).shape == (0,)
+
+
+def test_auto_routing_stays_host_below_threshold(monkeypatch):
+    """Small counts must never attempt the device rung (zero-XLA tier)."""
+    monkeypatch.delenv("LHTPU_EPOCH_BACKEND", raising=False)
+    called = {"n": 0}
+
+    def boom(*a, **k):
+        called["n"] += 1
+        raise AssertionError("device rung engaged below threshold")
+
+    monkeypatch.setattr(sh, "shuffle_list_device", boom)
+    seed = _seed(100, 10)
+    indices = np.arange(100, dtype=np.int64)
+    out = sh.shuffle_list(indices, seed, 10)
+    assert called["n"] == 0
+    assert np.array_equal(out, _expected(indices, 100, seed, 10))
+
+
+def test_forced_backend_keeps_tiny_shuffles_on_host(monkeypatch):
+    """A forced device backend must not route sub-bucket-floor shuffles
+    to the device rung: the force speeds up committee-scale sweeps, it
+    must not tax 2-element conformance shuffles with a padded 256-lane
+    dispatch each."""
+    from lighthouse_tpu.state_transition import epoch_processing as ep
+
+    monkeypatch.setenv("LHTPU_EPOCH_BACKEND", "device")
+    ep.reset_epoch_supervisor()
+
+    def boom(*a, **k):
+        raise AssertionError("device rung engaged below the bucket floor")
+
+    monkeypatch.setattr(sh, "shuffle_list_device", boom)
+    seed = _seed(100, 10)
+    indices = np.arange(100, dtype=np.int64)
+    out = sh.shuffle_list(indices, seed, 10)
+    assert np.array_equal(out, _expected(indices, 100, seed, 10))
+
+
+def test_device_fault_recovers_on_host_and_trips_breaker(monkeypatch):
+    from lighthouse_tpu.state_transition import epoch_processing as ep
+
+    monkeypatch.setenv("LHTPU_EPOCH_BACKEND", "device")
+    monkeypatch.setenv("LHTPU_SUPERVISOR_FAILS", "1")
+    ep.reset_epoch_supervisor()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected shuffle device fault")
+
+    monkeypatch.setattr(sh, "shuffle_list_device", boom)
+    # count must sit at/above the bucket floor: a forced backend only
+    # engages the device rung for bucket-floor-and-up shuffles
+    count = 256
+    seed = _seed(count, 10)
+    indices = np.arange(count, dtype=np.int64)
+    try:
+        out = sh.shuffle_list(indices, seed, 10)  # must not raise
+        assert np.array_equal(out, _expected(indices, count, seed, 10))
+        # the fault counts against the SHARED epoch breaker: a flapping
+        # device shuffle parks auto routing instead of paying the doomed
+        # dispatch (plus a duplicate hash sweep) every epoch
+        assert ep._BREAKER["open_until"] > 0
+        monkeypatch.delenv("LHTPU_EPOCH_BACKEND")
+        assert ep.resolve_epoch_backend(10**7) == "reference"
+    finally:
+        ep.reset_epoch_supervisor()
+
+
+@slow
+@pytest.mark.parametrize("rounds", ROUNDS)
+def test_device_matches_scalar_and_vectorized(rounds):
+    for count in COUNTS:
+        seed = _seed(count, rounds)
+        indices = np.arange(count, dtype=np.int64) * 3 + 1
+        expect = _expected(indices, count, seed, rounds)
+        assert np.array_equal(
+            sh.shuffle_list_device(indices, seed, rounds), expect), \
+            (count, rounds)
+        assert np.array_equal(
+            sh.shuffle_list(indices, seed, rounds, device=False), expect)

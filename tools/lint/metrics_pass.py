@@ -45,6 +45,9 @@ FAMILY_OWNERS = {
     # swallowed-error accounting funnels through the one helper
     "offload_swallowed_": "lighthouse_tpu/common/metrics.py",
     "offload_injected_": "lighthouse_tpu/ops/faults.py",
+    # device epoch pass: the backend seam owns the family; epoch_device /
+    # phase0_epoch / shuffle record through its helpers
+    "epoch_": "lighthouse_tpu/state_transition/epoch_processing.py",
 }
 
 

@@ -34,14 +34,22 @@ TARGET_MODULES = (
     "ops/dispatch_pipeline.py",
     "ops/bls_backend.py",
     "parallel/bls_sharded.py",
+    # device epoch processing (PR 6): epoch/shuffle kernels may only be
+    # dispatched through the epoch_processing backend seam's supervisor
+    "ops/epoch_kernels.py",
+    "state_transition/epoch_device.py",
+    "parallel/epoch_sharded.py",
 )
 
-# the functions the offload supervisor (crypto/bls/api.py) wraps: every
-# device dispatch must be reachable from one of these (or carry an
-# explicit allow)
+# the functions the offload supervisors wrap (crypto/bls/api for BLS,
+# state_transition/epoch_processing for the epoch pass): every device
+# dispatch must be reachable from one of these (or carry an explicit
+# allow)
 SUPERVISED_ENTRIES = (
     "ops/bls_backend.py::verify_signature_sets_device",
     "parallel/bls_sharded.py::verify_signature_sets_sharded",
+    "state_transition/epoch_processing.py::_maybe_device_epoch",
+    "state_transition/shuffle.py::shuffle_list",
 )
 
 
