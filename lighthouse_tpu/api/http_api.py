@@ -17,7 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 
 
 class ApiError(Exception):
@@ -509,8 +509,8 @@ class BeaconApi:
         if svc is not None:
             try:
                 svc.router.publish_block(block)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("api.publish_block_gossip", e)
         return {"data": {"root": _hex(root) if root else None}}
 
     def pool_attestations(self, body=None):
@@ -817,8 +817,9 @@ class BeaconApi:
                         bytes.fromhex(
                             msg["fee_recipient"].removeprefix("0x")),
                         int(msg.get("gas_limit", 30_000_000)))
-                except Exception:
-                    pass  # builder faults never fail registration
+                except Exception as e:
+                    # builder faults never fail registration
+                    record_swallowed("api.builder_register", e)
         return {"data": None}
 
     def state_fork(self, state_id, body=None):
@@ -1131,8 +1132,8 @@ class BeaconApi:
         if svc is not None:
             try:
                 svc.router.publish_block(full)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("api.publish_blinded_gossip", e)
         return {"data": {"root": _hex(root) if root else None}}
 
     def attestation_data(self, body=None, query=None):

@@ -427,8 +427,9 @@ class BeaconChain:
                         bytes(att.data.beacon_block_root),
                         int(att.data.target.epoch), int(att.data.slot),
                         is_from_block=True)
-                except Exception:
-                    pass  # invalid-for-fork-choice attestations skippable
+                except Exception as e:
+                    # invalid-for-fork-choice attestations skippable
+                    record_swallowed("chain.block_att_fork_choice", e)
             block_epoch = self.spec.compute_epoch_at_slot(int(block.slot))
             for slashing in block.body.attester_slashings:
                 a1 = set(int(i)
@@ -461,8 +462,9 @@ class BeaconChain:
         self._note_missed_proposals(block, state)
         try:
             self.light_client.on_block_imported(pending.signed_block)
-        except Exception:
-            pass  # LC serving is best-effort, never blocks import
+        except Exception as e:
+            # LC serving is best-effort, never blocks import
+            record_swallowed("chain.light_client_update", e)
         self.events.publish("block", {
             "slot": str(int(block.slot)), "block": "0x" + root.hex(),
             "execution_optimistic": pending.execution_status == 1})
@@ -492,8 +494,9 @@ class BeaconChain:
                     included.append(idx)
             vm.on_sync_aggregate_included(
                 included, int(block.slot), self.spec)
-        except Exception:
-            pass  # observability only, never blocks import
+        except Exception as e:
+            # observability only, never blocks import
+            record_swallowed("chain.sync_aggregate_monitor", e)
 
     def _note_missed_proposals(self, block, post_state) -> None:
         """Feed skipped slots between a block and its parent to the
@@ -582,8 +585,8 @@ class BeaconChain:
         try:
             self.execution_layer.notify_forkchoice_updated(
                 bytes(header.block_hash), fin_hash, fin_hash)
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("chain.forkchoice_notify", e)
 
     def persist(self) -> None:
         """Snapshot fork choice + head for restart resume (reference
@@ -829,8 +832,8 @@ class BeaconChain:
                     bytes(c.attestation.data.beacon_block_root),
                     int(c.attestation.data.target.epoch),
                     int(c.attestation.data.slot))
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("chain.batch_att_fork_choice", e)
         return verified
 
     # -- sync-committee pipelines -------------------------------------------

@@ -19,6 +19,8 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
+from lighthouse_tpu.common.metrics import record_swallowed
+
 
 def _field_proof(state, field_name: str) -> tuple[bytes, list[bytes], int]:
     """(leaf_root, branch, generalized_index) for a top-level state field
@@ -241,8 +243,8 @@ class LightClientServerCache:
         if self.on_optimistic_update is not None:
             try:
                 self.on_optimistic_update(self.latest_optimistic)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("light_client.optimistic_cb", e)
 
         state = chain.state_for_block(attested_root)
         if state is None:
@@ -264,8 +266,8 @@ class LightClientServerCache:
         if self.on_finality_update is not None:
             try:
                 self.on_finality_update(self.latest_finality)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("light_client.finality_cb", e)
 
         # period update: prove the attested state's NEXT sync committee;
         # keep the spec-ranked best update per period (is_better_update)

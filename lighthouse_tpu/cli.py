@@ -195,8 +195,11 @@ def _run_bn(args) -> int:
         from lighthouse_tpu.ops import sha256 as _sha_ops
 
         _sha_ops.calibrate_device_thresholds()
-    except Exception:
-        pass  # never block node startup on a calibration failure
+    except Exception as e:
+        # never block node startup on a calibration failure
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("cli.sha_calibration", e)
 
     cfg = ClientConfig(
         network=args.network,

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.common.logging import Logger
+from lighthouse_tpu.common.metrics import record_swallowed
 from lighthouse_tpu.common.task_executor import TaskExecutor
 
 
@@ -100,12 +101,12 @@ class Client:
         # flips to clean — the next open skips the integrity sweep
         try:
             self.chain.persist()
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("client.stop_persist", e)
         try:
             self.chain.store.close()
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("client.stop_close", e)
         if self.lockfile is not None:
             self.lockfile.release()
 

@@ -222,8 +222,9 @@ def record_swallowed(site: str, exc: BaseException) -> None:
             "errors swallowed (non-fatal by design) on the offload path, "
             "by site",
         ).labels(site=site).inc()
-    except Exception:
-        pass  # the terminal sink: accounting must never re-raise
+    except Exception:  # lhlint: allow(LH901)
+        pass  # the terminal sink: accounting must never re-raise (routing
+        # the failure back through record_swallowed would recurse)
     if site not in _SWALLOWED_LOGGED:
         _SWALLOWED_LOGGED.add(site)
         import sys

@@ -17,6 +17,8 @@ import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 
+from lighthouse_tpu.common.metrics import record_swallowed
+
 MONITORING_VERSION = 1           # types.rs:6 VERSION
 CLIENT_NAME = "lighthouse_tpu"   # types.rs:7 CLIENT_NAME
 DEFAULT_UPDATE_PERIOD_S = 60     # lib.rs:19 DEFAULT_UPDATE_DURATION
@@ -295,8 +297,8 @@ class MonitoringHttpClient:
         if self.network is not None:
             try:
                 peers = len(self.network.connected_peers())
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("system_health.peers", e)
         m.update({
             "disk_beaconchain_bytes_total": db_bytes,
             "network_peers_connected": peers,
@@ -311,8 +313,8 @@ class MonitoringHttpClient:
                     self.chain.finalized_checkpoint().epoch)
                 m["beacon_validator_count"] = len(
                     self.chain.head_state.validators)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("system_health.head", e)
         return m
 
     def validator_metrics(self) -> dict:
@@ -323,8 +325,8 @@ class MonitoringHttpClient:
             try:
                 total = len(self.validator_store.voting_pubkeys())
                 active = total
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("system_health.validators", e)
         # gather.rs VALIDATOR_PROCESS_METRICS json keys
         m.update({"vc_validators_enabled_count": active,
                   "vc_validators_total_count": total})

@@ -14,7 +14,7 @@ import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 
 
 @dataclass
@@ -90,8 +90,8 @@ class TaskExecutor:
         for cb in self._shutdown_cb:
             try:
                 cb(self.shutdown_reason)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("task_executor.shutdown_cb", e)
         self._pool.shutdown(wait=False)
 
     def join(self, timeout_s: float = 5.0) -> None:

@@ -190,7 +190,10 @@ class Signature:
             from lighthouse_tpu.ops import native_bls
 
             native = native_bls if native_bls.available() else None
-        except Exception:
+        except Exception as e:
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("bls.decompress_batch.native", e)
             native = None
         if native is None:
             try:
@@ -338,8 +341,12 @@ def record_batch(backend: str, n_sets: int) -> None:
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                      4096),
         ).labels(backend=backend).observe(n_sets)
-    except Exception:
-        pass  # metrics must never take down a verifier
+    except Exception as e:
+        # metrics must never take down a verifier — but a broken
+        # registry should not be invisible either
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.record_batch", e)
 
 
 # labeled children memoized here: interned() runs per gossip signature
@@ -361,7 +368,10 @@ def record_cache(cache: str, hit: bool) -> None:
                 "bls_cache_requests_total",
                 "verify-path cache lookups by cache and outcome",
             ).labels(cache=cache, outcome="hit" if hit else "miss")
-        except Exception:
+        except Exception as e:
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("bls.record_cache", e)
             return  # metrics must never take down a verifier
         _CACHE_COUNTERS[key] = child
     child.inc()
@@ -380,8 +390,10 @@ def record_stage(backend: str, stage: str, seconds: float) -> None:
             "(device stages time dispatch unless the caller syncs)",
             buckets=_STAGE_BUCKETS,
         ).labels(backend=backend, stage=stage).observe(seconds)
-    except Exception:
-        pass  # metrics must never take down a verifier
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.record_stage", e)
 
 
 def _verify_signature_sets_reference(sets: Sequence[SignatureSet],
@@ -496,7 +508,12 @@ def resolve_auto_backend() -> str:
         import jax
 
         platform = jax.devices()[0].platform
-    except Exception:
+    except Exception as e:
+        # a failed device probe silently pinning the node to the host
+        # backend is exactly the "worst silent fallback" class — count it
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.auto_backend_probe", e)
         return "reference"
     return "tpu" if platform == "tpu" else "reference"
 
@@ -832,9 +849,12 @@ def verify_signature_sets(
             "bls_verify_seconds",
             "wall time of one batch verification call",
             buckets=_STAGE_BUCKETS).labels(backend=name).time()
-    except Exception:
+    except Exception as e:
         from contextlib import nullcontext
 
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls.verify_timer", e)
         timer = nullcontext()
     from lighthouse_tpu.common import tracing
 

@@ -316,7 +316,10 @@ def _native():
             from lighthouse_tpu.ops import native_bls
 
             _NATIVE = native_bls if native_bls.available() else False
-        except Exception:
+        except Exception as e:
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("bls.curve.native_probe", e)
             _NATIVE = False
     return _NATIVE
 

@@ -94,7 +94,10 @@ def encrypt(secret: bytes, password: str, *, path: str = "",
         try:
             pubkey = bls.SecretKey.from_bytes(secret).public_key() \
                 .to_bytes().hex()
-        except Exception:
+        except Exception as e:
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("keystore.pubkey_derive", e)
             pubkey = ""
     return {
         "crypto": {

@@ -42,6 +42,7 @@ import time
 from typing import Callable
 
 from lighthouse_tpu.common.logging import Logger
+from lighthouse_tpu.common.metrics import record_swallowed
 from lighthouse_tpu.network.gossip import _SeenCache, message_id
 from lighthouse_tpu.network.rpc import RateLimiter, RpcError
 from lighthouse_tpu.network.wire import codec, gossipsub, noise
@@ -250,8 +251,8 @@ class WireNode:
             for conn in list(self._conns.values()):
                 try:
                     conn.writer.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    record_swallowed("wire.shutdown_close", e)
             if self._server is not None:
                 self._server.close()
             if self._udp_transport is not None:
@@ -261,8 +262,8 @@ class WireNode:
         try:
             asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
             self._thread.join(timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("wire.stop", e)
 
     def _call(self, coro, timeout=REQUEST_TIMEOUT_S):
         """Run a coroutine on the wire loop from a foreign thread."""
@@ -390,16 +391,16 @@ class WireNode:
             conn.alive = False
             try:
                 conn.writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("wire.conn_close", e)
             if conn.peer_id and self._conns.get(conn.peer_id) is conn:
                 del self._conns[conn.peer_id]
                 self._gs.peer_disconnected(conn.peer_id)
                 if self.on_peer_disconnected:
                     try:
                         self.on_peer_disconnected(conn.peer_id)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        record_swallowed("wire.peer_disconnected_cb", e)
 
     # -- frame handling ------------------------------------------------------
 
@@ -474,8 +475,8 @@ class WireNode:
             if self.on_peer_connected:
                 try:
                     self.on_peer_connected(conn.peer_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    record_swallowed("wire.peer_connected_cb", e)
         elif kind == K_SUBSCRIBE:
             conn.topics.add(body.decode())
         elif kind == K_UNSUBSCRIBE:
@@ -490,8 +491,8 @@ class WireNode:
                 if self.on_delivery_result is not None:
                     try:
                         self.on_delivery_result(conn.peer_id, topic, False)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        record_swallowed("wire.delivery_result_cb", e)
                 return
             self._on_gossip(conn.peer_id, topic, data)
         elif kind == K_RPC_REQ:
@@ -634,8 +635,8 @@ class WireNode:
             if self.on_delivery_result is not None:
                 try:
                     self.on_delivery_result(src, topic, ok)
-                except Exception:
-                    pass
+                except Exception as e:
+                    record_swallowed("wire.delivery_result_cb", e)
             # forward valid messages to OUR mesh; invalid messages are
             # NOT propagated (gossipsub validation gating)
             if ok:
@@ -661,8 +662,8 @@ class WireNode:
                 continue
             try:
                 await self._send_frame(conn, wire)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("wire.fanout_send", e)
 
     def publish(self, topic: str, data: bytes):
         async def run():
@@ -689,8 +690,8 @@ class WireNode:
                         try:
                             await self._send_frame(
                                 conn, bytes([K_GRAFT]) + topic.encode())
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            record_swallowed("wire.graft_send", e)
             asyncio.run_coroutine_threadsafe(_join(), self.loop)
 
     def unsubscribe(self, topic: str):
@@ -704,8 +705,8 @@ class WireNode:
                         try:
                             await self._send_frame(
                                 conn, self._prune_frame(topic, p))
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            record_swallowed("wire.prune_send", e)
             asyncio.run_coroutine_threadsafe(_leave(), self.loop)
 
     def _announce(self, kind: int, topic: str):
@@ -717,8 +718,8 @@ class WireNode:
             for conn in list(self._conns.values()):
                 try:
                     await self._send_frame(conn, frame)
-                except Exception:
-                    pass
+                except Exception as e:
+                    record_swallowed("wire.announce_send", e)
 
         asyncio.run_coroutine_threadsafe(_do(), self.loop)
 
@@ -796,8 +797,8 @@ class WireNode:
     async def _dial_quiet(self, host: str, port: int):
         try:
             await self._dial(host, port)
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("wire.dial_quiet", e)
 
     async def _send_ctrl(self, peer: str, frame: bytes):
         conn = self._conns.get(peer)
@@ -805,8 +806,8 @@ class WireNode:
             return
         try:
             await self._send_frame(conn, frame)
-        except Exception:
-            pass
+        except Exception as e:
+            record_swallowed("wire.ctrl_send", e)
 
     # -- rpc -----------------------------------------------------------------
 
@@ -835,8 +836,8 @@ class WireNode:
                 await self._send_frame(
                     conn, bytes([K_RPC_ERR]) + struct.pack("<Q", stream)
                     + str(e).encode())
-            except Exception:
-                pass
+            except Exception as e2:
+                record_swallowed("wire.rpc_err_send", e2)
 
     def request(self, dst_peer: str, protocol: str,
                 data: bytes) -> list[bytes]:
@@ -917,8 +918,8 @@ class WireNode:
             conn.alive = False
             try:
                 conn.writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("wire.disconnect_close", e)
 
         asyncio.run_coroutine_threadsafe(_close(), self.loop)
 

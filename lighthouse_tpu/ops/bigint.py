@@ -350,7 +350,10 @@ def _use_mxu_redc() -> bool:
         else:
             try:
                 _MXU_REDC = jax.default_backend() == "tpu"
-            except Exception:
+            except Exception as e:
+                from lighthouse_tpu.common.metrics import record_swallowed
+
+                record_swallowed("bigint.mxu_probe", e)
                 _MXU_REDC = False
     return _MXU_REDC
 

@@ -795,6 +795,8 @@ def multi_pairing_device(pairs) -> "object":
         from lighthouse_tpu.ops import native_bls
         if native_bls.available():
             return native_bls.final_exp(f_host)
-    except Exception:
-        pass
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls12_381.native_final_exp", e)
     return final_exponentiation_fast(f_host)

@@ -420,8 +420,10 @@ def _final_exp_is_one(f_host) -> bool:
 
         if native_bls.available():
             return native_bls.final_exp_is_one(f_host)
-    except Exception:
-        pass
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("bls_backend.native_final_exp", e)
     if not _use_device_final_exp():
         return final_exponentiation_fast(f_host).is_one()
     m = final_exp_easy(f_host)        # one host inversion (~µs, ext-gcd)

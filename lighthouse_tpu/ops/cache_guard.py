@@ -61,7 +61,9 @@ def ensure_map_headroom() -> bool:
             _log().info("raised vm.max_map_count sysctl",
                         target=_MAP_TARGET, path=_MAP_PATH)
         return raised
-    except Exception:
+    except (OSError, ValueError):
+        # unwritable/missing sysctl or a non-numeric readback — the
+        # install() fallback layer takes over
         return False
 
 
@@ -105,7 +107,7 @@ def install() -> None:
                         compile_time):
             try:
                 platform = backend.platform
-            except Exception:
+            except AttributeError:
                 platform = "?"
             if platform == "cpu" and any(n in module_name
                                          for n in _GUARDED_NAMES):
@@ -122,7 +124,7 @@ def install() -> None:
         def guarded_read(module_name, cache_key, compile_options, backend):
             try:
                 platform = backend.platform
-            except Exception:
+            except AttributeError:
                 platform = "?"
             if platform == "cpu" and any(n in module_name
                                          for n in _GUARDED_NAMES):

@@ -250,8 +250,8 @@ def run_with_deadline(fn, timeout_s: float, thread_name: str, what: str):
         _UNDER_WATCHDOG.value = True
         try:
             box["ok"] = fn()
-        except BaseException as e:  # re-raised on the caller thread
-            box["exc"] = e
+        except BaseException as e:  # lhlint: allow(LH902) — not swallowed:
+            box["exc"] = e          # re-raised on the caller thread below
         finally:
             done.set()
 

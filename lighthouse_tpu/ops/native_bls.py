@@ -38,6 +38,9 @@ def _load():
             path = build_shared_lib("bls_host.cc")
             lib = ctypes.CDLL(str(path))
         except Exception as e:          # missing toolchain, bad build...
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("native_bls.load", e)
             _lib_err = str(e)
             return None
         lib.lhbls_init.restype = ctypes.c_int

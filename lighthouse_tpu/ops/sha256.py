@@ -51,8 +51,10 @@ def _record_fold_dispatch(shape_key, seconds: float) -> None:
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
                      120.0),
         ).labels(phase=phase).observe(seconds)
-    except Exception:
-        pass  # metrics must never take down the hasher
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("sha256.record_fold", e)
 
 # FIPS 180-4 round constants.
 _K = np.array(
@@ -242,7 +244,10 @@ def _native_sha():
         lib.sha256_pairs.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
         _NATIVE_SHA = lib
-    except Exception:
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("sha256.native_load", e)
         _NATIVE_SHA = None
     return _NATIVE_SHA
 
@@ -473,8 +478,10 @@ def _publish_threshold() -> None:
             "pair count above which merkle levels route to the device "
             "(static default or startup calibration)",
         ).set(_DEVICE_MIN_PAIRS)
-    except Exception:
-        pass  # metrics must never take down the hasher
+    except Exception as e:
+        from lighthouse_tpu.common.metrics import record_swallowed
+
+        record_swallowed("sha256.publish_threshold", e)
 
 
 def merkleize_words(

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from lighthouse_tpu.common.metrics import record_swallowed
 from lighthouse_tpu.slasher.array import SurroundArray
 from lighthouse_tpu.store.kv import KeyValueOp, MemoryStore
 
@@ -255,13 +256,13 @@ class SlasherService:
         for sl in found.attester:
             try:
                 self.chain.op_pool.insert_attester_slashing(sl)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("slasher.insert_attester", e)
         for sl in found.proposer:
             try:
                 self.chain.op_pool.insert_proposer_slashing(sl)
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("slasher.insert_proposer", e)
         if epoch > self._last_batch_epoch:
             self.slasher.prune(epoch)
             self._last_batch_epoch = epoch

@@ -43,7 +43,7 @@ import numpy as np
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.common import env as envreg
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.state_transition import misc
 
 # Participation flag indices / weights (altair).
@@ -130,8 +130,8 @@ def record_epoch_stage(stage: str, seconds: float) -> None:
             "epoch_stage_seconds",
             "device epoch-pass stage wall time",
         ).labels(stage=stage).observe(seconds)
-    except Exception:
-        pass  # metrics must never take down the transition
+    except Exception as e:
+        record_swallowed("epoch.record_stage", e)
 
 
 def record_epoch_fault(backend: str, kind: str) -> None:
@@ -141,8 +141,8 @@ def record_epoch_fault(backend: str, kind: str) -> None:
             "epoch_supervisor_faults_total",
             "device epoch faults recovered on the reference backend",
         ).labels(backend=backend, kind=kind).inc()
-    except Exception:
-        pass
+    except Exception as e:
+        record_swallowed("epoch.record_fault", e)
 
 
 def _record_epoch_batch(backend: str, seconds: float) -> None:
@@ -155,8 +155,8 @@ def _record_epoch_batch(backend: str, seconds: float) -> None:
             "epoch_transition_seconds",
             "epoch core pass wall time by backend",
         ).labels(backend=backend).observe(seconds)
-    except Exception:
-        pass
+    except Exception as e:
+        record_swallowed("epoch.record_batch", e)
 
 
 def reset_epoch_supervisor() -> None:

@@ -47,8 +47,8 @@ class _EpochAttestations:
         epoch_start_root = None
         try:
             epoch_start_root = misc.get_block_root(state, spec, epoch)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # epoch start outside block_roots range (genesis edge)
         # all attestations in one epoch's list share the epoch's shuffle:
         # compute it ONCE and amortize over every committee lookup
         shuffle = (misc.compute_committee_shuffle(state, spec, epoch)
@@ -67,8 +67,8 @@ class _EpochAttestations:
                 try:
                     head_root = misc.get_block_root_at_slot(
                         state, spec, int(att.data.slot))
-                except Exception:
-                    continue
+                except ValueError:
+                    continue  # attestation slot outside block_roots range
                 if bytes(att.data.beacon_block_root) == head_root:
                     self.head[indices] = True
 

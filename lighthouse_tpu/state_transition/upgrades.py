@@ -69,7 +69,9 @@ def upgrade_to_altair(state, spec: T.ChainSpec, t) -> None:
                 state, spec, pending, None)
             flags = get_attestation_participation_flag_indices(
                 state, spec, data, int(pending.inclusion_delay))
-        except Exception:
+        except ValueError:
+            # root lookups outside block_roots range: the spec's
+            # translate_participation drops untranslatable attestations
             continue
         part = state.previous_epoch_participation
         for f in flags:

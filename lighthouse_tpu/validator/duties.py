@@ -10,6 +10,7 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field
 
+from lighthouse_tpu.common.metrics import record_swallowed
 from lighthouse_tpu.state_transition import misc
 
 
@@ -195,8 +196,8 @@ class DutiesService:
                 if pk is not None:
                     duties.proposers.append(
                         ProposerDuty(pk, proposer, slot))
-            except Exception:
-                pass
+            except Exception as e:
+                record_swallowed("duties.proposer", e)
         self._cache[epoch] = duties
         if len(self._cache) > 4:
             del self._cache[min(self._cache)]

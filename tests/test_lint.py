@@ -727,3 +727,835 @@ def test_baseline_json_is_valid_and_small():
     data = json.loads(BASELINE_PATH.read_text())
     assert isinstance(data, dict)
     assert all(isinstance(v, int) and v > 0 for v in data.values())
+
+
+# -- v2: pass 8 device-numeric safety (LH80x) ---------------------------------
+
+
+def test_numeric_pass_flags_host_int64_lane(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/epoch_bridge.py": """
+        import jax.numpy as jnp
+
+        def bad(epochs):
+            return jnp.asarray(epochs, dtype=jnp.int64)
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH801"]
+    assert "enable_x64" in findings[0].message
+
+
+def test_numeric_pass_x64_scope_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/epoch_bridge.py": """
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        def good(epochs):
+            with enable_x64():
+                return jnp.asarray(epochs, dtype=jnp.int64)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_flags_unscoped_int64_dispatch(tmp_path):
+    # the traced body is exempt (tracing happens at dispatch); the
+    # DISPATCH outside the scope is the bug
+    pkg, _ = make_pkg(tmp_path, {"chain/epoch_bridge.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(cols):
+            return cols.astype(jnp.int64) + 1
+
+        def bad_dispatch(cols):
+            return kernel(cols)
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH801"]
+    assert "dispatch" in findings[0].symbol
+
+
+def test_numeric_pass_scoped_dispatch_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/epoch_bridge.py": """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        @jax.jit
+        def kernel(cols):
+            return cols.astype(jnp.int64) + 1
+
+        def good_dispatch(cols):
+            with enable_x64():
+                return kernel(cols)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_flags_true_division_on_gwei_lanes(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/rewards.py": """
+        import jax.numpy as jnp
+
+        def bad(balances):
+            cols = jnp.asarray(balances)
+            return cols / 32
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH802"]
+    assert "gwei" in findings[0].message
+
+
+def test_numeric_pass_floor_division_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/rewards.py": """
+        import jax.numpy as jnp
+
+        def good(balances):
+            cols = jnp.asarray(balances)
+            return cols // 32
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_host_float_math_not_flagged(tmp_path):
+    # host-only floats (bench math, ratios) must never trip LH802: the
+    # pass fires only on positively classified device/traced values
+    pkg, _ = make_pkg(tmp_path, {"chain/bench.py": """
+        def ratio(balance_total, n):
+            return balance_total / n
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_flags_unclamped_uint64_bridge(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"state_transition/epoch_device.py": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def bridge(exit_epochs):
+            cols = exit_epochs.astype(np.uint64)
+            return jnp.asarray(cols)
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH803"]
+    assert "clamp" in findings[0].message
+
+
+def test_numeric_pass_clamp_constant_exempts(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"state_transition/epoch_device.py": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        EPOCH_CLAMP = 1 << 62
+
+        def bridge(exit_epochs):
+            cols = np.minimum(exit_epochs, EPOCH_CLAMP).astype(np.uint64)
+            return jnp.asarray(cols)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_build_tables_none_guard_exempts(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"state_transition/epoch_device.py": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        EPOCH_CLAMP = 1 << 62
+
+        def build_tables(max_eb):
+            if max_eb >= EPOCH_CLAMP:
+                return None
+            return max_eb
+
+        def bridge(exit_epochs):
+            cols = exit_epochs.astype(np.uint64)
+            return jnp.asarray(cols)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_numeric_pass_suppression(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/epoch_bridge.py": """
+        import jax.numpy as jnp
+
+        def waived(epochs):
+            return jnp.asarray(epochs, dtype=jnp.int64)  # lhlint: allow(LH801)
+    """})
+    assert analyze(pkg) == []
+
+
+# -- v2: pass 9 blocking-fetch escalation (LH811) -----------------------------
+
+
+def test_blocking_pass_flags_fetch_under_lock_package_wide(tmp_path):
+    # api/ is NOT in LH101's lock-owner module list — LH811 covers it
+    pkg, _ = make_pkg(tmp_path, {"api/http_api.py": """
+        import jax.numpy as jnp
+
+        class Api:
+            def bad(self, values):
+                arr = jnp.asarray(values)
+                with self._lock:
+                    return arr.item()
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH811"]
+    assert "with self._lock" in findings[0].message
+
+
+def test_blocking_pass_fetch_outside_lock_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"api/http_api.py": """
+        import jax.numpy as jnp
+
+        class Api:
+            def good(self, values):
+                arr = jnp.asarray(values)
+                got = arr.item()
+                with self._lock:
+                    return got
+    """})
+    assert analyze(pkg) == []
+
+
+def test_blocking_pass_reaches_through_call_graph_under_lock(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"api/http_api.py": """
+        import jax.numpy as jnp
+
+        def _materialize(values):
+            arr = jnp.asarray(values)
+            return arr.item()
+
+        def _level3(values):
+            return _materialize(values)
+
+        def _level2(values):
+            return _level3(values)
+
+        def _level1(values):
+            return _level2(values)
+
+        class Api:
+            def bad(self, values):
+                with self._lock:
+                    return _level1(values)
+    """})
+    # 4 hops deep — beyond LH101's 3-hop limit, within LH811's unlimited
+    # reachability
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH811"]
+    assert "reachable under" in findings[0].message
+
+
+def test_blocking_pass_flags_dispatch_thread_fetch(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"processor/beacon_processor.py": """
+        import jax.numpy as jnp
+
+        def _drain(batch):
+            arr = jnp.asarray(batch)
+            return arr.item()
+
+        def _dispatch_loop(batch):
+            return _drain(batch)
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH811"]
+    assert "dispatch thread" in findings[0].message
+
+
+def test_blocking_pass_commit_points_exempt(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"processor/beacon_processor.py": """
+        import jax.numpy as jnp
+
+        def commit(batch):
+            arr = jnp.asarray(batch)
+            return arr.item()
+
+        def _dispatch_loop(batch):
+            return commit(batch)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_blocking_pass_host_values_not_flagged(tmp_path):
+    # .item() on a host numpy value is not a device fetch — the lattice
+    # must positively classify the receiver
+    pkg, _ = make_pkg(tmp_path, {"api/http_api.py": """
+        import numpy as np
+
+        class Api:
+            def fine(self, values):
+                arr = np.asarray(values)
+                with self._lock:
+                    return arr.item()
+    """})
+    assert analyze(pkg) == []
+
+
+# -- v2: pass 10 swallowed-exception discipline (LH90x) -----------------------
+
+
+def test_exceptions_pass_flags_silent_pass(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/wire/transport.py": """
+        def notify(cb):
+            try:
+                cb()
+            except Exception:
+                pass
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH901"]
+    assert "record_swallowed" in findings[0].message
+
+
+def test_exceptions_pass_funneled_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/wire/transport.py": """
+        from pkg.common.metrics import record_swallowed
+
+        def notify(cb):
+            try:
+                cb()
+            except Exception as e:
+                record_swallowed("wire.notify", e)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_narrowed_type_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/wire/transport.py": """
+        def notify(cb):
+            try:
+                cb()
+            except (OSError, ValueError):
+                pass
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_waiver(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"common/metrics.py": """
+        def sink(fn):
+            try:
+                fn()
+            except Exception:  # lhlint: allow(LH901)
+                pass  # terminal sink: must never re-raise
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_flags_unaccounted_swallow_in_offload(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        def probe(compute):
+            try:
+                return compute()
+            except Exception:
+                return None
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH902"]
+    assert "starve the breaker" in findings[0].message
+
+
+def test_exceptions_pass_unaccounted_outside_offload_not_flagged(tmp_path):
+    # LH902 is scoped to the offload/supervisor modules; elsewhere a
+    # handled fallback is ordinary defensive code
+    pkg, _ = make_pkg(tmp_path, {"api/http_api.py": """
+        def probe(compute):
+            try:
+                return compute()
+            except Exception:
+                return None
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_accounted_swallow_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        from pkg.common.metrics import record_swallowed
+
+        def probe(compute):
+            try:
+                return compute()
+            except Exception as e:
+                record_swallowed("ops.probe", e)
+                return None
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_log_on_computed_receiver_accounted(tmp_path):
+    # ``_log().warn(...)`` — the receiver is a call, not a name; the
+    # terminal attribute must still count as accounting
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        def probe(compute, _log):
+            try:
+                return compute()
+            except Exception:
+                _log().warn("degraded")
+                return None
+    """})
+    assert analyze(pkg) == []
+
+
+def test_exceptions_pass_reraise_accounted(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        def probe(compute):
+            try:
+                return compute()
+            except Exception:
+                cleanup()
+                raise
+    """})
+    assert analyze(pkg) == []
+
+
+# -- v2: LH602 supervision completeness ---------------------------------------
+
+
+def test_supervisor_pass_flags_driver_missing_breaker_hooks(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        class _Supervisor:
+            def verify(self, name, sets, chunk_size):
+                try:
+                    return run_device(sets)
+                except Exception:
+                    return run_reference(sets)
+    """})
+    findings = [f for f in analyze(pkg) if f.rule == "LH602"]
+    assert sorted(f.symbol for f in findings) == [
+        "_Supervisor.verify:fault-hook", "_Supervisor.verify:ok-hook"]
+
+
+def test_supervisor_pass_driver_with_hooks_negative(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        class _Supervisor:
+            def verify(self, name, sets, chunk_size):
+                try:
+                    out = run_device(sets)
+                    self.breakers[name].record_success()
+                    return out
+                except Exception:
+                    self.breakers[name].record_failure()
+                    return run_reference(sets)
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH602"] == []
+
+
+def test_supervisor_pass_flags_renamed_driver(tmp_path):
+    # the LADDERS table names `_Supervisor.verify`; a rename must fail
+    # the lint until the table moves with it
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        class _Supervisor:
+            def run(self, name, sets):
+                return run_device(sets)
+    """})
+    findings = [f for f in analyze(pkg) if f.rule == "LH602"]
+    assert [f.symbol for f in findings] == ["_Supervisor.verify:missing"]
+    assert "LADDERS" in findings[0].message
+
+
+def test_supervisor_pass_real_tree_ladders_complete():
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    assert [f for f in findings if f.rule == "LH602"] == []
+
+
+# -- v2: real-tree zero-findings gates ----------------------------------------
+
+
+def test_real_tree_clean_for_v2_rules():
+    """The PR's breadth claim: every LH80x/LH81x/LH90x finding in the
+    real tree was FIXED (or carries an inline-justified waiver), not
+    baselined — the baseline still holds only the two LH102 entries."""
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    v2 = [f for f in findings
+          if f.rule in ("LH801", "LH802", "LH803", "LH811",
+                        "LH901", "LH902", "LH602")]
+    assert v2 == [], "v2 findings in the real tree:\n" + "\n".join(
+        f.render() for f in v2)
+
+
+def test_real_tree_waivers_are_justified():
+    """Every inline LH90x/LH602 waiver must carry prose (a comment
+    beyond the allow() itself) on the same or adjacent line."""
+    import re
+
+    allow_re = re.compile(r"#\s*lhlint:\s*allow\((LH9\d\d|LH602)\)")
+    for path in sorted((REPO / "lighthouse_tpu").rglob("*.py")):
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            m = allow_re.search(line)
+            if not m:
+                continue
+            tail = line[m.end():].strip(" —-")
+            nxt = lines[i + 1].strip() if i + 1 < len(lines) else ""
+            assert tail or nxt.startswith("#") or "#" in nxt, (
+                f"{path}:{i + 1}: waiver without justification")
+
+
+# -- the jit shape manifest ---------------------------------------------------
+
+MANIFEST_PATH = REPO / "tools" / "lint" / "shape_manifest.json"
+
+
+def _build_real_manifest():
+    from tools.lint import build_context
+    from tools.lint import manifest as mf
+
+    ctx = build_context(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    return mf.build_manifest(ctx)
+
+
+def test_manifest_matches_tree():
+    """The LH402-style sync gate: the checked-in manifest must be byte-
+    identical to a regeneration from the tree (``python -m tools.lint
+    --manifest`` refreshes it)."""
+    from tools.lint import manifest as mf
+
+    assert MANIFEST_PATH.exists(), "run: python -m tools.lint --manifest"
+    assert mf.render(_build_real_manifest()) == MANIFEST_PATH.read_text(), (
+        "tools/lint/shape_manifest.json is stale — regenerate with "
+        "`python -m tools.lint --manifest`")
+
+
+def test_manifest_covers_every_jit_site():
+    """Independent cross-check: a from-scratch AST sweep for jax.jit
+    constructions (calls AND decorators) over the package must find no
+    site the manifest misses."""
+    import ast as _ast
+
+    manifest = json.loads(MANIFEST_PATH.read_text())
+    covered = {(e["file"], e["line"]) for e in manifest["entries"]}
+
+    def dotted(expr):
+        parts = []
+        node = expr
+        while isinstance(node, _ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, _ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    missing = []
+    for path in sorted((REPO / "lighthouse_tpu").rglob("*.py")):
+        rel = str(path.relative_to(REPO))
+        tree = _ast.parse(path.read_text())
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.Call) \
+                    and dotted(node.func) in ("jax.jit", "jit"):
+                if (rel, node.lineno) not in covered:
+                    missing.append(f"{rel}:{node.lineno} (call)")
+            elif isinstance(node, (_ast.FunctionDef,
+                                   _ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    text = dotted(dec) or (
+                        dotted(dec.func)
+                        if isinstance(dec, _ast.Call) else None)
+                    inner = None
+                    if isinstance(dec, _ast.Call) and dec.args \
+                            and text in ("partial", "functools.partial"):
+                        inner = dotted(dec.args[0])
+                    if text in ("jax.jit", "jit") \
+                            or inner in ("jax.jit", "jit"):
+                        if (rel, dec.lineno) not in covered:
+                            missing.append(f"{rel}:{dec.lineno} (decorator)")
+    assert not missing, "jit sites absent from shape_manifest.json:\n" \
+        + "\n".join(missing)
+
+
+def test_manifest_entry_shape_and_owners():
+    manifest = json.loads(MANIFEST_PATH.read_text())
+    assert manifest["version"] == 1
+    entries = manifest["entries"]
+    assert entries, "manifest must enumerate the jit bucket set"
+    required = {"id", "file", "line", "kind", "target", "backend",
+                "static_argnums", "static_argnames", "dtypes",
+                "int64_lanes", "x64_dispatch", "buckets"}
+    for e in entries:
+        assert required <= set(e), e["id"]
+        assert e["kind"] in ("decorator", "assignment", "memoized",
+                             "inline"), e["id"]
+        assert e["backend"], e["id"]
+        assert e["buckets"]["policy"] in ("pow2", "fixed"), e["id"]
+    # the AOT prewarmer's key facts: the fused epoch pass is an int64
+    # program dispatched under enable_x64, memoized per bucket
+    epoch = [e for e in entries
+             if e["file"] == "lighthouse_tpu/ops/epoch_kernels.py"
+             and e["kind"] == "memoized"]
+    assert any(e["int64_lanes"] and e["x64_dispatch"] for e in epoch)
+    assert all(e["buckets"].get("memo_key") for e in epoch)
+    # entries are sorted and unique by id
+    ids = [e["id"] for e in entries]
+    assert len(ids) == len(set(ids))
+    files_lines = [(e["file"], e["line"], e["id"]) for e in entries]
+    assert files_lines == sorted(files_lines)
+
+
+def test_cli_manifest_mode(tmp_path):
+    out = tmp_path / "manifest.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--manifest",
+         "--manifest-path", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "shape manifest" in proc.stdout
+    data = json.loads(out.read_text())
+    assert data == json.loads(MANIFEST_PATH.read_text())
+
+
+# -- CLI: exit codes, --json, perf budget -------------------------------------
+
+
+def test_cli_json_output(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        def probe(compute):
+            try:
+                return compute()
+            except Exception:
+                return None
+    """})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--root", str(pkg),
+         "--no-baseline", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert [d["rule"] for d in data] == ["LH902"]
+    assert {"rule", "name", "file", "line", "symbol", "message",
+            "new"} <= set(data[0])
+    assert data[0]["new"] is True
+
+
+def test_cli_json_clean_tree_is_empty_array(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/clean.py": """
+        def fine():
+            return 1
+    """})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--root", str(pkg),
+         "--no-baseline", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout) == []
+
+
+def test_cli_exit_codes_documented():
+    """The documented exit-code contract (cli.py docstring) — 0 clean /
+    baselined, 1 findings, 2 usage error."""
+    from tools.lint import cli
+
+    assert "0" in cli.__doc__ and "1" in cli.__doc__ and "2" in cli.__doc__
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--no-such-flag"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 2
+
+
+def test_full_tree_run_stays_under_budget():
+    """Engine perf gate: a COLD full-tree analyze (module-lattice memo
+    dropped) stays under the 10 s CI budget."""
+    import time
+
+    from tools.lint import dataflow
+
+    dataflow.clear_cache()
+    t0 = time.perf_counter()
+    analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    cold = time.perf_counter() - t0
+    assert cold < 10.0, f"cold full-tree lhlint took {cold:.1f}s"
+    # warm re-run must hit the mtime-keyed memo (same process)
+    t0 = time.perf_counter()
+    analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    warm = time.perf_counter() - t0
+    assert warm < cold
+
+
+def test_module_lattice_memo_keyed_by_mtime(tmp_path):
+    """Editing a file re-analyzes it; untouched files come from the
+    memo."""
+    from tools.lint import dataflow
+
+    pkg, _ = make_pkg(tmp_path, {"ops/probe.py": """
+        def probe(compute):
+            try:
+                return compute()
+            except Exception:
+                return None
+    """})
+    assert rules_of(analyze(pkg)) == ["LH902"]
+    path = pkg / "ops" / "probe.py"
+    fixed = path.read_text().replace(
+        "except Exception:", "except ValueError:")
+    path.write_text(fixed)
+    os.utime(path, (os.path.getmtime(path) + 2,) * 2)
+    assert analyze(pkg) == []
+    del dataflow
+
+
+# -- review-round regressions -------------------------------------------------
+
+
+def test_traced_closure_covers_nested_def_callees(tmp_path):
+    """A helper called only from a jit target's fori_loop body traces
+    with it — it must NOT be flagged as a host int64 lane (the engine's
+    'can only miss, never invent' guarantee)."""
+    pkg, _ = make_pkg(tmp_path, {"chain/kernels.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def _helper(acc):
+            return acc.astype(jnp.int64)
+
+        @jax.jit
+        def kernel(cols):
+            def body(i, acc):
+                return _helper(acc)
+            return jax.lax.fori_loop(0, 3, body, cols)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_cli_manifest_refuses_unparseable_tree(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"ops/good.py": """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x
+    """})
+    (pkg / "ops" / "broken.py").write_text("def oops(:\n")
+    out = tmp_path / "manifest.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--manifest",
+         "--root", str(pkg), "--manifest-path", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 1
+    assert "unparseable" in proc.stderr
+    assert not out.exists()
+
+
+def test_blocking_pass_owner_module_defers_to_lh101_scope(tmp_path):
+    """In a LH101 owner module a 1-hop reachable fetch is LH101's alone
+    (one defect, one finding); strictly deeper than 3 hops it becomes
+    LH811's."""
+    shallow = """
+        import jax.numpy as jnp
+
+        def _materialize(values):
+            arr = jnp.asarray(values)
+            return arr.item()
+
+        class Chain:
+            def bad(self, values):
+                with self._import_lock:
+                    return _materialize(values)
+    """
+    pkg, _ = make_pkg(tmp_path, {"chain/beacon_chain.py": shallow})
+    assert rules_of(analyze(pkg)) == ["LH101"]
+
+    deep = """
+        import jax.numpy as jnp
+
+        def _materialize(values):
+            arr = jnp.asarray(values)
+            return arr.item()
+
+        def _l4(values):
+            return _materialize(values)
+
+        def _l3(values):
+            return _l4(values)
+
+        def _l2(values):
+            return _l3(values)
+
+        def _l1(values):
+            return _l2(values)
+
+        class Chain:
+            def bad(self, values):
+                with self._import_lock:
+                    return _l1(values)
+    """
+    pkg2, _ = make_pkg(tmp_path / "deep", {"chain/beacon_chain.py": deep})
+    findings = analyze(pkg2)
+    assert "LH811" in rules_of(findings)
+    lh811 = [f for f in findings if f.rule == "LH811"]
+    assert lh811[0].symbol.startswith("_materialize")
+
+
+def test_manifest_policy_not_flipped_by_metrics_buckets(tmp_path):
+    """A histogram `buckets=(...)` kwarg (or a stray 'bucket' comment)
+    elsewhere in the module must not stamp a fixed-shape program as
+    pow2; a real pow2 pad in the dispatching caller must."""
+    from tools.lint import build_context
+    from tools.lint import manifest as mf
+
+    pkg, _ = make_pkg(tmp_path, {"ops/kernels.py": """
+        import jax
+        import jax.numpy as jnp
+
+        # histogram buckets live here, nothing to do with shapes
+        def record(reg, s):
+            reg.histogram("x_seconds", "d", buckets=(0.1, 1.0)).observe(s)
+
+        @jax.jit
+        def fixed_kernel(x):
+            return x + 1
+
+        def run_fixed(x):
+            return fixed_kernel(x)
+
+        @jax.jit
+        def padded_kernel(x):
+            return x + 1
+
+        def run_padded(x, n):
+            pow2 = 1 << max(n - 1, 0).bit_length()
+            return padded_kernel(jnp.zeros(pow2))
+    """})
+    data = mf.build_manifest(build_context(pkg))
+    by_target = {e["target"]: e for e in data["entries"]}
+    assert by_target["fixed_kernel"]["buckets"]["policy"] == "fixed"
+    assert by_target["padded_kernel"]["buckets"]["policy"] == "pow2"
+
+
+def test_engine_memo_invalidated_by_cross_module_edit(tmp_path):
+    """Editing module B must invalidate module A's cached lattice — the
+    lattices embed resolved cross-module call edges."""
+    files = {
+        "api/http_api.py": """
+            import jax.numpy as jnp
+
+            from pkg.chain.helpers import fetchy
+
+            class Api:
+                def bad(self, values):
+                    with self._lock:
+                        return fetchy(values)
+        """,
+        "chain/helpers.py": """
+            import jax.numpy as jnp
+
+            def fetchy(values):
+                return len(values)
+        """,
+    }
+    pkg, _ = make_pkg(tmp_path, files)
+    assert analyze(pkg) == []
+    bad = pkg / "chain" / "helpers.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def fetchy(values):
+            arr = jnp.asarray(values)
+            return arr.item()
+    """))
+    os.utime(bad, (os.path.getmtime(bad) + 2,) * 2)
+    # api/http_api.py itself is untouched — a stale per-file memo would
+    # keep its lock body's old resolved-edge view and miss this
+    assert rules_of(analyze(pkg)) == ["LH811"]

@@ -50,7 +50,42 @@ LH701       unbatched-store-write  raw ``hot.put``/``cold.put``/``delete``
                                    single-key commit-point allowlist —
                                    related mutations must batch through
                                    ``do_atomically`` (crash consistency)
+LH602       breaker-hooks          a backend-ladder driver (or any
+                                   function in a ladder module that
+                                   recovers a device fault) missing its
+                                   breaker fault hook in the handler or
+                                   ok hook on the success path
+LH801       int64-outside-x64      int64 jnp lane created / int64-lane
+                                   program dispatched outside a scoped
+                                   ``with enable_x64():`` (silent int32
+                                   truncation)
+LH802       float-on-lanes         true division / float cast reaching
+                                   gwei/epoch/index-domain device values
+                                   (spec math is exact integers)
+LH803       unclamped-uint64       uint64-domain value cast into int64
+                                   lanes or device arrays without the
+                                   EPOCH_CLAMP / build_tables-None
+                                   guard discipline
+LH811       blocking-fetch-        lattice-confirmed device->host
+            escalation             materialization under ANY lock
+                                   package-wide (unlimited call depth)
+                                   or on the dispatch thread
+LH901       swallowed-exception    broad ``except: pass`` — the error
+                                   vanishes unrouted; funnel through
+                                   ``record_swallowed`` or waive
+LH902       unaccounted-swallow    broad handler in the offload modules
+                                   that handles a fault but never
+                                   records/raises/logs it
 ==========  =====================  =========================================
+
+The v2 passes (LH602, LH80x, LH81x, LH90x) share the interprocedural
+dataflow engine in ``tools/lint/dataflow.py``: a per-function
+abstract-value lattice (traced-vs-host, dtype domain, device-array-ness,
+exception-handler reachability) over the PR 3 call graph, with
+per-module lattices memoized by file mtime.  The same lattice emits
+``tools/lint/shape_manifest.json`` (``python -m tools.lint
+--manifest``) — the enumerated jit bucket set that ROADMAP item 5's
+AOT program store prewarms from.
 
 Suppression: a ``# lhlint: allow(<rule-id-or-name>[, ...])`` comment on
 the flagged line (or, for under-lock findings, on the ``with`` line)
@@ -117,7 +152,8 @@ def line_allows(line_text: str, rule: str, name: str) -> bool:
 
 
 class Context:
-    """Shared pass inputs: parsed modules, call graph, doc locations."""
+    """Shared pass inputs: parsed modules, call graph, doc locations,
+    and (built lazily on first use) the dataflow engine."""
 
     def __init__(self, pkg_root: pathlib.Path, modules: list[Module],
                  readme: pathlib.Path | None):
@@ -128,6 +164,18 @@ class Context:
         self.by_pkg_rel = {m.pkg_rel: m for m in modules}
         self.readme = readme
         self.graph = CallGraph(modules)
+        self.parse_errors: list[Finding] = []
+        self._engine = None
+
+    @property
+    def engine(self):
+        """The shared interprocedural dataflow engine (lazy: passes that
+        never query it cost nothing)."""
+        if self._engine is None:
+            from tools.lint.dataflow import Engine
+
+            self._engine = Engine(self)
+        return self._engine
 
     def suppressed(self, module: Module, rule: str, name: str,
                    *linenos: int) -> bool:
@@ -164,15 +212,29 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     """Run every pass over the package rooted at ``pkg_root``; returns
     suppression-filtered findings (baseline NOT applied — that's the
     CLI/baseline layer's job)."""
-    from tools.lint import (envpass, fetch, locks, metrics_pass, shapes,
-                            store_pass, supervisor_pass)
+    from tools.lint import (blocking_pass, envpass, exceptions_pass,
+                            fetch, locks, metrics_pass, numeric_pass,
+                            shapes, store_pass, supervisor_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
     ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
                      metrics_pass.run, supervisor_pass.run,
-                     store_pass.run):
+                     store_pass.run, numeric_pass.run, blocking_pass.run,
+                     exceptions_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings
+
+
+def build_context(pkg_root, readme=None) -> "Context":
+    """Parsed package + engine without running the passes (the manifest
+    builder and tests use this).  Parse failures are surfaced on
+    ``ctx.parse_errors`` — a manifest built over a tree with unparseable
+    modules is missing their jit sites and must not pass silently."""
+    modules, errors = load_package(pathlib.Path(pkg_root))
+    readme = pathlib.Path(readme) if readme is not None else None
+    ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
+    ctx.parse_errors = errors
+    return ctx

@@ -1,0 +1,136 @@
+"""Pass 9 — blocking-fetch escalation (LH811).
+
+LH201 polices fetch *primitives by name* in the three BLS pipeline
+modules; LH101 polices blocking *names* under the three known lock
+owners within 3 call hops.  Both are blind to the general case PR 6
+opened up: any module can now hold a device array (epoch columns,
+shuffle lanes, sha256 folds), and a ``.item()`` / ``np.asarray`` /
+``int()`` on one is a synchronous device round-trip wherever it runs.
+
+LH811 uses the dataflow lattice (the materialized value must be
+*positively classified* as a device array — no name guessing) and
+flags a device→host materialization in either escalated context:
+
+- **under a lock, package-wide**: inside a ``with <lock>:`` body in ANY
+  module, or in a function reachable from such a body through the
+  package call graph at unlimited depth.  LH101 stays authoritative for
+  its own scope — lock bodies in its three owner modules up to 3 hops —
+  so there LH811 reports only the strictly-deeper reachability LH101
+  cannot see (one defect must never need two waivers);
+- **on the dispatch thread**: in a function reachable from the beacon
+  processor's dedicated dispatch functions (``_dispatch*``).  That
+  thread exists precisely so device waits never serialize batch
+  hand-off; one stray ``.item()`` there stalls every queued batch.
+
+The designated commit points (``tools.lint.fetch.ALLOWED_FUNCTIONS``
+plus the per-program d2h commits below) are exempt — their JOB is the
+one fetch per batch.
+"""
+
+from __future__ import annotations
+
+from tools.lint import Context, Finding
+from tools.lint.fetch import ALLOWED_FUNCTIONS
+from tools.lint.locks import TARGET_MODULES as LOCK_OWNER_MODULES
+from tools.lint.locks import _direct_calls, _with_lock_blocks
+
+#: single-d2h commit points of the non-BLS device programs: each pays
+#: exactly ONE fetch per dispatched batch (by module doc/comment), and
+#: the epoch/shuffle/merkle work legitimately runs under the import
+#: commit because the state transition is serialized there
+COMMIT_POINTS = ALLOWED_FUNCTIONS | {
+    "shuffle_rounds_device",   # ops/epoch_kernels: shuffle program fetch
+    "epoch_pass_device",       # ops/epoch_kernels: epoch-pass column fetch
+    "sha256_msgs",             # ops/sha256: batched single-block sweep
+    "fold_levels",             # ops/sha256: merkle fold readback
+    "_hash_level",             # ops/sha256: per-level device hash commit
+}
+
+#: the dispatch-thread entry points: functions whose qualname's terminal
+#: component starts with one of these, in the processor module
+DISPATCH_THREAD_MODULE = "processor/beacon_processor.py"
+DISPATCH_THREAD_PREFIX = "_dispatch"
+
+
+def run(ctx: Context) -> list[Finding]:
+    engine = ctx.engine
+    findings: list[Finding] = []
+    emitted: set[tuple] = set()
+
+    def emit(module, lat, site, context_desc):
+        if lat.qualname.rsplit(".", 1)[-1] in COMMIT_POINTS:
+            return
+        dedup = (module.pkg_rel, lat.qualname, site.line)
+        if dedup in emitted:
+            return
+        emitted.add(dedup)
+        if ctx.suppressed(module, "LH811", "blocking-fetch-escalation",
+                          site.line):
+            return
+        findings.append(Finding(
+            "LH811", "blocking-fetch-escalation", module.rel, site.line,
+            f"{lat.qualname}:{site.kind}",
+            f"device->host materialization `{site.kind}({site.detail})` "
+            f"{context_desc} — move the fetch outside, or route through "
+            f"a designated commit point"))
+
+    # -- context (a): with-lock bodies package-wide -----------------------
+    for module in ctx.modules:
+        blocks = _with_lock_blocks(module)
+        if not blocks:
+            continue
+        ml = engine.modules.get(module.pkg_rel)
+        if ml is None:
+            continue
+        own_lock_module = module.pkg_rel in LOCK_OWNER_MODULES
+        for with_node, lock_text, qual in blocks:
+            lat = ml.function(qual) or ml.function("<module>")
+            if lat is None:
+                continue
+            body_lines = {c.lineno for c in _direct_calls(with_node.body)}
+            if not own_lock_module:
+                # direct device fetches lexically inside the body
+                for site in lat.fetch_sites:
+                    if site.line in body_lines and site.av.device:
+                        emit(module, lat, site,
+                             f"inside `with {lock_text}:`")
+            # deep reachability: functions the body calls, any depth
+            info = ctx.graph.functions.get(f"{module.pkg_rel}::{qual}")
+            if info is None:
+                continue
+            roots = [s.resolved for s in info.calls
+                     if s.resolved and s.line in body_lines]
+            reach = engine.reachable_from(roots)
+            if own_lock_module:
+                # LH101 already polices <=3 hops here — only the
+                # strictly-deeper tail is LH811's to report
+                reach = reach - engine.reachable_from(roots, max_depth=3)
+            for key in sorted(reach):
+                reached = engine.function(key)
+                if reached is None or reached.key == lat.key:
+                    continue
+                rmodule = reached.module
+                for site in reached.fetch_sites:
+                    if site.av.device:
+                        emit(rmodule, reached, site,
+                             f"reachable under `with {lock_text}:` "
+                             f"({module.rel}:{with_node.lineno})")
+
+    # -- context (b): the dispatch thread ---------------------------------
+    ml = engine.modules.get(DISPATCH_THREAD_MODULE)
+    if ml is not None:
+        roots = [lat.key for qual, lat in ml.functions.items()
+                 if qual.rsplit(".", 1)[-1].startswith(
+                     DISPATCH_THREAD_PREFIX)]
+        for key in sorted(engine.reachable_from(roots)):
+            lat = engine.function(key)
+            if lat is None:
+                continue
+            for site in lat.fetch_sites:
+                if site.av.device:
+                    emit(lat.module, lat, site,
+                         "on the dispatch thread (reachable from the "
+                         "beacon processor's _dispatch* loop)")
+
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings

@@ -1,24 +1,48 @@
 """``python -m tools.lint`` — run the analyzer, apply the baseline.
 
-Exit status: 0 when no NEW findings (stale baseline entries only warn),
-1 on any regression.  ``--update-baseline`` rewrites baseline.json from
-the current tree (use after consciously fixing or accepting findings —
-the tier-1 test asserts the file never grows).
+Exit status (documented contract, asserted by tests/test_lint.py):
+
+====  =====================================================
+code  meaning
+====  =====================================================
+0     no NEW findings (stale baseline entries only warn);
+      also: ``--update-baseline`` / ``--manifest`` succeeded
+1     at least one finding beyond the baseline allowance
+      (or, with ``--no-baseline``, any finding at all)
+2     usage error (argparse)
+====  =====================================================
+
+``--update-baseline`` rewrites baseline.json from the current tree (use
+after consciously fixing or accepting findings — the tier-1 test
+asserts the file never grows).  ``--manifest`` regenerates
+``tools/lint/shape_manifest.json`` from the tree (the tier-1 sync gate
+asserts the checked-in copy matches).  ``--json`` renders findings as a
+JSON array on stdout for tooling (each: rule, name, file, line, symbol,
+message, new).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import sys
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 
 
+def _findings_json(findings, new_keys: set[str]) -> str:
+    return json.dumps([
+        {"rule": f.rule, "name": f.name, "file": f.file, "line": f.line,
+         "symbol": f.symbol, "message": f.message,
+         "new": id(f) in new_keys}
+        for f in findings], indent=1)
+
+
 def main(argv: list[str] | None = None) -> int:
     if str(_REPO) not in sys.path:  # direct script invocation
         sys.path.insert(0, str(_REPO))
-    from tools.lint import analyze
+    from tools.lint import analyze, build_context
     from tools.lint import baseline as bl
 
     parser = argparse.ArgumentParser(
@@ -38,7 +62,33 @@ def main(argv: list[str] | None = None) -> int:
                         help="rewrite baseline.json from the current tree")
     parser.add_argument("--no-baseline", action="store_true",
                         help="report every finding, baseline ignored")
+    parser.add_argument("--manifest", action="store_true",
+                        help="regenerate the jit shape manifest "
+                             "(tools/lint/shape_manifest.json) and exit")
+    parser.add_argument("--manifest-path", type=pathlib.Path, default=None,
+                        help="write the manifest here instead of the "
+                             "checked-in location")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="render findings as JSON on stdout")
     args = parser.parse_args(argv)
+
+    if args.manifest:
+        from tools.lint import manifest as mf
+
+        ctx = build_context(args.root, readme=args.readme)
+        if ctx.parse_errors:
+            for f in ctx.parse_errors:
+                print(f"lhlint: {f.render()}", file=sys.stderr)
+            print("lhlint: refusing to write a manifest over unparseable "
+                  "modules (their jit sites would be silently missing)",
+                  file=sys.stderr)
+            return 1
+        data = mf.build_manifest(ctx)
+        path = mf.write(data, args.manifest_path)
+        print(f"lhlint: shape manifest — {len(data['entries'])} jit "
+              f"entr{'y' if len(data['entries']) == 1 else 'ies'} at "
+              f"{path}")
+        return 0
 
     findings = analyze(args.root, readme=args.readme)
 
@@ -49,12 +99,17 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.no_baseline:
-        for f in findings:
-            print(f.render(), file=sys.stderr)
-        print(f"lhlint: {len(findings)} finding(s), baseline ignored")
+        if args.as_json:
+            print(_findings_json(findings, {id(f) for f in findings}))
+        else:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            print(f"lhlint: {len(findings)} finding(s), baseline ignored")
         return 1 if findings else 0
 
     new, stale = bl.compare(findings, bl.load(args.baseline))
+    if args.as_json:
+        print(_findings_json(findings, {id(f) for f in new}))
     for f in new:
         print(f"lhlint: NEW {f.render()}", file=sys.stderr)
     for key, unused in stale.items():
@@ -65,8 +120,10 @@ def main(argv: list[str] | None = None) -> int:
               f"({len(findings)} total, "
               f"{len(findings) - len(new)} baselined)", file=sys.stderr)
         return 1
-    print(f"lhlint: ok ({len(findings)} baselined finding(s), "
-          f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'})")
+    if not args.as_json:
+        print(f"lhlint: ok ({len(findings)} baselined finding(s), "
+              f"{len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'})")
     return 0
 
 

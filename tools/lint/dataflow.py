@@ -1,0 +1,1026 @@
+"""Interprocedural dataflow engine shared by the v2 lhlint passes.
+
+PR 3's passes were independent AST walks; the PR 6 conventions they
+must now enforce (int64 lanes only under scoped ``enable_x64``,
+uint64-domain columns clamped before they reach device lanes, device
+materialization kept out of lock scopes, swallowed exceptions funneled
+through ``record_swallowed``) are *value* properties, not syntax
+properties.  This module computes, per function, an abstract-value
+lattice the passes can query:
+
+- **traced-vs-host**: which functions are jit targets (decorated
+  ``@jax.jit`` / ``@partial(jax.jit, ...)`` or referenced as the
+  argument of a ``jax.jit(...)`` construction) and which functions are
+  transitively traced from them through the package call graph;
+- **dtype domain**: abstract dtype tags (``int64``/``uint32``/
+  ``uint64``/``float``) from explicit casts plus semantic tags
+  (``gwei``/``epoch``/``index``/``hash``) seeded from identifier
+  names — the epoch/balance columns are uint64 in spec world and must
+  be clamped (``EPOCH_CLAMP``-style) into int64 lanes;
+- **device-array-ness**: values produced by ``jnp.*`` (or flowing out
+  of jitted callables) are device arrays; ``np.asarray``/``int()``/
+  ``.item()``/``jax.device_get`` on one is a host materialization and
+  is recorded as a *fetch site*;
+- **exception-handler reachability**: every ``except`` handler with its
+  breadth (bare/``Exception``/``BaseException``), body shape (only
+  ``pass``?), raises, and the terminal names of the calls its body
+  makes — the LH90x and LH602 inputs.
+
+The analysis is a single forward walk per function (assignments update
+a name→value environment; loops are walked once; branches accumulate
+without a merge).  That is deliberately *unsound but conservative in
+the direction lint needs*: a value the walk cannot classify stays
+unknown, and every pass built on the engine only fires on positively
+classified values — a missed classification can only miss a finding,
+never invent one.
+
+Cross-function reasoning is restricted to what the passes actually
+need and what keeps a module's lattice self-contained (and therefore
+cacheable):
+
+- *same-module return summaries* resolve the memoized-jit-wrapper
+  pattern (``fn = _epoch_pass_jit(); fn(cols)`` dispatches the cached
+  ``jax.jit(_fused_epoch_pass)``) with a recursion guard;
+- the *traced set* (jit targets plus transitive resolved callees) and
+  per-target ``int64-lane`` reach are computed package-wide on the
+  PR 3 call graph.
+
+Per-module lattices are memoized in-process keyed by (path, mtime) so
+repeated ``analyze()`` calls — the fixture-heavy test suite, editor
+integrations — re-analyze only files that changed; a full-tree cold
+run stays well under the 10 s CI budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field, replace
+
+from tools.lint.callgraph import dotted_name
+
+# -- abstract values ----------------------------------------------------------
+
+#: dtype tags (from explicit casts/constructors)
+DTYPES = ("int64", "uint32", "uint64", "float")
+#: semantic tags (seeded from identifier names): the spec's uint64
+#: quantities that must ride int64 device lanes, and the uint32 hash lanes
+_SEMANTIC_SEEDS = (
+    ("balance", "gwei"), ("gwei", "gwei"), ("reward", "gwei"),
+    ("penalt", "gwei"), ("slash", "gwei"),
+    ("epoch", "epoch"), ("withdrawable", "epoch"), ("activation", "epoch"),
+    ("index", "index"), ("indices", "index"),
+    ("digest", "hash"), ("hash", "hash"),
+)
+
+_EMPTY: frozenset = frozenset()
+
+
+@dataclass(frozen=True)
+class AV:
+    """One abstract value: device-array-ness, traced-ness, dtype domain,
+    and (for callables) the jit target it dispatches."""
+
+    device: bool = False
+    traced: bool = False
+    domain: frozenset = _EMPTY
+    jitted: bool = False        # value IS a jitted callable
+    jit_of: str | None = None   # local qualname of the traced function
+
+    def join(self, other: "AV") -> "AV":
+        return AV(self.device or other.device,
+                  self.traced or other.traced,
+                  self.domain | other.domain,
+                  self.jitted or other.jitted,
+                  self.jit_of or other.jit_of)
+
+
+TOP = AV()
+
+
+def _seed_domain(name: str) -> frozenset:
+    low = name.lower()
+    return frozenset(tag for frag, tag in _SEMANTIC_SEEDS if frag in low)
+
+
+# -- recorded facts -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Site:
+    """One recorded fact inside a function."""
+
+    line: int
+    kind: str            # e.g. "int64-lane", "astype-int64", "item"
+    detail: str          # rendered operand / dtype text
+    av: AV               # the abstract value involved
+    in_x64: bool         # lexically inside `with enable_x64():`
+    in_handler: bool     # lexically inside an except-handler body
+
+
+@dataclass
+class HandlerInfo:
+    """One ``except`` handler: the LH90x / LH602 unit of account."""
+
+    line: int              # the `except` line (allow-comment anchor)
+    try_line: int
+    qualname: str          # enclosing function ("<module>" at top level)
+    broad: bool            # bare / Exception / BaseException
+    bare: bool
+    binds: str | None      # `except Exception as e` name
+    only_pass: bool        # body is nothing but `pass`
+    has_raise: bool
+    call_terminals: set = field(default_factory=set)
+    try_call_terminals: set = field(default_factory=set)
+    try_resolved: list = field(default_factory=list)  # resolved keys in try body
+
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+@dataclass
+class FunctionLattice:
+    key: str
+    qualname: str
+    module: object
+    node: ast.AST
+    jit_decorated: bool = False
+    static_params: frozenset = _EMPTY
+    #: explicit jnp int64-lane creations: jnp.int64(x), .astype(jnp.int64),
+    #: dtype=jnp.int64 — with their lexical x64 flag
+    int64_sites: list = field(default_factory=list)
+    #: true divisions whose operands carry gwei/epoch/index/int64 domain
+    div_sites: list = field(default_factory=list)
+    #: uint64-domain values cast into int64 lanes / device conversion
+    uint64_sites: list = field(default_factory=list)
+    #: device→host materializations (.item(), np.asarray, int(), fetches)
+    fetch_sites: list = field(default_factory=list)
+    #: calls to values known to be jitted callables
+    dispatch_sites: list = field(default_factory=list)
+    handlers: list = field(default_factory=list)
+    #: names referenced anywhere (``EPOCH_CLAMP`` guard detection)
+    referenced_names: set = field(default_factory=set)
+    #: terminal names of calls made OUTSIDE except handlers (LH602
+    #: success-path hooks)
+    calls_outside_handlers: set = field(default_factory=set)
+    returns_av: AV = TOP
+    #: does the function return None under a *_CLAMP-guarded comparison
+    #: (the ``build_tables``-None overflow-guard pattern)?
+    guards_with_none: bool = False
+
+
+@dataclass
+class ModuleLattice:
+    pkg_rel: str
+    functions: dict = field(default_factory=dict)   # qualname -> FunctionLattice
+    #: local qualnames referenced as jax.jit targets, mapped to the
+    #: construction site line and static argument names/nums
+    jit_constructions: list = field(default_factory=list)
+
+    def function(self, qualname: str) -> FunctionLattice | None:
+        return self.functions.get(qualname)
+
+
+@dataclass(frozen=True)
+class JitConstruction:
+    """One ``jax.jit`` appearance: decorator, assignment or inline."""
+
+    line: int
+    qualname: str          # enclosing function ("<module>" at top level)
+    target: str | None     # dotted name of the traced callable, if visible
+    kind: str              # "decorator" | "assignment" | "memoized" | "inline"
+    static_argnums: tuple = ()
+    static_argnames: tuple = ()
+    in_x64: bool = False
+    memo_key: str | None = None   # `CACHE[key]` subscript text, if memoized
+    assigned: str | None = None   # `_fn = jax.jit(...)` variable name
+
+
+# -- per-module analysis ------------------------------------------------------
+
+_NP_ROOTS = {"np", "numpy"}
+_JNP_ROOTS = {"jnp", "jax.numpy"}
+_DTYPE_BY_NAME = {"int64": "int64", "uint32": "uint32", "uint64": "uint64",
+                  "float32": "float", "float64": "float", "float16": "float"}
+_FETCH_CALLS = {"jax.device_get"}
+_FETCH_METHODS = {"item", "block_until_ready"}
+
+
+def _dtype_of(expr: ast.expr) -> tuple[str | None, bool]:
+    """(dtype tag, is-jnp) for expressions like jnp.int64 / np.uint64."""
+    text = dotted_name(expr)
+    if not text or "." not in text:
+        return None, False
+    root, leaf = text.rsplit(".", 1)
+    tag = _DTYPE_BY_NAME.get(leaf)
+    if tag is None:
+        return None, False
+    return tag, root in _JNP_ROOTS
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on py>=3.9
+        return "<expr>"
+
+
+class _FunctionAnalyzer:
+    """One forward walk over a function (or module) body."""
+
+    def __init__(self, lattice: FunctionLattice, graph_info):
+        self.lat = lattice
+        self.env: dict[str, AV] = {}
+        self.x64 = 0
+        self.handler_depth = 0
+        self.graph_info = graph_info   # FunctionInfo with resolved calls
+        self._resolved_by_node = {}
+        if graph_info is not None:
+            self._resolved_by_node = {id(s.node): s.resolved
+                                      for s in graph_info.calls if s.node}
+        self.same_module_summary = None    # set by the module analyzer
+        self.jit_decorated_quals = None    # set by the module analyzer
+
+    # -- expression evaluation -------------------------------------------
+
+    def ev(self, expr: ast.expr) -> AV:
+        if expr is None:
+            return TOP
+        if isinstance(expr, ast.Name):
+            self.lat.referenced_names.add(expr.id)
+            got = self.env.get(expr.id)
+            if got is not None:
+                return got
+            return AV(domain=_seed_domain(expr.id))
+        if isinstance(expr, ast.Attribute):
+            base = self.ev(expr.value)
+            return AV(base.device, base.traced,
+                      base.domain | _seed_domain(expr.attr))
+        if isinstance(expr, ast.Call):
+            return self._ev_call(expr)
+        if isinstance(expr, ast.BinOp):
+            return self._ev_binop(expr)
+        if isinstance(expr, ast.Subscript):
+            av = self.ev(expr.value)
+            self.ev(expr.slice)
+            return replace(av, jitted=False, jit_of=None)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = TOP
+            for elt in expr.elts:
+                out = out.join(self.ev(elt))
+            return out
+        if isinstance(expr, ast.IfExp):
+            self.ev(expr.test)
+            return self.ev(expr.body).join(self.ev(expr.orelse))
+        if isinstance(expr, ast.BoolOp):
+            out = TOP
+            for v in expr.values:
+                out = out.join(self.ev(v))
+            return out
+        if isinstance(expr, ast.Compare):
+            self.ev(expr.left)
+            for c in expr.comparators:
+                self.ev(c)
+            return TOP
+        if isinstance(expr, ast.UnaryOp):
+            return self.ev(expr.operand)
+        if isinstance(expr, ast.Starred):
+            return self.ev(expr.value)
+        if isinstance(expr, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                             ast.DictComp)):
+            for gen in expr.generators:
+                self.ev(gen.iter)
+            if isinstance(expr, ast.DictComp):
+                self.ev(expr.key)
+                self.ev(expr.value)
+            else:
+                self.ev(expr.elt)
+            return TOP
+        return TOP
+
+    def _record(self, bucket: list, line: int, kind: str, detail: str,
+                av: AV) -> None:
+        bucket.append(Site(line, kind, detail, av, self.x64 > 0,
+                           self.handler_depth > 0))
+
+    def _ev_call(self, call: ast.Call) -> AV:
+        dotted = dotted_name(call.func)
+        args = [self.ev(a) for a in call.args]
+        kw_avs = {kw.arg: self.ev(kw.value) for kw in call.keywords}
+        arg_join = TOP
+        for a in args:
+            arg_join = arg_join.join(a)
+
+        # jax.jit(...) construction (incl. jax.jit(partial(f, ...)))
+        if dotted in ("jax.jit", "jit"):
+            target = None
+            if call.args:
+                target = dotted_name(call.args[0])
+                if target is None and isinstance(call.args[0], ast.Call):
+                    inner = call.args[0]
+                    if dotted_name(inner.func) in ("partial",
+                                                   "functools.partial") \
+                            and inner.args:
+                        target = dotted_name(inner.args[0])
+            return AV(jitted=True, jit_of=target)
+        if dotted in ("partial", "functools.partial") and call.args:
+            if dotted_name(call.args[0]) in ("jax.jit", "jit"):
+                target = dotted_name(call.args[1]) if len(call.args) > 1 \
+                    else None
+                return AV(jitted=True, jit_of=target)
+            if args and args[0].jitted:
+                return args[0]
+
+        # dispatch of a known jitted callable:  fn(...)
+        fn_av = None
+        if isinstance(call.func, ast.Name):
+            fn_av = self.env.get(call.func.id)
+        if fn_av is not None and fn_av.jitted:
+            self._record(self.lat.dispatch_sites, call.lineno, "dispatch",
+                         fn_av.jit_of or _unparse(call.func), fn_av)
+            return AV(device=True, domain=arg_join.domain)
+
+        if dotted:
+            root = dotted.split(".", 1)[0]
+            leaf = dotted.rsplit(".", 1)[-1]
+
+            # dtype constructors: jnp.int64(x), np.uint64(x) ...
+            tag, is_jnp = _dtype_of(call.func)
+            if tag is not None:
+                av = AV(device=is_jnp or arg_join.device,
+                        traced=arg_join.traced,
+                        domain=(arg_join.domain - set(DTYPES))
+                        | {tag})
+                if tag == "int64" and is_jnp:
+                    self._record(self.lat.int64_sites, call.lineno,
+                                 "int64-lane", dotted, av)
+                return av
+
+            # .astype(T)
+            if leaf == "astype" and isinstance(call.func, ast.Attribute):
+                recv = self.ev(call.func.value)
+                tgt = call.args[0] if call.args else None
+                tag, is_jnp = _dtype_of(tgt) if tgt is not None \
+                    else (None, False)
+                out = AV(recv.device or is_jnp, recv.traced,
+                         (recv.domain - set(DTYPES))
+                         | ({tag} if tag else set()))
+                if tag == "int64" and is_jnp:
+                    self._record(self.lat.int64_sites, call.lineno,
+                                 "astype-int64", _unparse(call.func), out)
+                if tag == "int64" and "uint64" in recv.domain \
+                        and "guarded" not in recv.domain:
+                    self._record(self.lat.uint64_sites, call.lineno,
+                                 "astype-int64",
+                                 _unparse(call.func.value), recv)
+                return out
+
+            # clamp/guard helpers launder uint64 into the guarded int64 world
+            if "clamp" in leaf.lower() or "guard" in leaf.lower():
+                return AV(arg_join.device, arg_join.traced,
+                          (arg_join.domain - {"uint64"})
+                          | {"guarded", "int64"})
+
+            # jnp producers: device arrays; honor dtype= kwargs
+            if root in _JNP_ROOTS or dotted.startswith("jax.numpy."):
+                dom = set(arg_join.domain)
+                dt = call_dtype_kwarg(call)
+                if dt:
+                    dtag, _ = _dtype_of(dt)
+                    if dtag:
+                        dom = (dom - set(DTYPES)) | {dtag}
+                        if dtag == "int64":
+                            self._record(self.lat.int64_sites, call.lineno,
+                                         "dtype-int64", dotted,
+                                         AV(True, domain=frozenset(dom)))
+                av = AV(device=True, traced=arg_join.traced,
+                        domain=frozenset(dom))
+                if leaf in ("asarray", "array", "device_put") \
+                        and "uint64" in arg_join.domain \
+                        and "guarded" not in arg_join.domain:
+                    self._record(self.lat.uint64_sites, call.lineno,
+                                 "device-conversion", dotted, arg_join)
+                return av
+
+            # explicit host->device placement
+            if dotted in ("jax.device_put", "device_put"):
+                if "uint64" in arg_join.domain \
+                        and "guarded" not in arg_join.domain:
+                    self._record(self.lat.uint64_sites, call.lineno,
+                                 "device-conversion", dotted, arg_join)
+                return replace(arg_join, device=True)
+
+            # fetches / host materialization
+            if dotted in _FETCH_CALLS:
+                self._record(self.lat.fetch_sites, call.lineno,
+                             "device_get", dotted, arg_join)
+                return replace(arg_join, device=False)
+            if leaf in _FETCH_METHODS and isinstance(call.func,
+                                                     ast.Attribute):
+                recv = self.ev(call.func.value)
+                if recv.device or recv.traced:
+                    self._record(self.lat.fetch_sites, call.lineno, leaf,
+                                 _unparse(call.func.value), recv)
+                return replace(recv, device=leaf != "block_until_ready")
+            if (root in _NP_ROOTS and leaf == "asarray") and args:
+                if args[0].device:
+                    self._record(self.lat.fetch_sites, call.lineno,
+                                 "np.asarray", _unparse(call.args[0]),
+                                 args[0])
+                return replace(args[0], device=False)
+            if dotted in ("int", "float") and len(call.args) == 1:
+                if args[0].device:
+                    self._record(self.lat.fetch_sites, call.lineno, dotted,
+                                 _unparse(call.args[0]), args[0])
+                dom = {"float"} if dotted == "float" else set()
+                return AV(domain=frozenset(dom))
+            if root in _NP_ROOTS:
+                # host numpy: value domain flows through
+                return AV(device=False, traced=arg_join.traced,
+                          domain=arg_join.domain)
+
+        # same-module resolved call: a direct dispatch of a decorated
+        # jit target, or the memoized-jit-wrapper's return summary
+        resolved = self._resolved_by_node.get(id(call))
+        if resolved:
+            if self.jit_decorated_quals is not None:
+                pkg_rel, _, qual = resolved.partition("::")
+                if pkg_rel == self.lat.module.pkg_rel \
+                        and qual in self.jit_decorated_quals:
+                    self._record(self.lat.dispatch_sites, call.lineno,
+                                 "dispatch", qual,
+                                 AV(jitted=True, jit_of=qual))
+                    return AV(device=True, domain=arg_join.domain)
+            if self.same_module_summary is not None:
+                summary = self.same_module_summary(resolved)
+                if summary is not None:
+                    return summary
+        return AV(domain=arg_join.domain & {"guarded"})
+
+    def _ev_binop(self, binop: ast.BinOp) -> AV:
+        left, right = self.ev(binop.left), self.ev(binop.right)
+        out = left.join(right)
+        if isinstance(binop.op, ast.Div):
+            lanes = (out.domain & {"int64", "gwei", "epoch", "index"})
+            if lanes and (out.device or out.traced):
+                self._record(self.lat.div_sites, binop.lineno,
+                             "true-division", _unparse(binop), out)
+            out = replace(out, domain=out.domain | {"float"})
+        return out
+
+    # -- statement walk ---------------------------------------------------
+
+    def run(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.exec_stmt(stmt)
+
+    def exec_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are their own lattice entries
+        if isinstance(stmt, ast.Assign):
+            av = self.ev(stmt.value)
+            for tgt in stmt.targets:
+                self._assign(tgt, av)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self.ev(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            av = self.ev(stmt.value).join(self.ev(stmt.target))
+            self._assign(stmt.target, av)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.lat.returns_av = self.lat.returns_av.join(
+                    self.ev(stmt.value))
+                if (isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is None):
+                    self._note_none_return()
+            else:
+                self._note_none_return()
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            is_x64 = any(self._is_x64_ctx(item.context_expr)
+                         for item in stmt.items)
+            for item in stmt.items:
+                av = self.ev(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, av)
+            if is_x64:
+                self.x64 += 1
+            self.run(stmt.body)
+            if is_x64:
+                self.x64 -= 1
+            return
+        if isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            try_terminals = _call_terminals(stmt.body)
+            try_resolved = [self._resolved_by_node.get(id(c))
+                            for c in _calls_in(stmt.body)]
+            try_resolved = [r for r in try_resolved if r]
+            for handler in stmt.handlers:
+                info = self._handler_info(stmt, handler)
+                info.try_call_terminals = try_terminals
+                info.try_resolved = try_resolved
+                self.lat.handlers.append(info)
+                self.handler_depth += 1
+                self.run(handler.body)
+                self.handler_depth -= 1
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.If):
+            self._note_clamp_guard(stmt)
+            self.ev(stmt.test)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._assign(stmt.target, self.ev(stmt.iter))
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self.ev(stmt.test)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Expr):
+            av = self.ev(stmt.value)
+            del av
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            if isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    self.ev(stmt.exc)
+            else:
+                self.ev(stmt.test)
+            return
+        # everything else (Pass, Import, Global, Delete, ...) is inert
+
+    def _assign(self, target: ast.expr, av: AV) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = av
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, av)
+        # Subscript/Attribute targets: no tracked cell
+
+    def _is_x64_ctx(self, expr: ast.expr) -> bool:
+        text = dotted_name(expr)
+        if text is None and isinstance(expr, ast.Call):
+            text = dotted_name(expr.func)
+        return bool(text) and text.rsplit(".", 1)[-1] == "enable_x64"
+
+    def _handler_info(self, try_stmt: ast.Try,
+                      handler: ast.ExceptHandler) -> HandlerInfo:
+        names: list[str] = []
+        t = handler.type
+        if isinstance(t, ast.Name):
+            names = [t.id]
+        elif isinstance(t, ast.Tuple):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        broad = t is None or bool(set(names) & _BROAD_NAMES)
+        has_raise = any(isinstance(n, ast.Raise)
+                        for n in ast.walk(handler))
+        only_pass = all(isinstance(s, ast.Pass) for s in handler.body)
+        return HandlerInfo(
+            line=handler.lineno, try_line=try_stmt.lineno,
+            qualname=self.lat.qualname, broad=broad, bare=t is None,
+            binds=handler.name, only_pass=only_pass, has_raise=has_raise,
+            call_terminals=_call_terminals(handler.body))
+
+    # ``build_tables``-None guard shape: ``if <cmp involving *_CLAMP or
+    # *overflow*>: return None`` — the epoch overflow guard that keeps
+    # unclampable states off the device path entirely.
+    def _note_clamp_guard(self, stmt: ast.If) -> None:
+        test_names = {n.id for n in ast.walk(stmt.test)
+                      if isinstance(n, ast.Name)}
+        test_attrs = {n.attr for n in ast.walk(stmt.test)
+                      if isinstance(n, ast.Attribute)}
+        mentions = {x.upper() for x in test_names | test_attrs}
+        if not any("CLAMP" in m or "OVERFLOW" in m for m in mentions):
+            return
+        for inner in stmt.body:
+            if (isinstance(inner, ast.Return)
+                    and (inner.value is None
+                         or (isinstance(inner.value, ast.Constant)
+                             and inner.value.value is None))):
+                self.lat.guards_with_none = True
+
+    def _note_none_return(self) -> None:
+        pass  # reserved: plain None returns carry no lattice info
+
+
+def _calls_in(body: list[ast.stmt]) -> list[ast.Call]:
+    out: list[ast.Call] = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                out.append(node)
+    return out
+
+
+def _call_terminals(body: list[ast.stmt]) -> set[str]:
+    terms: set[str] = set()
+    for call in _calls_in(body):
+        text = dotted_name(call.func)
+        if text:
+            terms.add(text.rsplit(".", 1)[-1])
+        elif isinstance(call.func, ast.Attribute):
+            # method on a computed receiver, e.g. ``_log().warn(...)`` —
+            # dotted_name gives up on the Call base but the terminal
+            # attribute is exactly what the exception pass matches on
+            terms.add(call.func.attr)
+    return terms
+
+
+# -- module + engine ----------------------------------------------------------
+
+#: (resolved path str, mtime_ns, tree fingerprint) -> ModuleLattice.
+#: In-process memo: the fixture-heavy test suite calls analyze() dozens
+#: of times over the same real tree; any edit anywhere invalidates the
+#: whole tree (lattices carry cross-module resolved edges).
+_MODULE_CACHE: dict[tuple[str, int, int], ModuleLattice] = {}
+
+
+def _jit_decoration(node) -> tuple[bool, frozenset]:
+    from tools.lint.shapes import _jit_decoration as impl
+
+    jitted, statics = impl(node)
+    return jitted, frozenset(statics)
+
+
+def call_dtype_kwarg(call: ast.Call) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+class Engine:
+    """Package-wide dataflow: per-module lattices + traced-set closure."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        # lattices embed cross-module facts (resolved call edges, return
+        # summaries), so the memo key must cover the whole tree state,
+        # not just the module's own file: any edit invalidates everything
+        # (re-analysis is ~seconds; staleness is a wrong LH602 verdict)
+        self._tree_key = hash(tuple(sorted(
+            (str(m.path), self._mtime_ns(m.path)) for m in ctx.modules)))
+        self.modules: dict[str, ModuleLattice] = {}
+        for m in ctx.modules:
+            self.modules[m.pkg_rel] = self._module_lattice(m)
+        self._traced: set[str] | None = None
+        self._int64_reach: dict[str, bool] = {}
+
+    @staticmethod
+    def _mtime_ns(path) -> int:
+        try:
+            return path.stat().st_mtime_ns
+        except OSError:
+            return -1
+
+    # -- construction -----------------------------------------------------
+
+    def _module_lattice(self, m) -> ModuleLattice:
+        try:
+            stat = m.path.stat()
+            cache_key = (str(m.path), stat.st_mtime_ns, self._tree_key)
+        except OSError:
+            cache_key = None
+        if cache_key is not None:
+            cached = _MODULE_CACHE.get(cache_key)
+            if cached is not None:
+                return cached
+        lattice = self._analyze_module(m)
+        if cache_key is not None:
+            _MODULE_CACHE[cache_key] = lattice
+        return lattice
+
+    def _analyze_module(self, m) -> ModuleLattice:
+        ml = ModuleLattice(m.pkg_rel)
+        summaries: dict[str, AV | None] = {}
+        in_flight: set[str] = set()
+        fn_nodes: dict[str, ast.AST] = {}
+
+        def collect(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    fn_nodes[qual] = child
+                    collect(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, stack + [child.name])
+                else:
+                    collect(child, stack)
+
+        collect(m.tree, [])
+        decorated = {qual for qual, node in fn_nodes.items()
+                     if _jit_decoration(node)[0]}
+
+        def summary(resolved_key: str) -> AV | None:
+            """Same-module return summary with a recursion guard."""
+            pkg_rel, _, qual = resolved_key.partition("::")
+            if pkg_rel != m.pkg_rel:
+                return None
+            if qual in summaries:
+                return summaries[qual]
+            if qual in in_flight:
+                return None
+            lat = analyze_one(qual)
+            summaries[qual] = lat.returns_av if lat is not None else None
+            return summaries[qual]
+
+        def analyze_one(qual: str) -> FunctionLattice | None:
+            done = ml.functions.get(qual)
+            if done is not None:
+                return done
+            node = fn_nodes.get(qual)
+            if node is None:
+                return None
+            in_flight.add(qual)
+            lat = self._analyze_function(m, qual, node, summary, decorated)
+            in_flight.discard(qual)
+            ml.functions[qual] = lat
+            return lat
+
+        for qual in fn_nodes:
+            analyze_one(qual)
+        # module-level statements get a pseudo-function lattice
+        mod_lat = FunctionLattice(f"{m.pkg_rel}::<module>", "<module>",
+                                  m, m.tree)
+        walker = _FunctionAnalyzer(mod_lat, None)
+        walker.same_module_summary = summary
+        walker.jit_decorated_quals = decorated
+        walker.run([s for s in m.tree.body])
+        ml.functions["<module>"] = mod_lat
+
+        ml.jit_constructions = self._collect_jit_constructions(m)
+        return ml
+
+    def _analyze_function(self, m, qual: str, node, summary,
+                          decorated: set) -> FunctionLattice:
+        jitted, statics = _jit_decoration(node)
+        lat = FunctionLattice(f"{m.pkg_rel}::{qual}", qual, m, node,
+                              jit_decorated=jitted, static_params=statics)
+        info = self.ctx.graph.functions.get(f"{m.pkg_rel}::{qual}")
+        walker = _FunctionAnalyzer(lat, info)
+        walker.same_module_summary = summary
+        walker.jit_decorated_quals = decorated
+        # traced params of jitted functions are device + traced
+        if jitted:
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                if a.arg in statics or a.arg == "self":
+                    continue
+                walker.env[a.arg] = AV(device=True, traced=True,
+                                       domain=_seed_domain(a.arg))
+        walker.run(node.body)
+        # calls outside handlers (LH602 success-path hooks)
+        lat.calls_outside_handlers = _calls_outside_handlers(node)
+        return lat
+
+    def _collect_jit_constructions(self, m) -> list[JitConstruction]:
+        out: list[JitConstruction] = []
+
+        def statics_of(call: ast.Call) -> tuple[tuple, tuple]:
+            from tools.lint.shapes import _const_ints, _const_strs
+
+            nums: tuple = ()
+            names: tuple = ()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    nums = tuple(_const_ints(kw.value))
+                elif kw.arg == "static_argnames":
+                    names = tuple(_const_strs(kw.value))
+            return nums, names
+
+        def jit_target_of(call: ast.Call) -> str | None:
+            if not call.args:
+                return None
+            tgt = dotted_name(call.args[0])
+            if tgt is None and isinstance(call.args[0], ast.Call):
+                inner = call.args[0]
+                if dotted_name(inner.func) in ("partial",
+                                               "functools.partial") \
+                        and inner.args:
+                    tgt = dotted_name(inner.args[0])
+                elif isinstance(call.args[0].func, ast.Name):
+                    tgt = call.args[0].func.id
+            if tgt is None and isinstance(call.args[0], ast.Lambda):
+                tgt = "<lambda>"
+            return tgt
+
+        def visit(node, stack, x64_depth):
+            for child in ast.iter_child_nodes(node):
+                child_stack = stack
+                child_x64 = x64_depth
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    jitted, _ = _jit_decoration(child)
+                    if jitted:
+                        nums: tuple = ()
+                        names: tuple = ()
+                        line = child.lineno
+                        for dec in child.decorator_list:
+                            text = dotted_name(dec) or (
+                                dotted_name(dec.func)
+                                if isinstance(dec, ast.Call) else None)
+                            inner = None
+                            if (isinstance(dec, ast.Call) and dec.args
+                                    and text in ("partial",
+                                                 "functools.partial")):
+                                inner = dotted_name(dec.args[0])
+                            if text in ("jax.jit", "jit") \
+                                    or inner in ("jax.jit", "jit"):
+                                line = dec.lineno
+                                if isinstance(dec, ast.Call):
+                                    nums, names = statics_of(dec)
+                        out.append(JitConstruction(
+                            line, qual, qual, "decorator",
+                            nums, names, x64_depth > 0))
+                    child_stack = stack + [child.name]
+                elif isinstance(child, ast.ClassDef):
+                    child_stack = stack + [child.name]
+                elif isinstance(child, (ast.With, ast.AsyncWith)):
+                    if any(_is_x64_item(i) for i in child.items):
+                        child_x64 = x64_depth + 1
+                elif isinstance(child, ast.Call) and \
+                        dotted_name(child.func) in ("jax.jit", "jit"):
+                    qual = ".".join(stack) or "<module>"
+                    kind = "inline"
+                    memo_key = None
+                    assigned = None
+                    parent = parents.get(id(child))
+                    if isinstance(parent, ast.Assign):
+                        kind = "assignment"
+                        for tgt in parent.targets:
+                            if isinstance(tgt, ast.Name):
+                                assigned = tgt.id
+                            if (isinstance(tgt, ast.Subscript)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and "CACHE" in tgt.value.id.upper()):
+                                kind = "memoized"
+                                memo_key = _unparse(tgt.slice)
+                    nums, names = statics_of(child)
+                    out.append(JitConstruction(
+                        child.lineno, qual, jit_target_of(child), kind,
+                        nums, names, x64_depth > 0, memo_key, assigned))
+                visit(child, child_stack, child_x64)
+
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        visit(m.tree, [], 0)
+        out.sort(key=lambda c: c.line)
+        return out
+
+    # -- queries -----------------------------------------------------------
+
+    def function(self, key: str) -> FunctionLattice | None:
+        pkg_rel, _, qual = key.partition("::")
+        ml = self.modules.get(pkg_rel)
+        return ml.function(qual) if ml else None
+
+    @property
+    def traced(self) -> set[str]:
+        """Function keys that are jit targets or transitively called by
+        one (their bodies run under trace, not as host Python)."""
+        if self._traced is None:
+            roots: list[str] = []
+            for pkg_rel, ml in self.modules.items():
+                for qual, lat in ml.functions.items():
+                    if lat.jit_decorated:
+                        roots.append(lat.key)
+                for con in ml.jit_constructions:
+                    if con.target and con.kind != "decorator":
+                        key = f"{pkg_rel}::{con.target}"
+                        if self.function(key) is not None:
+                            roots.append(key)
+            # BFS over resolved call edges AND nested defs together: a
+            # fori_loop body defined inside a kernel traces with it, and
+            # so does everything the body calls — expanding nested defs
+            # after the walk would leave their callees looking like host
+            # code (false LH801 positives)
+            seen: set[str] = set()
+            frontier = list(roots)
+            while frontier:
+                nxt: list[str] = []
+                for key in frontier:
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    info = self.ctx.graph.functions.get(key)
+                    if info is not None:
+                        nxt.extend(s.resolved for s in info.calls
+                                   if s.resolved)
+                    pkg_rel, _, qual = key.partition("::")
+                    ml = self.modules.get(pkg_rel)
+                    if ml is not None:
+                        prefix = qual + "."
+                        nxt.extend(f"{pkg_rel}::{q}"
+                                   for q in ml.functions
+                                   if q.startswith(prefix))
+                frontier = nxt
+            self._traced = seen
+        return self._traced
+
+    def target_has_int64_lanes(self, key: str, depth: int = 3) -> bool:
+        """Does the jit target (or a same-package callee within
+        ``depth`` hops) create explicit int64 lanes?"""
+        cached = self._int64_reach.get(key)
+        if cached is not None:
+            return cached
+        seen: set[str] = set()
+        frontier = [key]
+        found = False
+        for _ in range(depth + 1):
+            nxt: list[str] = []
+            for k in frontier:
+                if k in seen:
+                    continue
+                seen.add(k)
+                lat = self.function(k)
+                if lat is not None and lat.int64_sites:
+                    found = True
+                    break
+                info = self.ctx.graph.functions.get(k)
+                if info is not None:
+                    nxt.extend(s.resolved for s in info.calls if s.resolved)
+                # nested helpers (`def body(...)` inside the kernel)
+                pkg_rel, _, qual = k.partition("::")
+                ml = self.modules.get(pkg_rel)
+                if ml is not None:
+                    prefix = qual + "."
+                    nxt.extend(f"{pkg_rel}::{q}" for q in ml.functions
+                               if q.startswith(prefix))
+            if found:
+                break
+            frontier = nxt
+        self._int64_reach[key] = found
+        return found
+
+    def reachable_from(self, roots: list[str],
+                       max_depth: int = 64) -> set[str]:
+        """Function keys reachable from ``roots`` on resolved edges."""
+        seen = {r for r in roots if r in self.ctx.graph.functions}
+        frontier = list(seen)
+        depth = 0
+        while frontier and depth < max_depth:
+            nxt: list[str] = []
+            for key in frontier:
+                info = self.ctx.graph.functions.get(key)
+                if info is None:
+                    continue
+                for site in info.calls:
+                    if site.resolved and site.resolved not in seen:
+                        seen.add(site.resolved)
+                        nxt.append(site.resolved)
+            frontier = nxt
+            depth += 1
+        return seen
+
+
+def _calls_outside_handlers(fn_node) -> set[str]:
+    terms: set[str] = set()
+
+    def visit(node, in_handler):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.ExceptHandler):
+                visit(child, True)
+                continue
+            if isinstance(child, ast.Call) and not in_handler:
+                text = dotted_name(child.func)
+                if text:
+                    terms.add(text.rsplit(".", 1)[-1])
+                elif isinstance(child.func, ast.Attribute):
+                    # computed receiver (``self.breakers[name]
+                    # .record_success()``): keep the terminal attribute
+                    terms.add(child.func.attr)
+            visit(child, in_handler)
+
+    visit(fn_node, False)
+    return terms
+
+
+def _is_x64_item(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    text = dotted_name(expr)
+    if text is None and isinstance(expr, ast.Call):
+        text = dotted_name(expr.func)
+    return bool(text) and text.rsplit(".", 1)[-1] == "enable_x64"
+
+
+def clear_cache() -> None:
+    """Drop the per-module lattice memo (tests)."""
+    _MODULE_CACHE.clear()

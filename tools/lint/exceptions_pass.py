@@ -1,0 +1,99 @@
+"""Pass 10 — swallowed-exception discipline (LH901 / LH902).
+
+PR 4 established the funnel: a site that deliberately survives an
+internal error routes through ``common/metrics.record_swallowed`` —
+the error is counted under ``offload_swallowed_errors_total{site}`` and
+the first occurrence per site prints to stderr.  "Deliberately
+non-fatal" must never mean *invisible*: a bare ``except Exception:
+pass`` on the supervisor recovery path can mask a breaker transition
+(the fault count stays closed while the backend flaps), and on the
+import path it buries the first symptom of every corruption bug.
+
+- **LH901 swallowed-exception**: a broad handler (bare ``except``,
+  ``except Exception``, ``except BaseException``) whose body is nothing
+  but ``pass`` — the error vanishes with no routing at all.  Fix it:
+  funnel through ``record_swallowed(site, exc)``, narrow the exception
+  type to what the site actually expects, or carry an inline
+  ``# lhlint: allow(LH901)`` with a comment saying why the silence is
+  deliberate (the terminal metrics sink is the canonical waiver).
+- **LH902 unaccounted-swallow**: in the offload/supervisor modules
+  (``ops/``, ``crypto/``, ``parallel/``, ``processor/``,
+  ``state_transition/``), a broad handler that swallows with *some*
+  body (a fallback assignment, a default return) but never re-raises,
+  never records, and never logs.  Those modules sit on the recovery
+  paths where the health ladder's verdicts depend on faults being
+  counted; handled-but-unaccounted errors starve the breaker exactly
+  like LH901 does, they just look tidier.
+
+A handler is *accounted* when its body raises, or calls
+``record_swallowed`` / a ``record_*``/``_record*`` accounting hook /
+a breaker hook / a logging method / ``print`` (the one-shot stderr
+pattern predating the funnel).
+"""
+
+from __future__ import annotations
+
+from tools.lint import Context, Finding
+
+#: module prefixes where LH902 applies (the offload + recovery world)
+LH902_PREFIXES = ("ops/", "crypto/", "parallel/", "processor/",
+                  "state_transition/")
+
+_LOG_TERMINALS = {"debug", "info", "warning", "warn", "error", "exception",
+                  "critical", "log", "print"}
+_BREAKER_TERMINALS = {"record_failure", "record_success", "_breaker_fault",
+                      "_breaker_ok"}
+
+
+def _accounted(handler) -> bool:
+    if handler.has_raise:
+        return True
+    for term in handler.call_terminals:
+        if term == "record_swallowed":
+            return True
+        if term.startswith("record_") or term.startswith("_record"):
+            return True
+        if term in _LOG_TERMINALS or term in _BREAKER_TERMINALS:
+            return True
+    return False
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    engine = ctx.engine
+    for module in ctx.modules:
+        ml = engine.modules.get(module.pkg_rel)
+        if ml is None:
+            continue
+        in_902_scope = module.pkg_rel.startswith(LH902_PREFIXES)
+        for qual, lat in sorted(ml.functions.items()):
+            for handler in lat.handlers:
+                if not handler.broad:
+                    continue
+                kind = handler.bare and "bare except" or "except Exception"
+                if handler.only_pass:
+                    if ctx.suppressed(module, "LH901",
+                                      "swallowed-exception",
+                                      handler.line, handler.try_line):
+                        continue
+                    findings.append(Finding(
+                        "LH901", "swallowed-exception", module.rel,
+                        handler.line, f"{handler.qualname}:swallow",
+                        f"`{kind}: pass` in `{handler.qualname}` — the "
+                        f"error vanishes; funnel through "
+                        f"record_swallowed(site, exc), narrow the type, "
+                        f"or waive with `# lhlint: allow(LH901)`"))
+                elif in_902_scope and not _accounted(handler):
+                    if ctx.suppressed(module, "LH902",
+                                      "unaccounted-swallow",
+                                      handler.line, handler.try_line):
+                        continue
+                    findings.append(Finding(
+                        "LH902", "unaccounted-swallow", module.rel,
+                        handler.line, f"{handler.qualname}:unaccounted",
+                        f"broad `{kind}` in `{handler.qualname}` handles "
+                        f"the error but never records/raises/logs it — "
+                        f"on the offload path unaccounted faults starve "
+                        f"the breaker; add record_swallowed(site, exc) "
+                        f"next to the fallback"))
+    return findings

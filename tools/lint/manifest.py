@@ -1,0 +1,208 @@
+"""The jit shape manifest — ROADMAP item 5's input artifact.
+
+Every bench run pays ~100 s of warm-up because the AOT program store
+(the persistent compile cache that will kill it) needs the jit
+*bucket set* to be enumerable — and until now that set existed only as
+a comment in the LH301/302 shape-discipline rules.  This module walks
+the same dataflow lattice the v2 passes share and emits
+``tools/lint/shape_manifest.json``: one entry per ``jax.jit``
+construction in the package, with everything the AOT prewarmer needs
+to lower and persist the program ahead of time:
+
+- **where**: file, line, enclosing qualname, construction kind
+  (``decorator`` / ``assignment`` / ``memoized`` / ``inline``);
+- **what**: the traced target, its static argument names/nums (the
+  compile-cache key dimensions that are NOT shapes);
+- **dtype signature**: the explicit dtype tags the traced code (and its
+  same-module callees) uses — ``int64`` lanes mean the program must be
+  lowered under ``enable_x64``, recorded separately as
+  ``int64_lanes``/``x64_dispatch``;
+- **bucket discipline**: the memo-cache key expression for memoized
+  programs (``_SHUFFLE_JIT_CACHE[rounds]`` → one program per rounds
+  value), the pow2-vs-fixed shape policy, and the ``LHTPU_*`` env knobs
+  that parameterize the bucket floor/chunk size;
+- **owning backend**: which health-ladder backend the program belongs
+  to (the prewarmer warms rungs in ladder order).
+
+The checked-in file is synced by a tier-1 gate exactly like the README
+env table: ``lhlint --manifest`` regenerates it, and
+``tests/test_lint.py`` asserts the regenerated content matches the
+tree AND that every ``jax.jit`` text occurrence in the package is
+covered by an entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+
+MANIFEST_VERSION = 1
+
+#: module -> owning backend (the health-ladder rung or subsystem whose
+#: supervisor dispatches these programs).  Unlisted modules fall back to
+#: their package directory name.
+BACKEND_OWNERS = {
+    "ops/bls_backend.py": "bls.tpu",
+    "ops/dispatch_pipeline.py": "bls.tpu",
+    "parallel/bls_sharded.py": "bls.sharded",
+    "ops/fr.py": "bls.field",
+    "ops/ec.py": "bls.field",
+    "ops/bls12_381.py": "bls.field",
+    "ops/bigint.py": "bls.field",
+    "ops/sha256.py": "sha256",
+    "ops/epoch_kernels.py": "epoch",
+    "parallel/epoch_sharded.py": "epoch.sharded",
+    "state_transition/epoch_device.py": "epoch",
+    "crypto/kzg.py": "kzg",
+    "crypto/das.py": "das",
+    "parallel/dryrun_worker.py": "parallel.dryrun",
+}
+
+_DTYPE_LEAVES = {"int64", "int32", "uint64", "uint32", "uint8",
+                 "float32", "float64", "bool_"}
+_BUCKET_ENV_RE = re.compile(
+    r"LHTPU_[A-Z0-9_]*(?:BUCKET|CHUNK|FLOOR|MIN|SCALE)[A-Z0-9_]*")
+_POW2_HINT_RE = re.compile(r"pow2|bucket", re.IGNORECASE)
+
+
+def _dtypes_of_target(module, engine, target: str | None) -> list[str]:
+    """Explicit jnp/np dtype leaves mentioned by the traced target and
+    its same-module callees (one hop)."""
+    if not target or target == "<lambda>":
+        return []
+    node = _find_function(module.tree, target)
+    if node is None:
+        return []
+    nodes = [node]
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            callee = _find_function(module.tree, n.func.id)
+            if callee is not None:
+                nodes.append(callee)
+    seen: set[str] = set()
+    for fn_node in nodes:
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Attribute) and n.attr in _DTYPE_LEAVES:
+                seen.add("float" if n.attr.startswith("float") else n.attr)
+    return sorted(seen)
+
+
+def _find_function(tree, qualname: str):
+    parts = qualname.split(".")
+
+    def descend(node, remaining):
+        if not remaining:
+            return node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) \
+                    and child.name == remaining[0]:
+                got = descend(child, remaining[1:])
+                if got is not None:
+                    return got
+        return None
+
+    return descend(tree, parts)
+
+
+def _bucket_info(module, con) -> dict:
+    env = sorted(set(_BUCKET_ENV_RE.findall(module.source)))
+    # the pow2-vs-fixed policy is a fact about THIS construction, so the
+    # hint search is scoped to the traced target, the function holding
+    # the construction, and their direct same-module callers (shape
+    # padding lives in the caller: `_next_pow2`/`bucket_size` run host-
+    # side right before the dispatch) — a metrics `buckets=(...)` kwarg
+    # or a comment elsewhere in the module must not flip entries to pow2
+    leaves = {n.rsplit(".", 1)[-1]
+              for n in (con.target, con.qualname, con.assigned)
+              if n and n not in ("<lambda>", "<module>")}
+    fns = {child.name: child for child in ast.walk(module.tree)
+           if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    calls_of = {name: {n.func.id for n in ast.walk(node)
+                       if isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Name)}
+                for name, node in fns.items()}
+    nodes = {name: fns[name] for name in leaves if name in fns}
+    # the dispatching caller and everything it calls: the host-side
+    # shape sizing (`_next_pow2`, `bucket_size`) runs in the caller or a
+    # sibling callee right before the dispatch
+    for name, called in calls_of.items():
+        if called & leaves:
+            nodes.setdefault(name, fns[name])
+            for callee in called & set(fns):
+                nodes.setdefault(callee, fns[callee])
+    texts = ["\n".join(module.lines[node.lineno - 1:node.end_lineno])
+             for node in nodes.values()]
+    scoped = "\n".join(t for t in texts if t)
+    scoped = "\n".join(ln for ln in scoped.splitlines()
+                       if "buckets=(" not in ln.replace(" ", ""))
+    policy = "pow2" if _POW2_HINT_RE.search(scoped) else "fixed"
+    info: dict = {"policy": policy}
+    if con.memo_key is not None:
+        info["memo_key"] = con.memo_key
+    if env:
+        info["env"] = env
+    return info
+
+
+def build_manifest(ctx) -> dict:
+    """-> the manifest dict (stable ordering, json-ready)."""
+    engine = ctx.engine
+    entries: list[dict] = []
+    for module in ctx.modules:
+        ml = engine.modules.get(module.pkg_rel)
+        if ml is None:
+            continue
+        for con in ml.jit_constructions:
+            target = con.target
+            target_key = f"{module.pkg_rel}::{target}" if target else None
+            int64_lanes = bool(
+                target_key and engine.function(target_key) is not None
+                and engine.target_has_int64_lanes(target_key))
+            x64_dispatch = con.in_x64
+            if target and not x64_dispatch:
+                for lat in ml.functions.values():
+                    for site in lat.dispatch_sites:
+                        if site.av.jit_of == target and site.in_x64:
+                            x64_dispatch = True
+            entry = {
+                "id": f"{module.pkg_rel}::{con.qualname}"
+                      f"@{target or '<lambda>'}",
+                "file": module.rel,
+                "line": con.line,
+                "kind": con.kind,
+                "target": target or "<lambda>",
+                "backend": BACKEND_OWNERS.get(
+                    module.pkg_rel,
+                    module.pkg_rel.split("/", 1)[0]),
+                "static_argnums": list(con.static_argnums),
+                "static_argnames": list(con.static_argnames),
+                "dtypes": _dtypes_of_target(module, engine, target),
+                "int64_lanes": int64_lanes,
+                "x64_dispatch": x64_dispatch,
+                "buckets": _bucket_info(module, con),
+            }
+            entries.append(entry)
+    entries.sort(key=lambda e: (e["file"], e["line"], e["id"]))
+    return {"version": MANIFEST_VERSION,
+            "description": "every jax.jit construction in the package "
+                           "with the shape-bucket/dtype facts the AOT "
+                           "program store prewarms from (regenerate: "
+                           "python -m tools.lint --manifest)",
+            "entries": entries}
+
+
+def render(manifest: dict) -> str:
+    return json.dumps(manifest, indent=1, sort_keys=False) + "\n"
+
+
+def default_path() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent / "shape_manifest.json"
+
+
+def write(manifest: dict, path: pathlib.Path | None = None) -> pathlib.Path:
+    path = pathlib.Path(path) if path is not None else default_path()
+    path.write_text(render(manifest))
+    return path

@@ -1,0 +1,141 @@
+"""Pass 8 — device-numeric safety (LH801 / LH802 / LH803).
+
+PR 6's epoch kernels established the numeric conventions of the device
+world and nothing enforced them until now:
+
+- **LH801 int64-outside-x64**: an explicit int64 *device* lane —
+  ``jnp.int64(...)``, ``.astype(jnp.int64)``, ``dtype=jnp.int64`` —
+  created in host code outside a scoped ``with enable_x64():``, or a
+  jitted program whose traced body builds int64 lanes dispatched
+  outside one.  Without the scope JAX silently truncates to int32:
+  balances over 2**31 gwei and every clamped epoch column corrupt
+  *quietly* (values wrap; verdicts stay plausible).  Traced code itself
+  is exempt — tracing happens at the dispatch site, which is where the
+  scope must live.
+- **LH802 float-on-lanes**: a true division (``/``) or float cast whose
+  operands carry the gwei/epoch/index int64 domain on a device or
+  traced value.  Spec arithmetic is exact integer math; one ``/`` in a
+  kernel turns bit-identical verdicts into float round-off drift that
+  only shows at adversarial balances.  Use ``//`` (and the bigint
+  gather tables) instead.
+- **LH803 unclamped-uint64**: a uint64-domain value (the spec's native
+  balance/epoch dtype — ``FAR_FUTURE_EPOCH`` is 2**64-1) cast into
+  int64 lanes or converted to a device array without visibly routing
+  through the clamp/guard discipline.  Compliant provenance, in order
+  of preference: the value passed through a ``*clamp*`` helper
+  (``_clamp_epochs``-style), the enclosing function references a
+  ``*CLAMP*`` constant, or the module carries a ``build_tables``-None
+  overflow guard (a function that returns ``None`` under a comparison
+  naming a ``*CLAMP*``/``*OVERFLOW*`` bound, keeping unclampable states
+  off the device path entirely).
+
+LH801/LH802 apply package-wide (they only fire on positively classified
+jnp/traced values, so host float math never trips them); LH803 is
+scoped to the device-numeric modules below, where the uint64→int64
+bridge actually lives.
+"""
+
+from __future__ import annotations
+
+from tools.lint import Context, Finding
+
+#: modules that bridge spec-world uint64 columns into device lanes
+UINT64_BRIDGE_MODULES = (
+    "ops/epoch_kernels.py",
+    "state_transition/epoch_device.py",
+    "state_transition/shuffle.py",
+    "parallel/epoch_sharded.py",
+)
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    engine = ctx.engine
+    traced = engine.traced
+    for module in ctx.modules:
+        ml = engine.modules.get(module.pkg_rel)
+        if ml is None:
+            continue
+        module_guarded = any(lat.guards_with_none
+                             for lat in ml.functions.values())
+        for qual, lat in sorted(ml.functions.items()):
+            findings.extend(_int64_findings(ctx, engine, module, lat,
+                                            traced))
+            findings.extend(_float_findings(ctx, module, lat))
+            if module.pkg_rel in UINT64_BRIDGE_MODULES:
+                findings.extend(_uint64_findings(ctx, module, lat,
+                                                 module_guarded))
+    return findings
+
+
+def _int64_findings(ctx, engine, module, lat, traced) -> list[Finding]:
+    findings: list[Finding] = []
+    # (a) int64 lane creation in host code outside the scope
+    if lat.key not in traced:
+        for site in lat.int64_sites:
+            if site.in_x64:
+                continue
+            if ctx.suppressed(module, "LH801", "int64-outside-x64",
+                              site.line):
+                continue
+            findings.append(Finding(
+                "LH801", "int64-outside-x64", module.rel, site.line,
+                f"{lat.qualname}:{site.kind}",
+                f"int64 device lane `{site.detail}` created outside a "
+                f"scoped `with enable_x64():` — JAX silently truncates "
+                f"to int32 (balances/epochs wrap quietly)"))
+    # (b) dispatch of an int64-lane program outside the scope
+    for site in lat.dispatch_sites:
+        if site.in_x64 or not site.av.jit_of:
+            continue
+        target_key = f"{module.pkg_rel}::{site.av.jit_of}"
+        if engine.function(target_key) is None:
+            continue
+        if not engine.target_has_int64_lanes(target_key):
+            continue
+        if ctx.suppressed(module, "LH801", "int64-outside-x64", site.line):
+            continue
+        findings.append(Finding(
+            "LH801", "int64-outside-x64", module.rel, site.line,
+            f"{lat.qualname}:dispatch:{site.av.jit_of}",
+            f"jitted program `{site.av.jit_of}` builds int64 lanes but "
+            f"is dispatched outside `with enable_x64():` — the trace "
+            f"drops to int32"))
+    return findings
+
+
+def _float_findings(ctx, module, lat) -> list[Finding]:
+    findings: list[Finding] = []
+    for site in lat.div_sites:
+        if ctx.suppressed(module, "LH802", "float-on-lanes", site.line):
+            continue
+        lanes = ",".join(sorted(site.av.domain
+                                & {"int64", "gwei", "epoch", "index"}))
+        findings.append(Finding(
+            "LH802", "float-on-lanes", module.rel, site.line,
+            f"{lat.qualname}:div",
+            f"true division `{site.detail}` on {lanes}-domain device "
+            f"value — spec arithmetic is exact integer math; use `//` "
+            f"(or a precomputed gather table)"))
+    return findings
+
+
+def _uint64_findings(ctx, module, lat, module_guarded) -> list[Finding]:
+    findings: list[Finding] = []
+    fn_exempt = (
+        "clamp" in lat.qualname.lower()
+        or any("CLAMP" in name.upper() for name in lat.referenced_names)
+        or module_guarded)
+    if fn_exempt:
+        return findings
+    for site in lat.uint64_sites:
+        if ctx.suppressed(module, "LH803", "unclamped-uint64", site.line):
+            continue
+        findings.append(Finding(
+            "LH803", "unclamped-uint64", module.rel, site.line,
+            f"{lat.qualname}:{site.kind}",
+            f"uint64-domain value `{site.detail}` reaches device lanes "
+            f"without the clamp/guard discipline — route through a "
+            f"*clamp* helper (EPOCH_CLAMP) or a build_tables-None "
+            f"overflow guard"))
+    return findings

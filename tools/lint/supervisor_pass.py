@@ -1,4 +1,4 @@
-"""Pass 6 — supervised dispatch discipline (LH601).
+"""Pass 6 — supervised dispatch discipline (LH601 / LH602).
 
 PR 4's recovery guarantee only holds for device work the supervisor can
 see: a jitted kernel dispatched from a code path that is NOT reachable
@@ -21,6 +21,24 @@ memoized ``fn = _sharded_miller_reduce(...)``) are not resolvable
 statically and are skipped; the function HOLDING the memo is still
 covered when it is itself called by name.  Conservative by design: a
 missed edge can only miss a finding, never invent one.
+
+**LH602 breaker-hooks (supervision completeness)**: LH601 proves device
+dispatch is *reachable* from a supervised entry; LH602 proves the
+supervision actually closes the loop.  Every declared backend-ladder
+driver (the ``LADDERS`` table below) must
+
+- exist — a refactor that renames or removes the driver without
+  updating the table is flagged, not silently un-checked;
+- call one of its breaker *fault* hooks inside a broad handler (a
+  device fault that isn't counted never opens the breaker, so a
+  flapping backend gets re-dispatched forever);
+- call one of its breaker *ok* hooks outside any handler (successes
+  that aren't counted never close a half-open breaker).
+
+Additionally, ANY function in a ladder module whose ``try`` body makes
+a resolved call into the offload modules (``TARGET_MODULES``) while a
+broad handler swallows the fault without a fault hook is flagged — a
+new rung added next to the driver inherits the obligation.
 """
 
 from __future__ import annotations
@@ -50,6 +68,21 @@ SUPERVISED_ENTRIES = (
     "parallel/bls_sharded.py::verify_signature_sets_sharded",
     "state_transition/epoch_processing.py::_maybe_device_epoch",
     "state_transition/shuffle.py::shuffle_list",
+)
+
+#: the backend-ladder drivers and their breaker hooks: (module, driver
+#: qualname, fault hooks, ok hooks).  LH602 requires each driver to
+#: count faults in a broad handler and successes outside one.
+LADDERS = (
+    ("crypto/bls/api.py", "_Supervisor.verify",
+     frozenset({"record_failure", "_record_fault"}),
+     frozenset({"record_success", "_record_recovery"})),
+    ("state_transition/epoch_processing.py", "_maybe_device_epoch",
+     frozenset({"_breaker_fault", "record_epoch_fault"}),
+     frozenset({"_breaker_ok"})),
+    ("state_transition/shuffle.py", "shuffle_list",
+     frozenset({"_breaker_fault", "record_epoch_fault"}),
+     frozenset({"_breaker_ok"})),
 )
 
 
@@ -108,6 +141,86 @@ def run(ctx: Context) -> list[Finding]:
         if not jitted:
             continue
         findings.extend(_scan_module(ctx, module, jitted, reachable))
+    findings.extend(_breaker_hook_findings(ctx))
+    return findings
+
+
+def _breaker_hook_findings(ctx: Context) -> list[Finding]:
+    """LH602: ladder drivers must count faults and successes."""
+    findings: list[Finding] = []
+    engine = ctx.engine
+    checked: set[tuple[str, str]] = set()
+    for pkg_rel, driver, fault_hooks, ok_hooks in LADDERS:
+        module = ctx.by_pkg_rel.get(pkg_rel)
+        if module is None:
+            continue
+        checked.add((pkg_rel, driver))
+        lat = engine.function(f"{pkg_rel}::{driver}")
+        if lat is None:
+            if not ctx.suppressed(module, "LH602", "breaker-hooks", 1):
+                findings.append(Finding(
+                    "LH602", "breaker-hooks", module.rel, 1,
+                    f"{driver}:missing",
+                    f"declared ladder driver `{driver}` not found — "
+                    f"update tools/lint/supervisor_pass.LADDERS to the "
+                    f"renamed driver (its breaker obligations move "
+                    f"with it)"))
+            continue
+        node_line = getattr(lat.node, "lineno", 1)
+        broad = [h for h in lat.handlers if h.broad]
+        if not any(h.call_terminals & fault_hooks or h.has_raise
+                   for h in broad):
+            if not ctx.suppressed(module, "LH602", "breaker-hooks",
+                                  node_line):
+                findings.append(Finding(
+                    "LH602", "breaker-hooks", module.rel, node_line,
+                    f"{driver}:fault-hook",
+                    f"ladder driver `{driver}` has no broad handler "
+                    f"calling a breaker fault hook "
+                    f"({', '.join(sorted(fault_hooks))}) — unrecorded "
+                    f"device faults never open the breaker"))
+        if not (lat.calls_outside_handlers & ok_hooks):
+            if not ctx.suppressed(module, "LH602", "breaker-hooks",
+                                  node_line):
+                findings.append(Finding(
+                    "LH602", "breaker-hooks", module.rel, node_line,
+                    f"{driver}:ok-hook",
+                    f"ladder driver `{driver}` never calls a breaker ok "
+                    f"hook ({', '.join(sorted(ok_hooks))}) on its "
+                    f"success path — a half-open breaker can never "
+                    f"close"))
+    # any OTHER function in a ladder module that swallows a device fault
+    # without counting it inherits the obligation
+    ladder_modules = {pkg_rel: (fault_hooks)
+                      for pkg_rel, _d, fault_hooks, _o in LADDERS}
+    for pkg_rel, fault_hooks in ladder_modules.items():
+        module = ctx.by_pkg_rel.get(pkg_rel)
+        ml = engine.modules.get(pkg_rel)
+        if module is None or ml is None:
+            continue
+        for qual, lat in sorted(ml.functions.items()):
+            if (pkg_rel, qual) in checked:
+                continue
+            for handler in lat.handlers:
+                if not handler.broad or handler.has_raise:
+                    continue
+                reaches_device = any(
+                    key.partition("::")[0] in TARGET_MODULES
+                    for key in handler.try_resolved)
+                if not reaches_device:
+                    continue
+                if handler.call_terminals & fault_hooks:
+                    continue
+                if ctx.suppressed(module, "LH602", "breaker-hooks",
+                                  handler.line, handler.try_line):
+                    continue
+                findings.append(Finding(
+                    "LH602", "breaker-hooks", module.rel, handler.line,
+                    f"{qual}:fault-hook",
+                    f"`{qual}` recovers a device fault without calling "
+                    f"a breaker fault hook "
+                    f"({', '.join(sorted(fault_hooks))}) — the ladder "
+                    f"re-dispatches a flapping backend forever"))
     return findings
 
 
