@@ -219,35 +219,19 @@ def _bench_kzg_batch() -> dict:
     }
 
 
-def _bench_attestation_flood() -> dict:
-    """BASELINE config #3: unaggregated gossip attestations per slot
-    through the beacon_processor queue into the chain's batch-BLS
-    pipeline (reference beacon_processor/src/lib.rs:977-1010 batch
-    formation + attestation_verification/batch.rs).
-
-    The registry cycles a small keypair set so bench setup stays
-    tractable; verification cost is identical (every attestation is a
-    distinct (validator, committee) signature set; message grouping
-    folds each committee's sets into one pairing lane)."""
-    import asyncio
-
-    import jax
+def _flood_setup(n_atts: int, n_keys: int = 32) -> dict:
+    """Shared flood/firehose scaffolding: a registry sized so one slot
+    carries ``n_atts`` attesters (cycling ``n_keys`` real keypairs — the
+    verification cost is identical: every attestation is a distinct
+    (validator, committee) signature set; message grouping folds each
+    committee's sets into one pairing lane), a chain with real signature
+    verification, and the signed single-bit attestations themselves."""
     import numpy as np
 
     from lighthouse_tpu import types as T
     from lighthouse_tpu.chain.beacon_chain import BeaconChain
-    from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.processor import BeaconProcessor, WorkEvent, WorkType
     from lighthouse_tpu.state_transition import misc
     from lighthouse_tpu.testing import Harness, interop_secret_key
-
-    platform = jax.devices()[0].platform
-    # LHTPU_FULL_SCALE=1 forces the spec-size flood (32k atts — BASELINE
-    # config #3) even on the CPU fallback, for a long-timeout scale-proof
-    # run (VERDICT r3 #5); default fallback sizing stays child-timeout-safe
-    full_scale = os.environ.get("LHTPU_FULL_SCALE") == "1"
-    n_atts = 32768 if (platform == "tpu" or full_scale) else 128
-    n_keys = 32
 
     from dataclasses import replace as _dc_replace
 
@@ -315,7 +299,37 @@ def _bench_attestation_flood() -> dict:
                 break
         if len(atts) >= n_atts:
             break
-    build_s = time.perf_counter() - t_build0
+    return {
+        "harness": h, "spec": spec, "chain": chain, "atts": atts,
+        "per_slot": per_slot, "secret_keys": sks,
+        "signing_domain": domain,
+        "build_s": time.perf_counter() - t_build0,
+    }
+
+
+def _bench_attestation_flood() -> dict:
+    """BASELINE config #3: unaggregated gossip attestations per slot
+    through the beacon_processor queue into the chain's batch-BLS
+    pipeline (reference beacon_processor/src/lib.rs:977-1010 batch
+    formation + attestation_verification/batch.rs)."""
+    import asyncio
+
+    import jax
+
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.processor import BeaconProcessor, WorkEvent, WorkType
+
+    platform = jax.devices()[0].platform
+    # LHTPU_FULL_SCALE=1 forces the spec-size flood (32k atts — BASELINE
+    # config #3) even on the CPU fallback, for a long-timeout scale-proof
+    # run (VERDICT r3 #5); default fallback sizing stays child-timeout-safe
+    full_scale = os.environ.get("LHTPU_FULL_SCALE") == "1"
+    n_atts = 32768 if (platform == "tpu" or full_scale) else 128
+
+    setup = _flood_setup(n_atts)
+    spec, chain, atts = setup["spec"], setup["chain"], setup["atts"]
+    build_s = setup["build_s"]
     _emit_partial({"flood_n": len(atts), "flood_build_s": round(build_s, 1),
                    "flood_atts_per_s": 0.0, "flood_platform": platform,
                    "stage": "built"})
@@ -383,6 +397,217 @@ def _bench_attestation_flood() -> dict:
         "flood_build_s": round(build_s, 1),
         "flood_platform": platform,
     }
+
+
+def _bench_firehose() -> dict:
+    """ROADMAP item 1 headline: sustained-ingest overload drill.
+
+    Unlike --child-flood (one pre-built batch), this holds a
+    mainnet-shaped in-flight population (LHTPU_FIREHOSE_N, default 8192)
+    resident in the beacon_processor queues with CONTINUOUS per-subnet
+    arrival, then walks the storm ladder from ops/faults.IngestPlan:
+    steady → burst (arrival x4 — drop-oldest shed) → duplicate flood
+    (pre-BLS dedup) → invalid-signature flood (bisection attribution +
+    degradation ladder), and asserts the three acceptance properties:
+
+    - zero unaccounted drops: enqueued == processed + shed + queued per
+      lane, every shed visible in processor_shed_total{work_type,reason};
+    - the GOSSIP_BLOCK lane stays live (probe events keep completing)
+      while the attestation lane is saturated;
+    - the degradation ladder returns to the normal rung within one sweep
+      after the invalid storm ends.
+
+    Emits stages.firehose with per-phase throughput plus p50/p99
+    queue-wait from the PR 1 tracing histograms."""
+    import asyncio
+
+    import jax
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.network.subnet_service import (
+        compute_subnet_for_attestation,
+    )
+    from lighthouse_tpu.ops.faults import IngestPlan
+    from lighthouse_tpu.processor import BeaconProcessor, WorkEvent, WorkType
+    from lighthouse_tpu.processor.firehose import (
+        FirehoseDriver,
+        ledger,
+        queue_wait_percentiles,
+        unaccounted_total,
+    )
+
+    platform = jax.devices()[0].platform
+    full_scale = platform == "tpu" or os.environ.get("LHTPU_FULL_SCALE") == "1"
+    inflight = int(os.environ.get("LHTPU_FIREHOSE_N", "8192"))
+    phase_s = float(os.environ.get("LHTPU_FIREHOSE_SECONDS", "8"))
+    # unique supply: one mainnet-shaped slot; fewer keys on the CPU
+    # fallback keep the real-BLS signing prelude inside the child budget
+    n_atts = max(inflight, 32768 if full_scale else 8192)
+    setup = _flood_setup(n_atts, n_keys=32 if full_scale else 8)
+    spec, chain, atts = setup["spec"], setup["chain"], setup["atts"]
+    per_slot = setup["per_slot"]
+    build_s = setup["build_s"]
+    subnets = len({compute_subnet_for_attestation(
+        spec, int(a.data.slot), int(a.data.index), per_slot)
+        for a in atts})
+    result = {
+        "firehose_n_inflight": inflight, "firehose_supply": len(atts),
+        "firehose_subnets": subnets, "firehose_platform": platform,
+        "firehose_build_s": round(build_s, 1), "firehose_atts_per_s": 0.0,
+        "stage": "built",
+    }
+    _emit_partial(result)
+
+    # auto backend: device pipeline on TPU, pure-Python reference on the
+    # CPU fallback (no XLA compiles — the queue policies are the subject
+    # here, and CPU verify throughput is reported honestly as-is)
+    bls.set_backend("auto")
+    verified = {"n": 0}
+    rejected = {"n": 0}
+
+    def consume(payloads):
+        v, r = chain.verify_attestations_for_gossip(list(payloads))
+        verified["n"] += len(v)
+        rejected["n"] += len(r)
+
+    # queue limit 4x the resident target: steady-state sits at the LOW
+    # watermark (normal rung), the burst storm drives it through HIGH
+    bp = BeaconProcessor(
+        max_workers=2, max_batch=min(2048, inflight), batch_flush_ms=100,
+        queue_lengths={WorkType.GOSSIP_ATTESTATION: inflight * 4,
+                       WorkType.GOSSIP_BLOCK: 1024})
+
+    def make_payload(i):
+        return atts[i % len(atts)]
+
+    def corrupt(att):
+        sig = bytearray(bytes(att.signature))
+        sig[5] ^= 0xFF
+        return type(att)(aggregation_bits=list(att.aggregation_bits),
+                         data=att.data, signature=bytes(sig))
+
+    driver = FirehoseDriver(bp, make_payload, consume, corrupt=corrupt)
+    block_lane = {"submitted": 0, "done": 0, "max_wait_s": 0.0}
+
+    async def block_probe():
+        """GOSSIP_BLOCK liveness probe: one event per 200 ms; each
+        records its own queue->run latency."""
+        while True:
+            t0 = time.monotonic()
+
+            def done(t0=t0):
+                block_lane["done"] += 1
+                block_lane["max_wait_s"] = max(
+                    block_lane["max_wait_s"], time.monotonic() - t0)
+
+            bp.submit(WorkEvent(WorkType.GOSSIP_BLOCK, process=done))
+            block_lane["submitted"] += 1
+            await asyncio.sleep(0.2)
+
+    stages: dict = {}
+
+    async def main():
+        await bp.start()
+        probe = asyncio.ensure_future(block_probe())
+        # each storm starts from a purged lane (the operator's backlog
+        # purge — accounted under reason="purged") so its submissions
+        # actually flow instead of hiding behind the previous storm's
+        # backlog; purge + one sweep also demonstrates mid-run ladder
+        # recovery after every storm, not just at the end
+        phases = [
+            ("steady", phase_s, inflight, None),
+            ("burst", max(1.0, phase_s / 4), inflight,
+             IngestPlan("burst", factor=6.0)),
+            ("dup", phase_s / 2, inflight, IngestPlan("dup", factor=3.0)),
+            # CPU fallback: a small poisoned wave — bisection over a
+            # half-invalid batch costs ~n log n reference pairings, so
+            # the wave is sized to keep the drill inside the child
+            # budget while still proving attribution + ladder recovery
+            ("invalid", 2.0, inflight if full_scale else 64,
+             IngestPlan("invalid", factor=2.0)),
+        ]
+        last_tick = {"t": 0.0}
+
+        def steady_tick(stats):
+            # mid-phase progressive partial (~every 2 s): a child killed
+            # inside the steady phase still reports the rate it held
+            if stats.seconds - last_tick["t"] < 2.0 or stats.seconds <= 0:
+                return
+            last_tick["t"] = stats.seconds
+            result["firehose_atts_per_s"] = round(
+                stats.processed_delta / stats.seconds, 1)
+            result["stage"] = "steady_partial"
+            _emit_partial(result)
+
+        for label, seconds, target, plan in phases:
+            v0 = verified["n"]
+            stats = await driver.run_phase(
+                label, seconds, target, plan=plan,
+                on_tick=steady_tick if label == "steady" else None)
+            purged = 0
+            if plan is not None and plan.mode in ("burst", "dup"):
+                purged = bp.shed_queue(WorkType.GOSSIP_ATTESTATION)
+            rung_after_sweep = bp.sweep_now()
+            stages[label] = {
+                "seconds": round(stats.seconds, 2),
+                "submitted": stats.submitted,
+                "shed_at_admission": stats.shed_at_admission,
+                "purged": purged,
+                "processed_per_s": round(stats.per_s, 1),
+                "verified": verified["n"] - v0,
+                "rung_max": stats.rung_max,
+                "rung_after_sweep": rung_after_sweep,
+            }
+            if label == "steady":
+                result["firehose_atts_per_s"] = round(
+                    (verified["n"] - v0) / max(stats.seconds, 1e-9), 1)
+            result["stage"] = label
+            result["firehose_verified"] = verified["n"]
+            result["stages"] = {"firehose": dict(stages)}
+            _emit_partial(result)
+        # storm over: drain the invalid-flood remnant, then ONE sweep
+        # must restore the normal rung (the acceptance recovery bound)
+        probe.cancel()
+        await bp.drain()
+        rung_after_storm = bp.admission.rung
+        rung_recovered = bp.sweep_now()
+        stages["recovery"] = {
+            "rung_after_storm": rung_after_storm,
+            "rung_after_one_sweep": rung_recovered,
+        }
+        await bp.stop(drain=False)
+
+    t0 = time.perf_counter()
+    asyncio.run(main())
+    total_s = time.perf_counter() - t0
+
+    waits = queue_wait_percentiles(WorkType.GOSSIP_ATTESTATION)
+    books = ledger(bp)
+    att_row = books.get("gossip_attestation", {})
+    shed: dict = {}
+    for (_wt, r), n in bp.metrics.shed.items():
+        shed[r] = shed.get(r, 0) + n
+    unaccounted = unaccounted_total(bp)
+    assert unaccounted == 0, f"unaccounted drops: {books}"
+    assert stages["recovery"]["rung_after_one_sweep"] == 0, \
+        "ladder failed to recover after the storm"
+    assert block_lane["done"] > 0, "block lane starved during the drill"
+    result.update({
+        "firehose_total_s": round(total_s, 1),
+        "firehose_verified": verified["n"],
+        "firehose_rejected": rejected["n"],
+        "firehose_shed": shed,
+        "firehose_unaccounted": unaccounted,
+        "firehose_qwait_p50_ms": round(waits["p50"] * 1000, 2),
+        "firehose_qwait_p99_ms": round(waits["p99"] * 1000, 2),
+        "firehose_block_lane_max_wait_ms": round(
+            block_lane["max_wait_s"] * 1000, 1),
+        "firehose_block_lane_done": block_lane["done"],
+        "firehose_enqueued": att_row.get("enqueued", 0),
+        "stages": {"firehose": stages},
+    })
+    result.pop("stage", None)
+    return result
 
 
 def _bench_slasher() -> dict:
@@ -919,6 +1144,8 @@ def _child_main() -> int:
         result = _bench_epoch()
     elif "--child-flood" in sys.argv:
         result = _bench_attestation_flood()
+    elif "--child-firehose" in sys.argv:
+        result = _bench_firehose()
     elif "--child-blockverify" in sys.argv:
         result = _bench_block_verify()
     elif "--child-slasher" in sys.argv:
@@ -988,7 +1215,8 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
 
 _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-probe", "--child-stateroot", "--child-flood",
-                "--child-blockverify", "--child-slasher", "--child-epoch")
+                "--child-blockverify", "--child-slasher", "--child-epoch",
+                "--child-firehose")
 
 
 def main() -> int:
@@ -1063,6 +1291,7 @@ def main() -> int:
                 ("--child-epoch", "epoch", min(300, CHILD_TIMEOUT_S)),
                 ("--child-blockverify", "block_verify", None),
                 ("--child-flood", "flood", None),
+                ("--child-firehose", "firehose", None),
                 ("--child-slasher", "slasher",
                  min(120, CHILD_TIMEOUT_S))):
             r = _run_child(working_env, child_flag=flag, timeout_s=timeout)

@@ -212,7 +212,17 @@ def batch_verify(
 ) -> list[VerifiedAttestation]:
     """One device-sized batch verification over all candidates' sets, with
     bisection fallback attributing failures to items
-    (reference batch_verify_unaggregated_attestations, batch.rs:133)."""
+    (reference batch_verify_unaggregated_attestations, batch.rs:133).
+
+    The batch first passes through the pre-BLS coalescing stage
+    (pool/pre_aggregation): exact duplicates verify once and
+    same-message sets fold into blinded merges, so a mainnet-width
+    attestation sweep pays one pairing lane per (slot, committee,
+    beacon_block_root) instead of one per validator.  The fast path
+    verifies the COALESCED batch; on failure, bisection runs over the
+    ORIGINAL per-candidate sets so attribution is unchanged."""
+    from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
     all_sets: list[bls.SignatureSet] = []
     spans: list[tuple[int, int]] = []
     for c in candidates:
@@ -220,7 +230,8 @@ def batch_verify(
         all_sets.extend(c.sets)
     if not all_sets:
         return candidates
-    if bls.verify_signature_sets(all_sets):
+    coalesced, _stats = coalesce_sets(all_sets)
+    if bls.verify_signature_sets(coalesced):
         for c in candidates:
             c.ok = True
         return candidates

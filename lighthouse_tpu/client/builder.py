@@ -368,7 +368,46 @@ class ClientBuilder:
 
         client = Client(self.config, self.spec, self.chain, self.executor,
                         lockfile=self._lockfile)
-        client.processor = BeaconProcessor()
+        client.processor = processor = BeaconProcessor()
+
+        def _processor_loop(exit_event):
+            """Dedicated asyncio loop for the beacon processor — the
+            client is thread-structured, the processor's manager +
+            ladder sweeper are asyncio.  Cross-thread submissions rely
+            on the manager's bounded flush-interval wait: a wakeup lost
+            to the thread boundary is recovered within batch_flush_ms."""
+            import asyncio as _asyncio
+
+            loop = _asyncio.new_event_loop()
+            _asyncio.set_event_loop(loop)
+
+            async def main():
+                await processor.start()
+                while not exit_event.is_set():
+                    await _asyncio.sleep(0.1)
+                await processor.stop(drain=False)
+
+            loop.run_until_complete(main())
+            loop.close()
+
+        self.executor.spawn(_processor_loop, "beacon-processor")
+        # operator chaos drill: an LHTPU_INGEST_FAULT_MODE storm arms
+        # here, same discipline as the LHTPU_STORE_FAULT_* crash knobs —
+        # mode=stall wedges the real batch consumer
+        # (beacon_processor._with_ingest_stall); burst/dup/invalid shape
+        # firehose-driver arrival in drills
+        from lighthouse_tpu.ops import faults as _faults
+
+        ingest_plan = _faults.ingest_plan_from_env()
+        if ingest_plan is not None:
+            # the storm self-expires after LHTPU_INGEST_FAULT_S (<=0 =
+            # unbounded) — a forgotten drill knob must not wedge the
+            # consumer forever
+            _faults.install_ingest_plan(
+                ingest_plan, duration_s=ingest_plan.duration_s)
+            self.log.warn("ingest storm armed", mode=ingest_plan.mode,
+                          factor=ingest_plan.factor,
+                          duration_s=ingest_plan.duration_s)
 
         if self.config.listen_port is not None:
             self._wire_network(client)
@@ -434,7 +473,8 @@ class ClientBuilder:
             fork_digest=fork_digest(self.chain),
             transport=self.config.wire_transport)
         svc = NetworkService(self.chain, fabric, fabric.peer_id,
-                             scheduled_subnets=False)
+                             scheduled_subnets=False,
+                             processor=client.processor)
         client.network = svc
         client.services["wire"] = fabric
         # the HTTP API's node/* endpoints read peers/identity through the

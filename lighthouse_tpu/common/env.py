@@ -127,6 +127,63 @@ _register("LHTPU_DISPATCH_RESTART_WINDOW_S", "300",
           "Restart-storm window seconds for the dispatch-thread "
           "limiter.")
 
+# -- admission control + degradation ladder (processor/admission,
+#    processor/beacon_processor) ----------------------------------------------
+
+_register("LHTPU_ADMIT_HIGH", "0.75",
+          "High watermark (fraction of a governed queue's limit) the "
+          "queue-depth EWMA must cross to escalate the shed ladder.")
+_register("LHTPU_ADMIT_LOW", "0.25",
+          "Low watermark: a sweep with every governed lane at or below "
+          "it snaps the shed ladder back to normal; between the "
+          "watermarks the rung holds (hysteresis).")
+_register("LHTPU_ADMIT_EWMA_ALPHA", "0.4",
+          "EWMA smoothing factor for the per-lane queue-depth pressure "
+          "that drives the shed ladder (1.0 = instantaneous depth).")
+_register("LHTPU_ADMIT_SWEEP_S", "0.05",
+          "Admission-ladder sweep cadence in seconds (the processor's "
+          "dedicated sweeper task).")
+_register("LHTPU_ADMIT_RETRY_S", "0.25",
+          "Base backoff hint (seconds) returned with reject-newest "
+          "admission verdicts on RPC/API lanes; scales with queue "
+          "fullness and the ladder rung.")
+_register("LHTPU_SHED_UP_SWEEPS", "2",
+          "Consecutive sweeps above the high watermark required to "
+          "escalate the shed ladder one rung (breaker-style debounce).")
+_register("LHTPU_SHED_COALESCE_FACTOR", "4",
+          "Batch-flush deadline multiplier on the coalesce ladder rung "
+          "(bigger sweeps, fewer device batches under pressure).")
+
+# -- ingest storms + firehose bench (ops/faults, processor/firehose,
+#    bench.py --child-firehose) ------------------------------------------------
+
+_register("LHTPU_INGEST_FAULT_MODE", None,
+          "Ingest-path storm for chaos drills (burst|stall|dup|invalid), "
+          "armed at client build; stall wedges the live batch consumer, "
+          "burst/dup/invalid shape firehose-driver arrival; unset "
+          "disables the storm (ops/faults.IngestPlan).")
+_register("LHTPU_INGEST_FAULT_FACTOR", "4",
+          "Storm intensity: burst arrival multiplier, duplicate copies "
+          "per attestation (dup), or invalid-signature copies per "
+          "honest one (invalid).")
+_register("LHTPU_INGEST_FAULT_S", "2",
+          "Storm window in seconds for an env-armed ingest plan — the "
+          "storm self-expires after this; <=0 leaves it blowing until "
+          "cleared.")
+_register("LHTPU_INGEST_STALL_S", "0.05",
+          "Per-batch consumer stall for ingest mode=stall (the "
+          "slow-consumer drill).")
+_register("LHTPU_FIREHOSE_N", "8192",
+          "Firehose bench in-flight target: attestations resident in "
+          "the processor queues during the sustained-ingest phases.")
+_register("LHTPU_FIREHOSE_SECONDS", "8",
+          "Seconds of steady-state ingest per firehose bench phase on "
+          "the CPU fallback (TPU runs use the full slot budget).")
+_register("LHTPU_PRE_BLS", "1",
+          "0 disables the pre-BLS coalescing stage (exact-duplicate "
+          "dedup + blinded same-message merge in pool/pre_aggregation) "
+          "so every signature set pays its own pairing.")
+
 # -- device epoch processing (state_transition/epoch_processing seam,
 #    state_transition/epoch_device, ops/epoch_kernels) -------------------------
 

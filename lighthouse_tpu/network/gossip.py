@@ -18,6 +18,8 @@ from collections import OrderedDict, defaultdict
 from dataclasses import dataclass, field
 from typing import Callable
 
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
 
 def message_id(topic: str, data: bytes) -> bytes:
     """Spec-shaped message id: hash over domain + topic + payload."""
@@ -33,9 +35,17 @@ class GossipMessage:
 
 
 class _SeenCache:
-    def __init__(self, capacity: int = 4096):
+    """Message-id dedup ring — the FIRST line of duplicate-flood defense:
+    a byte-identical replay storm dies here, before decode, before the
+    processor queues, before BLS.  ``hits`` counts suppressed replays
+    (the firehose dup drill reads it); capacity must cover at least one
+    slot's mainnet-width traffic or a storm wider than the ring slips
+    duplicates through to the (accounted) pre-BLS dedup stage."""
+
+    def __init__(self, capacity: int = 65536):
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         self.capacity = capacity
+        self.hits = 0
 
     def __contains__(self, mid: bytes) -> bool:
         return mid in self._seen
@@ -43,6 +53,7 @@ class _SeenCache:
     def observe(self, mid: bytes) -> bool:
         """True if newly seen."""
         if mid in self._seen:
+            self.hits += 1
             return False
         self._seen[mid] = None
         while len(self._seen) > self.capacity:
@@ -53,11 +64,12 @@ class _SeenCache:
 class GossipEndpoint:
     """One node's gossip handle: subscriptions + handlers + dedup."""
 
-    def __init__(self, hub: "GossipHub", peer_id: str):
+    def __init__(self, hub: "GossipHub", peer_id: str,
+                 seen_capacity: int = 65536):
         self.hub = hub
         self.peer_id = peer_id
         self.handlers: dict[str, Callable[[GossipMessage], None]] = {}
-        self.seen = _SeenCache()
+        self.seen = _SeenCache(seen_capacity)
         self.on_delivery_result: Callable[[str, str, bool], None] | None = None
 
     def subscribe(self, topic: str, handler: Callable[[GossipMessage], None]):
@@ -84,6 +96,86 @@ class GossipEndpoint:
             ok = False
         if self.on_delivery_result is not None:
             self.on_delivery_result(msg.source, msg.topic, ok)
+
+
+_FANIN_CHILDREN: dict[str, object] = {}
+
+
+def record_fanin(outcome: str) -> None:
+    """Count one attestation fan-in delivery outcome
+    (accepted/shed/decode_error) — the single registration point of the
+    gossip_fanin_total family, shared by :class:`SubnetFanIn` and the
+    router's processor path so both fan-in seams keep one ledger."""
+    child = _FANIN_CHILDREN.get(outcome)
+    if child is None:
+        child = _FANIN_CHILDREN[outcome] = REGISTRY.counter(
+            "gossip_fanin_total",
+            "per-subnet attestation deliveries by outcome "
+            "(accepted/shed/decode_error)").labels(outcome=outcome)
+    child.inc()
+
+
+class SubnetFanIn:
+    """Per-subnet attestation fan-in: ``beacon_attestation_{n}`` topics
+    funneled into one submit callable (the beacon processor's admission
+    controller), with per-subnet delivery accounting.
+
+    Scope: the lightweight fan-in for drills and embeddings that run a
+    processor WITHOUT the full Router (the firehose harness, in-process
+    fabrics).  The production path is Router._on_attestation with
+    ``processor=`` — it needs per-message peer identity for scoring,
+    which this seam deliberately does not carry.  Both paths keep ONE
+    ledger through :func:`record_fanin`: gossip deliveries do NOT call
+    the verification pipeline directly — they go through ``submit``
+    (which may shed under the degradation ladder or a full queue) and
+    the outcome of every delivery is counted in
+    ``gossip_fanin_total{outcome}``.  A decode failure is counted too: a
+    hostile peer's garbage dies here at zero BLS cost.
+    """
+
+    def __init__(self, endpoint: "GossipEndpoint",
+                 submit: Callable[[int, object], object],
+                 decode: Callable[[bytes], object],
+                 subnet_count: int = 64,
+                 topic_fn: Callable[[int], str] | None = None):
+        self.endpoint = endpoint
+        self.submit = submit
+        self.decode = decode
+        self.subnet_count = subnet_count
+        self.topic_fn = topic_fn or (lambda n: f"beacon_attestation_{n}")
+        self.delivered: dict[int, int] = {}
+        self.outcomes: dict[str, int] = {}
+        self._subscribed: set[int] = set()
+
+    def _count(self, outcome: str) -> None:
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        record_fanin(outcome)
+
+    def subscribe(self, subnets=None) -> None:
+        for subnet in (range(self.subnet_count) if subnets is None
+                       else subnets):
+            if subnet in self._subscribed:
+                continue
+            self._subscribed.add(subnet)
+            self.endpoint.subscribe(
+                self.topic_fn(subnet),
+                lambda msg, subnet=subnet: self._on_message(subnet, msg))
+
+    def unsubscribe(self, subnets) -> None:
+        for subnet in subnets:
+            if subnet in self._subscribed:
+                self._subscribed.discard(subnet)
+                self.endpoint.unsubscribe(self.topic_fn(subnet))
+
+    def _on_message(self, subnet: int, msg: GossipMessage) -> None:
+        self.delivered[subnet] = self.delivered.get(subnet, 0) + 1
+        try:
+            payload = self.decode(msg.data)
+        except Exception as e:
+            self._count("decode_error")
+            record_swallowed("gossip.fanin_decode", e)
+            return
+        self._count("accepted" if self.submit(subnet, payload) else "shed")
 
 
 class GossipHub:

@@ -76,12 +76,18 @@ class Router:
     """Wires a chain + store to gossip topics and RPC protocols."""
 
     def __init__(self, chain: "BeaconChain", gossip_ep, rpc_ep, peer_manager,
-                 on_unknown_parent=None, subnet_service=None):
+                 on_unknown_parent=None, subnet_service=None,
+                 processor=None):
         self.chain = chain
         self.gossip = gossip_ep
         self.rpc = rpc_ep
         self.peers = peer_manager
         self.on_unknown_parent = on_unknown_parent
+        # optional BeaconProcessor: attestation/aggregate gossip rides
+        # its admission-controlled batch queues instead of verifying
+        # inline per message (mainnet-width fan-in; the ladder may shed
+        # under overload and every shed is accounted by the processor)
+        self.processor = processor
         # scheduled attestation-subnet subscriptions (subnet_service.py);
         # None = subscribe to all subnets (small test fabrics)
         self.subnet_service = subnet_service
@@ -196,16 +202,68 @@ class Router:
                 self.peers.report(msg.source, "mid")
                 raise
 
+    # gossip-check reject reasons that earn no peer penalty: expected
+    # around slot/fork boundaries and under honest duplication
+    _BENIGN_ATT_REJECTS = frozenset({
+        "past_slot", "unknown_head_block", "prior_attestation_known",
+        "duplicate_in_batch"})
+
+    def _decode_gossip(self, cls, msg, count: bool = False):
+        """``count=True`` only on the attestation lanes —
+        gossip_fanin_total is the ATTESTATION fan-in ledger, and its
+        accepted/shed/decode_error outcomes must add up per delivery."""
+        try:
+            return cls.deserialize(msg.data)
+        except Exception:
+            # counted (when in the ledger's scope), PENALIZED via the
+            # existing delivery-result path: re-raising marks the
+            # delivery failed and _score_delivery downgrades the sender
+            if count:
+                from lighthouse_tpu.network.gossip import record_fanin
+
+                record_fanin("decode_error")
+            raise
+
+    def _verify_attestation_batch(self, pairs):
+        """Batch handler for processor-queued gossip attestations: the
+        payloads carry (attestation, source) so the batch path keeps the
+        SAME peer-downscoring contract as the inline path — a hostile
+        peer flooding invalid signatures pays for it even when its
+        messages ride a 2048-lane sweep."""
+        atts = [a for a, _src in pairs]
+        source = {id(a): s for a, s in pairs}
+        _verified, rejects = self.chain.verify_attestations_for_gossip(atts)
+        for item, reason in rejects:
+            if reason not in self._BENIGN_ATT_REJECTS:
+                src = source.get(id(item))
+                if src is not None:
+                    self.peers.report(src, "low", topic="beacon_attestation")
+
     def _on_attestation(self, msg):
         c = self.chain
         att_cls = (c.t.AttestationElectra if self._topic_electra(msg.topic)
                    else c.t.Attestation)
-        att = att_cls.deserialize(msg.data)
+        from lighthouse_tpu.network.gossip import record_fanin
+
+        att = self._decode_gossip(att_cls, msg, count=True)
+        if self.processor is not None:
+            from lighthouse_tpu.processor import WorkEvent, WorkType
+
+            # admission-controlled queue path: the batch sweep feeds the
+            # chain's batched pipeline; a SHED verdict is accounted in
+            # processor_shed_total and earns the peer no penalty
+            # (overload is local, the message may be honest) — invalid
+            # signatures are penalized from the batch handler above
+            verdict = self.processor.submit(WorkEvent(
+                WorkType.GOSSIP_ATTESTATION, payload=(att, msg.source),
+                process_batch=self._verify_attestation_batch))
+            record_fanin("accepted" if verdict else "shed")
+            return
         verified, rejects = c.verify_attestations_for_gossip([att])
+        record_fanin("accepted")  # inline path: delivered + verified now
         if rejects:
             reasons = {r for _, r in rejects}
-            if not reasons & {"past_slot", "unknown_head_block",
-                              "prior_attestation_known"}:
+            if not reasons & self._BENIGN_ATT_REJECTS:
                 self.peers.report(msg.source, "low")
 
     def _on_aggregate(self, msg):
@@ -213,7 +271,17 @@ class Router:
         agg_cls = (c.t.SignedAggregateAndProofElectra
                    if self._topic_electra(msg.topic)
                    else c.t.SignedAggregateAndProof)
-        agg = agg_cls.deserialize(msg.data)
+        agg = self._decode_gossip(agg_cls, msg)
+        if self.processor is not None:
+            from lighthouse_tpu.processor import WorkEvent, WorkType
+
+            # parity with the inline path below: aggregate rejects are
+            # not peer-scored (either path)
+            self.processor.submit(WorkEvent(
+                WorkType.GOSSIP_AGGREGATE, payload=agg,
+                process_batch=lambda aggs: c.verify_aggregates_for_gossip(
+                    list(aggs))))
+            return
         c.verify_aggregates_for_gossip([agg])
 
     def _on_blob(self, msg):

@@ -26,7 +26,7 @@ class NetworkFabric:
 
 class NetworkService:
     def __init__(self, chain, fabric: NetworkFabric, peer_id: str,
-                 scheduled_subnets: bool = False):
+                 scheduled_subnets: bool = False, processor=None):
         from lighthouse_tpu.network.discovery import Discovery, Enr
         from lighthouse_tpu.network.router import fork_digest
 
@@ -59,7 +59,7 @@ class NetworkService:
         self.router = Router(
             chain, self.gossip_ep, self.rpc_ep, self.peer_manager,
             on_unknown_parent=self._on_unknown_parent,
-            subnet_service=subnet_service)
+            subnet_service=subnet_service, processor=processor)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
         # gossip fresh light-client updates as the chain mints them

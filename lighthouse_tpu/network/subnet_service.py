@@ -40,6 +40,19 @@ def compute_subscribed_subnets(node_id: bytes, epoch: int,
     return sorted(set(out))
 
 
+def compute_subnet_for_attestation(spec, slot: int, committee_index: int,
+                                   committees_per_slot: int) -> int:
+    """Spec ``compute_subnet_for_attestation``: the gossip subnet an
+    attestation for (slot, committee) belongs on.  The firehose bench
+    and the router's publish path share this so per-subnet fan-in and
+    fan-out can never disagree about the mapping."""
+    slots_since_epoch_start = slot % spec.slots_per_epoch
+    committees_since_epoch_start = (
+        committees_per_slot * slots_since_epoch_start)
+    return ((committees_since_epoch_start + committee_index)
+            % spec.attestation_subnet_count)
+
+
 @dataclass
 class _ShortLived:
     subnet: int
@@ -119,6 +132,7 @@ class SyncSubnetService:
 __all__ = [
     "AttestationSubnetService",
     "SyncSubnetService",
+    "compute_subnet_for_attestation",
     "compute_subscribed_subnets",
     "EPOCHS_PER_SUBSCRIPTION",
     "SUBNETS_PER_NODE",

@@ -223,6 +223,137 @@ def fire(site: str, index: int = 0) -> str | None:
     raise InjectedFault(f"injected device fault at {site}[{index}]")
 
 
+# --- ingest-path storms ------------------------------------------------------
+
+VALID_INGEST_MODES = ("burst", "stall", "dup", "invalid")
+
+
+@dataclass
+class IngestPlan:
+    """A hostile-peer / overload scenario for the attestation firehose.
+
+    Consumed by the firehose driver (processor/firehose.py) and the
+    ``bench.py --child-firehose`` scenario; the point is the same as
+    :class:`FaultPlan`'s — real storms (a peer replaying a slot's gossip,
+    a wedged disk stalling the consumer, an attacker flooding garbage
+    signatures) are neither deterministic nor available on CI, so the
+    drills synthesize them on command and assert the admission ladder's
+    response.
+
+    ======  ===================================================================
+    mode    behaviour while the storm window is open
+    ======  ===================================================================
+    burst   arrival rate multiplied by ``factor`` (sustained over-delivery)
+    stall   the batch consumer sleeps ``stall_s`` per batch (slow-consumer:
+            queues back up even at the honest arrival rate)
+    dup     every attestation delivered ``factor`` times (byte-identical
+            copies — the pre-BLS dedup stage's storm)
+    invalid ``factor`` invalid-signature copies ride along with each honest
+            attestation (hostile peer; the batch must bisect them out and
+            the ladder must recover once the storm ends)
+    ======  ===================================================================
+    """
+
+    mode: str
+    factor: float = 4.0
+    duration_s: float = 2.0
+    stall_s: float = 0.05
+
+    def __post_init__(self):
+        if self.mode not in VALID_INGEST_MODES:
+            raise ValueError(
+                f"ingest mode {self.mode!r} not in {VALID_INGEST_MODES}")
+
+
+_INGEST_PLAN: IngestPlan | None = None
+_INGEST_EXPIRES_AT: float | None = None
+
+
+def install_ingest_plan(plan: IngestPlan | None,
+                        duration_s: float | None = None) -> None:
+    """Install (or clear) the process-wide ingest storm plan.
+
+    ``duration_s`` bounds the storm: after that many seconds the plan
+    self-expires on the next :func:`active_ingest_plan` read.  The
+    env-armed path passes the plan's own ``duration_s`` (a drill knob
+    must not wedge the consumer forever); drill drivers that bound
+    their phases themselves install without one."""
+    global _INGEST_PLAN, _INGEST_EXPIRES_AT
+    with _LOCK:
+        _INGEST_PLAN = plan
+        _INGEST_EXPIRES_AT = (
+            time.monotonic() + duration_s
+            if plan is not None and duration_s and duration_s > 0
+            else None)
+
+
+def snapshot_ingest_plan() -> tuple:
+    """(plan, expiry) snapshot for save/restore around a drill phase —
+    restoring through :func:`restore_ingest_plan` preserves an env-armed
+    storm's remaining expiry window instead of unbounding it."""
+    with _LOCK:
+        return (_INGEST_PLAN, _INGEST_EXPIRES_AT)
+
+
+def restore_ingest_plan(snapshot: tuple) -> None:
+    global _INGEST_PLAN, _INGEST_EXPIRES_AT
+    plan, expires = snapshot
+    with _LOCK:
+        _INGEST_PLAN = plan
+        _INGEST_EXPIRES_AT = expires  # already-lapsed deadlines clear
+        #                               on the next active read
+
+
+def active_ingest_plan() -> IngestPlan | None:
+    global _INGEST_PLAN, _INGEST_EXPIRES_AT
+    plan = _INGEST_PLAN
+    expires = _INGEST_EXPIRES_AT
+    if plan is not None and expires is not None \
+            and time.monotonic() >= expires:
+        with _LOCK:
+            if _INGEST_PLAN is plan:
+                _INGEST_PLAN = None
+                _INGEST_EXPIRES_AT = None
+        return None
+    return plan
+
+
+_WARNED_INGEST_ENV = False
+
+
+def ingest_plan_from_env() -> IngestPlan | None:
+    """Build an ingest storm from the LHTPU_INGEST_* knobs; None when
+    unset or malformed (malformed warns once, same discipline as
+    :func:`plan_from_env`)."""
+    global _WARNED_INGEST_ENV
+    mode = envreg.get("LHTPU_INGEST_FAULT_MODE")
+    if not mode:
+        return None
+    try:
+        return IngestPlan(
+            mode=mode.strip(),
+            factor=envreg.get_float("LHTPU_INGEST_FAULT_FACTOR", 4.0),
+            duration_s=envreg.get_float("LHTPU_INGEST_FAULT_S", 2.0),
+            stall_s=envreg.get_float("LHTPU_INGEST_STALL_S", 0.05),
+        )
+    except ValueError as e:
+        if not _WARNED_INGEST_ENV:
+            _WARNED_INGEST_ENV = True
+            import sys
+
+            print(f"lighthouse_tpu: ignoring malformed LHTPU_INGEST_* "
+                  f"configuration ({e}); ingest storm disabled",
+                  file=sys.stderr)
+        return None
+
+
+def consumer_stall_s() -> float:
+    """Per-batch consumer stall the slow-consumer drill injects (0 when
+    no stall-mode ingest plan is active or the storm window expired)."""
+    plan = active_ingest_plan()
+    return plan.stall_s if plan is not None and plan.mode == "stall" else 0.0
+
+
 # --- watchdog execution ------------------------------------------------------
 
 _UNDER_WATCHDOG = threading.local()

@@ -4,10 +4,13 @@ beacon_chain/naive_aggregation_pool)."""
 from lighthouse_tpu.pool.max_cover import CoverItem, maximum_cover
 from lighthouse_tpu.pool.naive_aggregation import NaiveAggregationPool
 from lighthouse_tpu.pool.operation_pool import OperationPool
+from lighthouse_tpu.pool.pre_aggregation import CoalesceStats, coalesce_sets
 
 __all__ = [
     "CoverItem",
     "maximum_cover",
     "NaiveAggregationPool",
     "OperationPool",
+    "CoalesceStats",
+    "coalesce_sets",
 ]

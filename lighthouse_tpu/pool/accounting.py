@@ -1,0 +1,35 @@
+"""Shed/drop accounting funnel for the pools.
+
+The zero-unaccounted-drops discipline (lhlint LH603) extends past the
+processor queues: an aggregate evicted from a pool is queued work
+discarded, and an operator debugging a missing attestation needs to see
+WHERE it went.  Every pool discard routes through
+:func:`record_pool_dropped`, the single owner of the
+``pool_dropped_total{pool,reason}`` family.
+
+Retention pruning is accounted too — not because pruning is wrong (it
+is the design), but because "dropped for retention" vs "dropped under
+overload" is exactly the distinction the labels exist to make.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+
+def record_pool_dropped(pool: str, reason: str, n: int = 1) -> None:
+    """Count ``n`` items discarded from ``pool`` (naive_aggregation /
+    op_pool / sync_contribution / reprocess) for ``reason``."""
+    if n <= 0:
+        return
+    try:
+        REGISTRY.counter(
+            "pool_dropped_total",
+            "items discarded from the aggregation/operation pools, by "
+            "pool and reason",
+        ).labels(pool=pool, reason=reason).inc(n)
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        record_swallowed("pool.accounting", e)
+
+
+__all__ = ["record_pool_dropped"]

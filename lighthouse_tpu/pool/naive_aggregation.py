@@ -82,13 +82,21 @@ class NaiveAggregationPool:
                 yield data, bits.copy(), _aggregate(sigs), ci
 
     def _prune(self):
+        from lighthouse_tpu.pool.accounting import record_pool_dropped
+
         if len(self._slots) <= self.retained_slots:
             return
         for slot in sorted(self._slots)[: len(self._slots) - self.retained_slots]:
+            record_pool_dropped("naive_aggregation", "retention",
+                                len(self._slots[slot]))
             del self._slots[slot]
 
     def prune_below(self, slot: int):
+        from lighthouse_tpu.pool.accounting import record_pool_dropped
+
         for s in [s for s in self._slots if s < slot]:
+            record_pool_dropped("naive_aggregation", "finalized",
+                                len(self._slots[s]))
             del self._slots[s]
 
     def __len__(self):

@@ -61,7 +61,11 @@ class OperationPool:
             data, bits, signature if isinstance(signature, bls.Signature)
             else bls.Signature(bytes(signature)), ci))
         if len(variants) > self.max_variants_per_data:
+            from lighthouse_tpu.pool.accounting import record_pool_dropped
+
             variants.sort(key=lambda v: int(v.bits.sum()), reverse=True)
+            record_pool_dropped("op_pool", "variant_eviction",
+                                len(variants) - self.max_variants_per_data)
             del variants[self.max_variants_per_data:]
         return True
 

@@ -103,15 +103,21 @@ class SyncContributionPool:
             if hasattr(agg, "to_bytes") else bytes(agg))
 
     def _prune(self):
+        from lighthouse_tpu.pool.accounting import record_pool_dropped
+
         slots = {k[0] for k in self._entries}
         if len(slots) <= self.retained_slots:
             return
         cutoff = sorted(slots)[-self.retained_slots]
         for k in [k for k in self._entries if k[0] < cutoff]:
+            record_pool_dropped("sync_contribution", "retention")
             del self._entries[k]
 
     def prune_below(self, slot: int):
+        from lighthouse_tpu.pool.accounting import record_pool_dropped
+
         for k in [k for k in self._entries if k[0] < slot]:
+            record_pool_dropped("sync_contribution", "finalized")
             del self._entries[k]
 
     def __len__(self):

@@ -3,6 +3,10 @@
 Reference: /root/reference/beacon_node/beacon_processor.
 """
 
+from lighthouse_tpu.processor.admission import (
+    Admission,
+    AdmissionController,
+)
 from lighthouse_tpu.processor.beacon_processor import (
     PRIORITY_ORDER,
     BeaconProcessor,
@@ -20,6 +24,8 @@ from lighthouse_tpu.processor.reprocess import (
 )
 
 __all__ = [
+    "Admission",
+    "AdmissionController",
     "BeaconProcessor",
     "WorkEvent",
     "WorkType",

@@ -1,0 +1,233 @@
+"""Admission control + degradation ladder for the beacon processor.
+
+Mainnet-width ingest (tens of thousands of unaggregated attestations plus
+thousands of aggregates per slot) can outrun the verification plane for
+whole slots at a time.  Before this layer the only overload behaviour was
+a silent drop-oldest on four LIFO queues; now every queue has an explicit
+policy and every discard is accounted:
+
+- **drop-oldest** stays for gossip flood lanes (newest gossip is the most
+  likely to still matter), but each drop increments
+  ``processor_shed_total{work_type,reason}`` and is traced;
+- **reject-newest with backoff signaling** for RPC/API lanes: the
+  :class:`Admission` verdict a rejected ``submit`` returns carries a
+  ``retry_after_s`` hint the HTTP/RPC surface can turn into a 503 +
+  Retry-After;
+- a **degradation ladder** sheds the cheapest-to-regenerate work first
+  when sustained pressure builds:
+
+  ====  ===================  ===========================================
+  rung  name                 behaviour
+  ====  ===================  ===========================================
+  0     normal               full service
+  1     coalesce             batch flush deadlines stretch by
+                             ``LHTPU_SHED_COALESCE_FACTOR`` so sweeps run
+                             bigger (fewer, fuller device batches — the
+                             cheapest defense: a merged bitfield is a
+                             pairing never paid for)
+  2     shed_unaggregated    new unaggregated attestations are shed at
+                             admission (aggregates carry ~committee-width
+                             more value per pairing, so they survive one
+                             rung longer)
+  3     shed_aggregates      aggregates shed too; only blocks, chain
+                             segments and the other protected lanes are
+                             admitted
+  ====  ===================  ===========================================
+
+The ladder is driven by per-lane queue-depth EWMAs swept by the
+processor's dedicated sweeper task (the manager loop can park on an
+unbounded worker acquire — exactly when the ladder must keep
+observing), with the PR 4 circuit-breaker shape: *escalation* needs
+``LHTPU_SHED_UP_SWEEPS`` consecutive sweeps above the high watermark
+(consecutive faults open the breaker), the band between the watermarks
+holds the rung (hysteresis — no flapping on a noisy boundary), and a
+sweep that finds every governed lane back at/below the low watermark
+snaps straight to normal (the half-open probe succeeding closes the
+breaker in one observation; the acceptance drill is "recovered within
+one sweep of the storm ending").
+
+This module is deliberately WorkType-agnostic (lanes are opaque dict
+keys supplied by the processor) so it imports nothing from
+beacon_processor and stays trivially unit-testable.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+NORMAL = 0
+COALESCE = 1
+SHED_UNAGGREGATED = 2
+SHED_AGGREGATES = 3
+
+RUNG_NAMES = ("normal", "coalesce", "shed_unaggregated", "shed_aggregates")
+
+
+class Admission(int):
+    """Truthy/falsy ``submit`` verdict (bool-compatible: existing callers
+    keep doing ``if not bp.submit(...)``) carrying the shed reason and a
+    backoff hint for reject-newest lanes."""
+
+    reason: str | None
+    retry_after_s: float
+
+    def __new__(cls, accepted: bool, reason: str | None = None,
+                retry_after_s: float = 0.0) -> "Admission":
+        self = super().__new__(cls, 1 if accepted else 0)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        return self
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Admission(accepted={bool(self)}, reason={self.reason!r}, "
+                f"retry_after_s={self.retry_after_s})")
+
+
+ACCEPTED = Admission(True)
+
+
+class AdmissionController:
+    """Queue-depth EWMAs + the degradation ladder.
+
+    ``governed`` are the lanes whose pressure drives the ladder (the
+    attestation flood lanes); ``shed_order`` lists them cheapest-first —
+    rung ``SHED_UNAGGREGATED`` sheds ``shed_order[0]``, rung
+    ``SHED_AGGREGATES`` sheds ``shed_order[:2]``.
+
+    Thread model: ``shed_reason``/``flush_factor`` are read from any
+    producer thread (single int/dict reads of immutable-enough state);
+    ``sweep`` mutates under a lock and is called from the processor's
+    sweeper task (and directly by drills/tests).
+    """
+
+    def __init__(
+        self,
+        governed: tuple,
+        shed_order: tuple,
+        high: float | None = None,
+        low: float | None = None,
+        alpha: float | None = None,
+        up_sweeps: int | None = None,
+        coalesce_factor: float | None = None,
+        retry_base_s: float | None = None,
+    ):
+        self.governed = tuple(governed)
+        self.shed_order = tuple(shed_order)
+        self.high = high if high is not None else envreg.get_float(
+            "LHTPU_ADMIT_HIGH", 0.75)
+        self.low = low if low is not None else envreg.get_float(
+            "LHTPU_ADMIT_LOW", 0.25)
+        self.alpha = alpha if alpha is not None else envreg.get_float(
+            "LHTPU_ADMIT_EWMA_ALPHA", 0.4)
+        self.up_sweeps = max(1, up_sweeps if up_sweeps is not None
+                             else envreg.get_int("LHTPU_SHED_UP_SWEEPS", 2))
+        self.coalesce_factor = (
+            coalesce_factor if coalesce_factor is not None
+            else envreg.get_float("LHTPU_SHED_COALESCE_FACTOR", 4.0))
+        self.retry_base_s = (
+            retry_base_s if retry_base_s is not None
+            else envreg.get_float("LHTPU_ADMIT_RETRY_S", 0.25))
+        self.rung = NORMAL
+        self.sweeps = 0           # lifetime sweep count (drill surface)
+        self._streak = 0          # consecutive sweeps above high watermark
+        self._ewma: dict = {}
+        self._lock = threading.Lock()
+        self._shed_lanes: frozenset = frozenset()
+
+    # -- producer-side reads (any thread) ----------------------------------
+
+    def shed_reason(self, lane) -> str | None:
+        """Non-None when the ladder sheds this lane at admission."""
+        if lane in self._shed_lanes:
+            return ("ladder_unaggregated" if lane == self.shed_order[0]
+                    else "ladder_aggregates")
+        return None
+
+    def flush_factor(self) -> float:
+        """Batch-flush deadline multiplier (>1 from rung COALESCE up)."""
+        return self.coalesce_factor if self.rung >= COALESCE else 1.0
+
+    def retry_after_s(self, depth: int, limit: int) -> float:
+        """Backoff hint for a reject-newest lane: scales with how far
+        over the line the producer is pushing."""
+        fullness = depth / max(limit, 1)
+        return round(self.retry_base_s * max(1.0, fullness + self.rung), 3)
+
+    def pressure(self, lane) -> float:
+        return self._ewma.get(lane, 0.0)
+
+    # -- manager-side sweep -------------------------------------------------
+
+    def sweep(self, depths: dict) -> int:
+        """One ladder observation over ``{lane: (depth, limit)}``.
+        Returns the rung in force after the sweep."""
+        with self._lock:
+            self.sweeps += 1
+            instant_max = 0.0
+            ewma_max = 0.0
+            for lane in self.governed:
+                depth, limit = depths.get(lane, (0, 1))
+                instant = depth / max(limit, 1)
+                prev = self._ewma.get(lane, 0.0)
+                cur = self.alpha * instant + (1.0 - self.alpha) * prev
+                self._ewma[lane] = cur
+                instant_max = max(instant_max, instant)
+                ewma_max = max(ewma_max, cur)
+            old = self.rung
+            if instant_max <= self.low:
+                # storm over: snap to normal in ONE sweep (half-open
+                # probe success) and forget the smoothed history so the
+                # next storm is judged fresh
+                self.rung = NORMAL
+                self._streak = 0
+                if old != NORMAL:
+                    for lane in self.governed:
+                        self._ewma[lane] = instant_max
+            elif ewma_max >= self.high:
+                self._streak += 1
+                if self._streak >= self.up_sweeps:
+                    self.rung = min(SHED_AGGREGATES, self.rung + 1)
+                    self._streak = 0
+            else:
+                # hysteresis band: hold the rung, reset the streak
+                self._streak = 0
+            self._shed_lanes = frozenset(
+                self.shed_order[: max(0, self.rung - COALESCE)])
+            if self.rung != old:
+                self._record_transition(old, self.rung)
+            return self.rung
+
+    def _record_transition(self, old: int, new: int) -> None:
+        try:
+            REGISTRY.gauge(
+                "processor_ladder_rung",
+                "degradation ladder rung in force "
+                "(0 normal .. 3 shed_aggregates)").set(new)
+            REGISTRY.counter(
+                "processor_ladder_transitions_total",
+                "degradation ladder rung changes, by direction and rung",
+            ).labels(direction="up" if new > old else "down",
+                     rung=RUNG_NAMES[new]).inc()
+            from lighthouse_tpu.common import tracing
+
+            with tracing.span("beacon_processor.ladder",
+                              from_rung=RUNG_NAMES[old],
+                              to_rung=RUNG_NAMES[new]):
+                pass
+        except (AttributeError, KeyError, TypeError, ValueError) as e:
+            record_swallowed("admission.ladder_transition", e)
+
+
+__all__ = [
+    "ACCEPTED",
+    "Admission",
+    "AdmissionController",
+    "COALESCE",
+    "NORMAL",
+    "RUNG_NAMES",
+    "SHED_AGGREGATES",
+    "SHED_UNAGGREGATED",
+]

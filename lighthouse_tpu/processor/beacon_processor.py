@@ -23,6 +23,7 @@ task_executor::spawn_blocking).
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -33,6 +34,12 @@ from typing import Any, Awaitable, Callable
 from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common import tracing
 from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+from lighthouse_tpu.ops import faults
+from lighthouse_tpu.processor.admission import (
+    ACCEPTED,
+    Admission,
+    AdmissionController,
+)
 
 
 class WorkType(Enum):
@@ -108,13 +115,31 @@ PRIORITY_ORDER: tuple[WorkType, ...] = (
 )
 
 # queues that drop the OLDEST item when full (gossip floods); everything
-# else drops the newest (reference FifoQueue/LifoQueue split)
+# else rejects the newest with a backoff hint (reference
+# FifoQueue/LifoQueue split).  Either way the discard is accounted in
+# processor_shed_total{work_type,reason} — overload may degrade service,
+# never the books.
 _LIFO_TYPES = {
     WorkType.GOSSIP_ATTESTATION,
     WorkType.GOSSIP_AGGREGATE,
     WorkType.GOSSIP_SYNC_SIGNATURE,
     WorkType.GOSSIP_SYNC_CONTRIBUTION,
 }
+
+# lanes the degradation ladder must never shed AND the scheduler must
+# never starve: chain structure always lands.  One worker slot is
+# reserved for these — a saturated attestation plane can occupy at most
+# max_workers - 1 slots (the reserve is how GOSSIP_BLOCK/CHAIN_SEGMENT
+# stay verifiably live during a flood drill).
+_PROTECTED_TYPES = frozenset({
+    WorkType.CHAIN_SEGMENT,
+    WorkType.CHAIN_SEGMENT_BACKFILL,
+    WorkType.RPC_BLOCK,
+    WorkType.RPC_BLOBS,
+    WorkType.DELAYED_IMPORT_BLOCK,
+    WorkType.GOSSIP_BLOCK,
+    WorkType.GOSSIP_BLOB_SIDECAR,
+})
 
 # longest a deadline flush may be held for coalescing while the dispatch
 # thread is busy: bounds queue wait for sub-max batches when back-to-back
@@ -126,6 +151,25 @@ _BATCHABLE = {
     WorkType.GOSSIP_ATTESTATION: WorkType.GOSSIP_ATTESTATION_BATCH,
     WorkType.GOSSIP_AGGREGATE: WorkType.GOSSIP_AGGREGATE_BATCH,
 }
+
+
+def queue_wait_histogram():
+    """The beacon_processor_queue_wait_seconds family (this module is
+    its sole owner; the firehose driver reads quantiles through here)."""
+    return REGISTRY.histogram(
+        "beacon_processor_queue_wait_seconds",
+        "enqueue->dequeue wait per work event, by work type")
+
+
+def _with_ingest_stall(batch_fn, payloads):
+    """Batch-callable wrapper run ON the dispatch/worker thread: honors
+    an active slow-consumer ingest storm (ops/faults.IngestPlan
+    mode=stall, armable via LHTPU_INGEST_FAULT_MODE) so chaos drills can
+    wedge the REAL consumer, not just a bench harness."""
+    stall = faults.consumer_stall_s()
+    if stall:
+        time.sleep(stall)
+    return batch_fn(payloads)
 
 
 def _record_inflight(n: int) -> None:
@@ -187,11 +231,29 @@ class ProcessorMetrics:
     enqueued: dict[WorkType, int] = field(default_factory=dict)
     processed: dict[WorkType, int] = field(default_factory=dict)
     dropped: dict[WorkType, int] = field(default_factory=dict)
+    # (work_type, reason) -> count; the in-process mirror of the labeled
+    # processor_shed_total family.  Invariant the firehose drill holds:
+    # enqueued == processed + shed + still-queued, per work type.
+    shed: dict[tuple[WorkType, str], int] = field(default_factory=dict)
     batches_formed: int = 0
     batch_lanes: int = 0
+    # submit() races from producer threads: a bare read-modify-write
+    # would lose counts exactly when the books matter most (under flood)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
 
     def bump(self, table: dict, wt: WorkType, by: int = 1):
-        table[wt] = table.get(wt, 0) + by
+        with self._lock:
+            table[wt] = table.get(wt, 0) + by
+
+    def bump_shed(self, wt: WorkType, reason: str, by: int = 1):
+        with self._lock:
+            key = (wt, reason)
+            self.shed[key] = self.shed.get(key, 0) + by
+
+    def shed_total(self, wt: WorkType | None = None) -> int:
+        return sum(n for (w, _r), n in self.shed.items()
+                   if wt is None or w is wt)
 
 
 class BeaconProcessor:
@@ -223,6 +285,10 @@ class BeaconProcessor:
         self._wakeup = asyncio.Event()
         self._stopped = False
         self._manager_task: asyncio.Task | None = None
+        self._sweeper_task: asyncio.Task | None = None
+        # True while the manager holds popped-but-unscheduled work
+        # (parked on _idle.acquire); read by drain()
+        self._manager_holding = False
         self._executor = ThreadPoolExecutor(max_workers=self.max_workers)
         # ONE dedicated dispatch thread for device batches: batch work
         # from every batchable type serializes here back-to-back, so the
@@ -260,13 +326,31 @@ class BeaconProcessor:
         # mutated only on the event loop
         self._dispatch_inflight = 0
         self._inflight: set[asyncio.Task] = set()
-        # first-seen timestamps for batch flush decisions
-        self._batch_deadline: dict[WorkType, float] = {}
+        # first-seen timestamps for batch flush decisions (the flush
+        # deadline is computed at sweep time so the ladder's
+        # coalesce-harder rung can stretch it for already-queued work)
+        self._batch_first_seen: dict[WorkType, float] = {}
+        # --- admission control: per-WorkType watermarks + the
+        # degradation ladder over the flood lanes (processor/admission).
+        # Swept from the manager loop; drills call sweep_now() directly.
+        self.admission = AdmissionController(
+            governed=(WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE),
+            shed_order=(WorkType.GOSSIP_ATTESTATION,
+                        WorkType.GOSSIP_AGGREGATE))
+        self.admit_sweep_s = envreg.get_float("LHTPU_ADMIT_SWEEP_S", 0.05)
+        # unprotected (flood-lane) work currently scheduled; the manager
+        # keeps this strictly below max_workers so one slot always
+        # remains for _PROTECTED_TYPES.  Mutated only on the event loop.
+        self._unprotected_inflight = 0
+        self._shed_counter = REGISTRY.counter(
+            "processor_shed_total",
+            "work events discarded by admission control / queue policy, "
+            "by work type and reason")
+        # sheds awaiting their aggregated trace event (flushed per sweep)
+        self._shed_pending: dict[tuple[WorkType, str], int] = {}
         # labeled registry families (one series per WorkType label);
         # ProcessorMetrics above stays as the in-process test surface
-        self._wait_hist = REGISTRY.histogram(
-            "beacon_processor_queue_wait_seconds",
-            "enqueue->dequeue wait per work event, by work type")
+        self._wait_hist = queue_wait_histogram()
         self._batch_hist = REGISTRY.histogram(
             "beacon_processor_batch_size_lanes",
             "lanes per formed device batch, by work type",
@@ -280,41 +364,113 @@ class BeaconProcessor:
         # the per-call cost must stay one observe()/inc()
         self._label_memo: dict[tuple, Any] = {}
 
-    def _labeled(self, family, wt: WorkType, outcome: str | None = None):
-        key = (family.name, wt, outcome)
+    def _labeled(self, family, wt: WorkType, outcome: str | None = None,
+                 reason: str | None = None):
+        key = (family.name, wt, outcome, reason)
         child = self._label_memo.get(key)
         if child is None:
             labels = {"work_type": wt.name.lower()}
             if outcome is not None:
                 labels["outcome"] = outcome
+            if reason is not None:
+                labels["reason"] = reason
             child = self._label_memo[key] = family.labels(**labels)
         return child
 
+    def _account_shed(self, wt: WorkType, reason: str, n: int = 1) -> None:
+        """EVERY discard of queued (or submitted) work funnels through
+        here: the labeled processor_shed_total series, the in-process
+        mirrors, and (aggregated per sweep) a trace event.  The firehose
+        acceptance criterion — zero unaccounted drops — is this helper
+        being the only discard path.
+
+        Tracing is deferred: a span per shed event would take the
+        tracer's process-wide lock once per gossip message exactly when
+        tens of thousands/s are being shed, so sheds accumulate in
+        ``_shed_pending`` and ``sweep_now`` emits ONE span per
+        (work_type, reason) carrying the count since the last sweep."""
+        self.metrics.bump(self.metrics.dropped, wt, n)
+        self.metrics.bump_shed(wt, reason, n)
+        self._labeled(self._event_counter, wt, "dropped").inc(n)
+        self._labeled(self._shed_counter, wt, reason=reason).inc(n)
+        with self.metrics._lock:
+            key = (wt, reason)
+            self._shed_pending[key] = self._shed_pending.get(key, 0) + n
+
+    def _trace_pending_sheds(self) -> None:
+        with self.metrics._lock:
+            pending, self._shed_pending = self._shed_pending, {}
+        for (wt, reason), n in pending.items():
+            with tracing.span("beacon_processor.shed",
+                              work_type=wt.name.lower(), reason=reason,
+                              count=n):
+                pass
+
+    def shed_queue(self, wt: WorkType, reason: str = "purged") -> int:
+        """Discard EVERYTHING queued on one lane, accounted under
+        ``reason`` — the operator's backlog purge (a poisoned or stale
+        backlog after a storm is often worth less than the fresh traffic
+        behind it).  Returns the number of events shed."""
+        q = self._queues[wt]
+        n = 0
+        while True:
+            try:
+                q.popleft()
+            except IndexError:
+                break
+            n += 1
+        if n:
+            self._account_shed(wt, reason, n)
+        self._batch_first_seen.pop(wt, None)
+        return n
+
     # -- submission (any task/thread) -------------------------------------
 
-    def submit(self, event: WorkEvent) -> bool:
-        """Enqueue work; returns False if the queue was full and the event
-        (or the oldest event, for LIFO gossip queues) was dropped."""
+    def submit(self, event: WorkEvent) -> Admission:
+        """Enqueue work.  Returns a truthy :class:`Admission` when the
+        event was queued; a falsy one (with ``reason`` and, for
+        reject-newest lanes, a ``retry_after_s`` backoff hint) when it
+        was shed.  A LIFO gossip lane over its limit still accepts the
+        newest event and sheds its OLDEST instead — that drop is
+        accounted but the submitted event lands, so the call returns
+        accepted."""
         wt = event.work_type
         q = self._queues[wt]
         limit = self._lengths.get(wt, 1024)
         self.metrics.bump(self.metrics.enqueued, wt)
         self._labeled(self._event_counter, wt, "enqueued").inc()
-        accepted = True
+        reason = self.admission.shed_reason(wt)
+        if reason is not None:
+            # degradation-ladder shed: refused at the door, before any
+            # queue state is touched
+            self._account_shed(wt, reason)
+            self._wakeup.set()
+            return Admission(False, reason=reason)
         if len(q) >= limit:
-            self.metrics.bump(self.metrics.dropped, wt)
-            self._labeled(self._event_counter, wt, "dropped").inc()
             if wt in _LIFO_TYPES:
-                q.popleft()  # drop oldest, keep newest
+                try:
+                    q.popleft()  # drop oldest, keep newest
+                except IndexError:
+                    # racing producers both saw a full queue and the
+                    # manager drained it first — nothing was discarded,
+                    # so nothing is accounted (a phantom shed would
+                    # break the zero-unaccounted-drops books the other
+                    # way: shed counted with no event missing)
+                    pass
+                else:
+                    self._account_shed(wt, "queue_full_drop_oldest")
             else:
-                accepted = False
-        if accepted:
-            q.append(event)
-            if wt in _BATCHABLE and wt not in self._batch_deadline:
-                self._batch_deadline[wt] = (
-                    time.monotonic() + self.batch_flush_ms / 1000.0)
+                self._account_shed(wt, "queue_full_reject_newest")
+                self._wakeup.set()
+                return Admission(
+                    False, reason="queue_full_reject_newest",
+                    retry_after_s=self.admission.retry_after_s(
+                        len(q), limit))
+        q.append(event)
+        if wt in _BATCHABLE and wt not in self._batch_first_seen:
+            self._batch_first_seen[wt] = time.monotonic()
         self._wakeup.set()
-        return accepted
+        return ACCEPTED
 
     def queue_len(self, wt: WorkType) -> int:
         return len(self._queues[wt])
@@ -325,6 +481,7 @@ class BeaconProcessor:
         if self._manager_task is None:
             self._stopped = False
             self._manager_task = asyncio.ensure_future(self._manager())
+            self._sweeper_task = asyncio.ensure_future(self._sweeper())
 
     async def stop(self, drain: bool = True):
         if drain:
@@ -334,11 +491,34 @@ class BeaconProcessor:
         if self._manager_task is not None:
             await self._manager_task
             self._manager_task = None
+        if self._sweeper_task is not None:
+            self._sweeper_task.cancel()
+            try:
+                await self._sweeper_task
+            except asyncio.CancelledError:
+                pass
+            self._sweeper_task = None
+
+    async def _sweeper(self):
+        """Dedicated ladder-sweep cadence.  The manager loop cannot own
+        it: it parks on an unbounded ``_idle.acquire()`` whenever every
+        worker is busy — which is exactly the overload moment the ladder
+        must keep observing (a wedged dispatch batch would otherwise
+        freeze escalation for the whole wedge deadline)."""
+        while not self._stopped:
+            self.sweep_now()
+            await asyncio.sleep(self.admit_sweep_s or 0.05)
 
     async def drain(self):
-        """Wait until every queue is empty and all workers are idle."""
+        """Wait until every queue is empty and all workers are idle.
+        ``_manager_holding`` covers the window where the manager has
+        POPPED work but is still parked on ``_idle.acquire()`` — queues
+        and inflight are both empty there, yet work exists; returning
+        then would break every books-balance assertion built on
+        drain."""
         while True:
-            busy = any(self._queues[wt] for wt in WorkType) or self._inflight
+            busy = (any(self._queues[wt] for wt in WorkType)
+                    or self._inflight or self._manager_holding)
             if not busy:
                 return
             await asyncio.sleep(0.002)
@@ -355,10 +535,31 @@ class BeaconProcessor:
                 except asyncio.TimeoutError:
                     pass
                 continue
-            await self._idle.acquire()
-            task = asyncio.ensure_future(self._run_work(event_or_batch))
-            self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
+            first = (event_or_batch[0] if isinstance(event_or_batch, list)
+                     else event_or_batch)
+            unprotected = first.work_type not in _PROTECTED_TYPES
+            self._manager_holding = True
+            try:
+                await self._idle.acquire()
+                if unprotected:
+                    self._unprotected_inflight += 1
+                task = asyncio.ensure_future(
+                    self._run_work(event_or_batch, unprotected))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
+            finally:
+                self._manager_holding = False
+
+    def sweep_now(self) -> int:
+        """One admission-ladder observation over the governed queue
+        depths (the dedicated _sweeper task runs this at
+        LHTPU_ADMIT_SWEEP_S cadence; drills/tests call it directly).
+        Also flushes the aggregated shed trace events accumulated since
+        the last sweep."""
+        self._trace_pending_sheds()
+        return self.admission.sweep({
+            wt: (len(self._queues[wt]), self._lengths.get(wt, 1024))
+            for wt in self.admission.governed})
 
     def _journal_emit(self, token: str):
         if self._journal is not None:
@@ -366,15 +567,28 @@ class BeaconProcessor:
 
     def _next_work(self):
         """Pick the highest-priority queue with work; form batches
-        opportunistically for attestations/aggregates."""
+        opportunistically for attestations/aggregates.
+
+        Priority isolation: unprotected (flood-lane) work is only
+        scheduled while at least one worker slot stays free for
+        _PROTECTED_TYPES, so a saturated attestation plane can never
+        occupy the slot a gossip block or chain segment needs."""
         now = time.monotonic()
+        reserve_busy = (
+            self._unprotected_inflight >= max(1, self.max_workers - 1))
+        flush_s = (self.batch_flush_ms / 1000.0
+                   * self.admission.flush_factor())
         for wt in PRIORITY_ORDER:
             q = self._queues[wt]
             if not q:
                 continue
+            if reserve_busy and wt not in _PROTECTED_TYPES:
+                continue
             if wt in _BATCHABLE:
                 n = len(q)
-                deadline = self._batch_deadline.get(wt, 0.0)
+                first_seen = self._batch_first_seen.get(wt)
+                deadline = (now if first_seen is None
+                            else first_seen + flush_s)
                 # cross-batch coalescing: while a batch is in flight on
                 # the dispatch thread, deadline flushes HOLD — events
                 # arriving during the flight merge into one next sweep
@@ -392,7 +606,10 @@ class BeaconProcessor:
                     take = min(n, self.max_batch)
                     events = [q.popleft() for _ in range(take)]
                     if not q:
-                        self._batch_deadline.pop(wt, None)
+                        self._batch_first_seen.pop(wt, None)
+                    # non-empty remainder keeps its (already expired)
+                    # window, so it flushes on the next sweep — same
+                    # behaviour the absolute-deadline bookkeeping had
                     wait_child = self._labeled(self._wait_hist, wt)
                     for e in events:
                         wait_child.observe(now - e.enqueued_at)
@@ -414,13 +631,15 @@ class BeaconProcessor:
             return event
         return None
 
-    async def _run_work(self, work):
+    async def _run_work(self, work, unprotected: bool = False):
         try:
             if isinstance(work, list):
                 await self._run_batch(work)
             else:
                 await self._run_one(work)
         finally:
+            if unprotected:
+                self._unprotected_inflight -= 1
             self._idle.release()
             self._wakeup.set()
 
@@ -493,17 +712,20 @@ class BeaconProcessor:
             # dispatch executor is presumed wedged-and-unreplaceable —
             # go straight to the synchronous path instead of queueing
             # behind it for another full wedge deadline per batch
-            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            await loop.run_in_executor(self._executor, _with_ingest_stall,
+                                       batch_fn, payloads)
             return
         gen = self._dispatch_generation
         try:
             fut = loop.run_in_executor(
-                self._dispatch_executor, batch_fn, payloads)
+                self._dispatch_executor, _with_ingest_stall, batch_fn,
+                payloads)
         except RuntimeError as e:
             # executor shut down / thread unspawnable: a DEAD dispatch
             # thread — replace it and serve this batch synchronously
             self._recover_dispatch("dead", gen, e)
-            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            await loop.run_in_executor(self._executor, _with_ingest_stall,
+                                       batch_fn, payloads)
             return
         wedge = self.dispatch_wedge_s
         if not wedge or wedge <= 0:
@@ -517,7 +739,8 @@ class BeaconProcessor:
             # thread keeps its GIL turns until it dies with the old
             # executor), restart, and drain this batch synchronously.
             self._recover_dispatch("wedged", gen, None)
-            await loop.run_in_executor(self._executor, batch_fn, payloads)
+            await loop.run_in_executor(self._executor, _with_ingest_stall,
+                                       batch_fn, payloads)
 
     def _restart_budget_exhausted(self) -> bool:
         """True while the restart-storm limiter is saturated (prunes

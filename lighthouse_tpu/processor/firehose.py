@@ -1,0 +1,237 @@
+"""Sustained-ingest firehose driver: continuous arrival, storms, books.
+
+The flood bench (bench.py --child-flood) answers "how fast does one
+pre-built batch verify"; this module answers the ROADMAP item 1
+question: what happens when arrival NEVER stops.  It drives a
+:class:`~lighthouse_tpu.processor.BeaconProcessor` with a continuous
+per-subnet payload stream, holds a target number of events in flight,
+optionally opens an :class:`~lighthouse_tpu.ops.faults.IngestPlan`
+storm (burst / slow-consumer stall / duplicate flood / invalid-signature
+flood), and keeps double-entry books the acceptance drill audits:
+
+    enqueued == processed + shed + still-queued   (per work type)
+
+Every discard the processor makes is visible in
+``processor_shed_total{work_type,reason}``; :func:`ledger` recomputes
+the invariant from the in-process mirrors and reports any unaccounted
+remainder (which must be zero).
+
+Used by ``bench.py --child-firehose`` (real attestations through the
+chain's batch-BLS pipeline) and by the zero-XLA drills in
+tests/test_processor.py (dummy payloads, same queue policies).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from lighthouse_tpu.ops import faults
+from lighthouse_tpu.processor.beacon_processor import (
+    BeaconProcessor,
+    WorkEvent,
+    WorkType,
+    queue_wait_histogram,
+)
+
+
+def queue_wait_percentiles(wt: WorkType,
+                           qs: tuple[float, ...] = (0.5, 0.99)) -> dict:
+    """Interpolated quantiles of the enqueue->dequeue wait for one work
+    type, read from the beacon_processor_queue_wait_seconds histogram
+    (the PR 1 tracing's labeled series)."""
+    child = queue_wait_histogram().labels(work_type=wt.name.lower())
+    with child._lock:
+        counts = list(child.counts)
+        n = child.n
+        buckets = child.buckets
+    out = {}
+    for q in qs:
+        key = f"p{int(q * 100)}"
+        if n == 0:
+            out[key] = 0.0
+            continue
+        target = q * n
+        cum = 0
+        lo = 0.0
+        value = buckets[-1]
+        for b, c in zip(buckets, counts[:-1]):
+            if c and cum + c >= target:
+                value = lo + (b - lo) * ((target - cum) / c)
+                break
+            cum += c
+            lo = b
+        out[key] = value
+    return out
+
+
+def ledger(bp: BeaconProcessor) -> dict:
+    """Double-entry audit of the processor's books.
+
+    Per work type: enqueued, processed, shed (by reason), still queued,
+    and ``unaccounted = enqueued - processed - shed - queued`` — the
+    firehose acceptance criterion is that unaccounted is zero for every
+    lane once the processor drains."""
+    out: dict[str, dict] = {}
+    m = bp.metrics
+    for wt in WorkType:
+        enq = m.enqueued.get(wt, 0)
+        if not enq:
+            continue
+        shed = {r: n for (w, r), n in m.shed.items() if w is wt}
+        row = {
+            "enqueued": enq,
+            "processed": m.processed.get(wt, 0),
+            "shed": shed,
+            "queued": bp.queue_len(wt),
+        }
+        row["unaccounted"] = (row["enqueued"] - row["processed"]
+                              - sum(shed.values()) - row["queued"])
+        out[wt.name.lower()] = row
+    return out
+
+
+def unaccounted_total(bp: BeaconProcessor) -> int:
+    return sum(row["unaccounted"] for row in ledger(bp).values())
+
+
+@dataclass
+class PhaseStats:
+    label: str
+    seconds: float = 0.0
+    submitted: int = 0
+    accepted: int = 0
+    shed_at_admission: int = 0
+    processed_delta: int = 0
+    rung_max: int = 0
+    rung_end: int = 0
+
+    @property
+    def per_s(self) -> float:
+        return self.processed_delta / self.seconds if self.seconds else 0.0
+
+
+class FirehoseDriver:
+    """Continuous-arrival pump over one batchable work-type lane.
+
+    ``make_payload(i)`` produces the i-th honest payload (the caller
+    decides whether that is a real attestation or a test token);
+    ``corrupt(payload)`` produces an invalid-signature variant for
+    ``mode="invalid"`` storms.  ``process_batch`` is wrapped so
+    slow-consumer storms can stall it via
+    :func:`lighthouse_tpu.ops.faults.consumer_stall_s`.
+    """
+
+    def __init__(
+        self,
+        bp: BeaconProcessor,
+        make_payload: Callable[[int], Any],
+        process_batch: Callable[[list], Any],
+        work_type: WorkType = WorkType.GOSSIP_ATTESTATION,
+        corrupt: Callable[[Any], Any] | None = None,
+    ):
+        self.bp = bp
+        self.work_type = work_type
+        self.make_payload = make_payload
+        self.corrupt = corrupt
+        self._inner_process = process_batch
+        self._seq = 0
+
+    def _process(self, payloads: list) -> Any:
+        # slow-consumer stalls are injected by the PROCESSOR's own
+        # dispatch wrapper (beacon_processor._with_ingest_stall) — the
+        # storm hits the real consumer path, not a harness shim
+        return self._inner_process(payloads)
+
+    def _payload_stream(self, plan: faults.IngestPlan | None
+                        ) -> Iterable[Any]:
+        """One storm-shaped arrival wave: honest payloads, plus
+        duplicate / invalid copies per the plan."""
+        while True:
+            payload = self.make_payload(self._seq)
+            self._seq += 1
+            yield payload
+            if plan is None:
+                continue
+            copies = max(0, int(plan.factor) - 1)
+            if plan.mode == "dup":
+                for _ in range(copies):
+                    yield payload
+            elif plan.mode == "invalid" and self.corrupt is not None:
+                for _ in range(copies):
+                    yield self.corrupt(payload)
+
+    async def run_phase(
+        self,
+        label: str,
+        seconds: float,
+        inflight_target: int,
+        plan: faults.IngestPlan | None = None,
+        on_tick: Callable[["PhaseStats"], None] | None = None,
+    ) -> PhaseStats:
+        """Hold ``inflight_target`` events resident in the lane's queue
+        for ``seconds`` (arrival refills whatever the consumer drains —
+        sustained ingest, not a one-shot batch).  Under a ``burst``
+        storm the refill target multiplies by ``plan.factor``, pushing
+        the lane over its watermarks on purpose.
+
+        A phase with ``plan=None`` does not clear an externally-armed
+        plan (LHTPU_INGEST_FAULT_MODE / install_ingest_plan): the
+        background storm keeps blowing, shapes this phase's arrival,
+        and is restored after any phase that installed its own."""
+        prior = faults.snapshot_ingest_plan()
+        if plan is not None:
+            faults.install_ingest_plan(plan)
+        else:
+            plan = faults.active_ingest_plan()
+        stats = PhaseStats(label=label)
+        wt = self.work_type
+        m = self.bp.metrics
+        processed0 = m.processed.get(wt, 0)
+        stream = self._payload_stream(plan)
+        t0 = time.monotonic()
+        deadline = t0 + seconds
+        target = inflight_target
+        if plan is not None and plan.mode == "burst":
+            target = int(inflight_target * max(1.0, plan.factor))
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                deficit = target - self.bp.queue_len(wt)
+                for _ in range(max(0, deficit)):
+                    payload = next(stream)
+                    verdict = self.bp.submit(WorkEvent(
+                        wt, payload=payload, process_batch=self._process))
+                    stats.submitted += 1
+                    if verdict:
+                        stats.accepted += 1
+                    else:
+                        stats.shed_at_admission += 1
+                stats.rung_max = max(stats.rung_max, self.bp.admission.rung)
+                if on_tick is not None:
+                    stats.seconds = now - t0
+                    stats.processed_delta = m.processed.get(wt, 0) - processed0
+                    on_tick(stats)
+                # yield to the manager loop; the flush interval is the
+                # natural arrival granularity
+                await asyncio.sleep(self.bp.batch_flush_ms / 1000.0)
+        finally:
+            faults.restore_ingest_plan(prior)
+        stats.seconds = time.monotonic() - t0
+        stats.processed_delta = m.processed.get(wt, 0) - processed0
+        stats.rung_max = max(stats.rung_max, self.bp.admission.rung)
+        stats.rung_end = self.bp.admission.rung
+        return stats
+
+
+__all__ = [
+    "FirehoseDriver",
+    "PhaseStats",
+    "ledger",
+    "queue_wait_percentiles",
+    "unaccounted_total",
+]

@@ -16,6 +16,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from lighthouse_tpu.pool.accounting import record_pool_dropped
 from lighthouse_tpu.processor.beacon_processor import BeaconProcessor, WorkEvent
 
 # reference work_reprocessing_queue.rs:40-51
@@ -57,6 +58,7 @@ class ReprocessQueue:
         block is imported, or drop after `timeout` (reference behaviour:
         expired unknown-block attestations are discarded, :447)."""
         if self._n_parked >= MAX_QUEUED_ATTESTATIONS:
+            record_pool_dropped("reprocess", "capacity")
             return False
         self._by_root[block_root].append(
             _Parked(event, time.monotonic() + timeout, block_root))
@@ -99,12 +101,14 @@ class ReprocessQueue:
             self._timers = [(at, e) for at, e in self._timers if at > now]
             for e in due:
                 self.processor.submit(e)
-            # expire unknown-root attestations
+            # expire unknown-root attestations — an accounted discard:
+            # the block never arrived and the parked work dies here
             for root in list(self._by_root):
                 keep = []
                 for p in self._by_root[root]:
                     if p.expires <= now:
                         self._n_parked -= 1
+                        record_pool_dropped("reprocess", "expired")
                     else:
                         keep.append(p)
                 if keep:

@@ -594,6 +594,117 @@ def test_store_pass_suppression(tmp_path):
     assert analyze(pkg) == []
 
 
+# -- pass 8: accounted shed (LH603) -------------------------------------------
+
+
+def test_shed_pass_flags_unaccounted_del(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"pool/naive_aggregation.py": """
+        class Pool:
+            def prune_below(self, slot):
+                for s in [s for s in self._slots if s < slot]:
+                    del self._slots[s]
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH603"]
+    assert findings[0].symbol == "Pool.prune_below:_slots"
+    assert "_shed_total" in findings[0].message
+
+
+def test_shed_pass_flags_discarded_pop(tmp_path):
+    # an Expr-statement pop throws the removed work away
+    pkg, _ = make_pkg(tmp_path, {"processor/reprocess.py": """
+        class Queue:
+            def expire(self, root):
+                self._by_root.pop(root, None)
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH603"]
+    assert findings[0].symbol == "Queue.expire:_by_root.pop"
+
+
+def test_shed_pass_compliant_twin_metric_literal(tmp_path):
+    # same discard, accounted via a direct *_dropped_total registration
+    pkg, _ = make_pkg(tmp_path, {"pool/naive_aggregation.py": """
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        class Pool:
+            def prune_below(self, slot):
+                for s in [s for s in self._slots if s < slot]:
+                    REGISTRY.counter("pool_dropped_total").inc()
+                    del self._slots[s]
+    """})
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_compliant_twin_helper_call(tmp_path):
+    # accounting through a package helper (record-*-drop naming) counts
+    pkg, _ = make_pkg(tmp_path, {
+        "pool/accounting.py": """
+            def record_pool_dropped(pool, reason, n=1):
+                from lighthouse_tpu.common.metrics import REGISTRY
+                REGISTRY.counter("pool_dropped_total").inc(n)
+        """,
+        "pool/naive_aggregation.py": """
+            from pkg.pool.accounting import record_pool_dropped
+
+            class Pool:
+                def prune_below(self, slot):
+                    for s in [s for s in self._slots if s < slot]:
+                        record_pool_dropped("naive", "finalized")
+                        del self._slots[s]
+        """,
+    })
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_bound_pop_is_not_a_discard(tmp_path):
+    # a pop whose result is processed is work HANDLED, not shed
+    pkg, _ = make_pkg(tmp_path, {"processor/reprocess.py": """
+        class Queue:
+            def flush(self, root):
+                for parked in self._by_root.pop(root, []):
+                    self.processor.submit(parked)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_bookkeeping_receivers_exempt(tmp_path):
+    # flush timestamps / restart stamps never hold work items
+    pkg, _ = make_pkg(tmp_path, {"processor/beacon_processor.py": """
+        class BP:
+            def tidy(self, wt):
+                self._batch_first_seen.pop(wt, None)
+                self._dispatch_restarts.popleft()
+    """})
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_out_of_scope_modules_ignored(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/gossip.py": """
+        class Cache:
+            def evict(self, k):
+                del self._seen[k]
+    """})
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_suppression(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"pool/operation_pool.py": """
+        class Pool:
+            def evict(self, k):
+                del self._ops[k]  # lhlint: allow(LH603)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_shed_pass_real_tree_zero_findings():
+    """The real tree carries NO unaccounted shed paths (fixed, not
+    baselined): every processor/pool discard routes through
+    _account_shed / record_pool_dropped."""
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    assert [f for f in findings if f.rule == "LH603"] == []
+
+
 # -- baseline machinery -------------------------------------------------------
 
 

@@ -437,3 +437,80 @@ class TestLightClientUpdatesByRange:
         payload = json.loads(chunks[0])
         assert "next_sync_committee" in payload
         assert payload["next_sync_committee"]["pubkeys"]
+
+
+class TestProcessorFanIn:
+    """Router with a BeaconProcessor attached: gossip attestations ride
+    the admission-controlled batch queues, and the batch path keeps the
+    inline path's peer-downscoring contract."""
+
+    def test_batch_handler_downscores_invalid_only(self):
+        from lighthouse_tpu.network.router import Router
+
+        reports = []
+
+        class Peers:
+            def report(self, peer, level, **kw):
+                reports.append((peer, level))
+
+        class ChainStub:
+            def verify_attestations_for_gossip(self, atts):
+                # first att invalid, second a benign stale reject
+                return [], [(atts[0], "invalid_signature"),
+                            (atts[1], "past_slot")]
+
+        router = Router.__new__(Router)
+        router.chain = ChainStub()
+        router.peers = Peers()
+        a1, a2 = object(), object()
+        router._verify_attestation_batch([(a1, "evil-peer"),
+                                          (a2, "honest-peer")])
+        assert reports == [("evil-peer", "low")]
+
+    def test_gossip_attestations_flow_through_processor(self):
+        import asyncio
+
+        from lighthouse_tpu.network.router import Router, topic
+        from lighthouse_tpu.network.rpc import RpcFabric
+        from lighthouse_tpu.processor import (
+            BeaconProcessor, WorkType)
+        from lighthouse_tpu.processor.firehose import unaccounted_total
+
+        h = Harness(n_validators=64, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        signed = h.produce_block()
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        chain.slot_clock.set_slot(int(signed.message.slot))
+        chain.process_block(signed)
+        att = h.attest()
+        chain.slot_clock.set_slot(int(att.data.slot) + 1)
+
+        async def main():
+            bp = BeaconProcessor(max_workers=2, batch_flush_ms=5)
+            hub = GossipHub()
+            node_ep, peer_ep = hub.join("node"), hub.join("peer")
+            Router(chain, node_ep, RpcFabric().join("node"),
+                   PeerManager(), processor=bp)
+            await bp.start()
+            n = len(att.aggregation_bits)
+            for i in range(n):
+                bits = [False] * n
+                bits[i] = True
+                single = type(att)(aggregation_bits=bits, data=att.data,
+                                   signature=bytes(att.signature))
+                peer_ep.publish(topic(chain, "beacon_attestation_0"),
+                                single.serialize())
+            import time as _t
+
+            t0 = _t.monotonic()
+            while bp.metrics.processed.get(
+                    WorkType.GOSSIP_ATTESTATION, 0) < n:
+                assert _t.monotonic() - t0 < 10, "atts never processed"
+                await asyncio.sleep(0.01)
+            await bp.drain()
+            await bp.stop()
+            assert bp.metrics.batches_formed >= 1
+            assert len(chain.naive_pool) >= 1
+            assert unaccounted_total(bp) == 0
+
+        asyncio.run(main())

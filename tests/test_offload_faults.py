@@ -518,3 +518,90 @@ def test_concurrent_faulted_batches_one_restart(sets):
         await bp.stop()
 
     _run(main())
+
+
+# -- ingest storms (IngestPlan) -----------------------------------------------
+
+
+class TestIngestPlan:
+    def teardown_method(self):
+        faults.install_ingest_plan(None)
+
+    def test_modes_validated(self):
+        with pytest.raises(ValueError):
+            faults.IngestPlan(mode="meteor")
+        for mode in faults.VALID_INGEST_MODES:
+            faults.IngestPlan(mode=mode)
+
+    def test_env_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_INGEST_FAULT_MODE", "dup")
+        monkeypatch.setenv("LHTPU_INGEST_FAULT_FACTOR", "7")
+        monkeypatch.setenv("LHTPU_INGEST_FAULT_S", "3.5")
+        plan = faults.ingest_plan_from_env()
+        assert plan is not None
+        assert (plan.mode, plan.factor, plan.duration_s) == ("dup", 7.0, 3.5)
+
+    def test_env_unset_means_no_storm(self, monkeypatch):
+        monkeypatch.delenv("LHTPU_INGEST_FAULT_MODE", raising=False)
+        assert faults.ingest_plan_from_env() is None
+
+    def test_malformed_mode_disables_with_warning(self, monkeypatch, capsys):
+        monkeypatch.setenv("LHTPU_INGEST_FAULT_MODE", "meteor")
+        faults._WARNED_INGEST_ENV = False
+        assert faults.ingest_plan_from_env() is None
+        assert "ingest storm disabled" in capsys.readouterr().err
+        # warns once per process
+        assert faults.ingest_plan_from_env() is None
+        assert capsys.readouterr().err == ""
+
+    def test_consumer_stall_only_in_stall_mode(self):
+        faults.install_ingest_plan(
+            faults.IngestPlan("stall", stall_s=0.123))
+        assert faults.consumer_stall_s() == 0.123
+        faults.install_ingest_plan(faults.IngestPlan("burst"))
+        assert faults.consumer_stall_s() == 0.0
+        faults.install_ingest_plan(None)
+        assert faults.consumer_stall_s() == 0.0
+
+    def test_env_armed_storm_self_expires(self):
+        plan = faults.IngestPlan("stall", stall_s=0.2, duration_s=0.05)
+        faults.install_ingest_plan(plan, duration_s=plan.duration_s)
+        assert faults.consumer_stall_s() == 0.2
+        time.sleep(0.06)
+        assert faults.active_ingest_plan() is None  # window closed
+        assert faults.consumer_stall_s() == 0.0
+
+    def test_programmatic_install_does_not_expire(self):
+        plan = faults.IngestPlan("stall", stall_s=0.1, duration_s=0.01)
+        faults.install_ingest_plan(plan)  # no duration: driver-bounded
+        time.sleep(0.02)
+        assert faults.active_ingest_plan() is plan
+
+    def test_phase_restore_preserves_env_storm_expiry(self):
+        """A drill phase must not unbound an env-armed storm's window
+        when it restores the prior plan."""
+        import asyncio
+
+        from lighthouse_tpu.processor import BeaconProcessor
+        from lighthouse_tpu.processor.firehose import FirehoseDriver
+
+        armed = faults.IngestPlan("stall", stall_s=0.01, duration_s=0.15)
+        faults.install_ingest_plan(armed, duration_s=armed.duration_s)
+
+        async def main():
+            bp = BeaconProcessor(max_workers=2, batch_flush_ms=1)
+            drv = FirehoseDriver(bp, make_payload=lambda i: i,
+                                 process_batch=lambda ps: None)
+            await bp.start()
+            await drv.run_phase(
+                "mid", seconds=0.05, inflight_target=4,
+                plan=faults.IngestPlan("burst", factor=2.0))
+            await bp.drain()
+            await bp.stop()
+
+        asyncio.run(main())
+        # restored WITH its remaining window: still armed now...
+        assert faults.active_ingest_plan() is armed
+        time.sleep(0.15)
+        # ...and still self-expires when the original window lapses
+        assert faults.active_ingest_plan() is None

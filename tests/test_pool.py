@@ -148,6 +148,165 @@ class TestOperationPool:
         assert pool.num_attestations() == 0
 
 
+class TestPreAggregation:
+    """Pre-BLS coalescing (pool/pre_aggregation): the blinded
+    same-message merge must verify iff ALL constituents verify — the
+    soundness property the firehose's pairing savings rest on.  Real
+    crypto, tiny set counts (one pairing call per assertion)."""
+
+    @pytest.fixture(scope="class")
+    def keys(self):
+        from lighthouse_tpu.crypto import bls
+
+        return [bls.SecretKey.from_bytes(int(101 + i).to_bytes(32, "big"))
+                for i in range(4)]
+
+    def _singles(self, keys, msg):
+        from lighthouse_tpu.crypto import bls
+
+        return [bls.SignatureSet(sk.sign(msg), [sk.public_key()], msg)
+                for sk in keys]
+
+    def test_dedup_collapses_exact_duplicates(self, keys):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.pool.pre_aggregation import dedup_sets
+
+        msg = b"\x11" * 32
+        s = self._singles(keys[:1], msg)[0]
+        copy = bls.SignatureSet(
+            bls.Signature(s.signature.to_bytes()), list(s.pubkeys), msg)
+        out, stats = dedup_sets([s, copy, s])
+        assert len(out) == 1
+        assert stats.deduped == 2
+        assert stats.pairings_saved == 2
+
+    def test_merged_verifies_when_all_constituents_valid(self, keys):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        msg = b"\x22" * 32
+        out, stats = coalesce_sets(self._singles(keys, msg))
+        assert len(out) == 1 and stats.merged == len(keys)
+        assert bls.verify_signature_sets(out)
+
+    def test_merged_fails_when_any_constituent_invalid(self, keys):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        msg = b"\x33" * 32
+        sets = self._singles(keys, msg)
+        # one signer signed the WRONG message: a valid curve point, so
+        # the fold proceeds — the merged verdict must still be False
+        sets[2] = bls.SignatureSet(
+            keys[2].sign(b"\x44" * 32), [keys[2].public_key()], msg)
+        out, _ = coalesce_sets(sets)
+        assert len(out) == 1
+        assert not bls.verify_signature_sets(out)
+
+    def test_blinding_defeats_cancelling_pair(self, keys):
+        """The adversarial case the blinders exist for: two invalid
+        signatures crafted so their SUM equals the sum of two valid
+        ones.  An unblinded fold would verify; the blinded merge must
+        reject (up to 2^-64)."""
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        msg = b"\x55" * 32
+        good = [sk.sign(msg) for sk in keys[:2]]
+        delta = hash_to_g2(b"adversarial offset")
+        plus = cv.g2_add(good[0].point, delta)
+        minus = cv.g2_add(good[1].point, cv.g2_neg(delta))
+        forged = [bls.Signature(cv.g2_to_bytes(plus), plus),
+                  bls.Signature(cv.g2_to_bytes(minus), minus)]
+        sets = [bls.SignatureSet(sig, [sk.public_key()], msg)
+                for sig, sk in zip(forged, keys[:2])]
+        # sanity: the naive (unblinded) sum would have cancelled
+        naive_sum = cv.g2_add(plus, minus)
+        honest_sum = cv.g2_add(good[0].point, good[1].point)
+        assert cv.g2_to_bytes(naive_sum) == cv.g2_to_bytes(honest_sum)
+        out, stats = coalesce_sets(sets)
+        assert len(out) == 1 and stats.merged == 2
+        assert not bls.verify_signature_sets(out)
+
+    def test_overlapping_aggregate_bitfields_merge_as_multiset(self, keys):
+        """Two committee aggregates with OVERLAPPING bitfields (a shared
+        attester) merge as a pubkey multiset: valid pair verifies, one
+        bad aggregate poisons the merged verdict."""
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        msg = b"\x66" * 32
+        sig_a = bls.Signature.aggregate([keys[0].sign(msg),
+                                         keys[1].sign(msg)])
+        sig_b = bls.Signature.aggregate([keys[1].sign(msg),
+                                         keys[2].sign(msg)])
+        set_a = bls.SignatureSet(
+            sig_a, [keys[0].public_key(), keys[1].public_key()], msg)
+        set_b = bls.SignatureSet(
+            sig_b, [keys[1].public_key(), keys[2].public_key()], msg)
+        out, stats = coalesce_sets([set_a, set_b])
+        assert len(out) == 1 and stats.merged == 2
+        assert bls.verify_signature_sets(out)
+        # same overlap, but aggregate B is missing a contribution
+        bad_b = bls.SignatureSet(
+            bls.Signature(keys[1].sign(msg).to_bytes()),
+            [keys[1].public_key(), keys[2].public_key()], msg)
+        out, _ = coalesce_sets([set_a, bad_b])
+        assert len(out) == 1
+        assert not bls.verify_signature_sets(out)
+
+    def test_unmergeable_fake_signatures_pass_through(self):
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        msg = b"\x77" * 32
+        fake = [bls.SignatureSet(bls.Signature(bytes([i]) * 96),
+                                 [], msg) for i in range(2, 4)]
+        out, stats = coalesce_sets(fake)
+        assert len(out) == 2 and stats.merged == 0
+        assert stats.unmergeable == 2
+
+    def test_distinct_messages_stay_separate(self, keys):
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        sets = (self._singles(keys[:1], b"\x88" * 32)
+                + self._singles(keys[1:2], b"\x99" * 32))
+        out, stats = coalesce_sets(sets)
+        assert len(out) == 2 and stats.merged == 0
+
+    def test_env_kill_switch(self, keys, monkeypatch):
+        from lighthouse_tpu.pool.pre_aggregation import coalesce_sets
+
+        monkeypatch.setenv("LHTPU_PRE_BLS", "0")
+        sets = self._singles(keys, b"\xaa" * 32)
+        out, stats = coalesce_sets(sets)
+        assert out == sets and stats.pairings_saved == 0
+
+
+def test_pool_prunes_are_accounted():
+    """LH603 contract: pool evictions increment pool_dropped_total."""
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    fam = REGISTRY.counter(
+        "pool_dropped_total",
+        "items discarded from the aggregation/operation pools, by "
+        "pool and reason")
+    child = fam.labels(pool="naive_aggregation", reason="finalized")
+    before = child.value
+    h = Harness(n_validators=64, fork="altair", real_crypto=False)
+    from lighthouse_tpu.state_transition import state_transition
+
+    signed = h.produce_block()
+    state_transition(h.state, h.spec, signed, h._verify_strategy())
+    att = h.attest()
+    pool = NaiveAggregationPool()
+    pool.insert(att)
+    pool.prune_below(int(att.data.slot) + 1)
+    assert child.value == before + 1
+
+
 def test_chain_packs_pool_attestations():
     """End-to-end: gossip attestations flow naive-pool -> op-pool ->
     produced block (VERDICT round-1 #7: produce_block_on must pack from

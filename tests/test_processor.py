@@ -200,3 +200,423 @@ def test_duplicate_cache():
     assert not dc.check_and_insert(r)
     dc.release(r)
     assert dc.check_and_insert(r)
+
+
+# -- admission control + degradation ladder -----------------------------------
+
+
+from lighthouse_tpu.processor.admission import (  # noqa: E402
+    COALESCE,
+    NORMAL,
+    SHED_AGGREGATES,
+    SHED_UNAGGREGATED,
+    AdmissionController,
+)
+
+
+def _books_balance(bp):
+    """The zero-unaccounted-drops invariant, per work type."""
+    from lighthouse_tpu.processor.firehose import ledger
+
+    rows = ledger(bp)
+    assert all(r["unaccounted"] == 0 for r in rows.values()), rows
+    return rows
+
+
+class TestAdmissionController:
+    def _ctrl(self, **kw):
+        kw.setdefault("governed", ("atts", "aggs"))
+        kw.setdefault("shed_order", ("atts", "aggs"))
+        kw.setdefault("high", 0.75)
+        kw.setdefault("low", 0.25)
+        kw.setdefault("alpha", 1.0)  # instantaneous unless a test smooths
+        kw.setdefault("up_sweeps", 1)
+        return AdmissionController(**kw)
+
+    def test_escalates_through_every_rung(self):
+        c = self._ctrl()
+        for expected in (COALESCE, SHED_UNAGGREGATED, SHED_AGGREGATES):
+            assert c.sweep({"atts": (90, 100)}) == expected
+        # saturated ladder pegs at the top rung
+        assert c.sweep({"atts": (90, 100)}) == SHED_AGGREGATES
+        assert c.shed_reason("atts") == "ladder_unaggregated"
+        assert c.shed_reason("aggs") == "ladder_aggregates"
+        assert c.flush_factor() > 1.0
+
+    def test_hysteresis_band_holds_rung(self):
+        c = self._ctrl()
+        assert c.sweep({"atts": (90, 100)}) == COALESCE
+        # pressure drops into the band between the watermarks: the rung
+        # must HOLD — neither escalate nor recover (no flapping)
+        for _ in range(5):
+            assert c.sweep({"atts": (50, 100)}) == COALESCE
+        # and the band also resets the escalation streak
+        c2 = self._ctrl(up_sweeps=2)
+        assert c2.sweep({"atts": (90, 100)}) == NORMAL   # streak 1
+        assert c2.sweep({"atts": (50, 100)}) == NORMAL   # band: streak reset
+        assert c2.sweep({"atts": (90, 100)}) == NORMAL   # streak 1 again
+        assert c2.sweep({"atts": (90, 100)}) == COALESCE
+
+    def test_recovers_to_normal_in_one_sweep(self):
+        c = self._ctrl()
+        for _ in range(3):
+            c.sweep({"atts": (100, 100)})
+        assert c.rung == SHED_AGGREGATES
+        # the storm ends: a single sweep at/below the low watermark must
+        # restore full service (the acceptance drill's recovery bound)
+        assert c.sweep({"atts": (10, 100)}) == NORMAL
+        assert c.shed_reason("atts") is None
+        assert c.flush_factor() == 1.0
+
+    def test_up_sweeps_debounce(self):
+        c = self._ctrl(up_sweeps=3)
+        assert c.sweep({"atts": (90, 100)}) == NORMAL
+        assert c.sweep({"atts": (90, 100)}) == NORMAL
+        assert c.sweep({"atts": (90, 100)}) == COALESCE
+
+    def test_ewma_smooths_single_spike(self):
+        c = self._ctrl(alpha=0.2, up_sweeps=1)
+        # one instantaneous spike does not cross the smoothed watermark
+        assert c.sweep({"atts": (100, 100)}) == NORMAL
+        # sustained pressure does
+        for _ in range(12):
+            c.sweep({"atts": (100, 100)})
+        assert c.rung >= COALESCE
+
+
+class TestAdmissionInProcessor:
+    def test_fifo_reject_carries_backoff_hint(self):
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2, queue_lengths={WorkType.RPC_BLOCK: 2})
+            assert bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=1))
+            assert bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=2))
+            verdict = bp.submit(WorkEvent(WorkType.RPC_BLOCK, payload=3))
+            assert not verdict
+            assert verdict.reason == "queue_full_reject_newest"
+            assert verdict.retry_after_s > 0
+            assert bp.metrics.shed[
+                (WorkType.RPC_BLOCK, "queue_full_reject_newest")] == 1
+
+        run(main())
+
+    def test_lifo_drop_oldest_is_accounted(self):
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 4})
+            for i in range(6):
+                verdict = bp.submit(
+                    WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i))
+                assert verdict  # newest always lands on a LIFO lane
+            assert bp.metrics.shed[
+                (WorkType.GOSSIP_ATTESTATION, "queue_full_drop_oldest")] == 2
+            _books_balance(bp)
+
+        run(main())
+
+    def test_ladder_shed_refuses_at_the_door(self):
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 8,
+                               WorkType.GOSSIP_AGGREGATE: 8})
+            bp.admission.up_sweeps = 1
+            bp.admission.alpha = 1.0
+            for i in range(8):
+                bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i))
+            for _ in range(3):
+                bp.sweep_now()
+            assert bp.admission.rung == 3
+            v = bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=99))
+            assert not v and v.reason == "ladder_unaggregated"
+            v = bp.submit(WorkEvent(WorkType.GOSSIP_AGGREGATE, payload=99))
+            assert not v and v.reason == "ladder_aggregates"
+            # protected lanes are never ladder-shed
+            assert bp.submit(WorkEvent(WorkType.GOSSIP_BLOCK,
+                                       process=lambda: None))
+            assert bp.queue_len(WorkType.GOSSIP_ATTESTATION) == 8
+            _books_balance(bp)
+
+        run(main())
+
+    def test_shed_queue_purges_with_accounting(self):
+        async def main():
+            bp = BeaconProcessor(max_workers=2)
+            for i in range(10):
+                bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i))
+            assert bp.shed_queue(WorkType.GOSSIP_ATTESTATION) == 10
+            assert bp.queue_len(WorkType.GOSSIP_ATTESTATION) == 0
+            assert bp.metrics.shed[
+                (WorkType.GOSSIP_ATTESTATION, "purged")] == 10
+            assert bp.shed_queue(WorkType.GOSSIP_ATTESTATION) == 0
+            _books_balance(bp)
+
+        run(main())
+
+    def test_block_lane_live_during_attestation_saturation(self):
+        """Priority isolation: with every unprotected worker slot pinned
+        by a slow attestation batch, a gossip block still runs."""
+
+        async def main():
+            import threading
+
+            release = threading.Event()
+            block_done = asyncio.Event()
+
+            def slow_batch(payloads):
+                release.wait(timeout=5.0)
+
+            bp = BeaconProcessor(max_workers=2, max_batch=4,
+                                 batch_flush_ms=1)
+            for i in range(16):
+                bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i,
+                                    process_batch=slow_batch))
+            await bp.start()
+            await asyncio.sleep(0.05)  # a batch is now wedged in flight
+            loop = asyncio.get_running_loop()
+            bp.submit(WorkEvent(
+                WorkType.GOSSIP_BLOCK,
+                process=lambda: loop.call_soon_threadsafe(block_done.set)))
+            # the block must complete WHILE the attestation batch blocks
+            await asyncio.wait_for(block_done.wait(), timeout=2.0)
+            release.set()
+            await bp.stop()
+            _books_balance(bp)
+
+        run(main())
+
+
+class TestConcurrentProducers:
+    """Thread-race drills: the books must balance whatever interleaving
+    the producers, the manager loop and the ladder sweeps land on."""
+
+    N_THREADS = 6
+    PER_THREAD = 300
+
+    def test_saturation_during_inflight_batch(self):
+        """Producers race a full queue while a batch is on the dispatch
+        thread; every discard must be accounted."""
+        import threading
+
+        async def main():
+            release = threading.Event()
+
+            def slow_batch(payloads):
+                release.wait(timeout=5.0)
+
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=8, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 64})
+            await bp.start()
+            barrier = threading.Barrier(self.N_THREADS)
+
+            def produce():
+                barrier.wait()
+                for i in range(self.PER_THREAD):
+                    bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION,
+                                        payload=i,
+                                        process_batch=slow_batch))
+
+            threads = [threading.Thread(target=produce)
+                       for _ in range(self.N_THREADS)]
+            for t in threads:
+                t.start()
+            # poll (don't block the loop): the manager keeps scheduling
+            # batches WHILE the producers race the full queue
+            while any(t.is_alive() for t in threads):
+                await asyncio.sleep(0.001)
+            release.set()
+            await bp.drain()
+            await bp.stop()
+            wt = WorkType.GOSSIP_ATTESTATION
+            total = self.N_THREADS * self.PER_THREAD
+            assert bp.metrics.enqueued[wt] == total
+            rows = _books_balance(bp)
+            row = rows["gossip_attestation"]
+            assert row["processed"] + sum(row["shed"].values()) == total
+
+        run(main())
+
+    def test_racing_flush_vs_shed(self):
+        """Ladder sweeps escalate/recover concurrently with producers
+        and deadline flushes; no drop goes unaccounted and the queue
+        never goes negative."""
+        import threading
+
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=16, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 32})
+            bp.admission.up_sweeps = 1
+            bp.admission.alpha = 1.0
+            bp.admit_sweep_s = 0.001  # sweep aggressively mid-race
+            await bp.start()
+            stop = threading.Event()
+            barrier = threading.Barrier(self.N_THREADS)
+
+            def produce():
+                barrier.wait()
+                for i in range(self.PER_THREAD):
+                    bp.submit(WorkEvent(
+                        WorkType.GOSSIP_ATTESTATION, payload=i,
+                        process_batch=lambda ps: time.sleep(0.002)))
+
+            threads = [threading.Thread(target=produce)
+                       for _ in range(self.N_THREADS)]
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                await asyncio.sleep(0.001)
+            stop.set()
+            await bp.drain()
+            await bp.stop()
+            assert bp.queue_len(WorkType.GOSSIP_ATTESTATION) == 0
+            rows = _books_balance(bp)
+            total = self.N_THREADS * self.PER_THREAD
+            row = rows["gossip_attestation"]
+            assert row["enqueued"] == total
+            # the race must have actually exercised shedding
+            assert bp.metrics.shed_total() > 0
+
+        run(main())
+
+    def test_ladder_recovery_after_concurrent_storm(self):
+        """Hysteresis under concurrency: the storm drives the rung up;
+        one sweep after the queues drain restores normal service."""
+        import threading
+
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=64, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 16})
+            bp.admission.up_sweeps = 1
+            bp.admission.alpha = 1.0
+            await bp.start()
+
+            def produce():
+                for i in range(200):
+                    bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION,
+                                        payload=i,
+                                        process_batch=lambda ps: None))
+                    bp.sweep_now()
+
+            threads = [threading.Thread(target=produce) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert bp.admission.rung > NORMAL
+            await bp.drain()
+            assert bp.sweep_now() == NORMAL  # one sweep, full recovery
+            v = bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION,
+                                    payload=0, process_batch=lambda ps: None))
+            assert v
+            await bp.stop()
+            _books_balance(bp)
+
+        run(main())
+
+
+class TestFirehoseDriver:
+    """Queue-level firehose drills (the real-BLS version lives in
+    bench.py --child-firehose): storms shape arrival, the ladder
+    responds, the books balance, recovery is one sweep."""
+
+    def _driver(self, bp):
+        from lighthouse_tpu.processor.firehose import FirehoseDriver
+
+        return FirehoseDriver(
+            bp, make_payload=lambda i: ("att", i),
+            process_batch=lambda ps: None,
+            corrupt=lambda p: ("invalid", p[1]))
+
+    def test_steady_phase_keeps_normal_rung_and_balanced_books(self):
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=64, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 512})
+            await bp.start()
+            stats = await self._driver(bp).run_phase(
+                "steady", seconds=0.3, inflight_target=64)
+            await bp.drain()
+            await bp.stop()
+            assert stats.submitted > 0
+            assert stats.rung_max == NORMAL
+            assert stats.shed_at_admission == 0
+            _books_balance(bp)
+
+        run(main())
+
+    def test_dup_storm_multiplies_arrival(self):
+        async def main():
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=64, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 4096})
+            seen = []
+            from lighthouse_tpu.processor.firehose import FirehoseDriver
+            from lighthouse_tpu.ops.faults import IngestPlan
+
+            driver = FirehoseDriver(
+                bp, make_payload=lambda i: i,
+                process_batch=lambda ps: seen.extend(ps))
+            await bp.start()
+            await driver.run_phase("dup", seconds=0.2, inflight_target=32,
+                                   plan=IngestPlan("dup", factor=3.0))
+            await bp.drain()
+            await bp.stop()
+            from collections import Counter
+
+            counts = Counter(seen)
+            assert counts and max(counts.values()) >= 3
+            _books_balance(bp)
+
+        run(main())
+
+    def test_burst_storm_sheds_and_recovers_in_one_sweep(self):
+        async def main():
+            from lighthouse_tpu.ops.faults import IngestPlan
+
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=32, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 64})
+            bp.admission.up_sweeps = 1
+            bp.admission.alpha = 1.0
+            await bp.start()
+            driver = self._driver(bp)
+            stats = await driver.run_phase(
+                "burst", seconds=0.3, inflight_target=64,
+                plan=IngestPlan("burst", factor=4.0))
+            assert stats.rung_max > NORMAL
+            shed = {r for (_w, r) in bp.metrics.shed}
+            assert shed & {"queue_full_drop_oldest", "ladder_unaggregated",
+                           "ladder_aggregates"}
+            await bp.drain()
+            assert bp.sweep_now() == NORMAL
+            await bp.stop()
+            _books_balance(bp)
+
+        run(main())
+
+    def test_slow_consumer_stall_backs_queues_up(self):
+        async def main():
+            from lighthouse_tpu.ops import faults
+            from lighthouse_tpu.ops.faults import IngestPlan
+
+            bp = BeaconProcessor(
+                max_workers=2, max_batch=8, batch_flush_ms=1,
+                queue_lengths={WorkType.GOSSIP_ATTESTATION: 256})
+            await bp.start()
+            driver = self._driver(bp)
+            stats = await driver.run_phase(
+                "stall", seconds=0.25, inflight_target=64,
+                plan=IngestPlan("stall", factor=1.0, stall_s=0.05))
+            await bp.drain()
+            await bp.stop()
+            # the plan is uninstalled once the phase ends
+            assert faults.active_ingest_plan() is None
+            assert faults.consumer_stall_s() == 0.0
+            assert stats.submitted > 0
+            _books_balance(bp)
+
+        run(main())

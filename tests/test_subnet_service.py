@@ -9,6 +9,7 @@ from lighthouse_tpu.network.subnet_service import (
     AttestationSubnetService,
     SUBNETS_PER_NODE,
     SyncSubnetService,
+    compute_subnet_for_attestation,
     compute_subscribed_subnets,
     EPOCHS_PER_SUBSCRIPTION,
 )
@@ -88,6 +89,72 @@ class TestScheduling:
         router.update_attestation_subnets(6)
         assert topic(chain, f"beacon_attestation_{target}") \
             not in gossip.handlers
+
+
+class TestSubnetMapping:
+    def test_compute_subnet_matches_spec_shape(self):
+        h = Harness(n_validators=64, fork="altair", real_crypto=False)
+        spec = h.spec
+        count = spec.attestation_subnet_count
+        # deterministic, bounded, and rotating with the committee index
+        subs = {compute_subnet_for_attestation(spec, 0, ci, 4)
+                for ci in range(4)}
+        assert all(0 <= s < count for s in subs)
+        assert len(subs) == 4
+        # consecutive slots shift by committees_per_slot
+        a = compute_subnet_for_attestation(spec, 0, 0, 4)
+        b = compute_subnet_for_attestation(spec, 1, 0, 4)
+        assert b == (a + 4) % count
+
+    def test_fanin_accounts_every_delivery(self):
+        """SubnetFanIn: decode failures and shed submissions are
+        counted; accepted deliveries reach the submit callable with the
+        right subnet."""
+        from lighthouse_tpu.network.gossip import GossipHub, SubnetFanIn
+
+        hub = GossipHub()
+        node = hub.join("node")
+        peer = hub.join("peer")
+        got = []
+
+        def submit(subnet, payload):
+            if payload == b"full":
+                return False  # saturated queue sheds
+            got.append((subnet, payload))
+            return True
+
+        fanin = SubnetFanIn(
+            node, submit,
+            decode=lambda raw: (_ for _ in ()).throw(ValueError("bad"))
+            if raw == b"garbage" else raw,
+            subnet_count=4)
+        fanin.subscribe()
+        peer.publish("beacon_attestation_2", b"ok")
+        peer.publish("beacon_attestation_3", b"full")
+        peer.publish("beacon_attestation_1", b"garbage")
+        assert got == [(2, b"ok")]
+        assert fanin.outcomes == {
+            "accepted": 1, "shed": 1, "decode_error": 1}
+        assert fanin.delivered == {2: 1, 3: 1, 1: 1}
+        # unsubscribe stops delivery
+        fanin.unsubscribe([2])
+        peer.publish("beacon_attestation_2", b"again")
+        assert got == [(2, b"ok")]
+
+    def test_seen_cache_counts_duplicate_hits(self):
+        from lighthouse_tpu.network.gossip import GossipHub
+
+        hub = GossipHub()
+        node = hub.join("node")
+        seen = []
+        node.subscribe("t", lambda m: seen.append(m.data))
+        for peer_id in ("p1", "p2", "p3"):
+            hub.join(peer_id).subscribe("t", lambda m: None)
+        # the same bytes from three different publishers: delivered once
+        for peer_id in ("p1", "p2", "p3"):
+            hub._endpoints[peer_id].publish("t", b"dup")
+        assert seen == [b"dup"]
+        assert node.seen.hits == 2
 
 
 class TestSyncSubnets:

@@ -55,6 +55,12 @@ LH602       breaker-hooks          a backend-ladder driver (or any
                                    recovers a device fault) missing its
                                    breaker fault hook in the handler or
                                    ok hook on the success path
+LH603       unaccounted-shed       a code path in processor/ or pool/
+                                   that discards queued work (thrown-away
+                                   pop/popleft/popitem, del on a
+                                   subscript) without incrementing a
+                                   *_shed_total/*_dropped_total metric
+                                   (zero-unaccounted-drops discipline)
 LH801       int64-outside-x64      int64 jnp lane created / int64-lane
                                    program dispatched outside a scoped
                                    ``with enable_x64():`` (silent int32
@@ -214,15 +220,16 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     CLI/baseline layer's job)."""
     from tools.lint import (blocking_pass, envpass, exceptions_pass,
                             fetch, locks, metrics_pass, numeric_pass,
-                            shapes, store_pass, supervisor_pass)
+                            shapes, shed_pass, store_pass,
+                            supervisor_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
     ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
                      metrics_pass.run, supervisor_pass.run,
-                     store_pass.run, numeric_pass.run, blocking_pass.run,
-                     exceptions_pass.run):
+                     store_pass.run, shed_pass.run, numeric_pass.run,
+                     blocking_pass.run, exceptions_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

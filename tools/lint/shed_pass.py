@@ -1,0 +1,190 @@
+"""Pass 8 — accounted shed discipline (LH603).
+
+The firehose acceptance criterion is *zero unaccounted drops*: every
+discarded unit of queued work shows up in a ``*_shed_total`` /
+``*_dropped_total`` metric.  That guarantee only survives refactors if
+it is machine-checked — a new eviction path quietly added to a pool or
+queue re-opens exactly the silent-drop behaviour the admission
+controller replaced.
+
+This pass scans the work-holding packages (``processor/`` and
+``pool/``) for *discard statements*:
+
+- an expression statement whose value is a ``.pop()`` / ``.popleft()``
+  / ``.popitem()`` call (the removed item is thrown away, not
+  processed — a pop whose result is bound or iterated is fine), and
+- ``del`` statements on subscripts (``del self._slots[slot]``,
+  ``del variants[k:]``).
+
+The enclosing function must *account* the discard: either register a
+metric whose name contains ``_shed_total``/``_dropped_total`` (a string
+literal in the body), or call an accounting helper — a function whose
+name combines an accounting verb (account/record) with a shed/drop
+noun (``_account_shed``, ``record_dropped``, …) or whose own body
+carries such a metric literal (helpers are collected package-wide
+across the scoped directories, so funneling through one helper is
+enough).
+
+Pure bookkeeping containers (flush timestamps, restart stamps, timer
+lists, label memos — structures that never hold work items) are
+exempted by receiver name in ``BOOKKEEPING_RECEIVERS``; like
+store_pass's allowlist, moving work into a container with one of these
+names trips a reviewer, not the gate.  Deliberate unaccounted discards
+carry ``# lhlint: allow(LH603)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint import Context, Finding
+
+TARGET_PREFIXES = ("processor/", "pool/")
+
+DISCARD_METHODS = {"pop", "popleft", "popitem"}
+
+#: containers that hold scheduling bookkeeping, never work items
+BOOKKEEPING_RECEIVERS = {
+    "_batch_first_seen",   # flush-window timestamps
+    "_dispatch_restarts",  # restart-storm stamps
+    "_timers",             # (deadline, event) retry timers re-submitted
+    "_label_memo",         # metric label children
+    "covering",            # max-cover rescoring weights
+}
+
+_METRIC_LIT = re.compile(r"_(shed|dropped)_total")
+_HELPER_NAME = re.compile(
+    r"(account|record).*(shed|drop)|(shed|drop).*(account|record)")
+
+
+def _terminal_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _receiver_name(func: ast.AST) -> str | None:
+    """`_by_root` for ``self._by_root.pop(...)`` / ``_by_root.pop(...)``."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    obj = func.value
+    if isinstance(obj, ast.Attribute):
+        return obj.attr
+    if isinstance(obj, ast.Name):
+        return obj.id
+    return None
+
+
+def _has_metric_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _METRIC_LIT.search(sub.value):
+            return True
+    return False
+
+
+def _accounting_helper_names(ctx: Context) -> set[str]:
+    """Bare names of functions (package-wide within the scoped dirs)
+    that qualify as shed-accounting helpers."""
+    names: set[str] = set()
+    for module in ctx.modules:
+        if not module.pkg_rel.startswith(TARGET_PREFIXES):
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _HELPER_NAME.search(node.name) or _has_metric_literal(node):
+                names.add(node.name)
+    return names
+
+
+def _accounts(fn: ast.AST, helpers: set[str]) -> bool:
+    if _has_metric_literal(fn):
+        return True
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            name = _terminal_name(sub.func)
+            if name is not None and (name in helpers
+                                     or _HELPER_NAME.search(name)):
+                return True
+    return False
+
+
+def _discard_sites(fn: ast.AST) -> list[tuple[int, str, str]]:
+    """(line, description, symbol) per discard statement inside ``fn``
+    (not descending into nested function definitions)."""
+    sites: list[tuple[int, str, str]] = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Expr) and isinstance(child.value,
+                                                          ast.Call):
+                call = child.value
+                name = _terminal_name(call.func)
+                if name in DISCARD_METHODS:
+                    recv = _receiver_name(call.func)
+                    if recv not in BOOKKEEPING_RECEIVERS:
+                        sites.append(
+                            (child.lineno, f"{recv or '?'}.{name}(...)",
+                             f"{recv or '?'}.{name}"))
+            elif isinstance(child, ast.Delete):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        recv = (_terminal_name(tgt.value)
+                                if isinstance(tgt.value,
+                                              (ast.Name, ast.Attribute))
+                                else None)
+                        if recv not in BOOKKEEPING_RECEIVERS:
+                            sites.append(
+                                (child.lineno, f"del {recv or '?'}[...]",
+                                 recv or "?"))
+            visit(child)
+
+    visit(fn)
+    return sites
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    helpers = _accounting_helper_names(ctx)
+    for module in ctx.modules:
+        if not module.pkg_rel.startswith(TARGET_PREFIXES):
+            continue
+        findings.extend(_scan_module(ctx, module, helpers))
+    return findings
+
+
+def _scan_module(ctx: Context, module, helpers: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                sites = _discard_sites(child)
+                if sites and not _accounts(child, helpers):
+                    for line, what, symbol in sites:
+                        if ctx.suppressed(module, "LH603",
+                                          "unaccounted-shed", line):
+                            continue
+                        findings.append(Finding(
+                            "LH603", "unaccounted-shed", module.rel, line,
+                            f"{qual}:{symbol}",
+                            f"`{qual}` discards queued work ({what}) "
+                            f"without incrementing a *_shed_total/"
+                            f"*_dropped_total metric — account the drop "
+                            f"or waive with `# lhlint: allow(LH603)`"))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(module.tree, [])
+    return findings
