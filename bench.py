@@ -610,6 +610,243 @@ def _bench_firehose() -> dict:
     return result
 
 
+def _bench_syncstorm() -> dict:
+    """PR 10 acceptance drill: Byzantine-resilient sync under network
+    chaos.  One fresh node syncs to the honest head through a peer set
+    with EVERY ops/faults.PeerFaultPlan fault class active at least once
+    (stall, empty, truncate, malformed, wrong_chain, equivocate, flap),
+    then a checkpoint-anchored node backfills through the same hostile
+    pool.  Asserts the three acceptance properties:
+
+    - convergence to the honest head inside LHTPU_SYNCSTORM_BOUND_S
+      (and the backfill completes, provably linked to genesis);
+    - zero unaccounted downscores/abandons: the sync/backfill books
+      invariant ``requested == imported + retried + abandoned`` holds
+      and every downscore the plane issued is reason-labeled in the
+      ``sync_downscores_total``/``backfill_downscores_total`` metrics;
+    - no block that failed cross-batch linkage was imported: every
+      honest block is present and the head matches exactly.
+
+    Zero-XLA by design (fake BLS backend, signature verification off):
+    the subject is the sync supervision, not crypto throughput.  Emits
+    progressive partials per phase like --child-firehose, plus p50/p99
+    sync.batch latency from the PR 1 tracing for free."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.common.metrics import REGISTRY
+    from lighthouse_tpu.common.tracing import TRACER
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.network import (
+        NetworkFabric,
+        NetworkService,
+        PeerManager,
+    )
+    from lighthouse_tpu.network.backfill import BackfillSync
+    from lighthouse_tpu.network.rpc import (
+        BlocksByRangeRequest,
+        P_BLOCKS_BY_RANGE,
+        RpcError,
+    )
+    from lighthouse_tpu.ops import faults
+    from lighthouse_tpu.state_transition import state_transition
+    from lighthouse_tpu.testing import Harness
+
+    bls.set_backend("fake")
+    n_slots = int(os.environ.get("LHTPU_SYNCSTORM_SLOTS", "64"))
+    bound_s = float(os.environ.get("LHTPU_SYNCSTORM_BOUND_S", "180"))
+    # tight request discipline: a stall fault costs milliseconds of
+    # deadline, not the production default
+    os.environ.setdefault("LHTPU_RPC_DEADLINE_S", "0.5")
+    os.environ.setdefault("LHTPU_RPC_BACKOFF_S", "0.05")
+    os.environ.setdefault("LHTPU_RPC_BACKOFF_MAX_S", "0.5")
+    os.environ.setdefault("LHTPU_SYNC_BATCH_SIZE", "8")
+    os.environ.setdefault("LHTPU_SYNC_STALL_S", "30")
+
+    RANGE = "beacon_blocks_by_range"
+    t_all = time.perf_counter()
+    result = {"syncstorm_slots": n_slots, "syncstorm_platform": "cpu",
+              "stage": "building"}
+    _emit_partial(result)
+
+    # -- build: honest chain (attestation-weighted) + fork branch ---------
+    t0 = time.perf_counter()
+    h = Harness(n_validators=32, fork="altair", real_crypto=False)
+    fabric = NetworkFabric()
+    genesis = h.state.copy()
+    honest_chain = BeaconChain(h.spec, genesis.copy(),
+                               verify_signatures=False)
+    blocks = []
+    for i in range(n_slots):
+        atts = [h.attest()] if i > 0 else []
+        signed = h.produce_block(attestations=atts)
+        state_transition(h.state, h.spec, signed, h._verify_strategy())
+        honest_chain.slot_clock.set_slot(int(signed.message.slot))
+        honest_chain.process_block(signed)
+        blocks.append(signed)
+    # the wrong-chain branch: same genesis, even slots only, no weight
+    fh = Harness(n_validators=32, fork="altair", real_crypto=False)
+    fork_chain = BeaconChain(fh.spec, fh.state.copy(),
+                             verify_signatures=False)
+    for slot in range(2, n_slots // 2, 2):
+        signed = fh.produce_block(slot=slot)
+        state_transition(fh.state, fh.spec, signed, fh._verify_strategy())
+        fork_chain.slot_clock.set_slot(slot)
+        fork_chain.process_block(signed)
+    build_s = time.perf_counter() - t0
+
+    # -- the peer set: two clean peers + one peer per fault class ---------
+    services = {"honest-0": NetworkService(honest_chain, fabric, "honest-0"),
+                "honest-1": NetworkService(honest_chain, fabric, "honest-1")}
+    fault_peers = {
+        "stall": "p-stall", "empty": "p-empty", "truncate": "p-truncate",
+        "malformed": "p-malformed", "flap": "p-flap",
+        "equivocate": "p-equivocate", "wrong_chain": "p-janus",
+    }
+    for pid in fault_peers.values():
+        services[pid] = NetworkService(honest_chain, fabric, pid)
+    NetworkService(fork_chain, fabric, "p-fork")
+    plans = [
+        faults.PeerFaultPlan("stall", peers={"p-stall"},
+                             protocols={RANGE}, stall_s=2.0),
+        faults.PeerFaultPlan("empty", peers={"p-empty"}, protocols={RANGE}),
+        faults.PeerFaultPlan("truncate", peers={"p-truncate"},
+                             protocols={RANGE}),
+        faults.PeerFaultPlan("malformed", peers={"p-malformed"},
+                             protocols={RANGE}),
+        faults.PeerFaultPlan("flap", peers={"p-flap"}, protocols={RANGE}),
+        faults.PeerFaultPlan("equivocate", peers={"p-equivocate"},
+                             protocols={"status"}),
+        faults.PeerFaultPlan("wrong_chain", peers={"p-janus"},
+                             protocols={RANGE}, alt_peer="p-fork"),
+    ]
+    faults.install_peer_plans(plans)
+
+    fresh_chain = BeaconChain(h.spec, genesis.copy(),
+                              verify_signatures=False)
+    fresh = NetworkService(fresh_chain, fabric, "fresh")
+    fresh_chain.slot_clock.set_slot(n_slots)
+    # hostile peers first: the batch rotation must wade through them
+    for pid in (*fault_peers.values(), "honest-0", "honest-1"):
+        fresh.connect(services[pid])
+    result.update({"syncstorm_build_s": round(build_s, 1),
+                   "syncstorm_peers": len(services), "stage": "connected"})
+    _emit_partial(result)
+
+    # -- phase 1: range sync to the honest head through the chaos ---------
+    t0 = time.perf_counter()
+    rounds = 0
+    while fresh_chain.head_root != honest_chain.head_root:
+        rounds += 1
+        fresh.sync.sync()
+        result.update({
+            "stage": f"sync_round_{rounds}",
+            "syncstorm_head_slot": int(fresh_chain.head_state.slot),
+            "syncstorm_rounds": rounds,
+        })
+        _emit_partial(result)
+        if time.perf_counter() - t_all > bound_s:
+            break
+        if rounds > 32:
+            break
+    sync_s = time.perf_counter() - t0
+
+    # coverage probe: any armed range fault that rotation happened to
+    # skip gets one direct request so every fault class actually fired
+    probe = BlocksByRangeRequest(start_slot=1, count=4, step=1).serialize()
+    for plan in plans:
+        if plan.fires or plan.protocols == {"status"}:
+            continue
+        for pid in plan.peers:
+            try:
+                fresh.rpc_ep.request(pid, P_BLOCKS_BY_RANGE, probe)
+            except RpcError:
+                pass   # the fault doing its job; discipline accounted it
+
+    # -- phase 2: checkpoint-anchored backfill through the same pool ------
+    anchor_idx = n_slots * 3 // 4
+    replay = Harness(n_validators=32, fork="altair", real_crypto=False)
+    for signed in blocks[: anchor_idx + 1]:
+        state_transition(replay.state, replay.spec, signed,
+                         replay._verify_strategy())
+    anchored = BeaconChain(replay.spec, replay.state.copy(),
+                           verify_signatures=False)
+    anchored.store.put_block(anchored.genesis_block_root,
+                             blocks[anchor_idx])
+    bf = BackfillSync(anchored, fabric.rpc.join("backfiller"),
+                      PeerManager(),
+                      terminal_root=honest_chain.genesis_block_root)
+    t0 = time.perf_counter()
+    bf_total = bf.run(["p-empty", "p-truncate", "p-malformed", "p-flap",
+                       "p-janus", "honest-0"])
+    backfill_s = time.perf_counter() - t0
+
+    # -- acceptance ------------------------------------------------------
+    fires = faults.peer_fires_by_mode()
+    missing = [m for m in fault_peers if fires.get(m, 0) < 1]
+    assert not missing, f"fault classes never fired: {missing}"
+    assert fresh_chain.head_root == honest_chain.head_root, \
+        "fresh node failed to converge to the honest head"
+    for signed in blocks:
+        # store membership, not proto: fork choice prunes finalized
+        # ancestors, imported blocks stay addressable in the store
+        assert fresh_chain.store.get_block(
+            bytes(signed.message.hash_tree_root())) is not None, \
+            f"honest block at slot {int(signed.message.slot)} missing " \
+            "(a withheld window was skipped, not recovered)"
+    assert fresh.sync.books_balanced(), \
+        f"sync books leak: {fresh.sync.books}"
+    assert bf.books_balanced(), f"backfill books leak: {bf.books}"
+    assert bf.is_complete, "backfill did not link to genesis"
+
+    def _family_sum(name):
+        fam = REGISTRY.metrics.get(name)
+        if fam is None:
+            return 0.0
+        return sum(c.value for c in fam._children.values())
+
+    ds_sync = _family_sum("sync_downscores_total")
+    ds_backfill = _family_sum("backfill_downscores_total")
+    assert ds_sync == fresh.sync.downscores, \
+        f"unaccounted sync downscores: {ds_sync} != {fresh.sync.downscores}"
+    assert ds_backfill == bf.downscores, \
+        f"unaccounted backfill downscores: {ds_backfill} != {bf.downscores}"
+    total_s = time.perf_counter() - t_all
+    assert total_s < bound_s, \
+        f"syncstorm blew its wall-clock bound: {total_s:.1f}s >= {bound_s}s"
+
+    # p50/p99 batch latency for free from the PR 1 tracing spans
+    durs = []
+    for slot in TRACER.slots():
+        tl = TRACER.timeline(slot) or {}
+        durs.extend(sp["duration_ms"] for sp in tl.get("spans", ())
+                    if sp["name"] in ("sync.batch", "backfill.batch"))
+    durs.sort()
+    p50 = durs[len(durs) // 2] if durs else 0.0
+    p99 = durs[min(len(durs) - 1, int(len(durs) * 0.99))] if durs else 0.0
+
+    result.update({
+        "syncstorm_total_s": round(total_s, 1),
+        "syncstorm_sync_s": round(sync_s, 1),
+        "syncstorm_backfill_s": round(backfill_s, 1),
+        "syncstorm_rounds": rounds,
+        "syncstorm_backfilled": bf_total,
+        "syncstorm_head_slot": int(fresh_chain.head_state.slot),
+        "syncstorm_fires": {m: int(fires.get(m, 0)) for m in fault_peers},
+        "syncstorm_downscores": int(ds_sync + ds_backfill),
+        "syncstorm_batch_p50_ms": round(p50, 2),
+        "syncstorm_batch_p99_ms": round(p99, 2),
+        "stages": {"syncstorm": {
+            "build": {"seconds": round(build_s, 2), "blocks": len(blocks)},
+            "sync": {"seconds": round(sync_s, 2), "rounds": rounds,
+                     "books": dict(fresh.sync.books)},
+            "backfill": {"seconds": round(backfill_s, 2),
+                         "imported": bf_total, "books": dict(bf.books)},
+        }},
+    })
+    result.pop("stage", None)
+    faults.clear_peer_plans()
+    return result
+
+
 def _bench_slasher() -> dict:
     """BASELINE table row "slasher batch update": the reference's sample
     log processes 1 block + 279 attestations in 1,821 ms on a commodity
@@ -1150,6 +1387,8 @@ def _child_main() -> int:
         result = _bench_block_verify()
     elif "--child-slasher" in sys.argv:
         result = _bench_slasher()
+    elif "--child-syncstorm" in sys.argv:
+        result = _bench_syncstorm()
     else:
         result = _bench_bls_1k()
     print("LHTPU_BENCH_JSON " + json.dumps(result), flush=True)
@@ -1216,7 +1455,7 @@ def _run_child(extra_env: dict | None, child_flag: str = "--child",
 _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-probe", "--child-stateroot", "--child-flood",
                 "--child-blockverify", "--child-slasher", "--child-epoch",
-                "--child-firehose")
+                "--child-firehose", "--child-syncstorm")
 
 
 def main() -> int:
@@ -1292,6 +1531,8 @@ def main() -> int:
                 ("--child-blockverify", "block_verify", None),
                 ("--child-flood", "flood", None),
                 ("--child-firehose", "firehose", None),
+                ("--child-syncstorm", "syncstorm",
+                 min(300, CHILD_TIMEOUT_S)),
                 ("--child-slasher", "slasher",
                  min(120, CHILD_TIMEOUT_S))):
             r = _run_child(working_env, child_flag=flag, timeout_s=timeout)

@@ -398,6 +398,18 @@ class ClientBuilder:
         # firehose-driver arrival in drills
         from lighthouse_tpu.ops import faults as _faults
 
+        # network-plane chaos drill: LHTPU_PEERFAULT_* arms Byzantine
+        # peer faults (stall/empty/truncate/malformed/wrong_chain/
+        # equivocate/flap) at the rpc request seam, same discipline as
+        # the store/ingest knobs above
+        peer_plan = _faults.peer_plan_from_env()
+        if peer_plan is not None:
+            _faults.install_peer_plans((peer_plan,))
+            self.log.warn("peer fault injection armed",
+                          mode=peer_plan.mode,
+                          peers=",".join(sorted(peer_plan.peers))
+                          if peer_plan.peers else "*")
+
         ingest_plan = _faults.ingest_plan_from_env()
         if ingest_plan is not None:
             # the storm self-expires after LHTPU_INGEST_FAULT_S (<=0 =

@@ -127,6 +127,61 @@ _register("LHTPU_DISPATCH_RESTART_WINDOW_S", "300",
           "Restart-storm window seconds for the dispatch-thread "
           "limiter.")
 
+# -- peer fault injection + rpc/sync/backfill discipline (ops/faults,
+#    network/rpc, network/sync, network/backfill, bench --child-syncstorm) ----
+
+_register("LHTPU_PEERFAULT_MODE", None,
+          "Inject Byzantine peer faults (stall|empty|truncate|malformed|"
+          "wrong_chain|equivocate|flap) at the rpc request seam "
+          "(ops/faults.PeerFaultPlan); unset disables injection.")
+_register("LHTPU_PEERFAULT_PEERS", None,
+          "Comma-separated peer ids the peer fault fires against; "
+          "unset = every peer.")
+_register("LHTPU_PEERFAULT_PROTOCOLS", None,
+          "Comma-separated protocol tokens (status, "
+          "beacon_blocks_by_range, beacon_blocks_by_root, ...) the peer "
+          "fault fires on; unset = every protocol.")
+_register("LHTPU_PEERFAULT_ORDINALS", None,
+          "Comma-separated per-(peer,protocol) request ordinals the "
+          "fault fires at; unset = every matching request.")
+_register("LHTPU_PEERFAULT_STALL_S", "30",
+          "Response delay seconds for peer fault mode=stall (the rpc "
+          "deadline should cut the stall off first).")
+_register("LHTPU_PEERFAULT_MAX_FIRES", None,
+          "Stop injecting peer faults after N fires; unset = unlimited.")
+_register("LHTPU_RPC_DEADLINE_S", "5",
+          "Per-request deadline in seconds for outbound rpc requests "
+          "(watchdog-enforced); 0 disables the deadline.")
+_register("LHTPU_RPC_FAILS", "3",
+          "Consecutive request failures against one peer that trip its "
+          "quarantine window (network/rpc backoff ladder).")
+_register("LHTPU_RPC_BACKOFF_S", "0.5",
+          "Initial per-peer quarantine window in seconds; doubles on "
+          "every re-quarantine (exponential backoff ladder).")
+_register("LHTPU_RPC_BACKOFF_MAX_S", "30",
+          "Per-peer quarantine window ceiling in seconds.")
+_register("LHTPU_SYNC_BATCH_SIZE", "32",
+          "Slots per BlocksByRange batch in the range-sync state "
+          "machine (and the backfill reverse fill).")
+_register("LHTPU_SYNC_BATCH_ATTEMPTS", "5",
+          "Download+process attempts per range-sync batch across the "
+          "peer pool before the chain attempt is abandoned.")
+_register("LHTPU_SYNC_STALL_S", "20",
+          "Range-sync progress watchdog: a syncing chain with no batch "
+          "progress for this many seconds is abandoned and its peers "
+          "re-pooled; 0 disables the watchdog.")
+_register("LHTPU_SYNC_CHAIN_ATTEMPTS", "3",
+          "Abandoned-chain attempts per sync target before that target "
+          "is skipped (per-target accounting, PR 8 ladder shape).")
+_register("LHTPU_SYNC_BACKFILL_ATTEMPTS", "3",
+          "Peer-rotation attempts per backfill batch window before the "
+          "backfill run abandons (resumes from the freezer cursor).")
+_register("LHTPU_SYNCSTORM_SLOTS", "64",
+          "bench.py --child-syncstorm honest-chain length in slots.")
+_register("LHTPU_SYNCSTORM_BOUND_S", "180",
+          "bench.py --child-syncstorm wall-clock bound in seconds "
+          "(the convergence-under-chaos acceptance window).")
+
 # -- admission control + degradation ladder (processor/admission,
 #    processor/beacon_processor) ----------------------------------------------
 

@@ -8,10 +8,28 @@ known child (no state needed — the hash chain is the proof, which is why
 the reference can backfill without replaying), persists the blocks and
 records canonical block roots in the freezer so the API and sync can
 serve the full chain.
+
+Byzantine hardening (mirrors network/sync.py's discipline):
+
+- ``run`` takes a peer POOL and rotates on :class:`BackfillError` /
+  no-progress instead of raising through the caller, up to
+  LHTPU_SYNC_BACKFILL_ATTEMPTS consecutive failures per window;
+- a restart resumes from the freezer's lowest filled root instead of
+  refilling from the anchor (the cursor is recoverable from the
+  persisted hash-chain prefix);
+- every batch attempt is accounted in
+  ``backfill_batches_total{outcome}`` (requested == imported + retried
+  + abandoned, the same books invariant as range sync) and every
+  penalty in ``backfill_downscores_total{reason}``.
 """
 
 from __future__ import annotations
 
+import time
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+from lighthouse_tpu.common.tracing import add_attrs, span
 from lighthouse_tpu.network.rpc import (
     BlocksByRangeRequest,
     P_BLOCKS_BY_RANGE,
@@ -20,7 +38,7 @@ from lighthouse_tpu.network.rpc import (
 from lighthouse_tpu.store.hot_cold import P_COLD_BLOCK_ROOT, _slot_key
 from lighthouse_tpu.store.kv import KeyValueOp
 
-BATCH_SIZE = 32
+BATCH_SIZE = 32   # default; LHTPU_SYNC_BATCH_SIZE overrides
 
 
 class BackfillError(ValueError):
@@ -53,33 +71,118 @@ class BackfillSync:
         # lowest slot whose freezer root entry is already written; slots
         # below it are deferred until the covering block's slot is known
         self._unfilled_upper = self.expected_slot
+        # books: requested == imported + retried + abandoned, always
+        self.books = {"requested": 0, "imported": 0, "retried": 0,
+                      "abandoned": 0}
+        self.downscores = 0
+        # a prior run's progress is recoverable from the freezer's
+        # hash-chain prefix: resume below it instead of refilling
+        self._resume_from_freezer()
         self._complete = self.expected_slot == 0 or (
             terminal_root is not None and self.expected_root == terminal_root)
         if self._complete and terminal_root is not None:
             self._finalize_fill(terminal_root)
 
+    # -- accounting (the LH604 funnels) -------------------------------------
+
+    def _account(self, outcome: str) -> None:
+        self.books[outcome] += 1
+        REGISTRY.counter(
+            "backfill_batches_total",
+            "backfill batch attempts by outcome (requested is the "
+            "attempt counter; the rest are terminal outcomes)",
+        ).labels(outcome=outcome).inc()
+
+    def _downscore(self, peer: str, level: str, reason: str) -> None:
+        self.downscores += 1
+        REGISTRY.counter(
+            "backfill_downscores_total",
+            "peer downscores issued by backfill, by reason",
+        ).labels(reason=reason).inc()
+        self.peers.report(peer, level)
+
+    def books_balanced(self) -> bool:
+        b = self.books
+        return b["requested"] == (b["imported"] + b["retried"]
+                                  + b["abandoned"])
+
+    # -- cursor resume -------------------------------------------------------
+
+    def _resume_from_freezer(self) -> None:
+        """A restart used to refill from the anchor; the freezer's
+        LOWEST filled root entry names the oldest block whose hash-chain
+        link was already verified and persisted — resume below it."""
+        cold = getattr(self.chain.store, "cold", None)
+        if cold is None:
+            return
+        lowest = None
+        try:
+            for key, val in cold.iter_prefix(P_COLD_BLOCK_ROOT):
+                lowest = (key, val)
+                break          # iter_prefix is slot-ascending
+        except Exception as e:
+            # a failed resume scan leaves the cursor at the anchor — the
+            # safe pre-resume behaviour, accounted as a swallowed error,
+            # not a batch abandon
+            record_swallowed("backfill.resume_scan", e)
+            return  # lhlint: allow(LH604)
+        if lowest is None:
+            return
+        slot = int.from_bytes(lowest[0][len(P_COLD_BLOCK_ROOT):], "big")
+        if slot >= self.expected_slot:
+            return             # no backfill progress below the anchor
+        blk = self.chain.store.get_block(lowest[1])
+        if blk is None or int(blk.message.slot) != slot:
+            # deferred-fill entry whose covering block sits higher up,
+            # or a missing body: not a safe resume point
+            return
+        self.expected_slot = slot
+        self.expected_root = bytes(blk.message.parent_root)
+        self._unfilled_upper = slot
+
     @property
     def is_complete(self) -> bool:
         return self._complete
 
-    def process_batch(self, peer: str) -> int:
+    def process_batch(self, peer: str, last_attempt: bool = False) -> int:
         """Fetch + verify + store one backward batch from `peer`.
-        Returns blocks imported (0 at completion)."""
+        Returns blocks imported (0 at completion).  ``last_attempt``
+        classifies a failure as abandoned instead of retried (the
+        rotation driver in :meth:`run` knows whether another attempt
+        follows)."""
         if self._complete:
             return 0
+        fail_outcome = "abandoned" if last_attempt else "retried"
+        self._account("requested")
         end = self.expected_slot  # exclusive: the anchor itself is stored
-        start = max(0, end - BATCH_SIZE)
+        start = max(0, end - max(1, envreg.get_int("LHTPU_SYNC_BATCH_SIZE",
+                                                   BATCH_SIZE) or BATCH_SIZE))
         req = BlocksByRangeRequest(start_slot=start, count=end - start, step=1)
         try:
             chunks = self.rpc.request(peer, P_BLOCKS_BY_RANGE, req.serialize())
         except RpcError:
-            self.peers.report(peer, "mid")
+            self._downscore(peer, "mid", "rpc_error")
+            self._account(fail_outcome)
+            return 0
+        if len(chunks) > end - start:
+            self._downscore(peer, "high", "overserve")
+            self._account(fail_outcome)
+            return 0
+        if not chunks:
+            # a fully-empty window is NO progress, not a license to walk
+            # the cursor past (possibly withheld) history: the expected
+            # child's parent provably exists below the anchor, so some
+            # window down there must serve it.  The rotation driver asks
+            # another peer; a genuinely all-skipped window needs a batch
+            # size spanning the gap (LHTPU_SYNC_BATCH_SIZE).
+            self._account(fail_outcome)
             return 0
         blocks = []
         for raw in chunks:
             blk = self._decode(raw)
             if blk is None:
-                self.peers.report(peer, "high")
+                self._downscore(peer, "high", "decode")
+                self._account(fail_outcome)
                 return 0
             blocks.append(blk)
         # Phase 1 — verify the WHOLE batch's linkage newest-first before
@@ -93,7 +196,8 @@ class BackfillSync:
             if root != expected:
                 # peers may omit skipped slots; a root mismatch on a
                 # served block breaks the hash chain
-                self.peers.report(peer, "high")
+                self._downscore(peer, "high", "broken_hash_chain")
+                self._account(fail_outcome)
                 raise BackfillError(
                     f"backfill batch broke the hash chain at slot "
                     f"{int(blk.message.slot)}")
@@ -123,6 +227,7 @@ class BackfillSync:
         self.expected_slot = start
         self.expected_root = expected
         imported = len(verified)
+        self._account("imported")
         self.peers.report(peer, "useful_response")
 
         # Completion: provable when the chain links to the known terminal
@@ -132,7 +237,7 @@ class BackfillSync:
                 self._complete = True
                 self._finalize_fill(self.terminal_root)
             elif start == 0:
-                self.peers.report(peer, "high")
+                self._downscore(peer, "high", "withheld_history")
                 raise BackfillError(
                     "backfill reached slot 0 without linking to the "
                     "genesis block root — peer withheld history")
@@ -152,19 +257,89 @@ class BackfillSync:
             self.chain.store.cold.do_atomically(ops)
         self._unfilled_upper = 0
 
-    def run(self, peer: str, max_batches: int = 10_000) -> int:
+    def run(self, peers, max_batches: int = 10_000) -> int:
+        """Drive backfill to completion over a peer POOL, rotating to
+        the next peer on a broken hash chain or a no-progress batch
+        instead of raising through the caller.  A window that fails
+        LHTPU_SYNC_BACKFILL_ATTEMPTS consecutive attempts abandons the
+        run (resumable: the freezer cursor survives)."""
+        pool = [peers] if isinstance(peers, str) else list(peers)
+        if not pool:
+            return 0
+        outcome = "abandoned"
+        # the window budget covers at least one full pool rotation: a
+        # hostile majority must not starve the honest tail of its turn
+        budget = max(1, envreg.get_int("LHTPU_SYNC_BACKFILL_ATTEMPTS", 3)
+                     or 3, len(pool))
         total = 0
+        idx = 0
+        window_fails = 0
         for _ in range(max_batches):
-            before = self.expected_slot
-            total += self.process_batch(peer)
             if self._complete:
+                outcome = "completed"
+                break
+            before = self.expected_slot
+            peer = pool[idx % len(pool)]
+            last = window_fails + 1 >= budget
+            t0 = time.perf_counter()
+            with span("backfill.batch", slot=before, peer=peer):
+                try:
+                    n = self.process_batch(peer, last_attempt=last)
+                except BackfillError as e:
+                    # rotation, not propagation: the offender is already
+                    # downscored and the attempt accounted
+                    add_attrs(outcome="hash_chain_break", error=str(e))
+                    if self.expected_slot == 0 and not self._complete:
+                        # walked to slot 0 without linking the terminal
+                        # root: no peer can repair persisted-but-unlinked
+                        # history — stop, the operator's terminal config
+                        # or the serving set is wrong
+                        self._observe(time.perf_counter() - t0)
+                        outcome = "terminal_mismatch"
+                        break
+                    n = 0
+                else:
+                    add_attrs(outcome="imported" if n else "no_progress",
+                              imported=n)
+            self._observe(time.perf_counter() - t0)
+            total += n
+            if self._complete:
+                outcome = "completed"
                 break
             if self.expected_slot == before:
-                break  # rpc failure: no progress, caller retries/rotates
+                # rpc failure / withheld window: no progress — rotate
+                if last:
+                    break
+                window_fails += 1
+                idx += 1
+                continue
+            window_fails = 0
+        else:
+            outcome = "completed" if self._complete else "paced"
+        self._record_run(outcome)
         return total
 
+    def _record_run(self, outcome: str) -> None:
+        REGISTRY.counter(
+            "backfill_runs_total",
+            "backfill run() drives by outcome (paced = max_batches "
+            "reached with the fill still resumable)",
+        ).labels(outcome=outcome).inc()
+
+    def _observe(self, seconds: float) -> None:
+        REGISTRY.histogram(
+            "backfill_batch_seconds",
+            "backfill batch wall time (download+verify+persist)",
+        ).observe(seconds)
+
     def _decode(self, raw: bytes):
-        return self.chain.t.decode_signed_block(raw)
+        try:
+            return self.chain.t.decode_signed_block(raw)
+        except Exception as e:
+            # the CALLER downscores + accounts the failed attempt; this
+            # is only the malformed-bytes -> None translation
+            record_swallowed("backfill.decode_block", e)
+            return None  # lhlint: allow(LH604)
 
 
 __all__ = ["BackfillError", "BackfillSync", "BATCH_SIZE"]

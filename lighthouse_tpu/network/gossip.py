@@ -92,7 +92,12 @@ class GossipEndpoint:
         ok = True
         try:
             handler(msg)
-        except Exception:
+        except Exception as e:
+            # delivery failures downscore the SENDER via the delivery-
+            # result callback below; the handler error itself is counted
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("gossip.deliver", e)
             ok = False
         if self.on_delivery_result is not None:
             self.on_delivery_result(msg.source, msg.topic, ok)

@@ -341,6 +341,13 @@ class PeerManager:
                     else:
                         node.connect(*cand)
                     dials += 1
-                except Exception:
+                except Exception as e:
+                    # a refused/unreachable dial candidate must not sink
+                    # the heartbeat; counted, then the next candidate
+                    from lighthouse_tpu.common.metrics import (
+                        record_swallowed,
+                    )
+
+                    record_swallowed("peer_manager.dial", e)
                     continue
         return dials

@@ -186,8 +186,9 @@ class Router:
             try:
                 block = c.t.signed_beacon_block_class(f).deserialize(msg.data)
                 break
-            except Exception:
-                continue
+            except Exception:  # lhlint: allow(LH902) — fork-probe loop:
+                continue       # a miss on one fork's class is expected;
+                #                total failure is penalized right below
         if block is None:
             self.peers.report(msg.source, "mid")
             return

@@ -6,14 +6,33 @@ BlobsByRange) between peers over the in-process fabric, with a token-
 bucket rate limiter per (peer, protocol) mirroring the reference's
 rate_limiter.rs.  Payloads are SSZ bytes; responses are streamed as lists
 of SSZ chunks (the reference's response-chunk framing).
+
+Outbound requests run under :class:`RequestDiscipline` (shared by the
+in-process endpoint and the socket WireRpcEndpoint): a per-request
+watchdog deadline (``LHTPU_RPC_DEADLINE_S``, the PR 4 deadline idiom),
+a per-peer consecutive-failure counter that trips an exponential
+quarantine window (``LHTPU_RPC_FAILS`` / ``LHTPU_RPC_BACKOFF_S`` /
+``LHTPU_RPC_BACKOFF_MAX_S`` — the reference's peer-scoring-fed request
+backoff), and ``rpc_requests_total{protocol,outcome}`` /
+``rpc_request_seconds`` accounting.  The discipline is also where the
+ops/faults :class:`PeerFaultPlan` Byzantine-peer injection fires —
+stalls, withheld windows, truncated/malformed chunks, wrong-chain
+redirects, STATUS equivocation and mid-stream flaps are synthesized at
+the requester's seam so sync/backfill supervision is exercised
+deterministically on CI.
 """
 
 from __future__ import annotations
 
+import hashlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+from lighthouse_tpu.ops import faults
 from lighthouse_tpu.ssz import core as ssz
 
 
@@ -23,6 +42,15 @@ class RpcError(ValueError):
 
 class RateLimited(RpcError):
     pass
+
+
+class RpcDeadline(RpcError):
+    """The request exceeded its LHTPU_RPC_DEADLINE_S watchdog deadline."""
+
+
+class PeerQuarantined(RpcError):
+    """The peer is inside its backoff quarantine window; the request was
+    refused locally without touching the wire (fail-fast)."""
 
 
 # --- protocol payload containers (reference rpc/methods.rs) ----------------
@@ -74,6 +102,174 @@ class RateLimiter:
         return True
 
 
+def proto_token(protocol: str) -> str:
+    """Short metric/fault-plan token for a protocol id: the name path
+    segment ("status", "beacon_blocks_by_range", ...)."""
+    parts = protocol.strip("/").split("/")
+    return parts[-2] if len(parts) >= 2 else protocol
+
+
+def _record_request(token: str, outcome: str,
+                    seconds: float | None = None) -> None:
+    REGISTRY.counter(
+        "rpc_requests_total",
+        "outbound rpc requests by protocol token and outcome",
+    ).labels(protocol=token, outcome=outcome).inc()
+    if seconds is not None:
+        REGISTRY.histogram(
+            "rpc_request_seconds",
+            "outbound rpc request wall time (includes retr-able "
+            "failures; quarantined fail-fasts are not timed)",
+        ).observe(seconds)
+
+
+@dataclass
+class _PeerHealth:
+    fails: int = 0         # consecutive failures since the last success
+    quarantines: int = 0   # ladder rung: doubles the next window
+    until: float = 0.0     # monotonic instant the quarantine lifts
+
+
+class RequestDiscipline:
+    """Per-peer deadline/backoff/quarantine + metrics + fault injection
+    for outbound requests — one instance per endpoint, shared between
+    the in-process and socket RPC seams.
+
+    ``execute`` wraps the transport-specific ``issue(dst)`` callable:
+    consult the peer fault plans, enforce the watchdog deadline, track
+    the per-peer failure ladder, and account every outcome in
+    ``rpc_requests_total{protocol,outcome}``.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._health: dict[str, _PeerHealth] = {}
+        self._ordinals: dict[tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+        # (peer, rung) callback when a peer crosses into quarantine —
+        # the NetworkService feeds this into peer_manager scoring
+        self.on_quarantine: Callable | None = None
+
+    def quarantined_until(self, peer: str) -> float:
+        """Monotonic lift instant, 0.0 when not quarantined."""
+        with self._lock:
+            h = self._health.get(peer)
+            return h.until if h is not None else 0.0
+
+    def execute(self, dst: str, protocol: str, data: bytes,
+                issue: Callable[[str], list[bytes]]) -> list[bytes]:
+        token = proto_token(protocol)
+        now = self.clock()
+        with self._lock:
+            h = self._health.get(dst)
+            if h is not None and now < h.until:
+                _record_request(token, "quarantined")
+                raise PeerQuarantined(
+                    f"{dst} quarantined for another "
+                    f"{h.until - now:.2f}s (rung {h.quarantines})")
+            key = (dst, protocol)
+            ordinal = self._ordinals.get(key, 0)
+            self._ordinals[key] = ordinal + 1
+        plan = faults.consult_peer(dst, token, ordinal)
+        deadline = envreg.get_float("LHTPU_RPC_DEADLINE_S", 5.0) or 0.0
+
+        def _issue():
+            return self._issue_with_plan(dst, protocol, data, plan, issue)
+
+        t0 = time.perf_counter()
+        try:
+            if deadline > 0 and not faults.under_watchdog():
+                try:
+                    chunks = faults.run_with_deadline(
+                        _issue, deadline, f"rpc-{token}",
+                        f"rpc {token} request to {dst}")
+                except faults.WatchdogTimeout as e:
+                    raise RpcDeadline(str(e)) from e
+            else:
+                chunks = _issue()
+        except Exception as e:
+            outcome = ("deadline" if isinstance(e, RpcDeadline)
+                       else "rate_limited" if isinstance(e, RateLimited)
+                       else "error")
+            self._note_failure(dst)
+            _record_request(token, outcome, time.perf_counter() - t0)
+            raise
+        self._note_success(dst)
+        _record_request(token, "ok", time.perf_counter() - t0)
+        return chunks
+
+    # -- fault synthesis (PeerFaultPlan modes) ------------------------------
+
+    def _issue_with_plan(self, dst, protocol, data, plan, issue):
+        if plan is None:
+            return issue(dst)
+        mode = plan.mode
+        if mode == "stall":
+            # the deadline watchdog's job is to cut this off
+            time.sleep(plan.stall_s)
+            return issue(dst)
+        if mode == "flap":
+            raise RpcError(
+                f"injected mid-stream disconnect from {dst}")
+        if mode == "empty":
+            return []
+        if mode == "wrong_chain":
+            if plan.alt_peer is None:
+                return []        # no branch to serve: withhold
+            return issue(plan.alt_peer)
+        chunks = issue(dst)
+        if mode == "truncate":
+            return chunks[: len(chunks) // 2]
+        if mode == "malformed":
+            return [bytes(b ^ 0xA5 for b in c[:16]) + c[16:] if c
+                    else b"\xa5" for c in chunks] or [b"\xa5"]
+        if mode == "equivocate" and proto_token(protocol) == "status":
+            out = []
+            for c in chunks:
+                st = StatusMessage.deserialize(c)
+                bogus = hashlib.sha256(
+                    bytes(st.head_root) + b"equivocate").digest()
+                out.append(StatusMessage(
+                    fork_digest=bytes(st.fork_digest),
+                    finalized_root=bytes(st.finalized_root),
+                    finalized_epoch=int(st.finalized_epoch),
+                    head_root=bogus,
+                    head_slot=int(st.head_slot) + plan.lift,
+                ).serialize())
+            return out
+        return chunks
+
+    # -- failure ladder ------------------------------------------------------
+
+    def _note_failure(self, dst: str) -> None:
+        fails_max = envreg.get_int("LHTPU_RPC_FAILS", 3) or 3
+        base = envreg.get_float("LHTPU_RPC_BACKOFF_S", 0.5) or 0.5
+        cap = envreg.get_float("LHTPU_RPC_BACKOFF_MAX_S", 30.0) or 30.0
+        cb = rung = None
+        with self._lock:
+            h = self._health.setdefault(dst, _PeerHealth())
+            h.fails += 1
+            if h.fails >= fails_max:
+                h.until = self.clock() + min(
+                    base * (2.0 ** h.quarantines), cap)
+                h.quarantines += 1
+                h.fails = 0
+                cb, rung = self.on_quarantine, h.quarantines
+        if cb is not None:
+            try:
+                cb(dst, rung)
+            except Exception as e:
+                record_swallowed("rpc.on_quarantine", e)
+
+    def _note_success(self, dst: str) -> None:
+        with self._lock:
+            h = self._health.get(dst)
+            if h is not None:
+                h.fails = 0
+                h.quarantines = 0
+                h.until = 0.0
+
+
 class RpcFabric:
     """In-process request routing between registered RPC endpoints."""
 
@@ -98,13 +294,17 @@ class RpcEndpoint:
         self.peer_id = peer_id
         self.handlers: dict[str, Callable[[str, bytes], list[bytes]]] = {}
         self.limiter = RateLimiter()
+        self.discipline = RequestDiscipline()
 
     def register(self, protocol: str,
                  handler: Callable[[str, bytes], list[bytes]]):
         self.handlers[protocol] = handler
 
     def request(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
-        return self.fabric.call(self.peer_id, dst, protocol, data)
+        return self.discipline.execute(
+            dst, protocol, data,
+            lambda target: self.fabric.call(
+                self.peer_id, target, protocol, data))
 
     def _serve(self, src: str, protocol: str, data: bytes) -> list[bytes]:
         if not self.limiter.allow(src, protocol):

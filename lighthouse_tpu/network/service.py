@@ -62,6 +62,14 @@ class NetworkService:
             subnet_service=subnet_service, processor=processor)
         self.sync = SyncManager(chain, self.rpc_ep, self.router,
                                 self.peer_manager)
+        # the rpc request discipline's quarantine ladder feeds peer
+        # scoring: a peer that keeps timing out / erroring until it is
+        # quarantined loses standing like any other misbehaver
+        discipline = getattr(self.rpc_ep, "discipline", None)
+        if discipline is not None:
+            discipline.on_quarantine = (
+                lambda peer, rung, _pm=self.peer_manager:
+                _pm.report(peer, "mid"))
         # gossip fresh light-client updates as the chain mints them
         # (reference --light-client-server gossip publication)
         chain.light_client.on_finality_update = \

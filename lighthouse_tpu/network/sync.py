@@ -1,4 +1,4 @@
-"""Sync manager: range sync + parent-lookup sync.
+"""Sync manager: supervised range sync + parent-lookup sync.
 
 Rebuild of /root/reference/beacon_node/network/src/sync/ (manager.rs,
 range_sync/chain.rs + chain_collection.rs, block_lookups/): STATUS
@@ -6,20 +6,47 @@ handshakes pick peers ahead of us, peers advertising the SAME target
 head merge into one syncing chain (concurrent-chain dedup), and each
 BlocksByRange batch runs a retry state machine — a failed or lying
 download moves to another pool peer with the offender downscored, up to
-MAX_BATCH_ATTEMPTS (range_sync/batch.rs's
+LHTPU_SYNC_BATCH_ATTEMPTS (range_sync/batch.rs's
 MAX_BATCH_DOWNLOAD_ATTEMPTS).  Batch contents are validated against the
-request (slot window, ascending order, intra-batch parent linkage)
-before a single block is executed, so a lying peer costs one round
-trip, not a poisoned import.  Unknown-parent blocks trigger a
-backwards lookup chase capped in depth, single-flight per root with a
-failed-chase cache (block_lookups dedup hardening).
+request (slot window, chunk-count bound, ascending order, intra-batch
+parent linkage) before a single block is executed, so a lying peer
+costs one round trip, not a poisoned import.
+
+Byzantine hardening (the PAPER.md §L5/§L8 adversarial serving model):
+
+- **Cross-batch linkage.** A batch's first block must attach to KNOWN
+  history (its parent in fork choice).  An empty response can no longer
+  silently advance the cursor past real history: empty windows are
+  recorded as *provisional* and only confirmed when a later served
+  block links across them.  When it does not, the windows are
+  re-requested from different pool peers; blocks recovered there prove
+  the original server withheld history and it is downscored hard
+  (``sync_downscores_total{reason="withheld_window"}``).
+- **Progress watchdog + per-target accounting.** A chain making no
+  batch progress for LHTPU_SYNC_STALL_S is abandoned and its peers
+  re-pooled; targets are retried at most LHTPU_SYNC_CHAIN_ATTEMPTS
+  times (the PR 8 ladder shape, per advertised (head_root, head_slot)).
+- **Books.** Every batch attempt lands in exactly one of
+  imported/retried/abandoned, so the invariant
+  ``requested == imported + retried + abandoned`` holds at all times
+  (``sync_batch_requests_total`` vs ``sync_batches_total{outcome}``);
+  every penalty issued by the sync plane is reason-labeled in
+  ``sync_downscores_total{reason}``.
+
+Unknown-parent blocks trigger a backwards lookup chase capped in depth,
+single-flight per root with a failed-chase cache (block_lookups dedup
+hardening).
 """
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+from lighthouse_tpu.common.tracing import add_attrs, span
 from lighthouse_tpu.network.rpc import (
     BlocksByRangeRequest,
     P_BLOCKS_BY_RANGE,
@@ -29,10 +56,26 @@ from lighthouse_tpu.network.rpc import (
     StatusMessage,
 )
 
-BATCH_SIZE = 32
-MAX_BATCH_ATTEMPTS = 5        # download+process tries across the pool
+BATCH_SIZE = 32               # default; LHTPU_SYNC_BATCH_SIZE overrides
+MAX_BATCH_ATTEMPTS = 5        # default; LHTPU_SYNC_BATCH_ATTEMPTS overrides
 MAX_LOOKUP_DEPTH = 16
 FAILED_LOOKUP_CACHE = 512
+#: unconfirmed empty windows tolerated before the chain counts as
+#: wedged (a peer set serving nothing but empties toward an advertised
+#: head is withholding — or the head was equivocated)
+MAX_PENDING_WINDOWS = 8
+#: remembered sync targets for per-target attempt accounting
+TARGET_CACHE = 64
+
+
+def _batch_size() -> int:
+    return max(1, envreg.get_int("LHTPU_SYNC_BATCH_SIZE", BATCH_SIZE)
+               or BATCH_SIZE)
+
+
+def _batch_attempts() -> int:
+    return max(1, envreg.get_int("LHTPU_SYNC_BATCH_ATTEMPTS",
+                                 MAX_BATCH_ATTEMPTS) or MAX_BATCH_ATTEMPTS)
 
 
 @dataclass
@@ -51,6 +94,60 @@ class SyncManager:
         self.statuses: dict[str, PeerStatus] = {}
         self._inflight_lookups: set[bytes] = set()
         self._failed_lookups: OrderedDict[bytes, None] = OrderedDict()
+        # per-advertised-target abandoned-attempt accounting (PR 8
+        # ladder shape: a target that keeps wedging is skipped)
+        self._chain_attempts: OrderedDict[tuple, int] = OrderedDict()
+        self._target_root: bytes | None = None
+        self._last_chain_ok = True
+        # the books: requested == imported + retried + abandoned, always
+        self.books = {"requested": 0, "imported": 0, "retried": 0,
+                      "abandoned": 0}
+        self.downscores = 0
+
+    # -- accounting (the LH604 funnels) -------------------------------------
+
+    def _account_batch(self, outcome: str) -> None:
+        """One batch attempt lands in exactly one outcome bucket; the
+        requested counter is bumped separately per attempt so the books
+        invariant is checkable from the metrics alone."""
+        self.books[outcome] += 1
+        if outcome == "requested":
+            REGISTRY.counter(
+                "sync_batch_requests_total",
+                "range-sync batch download attempts issued").inc()
+        else:
+            REGISTRY.counter(
+                "sync_batches_total",
+                "range-sync batch attempts by terminal outcome",
+            ).labels(outcome=outcome).inc()
+
+    def _record_chain(self, outcome: str) -> None:
+        REGISTRY.counter(
+            "sync_chains_total",
+            "syncing-chain attempts by outcome",
+        ).labels(outcome=outcome).inc()
+
+    def _account_lookup(self, outcome: str) -> None:
+        REGISTRY.counter(
+            "sync_lookups_total",
+            "parent-lookup chases by outcome",
+        ).labels(outcome=outcome).inc()
+
+    def _downscore(self, peer: str, level: str, reason: str) -> None:
+        """EVERY penalty the sync plane issues goes through here:
+        reason-labeled in sync_downscores_total and tallied in the
+        local ledger (zero-unaccounted-downscores discipline)."""
+        self.downscores += 1
+        REGISTRY.counter(
+            "sync_downscores_total",
+            "peer downscores issued by the sync plane, by reason",
+        ).labels(reason=reason).inc()
+        self.peers.report(peer, level)
+
+    def books_balanced(self) -> bool:
+        b = self.books
+        return b["requested"] == (b["imported"] + b["retried"]
+                                  + b["abandoned"])
 
     # -- status -------------------------------------------------------------
 
@@ -59,11 +156,16 @@ class SyncManager:
             chunks = self.rpc.request(
                 peer, P_STATUS, self.router.local_status().serialize())
         except RpcError:
-            self.peers.report(peer, "mid")
+            self._downscore(peer, "mid", "rpc_error")
             return None
         if not chunks:
             return None
-        remote = StatusMessage.deserialize(chunks[0])
+        try:
+            remote = StatusMessage.deserialize(chunks[0])
+        except Exception as e:
+            record_swallowed("sync.status_decode", e)
+            self._downscore(peer, "high", "decode")
+            return None
         st = PeerStatus(
             head_slot=int(remote.head_slot),
             head_root=bytes(remote.head_root),
@@ -79,16 +181,20 @@ class SyncManager:
                         count: int) -> list | None:
         """One BlocksByRange round trip, VALIDATED against the request
         before anything executes (range_sync/batch.rs received-block
-        checks): every block inside [start, start+count), slots strictly
-        ascending, and each block's parent_root chaining to its batch
-        predecessor.  Violations downscore the peer hard and fail the
-        attempt."""
+        checks): chunk count bounded by the request, every block inside
+        [start, start+count), slots strictly ascending, and each block's
+        parent_root chaining to its batch predecessor.  Violations
+        downscore the peer hard and fail the attempt."""
         req = BlocksByRangeRequest(start_slot=start, count=count, step=1)
         try:
             chunks = self.rpc.request(peer, P_BLOCKS_BY_RANGE,
                                       req.serialize())
         except RpcError:
-            self.peers.report(peer, "mid")
+            self._downscore(peer, "mid", "rpc_error")
+            return None
+        if len(chunks) > count:
+            # a peer may serve FEWER blocks (skipped slots), never more
+            self._downscore(peer, "high", "overserve")
             return None
         blocks = []
         prev_slot = -1
@@ -96,72 +202,222 @@ class SyncManager:
         for raw in chunks:
             block = self._decode_block(raw)
             if block is None:
-                self.peers.report(peer, "high")
+                self._downscore(peer, "high", "decode")
                 return None
             slot = int(block.message.slot)
             if not (start <= slot < start + count) or slot <= prev_slot:
-                self.peers.report(peer, "high")   # outside window / order
+                self._downscore(peer, "high", "window")
                 return None
             if prev_root is not None and \
                     bytes(block.message.parent_root) != prev_root:
-                self.peers.report(peer, "high")   # broken intra-batch chain
+                self._downscore(peer, "high", "broken_linkage")
                 return None
             prev_slot = slot
             prev_root = block.message.hash_tree_root()
             blocks.append(block)
         return blocks
 
-    def _execute_batch(self, pool: list[str], start: int,
-                       count: int) -> tuple[int, bool]:
-        """Run one batch through the retry machine: (imported, ok).
+    def _resolve_pending(self, pool: list[str], pending: list,
+                         exclude: str) -> int:
+        """A served block failed to link across provisional empty
+        windows: re-request each window from DIFFERENT peers.  Blocks
+        recovered there prove the original server withheld history —
+        it is downscored hard and the blocks are imported.  Returns the
+        number of recovered blocks imported."""
+        recovered = 0
+        for window in list(pending):
+            wstart, wcount, wpeer = window
+            for cand in pool:
+                if cand == wpeer or cand == exclude:
+                    continue
+                self._account_batch("requested")
+                blocks = self._download_batch(cand, wstart, wcount)
+                if blocks is None:
+                    self._account_batch("retried")
+                    continue
+                if not blocks:
+                    # this candidate agrees the window is empty; ask the
+                    # next one — unanimity leaves the window provisional
+                    self._account_batch("imported")
+                    continue
+                if bytes(blocks[0].message.parent_root) \
+                        not in self.chain.fork_choice.proto:
+                    self._downscore(cand, "high", "broken_linkage")
+                    self._account_batch("retried")
+                    continue
+                n, ok = self._process_blocks(cand, blocks)
+                recovered += n
+                if ok:
+                    self._account_batch("imported")
+                    self._downscore(wpeer, "high", "withheld_window")
+                    pending.remove(window)
+                    break
+                self._account_batch("retried")
+        return recovered
+
+    def _process_blocks(self, peer: str, blocks: list) -> tuple[int, bool]:
+        """Execute validated blocks; (imported, ok).  A rejection
+        attributes blame to the serving peer; unexpected processing
+        faults are accounted, never silently swallowed."""
+        from lighthouse_tpu.chain.block_verification import BlockError
+
+        imported = 0
+        for block in blocks:
+            try:
+                if self.chain.process_block(block,
+                                            source="rpc") is not None:
+                    imported += 1
+            except BlockError as e:
+                if str(e) == "duplicate":
+                    continue      # earlier attempt imported a prefix
+                self._downscore(peer, "high", "invalid_block")
+                return imported, False
+            except Exception as e:
+                record_swallowed("sync.process_block", e)
+                self._downscore(peer, "mid", "process_error")
+                return imported, False
+        return imported, True
+
+    def _execute_batch(self, pool: list[str], start: int, count: int,
+                       batch_no: int,
+                       pending: list) -> tuple[int, str, str | None]:
+        """Run one batch through the retry machine: (imported, outcome,
+        serving_peer) with outcome in {"ok", "empty", "failed"}.
 
         A failed download or a processing rejection moves the batch to
         the next pool peer (the offender already downscored); after
-        MAX_BATCH_ATTEMPTS the whole chain attempt is abandoned —
-        exactly the pressure shape of range_sync's batch state
-        machine."""
-        from lighthouse_tpu.chain.block_verification import BlockError
-
+        LHTPU_SYNC_BATCH_ATTEMPTS the whole chain attempt is abandoned —
+        exactly the pressure shape of range_sync's batch state machine.
+        ``batch_no`` rotates the starting peer so consecutive batches
+        spread over the pool instead of hammering its head."""
+        attempts = _batch_attempts()
         failed: set[str] = set()
-        for attempt in range(MAX_BATCH_ATTEMPTS):
+        recovered = 0   # blocks imported while disproving empty windows
+        for attempt in range(attempts):
             cands = [p for p in pool if p not in failed] or list(pool)
-            peer = cands[attempt % len(cands)]
-            blocks = self._download_batch(peer, start, count)
-            if blocks is None:
+            peer = cands[(batch_no + attempt) % len(cands)]
+            last = attempt == attempts - 1
+            self._account_batch("requested")
+            t0 = time.perf_counter()
+            with span("sync.batch", slot=start, peer=peer, count=count):
+                blocks = self._download_batch(peer, start, count)
+                if blocks is None:
+                    add_attrs(outcome="download_failed")
+                    failed.add(peer)
+                    self._account_batch("abandoned" if last else "retried")
+                    continue
+                if not blocks:
+                    # provisional: confirmed only when later blocks link
+                    # across this window (or disproven and re-requested)
+                    add_attrs(outcome="empty")
+                    self._account_batch("imported")
+                    self.peers.report(peer, "useful_response")
+                    self._observe_batch(time.perf_counter() - t0)
+                    return 0, "empty", peer
+                if bytes(blocks[0].message.parent_root) \
+                        not in self.chain.fork_choice.proto:
+                    # does not attach to known history: an earlier empty
+                    # window may have withheld the connecting blocks, our
+                    # own head may sit on a side branch (chase the
+                    # ancestors by root — the block_lookups fallback), or
+                    # THIS peer serves a fabricated chain
+                    if pending:
+                        recovered += self._resolve_pending(
+                            pool, pending, exclude=peer)
+                    if bytes(blocks[0].message.parent_root) \
+                            not in self.chain.fork_choice.proto:
+                        self.lookup_unknown_parent(peer, blocks[0])
+                    if bytes(blocks[0].message.parent_root) \
+                            in self.chain.fork_choice.proto:
+                        pass     # recovered the missing history; import
+                    else:
+                        add_attrs(outcome="unlinked")
+                        self._downscore(peer, "high", "broken_linkage")
+                        failed.add(peer)
+                        self._account_batch(
+                            "abandoned" if last else "retried")
+                        continue
+                imported, ok = self._process_blocks(peer, blocks)
+                self._observe_batch(time.perf_counter() - t0)
+                if ok:
+                    add_attrs(outcome="imported", imported=imported)
+                    self._account_batch("imported")
+                    self.peers.report(peer, "useful_response")
+                    # real blocks linked through: earlier provisional
+                    # windows are confirmed honest skips
+                    pending.clear()
+                    return imported + recovered, "ok", peer
+                add_attrs(outcome="process_failed")
                 failed.add(peer)
-                continue
-            imported = 0
-            ok = True
-            for block in blocks:
-                try:
-                    if self.chain.process_block(block,
-                                                source="rpc") is not None:
-                        imported += 1
-                except BlockError as e:
-                    if str(e) == "duplicate":
-                        continue      # earlier attempt imported a prefix
-                    self.peers.report(peer, "high")
-                    ok = False
-                    break
-                except Exception:
-                    self.peers.report(peer, "mid")
-                    ok = False
-                    break
-            if ok:
-                self.peers.report(peer, "useful_response")
-                return imported, True
-            failed.add(peer)
-        return 0, False
+                self._account_batch("abandoned" if last else "retried")
+        return recovered, "failed", None
+
+    def _observe_batch(self, seconds: float) -> None:
+        REGISTRY.histogram(
+            "sync_batch_seconds",
+            "range-sync batch wall time (download+validate+process)",
+        ).observe(seconds)
 
     def _sync_chain(self, pool: list[str], target_slot: int) -> int:
+        """Drive one syncing chain batch-by-batch; returns blocks
+        imported.  Sets ``_last_chain_ok`` for the caller's per-target
+        accounting: False means the chain was abandoned (wedged, lying
+        pool, or unreachable target)."""
         imported = 0
+        self._last_chain_ok = True
+        target_root = self._target_root
+        bsize = _batch_size()
+        stall_s = envreg.get_float("LHTPU_SYNC_STALL_S", 20.0) or 0.0
         slot = int(self.chain.head_state.slot) + 1
+        # provisional empty windows awaiting linkage confirmation
+        pending: list[tuple[int, int, str]] = []
+        served: list[str] = []   # peers whose batches we accepted
+        last_progress = time.monotonic()
+        batch_no = 0
         while slot <= target_slot:
-            n, ok = self._execute_batch(pool, slot, BATCH_SIZE)
-            if not ok:
+            count = min(bsize, target_slot - slot + 1)
+            n, outcome, peer = self._execute_batch(pool, slot, count,
+                                                   batch_no, pending)
+            batch_no += 1
+            if outcome == "failed":
+                imported += n   # blocks recovered from disproven windows
+                self._last_chain_ok = False
                 break
-            imported += n
-            slot += BATCH_SIZE
+            if outcome == "empty":
+                pending.append((slot, count, peer))
+                if len(pending) > MAX_PENDING_WINDOWS:
+                    # nothing but withheld windows toward an advertised
+                    # head: the pool is lying (or the head equivocated)
+                    for _, _, wpeer in pending:
+                        self._downscore(wpeer, "mid", "withheld_window")
+                    self._last_chain_ok = False
+                    break
+            else:
+                imported += n
+                if peer is not None and peer not in served:
+                    served.append(peer)
+                last_progress = time.monotonic()
+            slot += count
+            if stall_s and time.monotonic() - last_progress > stall_s:
+                self._last_chain_ok = False   # wedged: abandon, re-pool
+                break
+        else:
+            # reached the target window; the advertised head must have
+            # actually materialized or the chain was a fiction
+            if pending:
+                for wpeer in dict.fromkeys(p for _, _, p in pending):
+                    self._downscore(wpeer, "mid", "withheld_window")
+                self._last_chain_ok = False
+            if target_root is not None and not pending and \
+                    target_root not in self.chain.fork_choice.proto:
+                # every batch "succeeded" yet the advertised head never
+                # materialized: the pool served a consistent but
+                # NON-CANONICAL branch (or a fiction).  Blame the peers
+                # whose batches we accepted — a wrong-chain server looks
+                # honest batch-by-batch, only the end state convicts it.
+                for wpeer in served:
+                    self._downscore(wpeer, "mid", "wrong_chain")
+                self._last_chain_ok = False
         return imported
 
     def sync_to_peer(self, peer: str) -> int:
@@ -169,13 +425,22 @@ class SyncManager:
         status = self.statuses.get(peer) or self.status_handshake(peer)
         if status is None:
             return 0
-        return self._sync_chain([peer], status.head_slot)
+        self._target_root = bytes(status.head_root)
+        try:
+            n = self._sync_chain([peer], status.head_slot)
+        finally:
+            self._target_root = None
+        self._record_chain("completed" if self._last_chain_ok
+                           else "abandoned")
+        return n
 
     def sync(self) -> int:
         """Group peers ahead of us by advertised target and range-sync
         the best-supported chain (chain_collection.rs: one syncing chain
         per target, peers pooled — never duplicate batch work for peers
-        that advertise the same head)."""
+        that advertise the same head).  An abandoned chain falls through
+        to the next-best target with its peers re-pooled; targets that
+        keep wedging are skipped after LHTPU_SYNC_CHAIN_ATTEMPTS."""
         local = int(self.chain.head_state.slot)
         chains: dict[tuple[bytes, int], list[str]] = {}
         for peer in self.peers.good_peers():
@@ -185,10 +450,33 @@ class SyncManager:
                     (st.head_root, st.head_slot), []).append(peer)
         if not chains:
             return 0
-        # most-supported target wins; ties to the higher head
-        (_, target_slot), pool = max(
-            chains.items(), key=lambda kv: (len(kv[1]), kv[0][1]))
-        return self._sync_chain(pool, target_slot)
+        budget = max(1, envreg.get_int("LHTPU_SYNC_CHAIN_ATTEMPTS", 3) or 3)
+        total = 0
+        # most-supported target first; ties to the higher head
+        for key, pool in sorted(
+                chains.items(),
+                key=lambda kv: (len(kv[1]), kv[0][1]), reverse=True):
+            attempts = self._chain_attempts.get(key, 0)
+            if attempts >= budget:
+                continue          # exhausted target (already accounted)
+            # re-pool on retry: rotate the pool so a prior attempt's
+            # wrong-chain/wedged server is not first in line again
+            k = attempts % len(pool)
+            pool = pool[k:] + pool[:k]
+            self._target_root = bytes(key[0])
+            try:
+                total += self._sync_chain(pool, key[1])
+            finally:
+                self._target_root = None
+            if self._last_chain_ok:
+                self._chain_attempts.pop(key, None)
+                self._record_chain("completed")
+                break
+            self._chain_attempts[key] = attempts + 1
+            while len(self._chain_attempts) > TARGET_CACHE:
+                self._chain_attempts.popitem(last=False)
+            self._record_chain("abandoned")
+        return total
 
     # -- lookup sync ----------------------------------------------------------
 
@@ -216,6 +504,8 @@ class SyncManager:
             self._failed_lookups.popitem(last=False)
 
     def _lookup_chase(self, peer: str, block, parent: bytes) -> int:
+        from lighthouse_tpu.chain.block_verification import BlockError
+
         chain_segment = [block]
         for _ in range(MAX_LOOKUP_DEPTH):
             if parent in self.chain.fork_choice.proto:
@@ -223,18 +513,22 @@ class SyncManager:
             if parent in self._failed_lookups:
                 # a previous chase already proved this ancestor
                 # unreachable: don't re-walk the live prefix to it
+                self._account_lookup("cached_dead_end")
                 return 0
             try:
                 chunks = self.rpc.request(peer, P_BLOCKS_BY_ROOT, parent)
             except RpcError:
-                self.peers.report(peer, "mid")
+                self._downscore(peer, "mid", "rpc_error")
+                self._account_lookup("failed")
                 return 0
             if not chunks:
                 self._mark_failed_lookup(parent)
+                self._account_lookup("dead_end")
                 return 0
             got = self._decode_block(chunks[0])
             if got is None or got.message.hash_tree_root() != parent:
-                self.peers.report(peer, "high")   # lied about the root
+                self._downscore(peer, "high", "lied_root")
+                self._account_lookup("failed")
                 return 0
             chain_segment.append(got)
             parent = bytes(got.message.parent_root)
@@ -242,15 +536,31 @@ class SyncManager:
             # depth budget exhausted — NOT evidence the ancestor is
             # unreachable (a fresh chase from a closer descendant could
             # succeed), so nothing is cached as failed
+            self._account_lookup("depth_exhausted")
             return 0
         imported = 0
         for blk in reversed(chain_segment):
             try:
                 if self.chain.process_block(blk, source="rpc") is not None:
                     imported += 1
-            except Exception:
-                break
+            except BlockError as e:
+                if str(e) == "duplicate":
+                    continue      # racing gossip import won; keep walking
+                self._downscore(peer, "mid", "invalid_block")
+                self._account_lookup("failed")
+                return imported
+            except Exception as e:
+                record_swallowed("sync.lookup_import", e)
+                self._account_lookup("failed")
+                return imported
+        self._account_lookup("imported" if imported else "noop")
         return imported
 
     def _decode_block(self, raw: bytes):
-        return self.chain.t.decode_signed_block(raw)
+        try:
+            return self.chain.t.decode_signed_block(raw)
+        except Exception as e:
+            # malformed bytes from a hostile peer: the CALLER downscores
+            # + accounts the failed attempt through the reason funnel
+            record_swallowed("sync.decode_block", e)
+            return None  # lhlint: allow(LH604)

@@ -44,7 +44,8 @@ from typing import Callable
 from lighthouse_tpu.common.logging import Logger
 from lighthouse_tpu.common.metrics import record_swallowed
 from lighthouse_tpu.network.gossip import _SeenCache, message_id
-from lighthouse_tpu.network.rpc import RateLimiter, RpcError
+from lighthouse_tpu.network.rpc import (RateLimiter, RequestDiscipline,
+                                        RpcError)
 from lighthouse_tpu.network.wire import codec, gossipsub, noise
 
 REQUEST_TIMEOUT_S = 10.0
@@ -628,7 +629,10 @@ class WireNode:
                 try:
                     await self.loop.run_in_executor(
                         self._pool, handler, topic, data, src)
-                except Exception:
+                except Exception as e:
+                    # the sender is downscored via mark_invalid below;
+                    # the handler error itself is counted
+                    record_swallowed("wire.gossip_handler", e)
                     ok = False
             if not ok:
                 self._gs.mark_invalid(src, topic)
@@ -896,7 +900,10 @@ class WireNode:
             try:
                 chunks = handler(d.get("from", "?"),
                                  bytes.fromhex(d.get("d", "")))
-            except Exception:
+            except Exception as e:
+                # a failed discovery handler drops the datagram (UDP is
+                # best-effort) but must not vanish uncounted
+                record_swallowed("wire.udp_handler", e)
                 return
             resp = json.dumps({
                 "t": "resp", "n": d["n"],
@@ -996,11 +1003,19 @@ class WireRpcEndpoint:
         self.node = node
         self.peer_id = node.peer_id
         self._resolve_addr = resolve_addr
+        # same per-peer deadline/backoff/quarantine + accounting as the
+        # in-process endpoint (network/rpc.RequestDiscipline)
+        self.discipline = RequestDiscipline()
 
     def register(self, protocol: str, handler):
         self.node.register_rpc(protocol, handler)
 
     def request(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        return self.discipline.execute(dst, protocol, data,
+                                       lambda target: self._issue(
+                                           target, protocol, data))
+
+    def _issue(self, dst: str, protocol: str, data: bytes) -> list[bytes]:
         if dst not in self.node.peers and self._resolve_addr is not None:
             addr = self._resolve_addr(dst)
             if addr is not None:
@@ -1030,8 +1045,10 @@ class WireDiscoveryEndpoint:
         for c in chunks:
             try:
                 enr = Enr.from_bytes(c)
-            except Exception:
-                continue
+            except Exception:  # lhlint: allow(LH902) — probe loop over
+                continue       # untrusted datagram bytes: non-Enr chunks
+                #                are expected, the verify() below is the
+                #                actual trust gate
             # records learned over UDP are untrusted: only admit ENRs
             # signed by the key whose fingerprint is the record's peer id
             if not enr.verify():

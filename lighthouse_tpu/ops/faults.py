@@ -223,6 +223,197 @@ def fire(site: str, index: int = 0) -> str | None:
     raise InjectedFault(f"injected device fault at {site}[{index}]")
 
 
+# --- peer / network-plane faults ---------------------------------------------
+
+VALID_PEER_MODES = ("stall", "empty", "truncate", "malformed",
+                    "wrong_chain", "equivocate", "flap")
+
+#: protocol tokens the rpc layer derives from its protocol ids (the
+#: second-to-last path segment: "status", "beacon_blocks_by_range", ...)
+KNOWN_PROTOCOL_TOKENS = (
+    "status", "goodbye", "beacon_blocks_by_range", "beacon_blocks_by_root",
+    "blob_sidecars_by_range", "blob_sidecars_by_root")
+
+
+@dataclass
+class PeerFaultPlan:
+    """One adversarial-peer directive for the network plane.
+
+    Consumed by the rpc request discipline (network/rpc.py) — the same
+    reasoning as :class:`FaultPlan`: real Byzantine peers (withholding
+    ranges, serving stale forks, stalling responses past deadlines,
+    flapping mid-stream) are neither deterministic nor available on CI,
+    so the sync/backfill supervision is exercised by injecting them on
+    command at the requester's seam.
+
+    ===========  ==============================================================
+    mode         behaviour at a matching (peer, protocol, ordinal) request
+    ===========  ==============================================================
+    stall        response delayed ``stall_s`` seconds — the rpc deadline
+                 watchdog must cut it off
+    empty        the response chunks are withheld (served as ``[]``) — a
+                 lying empty window the sync linkage machine must detect
+    truncate     only the first half of the response chunks are served
+    malformed    response bytes are corrupted (decode must fail, peer
+                 downscored hard)
+    wrong_chain  the request is transparently redirected to ``alt_peer``
+                 (a node serving a consistent but non-canonical branch);
+                 with no ``alt_peer`` the response is withheld
+    equivocate   STATUS responses advertise a bogus head: ``head_slot``
+                 lifted by ``lift`` and a fabricated ``head_root``
+    flap         the peer disconnects mid-stream (request raises)
+    ===========  ==============================================================
+
+    ``peers``/``protocols``/``ordinals`` of None match everything; the
+    ordinal is the per-(peer, protocol) request counter at the
+    requesting endpoint, so "fail the third range request to peer X" is
+    expressible exactly.
+    """
+
+    mode: str
+    peers: frozenset | None = None       # peer ids; None = every peer
+    protocols: frozenset | None = None   # protocol tokens; None = every one
+    ordinals: frozenset | None = None    # request ordinals; None = every hit
+    stall_s: float = 30.0
+    max_fires: int | None = None
+    alt_peer: str | None = None          # wrong_chain redirect target
+    lift: int = 4096                     # equivocate head_slot lift
+    fires: int = field(default=0)        # mutated under _LOCK
+
+    def __post_init__(self):
+        if self.mode not in VALID_PEER_MODES:
+            raise ValueError(
+                f"peer fault mode {self.mode!r} not in {VALID_PEER_MODES}")
+        if self.peers is not None:
+            self.peers = frozenset(self.peers)
+        if self.protocols is not None:
+            self.protocols = frozenset(self.protocols)
+        if self.ordinals is not None:
+            self.ordinals = frozenset(int(i) for i in self.ordinals)
+
+
+_PEER_PLANS: tuple = ()
+_PEER_ENV_LOADED = False
+_WARNED_PEER_ENV = False
+
+
+def install_peer_plans(plans) -> None:
+    """Install (or, with None/(), clear) the process-wide peer fault
+    plans.  Multiple plans may be active at once — the syncstorm drill
+    arms one per fault class, each scoped to its own peer."""
+    global _PEER_PLANS, _PEER_ENV_LOADED
+    with _LOCK:
+        _PEER_PLANS = tuple(plans) if plans else ()
+        _PEER_ENV_LOADED = True  # explicit install suppresses the env load
+
+
+def clear_peer_plans() -> None:
+    """Remove all peer plans AND forget the env snapshot."""
+    global _PEER_PLANS, _PEER_ENV_LOADED
+    with _LOCK:
+        _PEER_PLANS = ()
+        _PEER_ENV_LOADED = False
+
+
+def peer_plan_from_env() -> PeerFaultPlan | None:
+    """Build a plan from the LHTPU_PEERFAULT_* knobs; None when unset.
+    Malformed values warn once and disable injection (same discipline
+    as :func:`plan_from_env`)."""
+    global _WARNED_PEER_ENV
+    mode = envreg.get("LHTPU_PEERFAULT_MODE")
+    if not mode:
+        return None
+
+    def _set(name):
+        raw = envreg.get(name)
+        if not raw:
+            return None
+        return frozenset(s.strip() for s in raw.split(",") if s.strip())
+
+    try:
+        raw_ord = envreg.get("LHTPU_PEERFAULT_ORDINALS")
+        ordinals = None
+        if raw_ord:
+            ordinals = frozenset(
+                int(i) for i in raw_ord.split(",") if i.strip())
+        return PeerFaultPlan(
+            mode=mode.strip(),
+            peers=_set("LHTPU_PEERFAULT_PEERS"),
+            protocols=_set("LHTPU_PEERFAULT_PROTOCOLS"),
+            ordinals=ordinals,
+            stall_s=envreg.get_float("LHTPU_PEERFAULT_STALL_S", 30.0),
+            max_fires=envreg.get_int("LHTPU_PEERFAULT_MAX_FIRES"),
+        )
+    except ValueError as e:
+        if not _WARNED_PEER_ENV:
+            _WARNED_PEER_ENV = True
+            import sys
+
+            print(f"lighthouse_tpu: ignoring malformed LHTPU_PEERFAULT_* "
+                  f"configuration ({e}); peer fault injection disabled",
+                  file=sys.stderr)
+        return None
+
+
+def active_peer_plans() -> tuple:
+    global _PEER_PLANS, _PEER_ENV_LOADED
+    if _PEER_ENV_LOADED:
+        return _PEER_PLANS
+    with _LOCK:
+        if not _PEER_ENV_LOADED:
+            plan = peer_plan_from_env()
+            _PEER_PLANS = (plan,) if plan is not None else ()
+            _PEER_ENV_LOADED = True
+        return _PEER_PLANS
+
+
+def _record_peer_injection(mode: str, protocol: str) -> None:
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "peer_faults_injected_total",
+            "peer faults injected by ops/faults, by mode and protocol",
+        ).labels(mode=mode, protocol=protocol).inc()
+    except (AttributeError, KeyError, TypeError, ValueError):
+        pass  # injection accounting must never mask the injected fault
+
+
+def consult_peer(peer: str, protocol_token: str,
+                 ordinal: int) -> PeerFaultPlan | None:
+    """First active plan matching this (peer, protocol, ordinal) request
+    at the requesting endpoint, with its fire accounted; None = serve
+    honestly."""
+    plans = active_peer_plans()
+    if not plans:
+        return None
+    for plan in plans:
+        if plan.peers is not None and peer not in plan.peers:
+            continue
+        if plan.protocols is not None \
+                and protocol_token not in plan.protocols:
+            continue
+        with _LOCK:
+            if plan.ordinals is not None \
+                    and int(ordinal) not in plan.ordinals:
+                continue
+            if plan.max_fires is not None and plan.fires >= plan.max_fires:
+                continue
+            plan.fires += 1
+        _record_peer_injection(plan.mode, protocol_token)
+        return plan
+    return None
+
+
+def peer_fires_by_mode() -> dict:
+    """{mode: fires} across the active plans (drill assertions: every
+    armed fault class actually fired)."""
+    out: dict = {}
+    for plan in active_peer_plans():
+        out[plan.mode] = out.get(plan.mode, 0) + plan.fires
+    return out
+
+
 # --- ingest-path storms ------------------------------------------------------
 
 VALID_INGEST_MODES = ("burst", "stall", "dup", "invalid")

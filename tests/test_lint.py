@@ -705,6 +705,130 @@ def test_shed_pass_real_tree_zero_findings():
     assert [f for f in findings if f.rule == "LH603"] == []
 
 
+# -- pass 12: accounted sync abandon (LH604) ----------------------------------
+
+
+def test_sync_pass_flags_unaccounted_penalty(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/sync.py": """
+        class SyncManager:
+            def download(self, peer):
+                blocks = self.rpc.request(peer, "range", b"")
+                if not blocks:
+                    self.peers.report(peer, "high")
+                    return None
+                return blocks
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH604"]
+    assert findings[0].symbol == "SyncManager.download:penalty_report"
+    assert "sync_*_total" in findings[0].message
+
+
+def test_sync_pass_flags_handler_exit(tmp_path):
+    # a return inside an except handler abandons the in-flight attempt
+    pkg, _ = make_pkg(tmp_path, {"network/backfill.py": """
+        class BackfillSync:
+            def process_batch(self, peer):
+                try:
+                    chunks = self.rpc.request(peer, "range", b"")
+                except ValueError:
+                    return 0
+                return len(chunks)
+    """})
+    findings = analyze(pkg)
+    assert [f.rule for f in findings] == ["LH604"]
+    assert findings[0].symbol == "BackfillSync.process_batch:handler_return"
+
+
+def test_sync_pass_compliant_twin_metric_literal(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/sync.py": """
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        class SyncManager:
+            def download(self, peer):
+                blocks = self.rpc.request(peer, "range", b"")
+                if not blocks:
+                    REGISTRY.counter("sync_attempts_total").labels(
+                        outcome="retried").inc()
+                    self.peers.report(peer, "high")
+                    return None
+                return blocks
+    """})
+    assert analyze(pkg) == []
+
+
+def test_sync_pass_compliant_twin_helper_call(tmp_path):
+    # funneling through a package accounting helper counts
+    pkg, _ = make_pkg(tmp_path, {"network/sync.py": """
+        from lighthouse_tpu.common.metrics import REGISTRY
+
+        class SyncManager:
+            def _downscore(self, peer, level, reason):
+                REGISTRY.counter("sync_penalties_total").labels(
+                    reason=reason).inc()
+                self.peers.report(peer, level)
+
+            def download(self, peer):
+                try:
+                    return self.rpc.request(peer, "range", b"")
+                except ValueError:
+                    self._downscore(peer, "mid", "rpc_error")
+                    return None
+    """})
+    assert analyze(pkg) == []
+
+
+def test_sync_pass_out_of_scope_modules_ignored(tmp_path):
+    # only the sync-plane modules are in scope — the router's penalty
+    # reports have their own (gossip-delivery) accounting story
+    pkg, _ = make_pkg(tmp_path, {"network/router.py": """
+        class Router:
+            def on_bad_block(self, peer):
+                self.peers.report(peer, "mid")
+    """})
+    assert analyze(pkg) == []
+
+
+def test_sync_pass_suppression(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/sync.py": """
+        class SyncManager:
+            def download(self, peer):
+                self.peers.report(peer, "high")  # lhlint: allow(LH604)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_sync_pass_real_tree_zero_findings():
+    """The real sync plane carries NO unaccounted abandons/downscores
+    (fixed, not baselined): every penalty and every attempt exit routes
+    through the _account*/_downscore funnels."""
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    assert [f for f in findings if f.rule == "LH604"] == []
+
+
+def test_exceptions_pass_network_scope(tmp_path):
+    # PR 10 extended LH902 to the network plane: an unaccounted broad
+    # swallow in network/ is a finding now
+    pkg, _ = make_pkg(tmp_path, {"network/gossip.py": """
+        def deliver(handler, msg):
+            try:
+                handler(msg)
+            except Exception:
+                return None
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH902"]
+
+
+def test_exceptions_pass_real_network_tree_clean():
+    """network/ carries no unaccounted swallows (fixed or justified
+    inline, not baselined)."""
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    assert [f for f in findings
+            if f.rule in ("LH901", "LH902")
+            and f.file.startswith("lighthouse_tpu/network/")] == []
+
+
 # -- baseline machinery -------------------------------------------------------
 
 

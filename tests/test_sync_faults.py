@@ -1,0 +1,460 @@
+"""Byzantine sync-plane fault matrix (PR 10 tentpole).
+
+Every ops/faults.PeerFaultPlan mode against the three sync surfaces —
+range sync, parent lookups, backfill — plus the regression pins the
+tentpole exists for: a withholding peer can no longer advance the range
+cursor past real history, backfill rotates peers instead of raising,
+and a restart resumes from the freezer cursor.  All zero-XLA fast:
+fake-crypto harness, no signature verification, tiny deadlines.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.network import NetworkFabric, NetworkService, PeerManager
+from lighthouse_tpu.network.backfill import BackfillSync
+from lighthouse_tpu.ops import faults
+from lighthouse_tpu.state_transition import state_transition
+from lighthouse_tpu.testing import Harness
+
+RANGE = "beacon_blocks_by_range"
+ROOT = "beacon_blocks_by_root"
+
+
+@pytest.fixture(autouse=True)
+def _fault_env(monkeypatch):
+    """Tight deadlines/backoff so stall faults resolve in milliseconds,
+    and a clean fault switchboard around every test."""
+    monkeypatch.setenv("LHTPU_RPC_DEADLINE_S", "0.3")
+    monkeypatch.setenv("LHTPU_RPC_FAILS", "3")
+    monkeypatch.setenv("LHTPU_RPC_BACKOFF_S", "0.05")
+    monkeypatch.setenv("LHTPU_RPC_BACKOFF_MAX_S", "0.2")
+    monkeypatch.setenv("LHTPU_SYNC_STALL_S", "10")
+    faults.clear_peer_plans()
+    yield
+    faults.clear_peer_plans()
+
+
+def _metric_sum(name: str, **labels) -> float:
+    fam = REGISTRY.metrics.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    want = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    for key, child in fam._children.items():
+        if all(kv in key for kv in want):
+            total += child.value
+    if not labels:
+        total += fam.value
+    return total
+
+
+class _Net:
+    """Honest chain + replicas (Byzantine peers serve REAL data that the
+    fault plan corrupts at the rpc seam) + one fresh syncing node."""
+
+    def __init__(self, n_blocks: int = 8, replicas: tuple = ()):
+        self.h = Harness(n_validators=32, fork="altair", real_crypto=False)
+        self.fabric = NetworkFabric()
+        genesis = self.h.state.copy()
+        self.honest_chain = BeaconChain(
+            self.h.spec, genesis.copy(), verify_signatures=False)
+        self.honest = NetworkService(self.honest_chain, self.fabric, "honest")
+        # replicas share the honest chain object: same data, own peer id
+        self.replica = {
+            pid: NetworkService(self.honest_chain, self.fabric, pid)
+            for pid in replicas}
+        self.fresh_chain = BeaconChain(
+            self.h.spec, genesis.copy(), verify_signatures=False)
+        self.fresh = NetworkService(self.fresh_chain, self.fabric, "fresh")
+        self.blocks = []
+        for i in range(n_blocks):
+            # attestations give the honest branch fork-choice weight, so
+            # a zero-weight fork served by a wrong-chain peer can never
+            # win a tie-break against it
+            atts = [self.h.attest()] if i > 0 else []
+            signed = self.h.produce_block(attestations=atts)
+            state_transition(self.h.state, self.h.spec, signed,
+                             self.h._verify_strategy())
+            self.honest_chain.slot_clock.set_slot(int(signed.message.slot))
+            self.honest_chain.process_block(signed)
+            self.blocks.append(signed)
+        self.fresh_chain.slot_clock.set_slot(n_blocks)
+
+    def connect_fresh(self, *peer_ids: str):
+        for pid in peer_ids:
+            svc = self.honest if pid == "honest" else self.replica[pid]
+            self.fresh.connect(svc)
+
+    def sync_until_converged(self, rounds: int = 4) -> int:
+        total = 0
+        for _ in range(rounds):
+            total += self.fresh.sync.sync()
+            if self.fresh_chain.head_root == self.honest_chain.head_root:
+                break
+        return total
+
+
+# -- range sync × every fault mode -------------------------------------------
+
+
+class TestRangeFaultMatrix:
+    @pytest.mark.parametrize(
+        "mode", ["stall", "empty", "truncate", "malformed", "flap"])
+    def test_converges_past_faulty_peer(self, mode):
+        net = _Net(n_blocks=8, replicas=("evil",))
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode=mode, peers={"evil"}, protocols={RANGE}, stall_s=0.8)])
+        # evil first: the batch rotation hits it before the honest peer
+        net.connect_fresh("evil", "honest")
+        net.sync_until_converged()
+        assert net.fresh_chain.head_root == net.honest_chain.head_root
+        assert faults.peer_fires_by_mode().get(mode, 0) >= 1, \
+            "the armed fault never fired"
+        assert net.fresh.peer_manager.score("evil") < 0, \
+            "faulty peer was not downscored"
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+    def test_equivocating_status_abandoned_and_accounted(self):
+        # the equivocator advertises a lifted bogus head; the chain to it
+        # can never materialize and must be abandoned with every empty
+        # window downscored — not chased forever
+        net = _Net(n_blocks=8, replicas=("evil",))
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="equivocate", peers={"evil"}, protocols={"status"})])
+        net.connect_fresh("evil")
+        before_abandoned = _metric_sum("sync_chains_total",
+                                       outcome="abandoned")
+        net.fresh.sync.sync()
+        # the range data itself was honest, so the real history imported
+        assert net.fresh_chain.head_root == net.honest_chain.head_root
+        assert faults.peer_fires_by_mode().get("equivocate", 0) >= 1
+        assert _metric_sum("sync_chains_total",
+                           outcome="abandoned") > before_abandoned
+        assert net.fresh.peer_manager.score("evil") < 0
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+    def test_wrong_chain_redirect_detected(self):
+        # "janus" advertises the honest head but serves a consistent
+        # NON-CANONICAL branch (redirected to a forked node).  Batch
+        # validation passes block-by-block — only the end state convicts
+        # it: the advertised head never materializes, the chain attempt
+        # is abandoned, janus is downscored, and the retry re-pools onto
+        # the honest peer.
+        net = _Net(n_blocks=8, replicas=("janus",))
+        # fork from genesis: same validators, different block pattern
+        fh = Harness(n_validators=32, fork="altair", real_crypto=False)
+        fork_chain = BeaconChain(fh.spec, fh.state.copy(),
+                                 verify_signatures=False)
+        for slot in (2, 4, 6):
+            signed = fh.produce_block(slot=slot)
+            state_transition(fh.state, fh.spec, signed,
+                             fh._verify_strategy())
+            fork_chain.slot_clock.set_slot(slot)
+            fork_chain.process_block(signed)
+        NetworkService(fork_chain, net.fabric, "fork")
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="wrong_chain", peers={"janus"}, protocols={RANGE},
+            alt_peer="fork")])
+        net.connect_fresh("janus", "honest")
+        net.sync_until_converged()
+        assert net.fresh_chain.head_root == net.honest_chain.head_root
+        assert faults.peer_fires_by_mode().get("wrong_chain", 0) >= 1
+        assert net.fresh.peer_manager.score("janus") < 0
+        assert _metric_sum("sync_downscores_total",
+                           reason="wrong_chain") >= 1
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+
+# -- the tentpole regression pins ---------------------------------------------
+
+
+class TestWithholdingRegression:
+    def test_lying_empty_window_recovered_and_blamed(self):
+        """The PR 10 hole: an empty BlocksByRange response used to
+        advance the cursor past real history unchallenged.  Now the
+        window is provisional — when the next batch fails to link, the
+        window is re-requested from another peer, the real blocks are
+        imported, and the withholder is downscored."""
+        net = _Net(n_blocks=40, replicas=("evil",))
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="empty", peers={"evil"}, protocols={RANGE},
+            ordinals={0})])   # withhold exactly the first window
+        net.connect_fresh("evil", "honest")
+        net.sync_until_converged()
+        assert net.fresh_chain.head_root == net.honest_chain.head_root
+        assert _metric_sum("sync_downscores_total",
+                           reason="withheld_window") >= 1, \
+            "the withholding peer was never blamed"
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+    def test_withholding_only_pool_cannot_fake_completion(self):
+        """With ONLY a withholding peer, sync must not report a clean
+        chain: nothing imports, the chain is abandoned (accounted), and
+        the peer is downscored — the cursor never silently walks past
+        withheld history."""
+        net = _Net(n_blocks=8, replicas=("evil",))
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="empty", peers={"evil"}, protocols={RANGE})])
+        before_abandoned = _metric_sum("sync_chains_total",
+                                       outcome="abandoned")
+        net.connect_fresh("evil")
+        imported = net.fresh.sync.sync()
+        assert imported == 0
+        assert int(net.fresh_chain.head_state.slot) == 0
+        assert _metric_sum("sync_chains_total",
+                           outcome="abandoned") > before_abandoned, \
+            "an all-withheld chain was not accounted as abandoned"
+        assert net.fresh.peer_manager.score("evil") < 0
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+    def test_overserving_peer_rejected(self):
+        """A peer serving more chunks than requested fails the attempt
+        before a single decode."""
+        net = _Net(n_blocks=4)
+        from lighthouse_tpu.network.rpc import P_BLOCKS_BY_RANGE
+
+        raw = net.blocks[0].serialize()
+
+        def overserver(src, data):
+            return [raw] * 64     # way past any requested count
+
+        net.honest.router.rpc.register(P_BLOCKS_BY_RANGE, overserver)
+        net.connect_fresh("honest")
+        assert net.fresh.sync.sync() == 0
+        assert _metric_sum("sync_downscores_total", reason="overserve") >= 1
+        assert net.fresh.sync.books_balanced(), net.fresh.sync.books
+
+
+# -- lookup sync × fault modes ------------------------------------------------
+
+
+class TestLookupFaultMatrix:
+    def _orphan_setup(self):
+        net = _Net(n_blocks=4)
+        net.connect_fresh("honest")
+        # gossip only the TIP: the fresh node must chase 3 ancestors
+        tip = net.blocks[-1]
+        return net, tip
+
+    @pytest.mark.parametrize("mode", ["stall", "malformed", "flap"])
+    def test_chase_fails_closed_then_recovers(self, mode):
+        net, tip = self._orphan_setup()
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode=mode, peers={"honest"}, protocols={ROOT}, stall_s=0.8)])
+        assert net.fresh.sync.lookup_unknown_parent("honest", tip) == 0
+        assert faults.peer_fires_by_mode().get(mode, 0) >= 1
+        assert net.fresh.peer_manager.score("honest") < 1.0
+        # fault cleared: the same chase now succeeds end-to-end
+        faults.clear_peer_plans()
+        faults.install_peer_plans(())
+        got = net.fresh.sync.lookup_unknown_parent("honest", tip)
+        assert got >= 3
+        assert net.fresh_chain.head_root == tip.message.hash_tree_root()
+
+    @pytest.mark.parametrize("mode", ["empty", "truncate"])
+    def test_withheld_root_cached_as_dead_end(self, mode):
+        # an empty/truncated BlocksByRoot answer is a dead end: cached,
+        # not retried forever (the reference's failed-chase cache)
+        net, tip = self._orphan_setup()
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode=mode, peers={"honest"}, protocols={ROOT})])
+        before = _metric_sum("sync_lookups_total", outcome="dead_end")
+        assert net.fresh.sync.lookup_unknown_parent("honest", tip) == 0
+        assert _metric_sum("sync_lookups_total", outcome="dead_end") > before
+        parent = bytes(tip.message.parent_root)
+        assert parent in net.fresh.sync._failed_lookups
+
+
+# -- backfill × fault modes + rotation + resume -------------------------------
+
+
+def _anchored(net: _Net, anchor_idx: int):
+    """A chain checkpoint-anchored at net.blocks[anchor_idx] (state
+    captured by replaying the honest blocks onto a fresh copy)."""
+    # rebuild the anchor state by replaying the honest blocks onto a
+    # fresh genesis (same interop validators => identical anchor state)
+    replay = Harness(n_validators=32, fork="altair", real_crypto=False)
+    for signed in net.blocks[: anchor_idx + 1]:
+        state_transition(replay.state, replay.spec, signed,
+                         replay._verify_strategy())
+    anchored = BeaconChain(replay.spec, replay.state.copy(),
+                           verify_signatures=False)
+    anchor_block = net.blocks[anchor_idx]
+    anchored.store.put_block(anchored.genesis_block_root, anchor_block)
+    assert anchored.genesis_block_root == \
+        anchor_block.message.hash_tree_root()
+    return anchored
+
+
+class TestBackfillFaults:
+    def _bf(self, net, anchored, pool):
+        ep = net.fabric.rpc.join("backfiller")
+        return BackfillSync(anchored, ep, PeerManager(),
+                            terminal_root=net.honest_chain
+                            .genesis_block_root), ep
+
+    @pytest.mark.parametrize(
+        "mode", ["stall", "empty", "truncate", "malformed", "flap"])
+    def test_rotates_past_faulty_peer(self, mode, monkeypatch):
+        monkeypatch.setenv("LHTPU_SYNC_BATCH_SIZE", "8")
+        net = _Net(n_blocks=12, replicas=("evil",))
+        anchored = _anchored(net, 11)
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode=mode, peers={"evil"}, protocols={RANGE}, stall_s=0.8)])
+        bf, _ = self._bf(net, anchored, ["evil", "honest"])
+        total = bf.run(["evil", "honest"])
+        assert bf.is_complete, f"backfill did not complete past {mode}"
+        assert total >= 11
+        assert faults.peer_fires_by_mode().get(mode, 0) >= 1
+        assert bf.books_balanced(), bf.books
+        # every pre-anchor canonical block is addressable
+        for slot in range(1, 12):
+            root = net.honest_chain.block_root_at_slot(slot)
+            if root is None:
+                continue
+            assert anchored.store.get_block(root) is not None
+            assert anchored.store.cold_block_root_at_slot(slot) == root
+
+    def test_wrong_chain_breaks_hash_chain_and_rotates(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_SYNC_BATCH_SIZE", "8")
+        net = _Net(n_blocks=12, replicas=("janus",))
+        fh = Harness(n_validators=32, fork="altair", real_crypto=False)
+        fork_chain = BeaconChain(fh.spec, fh.state.copy(),
+                                 verify_signatures=False)
+        for slot in (2, 4, 6, 8, 10):
+            signed = fh.produce_block(slot=slot)
+            state_transition(fh.state, fh.spec, signed,
+                             fh._verify_strategy())
+            fork_chain.slot_clock.set_slot(slot)
+            fork_chain.process_block(signed)
+        NetworkService(fork_chain, net.fabric, "fork")
+        anchored = _anchored(net, 11)
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="wrong_chain", peers={"janus"}, protocols={RANGE},
+            alt_peer="fork")])
+        bf, _ = self._bf(net, anchored, ["janus", "honest"])
+        assert bf.run(["janus", "honest"]) >= 11
+        assert bf.is_complete
+        assert _metric_sum("backfill_downscores_total",
+                           reason="broken_hash_chain") >= 1
+        assert bf.books_balanced(), bf.books
+
+    def test_run_abandons_with_accounting_when_pool_is_hostile(
+            self, monkeypatch):
+        monkeypatch.setenv("LHTPU_SYNC_BATCH_SIZE", "8")
+        monkeypatch.setenv("LHTPU_SYNC_BACKFILL_ATTEMPTS", "2")
+        net = _Net(n_blocks=12, replicas=("evil",))
+        anchored = _anchored(net, 11)
+        faults.install_peer_plans([faults.PeerFaultPlan(
+            mode="empty", peers={"evil"}, protocols={RANGE})])
+        bf, _ = self._bf(net, anchored, ["evil"])
+        before = _metric_sum("backfill_runs_total", outcome="abandoned")
+        total = bf.run(["evil"])   # no honest peer: must abandon cleanly
+        assert total == 0
+        assert not bf.is_complete
+        assert _metric_sum("backfill_runs_total",
+                           outcome="abandoned") > before
+        assert bf.books_balanced(), bf.books
+
+    def test_resume_from_freezer_cursor(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_SYNC_BATCH_SIZE", "4")
+        net = _Net(n_blocks=12)
+        anchored = _anchored(net, 11)
+        ep = net.fabric.rpc.join("backfiller")
+        bf1 = BackfillSync(anchored, ep, PeerManager(),
+                           terminal_root=net.honest_chain.genesis_block_root)
+        anchor_slot = bf1.expected_slot
+        bf1.run("honest", max_batches=1)
+        assert not bf1.is_complete
+        assert bf1.expected_slot < anchor_slot
+        # a RESTARTED backfill resumes below the persisted prefix
+        # instead of refilling from the anchor (the PR 10 fix)
+        bf2 = BackfillSync(anchored, ep, PeerManager(),
+                           terminal_root=net.honest_chain.genesis_block_root)
+        assert bf2.expected_slot == bf1.expected_slot, \
+            "restart refilled from the anchor instead of resuming"
+        assert bf2.expected_root == bf1.expected_root
+        bf2.run("honest")
+        assert bf2.is_complete
+        for slot in range(1, 12):
+            root = net.honest_chain.block_root_at_slot(slot)
+            if root is None:
+                continue
+            assert anchored.store.cold_block_root_at_slot(slot) == root
+
+
+# -- env arming ----------------------------------------------------------------
+
+
+class TestEnvArming:
+    def test_peerfault_env_knobs_build_a_plan(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_PEERFAULT_MODE", "empty")
+        monkeypatch.setenv("LHTPU_PEERFAULT_PEERS", "evil,worse")
+        monkeypatch.setenv("LHTPU_PEERFAULT_PROTOCOLS", RANGE)
+        monkeypatch.setenv("LHTPU_PEERFAULT_ORDINALS", "0,2")
+        faults.clear_peer_plans()        # force the lazy env re-read
+        plans = faults.active_peer_plans()
+        assert len(plans) == 1
+        plan = plans[0]
+        assert plan.mode == "empty"
+        assert plan.peers == frozenset({"evil", "worse"})
+        assert plan.protocols == frozenset({RANGE})
+        assert plan.ordinals == frozenset({0, 2})
+
+    def test_malformed_env_mode_disables_injection(self, monkeypatch):
+        # a typo'd chaos knob must not become a permanent fault generator
+        monkeypatch.setenv("LHTPU_PEERFAULT_MODE", "bogus")
+        faults.clear_peer_plans()
+        assert faults.active_peer_plans() == ()
+
+
+# -- rpc discipline ------------------------------------------------------------
+
+
+class TestRpcDiscipline:
+    def test_quarantine_ladder_fail_fast_and_recovery(self):
+        from lighthouse_tpu.network.rpc import (
+            PeerQuarantined,
+            RequestDiscipline,
+            RpcError,
+        )
+
+        t = [0.0]
+        d = RequestDiscipline(clock=lambda: t[0])
+        quarantined = []
+        d.on_quarantine = lambda peer, rung: quarantined.append(
+            (peer, rung))
+
+        def failing(target):
+            raise RpcError("boom")
+
+        for _ in range(3):     # LHTPU_RPC_FAILS=3 trips the window
+            with pytest.raises(RpcError):
+                d.execute("p1", "/x/proto/1", b"", failing)
+        assert quarantined == [("p1", 1)]
+        with pytest.raises(PeerQuarantined):
+            d.execute("p1", "/x/proto/1", b"", failing)
+        t[0] += 10.0           # window lapses; a success resets the rung
+        assert d.execute("p1", "/x/proto/1", b"",
+                         lambda target: [b"ok"]) == [b"ok"]
+        assert d.quarantined_until("p1") == 0.0
+
+    def test_deadline_cuts_stalled_request(self):
+        import time as _time
+
+        from lighthouse_tpu.network.rpc import (
+            RequestDiscipline,
+            RpcDeadline,
+        )
+
+        d = RequestDiscipline()
+        t0 = _time.monotonic()
+        with pytest.raises(RpcDeadline):
+            d.execute("p1", "/x/proto/1", b"",
+                      lambda target: _time.sleep(5.0))
+        assert _time.monotonic() - t0 < 2.0, \
+            "deadline did not cut the stall off"

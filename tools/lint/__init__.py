@@ -61,6 +61,13 @@ LH603       unaccounted-shed       a code path in processor/ or pool/
                                    subscript) without incrementing a
                                    *_shed_total/*_dropped_total metric
                                    (zero-unaccounted-drops discipline)
+LH604       unaccounted-sync-      abandoning a batch/chain/lookup (an
+            abandon                attempt exit inside an except handler)
+                                   or issuing a peer penalty in
+                                   network/sync.py / network/backfill.py
+                                   without incrementing a sync_*_total/
+                                   backfill_*_total metric
+                                   (zero-unaccounted-abandons discipline)
 LH801       int64-outside-x64      int64 jnp lane created / int64-lane
                                    program dispatched outside a scoped
                                    ``with enable_x64():`` (silent int32
@@ -79,9 +86,9 @@ LH811       blocking-fetch-        lattice-confirmed device->host
 LH901       swallowed-exception    broad ``except: pass`` — the error
                                    vanishes unrouted; funnel through
                                    ``record_swallowed`` or waive
-LH902       unaccounted-swallow    broad handler in the offload modules
-                                   that handles a fault but never
-                                   records/raises/logs it
+LH902       unaccounted-swallow    broad handler in the offload or
+                                   network modules that handles a fault
+                                   but never records/raises/logs it
 ==========  =====================  =========================================
 
 The v2 passes (LH602, LH80x, LH81x, LH90x) share the interprocedural
@@ -221,15 +228,16 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     from tools.lint import (blocking_pass, envpass, exceptions_pass,
                             fetch, locks, metrics_pass, numeric_pass,
                             shapes, shed_pass, store_pass,
-                            supervisor_pass)
+                            supervisor_pass, sync_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
     ctx = Context(pathlib.Path(pkg_root).resolve(), modules, readme)
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
                      metrics_pass.run, supervisor_pass.run,
-                     store_pass.run, shed_pass.run, numeric_pass.run,
-                     blocking_pass.run, exceptions_pass.run):
+                     store_pass.run, shed_pass.run, sync_pass.run,
+                     numeric_pass.run, blocking_pass.run,
+                     exceptions_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

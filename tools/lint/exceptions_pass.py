@@ -18,7 +18,8 @@ import path it buries the first symptom of every corruption bug.
   deliberate (the terminal metrics sink is the canonical waiver).
 - **LH902 unaccounted-swallow**: in the offload/supervisor modules
   (``ops/``, ``crypto/``, ``parallel/``, ``processor/``,
-  ``state_transition/``), a broad handler that swallows with *some*
+  ``state_transition/``) and the network/peer plane (``network/``), a
+  broad handler that swallows with *some*
   body (a fallback assignment, a default return) but never re-raises,
   never records, and never logs.  Those modules sit on the recovery
   paths where the health ladder's verdicts depend on faults being
@@ -35,9 +36,10 @@ from __future__ import annotations
 
 from tools.lint import Context, Finding
 
-#: module prefixes where LH902 applies (the offload + recovery world)
+#: module prefixes where LH902 applies (the offload + recovery world,
+#: plus the network/peer plane since the PR 10 Byzantine-sync hardening)
 LH902_PREFIXES = ("ops/", "crypto/", "parallel/", "processor/",
-                  "state_transition/")
+                  "state_transition/", "network/")
 
 _LOG_TERMINALS = {"debug", "info", "warning", "warn", "error", "exception",
                   "critical", "log", "print"}

@@ -45,6 +45,15 @@ FAMILY_OWNERS = {
     # swallowed-error accounting funnels through the one helper
     "offload_swallowed_": "lighthouse_tpu/common/metrics.py",
     "offload_injected_": "lighthouse_tpu/ops/faults.py",
+    "peer_faults_injected_": "lighthouse_tpu/ops/faults.py",
+    # the sync-plane books (PR 10): each module owns its own families so
+    # the LH604 zero-unaccounted-abandons invariant has a single writer
+    "rpc_request": "lighthouse_tpu/network/rpc.py",
+    "sync_batch": "lighthouse_tpu/network/sync.py",
+    "sync_chains_": "lighthouse_tpu/network/sync.py",
+    "sync_lookups_": "lighthouse_tpu/network/sync.py",
+    "sync_downscores_": "lighthouse_tpu/network/sync.py",
+    "backfill_": "lighthouse_tpu/network/backfill.py",
     # device epoch pass: the backend seam owns the family; epoch_device /
     # phase0_epoch / shuffle record through its helpers
     "epoch_": "lighthouse_tpu/state_transition/epoch_processing.py",

@@ -1,0 +1,181 @@
+"""Pass 12 — accounted sync-abandon discipline (LH604).
+
+The syncstorm acceptance criterion mirrors the firehose's: *zero
+unaccounted abandons/downscores* on the network sync plane.  Every
+batch/chain/lookup the range-sync or backfill machines give up on — and
+every peer penalty they issue — must land in a ``sync_*_total`` /
+``backfill_*_total`` metric, or the books invariant
+(``requested == imported + retried + abandoned``) silently rots the
+next time someone adds an early-return to a retry loop.
+
+This pass scans the sync-plane modules (``network/sync.py`` and
+``network/backfill.py``) for *abandon sites*:
+
+- a peer penalty: a ``.report(peer, <level>)`` call whose level literal
+  is one of the penalty actions (``low``/``mid``/``high``/``fatal``) —
+  a downscore issued outside the reason-labeled funnel is an
+  unaccounted downscore, and
+- an attempt exit inside an exception handler: a ``return`` / ``break``
+  / ``continue`` / ``raise`` statement in an ``except`` body abandons
+  the in-flight attempt.
+
+The enclosing function must *account* the abandon: register a metric
+whose name matches ``sync_*_total``/``backfill_*_total`` (a string
+literal in the body), or call an accounting helper — a function whose
+name starts with ``_account``/``_downscore``/``_record``, or whose own
+body (collected package-wide across ``network/``) carries such a metric
+literal.  Deliberate unaccounted abandons carry
+``# lhlint: allow(LH604)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint import Context, Finding
+
+TARGET_MODULES = ("sync.py", "backfill.py")
+TARGET_PREFIX = "network/"
+
+PENALTY_LEVELS = {"low", "mid", "high", "fatal"}
+
+_METRIC_LIT = re.compile(r"^(sync|backfill)_[a-z0-9_]*_total$")
+_HELPER_NAME = re.compile(r"^(_account|_downscore|_record)")
+
+
+def _in_scope(pkg_rel: str) -> bool:
+    return (pkg_rel.startswith(TARGET_PREFIX)
+            and pkg_rel.rsplit("/", 1)[-1] in TARGET_MODULES)
+
+
+def _terminal_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _has_metric_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _METRIC_LIT.match(sub.value):
+            return True
+    return False
+
+
+def _accounting_helper_names(ctx: Context) -> set[str]:
+    """Bare names of functions (package-wide within network/) whose
+    body registers a sync/backfill metric — funneling through one
+    helper is enough."""
+    names: set[str] = set()
+    for module in ctx.modules:
+        if not module.pkg_rel.startswith(TARGET_PREFIX):
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _has_metric_literal(node):
+                names.add(node.name)
+    return names
+
+
+def _accounts(fn: ast.AST, helpers: set[str]) -> bool:
+    if _has_metric_literal(fn):
+        return True
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            name = _terminal_name(sub.func)
+            if name is not None and (name in helpers
+                                     or _HELPER_NAME.match(name)):
+                return True
+    return False
+
+
+def _is_penalty_report(call: ast.Call) -> bool:
+    if _terminal_name(call.func) != "report" or len(call.args) < 2:
+        return False
+    level = call.args[1]
+    return (isinstance(level, ast.Constant)
+            and isinstance(level.value, str)
+            and level.value in PENALTY_LEVELS)
+
+
+def _abandon_sites(fn: ast.AST) -> list[tuple[int, str, str]]:
+    """(line, description, symbol) per abandon site inside ``fn`` (not
+    descending into nested function definitions)."""
+    sites: list[tuple[int, str, str]] = []
+
+    def scan_handler_body(node):
+        """Attempt exits inside an except body (not nested functions)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.Return, ast.Break, ast.Continue,
+                                  ast.Raise)):
+                kind = type(child).__name__.lower()
+                sites.append((child.lineno,
+                              f"`{kind}` inside an except handler",
+                              f"handler_{kind}"))
+            scan_handler_body(child)
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call) and _is_penalty_report(child):
+                level = child.args[1].value
+                sites.append((child.lineno,
+                              f'peer penalty report(..., "{level}")',
+                              "penalty_report"))
+            if isinstance(child, ast.ExceptHandler):
+                scan_handler_body(child)
+                continue   # already scanned; don't double-visit
+            visit(child)
+
+    visit(fn)
+    return sites
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    helpers = _accounting_helper_names(ctx)
+    for module in ctx.modules:
+        if not _in_scope(module.pkg_rel):
+            continue
+        findings.extend(_scan_module(ctx, module, helpers))
+    return findings
+
+
+def _scan_module(ctx: Context, module, helpers: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                sites = _abandon_sites(child)
+                if sites and not _accounts(child, helpers):
+                    for line, what, symbol in sites:
+                        if ctx.suppressed(module, "LH604",
+                                          "unaccounted-sync-abandon", line):
+                            continue
+                        findings.append(Finding(
+                            "LH604", "unaccounted-sync-abandon",
+                            module.rel, line, f"{qual}:{symbol}",
+                            f"`{qual}` abandons sync work ({what}) "
+                            f"without incrementing a sync_*_total/"
+                            f"backfill_*_total metric — account the "
+                            f"abandon/downscore or waive with "
+                            f"`# lhlint: allow(LH604)`"))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(module.tree, [])
+    return findings
