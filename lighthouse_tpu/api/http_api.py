@@ -143,6 +143,9 @@ class BeaconApi:
         r("GET", r"/lighthouse/health", self.lighthouse_health)
         r("GET", r"/lighthouse/tracing", self.tracing_slots)
         r("GET", r"/lighthouse/tracing/(?P<slot>-?\d+)", self.tracing_slot)
+        r("GET", r"/lighthouse/observatory/flight", self.observatory_flight)
+        r("GET", r"/lighthouse/observatory/slo", self.observatory_slo)
+        r("GET", r"/lighthouse/observatory/jit", self.observatory_jit)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
         r("GET", r"/eth/v1/node/identity", self.node_identity)
         r("GET", r"/eth/v1/node/peers", self.node_peers)
@@ -1455,6 +1458,32 @@ class BeaconApi:
             raise ApiError(404, f"no timeline recorded for slot {slot}")
         return {"data": timeline}
 
+    def observatory_flight(self, body=None):
+        """The flight recorder's black box: the last trip dump (if a
+        trip condition has fired) plus the live event-ring tail."""
+        from lighthouse_tpu.common import flight_recorder
+
+        return {"data": flight_recorder.observatory_view()}
+
+    def observatory_slo(self, body=None):
+        """Per-slot SLO engine report: budgets, scored-slot counts,
+        violations by stage, and exact p50/p99/p999 per stage."""
+        from lighthouse_tpu.chain import slo
+
+        return {"data": slo.ENGINE.report()}
+
+    def observatory_jit(self, body=None):
+        """Manifest-keyed device-runtime telemetry: per-entry compile/
+        dispatch stats, manifest coverage, and the per-backend
+        time_to_first_verify cold-start headline."""
+        from lighthouse_tpu.common import device_telemetry as dtel
+
+        return {"data": {
+            "coverage": dtel.coverage(),
+            "entries": dtel.snapshot(),
+            "time_to_first_verify_s": dtel.first_verify_times(),
+        }}
+
 
 class _Handler(BaseHTTPRequestHandler):
     api: BeaconApi = None
@@ -1527,7 +1556,8 @@ class _Handler(BaseHTTPRequestHandler):
             status = 500
         if isinstance(result, str):  # /metrics text exposition
             payload = result.encode()
-            ctype = "text/plain; version=0.0.4"
+            # the Prometheus text-format content type, charset included
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
         else:
             payload = json.dumps(result).encode()
             ctype = "application/json"

@@ -61,6 +61,11 @@ class BeaconChain:
         # reference's equivalent is the per-chain write lock
         # (beacon_chain.rs canonical_head write lock)
         self._import_lock = threading.RLock()
+        # per-slot SLO scoring rides the tracer's root-span sink; the
+        # process engine is shared, install is idempotent
+        from lighthouse_tpu.chain import slo as _slo
+
+        self.slo = _slo.install()
         self.store = store if store is not None else HotColdDB(spec)
         self.slot_clock = slot_clock or ManualSlotClock(
             int(genesis_state.genesis_time), spec.seconds_per_slot)

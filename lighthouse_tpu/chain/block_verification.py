@@ -124,14 +124,18 @@ def verify_block_signatures(chain, gossip_block: GossipVerifiedBlock) -> Signatu
     The batch rides the active BLS backend — this is the TPU offload seam.
     """
     if chain.verify_signatures:
+        from lighthouse_tpu.common import tracing
+
         try:
             # the proposal signature already passed at the gossip stage —
             # don't pay that pairing twice (reference:
-            # include_all_signatures_except_proposal)
-            sets = sigs.include_all_signatures(
-                gossip_block.parent_state, chain.spec,
-                gossip_block.signed_block, gossip_block.block_root,
-                include_proposal=False)
+            # include_all_signatures_except_proposal).  The extraction is
+            # the block path's pre-BLS stage in the slot SLO timeline.
+            with tracing.span("pre_bls"):
+                sets = sigs.include_all_signatures(
+                    gossip_block.parent_state, chain.spec,
+                    gossip_block.signed_block, gossip_block.block_root,
+                    include_proposal=False)
         except ValueError as e:
             raise BlockError(f"invalid_signature_structure: {e}")
         if sets and not bls.verify_signature_sets(sets):

@@ -1,0 +1,286 @@
+"""Per-slot SLO engine: score every slot's causal timeline.
+
+The tracing ring (PR 1) records WHAT happened inside a slot; this module
+decides whether it happened FAST ENOUGH.  It rides the tracer's
+root-span sink: finished ``block_import`` / ``import_block`` roots are
+stitched into a per-slot causal timeline
+
+    gossip arrival -> admission (gossip checks) -> pre_bls (signature-set
+    extraction/coalesce) -> verify (the BLS batch) -> import (state
+    transition + payload join + store) -> fork_choice -> head
+
+by mapping span names to protocol stages, and once the ``head`` stage
+lands the slot is SCORED against its deadline budget:
+
+- ``LHTPU_SLO_BUDGET_MS`` is the full gossip-to-head budget (default
+  4000 ms — a block must be in fork choice well before the 4 s
+  attestation deadline inside a 12 s slot);
+- each stage's budget is a fixed fraction of it (:data:`STAGE_FRACTIONS`,
+  summing > 1 deliberately: stages overlap and a single slow stage
+  inside an on-time slot is still worth flagging);
+- a stage over budget increments ``slo_violations_total{stage}`` and
+  files an ``slo_violation`` flight-recorder event; every scored slot
+  lands in ``slo_slots_total{outcome}``.
+
+Latency distributions are exposed two ways: labeled
+``slo_stage_seconds{stage}`` histograms (Prometheus surface) and exact
+p50/p99/p999 from bounded per-stage reservoirs (:func:`quantiles`, the
+``GET /lighthouse/observatory/slo`` payload — the chaos-soak liveness
+assertion reads p999 here).  Both structures are hard-bounded
+(``LHTPU_SLO_RING`` slots, ``LHTPU_SLO_RESERVOIR`` samples per stage,
+newest-wins; evictions counted in ``tracing_evicted_total``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import (
+    REGISTRY,
+    record_evicted,
+    record_swallowed,
+)
+from lighthouse_tpu.common.tracing import TRACER
+
+#: protocol stages in causal order (``total`` is the whole root span)
+STAGES = ("admission", "pre_bls", "verify", "import", "fork_choice",
+          "head", "total")
+
+#: per-stage budget as a fraction of LHTPU_SLO_BUDGET_MS.  Sums past
+#: 1.0 on purpose: the stage budgets flag a *locally* slow stage even
+#: when pipeline overlap keeps the slot total inside its deadline.
+STAGE_FRACTIONS = {
+    "admission": 0.10,
+    "pre_bls": 0.10,
+    "verify": 0.40,
+    "import": 0.35,
+    "fork_choice": 0.15,
+    "head": 0.15,
+    "total": 1.00,
+}
+
+#: span name -> stage (the stitch map; spans outside it are ignored)
+SPAN_STAGES = {
+    "gossip_verify": "admission",
+    "pre_bls": "pre_bls",
+    "signature_verify": "verify",
+    "state_transition": "import",
+    "payload_join": "import",
+    "store_import": "import",
+    "fork_choice": "fork_choice",
+    "head_update": "head",
+}
+
+#: roots the engine stitches (anything else in the ring is not part of
+#: the block pipeline)
+_ROOT_NAMES = ("block_import", "import_block")
+
+_SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.0, 4.0, 8.0, 12.0)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class SloEngine:
+    """Stage accumulation + scoring; install on a tracer with
+    :func:`install` (idempotent)."""
+
+    def __init__(self, budget_ms: float | None = None,
+                 ring: int | None = None, reservoir: int | None = None):
+        self.budget_ms = (budget_ms if budget_ms is not None
+                          else envreg.get_float("LHTPU_SLO_BUDGET_MS",
+                                                4000.0) or 4000.0)
+        self.ring = max(8, ring if ring is not None
+                        else envreg.get_int("LHTPU_SLO_RING", 128) or 128)
+        self.reservoir = max(
+            32, reservoir if reservoir is not None
+            else envreg.get_int("LHTPU_SLO_RESERVOIR", 1024) or 1024)
+        self._lock = threading.Lock()
+        # slot -> {"stages": {stage: seconds}, "scored": bool}
+        self._slots: OrderedDict[int, dict] = OrderedDict()
+        # stage -> bounded sample deque (seconds, newest-wins)
+        self._samples: dict[str, deque] = {
+            s: deque(maxlen=self.reservoir) for s in STAGES}
+        self.scored = 0
+        self.violations: dict[str, int] = {}
+        self._hist_memo: dict = {}
+        self._viol_memo: dict = {}
+
+    # -- feeding -------------------------------------------------------------
+
+    def sink(self, root, slot) -> None:
+        """Tracer root-span sink: stitch a finished pipeline root into
+        its slot's timeline; score once the head stage lands."""
+        if not flight.RECORDER.enabled:
+            return
+        if root.name not in _ROOT_NAMES or slot is None or slot < 0:
+            return
+        stages: dict[str, float] = {}
+        saw_head = False
+
+        def walk(sp):
+            nonlocal saw_head
+            stage = SPAN_STAGES.get(sp.name)
+            if stage is not None:
+                stages[stage] = (stages.get(stage, 0.0)
+                                 + sp.duration_ms() / 1000.0)
+                if stage == "head":
+                    saw_head = True
+            for c in sp.children:
+                walk(c)
+
+        for c in root.children:
+            walk(c)
+        if root.name == "block_import":
+            stages["total"] = (stages.get("total", 0.0)
+                               + root.duration_ms() / 1000.0)
+        self._merge(int(slot), stages, saw_head)
+
+    def observe_stage(self, slot: int, stage: str, seconds: float,
+                      final: bool = False) -> None:
+        """Manual stage feed for work that reports outside the span
+        tree; ``final=True`` scores the slot immediately."""
+        self._merge(int(slot), {stage: seconds}, final)
+
+    def _merge(self, slot: int, stages: dict, score_now: bool) -> None:
+        to_score = None
+        with self._lock:
+            row = self._slots.get(slot)
+            if row is None:
+                row = self._slots[slot] = {"stages": {}, "scored": False}
+                while len(self._slots) > self.ring:
+                    self._slots.popitem(last=False)
+                    record_evicted("slo_slot")
+            else:
+                self._slots.move_to_end(slot)
+            for stage, secs in stages.items():
+                row["stages"][stage] = row["stages"].get(stage, 0.0) + secs
+            if score_now and not row["scored"]:
+                row["scored"] = True
+                to_score = dict(row["stages"])
+        if to_score is not None:
+            self._score(slot, to_score)
+
+    # -- scoring -------------------------------------------------------------
+
+    def stage_budget_s(self, stage: str) -> float:
+        return self.budget_ms / 1000.0 * STAGE_FRACTIONS.get(stage, 1.0)
+
+    def _score(self, slot: int, stages: dict) -> None:
+        over: dict[str, float] = {}
+        for stage, secs in stages.items():
+            # reservoir mutation under the lock: quantiles() iterates
+            # these deques under the same lock, and an unlocked append
+            # would fault a concurrent scrape mid-sort
+            with self._lock:
+                if len(self._samples[stage]) == \
+                        self._samples[stage].maxlen:
+                    record_evicted("slo_sample")
+                self._samples[stage].append(secs)
+            hist = self._hist_memo.get(stage)
+            if hist is None:
+                hist = self._hist_memo[stage] = REGISTRY.histogram(
+                    "slo_stage_seconds",
+                    "scored per-slot protocol-stage wall time",
+                    buckets=_SECONDS_BUCKETS).labels(stage=stage)
+            hist.observe(secs)
+            if secs > self.stage_budget_s(stage):
+                over[stage] = secs
+                child = self._viol_memo.get(stage)
+                if child is None:
+                    child = self._viol_memo[stage] = REGISTRY.counter(
+                        "slo_violations_total",
+                        "scored slots whose stage exceeded its deadline "
+                        "budget, by stage").labels(stage=stage)
+                child.inc()
+        with self._lock:
+            self.scored += 1
+            for stage in over:
+                self.violations[stage] = self.violations.get(stage, 0) + 1
+        try:
+            REGISTRY.counter(
+                "slo_slots_total",
+                "slots scored by the SLO engine, by outcome",
+            ).labels(outcome="violated" if over else "ok").inc()
+        except Exception as e:
+            record_swallowed("slo.slot_counter", e)
+        if over:
+            flight.emit(
+                "slo_violation", slot=slot,
+                stages={s: round(v * 1000.0, 1) for s, v in over.items()},
+                budget_ms=self.budget_ms)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def quantiles(self) -> dict[str, dict]:
+        """Exact p50/p99/p999 over each stage's bounded reservoir."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            sampled = {s: sorted(d) for s, d in self._samples.items() if d}
+        for stage, vals in sampled.items():
+            out[stage] = {
+                "n": len(vals),
+                "p50_ms": round(_percentile(vals, 0.50) * 1000.0, 3),
+                "p99_ms": round(_percentile(vals, 0.99) * 1000.0, 3),
+                "p999_ms": round(_percentile(vals, 0.999) * 1000.0, 3),
+                "budget_ms": round(self.stage_budget_s(stage) * 1000.0, 1),
+            }
+        return out
+
+    def report(self) -> dict:
+        """The GET /lighthouse/observatory/slo payload."""
+        with self._lock:
+            violations = dict(self.violations)
+            scored = self.scored
+            tracked = len(self._slots)
+        return {
+            "budget_ms": self.budget_ms,
+            "stage_fractions": dict(STAGE_FRACTIONS),
+            "slots_scored": scored,
+            "slots_tracked": tracked,
+            "violations": violations,
+            "stages": self.quantiles(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots.clear()
+            for d in self._samples.values():
+                d.clear()
+            self.scored = 0
+            self.violations.clear()
+
+
+ENGINE = SloEngine()
+_INSTALLED = False
+
+
+def install(tracer=None) -> SloEngine:
+    """Hook the process engine onto the tracer (idempotent); the chain
+    constructor calls this so every node scores its slots."""
+    global _INSTALLED
+    t = tracer if tracer is not None else TRACER
+    t.add_sink(ENGINE.sink)
+    _INSTALLED = True
+    return ENGINE
+
+
+def reconfigure() -> SloEngine:
+    """Rebuild the process engine from the LHTPU_SLO_* knobs (tests);
+    keeps the tracer hook pointed at the fresh state."""
+    global ENGINE
+    old = ENGINE
+    TRACER.remove_sink(old.sink)
+    ENGINE = SloEngine()
+    if _INSTALLED:
+        TRACER.add_sink(ENGINE.sink)
+    return ENGINE

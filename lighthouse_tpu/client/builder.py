@@ -421,6 +421,16 @@ class ClientBuilder:
                           factor=ingest_plan.factor,
                           duration_s=ingest_plan.duration_s)
 
+        # the observatory plane: invariant monitors (processor/sync/
+        # backfill books register themselves at construction) get their
+        # background sweeper; LHTPU_OBS_SWEEP_S<=0 / LHTPU_OBS_ARMED=0
+        # leaves them sweep-on-demand only
+        from lighthouse_tpu.common import monitors as _monitors
+
+        if _monitors.MONITORS.start():
+            self.log.info("invariant watchdog sweeping",
+                          monitors=",".join(_monitors.MONITORS.names()))
+
         if self.config.listen_port is not None:
             self._wire_network(client)
 

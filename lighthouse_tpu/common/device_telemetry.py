@@ -1,0 +1,285 @@
+"""Manifest-keyed device-runtime telemetry for every jit entry point.
+
+ROADMAP item 1 (the persistent AOT program store) will be judged against
+cold-start numbers that today exist only as one opaque ``warm_s`` total.
+This module makes the device runtime measurable per program: every one
+of the ``tools/lint/shape_manifest.json`` entries (the PR 7 lint
+manifest that enumerates ALL ``jax.jit`` constructions in the package)
+is wrapped with :func:`instrument`, which records — keyed by the
+manifest entry id and the dispatched shape bucket —
+
+- ``jit_dispatch_total{entry,bucket}`` — dispatches per shape bucket;
+- ``jit_compiles_total{entry,bucket}`` — dispatches that grew the jit
+  compile cache (trace+lower+compile paid on that call);
+- ``jit_cache_requests_total{entry,outcome}`` — compile-cache hit/miss;
+- ``jit_dispatch_seconds{entry}`` — dispatch wall time (NOT synced:
+  device stages time dispatch unless the caller blocks, same contract
+  as bls_verify_stage_seconds);
+- ``jit_first_dispatch_timestamp_seconds{entry}`` — epoch time of the
+  entry's first dispatch (the cold-start fingerprint the AOT store must
+  erase);
+
+plus the backend-level cold-start headline the AOT store is judged
+against: ``time_to_first_verify_seconds{backend}`` — seconds from
+process start (first import of this module, which common/metrics pulls
+in early) to the first completed signature-set verification on each BLS
+backend (recorded by crypto/bls/api).
+
+Wrapper contract: :func:`instrument` is TRANSPARENT — ``__getattr__``
+forwards to the wrapped jitted callable (``.lower()``, ``.clear_cache``,
+``_cache_size`` all keep working) and the lint dataflow engine
+propagates jitted-ness through it, so the dispatch-discipline passes
+(LH601/LH811) and the shape manifest itself see the same tree.  Per-call
+cost is two ``perf_counter`` reads, one ``_cache_size`` probe and
+memoized counter increments — noise next to a host<->device crossing.
+
+This module never imports jax: it wraps callables handed to it.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+import time
+
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+#: process-start reference for time_to_first_verify_seconds (this module
+#: is imported by the BLS facade at import time, before any verify)
+PROCESS_T0 = time.monotonic()
+PROCESS_T0_WALL = time.time()
+
+# wall-time spread between a warm tiny dispatch (sub-ms) and a cold
+# device compile (minutes on CPU)
+_DISPATCH_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                     5.0, 10.0, 60.0, 300.0)
+
+_LOCK = threading.Lock()
+_ENTRIES: dict[str, dict] = {}
+_FIRST_VERIFY: dict[str, float] = {}
+
+
+def _manifest_path() -> pathlib.Path:
+    return (pathlib.Path(__file__).resolve().parents[2]
+            / "tools" / "lint" / "shape_manifest.json")
+
+
+_MANIFEST_IDS: list[str] | None = None
+
+
+def manifest_ids() -> list[str]:
+    """Entry ids from the checked-in shape manifest ([] when the file is
+    absent, e.g. an installed package without the lint tree)."""
+    global _MANIFEST_IDS
+    if _MANIFEST_IDS is None:
+        try:
+            data = json.loads(_manifest_path().read_text())
+            _MANIFEST_IDS = [e["id"] for e in data.get("entries", [])]
+        except (OSError, ValueError, KeyError) as e:
+            record_swallowed("device_telemetry.manifest", e)
+            _MANIFEST_IDS = []
+    return list(_MANIFEST_IDS)
+
+
+def _shape_label(args) -> str:
+    """Shape-bucket label for one dispatch: the leading dimension of the
+    first shaped argument (the lane count every bucketing policy in the
+    package pads), "scalar" when no argument carries a shape."""
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape:
+            return str(int(shape[0]))
+        if shape is not None:
+            return "0d"
+    return "scalar"
+
+
+class _Instrumented:
+    """Transparent telemetry wrapper around one jitted callable."""
+
+    __slots__ = ("_fn", "_entry", "_static_bucket", "_stats",
+                 "_dispatch_hist", "_first_gauge", "_memo")
+
+    def __init__(self, entry: str, fn, bucket=None):
+        self._fn = fn
+        self._entry = entry
+        self._static_bucket = None if bucket is None else str(bucket)
+        self._stats = _entry_stats(entry)
+        self._dispatch_hist = None
+        self._first_gauge = None
+        self._memo = {}
+
+    def __call__(self, *args, **kwargs):
+        # a wrapped kernel called from INSIDE another jit's trace (e.g.
+        # hash_pairs_device inlined into the fold programs) is not a
+        # dispatch — tracer arguments mark it; record host calls only
+        for a in args:
+            if a.__class__.__name__.endswith("Tracer"):
+                return self._fn(*args, **kwargs)
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        try:
+            after = self._cache_size()
+            bucket = self._static_bucket or _shape_label(args)
+            compiled = (after > before if after is not None
+                        else bucket not in self._stats["buckets"])
+            _record_dispatch(self._entry, self._stats, bucket, wall,
+                             compiled, self._memo)
+        except Exception as e:
+            record_swallowed("device_telemetry.record", e)
+        return out
+
+    def _cache_size(self):
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:  # lhlint: allow(LH901)
+            return None  # telemetry probe only; the dispatch result is
+            # what matters and it already succeeded
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __repr__(self):
+        return f"instrumented({self._entry!r}, {self._fn!r})"
+
+
+def _entry_stats(entry: str) -> dict:
+    with _LOCK:
+        st = _ENTRIES.get(entry)
+        if st is None:
+            st = _ENTRIES[entry] = {
+                "buckets": {},          # bucket -> {dispatches, compiles}
+                "dispatches": 0,
+                "compiles": 0,
+                "first_dispatch_unix": None,
+                "first_dispatch_rel_s": None,
+                "dispatch_s_total": 0.0,
+            }
+        return st
+
+
+def _record_dispatch(entry: str, st: dict, bucket: str, wall: float,
+                     compiled: bool, memo: dict) -> None:
+    with _LOCK:
+        row = st["buckets"].setdefault(bucket,
+                                       {"dispatches": 0, "compiles": 0})
+        row["dispatches"] += 1
+        st["dispatches"] += 1
+        st["dispatch_s_total"] += wall
+        if compiled:
+            row["compiles"] += 1
+            st["compiles"] += 1
+        first = st["first_dispatch_unix"] is None
+        if first:
+            st["first_dispatch_unix"] = time.time()
+            st["first_dispatch_rel_s"] = time.monotonic() - PROCESS_T0
+    child = memo.get(("dispatch", bucket))
+    if child is None:
+        child = memo[("dispatch", bucket)] = REGISTRY.counter(
+            "jit_dispatch_total",
+            "jit entry-point dispatches by manifest entry and shape "
+            "bucket").labels(entry=entry, bucket=bucket)
+    child.inc()
+    outcome = "miss" if compiled else "hit"
+    child = memo.get(("cache", outcome))
+    if child is None:
+        child = memo[("cache", outcome)] = REGISTRY.counter(
+            "jit_cache_requests_total",
+            "jit compile-cache consultations by manifest entry and "
+            "outcome").labels(entry=entry, outcome=outcome)
+    child.inc()
+    if compiled:
+        child = memo.get(("compile", bucket))
+        if child is None:
+            child = memo[("compile", bucket)] = REGISTRY.counter(
+                "jit_compiles_total",
+                "jit compiles (trace+lower+compile paid on the "
+                "dispatching call) by manifest entry and shape bucket",
+            ).labels(entry=entry, bucket=bucket)
+        child.inc()
+    hist = memo.get("hist")
+    if hist is None:
+        hist = memo["hist"] = REGISTRY.histogram(
+            "jit_dispatch_seconds",
+            "jit entry-point dispatch wall time (device execution is "
+            "NOT synced unless the caller blocks)",
+            buckets=_DISPATCH_BUCKETS).labels(entry=entry)
+    hist.observe(wall)
+    if first:
+        REGISTRY.gauge(
+            "jit_first_dispatch_timestamp_seconds",
+            "epoch time of the entry's first dispatch (cold-start "
+            "fingerprint)").labels(entry=entry).set(st["first_dispatch_unix"])
+
+
+def instrument(entry: str, fn, bucket=None):
+    """Wrap a jitted callable with manifest-keyed dispatch telemetry.
+
+    ``entry`` is the shape-manifest id; ``bucket`` pins the shape-bucket
+    label for memoized constructions keyed by a host value (``rounds``,
+    lane count) — per-call shape derivation is used otherwise.  Wrapping
+    is idempotent-safe (re-wrapping the same entry shares its stats)."""
+    return _Instrumented(entry, fn, bucket=bucket)
+
+
+# -- backend cold-start headline ----------------------------------------------
+
+
+def record_first_verify(backend: str) -> None:
+    """Record the first completed signature-set verification on
+    ``backend`` (crypto/bls/api calls this per served batch; only the
+    first call per backend lands)."""
+    with _LOCK:
+        if backend in _FIRST_VERIFY:
+            return
+        t = time.monotonic() - PROCESS_T0
+        _FIRST_VERIFY[backend] = t
+    try:
+        REGISTRY.gauge(
+            "time_to_first_verify_seconds",
+            "seconds from process start to the first completed "
+            "signature-set verification, by serving backend",
+        ).labels(backend=backend).set(t)
+    except Exception as e:
+        record_swallowed("device_telemetry.first_verify", e)
+
+
+def first_verify_times() -> dict[str, float]:
+    with _LOCK:
+        return dict(_FIRST_VERIFY)
+
+
+# -- snapshots (bench / HTTP surface) -----------------------------------------
+
+
+def snapshot() -> dict[str, dict]:
+    """{entry id: stats} for every entry that has dispatched."""
+    with _LOCK:
+        return {e: {**st, "buckets": {b: dict(r) for b, r
+                                      in st["buckets"].items()}}
+                for e, st in _ENTRIES.items()}
+
+
+def coverage() -> dict:
+    """Manifest coverage: which entries have reported dispatch
+    telemetry (the --child-observatory acceptance surface)."""
+    ids = manifest_ids()
+    with _LOCK:
+        reported = sorted(e for e in _ENTRIES
+                          if _ENTRIES[e]["dispatches"] > 0)
+    missing = sorted(set(ids) - set(reported))
+    return {"manifest_entries": len(ids), "reported": reported,
+            "missing": missing}
+
+
+def reset() -> None:
+    """Drop all recorded telemetry (tests)."""
+    with _LOCK:
+        _ENTRIES.clear()
+        _FIRST_VERIFY.clear()

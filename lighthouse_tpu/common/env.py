@@ -276,6 +276,44 @@ _register("LHTPU_STORE_SWEEP", None,
           "1 forces the store integrity sweep on every open, 0 disables "
           "it; unset = sweep only after a dirty shutdown.")
 
+# -- the observatory plane: flight recorder, SLO engine, invariant
+#    watchdog (common/flight_recorder, chain/slo, common/monitors) ------------
+
+_register("LHTPU_OBS_ARMED", "1",
+          "0 disarms the observatory plane (flight recorder, slow-span "
+          "capture, SLO scoring, invariant monitor sweeps) for "
+          "overhead A/B runs.")
+_register("LHTPU_OBS_SWEEP_S", "1",
+          "Invariant-watchdog sweep cadence in seconds "
+          "(common/monitors); <=0 disables the background sweeper.")
+_register("LHTPU_OBS_LABEL_MAX", "1024",
+          "Hard bound on labeled children per metric family; a "
+          "label-cardinality storm evicts the oldest child "
+          "(tracing_evicted_total) instead of growing without bound.")
+_register("LHTPU_FLIGHT_CAPACITY", "512",
+          "Flight-recorder ring capacity in events (overflow rotates "
+          "the oldest event out, counted in flight_evicted_total).")
+_register("LHTPU_FLIGHT_DIR", None,
+          "Directory trip-triggered flight-recorder dumps are written "
+          "to; unset = <tmpdir>/lighthouse_flight.")
+_register("LHTPU_FLIGHT_DUMPS", "8",
+          "Newest trip dumps kept on disk; older dump files are "
+          "pruned.")
+_register("LHTPU_FLIGHT_SPAN_MS", "50",
+          "Latency floor in milliseconds above which a closing tracing "
+          "span is filed into the flight recorder as a slow_span "
+          "event.")
+_register("LHTPU_SLO_BUDGET_MS", "4000",
+          "Per-slot SLO budget in milliseconds for the full "
+          "gossip-to-head block pipeline; per-stage budgets are fixed "
+          "fractions of it (chain/slo.STAGE_FRACTIONS).")
+_register("LHTPU_SLO_RING", "128",
+          "Slots the SLO engine tracks concurrently (older unscored "
+          "slots are evicted, counted in tracing_evicted_total).")
+_register("LHTPU_SLO_RESERVOIR", "1024",
+          "Per-stage latency samples kept for the p50/p99/p999 "
+          "quantile surface (bounded reservoir, newest-wins).")
+
 
 # -- typed readers ------------------------------------------------------------
 

@@ -1,0 +1,318 @@
+"""Black-box flight recorder: the story BEHIND a counter increment.
+
+Ten PRs of hardening left every failure *counted* — breaker trips,
+ladder escalations, shed totals, quarantines, store repairs — but a
+counter is a verdict, not a story.  When a breaker opens in production
+the operator needs the ordered sequence of events that led up to it:
+which faults fired, which rungs escalated, what was shed, which peers
+were downscored.  This module is that black box: a bounded, lock-cheap
+ring of structured events that every plane (BLS supervisor, admission
+ladder, dispatch supervisor, epoch breaker, store repair, rpc
+quarantine, sync accounting, fault injection) emits into, and that
+auto-dumps to disk as JSON the moment a TRIP CONDITION fires:
+
+==================  ==========================================================
+trip reason         fired by
+==================  ==========================================================
+bls_breaker_open    a BLS device backend's circuit breaker opening
+                    (crypto/bls/api._note_transition)
+epoch_breaker_open  the shared epoch/shuffle breaker opening
+                    (state_transition/epoch_processing._breaker_fault)
+dispatch_wedge      the beacon-processor dispatch-thread supervisor
+                    replacing a wedged/dead dispatch thread
+store_corruption    the startup integrity sweep repairing/dropping a
+                    corrupt meta record (store/hot_cold)
+peer_quarantine     a peer crossing into its rpc quarantine window
+                    (network/rpc.RequestDiscipline)
+books_violation     a registered invariant monitor breaching
+                    (common/monitors)
+==================  ==========================================================
+
+The ring keeps the newest ``LHTPU_FLIGHT_CAPACITY`` events (overflow
+rotates the oldest out, counted in ``flight_evicted_total``); a trip
+snapshots the whole ring into ``last_dump``, writes it atomically to
+``LHTPU_FLIGHT_DIR`` (newest ``LHTPU_FLIGHT_DUMPS`` files kept), and the
+HTTP surface serves it at ``GET /lighthouse/observatory/flight``.
+
+Cost model: ``emit`` is one small dict + one lock-protected deque append
++ one memoized counter inc — cheap enough to ride the supervisor/ladder
+transition paths, which are themselves rare relative to the work they
+govern.  Hot per-message paths (gossip shed) emit AGGREGATED events per
+sweep, never per message.  ``LHTPU_OBS_ARMED=0`` disarms the whole
+observatory plane (recorder, slow-span capture, SLO scoring, monitor
+sweeps) for overhead A/B runs.
+
+Stdlib-only (no jax, no numpy): importable from ops/faults and the env
+registry layer without dragging in the device stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+#: documented trip reasons (``trip`` accepts any string so drills can
+#: add ad-hoc conditions)
+TRIP_REASONS = ("bls_breaker_open", "epoch_breaker_open", "dispatch_wedge",
+                "store_corruption", "peer_quarantine", "books_violation")
+
+
+def _jsonable(v):
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return "0x" + bytes(v).hex()
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+class FlightRecorder:
+    """Bounded event ring + trip-triggered JSON dumps.
+
+    Thread model: ``emit`` takes one short lock (seq + append); ``trip``
+    snapshots under the same lock and does its disk I/O outside it.
+    Counter children are memoized so steady-state emits cost one
+    ``inc()``.
+    """
+
+    def __init__(self, capacity: int | None = None,
+                 dump_dir: str | None = None,
+                 max_dumps: int | None = None):
+        cap = (capacity if capacity is not None
+               else envreg.get_int("LHTPU_FLIGHT_CAPACITY", 512) or 512)
+        self.capacity = max(16, int(cap))
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
+        self.dump_dir = (dump_dir if dump_dir is not None
+                         else envreg.get("LHTPU_FLIGHT_DIR"))
+        md = (max_dumps if max_dumps is not None
+              else envreg.get_int("LHTPU_FLIGHT_DUMPS", 8) or 8)
+        self.max_dumps = max(1, int(md))
+        self.span_floor_ms = max(0.0, envreg.get_float(
+            "LHTPU_FLIGHT_SPAN_MS", 50.0) or 0.0)
+        self.evicted = 0
+        self.trip_count = 0
+        self.last_dump: dict | None = None
+        self._dump_paths: deque[str] = deque()
+        self._counter_memo: dict = {}
+
+    # -- accounting helpers (memoized labeled children) ---------------------
+
+    def _count_event(self, kind: str) -> None:
+        child = self._counter_memo.get(("event", kind))
+        if child is None:
+            try:
+                child = REGISTRY.counter(
+                    "flight_events_total",
+                    "flight-recorder events by kind").labels(kind=kind)
+            except Exception as e:
+                record_swallowed("flight.counter", e)
+                return
+            self._counter_memo[("event", kind)] = child
+        child.inc()
+
+    def _count_evicted(self) -> None:
+        child = self._counter_memo.get("evicted")
+        if child is None:
+            try:
+                child = REGISTRY.counter(
+                    "flight_evicted_total",
+                    "flight-recorder events rotated out by the ring "
+                    "bound")
+            except Exception as e:
+                record_swallowed("flight.counter", e)
+                return
+            self._counter_memo["evicted"] = child
+        child.inc()
+
+    def _count_trip(self, reason: str) -> None:
+        child = self._counter_memo.get(("trip", reason))
+        if child is None:
+            try:
+                child = REGISTRY.counter(
+                    "flight_trips_total",
+                    "flight-recorder trip conditions fired, by reason",
+                ).labels(reason=reason)
+            except Exception as e:
+                record_swallowed("flight.counter", e)
+                return
+            self._counter_memo[("trip", reason)] = child
+        child.inc()
+
+    # -- the ring ------------------------------------------------------------
+
+    def emit(self, kind: str, **fields) -> None:
+        """File one structured event into the ring (no-op when
+        disarmed).  ``fields`` are coerced to JSON-able values at dump
+        time, not here — emit stays on the cheap path."""
+        if not self.enabled:
+            return
+        evt = {"kind": kind, "t": time.time()}
+        evt.update(fields)
+        with self._lock:
+            self._seq += 1
+            evt["seq"] = self._seq
+            if len(self._ring) == self.capacity:
+                self.evicted += 1
+                evicted = True
+            else:
+                evicted = False
+            self._ring.append(evt)
+        self._count_event(kind)
+        if evicted:
+            self._count_evicted()
+
+    def snapshot(self) -> list[dict]:
+        """Ordered copy of the current ring (oldest first)."""
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def tail(self, n: int) -> list[dict]:
+        """Copy of the newest ``n`` events (oldest first) — the scrape
+        surface; copies n events under the lock, not the whole ring."""
+        with self._lock:
+            take = min(n, len(self._ring))
+            it = reversed(self._ring)
+            out = [dict(next(it)) for _ in range(take)]
+        out.reverse()
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.evicted = 0
+
+    # -- trips ---------------------------------------------------------------
+
+    def trip(self, reason: str, **fields) -> dict | None:
+        """A trip condition fired: file the trip event, snapshot the
+        whole ring into ``last_dump``, and write the black box to disk
+        (atomic tmp+rename; newest ``max_dumps`` files kept).  Returns
+        the dump dict (None when disarmed)."""
+        if not self.enabled:
+            return None
+        self.emit("trip", reason=reason, **fields)
+        with self._lock:
+            self.trip_count += 1
+            ordinal = self.trip_count   # captured under the lock: two
+            #                             concurrent trips get distinct
+            #                             dump filenames
+            events = [dict(e) for e in self._ring]
+        dump = {
+            "reason": reason,
+            "tripped_at": time.time(),
+            "trip_fields": {k: _jsonable(v) for k, v in fields.items()},
+            "event_count": len(events),
+            "events": [{k: _jsonable(v) for k, v in e.items()}
+                       for e in events],
+        }
+        self.last_dump = dump
+        self._count_trip(reason)
+        self._write_dump(dump, ordinal)
+        return dump
+
+    def _resolve_dump_dir(self) -> str:
+        if self.dump_dir:
+            return self.dump_dir
+        return os.path.join(tempfile.gettempdir(), "lighthouse_flight")
+
+    def _write_dump(self, dump: dict, ordinal: int) -> None:
+        try:
+            d = self._resolve_dump_dir()
+            os.makedirs(d, exist_ok=True)
+            name = (f"flight-{os.getpid()}-{ordinal:06d}-"
+                    f"{dump['reason']}.json")
+            path = os.path.join(d, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(dump, fh, indent=1)
+            os.replace(tmp, path)
+            dump["path"] = path
+            self._dump_paths.append(path)
+            while len(self._dump_paths) > self.max_dumps:
+                old = self._dump_paths.popleft()
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass  # already gone: pruning is best-effort
+        except OSError as e:
+            # a full disk must not turn the black box into a crash: the
+            # in-memory last_dump (and the HTTP surface) still carry it
+            record_swallowed("flight.dump_write", e)
+
+    # -- slow-span capture (called by common/tracing on span close) ----------
+
+    def note_span(self, name: str, duration_ms: float,
+                  slot: int | None, attrs: dict | None = None) -> None:
+        """File a span closure above the latency floor
+        (``LHTPU_FLIGHT_SPAN_MS``); sub-floor closures cost one float
+        compare."""
+        if not self.enabled or duration_ms < self.span_floor_ms:
+            return
+        fields = {"name": name, "ms": round(duration_ms, 3)}
+        if slot is not None:
+            fields["slot"] = int(slot)
+        if attrs:
+            fields["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+        self.emit("slow_span", **fields)
+
+    def reconfigure(self) -> None:
+        """Re-read the LHTPU_FLIGHT_* / LHTPU_OBS_ARMED knobs (tests
+        mutate os.environ after import).  A changed capacity rebuilds
+        the ring in place, keeping the newest events."""
+        self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
+        self.dump_dir = envreg.get("LHTPU_FLIGHT_DIR")
+        self.span_floor_ms = max(0.0, envreg.get_float(
+            "LHTPU_FLIGHT_SPAN_MS", 50.0) or 0.0)
+        self.max_dumps = max(1, envreg.get_int("LHTPU_FLIGHT_DUMPS", 8) or 8)
+        cap = max(16, envreg.get_int("LHTPU_FLIGHT_CAPACITY", 512) or 512)
+        if cap != self.capacity:
+            with self._lock:
+                self.capacity = cap
+                self._ring = deque(self._ring, maxlen=cap)
+
+
+RECORDER = FlightRecorder()
+
+
+def emit(kind: str, **fields) -> None:
+    """Module-level convenience: file one event into the process
+    recorder (the emit funnel the LH605 lint pass recognizes)."""
+    RECORDER.emit(kind, **fields)
+
+
+def trip(reason: str, **fields) -> dict | None:
+    """Module-level convenience: fire one trip condition."""
+    return RECORDER.trip(reason, **fields)
+
+
+def observatory_view() -> dict:
+    """The GET /lighthouse/observatory/flight payload: the last trip's
+    black box (if any) plus the live ring tail."""
+    r = RECORDER
+    tail = r.tail(32)
+    return {
+        "armed": r.enabled,
+        "capacity": r.capacity,
+        "events": len(r),
+        "evicted": r.evicted,
+        "trips": r.trip_count,
+        "last_dump": r.last_dump,
+        "tail": [{k: _jsonable(v) for k, v in e.items()} for e in tail],
+    }

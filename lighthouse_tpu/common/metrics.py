@@ -25,8 +25,32 @@ def _escape_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _escape_help(v: str) -> str:
+    """HELP text escaping per the Prometheus text exposition format:
+    backslash and line feed only (quotes stay literal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _format_labels(items: tuple) -> str:
     return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+
+
+#: hard bound on labeled children per family, read once (lazy so this
+#: module stays importable before the env registry in edge cases)
+_LABEL_MAX: int | None = None
+
+
+def _label_max() -> int:
+    global _LABEL_MAX
+    if _LABEL_MAX is None:
+        try:
+            from lighthouse_tpu.common import env as envreg
+
+            _LABEL_MAX = max(
+                8, envreg.get_int("LHTPU_OBS_LABEL_MAX", 1024) or 1024)
+        except (ImportError, KeyError, ValueError):
+            _LABEL_MAX = 1024
+    return _LABEL_MAX
 
 
 class _Metric:
@@ -35,26 +59,68 @@ class _Metric:
         self.help = help_
         self._lock = threading.Lock()
         self._children: dict[tuple, "_Metric"] = {}
-        self._label_str = ""   # set on labeled children
-        self._touched = False  # unlabeled sample was actually used
+        self._label_str = ""    # set on labeled children
+        self._touched = False   # unlabeled sample was actually used
+        self._parent: "_Metric | None" = None   # set on labeled children
+        self._label_key: tuple | None = None
 
     def labels(self, **labelset) -> "_Metric":
-        """Per-label-set child (created on first use, then cached)."""
+        """Per-label-set child (created on first use, then cached).
+
+        Cardinality is HARD-BOUNDED: past LHTPU_OBS_LABEL_MAX children
+        the oldest-created child is evicted (its accumulated value is
+        lost, counted in tracing_evicted_total{kind="metric_child"}) —
+        a per-peer label storm under syncstorm degrades to a rolling
+        window instead of growing without bound.  An evicted child a
+        producer still holds (the hot paths memoize child handles)
+        re-attaches itself on its next update, so memoization never
+        turns eviction into a permanently invisible series."""
         if not labelset:
             return self
         key = tuple(sorted((k, str(v)) for k, v in labelset.items()))
+        evictions = 0
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 child = self._new_child()
                 child._label_str = _format_labels(key)
+                child._parent = self
+                child._label_key = key
                 self._children[key] = child
-            return child
+                bound = _label_max()
+                while len(self._children) > bound:
+                    oldest = next(iter(self._children))
+                    del self._children[oldest]
+                    evictions += 1
+        if evictions and self.name != "tracing_evicted_total":
+            record_evicted("metric_child", evictions)
+        return child
+
+    def _ensure_attached(self) -> None:
+        """Fast-path containment probe (one dict lookup; the common
+        case); a child evicted by the cardinality bound re-enters its
+        parent's table on the next update."""
+        p = self._parent
+        if p is None or self._label_key in p._children:
+            return
+        with p._lock:
+            p._children.setdefault(self._label_key, self)
+            bound = _label_max()
+            while len(p._children) > bound:
+                oldest = next(iter(p._children))
+                if oldest == self._label_key:
+                    # never self-evict the child being updated; rotate
+                    # it to newest instead
+                    p._children[oldest] = p._children.pop(oldest)
+                    continue
+                del p._children[oldest]
+                if p.name != "tracing_evicted_total":
+                    record_evicted("metric_child")
 
     def render(self) -> str:
         with self._lock:
             children = list(self._children.values())
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} {self._TYPE}"]
         if self._touched or not children:
             out.extend(self._sample_lines())
@@ -74,6 +140,7 @@ class Counter(_Metric):
         return Counter(self.name, self.help)
 
     def inc(self, by: float = 1.0):
+        self._ensure_attached()
         with self._lock:
             self._touched = True
             self.value += by
@@ -94,11 +161,13 @@ class Gauge(_Metric):
         return Gauge(self.name, self.help)
 
     def set(self, v: float):
+        self._ensure_attached()
         with self._lock:
             self._touched = True
             self.value = float(v)
 
     def inc(self, by: float = 1.0):
+        self._ensure_attached()
         with self._lock:
             self._touched = True
             self.value += by
@@ -129,6 +198,7 @@ class Histogram(_Metric):
         return Histogram(self.name, self.help, self.buckets)
 
     def observe(self, v: float):
+        self._ensure_attached()
         with self._lock:
             self._touched = True
             self.total += v
@@ -178,20 +248,25 @@ class Registry:
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get(name, lambda: Counter(name, help_))
+        return self._get(name, lambda: Counter(name, help_), help_)
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get(name, lambda: Gauge(name, help_))
+        return self._get(name, lambda: Gauge(name, help_), help_)
 
     def histogram(self, name: str, help_: str = "",
                   buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help_, buckets))
+        return self._get(name, lambda: Histogram(name, help_, buckets),
+                         help_)
 
-    def _get(self, name, factory):
+    def _get(self, name, factory, help_: str = ""):
         with self._lock:
             m = self.metrics.get(name)
             if m is None:
                 m = self.metrics[name] = factory()
+            elif help_ and not m.help:
+                # a later registration carrying the help string backfills
+                # a help-less first touch, so exposition always has HELP
+                m.help = help_
             return m
 
     def render(self) -> str:
@@ -201,6 +276,30 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+# -- bounded-structure eviction accounting -------------------------------------
+# Every observability structure with a hard bound (labeled-children maps
+# above, the tracing slot ring, the SLO engine's slot map and stage
+# reservoirs) counts what it rotates out here, so "the storm outran the
+# window" is distinguishable from "nothing happened".  This module is
+# the single owner of the tracing_evicted_total family.
+
+
+def record_evicted(kind: str, n: int = 1) -> None:
+    """Count ``n`` items evicted from a bounded observability structure
+    (``kind``: metric_child | slo_slot | slo_sample | ...)."""
+    try:
+        REGISTRY.counter(
+            "tracing_evicted_total",
+            "items evicted from bounded observability structures "
+            "(labeled-metric children, SLO slot ring, stage "
+            "reservoirs), by structure kind",
+        ).labels(kind=kind).inc(n)
+    except Exception:  # lhlint: allow(LH901)
+        pass  # eviction accounting must never take down the caller
+        # (and routing through record_swallowed from here could recurse
+        # through the very label path that just evicted)
 
 
 # -- swallowed-error accounting -----------------------------------------------

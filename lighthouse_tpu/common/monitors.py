@@ -1,0 +1,289 @@
+"""Live invariant watchdog: the books, swept at runtime.
+
+The repo's accounting invariants are currently asserted only at drill
+boundaries (firehose: ``enqueued == processed + shed + queued``;
+syncstorm: ``requested == imported + retried + abandoned``; backfill's
+twin).  In production nobody calls the assertion — a books leak would
+rot silently until the next bench run.  This module keeps them LIVE:
+subsystems register their ledgers as named monitors, a daemon sweeper
+re-checks them on a cadence (``LHTPU_OBS_SWEEP_S``), and a breach
+
+- increments ``invariant_violations_total{monitor}``,
+- files the violation into the flight recorder and fires the
+  ``books_violation`` trip (the black box dumps with the full event
+  context that led up to the leak),
+- fires EXACTLY ONCE per breach: the monitor re-arms only after a sweep
+  observes it healthy again (no alert storm from one stuck ledger).
+
+False-positive discipline: ledgers are transiently imbalanced while
+work is in flight (an enqueue is counted before the queue append; a
+requested batch before its outcome), so each registered check knows its
+own quiescence rule — the processor monitor requires imbalance only at
+idle, the sync/backfill monitors compare the deficit against their
+in-flight attempt count.  A *negative* imbalance (more accounted than
+submitted) is impossible legitimately and always fires.
+
+Checks run swallowed-but-accounted: a monitor that raises is counted
+(``record_swallowed``) and skipped, never kills the sweeper.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+
+class MonitorRegistry:
+    """Named invariant checks + the sweep/breach state machine.
+
+    A check is a zero-arg callable returning ``None`` (healthy) or a
+    dict describing the violation.  Re-registering a name replaces the
+    old check (a new BeaconProcessor instance supersedes the previous
+    one's books).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._checks: dict[str, object] = {}
+        self._breached: set[str] = set()
+        self.sweeps = 0
+        self.violations: list[dict] = []   # bounded breach log
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, name: str, check) -> str:
+        with self._lock:
+            self._checks[name] = check
+            self._breached.discard(name)
+        return name
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._checks.pop(name, None)
+            self._breached.discard(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._checks)
+
+    # -- sweeping -----------------------------------------------------------
+
+    def sweep(self) -> list[dict]:
+        """Run every registered check once; returns the violations that
+        FIRED this sweep (first observation of a breach only)."""
+        with self._lock:
+            checks = list(self._checks.items())
+            self.sweeps += 1
+        fired: list[dict] = []
+        for name, check in checks:
+            try:
+                detail = check()
+            except Exception as e:
+                record_swallowed(f"monitors.{name}", e)
+                continue
+            if detail:
+                with self._lock:
+                    is_new = name not in self._breached
+                    if is_new:
+                        self._breached.add(name)
+                if is_new:
+                    fired.append(self._fire(name, detail))
+            else:
+                with self._lock:
+                    self._breached.discard(name)
+        return fired
+
+    def _fire(self, name: str, detail: dict) -> dict:
+        violation = {"monitor": name, "detail": detail}
+        try:
+            REGISTRY.counter(
+                "invariant_violations_total",
+                "runtime accounting-invariant breaches, by monitor",
+            ).labels(monitor=name).inc()
+        except Exception as e:
+            record_swallowed("monitors.violation_counter", e)
+        with self._lock:
+            self.violations.append(violation)
+            del self.violations[:-64]   # bounded breach log
+        flight.trip("books_violation", monitor=name, detail=detail)
+        return violation
+
+    def breached(self) -> list[str]:
+        with self._lock:
+            return sorted(self._breached)
+
+    # -- the daemon sweeper --------------------------------------------------
+
+    def start(self, interval_s: float | None = None) -> bool:
+        """Start the background sweeper (idempotent); False when the
+        cadence knob disables it or the observatory is disarmed."""
+        cadence = (interval_s if interval_s is not None
+                   else envreg.get_float("LHTPU_OBS_SWEEP_S", 1.0) or 0.0)
+        if cadence <= 0 or not flight.RECORDER.enabled:
+            return False
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, args=(cadence,), daemon=True,
+                name="lhtpu-invariant-watchdog")
+            self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _loop(self, cadence: float) -> None:
+        while not self._stop.wait(cadence):
+            self.sweep()
+
+    def reset(self) -> None:
+        """Drop all monitors and breach state (tests)."""
+        self.stop()
+        with self._lock:
+            self._checks.clear()
+            self._breached.clear()
+            self.violations.clear()
+            self.sweeps = 0
+
+
+MONITORS = MonitorRegistry()
+
+
+def register(name: str, check) -> str:
+    return MONITORS.register(name, check)
+
+
+def sweep() -> list[dict]:
+    return MONITORS.sweep()
+
+
+# -- the stock ledgers ---------------------------------------------------------
+# Each helper takes the OWNING OBJECT and registers a weakref-backed
+# check: a collected owner reads as healthy (its books died with it).
+
+
+def _confirmed(compute):
+    """Double-read settle: the ledgers are mutated by OTHER threads a
+    few statements at a time (enqueue-then-append, outcome-then-
+    release), so a single read can land inside a microsecond window
+    that looks imbalanced.  A breach only counts when it survives a
+    re-read 2 ms later — a real leak is stable, a window is not."""
+    detail = compute()
+    if not detail:
+        return None
+    time.sleep(0.002)
+    return compute() or None
+
+
+def register_processor_books(bp, name: str = "processor_books") -> str:
+    """``enqueued == processed + shed + queued`` per work type.
+
+    A positive deficit equals the in-flight population while the
+    processor is busy, so it only counts as a breach at idle (no
+    in-flight tasks, manager not holding popped work).  A NEGATIVE
+    deficit — more accounted than ever enqueued — always fires."""
+    ref = weakref.ref(bp)
+
+    def _compute():
+        p = ref()
+        if p is None:
+            return None
+        idle = not p._inflight and not p._manager_holding
+        bad = {}
+        with p.metrics._lock:
+            enq = dict(p.metrics.enqueued)
+            proc = dict(p.metrics.processed)
+            shed: dict = {}
+            for (wt, _r), n in p.metrics.shed.items():
+                shed[wt] = shed.get(wt, 0) + n
+        for wt in set(enq) | set(proc) | set(shed):
+            deficit = (enq.get(wt, 0) - proc.get(wt, 0)
+                       - shed.get(wt, 0) - p.queue_len(wt))
+            if deficit < 0 or (idle and deficit != 0):
+                bad[wt.name.lower()] = deficit
+        if bad:
+            return {"invariant": "enqueued == processed + shed + queued",
+                    "idle": idle, "deficit_by_lane": bad}
+        return None
+
+    return MONITORS.register(name, lambda: _confirmed(_compute))
+
+
+def register_sync_books(sm, name: str = "sync_books") -> str:
+    """``requested == imported + retried + abandoned`` (+ the in-flight
+    attempt window while a batch is between request and outcome)."""
+    ref = weakref.ref(sm)
+
+    def _compute():
+        s = ref()
+        if s is None:
+            return None
+        b = dict(s.books)
+        deficit = b["requested"] - (b["imported"] + b["retried"]
+                                    + b["abandoned"])
+        inflight = getattr(s, "inflight_attempts", 0)
+        if deficit < 0 or deficit > max(inflight, 0):
+            return {"invariant":
+                    "requested == imported + retried + abandoned",
+                    "books": dict(b), "inflight_attempts": inflight,
+                    "deficit": deficit}
+        return None
+
+    return MONITORS.register(name, lambda: _confirmed(_compute))
+
+
+def register_backfill_books(bf, name: str = "backfill_books") -> str:
+    """The backfill twin of the range-sync books."""
+    ref = weakref.ref(bf)
+
+    def _compute():
+        f = ref()
+        if f is None:
+            return None
+        b = dict(f.books)
+        deficit = b["requested"] - (b["imported"] + b["retried"]
+                                    + b["abandoned"])
+        inflight = getattr(f, "inflight_attempts", 0)
+        if deficit < 0 or deficit > max(inflight, 0):
+            return {"invariant":
+                    "requested == imported + retried + abandoned",
+                    "books": dict(b), "inflight_attempts": inflight,
+                    "deficit": deficit}
+        return None
+
+    return MONITORS.register(name, lambda: _confirmed(_compute))
+
+
+def register_pool_bound(pool, capacity: int,
+                        name: str = "pool_bound") -> str:
+    """A pool that promises a bound must honor it: ``len(pool)`` above
+    ``capacity`` means an eviction path was skipped (the pool ledger's
+    runtime guard)."""
+    ref = weakref.ref(pool)
+
+    def check():
+        p = ref()
+        if p is None:
+            return None
+        try:
+            size = len(p)
+        except TypeError:
+            return None
+        if size > capacity:
+            return {"invariant": f"len(pool) <= {capacity}", "size": size}
+        return None
+
+    return MONITORS.register(name, check)

@@ -30,7 +30,8 @@ import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 
 # Roots that finish with no slot (device-plane work outside any block
 # context) are filed here so they stay inspectable.
@@ -108,6 +109,20 @@ class Tracer:
         self._ring: OrderedDict[int, _SlotTimeline] = OrderedDict()
         self._lock = threading.Lock()
         self.enabled = True
+        # root-span sinks (the SLO engine stitches slot timelines out of
+        # finished roots); called OUTSIDE the ring lock, exceptions
+        # swallowed-but-accounted — a broken sink must not break tracing
+        self._sinks: list = []
+
+    def add_sink(self, fn) -> None:
+        """Register ``fn(root_span, slot)`` to observe every finished
+        root span (idempotent per callable)."""
+        if fn not in self._sinks:
+            self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        if fn in self._sinks:
+            self._sinks.remove(fn)
 
     def span(self, name: str, slot: int | None = None, **attrs) -> "span":
         return span(name, slot=slot, tracer=self, **attrs)
@@ -132,6 +147,14 @@ class Tracer:
                     "tracing_spans_dropped_total",
                     "root spans rotated out by the per-slot bound").inc()
             tl.spans.append(sp)
+        # snapshot: add_sink/remove_sink mutate the list from other
+        # threads, and index-based iteration over a shifting list can
+        # skip a live sink or call a just-removed one
+        for sink in tuple(self._sinks):
+            try:
+                sink(sp, key)
+            except Exception as e:
+                record_swallowed("tracing.root_sink", e)
 
     def timeline(self, slot: int) -> dict | None:
         with self._lock:
@@ -200,6 +223,11 @@ class span:
         _current.reset(self._token)
         if self._slot_token is not None:
             _slot_ctx.reset(self._slot_token)
+        # closures above the flight recorder's latency floor become
+        # black-box events (sub-floor spans pay one float compare)
+        dur_ms = (sp.end - sp.start) * 1000.0
+        if dur_ms >= flight.RECORDER.span_floor_ms:
+            flight.RECORDER.note_span(sp.name, dur_ms, slot, sp.attrs)
         if self._parent is not None:
             self._parent.children.append(sp)
         else:

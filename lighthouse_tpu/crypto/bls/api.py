@@ -573,6 +573,7 @@ def _set_health_gauge(backend: str, state: str) -> None:
 
 def _note_transition(backend: str, old: str, new: str) -> None:
     _set_health_gauge(backend, new)
+    from lighthouse_tpu.common import flight_recorder as flight
     from lighthouse_tpu.common import tracing
 
     # zero-duration event in the slot timeline: health flips show up in
@@ -580,6 +581,12 @@ def _note_transition(backend: str, old: str, new: str) -> None:
     with tracing.span("bls.backend_health", backend=backend,
                       transition=f"{old}->{new}"):
         pass
+    # the black box: every breaker transition is a flight event, and a
+    # breaker OPENING is a trip condition — the ring that led up to it
+    # (faults, recoveries, ladder state) dumps to disk
+    flight.emit("breaker", plane="bls", backend=backend, old=old, new=new)
+    if new == "open":
+        flight.trip("bls_breaker_open", backend=backend, old=old)
 
 
 def _record_fault(backend: str, kind: str, exc: BaseException | None) -> None:
@@ -595,6 +602,10 @@ def _record_fault(backend: str, kind: str, exc: BaseException | None) -> None:
         from lighthouse_tpu.common.metrics import record_swallowed
 
         record_swallowed("bls.supervisor.fault_counter", e)
+    from lighthouse_tpu.common import flight_recorder as flight
+
+    flight.emit("supervisor_fault", plane="bls", backend=backend,
+                fault=kind, exc=repr(exc) if exc is not None else None)
     if (backend, kind) not in _FAULT_LOGGED:
         _FAULT_LOGGED.add((backend, kind))
         import sys
@@ -745,8 +756,11 @@ class _Supervisor:
                 ok = self._call_with_watchdog(rung, fn, sets, kwargs)
             except Exception as e:
                 kind = _faults.classify(e)
-                breaker.record_failure(kind)
+                # fault first, then the breaker transition: the flight
+                # ring reads causally (fault -> open) and a breaker-open
+                # trip dump carries the fault that caused it
                 _record_fault(rung, kind, e)
+                breaker.record_failure(kind)
                 continue
             except BaseException:
                 # KeyboardInterrupt/SystemExit surfacing from the
@@ -755,27 +769,31 @@ class _Supervisor:
                 # False with no backoff expiry to clear it)
                 breaker.record_failure("raise")
                 raise
-            from lighthouse_tpu.common import tracing
+            from lighthouse_tpu.common import device_telemetry, tracing
 
             if self._should_audit():
                 ref = _verify_signature_sets_reference(sets)
                 if ref != ok:
-                    breaker.record_failure("corrupt")
                     _record_fault(rung, "corrupt", None)
+                    breaker.record_failure("corrupt")
                     _record_recovery(entry)
                     tracing.add_attrs(served="reference")
+                    device_telemetry.record_first_verify("reference")
                     return ref
             breaker.record_success()
             tracing.add_attrs(served=rung)
+            device_telemetry.record_first_verify(rung)
             return ok
         # every device rung faulted or is benched: the in-flight sets are
         # re-verified whole on the authoritative CPU path — the caller
         # gets a correct verdict, never an exception or a torn partial
-        from lighthouse_tpu.common import tracing
+        from lighthouse_tpu.common import device_telemetry, tracing
 
         _record_recovery(entry)
         tracing.add_attrs(served="reference")
-        return _verify_signature_sets_reference(sets)
+        ok = _verify_signature_sets_reference(sets)
+        device_telemetry.record_first_verify("reference")
+        return ok
 
 
 _SUPERVISOR: _Supervisor | None = None
@@ -863,4 +881,10 @@ def verify_signature_sets(
         with timer:
             if supervised:
                 return sup.verify(name, sets, chunk_size)
-            return fn(sets, **kwargs)
+            ok = fn(sets, **kwargs)
+            from lighthouse_tpu.common import device_telemetry
+
+            # cold-start headline: first completed verification per
+            # backend (the AOT program store's acceptance metric)
+            device_telemetry.record_first_verify(name)
+            return ok

@@ -269,6 +269,10 @@ def _batched_cell_proof_msms(q_lists: list[list[int]], settings
             return ec.g1_segment_sum(X, Y, Z, n_seg)
 
         _CELL_PROOFS_JIT = jax.jit(_f, static_argnums=(3,))
+        from lighthouse_tpu.common import device_telemetry as _dtel
+
+        _CELL_PROOFS_JIT = _dtel.instrument(
+            "crypto/das.py::_batched_cell_proof_msms@_f", _CELL_PROOFS_JIT)
 
     seg_pad = 1 << max(len(q_lists[0]) - 1, 0).bit_length()
     chunk = max(1, _CELL_PROOF_MAX_LANES // seg_pad)

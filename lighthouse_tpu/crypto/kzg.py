@@ -30,6 +30,7 @@ from functools import lru_cache
 
 import numpy as np
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.crypto.bls import curve as cv
 from lighthouse_tpu.crypto.bls.fields import R as BLS_MODULUS
 
@@ -263,6 +264,8 @@ def _msm_device(points, scalars, pad_to: int | None = None):
     global _MSM_JIT
     if _MSM_JIT is None:
         _MSM_JIT = jax.jit(ec.g1_msm_windowed)
+        _MSM_JIT = _dtel.instrument(
+            "crypto/kzg.py::_msm_device@ec.g1_msm_windowed", _MSM_JIT)
     X, Y, Z = _MSM_JIT(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(bits))
     x, y, z = (int(bi.from_mont(np.asarray(c))) for c in (X, Y, Z))
     if z == 0:
@@ -479,6 +482,8 @@ def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
             return reduce_product(f, ok)
 
         _KZG_FUSED_JIT = jax.jit(_kzg_fused)
+        _KZG_FUSED_JIT = _dtel.instrument(
+            "crypto/kzg.py::_kzg_fused_check@_kzg_fused", _KZG_FUSED_JIT)
 
     m = 1 << max(len(lhs_points) - 1, 0).bit_length()
 

@@ -74,7 +74,13 @@ class BackfillSync:
         # books: requested == imported + retried + abandoned, always
         self.books = {"requested": 0, "imported": 0, "retried": 0,
                       "abandoned": 0}
+        # attempts between "requested" and their terminal outcome (the
+        # live books monitor's in-flight tolerance window)
+        self.inflight_attempts = 0
         self.downscores = 0
+        from lighthouse_tpu.common import monitors as _monitors
+
+        _monitors.register_backfill_books(self)
         # a prior run's progress is recoverable from the freezer's
         # hash-chain prefix: resume below it instead of refilling
         self._resume_from_freezer()
@@ -86,7 +92,16 @@ class BackfillSync:
     # -- accounting (the LH604 funnels) -------------------------------------
 
     def _account(self, outcome: str) -> None:
-        self.books[outcome] += 1
+        # ordering vs the watchdog thread: inflight grows BEFORE the
+        # requested bump, and a terminal outcome lands BEFORE inflight
+        # releases — a sweep between any two statements never observes
+        # deficit > inflight (no false books_violation trips)
+        if outcome == "requested":
+            self.inflight_attempts += 1
+            self.books[outcome] += 1
+        else:
+            self.books[outcome] += 1
+            self.inflight_attempts = max(0, self.inflight_attempts - 1)
         REGISTRY.counter(
             "backfill_batches_total",
             "backfill batch attempts by outcome (requested is the "
@@ -99,6 +114,10 @@ class BackfillSync:
             "backfill_downscores_total",
             "peer downscores issued by backfill, by reason",
         ).labels(reason=reason).inc()
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("downscore", plane="backfill", peer=peer, level=level,
+                    reason=reason)
         self.peers.report(peer, level)
 
     def books_balanced(self) -> bool:

@@ -121,6 +121,12 @@ def _record_request(token: str, outcome: str,
             "outbound rpc request wall time (includes retr-able "
             "failures; quarantined fail-fasts are not timed)",
         ).observe(seconds)
+    if outcome not in ("ok", "quarantined"):
+        # failed requests are the story BEFORE a quarantine trip; ok
+        # outcomes stay off the ring (steady traffic is not a story)
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("rpc_fail", protocol=token, outcome=outcome)
 
 
 @dataclass
@@ -246,6 +252,7 @@ class RequestDiscipline:
         base = envreg.get_float("LHTPU_RPC_BACKOFF_S", 0.5) or 0.5
         cap = envreg.get_float("LHTPU_RPC_BACKOFF_MAX_S", 30.0) or 30.0
         cb = rung = None
+        quarantined = False
         with self._lock:
             h = self._health.setdefault(dst, _PeerHealth())
             h.fails += 1
@@ -255,6 +262,15 @@ class RequestDiscipline:
                 h.quarantines += 1
                 h.fails = 0
                 cb, rung = self.on_quarantine, h.quarantines
+                quarantined = True
+        if quarantined:
+            # crossing into quarantine is a trip condition: the black
+            # box shows the request failures (and injected peer faults)
+            # that walked this peer up the ladder
+            from lighthouse_tpu.common import flight_recorder as flight
+
+            flight.emit("quarantine", peer=dst, rung=rung)
+            flight.trip("peer_quarantine", peer=dst, rung=rung)
         if cb is not None:
             try:
                 cb(dst, rung)

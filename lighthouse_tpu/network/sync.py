@@ -102,7 +102,16 @@ class SyncManager:
         # the books: requested == imported + retried + abandoned, always
         self.books = {"requested": 0, "imported": 0, "retried": 0,
                       "abandoned": 0}
+        # attempts between their "requested" bump and terminal outcome —
+        # the live books monitor compares the deficit against this, so
+        # mid-attempt sweeps never read as violations
+        self.inflight_attempts = 0
         self.downscores = 0
+        # the books go LIVE: the invariant watchdog sweeps them
+        # (weakref-backed; the newest manager owns the name)
+        from lighthouse_tpu.common import monitors as _monitors
+
+        _monitors.register_sync_books(self)
 
     # -- accounting (the LH604 funnels) -------------------------------------
 
@@ -110,12 +119,20 @@ class SyncManager:
         """One batch attempt lands in exactly one outcome bucket; the
         requested counter is bumped separately per attempt so the books
         invariant is checkable from the metrics alone."""
-        self.books[outcome] += 1
         if outcome == "requested":
+            # inflight BEFORE the requested bump: the watchdog thread
+            # sweeping between the two statements must never observe
+            # deficit > inflight (a false books_violation trip)
+            self.inflight_attempts += 1
+            self.books[outcome] += 1
             REGISTRY.counter(
                 "sync_batch_requests_total",
                 "range-sync batch download attempts issued").inc()
         else:
+            # outcome lands BEFORE inflight releases (the mirror-image
+            # ordering constraint: deficit shrinks first, window after)
+            self.books[outcome] += 1
+            self.inflight_attempts = max(0, self.inflight_attempts - 1)
             REGISTRY.counter(
                 "sync_batches_total",
                 "range-sync batch attempts by terminal outcome",
@@ -142,6 +159,10 @@ class SyncManager:
             "sync_downscores_total",
             "peer downscores issued by the sync plane, by reason",
         ).labels(reason=reason).inc()
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("downscore", plane="sync", peer=peer, level=level,
+                    reason=reason)
         self.peers.report(peer, level)
 
     def books_balanced(self) -> bool:

@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.ops import bigint as bi
 
 # --- Fp2 -------------------------------------------------------------------
@@ -770,6 +771,8 @@ def _miller_reduce_jit(n: int):
             return reduce_product(f, mask)
 
         _JIT_CACHE[n] = jax.jit(run)
+        _JIT_CACHE[n] = _dtel.instrument(
+            "ops/bls12_381.py::_miller_reduce_jit@run", _JIT_CACHE[n])
     return _JIT_CACHE[n]
 
 

@@ -36,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.crypto.bls import api, curve as cv
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import cache_guard
@@ -155,9 +156,18 @@ def _pipeline_fused(pkx, pky, sxa, sxb, sya, syb,
     return reduce_product(f, mask)
 
 
+_pipeline_fused = _dtel.instrument(
+    "ops/bls_backend.py::_pipeline_fused@_pipeline_fused", _pipeline_fused)
+
+
 @jax.jit
 def _g2_subgroup_kernel(xqa, xqb, yqa, yqb):
     return ec.g2_subgroup_verdict_batch(xqa, xqb, yqa, yqb)
+
+
+_g2_subgroup_kernel = _dtel.instrument(
+    "ops/bls_backend.py::_g2_subgroup_kernel@_g2_subgroup_kernel",
+    _g2_subgroup_kernel)
 
 
 def _dispatch_g2_subgroup_kernel(points):
@@ -185,6 +195,11 @@ def batch_subgroup_check_g2(points) -> np.ndarray:
 @jax.jit
 def _g1_subgroup_kernel(xp, yp):
     return ec.g1_subgroup_verdict_batch(xp, yp)
+
+
+_g1_subgroup_kernel = _dtel.instrument(
+    "ops/bls_backend.py::_g1_subgroup_kernel@_g1_subgroup_kernel",
+    _g1_subgroup_kernel)
 
 
 def _next_pow2(x: int, floor: int = 1) -> int:
@@ -226,6 +241,11 @@ def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
          one))
     xa, ya = ec.g1_jacobian_to_affine_batch(Xr, Yr, Zr)
     return xa, ya, bi.is_zero_mod_p_device(Zr)
+
+
+_aggregate_kernel = _dtel.instrument(
+    "ops/bls_backend.py::_aggregate_kernel@_aggregate_kernel",
+    _aggregate_kernel)
 
 
 # blinding pool: lane j carries B_j = [u_j]G alongside the pubkeys, and
@@ -382,6 +402,9 @@ def _g1_neg_limbs():
 
 
 _final_exp_hard_jit = jax.jit(final_exp_hard_device)
+_final_exp_hard_jit = _dtel.instrument(
+    "ops/bls_backend.py::<module>@final_exp_hard_device",
+    _final_exp_hard_jit)
 _DEVICE_FINAL_EXP: bool | None = None
 
 

@@ -33,6 +33,7 @@ import numpy as np
 
 import jax
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.ops import faults
@@ -153,6 +154,8 @@ class AsyncVerdict:
 
 
 _fq12_mul_pair = jax.jit(_fp12_mul_q)
+_fq12_mul_pair = _dtel.instrument(
+    "ops/dispatch_pipeline.py::<module>@_fp12_mul_q", _fq12_mul_pair)
 
 
 def combine_partials(partials: list):

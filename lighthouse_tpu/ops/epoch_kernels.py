@@ -56,6 +56,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import enable_x64
 
+from lighthouse_tpu.common import device_telemetry as _dtel
+
 TIMELY_SOURCE_FLAG_INDEX = 0
 TIMELY_TARGET_FLAG_INDEX = 1
 TIMELY_HEAD_FLAG_INDEX = 2
@@ -160,6 +162,9 @@ def _epoch_pass_jit():
     if fn is None:
         fn = _EPOCH_JIT_CACHE["epoch_pass"] = jax.jit(
             _fused_epoch_pass, static_argnames=("apply_eb",))
+        fn = _EPOCH_JIT_CACHE["epoch_pass"] = _dtel.instrument(
+            "ops/epoch_kernels.py::_epoch_pass_jit@_fused_epoch_pass",
+            fn)
     return fn
 
 
@@ -235,6 +240,8 @@ def _shuffle_jit(rounds: int):
     if fn is None:
         fn = _SHUFFLE_JIT_CACHE[rounds] = jax.jit(
             partial(_shuffle_rounds, rounds=rounds))
+        fn = _SHUFFLE_JIT_CACHE[rounds] = _dtel.instrument(
+            "ops/epoch_kernels.py::_shuffle_jit@_shuffle_rounds", fn)
     return fn
 
 

@@ -187,6 +187,10 @@ def _record_injection(site: str, mode: str) -> None:
             "offload_injected_faults_total",
             "faults injected by ops/faults, by site and mode",
         ).labels(site=site, mode=mode).inc()
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("fault_injected", plane="offload", site=site,
+                    mode=mode)
     except (AttributeError, KeyError, TypeError, ValueError):
         pass  # injection accounting must never mask the injected fault
 
@@ -375,6 +379,10 @@ def _record_peer_injection(mode: str, protocol: str) -> None:
             "peer_faults_injected_total",
             "peer faults injected by ops/faults, by mode and protocol",
         ).labels(mode=mode, protocol=protocol).inc()
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("fault_injected", plane="peer", mode=mode,
+                    protocol=protocol)
     except (AttributeError, KeyError, TypeError, ValueError):
         pass  # injection accounting must never mask the injected fault
 

@@ -27,6 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from lighthouse_tpu.common import device_telemetry as _dtel
+
 R_INT = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 
 B = 15
@@ -317,7 +319,12 @@ def _eval_kernel(f, zr, roots, inv_w):
     return y
 
 
+_eval_kernel = _dtel.instrument(
+    "ops/fr.py::_eval_kernel@_eval_kernel", _eval_kernel)
+
+
 _TO_MONT_JIT = jax.jit(lambda x: mont_mul(x, _jconst("r2")))
+_TO_MONT_JIT = _dtel.instrument("ops/fr.py::<module>@<lambda>", _TO_MONT_JIT)
 
 
 def evaluate_polynomials_batch(polys_raw_limbs: np.ndarray,

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.common.metrics import REGISTRY
 
 # shapes whose whole-fold device program has already been dispatched in
@@ -147,6 +148,10 @@ def sha256_block(state: jax.Array, block: jax.Array) -> jax.Array:
     return _rounds(state, _expand_schedule(block))
 
 
+sha256_block = _dtel.instrument(
+    "ops/sha256.py::sha256_block@sha256_block", sha256_block)
+
+
 @jax.jit
 def hash_pairs_device(pairs: jax.Array) -> jax.Array:
     """SHA-256 of N 64-byte messages given as big-endian words.
@@ -160,6 +165,10 @@ def hash_pairs_device(pairs: jax.Array) -> jax.Array:
     pad_w = jnp.asarray(_PAD_W, jnp.uint32).reshape((64,) + (1,) * (pairs.ndim - 1))
     pad_w = jnp.broadcast_to(pad_w, (64,) + pairs.shape[:-1])
     return _rounds(mid, pad_w)
+
+
+hash_pairs_device = _dtel.instrument(
+    "ops/sha256.py::hash_pairs_device@hash_pairs_device", hash_pairs_device)
 
 
 def fold_to_root_device(leaves: jax.Array) -> jax.Array:
@@ -188,6 +197,11 @@ def _fold_levels_device(leaves: jax.Array):
         x = hash_pairs_device(x.reshape(x.shape[0] // 2, 16))
         out.append(x)
     return tuple(out)
+
+
+_fold_levels_device = _dtel.instrument(
+    "ops/sha256.py::_fold_levels_device@_fold_levels_device",
+    _fold_levels_device)
 
 
 def fold_levels(leaves: np.ndarray, *, device: bool | None = None) -> list[np.ndarray]:
@@ -383,6 +397,8 @@ def _hash_level(pairs: np.ndarray, *, device: bool | None = None) -> np.ndarray:
 _DEVICE_FOLD_MIN_LEAVES = 1 << 12
 _fold_to_root_jit = jax.jit(
     lambda leaves: fold_to_root_device(leaves))
+_fold_to_root_jit = _dtel.instrument(
+    "ops/sha256.py::<module>@<lambda>", _fold_to_root_jit)
 
 # --- startup micro-calibration ---------------------------------------------
 

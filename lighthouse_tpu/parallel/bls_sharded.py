@@ -20,6 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.ops import bls12_381 as dev
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import faults
@@ -58,6 +59,8 @@ def _sharded_miller_reduce(mesh, per_dev: int):
         in_specs=(spec,) * 6 + (P("data"),),
         out_specs=P(None, None),
         check_rep=False))
+    fn = _dtel.instrument(
+        "parallel/bls_sharded.py::_sharded_miller_reduce@shard_map", fn)
     _SHARDED_JIT_CACHE[key] = fn
     return fn
 

@@ -64,7 +64,11 @@ def _merkle_dryrun(n_devices: int) -> None:
 
     arr = jax.device_put(leaves, NamedSharding(mesh, P("data", None)))
     # one-shot warmup compile by design — the whole point of the dryrun
-    root = jax.jit(sharded)(arr)  # lhlint: allow(jit-in-function)
+    from lighthouse_tpu.common import device_telemetry as _dtel
+
+    root = _dtel.instrument(
+        "parallel/dryrun_worker.py::_merkle_dryrun@sharded",
+        jax.jit(sharded))(arr)  # lhlint: allow(jit-in-function)
     root.block_until_ready()
 
     # host cross-check (hashlib path, zero extra compiles)

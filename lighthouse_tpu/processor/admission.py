@@ -211,12 +211,17 @@ class AdmissionController:
                 "degradation ladder rung changes, by direction and rung",
             ).labels(direction="up" if new > old else "down",
                      rung=RUNG_NAMES[new]).inc()
+            from lighthouse_tpu.common import flight_recorder as flight
             from lighthouse_tpu.common import tracing
 
             with tracing.span("beacon_processor.ladder",
                               from_rung=RUNG_NAMES[old],
                               to_rung=RUNG_NAMES[new]):
                 pass
+            # every rung change is a black-box event: after a trip, the
+            # dump shows the ladder walking up under pressure
+            flight.emit("ladder", plane="admission", old=RUNG_NAMES[old],
+                        new=RUNG_NAMES[new], sweeps=self.sweeps)
         except (AttributeError, KeyError, TypeError, ValueError) as e:
             record_swallowed("admission.ladder_transition", e)
 

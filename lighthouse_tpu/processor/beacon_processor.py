@@ -363,6 +363,12 @@ class BeaconProcessor:
         # submit()/dequeue run once per gossip event at flood scale, so
         # the per-call cost must stay one observe()/inc()
         self._label_memo: dict[tuple, Any] = {}
+        # the books go LIVE: enqueued == processed + shed + queued is a
+        # registered invariant monitor (weakref-backed; the newest
+        # processor instance owns the "processor_books" name)
+        from lighthouse_tpu.common import monitors as _monitors
+
+        _monitors.register_processor_books(self)
 
     def _labeled(self, family, wt: WorkType, outcome: str | None = None,
                  reason: str | None = None):
@@ -398,6 +404,8 @@ class BeaconProcessor:
             self._shed_pending[key] = self._shed_pending.get(key, 0) + n
 
     def _trace_pending_sheds(self) -> None:
+        from lighthouse_tpu.common import flight_recorder as flight
+
         with self.metrics._lock:
             pending, self._shed_pending = self._shed_pending, {}
         for (wt, reason), n in pending.items():
@@ -405,6 +413,10 @@ class BeaconProcessor:
                               work_type=wt.name.lower(), reason=reason,
                               count=n):
                 pass
+            # aggregated per sweep (never per message): the black box
+            # shows WHAT was shed in the window before a trip
+            flight.emit("shed", plane="processor",
+                        work_type=wt.name.lower(), reason=reason, count=n)
 
     def shed_queue(self, wt: WorkType, reason: str = "purged") -> int:
         """Discard EVERYTHING queued on one lane, accounted under
@@ -782,5 +794,13 @@ class BeaconProcessor:
                      action="restarted" if restarted else "sync_only").inc()
         except (AttributeError, KeyError, TypeError, ValueError) as e:
             record_swallowed("beacon_processor.dispatch_restart_counter", e)
+        # a wedged/dead dispatch thread is a trip condition: the black
+        # box dumps with the batches and faults that preceded the wedge
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.trip("dispatch_wedge", wedge=reason,
+                    restarted=restarted,
+                    generation=self._dispatch_generation,
+                    inflight=self._dispatch_inflight)
         if exc is not None:
             record_swallowed(f"beacon_processor.dispatch_{reason}", exc)

@@ -205,9 +205,16 @@ def resolve_epoch_backend(n_validators: int) -> str:
 def _breaker_ok() -> None:
     """A successful device dispatch (epoch pass OR shuffle — they share
     the breaker) closes the consecutive-fault count and the backoff."""
+    was_tripped = False
     with _BREAKER_LOCK:
+        was_tripped = _BREAKER["open_until"] > 0.0
         _BREAKER["fails"] = 0
         _BREAKER["backoff"] = 0.0
+        _BREAKER["open_until"] = 0.0
+    if was_tripped:
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("breaker", plane="epoch", old="open", new="closed")
 
 
 def _breaker_fault() -> None:
@@ -216,6 +223,7 @@ def _breaker_fault() -> None:
         envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_S", 1.0) or 1.0)
     ceiling = float(
         envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_MAX_S", 60.0) or 60.0)
+    opened = False
     with _BREAKER_LOCK:
         fails = _BREAKER["fails"] = _BREAKER["fails"] + 1
         if fails >= threshold:
@@ -223,6 +231,15 @@ def _breaker_fault() -> None:
             _BREAKER["open_until"] = time.monotonic() + backoff
             _BREAKER["backoff"] = min(backoff * 2, ceiling)
             _BREAKER["fails"] = 0
+            opened = True
+    from lighthouse_tpu.common import flight_recorder as flight
+
+    flight.emit("breaker", plane="epoch", old="closed",
+                new="open" if opened else "counting", fails=fails)
+    if opened:
+        # the epoch breaker opening is a trip condition: the dump shows
+        # the device faults that benched the fused pass
+        flight.trip("epoch_breaker_open", fails=fails)
 
 
 def _maybe_device_epoch(state, spec: T.ChainSpec, fork: str):

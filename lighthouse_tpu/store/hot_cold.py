@@ -277,6 +277,9 @@ class HotColdDB:
             "store_recovery_repairs_total",
             "meta records repaired/dropped by the startup sweep",
         ).labels(record=record, action=action).inc()
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("store_repair", record=record, action=action)
 
     def _startup_repair(self, dirty: bool) -> dict[str, str]:
         """Integrity sweep after a dirty shutdown: validate every meta
@@ -380,6 +383,13 @@ class HotColdDB:
         with tracing.span("store.recovery", dirty=dirty,
                           repairs=len(report), pruned=pruned):
             pass
+        if report:
+            # repaired/dropped meta records mean the store WAS corrupt:
+            # a trip condition — the black box carries the repair story
+            from lighthouse_tpu.common import flight_recorder as flight
+
+            flight.trip("store_corruption", dirty=dirty, report=report,
+                        pruned=pruned)
         return report
 
     # -- fork helpers ------------------------------------------------------

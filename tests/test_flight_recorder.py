@@ -1,0 +1,242 @@
+"""Flight recorder: ring semantics, concurrency, and the trip matrix.
+
+The acceptance contract (ISSUE 11): every documented trip condition
+produces a JSON black box naming its trigger, concurrent emitters lose
+no events, and the ring bound is honored (overflow evicts oldest,
+counted).  The trip matrix drives each condition through its OWNING
+seam (supervisor breaker, epoch breaker, dispatch supervisor, store
+sweep, rpc quarantine, invariant monitor) — never by calling ``trip``
+directly — so a refactor that disconnects an emit point fails here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common import monitors
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls import api
+from lighthouse_tpu.ops import faults
+from lighthouse_tpu.testing import supervised_bls
+
+
+@pytest.fixture(autouse=True)
+def fresh_recorder(tmp_path, monkeypatch):
+    """A fresh armed recorder per test, dumping into tmp_path."""
+    rec = flight.FlightRecorder(capacity=256, dump_dir=str(tmp_path),
+                                max_dumps=4)
+    rec.enabled = True
+    monkeypatch.setattr(flight, "RECORDER", rec)
+    monitors.MONITORS.reset()
+    yield rec
+    monitors.MONITORS.reset()
+
+
+# -- ring semantics -----------------------------------------------------------
+
+
+def test_ring_bound_honored(fresh_recorder):
+    rec = flight.FlightRecorder(capacity=32, dump_dir=None)
+    for i in range(100):
+        rec.emit("tick", i=i)
+    assert len(rec) == 32
+    assert rec.evicted == 68
+    events = rec.snapshot()
+    # newest-wins: the survivors are the last 32 emits, in order
+    assert [e["i"] for e in events] == list(range(68, 100))
+
+
+def test_concurrent_emitters_lose_no_events(fresh_recorder):
+    rec = flight.FlightRecorder(capacity=4096, dump_dir=None)
+    n_threads, per_thread = 8, 200
+
+    def pump(t):
+        for i in range(per_thread):
+            rec.emit("load", thread=t, i=i)
+
+    threads = [threading.Thread(target=pump, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    events = rec.snapshot()
+    assert len(events) == n_threads * per_thread
+    # sequence numbers are unique and dense
+    seqs = {e["seq"] for e in events}
+    assert len(seqs) == n_threads * per_thread
+
+
+def test_trip_dumps_to_disk_and_prunes(fresh_recorder, tmp_path):
+    rec = fresh_recorder
+    for i in range(5):
+        rec.emit("precursor", i=i)
+    for k in range(6):  # max_dumps=4: the first two files are pruned
+        dump = rec.trip("drill", ordinal=k)
+    assert dump["reason"] == "drill"
+    assert dump["event_count"] >= 6
+    files = sorted(tmp_path.glob("flight-*.json"))
+    assert len(files) == 4
+    parsed = json.loads(files[-1].read_text())
+    assert parsed["reason"] == "drill"
+    assert parsed["events"][0]["kind"] in ("precursor", "trip")
+
+
+def test_disarmed_recorder_is_inert(fresh_recorder):
+    rec = fresh_recorder
+    rec.enabled = False
+    rec.emit("x")
+    assert rec.trip("y") is None
+    assert len(rec) == 0 and rec.last_dump is None
+
+
+def test_slow_span_capture(fresh_recorder):
+    import time
+
+    from lighthouse_tpu.common import tracing
+
+    fresh_recorder.span_floor_ms = 5.0
+    with tracing.span("slow_thing", slot=9):
+        time.sleep(0.02)
+    with tracing.span("fast_thing", slot=9):
+        pass
+    kinds = [(e["kind"], e.get("name")) for e in fresh_recorder.snapshot()]
+    assert ("slow_span", "slow_thing") in kinds
+    assert ("slow_span", "fast_thing") not in kinds
+
+
+# -- the trip matrix ----------------------------------------------------------
+
+
+@pytest.fixture
+def valid_sets():
+    sk = bls.SecretKey.from_bytes(bytes([0] * 31 + [5]))
+    msg = b"flight-recorder-trip".ljust(32, b"\x00")
+    return [bls.SignatureSet(sk.sign(msg), [sk.public_key()], msg)]
+
+
+def test_trip_bls_breaker_open(fresh_recorder, valid_sets):
+    """An injected device fault opens the tpu breaker through the REAL
+    supervisor path; the dump names the trigger and carries the
+    supervisor_fault event that preceded it."""
+    def raising_backend(sets, **kw):
+        raise faults.InjectedFault("flight drill")
+
+    prev = api._BACKENDS.get("tpu")
+    api.register_backend("tpu", raising_backend)
+    try:
+        with supervised_bls(LHTPU_SUPERVISOR_FAILS="1",
+                            LHTPU_SUPERVISOR_LADDER="tpu,reference"):
+            assert bls.verify_signature_sets(valid_sets, backend="tpu")
+    finally:
+        if prev is None:
+            api._BACKENDS.pop("tpu", None)
+        else:
+            api._BACKENDS["tpu"] = prev
+        api.reset_supervisor()
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "bls_breaker_open"
+    kinds = {e["kind"] for e in dump["events"]}
+    assert "supervisor_fault" in kinds
+    assert any(e["kind"] == "breaker" and e.get("new") == "open"
+               for e in dump["events"])
+
+
+def test_trip_epoch_breaker_open(fresh_recorder, monkeypatch):
+    from lighthouse_tpu.state_transition import epoch_processing as ep
+
+    monkeypatch.setenv("LHTPU_SUPERVISOR_FAILS", "1")
+    ep.reset_epoch_supervisor()
+    ep._breaker_fault()
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "epoch_breaker_open"
+    ep.reset_epoch_supervisor()
+
+
+def test_trip_dispatch_wedge(fresh_recorder):
+    """A batch that outlives the wedge deadline trips through the real
+    dispatch-thread supervisor."""
+    import asyncio
+    import time
+
+    from lighthouse_tpu.processor import (
+        BeaconProcessor,
+        WorkEvent,
+        WorkType,
+    )
+
+    bp = BeaconProcessor(max_workers=2, batch_flush_ms=5,
+                         dispatch_wedge_s=0.05)
+
+    async def main():
+        await bp.start()
+        bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=1,
+                            process_batch=lambda p: time.sleep(0.4)))
+        await bp.drain()
+        await bp.stop(drain=False)
+
+    asyncio.run(main())
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "dispatch_wedge"
+    assert dump["trip_fields"]["wedge"] == "wedged"
+
+
+def test_trip_store_corruption(fresh_recorder):
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.store.migrations import K_HEAD
+    from lighthouse_tpu.testing import Harness
+
+    h = Harness(n_validators=8, real_crypto=False)
+    db = HotColdDB(h.spec)
+    db.hot.put(K_HEAD, b"torn-unenveloped-garbage")
+    report = db._startup_repair(dirty=True)
+    assert report.get("head") == "dropped"
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "store_corruption"
+    assert dump["trip_fields"]["report"]["head"] == "dropped"
+    kinds = {e["kind"] for e in dump["events"]}
+    assert "store_repair" in kinds
+
+
+def test_trip_peer_quarantine(fresh_recorder, monkeypatch):
+    from lighthouse_tpu.network.rpc import RequestDiscipline, RpcError
+
+    monkeypatch.setenv("LHTPU_RPC_FAILS", "3")
+    monkeypatch.setenv("LHTPU_RPC_DEADLINE_S", "0")
+    d = RequestDiscipline()
+
+    def failing_issue(dst):
+        raise RpcError("refused")
+
+    for _ in range(3):
+        with pytest.raises(RpcError):
+            d.execute("evil-peer", "/eth2/x/req/status/1", b"",
+                      failing_issue)
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "peer_quarantine"
+    assert dump["trip_fields"]["peer"] == "evil-peer"
+    # the failures that walked the ladder are in the story
+    assert sum(1 for e in dump["events"]
+               if e["kind"] == "rpc_fail") >= 2
+
+
+def test_trip_books_violation(fresh_recorder):
+    monitors.register("drill_books", lambda: {"deficit": 3})
+    fired = monitors.sweep()
+    assert len(fired) == 1
+    dump = fresh_recorder.last_dump
+    assert dump is not None and dump["reason"] == "books_violation"
+    assert dump["trip_fields"]["monitor"] == "drill_books"
+
+
+def test_observatory_view_shape(fresh_recorder):
+    fresh_recorder.emit("a")
+    fresh_recorder.trip("drill")
+    view = flight.observatory_view()
+    assert view["armed"] and view["trips"] == 1
+    assert view["last_dump"]["reason"] == "drill"
+    assert view["tail"][-1]["kind"] == "trip"

@@ -630,7 +630,7 @@ def test_shed_pass_compliant_twin_metric_literal(tmp_path):
         class Pool:
             def prune_below(self, slot):
                 for s in [s for s in self._slots if s < slot]:
-                    REGISTRY.counter("pool_dropped_total").inc()
+                    REGISTRY.counter("pool_dropped_total", "h").inc()
                     del self._slots[s]
     """})
     assert analyze(pkg) == []
@@ -642,7 +642,7 @@ def test_shed_pass_compliant_twin_helper_call(tmp_path):
         "pool/accounting.py": """
             def record_pool_dropped(pool, reason, n=1):
                 from lighthouse_tpu.common.metrics import REGISTRY
-                REGISTRY.counter("pool_dropped_total").inc(n)
+                REGISTRY.counter("pool_dropped_total", "h").inc(n)
         """,
         "pool/naive_aggregation.py": """
             from pkg.pool.accounting import record_pool_dropped
@@ -748,7 +748,7 @@ def test_sync_pass_compliant_twin_metric_literal(tmp_path):
             def download(self, peer):
                 blocks = self.rpc.request(peer, "range", b"")
                 if not blocks:
-                    REGISTRY.counter("sync_attempts_total").labels(
+                    REGISTRY.counter("sync_attempts_total", "h").labels(
                         outcome="retried").inc()
                     self.peers.report(peer, "high")
                     return None
@@ -764,7 +764,7 @@ def test_sync_pass_compliant_twin_helper_call(tmp_path):
 
         class SyncManager:
             def _downscore(self, peer, level, reason):
-                REGISTRY.counter("sync_penalties_total").labels(
+                REGISTRY.counter("sync_penalties_total", "h").labels(
                     reason=reason).inc()
                 self.peers.report(peer, level)
 
@@ -804,6 +804,111 @@ def test_sync_pass_real_tree_zero_findings():
     through the _account*/_downscore funnels."""
     findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
     assert [f for f in findings if f.rule == "LH604"] == []
+
+
+# -- pass 13: recorded breaker/ladder transitions (LH605) ---------------------
+
+
+def test_flight_pass_flags_unrecorded_rung_change(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"processor/admission.py": """
+        class AdmissionController:
+            def sweep(self, depths):
+                self.rung = 1
+                return self.rung
+    """})
+    findings = analyze(pkg)
+    assert rules_of(findings) == ["LH605"]
+    assert findings[0].symbol == "AdmissionController.sweep:set_rung"
+    assert "flight-recorder" in findings[0].message
+
+
+def test_flight_pass_flags_unrecorded_breaker_state(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        class Breaker:
+            def record_failure(self):
+                self.state = "open"
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == ["Breaker.record_failure:set_state"]
+
+
+def test_flight_pass_flags_open_until_store(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {
+        "state_transition/epoch_processing.py": """
+        _BREAKER = {"open_until": 0.0}
+
+        def breaker_fault(now):
+            _BREAKER["open_until"] = now + 1.0
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == ["breaker_fault:set_open_until"]
+
+
+def test_flight_pass_compliant_twin_direct_emit(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"processor/admission.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        class AdmissionController:
+            def sweep(self, depths):
+                self.rung = 1
+                flight.emit("ladder", old=0, new=1)
+                return self.rung
+    """})
+    assert analyze(pkg) == []
+
+
+def test_flight_pass_compliant_twin_helper_funnel(tmp_path):
+    # funneling through a package helper that emits counts
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        def _note_transition(backend, old, new):
+            flight.emit("breaker", backend=backend, old=old, new=new)
+
+        class Breaker:
+            def record_failure(self):
+                old, self.state = self.state, "open"
+                _note_transition(self.backend, old, "open")
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
+def test_flight_pass_init_and_reset_exempt(tmp_path):
+    # initialization is not a transition
+    pkg, _ = make_pkg(tmp_path, {"crypto/bls/api.py": """
+        class Breaker:
+            def __init__(self):
+                self.state = "closed"
+
+            def reset(self):
+                self.state = "closed"
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
+def test_flight_pass_out_of_scope_modules_ignored(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"network/peer_manager.py": """
+        class Peer:
+            def ban(self):
+                self.state = "banned"
+    """})
+    assert analyze(pkg) == []
+
+
+def test_flight_pass_suppression(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"processor/admission.py": """
+        class AdmissionController:
+            def sweep(self, depths):
+                self.rung = 1  # lhlint: allow(LH605)
+    """})
+    assert analyze(pkg) == []
+
+
+def test_flight_pass_real_tree_zero_findings():
+    """Every breaker/ladder transition in the real tree emits its
+    flight-recorder event (fixed, not baselined)."""
+    findings = analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    assert [f for f in findings if f.rule == "LH605"] == []
 
 
 def test_exceptions_pass_network_scope(tmp_path):

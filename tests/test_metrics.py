@@ -131,6 +131,73 @@ class TestInstrumentedPaths:
         assert 'sha256_merkleize_seconds_count{path="level_loop"}' in text
 
 
+_SAMPLE_RE = None
+
+
+def _exposition_line_ok(line: str) -> bool:
+    """One text-format line: HELP, TYPE, or a sample
+    ``name[{labels}] value`` with escaped label values."""
+    import re
+
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        label = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+        _SAMPLE_RE = re.compile(
+            r"^[a-z_][a-zA-Z0-9_]*(?:\{%s(?:,%s)*\})? "
+            r"-?(?:[0-9.e+-]+|inf|nan)$" % (label, label))
+    if line.startswith("# HELP ") or line.startswith("# TYPE "):
+        return len(line.split(" ", 3)) >= 3 and "\n" not in line
+    return bool(_SAMPLE_RE.match(line))
+
+
+class TestExpositionConformance:
+    def test_full_registry_scrape_parses(self):
+        """Drive representative series through the PROCESS registry
+        (labels with hostile values, histograms, gauges) and require
+        every rendered line to parse — the satellite acceptance: zero
+        malformed lines on /metrics."""
+        REGISTRY.counter("conf_total", "help text").labels(
+            peer='evil"peer\\with\nnewline').inc()
+        REGISTRY.histogram("conf_seconds", "h").labels(
+            stage="verify").observe(0.2)
+        REGISTRY.gauge("conf_depth", "multi\nline help\\x").set(3)
+        bad = [ln for ln in REGISTRY.render().splitlines()
+               if ln and not _exposition_line_ok(ln)]
+        assert bad == [], f"malformed exposition lines: {bad[:5]}"
+
+    def test_help_text_escaped(self):
+        reg = Registry()
+        reg.counter("esc_total", "line\nbreak \\slash").inc()
+        text = reg.render()
+        assert "# HELP esc_total line\\nbreak \\\\slash" in text
+
+    def test_help_backfilled_from_later_registration(self):
+        reg = Registry()
+        reg.counter("late_help_total").inc()
+        reg.counter("late_help_total", "arrived later").inc()
+        assert "# HELP late_help_total arrived later" in reg.render()
+
+    def test_label_cardinality_hard_bound(self, monkeypatch):
+        """A per-peer label storm cannot grow a family without bound:
+        past LHTPU_OBS_LABEL_MAX the oldest child is evicted and the
+        eviction is counted."""
+        from lighthouse_tpu.common import metrics as m
+
+        monkeypatch.setattr(m, "_LABEL_MAX", 16)
+        reg = Registry()
+        c = reg.counter("storm_total", "h")
+        for i in range(100):
+            c.labels(peer=f"peer-{i}").inc()
+        assert len(c._children) == 16
+        # the newest children survive (rolling window)
+        assert ("peer", "peer-99") in {k[0] for k in c._children}, \
+            list(c._children)[:2]
+        evict = REGISTRY.metrics.get("tracing_evicted_total")
+        assert evict is not None
+        total = sum(ch.value for ch in evict._children.values())
+        assert total >= 84
+
+
 def test_check_metrics_lint_passes():
     """tools/check_metrics.py is part of tier-1: every in-tree metric
     name must be literal, well-formed, single-kind and single-module."""

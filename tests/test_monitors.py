@@ -1,0 +1,218 @@
+"""Invariant watchdog: once-per-breach semantics and zero false
+positives over live subsystems.
+
+The contract (ISSUE 11): a violation fires EXACTLY ONCE per breach (the
+monitor re-arms only after a healthy sweep), transiently-imbalanced
+in-flight ledgers never read as violations, and the stock monitors
+(processor/sync/backfill books) hold over real drill traffic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common import monitors
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.processor import BeaconProcessor, WorkEvent, WorkType
+
+
+class _Ledger:
+    """Weakref-able stand-in for a sync/backfill manager's books."""
+
+    def __init__(self, books, inflight_attempts=0):
+        self.books = books
+        self.inflight_attempts = inflight_attempts
+
+
+@pytest.fixture(autouse=True)
+def fresh(monkeypatch, tmp_path):
+    rec = flight.FlightRecorder(capacity=128, dump_dir=str(tmp_path))
+    rec.enabled = True
+    monkeypatch.setattr(flight, "RECORDER", rec)
+    monitors.MONITORS.reset()
+    yield
+    monitors.MONITORS.reset()
+
+
+def _violation_count(monitor: str) -> float:
+    fam = REGISTRY.metrics.get("invariant_violations_total")
+    if fam is None:
+        return 0.0
+    child = fam._children.get((("monitor", monitor),))
+    return child.value if child is not None else 0.0
+
+
+def test_fires_exactly_once_per_breach():
+    state = {"broken": False}
+    monitors.register(
+        "toggle", lambda: {"bad": 1} if state["broken"] else None)
+    base = _violation_count("toggle")
+
+    assert monitors.sweep() == []          # healthy
+    state["broken"] = True
+    assert len(monitors.sweep()) == 1      # breach observed: fires once
+    assert monitors.sweep() == []          # still breached: no re-fire
+    assert monitors.sweep() == []
+    state["broken"] = False
+    assert monitors.sweep() == []          # healed: re-arms
+    state["broken"] = True
+    assert len(monitors.sweep()) == 1      # NEW breach: fires again
+    assert _violation_count("toggle") == base + 2
+
+
+def test_breach_trips_flight_recorder():
+    monitors.register("books_drill", lambda: {"deficit": 7})
+    monitors.sweep()
+    dump = flight.RECORDER.last_dump
+    assert dump is not None and dump["reason"] == "books_violation"
+    assert dump["trip_fields"]["monitor"] == "books_drill"
+
+
+def test_raising_check_is_swallowed_not_fatal():
+    def bad_check():
+        raise RuntimeError("monitor bug")
+
+    monitors.register("broken_monitor", bad_check)
+    monitors.register("fine", lambda: None)
+    assert monitors.sweep() == []          # sweep survives, no breach
+
+
+def test_background_sweeper_start_stop():
+    hits = []
+    monitors.register("ticker", lambda: hits.append(1) and None)
+    assert monitors.MONITORS.start(interval_s=0.01)
+    deadline = time.monotonic() + 2.0
+    while len(hits) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    monitors.MONITORS.stop()
+    assert len(hits) >= 3
+
+
+def test_sweeper_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("LHTPU_OBS_SWEEP_S", "0")
+    assert monitors.MONITORS.start() is False
+
+
+# -- the stock ledger monitors ------------------------------------------------
+
+
+def test_processor_books_no_false_positive_under_load():
+    """The processor registers its own books monitor; sweeps DURING the
+    drill (in-flight work, positive deficit) and after drain must both
+    read healthy."""
+    bp = BeaconProcessor(max_workers=2, batch_flush_ms=5)
+    assert "processor_books" in monitors.MONITORS.names()
+    seen = {"n": 0}
+
+    def work(payloads):
+        seen["n"] += len(payloads)
+        time.sleep(0.002)
+
+    async def main():
+        await bp.start()
+        for i in range(200):
+            bp.submit(WorkEvent(WorkType.GOSSIP_ATTESTATION, payload=i,
+                                process_batch=work))
+            if i % 50 == 0:
+                assert monitors.sweep() == []   # mid-flight: no breach
+        await bp.drain()
+        await bp.stop(drain=False)
+
+    asyncio.run(main())
+    assert monitors.sweep() == []               # idle: books balance
+    assert seen["n"] == 200
+
+
+def test_processor_books_detects_cooked_ledger():
+    """A genuinely broken ledger (processed without enqueue — the
+    double-count class) fires even while running."""
+    bp = BeaconProcessor(max_workers=2)
+    bp.metrics.bump(bp.metrics.processed, WorkType.GOSSIP_ATTESTATION, 5)
+    fired = monitors.sweep()
+    assert [v["monitor"] for v in fired] == ["processor_books"]
+    assert fired[0]["detail"]["deficit_by_lane"][
+        "gossip_attestation"] == -5
+
+
+def test_sync_books_tolerates_inflight_attempts():
+    sm = _Ledger(
+        books={"requested": 5, "imported": 3, "retried": 1,
+               "abandoned": 0},
+        inflight_attempts=1)
+    monitors.register_sync_books(sm, name="sync_books_t")
+    assert monitors.sweep() == []      # deficit 1 == inflight 1
+    sm.inflight_attempts = 0
+    fired = monitors.sweep()           # same deficit, nothing in flight
+    assert [v["monitor"] for v in fired] == ["sync_books_t"]
+
+
+def test_sync_books_negative_deficit_always_fires():
+    sm = _Ledger(
+        books={"requested": 2, "imported": 2, "retried": 1,
+               "abandoned": 0},
+        inflight_attempts=5)
+    monitors.register_sync_books(sm, name="sync_books_neg")
+    fired = monitors.sweep()
+    assert [v["monitor"] for v in fired] == ["sync_books_neg"]
+
+
+def test_backfill_books_monitor():
+    bf = _Ledger(
+        books={"requested": 4, "imported": 2, "retried": 2,
+               "abandoned": 0},
+        inflight_attempts=0)
+    monitors.register_backfill_books(bf, name="backfill_books_t")
+    assert monitors.sweep() == []
+    bf.books["requested"] = 6
+    assert len(monitors.sweep()) == 1
+
+
+def test_dead_owner_reads_healthy():
+    import gc
+
+    sm = _Ledger(
+        books={"requested": 9, "imported": 0, "retried": 0,
+               "abandoned": 0},
+        inflight_attempts=0)
+    monitors.register_sync_books(sm, name="sync_books_dead")
+    del sm
+    gc.collect()
+    assert monitors.sweep() == []      # collected owner: books died too
+
+
+def test_pool_bound_monitor():
+    class _Pool(dict):
+        pass
+
+    pool = _Pool()
+    monitors.register_pool_bound(pool, capacity=2, name="pool_t")
+    pool[1] = pool[2] = "x"
+    assert monitors.sweep() == []
+    pool[3] = "overflow"
+    assert len(monitors.sweep()) == 1
+
+
+def test_real_drill_suite_stays_clean():
+    """Run the monitors across a real sync-manager-shaped ledger walk
+    (requested -> outcome per attempt) — the no-false-positives gate
+    over drill-style accounting."""
+    sm = _Ledger(
+        books={"requested": 0, "imported": 0, "retried": 0,
+               "abandoned": 0},
+        inflight_attempts=0)
+    monitors.register_sync_books(sm, name="sync_books_walk")
+    import random
+
+    rng = random.Random(7)
+    for _ in range(200):
+        sm.books["requested"] += 1
+        sm.inflight_attempts += 1
+        assert monitors.sweep() == []       # mid-attempt: tolerated
+        outcome = rng.choice(["imported", "retried", "abandoned"])
+        sm.books[outcome] += 1
+        sm.inflight_attempts -= 1
+        assert monitors.sweep() == []

@@ -68,6 +68,15 @@ LH604       unaccounted-sync-      abandoning a batch/chain/lookup (an
                                    without incrementing a sync_*_total/
                                    backfill_*_total metric
                                    (zero-unaccounted-abandons discipline)
+LH605       unrecorded-transition  a breaker state change or admission-
+                                   ladder rung change (``.state``/
+                                   ``.rung`` assignment, ``open_until``
+                                   store) in crypto/bls/api.py,
+                                   processor/admission.py or
+                                   state_transition/epoch_processing.py
+                                   that never emits a flight-recorder
+                                   event (the black box must carry every
+                                   transition that led up to a trip)
 LH801       int64-outside-x64      int64 jnp lane created / int64-lane
                                    program dispatched outside a scoped
                                    ``with enable_x64():`` (silent int32
@@ -226,8 +235,8 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     suppression-filtered findings (baseline NOT applied — that's the
     CLI/baseline layer's job)."""
     from tools.lint import (blocking_pass, envpass, exceptions_pass,
-                            fetch, locks, metrics_pass, numeric_pass,
-                            shapes, shed_pass, store_pass,
+                            fetch, flight_pass, locks, metrics_pass,
+                            numeric_pass, shapes, shed_pass, store_pass,
                             supervisor_pass, sync_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
@@ -236,7 +245,7 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
                      metrics_pass.run, supervisor_pass.run,
                      store_pass.run, shed_pass.run, sync_pass.run,
-                     numeric_pass.run, blocking_pass.run,
+                     flight_pass.run, numeric_pass.run, blocking_pass.run,
                      exceptions_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))

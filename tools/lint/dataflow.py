@@ -330,6 +330,13 @@ class _FunctionAnalyzer:
                 return AV(jitted=True, jit_of=target)
             if args and args[0].jitted:
                 return args[0]
+        # device_telemetry.instrument(entry, jitted_fn, ...) is a
+        # transparent telemetry wrapper: jitted-ness flows through it so
+        # dispatch sites behind the wrapper keep their LH601/LH811
+        # coverage and the manifest's x64_dispatch derivation
+        if dotted and dotted.rsplit(".", 1)[-1] == "instrument" \
+                and len(args) >= 2 and args[1].jitted:
+            return args[1]
 
         # dispatch of a known jitted callable:  fn(...)
         fn_av = None

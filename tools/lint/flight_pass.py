@@ -1,0 +1,169 @@
+"""Pass 13 — recorded breaker/ladder transitions (LH605).
+
+The flight recorder's whole value is that a trip dump contains the
+TRANSITIONS that led up to it — which is only true if every breaker
+state change and admission-ladder rung change actually emits a
+flight-recorder event.  A new transition path added without its emit
+silently punches a hole in the black box: the next production incident
+dumps a ring with the decisive state change missing.
+
+This pass scans the breaker/ladder modules (``crypto/bls/api.py``,
+``processor/admission.py``, ``state_transition/epoch_processing.py``)
+for *transition sites*:
+
+- an assignment to an attribute named ``state`` or ``rung`` (the
+  circuit-breaker / ladder state machines), or
+- a subscript store under the constant key ``"open_until"`` (the epoch
+  breaker's open transition).
+
+The enclosing function must *record* the transition: contain a
+flight-recorder emit — a ``.emit(...)`` / ``.trip(...)`` call on a
+receiver whose dotted name mentions ``flight`` (``flight.emit``,
+``flight_recorder.RECORDER.trip``, ...) — or call a helper function
+(collected package-wide by name) whose own body carries one.
+``__init__``/``reset*`` functions are exempt (initialization is not a
+transition).  Deliberate unrecorded transitions carry
+``# lhlint: allow(LH605)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint import Context, Finding
+
+TARGET_MODULES = ("crypto/bls/api.py", "processor/admission.py",
+                  "state_transition/epoch_processing.py")
+
+_STATE_ATTRS = {"state", "rung"}
+_STATE_KEYS = {"open_until"}
+_EXEMPT_FN = re.compile(r"^(__init__|reset)")
+
+
+def _terminal_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_flight_emit(call: ast.Call) -> bool:
+    """``<something mentioning flight>.emit(...)`` / ``.trip(...)``."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in ("emit",
+                                                                "trip"):
+        return False
+    return "flight" in _dotted(func.value).lower()
+
+
+def _emitting_helper_names(ctx: Context) -> set[str]:
+    """Bare names of functions (package-wide) whose body contains a
+    flight-recorder emit — funneling a transition through one counts."""
+    names: set[str] = set()
+    for module in ctx.modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(isinstance(sub, ast.Call) and _is_flight_emit(sub)
+                   for sub in ast.walk(node)):
+                names.add(node.name)
+    return names
+
+
+def _records(fn: ast.AST, helpers: set[str]) -> bool:
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        if _is_flight_emit(sub):
+            return True
+        name = _terminal_name(sub.func)
+        if name is not None and name in helpers:
+            return True
+    return False
+
+
+def _transition_sites(fn: ast.AST) -> list[tuple[int, str, str]]:
+    """(line, description, symbol) per transition site inside ``fn``
+    (not descending into nested function definitions)."""
+    sites: list[tuple[int, str, str]] = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (child.targets if isinstance(child, ast.Assign)
+                           else [child.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr in _STATE_ATTRS:
+                        sites.append((child.lineno,
+                                      f"`.{tgt.attr}` assignment",
+                                      f"set_{tgt.attr}"))
+                    if isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.slice, ast.Constant) \
+                            and tgt.slice.value in _STATE_KEYS:
+                        sites.append((child.lineno,
+                                      f'`["{tgt.slice.value}"]` store',
+                                      f"set_{tgt.slice.value}"))
+            visit(child)
+
+    visit(fn)
+    return sites
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    helpers = _emitting_helper_names(ctx)
+    for module in ctx.modules:
+        if module.pkg_rel not in TARGET_MODULES:
+            continue
+        findings.extend(_scan_module(ctx, module, helpers))
+    return findings
+
+
+def _scan_module(ctx: Context, module, helpers: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node, stack: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                if not _EXEMPT_FN.match(child.name):
+                    sites = _transition_sites(child)
+                    if sites and not _records(child, helpers):
+                        for line, what, symbol in sites:
+                            if ctx.suppressed(module, "LH605",
+                                              "unrecorded-transition",
+                                              line):
+                                continue
+                            findings.append(Finding(
+                                "LH605", "unrecorded-transition",
+                                module.rel, line, f"{qual}:{symbol}",
+                                f"`{qual}` changes breaker/ladder state "
+                                f"({what}) without a flight-recorder "
+                                f"event — emit through "
+                                f"flight_recorder.emit/trip (or a "
+                                f"funnel helper) or waive with "
+                                f"`# lhlint: allow(LH605)`"))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(module.tree, [])
+    return findings

@@ -57,6 +57,16 @@ FAMILY_OWNERS = {
     # device epoch pass: the backend seam owns the family; epoch_device /
     # phase0_epoch / shuffle record through its helpers
     "epoch_": "lighthouse_tpu/state_transition/epoch_processing.py",
+    # the observatory plane (PR 11): each subsystem owns its families —
+    # flight events/trips, manifest-keyed jit telemetry + the cold-start
+    # headline, SLO scoring, invariant breaches, and the shared
+    # bounded-structure eviction counter
+    "flight_": "lighthouse_tpu/common/flight_recorder.py",
+    "jit_": "lighthouse_tpu/common/device_telemetry.py",
+    "time_to_first_verify": "lighthouse_tpu/common/device_telemetry.py",
+    "slo_": "lighthouse_tpu/chain/slo.py",
+    "invariant_": "lighthouse_tpu/common/monitors.py",
+    "tracing_evicted": "lighthouse_tpu/common/metrics.py",
 }
 
 
@@ -90,6 +100,20 @@ def _scan_tree(rel: str, tree, regs, errors) -> None:
         if not NAME_RE.match(name):
             errors.append(f"{loc}: invalid metric name {name!r} "
                           "(must match [a-z][a-z0-9_]*)")
+        # exposition conformance: every registration carries a HELP
+        # string (a literal or literal concatenation as the second
+        # positional or help_= keyword) so # HELP lines are never empty
+        help_arg = None
+        if len(node.args) >= 2:
+            help_arg = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "help_":
+                    help_arg = kw.value
+        if help_arg is None or (isinstance(help_arg, ast.Constant)
+                                and not help_arg.value):
+            errors.append(f"{loc}: {name!r} registered without a help "
+                          "string — scrape output needs its # HELP line")
         regs.setdefault(name, set()).add((func.attr, rel))
 
 
