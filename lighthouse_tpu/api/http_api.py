@@ -1474,14 +1474,19 @@ class BeaconApi:
 
     def observatory_jit(self, body=None):
         """Manifest-keyed device-runtime telemetry: per-entry compile/
-        dispatch stats, manifest coverage, and the per-backend
-        time_to_first_verify cold-start headline."""
+        dispatch stats (including the serving ``source`` —
+        store_hit/compiled/jit), manifest coverage, the per-backend
+        time_to_first_verify cold-start headline, and the AOT program
+        store's live state."""
         from lighthouse_tpu.common import device_telemetry as dtel
+        from lighthouse_tpu.ops import program_store
 
         return {"data": {
             "coverage": dtel.coverage(),
             "entries": dtel.snapshot(),
             "time_to_first_verify_s": dtel.first_verify_times(),
+            "aot_store": {**program_store.status(),
+                          "memo": program_store.memo_stats()},
         }}
 
 

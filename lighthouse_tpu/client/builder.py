@@ -431,6 +431,39 @@ class ClientBuilder:
             self.log.info("invariant watchdog sweeping",
                           monitors=",".join(_monitors.MONITORS.names()))
 
+        # the persistent AOT program store: stored executables serve
+        # every jit entry's first dispatch (source=store_hit) and the
+        # background prewarmer compiles the misses while the PR 4/PR 6
+        # ladders keep serving on the reference backends.  Directory:
+        # LHTPU_AOT_STORE_DIR, defaulting to <datadir>/aot_programs for
+        # a durable node; LHTPU_AOT_STORE=0 kills the whole plane.
+        import os
+
+        from lighthouse_tpu.common import env as _envreg
+        from lighthouse_tpu.ops import program_store as _pstore
+
+        aot_dir = _envreg.get("LHTPU_AOT_STORE_DIR") or (
+            os.path.join(self.config.datadir, "aot_programs")
+            if self.config.datadir else None)
+        aot_store = _pstore.configure(aot_dir) if aot_dir else None
+        if aot_store is not None:
+            self.log.info("aot program store armed", dir=str(aot_dir))
+
+            from lighthouse_tpu.ops import prewarm as _prewarm
+
+            def _prewarm_task(exit_event):
+                report = _prewarm.run(stop_event=exit_event)
+                if report.get("ran"):
+                    self.log.info(
+                        "aot prewarm complete",
+                        **{k: v for k, v in report["counts"].items() if v},
+                        seconds=report["seconds"], scale=report["scale"])
+                elif report.get("skipped"):
+                    self.log.info("aot prewarm skipped",
+                                  reason=report["skipped"])
+
+            self.executor.spawn(_prewarm_task, "aot-prewarm")
+
         if self.config.listen_port is not None:
             self._wire_network(client)
 

@@ -33,6 +33,19 @@ propagates jitted-ness through it, so the dispatch-discipline passes
 cost is two ``perf_counter`` reads, one ``_cache_size`` probe and
 memoized counter increments — noise next to a host<->device crossing.
 
+The wrapper is also the AOT program store's serving seam: when
+ops/program_store is configured it installs a dispatch hook
+(:func:`set_aot_dispatcher`) consulted before the plain ``jax.jit``
+call, and every dispatch carries a ``source`` label —
+``jit_dispatch_source_total{entry,source}`` with ``store_hit``
+(deserialized from the persistent store), ``compiled`` (AOT-compiled
+and committed this process) or ``jit`` (store inactive/bypassed) — so
+the observatory shows exactly where cold-start time went.  Batches the
+health ladder recovers onto the CPU path appear as
+``time_to_first_verify_seconds{backend="reference"}`` /
+``served=reference`` trace attrs, not as a jit source (no jit entry
+dispatches there).
+
 This module never imports jax: it wraps callables handed to it.
 """
 
@@ -58,6 +71,19 @@ _DISPATCH_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
 _LOCK = threading.Lock()
 _ENTRIES: dict[str, dict] = {}
 _FIRST_VERIFY: dict[str, float] = {}
+
+#: the AOT program store's dispatch hook (ops/program_store installs it
+#: at configure time): (entry, fn, args, kwargs) -> (out, source,
+#: compiled_now) or None = "use the plain jax.jit path".  None (the
+#: default) keeps the wrapper byte-for-byte on its PR 11 path.
+_AOT_DISPATCH = None
+
+
+def set_aot_dispatcher(fn) -> None:
+    """Install (or with None, remove) the AOT program-store dispatch
+    hook consulted before every instrumented jit call."""
+    global _AOT_DISPATCH
+    _AOT_DISPATCH = fn
 
 
 def _manifest_path() -> pathlib.Path:
@@ -117,17 +143,41 @@ class _Instrumented:
         for a in args:
             if a.__class__.__name__.endswith("Tracer"):
                 return self._fn(*args, **kwargs)
-        before = self._cache_size()
-        t0 = time.perf_counter()
-        out = self._fn(*args, **kwargs)
-        wall = time.perf_counter() - t0
+        # AOT program store first: a loaded program serves the call as
+        # source=store_hit/compiled; any miss or failure falls through
+        # to the plain jax.jit path (source=jit)
+        aot = _AOT_DISPATCH
+        served = None
+        if aot is not None:
+            t0 = time.perf_counter()
+            try:
+                served = aot(self._entry, self._fn, args, kwargs)
+            except Exception as e:
+                record_swallowed("device_telemetry.aot", e)
+        if served is not None:
+            out, source, compiled = served
+            wall = time.perf_counter() - t0
+        else:
+            before = self._cache_size()
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            wall = time.perf_counter() - t0
+            source = "jit"
+            compiled = None
         try:
-            after = self._cache_size()
+            # reset() replaces the per-entry stats dict; a module-level
+            # wrapper created before the reset must not keep recording
+            # into the detached one (snapshot()/coverage() would go
+            # blind on exactly the entries the store serves)
+            if _ENTRIES.get(self._entry) is not self._stats:
+                self._stats = _entry_stats(self._entry)
             bucket = self._static_bucket or _shape_label(args)
-            compiled = (after > before if after is not None
-                        else bucket not in self._stats["buckets"])
+            if compiled is None:
+                after = self._cache_size()
+                compiled = (after > before if after is not None
+                            else bucket not in self._stats["buckets"])
             _record_dispatch(self._entry, self._stats, bucket, wall,
-                             compiled, self._memo)
+                             compiled, self._memo, source)
         except Exception as e:
             record_swallowed("device_telemetry.record", e)
         return out
@@ -157,6 +207,7 @@ def _entry_stats(entry: str) -> dict:
                 "buckets": {},          # bucket -> {dispatches, compiles}
                 "dispatches": 0,
                 "compiles": 0,
+                "sources": {},          # store_hit/compiled/jit -> count
                 "first_dispatch_unix": None,
                 "first_dispatch_rel_s": None,
                 "dispatch_s_total": 0.0,
@@ -165,13 +216,15 @@ def _entry_stats(entry: str) -> dict:
 
 
 def _record_dispatch(entry: str, st: dict, bucket: str, wall: float,
-                     compiled: bool, memo: dict) -> None:
+                     compiled: bool, memo: dict,
+                     source: str = "jit") -> None:
     with _LOCK:
         row = st["buckets"].setdefault(bucket,
                                        {"dispatches": 0, "compiles": 0})
         row["dispatches"] += 1
         st["dispatches"] += 1
         st["dispatch_s_total"] += wall
+        st["sources"][source] = st["sources"].get(source, 0) + 1
         if compiled:
             row["compiles"] += 1
             st["compiles"] += 1
@@ -185,6 +238,16 @@ def _record_dispatch(entry: str, st: dict, bucket: str, wall: float,
             "jit_dispatch_total",
             "jit entry-point dispatches by manifest entry and shape "
             "bucket").labels(entry=entry, bucket=bucket)
+    child.inc()
+    child = memo.get(("source", source))
+    if child is None:
+        child = memo[("source", source)] = REGISTRY.counter(
+            "jit_dispatch_source_total",
+            "jit entry-point dispatches by serving source: store_hit "
+            "(AOT program loaded from the persistent store), compiled "
+            "(AOT-compiled and committed this process), jit (plain "
+            "jax.jit dispatch, store inactive or bypassed)",
+        ).labels(entry=entry, source=source)
     child.inc()
     outcome = "miss" if compiled else "hit"
     child = memo.get(("cache", outcome))
@@ -261,8 +324,10 @@ def first_verify_times() -> dict[str, float]:
 def snapshot() -> dict[str, dict]:
     """{entry id: stats} for every entry that has dispatched."""
     with _LOCK:
-        return {e: {**st, "buckets": {b: dict(r) for b, r
-                                      in st["buckets"].items()}}
+        return {e: {**st,
+                    "buckets": {b: dict(r) for b, r
+                                in st["buckets"].items()},
+                    "sources": dict(st["sources"])}
                 for e, st in _ENTRIES.items()}
 
 

@@ -314,6 +314,28 @@ _register("LHTPU_SLO_RESERVOIR", "1024",
           "Per-stage latency samples kept for the p50/p99/p999 "
           "quantile surface (bounded reservoir, newest-wins).")
 
+# -- the persistent AOT program store + prewarmer (ops/program_store,
+#    ops/prewarm, bench --child-coldstart) ------------------------------------
+
+_register("LHTPU_AOT_STORE", "1",
+          "0 kills the AOT program store entirely: no stored program "
+          "is consulted, no compiled program is committed, the "
+          "prewarmer never starts.")
+_register("LHTPU_AOT_STORE_DIR", None,
+          "Directory the serialized AOT executables (and the sha256 "
+          "calibration record) persist in; unset disables the store "
+          "(the client builder defaults it to <datadir>/aot_programs).")
+_register("LHTPU_AOT_PREWARM", "auto",
+          "Background startup prewarmer: 1 always runs it, 0 never, "
+          "auto runs it on TPU platforms or when LHTPU_AOT_STORE_DIR "
+          "is set explicitly (stored programs still serve lazily on "
+          "first dispatch either way).")
+_register("LHTPU_AOT_PREWARM_SCALE", "auto",
+          "Prewarm driver workload scale (tiny|production|auto): auto "
+          "= production shape buckets on TPU platforms, tiny on the "
+          "XLA-CPU fallback (where production-width compiles cost "
+          "minutes each).")
+
 
 # -- typed readers ------------------------------------------------------------
 

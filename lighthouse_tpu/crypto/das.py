@@ -22,6 +22,13 @@ for these butterflies when DAS hits the hot path).
 
 from __future__ import annotations
 
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the chunked cell-proof MSM
+# is prewarmed by the "das" driver in ops/prewarm
+_pstore.register_entry("crypto/das.py::_batched_cell_proof_msms@_f",
+                       driver="das")
+
 from lighthouse_tpu.crypto.kzg import (
     BLS_MODULUS,
     KzgError,

@@ -32,6 +32,14 @@ import numpy as np
 
 from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.crypto.bls import curve as cv
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the MSM and fused
+# verification programs are prewarmed by the "kzg" driver in ops/prewarm
+_pstore.register_entry("crypto/kzg.py::_msm_device@ec.g1_msm_windowed",
+                       driver="kzg")
+_pstore.register_entry("crypto/kzg.py::_kzg_fused_check@_kzg_fused",
+                       driver="kzg")
 from lighthouse_tpu.crypto.bls.fields import R as BLS_MODULUS
 
 BYTES_PER_FIELD_ELEMENT = 32

@@ -28,6 +28,12 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.ops import bigint as bi
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the multi-pairing reduce
+# is prewarmed by the "pairing" driver in ops/prewarm
+_pstore.register_entry("ops/bls12_381.py::_miller_reduce_jit@run",
+                       driver="pairing")
 
 # --- Fp2 -------------------------------------------------------------------
 # element: (a, b) = a + b·u, each uint32[..., 27]

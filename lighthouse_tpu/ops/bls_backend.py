@@ -38,6 +38,22 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.crypto.bls import api, curve as cv
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the fused verify plane is
+# prewarmed by the "bls" driver, the final-exp ladder by "pairing"
+_pstore.register_entry("ops/bls_backend.py::_pipeline_fused@_pipeline_fused",
+                       driver="bls")
+_pstore.register_entry(
+    "ops/bls_backend.py::_g2_subgroup_kernel@_g2_subgroup_kernel",
+    driver="bls")
+_pstore.register_entry(
+    "ops/bls_backend.py::_g1_subgroup_kernel@_g1_subgroup_kernel",
+    driver="bls")
+_pstore.register_entry(
+    "ops/bls_backend.py::_aggregate_kernel@_aggregate_kernel", driver="bls")
+_pstore.register_entry("ops/bls_backend.py::<module>@final_exp_hard_device",
+                       driver="pairing")
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import cache_guard
 from lighthouse_tpu.ops import ec

@@ -37,7 +37,13 @@ from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.ops import faults
+from lighthouse_tpu.ops import program_store as _pstore
 from lighthouse_tpu.ops.bls12_381 import _fp12_mul_q
+
+# AOT program-store coverage (lhlint LH606): the chunk-combine kernel
+# is prewarmed by the "pairing" driver in ops/prewarm
+_pstore.register_entry("ops/dispatch_pipeline.py::<module>@_fp12_mul_q",
+                       driver="pairing")
 
 # default split point: batches at or below this verify single-shot (the
 # pre-chunking path, one fused dispatch); larger batches split so host

@@ -57,6 +57,15 @@ import jax.numpy as jnp
 from jax.experimental import enable_x64
 
 from lighthouse_tpu.common import device_telemetry as _dtel
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the fused epoch pass and
+# the device shuffle are prewarmed by their ops/prewarm drivers
+_pstore.register_entry(
+    "ops/epoch_kernels.py::_epoch_pass_jit@_fused_epoch_pass",
+    driver="epoch")
+_pstore.register_entry("ops/epoch_kernels.py::_shuffle_jit@_shuffle_rounds",
+                       driver="shuffle")
 
 TIMELY_SOURCE_FLAG_INDEX = 0
 TIMELY_TARGET_FLAG_INDEX = 1

@@ -28,6 +28,12 @@ import jax
 import jax.numpy as jnp
 
 from lighthouse_tpu.common import device_telemetry as _dtel
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the barycentric-eval plane
+# is prewarmed by the "fr" driver in ops/prewarm
+_pstore.register_entry("ops/fr.py::_eval_kernel@_eval_kernel", driver="fr")
+_pstore.register_entry("ops/fr.py::<module>@<lambda>", driver="fr")
 
 R_INT = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 

@@ -33,6 +33,18 @@ import numpy as np
 
 from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the merkle hashers are
+# prewarmed by the "sha256" driver in ops/prewarm
+_pstore.register_entry("ops/sha256.py::sha256_block@sha256_block",
+                       driver="sha256")
+_pstore.register_entry("ops/sha256.py::hash_pairs_device@hash_pairs_device",
+                       driver="sha256")
+_pstore.register_entry(
+    "ops/sha256.py::_fold_levels_device@_fold_levels_device",
+    driver="sha256")
+_pstore.register_entry("ops/sha256.py::<module>@<lambda>", driver="sha256")
 
 # shapes whose whole-fold device program has already been dispatched in
 # this process: the first call at a shape pays tracing + XLA compile (or
@@ -485,6 +497,26 @@ def calibrate_device_thresholds(sample_pairs: int = 2048,
         "dispatch_overhead_ms": round(overhead_s * 1000, 3),
         "source": "measured",
     }
+
+
+def apply_calibration(data: dict) -> bool:
+    """Adopt a persisted calibration measurement (ops/program_store's
+    sidecar for this platform fingerprint) instead of re-measuring:
+    restart skips the micro-benchmark entirely.  Returns False — and
+    changes nothing — when the record does not carry a usable
+    threshold, so a damaged sidecar falls back to measurement."""
+    global _DEVICE_MIN_PAIRS, _DEVICE_FOLD_MIN_LEAVES, _CALIBRATED
+    try:
+        threshold = int(data["threshold_pairs"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    if threshold < 1:
+        return False
+    _DEVICE_MIN_PAIRS = min(threshold, _THRESHOLD_CEIL)
+    _DEVICE_FOLD_MIN_LEAVES = min(2 * _DEVICE_MIN_PAIRS, _THRESHOLD_CEIL)
+    _CALIBRATED = True
+    _publish_threshold()
+    return True
 
 
 def _publish_threshold() -> None:

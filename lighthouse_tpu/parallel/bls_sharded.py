@@ -22,6 +22,13 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.ops import bls12_381 as dev
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the mesh Miller program is
+# prewarmed by the "sharded" driver in ops/prewarm
+_pstore.register_entry(
+    "parallel/bls_sharded.py::_sharded_miller_reduce@shard_map",
+    driver="sharded")
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import faults
 

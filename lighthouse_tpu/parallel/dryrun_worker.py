@@ -29,6 +29,13 @@ import os
 import sys
 import time
 
+from lighthouse_tpu.ops import program_store as _pstore
+
+# AOT program-store coverage (lhlint LH606): the dryrun's sharded
+# merkle fold is prewarmed by the "dryrun" driver in ops/prewarm
+_pstore.register_entry("parallel/dryrun_worker.py::_merkle_dryrun@sharded",
+                       driver="dryrun")
+
 
 def _merkle_dryrun(n_devices: int) -> None:
     import jax

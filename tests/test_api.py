@@ -125,6 +125,13 @@ def test_observatory_endpoints(api_setup):
                    fromlist=["STAGES"]).STAGES)
     jit = get("/lighthouse/observatory/jit")
     assert jit["coverage"]["manifest_entries"] == 20
+    # the AOT program store's live state + per-entry serving sources
+    # (PR 12): unconfigured here, but the surface must be present
+    assert jit["aot_store"]["enabled"] in (True, False)
+    assert "memo" in jit["aot_store"]
+    for st in jit["entries"].values():
+        assert set(st.get("sources", {})) <= {"store_hit", "compiled",
+                                              "jit"}
 
 
 class TestStandardApiBreadth:

@@ -234,10 +234,10 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     """Run every pass over the package rooted at ``pkg_root``; returns
     suppression-filtered findings (baseline NOT applied — that's the
     CLI/baseline layer's job)."""
-    from tools.lint import (blocking_pass, envpass, exceptions_pass,
-                            fetch, flight_pass, locks, metrics_pass,
-                            numeric_pass, shapes, shed_pass, store_pass,
-                            supervisor_pass, sync_pass)
+    from tools.lint import (aot_pass, blocking_pass, envpass,
+                            exceptions_pass, fetch, flight_pass, locks,
+                            metrics_pass, numeric_pass, shapes, shed_pass,
+                            store_pass, supervisor_pass, sync_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
@@ -245,8 +245,8 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     for pass_run in (locks.run, fetch.run, shapes.run, envpass.run,
                      metrics_pass.run, supervisor_pass.run,
                      store_pass.run, shed_pass.run, sync_pass.run,
-                     flight_pass.run, numeric_pass.run, blocking_pass.run,
-                     exceptions_pass.run):
+                     flight_pass.run, aot_pass.run, numeric_pass.run,
+                     blocking_pass.run, exceptions_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

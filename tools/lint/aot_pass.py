@@ -1,0 +1,72 @@
+"""Pass 14 — AOT program-store coverage (LH606).
+
+The persistent AOT program store (ops/program_store) kills jit warm-up
+only for the entries it knows about: the prewarmer walks
+``program_store.registered_entries()`` and the LH606 contract is that
+this registry covers the WHOLE shape manifest.  A new ``jax.jit``
+construction that lands without a ``register_entry`` call silently
+re-opens the cold-start hole — its first dispatch after every restart
+pays the full trace+lower+compile again and the coldstart bench's
+"every entry served as store_hit" gate quietly loses an entry.
+
+This pass rebuilds the shape manifest from the tree (the same builder
+``--manifest`` uses, so fixture trees work without a checked-in file)
+and requires, for every entry, a package-wide
+``register_entry("<entry id>", ...)`` call whose first argument is a
+string literal equal to the entry id.  Deliberately uncovered entries
+carry ``# lhlint: allow(LH606)`` on the jit construction line, with
+prose justification (the waiver-justification gate applies).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Context, Finding
+
+RULE = "LH606"
+NAME = "aot-store-coverage"
+
+
+def _registered_ids(ctx: Context) -> set[str]:
+    """Every string literal passed as the first argument to a
+    ``register_entry(...)`` call anywhere in the package."""
+    ids: set[str] = set()
+    for module in ctx.modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+            if name != "register_entry" or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str):
+                ids.add(first.value)
+    return ids
+
+
+def run(ctx: Context) -> list[Finding]:
+    from tools.lint import manifest as mf
+
+    registered = _registered_ids(ctx)
+    findings: list[Finding] = []
+    for entry in mf.build_manifest(ctx)["entries"]:
+        if entry["id"] in registered:
+            continue
+        module = ctx.by_pkg_rel.get(
+            entry["file"].split("/", 1)[-1] if "/" in entry["file"]
+            else entry["file"])
+        line = int(entry.get("line", 0) or 0)
+        if module is not None and ctx.suppressed(module, RULE, NAME, line):
+            continue
+        findings.append(Finding(
+            RULE, NAME, entry["file"], line, entry["id"],
+            f"jit entry {entry['id']} is not registered with the AOT "
+            f"program store loader (program_store.register_entry) — its "
+            f"first dispatch pays a full trace+compile after every "
+            f"restart; register it with a prewarm driver or waive with "
+            f"# lhlint: allow(LH606) and a justification"))
+    return findings

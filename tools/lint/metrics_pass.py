@@ -63,6 +63,10 @@ FAMILY_OWNERS = {
     # bounded-structure eviction counter
     "flight_": "lighthouse_tpu/common/flight_recorder.py",
     "jit_": "lighthouse_tpu/common/device_telemetry.py",
+    # the AOT program store (PR 12): store hits/misses/commits belong
+    # to the store, prewarm walk outcomes to the prewarmer
+    "aot_store_": "lighthouse_tpu/ops/program_store.py",
+    "aot_prewarm_": "lighthouse_tpu/ops/prewarm.py",
     "time_to_first_verify": "lighthouse_tpu/common/device_telemetry.py",
     "slo_": "lighthouse_tpu/chain/slo.py",
     "invariant_": "lighthouse_tpu/common/monitors.py",
