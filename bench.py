@@ -1885,6 +1885,298 @@ def _coldstart_phases(result: dict, phase, budget: int) -> dict:
     return result
 
 
+def _bench_fleetwatch() -> dict:
+    """ISSUE 13 acceptance drill: the fleet observatory end to end.
+
+    Four nodes on one fabric walk steady -> 2/2 partition -> heal, and
+    every observer claim is gated against ground truth the bench
+    computes independently:
+
+    - **overhead A/B** — the armed steady leg (chain-health detector +
+      fleet observer + flight recorder) must hold >= 95% of an
+      identical unarmed leg's slots/s;
+    - **split detection** — the induced 2/2 partition must appear in
+      the observer's head-equivalence classes within ONE slot;
+    - **reorg exactness** — every ``chain_reorg`` SSE event any node
+      publishes is re-derived from the bench's OWN per-slot ancestor
+      map (a slot-based two-pointer walk, deliberately a different
+      algorithm from the detector's index-based proto-array walk, and
+      immune to finality pruning): reported depth must match exactly,
+      and every losing-side node must have recorded its post-heal
+      reorg;
+    - **finality resumes** — the finalized epoch must advance past its
+      at-heal value, with the ``finality_stall`` trip having fired
+      during the stall and the ``deep_reorg`` trip during
+      reconvergence;
+    - **books exact** — the fleet-wide ledger roll-up accounts for
+      every event in every snapshot (zero unaccounted, network-wide);
+    - **causal timeline** — the merged node-labeled flight timeline
+      orders partition < split < heal < reorg/reconvergence.
+
+    Zero-XLA by design (fake BLS): the subject is observability and
+    protocol outcomes, not crypto throughput — the overhead ratio is
+    crypto-independent by construction (identical work in both legs).
+    """
+    import queue as _queue
+
+    from lighthouse_tpu.common import flight_recorder as flight
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.fork_choice.proto_array import NONE
+    from lighthouse_tpu.simulator import LocalNetwork, SimSummary
+
+    bls.set_backend("fake")
+    n_nodes = int(os.environ.get("LHTPU_FLEET_NODES", "4"))
+    n_nodes = max(2, n_nodes - n_nodes % 2)   # two equal halves
+    steady = int(os.environ.get("LHTPU_FLEET_STEADY_SLOTS", "34"))
+    part_slots = int(os.environ.get("LHTPU_FLEET_PARTITION_SLOTS", "12"))
+    heal_slots = int(os.environ.get("LHTPU_FLEET_HEAL_SLOTS", "26"))
+    n_vals = 8 * n_nodes
+
+    result: dict = {
+        "metric": "fleetwatch_slots_per_s", "unit": "slots/s",
+        "value": 0.0, "vs_baseline": 0.0, "stage": "built",
+        "fleetwatch_nodes": n_nodes,
+    }
+    _emit_partial(result)
+
+    def build() -> LocalNetwork:
+        return LocalNetwork(n_nodes=n_nodes, n_validators=n_vals,
+                            fork="altair")
+
+    def drive(net, start_slot, n_slots):
+        """Explicit slot numbers: a failed proposal must cost liveness,
+        never stall the driver (run_slots derives the next slot from
+        head state, which a fully-partitioned slot would not advance)."""
+        summary = SimSummary()
+        for slot in range(start_slot, start_slot + n_slots):
+            net.run_slot(slot, summary)
+        return summary
+
+    # -- phase 0: throwaway warm-up so neither A/B leg pays first-run
+    # process-wide costs (ssz type interning, code paths)
+    warm = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+    drive(warm, 1, 6)
+    del warm
+    result["stage"] = "warmed"
+    _emit_partial(result)
+
+    # -- phase 1: unarmed A/B leg ------------------------------------------
+    os.environ["LHTPU_OBS_ARMED"] = "0"
+    flight.RECORDER.reconfigure()
+    try:
+        net_u = build()
+        t0 = time.monotonic()
+        drive(net_u, 1, steady)
+        rate_unarmed = steady / max(time.monotonic() - t0, 1e-9)
+        assert net_u.heads_agree(), "unarmed leg diverged"
+        assert net_u.observer.snapshot(steady) is None, \
+            "observer not disarmed by LHTPU_OBS_ARMED=0"
+    finally:
+        os.environ.pop("LHTPU_OBS_ARMED", None)
+        flight.RECORDER.reconfigure()
+    del net_u
+    result.update(stage="unarmed",
+                  fleetwatch_unarmed_slots_s=round(rate_unarmed, 2))
+    _emit_partial(result)
+
+    # -- phase 2: armed steady leg ------------------------------------------
+    net = build()
+    subs = {n.name: n.chain.events.subscribe(["chain_reorg"])
+            for n in net.nodes}
+    reorg_events: dict = {n.name: [] for n in net.nodes}
+    # the bench's OWN ancestor map: root -> (parent or None, slot),
+    # accumulated every slot so finality pruning can never erase the
+    # ground truth the exactness gate replays against
+    parent_map: dict = {}
+
+    def record_tree():
+        for node in net.nodes:
+            p = node.chain.fork_choice.proto
+            for i in range(p.n_nodes):
+                r = p.roots[i]
+                if r not in parent_map:
+                    par = int(p.parents[i])
+                    parent_map[r] = (p.roots[par] if par != NONE else None,
+                                     int(p.slots[i]))
+
+    def drain_events():
+        for name, q in subs.items():
+            while True:
+                try:
+                    _topic, data = q.get_nowait()
+                except _queue.Empty:
+                    break
+                reorg_events[name].append(data)
+
+    def hand_depth(old_hex: str, new_hex: str):
+        """Slot-based two-pointer common-ancestor walk over the bench's
+        accumulated map; returns the reference-semantics reorg depth
+        (old head slot - fork point slot) or None when unwalkable."""
+        a = bytes.fromhex(old_hex[2:])
+        b = bytes.fromhex(new_hex[2:])
+        if a not in parent_map or b not in parent_map:
+            return None
+        old_slot = parent_map[a][1]
+        while a != b:
+            sa, sb = parent_map[a][1], parent_map[b][1]
+            if sa >= sb:
+                a = parent_map[a][0]
+            if sb >= sa:
+                b = parent_map[b][0]
+            if a is None or b is None or a not in parent_map \
+                    or b not in parent_map:
+                return None
+        return old_slot - parent_map[a][1]
+
+    def drive_observed(start_slot, n_slots):
+        summary = SimSummary()
+        for slot in range(start_slot, start_slot + n_slots):
+            net.run_slot(slot, summary)
+            record_tree()
+        return summary
+
+    record_tree()
+    t0 = time.monotonic()
+    drive_observed(1, steady)
+    rate_armed = steady / max(time.monotonic() - t0, 1e-9)
+    overhead = rate_armed / max(rate_unarmed, 1e-9)
+    fin_steady = net.finalized_epoch()
+    assert net.heads_agree(), "armed steady leg diverged"
+    assert fin_steady >= 2, \
+        f"no finality in the steady phase (finalized={fin_steady})"
+    assert len(net.observer.snapshots) == steady, "observer missed slots"
+    assert net.observer.first_split_slot is None, \
+        "phantom split in the steady phase"
+    assert overhead >= 0.95, \
+        f"observatory overhead gate: armed/unarmed = {overhead:.3f} < 0.95"
+    result.update(
+        stage="steady", value=round(rate_armed, 2),
+        vs_baseline=round(overhead, 3),
+        fleetwatch_overhead_ratio=round(overhead, 3),
+        fleetwatch_steady_finalized=fin_steady)
+    _emit_partial(result)
+
+    # -- phase 3: the 2/2 partition ----------------------------------------
+    half = n_nodes // 2
+    part_at = steady
+    severed = net.partition(range(half), range(half, n_nodes))
+    drive_observed(part_at + 1, part_slots)
+    drain_events()
+    snap = net.observer.snapshots[-1]
+    assert net.observer.first_split_slot is not None \
+        and net.observer.first_split_slot <= part_at + 1, \
+        f"split not detected within one slot " \
+        f"(induced after {part_at}, seen {net.observer.first_split_slot})"
+    assert len(snap.classes) == 2, \
+        f"expected a 2-way split, observed {len(snap.classes)} classes"
+    # per-class liveness: both sides kept building through the split
+    for root, names in snap.classes.items():
+        side_slot = max(
+            int(n.chain.head_state.slot) for n in net.nodes
+            if n.name in names)
+        assert side_slot > part_at, f"side {names} stalled at {side_slot}"
+    pre_heal_heads = {n.name: n.chain.head_root for n in net.nodes}
+    pre_heal_reorgs = {name: len(evs) for name, evs in reorg_events.items()}
+    fin_at_heal = net.finalized_epoch()
+    result.update(stage="partitioned", fleetwatch_severed_pairs=severed,
+                  fleetwatch_split_slot=net.observer.first_split_slot)
+    _emit_partial(result)
+
+    # -- phase 4: heal + reconvergence forensics ---------------------------
+    net.heal()
+    drive_observed(part_at + part_slots + 1, heal_slots)
+    drain_events()
+    assert net.heads_agree(), "fleet failed to reconverge after heal"
+    assert net.observer.reconverged_slot is not None, \
+        "observer missed the reconvergence edge"
+    fin_final = net.finalized_epoch()
+    assert fin_final > fin_at_heal, \
+        f"finality did not resume (stuck at {fin_final})"
+
+    # reorg exactness: every event every node published, re-derived
+    checked = 0
+    for name, events in reorg_events.items():
+        for ev in events:
+            expected = hand_depth(ev["old_head_block"], ev["new_head_block"])
+            assert expected is not None, \
+                f"{name}: reorg roots missing from the ground-truth map"
+            assert int(ev["depth"]) == expected, \
+                f"{name}: reported depth {ev['depth']} != " \
+                f"hand-walked {expected}"
+            checked += 1
+    # losing side: nodes whose pre-heal head is NOT on the final chain
+    # must each have recorded the post-heal reorg
+    final_head = net.nodes[0].chain.head_root
+    final_chain = set()
+    r = final_head
+    while r is not None and r in parent_map:
+        final_chain.add(r)
+        r = parent_map[r][0]
+    losers = [name for name, head in pre_heal_heads.items()
+              if head not in final_chain]
+    assert losers, "no losing side — the partition produced no fork"
+    for name in losers:
+        assert len(reorg_events[name]) > pre_heal_reorgs[name], \
+            f"losing-side {name} never recorded its post-heal reorg"
+
+    # fleet books: zero unaccounted events across ALL nodes, every slot
+    worst_unaccounted = max(s.unaccounted for s in net.observer.snapshots)
+    assert worst_unaccounted == 0, \
+        f"fleet books leak: unaccounted={worst_unaccounted}"
+
+    # the merged node-labeled causal timeline + the two new trips
+    timeline = net.observer.timeline()
+    seq_of = {}
+    for e in timeline:
+        seq_of.setdefault(e["kind"], e["seq"])   # first occurrence
+    for kind in ("fleet_partition", "fleet_split", "fleet_heal",
+                 "chain_reorg", "fleet_reconverged"):
+        assert kind in seq_of, f"timeline missing {kind}"
+    assert seq_of["fleet_partition"] < seq_of["fleet_split"], \
+        "split observed before the partition was induced"
+    assert seq_of["fleet_split"] < seq_of["fleet_heal"] \
+        < seq_of["fleet_reconverged"], "timeline out of causal order"
+    trip_reasons = {e.get("reason") for e in timeline
+                    if e["kind"] == "trip"}
+    assert "deep_reorg" in trip_reasons, "deep_reorg trip never fired"
+    assert "finality_stall" in trip_reasons, \
+        "finality_stall trip never fired"
+    reorg_nodes = {e.get("node") for e in timeline
+                   if e["kind"] == "chain_reorg"}
+    assert set(losers) <= reorg_nodes, \
+        "timeline missing a losing-side node's reorg event"
+
+    health = {n.name: n.chain.chain_health.status() for n in net.nodes}
+    result.update({
+        "stage": "done",
+        "fleetwatch_reconverged_slot": net.observer.reconverged_slot,
+        "fleetwatch_finalized_final": fin_final,
+        "fleetwatch_finality_at_heal": fin_at_heal,
+        "fleetwatch_reorgs_checked": checked,
+        "fleetwatch_losing_side": sorted(losers),
+        "fleetwatch_max_reorg_depth": max(
+            h["reorgs"]["max_depth"] for h in health.values()),
+        "fleetwatch_unaccounted": worst_unaccounted,
+        "stages": {"fleetwatch": {
+            "overhead": {"armed_slots_s": round(rate_armed, 2),
+                         "unarmed_slots_s": round(rate_unarmed, 2),
+                         "ratio": round(overhead, 3)},
+            "partition": {"severed_pairs": severed,
+                          "split_slot": net.observer.first_split_slot,
+                          "held_slots": part_slots},
+            "heal": {"reconverged_slot": net.observer.reconverged_slot,
+                     "finalized": [fin_at_heal, fin_final],
+                     "reorg_events": {k: len(v)
+                                      for k, v in reorg_events.items()},
+                     "reorgs_depth_checked": checked},
+            "books": {"worst_unaccounted": worst_unaccounted,
+                      "total": net.observer.snapshots[-1].books["total"]},
+        }},
+    })
+    result.pop("stage", None)
+    return result
+
+
 def _child_main() -> int:
     if "--child-probe" in sys.argv:
         import jax
@@ -1908,6 +2200,8 @@ def _child_main() -> int:
         result = _bench_slasher()
     elif "--child-syncstorm" in sys.argv:
         result = _bench_syncstorm()
+    elif "--child-fleetwatch" in sys.argv:
+        result = _bench_fleetwatch()
     elif "--child-observatory" in sys.argv:
         result = _bench_observatory()
     elif "--child-coldstart-run" in sys.argv:
@@ -1981,8 +2275,8 @@ _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-probe", "--child-stateroot", "--child-flood",
                 "--child-blockverify", "--child-slasher", "--child-epoch",
                 "--child-firehose", "--child-syncstorm",
-                "--child-observatory", "--child-coldstart",
-                "--child-coldstart-run")
+                "--child-fleetwatch", "--child-observatory",
+                "--child-coldstart", "--child-coldstart-run")
 
 
 def main() -> int:
@@ -2060,6 +2354,11 @@ def main() -> int:
                 ("--child-firehose", "firehose", None),
                 ("--child-syncstorm", "syncstorm",
                  min(300, CHILD_TIMEOUT_S)),
+                # 4 nodes x ~100 slots of real state transitions (the
+                # A/B legs run the steady phase twice) — zero-XLA but
+                # wall-clock heavy on CPU
+                ("--child-fleetwatch", "fleetwatch",
+                 max(900, CHILD_TIMEOUT_S)),
                 # the manifest tour compiles every jit entry cold (the
                 # CPU write-guard keeps the big programs out of the
                 # persistent cache), so this child gets a bigger budget

@@ -143,6 +143,7 @@ class BeaconApi:
         r("GET", r"/lighthouse/health", self.lighthouse_health)
         r("GET", r"/lighthouse/tracing", self.tracing_slots)
         r("GET", r"/lighthouse/tracing/(?P<slot>-?\d+)", self.tracing_slot)
+        r("GET", r"/lighthouse/observatory/chain", self.observatory_chain)
         r("GET", r"/lighthouse/observatory/flight", self.observatory_flight)
         r("GET", r"/lighthouse/observatory/slo", self.observatory_slo)
         r("GET", r"/lighthouse/observatory/jit", self.observatory_jit)
@@ -1457,6 +1458,12 @@ class BeaconApi:
         if timeline is None:
             raise ApiError(404, f"no timeline recorded for slot {slot}")
         return {"data": timeline}
+
+    def observatory_chain(self, body=None):
+        """The chain-health detector's live state: reorg forensics
+        (counts, depth buckets, last classified move), head/finality
+        lag, participation, and the trip thresholds."""
+        return {"data": self.chain.chain_health.status()}
 
     def observatory_flight(self, body=None):
         """The flight recorder's black box: the last trip dump (if a

@@ -27,10 +27,12 @@ from lighthouse_tpu.chain.caches import (
     StateCache,
     ValidatorPubkeyCache,
 )
+from lighthouse_tpu.chain.chain_health import ChainHealthMonitor
 
 __all__ = [
     "BeaconChain",
     "BlockError",
+    "ChainHealthMonitor",
     "AttestationError",
     "VerifiedAttestation",
     "GossipVerifiedBlock",

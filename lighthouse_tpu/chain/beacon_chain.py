@@ -111,11 +111,15 @@ class BeaconChain:
         self.slasher = None  # attach a SlasherService to enable slashing detection
         self.eth1_service = None  # attach an Eth1Service for eth1data voting
         self.state_advance_timer = None  # StateAdvanceTimer.install()
+        from lighthouse_tpu.chain.chain_health import ChainHealthMonitor
         from lighthouse_tpu.chain.events import EventStream
         from lighthouse_tpu.chain.light_client import LightClientServerCache
         from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
 
         self.events = EventStream()
+        # reorg forensics + head/finality lag tracking; every head move
+        # in recompute_head runs through its common-ancestor classifier
+        self.chain_health = ChainHealthMonitor(self)
         self.validator_monitor = ValidatorMonitor()
         self.light_client = LightClientServerCache(self)
         self._pending_executed: dict[bytes, object] = {}
@@ -533,10 +537,17 @@ class BeaconChain:
         if head != self.head_root:
             st = self.state_for_block(head)
             if st is not None:
+                old_head_root = self.head_root
                 old_head_state = self.head_state
                 self.head_root = head
                 self.head_state = st
                 self.store.persist_head(head)
+                try:
+                    # extension-vs-reorg classification, chain_reorg SSE,
+                    # deep_reorg trip — never blocks the head update
+                    self.chain_health.on_head_update(old_head_root, head)
+                except Exception as e:
+                    record_swallowed("chain.chain_health", e)
                 self.events.publish("head", {
                     "slot": str(int(st.slot)), "block": "0x" + head.hex(),
                     "state": "0x" + bytes(

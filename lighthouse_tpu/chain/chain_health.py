@@ -1,0 +1,381 @@
+"""Per-node chain-health detector: reorg forensics + lag tracking.
+
+Ten PRs of hardening gave every *subsystem* books and breakers, but the
+protocol-level outcomes a consensus node is judged on — does the head
+track the slot clock, does finality advance, how often and how deeply
+does the canonical chain rewrite itself — were unmeasured: the
+simulator's health checks were a bare ``heads_agree()`` bool and a
+``min(finalized)``.  This module is the per-node half of the fleet
+observatory (the fleet half is :class:`simulator.FleetObserver`):
+
+- **Head-move classification.**  Every head update runs a
+  common-ancestor walk in the proto-array
+  (:meth:`ProtoArray.common_ancestor`): ``extension`` when the old head
+  is an ancestor of the new one, ``reorg`` otherwise — with the exact
+  ``depth`` (slots from the old head back to the fork point, the
+  reference ChainReorg semantics), ``distance`` (slots from the fork
+  point forward to the new head) and abandoned/adopted block counts.
+  Reorgs count into ``reorg_events_total{node,depth_bucket}``, publish
+  a reference-shaped ``chain_reorg`` SSE event (slot, depth, old/new
+  head block+state roots, epoch) and file a flight-recorder event.
+- **Lag gauges against the slot clock.**  ``head_lag_slots{node}`` and
+  ``finality_lag_epochs{node}`` update on every slot tick, plus an
+  effective-balance-weighted ``chain_participation_rate{node}`` gauge
+  for each completed epoch (altair+ previous-epoch TIMELY_TARGET
+  flags — the quantity justification actually weighs).
+- **Trip conditions.**  A reorg of depth >= ``LHTPU_REORG_TRIP_DEPTH``
+  fires the ``deep_reorg`` flight trip; a finality lag of
+  >= ``LHTPU_FINALITY_STALL_EPOCHS`` epochs fires ``finality_stall``
+  ONCE per stall episode (the state machine re-arms when finality
+  advances again, with a ``finality_recovered`` event marking the
+  edge).  Both dumps are served with the rest of the black box at
+  ``GET /lighthouse/observatory/flight``; the live detector state is
+  ``GET /lighthouse/observatory/chain``.
+
+``LHTPU_OBS_ARMED=0`` disarms the detector with the rest of the
+observatory plane (the overhead A/B knob).  Every hook is wrapped by
+the caller (`BeaconChain.recompute_head`, `NetworkService.on_slot`) so
+a detector fault can never block import or the slot tick.
+
+Multi-node processes (the in-process simulator) share one metrics
+registry and one flight recorder, so every series and event carries a
+``node`` label — :class:`simulator.LocalNetwork` names its chains, a
+production process keeps the default.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY
+
+#: EventStream topic for reorg notifications (reference
+#: beacon_chain/src/events.rs ChainReorg SSE)
+CHAIN_REORG_TOPIC = "chain_reorg"
+
+
+def _depth_bucket(depth: int) -> str:
+    if depth <= 1:
+        return "1"
+    if depth == 2:
+        return "2"
+    if depth <= 4:
+        return "3-4"
+    if depth <= 8:
+        return "5-8"
+    return "9+"
+
+
+class ChainHealthMonitor:
+    """One beacon chain's health plane: reorg classification, lag
+    gauges, stall/trip state.
+
+    Thread model: ``on_head_update`` runs under the chain's import lock
+    (head updates are single-writer); ``on_slot`` may race it from the
+    network tick, so the small mutable aggregates are guarded by one
+    short lock.
+    """
+
+    def __init__(self, chain, name: str = "node"):
+        self.chain = chain
+        self.name = name
+        self._lock = threading.Lock()
+        self.reconfigure()
+        # finality-stall state machine: "ok" | "stalled"; transitions
+        # emit flight events (lhlint LH605 enforces this)
+        self.state = "ok"
+        self.head_moves = 0
+        self.extensions = 0
+        self.reorg_count = 0
+        self.max_reorg_depth = 0
+        self.reorgs_by_bucket: dict[str, int] = {}
+        self.last_reorg: dict | None = None
+        self.reorg_log: list[dict] = []   # newest-last, bounded
+        self.head_lag_slots = 0
+        self.finality_lag_epochs = 0
+        self.participation_rate: float | None = None
+        self.participation_epoch: int | None = None
+        self._part_key: tuple | None = None
+        self._label_memo: dict = {}
+
+    # -- labeled-series plumbing (literal registrations so the lhlint
+    #    metric discipline sees every family; children memoized so the
+    #    per-tick cost is one inc()/set()) --------------------------------
+
+    def _reorg_counter(self, bucket: str):
+        key = ("reorg", bucket)
+        child = self._label_memo.get(key)
+        if child is None:
+            child = REGISTRY.counter(
+                "reorg_events_total",
+                "canonical head rewrites, by node and reorg-depth bucket",
+            ).labels(node=self.name, depth_bucket=bucket)
+            self._label_memo[key] = child
+        return child
+
+    def _head_lag_gauge(self):
+        child = self._label_memo.get("head_lag")
+        if child is None:
+            child = REGISTRY.gauge(
+                "head_lag_slots",
+                "slots between the clock and the canonical head, by node",
+            ).labels(node=self.name)
+            self._label_memo["head_lag"] = child
+        return child
+
+    def _finality_lag_gauge(self):
+        child = self._label_memo.get("finality_lag")
+        if child is None:
+            child = REGISTRY.gauge(
+                "finality_lag_epochs",
+                "epochs between the clock and the finalized checkpoint, "
+                "by node",
+            ).labels(node=self.name)
+            self._label_memo["finality_lag"] = child
+        return child
+
+    def _participation_gauge(self):
+        child = self._label_memo.get("participation")
+        if child is None:
+            child = REGISTRY.gauge(
+                "chain_participation_rate",
+                "effective-balance-weighted TIMELY_TARGET participation "
+                "of the newest completed epoch, by node",
+            ).labels(node=self.name)
+            self._label_memo["participation"] = child
+        return child
+
+    # -- head-move classification -------------------------------------------
+
+    def classify(self, old_root: bytes, new_root: bytes) -> dict | None:
+        """Classify one head move via the proto-array common-ancestor
+        walk.  Returns None when either root is unknown (a pruned-away
+        branch) or the move is a no-op."""
+        chain = self.chain
+        if old_root == new_root:
+            return None
+        proto = chain.fork_choice.proto
+        ancestor = proto.common_ancestor(old_root, new_root)
+        if ancestor is None:
+            return None
+        old_i = proto.indices[old_root]
+        new_i = proto.indices[new_root]
+        anc_i = proto.indices[ancestor]
+        old_slot = int(proto.slots[old_i])
+        new_slot = int(proto.slots[new_i])
+        anc_slot = int(proto.slots[anc_i])
+        # block counts along each side of the fork (the hand-walkable
+        # ancestor chains the property tests pin against)
+        abandoned = 0
+        i = old_i
+        while i != anc_i:
+            abandoned += 1
+            i = int(proto.parents[i])
+        adopted = 0
+        i = new_i
+        while i != anc_i:
+            adopted += 1
+            i = int(proto.parents[i])
+        kind = "extension" if ancestor == old_root else "reorg"
+        return {
+            "kind": kind,
+            # reference ChainReorg depth: slots from the old head back
+            # to the fork point (0 for a pure extension)
+            "depth": old_slot - anc_slot,
+            "distance": new_slot - anc_slot,
+            "abandoned_blocks": abandoned,
+            "adopted_blocks": adopted,
+            "ancestor": ancestor,
+            "old_head": old_root,
+            "new_head": new_root,
+            "old_slot": old_slot,
+            "new_slot": new_slot,
+        }
+
+    def on_head_update(self, old_root: bytes, new_root: bytes) -> dict | None:
+        """Hook run by ``BeaconChain.recompute_head`` on every head
+        change (under the import lock).  Classifies the move, updates
+        the reorg books, publishes the ``chain_reorg`` SSE event and
+        files/trips the flight recorder."""
+        if not self.enabled:
+            return None
+        move = self.classify(old_root, new_root)
+        if move is None:
+            return None
+        chain = self.chain
+        with self._lock:
+            self.head_moves += 1
+            if move["kind"] == "extension":
+                self.extensions += 1
+                return move
+            bucket = _depth_bucket(move["depth"])
+            self.reorg_count += 1
+            self.max_reorg_depth = max(self.max_reorg_depth, move["depth"])
+            self.reorgs_by_bucket[bucket] = (
+                self.reorgs_by_bucket.get(bucket, 0) + 1)
+            self.last_reorg = move
+            self.reorg_log.append(move)
+            del self.reorg_log[:-64]
+        self._reorg_counter(bucket).inc()
+        self._publish_reorg(chain, move)
+        flight.emit("chain_reorg", node=self.name, slot=move["new_slot"],
+                    depth=move["depth"], distance=move["distance"],
+                    old_head=move["old_head"], new_head=move["new_head"])
+        if move["depth"] >= self.trip_depth:
+            flight.trip("deep_reorg", node=self.name, depth=move["depth"],
+                        distance=move["distance"],
+                        old_head=move["old_head"],
+                        new_head=move["new_head"])
+        return move
+
+    def _publish_reorg(self, chain, move: dict) -> None:
+        """Reference-shaped ChainReorg SSE payload (events.rs)."""
+        if chain is None:
+            return
+        state_roots = getattr(chain, "_state_root_of_block", {})
+        epoch = chain.spec.compute_epoch_at_slot(move["new_slot"])
+        chain.events.publish(CHAIN_REORG_TOPIC, {
+            "slot": str(move["new_slot"]),
+            "depth": str(move["depth"]),
+            "old_head_block": "0x" + move["old_head"].hex(),
+            "new_head_block": "0x" + move["new_head"].hex(),
+            "old_head_state": "0x" + bytes(
+                state_roots.get(move["old_head"], b"")).hex(),
+            "new_head_state": "0x" + bytes(
+                state_roots.get(move["new_head"], b"")).hex(),
+            "epoch": str(epoch),
+            "execution_optimistic": False,
+        })
+
+    # -- slot-clock tracking -------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        """Per-slot tick: lag gauges + the finality-stall machine +
+        per-epoch participation.  Idempotent — multiple ticks for one
+        slot re-set the same gauges and the stall machine is
+        edge-triggered."""
+        if not self.enabled:
+            return
+        chain = self.chain
+        spec = chain.spec
+        head_slot = int(chain.head_state.slot)
+        fin_epoch = int(chain.fork_choice.finalized.epoch)
+        epoch = spec.compute_epoch_at_slot(int(slot))
+        head_lag = max(int(slot) - head_slot, 0)
+        fin_lag = max(epoch - fin_epoch, 0)
+        with self._lock:
+            self.head_lag_slots = head_lag
+            self.finality_lag_epochs = fin_lag
+        self._head_lag_gauge().set(head_lag)
+        self._finality_lag_gauge().set(fin_lag)
+        if fin_lag >= self.stall_epochs:
+            self._enter_stall(fin_lag, epoch)
+        else:
+            self._clear_stall(fin_lag, epoch)
+        self._update_participation(chain)
+
+    def _enter_stall(self, lag: int, epoch: int) -> None:
+        """Edge-triggered: the trip fires once per stall episode."""
+        with self._lock:
+            if self.state == "stalled":
+                return
+            self.state = "stalled"
+        flight.trip("finality_stall", node=self.name, lag_epochs=lag,
+                    epoch=epoch, threshold=self.stall_epochs)
+
+    def _clear_stall(self, lag: int, epoch: int) -> None:
+        """Finality advanced again: re-arm the trip."""
+        with self._lock:
+            if self.state == "ok":
+                return
+            self.state = "ok"
+        flight.emit("finality_recovered", node=self.name, lag_epochs=lag,
+                    epoch=epoch)
+
+    def _update_participation(self, chain) -> None:
+        """Effective-balance-weighted previous-epoch TIMELY_TARGET
+        participation of the head state (altair+; phase0 states carry
+        no flags).  Recomputed whenever the head advances — flags for
+        epoch E-1 keep accruing from late-included attestations all
+        through epoch E (exactly the post-heal recovery window), so a
+        once-per-epoch latch would systematically under-report.  One
+        vectorized sweep per new head slot; duplicate ticks for the
+        same head are skipped."""
+        from lighthouse_tpu.state_transition.epoch_processing import (
+            TIMELY_TARGET_FLAG_INDEX,
+            has_flag,
+        )
+
+        state = chain.head_state
+        flags = getattr(state, "previous_epoch_participation", None)
+        if flags is None:
+            return
+        head_epoch = chain.spec.compute_epoch_at_slot(int(state.slot))
+        if head_epoch < 1:
+            return
+        key = (int(state.slot), head_epoch)
+        if self._part_key == key:
+            return
+        self._part_key = key
+        part = np.asarray(flags, np.uint8)
+        active = state.validators.is_active(head_epoch - 1)
+        eb = np.asarray(state.validators.effective_balance, np.int64)
+        n = min(part.shape[0], active.shape[0])
+        hit = has_flag(part[:n], TIMELY_TARGET_FLAG_INDEX) & active[:n]
+        total = int(eb[:n][active[:n]].sum())
+        rate = (int(eb[:n][hit].sum()) / total) if total else 0.0
+        with self._lock:
+            self.participation_rate = rate
+            self.participation_epoch = head_epoch - 1
+        self._participation_gauge().set(rate)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``GET /lighthouse/observatory/chain`` payload."""
+        with self._lock:
+            last = dict(self.last_reorg) if self.last_reorg else None
+            if last:
+                for k in ("ancestor", "old_head", "new_head"):
+                    last[k] = "0x" + last[k].hex()
+            return {
+                "node": self.name,
+                "armed": self.enabled,
+                "state": self.state,
+                "head_lag_slots": self.head_lag_slots,
+                "finality_lag_epochs": self.finality_lag_epochs,
+                "participation_rate": self.participation_rate,
+                "participation_epoch": self.participation_epoch,
+                "head_moves": self.head_moves,
+                "extensions": self.extensions,
+                "reorgs": {
+                    "count": self.reorg_count,
+                    "max_depth": self.max_reorg_depth,
+                    "by_depth_bucket": dict(self.reorgs_by_bucket),
+                    "last": last,
+                },
+                "trip_thresholds": {
+                    "deep_reorg_depth": self.trip_depth,
+                    "finality_stall_epochs": self.stall_epochs,
+                },
+            }
+
+    def set_name(self, name: str) -> None:
+        """Label this node's series/events (the in-process simulator
+        shares one registry across N nodes).  Drops memoized children —
+        call before the first slot, not mid-flight."""
+        self.name = name
+        self._label_memo.clear()
+
+    def reconfigure(self) -> None:
+        """Re-read the LHTPU_* knobs (tests/drills mutate os.environ
+        after construction)."""
+        self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
+        self.trip_depth = max(
+            1, envreg.get_int("LHTPU_REORG_TRIP_DEPTH", 3) or 3)
+        self.stall_epochs = max(
+            1, envreg.get_int("LHTPU_FINALITY_STALL_EPOCHS", 4) or 4)

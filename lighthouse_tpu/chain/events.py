@@ -13,7 +13,7 @@ class EventStream:
     """Bounded fan-out of chain events to SSE subscribers."""
 
     TOPICS = ("head", "block", "attestation", "finalized_checkpoint",
-              "voluntary_exit", "contribution_and_proof",
+              "chain_reorg", "voluntary_exit", "contribution_and_proof",
               "light_client_finality_update",
               "light_client_optimistic_update")
 

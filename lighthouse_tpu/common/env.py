@@ -336,6 +336,35 @@ _register("LHTPU_AOT_PREWARM_SCALE", "auto",
           "XLA-CPU fallback (where production-width compiles cost "
           "minutes each).")
 
+# -- chain health + fleet observatory (chain/chain_health, simulator,
+#    bench --child-fleetwatch) ------------------------------------------------
+
+_register("LHTPU_REORG_TRIP_DEPTH", "3",
+          "Reorg depth (slots from the old head back to the fork "
+          "point) at or beyond which the deep_reorg flight trip dumps "
+          "the black box.")
+_register("LHTPU_FINALITY_STALL_EPOCHS", "4",
+          "Finality lag (epochs between the slot clock and the "
+          "finalized checkpoint) that fires the finality_stall flight "
+          "trip, once per stall episode (re-arms when finality "
+          "advances).")
+_register("LHTPU_FLEET_NODES", "4",
+          "Node count for the bench --child-fleetwatch drill (the "
+          "partition phase splits them into two equal halves).")
+_register("LHTPU_FLEET_STEADY_SLOTS", "34",
+          "Steady-phase slot count for --child-fleetwatch, also the "
+          "length of each armed/unarmed overhead A/B leg (4 minimal-"
+          "spec epochs + 2 so finality reaches epoch >= 2 before the "
+          "partition).")
+_register("LHTPU_FLEET_PARTITION_SLOTS", "12",
+          "Slots the --child-fleetwatch 2/2 partition is held open "
+          "(kept under the 16-block unknown-parent chase bound so the "
+          "post-heal by-root sync converges in one chase).")
+_register("LHTPU_FLEET_HEAL_SLOTS", "26",
+          "Slots run after healing the --child-fleetwatch partition "
+          "(must cover reconvergence plus enough epochs for finality "
+          "to resume).")
+
 
 # -- typed readers ------------------------------------------------------------
 

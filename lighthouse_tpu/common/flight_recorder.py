@@ -26,6 +26,10 @@ peer_quarantine     a peer crossing into its rpc quarantine window
                     (network/rpc.RequestDiscipline)
 books_violation     a registered invariant monitor breaching
                     (common/monitors)
+deep_reorg          a canonical-head rewrite at or beyond
+                    LHTPU_REORG_TRIP_DEPTH (chain/chain_health)
+finality_stall      finality lag reaching LHTPU_FINALITY_STALL_EPOCHS,
+                    once per stall episode (chain/chain_health)
 ==================  ==========================================================
 
 The ring keeps the newest ``LHTPU_FLIGHT_CAPACITY`` events (overflow
@@ -61,7 +65,8 @@ from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 #: documented trip reasons (``trip`` accepts any string so drills can
 #: add ad-hoc conditions)
 TRIP_REASONS = ("bls_breaker_open", "epoch_breaker_open", "dispatch_wedge",
-                "store_corruption", "peer_quarantine", "books_violation")
+                "store_corruption", "peer_quarantine", "books_violation",
+                "deep_reorg", "finality_stall")
 
 
 def _jsonable(v):

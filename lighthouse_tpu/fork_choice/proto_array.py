@@ -258,6 +258,25 @@ class ProtoArray:
             i = self.parents[i]
         return self.roots[i] if i != NONE else None
 
+    def common_ancestor(self, a: bytes, b: bytes) -> bytes | None:
+        """Deepest common ancestor of two known roots (the reorg
+        detector's classification walk).  Nodes are insertion-ordered —
+        every parent precedes its children — so repeatedly stepping the
+        HIGHER-indexed side to its parent converges on the fork point
+        without comparing slots, in O(depth of the deeper branch)."""
+        ia = self.indices.get(a)
+        ib = self.indices.get(b)
+        if ia is None or ib is None:
+            return None
+        while ia != ib:
+            if ia == NONE or ib == NONE:
+                return None  # disjoint trees (pruned-away branch)
+            if ia > ib:
+                ia = int(self.parents[ia])
+            else:
+                ib = int(self.parents[ib])
+        return self.roots[ia]
+
     def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
         a = self.indices.get(ancestor_root)
         if a is None:

@@ -188,9 +188,11 @@ class GossipHub:
     subscribed endpoint except the publisher."""
 
     def __init__(self):
+        from lighthouse_tpu.network.partition import PartitionSet
+
         self._topics: dict[str, list[GossipEndpoint]] = defaultdict(list)
         self._endpoints: dict[str, GossipEndpoint] = {}
-        self._partitions: dict[str, set[str]] = {}
+        self._partitions = PartitionSet()
 
     def join(self, peer_id: str) -> GossipEndpoint:
         ep = GossipEndpoint(self, peer_id)
@@ -206,12 +208,10 @@ class GossipHub:
 
     def disconnect(self, a: str, b: str):
         """Partition two peers (fault injection for tests)."""
-        self._partitions.setdefault(a, set()).add(b)
-        self._partitions.setdefault(b, set()).add(a)
+        self._partitions.disconnect(a, b)
 
     def reconnect(self, a: str, b: str):
-        self._partitions.get(a, set()).discard(b)
-        self._partitions.get(b, set()).discard(a)
+        self._partitions.reconnect(a, b)
 
     def _subscribe(self, topic: str, ep: GossipEndpoint):
         if ep not in self._topics[topic]:
@@ -222,7 +222,7 @@ class GossipHub:
             self._topics[topic].remove(ep)
 
     def route(self, msg: GossipMessage):
-        blocked = self._partitions.get(msg.source, set())
+        blocked = self._partitions.blocked_for(msg.source)
         for ep in list(self._topics.get(msg.topic, ())):
             if ep.peer_id == msg.source or ep.peer_id in blocked:
                 continue

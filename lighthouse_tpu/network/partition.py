@@ -1,0 +1,29 @@
+"""Pairwise partition bookkeeping shared by the in-process fabrics.
+
+One implementation for both hubs (GossipHub and RpcFabric): the
+simulator's ``LocalNetwork.partition``/``heal`` assume gossip and rpc
+sever identically, so the semantics must live in exactly one place.
+"""
+
+from __future__ import annotations
+
+
+class PartitionSet:
+    """Symmetric blocked-pair set (fault induction for drills/tests)."""
+
+    def __init__(self):
+        self._blocked: dict[str, set[str]] = {}
+
+    def disconnect(self, a: str, b: str) -> None:
+        self._blocked.setdefault(a, set()).add(b)
+        self._blocked.setdefault(b, set()).add(a)
+
+    def reconnect(self, a: str, b: str) -> None:
+        self._blocked.get(a, set()).discard(b)
+        self._blocked.get(b, set()).discard(a)
+
+    def blocked(self, a: str, b: str) -> bool:
+        return b in self._blocked.get(a, ())
+
+    def blocked_for(self, a: str) -> set[str]:
+        return self._blocked.get(a, set())

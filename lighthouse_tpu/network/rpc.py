@@ -290,14 +290,31 @@ class RpcFabric:
     """In-process request routing between registered RPC endpoints."""
 
     def __init__(self):
+        from lighthouse_tpu.network.partition import PartitionSet
+
         self._nodes: dict[str, "RpcEndpoint"] = {}
+        # pairwise partitions (the same PartitionSet GossipHub uses —
+        # LocalNetwork.partition assumes both fabrics sever
+        # identically): a partitioned pair's calls fail like a dead
+        # link, which the RequestDiscipline accounts exactly like any
+        # peer failure
+        self._partitions = PartitionSet()
 
     def join(self, peer_id: str) -> "RpcEndpoint":
         ep = RpcEndpoint(self, peer_id)
         self._nodes[peer_id] = ep
         return ep
 
+    def disconnect(self, a: str, b: str):
+        """Partition two peers (fault injection for drills/tests)."""
+        self._partitions.disconnect(a, b)
+
+    def reconnect(self, a: str, b: str):
+        self._partitions.reconnect(a, b)
+
     def call(self, src: str, dst: str, protocol: str, data: bytes) -> list[bytes]:
+        if self._partitions.blocked(src, dst):
+            raise RpcError(f"partitioned from {dst}")
         ep = self._nodes.get(dst)
         if ep is None:
             raise RpcError(f"unknown peer {dst}")

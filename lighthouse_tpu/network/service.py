@@ -125,10 +125,19 @@ class NetworkService:
             self._graylisted_gossip.discard(peer)
 
     def on_slot(self, slot: int) -> None:
-        """Per-slot tick: subnet subscription deltas + the peer-manager
-        heartbeat (disconnect bad scores, prune beyond the target peer
-        count with sole-subnet-provider protection, refill the dial
-        deficit from the discovery table)."""
+        """Per-slot tick: chain-health lag gauges, subnet subscription
+        deltas + the peer-manager heartbeat (disconnect bad scores,
+        prune beyond the target peer count with sole-subnet-provider
+        protection, refill the dial deficit from the discovery
+        table)."""
+        health = getattr(self.chain, "chain_health", None)
+        if health is not None:
+            try:
+                health.on_slot(slot)
+            except Exception as e:
+                from lighthouse_tpu.common.metrics import record_swallowed
+
+                record_swallowed("network.chain_health_tick", e)
         self.router.update_attestation_subnets(slot)
         node = getattr(self.fabric, "node", None)
         if node is None:

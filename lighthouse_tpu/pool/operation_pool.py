@@ -94,6 +94,12 @@ class OperationPool:
         # pre-deneb inclusion window: delay <= SLOTS_PER_EPOCH (deneb
         # removed the upper bound, EIP-7045); constant per call, hoisted
         post_7045 = spec.fork_at_least(fork_now, "deneb")
+        # the state's justified checkpoints are constant per call too;
+        # the per-attestation source gate compares against these tuples
+        cur_src = (int(state.current_justified_checkpoint.epoch),
+                   bytes(state.current_justified_checkpoint.root))
+        prev_src = (int(state.previous_justified_checkpoint.epoch),
+                    bytes(state.previous_justified_checkpoint.root))
         for variants in self.attestations.values():
             for stored in variants:
                 att_slot = int(stored.data.slot)
@@ -112,6 +118,15 @@ class OperationPool:
                 if electra and int(stored.data.index) != 0:
                     continue
                 if not electra and stored.committee != int(stored.data.index):
+                    continue
+                # the transition hard-fails attestations whose source is
+                # not THIS state's justified checkpoint (spec
+                # is_matching_source); on a forked network the pool
+                # holds votes from both branches, so packing one from
+                # the other side would abort the whole block build
+                src = (cur_src if target_epoch == cur_epoch else prev_src)
+                if (int(stored.data.source.epoch),
+                        bytes(stored.data.source.root)) != src:
                     continue
                 part = cur_part if target_epoch == cur_epoch else prev_part
                 try:

@@ -1,4 +1,4 @@
-"""In-process multi-node simulator.
+"""In-process multi-node simulator + the fleet observatory.
 
 Rebuild of /root/reference/testing/simulator/src/{basic_sim.rs:18-80,
 local_network.rs} + testing/node_test_rig: boots N beacon nodes and
@@ -8,18 +8,39 @@ VCs, drives an accelerated slot clock (no wall-clock sleeps — the
 ManualSlotClock steps), crosses fork boundaries, and asserts the
 liveness checks the reference's `checks.rs` runs: heads agree,
 finalization advances, sync participation is non-zero.
+
+The fleet observatory (ISSUE 13) grows this from "run and hope" into
+asserted protocol-level outcomes:
+
+- :meth:`LocalNetwork.partition` / :meth:`LocalNetwork.heal` induce
+  network splits by riding the gossip fabric's pairwise disconnect
+  machinery (and the RPC fabric's twin), so forks and reorgs are
+  first-class induced faults like every other fault plane.
+- :class:`FleetObserver` snapshots every slot: head-equivalence
+  classes (split detection within one slot of induction), min/max
+  finalized epoch, and a network-wide ledger roll-up proving the sum
+  of every node's sync/backfill/processor books balances — plus a
+  merged node-labeled causal timeline of all N nodes' flight events
+  (the in-process fleet shares one flight recorder; per-node
+  attribution rides the events' ``node`` field).
+
+``bench.py --child-fleetwatch`` drives the acceptance drill: 4 nodes
+steady -> 2/2 partition -> heal, gating on observer-vs-ground-truth
+exactness (see the README "Fleet observatory" section).
 """
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass, field
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.network import BootNode, NetworkFabric, NetworkService
 from lighthouse_tpu.network.router import fork_digest
-from lighthouse_tpu.state_transition import genesis_state, misc
+from lighthouse_tpu.state_transition import genesis_state
 from lighthouse_tpu.testing import interop_secret_key
 from lighthouse_tpu.validator import ValidatorClient, ValidatorStore
 
@@ -41,6 +62,171 @@ class SimSummary:
     per_slot: list = field(default_factory=list)
 
 
+@dataclass
+class FleetSnapshot:
+    """One slot's fleet-wide observation."""
+
+    slot: int
+    heads: dict            # node name -> head root (bytes)
+    classes: dict          # head root (bytes) -> [node names]
+    split: bool
+    finalized_min: int
+    finalized_max: int
+    books: dict            # network-wide ledger roll-up
+    unaccounted: int       # events no node's books can account for
+
+
+class FleetObserver:
+    """Cross-node correlation: per-slot fleet snapshots + the merged
+    node-labeled flight timeline.
+
+    Split detection is equivalence-class based: the fleet is split
+    exactly when the nodes' head roots form more than one class.  The
+    observer is edge-triggered on split/reconverge (one flight event
+    per transition) and keeps every snapshot for ground-truth replay
+    (bounded; a fleetwatch drill is tens of slots, not millions).
+    """
+
+    _MAX_SNAPSHOTS = 4096
+
+    def __init__(self, net: "LocalNetwork"):
+        self.net = net
+        self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
+        # scope timeline() to THIS network's lifetime: the flight ring
+        # is process-wide, so without a watermark an earlier net's
+        # events (same node names) would merge in and be misattributed
+        self._seq_floor = max(
+            (e["seq"] for e in flight.RECORDER.snapshot()), default=0)
+        self.snapshots: list[FleetSnapshot] = []
+        self.first_split_slot: int | None = None
+        self.reconverged_slot: int | None = None
+        self._was_split = False
+        self._snap_counter = REGISTRY.counter(
+            "fleet_snapshots_total",
+            "per-slot fleet observations taken by the observer")
+        self._split_counter = REGISTRY.counter(
+            "fleet_splits_total",
+            "head-divergence episodes detected (edge-triggered)")
+        self._classes_gauge = REGISTRY.gauge(
+            "fleet_head_classes",
+            "distinct head-equivalence classes across the fleet")
+        self._unaccounted_gauge = REGISTRY.gauge(
+            "fleet_unaccounted_events",
+            "network-wide ledger deficit beyond the in-flight windows "
+            "(0 = every node's books balance)")
+
+    # -- the per-slot observation -------------------------------------------
+
+    def snapshot(self, slot: int) -> FleetSnapshot | None:
+        if not self.enabled:
+            return None
+        nodes = self.net.nodes
+        heads = {n.name: n.chain.head_root for n in nodes}
+        classes: dict[bytes, list[str]] = {}
+        for name, root in heads.items():
+            classes.setdefault(root, []).append(name)
+        split = len(classes) > 1
+        finalized = [int(n.chain.fork_choice.finalized.epoch)
+                     for n in nodes]
+        books, unaccounted = self._roll_up_books(nodes)
+        snap = FleetSnapshot(
+            slot=int(slot), heads=heads, classes=classes, split=split,
+            finalized_min=min(finalized), finalized_max=max(finalized),
+            books=books, unaccounted=unaccounted)
+        self.snapshots.append(snap)
+        del self.snapshots[:-self._MAX_SNAPSHOTS]
+        self._snap_counter.inc()
+        self._classes_gauge.set(len(classes))
+        self._unaccounted_gauge.set(unaccounted)
+        if split and not self._was_split:
+            if self.first_split_slot is None:
+                self.first_split_slot = int(slot)
+            self._split_counter.inc()
+            flight.emit(
+                "fleet_split", slot=int(slot), n_classes=len(classes),
+                classes={("0x" + r.hex()[:16]): names
+                         for r, names in classes.items()})
+        elif self._was_split and not split:
+            self.reconverged_slot = int(slot)
+            flight.emit("fleet_reconverged", slot=int(slot),
+                        head="0x" + next(iter(classes)).hex())
+        self._was_split = split
+        return snap
+
+    @staticmethod
+    def _roll_up_books(nodes) -> tuple[dict, int]:
+        """Network-wide sum of every node's sync/backfill/processor
+        ledgers + the unaccounted total: deficit beyond each ledger's
+        in-flight tolerance window, plus ANY negative deficit (more
+        accounted than submitted is impossible legitimately)."""
+        total = {"requested": 0, "imported": 0, "retried": 0,
+                 "abandoned": 0, "inflight": 0}
+        unaccounted = 0
+        per_node: dict[str, dict] = {}
+        for node in nodes:
+            ledgers = {}
+            for label, owner in (("sync", getattr(node.net, "sync", None)),
+                                 ("backfill",
+                                  getattr(node.net, "backfill", None))):
+                books = getattr(owner, "books", None)
+                if books is None:
+                    continue
+                b = dict(books)
+                inflight = int(getattr(owner, "inflight_attempts", 0))
+                # .get throughout: a future ledger with a partial books
+                # shape must read as an observer finding, never kill
+                # the simulation driver mid-slot
+                deficit = b.get("requested", 0) - (
+                    b.get("imported", 0) + b.get("retried", 0)
+                    + b.get("abandoned", 0))
+                if deficit < 0:
+                    unaccounted += -deficit
+                elif deficit > inflight:
+                    unaccounted += deficit - inflight
+                for k in ("requested", "imported", "retried", "abandoned"):
+                    total[k] += int(b.get(k, 0))
+                total["inflight"] += inflight
+                ledgers[label] = {**b, "inflight": inflight}
+            proc = getattr(node, "processor", None)
+            if proc is not None:
+                m = proc.metrics
+                with m._lock:
+                    enq = sum(m.enqueued.values())
+                    done = sum(m.processed.values())
+                    shed = sum(m.shed.values())
+                queued = sum(len(q) for q in proc._queues.values())
+                deficit = enq - done - shed - queued
+                # the monitors idiom: a positive deficit equals the
+                # in-flight population while busy, so it only counts at
+                # idle; a negative deficit is impossible legitimately
+                idle = (not getattr(proc, "_inflight", ())
+                        and not getattr(proc, "_manager_holding", False))
+                if deficit < 0:
+                    unaccounted += -deficit
+                elif idle and deficit > 0:
+                    unaccounted += deficit
+                ledgers["processor"] = {
+                    "enqueued": enq, "processed": done, "shed": shed,
+                    "queued": queued, "idle": idle}
+            per_node[node.name] = ledgers
+        return {"total": total, "per_node": per_node}, unaccounted
+
+    # -- cross-node correlation ---------------------------------------------
+
+    def timeline(self) -> list[dict]:
+        """All N nodes' flight events merged into one causally-ordered
+        (ring-sequence) node-labeled timeline, scoped to events emitted
+        since this observer was constructed.  Events without per-node
+        attribution (process-wide planes) are labeled ``process``."""
+        return [{**e, "node": e.get("node", "process")}
+                for e in flight.RECORDER.snapshot()
+                if e["seq"] > self._seq_floor]
+
+    def books_balanced(self) -> bool:
+        """True when the newest snapshot accounts for every event."""
+        return bool(self.snapshots) and self.snapshots[-1].unaccounted == 0
+
+
 class LocalNetwork:
     """N nodes + VCs over one fabric (the reference's LocalNetwork)."""
 
@@ -58,6 +244,7 @@ class LocalNetwork:
                 self.spec, self.genesis.copy(), verify_signatures=True)
             chain.mock_payload = (
                 lambda slot, c=chain: self._mock_payload(c, slot))
+            chain.chain_health.set_name(f"node-{i}")
             net = NetworkService(chain, self.fabric, f"node-{i}")
             store = ValidatorStore(self.spec, gvr)
             # validators are split round-robin across the VCs
@@ -71,6 +258,42 @@ class LocalNetwork:
             self.fabric, fork_digest=fork_digest(self.nodes[0].chain))
         for node in self.nodes:
             node.net.discover_and_connect(self.boot.peer_id)
+
+        self.observer = FleetObserver(self)
+        # pairs currently severed by partition() (for heal())
+        self._partitioned: list[tuple[str, str]] = []
+
+    # -- fault induction: network splits -----------------------------------
+
+    def partition(self, *groups) -> int:
+        """Sever gossip+RPC between every cross-group node pair.
+        ``groups`` are sequences of node indices; nodes absent from all
+        groups keep full connectivity.  Returns the number of severed
+        pairs.  Layered on the fabric's pairwise disconnect machinery —
+        the same seam the gossip fault tests use."""
+        named = [[self.nodes[i].name for i in g] for g in groups]
+        severed = 0
+        for gi, ga in enumerate(named):
+            for gb in named[gi + 1:]:
+                for a in ga:
+                    for b in gb:
+                        self.fabric.gossip.disconnect(a, b)
+                        self.fabric.rpc.disconnect(a, b)
+                        self._partitioned.append((a, b))
+                        severed += 1
+        flight.emit("fleet_partition", groups=named, severed=severed)
+        return severed
+
+    def heal(self) -> int:
+        """Reconnect every pair severed by :meth:`partition`."""
+        healed = 0
+        for a, b in self._partitioned:
+            self.fabric.gossip.reconnect(a, b)
+            self.fabric.rpc.reconnect(a, b)
+            healed += 1
+        self._partitioned.clear()
+        flight.emit("fleet_heal", healed=healed)
+        return healed
 
     # -- driving -----------------------------------------------------------
 
@@ -95,6 +318,7 @@ class LocalNetwork:
             node.vc._sync_committee(slot, ats)
             summary.attestations += ats.attestations_published
             summary.sync_messages += ats.sync_messages_published
+        self.observer.snapshot(slot)
 
     def run_slots(self, n_slots: int, start: int | None = None) -> SimSummary:
         summary = SimSummary()
@@ -122,7 +346,6 @@ class LocalNetwork:
 
     def sync_participation_nonzero(self) -> bool:
         for n in self.nodes:
-            body = None
             blk = n.chain.store.get_block(n.chain.head_root)
             if blk is None or not hasattr(blk.message.body, "sync_aggregate"):
                 continue
@@ -146,4 +369,5 @@ def _new_slot_summary(slot: int):
     return SlotSummary(slot)
 
 
-__all__ = ["LocalNetwork", "LocalNode", "SimSummary"]
+__all__ = ["FleetObserver", "FleetSnapshot", "LocalNetwork", "LocalNode",
+           "SimSummary"]

@@ -28,6 +28,7 @@ class SlotSummary:
     sync_messages_published: int = 0
     sync_contributions_published: int = 0
     slashing_refusals: int = 0
+    proposal_failures: int = 0
 
 
 class ValidatorClient:
@@ -98,8 +99,18 @@ class ValidatorClient:
                 kwargs["execution_payload"] = (
                     chain.mock_payload(slot) if hasattr(chain, "mock_payload")
                     else None)
-            block, proposer = chain.produce_block_on(
-                slot, randao, **kwargs)
+            try:
+                block, proposer = chain.produce_block_on(
+                    slot, randao, **kwargs)
+            except Exception as e:
+                # a proposer that cannot build a valid block misses its
+                # slot (the reference VC logs and moves on) — it must
+                # never take the whole client down with it
+                from lighthouse_tpu.common.metrics import record_swallowed
+
+                record_swallowed("validator.produce_block", e)
+                summary.proposal_failures += 1
+                continue
             try:
                 sig = self.store.sign_block(duty.pubkey, block)
             except SlashingProtectionError:

@@ -99,6 +99,65 @@ def test_metrics_content_type(api_setup):
             "text/plain; version=0.0.4; charset=utf-8"
 
 
+def test_observatory_chain_endpoint(api_setup):
+    """The chain-health detector's live surface: lag gauges, reorg
+    forensics and trip thresholds, served before any reorg happened."""
+    import json
+    import urllib.request
+
+    h, chain, client = api_setup
+    chain.chain_health.on_slot(int(chain.head_state.slot) + 2)
+    with urllib.request.urlopen(
+            client.base_url + "/lighthouse/observatory/chain",
+            timeout=5) as r:
+        data = json.loads(r.read())["data"]
+    assert data["armed"] is True and data["state"] == "ok"
+    assert data["head_lag_slots"] == 2
+    assert data["reorgs"]["count"] == 0 and data["reorgs"]["last"] is None
+    assert data["trip_thresholds"]["deep_reorg_depth"] >= 1
+
+
+def test_chain_reorg_sse_stream(api_setup):
+    """chain_reorg rides the SSE endpoint like any other topic, with
+    the reference-shaped payload intact end to end."""
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    h, chain, client = api_setup
+    out = {}
+
+    def read():
+        url = (client.base_url + "/eth/v1/events"
+               "?topics=chain_reorg&max_events=1&timeout=5")
+        with urllib.request.urlopen(url, timeout=10) as r:
+            out["content_type"] = r.headers["Content-Type"]
+            out["body"] = r.read().decode()
+
+    t = threading.Thread(target=read)
+    t.start()
+    deadline = time.time() + 5
+    while not chain.events.has_subscribers("chain_reorg") \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    payload = {
+        "slot": "7", "depth": "3",
+        "old_head_block": "0x" + "11" * 32,
+        "new_head_block": "0x" + "22" * 32,
+        "old_head_state": "0x" + "33" * 32,
+        "new_head_state": "0x" + "44" * 32,
+        "epoch": "0", "execution_optimistic": False,
+    }
+    chain.events.publish("chain_reorg", payload)
+    t.join(10)
+    assert out["content_type"].startswith("text/event-stream")
+    assert "event: chain_reorg" in out["body"]
+    data_line = next(line for line in out["body"].splitlines()
+                     if line.startswith("data: "))
+    assert json.loads(data_line[len("data: "):]) == payload
+
+
 def test_observatory_endpoints(api_setup):
     """The observatory surfaces: flight black box, SLO report, jit
     telemetry — all JSON, all served even before any trip/score."""

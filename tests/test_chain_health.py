@@ -1,0 +1,273 @@
+"""Chain-health detector: reorg classification exactness, lag gauges,
+stall state machine, trip conditions (ISSUE 13).
+
+The classification property tests pin the detector's proto-array
+common-ancestor walk against an independent hand-walked ancestor chain
+(pure-dict parent maps), so a proto-array layout change can never
+silently skew reported reorg depths.  Zero-XLA throughout.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.chain.chain_health import (
+    CHAIN_REORG_TOPIC,
+    ChainHealthMonitor,
+    _depth_bucket,
+)
+from lighthouse_tpu.chain.events import EventStream
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.fork_choice.proto_array import CheckpointKey, ProtoArray
+
+SPEC = T.ChainSpec.minimal().with_forks_at(0, through="altair")
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder(tmp_path, monkeypatch):
+    """Isolated flight recorder: dumps land in tmp, ring starts empty."""
+    monkeypatch.setenv("LHTPU_FLIGHT_DIR", str(tmp_path))
+    flight.RECORDER.reconfigure()
+    flight.RECORDER.clear()
+    yield
+    flight.RECORDER.clear()
+    monkeypatch.delenv("LHTPU_FLIGHT_DIR", raising=False)
+    flight.RECORDER.reconfigure()
+
+
+def _root(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def _make_chain(blocks, head=None, finalized_epoch=0, head_state=None):
+    """Fake chain over a REAL proto-array: blocks = [(root, parent,
+    slot)], insertion-ordered."""
+    proto = ProtoArray()
+    cp = CheckpointKey(0, blocks[0][0])
+    for root, parent, slot in blocks:
+        proto.add_block(root, parent, slot, cp, cp)
+    fc = SimpleNamespace(
+        proto=proto, finalized=SimpleNamespace(epoch=finalized_epoch))
+    head = head if head is not None else blocks[-1][0]
+    slots = {r: s for r, _, s in blocks}
+    if head_state is None:
+        head_state = SimpleNamespace(slot=slots[head])
+    return SimpleNamespace(
+        spec=SPEC, fork_choice=fc, events=EventStream(),
+        head_root=head, head_state=head_state,
+        _state_root_of_block={r: b"\x55" * 32 for r, _, _ in blocks})
+
+
+class TestClassification:
+    def test_extension_is_not_a_reorg(self):
+        chain = _make_chain([(_root(1), None, 0), (_root(2), _root(1), 1)])
+        mon = ChainHealthMonitor(chain)
+        move = mon.on_head_update(_root(1), _root(2))
+        assert move["kind"] == "extension"
+        assert move["depth"] == 0
+        assert move["distance"] == 1
+        assert mon.reorg_count == 0 and mon.extensions == 1
+        # no chain_reorg event, no flight event for an extension
+        assert all(e["kind"] != "chain_reorg"
+                   for e in flight.RECORDER.snapshot())
+
+    def test_reorg_exact_depth_and_distance(self):
+        # G(0) <- A1(1) <- A2(2) <- A3(3)   and   G <- B1(2) <- B2(4)
+        chain = _make_chain([
+            (_root(1), None, 0),
+            (_root(2), _root(1), 1), (_root(3), _root(2), 2),
+            (_root(4), _root(3), 3),
+            (_root(5), _root(1), 2), (_root(6), _root(5), 4),
+        ], head=_root(4))
+        q = chain.events.subscribe([CHAIN_REORG_TOPIC])
+        mon = ChainHealthMonitor(chain, name="n0")
+        move = mon.on_head_update(_root(4), _root(6))
+        assert move["kind"] == "reorg"
+        assert move["depth"] == 3          # slots: old head 3 - fork 0
+        assert move["distance"] == 4       # new head 4 - fork 0
+        assert move["abandoned_blocks"] == 3
+        assert move["adopted_blocks"] == 2
+        assert move["ancestor"] == _root(1)
+        assert mon.reorg_count == 1
+        assert mon.reorgs_by_bucket == {"3-4": 1}
+        # reference-shaped SSE payload
+        topic, data = q.get_nowait()
+        assert topic == CHAIN_REORG_TOPIC
+        assert data["slot"] == "4" and data["depth"] == "3"
+        assert data["old_head_block"] == "0x" + _root(4).hex()
+        assert data["new_head_block"] == "0x" + _root(6).hex()
+        assert set(data) >= {"old_head_state", "new_head_state", "epoch",
+                             "execution_optimistic"}
+        # node-labeled flight event + the deep_reorg trip (depth 3 >= 3)
+        kinds = {e["kind"]: e for e in flight.RECORDER.snapshot()}
+        assert kinds["chain_reorg"]["node"] == "n0"
+        assert kinds["trip"]["reason"] == "deep_reorg"
+
+    def test_shallow_reorg_does_not_trip(self):
+        chain = _make_chain([
+            (_root(1), None, 0),
+            (_root(2), _root(1), 1), (_root(3), _root(1), 2),
+        ], head=_root(2))
+        mon = ChainHealthMonitor(chain)
+        move = mon.on_head_update(_root(2), _root(3))
+        assert move["kind"] == "reorg" and move["depth"] == 1
+        assert all(e["kind"] != "trip" for e in flight.RECORDER.snapshot())
+
+    def test_unknown_root_is_unclassifiable(self):
+        chain = _make_chain([(_root(1), None, 0)])
+        mon = ChainHealthMonitor(chain)
+        assert mon.on_head_update(_root(9), _root(1)) is None
+        assert mon.classify(_root(1), _root(1)) is None
+
+    def test_disarmed_detector_is_inert(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_OBS_ARMED", "0")
+        chain = _make_chain([(_root(1), None, 0), (_root(2), _root(1), 1)])
+        mon = ChainHealthMonitor(chain)
+        assert mon.on_head_update(_root(1), _root(2)) is None
+        mon.on_slot(5)
+        assert mon.head_moves == 0 and mon.head_lag_slots == 0
+
+
+class TestAncestorWalkProperty:
+    """Detector-reported depth pinned against a hand-walked ancestor
+    chain over randomized trees."""
+
+    @staticmethod
+    def _hand_walk(parents, slots, old, new):
+        """Independent pure-dict walk: chains to genesis, set
+        intersection for the fork point."""
+        chain_of = {}
+        for start in (old, new):
+            chain = []
+            r = start
+            while r is not None:
+                chain.append(r)
+                r = parents[r]
+            chain_of[start] = chain
+        old_chain = chain_of[old]
+        new_set = set(chain_of[new])
+        anc = next(r for r in old_chain if r in new_set)
+        return {
+            "ancestor": anc,
+            "depth": slots[old] - slots[anc],
+            "distance": slots[new] - slots[anc],
+            "abandoned": old_chain.index(anc),
+            "adopted": chain_of[new].index(anc),
+        }
+
+    def test_randomized_trees_match_hand_walk(self):
+        rng = np.random.default_rng(1313)
+        for _ in range(25):
+            n = int(rng.integers(3, 40))
+            blocks = [(_root(1), None, 0)]
+            parents = {_root(1): None}
+            slots = {_root(1): 0}
+            for i in range(2, n + 1):
+                parent = blocks[int(rng.integers(0, len(blocks)))][0]
+                root = bytes([i]) * 16 + bytes([255 - i]) * 16
+                slot = slots[parent] + int(rng.integers(1, 4))
+                blocks.append((root, parent, slot))
+                parents[root] = parent
+                slots[root] = slot
+            old = blocks[int(rng.integers(0, len(blocks)))][0]
+            new = blocks[int(rng.integers(0, len(blocks)))][0]
+            if old == new:
+                continue
+            chain = _make_chain(blocks, head=old)
+            mon = ChainHealthMonitor(chain)
+            move = mon.classify(old, new)
+            expect = self._hand_walk(parents, slots, old, new)
+            assert move["ancestor"] == expect["ancestor"]
+            assert move["depth"] == expect["depth"]
+            assert move["distance"] == expect["distance"]
+            assert move["abandoned_blocks"] == expect["abandoned"]
+            assert move["adopted_blocks"] == expect["adopted"]
+            assert move["kind"] == (
+                "extension" if expect["ancestor"] == old else "reorg")
+            # proto-array's own walk agrees with both
+            assert chain.fork_choice.proto.common_ancestor(old, new) \
+                == expect["ancestor"]
+
+
+class TestLagAndStall:
+    def test_lag_gauges_track_the_clock(self):
+        chain = _make_chain([(_root(1), None, 0), (_root(2), _root(1), 3)],
+                            finalized_epoch=1)
+        mon = ChainHealthMonitor(chain)
+        mon.on_slot(3 + 2)                       # head at 3, clock at 5
+        assert mon.head_lag_slots == 2
+        # clock epoch 0 (slot 5 of 8-slot epochs) vs finalized 1 -> 0
+        assert mon.finality_lag_epochs == 0
+        mon.on_slot(4 * SPEC.slots_per_epoch)    # epoch 4, finalized 1
+        assert mon.finality_lag_epochs == 3
+
+    def test_stall_trips_once_per_episode_and_rearms(self):
+        chain = _make_chain([(_root(1), None, 0)], finalized_epoch=0)
+        mon = ChainHealthMonitor(chain)
+        stall_slot = mon.stall_epochs * SPEC.slots_per_epoch
+
+        def stall_trips():
+            return sum(1 for e in flight.RECORDER.snapshot()
+                       if e["kind"] == "trip"
+                       and e.get("reason") == "finality_stall")
+
+        mon.on_slot(stall_slot)
+        assert mon.state == "stalled" and stall_trips() == 1
+        mon.on_slot(stall_slot + 1)              # still stalled: no re-trip
+        assert stall_trips() == 1
+        chain.fork_choice.finalized.epoch = mon.stall_epochs  # recovery
+        mon.on_slot(stall_slot + 2)
+        assert mon.state == "ok"
+        assert any(e["kind"] == "finality_recovered"
+                   for e in flight.RECORDER.snapshot())
+        chain.fork_choice.finalized.epoch = 0    # second episode
+        mon.on_slot(stall_slot + 3)
+        assert mon.state == "stalled" and stall_trips() == 2
+
+    def test_participation_rate_weighted_by_effective_balance(self):
+        from lighthouse_tpu.state_transition import genesis_state
+
+        genesis = genesis_state(16, SPEC, "altair")
+        part = np.zeros(16, np.uint8)
+        part[:8] = 1 << 1                        # TIMELY_TARGET flag
+        head_state = SimpleNamespace(
+            slot=SPEC.slots_per_epoch + 1,       # head in epoch 1
+            previous_epoch_participation=part,
+            validators=genesis.validators)
+        chain = _make_chain([(_root(1), None, 0)], head_state=head_state)
+        mon = ChainHealthMonitor(chain)
+        mon.on_slot(SPEC.slots_per_epoch + 1)
+        assert mon.participation_rate == pytest.approx(0.5)
+        assert mon.participation_epoch == 0
+        # phase0-shaped state (no flags): gauge untouched, no crash
+        chain.head_state = SimpleNamespace(slot=20)
+        mon.on_slot(20)
+        assert mon.participation_epoch == 0
+
+
+class TestSurfaces:
+    def test_status_shape(self):
+        chain = _make_chain([
+            (_root(1), None, 0),
+            (_root(2), _root(1), 1), (_root(3), _root(1), 2),
+        ], head=_root(2))
+        mon = ChainHealthMonitor(chain, name="n7")
+        mon.on_head_update(_root(2), _root(3))
+        mon.on_slot(4)
+        st = mon.status()
+        assert st["node"] == "n7" and st["armed"] is True
+        assert st["reorgs"]["count"] == 1
+        assert st["reorgs"]["last"]["old_head"].startswith("0x")
+        assert st["trip_thresholds"]["deep_reorg_depth"] == mon.trip_depth
+        assert st["state"] == "ok"
+
+    def test_chain_reorg_topic_registered(self):
+        assert CHAIN_REORG_TOPIC in EventStream.TOPICS
+        # subscribable by name (unknown topics raise)
+        EventStream().subscribe([CHAIN_REORG_TOPIC])
+
+    def test_depth_buckets(self):
+        assert [_depth_bucket(d) for d in (1, 2, 3, 4, 5, 8, 9, 100)] == \
+            ["1", "2", "3-4", "3-4", "5-8", "5-8", "9+", "9+"]

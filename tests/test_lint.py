@@ -894,6 +894,35 @@ def test_flight_pass_init_and_reset_exempt(tmp_path):
     assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
 
 
+def test_flight_pass_flags_unrecorded_chain_health_stall(tmp_path):
+    # ISSUE 13: the chain-health detector's stall machine gates the
+    # finality_stall trip — an unrecorded edge silences the trip itself
+    pkg, _ = make_pkg(tmp_path, {"chain/chain_health.py": """
+        class ChainHealthMonitor:
+            def _enter_stall(self, lag):
+                self.state = "stalled"
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == \
+        ["ChainHealthMonitor._enter_stall:set_state"]
+
+
+def test_flight_pass_chain_health_compliant_twin(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/chain_health.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        class ChainHealthMonitor:
+            def _enter_stall(self, lag):
+                self.state = "stalled"
+                flight.trip("finality_stall", lag_epochs=lag)
+
+            def _clear_stall(self, lag):
+                self.state = "ok"
+                flight.emit("finality_recovered", lag_epochs=lag)
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
 def test_flight_pass_out_of_scope_modules_ignored(tmp_path):
     pkg, _ = make_pkg(tmp_path, {"network/peer_manager.py": """
         class Peer:

@@ -49,3 +49,155 @@ class TestBasicSim:
         summary = net.run_slots(6)
         assert summary.blocks_proposed == 6
         assert net.heads_agree()
+
+
+class TestFleetObservatory:
+    """Partition induction + the fleet observer (ISSUE 13)."""
+
+    def _hand_depth(self, proto, old, new):
+        """Independent index-free walk over proto's parent pointers."""
+        def chain_of(root):
+            out = []
+            i = proto.indices[root]
+            while i != -1:
+                out.append((proto.roots[i], int(proto.slots[i])))
+                i = int(proto.parents[i])
+            return out
+
+        old_chain = chain_of(old)
+        new_roots = {r for r, _ in chain_of(new)}
+        anc_slot = next(s for r, s in old_chain if r in new_roots)
+        return old_chain[0][1] - anc_slot
+
+    def test_partition_split_detected_within_one_slot(self):
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(6)
+        assert net.observer.first_split_slot is None
+        assert len(net.observer.snapshots) == 6
+        net.partition([0], [1])
+        net.run_slots(6)
+        assert not net.heads_agree()
+        assert net.observer.first_split_slot == 7  # induced after slot 6
+        assert len(net.observer.snapshots[-1].classes) == 2
+
+    def test_heal_reconverges_with_exact_reorg_depth(self):
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(6)
+        net.partition([0], [1])
+        net.run_slots(6)
+        pre_heal = {n.name: n.chain.head_root for n in net.nodes}
+        net.heal()
+        net.run_slots(16)
+        assert net.heads_agree(), "fleet failed to reconverge"
+        assert net.observer.reconverged_slot is not None
+        final = net.nodes[0].chain.head_root
+        losers = [n for n in net.nodes
+                  if not n.chain.fork_choice.proto.is_descendant(
+                      pre_heal[n.name], final)]
+        assert losers, "partition produced no losing side"
+        for node in losers:
+            st = node.chain.chain_health.status()
+            assert st["reorgs"]["count"] >= 1, \
+                f"{node.name} never recorded its reorg"
+        # every recorded reorg's depth matches a hand-walked ancestor
+        # chain on that node's own proto-array (no finality here, so
+        # nothing was pruned)
+        checked = 0
+        for node in net.nodes:
+            for move in node.chain.chain_health.reorg_log:
+                expect = self._hand_depth(
+                    node.chain.fork_choice.proto,
+                    move["old_head"], move["new_head"])
+                assert move["depth"] == expect
+                checked += 1
+        assert checked >= len(losers)
+
+    def test_fleet_books_balance_and_timeline_labels(self):
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(4)
+        net.partition([0], [1])
+        net.run_slots(4)
+        net.heal()
+        net.run_slots(10)
+        assert all(s.unaccounted == 0 for s in net.observer.snapshots)
+        assert net.observer.books_balanced()
+        total = net.observer.snapshots[-1].books["total"]
+        assert total["requested"] == (
+            total["imported"] + total["retried"] + total["abandoned"]
+            + total["inflight"])
+        kinds = {e["kind"] for e in net.observer.timeline()}
+        assert {"fleet_partition", "fleet_split", "fleet_heal"} <= kinds
+        # per-node attribution on the merged timeline
+        nodes = {e["node"] for e in net.observer.timeline()
+                 if e["kind"] == "chain_reorg"}
+        assert nodes <= {"node-0", "node-1"} and nodes
+
+    def test_roll_up_books_audits_backfill_and_processor_ledgers(self):
+        """The roll-up's backfill/processor branches through the real
+        code path (simulator nodes carry only sync books today; the
+        chaos-soak composition adds the rest — the audit must already
+        be correct for them)."""
+        import threading
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.simulator import FleetObserver
+
+        sync = SimpleNamespace(
+            books={"requested": 5, "imported": 4, "retried": 1,
+                   "abandoned": 0}, inflight_attempts=0)
+        # backfill: deficit 2 with only 1 in flight -> 1 unaccounted
+        backfill = SimpleNamespace(
+            books={"requested": 3, "imported": 1, "retried": 0,
+                   "abandoned": 0}, inflight_attempts=1)
+        metrics = SimpleNamespace(
+            _lock=threading.Lock(), enqueued={"att": 10},
+            processed={"att": 6}, shed={("att", "purged"): 1})
+        # processor: enq 10 = done 6 + shed 1 + queued 2 + LOST 1
+        proc = SimpleNamespace(
+            metrics=metrics, _queues={"att": [1, 2]},
+            _inflight=set(), _manager_holding=False)
+        node = SimpleNamespace(
+            name="n0", net=SimpleNamespace(sync=sync, backfill=backfill),
+            processor=proc)
+        books, unaccounted = FleetObserver._roll_up_books([node])
+        assert set(books["per_node"]["n0"]) == {"sync", "backfill",
+                                                "processor"}
+        assert books["total"]["requested"] == 8
+        assert unaccounted == 2      # backfill leak + idle processor leak
+        # a BUSY processor's positive deficit is in-flight, not a leak
+        proc._inflight = {"task"}
+        _, unacc = FleetObserver._roll_up_books([node])
+        assert unacc == 1
+        # a negative deficit (more accounted than enqueued) always fires
+        metrics.processed = {"att": 13}
+        _, unacc = FleetObserver._roll_up_books([node])
+        assert unacc == 1 + 6        # backfill 1 + processor |10-13-1-2|
+
+    def test_rpc_fabric_partition_blocks_calls(self):
+        from lighthouse_tpu.network.rpc import RpcError, RpcFabric
+
+        fabric = RpcFabric()
+        a = fabric.join("a")
+        fabric.join("b").register("/p/1", lambda src, data: [b"ok"])
+        assert fabric.call("a", "b", "/p/1", b"") == [b"ok"]
+        fabric.disconnect("a", "b")
+        with pytest.raises(RpcError, match="partitioned"):
+            fabric.call("a", "b", "/p/1", b"")
+        with pytest.raises(RpcError, match="partitioned"):
+            fabric.call("b", "a", "/p/1", b"")
+        fabric.reconnect("a", "b")
+        assert fabric.call("a", "b", "/p/1", b"") == [b"ok"]
+
+    def test_observer_disarmed_by_kill_switch(self, monkeypatch):
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        monkeypatch.setenv("LHTPU_OBS_ARMED", "0")
+        flight.RECORDER.reconfigure()
+        try:
+            net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+            net.run_slots(3)
+            assert net.observer.snapshots == []
+            assert net.nodes[0].chain.chain_health.head_moves == 0
+        finally:
+            monkeypatch.delenv("LHTPU_OBS_ARMED")
+            flight.RECORDER.reconfigure()

@@ -7,9 +7,12 @@ flight-recorder event.  A new transition path added without its emit
 silently punches a hole in the black box: the next production incident
 dumps a ring with the decisive state change missing.
 
-This pass scans the breaker/ladder modules (``crypto/bls/api.py``,
-``processor/admission.py``, ``state_transition/epoch_processing.py``)
-for *transition sites*:
+This pass scans the breaker/ladder/detector modules
+(``crypto/bls/api.py``, ``processor/admission.py``,
+``state_transition/epoch_processing.py``, ``chain/chain_health.py`` —
+the last one's finality-stall machine gates the ``finality_stall``
+trip, so an unrecorded edge would silence the trip itself) for
+*transition sites*:
 
 - an assignment to an attribute named ``state`` or ``rung`` (the
   circuit-breaker / ladder state machines), or
@@ -34,7 +37,8 @@ import re
 from tools.lint import Context, Finding
 
 TARGET_MODULES = ("crypto/bls/api.py", "processor/admission.py",
-                  "state_transition/epoch_processing.py")
+                  "state_transition/epoch_processing.py",
+                  "chain/chain_health.py")
 
 _STATE_ATTRS = {"state", "rung"}
 _STATE_KEYS = {"open_until"}

@@ -71,6 +71,13 @@ FAMILY_OWNERS = {
     "slo_": "lighthouse_tpu/chain/slo.py",
     "invariant_": "lighthouse_tpu/common/monitors.py",
     "tracing_evicted": "lighthouse_tpu/common/metrics.py",
+    # the fleet observatory (PR 13): per-node chain health owns the
+    # reorg/lag/participation series, the fleet observer the fleet_*
+    "reorg_": "lighthouse_tpu/chain/chain_health.py",
+    "head_lag_": "lighthouse_tpu/chain/chain_health.py",
+    "finality_lag_": "lighthouse_tpu/chain/chain_health.py",
+    "chain_participation_": "lighthouse_tpu/chain/chain_health.py",
+    "fleet_": "lighthouse_tpu/simulator.py",
 }
 
 
