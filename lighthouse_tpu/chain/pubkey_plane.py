@@ -1,0 +1,446 @@
+"""Device-resident validator pubkey plane for the attestation firehose.
+
+The registry's pubkey column lives ON DEVICE as an affine Montgomery
+limb table; committee aggregate pubkeys for the ingest lane's
+(slot, committee index, beacon_block_root) groups become a gather +
+G1 MSM in one fused dispatch (ops/pubkey_kernels) instead of per-set
+host point additions in ``SignatureSet.aggregate_pubkey`` /
+``pre_aggregation._fold_group`` — the per-set host cost ISSUE 14's
+profile names as the post-decode firehose ceiling.
+
+Rungs, mirroring the epoch/BLS supervisor shape (PR 4 breaker):
+
+- ``device``  — the fused gather+MSM kernel over the resident table;
+- ``sharded`` — same kernel, lanes partitioned over the device mesh
+  (parallel/pubkey_sharded);
+- ``reference`` — host point adds (one ``g1_mul`` per unique
+  (group, pubkey) after scalar-sum collapse), the authoritative
+  terminal rung.
+
+Faults on a device rung recover on reference, count
+``pubkey_plane_faults_total``, and trip a consecutive-fault breaker
+(shared LHTPU_SUPERVISOR_* knobs); successes close it.  The breaker
+transitions emit flight events like the other planes.
+
+Table refresh/invalidation discipline: validator pubkeys are
+append-only and immutable per index (consensus invariant), so a table
+covering rows [0, T) stays valid for any registry that grew from the
+same prefix.  The plane fingerprints the registry's pubkey column
+(sha256) at build; a registry object it has not seen yet is verified
+against the prefix fingerprint before reuse and the check result is
+cached on the object — a MISMATCH rebuilds from scratch (all-or-nothing
+swap: the new table is fully built before the old one is replaced, a
+mid-build fault leaves the old table serving).  The PR 6 epoch
+bridge's write-back calls :func:`notify_registry` after registry
+updates so growth refreshes eagerly instead of on first use.
+
+``LHTPU_PUBKEY_PLANE=0`` is the kill switch: the plane always answers
+with the reference rung and never touches jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+_BACKENDS = ("device", "sharded", "reference")
+_DEVICE_MIN_DEFAULT = 256
+
+_BREAKER = {"fails": 0, "open_until": 0.0, "backoff": 0.0}
+_BREAKER_LOCK = threading.Lock()
+_AUTO_RUNG: str | None = None
+
+
+def enabled() -> bool:
+    return envreg.get_bool("LHTPU_PUBKEY_PLANE", True)
+
+
+def reset_pubkey_plane() -> None:
+    """Close the breaker, drop the memoized auto rung and the table
+    (tests / operator reset)."""
+    global _AUTO_RUNG, _PLANE
+    with _BREAKER_LOCK:
+        _BREAKER.update(fails=0, open_until=0.0, backoff=0.0)
+    _AUTO_RUNG = None
+    _PLANE = PubkeyPlane()
+
+
+def resolve_pubkey_backend(n_lanes: int) -> str:
+    """Which rung folds an ``n_lanes`` batch: kill switch first, then
+    LHTPU_PUBKEY_BACKEND force, the breaker, then auto (device only on
+    a real TPU at or above LHTPU_PUBKEY_DEVICE_MIN lanes — XLA-CPU
+    defaults to reference: first-dispatch compiles dominate short
+    processes; operators can force the device rung on long-lived
+    fallback nodes).  Small batches never import jax."""
+    if not enabled():
+        return "reference"
+    forced = envreg.get_choice("LHTPU_PUBKEY_BACKEND", _BACKENDS)
+    if forced:
+        return forced
+    with _BREAKER_LOCK:
+        open_until = _BREAKER["open_until"]
+    if open_until > time.monotonic():
+        return "reference"
+    device_min = envreg.get_int("LHTPU_PUBKEY_DEVICE_MIN",
+                                _DEVICE_MIN_DEFAULT)
+    if n_lanes < max(device_min, 1):
+        return "reference"
+    global _AUTO_RUNG
+    if _AUTO_RUNG is None:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            _AUTO_RUNG = "reference"
+        else:
+            _AUTO_RUNG = "sharded" if len(jax.devices()) > 1 else "device"
+    return _AUTO_RUNG
+
+
+def _breaker_ok() -> None:
+    was_tripped = False
+    with _BREAKER_LOCK:
+        was_tripped = _BREAKER["open_until"] > 0.0
+        _BREAKER["fails"] = 0
+        _BREAKER["backoff"] = 0.0
+        _BREAKER["open_until"] = 0.0
+    if was_tripped:
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        flight.emit("breaker", plane="pubkey", old="open", new="closed")
+
+
+def _breaker_fault() -> None:
+    threshold = envreg.get_int("LHTPU_SUPERVISOR_FAILS", 1) or 1
+    backoff_init = float(
+        envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_S", 1.0) or 1.0)
+    ceiling = float(
+        envreg.get_float("LHTPU_SUPERVISOR_BACKOFF_MAX_S", 60.0) or 60.0)
+    opened = False
+    with _BREAKER_LOCK:
+        fails = _BREAKER["fails"] = _BREAKER["fails"] + 1
+        if fails >= threshold:
+            backoff = _BREAKER["backoff"] or backoff_init
+            _BREAKER["open_until"] = time.monotonic() + backoff
+            _BREAKER["backoff"] = min(backoff * 2, ceiling)
+            _BREAKER["fails"] = 0
+            opened = True
+    from lighthouse_tpu.common import flight_recorder as flight
+
+    flight.emit("breaker", plane="pubkey", old="closed",
+                new="open" if opened else "counting", fails=fails)
+
+
+def record_fold(backend: str, seconds: float, n_groups: int) -> None:
+    try:
+        REGISTRY.counter(
+            "pubkey_plane_batches_total",
+            "aggregate-pubkey fold batches by executing backend",
+        ).labels(backend=backend).inc()
+        REGISTRY.histogram(
+            "pubkey_plane_fold_seconds",
+            "aggregate-pubkey fold wall time by backend",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                     5.0, 60.0),
+        ).labels(backend=backend).observe(seconds)
+        REGISTRY.counter(
+            "pubkey_plane_groups_total",
+            "merged (slot, committee index, beacon_block_root) lanes "
+            "folded").inc(n_groups)
+    except Exception as e:
+        record_swallowed("pubkey_plane.record_fold", e)
+
+
+def record_plane_fault(backend: str, kind: str) -> None:
+    try:
+        REGISTRY.counter(
+            "pubkey_plane_faults_total",
+            "device pubkey-plane faults recovered on the reference rung",
+        ).labels(backend=backend, kind=kind).inc()
+    except Exception as e:
+        record_swallowed("pubkey_plane.record_fault", e)
+
+
+class _TableUnavailable(RuntimeError):
+    """ensure_table failed — the fault and breaker step were already
+    recorded there; fold() must not account them a second time."""
+
+
+class PubkeyPlane:
+    """The resident table + fold entry point (module singleton via
+    :func:`get_plane`; a fresh instance per reset keeps tests
+    hermetic)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None          # (tx, ty) device arrays
+        self._table_rows = 0        # valid rows in the table
+        self._rows = None           # host (x, y) limb rows for [0, table_rows)
+        self._prefix_sha = b""      # sha256 of pubkey rows [0, table_rows)
+        # verified registry objects, id -> STRONG ref (a live ref can't
+        # have its id() recycled by a different registry — the memo can
+        # never alias; bounded, newest-wins)
+        self._seen: dict[int, object] = {}
+
+    # -- table discipline --------------------------------------------------
+
+    def _column_sha(self, validators, n: int) -> bytes:
+        return hashlib.sha256(
+            np.ascontiguousarray(validators.pubkeys[:n]).tobytes()).digest()
+
+    def _registry_matches(self, validators) -> bool:
+        """True when the resident table is a prefix of this registry
+        (append-only discipline); memoized per registry object."""
+        if self._table_rows == 0:
+            return False
+        if len(validators) < self._table_rows:
+            return False
+        if id(validators) in self._seen:
+            return True
+        ok = self._column_sha(validators, self._table_rows) == \
+            self._prefix_sha
+        if ok:
+            if len(self._seen) >= 4:
+                self._seen.pop(next(iter(self._seen)))
+            self._seen[id(validators)] = validators
+        return ok
+
+    def ensure_table(self, validators) -> bool:
+        """Make the device table cover this registry — incremental
+        append when the prefix matches (only the NEW rows decompress
+        and limb-convert; the resident rows' host limbs are cached),
+        full rebuild otherwise.  A registry SHORTER than the table is
+        served as-is: the registry is append-only (deposits apply in
+        deposit-index order on every branch — the same argument that
+        lets the fold read the head registry), so the resident table
+        already covers any prefix; rebuilding here would shrink the
+        table and pay a full-registry rebuild under this lock on every
+        epoch replay of an older state.  The swap is all-or-nothing:
+        the new (tx, ty) pair is fully built before it replaces the
+        old one, so a mid-build fault leaves the previous table
+        intact.  Returns False on failure (callers fall back to the
+        reference rung)."""
+        from lighthouse_tpu.ops import pubkey_kernels
+
+        n = len(validators)
+        with self._lock:
+            if self._registry_matches(validators) and self._table_rows >= n:
+                return True
+            if 0 < n < self._table_rows:
+                return True         # prefix registry: already covered
+            try:
+                if self._registry_matches(validators):
+                    start = self._table_rows       # append-only growth
+                    rows_x, rows_y = self._rows
+                else:
+                    start, rows_x, rows_y = 0, None, None
+                new_x, new_y = pubkey_kernels.mont_rows(
+                    self._decompress_rows(validators, start, n))
+                if start:
+                    rows_x = np.concatenate([rows_x, new_x])
+                    rows_y = np.concatenate([rows_y, new_y])
+                else:
+                    rows_x, rows_y = new_x, new_y
+                table = pubkey_kernels.table_from_rows(rows_x, rows_y)
+                sha = self._column_sha(validators, n)
+            except Exception as e:
+                record_plane_fault("device", "table_" + type(e).__name__)
+                _breaker_fault()
+                return False
+            self._table = table
+            self._table_rows = n
+            self._rows = (rows_x, rows_y)
+            self._prefix_sha = sha
+            self._seen = {id(validators): validators}
+            try:
+                REGISTRY.counter(
+                    "pubkey_plane_refreshes_total",
+                    "device pubkey-table refreshes by kind",
+                ).labels(kind="append" if start else "rebuild").inc()
+                REGISTRY.gauge(
+                    "pubkey_plane_table_rows",
+                    "validator rows resident in the device pubkey table",
+                ).set(n)
+            except Exception as e:
+                record_swallowed("pubkey_plane.refresh_metric", e)
+            return True
+
+    @staticmethod
+    def _decompress(pk_bytes: bytes):
+        from lighthouse_tpu.crypto import bls
+
+        return bls.PublicKey.interned(pk_bytes).point
+
+    @staticmethod
+    def _decompress_rows(validators, start: int, n: int) -> list:
+        """Affine points for registry rows [start, n): ONE native
+        batched decompress + [r]P membership sweep when available
+        (~0.5 ms/key vs ~6 ms python per key — the difference between
+        minutes and tens of minutes on a mainnet-scale rebuild), the
+        interned python path otherwise.  A row that fails either step
+        raises exactly like the python path — the caller's table-build
+        fault accounting is unchanged."""
+        from lighthouse_tpu.crypto import bls
+
+        rows = [validators.pubkeys[i].tobytes() for i in range(start, n)]
+        try:
+            from lighthouse_tpu.ops import native_bls
+
+            if native_bls.available():
+                pts = native_bls.g1_decompress_batch(rows)
+                if pts is not None:
+                    bad = [i for i, p in enumerate(pts)
+                           if p is None or p == native_bls.G1_INF]
+                    if bad:
+                        raise bls.BlsError(
+                            f"pubkey row {start + bad[0]} undecompressable")
+                    verdicts = native_bls.g1_in_subgroup_batch(pts)
+                    if verdicts is not None:
+                        if any(v != 1 for v in verdicts):
+                            i = next(i for i, v in enumerate(verdicts)
+                                     if v != 1)
+                            raise bls.BlsError(
+                                f"pubkey row {start + i} not in G1 "
+                                "subgroup")
+                        return pts
+        except bls.BlsError:
+            raise
+        except Exception as e:
+            record_swallowed("pubkey_plane.decompress_rows_native", e)
+        return [PubkeyPlane._decompress(pk) for pk in rows]
+
+    # -- the fold ----------------------------------------------------------
+
+    def fold(self, validators, indices: np.ndarray, scalars: np.ndarray,
+             groups: np.ndarray, n_groups: int) -> list:
+        """Blinded committee-aggregate pubkeys: out[g] = Σ_{i: groups[i]
+        == g} scalars[i]·pubkey(indices[i]) as host affine points (None
+        for an identity aggregate — such a merged set can never
+        verify).  Routed device → reference per the breaker ladder;
+        device faults recover on reference within this call."""
+        backend = resolve_pubkey_backend(len(indices))
+        t0 = time.perf_counter()
+        if backend in ("device", "sharded"):
+            try:
+                out = self._fold_device(validators, indices, scalars,
+                                        groups, n_groups, backend)
+                _breaker_ok()
+                record_fold(backend, time.perf_counter() - t0, n_groups)
+                return out
+            except _TableUnavailable:
+                pass    # ensure_table already counted fault + breaker step
+            except Exception as exc:   # device fault: recover on host
+                record_plane_fault(backend, type(exc).__name__)
+                _breaker_fault()
+        out = self._fold_host(validators, indices, scalars, groups,
+                              n_groups)
+        record_fold("reference", time.perf_counter() - t0, n_groups)
+        return out
+
+    def _fold_device(self, validators, indices, scalars, groups,
+                     n_groups: int, backend: str) -> list:
+        from lighthouse_tpu.ops import bigint as bi
+        from lighthouse_tpu.ops import pubkey_kernels
+
+        if not self.ensure_table(validators):
+            raise _TableUnavailable("pubkey table unavailable")
+        with self._lock:
+            # snapshot: a concurrent refresh swaps the whole (tx, ty)
+            # tuple (tables only grow — ensure_table never shrinks),
+            # so one read under the lock keeps this fold consistent
+            table = self._table
+        if backend == "sharded":
+            from lighthouse_tpu.parallel import pubkey_sharded
+
+            xa, ya, inf = pubkey_sharded.gather_fold_sharded(
+                table, np.asarray(indices, np.int64),
+                np.asarray(scalars, np.uint64),
+                np.asarray(groups, np.int64), n_groups)
+        else:
+            xa, ya, inf = pubkey_kernels.gather_fold(
+                table, np.asarray(indices, np.int64),
+                np.asarray(scalars, np.uint64),
+                np.asarray(groups, np.int64), n_groups)
+        out: list = []
+        for g in range(n_groups):
+            if bool(inf[g]):
+                out.append(None)
+                continue
+            out.append((int(bi.from_mont(xa[g])), int(bi.from_mont(ya[g]))))
+        return out
+
+    def _fold_host(self, validators, indices, scalars, groups,
+                   n_groups: int) -> list:
+        """Reference rung: scalar-sum collapse per (group, pubkey) —
+        r₁·pk + r₂·pk = (r₁+r₂)·pk, sound regardless of which sets the
+        blinders came from — then ONE native segment-MSM over the
+        unique pairs (ops/native_bls.g1_lincomb_groups, ~100 µs/point;
+        host g1_mul + point adds when the native layer is unavailable).
+        This IS the old per-set host aggregation, minus the redundant
+        multiplications for repeated keys."""
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.crypto.bls.fields import R as _R
+
+        sums: dict[tuple[int, bytes], int] = {}
+        for i in range(len(indices)):
+            key = (int(groups[i]),
+                   validators.pubkeys[int(indices[i])].tobytes())
+            sums[key] = (sums.get(key, 0) + int(scalars[i])) % _R
+        entries = [(g, pk_bytes, s) for (g, pk_bytes), s in sums.items()
+                   if s != 0]
+        try:
+            from lighthouse_tpu.ops import native_bls
+
+            if native_bls.available():
+                res = native_bls.g1_lincomb_groups(
+                    [self._decompress(pk) for _g, pk, _s in entries],
+                    [s for _g, _pk, s in entries],
+                    [g for g, _pk, _s in entries], n_groups)
+                if res is not None:
+                    return res
+        except Exception as e:
+            record_swallowed("pubkey_plane.fold_host_native", e)
+        acc: list = [cv.INF] * n_groups
+        for g, pk_bytes, s in entries:
+            pt = self._decompress(pk_bytes)
+            acc[g] = cv.g1_add(acc[g], cv.g1_mul(pt, s))
+        return [None if pt is cv.INF else pt for pt in acc]
+
+
+_PLANE = PubkeyPlane()
+
+
+def get_plane() -> PubkeyPlane:
+    return _PLANE
+
+
+def notify_registry(validators) -> None:
+    """Registry write-back hook (PR 6 epoch bridge / deposit
+    processing): refresh the device copy eagerly when a device rung is
+    armed.  Never raises — a failed refresh is a counted fault and the
+    next fold recovers on reference."""
+    try:
+        if resolve_pubkey_backend(
+                envreg.get_int("LHTPU_PUBKEY_DEVICE_MIN",
+                               _DEVICE_MIN_DEFAULT)) == "reference":
+            return
+        get_plane().ensure_table(validators)
+    except Exception as e:
+        record_swallowed("pubkey_plane.notify_registry", e)
+
+
+__all__ = [
+    "PubkeyPlane",
+    "enabled",
+    "get_plane",
+    "notify_registry",
+    "record_fold",
+    "record_plane_fault",
+    "reset_pubkey_plane",
+    "resolve_pubkey_backend",
+]

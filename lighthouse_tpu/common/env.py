@@ -239,6 +239,30 @@ _register("LHTPU_PRE_BLS", "1",
           "dedup + blinded same-message merge in pool/pre_aggregation) "
           "so every signature set pays its own pairing.")
 
+# -- wire-to-device ingest (ssz/columnar, chain/columnar_ingest,
+#    chain/pubkey_plane, ops/pubkey_kernels) -----------------------------------
+
+_register("LHTPU_INGEST_COLUMNAR", "1",
+          "0 disables the columnar wire path everywhere: gossip "
+          "attestation batches fall back to per-message scalar SSZ "
+          "decode + the per-object verification pipeline (routers "
+          "snapshot the switch at construction so one processor batch "
+          "never mixes wire-bytes and object payloads).")
+_register("LHTPU_PUBKEY_PLANE", "1",
+          "0 is the pubkey-plane kill switch: every committee "
+          "aggregate-pubkey fold answers on the host reference rung "
+          "and never touches jax.  1 (default) lets the supervisor "
+          "ladder route folds to the device-resident gather+MSM rungs "
+          "per LHTPU_PUBKEY_BACKEND / the auto policy.")
+_register("LHTPU_PUBKEY_BACKEND", None,
+          "Force the pubkey-plane fold rung (device|sharded|"
+          "reference); unset = auto (device/sharded on TPU above "
+          "LHTPU_PUBKEY_DEVICE_MIN lanes, reference otherwise).")
+_register("LHTPU_PUBKEY_DEVICE_MIN", "256",
+          "Fold-lane count at or above which the pubkey-plane auto "
+          "routing considers a device rung (smaller batches never "
+          "import jax).")
+
 # -- device epoch processing (state_transition/epoch_processing seam,
 #    state_transition/epoch_device, ops/epoch_kernels) -------------------------
 

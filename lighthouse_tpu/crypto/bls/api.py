@@ -44,6 +44,27 @@ from lighthouse_tpu.common.utils import LruCache  # noqa: E402
 # ~1M validators fit (mainnet registry scale)
 _PK_INTERN = LruCache(capacity=1 << 20)
 
+# hash-to-curve memo: a slot's firehose re-verifies the same <=64
+# distinct attestation messages every admission sweep, and H(m) is a
+# pure ~8 ms map on the host — amortize it across sweeps.  Bounded so a
+# hostile stream of unique messages stays O(1) memory (default-DST
+# messages only; `sign` keeps its explicit-dst path uncached).
+_H2G_MEMO = LruCache(capacity=512)
+
+# wire-signature interning (Signature.interned): bounded so a hostile
+# stream of unique signatures stays O(1) memory — a slot's honest
+# firehose carries far fewer distinct signatures than this
+_SIG_INTERN = LruCache(capacity=1 << 16)
+
+
+def _hash_to_g2_memo(message: bytes):
+    pt = _H2G_MEMO.get(message)
+    record_cache("hash_g2", hit=pt is not None)
+    if pt is None:
+        pt = hash_to_g2(message)
+        _H2G_MEMO.put(message, pt)
+    return pt
+
 
 class PublicKey:
     """Compressed G1 public key with lazy decompression + caching."""
@@ -171,6 +192,23 @@ class Signature:
         return f"Signature({self._bytes.hex()[:16]}…)"
 
     @staticmethod
+    def interned(data: bytes) -> "Signature":
+        """Process-wide interning for byte-identical wire signatures:
+        the decompressed point (and subgroup verdict — a property of
+        the bytes) is paid once per distinct signature, no matter how
+        many admission sweeps or duplicate gossip copies carry it.  The
+        wire ingest lane's counterpart to the scalar path's long-lived
+        Attestation objects caching their own `_point`."""
+        sig = _SIG_INTERN.get(data)
+        if sig is None:
+            record_cache("sig_intern", hit=False)
+            sig = Signature(data)
+            _SIG_INTERN.put(bytes(data), sig)
+        else:
+            record_cache("sig_intern", hit=True)
+        return sig
+
+    @staticmethod
     def decompress_batch(sigs: Sequence["Signature"]) -> bool:
         """Fill `_point` for every not-yet-decompressed signature in ONE
         native batch call (ops/native_bls.g2_decompress_batch) — one
@@ -181,8 +219,8 @@ class Signature:
         curve / malformed); a valid INFINITY encoding decompresses to
         cv.INF and returns True — callers that must reject infinity
         signatures (all verifiers) check the cached point, as
-        verify_sets_pipeline does.  Signatures before a failing one
-        keep their decompressed points cached."""
+        verify_sets_pipeline does.  Every decompressable signature
+        keeps its point cached even when another in the batch fails."""
         pending = [s for s in sigs if s._point is None]
         if not pending:
             return True
@@ -196,22 +234,77 @@ class Signature:
             record_swallowed("bls.decompress_batch.native", e)
             native = None
         if native is None:
-            try:
-                for s in pending:
+            ok = True
+            for s in pending:
+                try:
                     s.point_unchecked()
-            except (BlsError, ValueError):
-                return False
-            return True
+                except (BlsError, ValueError):
+                    ok = False
+            return ok
         res = native.g2_decompress_batch([s._bytes for s in pending])
+        ok = True
         for s, r in zip(pending, res):
             if r is None:
-                return False
-            if r == native.G2_INF:
+                ok = False      # keep caching the rest: one malformed
+                continue        # signature must not cost the batch its
+            if r == native.G2_INF:   # amortized decompressions
                 s._point = cv.INF
             else:
                 (xa, xb), (ya, yb) = r
                 s._point = (cv.Fq2(xa, xb), cv.Fq2(ya, yb))
-        return True
+        return ok
+
+    @staticmethod
+    def subgroup_check_batch(sigs: Sequence["Signature"]) -> bool:
+        """Complete the G2 membership test for every decompressed,
+        not-yet-checked signature in ONE native crossing
+        (ops/native_bls.g2_in_subgroup_batch, ~70 µs/point vs ~1.6 ms
+        for the per-signature host ψ check).  Passing signatures are
+        marked checked (a property of the bytes — interned signatures
+        pay this once ever); failing or infinity signatures stay
+        UNMARKED so per-signature paths re-check and attribute.
+        Returns True when every pending signature passed.  Falls back
+        to the host ψ loop when the native layer is unavailable."""
+        pending = []
+        pts = []
+        all_finite = True
+        for s in sigs:
+            if s._subgroup_ok:
+                continue
+            try:
+                pt = s.point_unchecked()
+            except (BlsError, ValueError):
+                all_finite = False   # undecompressable: can't verify
+                continue
+            if pt is cv.INF:
+                all_finite = False   # verifiers reject infinity anyway
+                continue
+            pending.append(s)
+            pts.append(pt)
+        if not pending:
+            return all_finite
+        native = None
+        try:
+            from lighthouse_tpu.ops import native_bls
+
+            if native_bls.available():
+                native = native_bls
+        except Exception as e:
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            record_swallowed("bls.subgroup_batch.native", e)
+        verdicts = (native.g2_in_subgroup_batch(pts)
+                    if native is not None else None)
+        if verdicts is None:
+            verdicts = [1 if cv.g2_in_subgroup_fast(pt) else 0
+                        for pt in pts]
+        ok = all_finite
+        for s, v in zip(pending, verdicts):
+            if v == 1:
+                s.mark_subgroup_checked()
+            else:
+                ok = False
+        return ok
 
     @staticmethod
     def aggregate(sigs: Sequence["Signature"]) -> "Signature":
@@ -279,7 +372,7 @@ def verify(pubkey: PublicKey, message: bytes, signature: Signature) -> bool:
         return False
     if sig_pt is cv.INF:
         return False
-    h = hash_to_g2(message)
+    h = _hash_to_g2_memo(message)
     res = cv.multi_pairing([
         (cv.g1_neg(cv.g1_generator()), sig_pt),
         (pk_pt, h),
@@ -305,7 +398,7 @@ def aggregate_verify(
         sig_pt = signature.point
         pairs = [(cv.g1_neg(cv.g1_generator()), sig_pt)]
         for pk, msg in zip(pubkeys, messages):
-            pairs.append((pk.point, hash_to_g2(msg)))
+            pairs.append((pk.point, _hash_to_g2_memo(msg)))
     except (BlsError, ValueError):
         return False
     if sig_pt is cv.INF:
@@ -426,7 +519,7 @@ def _verify_signature_sets_reference(sets: Sequence[SignatureSet],
         while rand == 0:
             rand = secrets.randbits(RAND_BITS)
         sig_acc = cv.g2_add(sig_acc, cv.g2_mul(sig_pt, rand))
-        pairs.append((cv.g1_mul(agg_pk, rand), hash_to_g2(message)))
+        pairs.append((cv.g1_mul(agg_pk, rand), _hash_to_g2_memo(message)))
     pairs.append((cv.g1_neg(cv.g1_generator()), sig_acc))
     now = time.perf_counter()
     record_stage("reference", "accumulate", now - t0)

@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -217,6 +218,8 @@ struct Fp2 { Fp a, b; };
 Fp2 XI_M;        // 1 + u
 Fp INV2_M;       // to_mont(2^-1)
 Fp2 FROB_G[6];   // gamma[k] = XI^(k*(p-1)/6)
+Fp2 PSI_CX_M;    // xi^(-(p-1)/3)  (curve.py PSI_CX)
+Fp2 PSI_CY_M;    // xi^(-(p-1)/2)  (curve.py PSI_CY)
 
 inline void f2_add(Fp2& r, const Fp2& x, const Fp2& y) {
     add(r.a, x.a, y.a);
@@ -622,6 +625,10 @@ void do_init() {
     FROB_G[0] = {ONE_M, ZERO};
     f2_pow(FROB_G[1], XI_M, EXP_FROB, 48);
     for (int k = 2; k < 6; k++) f2_mul(FROB_G[k], FROB_G[k - 1], FROB_G[1]);
+    // psi endomorphism coefficients: FROB_G[2] = xi^((p-1)/3),
+    // FROB_G[3] = xi^((p-1)/2) — the psi constants are their inverses
+    f2_inv(PSI_CX_M, FROB_G[2]);
+    f2_inv(PSI_CY_M, FROB_G[3]);
     INITED = true;
 }
 
@@ -696,6 +703,263 @@ int g2_decompress_one(const uint8_t* in, uint8_t* out) {
     return 0;
 }
 
+// ---- G2 subgroup check (psi) ----------------------------------------------
+// psi(x, y) = (conj(x)*PSI_CX, conj(y)*PSI_CY); Q is in the prime-order
+// subgroup iff psi(Q) == [BLS_X]Q with BLS_X = -0xd201000000010000,
+// i.e. [|BLS_X|]Q == -psi(Q)  (mirrors curve.py g2_in_subgroup_fast).
+
+struct G2j { Fp2 X, Y, Z; bool inf; };
+
+// dbl-2009-l (a = 0); alias-safe: all reads precede the writes
+void g2j_dbl(G2j& r, const G2j& p) {
+    if (p.inf) { r.inf = true; return; }
+    Fp2 A, B, C, D, E, F, X3, Y3, Z3, t;
+    f2_sqr(A, p.X);
+    f2_sqr(B, p.Y);
+    f2_sqr(C, B);
+    f2_add(t, p.X, B); f2_sqr(t, t); f2_sub(t, t, A); f2_sub(t, t, C);
+    f2_add(D, t, t);
+    f2_add(E, A, A); f2_add(E, E, A);
+    f2_sqr(F, E);
+    f2_sub(X3, F, D); f2_sub(X3, X3, D);
+    f2_sub(t, D, X3); f2_mul(Y3, E, t);
+    f2_add(t, C, C); f2_add(t, t, t); f2_add(t, t, t);   // 8C
+    f2_sub(Y3, Y3, t);
+    f2_mul(Z3, p.Y, p.Z); f2_add(Z3, Z3, Z3);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = f2_is_zero(Z3);   // Y == 0: 2-torsion doubles to infinity
+}
+
+// madd-2007-bl mixed addition (Z2 = 1); adversarial inputs may hit the
+// equal/opposite edge cases, both handled exactly
+void g2j_madd(G2j& r, const G2j& p, const Fp2& qx, const Fp2& qy) {
+    if (p.inf) {
+        r.X = qx; r.Y = qy; r.Z = {ONE_M, ZERO}; r.inf = false;
+        return;
+    }
+    Fp2 Z1Z1, U2, S2, H, HH, I, J, rr, V, X3, Y3, Z3, t;
+    f2_sqr(Z1Z1, p.Z);
+    f2_mul(U2, qx, Z1Z1);
+    f2_mul(t, p.Z, Z1Z1); f2_mul(S2, qy, t);
+    f2_sub(H, U2, p.X);
+    f2_sub(rr, S2, p.Y); f2_add(rr, rr, rr);
+    if (f2_is_zero(H)) {
+        if (f2_is_zero(rr)) { g2j_dbl(r, p); return; }
+        r.inf = true; return;                     // P + (-P)
+    }
+    f2_sqr(HH, H);
+    f2_add(I, HH, HH); f2_add(I, I, I);
+    f2_mul(J, H, I);
+    f2_mul(V, p.X, I);
+    f2_sqr(X3, rr); f2_sub(X3, X3, J);
+    f2_sub(X3, X3, V); f2_sub(X3, X3, V);
+    f2_sub(t, V, X3); f2_mul(Y3, rr, t);
+    f2_mul(t, p.Y, J); f2_add(t, t, t);
+    f2_sub(Y3, Y3, t);
+    f2_add(t, p.Z, H); f2_sqr(t, t);
+    f2_sub(t, t, Z1Z1); f2_sub(Z3, t, HH);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = f2_is_zero(Z3);
+}
+
+void g2j_mul_u64(G2j& r, const Fp2& qx, const Fp2& qy, u64 k) {
+    r.inf = true;
+    bool started = false;
+    for (int i = 63; i >= 0; i--) {
+        if (started) g2j_dbl(r, r);
+        if ((k >> i) & 1) {
+            if (!started) {
+                r.X = qx; r.Y = qy; r.Z = {ONE_M, ZERO};
+                r.inf = false; started = true;
+            } else {
+                g2j_madd(r, r, qx, qy);
+            }
+        }
+    }
+}
+
+// ---- G1 Jacobian (same formulas over Fp; y^2 = x^3 + 4) --------------------
+
+struct G1j { Fp X, Y, Z; bool inf; };
+
+void g1j_dbl(G1j& r, const G1j& p) {
+    if (p.inf) { r.inf = true; return; }
+    Fp A, B, C, D, E, F, X3, Y3, Z3, t;
+    mont_sqr(A, p.X);
+    mont_sqr(B, p.Y);
+    mont_sqr(C, B);
+    add(t, p.X, B); mont_sqr(t, t); sub(t, t, A); sub(t, t, C);
+    add(D, t, t);
+    add(E, A, A); add(E, E, A);
+    mont_sqr(F, E);
+    sub(X3, F, D); sub(X3, X3, D);
+    sub(t, D, X3); mont_mul(Y3, E, t);
+    add(t, C, C); add(t, t, t); add(t, t, t);
+    sub(Y3, Y3, t);
+    mont_mul(Z3, p.Y, p.Z); add(Z3, Z3, Z3);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = is_zero(Z3);
+}
+
+void g1j_madd(G1j& r, const G1j& p, const Fp& qx, const Fp& qy) {
+    if (p.inf) {
+        r.X = qx; r.Y = qy; r.Z = ONE_M; r.inf = false;
+        return;
+    }
+    Fp Z1Z1, U2, S2, H, HH, I, J, rr, V, X3, Y3, Z3, t;
+    mont_sqr(Z1Z1, p.Z);
+    mont_mul(U2, qx, Z1Z1);
+    mont_mul(t, p.Z, Z1Z1); mont_mul(S2, qy, t);
+    sub(H, U2, p.X);
+    sub(rr, S2, p.Y); add(rr, rr, rr);
+    if (is_zero(H)) {
+        if (is_zero(rr)) { g1j_dbl(r, p); return; }
+        r.inf = true; return;
+    }
+    mont_sqr(HH, H);
+    add(I, HH, HH); add(I, I, I);
+    mont_mul(J, H, I);
+    mont_mul(V, p.X, I);
+    mont_sqr(X3, rr); sub(X3, X3, J);
+    sub(X3, X3, V); sub(X3, X3, V);
+    sub(t, V, X3); mont_mul(Y3, rr, t);
+    mont_mul(t, p.Y, J); add(t, t, t);
+    sub(Y3, Y3, t);
+    add(t, p.Z, H); mont_sqr(t, t);
+    sub(t, t, Z1Z1); sub(Z3, t, HH);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = is_zero(Z3);
+}
+
+// MSB-first double-and-add over a 32-byte big-endian scalar (the
+// segment-lincomb entries carry collapsed mod-R blinder sums: 64-bit
+// in the common case, wider only for honest in-lane duplicates —
+// cost scales with the top set bit)
+void g1j_mul_be(G1j& r, const Fp& qx, const Fp& qy, const uint8_t* k) {
+    r.inf = true;
+    bool started = false;
+    for (int i = 0; i < 32; i++) {
+        for (int bit = 7; bit >= 0; bit--) {
+            if (started) g1j_dbl(r, r);
+            if ((k[i] >> bit) & 1) {
+                if (!started) {
+                    r.X = qx; r.Y = qy; r.Z = ONE_M;
+                    r.inf = false; started = true;
+                } else {
+                    g1j_madd(r, r, qx, qy);
+                }
+            }
+        }
+    }
+}
+
+void g2j_mul_be(G2j& r, const Fp2& qx, const Fp2& qy, const uint8_t* k) {
+    r.inf = true;
+    bool started = false;
+    for (int i = 0; i < 32; i++) {
+        for (int bit = 7; bit >= 0; bit--) {
+            if (started) g2j_dbl(r, r);
+            if ((k[i] >> bit) & 1) {
+                if (!started) {
+                    r.X = qx; r.Y = qy; r.Z = {ONE_M, ZERO};
+                    r.inf = false; started = true;
+                } else {
+                    g2j_madd(r, r, qx, qy);
+                }
+            }
+        }
+    }
+}
+
+void g2j_add(G2j& r, const G2j& p, const G2j& q) {
+    if (p.inf) { r = q; return; }
+    if (q.inf) { r = p; return; }
+    // general Jacobian addition via madd on the affinized q would cost
+    // an inversion; use add-2007-bl
+    Fp2 Z1Z1, Z2Z2, U1, U2, S1, S2, H, I, J, rr, V, X3, Y3, Z3, t;
+    f2_sqr(Z1Z1, p.Z);
+    f2_sqr(Z2Z2, q.Z);
+    f2_mul(U1, p.X, Z2Z2);
+    f2_mul(U2, q.X, Z1Z1);
+    f2_mul(t, q.Z, Z2Z2); f2_mul(S1, p.Y, t);
+    f2_mul(t, p.Z, Z1Z1); f2_mul(S2, q.Y, t);
+    f2_sub(H, U2, U1);
+    f2_sub(rr, S2, S1); f2_add(rr, rr, rr);
+    if (f2_is_zero(H)) {
+        if (f2_is_zero(rr)) { g2j_dbl(r, p); return; }
+        r.inf = true; return;
+    }
+    f2_add(I, H, H); f2_sqr(I, I);
+    f2_mul(J, H, I);
+    f2_mul(V, U1, I);
+    f2_sqr(X3, rr); f2_sub(X3, X3, J);
+    f2_sub(X3, X3, V); f2_sub(X3, X3, V);
+    f2_sub(t, V, X3); f2_mul(Y3, rr, t);
+    f2_mul(t, S1, J); f2_add(t, t, t);
+    f2_sub(Y3, Y3, t);
+    f2_add(t, p.Z, q.Z); f2_sqr(t, t);
+    f2_sub(t, t, Z1Z1); f2_sub(t, t, Z2Z2);
+    f2_mul(Z3, t, H);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = f2_is_zero(Z3);
+}
+
+void g1j_add(G1j& r, const G1j& p, const G1j& q) {
+    if (p.inf) { r = q; return; }
+    if (q.inf) { r = p; return; }
+    Fp Z1Z1, Z2Z2, U1, U2, S1, S2, H, I, J, rr, V, X3, Y3, Z3, t;
+    mont_sqr(Z1Z1, p.Z);
+    mont_sqr(Z2Z2, q.Z);
+    mont_mul(U1, p.X, Z2Z2);
+    mont_mul(U2, q.X, Z1Z1);
+    mont_mul(t, q.Z, Z2Z2); mont_mul(S1, p.Y, t);
+    mont_mul(t, p.Z, Z1Z1); mont_mul(S2, q.Y, t);
+    sub(H, U2, U1);
+    sub(rr, S2, S1); add(rr, rr, rr);
+    if (is_zero(H)) {
+        if (is_zero(rr)) { g1j_dbl(r, p); return; }
+        r.inf = true; return;
+    }
+    add(I, H, H); mont_sqr(I, I);
+    mont_mul(J, H, I);
+    mont_mul(V, U1, I);
+    mont_sqr(X3, rr); sub(X3, X3, J);
+    sub(X3, X3, V); sub(X3, X3, V);
+    sub(t, V, X3); mont_mul(Y3, rr, t);
+    mont_mul(t, S1, J); add(t, t, t);
+    sub(Y3, Y3, t);
+    add(t, p.Z, q.Z); mont_sqr(t, t);
+    sub(t, t, Z1Z1); sub(t, t, Z2Z2);
+    mont_mul(Z3, t, H);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+    r.inf = is_zero(Z3);
+}
+
+// in[192] = x.a||x.b||y.a||y.b big-endian 48-byte coords (the
+// decompress output layout); -1 = coord out of range, 1 = in
+// subgroup, 0 = on-curve-or-not but NOT in the subgroup (callers
+// only hand us decompressed on-curve points)
+int g2_in_subgroup_one(const uint8_t* in) {
+    Fp2 x, y;
+    if (!fp_from_bytes(x.a, in)) return -1;
+    if (!fp_from_bytes(x.b, in + 48)) return -1;
+    if (!fp_from_bytes(y.a, in + 96)) return -1;
+    if (!fp_from_bytes(y.b, in + 144)) return -1;
+    Fp2 px, py, t;
+    f2_conj(t, x); f2_mul(px, t, PSI_CX_M);
+    f2_conj(t, y); f2_mul(py, t, PSI_CY_M);
+    G2j R;
+    g2j_mul_u64(R, x, y, 0xD201000000010000ULL);
+    if (R.inf) return 0;     // finite psi(Q) can never equal infinity
+    Fp2 zz, zzz, lx, ly;
+    f2_sqr(zz, R.Z);
+    f2_mul(zzz, zz, R.Z);
+    f2_mul(lx, px, zz);
+    f2_neg(py, py);
+    f2_mul(ly, py, zzz);
+    return (f2_eq(lx, R.X) && f2_eq(ly, R.Y)) ? 1 : 0;
+}
+
 // Fq12 from 576 bytes: coefficient order c0.c0.a, c0.c0.b, c0.c1.a, ...
 // c1.c2.b, each a big-endian 48-byte Fq value
 bool f12_from_bytes(Fp12& out, const uint8_t* in) {
@@ -762,6 +1026,127 @@ long lhbls_g1_decompress_batch(const uint8_t* in, long n, uint8_t* out,
         if (r < 0) bad++;
     }
     return bad;
+}
+
+// batch G2 psi subgroup check over affine coordinate rows (192 bytes
+// per point, the decompress output layout); out[i] in {1, 0, -1} =
+// {in subgroup, not in subgroup, coord out of range}; returns n
+long lhbls_g2_in_subgroup_batch(const uint8_t* in, long n, int8_t* out) {
+    do_init();
+    for (long i = 0; i < n; i++)
+        out[i] = (int8_t)g2_in_subgroup_one(in + i * 192);
+    return n;
+}
+
+// batch G1 subgroup check over affine coordinate rows (96 bytes per
+// point): [r]P == INF with r the prime group order — slower than an
+// endomorphism check but dependency-free, and still ~14x the python
+// per-point path.  out[i] in {1, 0, -1} as for the G2 variant.
+long lhbls_g1_in_subgroup_batch(const uint8_t* in, long n, int8_t* out) {
+    do_init();
+    static const uint8_t R_BE[32] = {
+        0x73, 0xed, 0xa7, 0x53, 0x29, 0x9d, 0x7d, 0x48,
+        0x33, 0x39, 0xd8, 0x08, 0x09, 0xa1, 0xd8, 0x05,
+        0x53, 0xbd, 0xa4, 0x02, 0xff, 0xfe, 0x5b, 0xfe,
+        0xff, 0xff, 0xff, 0xff, 0x00, 0x00, 0x00, 0x01};
+    for (long i = 0; i < n; i++) {
+        Fp qx, qy;
+        if (!fp_from_bytes(qx, in + i * 96) ||
+            !fp_from_bytes(qy, in + i * 96 + 48)) {
+            out[i] = -1;
+            continue;
+        }
+        G1j r;
+        g1j_mul_be(r, qx, qy, R_BE);
+        out[i] = r.inf ? 1 : 0;
+    }
+    return n;
+}
+
+// segment-summed linear combination: out[g] = sum_{i: groups[i]==g}
+// scalars[i] * P_i.  Affine points 96 (G1: x||y) / 192 (G2:
+// x.a||x.b||y.a||y.b) big-endian bytes per row, 32-byte big-endian
+// scalars, int64 group ids in [0, n_groups).  Output affine rows per
+// group + flags[g] in {1 finite, 0 identity, -1 bad input row (whole
+// call poisoned: callers fall back to the host loop)}.  This is the
+// reference-rung fold of the merged-set premerge: one native crossing
+// instead of one ~2.5 ms python scalar mul per unique signature.
+int lhbls_g1_lincomb_groups(const uint8_t* pts, const uint8_t* scalars,
+                            const long long* groups, long n,
+                            long n_groups, uint8_t* out, int8_t* flags) {
+    do_init();
+    std::vector<G1j> acc(n_groups);
+    for (long g = 0; g < n_groups; g++) acc[g].inf = true;
+    for (long i = 0; i < n; i++) {
+        long long g = groups[i];
+        if (g < 0 || g >= n_groups) return -1;
+        Fp qx, qy;
+        if (!fp_from_bytes(qx, pts + i * 96)) return -1;
+        if (!fp_from_bytes(qy, pts + i * 96 + 48)) return -1;
+        G1j term;
+        g1j_mul_be(term, qx, qy, scalars + i * 32);
+        G1j sum;
+        g1j_add(sum, acc[g], term);
+        acc[g] = sum;
+    }
+    for (long g = 0; g < n_groups; g++) {
+        if (acc[g].inf) {
+            flags[g] = 0;
+            std::memset(out + g * 96, 0, 96);
+            continue;
+        }
+        Fp zi, zi2, zi3, x, y;
+        fp_inv(zi, acc[g].Z);
+        mont_sqr(zi2, zi);
+        mont_mul(zi3, zi2, zi);
+        mont_mul(x, acc[g].X, zi2);
+        mont_mul(y, acc[g].Y, zi3);
+        fp_to_bytes(out + g * 96, x);
+        fp_to_bytes(out + g * 96 + 48, y);
+        flags[g] = 1;
+    }
+    return 0;
+}
+
+int lhbls_g2_lincomb_groups(const uint8_t* pts, const uint8_t* scalars,
+                            const long long* groups, long n,
+                            long n_groups, uint8_t* out, int8_t* flags) {
+    do_init();
+    std::vector<G2j> acc(n_groups);
+    for (long g = 0; g < n_groups; g++) acc[g].inf = true;
+    for (long i = 0; i < n; i++) {
+        long long g = groups[i];
+        if (g < 0 || g >= n_groups) return -1;
+        Fp2 qx, qy;
+        if (!fp_from_bytes(qx.a, pts + i * 192)) return -1;
+        if (!fp_from_bytes(qx.b, pts + i * 192 + 48)) return -1;
+        if (!fp_from_bytes(qy.a, pts + i * 192 + 96)) return -1;
+        if (!fp_from_bytes(qy.b, pts + i * 192 + 144)) return -1;
+        G2j term;
+        g2j_mul_be(term, qx, qy, scalars + i * 32);
+        G2j sum;
+        g2j_add(sum, acc[g], term);
+        acc[g] = sum;
+    }
+    for (long g = 0; g < n_groups; g++) {
+        if (acc[g].inf) {
+            flags[g] = 0;
+            std::memset(out + g * 192, 0, 192);
+            continue;
+        }
+        Fp2 zi, zi2, zi3, x, y;
+        f2_inv(zi, acc[g].Z);
+        f2_sqr(zi2, zi);
+        f2_mul(zi3, zi2, zi);
+        f2_mul(x, acc[g].X, zi2);
+        f2_mul(y, acc[g].Y, zi3);
+        fp_to_bytes(out + g * 192, x.a);
+        fp_to_bytes(out + g * 192 + 48, x.b);
+        fp_to_bytes(out + g * 192 + 96, y.a);
+        fp_to_bytes(out + g * 192 + 144, y.b);
+        flags[g] = 1;
+    }
+    return 0;
 }
 
 // full (cubed) final exponentiation, 576-byte Fq12 in/out; -1 on a

@@ -104,6 +104,18 @@ class Router:
             _compute_digest(chain.spec.fork_version(f), root).hex(): f
             for f in FORKS
             if chain.spec.fork_epoch(f) != FAR_FUTURE_EPOCH}
+        # wire layouts for the columnar attestation decode (fixed per
+        # preset; one per attestation wire format)
+        from lighthouse_tpu.ssz import columnar as _col
+
+        self._wire_layouts = {
+            False: _col.layout_for(chain.spec.preset, False),
+            True: _col.layout_for(chain.spec.preset, True),
+        }
+        # snapshot the kill switch once: a mid-run env flip must not mix
+        # wire-bytes and object payloads inside one processor batch
+        # (the batch handler comes from the first event of a sweep)
+        self._columnar = _col.enabled()
         self._subscribe_topics()
         self._register_rpc()
         self.gossip.on_delivery_result = self._score_delivery
@@ -240,21 +252,67 @@ class Router:
                 if src is not None:
                     self.peers.report(src, "low", topic="beacon_attestation")
 
+    def _ingest_attestation_blob_batch(self, triples):
+        """Columnar batch handler: payloads are RAW WIRE BYTES
+        ``(blob, source, electra)`` — one strided parse decodes the
+        whole sweep (ssz/columnar) and the chain's columnar lane
+        verifies it; rows the lane can't handle exactly ride the scalar
+        pipeline inside the same call.  Peer-downscoring contract
+        identical to :meth:`_verify_attestation_batch`: non-benign
+        rejects (including ``decode_error`` for a blob the scalar
+        deserialize refuses) cost the sender."""
+        from lighthouse_tpu.chain import columnar_ingest
+
+        result = columnar_ingest.process_wire_batch(
+            self.chain, [(blob, electra) for blob, _src, electra in triples])
+        for i, reason in result.rejects:
+            if reason not in self._BENIGN_ATT_REJECTS and i >= 0:
+                src = triples[i][1]
+                if src is not None:
+                    self.peers.report(src, "low", topic="beacon_attestation")
+
     def _on_attestation(self, msg):
         c = self.chain
-        att_cls = (c.t.AttestationElectra if self._topic_electra(msg.topic)
-                   else c.t.Attestation)
+        electra = self._topic_electra(msg.topic)
+        att_cls = c.t.AttestationElectra if electra else c.t.Attestation
         from lighthouse_tpu.network.gossip import record_fanin
+        from lighthouse_tpu.ssz import columnar
+
+        if self.processor is not None and self._columnar:
+            from lighthouse_tpu.processor import WorkEvent, WorkType
+
+            # columnar wire path: NO per-message object materialization
+            # — an O(1) structural gate replaces the scalar deserialize
+            # (property-pinned equivalent), and raw bytes ride the
+            # admission queue into the one-parse-per-batch handler.
+            # The fan-in ledger's per-delivery accounting is unchanged:
+            # exactly one of decode_error / accepted / shed per message.
+            if not columnar.validate_blob(msg.data, self._wire_layouts[
+                    electra]):
+                # the scalar deserialize stays AUTHORITATIVE for
+                # decode_error: genuine garbage raises here (counted +
+                # peer-scored via the delivery result, exactly the old
+                # point); a validate_blob divergence — impossible per
+                # the property suite — yields a decodable blob that
+                # rides the batch path's in-batch scalar fallback
+                self._decode_gossip(att_cls, msg, count=True)
+            verdict = self.processor.submit(WorkEvent(
+                WorkType.GOSSIP_ATTESTATION,
+                payload=(msg.data, msg.source, electra),
+                process_batch=self._ingest_attestation_blob_batch))
+            record_fanin("accepted" if verdict else "shed")
+            return
 
         att = self._decode_gossip(att_cls, msg, count=True)
         if self.processor is not None:
             from lighthouse_tpu.processor import WorkEvent, WorkType
 
-            # admission-controlled queue path: the batch sweep feeds the
-            # chain's batched pipeline; a SHED verdict is accounted in
-            # processor_shed_total and earns the peer no penalty
-            # (overload is local, the message may be honest) — invalid
-            # signatures are penalized from the batch handler above
+            # admission-controlled queue path (columnar kill switch
+            # off): the batch sweep feeds the chain's batched pipeline;
+            # a SHED verdict is accounted in processor_shed_total and
+            # earns the peer no penalty (overload is local, the message
+            # may be honest) — invalid signatures are penalized from
+            # the batch handler above
             verdict = self.processor.submit(WorkEvent(
                 WorkType.GOSSIP_ATTESTATION, payload=(att, msg.source),
                 process_batch=self._verify_attestation_batch))

@@ -853,8 +853,14 @@ def scalars_to_digits(scalars, n_bits: int = 64, w: int = 4) -> np.ndarray:
     if n == 0:
         return np.zeros((n_dig, 0), np.uint32)
     n_bytes = (n_bits + 7) // 8
-    buf = b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars)
-    byts = np.frombuffer(buf, np.uint8).reshape(n, n_bytes)
+    if (isinstance(scalars, np.ndarray) and scalars.dtype == np.uint64
+            and n_bits == 64):
+        # machine-word fast path: vectorized big-endian reinterpret
+        # instead of a per-scalar int.to_bytes join
+        byts = scalars.astype(">u8").view(np.uint8).reshape(n, n_bytes)
+    else:
+        buf = b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars)
+        byts = np.frombuffer(buf, np.uint8).reshape(n, n_bytes)
     bits = np.unpackbits(byts, axis=1, bitorder="big")[:, -n_bits:]
     weights = 1 << np.arange(w - 1, -1, -1, dtype=np.uint32)
     digs = (bits.reshape(n, n_dig, w).astype(np.uint32) * weights).sum(

@@ -58,6 +58,22 @@ def _load():
             ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int8)]
         lib.lhbls_g1_decompress_batch.restype = ctypes.c_long
+        lib.lhbls_g2_in_subgroup_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int8)]
+        lib.lhbls_g2_in_subgroup_batch.restype = ctypes.c_long
+        lib.lhbls_g1_in_subgroup_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int8)]
+        lib.lhbls_g1_in_subgroup_batch.restype = ctypes.c_long
+        for fn in (lib.lhbls_g1_lincomb_groups,
+                   lib.lhbls_g2_lincomb_groups):
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+                ctypes.c_long, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int8)]
+            fn.restype = ctypes.c_int
         lib.lhbls_final_exp.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.lhbls_final_exp.restype = ctypes.c_int
         lib.lhbls_final_exp_is_one.argtypes = [ctypes.c_char_p]
@@ -141,6 +157,151 @@ def g2_decompress_batch(blobs: list[bytes]):
     return res
 
 
+def g2_in_subgroup_batch(points) -> list[int]:
+    """Batched ψ membership test over affine G2 points ((Fq2, Fq2)
+    pairs, Fq2 exposing .a/.b ints) -> verdict per point: 1 in the
+    prime-order subgroup, 0 not, -1 coordinate out of range.  ~70 µs
+    per point vs ~1.6 ms for the pure-python psi check — the merged-
+    lane premerge path batches every fresh signature's check through
+    one ctypes crossing.  None when the native layer is unavailable
+    (callers fall back to the per-point python check)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(points)
+    if n == 0:
+        return []
+    buf = bytearray(192 * n)
+    for i, (x, y) in enumerate(points):
+        o = i * 192
+        buf[o:o + 48] = int(x.a).to_bytes(48, "big")
+        buf[o + 48:o + 96] = int(x.b).to_bytes(48, "big")
+        buf[o + 96:o + 144] = int(y.a).to_bytes(48, "big")
+        buf[o + 144:o + 192] = int(y.b).to_bytes(48, "big")
+    out = (ctypes.c_int8 * n)()
+    lib.lhbls_g2_in_subgroup_batch(bytes(buf), n, out)
+    return [int(v) for v in out]
+
+
+def g1_decompress_batch(blobs: list[bytes]):
+    """Batched G1 decompression: list of results as in g1_decompress,
+    or None when the native layer is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(blobs)
+    if n == 0:
+        return []
+    inp = b"".join(bytes(b) for b in blobs)
+    out = ctypes.create_string_buffer(96 * n)
+    st = (ctypes.c_int8 * n)()
+    lib.lhbls_g1_decompress_batch(inp, n, out, st)
+    raw = out.raw
+    res = []
+    for i in range(n):
+        if st[i] < 0:
+            res.append(None)
+        elif st[i] == 1:
+            res.append(G1_INF)
+        else:
+            o = raw[i * 96:(i + 1) * 96]
+            res.append((int.from_bytes(o[:48], "big"),
+                        int.from_bytes(o[48:], "big")))
+    return res
+
+
+def g1_in_subgroup_batch(points):
+    """Batched G1 membership test ([r]P == INF with r the group
+    order) over affine ``(x, y)`` int pairs -> verdict per point (1 in
+    subgroup / 0 not / -1 coord out of range), or None when the native
+    layer is unavailable.  ~0.4 ms/point vs ~6 ms for the python
+    per-key path — the pubkey plane's table build sweeps the whole
+    registry through this."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(points)
+    if n == 0:
+        return []
+    buf = b"".join(int(x).to_bytes(48, "big") + int(y).to_bytes(48, "big")
+                   for x, y in points)
+    out = (ctypes.c_int8 * n)()
+    lib.lhbls_g1_in_subgroup_batch(buf, n, out)
+    return [int(v) for v in out]
+
+
+def _lincomb_groups(kind: str, pts_blob: bytes, scalars_blob: bytes,
+                    groups, n: int, n_groups: int):
+    lib = _load()
+    width = 96 if kind == "g1" else 192
+    garr = (ctypes.c_longlong * n)(*[int(g) for g in groups])
+    out = ctypes.create_string_buffer(width * n_groups)
+    flags = (ctypes.c_int8 * n_groups)()
+    fn = (lib.lhbls_g1_lincomb_groups if kind == "g1"
+          else lib.lhbls_g2_lincomb_groups)
+    if fn(pts_blob, scalars_blob, garr, n, n_groups, out, flags) != 0:
+        return None
+    return out.raw, [int(f) for f in flags]
+
+
+def g1_lincomb_groups(points, scalars, groups, n_groups: int):
+    """Segment-summed MSM: out[g] = Σ_{i: groups[i]==g} scalars[i]·Pᵢ
+    over affine G1 ``(x, y)`` int pairs with arbitrary-width int
+    scalars (< 2^256) -> list of (x, y) ints, None for an identity
+    group; or None (whole call) when the native layer is unavailable
+    or an input is out of range."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(points)
+    pts = b"".join(int(x).to_bytes(48, "big") + int(y).to_bytes(48, "big")
+                   for x, y in points)
+    sc = b"".join(int(s).to_bytes(32, "big") for s in scalars)
+    res = _lincomb_groups("g1", pts, sc, groups, n, n_groups)
+    if res is None:
+        return None
+    raw, flags = res
+    out = []
+    for g in range(n_groups):
+        if flags[g] != 1:
+            out.append(None)
+            continue
+        o = g * 96
+        out.append((int.from_bytes(raw[o:o + 48], "big"),
+                    int.from_bytes(raw[o + 48:o + 96], "big")))
+    return out
+
+
+def g2_lincomb_groups(points, scalars, groups, n_groups: int):
+    """As :func:`g1_lincomb_groups` over affine G2 points ((Fq2, Fq2)
+    pairs exposing .a/.b) -> list of ((xa, xb), (ya, yb)) int tuples,
+    None for identity groups."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(points)
+    pts = b"".join(
+        int(x.a).to_bytes(48, "big") + int(x.b).to_bytes(48, "big")
+        + int(y.a).to_bytes(48, "big") + int(y.b).to_bytes(48, "big")
+        for x, y in points)
+    sc = b"".join(int(s).to_bytes(32, "big") for s in scalars)
+    res = _lincomb_groups("g2", pts, sc, groups, n, n_groups)
+    if res is None:
+        return None
+    raw, flags = res
+    out = []
+    for g in range(n_groups):
+        if flags[g] != 1:
+            out.append(None)
+            continue
+        o = g * 192
+        out.append(((int.from_bytes(raw[o:o + 48], "big"),
+                     int.from_bytes(raw[o + 48:o + 96], "big")),
+                    (int.from_bytes(raw[o + 96:o + 144], "big"),
+                     int.from_bytes(raw[o + 144:o + 192], "big"))))
+    return out
+
+
 # -- final exponentiation ----------------------------------------------------
 
 def _fq12_bytes(f) -> bytes:
@@ -183,4 +344,7 @@ def final_exp_is_one(f) -> bool:
 
 
 __all__ = ["available", "build_error", "final_exp", "final_exp_is_one",
-           "g1_decompress", "g2_decompress", "g2_decompress_batch"]
+           "g1_decompress", "g1_decompress_batch", "g2_decompress",
+           "g2_decompress_batch", "g1_in_subgroup_batch",
+           "g2_in_subgroup_batch", "g1_lincomb_groups",
+           "g2_lincomb_groups"]

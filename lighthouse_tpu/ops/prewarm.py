@@ -50,8 +50,8 @@ from lighthouse_tpu.ops import program_store
 #: production client must verify its first block), then the merkle
 #: hashers, the blob planes, the epoch pass, the shuffle, and the
 #: multichip dryrun fold last
-DRIVER_ORDER = ("bls", "pairing", "sharded", "sha256", "kzg", "fr",
-                "das", "epoch", "shuffle", "dryrun")
+DRIVER_ORDER = ("bls", "pairing", "sharded", "pubkey", "sha256", "kzg",
+                "fr", "das", "epoch", "shuffle", "dryrun")
 
 
 def _import_owners() -> None:
@@ -61,7 +61,7 @@ def _import_owners() -> None:
     from lighthouse_tpu.crypto import das, kzg  # noqa: F401
     from lighthouse_tpu.ops import (  # noqa: F401
         bls12_381, bls_backend, dispatch_pipeline, epoch_kernels, fr,
-        sha256)
+        pubkey_kernels, sha256)
     from lighthouse_tpu.parallel import (  # noqa: F401
         bls_sharded, dryrun_worker)
 
@@ -180,6 +180,32 @@ def _drv_sharded(scale: str) -> None:
         raise RuntimeError("prewarm sharded verify rejected")
 
 
+def _drv_pubkey(scale: str) -> None:
+    """The ingest pubkey plane's fused gather+MSM at its fold bucket,
+    with a host-point-math sanity gate (a mis-prewarmed program must
+    never serve committee aggregates)."""
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.ops import pubkey_kernels
+
+    lanes = 64 if scale == "production" else 2
+    pts = [cv.g1_mul(cv.g1_generator(), 3 + i) for i in range(2)]
+    table = pubkey_kernels.build_table(pts)
+    rows = np.arange(lanes, dtype=np.int64) % 2
+    scalars = (np.arange(lanes, dtype=np.uint64) % 7) + 1
+    groups = np.zeros(lanes, np.int64)
+    xa, ya, inf = pubkey_kernels.gather_fold(table, rows, scalars,
+                                             groups, 1)
+    want = cv.INF
+    for r, s in zip(rows, scalars):
+        want = cv.g1_add(want, cv.g1_mul(pts[int(r)], int(s)))
+    got = (int(bi.from_mont(xa[0])), int(bi.from_mont(ya[0])))
+    if bool(inf[0]) or got != want:
+        raise RuntimeError("prewarmed pubkey fold mismatches host adds")
+
+
 def _drv_sha256(scale: str) -> None:
     """The merkle hashers at their serving buckets: the pair hash, the
     single-block message sweep, and both whole-fold programs."""
@@ -295,6 +321,7 @@ _DRIVERS = {
     "bls": _drv_bls,
     "pairing": _drv_pairing,
     "sharded": _drv_sharded,
+    "pubkey": _drv_pubkey,
     "sha256": _drv_sha256,
     "kzg": _drv_kzg,
     "fr": _drv_fr,

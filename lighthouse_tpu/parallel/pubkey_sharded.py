@@ -1,0 +1,48 @@
+"""Pubkey-plane gather+MSM sharded over the device mesh.
+
+Same model as parallel/epoch_sharded: the fold is pure lane
+parallelism (each lane multiplies its own gathered table row by its
+own blinder; the segment tree only combines lanes of one group), so
+the lanes partition over a pow2 1-D mesh with the resident table
+replicated, and GSPMD splits the one fused program — no second kernel,
+no per-device re-padding (the plane's pow2 lane/group padding always
+covers a pow2 mesh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from lighthouse_tpu.ops import pubkey_kernels
+
+
+def pubkey_mesh(n_devices: int | None = None):
+    """A pow2-sized 1-D mesh over the available devices."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    n = 1 << max(n.bit_length() - 1, 0)  # round DOWN to a power of two
+    return Mesh(np.array(devs[:n]), axis_names=("data",))
+
+
+def gather_fold_sharded(table, row_of_lane: np.ndarray,
+                        scalars: np.ndarray, group_of_lane: np.ndarray,
+                        n_groups: int, mesh=None):
+    """Mesh-sharded :func:`ops.pubkey_kernels.gather_fold` — identical
+    contract and verdicts (digest-identity pinned by the property
+    suite on virtual devices)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = pubkey_mesh()
+    lane_sh = NamedSharding(mesh, P("data"))
+    tbl_sh = NamedSharding(mesh, P())
+    return pubkey_kernels.gather_fold(
+        table, row_of_lane, scalars, group_of_lane, n_groups,
+        shardings=(lane_sh, tbl_sh))
+
+
+__all__ = ["gather_fold_sharded", "pubkey_mesh"]

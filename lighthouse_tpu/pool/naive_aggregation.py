@@ -66,6 +66,31 @@ class NaiveAggregationPool:
         sigs.append(bls.Signature(bytes(attestation.signature)))
         return True
 
+    def insert_single_bit(self, data, data_root: bytes, committee: int,
+                          committee_len: int, bit_pos: int,
+                          sig_bytes: bytes) -> bool:
+        """Columnar-lane fast path: fold ONE bit in without
+        materializing an Attestation container or re-hashing its data —
+        the caller (chain/columnar_ingest) already holds the group's
+        data root and object.  Semantics identical to :meth:`insert`
+        for a single-bit contribution."""
+        per_slot = self._slots.setdefault(int(data.slot), {})
+        key = (data_root, committee)
+        entry = per_slot.get(key)
+        if entry is None:
+            bits = np.zeros(committee_len, dtype=bool)
+            bits[bit_pos] = True
+            per_slot[key] = (
+                data, bits, [bls.Signature(sig_bytes)], committee)
+            self._prune()
+            return True
+        _, agg_bits, sigs, _ci = entry
+        if agg_bits.shape[0] != committee_len or agg_bits[bit_pos]:
+            return False
+        agg_bits[bit_pos] = True
+        sigs.append(bls.Signature(sig_bytes))
+        return True
+
     def get_aggregate(self, data, committee_index: int | None = None):
         """Best aggregate for this AttestationData (or None)."""
         ci = int(data.index) if committee_index is None else committee_index

@@ -1,0 +1,345 @@
+"""Columnar batch SSZ decode for the gossip attestation firehose.
+
+PAPER.md §L1: attestation containers have a FIXED field order and, bar
+the aggregation bitlist, fixed field sizes — so a same-topic admission
+batch is a fixed-stride byte layout, not N opaque blobs.  This module
+parses a whole batch with numpy strided views: one ``np.frombuffer``
+per equal-length stride class, column slices for every field, and
+vectorized structural validation (offset, bitlist delimiter, bitvector
+padding).  The per-message Python object materialization that
+dominated ``Router._decode_gossip`` upstream of BLS (ISSUE 14
+profiling) is deferred: full containers are built lazily, ONLY for the
+rows that survive dedup/coalescing and need them (fork-choice feed,
+pool insert) via :meth:`ColumnarAttestations.materialize`.
+
+Wire layouts decoded here (consensus SSZ, field order is
+consensus-critical):
+
+``Attestation`` (phase0 … deneb)::
+
+    [bits_offset u32 == 228][data 128][signature 96][aggregation_bits…]
+
+``AttestationElectra`` (EIP-7549)::
+
+    [bits_offset u32][data 128][committee_bits cb][signature 96][bits…]
+
+``AttestationData`` (128 bytes)::
+
+    slot u64 | index u64 | beacon_block_root 32 |
+    source.epoch u64 | source.root 32 | target.epoch u64 | target.root 32
+
+Malformed blobs NEVER poison a batch: :func:`decode_batch` returns the
+row indices the strided parse rejected and the caller routes exactly
+those through the scalar ``cls.deserialize`` path (whose failure is the
+authoritative ``decode_error``).  :func:`validate_blob` is the O(1)
+delivery-time gate — property-tested equivalent to "scalar deserialize
+succeeds" (tests/test_columnar.py), so the admission accounting the
+PR 8 fan-in ledger depends on stays exact without materializing a
+single container on the hot path.
+
+``LHTPU_INGEST_COLUMNAR=0`` disables the columnar wire path everywhere
+(router + chain lane fall back to per-message scalar decode).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+DATA_BYTES = 128          # AttestationData serialized size
+SIG_BYTES = 96
+OFFSET_BYTES = 4
+
+#: bit_length lookup per byte value (vectorized bitlist delimiter math)
+_BIT_LENGTH = np.array([int(b).bit_length() for b in range(256)],
+                       dtype=np.int64)
+
+
+def enabled() -> bool:
+    return envreg.get_bool("LHTPU_INGEST_COLUMNAR", True)
+
+
+@dataclass(frozen=True)
+class WireLayout:
+    """Fixed-part geometry of one attestation wire format."""
+
+    electra: bool
+    bits_limit: int          # aggregation_bits Bitlist limit
+    committee_count: int     # committee_bits Bitvector length (electra)
+
+    @property
+    def committee_bits_len(self) -> int:
+        return (self.committee_count + 7) // 8 if self.electra else 0
+
+    @property
+    def head(self) -> int:
+        """Fixed-part length == required value of the bits offset."""
+        return (OFFSET_BYTES + DATA_BYTES + self.committee_bits_len
+                + SIG_BYTES)
+
+    @property
+    def sig_off(self) -> int:
+        return OFFSET_BYTES + DATA_BYTES + self.committee_bits_len
+
+    @property
+    def cb_off(self) -> int:
+        return OFFSET_BYTES + DATA_BYTES
+
+
+def layout_for(preset, electra: bool) -> WireLayout:
+    """Layout for a preset's (non-)electra attestation class."""
+    per_slot = preset.max_validators_per_committee
+    if electra:
+        return WireLayout(
+            True, per_slot * preset.max_committees_per_slot,
+            preset.max_committees_per_slot)
+    return WireLayout(False, per_slot, 0)
+
+
+def validate_blob(blob: bytes, layout: WireLayout) -> bool:
+    """O(1) structural validity — True iff the scalar
+    ``cls.deserialize`` would succeed (pinned by the property suite).
+    No numpy, no object materialization: this runs per DELIVERY on the
+    router's hot path so the fan-in ledger can count ``decode_error``
+    at the same point the scalar path did."""
+    head = layout.head
+    if len(blob) <= head:
+        return False
+    if int.from_bytes(blob[:OFFSET_BYTES], "little") != head:
+        return False
+    last = blob[-1]
+    if last == 0:
+        return False                      # bitlist delimiter missing
+    bit_len = (len(blob) - head - 1) * 8 + last.bit_length() - 1
+    if bit_len > layout.bits_limit:
+        return False
+    if layout.electra:
+        cb = int.from_bytes(
+            blob[layout.cb_off:layout.cb_off + layout.committee_bits_len],
+            "little")
+        if cb >> layout.committee_count:
+            return False                  # bitvector padding bits set
+    return True
+
+
+class ColumnarAttestations:
+    """Device-ready column views over one decoded batch.
+
+    All arrays are length ``n`` (the surviving rows, original batch
+    order preserved); ``row_index[i]`` maps back to the caller's blob
+    list.  ``data_raw`` (the 128-byte AttestationData slice) doubles as
+    the (slot, index, beacon_block_root, …) group key: byte-equal rows
+    attest the same message."""
+
+    __slots__ = (
+        "n", "electra", "row_index", "blobs", "slot", "index",
+        "beacon_block_root", "source_epoch", "target_epoch", "target_root",
+        "data_raw", "signature", "committee_bits", "bit_count", "set_bits",
+        "first_bit", "_cls", "_materialized")
+
+    def __init__(self, n: int, electra: bool, cls=None):
+        self.n = n
+        self.electra = electra
+        self.row_index = np.empty(n, np.int64)
+        self.blobs: list[bytes] = [b""] * n
+        self.slot = np.empty(n, np.uint64)
+        self.index = np.empty(n, np.uint64)
+        self.beacon_block_root = np.empty((n, 32), np.uint8)
+        self.source_epoch = np.empty(n, np.uint64)
+        self.target_epoch = np.empty(n, np.uint64)
+        self.target_root = np.empty((n, 32), np.uint8)
+        self.data_raw = np.empty((n, DATA_BYTES), np.uint8)
+        self.signature = np.empty((n, SIG_BYTES), np.uint8)
+        self.committee_bits = np.zeros(n, np.uint64)
+        self.bit_count = np.empty(n, np.int64)   # aggregation bit length
+        self.set_bits = np.empty(n, np.int64)    # popcount
+        self.first_bit = np.empty(n, np.int64)   # first set bit, -1 if none
+        self._cls = cls
+        self._materialized: dict[int, object] = {}
+
+    def materialize(self, i: int):
+        """Full container for row ``i`` — the LAZY path: only rows that
+        survive dedup/coalescing and reach the pools / fork choice pay
+        Python object construction."""
+        obj = self._materialized.get(i)
+        if obj is None:
+            if self._cls is None:
+                raise ValueError("no container class bound to this batch")
+            obj = self._cls.deserialize(self.blobs[i])
+            self._materialized[i] = obj
+        return obj
+
+    def signature_bytes(self, i: int) -> bytes:
+        return self.signature[i].tobytes()
+
+    def group_keys(self) -> tuple[np.ndarray, np.ndarray]:
+        """(group_of_row int64[n], first_row_of_group int64[G]) — rows
+        with byte-equal (AttestationData, committee_bits) share a group:
+        the (slot, committee index, beacon_block_root) lane of the
+        ISSUE.  committee_bits joins the key because electra data
+        carries index=0 for every committee — the DATA alone would
+        merge different committees' bit geometries (their signing root
+        is still shared; the BLS merge stage re-groups by root)."""
+        if self.n == 0:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        key = np.empty((self.n, DATA_BYTES + 8), np.uint8)
+        key[:, :DATA_BYTES] = self.data_raw
+        key[:, DATA_BYTES:] = self.committee_bits.view(np.uint8).reshape(
+            self.n, 8)
+        view = np.ascontiguousarray(key).view(
+            [("d", f"V{DATA_BYTES + 8}")]).ravel()
+        _, first, inverse = np.unique(
+            view, return_index=True, return_inverse=True)
+        return inverse.astype(np.int64), first.astype(np.int64)
+
+
+def decode_batch(blobs: list[bytes], layout: WireLayout, cls=None,
+                 ) -> tuple[ColumnarAttestations, list[int]]:
+    """Strided parse of a whole admission batch.
+
+    Returns ``(columns, malformed)`` — ``columns`` covers every row the
+    vectorized validation accepted (original order), ``malformed`` the
+    blob indices it rejected; the caller runs exactly those through the
+    scalar path so a garbage tail inside a batch costs scalar work for
+    the garbage only, and the accounting (``decode_error`` per
+    malformed delivery) stays bit-for-bit with the per-message path."""
+    t0 = time.perf_counter()
+    n_in = len(blobs)
+    head = layout.head
+    lengths = np.fromiter((len(b) for b in blobs), np.int64, count=n_in)
+    ok = lengths > head
+
+    # stride classes: same total length => same fixed layout => ONE
+    # frombuffer + reshape covers the class
+    good_rows: list[np.ndarray] = []
+    class_arrays: list[tuple[np.ndarray, np.ndarray]] = []
+    if n_in:
+        for L in np.unique(lengths[ok]):
+            rows = np.nonzero(lengths == L)[0]
+            buf = b"".join(blobs[i] for i in rows)
+            arr = np.frombuffer(buf, np.uint8).reshape(len(rows), int(L))
+            offs = np.ascontiguousarray(arr[:, :OFFSET_BYTES]).view(
+                "<u4").ravel()
+            valid = offs == head
+            last = arr[:, -1].astype(np.int64)
+            valid &= last != 0
+            bit_len = (int(L) - head - 1) * 8 + _BIT_LENGTH[last] - 1
+            valid &= bit_len <= layout.bits_limit
+            if layout.electra and layout.committee_count < 64:
+                # padding-bit check; a full 64-wide Bitvector has no
+                # padding, and uint64 >> 64 is undefined in numpy
+                # (mod-64 on x86 would fail every set row)
+                cb = _read_uint_col(
+                    arr, layout.cb_off, layout.committee_bits_len)
+                valid &= (cb >> np.uint64(layout.committee_count)) == 0
+            good_rows.append(rows[valid])
+            class_arrays.append((arr[valid], bit_len[valid]))
+
+    n_good = sum(len(r) for r in good_rows)
+    cols = ColumnarAttestations(n_good, layout.electra, cls=cls)
+    pos = 0
+    for rows, (arr, bit_len) in zip(good_rows, class_arrays):
+        m = len(rows)
+        if not m:
+            continue
+        sl = slice(pos, pos + m)
+        cols.row_index[sl] = rows
+        d = OFFSET_BYTES
+        cols.slot[sl] = _read_uint_col(arr, d, 8)
+        cols.index[sl] = _read_uint_col(arr, d + 8, 8)
+        cols.beacon_block_root[sl] = arr[:, d + 16:d + 48]
+        cols.source_epoch[sl] = _read_uint_col(arr, d + 48, 8)
+        cols.target_epoch[sl] = _read_uint_col(arr, d + 88, 8)
+        cols.target_root[sl] = arr[:, d + 96:d + 128]
+        cols.data_raw[sl] = arr[:, d:d + DATA_BYTES]
+        cols.signature[sl] = arr[:, layout.sig_off:layout.sig_off + SIG_BYTES]
+        if layout.electra:
+            cols.committee_bits[sl] = _read_uint_col(
+                arr, layout.cb_off, layout.committee_bits_len)
+        cols.bit_count[sl] = bit_len
+        # aggregation bits: LSB-first within bytes (SSZ bitlist);
+        # delimiter + beyond masked out before popcount
+        bits = np.unpackbits(arr[:, head:], axis=1, bitorder="little")
+        mask = np.arange(bits.shape[1]) < bit_len[:, None]
+        bits = bits.astype(bool) & mask
+        cols.set_bits[sl] = bits.sum(axis=1)
+        first = bits.argmax(axis=1)
+        cols.first_bit[sl] = np.where(bits.any(axis=1), first, -1)
+        pos += m
+
+    # restore original arrival order across stride classes
+    if n_good:
+        order = np.argsort(cols.row_index, kind="stable")
+        for name in ("row_index", "slot", "index", "beacon_block_root",
+                     "source_epoch", "target_epoch", "target_root",
+                     "data_raw", "signature", "committee_bits", "bit_count",
+                     "set_bits", "first_bit"):
+            setattr(cols, name, getattr(cols, name)[order])
+    for j, i in enumerate(cols.row_index):
+        cols.blobs[j] = blobs[int(i)]
+    bad = np.ones(n_in, bool)
+    bad[cols.row_index] = False
+    malformed = [int(i) for i in np.nonzero(bad)[0]]
+    record_decode("columnar", time.perf_counter() - t0, n_good)
+    return cols, malformed
+
+
+def _read_uint_col(arr: np.ndarray, off: int, width: int) -> np.ndarray:
+    """Little-endian unsigned column of ``width`` (<=8) bytes -> u64."""
+    col = arr[:, off:off + width].astype(np.uint64)
+    shifts = np.arange(width, dtype=np.uint64) * np.uint64(8)
+    return (col << shifts).sum(axis=1, dtype=np.uint64)
+
+
+# -- telemetry (single owner of the ingest_* families) ------------------------
+
+
+def record_decode(path: str, seconds: float, rows: int) -> None:
+    """Count one decode sweep (path: columnar|scalar) — the
+    ``ingest_decode_seconds`` / ``ingest_decode_rows_total`` series on
+    the observatory."""
+    try:
+        REGISTRY.histogram(
+            "ingest_decode_seconds",
+            "wire-to-columns decode sweep wall time by path",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1.0),
+        ).labels(path=path).observe(seconds)
+        REGISTRY.counter(
+            "ingest_decode_rows_total",
+            "attestation rows decoded by path (columnar = strided batch "
+            "parse, scalar = per-message fallback)",
+        ).labels(path=path).inc(rows)
+    except Exception as e:
+        record_swallowed("columnar.record_decode", e)
+
+
+def record_fallback_rows(n: int) -> None:
+    """Rows the strided parse rejected and the scalar path re-examined
+    (decode_error accounting itself stays in the fan-in ledger)."""
+    if n <= 0:
+        return
+    try:
+        REGISTRY.counter(
+            "ingest_columnar_fallback_total",
+            "batch rows routed to the scalar decode fallback",
+        ).inc(n)
+    except Exception as e:
+        record_swallowed("columnar.record_fallback", e)
+
+
+__all__ = [
+    "ColumnarAttestations",
+    "WireLayout",
+    "decode_batch",
+    "enabled",
+    "layout_for",
+    "record_decode",
+    "record_fallback_rows",
+    "validate_blob",
+]

@@ -36,6 +36,7 @@ applied after registry updates, matching spec order exactly).
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 
@@ -317,6 +318,15 @@ def process_epoch(state, spec: T.ChainSpec) -> None:
     process_historical_update(state, spec, fork)
     process_participation_flag_updates(state)
     process_sync_committee_updates(state, spec)
+    # registry write-back hook: the epoch boundary is where the prior
+    # epoch's deposits have settled into the registry — refresh the
+    # device-resident pubkey table eagerly (all-or-nothing swap inside
+    # the plane; a no-op unless a device rung is armed).  Guarded on
+    # sys.modules so pure state-transition processes never pull the
+    # chain package (or jax) just for the hook.  Never raises.
+    plane = sys.modules.get("lighthouse_tpu.chain.pubkey_plane")
+    if plane is not None:
+        plane.notify_registry(state.validators)
 
 
 # --- justification / finalization ------------------------------------------

@@ -183,7 +183,11 @@ def test_observatory_endpoints(api_setup):
         __import__("lighthouse_tpu.chain.slo",
                    fromlist=["STAGES"]).STAGES)
     jit = get("/lighthouse/observatory/jit")
-    assert jit["coverage"]["manifest_entries"] == 20
+    import pathlib
+    manifest = json.loads(
+        (pathlib.Path(__file__).resolve().parents[1] / "tools" / "lint"
+         / "shape_manifest.json").read_text())
+    assert jit["coverage"]["manifest_entries"] == len(manifest["entries"])
     # the AOT program store's live state + per-entry serving sources
     # (PR 12): unconfigured here, but the surface must be present
     assert jit["aot_store"]["enabled"] in (True, False)

@@ -1,0 +1,684 @@
+"""Wire-to-device ingest (ISSUE 14): columnar SSZ decode + pubkey plane.
+
+Property pins:
+- ``columnar.validate_blob`` ≡ "scalar ``cls.deserialize`` succeeds"
+  over valid wires, targeted mutations and pure garbage, both forks;
+- ``columnar.decode_batch`` column values ≡ the scalar containers',
+  with exactly the scalar-rejected rows reported as malformed;
+- the full columnar lane (``process_wire_batch``) ≡ the scalar batch
+  pipeline: same verified rows, same reject vocabulary, same pool and
+  dup-cache effects — randomized batches with bad signatures, garbage
+  tails, duplicates and timing rejects;
+- device pubkey fold ≡ host point adds (identity + duplicate-validator
+  edge cases; the device rung itself is @slow — it compiles a kernel).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types as pytypes
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import types as T
+from lighthouse_tpu.ssz import columnar
+from lighthouse_tpu.testing import Harness
+
+slow = pytest.mark.skipif(
+    os.environ.get("LHTPU_SLOW") != "1",
+    reason="compiles the pubkey gather+MSM kernel; set LHTPU_SLOW=1")
+
+
+def _layouts(electra: bool):
+    spec = T.ChainSpec.minimal()
+    t = T.make_types(spec.preset)
+    cls = t.AttestationElectra if electra else t.Attestation
+    return columnar.layout_for(spec.preset, electra), cls, spec
+
+
+def _scalar_ok(cls, blob: bytes) -> bool:
+    try:
+        cls.deserialize(blob)
+        return True
+    except Exception:
+        return False
+
+
+def _mk_att(t, electra: bool, rng, n_bits=None,
+            committee_count=None):
+    if committee_count is None:
+        committee_count = T.ChainSpec.minimal(
+            ).preset.max_committees_per_slot
+    data = T.AttestationData(
+        slot=int(rng.integers(0, 100)), index=int(rng.integers(0, 4)),
+        beacon_block_root=bytes(rng.bytes(32)),
+        source=T.Checkpoint(epoch=0, root=bytes(rng.bytes(32))),
+        target=T.Checkpoint(epoch=int(rng.integers(0, 8)),
+                            root=bytes(rng.bytes(32))))
+    n = int(rng.integers(1, 40)) if n_bits is None else n_bits
+    bits = [bool(b) for b in rng.integers(0, 2, n)]
+    sig = bytes(rng.bytes(96))
+    if electra:
+        cb = [False] * committee_count
+        cb[int(rng.integers(0, committee_count))] = True
+        return t.AttestationElectra(
+            aggregation_bits=bits, data=data, committee_bits=cb,
+            signature=sig)
+    return t.Attestation(aggregation_bits=bits, data=data, signature=sig)
+
+
+class TestValidateBlob:
+    """validate_blob ≡ scalar-deserialize-success, per wire format."""
+
+    @pytest.mark.parametrize("electra", [False, True])
+    def test_valid_wires_and_mutations(self, electra):
+        layout, cls, spec = _layouts(electra)
+        t = T.make_types(spec.preset)
+        rng = np.random.default_rng(7 + electra)
+        for _ in range(40):
+            blob = _mk_att(t, electra, rng).serialize()
+            assert columnar.validate_blob(blob, layout)
+            assert _scalar_ok(cls, blob)
+            muts = [
+                blob[:int(rng.integers(0, len(blob)))],   # truncation
+                blob[:-1] + b"\x00",                      # delimiter gone
+                b"\x00" * 4 + blob[4:],                   # offset wrong
+                bytes([blob[0] ^ 1]) + blob[1:],          # offset off-by-one
+                blob + bytes(rng.bytes(int(rng.integers(1, 8)))),
+            ]
+            if electra:
+                # committee_bits padding bit set
+                cb_off = layout.cb_off
+                raised = bytearray(blob)
+                raised[cb_off] |= 1 << (layout.committee_count % 8) \
+                    if layout.committee_count % 8 else 0x80
+                muts.append(bytes(raised))
+            # overlong bitlist: max bits + 1 (delimiter one byte past)
+            over_bits = bytearray(blob[:layout.head])
+            tail = bytes([0xFF] * (layout.bits_limit // 8) + [0x03])
+            muts.append(bytes(over_bits) + tail)
+            for m in muts:
+                assert columnar.validate_blob(m, layout) == \
+                    _scalar_ok(cls, m), m.hex()[:40]
+
+    @pytest.mark.parametrize("electra", [False, True])
+    def test_garbage(self, electra):
+        layout, cls, _spec = _layouts(electra)
+        rng = np.random.default_rng(11)
+        for _ in range(200):
+            m = bytes(rng.bytes(int(rng.integers(0, 400))))
+            assert columnar.validate_blob(m, layout) == _scalar_ok(cls, m)
+
+
+class TestDecodeBatch:
+    """Strided decode ≡ per-message scalar decode, column by column."""
+
+    @pytest.mark.parametrize("electra", [False, True])
+    def test_columns_match_scalar(self, electra):
+        layout, cls, spec = _layouts(electra)
+        t = T.make_types(spec.preset)
+        rng = np.random.default_rng(23 + electra)
+        blobs, want = [], []
+        for i in range(64):
+            if i % 7 == 3:
+                blobs.append(bytes(rng.bytes(int(rng.integers(0, 300)))))
+                want.append(None if not _scalar_ok(cls, blobs[-1])
+                            else cls.deserialize(blobs[-1]))
+            else:
+                att = _mk_att(t, electra, rng)
+                blobs.append(att.serialize())
+                want.append(att)
+        cols, malformed = columnar.decode_batch(blobs, layout, cls=cls)
+        assert sorted(malformed) == [i for i, w in enumerate(want)
+                                     if w is None]
+        assert cols.n == len(blobs) - len(malformed)
+        for j in range(cols.n):
+            i = int(cols.row_index[j])
+            att = want[i]
+            bits = np.asarray(att.aggregation_bits, bool)
+            assert int(cols.slot[j]) == int(att.data.slot)
+            assert int(cols.index[j]) == int(att.data.index)
+            assert cols.beacon_block_root[j].tobytes() == \
+                bytes(att.data.beacon_block_root)
+            assert int(cols.source_epoch[j]) == int(att.data.source.epoch)
+            assert int(cols.target_epoch[j]) == int(att.data.target.epoch)
+            assert cols.target_root[j].tobytes() == \
+                bytes(att.data.target.root)
+            assert cols.signature[j].tobytes() == bytes(att.signature)
+            assert int(cols.bit_count[j]) == bits.shape[0]
+            assert int(cols.set_bits[j]) == int(bits.sum())
+            first = int(np.argmax(bits)) if bits.any() else -1
+            assert int(cols.first_bit[j]) == first
+            if electra:
+                cb = np.asarray(att.committee_bits, bool)
+                assert int(cols.committee_bits[j]) == int(
+                    sum(1 << k for k, b in enumerate(cb) if b))
+            # lazy materialization round-trips the original container
+            assert cols.materialize(j) == att
+
+    def test_empty_batch(self):
+        layout, cls, _spec = _layouts(False)
+        cols, malformed = columnar.decode_batch([], layout, cls=cls)
+        assert cols.n == 0 and malformed == []
+        g, f = cols.group_keys()
+        assert g.size == 0 and f.size == 0
+
+
+# -- full-lane equivalence ----------------------------------------------------
+
+
+def _lane_harness(fork: str, real_crypto: bool):
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+
+    h = Harness(n_validators=64, fork=fork, real_crypto=real_crypto)
+    chain = BeaconChain(h.spec, h.state.copy(),
+                        verify_signatures=real_crypto)
+    chain.slot_clock.set_slot(1)
+    return h, chain
+
+
+def _signed_single_bits(h, chain, slot=0, bad_rows=()):
+    """One single-bit attestation per committee member of `slot`, signed
+    with the real interop keys; rows in `bad_rows` get a corrupted
+    signature byte."""
+    from lighthouse_tpu.state_transition import misc
+
+    spec = h.spec
+    epoch = spec.compute_epoch_at_slot(slot)
+    shuffle = chain.committee_shuffle(chain.head_state, epoch)
+    per_slot = misc.get_committee_count_per_slot(spec, shuffle.shape[0])
+    head_root = chain.head_root
+    target = T.Checkpoint(epoch=epoch, root=head_root)
+    source = chain.head_state.current_justified_checkpoint
+    out = []
+    electra = hasattr(h.t, "AttestationElectra") and \
+        h.spec.fork_at_epoch(epoch) == "electra"
+    for ci in range(per_slot):
+        committee = misc.get_beacon_committee(
+            chain.head_state, spec, slot, ci, shuffle)
+        data = T.AttestationData(
+            slot=slot, index=0 if electra else ci,
+            beacon_block_root=head_root, source=source, target=target)
+        domain = misc.get_domain(
+            chain.head_state, spec, spec.domain_beacon_attester, epoch)
+        root = misc.compute_signing_root(data.hash_tree_root(), domain)
+        for pos, vidx in enumerate(committee):
+            sig = bytearray(h.sk(int(vidx)).sign(root).to_bytes())
+            if len(out) in bad_rows:
+                sig[5] ^= 0xFF
+            bits = [False] * committee.shape[0]
+            bits[pos] = True
+            if electra:
+                cb = [False] * spec.preset.max_committees_per_slot
+                cb[ci] = True
+                out.append(h.t.AttestationElectra(
+                    aggregation_bits=bits, data=data, committee_bits=cb,
+                    signature=bytes(sig)))
+            else:
+                out.append(h.t.Attestation(
+                    aggregation_bits=bits, data=data,
+                    signature=bytes(sig)))
+    return out
+
+
+def _pool_state(chain):
+    return {
+        (slot, key): (bits.copy().tolist())
+        for slot, per_slot in chain.naive_pool._slots.items()
+        for key, (_d, bits, _s, _ci) in per_slot.items()
+    }
+
+
+class TestWireLaneEquivalence:
+    """process_wire_batch ≡ the scalar batch pipeline on the same wire."""
+
+    @pytest.mark.parametrize("fork", ["altair", "electra"])
+    def test_mixed_batch_matches_scalar(self, fork):
+        from lighthouse_tpu.chain import columnar_ingest
+
+        electra = fork == "electra"
+        h, chain_c = _lane_harness(fork, real_crypto=True)
+        _h2, chain_s = _lane_harness(fork, real_crypto=True)
+        # keep both harnesses on the SAME keys/state
+        atts = _signed_single_bits(h, chain_c, bad_rows={1, 5})
+        rng = np.random.default_rng(3)
+        blobs = [a.serialize() for a in atts]
+        # a duplicate row (same validator bit, distinct object so the
+        # id-keyed scalar oracle attributes per entry) + garbage tails
+        blobs.append(blobs[0])
+        atts.append(type(atts[0]).deserialize(blobs[0]))
+        garbage_at = len(blobs)
+        blobs.append(b"\x00\x01\x02")
+        blobs.append(bytes(rng.bytes(150)))
+
+        res = columnar_ingest.process_wire_batch(
+            chain_c, [(b, electra) for b in blobs])
+        col_rejects = dict(res.rejects)
+
+        verified_s, rejects_s = chain_s.verify_attestations_for_gossip(
+            list(atts))
+        # same verified count (garbage rows can never verify)
+        assert res.verified == len(verified_s)
+        # same per-entry reject reasons for the object rows
+        scalar_reasons = {id(item): r for item, r in rejects_s}
+        for i, att in enumerate(atts):
+            want = scalar_reasons.get(id(att))
+            assert col_rejects.get(i) == want, (i, col_rejects.get(i), want)
+        # garbage rows reject as decode_error
+        assert col_rejects[garbage_at] == "decode_error"
+        assert col_rejects[garbage_at + 1] == "decode_error"
+        # pool effect identical
+        assert _pool_state(chain_c) == _pool_state(chain_s)
+
+    def test_timing_and_target_rejects_match(self):
+        from lighthouse_tpu.chain import columnar_ingest
+
+        h, chain_c = _lane_harness("altair", real_crypto=False)
+        _h2, chain_s = _lane_harness("altair", real_crypto=False)
+        atts = _signed_single_bits(h, chain_c)
+        base = atts[0]
+        crafted = []
+        # future slot
+        crafted.append(type(base)(
+            aggregation_bits=list(base.aggregation_bits),
+            data=T.AttestationData(
+                slot=64, index=int(base.data.index),
+                beacon_block_root=bytes(base.data.beacon_block_root),
+                source=base.data.source,
+                target=T.Checkpoint(epoch=8, root=bytes(
+                    base.data.target.root))),
+            signature=bytes(base.signature)))
+        # target epoch mismatch
+        crafted.append(type(base)(
+            aggregation_bits=list(base.aggregation_bits),
+            data=T.AttestationData(
+                slot=0, index=int(base.data.index),
+                beacon_block_root=bytes(base.data.beacon_block_root),
+                source=base.data.source,
+                target=T.Checkpoint(epoch=3, root=bytes(
+                    base.data.target.root))),
+            signature=bytes(base.signature)))
+        # unknown head block
+        crafted.append(type(base)(
+            aggregation_bits=list(base.aggregation_bits),
+            data=T.AttestationData(
+                slot=0, index=int(base.data.index),
+                beacon_block_root=b"\xee" * 32,
+                source=base.data.source, target=base.data.target),
+            signature=bytes(base.signature)))
+        # empty aggregation bits
+        crafted.append(type(base)(
+            aggregation_bits=[False] * len(base.aggregation_bits),
+            data=base.data, signature=bytes(base.signature)))
+        # aggregated (2 bits) -> not_unaggregated
+        two = [False] * len(base.aggregation_bits)
+        if len(two) >= 2:
+            two[0] = two[1] = True
+        crafted.append(type(base)(
+            aggregation_bits=two, data=base.data,
+            signature=bytes(base.signature)))
+        batch = atts + crafted
+        res = columnar_ingest.process_wire_batch(
+            chain_c, [(a.serialize(), False) for a in batch])
+        _v, rejects_s = chain_s.verify_attestations_for_gossip(list(batch))
+        col = sorted(r for _i, r in res.rejects)
+        want = sorted(r for _item, r in rejects_s)
+        assert col == want
+        assert res.verified == len(batch) - len(res.rejects)
+
+    def test_cross_batch_duplicates_rejected(self):
+        from lighthouse_tpu.chain import columnar_ingest
+
+        h, chain = _lane_harness("altair", real_crypto=False)
+        atts = _signed_single_bits(h, chain)
+        entries = [(a.serialize(), False) for a in atts]
+        first = columnar_ingest.process_wire_batch(chain, entries)
+        assert first.verified == len(atts)
+        again = columnar_ingest.process_wire_batch(chain, entries)
+        assert again.verified == 0
+        assert {r for _i, r in again.rejects} == \
+            {"prior_attestation_known"}
+
+    def test_kill_switch_reports_disabled(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_INGEST_COLUMNAR", "0")
+        assert not columnar.enabled()
+        monkeypatch.setenv("LHTPU_INGEST_COLUMNAR", "1")
+        assert columnar.enabled()
+
+    def test_unknown_head_outranks_bits_checks(self):
+        """Downscore parity: unknown_head_block (benign — the block may
+        simply not have arrived yet) must win over the non-benign
+        empty_aggregation_bits / not_unaggregated reasons, exactly like
+        the scalar _gossip_checks order."""
+        from lighthouse_tpu.chain import columnar_ingest
+
+        h, chain_c = _lane_harness("altair", real_crypto=False)
+        _h2, chain_s = _lane_harness("altair", real_crypto=False)
+        base = _signed_single_bits(h, chain_c)[0]
+        nbits = len(base.aggregation_bits)
+        data = T.AttestationData(
+            slot=0, index=int(base.data.index),
+            beacon_block_root=b"\xee" * 32,
+            source=base.data.source, target=base.data.target)
+        crafted = [type(base)(
+            aggregation_bits=[False] * nbits, data=data,
+            signature=bytes(base.signature))]
+        two = [False] * nbits
+        two[0] = two[1] = True
+        crafted.append(type(base)(
+            aggregation_bits=two, data=data,
+            signature=bytes(base.signature)))
+        res = columnar_ingest.process_wire_batch(
+            chain_c, [(a.serialize(), False) for a in crafted])
+        assert [r for _i, r in sorted(res.rejects)] == \
+            ["unknown_head_block"] * 2
+        _v, rejects_s = chain_s.verify_attestations_for_gossip(
+            list(crafted))
+        assert [r for _it, r in rejects_s] == ["unknown_head_block"] * 2
+
+    def test_fold_rejects_out_of_subgroup_signature(self):
+        """_fold_sig_side completes the G2 membership test: an on-curve
+        point OUTSIDE the prime-order subgroup must not fold into a
+        merged lane (the merged Signature carries a preset point the
+        verifiers trust as subgroup-checked)."""
+        from lighthouse_tpu.chain import columnar_ingest
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls import curve as cv
+        from lighthouse_tpu.crypto.bls.fields import R
+
+        rng = np.random.default_rng(11)
+        rogue = None
+        for _ in range(512):
+            cand = bytearray(rng.bytes(96))
+            cand[0] = (cand[0] & 0x1F) | 0x80   # compressed, finite
+            try:
+                p = cv.g2_from_bytes(bytes(cand), subgroup_check=False)
+            except Exception:
+                continue
+            if p is not cv.INF and not cv.g2_in_subgroup_fast(p):
+                rogue = bytes(cand)
+                break
+        assert rogue is not None, "no on-curve rogue point found"
+        honest = bls.SecretKey(12345).sign(b"\x22" * 32).to_bytes()
+        prep = {"sig_bytes": [rogue, honest]}
+        assert columnar_ingest._fold_sig_side(
+            prep, [0, 1], cv, R) is None
+        honest2 = bls.SecretKey(54321).sign(b"\x22" * 32).to_bytes()
+        prep = {"sig_bytes": [honest, honest2]}
+        assert columnar_ingest._fold_sig_side(
+            prep, [0, 1], cv, R) is not None
+
+
+class TestInsertSingleBit:
+    """naive-pool fast path ≡ insert() for single-bit contributions."""
+
+    def test_parity_with_insert(self):
+        from lighthouse_tpu.pool import NaiveAggregationPool
+
+        h = Harness(n_validators=64, fork="altair", real_crypto=False)
+        data = T.AttestationData(
+            slot=3, index=1, beacon_block_root=b"\x11" * 32,
+            source=T.Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=T.Checkpoint(epoch=0, root=b"\x22" * 32))
+        root = data.hash_tree_root()
+        a_pool, b_pool = NaiveAggregationPool(), NaiveAggregationPool()
+        n = 8
+        for pos in (2, 5, 2, 7):   # incl. a duplicate bit
+            bits = [False] * n
+            bits[pos] = True
+            att = h.t.Attestation(aggregation_bits=bits, data=data,
+                                  signature=bytes([pos]) * 96)
+            got_a = a_pool.insert(att)
+            got_b = b_pool.insert_single_bit(
+                data, root, 1, n, pos, bytes([pos]) * 96)
+            assert got_a == got_b
+        assert _pool_like(a_pool) == _pool_like(b_pool)
+
+    def test_length_mismatch_rejected(self):
+        from lighthouse_tpu.pool import NaiveAggregationPool
+
+        data = T.AttestationData(
+            slot=3, index=1, beacon_block_root=b"\x11" * 32,
+            source=T.Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=T.Checkpoint(epoch=0, root=b"\x22" * 32))
+        root = data.hash_tree_root()
+        pool = NaiveAggregationPool()
+        assert pool.insert_single_bit(data, root, 1, 8, 0, b"\x01" * 96)
+        assert not pool.insert_single_bit(data, root, 1, 9, 1,
+                                          b"\x01" * 96)
+
+
+def _pool_like(pool):
+    return {
+        (slot, key): bits.tolist()
+        for slot, per_slot in pool._slots.items()
+        for key, (_d, bits, _s, _ci) in per_slot.items()
+    }
+
+
+# -- pubkey plane -------------------------------------------------------------
+
+
+def _registry(n: int, n_keys: int = 4, seed: int = 5):
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.types.registry import Validators
+
+    rng = np.random.default_rng(seed)
+    sks = [bls.SecretKey(int(rng.integers(2, 1 << 60))) for _ in
+           range(n_keys)]
+    v = Validators(n)
+    for i in range(n):
+        v.pubkeys[i] = np.frombuffer(
+            sks[i % n_keys].public_key().to_bytes(), np.uint8)
+    return v, sks
+
+
+def _install_stub_kernels(monkeypatch, stub):
+    """Replace ops.pubkey_kernels for the plane's lazy import — both
+    the sys.modules entry AND the package attribute (``from lighthouse_
+    tpu.ops import pubkey_kernels`` resolves the attribute when the
+    real module was imported earlier in the process)."""
+    import lighthouse_tpu.ops as ops_pkg
+
+    monkeypatch.setitem(
+        sys.modules, "lighthouse_tpu.ops.pubkey_kernels", stub)
+    monkeypatch.setattr(ops_pkg, "pubkey_kernels", stub, raising=False)
+
+
+class TestPubkeyPlaneHost:
+    """Reference rung ≡ naive per-lane point adds (the old
+    aggregate_pubkey semantics), incl. identity and duplicates."""
+
+    def setup_method(self):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        pubkey_plane.reset_pubkey_plane()
+
+    def test_host_fold_matches_naive_adds(self):
+        from lighthouse_tpu.chain import pubkey_plane
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls import curve as cv
+
+        v, _sks = _registry(16)
+        plane = pubkey_plane.get_plane()
+        rng = np.random.default_rng(9)
+        idx = rng.integers(0, 16, 30).astype(np.int64)
+        idx[3] = idx[4]                       # duplicate validator
+        sc = rng.integers(1, 1 << 62, 30, dtype=np.uint64)
+        gr = np.sort(rng.integers(0, 5, 30)).astype(np.int64)
+        got = plane.fold(v, idx, sc, gr, 6)   # group 5 may be empty
+        want = [cv.INF] * 6
+        for i in range(30):
+            pt = bls.PublicKey.interned(
+                v.pubkeys[int(idx[i])].tobytes()).point
+            want[int(gr[i])] = cv.g1_add(
+                want[int(gr[i])], cv.g1_mul(pt, int(sc[i])))
+        want = [None if p is cv.INF else p for p in want]
+        assert got == want
+        # empty groups answer None (identity aggregate can't verify)
+        for g in range(6):
+            if not (gr == g).any():
+                assert got[g] is None
+
+    def test_scalar_sum_collapse_mod_r(self):
+        """r1·pk + r2·pk = (r1+r2 mod R)·pk — incl. sums that cancel."""
+        from lighthouse_tpu.chain import pubkey_plane
+        from lighthouse_tpu.crypto.bls.fields import R
+
+        v, _sks = _registry(4, n_keys=1)      # every row the SAME key
+        plane = pubkey_plane.get_plane()
+        s = 12345
+        idx = np.array([0, 1], np.int64)
+        gr = np.array([0, 0], np.int64)
+        # object dtype scalars are not the fold's contract; emulate a
+        # cancelling pair via the host rung's own mod-R arithmetic
+        out = plane._fold_host(v, idx, np.array([s, R - s], dtype=object),
+                               gr, 1)
+        assert out == [None]                  # cancelled -> identity
+
+    def test_kill_switch_and_forced_backend(self, monkeypatch):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        monkeypatch.setenv("LHTPU_PUBKEY_PLANE", "0")
+        assert pubkey_plane.resolve_pubkey_backend(10**6) == "reference"
+        monkeypatch.setenv("LHTPU_PUBKEY_PLANE", "1")
+        monkeypatch.setenv("LHTPU_PUBKEY_BACKEND", "device")
+        assert pubkey_plane.resolve_pubkey_backend(1) == "device"
+        monkeypatch.delenv("LHTPU_PUBKEY_BACKEND")
+        # below device-min: reference without ever importing jax
+        assert pubkey_plane.resolve_pubkey_backend(1) == "reference"
+
+    def test_breaker_opens_and_recovers(self, monkeypatch):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        monkeypatch.setenv("LHTPU_PUBKEY_BACKEND", "device")
+        monkeypatch.setenv("LHTPU_SUPERVISOR_FAILS", "1")
+        v, _sks = _registry(8)
+        plane = pubkey_plane.get_plane()
+        # a device rung that always faults (stub kernels module)
+        stub = pytypes.ModuleType("lighthouse_tpu.ops.pubkey_kernels")
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device fault")
+
+        stub.build_table = boom
+        stub.mont_rows = boom
+        stub.table_from_rows = boom
+        stub.gather_fold = boom
+        _install_stub_kernels(monkeypatch, stub)
+        idx = np.array([0, 1], np.int64)
+        sc = np.array([3, 5], np.uint64)
+        gr = np.array([0, 0], np.int64)
+        out = plane.fold(v, idx, sc, gr, 1)
+        assert out[0] is not None             # recovered on reference
+        # breaker open: auto routing answers reference while tripped
+        monkeypatch.delenv("LHTPU_PUBKEY_BACKEND")
+        assert pubkey_plane.resolve_pubkey_backend(10**6) == "reference"
+        pubkey_plane._breaker_ok()
+        monkeypatch.setenv("LHTPU_PUBKEY_BACKEND", "reference")
+        assert pubkey_plane.resolve_pubkey_backend(10**6) == "reference"
+
+    def test_table_fault_counts_breaker_once(self, monkeypatch):
+        """A failed ensure_table inside a fold advances the breaker ONE
+        step — the fault is accounted where it happens, never re-counted
+        by fold()'s recovery handler."""
+        from lighthouse_tpu.chain import pubkey_plane
+
+        monkeypatch.setenv("LHTPU_PUBKEY_BACKEND", "device")
+        monkeypatch.setenv("LHTPU_SUPERVISOR_FAILS", "2")
+        v, _sks = _registry(4)
+        plane = pubkey_plane.get_plane()
+        stub = pytypes.ModuleType("lighthouse_tpu.ops.pubkey_kernels")
+
+        def boom(*a, **k):
+            raise RuntimeError("injected table fault")
+
+        stub.build_table = boom
+        stub.mont_rows = boom
+        stub.table_from_rows = boom
+        stub.gather_fold = boom
+        _install_stub_kernels(monkeypatch, stub)
+        idx = np.array([0, 1], np.int64)
+        sc = np.array([3, 5], np.uint64)
+        gr = np.array([0, 0], np.int64)
+        out = plane.fold(v, idx, sc, gr, 1)
+        assert out[0] is not None             # recovered on reference
+        with pubkey_plane._BREAKER_LOCK:
+            assert pubkey_plane._BREAKER["fails"] == 1
+            assert pubkey_plane._BREAKER["open_until"] == 0.0
+        out = plane.fold(v, idx, sc, gr, 1)   # second REAL fault opens
+        assert out[0] is not None
+        with pubkey_plane._BREAKER_LOCK:
+            assert pubkey_plane._BREAKER["open_until"] > 0.0
+
+    def test_table_refresh_append_and_rebuild(self, monkeypatch):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        built = []
+        converted = []
+        stub = pytypes.ModuleType("lighthouse_tpu.ops.pubkey_kernels")
+
+        def mont_rows(points):
+            converted.append(len(points))
+            return (np.zeros((len(points), 2), np.uint32),
+                    np.zeros((len(points), 2), np.uint32))
+
+        def table_from_rows(rows_x, rows_y):
+            built.append(len(rows_x))
+            return ("table", len(rows_x))
+
+        stub.mont_rows = mont_rows
+        stub.table_from_rows = table_from_rows
+        _install_stub_kernels(monkeypatch, stub)
+        v, _sks = _registry(4)
+        plane = pubkey_plane.get_plane()
+        assert plane.ensure_table(v)
+        assert built == [4] and plane._table_rows == 4
+        # same registry object: memoized, no rebuild
+        assert plane.ensure_table(v)
+        assert built == [4]
+        # append-only growth: only the NEW rows decompress + convert
+        v2, _ = _registry(6)
+        assert plane.ensure_table(v2)
+        assert built == [4, 6] and plane._table_rows == 6
+        assert converted == [4, 2]
+        # a SHORTER registry is a prefix (append-only discipline): the
+        # resident table serves it — no rebuild, no shrink
+        v_short, _ = _registry(3)
+        assert plane.ensure_table(v_short)
+        assert built == [4, 6] and plane._table_rows == 6
+        # prefix MISMATCH (different key material): full rebuild
+        v3, _ = _registry(6, seed=77)
+        assert plane.ensure_table(v3)
+        assert plane._table_rows == 6
+        assert converted == [4, 2, 6]
+        assert plane._prefix_sha != b""
+
+    def test_notify_registry_is_noop_on_reference(self, monkeypatch):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        monkeypatch.setenv("LHTPU_PUBKEY_PLANE", "0")
+        v, _sks = _registry(4)
+        pubkey_plane.notify_registry(v)       # must not raise or build
+        assert pubkey_plane.get_plane()._table_rows == 0
+
+
+class TestPubkeyPlaneDevice:
+    @slow
+    def test_device_fold_matches_host(self, monkeypatch):
+        from lighthouse_tpu.chain import pubkey_plane
+
+        pubkey_plane.reset_pubkey_plane()
+        monkeypatch.setenv("LHTPU_PUBKEY_BACKEND", "device")
+        v, _sks = _registry(12)
+        plane = pubkey_plane.get_plane()
+        rng = np.random.default_rng(41)
+        idx = rng.integers(0, 12, 64).astype(np.int64)
+        idx[5] = idx[6]                       # duplicate validator lane
+        sc = rng.integers(1, 1 << 63, 64, dtype=np.uint64)
+        gr = np.sort(rng.integers(0, 7, 64)).astype(np.int64)
+        dev = plane.fold(v, idx, sc, gr, 8)   # group 7 may be empty
+        host = plane._fold_host(v, idx, sc, gr, 8)
+        assert dev == host

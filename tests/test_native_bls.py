@@ -99,6 +99,114 @@ class TestG2Decompression:
         assert via_layer == pt
 
 
+class TestG2SubgroupBatch:
+    """Native psi membership test ≡ the python g2_in_subgroup_fast
+    oracle — in-subgroup multiples of the generator, rogue on-curve
+    points outside the subgroup, and out-of-range coordinates."""
+
+    def test_differential_against_python_oracle(self):
+        rng = np.random.default_rng(4)
+        pts = [cv.g2_mul(cv.g2_generator(), int(rng.integers(1, 2**62)))
+               for _ in range(6)]
+        while len(pts) < 10:       # rogue on-curve points (cofactor hit)
+            cand = bytearray(rng.bytes(96))
+            cand[0] = (cand[0] & 0x1F) | 0x80
+            try:
+                p = cv.g2_from_bytes(bytes(cand), subgroup_check=False)
+            except Exception:
+                continue
+            if p is not cv.INF and not cv.g2_in_subgroup_fast(p):
+                pts.append(p)
+        want = [1 if cv.g2_in_subgroup_fast(p) else 0 for p in pts]
+        assert native_bls.g2_in_subgroup_batch(pts) == want
+        assert want[:6] == [1] * 6 and 0 in want
+
+    def test_out_of_range_coordinate_flagged(self):
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.crypto.bls.fields import P as _P
+
+        # raw namespace: the Fq2 constructor would reduce mod p
+        bad = (SimpleNamespace(a=_P, b=0), SimpleNamespace(a=1, b=2))
+        assert native_bls.g2_in_subgroup_batch([bad]) == [-1]
+        assert native_bls.g2_in_subgroup_batch([]) == []
+
+    def test_signature_batch_marks_checked(self):
+        from lighthouse_tpu.crypto import bls
+
+        sigs = [bls.Signature(bls.SecretKey(i + 2).sign(
+            bytes([i]) * 32).to_bytes()) for i in range(4)]
+        assert bls.Signature.decompress_batch(sigs)
+        assert not any(s.subgroup_checked() for s in sigs)
+        assert bls.Signature.subgroup_check_batch(sigs)
+        assert all(s.subgroup_checked() for s in sigs)
+
+
+class TestLincombGroups:
+    """Native segment-summed MSM ≡ per-term host scalar muls + point
+    adds — the merged-lane sig fold and the pubkey plane's reference
+    rung both ride these."""
+
+    def test_g2_matches_host_loop(self):
+        import secrets
+
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls.fields import R as _R
+
+        pts = [bls.SecretKey(i + 2).sign(bytes([i]) * 32)
+               .point_unchecked() for i in range(12)]
+        rs = [secrets.randbits(64) for _ in pts]
+        groups = [0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 3, 3]
+        got = native_bls.g2_lincomb_groups(pts, rs, groups, 5)
+        want = [cv.INF] * 5
+        for p, r, g in zip(pts, rs, groups):
+            want[g] = cv.g2_add(want[g], cv.g2_mul(p, r))
+        for g in range(5):
+            w = (None if want[g] is cv.INF else
+                 ((want[g][0].a, want[g][0].b),
+                  (want[g][1].a, want[g][1].b)))
+            assert got[g] == w, g
+        assert got[4] is None                 # empty group = identity
+        # cancellation: r and R-r on the same point -> identity
+        assert native_bls.g2_lincomb_groups(
+            [pts[0], pts[0]], [5, _R - 5], [0, 0], 1) == [None]
+
+    def test_g1_matches_host_loop(self):
+        import secrets
+
+        from lighthouse_tpu.crypto import bls
+
+        pks = [cv.g1_from_bytes(bls.SecretKey(i + 2).public_key()
+                                .to_bytes()) for i in range(9)]
+        rs = [secrets.randbits(64) for _ in pks]
+        groups = [0, 1, 2, 0, 1, 2, 0, 1, 2]
+        got = native_bls.g1_lincomb_groups(pks, rs, groups, 3)
+        want = [cv.INF] * 3
+        for p, r, g in zip(pks, rs, groups):
+            want[g] = cv.g1_add(want[g], cv.g1_mul(p, r))
+        assert got == [None if w is cv.INF else w for w in want]
+        # duplicate point doubles through the H==0 branch exactly
+        assert native_bls.g1_lincomb_groups(
+            [pks[0], pks[0]], [7, 7], [0, 0], 1) == \
+            [cv.g1_mul(pks[0], 14)]
+        # zero scalar contributes identity
+        assert native_bls.g1_lincomb_groups([pks[0]], [0], [0], 1) == \
+            [None]
+
+    def test_bad_group_or_coord_poisons_call(self):
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.crypto import bls
+        from lighthouse_tpu.crypto.bls.fields import P as _P
+
+        pk = cv.g1_from_bytes(bls.SecretKey(2).public_key().to_bytes())
+        assert native_bls.g1_lincomb_groups([pk], [3], [5], 2) is None
+        sig = bls.SecretKey(2).sign(b"\x07" * 32).point_unchecked()
+        bad = (SimpleNamespace(a=_P, b=0), sig[1])
+        assert native_bls.g2_lincomb_groups(
+            [sig, bad], [3, 4], [0, 0], 1) is None
+
+
 class TestFinalExponentiation:
     def test_matches_python_oracle(self):
         rng = np.random.default_rng(4)

@@ -514,3 +514,129 @@ class TestProcessorFanIn:
             assert unaccounted_total(bp) == 0
 
         asyncio.run(main())
+
+
+class TestColumnarFanIn:
+    """ISSUE 14 regression pins: the columnar wire batch path keeps the
+    PR 8 fan-in ledger's decode_error scoping (attestation deliveries
+    only) and the peer-downscoring contract of the object batch path."""
+
+    @staticmethod
+    def _fanin(outcome):
+        from lighthouse_tpu.network import gossip
+
+        child = gossip._FANIN_CHILDREN.get(outcome)
+        return child.value if child is not None else 0.0
+
+    def test_decode_error_scoped_to_attestation_deliveries(self):
+        import asyncio
+
+        from lighthouse_tpu.network.router import Router, topic
+        from lighthouse_tpu.network.rpc import RpcFabric
+        from lighthouse_tpu.processor import BeaconProcessor, WorkType
+
+        h = Harness(n_validators=64, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        att = h.attest()
+        chain.slot_clock.set_slot(int(att.data.slot) + 1)
+        reports = []
+
+        async def main():
+            bp = BeaconProcessor(max_workers=2, batch_flush_ms=5)
+            hub = GossipHub()
+            node_ep, peer_ep = hub.join("node"), hub.join("peer")
+            peers = PeerManager()
+            router = Router(chain, node_ep, RpcFabric().join("node"),
+                            peers, processor=bp)
+            assert router._columnar, "columnar path must be the default"
+            orig = peers.report
+            peers.report = lambda p, lvl, **kw: (
+                reports.append((p, lvl)), orig(p, lvl, **kw))
+            await bp.start()
+            before = {o: self._fanin(o)
+                      for o in ("accepted", "decode_error")}
+            n = len(att.aggregation_bits)
+            for i in range(n):
+                bits = [False] * n
+                bits[i] = True
+                single = type(att)(aggregation_bits=bits, data=att.data,
+                                   signature=bytes(att.signature))
+                peer_ep.publish(topic(chain, "beacon_attestation_0"),
+                                single.serialize())
+            # garbage on the ATTESTATION lane: counted decode_error
+            peer_ep.publish(topic(chain, "beacon_attestation_0"),
+                            b"\x00\x01garbage")
+            # garbage on the AGGREGATE lane: NOT in the fan-in ledger
+            peer_ep.publish(topic(chain, "beacon_aggregate_and_proof"),
+                            b"\x00\x01garbage")
+            import time as _t
+
+            t0 = _t.monotonic()
+            while bp.metrics.processed.get(
+                    WorkType.GOSSIP_ATTESTATION, 0) < n:
+                assert _t.monotonic() - t0 < 10, "atts never processed"
+                await asyncio.sleep(0.01)
+            await bp.drain()
+            await bp.stop()
+            assert self._fanin("accepted") - before["accepted"] == n
+            assert self._fanin("decode_error") - before["decode_error"] \
+                == 1, "decode_error must count attestation deliveries only"
+            # the columnar lane fed the pool without object payloads
+            assert len(chain.naive_pool) >= 1
+            # both garbage deliveries downscored their sender
+            assert ("peer", "low") in reports
+
+        asyncio.run(main())
+
+    def test_columnar_handler_downscores_non_benign_only(self, monkeypatch):
+        from lighthouse_tpu.chain import columnar_ingest
+        from lighthouse_tpu.network.router import Router
+
+        reports = []
+
+        class Peers:
+            def report(self, peer, level, **kw):
+                reports.append((peer, level))
+
+        class Result:
+            verified = 1
+            rejects = [(0, "invalid_signature"), (1, "past_slot"),
+                       (2, "decode_error")]
+
+        monkeypatch.setattr(columnar_ingest, "process_wire_batch",
+                            lambda chain, entries: Result())
+        router = Router.__new__(Router)
+        router.chain = object()
+        router.peers = Peers()
+        router._ingest_attestation_blob_batch([
+            (b"a", "evil-1", False), (b"b", "honest", False),
+            (b"c", "evil-2", False), (b"d", "fine", False)])
+        assert reports == [("evil-1", "low"), ("evil-2", "low")]
+
+    def test_kill_switch_restores_object_payloads(self, monkeypatch):
+        from lighthouse_tpu.network.router import Router, topic
+        from lighthouse_tpu.network.rpc import RpcFabric
+
+        monkeypatch.setenv("LHTPU_INGEST_COLUMNAR", "0")
+        h = Harness(n_validators=64, fork="altair", real_crypto=False)
+        chain = BeaconChain(h.spec, h.state.copy(), verify_signatures=False)
+        att = h.attest()
+        chain.slot_clock.set_slot(int(att.data.slot) + 1)
+        submitted = []
+
+        class Proc:
+            def submit(self, event):
+                submitted.append(event)
+                return True
+
+        hub = GossipHub()
+        node_ep, peer_ep = hub.join("node"), hub.join("peer")
+        router = Router(chain, node_ep, RpcFabric().join("node"),
+                        PeerManager(), processor=Proc())
+        assert not router._columnar
+        peer_ep.publish(topic(chain, "beacon_attestation_0"),
+                        att.serialize())
+        assert len(submitted) == 1
+        payload = submitted[0].payload
+        assert type(payload[0]).__name__ == "Attestation"
+        assert submitted[0].process_batch == router._verify_attestation_batch

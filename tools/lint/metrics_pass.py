@@ -78,6 +78,10 @@ FAMILY_OWNERS = {
     "finality_lag_": "lighthouse_tpu/chain/chain_health.py",
     "chain_participation_": "lighthouse_tpu/chain/chain_health.py",
     "fleet_": "lighthouse_tpu/simulator.py",
+    # wire-to-device ingest (PR 14): the columnar decoder owns the
+    # ingest_* decode series, the pubkey plane its fold/refresh books
+    "ingest_": "lighthouse_tpu/ssz/columnar.py",
+    "pubkey_plane_": "lighthouse_tpu/chain/pubkey_plane.py",
 }
 
 
