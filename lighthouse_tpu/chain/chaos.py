@@ -1,0 +1,352 @@
+"""Seed-keyed chaos scheduler: every fault plane composed on one clock.
+
+Ten PRs built four orthogonal deterministic fault planes (offload
+``FaultPlan``, store ``CrashPointStore``, ingest ``IngestPlan``, peer
+``PeerFaultPlan``) plus partition induction and a node stop/crash/
+restart cycle — each drilled in isolation.  Production failures do not
+arrive in isolation: committee-based-consensus measurements (PAPERS.md,
+"Performance of EdDSA and BLS Signatures in Committee-Based Consensus")
+show finality latency is governed by the *composition* of crypto load,
+network faults and restarts.  This module is the composer:
+
+- :func:`build_plan` maps a seed to a :class:`ChaosPlan` — a fixed
+  schedule of slot windows, each arming one fault plane against one
+  target (``same seed => byte-identical schedule``, pinned by
+  :meth:`ChaosPlan.digest`).  The tail of the horizon (the *quiet
+  tail*) is kept chaos-free so finality can resume INSIDE the window
+  the headline gauge measures.
+- :class:`ChaosController` applies the plan to a live
+  ``simulator.LocalNetwork`` slot by slot, through each plane's real
+  seam: ``partition``/``heal``, ``kill``/``restart`` (mid-commit store
+  deaths at chosen commit ordinals), ``ops.faults.install_plan`` /
+  ``install_peer_plans`` / ``install_ingest_plan``.  Every armed and
+  disarmed edge emits a flight event and counts into the ``chaos_*``
+  metric family, so a soak's black box reads causally: which plane was
+  blowing when a gate degraded.
+
+``bench.py --child-chaossoak`` drives the acceptance scenario (README
+"Chaos soak"); knobs ride ``LHTPU_CHAOS_*`` (common/env.py).
+
+Stdlib-only by design (no jax, no numpy): the scheduler must be
+importable from the bench driver and the lint fixtures without the
+device stack.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.common import env as envreg
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.ops import faults
+
+#: the fault planes a plan can compose, in deterministic build order
+PLANES = ("partition", "crash", "wedge", "ingest", "offload", "peer")
+
+
+@dataclass(frozen=True)
+class ChaosAction:
+    """One scheduled fault window: arm ``plane`` against ``node`` at
+    ``at_slot``, disarm (and for the crash plane: restart) at
+    ``until_slot``.  ``params`` is a sorted tuple of (key, value) pairs
+    so actions hash/compare bytewise."""
+
+    plane: str
+    at_slot: int
+    until_slot: int
+    node: str | None
+    params: tuple
+
+    def describe(self) -> str:
+        return (f"{self.plane}@{self.at_slot}-{self.until_slot}"
+                f":{self.node or '*'}:{self.params!r}")
+
+    def param(self, key, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+
+@dataclass(frozen=True)
+class ChaosPlan:
+    """A deterministic schedule over ``[start_slot, start_slot +
+    horizon)``.  ``quiet_tail`` slots at the end carry no armed
+    window — finality recovers inside the measured phase."""
+
+    seed: int
+    nodes: tuple
+    start_slot: int
+    horizon: int
+    quiet_tail: int
+    actions: tuple
+
+    def digest(self) -> str:
+        """Byte-stable fingerprint: equal seeds/inputs give equal
+        digests (the determinism pin the soak asserts)."""
+        h = hashlib.sha256()
+        h.update(f"{self.seed}|{','.join(self.nodes)}|"
+                 f"{self.start_slot}|{self.horizon}".encode())
+        for a in self.actions:
+            h.update(a.describe().encode())
+        return h.hexdigest()
+
+    def by_plane(self, plane: str) -> list[ChaosAction]:
+        return [a for a in self.actions if a.plane == plane]
+
+
+def _overlaps(at: int, until: int, windows) -> bool:
+    return any(at < w_until and w_at < until for w_at, w_until in windows)
+
+
+def build_plan(seed: int | None = None, nodes=(), start_slot: int = 0,
+               horizon: int | None = None, kill_every: int | None = None,
+               planes=PLANES) -> ChaosPlan:
+    """Derive a :class:`ChaosPlan` purely from ``seed`` (default
+    ``LHTPU_CHAOS_SEED``) and the explicit inputs — no wall clock, no
+    ambient state, so the same call is byte-identical across runs and
+    machines.  Planes are generated in :data:`PLANES` order from one
+    ``random.Random(seed)`` stream; windows that share a process-wide
+    seam (wedge/ingest, the peer-plan slot) are kept disjoint so a
+    later arm never silently clobbers an earlier one."""
+    if seed is None:
+        # no falsy-zero remap: seed 0 is a valid seed and must produce
+        # the same schedule here as through an explicit seed=0 call
+        seed = envreg.get_int("LHTPU_CHAOS_SEED", 1337)
+    nodes = tuple(nodes)
+    if horizon is None:
+        horizon = envreg.get_int("LHTPU_CHAOS_SLOTS", 44) or 44
+    if kill_every is None:
+        kill_every = envreg.get_int("LHTPU_CHAOS_KILL_EVERY", 10) or 10
+    kill_every = max(4, int(kill_every))
+    rng = random.Random(seed)
+    quiet = max(8, horizon // 4)
+    end = start_slot + horizon - quiet   # last slot any window may reach
+    actions: list[ChaosAction] = []
+
+    if "partition" in planes and len(nodes) >= 2 and horizon >= 24:
+        at = start_slot + rng.randrange(2, max(3, horizon // 3))
+        hold = rng.randrange(4, 7)
+        # groups carry node NAMES (like every other plane's target):
+        # a plan built over a subset of the fleet partitions exactly
+        # the named nodes, never positional aliases
+        split = list(nodes)
+        rng.shuffle(split)
+        half = len(split) // 2
+        groups = (tuple(sorted(split[:half])), tuple(sorted(split[half:])))
+        until = min(at + hold, end)
+        if until > at:
+            actions.append(ChaosAction(
+                "partition", at, until, None, (("groups", groups),)))
+
+    if "crash" in planes and len(nodes) >= 3:
+        # staggered kills (never two nodes down at once: the fleet must
+        # keep >2/3 attesting weight so the soak's finality gate stays
+        # reachable), victims cycle a seed-shuffled node order
+        order = list(nodes)
+        rng.shuffle(order)
+        at = start_slot + kill_every
+        k = 0
+        while True:
+            down = rng.randrange(3, 6)
+            if at + down >= end:
+                break
+            mode = rng.choice(("crash", "drop"))
+            actions.append(ChaosAction(
+                "crash", at, at + down, order[k % len(order)],
+                (("mode", mode), ("offset", rng.randrange(0, 2)),
+                 ("op", rng.randrange(0, 2) if mode == "drop" else 0))))
+            k += 1
+            at = at + down + max(2, kill_every - down)
+
+    # the wedge and ingest planes share the process-wide ingest seam:
+    # build their windows from one disjoint pool
+    seam_windows: list[tuple[int, int]] = []
+    if "wedge" in planes:
+        at = start_slot + rng.randrange(1, max(2, horizon // 2))
+        until = min(at + rng.randrange(2, 4), end)
+        if until > at:
+            seam_windows.append((at, until))
+            actions.append(ChaosAction(
+                "wedge", at, until, None,
+                (("stall_s", rng.choice((0.01, 0.02))),)))
+    if "ingest" in planes:
+        for _ in range(2):
+            at = start_slot + rng.randrange(1, max(2, horizon - quiet - 3))
+            until = min(at + rng.randrange(2, 5), end)
+            if until <= at or _overlaps(at, until, seam_windows):
+                continue
+            seam_windows.append((at, until))
+            actions.append(ChaosAction(
+                "ingest", at, until, None,
+                (("factor", float(rng.randrange(2, 5))),
+                 ("mode", rng.choice(("burst", "dup", "invalid"))))))
+
+    if "offload" in planes:
+        at = start_slot + rng.randrange(1, max(2, horizon // 2))
+        until = min(at + rng.randrange(3, 6), end)
+        if until > at:
+            actions.append(ChaosAction(
+                "offload", at, until, None,
+                (("mode", rng.choice(("raise", "corrupt", "compile"))),
+                 ("sites", ("chunk", "tpu")))))
+
+    if "peer" in planes and nodes:
+        # Byzantine service: requests TO the victim node get faulted at
+        # the requester's discipline seam (bounded fires so a rejoining
+        # node is slowed, never starved).  Windows are ALIGNED with the
+        # crash restarts — the rejoin's handshakes and range sync are
+        # exactly when requests fly, so the plane provably injects
+        # instead of arming into a quiet wire
+        crash_actions = [a for a in actions if a.plane == "crash"]
+        peer_windows: list[tuple[int, int]] = []
+        for k in range(2):
+            if k < len(crash_actions):
+                ca = crash_actions[k]
+                # armed one slot BEFORE the restart edge (same-slot
+                # edges process in plan order, crash first)
+                at = max(ca.at_slot + 1, ca.until_slot - 1)
+                victim = rng.choice([n for n in nodes if n != ca.node]
+                                    or list(nodes))
+            else:
+                at = start_slot + rng.randrange(
+                    1, max(2, horizon - quiet - 3))
+                victim = rng.choice(list(nodes))
+            until = min(at + rng.randrange(3, 6), end)
+            if until <= at or _overlaps(at, until, peer_windows):
+                continue
+            peer_windows.append((at, until))
+            actions.append(ChaosAction(
+                "peer", at, until, victim,
+                (("max_fires", rng.randrange(3, 7)),
+                 ("mode", rng.choice(("empty", "malformed", "flap"))))))
+
+    actions.sort(key=lambda a: (a.at_slot, PLANES.index(a.plane),
+                                a.until_slot, a.node or ""))
+    return ChaosPlan(seed=seed, nodes=nodes, start_slot=start_slot,
+                     horizon=horizon, quiet_tail=quiet,
+                     actions=tuple(actions))
+
+
+@dataclass
+class _ActionRecord:
+    action: ChaosAction
+    state: str = "pending"       # pending -> armed -> done
+
+
+class ChaosController:
+    """Applies a :class:`ChaosPlan` to a live ``LocalNetwork``.
+
+    Call :meth:`on_slot` once per slot BEFORE the network runs it; call
+    :meth:`quiesce` at the end of the phase to disarm anything still
+    open (restarting any node still down).  Every edge is a flight
+    event (``chaos_edge``) and a ``chaos_actions_total{plane,edge}``
+    count; ``chaos_armed_actions`` gauges the composition depth."""
+
+    def __init__(self, net, plan: ChaosPlan):
+        self.net = net
+        self.plan = plan
+        self._records = [_ActionRecord(a) for a in plan.actions]
+        self.killed: list[str] = []      # kill order (drill assertions)
+        self.restarted: list[tuple[str, str]] = []   # (node, resume_mode)
+        # injection evidence per plan-carrying plane, captured at each
+        # disarm edge (honest reporting: an armed plane whose consumer
+        # never dispatched — e.g. offload under fake BLS — shows 0)
+        self.plane_fires: dict[str, int] = {}
+        self._armed = 0
+        self._counter = REGISTRY.counter(
+            "chaos_actions_total",
+            "chaos-plan fault windows by plane and edge "
+            "(armed/disarmed)")
+        self._gauge = REGISTRY.gauge(
+            "chaos_armed_actions",
+            "fault windows currently armed by the chaos controller "
+            "(the composition depth)")
+
+    # -- the clock -----------------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        for rec in self._records:
+            if rec.state == "pending" and slot >= rec.action.at_slot:
+                self._arm(rec, slot)
+            elif rec.state == "armed" and slot >= rec.action.until_slot:
+                self._disarm(rec, slot)
+
+    def quiesce(self, slot: int) -> None:
+        """Disarm every still-open window (end of phase): heal, restart
+        downed nodes, clear every process-wide plan."""
+        for rec in self._records:
+            if rec.state == "armed":
+                self._disarm(rec, slot)
+        faults.clear_all_plans()
+
+    def armed_planes(self) -> set[str]:
+        return {r.action.plane for r in self._records if r.state == "armed"}
+
+    # -- edges ---------------------------------------------------------------
+
+    def _edge(self, action: ChaosAction, edge: str, slot: int) -> None:
+        self._counter.labels(plane=action.plane, edge=edge).inc()
+        self._gauge.set(self._armed)
+        flight.emit("chaos_edge", plane=action.plane, edge=edge,
+                    slot=int(slot), node=action.node,
+                    window=[action.at_slot, action.until_slot],
+                    params=dict(action.params))
+
+    def _arm(self, rec: _ActionRecord, slot: int) -> None:
+        a = rec.action
+        if a.plane == "partition":
+            by_name = {n.name: i for i, n in enumerate(self.net.nodes)}
+            self.net.partition(*[[by_name[name] for name in g]
+                                 for g in a.param("groups")])
+        elif a.plane == "crash":
+            self.net.kill(a.node, mode=a.param("mode"),
+                          op=a.param("op", 0), offset=a.param("offset", 0))
+            self.killed.append(a.node)
+        elif a.plane == "wedge":
+            faults.install_ingest_plan(faults.IngestPlan(
+                "stall", stall_s=a.param("stall_s", 0.01)))
+        elif a.plane == "ingest":
+            faults.install_ingest_plan(faults.IngestPlan(
+                a.param("mode"), factor=a.param("factor", 4.0)))
+        elif a.plane == "offload":
+            faults.install_plan(faults.FaultPlan(
+                a.param("mode"), sites=frozenset(a.param("sites", ()))))
+        elif a.plane == "peer":
+            faults.install_peer_plans([faults.PeerFaultPlan(
+                a.param("mode"), peers=frozenset({a.node}),
+                max_fires=a.param("max_fires", 4))])
+        rec.state = "armed"
+        self._armed += 1
+        self._edge(a, "armed", slot)
+
+    def _disarm(self, rec: _ActionRecord, slot: int) -> None:
+        a = rec.action
+        if a.plane == "partition":
+            self.net.heal()
+        elif a.plane == "crash":
+            node = self.net.restart(a.node)
+            self.restarted.append((a.node, node.chain.resume_mode))
+        elif a.plane in ("wedge", "ingest"):
+            faults.install_ingest_plan(None)
+        elif a.plane == "offload":
+            active = faults.active_plan()
+            if active is not None:
+                self.plane_fires["offload"] = (
+                    self.plane_fires.get("offload", 0) + active.fires)
+            faults.install_plan(None)
+        elif a.plane == "peer":
+            self.plane_fires["peer"] = (
+                self.plane_fires.get("peer", 0)
+                + sum(p.fires for p in faults.active_peer_plans()))
+            faults.install_peer_plans(())
+        rec.state = "done"
+        self._armed -= 1
+        self._edge(a, "disarmed", slot)
+
+
+__all__ = ["PLANES", "ChaosAction", "ChaosController", "ChaosPlan",
+           "build_plan"]

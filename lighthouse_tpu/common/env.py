@@ -389,6 +389,27 @@ _register("LHTPU_FLEET_HEAL_SLOTS", "26",
           "(must cover reconvergence plus enough epochs for finality "
           "to resume).")
 
+# -- the chaos soak: seeded fault-plane composition + node lifecycle
+#    (chain/chaos, simulator lifecycle, bench --child-chaossoak) --------------
+
+_register("LHTPU_CHAOS_SEED", "1337",
+          "ChaosPlan seed: same seed => byte-identical fault schedule "
+          "(chain/chaos.build_plan; the soak's determinism pin).")
+_register("LHTPU_CHAOS_NODES", "4",
+          "Node count for the bench --child-chaossoak soak (floored at "
+          "3 so one node can die without losing quorum).")
+_register("LHTPU_CHAOS_SLOTS", "44",
+          "Slot budget of the all-planes-armed soak phase; the plan "
+          "keeps a quiet tail (~1/4) chaos-free so finality recovers "
+          "inside the measured window.")
+_register("LHTPU_CHAOS_FINALITY_LAG", "6",
+          "Finality-lag bound in epochs the soak's settle phase must "
+          "end within (current epoch minus finalized epoch).")
+_register("LHTPU_CHAOS_KILL_EVERY", "10",
+          "Kill cadence in slots for the ChaosPlan crash plane "
+          "(staggered: at most one node down at a time; floored at "
+          "4).")
+
 
 # -- typed readers ------------------------------------------------------------
 

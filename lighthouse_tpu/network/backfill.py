@@ -163,6 +163,19 @@ class BackfillSync:
     def is_complete(self) -> bool:
         return self._complete
 
+    def rewind_to(self, child_root: bytes, child_slot: int) -> None:
+        """Point the cursor so the next backward batch must serve the
+        chain ENDING at ``child_root`` (window end just above
+        ``child_slot``): re-verification of already-stored history —
+        the chaos soak's crash-repair defense in depth.  Completion
+        resets; the fill invariants (deferred roots, newest-first
+        linkage) apply unchanged, and freezer entries rewritten along
+        the walk carry the same canonical values they already hold."""
+        self._complete = False
+        self.expected_root = bytes(child_root)
+        self.expected_slot = int(child_slot) + 1
+        self._unfilled_upper = int(child_slot) + 1
+
     def process_batch(self, peer: str, last_attempt: bool = False) -> int:
         """Fetch + verify + store one backward batch from `peer`.
         Returns blocks imported (0 at completion).  ``last_attempt``

@@ -305,6 +305,13 @@ class RpcFabric:
         self._nodes[peer_id] = ep
         return ep
 
+    def leave(self, peer_id: str):
+        """Drop a peer's endpoint (node death): further calls to it fail
+        like a dead link — the requester's RequestDiscipline accounts
+        them exactly like any peer failure.  Pairwise partitions are
+        kept: a node that dies partitioned restarts partitioned."""
+        self._nodes.pop(peer_id, None)
+
     def disconnect(self, a: str, b: str):
         """Partition two peers (fault injection for drills/tests)."""
         self._partitions.disconnect(a, b)

@@ -546,6 +546,16 @@ def ingest_plan_from_env() -> IngestPlan | None:
         return None
 
 
+def clear_all_plans() -> None:
+    """Disarm every process-wide fault plane in one call — offload,
+    peer, and ingest.  The chaos controller's quiesce and drill
+    teardown seam: install semantics (the env-derived plans stay
+    suppressed until an explicit clear()/clear_peer_plans())."""
+    install_plan(None)
+    install_peer_plans(())
+    install_ingest_plan(None)
+
+
 def consumer_stall_s() -> float:
     """Per-batch consumer stall the slow-consumer drill injects (0 when
     no stall-mode ingest plan is active or the storm window expired)."""

@@ -27,30 +27,63 @@ asserted protocol-level outcomes:
 ``bench.py --child-fleetwatch`` drives the acceptance drill: 4 nodes
 steady -> 2/2 partition -> heal, gating on observer-vs-ground-truth
 exactness (see the README "Fleet observatory" section).
+
+Node lifecycle (ISSUE 15): every node owns a persistent storage image
+(the PR 5 crash-consistent engines) so :meth:`LocalNetwork.kill`
+(simulated SIGKILL — no close(), dirty marker stays, optionally armed
+mid-commit through the node's CrashPointStore) and
+:meth:`LocalNetwork.restart` (reopen through the startup repair sweep,
+``BeaconChain.try_resume``, re-dial the boot node, range-sync back to
+the live head) give the chaos soak a real stop/crash/restart cycle.
+``bench.py --child-chaossoak`` composes this with every other fault
+plane under a seeded :class:`~lighthouse_tpu.chain.chaos.ChaosPlan`
+(see the README "Chaos soak" section).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import time
+
 from lighthouse_tpu import types as T
 from lighthouse_tpu.chain.beacon_chain import BeaconChain
 from lighthouse_tpu.common import env as envreg
 from lighthouse_tpu.common import flight_recorder as flight
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.network import BootNode, NetworkFabric, NetworkService
 from lighthouse_tpu.network.router import fork_digest
+from lighthouse_tpu.ops import faults
 from lighthouse_tpu.state_transition import genesis_state
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.store.crash import CrashPointStore, InjectedCrash
+from lighthouse_tpu.store.kv import KeyValueStore, MemoryStore
 from lighthouse_tpu.testing import interop_secret_key
 from lighthouse_tpu.validator import ValidatorClient, ValidatorStore
 
 
 @dataclass
 class LocalNode:
+    """One node of the in-process fleet.
+
+    ``disk`` is the node's surviving storage image (the KV engine a
+    real deployment keeps on disk): a kill abandons the wrapper but the
+    image persists, and restart() reopens a fresh HotColdDB over it —
+    exactly a process restart over the surviving disk.  ``crash`` is
+    the per-"process" CrashPointStore wrapper (commit ordinals reset on
+    every restart, matching real process lifetimes).  ``state`` walks
+    up -> killed|stopped -> up; every edge emits a flight event and a
+    ``node_lifecycle_*`` count.
+    """
+
     name: str
     chain: BeaconChain
     net: NetworkService
     vc: ValidatorClient | None = None
+    disk: KeyValueStore | None = None
+    crash: CrashPointStore | None = None
+    state: str = "up"            # up | killed | stopped
+    processor: object | None = None   # soak mode: a live processor ledger
 
 
 @dataclass
@@ -74,6 +107,7 @@ class FleetSnapshot:
     finalized_max: int
     books: dict            # network-wide ledger roll-up
     unaccounted: int       # events no node's books can account for
+    down: list = field(default_factory=list)   # nodes not up this slot
 
 
 class FleetObserver:
@@ -120,7 +154,13 @@ class FleetObserver:
     def snapshot(self, slot: int) -> FleetSnapshot | None:
         if not self.enabled:
             return None
-        nodes = self.net.nodes
+        # equivalence classes, finality and the books roll-up cover the
+        # LIVE fleet: a node that is down is reported as down, never as
+        # a phantom head class or a frozen finality floor
+        nodes = self.net.live_nodes
+        down = [n.name for n in self.net.nodes if n.state != "up"]
+        if not nodes:
+            return None
         heads = {n.name: n.chain.head_root for n in nodes}
         classes: dict[bytes, list[str]] = {}
         for name, root in heads.items():
@@ -132,7 +172,7 @@ class FleetObserver:
         snap = FleetSnapshot(
             slot=int(slot), heads=heads, classes=classes, split=split,
             finalized_min=min(finalized), finalized_max=max(finalized),
-            books=books, unaccounted=unaccounted)
+            books=books, unaccounted=unaccounted, down=down)
         self.snapshots.append(snap)
         del self.snapshots[:-self._MAX_SNAPSHOTS]
         self._snap_counter.inc()
@@ -231,27 +271,35 @@ class LocalNetwork:
     """N nodes + VCs over one fabric (the reference's LocalNetwork)."""
 
     def __init__(self, n_nodes: int = 3, n_validators: int = 32,
-                 spec: T.ChainSpec | None = None, fork: str = "altair"):
+                 spec: T.ChainSpec | None = None, fork: str = "altair",
+                 soak: bool = False):
         self.spec = spec or T.ChainSpec.minimal().with_forks_at(
             0, through=fork)
         self.genesis = genesis_state(n_validators, self.spec, fork)
         self.fabric = NetworkFabric()
         self.nodes: list[LocalNode] = []
-        gvr = bytes(self.genesis.genesis_validators_root)
+        self._gvr = bytes(self.genesis.genesis_validators_root)
+        self._n_validators = n_validators
+        self._n_nodes = n_nodes
+        # soak mode (the chaos composition): restarted nodes carry
+        # backfill + processor ledgers so the observer's roll-up audits
+        # every book the production client keeps
+        self.soak = soak
 
         for i in range(n_nodes):
-            chain = BeaconChain(
-                self.spec, self.genesis.copy(), verify_signatures=True)
-            chain.mock_payload = (
-                lambda slot, c=chain: self._mock_payload(c, slot))
+            # every node owns a persistent storage image: kill() leaves
+            # it dirty, restart() reopens over it through the startup
+            # repair sweep — the crash wrapper is the per-process seam
+            # chaos drills arm (store/crash.py)
+            disk = MemoryStore()
+            crash = CrashPointStore(disk)
+            chain = self._build_chain(crash)
             chain.chain_health.set_name(f"node-{i}")
             net = NetworkService(chain, self.fabric, f"node-{i}")
-            store = ValidatorStore(self.spec, gvr)
-            # validators are split round-robin across the VCs
-            for v in range(i, n_validators, n_nodes):
-                store.add_validator(interop_secret_key(v), index=v)
-            vc = ValidatorClient(chain, store, router=net.router)
-            self.nodes.append(LocalNode(f"node-{i}", chain, net, vc))
+            vc = ValidatorClient(chain, self._validator_store(i),
+                                 router=net.router)
+            self.nodes.append(LocalNode(f"node-{i}", chain, net, vc,
+                                        disk=disk, crash=crash))
 
         # discovery bootstrap + mutual status handshakes (dial)
         self.boot = BootNode(
@@ -262,6 +310,194 @@ class LocalNetwork:
         self.observer = FleetObserver(self)
         # pairs currently severed by partition() (for heal())
         self._partitioned: list[tuple[str, str]] = []
+
+    # -- node construction (shared by __init__ and restart) -----------------
+
+    def _build_chain(self, store_engine) -> BeaconChain:
+        chain = BeaconChain(
+            self.spec, self.genesis.copy(),
+            store=HotColdDB(self.spec, hot=store_engine),
+            verify_signatures=True)
+        chain.mock_payload = (
+            lambda slot, c=chain: self._mock_payload(c, slot))
+        return chain
+
+    def _validator_store(self, i: int) -> ValidatorStore:
+        store = ValidatorStore(self.spec, self._gvr)
+        # validators are split round-robin across the VCs
+        for v in range(i, self._n_validators, self._n_nodes):
+            store.add_validator(interop_secret_key(v), index=v)
+        return store
+
+    @property
+    def live_nodes(self) -> list[LocalNode]:
+        return [n for n in self.nodes if n.state == "up"]
+
+    def _resolve(self, node) -> LocalNode:
+        if isinstance(node, LocalNode):
+            return node
+        if isinstance(node, str):
+            return next(n for n in self.nodes if n.name == node)
+        return self.nodes[int(node)]
+
+    # -- node lifecycle ------------------------------------------------------
+
+    @staticmethod
+    def _lifecycle(event: str, node: str) -> None:
+        REGISTRY.counter(
+            "node_lifecycle_events_total",
+            "simulated node lifecycle transitions, by node and event "
+            "(stop/kill/restart/rejoin)").labels(
+                event=event, node=node).inc()
+
+    def _detach(self, node: LocalNode) -> None:
+        """Remove the node from both fabrics: gossip stops flowing to it
+        and rpc calls to it fail like a dead link (accounted by the
+        caller's RequestDiscipline like any peer failure).  A soak-mode
+        processor's executors are host resources, not simulated disk
+        state — release them here so repeated kill/restart cycles never
+        accumulate thread pools in the driving process."""
+        self.fabric.gossip.leave(node.name)
+        self.fabric.rpc.leave(node.name)
+        proc = node.processor
+        if proc is not None:
+            for ex in (getattr(proc, "_executor", None),
+                       getattr(proc, "_dispatch_executor", None)):
+                if ex is not None:
+                    ex.shutdown(wait=False)
+
+    def stop(self, node) -> LocalNode:
+        """Orderly shutdown: persist the resume frame, close the store
+        (clean marker) and leave the fabric.  restart() resumes from
+        the snapshot without a repair sweep."""
+        node = self._resolve(node)
+        if node.state != "up":
+            # a stop after a kill would close the abandoned store and
+            # flip the surviving disk's dirty marker to clean — erasing
+            # exactly the repair-sweep semantics the kill established
+            raise ValueError(f"{node.name} is already {node.state}")
+        node.chain.persist()
+        node.chain.store.close()
+        self._detach(node)
+        node.state = "stopped"
+        flight.emit("node_stop", node=node.name)
+        self._lifecycle("stop", node.name)
+        return node
+
+    def kill(self, node, mode: str | None = None, op: int = 0,
+             offset: int = 0) -> LocalNode:
+        """Simulated SIGKILL: no close(), so the dirty marker survives
+        and restart() pays the startup repair sweep.  With ``mode``
+        ("crash" | "drop") the death lands MID-COMMIT: the node's
+        CrashPointStore is armed ``offset`` commits ahead (``op`` =
+        torn-write ops applied for mode=drop) and the next persisted
+        frame dies inside its atomic batch — the worst-case power loss
+        the PR 5 repair ladder exists for."""
+        node = self._resolve(node)
+        if node.state != "up":
+            raise ValueError(f"{node.name} is already {node.state}")
+        mid_commit = False
+        if mode is not None and node.crash is not None:
+            node.crash.arm_at_next_commit(mode=mode, offset=offset, op=op)
+            for _ in range(offset + 2):
+                try:
+                    node.chain.persist()
+                except InjectedCrash:
+                    mid_commit = True
+                    break
+        self._detach(node)
+        node.state = "killed"
+        flight.emit("node_kill", node=node.name, mid_commit=mid_commit,
+                    mode=mode)
+        self._lifecycle("kill", node.name)
+        return node
+
+    def restart(self, node, slot: int | None = None) -> LocalNode:
+        """Rebuild a stopped/killed node from its surviving storage
+        image: reopen the store (a dirty image runs the startup repair
+        sweep), resume the chain (``resume_mode`` snapshot | rebuilt |
+        fresh), re-dial the boot node, and rejoin the live fleet
+        through the range-sync state machine.  Soak mode additionally
+        attaches backfill + processor ledgers so the fleet books
+        roll-up audits every plane."""
+        node = self._resolve(node)
+        if node.state == "up":
+            raise ValueError(f"{node.name} is already up")
+        crash = CrashPointStore(node.disk)   # fresh "process": ordinals reset
+        chain = self._build_chain(crash)
+        chain.chain_health.set_name(node.name)
+        chain.try_resume()
+        if slot is None:
+            others = [n for n in self.nodes
+                      if n is not node and n.state == "up"]
+            slot = max((n.chain.slot_clock.current_slot() for n in others),
+                       default=int(chain.head_state.slot))
+        chain.slot_clock.set_slot(int(slot))
+        net = NetworkService(chain, self.fabric, node.name)
+        vc = ValidatorClient(chain, self._validator_store(
+            self.nodes.index(node)), router=net.router)
+        node.chain, node.net, node.vc, node.crash = chain, net, vc, crash
+        node.state = "up"
+        flight.emit("node_restart", node=node.name,
+                    resume=chain.resume_mode,
+                    repairs=dict(chain.store.recovery))
+        self._lifecycle("restart", node.name)
+        REGISTRY.counter(
+            "node_lifecycle_resumes_total",
+            "restarted-node chain resume outcomes, by mode "
+            "(snapshot/rebuilt/fresh)").labels(mode=chain.resume_mode).inc()
+        # re-dial the boot node, then range-sync back to the live head
+        node.net.discover_and_connect(self.boot.peer_id)
+        imported = node.net.sync.sync()
+        if self.soak:
+            self._soak_attach(node)
+        flight.emit("node_rejoin", node=node.name, imported=imported,
+                    head_slot=int(node.chain.head_state.slot))
+        self._lifecycle("rejoin", node.name)
+        return node
+
+    def _soak_attach(self, node: LocalNode) -> None:
+        """Soak mode: a restarted node carries the full production
+        ledger set — a backfill machine (hash-chain re-verification of
+        stored history) and a beacon processor (admission-accounted
+        work queues) — so the observer's network-wide roll-up audits
+        the PR 13 backfill/processor branches through live objects."""
+        from lighthouse_tpu.network.backfill import BackfillSync
+        from lighthouse_tpu.processor.beacon_processor import BeaconProcessor
+
+        node.net.backfill = BackfillSync(
+            node.chain, node.net.rpc_ep, node.net.peer_manager)
+        node.processor = BeaconProcessor(max_workers=2, max_batch=64)
+
+    def reverify_tail(self, node, window: int | None = None) -> int:
+        """Soak-mode defense in depth after a crash repair: re-verify
+        the node's trailing hash chain through the backfill machine
+        against the live pool — real BlocksByRange requests, real
+        newest-first linkage checks, real freezer writes, real books.
+        Returns blocks re-verified (0 when the node carries no backfill
+        ledger or has no peers)."""
+        node = self._resolve(node)
+        bf = getattr(node.net, "backfill", None)
+        if bf is None:
+            return 0
+        head = node.chain.head_root
+        blk = node.chain.store.get_block(head)
+        pool = [n.name for n in self.live_nodes if n is not node]
+        if blk is None or not pool:
+            return 0
+        # point the cursor just above the head: the next backward batch
+        # must serve a chain whose newest block IS our head — anything
+        # else is a broken hash chain and is accounted as such
+        bf.rewind_to(head, int(blk.message.slot))
+        try:
+            return bf.run(pool, max_batches=max(
+                1, (window or 1) // max(1, envreg.get_int(
+                    "LHTPU_SYNC_BATCH_SIZE", 32) or 32)))
+        except Exception as e:
+            # the run() driver already rotates/accounts; anything else
+            # is a finding, never a dead soak driver
+            record_swallowed("simulator.reverify_tail", e)
+            return 0
 
     # -- fault induction: network splits -----------------------------------
 
@@ -298,7 +534,7 @@ class LocalNetwork:
     # -- driving -----------------------------------------------------------
 
     def _set_slot(self, slot: int) -> None:
-        for node in self.nodes:
+        for node in self.live_nodes:
             node.chain.slot_clock.set_slot(slot)
             node.net.on_slot(slot)
 
@@ -307,24 +543,60 @@ class LocalNetwork:
         # ValidatorClient keeps propose/attest in one call; the simulator
         # splits the phases so cross-node ordering matches a real
         # network's intra-slot timing: every node sees the slot's block
-        # (propose at t=0, gossiped) before its attesters vote (t/3)
-        for node in self.nodes:
+        # (propose at t=0, gossiped) before its attesters vote (t/3).
+        # Down nodes miss their duties (that is the liveness cost a kill
+        # is supposed to inflict).
+        for node in self.live_nodes:
             ps = _new_slot_summary(slot)
             node.vc._propose(slot, ps)
             summary.blocks_proposed += ps.blocks_proposed
-        for node in self.nodes:
+        for node in self.live_nodes:
             ats = _new_slot_summary(slot)
             node.vc._attest(slot, ats)
             node.vc._sync_committee(slot, ats)
             summary.attestations += ats.attestations_published
             summary.sync_messages += ats.sync_messages_published
+        # the process-wide ingest seam is LIVE in the fleet: an armed
+        # storm blows through the real gossip fabric, and an armed
+        # consumer stall (the dispatch-wedge drill) costs real wall
+        # clock — exactly the denominator the chaos soak's
+        # slots-finalized-per-hour headline divides by
+        plan = faults.active_ingest_plan()
+        if plan is not None and plan.mode != "stall":
+            self._shape_ingest_storm(plan, slot)
+        stall = faults.consumer_stall_s()
+        if stall > 0:
+            time.sleep(min(stall, 0.25))
         self.observer.snapshot(slot)
+
+    def _shape_ingest_storm(self, plan, slot: int) -> None:
+        """One slot's worth of an armed ingest storm, shaped through
+        the REAL wire: a rotating live publisher floods attestation
+        subnets with ``factor`` storm blobs.  ``dup`` copies are
+        byte-identical on one topic — they die in every receiver's
+        seen-message cache, the first line of duplicate-flood defense;
+        ``burst``/``invalid`` copies are distinct, so every receiver
+        pays the full decode/reject/sender-scoring path per copy."""
+        live = self.live_nodes
+        if not live:
+            return
+        from lighthouse_tpu.network.router import topic as gossip_topic
+
+        node = live[slot % len(live)]
+        for i in range(max(1, int(plan.factor))):
+            tag = slot if plan.mode == "dup" else (slot << 16) | i
+            subnet = 0 if plan.mode == "dup" else i % 4
+            node.net.gossip_ep.publish(
+                gossip_topic(node.chain, f"beacon_attestation_{subnet}"),
+                b"\xa5" * 8 + int(tag).to_bytes(8, "big"))
 
     def run_slots(self, n_slots: int, start: int | None = None) -> SimSummary:
         summary = SimSummary()
+        live = self.live_nodes
+        if not live:
+            raise RuntimeError("every node is down: restart one first")
         first = (start if start is not None
-                 else max(int(n.chain.head_state.slot)
-                          for n in self.nodes) + 1)
+                 else max(int(n.chain.head_state.slot) for n in live) + 1)
         for slot in range(first, first + n_slots):
             self.run_slot(slot, summary)
             summary.slots_run += 1
@@ -334,18 +606,20 @@ class LocalNetwork:
     # -- checks (reference simulator/src/checks.rs) ------------------------
 
     def heads_agree(self) -> bool:
-        roots = {n.chain.head_root for n in self.nodes}
+        roots = {n.chain.head_root for n in self.live_nodes}
         return len(roots) == 1
 
     def finalized_epoch(self) -> int:
-        return min(int(n.chain.fork_choice.finalized.epoch)
-                   for n in self.nodes)
+        live = self.live_nodes
+        if not live:
+            raise RuntimeError("every node is down: no finality to read")
+        return min(int(n.chain.fork_choice.finalized.epoch) for n in live)
 
     def fork_of_heads(self) -> set[str]:
-        return {type(n.chain.head_state).__name__ for n in self.nodes}
+        return {type(n.chain.head_state).__name__ for n in self.live_nodes}
 
     def sync_participation_nonzero(self) -> bool:
-        for n in self.nodes:
+        for n in self.live_nodes:
             blk = n.chain.store.get_block(n.chain.head_root)
             if blk is None or not hasattr(blk.message.body, "sync_aggregate"):
                 continue

@@ -135,6 +135,18 @@ class CrashPointStore(KeyValueStore):
     def from_env(cls, inner: KeyValueStore) -> "CrashPointStore":
         return cls(inner, plan_from_env())
 
+    def arm_at_next_commit(self, mode: str, offset: int = 0, op: int = 0,
+                           key: bytes | None = None,
+                           bit: int = 0) -> StoreFaultPlan:
+        """Install a plan whose crash/drop ordinal is RELATIVE to the
+        commits already recorded — "die at the k-th commit from now"
+        without the caller tracking absolute ordinals (the node
+        lifecycle/chaos seam: kill a LIVE node mid-commit)."""
+        plan = StoreFaultPlan(mode=mode, batch=self.commits + max(0, offset),
+                              op=op, key=key, bit=bit)
+        self.plan = plan
+        return plan
+
     # -- fault machinery ---------------------------------------------------
 
     def _check_alive(self):

@@ -923,6 +923,53 @@ def test_flight_pass_chain_health_compliant_twin(tmp_path):
     assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
 
 
+def test_flight_pass_flags_unrecorded_chaos_edge(tmp_path):
+    # ISSUE 15: the chaos controller's armed/disarmed edges ARE the
+    # soak's causal record — an unrecorded edge silences the timeline
+    # the drill gates on
+    pkg, _ = make_pkg(tmp_path, {"chain/chaos.py": """
+        class ChaosController:
+            def arm(self, rec):
+                rec.state = "armed"
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == ["ChaosController.arm:set_state"]
+
+
+def test_flight_pass_chaos_compliant_twin(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"chain/chaos.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        class ChaosController:
+            def arm(self, rec):
+                rec.state = "armed"
+                flight.emit("chaos_edge", plane=rec.plane, edge="armed")
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
+def test_flight_pass_flags_unrecorded_node_lifecycle(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"simulator.py": """
+        class LocalNetwork:
+            def kill(self, node):
+                node.state = "killed"
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == ["LocalNetwork.kill:set_state"]
+
+
+def test_flight_pass_node_lifecycle_compliant_twin(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"simulator.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        class LocalNetwork:
+            def kill(self, node):
+                node.state = "killed"
+                flight.emit("node_kill", node=node.name)
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
 def test_flight_pass_out_of_scope_modules_ignored(tmp_path):
     pkg, _ = make_pkg(tmp_path, {"network/peer_manager.py": """
         class Peer:

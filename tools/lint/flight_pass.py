@@ -38,7 +38,13 @@ from tools.lint import Context, Finding
 
 TARGET_MODULES = ("crypto/bls/api.py", "processor/admission.py",
                   "state_transition/epoch_processing.py",
-                  "chain/chain_health.py")
+                  "chain/chain_health.py",
+                  # ISSUE 15: the chaos controller's armed/disarmed
+                  # edges and the simulator's node lifecycle edges ARE
+                  # the soak's causal record — an unrecorded transition
+                  # punches a hole in exactly the timeline the drill
+                  # gates on
+                  "chain/chaos.py", "simulator.py")
 
 _STATE_ATTRS = {"state", "rung"}
 _STATE_KEYS = {"open_until"}

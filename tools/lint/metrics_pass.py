@@ -82,6 +82,10 @@ FAMILY_OWNERS = {
     # ingest_* decode series, the pubkey plane its fold/refresh books
     "ingest_": "lighthouse_tpu/ssz/columnar.py",
     "pubkey_plane_": "lighthouse_tpu/chain/pubkey_plane.py",
+    # the chaos soak (ISSUE 15): the scheduler owns the armed/disarmed
+    # edge counts, the simulator the node stop/kill/restart lifecycle
+    "chaos_": "lighthouse_tpu/chain/chaos.py",
+    "node_lifecycle_": "lighthouse_tpu/simulator.py",
 }
 
 
