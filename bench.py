@@ -2283,6 +2283,224 @@ def _bench_fleetwatch() -> dict:
     return result
 
 
+def _bench_scrapewatch() -> dict:
+    """ISSUE 16 acceptance drill: the pull observatory's transport
+    equivalence.
+
+    The fleetwatch scenario (steady -> 2/2 partition -> heal) runs
+    TWICE over identical inputs — once with the observer on
+    :class:`DirectSource` (in-memory reads, the pre-ISSUE-16 behavior)
+    and once on :class:`HttpSource` (real localhost scrapes of every
+    node's bound API server) — and every fleet-level conclusion must be
+    IDENTICAL across transports:
+
+    - per-snapshot head-equivalence classes (as node-name partitions),
+    - the split and reconvergence slots,
+    - per-snapshot finality min/max,
+    - zero unaccounted ledger events network-wide,
+    - per-node reorg count and max depth.
+
+    Gates beyond equivalence:
+
+    - **overhead** — the http leg must hold >= 95% of the direct leg's
+      steady slots/s (the scrape loop is not allowed to become the
+      fleet's bottleneck);
+    - **staleness** — p99 scraped-payload age under 2 slot durations;
+    - **outage honesty** — an injected scrape failure on one node
+      (transport-level, the node itself stays healthy) must NEVER
+      manufacture a head-class split: the node goes absent, then
+      ``unreachable`` after LHTPU_SCRAPE_UNREACHABLE_AFTER consecutive
+      failures (with the node_unreachable/node_reachable flight edges),
+      and is never conflated with lifecycle ``down``.
+    """
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.simulator import (HttpSource, LocalNetwork,
+                                          SimSummary)
+
+    bls.set_backend("fake")
+    n_nodes = int(os.environ.get("LHTPU_FLEET_NODES", "4"))
+    n_nodes = max(2, n_nodes - n_nodes % 2)   # two equal halves
+    steady = int(os.environ.get("LHTPU_FLEET_STEADY_SLOTS", "34"))
+    part_slots = int(os.environ.get("LHTPU_FLEET_PARTITION_SLOTS", "12"))
+    heal_slots = int(os.environ.get("LHTPU_FLEET_HEAL_SLOTS", "26"))
+    n_vals = 8 * n_nodes
+    half = n_nodes // 2
+    total_slots = steady + part_slots + heal_slots
+
+    result: dict = {
+        "metric": "scrapewatch_http_slots_per_s", "unit": "slots/s",
+        "value": 0.0, "vs_baseline": 0.0, "stage": "built",
+        "scrapewatch_nodes": n_nodes,
+    }
+    _emit_partial(result)
+
+    def drive(net, start_slot, n_slots):
+        summary = SimSummary()
+        for slot in range(start_slot, start_slot + n_slots):
+            net.run_slot(slot, summary)
+        return summary
+
+    def conclusions(net) -> dict:
+        """Everything a fleet operator would conclude from the
+        observer — deliberately name-based (no object identity), so
+        the two transports' outputs are directly comparable."""
+        obs = net.observer
+        return {
+            "slots": [s.slot for s in obs.snapshots],
+            "classes": [sorted(sorted(names)
+                               for names in s.classes.values())
+                        for s in obs.snapshots],
+            "split_slot": obs.first_split_slot,
+            "reconverged_slot": obs.reconverged_slot,
+            "finality": [[s.finalized_min, s.finalized_max]
+                         for s in obs.snapshots],
+            "worst_unaccounted": max(
+                s.unaccounted for s in obs.snapshots),
+            "reorgs": {
+                n.name: {
+                    "count": n.chain.chain_health.status()
+                    ["reorgs"]["count"],
+                    "max_depth": n.chain.chain_health.status()
+                    ["reorgs"]["max_depth"]}
+                for n in net.nodes},
+        }
+
+    # -- phase 0: throwaway warm-up (ssz interning, first-run paths)
+    warm = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+    drive(warm, 1, 6)
+    del warm
+    result["stage"] = "warmed"
+    _emit_partial(result)
+
+    # -- phases 1+2: the same scenario over both transports ----------------
+    legs: dict = {}
+    for transport in ("direct", "http"):
+        net = LocalNetwork(n_nodes=n_nodes, n_validators=n_vals,
+                           fork="altair")
+        if transport == "http":
+            net.observer.use_source(HttpSource(net.serve_http()))
+        t0 = time.monotonic()
+        drive(net, 1, steady)
+        rate = steady / max(time.monotonic() - t0, 1e-9)
+        net.partition(range(half), range(half, n_nodes))
+        drive(net, steady + 1, part_slots)
+        net.heal()
+        drive(net, steady + part_slots + 1, heal_slots)
+        assert net.heads_agree(), f"{transport} leg failed to reconverge"
+        assert len(net.observer.snapshots) == total_slots, \
+            f"{transport} leg: observer missed slots " \
+            f"({len(net.observer.snapshots)}/{total_slots})"
+        legs[transport] = {"net": net, "rate": rate,
+                           "conclusions": conclusions(net)}
+        result.update(stage=f"{transport}_leg",
+                      **{f"scrapewatch_{transport}_slots_s":
+                         round(rate, 2)})
+        _emit_partial(result)
+
+    # -- gate 1: transport-identical fleet conclusions ---------------------
+    direct_c = legs["direct"]["conclusions"]
+    http_c = legs["http"]["conclusions"]
+    for key in direct_c:
+        assert direct_c[key] == http_c[key], \
+            f"transport drift on {key!r}: direct={direct_c[key]!r} " \
+            f"http={http_c[key]!r}"
+    assert direct_c["split_slot"] is not None, \
+        "the partition produced no observed split"
+    assert direct_c["worst_unaccounted"] == 0, \
+        f"fleet books leak: unaccounted={direct_c['worst_unaccounted']}"
+
+    # -- gate 2: scrape overhead + staleness -------------------------------
+    overhead = legs["http"]["rate"] / max(legs["direct"]["rate"], 1e-9)
+    assert overhead >= 0.95, \
+        f"scrape overhead gate: http/direct = {overhead:.3f} < 0.95"
+    http_net = legs["http"]["net"]
+    ages = sorted(http_net.observer.discipline.ages)
+    assert ages, "http leg recorded no staleness samples"
+    p99 = ages[min(len(ages) - 1, int(0.99 * len(ages)))]
+    stale_limit = 2.0 * http_net.spec.seconds_per_slot
+    assert p99 < stale_limit, \
+        f"scrape staleness gate: p99 {p99:.3f}s >= {stale_limit}s"
+    result.update(stage="gated", value=round(legs["http"]["rate"], 2),
+                  vs_baseline=round(overhead, 3),
+                  scrapewatch_overhead_ratio=round(overhead, 3),
+                  scrapewatch_staleness_p99_s=round(p99, 4))
+    _emit_partial(result)
+
+    # -- phase 3: injected scrape outage (transport fault, healthy node) ---
+    class _FlakySource(HttpSource):
+        """Scrape failures for ONE node, injected above the socket
+        seam; everything else rides the real HTTP path."""
+
+        dead: str | None = None
+
+        def observe(self, node, since_seq, deadline_s):
+            if node.name == self.dead:
+                raise OSError(f"injected scrape outage for {node.name}")
+            return super().observe(node, since_seq, deadline_s)
+
+    obs = http_net.observer
+    victim = http_net.nodes[-1].name
+    flaky = _FlakySource(http_net.serve_http())
+    flaky.dead = victim
+    obs.use_source(flaky)
+    threshold = obs._unreachable_after
+    pre_snaps = len(obs.snapshots)
+    pre_split = obs.first_split_slot
+    drive(http_net, total_slots + 1, threshold + 2)
+    outage_snaps = obs.snapshots[pre_snaps:]
+    assert obs.first_split_slot == pre_split and \
+        all(not s.split for s in outage_snaps), \
+        "a scrape outage manufactured a phantom fleet split"
+    assert all(victim not in s.heads for s in outage_snaps), \
+        "an unscrapable node still contributed a head class"
+    assert any(victim in s.unreachable for s in outage_snaps), \
+        f"{victim} never classified unreachable after {threshold} " \
+        "consecutive scrape failures"
+    assert all(victim not in s.down for s in outage_snaps), \
+        "scrape-unreachable was conflated with lifecycle down"
+
+    # outage over: the node must return to the observed fleet
+    flaky.dead = None
+    drive(http_net, total_slots + threshold + 3, 2)
+    last = obs.snapshots[-1]
+    assert victim in last.heads and not last.unreachable, \
+        f"{victim} did not rejoin the observed fleet after the outage"
+    kinds = [(e["kind"], e.get("node")) for e in obs.timeline()]
+    assert ("node_unreachable", victim) in kinds, \
+        "node_unreachable flight edge missing"
+    assert ("node_reachable", victim) in kinds, \
+        "node_reachable flight edge missing"
+    http_net.stop_http()
+
+    result.update({
+        "stage": "done",
+        "scrapewatch_split_slot": direct_c["split_slot"],
+        "scrapewatch_reconverged_slot": direct_c["reconverged_slot"],
+        "scrapewatch_unaccounted": direct_c["worst_unaccounted"],
+        "scrapewatch_outage_victim": victim,
+        "stages": {"scrapewatch": {
+            "equivalence": {
+                "snapshots": total_slots,
+                "split_slot": direct_c["split_slot"],
+                "reconverged_slot": direct_c["reconverged_slot"],
+                "reorgs": direct_c["reorgs"],
+            },
+            "overhead": {
+                "direct_slots_s": round(legs["direct"]["rate"], 2),
+                "http_slots_s": round(legs["http"]["rate"], 2),
+                "ratio": round(overhead, 3)},
+            "staleness": {"p99_s": round(p99, 4),
+                          "limit_s": stale_limit,
+                          "samples": len(ages)},
+            "outage": {"victim": victim,
+                       "unreachable_after": threshold,
+                       "phantom_splits": 0},
+        }},
+    })
+    result.pop("stage", None)
+    return result
+
+
 def _bench_chaossoak() -> dict:
     """ISSUE 15 acceptance: the full-network chaos soak.
 
@@ -2521,6 +2739,8 @@ def _child_main() -> int:
         result = _bench_syncstorm()
     elif "--child-fleetwatch" in sys.argv:
         result = _bench_fleetwatch()
+    elif "--child-scrapewatch" in sys.argv:
+        result = _bench_scrapewatch()
     elif "--child-chaossoak" in sys.argv:
         result = _bench_chaossoak()
     elif "--child-observatory" in sys.argv:
@@ -2596,8 +2816,8 @@ _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-probe", "--child-stateroot", "--child-flood",
                 "--child-blockverify", "--child-slasher", "--child-epoch",
                 "--child-firehose", "--child-syncstorm",
-                "--child-fleetwatch", "--child-chaossoak",
-                "--child-observatory",
+                "--child-fleetwatch", "--child-scrapewatch",
+                "--child-chaossoak", "--child-observatory",
                 "--child-coldstart", "--child-coldstart-run")
 
 
@@ -2685,6 +2905,11 @@ def main() -> int:
                 # A/B legs run the steady phase twice) — zero-XLA but
                 # wall-clock heavy on CPU
                 ("--child-fleetwatch", "fleetwatch",
+                 max(900, CHILD_TIMEOUT_S)),
+                # the fleetwatch scenario run TWICE (direct vs http
+                # scrape legs) plus the injected-outage tail — same
+                # zero-XLA wall-clock profile, double the slot count
+                ("--child-scrapewatch", "scrapewatch",
                  max(900, CHILD_TIMEOUT_S)),
                 # ~100 slots of real state transitions across N nodes
                 # PLUS kill/restart resume work and post-chaos sync —

@@ -31,6 +31,68 @@ def _hex(b: bytes) -> str:
     return "0x" + bytes(b).hex()
 
 
+def _since_seq(query) -> int | None:
+    """The observatory endpoints' shared cursor param (None = no
+    cursor supplied)."""
+    raw = (query or {}).get("since_seq")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ApiError(400, f"bad since_seq {raw!r}")
+
+
+def node_rollup(chain, since_seq: int | None = None) -> dict:
+    """One node's machine-consumable observatory roll-up (the GET
+    /lighthouse/observatory/node payload, and the exact observation the
+    simulator's DirectSource serves in-memory — one builder, so the two
+    transports can never drift).
+
+    ``since_seq`` scopes the flight tail: only events newer than the
+    cursor are included, and ``flight.seq`` is the watermark to hand
+    back on the next scrape (exactly the highest seq delivered, so a
+    concurrent emit is never skipped).  ``seq`` is the monotonic
+    roll-up ordinal; ``t`` is the composition wall-clock time the
+    scraper measures staleness against.
+    """
+    import time
+
+    from lighthouse_tpu.common import flight_recorder as flight
+    from lighthouse_tpu.simulator import node_ledgers
+
+    health = chain.chain_health
+    fin = chain.finalized_checkpoint()
+    just = chain.justified_checkpoint()
+    svc = getattr(chain, "network_service", None)
+    processor = getattr(chain, "beacon_processor", None)
+    cursor = int(since_seq) if since_seq is not None else 0
+    events = flight.RECORDER.events_since(cursor)
+    watermark = events[-1]["seq"] if events else cursor
+    return {
+        "node": health.name,
+        "seq": health.next_snapshot_seq(),
+        "t": time.time(),
+        "head": {"root": _hex(chain.head_root),
+                 "slot": int(chain.head_state.slot)},
+        "finalized": {"epoch": int(fin.epoch), "root": _hex(fin.root)},
+        "justified": {"epoch": int(just.epoch), "root": _hex(just.root)},
+        "chain_health": health.status(),
+        "books": node_ledgers(svc, processor),
+        "lifecycle": {
+            "resume_mode": getattr(chain, "resume_mode", None),
+            "recovery": dict(getattr(chain.store, "recovery", None) or {}),
+        },
+        "flight": {
+            "seq": watermark,
+            "since_seq": cursor,
+            "events": [
+                {k: flight._jsonable(v) for k, v in e.items()}
+                for e in events],
+        },
+    }
+
+
 class BeaconApi:
     """Route table bound to a chain (+ optional validator helpers)."""
 
@@ -144,6 +206,7 @@ class BeaconApi:
         r("GET", r"/lighthouse/tracing", self.tracing_slots)
         r("GET", r"/lighthouse/tracing/(?P<slot>-?\d+)", self.tracing_slot)
         r("GET", r"/lighthouse/observatory/chain", self.observatory_chain)
+        r("GET", r"/lighthouse/observatory/node", self.observatory_node)
         r("GET", r"/lighthouse/observatory/flight", self.observatory_flight)
         r("GET", r"/lighthouse/observatory/slo", self.observatory_slo)
         r("GET", r"/lighthouse/observatory/jit", self.observatory_jit)
@@ -1465,12 +1528,24 @@ class BeaconApi:
         lag, participation, and the trip thresholds."""
         return {"data": self.chain.chain_health.status()}
 
-    def observatory_flight(self, body=None):
+    def observatory_node(self, body=None, query=None):
+        """The pull observatory's one-request node roll-up: everything
+        a fleet scraper needs per scrape — head/finalized/justified
+        checkpoints, the chain-health state, the sync/backfill/
+        processor books ledgers, lifecycle/resume state, the flight
+        tail since the client's ``since_seq`` cursor, and a monotonic
+        snapshot ``seq``."""
+        return {"data": node_rollup(
+            self.chain, since_seq=_since_seq(query))}
+
+    def observatory_flight(self, body=None, query=None):
         """The flight recorder's black box: the last trip dump (if a
-        trip condition has fired) plus the live event-ring tail."""
+        trip condition has fired) plus the live event-ring tail
+        (newest 32, or everything past a ``since_seq`` cursor)."""
         from lighthouse_tpu.common import flight_recorder
 
-        return {"data": flight_recorder.observatory_view()}
+        return {"data": flight_recorder.observatory_view(
+            since_seq=_since_seq(query))}
 
     def observatory_slo(self, body=None):
         """Per-slot SLO engine report: budgets, scored-slot counts,

@@ -101,6 +101,17 @@ class ChainHealthMonitor:
         self.participation_epoch: int | None = None
         self._part_key: tuple | None = None
         self._label_memo: dict = {}
+        # the pull observatory's per-node roll-up seq: strictly
+        # monotonic per process-lifetime of this monitor, so a scraper
+        # can order scrapes and detect duplicates/regressions
+        self.snapshot_seq = 0
+
+    def next_snapshot_seq(self) -> int:
+        """Monotonic roll-up sequence for GET /lighthouse/observatory/
+        node: every composed snapshot gets the next integer."""
+        with self._lock:
+            self.snapshot_seq += 1
+            return self.snapshot_seq
 
     # -- labeled-series plumbing (literal registrations so the lhlint
     #    metric discipline sees every family; children memoized so the

@@ -410,6 +410,26 @@ _register("LHTPU_CHAOS_KILL_EVERY", "10",
           "(staggered: at most one node down at a time; floored at "
           "4).")
 
+# -- the pull observatory: per-node scrape discipline (simulator
+#    ScrapeDiscipline, bench --child-scrapewatch) -----------------------------
+
+_register("LHTPU_SCRAPE_DEADLINE_S", "2.0",
+          "Watchdog deadline in seconds for one node-scrape attempt "
+          "(guarded transports only; the direct in-memory source runs "
+          "inline).  Floored at 0.05.")
+_register("LHTPU_SCRAPE_RETRIES", "1",
+          "Extra scrape attempts after a timeout/error before the "
+          "scrape counts as failed for this slot (0 = single "
+          "attempt).")
+_register("LHTPU_SCRAPE_UNREACHABLE_AFTER", "3",
+          "Consecutive failed scrapes after which the observer "
+          "classifies a node unreachable (a monitoring-plane state, "
+          "distinct from lifecycle down; floored at 1).")
+_register("LHTPU_SCRAPE_CADENCE_SLOTS", "1",
+          "Observer snapshot cadence: scrape the fleet every Nth slot "
+          "(1 = every slot, the default and the pre-scrape-plane "
+          "behavior).")
+
 
 # -- typed readers ------------------------------------------------------------
 

@@ -194,6 +194,26 @@ class FlightRecorder:
         out.reverse()
         return out
 
+    @property
+    def seq(self) -> int:
+        """The current sequence watermark (the newest event's seq; 0
+        before any emit) — clients hand it back as a cursor."""
+        with self._lock:
+            return self._seq
+
+    def events_since(self, seq: int) -> list[dict]:
+        """Events newer than the ``seq`` cursor (oldest first).  Walks
+        the ring newest-first and stops at the watermark, so a repeat
+        scrape costs O(new events), not O(capacity)."""
+        with self._lock:
+            out = []
+            for e in reversed(self._ring):
+                if e["seq"] <= seq:
+                    break
+                out.append(dict(e))
+        out.reverse()
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
@@ -307,17 +327,21 @@ def trip(reason: str, **fields) -> dict | None:
     return RECORDER.trip(reason, **fields)
 
 
-def observatory_view() -> dict:
+def observatory_view(since_seq: int | None = None) -> dict:
     """The GET /lighthouse/observatory/flight payload: the last trip's
-    black box (if any) plus the live ring tail."""
+    black box (if any) plus the live ring tail.  With a ``since_seq``
+    cursor the tail is every event newer than that watermark instead of
+    the fixed newest-32 window; ``seq`` in the payload is the cursor to
+    hand back on the next scrape."""
     r = RECORDER
-    tail = r.tail(32)
+    tail = r.tail(32) if since_seq is None else r.events_since(since_seq)
     return {
         "armed": r.enabled,
         "capacity": r.capacity,
         "events": len(r),
         "evicted": r.evicted,
         "trips": r.trip_count,
+        "seq": r.seq,
         "last_dump": r.last_dump,
         "tail": [{k: _jsonable(v) for k, v in e.items()} for e in tail],
     }

@@ -1,0 +1,280 @@
+"""Prometheus text-exposition round-trip parser (the pull observatory's
+ingest side).
+
+``common/metrics.Registry.render()`` is the repo's only exposition
+writer; this module is its exact inverse: ``parse()`` turns a scraped
+``/metrics`` body back into structured families (name, type, help,
+samples with decoded label sets), and ``expose()`` re-renders a parsed
+document **byte-identically** — ``expose(parse(text)) == text`` for any
+text the registry can produce, label/HELP escapes included.  That
+round-trip property is what makes any node's scrape output a wire
+format rather than a log: a fleet scraper can ingest it, reason over
+it, and re-serve it without loss.
+
+Scope: the v0.0.4 text format subset the in-tree renderer emits —
+``# HELP``/``# TYPE`` headers followed by that family's sample lines
+(labeled or bare, histograms as ``_bucket``/``_sum``/``_count`` series
+under the family name).  Sample values keep their **raw string** form
+(``7`` vs ``7.0`` matters for byte-identity); ``Sample.value`` exposes
+the parsed float.
+
+Stdlib-only, and deliberately free of metric families of its own: the
+parser is a consumer of the exposition plane, never a producer (the
+lint FAMILY_OWNERS table has no entry for it, and tests pin that it
+registers nothing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class PromTextError(ValueError):
+    """Malformed exposition text (with the offending line number)."""
+
+    def __init__(self, lineno: int, message: str):
+        self.lineno = lineno
+        super().__init__(f"line {lineno}: {message}")
+
+
+@dataclass
+class Sample:
+    """One sample line: ``name{labels} raw``.
+
+    ``labels`` preserves the wire order of the pairs (the renderer
+    sorts label keys and appends ``le`` last on histogram buckets);
+    values are fully unescaped.
+    """
+
+    name: str
+    labels: list  # [(key, value), ...] in wire order, unescaped
+    raw: str      # the value exactly as exposed
+
+    @property
+    def value(self) -> float:
+        return float(self.raw)
+
+    def labelset(self) -> dict:
+        return dict(self.labels)
+
+
+@dataclass
+class Family:
+    """One ``# HELP``/``# TYPE`` block plus its sample lines."""
+
+    name: str
+    type: str
+    help: str
+    samples: list = field(default_factory=list)
+
+
+def _unescape_label(v: str, lineno: int) -> str:
+    """Inverse of metrics._escape_label_value: \\\\, \\", \\n."""
+    out: list[str] = []
+    i, n = 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\":
+            if i + 1 >= n:
+                raise PromTextError(lineno, "dangling backslash in label")
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                raise PromTextError(lineno, f"bad escape \\{nxt} in label")
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_help(v: str, lineno: int) -> str:
+    """Inverse of metrics._escape_help: \\\\ and \\n only (quotes are
+    literal in HELP text)."""
+    out: list[str] = []
+    i, n = 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\":
+            if i + 1 >= n:
+                raise PromTextError(lineno, "dangling backslash in HELP")
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                raise PromTextError(lineno, f"bad escape \\{nxt} in HELP")
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _parse_labels(body: str, lineno: int) -> list:
+    """``k="v",k2="v2"`` -> ordered pairs; a small scanner, since label
+    VALUES may contain commas, braces and escaped quotes."""
+    pairs: list = []
+    i, n = 0, len(body)
+    while i < n:
+        j = body.find("=", i)
+        if j < 0:
+            raise PromTextError(lineno, "label without '='")
+        key = body[i:j]
+        if not key:
+            raise PromTextError(lineno, "empty label name")
+        if j + 1 >= n or body[j + 1] != '"':
+            raise PromTextError(lineno, f"label {key!r} value not quoted")
+        k = j + 2
+        while k < n:
+            if body[k] == "\\":
+                k += 2
+                continue
+            if body[k] == '"':
+                break
+            k += 1
+        if k >= n:
+            raise PromTextError(lineno, f"unterminated value for {key!r}")
+        pairs.append((key, _unescape_label(body[j + 2:k], lineno)))
+        i = k + 1
+        if i < n:
+            if body[i] != ",":
+                raise PromTextError(lineno, "expected ',' between labels")
+            i += 1
+    return pairs
+
+
+def _parse_sample(line: str, lineno: int) -> Sample:
+    brace = line.find("{")
+    if brace >= 0:
+        # the value may itself contain no '}', but a label VALUE can:
+        # scan for the closing brace respecting quoted strings
+        i, n = brace + 1, len(line)
+        in_str = False
+        while i < n:
+            c = line[i]
+            if in_str:
+                if c == "\\":
+                    i += 1
+                elif c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+            elif c == "}":
+                break
+            i += 1
+        if i >= n:
+            raise PromTextError(lineno, "unterminated label braces")
+        name = line[:brace]
+        labels = _parse_labels(line[brace + 1:i], lineno)
+        rest = line[i + 1:]
+    else:
+        name, _, rest = line.partition(" ")
+        rest = " " + rest if rest else rest
+        labels = []
+    if not rest.startswith(" ") or not rest[1:]:
+        raise PromTextError(lineno, "sample line without a value")
+    raw = rest[1:]
+    try:
+        float(raw)
+    except ValueError:
+        raise PromTextError(lineno, f"non-numeric sample value {raw!r}")
+    return Sample(name=name, labels=labels, raw=raw)
+
+
+def _family_of(sample_name: str, families: dict) -> str | None:
+    """Map a sample line to its owning family: exact name, or the
+    histogram suffixes under the family name."""
+    if sample_name in families:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[:-len(suffix)]
+            if base in families:
+                return base
+    return None
+
+
+def parse(text: str) -> dict:
+    """Exposition text -> insertion-ordered ``{name: Family}``.
+
+    Raises :class:`PromTextError` on anything the in-tree renderer
+    could not have produced (unknown escapes, type-less samples,
+    samples preceding their headers).
+    """
+    families: dict[str, Family] = {}
+    current: Family | None = None
+    for lineno, line in enumerate(text.split("\n"), start=1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_esc = rest.partition(" ")
+            if not name:
+                raise PromTextError(lineno, "HELP without a metric name")
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = Family(
+                    name=name, type="untyped",
+                    help=_unescape_help(help_esc, lineno))
+            else:
+                fam.help = _unescape_help(help_esc, lineno)
+            current = fam
+        elif line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            if not name or not kind:
+                raise PromTextError(lineno, "malformed TYPE line")
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = Family(name=name, type=kind, help="")
+            else:
+                fam.type = kind
+            current = fam
+        elif line.startswith("#"):
+            continue  # comments are legal, the renderer never emits them
+        else:
+            sample = _parse_sample(line, lineno)
+            owner = _family_of(sample.name, families)
+            if owner is None:
+                raise PromTextError(
+                    lineno, f"sample {sample.name!r} before its # TYPE "
+                    "header")
+            families[owner].samples.append(sample)
+            current = families[owner]
+    del current
+    return families
+
+
+def expose(families: dict) -> str:
+    """``{name: Family}`` -> exposition text, byte-identical to what
+    ``parse`` consumed (for renderer-produced input)."""
+    chunks: list[str] = []
+    for fam in families.values():
+        lines = [f"# HELP {fam.name} {_escape_help(fam.help)}",
+                 f"# TYPE {fam.name} {fam.type}"]
+        for s in fam.samples:
+            if s.labels:
+                body = ",".join(
+                    f'{k}="{_escape_label(v)}"' for k, v in s.labels)
+                lines.append(f"{s.name}{{{body}}} {s.raw}")
+            else:
+                lines.append(f"{s.name} {s.raw}")
+        # each family block ends with "\n", matching _Metric.render()
+        chunks.append("\n".join(lines) + "\n")
+    return "".join(chunks)

@@ -38,13 +38,28 @@ the live head) give the chaos soak a real stop/crash/restart cycle.
 ``bench.py --child-chaossoak`` composes this with every other fault
 plane under a seeded :class:`~lighthouse_tpu.chain.chaos.ChaosPlan`
 (see the README "Chaos soak" section).
+
+The pull observatory (ISSUE 16): :class:`FleetObserver` observes nodes
+through a :class:`NodeScrapeSource` seam instead of reaching into
+shared memory — :class:`DirectSource` keeps today's in-memory reads
+(both transports serve the same ``node_rollup`` composition, so they
+cannot drift), :class:`HttpSource` scrapes each node's real bound API
+server (``GET /lighthouse/observatory/node``) under a per-scrape
+deadline/retry :class:`ScrapeDiscipline`.  N consecutive failed
+scrapes classify a node ``unreachable`` — distinct from the lifecycle
+``down`` list, and never a head class — so a scrape outage cannot
+manufacture a phantom fleet split.  ``bench.py --child-scrapewatch``
+gates DirectSource-vs-HttpSource conclusion equivalence over the same
+fleetwatch scenario (see the README "Pull observatory" section).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import json
 import time
+from collections import deque
 
 from lighthouse_tpu import types as T
 from lighthouse_tpu.chain.beacon_chain import BeaconChain
@@ -95,6 +110,218 @@ class SimSummary:
     per_slot: list = field(default_factory=list)
 
 
+# -- the pull observatory's scrape plane (ISSUE 16) ----------------------------
+
+
+def node_ledgers(svc, processor=None) -> dict:
+    """One node's normalized sync/backfill/processor ledger view: the
+    ``books`` branch of the node roll-up, shared verbatim by the HTTP
+    endpoint (api/http_api.node_rollup) and the fleet roll-up math
+    (:func:`_roll_up_ledgers`) — one extractor, zero transport drift.
+
+    ``.get`` throughout: a future ledger with a partial books shape
+    must read as an observer finding, never kill the scrape."""
+    ledgers: dict = {}
+    for label, owner in (("sync", getattr(svc, "sync", None)),
+                         ("backfill", getattr(svc, "backfill", None))):
+        books = getattr(owner, "books", None)
+        if books is None:
+            continue
+        b = dict(books)
+        b["inflight"] = int(getattr(owner, "inflight_attempts", 0))
+        ledgers[label] = b
+    if processor is not None:
+        m = processor.metrics
+        with m._lock:
+            enq = sum(m.enqueued.values())
+            done = sum(m.processed.values())
+            shed = sum(m.shed.values())
+        queued = sum(len(q) for q in processor._queues.values())
+        # the monitors idiom: a positive deficit equals the in-flight
+        # population while busy, so it only counts at idle
+        idle = (not getattr(processor, "_inflight", ())
+                and not getattr(processor, "_manager_holding", False))
+        ledgers["processor"] = {
+            "enqueued": enq, "processed": done, "shed": shed,
+            "queued": queued, "idle": idle}
+    return ledgers
+
+
+def _roll_up_ledgers(per_node: dict) -> tuple[dict, int]:
+    """Network-wide sum of per-node normalized ledgers (the
+    :func:`node_ledgers` shape) + the unaccounted total: deficit beyond
+    each ledger's in-flight tolerance window, plus ANY negative deficit
+    (more accounted than submitted is impossible legitimately)."""
+    total = {"requested": 0, "imported": 0, "retried": 0,
+             "abandoned": 0, "inflight": 0}
+    unaccounted = 0
+    for ledgers in per_node.values():
+        for label in ("sync", "backfill"):
+            b = ledgers.get(label)
+            if b is None:
+                continue
+            inflight = int(b.get("inflight", 0))
+            deficit = b.get("requested", 0) - (
+                b.get("imported", 0) + b.get("retried", 0)
+                + b.get("abandoned", 0))
+            if deficit < 0:
+                unaccounted += -deficit
+            elif deficit > inflight:
+                unaccounted += deficit - inflight
+            for k in ("requested", "imported", "retried", "abandoned"):
+                total[k] += int(b.get(k, 0))
+            total["inflight"] += inflight
+        proc = ledgers.get("processor")
+        if proc is not None:
+            deficit = (proc.get("enqueued", 0) - proc.get("processed", 0)
+                       - proc.get("shed", 0) - proc.get("queued", 0))
+            if deficit < 0:
+                unaccounted += -deficit
+            elif bool(proc.get("idle")) and deficit > 0:
+                unaccounted += deficit
+    return {"total": total, "per_node": per_node}, unaccounted
+
+
+class ScrapeError(RuntimeError):
+    """One node's scrape failed its whole deadline/retry budget."""
+
+
+class NodeScrapeSource:
+    """The FleetObserver's transport seam: one node -> one roll-up.
+
+    ``observe`` returns the ``node_rollup`` payload (api/http_api) as
+    plain JSON-able data, or raises.  ``guarded`` sources run each
+    attempt under the scrape discipline's watchdog deadline (transports
+    that can hang); the direct source reads memory and stays inline.
+    """
+
+    transport = "abstract"
+    guarded = False
+
+    def observe(self, node, since_seq: int, deadline_s: float) -> dict:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release transport resources (no-op for in-memory reads)."""
+
+
+class DirectSource(NodeScrapeSource):
+    """Today's in-memory reads, behavior-identical: the same roll-up
+    composition the HTTP endpoint serves, minus the wire."""
+
+    transport = "direct"
+
+    def observe(self, node, since_seq: int, deadline_s: float) -> dict:
+        from lighthouse_tpu.api.http_api import node_rollup
+
+        return node_rollup(node.chain, since_seq=since_seq)
+
+
+class HttpSource(NodeScrapeSource):
+    """urllib against each node's bound API server — what a production
+    operator (and the ROADMAP item 5 socket fleet) actually has."""
+
+    transport = "http"
+    guarded = True
+
+    def __init__(self, urls: dict):
+        #: node name -> base url ("http://127.0.0.1:<port>")
+        self.urls = dict(urls)
+
+    def _open(self, url: str, timeout_s: float) -> bytes:
+        """The one socket touch (tests/drills override this seam to
+        inject scrape failures without a real network fault)."""
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read()
+
+    def observe(self, node, since_seq: int, deadline_s: float) -> dict:
+        name = getattr(node, "name", str(node))
+        base = self.urls[name]
+        url = (f"{base}/lighthouse/observatory/node"
+               f"?since_seq={int(since_seq)}")
+        return json.loads(self._open(url, deadline_s))["data"]
+
+
+class ScrapeDiscipline:
+    """Per-scrape deadline/retry discipline — the PR 10
+    RequestDiscipline shape on the scrape plane: every attempt runs
+    under a watchdog deadline (guarded transports), every outcome is
+    accounted (``fleet_scrapes_total{node,outcome}``,
+    ``fleet_scrape_seconds``), and every successful payload's age lands
+    in ``fleet_scrape_staleness_seconds{node}`` plus a bounded sample
+    window (the bench's p99 staleness gate)."""
+
+    _MAX_AGES = 8192
+
+    def __init__(self):
+        self.reconfigure()
+        self._scrapes = REGISTRY.counter(
+            "fleet_scrapes_total",
+            "node scrape attempts by node and outcome (ok/timeout/error)")
+        self._latency = REGISTRY.histogram(
+            "fleet_scrape_seconds",
+            "wall time of one node scrape attempt")
+        self._staleness = REGISTRY.gauge(
+            "fleet_scrape_staleness_seconds",
+            "age of the newest successfully scraped roll-up, per node "
+            "(scrape receive time minus payload composition time)")
+        #: staleness samples, newest _MAX_AGES (the p99 gate's window)
+        self.ages: deque = deque(maxlen=self._MAX_AGES)
+
+    def reconfigure(self) -> None:
+        """Re-read the LHTPU_SCRAPE_* knobs (drills mutate os.environ
+        after construction)."""
+        self.deadline_s = max(0.05, envreg.get_float(
+            "LHTPU_SCRAPE_DEADLINE_S", 2.0) or 2.0)
+        self.retries = max(0, envreg.get_int("LHTPU_SCRAPE_RETRIES", 1) or 0)
+
+    def _account(self, name: str, outcome: str, elapsed: float) -> None:
+        self._scrapes.labels(node=name, outcome=outcome).inc()
+        self._latency.observe(elapsed)
+
+    def execute(self, name: str, issue, guarded: bool = True) -> dict:
+        """Run ``issue()`` under the deadline, retrying up to the
+        budget; raises :class:`ScrapeError` when every attempt failed."""
+        last: BaseException | None = None
+        for _attempt in range(1 + self.retries):
+            t0 = time.monotonic()
+            try:
+                if guarded:
+                    obs = faults.run_with_deadline(
+                        issue, self.deadline_s, f"scrape-{name}",
+                        f"scrape of {name}")
+                else:
+                    obs = issue()
+            except faults.WatchdogTimeout as e:
+                self._account(name, "timeout", time.monotonic() - t0)
+                last = e
+                continue
+            except Exception as e:
+                self._account(name, "error", time.monotonic() - t0)
+                last = e
+                continue
+            self._account(name, "ok", time.monotonic() - t0)
+            age = max(0.0, time.time() - float(obs.get("t") or time.time()))
+            self._staleness.labels(node=name).set(age)
+            self.ages.append(age)
+            return obs
+        raise ScrapeError(
+            f"scrape of {name} failed all {1 + self.retries} attempt(s): "
+            f"{type(last).__name__}: {last}")
+
+
+class _NodeReach:
+    """Per-node reachability state machine (reachable | unreachable);
+    transitions emit flight events (lhlint LH605 enforces this)."""
+
+    __slots__ = ("state",)
+
+    def __init__(self):
+        self.state = "reachable"
+
+
 @dataclass
 class FleetSnapshot:
     """One slot's fleet-wide observation."""
@@ -108,6 +335,7 @@ class FleetSnapshot:
     books: dict            # network-wide ledger roll-up
     unaccounted: int       # events no node's books can account for
     down: list = field(default_factory=list)   # nodes not up this slot
+    unreachable: list = field(default_factory=list)  # up, but unscrapable
 
 
 class FleetObserver:
@@ -119,11 +347,25 @@ class FleetObserver:
     observer is edge-triggered on split/reconverge (one flight event
     per transition) and keeps every snapshot for ground-truth replay
     (bounded; a fleetwatch drill is tens of slots, not millions).
+
+    The observer never touches a node directly: every read goes
+    through its :class:`NodeScrapeSource` (ISSUE 16), so the same
+    correlation logic runs over in-memory reads (:class:`DirectSource`)
+    or a real scrape loop (:class:`HttpSource`).  A failed scrape
+    degrades that node to absent-from-this-snapshot — it can NEVER
+    manufacture a phantom head class, so a scrape outage is
+    indistinguishable from the node being slow, never from a fork.
+    After ``LHTPU_SCRAPE_UNREACHABLE_AFTER`` consecutive failures the
+    node is classified ``unreachable`` (a monitoring-plane state,
+    distinct from lifecycle ``down``: the node may be producing blocks
+    perfectly well).
     """
 
     _MAX_SNAPSHOTS = 4096
+    _MAX_EVENTS = 65536
 
-    def __init__(self, net: "LocalNetwork"):
+    def __init__(self, net: "LocalNetwork",
+                 source: NodeScrapeSource | None = None):
         self.net = net
         self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
         # scope timeline() to THIS network's lifetime: the flight ring
@@ -135,6 +377,21 @@ class FleetObserver:
         self.first_split_slot: int | None = None
         self.reconverged_slot: int | None = None
         self._was_split = False
+        self.source: NodeScrapeSource = source or DirectSource()
+        self.discipline = ScrapeDiscipline()
+        # per-node flight cursors: each scrape asks only for events past
+        # what that node already delivered (resumable tail-follow)
+        self._cursors: dict[str, int] = {}
+        self._fails: dict[str, int] = {}
+        self._reach: dict[str, _NodeReach] = {}
+        # scraped flight events (pull transports only; the direct
+        # transport reads the live ring), deduped by ring seq
+        self._events: list[dict] = []
+        self._event_seqs: set[int] = set()
+        self._unreachable_after = max(1, envreg.get_int(
+            "LHTPU_SCRAPE_UNREACHABLE_AFTER", 3) or 3)
+        self._cadence = max(1, envreg.get_int(
+            "LHTPU_SCRAPE_CADENCE_SLOTS", 1) or 1)
         self._snap_counter = REGISTRY.counter(
             "fleet_snapshots_total",
             "per-slot fleet observations taken by the observer")
@@ -149,10 +406,77 @@ class FleetObserver:
             "network-wide ledger deficit beyond the in-flight windows "
             "(0 = every node's books balance)")
 
+    # -- the scrape plane ---------------------------------------------------
+
+    def use_source(self, source: NodeScrapeSource) -> None:
+        """Swap the transport (e.g. direct -> http once the fleet's API
+        servers are bound); correlation state carries over untouched."""
+        self.source = source
+
+    def _scrape(self, node) -> dict | None:
+        """One node's roll-up through the source + discipline, or None
+        when every attempt in the budget failed (the node then simply
+        drops out of this snapshot — absence, never a phantom class)."""
+        name = node.name
+        cursor = self._cursors.get(name, self._seq_floor)
+        reach = self._reach.setdefault(name, _NodeReach())
+        try:
+            obs = self.discipline.execute(
+                name,
+                lambda: self.source.observe(
+                    node, cursor, self.discipline.deadline_s),
+                guarded=self.source.guarded)
+        except ScrapeError as e:
+            fails = self._fails.get(name, 0) + 1
+            self._fails[name] = fails
+            if (fails >= self._unreachable_after
+                    and reach.state != "unreachable"):
+                self._mark_unreachable(name, fails, e)
+            return None
+        self._fails[name] = 0
+        if reach.state != "reachable":
+            self._mark_reachable(name)
+        flt = obs.get("flight") or {}
+        self._cursors[name] = int(flt.get("seq") or cursor)
+        self._ingest_events(flt.get("events") or ())
+        return obs
+
+    def _mark_unreachable(self, name: str, fails: int, err) -> None:
+        reach = self._reach[name]
+        reach.state = "unreachable"
+        flight.emit("node_unreachable", node=name,
+                    consecutive_failures=fails, error=str(err))
+
+    def _mark_reachable(self, name: str) -> None:
+        reach = self._reach[name]
+        reach.state = "reachable"
+        flight.emit("node_reachable", node=name)
+
+    def _ingest_events(self, events) -> None:
+        """Fold one scrape's flight tail into the merged event store
+        (pull transports; the direct transport reads the live ring).
+        Nodes share the process ring in-sim, so dedup by seq."""
+        if self.source.transport == "direct":
+            return
+        for e in events:
+            seq = int(e.get("seq", 0))
+            if seq in self._event_seqs:
+                continue
+            self._event_seqs.add(seq)
+            self._events.append(dict(e))
+        if len(self._events) > self._MAX_EVENTS:
+            self._events.sort(key=lambda e: e.get("seq", 0))
+            dropped = self._events[:-self._MAX_EVENTS]
+            del self._events[:-self._MAX_EVENTS]
+            self._event_seqs.difference_update(
+                int(e.get("seq", 0)) for e in dropped)
+
     # -- the per-slot observation -------------------------------------------
 
     def snapshot(self, slot: int) -> FleetSnapshot | None:
         if not self.enabled:
+            return None
+        if self._cadence > 1 and int(slot) % self._cadence != 0:
             return None
         # equivalence classes, finality and the books roll-up cover the
         # LIVE fleet: a node that is down is reported as down, never as
@@ -161,18 +485,35 @@ class FleetObserver:
         down = [n.name for n in self.net.nodes if n.state != "up"]
         if not nodes:
             return None
-        heads = {n.name: n.chain.head_root for n in nodes}
+        observations: dict[str, dict] = {}
+        unreachable: list[str] = []
+        for node in nodes:
+            obs = self._scrape(node)
+            if obs is None:
+                # below the threshold the node is just absent this
+                # slot; at/past it, it is reported unreachable — but in
+                # neither case does it contribute a head class
+                if self._reach[node.name].state == "unreachable":
+                    unreachable.append(node.name)
+                continue
+            observations[node.name] = obs
+        if not observations:
+            return None
+        heads = {name: bytes.fromhex(obs["head"]["root"][2:])
+                 for name, obs in observations.items()}
         classes: dict[bytes, list[str]] = {}
         for name, root in heads.items():
             classes.setdefault(root, []).append(name)
         split = len(classes) > 1
-        finalized = [int(n.chain.fork_choice.finalized.epoch)
-                     for n in nodes]
-        books, unaccounted = self._roll_up_books(nodes)
+        finalized = [int(obs["finalized"]["epoch"])
+                     for obs in observations.values()]
+        books, unaccounted = _roll_up_ledgers(
+            {name: obs["books"] for name, obs in observations.items()})
         snap = FleetSnapshot(
             slot=int(slot), heads=heads, classes=classes, split=split,
             finalized_min=min(finalized), finalized_max=max(finalized),
-            books=books, unaccounted=unaccounted, down=down)
+            books=books, unaccounted=unaccounted, down=down,
+            unreachable=unreachable)
         self.snapshots.append(snap)
         del self.snapshots[:-self._MAX_SNAPSHOTS]
         self._snap_counter.inc()
@@ -196,60 +537,15 @@ class FleetObserver:
     @staticmethod
     def _roll_up_books(nodes) -> tuple[dict, int]:
         """Network-wide sum of every node's sync/backfill/processor
-        ledgers + the unaccounted total: deficit beyond each ledger's
-        in-flight tolerance window, plus ANY negative deficit (more
-        accounted than submitted is impossible legitimately)."""
-        total = {"requested": 0, "imported": 0, "retried": 0,
-                 "abandoned": 0, "inflight": 0}
-        unaccounted = 0
-        per_node: dict[str, dict] = {}
-        for node in nodes:
-            ledgers = {}
-            for label, owner in (("sync", getattr(node.net, "sync", None)),
-                                 ("backfill",
-                                  getattr(node.net, "backfill", None))):
-                books = getattr(owner, "books", None)
-                if books is None:
-                    continue
-                b = dict(books)
-                inflight = int(getattr(owner, "inflight_attempts", 0))
-                # .get throughout: a future ledger with a partial books
-                # shape must read as an observer finding, never kill
-                # the simulation driver mid-slot
-                deficit = b.get("requested", 0) - (
-                    b.get("imported", 0) + b.get("retried", 0)
-                    + b.get("abandoned", 0))
-                if deficit < 0:
-                    unaccounted += -deficit
-                elif deficit > inflight:
-                    unaccounted += deficit - inflight
-                for k in ("requested", "imported", "retried", "abandoned"):
-                    total[k] += int(b.get(k, 0))
-                total["inflight"] += inflight
-                ledgers[label] = {**b, "inflight": inflight}
-            proc = getattr(node, "processor", None)
-            if proc is not None:
-                m = proc.metrics
-                with m._lock:
-                    enq = sum(m.enqueued.values())
-                    done = sum(m.processed.values())
-                    shed = sum(m.shed.values())
-                queued = sum(len(q) for q in proc._queues.values())
-                deficit = enq - done - shed - queued
-                # the monitors idiom: a positive deficit equals the
-                # in-flight population while busy, so it only counts at
-                # idle; a negative deficit is impossible legitimately
-                idle = (not getattr(proc, "_inflight", ())
-                        and not getattr(proc, "_manager_holding", False))
-                if deficit < 0:
-                    unaccounted += -deficit
-                elif idle and deficit > 0:
-                    unaccounted += deficit
-                ledgers["processor"] = {
-                    "enqueued": enq, "processed": done, "shed": shed,
-                    "queued": queued, "idle": idle}
-            per_node[node.name] = ledgers
-        return {"total": total, "per_node": per_node}, unaccounted
+        ledgers + the unaccounted total (see :func:`_roll_up_ledgers`
+        for the deficit math, :func:`node_ledgers` for the extraction —
+        the split lets scraped remote books flow through the same
+        audit)."""
+        per_node = {
+            node.name: node_ledgers(getattr(node, "net", None),
+                                    getattr(node, "processor", None))
+            for node in nodes}
+        return _roll_up_ledgers(per_node)
 
     # -- cross-node correlation ---------------------------------------------
 
@@ -257,10 +553,18 @@ class FleetObserver:
         """All N nodes' flight events merged into one causally-ordered
         (ring-sequence) node-labeled timeline, scoped to events emitted
         since this observer was constructed.  Events without per-node
-        attribution (process-wide planes) are labeled ``process``."""
+        attribution (process-wide planes) are labeled ``process``.
+
+        The direct transport reads the live ring (complete through this
+        instant, including events after the newest snapshot); a pull
+        transport can only ever serve what its scrapes delivered."""
+        if self.source.transport == "direct":
+            return [{**e, "node": e.get("node", "process")}
+                    for e in flight.RECORDER.snapshot()
+                    if e["seq"] > self._seq_floor]
         return [{**e, "node": e.get("node", "process")}
-                for e in flight.RECORDER.snapshot()
-                if e["seq"] > self._seq_floor]
+                for e in sorted(self._events,
+                                key=lambda e: e.get("seq", 0))]
 
     def books_balanced(self) -> bool:
         """True when the newest snapshot accounts for every event."""
@@ -296,6 +600,9 @@ class LocalNetwork:
             chain = self._build_chain(crash)
             chain.chain_health.set_name(f"node-{i}")
             net = NetworkService(chain, self.fabric, f"node-{i}")
+            # back-reference for the node roll-up (api/http_api), so a
+            # scrape of this node's endpoint reads its real books
+            chain.network_service = net
             vc = ValidatorClient(chain, self._validator_store(i),
                                  router=net.router)
             self.nodes.append(LocalNode(f"node-{i}", chain, net, vc,
@@ -310,6 +617,8 @@ class LocalNetwork:
         self.observer = FleetObserver(self)
         # pairs currently severed by partition() (for heal())
         self._partitioned: list[tuple[str, str]] = []
+        # per-node bound API servers (serve_http/stop_http)
+        self._http: dict = {}
 
     # -- node construction (shared by __init__ and restart) -----------------
 
@@ -359,6 +668,9 @@ class LocalNetwork:
         accumulate thread pools in the driving process."""
         self.fabric.gossip.leave(node.name)
         self.fabric.rpc.leave(node.name)
+        srv = self._http.pop(node.name, None)
+        if srv is not None:
+            srv.stop()
         proc = node.processor
         if proc is not None:
             for ex in (getattr(proc, "_executor", None),
@@ -434,6 +746,7 @@ class LocalNetwork:
                        default=int(chain.head_state.slot))
         chain.slot_clock.set_slot(int(slot))
         net = NetworkService(chain, self.fabric, node.name)
+        chain.network_service = net
         vc = ValidatorClient(chain, self._validator_store(
             self.nodes.index(node)), router=net.router)
         node.chain, node.net, node.vc, node.crash = chain, net, vc, crash
@@ -468,6 +781,7 @@ class LocalNetwork:
         node.net.backfill = BackfillSync(
             node.chain, node.net.rpc_ep, node.net.peer_manager)
         node.processor = BeaconProcessor(max_workers=2, max_batch=64)
+        node.chain.beacon_processor = node.processor
 
     def reverify_tail(self, node, window: int | None = None) -> int:
         """Soak-mode defense in depth after a crash repair: re-verify
@@ -530,6 +844,28 @@ class LocalNetwork:
         self._partitioned.clear()
         flight.emit("fleet_heal", healed=healed)
         return healed
+
+    # -- the pull observatory's transport (ISSUE 16) ------------------------
+
+    def serve_http(self) -> dict:
+        """Bind one API server per live node (ephemeral localhost
+        ports) and return ``{node name: base url}`` — the exact mapping
+        :class:`HttpSource` wants.  Idempotent per node; a node killed
+        or stopped later has its server torn down by ``_detach``."""
+        from lighthouse_tpu.api.http_api import HttpServer
+
+        for node in self.live_nodes:
+            if node.name not in self._http:
+                self._http[node.name] = HttpServer(
+                    node.chain, host="127.0.0.1", port=0).start()
+        return {name: f"http://127.0.0.1:{srv.port}"
+                for name, srv in self._http.items()}
+
+    def stop_http(self) -> None:
+        """Tear down every bound API server (drill teardown)."""
+        for srv in self._http.values():
+            srv.stop()
+        self._http.clear()
 
     # -- driving -----------------------------------------------------------
 
@@ -643,5 +979,6 @@ def _new_slot_summary(slot: int):
     return SlotSummary(slot)
 
 
-__all__ = ["FleetObserver", "FleetSnapshot", "LocalNetwork", "LocalNode",
-           "SimSummary"]
+__all__ = ["DirectSource", "FleetObserver", "FleetSnapshot", "HttpSource",
+           "LocalNetwork", "LocalNode", "NodeScrapeSource", "ScrapeDiscipline",
+           "ScrapeError", "SimSummary", "node_ledgers"]

@@ -484,3 +484,97 @@ def test_tracing_endpoint_serves_block_timeline(api_setup):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+def test_observatory_node_endpoint(api_setup):
+    """ISSUE 16: one scrape composes everything the fleet observer
+    reads — head, checkpoints, health, books, lifecycle, flight tail —
+    with a per-node monotonic seq and a resumable flight cursor."""
+    import json
+    import urllib.request
+
+    h, chain, client = api_setup
+    from lighthouse_tpu.common import flight_recorder as flight
+
+    def get(path):
+        with urllib.request.urlopen(client.base_url + path,
+                                    timeout=5) as r:
+            return json.loads(r.read())["data"]
+
+    flight.emit("node_probe_one", detail=1)
+    data = get("/lighthouse/observatory/node")
+    assert data["head"]["root"].startswith("0x")
+    assert data["head"]["slot"] == int(chain.head_state.slot)
+    assert data["finalized"]["epoch"] == \
+        int(chain.finalized_checkpoint().epoch)
+    assert data["justified"]["epoch"] == \
+        int(chain.justified_checkpoint().epoch)
+    assert data["chain_health"]["node"] == data["node"]
+    assert isinstance(data["books"], dict)
+    assert "resume_mode" in data["lifecycle"]
+    assert data["seq"] >= 1 and data["t"] > 0
+    assert any(e["kind"] == "node_probe_one"
+               for e in data["flight"]["events"])
+    # the seq is per-node monotonic: a second scrape advances it
+    again = get("/lighthouse/observatory/node")
+    assert again["seq"] > data["seq"]
+    # cursor resume: only events past the watermark come back
+    cursor = data["flight"]["seq"]
+    flight.emit("node_probe_two", detail=2)
+    tail = get(f"/lighthouse/observatory/node?since_seq={cursor}")
+    kinds = [e["kind"] for e in tail["flight"]["events"]]
+    assert "node_probe_two" in kinds
+    assert "node_probe_one" not in kinds
+    assert all(e["seq"] > cursor for e in tail["flight"]["events"])
+    assert tail["flight"]["since_seq"] == cursor
+    assert tail["flight"]["seq"] >= cursor + 1
+
+
+def test_observatory_flight_cursor(api_setup):
+    """The flight endpoint takes the same since_seq cursor and reports
+    the same watermark, so a scraper can tail either surface."""
+    import json
+    import urllib.request
+
+    h, chain, client = api_setup
+    from lighthouse_tpu.common import flight_recorder as flight
+
+    def get(path):
+        with urllib.request.urlopen(client.base_url + path,
+                                    timeout=5) as r:
+            return json.loads(r.read())["data"]
+
+    flight.emit("cursor_probe_a")
+    fl = get("/lighthouse/observatory/flight")
+    assert fl["seq"] >= 1
+    cursor = fl["seq"]
+    flight.emit("cursor_probe_b")
+    fl2 = get(f"/lighthouse/observatory/flight?since_seq={cursor}")
+    kinds = [e["kind"] for e in fl2["tail"]]
+    assert "cursor_probe_b" in kinds
+    assert "cursor_probe_a" not in kinds
+    assert fl2["seq"] > cursor
+
+
+def test_observatory_bad_cursor_is_400(api_setup):
+    import urllib.error
+    import urllib.request
+
+    h, chain, client = api_setup
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(
+            client.base_url + "/lighthouse/observatory/node?since_seq=abc",
+            timeout=5)
+    assert exc.value.code == 400
+
+
+def test_node_rollup_round_trips_through_promtext(api_setup):
+    """The scrape pair end to end: the node's /metrics exposition
+    parses and re-exposes byte-identically (the wire-format property
+    the fleet scraper relies on)."""
+    h, chain, client = api_setup
+    from lighthouse_tpu.common.promtext import expose, parse
+
+    REGISTRY.counter("test_roundtrip_total", "probe").labels(
+        peer="a,b\"c").inc()
+    text = client.metrics_text()
+    assert expose(parse(text)) == text

@@ -457,6 +457,27 @@ def test_metrics_pass_clean_negative(tmp_path):
     assert analyze(pkg) == []
 
 
+def test_metrics_pass_pins_fleet_scrape_family_to_simulator(tmp_path):
+    # ISSUE 16: scrape-plane accounting belongs to the observer's
+    # ScrapeDiscipline — a fleet_scrape_* registration anywhere else
+    # (e.g. the promtext parser growing its own series) is a finding
+    pkg, _ = make_pkg(tmp_path, {"common/promtext.py": """
+        REGISTRY.histogram("fleet_scrape_seconds", "h")
+    """})
+    findings = [f for f in analyze(pkg) if f.rule == "LH501"]
+    assert findings, "fleet_scrape_ family not pinned to simulator.py"
+    assert "simulator.py" in findings[0].message
+
+
+def test_metrics_pass_fleet_scrape_owner_is_clean(tmp_path):
+    # the owner pin is a path suffix, so the compliant twin must sit at
+    # .../lighthouse_tpu/simulator.py like the real registration site
+    pkg, _ = make_pkg(tmp_path, {"lighthouse_tpu/simulator.py": """
+        REGISTRY.histogram("fleet_scrape_seconds", "h")
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH501"] == []
+
+
 def test_check_metrics_shim_collect_still_works(tmp_path):
     bad = tmp_path / "pkg"
     bad.mkdir()
@@ -966,6 +987,40 @@ def test_flight_pass_node_lifecycle_compliant_twin(tmp_path):
             def kill(self, node):
                 node.state = "killed"
                 flight.emit("node_kill", node=node.name)
+    """})
+    assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
+
+
+def test_flight_pass_flags_unrecorded_reachability_edge(tmp_path):
+    # ISSUE 16: the observer's per-node reachability machine — an
+    # unrecorded reachable<->unreachable edge makes a scrape outage
+    # forensically invisible
+    pkg, _ = make_pkg(tmp_path, {"simulator.py": """
+        class FleetObserver:
+            def _mark_unreachable(self, name, fails):
+                reach = self._reach[name]
+                reach.state = "unreachable"
+    """})
+    f605 = [f for f in analyze(pkg) if f.rule == "LH605"]
+    assert [f.symbol for f in f605] == \
+        ["FleetObserver._mark_unreachable:set_state"]
+
+
+def test_flight_pass_reachability_compliant_twin(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"simulator.py": """
+        from lighthouse_tpu.common import flight_recorder as flight
+
+        class FleetObserver:
+            def _mark_unreachable(self, name, fails):
+                reach = self._reach[name]
+                reach.state = "unreachable"
+                flight.emit("node_unreachable", node=name,
+                            consecutive_failures=fails)
+
+            def _mark_reachable(self, name):
+                reach = self._reach[name]
+                reach.state = "reachable"
+                flight.emit("node_reachable", node=name)
     """})
     assert [f for f in analyze(pkg) if f.rule == "LH605"] == []
 

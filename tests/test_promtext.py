@@ -1,0 +1,156 @@
+"""The pull observatory's ingest side (ISSUE 16): the Prometheus
+text-exposition round-trip.
+
+The contract under test is byte-identity: ``expose(parse(text)) ==
+text`` for anything ``common/metrics.Registry.render()`` can produce —
+label escapes, HELP escapes, histogram series attribution, raw value
+strings.  Plus the negative space: promtext is a consumer of the
+metrics plane and must register no families of its own.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+from lighthouse_tpu.common import promtext
+from lighthouse_tpu.common.metrics import Registry
+from lighthouse_tpu.common.promtext import PromTextError, expose, parse
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _tricky_registry() -> Registry:
+    """A registry exercising every renderer feature at once."""
+    reg = Registry()
+    c = reg.counter("requests_total", "outbound requests by peer")
+    c.inc()
+    c.labels(peer="alpha", outcome="ok").inc(3)
+    c.labels(peer="be\"ta", outcome="time\nout").inc()
+    c.labels(peer="gam\\ma", outcome="err").inc(2)
+    g = reg.gauge("queue_depth", 'depth with "quotes" and a \\ slash\nplus')
+    g.set(7)
+    g.labels(lane="a,b={c}").set(2.5)
+    h = reg.histogram("latency_seconds", "request wall time")
+    for v in (0.002, 0.03, 0.4, 2.0):
+        h.observe(v)
+    h.labels(kind="scrape").observe(0.07)
+    reg.counter("untouched_total", "registered but never incremented")
+    reg.gauge("helpless")
+    return reg
+
+
+def test_round_trip_is_byte_identical():
+    text = _tricky_registry().render()
+    assert expose(parse(text)) == text
+
+
+def test_round_trip_of_the_process_registry():
+    """The real process-wide registry (whatever this test session
+    already touched) must round-trip too — no cherry-picked corpus."""
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    REGISTRY.counter("promtext_probe_total", "round-trip probe").inc()
+    text = REGISTRY.render()
+    assert expose(parse(text)) == text
+
+
+def test_parse_shapes_families_and_samples():
+    fams = parse(_tricky_registry().render())
+    req = fams["requests_total"]
+    assert req.type == "counter"
+    assert req.help == "outbound requests by peer"
+    bare = [s for s in req.samples if not s.labels]
+    assert len(bare) == 1 and bare[0].value == 1.0
+    by_labels = {tuple(sorted(s.labelset().items())): s.value
+                 for s in req.samples if s.labels}
+    assert by_labels[(("outcome", "ok"), ("peer", "alpha"))] == 3.0
+    # escaped label values decode back to their raw forms
+    assert (("outcome", "time\nout"), ("peer", 'be"ta')) in by_labels
+    assert (("outcome", "err"), ("peer", "gam\\ma")) in by_labels
+
+
+def test_parse_decodes_escaped_help():
+    fams = parse(_tricky_registry().render())
+    assert fams["queue_depth"].help == \
+        'depth with "quotes" and a \\ slash\nplus'
+
+
+def test_histogram_series_attach_to_their_family():
+    fams = parse(_tricky_registry().render())
+    h = fams["latency_seconds"]
+    assert h.type == "histogram"
+    names = {s.name for s in h.samples}
+    assert names == {"latency_seconds_bucket", "latency_seconds_sum",
+                     "latency_seconds_count"}
+    # +Inf bucket count equals _count for the unlabeled series
+    inf = [s for s in h.samples
+           if s.name == "latency_seconds_bucket"
+           and s.labelset().get("le") == "+Inf" and len(s.labels) == 1]
+    count = [s for s in h.samples
+             if s.name == "latency_seconds_count" and not s.labels]
+    assert inf[0].value == count[0].value == 4.0
+
+
+def test_raw_value_strings_survive():
+    """The raw value string is preserved verbatim — the round-trip must
+    not renormalize floats (``7.0`` stays ``7.0``, never ``7``), and a
+    hand-written integer sample survives as written."""
+    reg = Registry()
+    reg.gauge("g").set(7)
+    text = reg.render()
+    fams = parse(text)
+    assert {s.raw for s in fams["g"].samples} == {"7.0"}
+    assert expose(fams) == text
+    hand = "# HELP g \n# TYPE g gauge\ng 7\n"
+    assert expose(parse(hand)) == hand
+
+
+def test_label_values_with_commas_and_braces():
+    reg = Registry()
+    reg.counter("c", "h").labels(k='a,b="x"}{').inc()
+    text = reg.render()
+    fams = parse(text)
+    assert fams["c"].samples[0].labelset() == {"k": 'a,b="x"}{'}
+    assert expose(fams) == text
+
+
+@pytest.mark.parametrize("bad,fragment", [
+    ("orphan_sample 1\n", "before its # TYPE"),
+    ("# TYPE c counter\nc{k=\"v} 1\n", "unterminated"),
+    ("# TYPE c counter\nc{k=\"v\"} x\n", "non-numeric"),
+    ("# TYPE c counter\nc{k=\"\\q\"} 1\n", "bad escape"),
+    ("# TYPE c counter\nc{k} 1\n", "label without '='"),
+    ("# HELP  \n", "HELP without a metric name"),
+])
+def test_malformed_text_raises_with_line_numbers(bad, fragment):
+    with pytest.raises(PromTextError) as exc:
+        parse(bad)
+    assert fragment in str(exc.value)
+    assert exc.value.lineno >= 1
+
+
+def test_comments_are_tolerated():
+    text = ("# a scraper note\n"
+            "# TYPE c counter\nc 1\n")
+    assert parse(text)["c"].samples[0].value == 1.0
+
+
+def test_promtext_registers_no_metric_families():
+    """The parser is a consumer of the exposition plane, never a
+    producer: zero REGISTRY registrations in its source (the same
+    scanner lhlint's LH501 pass runs)."""
+    from tools.lint.metrics_pass import _scan_tree
+
+    path = REPO / "lighthouse_tpu" / "common" / "promtext.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    regs: dict = {}
+    errors: list = []
+    _scan_tree("lighthouse_tpu/common/promtext.py", tree, regs, errors)
+    assert regs == {} and errors == []
+
+
+def test_module_has_no_registry_import():
+    src = (REPO / "lighthouse_tpu" / "common" / "promtext.py").read_text()
+    assert "REGISTRY" not in src
+    assert promtext.__doc__ and "round-trip" in promtext.__doc__.lower()

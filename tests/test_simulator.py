@@ -410,3 +410,175 @@ class TestChaosPlan:
         assert ctrl.restarted[0][1] in ("snapshot", "rebuilt")
         assert net.nodes[2].state == "up"
         assert net.heads_agree()
+
+
+class TestPullObservatory:
+    """The NodeScrapeSource seam + scrape discipline (ISSUE 16)."""
+
+    def test_direct_and_http_observations_agree(self):
+        """The same node, observed through both transports back to
+        back, must produce the same roll-up (monotonic seq and the
+        composition timestamp excepted)."""
+        from lighthouse_tpu.simulator import DirectSource, HttpSource
+
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(4)
+        urls = net.serve_http()
+        try:
+            node = net.nodes[0]
+            a = DirectSource().observe(node, 0, 2.0)
+            b = HttpSource(urls).observe(node, 0, 2.0)
+            for key in ("node", "head", "finalized", "justified",
+                        "chain_health", "books", "lifecycle"):
+                assert a[key] == b[key], f"transport drift on {key!r}"
+            assert a["flight"]["events"] == b["flight"]["events"]
+            assert b["seq"] > a["seq"]   # per-node monotonic
+        finally:
+            net.stop_http()
+
+    def test_observer_runs_identically_over_http(self):
+        """Swapping the observer onto HttpSource mid-run keeps every
+        fleet conclusion intact: one head class, balancing books, no
+        phantom splits, staleness accounted."""
+        from lighthouse_tpu.simulator import HttpSource
+
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(4)
+        net.observer.use_source(HttpSource(net.serve_http()))
+        try:
+            net.run_slots(4)
+        finally:
+            net.stop_http()
+        assert len(net.observer.snapshots) == 8
+        last = net.observer.snapshots[-1]
+        assert len(last.classes) == 1
+        assert last.unaccounted == 0
+        assert last.unreachable == [] and last.down == []
+        assert net.observer.first_split_slot is None
+        # one staleness sample per node per slot, across both legs
+        assert len(net.observer.discipline.ages) == 16
+        assert max(net.observer.discipline.ages) < 2 * net.spec.seconds_per_slot
+
+    def test_failed_scrape_never_splits_and_classifies_unreachable(self):
+        """A scrape outage (transport plane) makes the node absent,
+        then unreachable after the threshold — NEVER a head class, and
+        never lifecycle down."""
+        from lighthouse_tpu.common import flight_recorder as flight
+        from lighthouse_tpu.simulator import DirectSource
+
+        class _Flaky(DirectSource):
+            dead = None
+
+            def observe(self, node, since_seq, deadline_s):
+                if node.name == self.dead:
+                    raise RuntimeError("injected scrape outage")
+                return super().observe(node, since_seq, deadline_s)
+
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        net.run_slots(3)
+        flaky = _Flaky()
+        flaky.dead = "node-1"
+        net.observer.use_source(flaky)
+        threshold = net.observer._unreachable_after
+        net.run_slots(threshold + 1)
+        outage = net.observer.snapshots[3:]
+        assert all(not s.split for s in outage)
+        assert net.observer.first_split_slot is None, \
+            "a scrape outage manufactured a phantom split"
+        assert all("node-1" not in s.heads for s in outage)
+        assert all(s.down == [] for s in outage), \
+            "scrape-unreachable conflated with lifecycle down"
+        assert outage[-1].unreachable == ["node-1"]
+        kinds = [(e["kind"], e.get("node"))
+                 for e in flight.RECORDER.snapshot()]
+        assert ("node_unreachable", "node-1") in kinds
+        # outage ends: the node rejoins the observed fleet
+        flaky.dead = None
+        net.run_slots(1)
+        last = net.observer.snapshots[-1]
+        assert "node-1" in last.heads and last.unreachable == []
+        kinds = [(e["kind"], e.get("node"))
+                 for e in flight.RECORDER.snapshot()]
+        assert ("node_reachable", "node-1") in kinds
+
+    def test_down_is_not_unreachable(self):
+        net = LocalNetwork(n_nodes=3, n_validators=24, fork="altair")
+        net.run_slots(2)
+        net.kill(2)
+        net.run_slots(2)
+        snap = net.observer.snapshots[-1]
+        assert snap.down == ["node-2"]
+        assert snap.unreachable == []
+
+    def test_scrape_deadline_and_retry_budget(self, monkeypatch):
+        """The discipline's watchdog bounds a wedged transport: every
+        attempt in the budget times out, then ScrapeError."""
+        import time as _time
+
+        from lighthouse_tpu.simulator import ScrapeDiscipline, ScrapeError
+
+        monkeypatch.setenv("LHTPU_SCRAPE_DEADLINE_S", "0.15")
+        monkeypatch.setenv("LHTPU_SCRAPE_RETRIES", "1")
+        disc = ScrapeDiscipline()
+        assert disc.deadline_s == 0.15 and disc.retries == 1
+        calls = []
+
+        def wedged():
+            calls.append(1)
+            _time.sleep(1.0)
+
+        t0 = _time.monotonic()
+        with pytest.raises(ScrapeError):
+            disc.execute("node-x", wedged, guarded=True)
+        assert len(calls) == 2, "retry budget not honored"
+        assert _time.monotonic() - t0 < 1.0, "deadline did not bound the wait"
+
+    def test_http_source_vs_wedged_handler(self, monkeypatch):
+        """A real socket that accepts and never answers: the scrape
+        fails within the deadline/retry budget instead of hanging the
+        observer."""
+        import socket
+        import time as _time
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.simulator import (HttpSource, ScrapeDiscipline,
+                                              ScrapeError)
+
+        monkeypatch.setenv("LHTPU_SCRAPE_DEADLINE_S", "0.2")
+        monkeypatch.setenv("LHTPU_SCRAPE_RETRIES", "1")
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        try:
+            port = srv.getsockname()[1]
+            src = HttpSource({"node-0": f"http://127.0.0.1:{port}"})
+            disc = ScrapeDiscipline()
+            node = SimpleNamespace(name="node-0")
+            t0 = _time.monotonic()
+            with pytest.raises(ScrapeError):
+                disc.execute(
+                    "node-0",
+                    lambda: src.observe(node, 0, disc.deadline_s),
+                    guarded=True)
+            assert _time.monotonic() - t0 < 2.0
+        finally:
+            srv.close()
+
+    def test_flight_cursor_is_resumable_per_node(self):
+        """Each scrape's flight watermark is the next cursor: no event
+        is delivered twice, none skipped."""
+        from lighthouse_tpu.common import flight_recorder as flight
+        from lighthouse_tpu.simulator import DirectSource
+
+        net = LocalNetwork(n_nodes=2, n_validators=16, fork="altair")
+        src = DirectSource()
+        node = net.nodes[0]
+        first = src.observe(node, 0, 2.0)
+        cursor = first["flight"]["seq"]
+        flight.emit("probe_event", node="node-0")
+        second = src.observe(node, cursor, 2.0)
+        kinds = [e["kind"] for e in second["flight"]["events"]]
+        assert "probe_event" in kinds
+        seqs = [e["seq"] for e in second["flight"]["events"]]
+        assert all(s > cursor for s in seqs), "cursor re-delivered events"
+        assert second["flight"]["since_seq"] == cursor

@@ -43,7 +43,11 @@ TARGET_MODULES = ("crypto/bls/api.py", "processor/admission.py",
                   # edges and the simulator's node lifecycle edges ARE
                   # the soak's causal record — an unrecorded transition
                   # punches a hole in exactly the timeline the drill
-                  # gates on
+                  # gates on.  ISSUE 16 adds the observer's per-node
+                  # reachability machine (_NodeReach.state in
+                  # _mark_unreachable/_mark_reachable): an unrecorded
+                  # reachable<->unreachable edge would make a scrape
+                  # outage forensically invisible
                   "chain/chaos.py", "simulator.py")
 
 _STATE_ATTRS = {"state", "rung"}

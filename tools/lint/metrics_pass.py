@@ -78,6 +78,10 @@ FAMILY_OWNERS = {
     "finality_lag_": "lighthouse_tpu/chain/chain_health.py",
     "chain_participation_": "lighthouse_tpu/chain/chain_health.py",
     "fleet_": "lighthouse_tpu/simulator.py",
+    # the pull observatory (PR 16): scrape-plane accounting lives with
+    # the observer's ScrapeDiscipline; promtext (the exposition parser)
+    # is a consumer of the metrics plane and must register NOTHING
+    "fleet_scrape_": "lighthouse_tpu/simulator.py",
     # wire-to-device ingest (PR 14): the columnar decoder owns the
     # ingest_* decode series, the pubkey plane its fold/refresh books
     "ingest_": "lighthouse_tpu/ssz/columnar.py",
