@@ -1843,6 +1843,159 @@ def _bench_observatory() -> dict:
     return result
 
 
+def _bench_msm() -> dict:
+    """The unified-MSM-plane drill (ISSUE 17): the calibration
+    lifecycle (measure -> enveloped msm_calibration sidecar -> warm
+    adoption from the store), per-(track, bucket) device-vs-host rates
+    with digest-equality gates, and the consumer-visible host-path
+    gate — the msm_g1 routing wrapper must not cost more than 5% over
+    the raw host lincomb seam the pre-refactor consumers called
+    directly."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from lighthouse_tpu.crypto import kzg
+    from lighthouse_tpu.crypto.bls import curve as cv
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.ops import msm, prewarm, pubkey_kernels
+    from lighthouse_tpu.ops import program_store as ps
+
+    base = tempfile.mkdtemp(prefix="lhtpu-msm-")
+    result: dict = {"msm_platform": jax.devices()[0].platform,
+                    "stage": "calibrating"}
+    _emit_partial(result)
+
+    def rate(fn, min_s=0.2, best_of=3):
+        # best-of-N windows: the gate below compares two host-python
+        # paths whose per-call cost dwarfs the wrapper overhead, and a
+        # single noisy window must not fail a 5% bound
+        best = 0.0
+        for _ in range(best_of):
+            reps, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < min_s:
+                fn()
+                reps += 1
+            best = max(best, reps / (time.perf_counter() - t0))
+        return best
+
+    try:
+        ps.configure(os.path.join(base, "store"))
+        cold = prewarm.msm_calibration_step()
+        assert cold.get("source") in ("measured", "env"), cold
+        # simulate the next process-life: forget the adopted thresholds,
+        # re-adopt from the persisted sidecar
+        msm._CALIBRATED = False
+        msm._DEVICE_MIN.clear()
+        warm = prewarm.msm_calibration_step()
+        if cold.get("source") == "measured":
+            assert warm.get("source") == "store", \
+                f"warm restart re-measured: {warm}"
+        result.update({
+            "msm_calibration_source": warm.get("source"),
+            "msm_threshold_lanes": {t: msm.device_min(t)
+                                    for t in msm.TRACKS},
+            "stage": "tracks",
+        })
+        _emit_partial(result)
+
+        g = cv.g1_generator()
+        tracks: dict = {}
+        # plain g1 track at two lane buckets (every extra bucket is a
+        # fresh XLA compile on the CPU fallback — coverage beyond these
+        # is the calibration step's job, not the bench gate's)
+        for lanes in (2, 8):
+            pts = [cv.g1_mul(g, 3 + i) for i in range(lanes)]
+            ks = [(0x9E3779B97F4A7C15 * (i + 1)) % kzg.BLS_MODULUS
+                  for i in range(lanes)]
+            t0 = time.perf_counter()
+            dev = kzg.g1_lincomb(pts, ks, device=True)
+            compile_s = time.perf_counter() - t0
+            host = kzg.g1_lincomb(pts, ks, device=False)
+            assert dev == host, f"g1 digest mismatch at {lanes} lanes"
+            dev_rate = rate(lambda: kzg.g1_lincomb(pts, ks, device=True),
+                            min_s=0.05) * lanes
+            host_rate = rate(lambda: kzg.g1_lincomb(pts, ks,
+                                                    device=False),
+                             min_s=0.05) * lanes
+            tracks[f"g1@{lanes}"] = {
+                "device_lanes_per_s": round(dev_rate, 1),
+                "host_lanes_per_s": round(host_rate, 1),
+                "device_vs_host": round(dev_rate / max(host_rate, 1e-9),
+                                        3),
+                "first_dispatch_s": round(compile_s, 3),
+            }
+            result["stages"] = {"msm": {"tracks": dict(tracks)}}
+            _emit_partial(result)
+
+        # gather track (the pubkey-plane fold) at the 2-lane bucket
+        pts2 = [cv.g1_mul(g, 3 + i) for i in range(2)]
+        table = pubkey_kernels.build_table(pts2)
+        rows = np.arange(2, dtype=np.int64) % 2
+        scalars = (np.arange(2, dtype=np.uint64) % 7) + 1
+        groups = np.zeros(2, np.int64)
+        xa, ya, inf = pubkey_kernels.gather_fold(table, rows, scalars,
+                                                 groups, 1)
+        want = cv.INF
+        for r, s in zip(rows, scalars):
+            want = cv.g1_add(want, cv.g1_mul(pts2[int(r)], int(s)))
+        got = (int(bi.from_mont(xa[0])), int(bi.from_mont(ya[0])))
+        assert not bool(inf[0]) and got == want, "gather digest mismatch"
+
+        def host_adds():
+            acc = cv.INF
+            for r, s in zip(rows, scalars):
+                acc = cv.g1_add(acc, cv.g1_mul(pts2[int(r)], int(s)))
+            return acc
+
+        dev_rate = rate(lambda: pubkey_kernels.gather_fold(
+            table, rows, scalars, groups, 1), min_s=0.05) * 2
+        host_rate = rate(host_adds, min_s=0.05) * 2
+        tracks["gather@2"] = {
+            "device_lanes_per_s": round(dev_rate, 1),
+            "host_lanes_per_s": round(host_rate, 1),
+            "device_vs_host": round(dev_rate / max(host_rate, 1e-9), 3),
+        }
+        result.update({"stage": "host-overhead",
+                       "stages": {"msm": {"tracks": dict(tracks)}}})
+        _emit_partial(result)
+
+        # consumer-visible host-path overhead: the unified wrapper vs
+        # the raw seam the pre-refactor consumers called directly
+        pts = [cv.g1_mul(g, 3 + i) for i in range(8)]
+        ks = [(0x9E3779B97F4A7C15 * (i + 1)) % kzg.BLS_MODULUS
+              for i in range(8)]
+        direct_rate = rate(lambda: msm.host_lincomb_groups(
+            pts, ks, None, 1))
+        wrapper_rate = rate(lambda: kzg.g1_lincomb(pts, ks,
+                                                   device=False))
+        overhead = 1.0 - wrapper_rate / max(direct_rate, 1e-9)
+        assert overhead <= 0.05, \
+            f"msm_g1 wrapper costs {overhead:.1%} over the raw host " \
+            f"lincomb seam (gate: 5%)"
+        result.update({
+            "msm_host_overhead_pct": round(max(overhead, 0.0) * 100, 2),
+            "stages": {"msm": {
+                "tracks": tracks,
+                "calibration": {
+                    "cold_source": cold.get("source"),
+                    "warm_source": warm.get("source"),
+                    "thresholds": result["msm_threshold_lanes"],
+                },
+                "host_overhead": {
+                    "direct_calls_per_s": round(direct_rate, 1),
+                    "wrapper_calls_per_s": round(wrapper_rate, 1),
+                },
+            }},
+        })
+        result.pop("stage", None)
+        return result
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _bench_coldstart_run() -> dict:
     """Grandchild: ONE fresh interpreter's cold-start story.  Configures
     the AOT program store from LHTPU_AOT_STORE_DIR, runs the full
@@ -1871,6 +2024,8 @@ def _bench_coldstart_run() -> dict:
                      "load_phase", "driver_errors")},
         "calibration_source": (report.get("calibration") or {}).get(
             "source"),
+        "msm_calibration_source": (report.get("msm_calibration")
+                                   or {}).get("source"),
         "time_to_first_verify_s": {
             k: round(v, 3) for k, v in dtel.first_verify_times().items()},
         "sources": {e: s.get("sources", {}) for e, s in snap.items()},
@@ -1933,7 +2088,8 @@ def _coldstart_phases(result: dict, phase, budget: int) -> dict:
     result.update({
         "coldstart_cold": {k: cold.get(k) for k in
                            ("wall_s", "time_to_first_verify_s",
-                            "calibration_source", "prewarm")},
+                            "calibration_source",
+                            "msm_calibration_source", "prewarm")},
         "stage": "warm",
     })
     _emit_partial(result)
@@ -1942,7 +2098,8 @@ def _coldstart_phases(result: dict, phase, budget: int) -> dict:
     assert warm is not None, "warm grandchild produced no result"
     result["coldstart_warm"] = {k: warm.get(k) for k in
                                ("wall_s", "time_to_first_verify_s",
-                                "calibration_source", "prewarm")}
+                                "calibration_source",
+                                "msm_calibration_source", "prewarm")}
 
     # --- gates -------------------------------------------------------------
     cold_ttfv = (cold.get("time_to_first_verify_s") or {}).get("tpu")
@@ -1972,6 +2129,9 @@ def _coldstart_phases(result: dict, phase, budget: int) -> dict:
     assert warm.get("calibration_source") == "store", \
         f"calibration re-measured on warm start: " \
         f"{warm.get('calibration_source')}"
+    assert warm.get("msm_calibration_source") == "store", \
+        f"msm calibration re-measured on warm start: " \
+        f"{warm.get('msm_calibration_source')}"
 
     result.update({
         "coldstart_speedup": round(speedup, 1),
@@ -2745,6 +2905,8 @@ def _child_main() -> int:
         result = _bench_chaossoak()
     elif "--child-observatory" in sys.argv:
         result = _bench_observatory()
+    elif "--child-msm" in sys.argv:
+        result = _bench_msm()
     elif "--child-coldstart-run" in sys.argv:
         result = _bench_coldstart_run()
     elif "--child-coldstart" in sys.argv:
@@ -2818,7 +2980,8 @@ _CHILD_FLAGS = ("--child", "--child-kzg", "--child-merkle",
                 "--child-firehose", "--child-syncstorm",
                 "--child-fleetwatch", "--child-scrapewatch",
                 "--child-chaossoak", "--child-observatory",
-                "--child-coldstart", "--child-coldstart-run")
+                "--child-msm", "--child-coldstart",
+                "--child-coldstart-run")
 
 
 def main() -> int:
@@ -2928,6 +3091,9 @@ def main() -> int:
                 # (cold max(900, T) + warm max(300, T//2)) plus slack,
                 # or a raised LHTPU_BENCH_TIMEOUT kills the child
                 # mid-warm-phase with the gates never run
+                # msm calibration lifecycle + per-(track, bucket)
+                # rates: three cold XLA compiles on the CPU fallback
+                ("--child-msm", "msm", max(900, CHILD_TIMEOUT_S)),
                 ("--child-coldstart", "coldstart",
                  max(1500, max(900, CHILD_TIMEOUT_S)
                      + max(300, CHILD_TIMEOUT_S // 2) + 120)),

@@ -12,7 +12,7 @@ Rungs, mirroring the epoch/BLS supervisor shape (PR 4 breaker):
 
 - ``device``  — the fused gather+MSM kernel over the resident table;
 - ``sharded`` — same kernel, lanes partitioned over the device mesh
-  (parallel/pubkey_sharded);
+  (parallel/msm_sharded; LHTPU_MSM_SHARDED=0 drops the auto-pick);
 - ``reference`` — host point adds (one ``g1_mul`` per unique
   (group, pubkey) after scalar-sum collapse), the authoritative
   terminal rung.
@@ -97,8 +97,11 @@ def resolve_pubkey_backend(n_lanes: int) -> str:
 
         if jax.devices()[0].platform != "tpu":
             _AUTO_RUNG = "reference"
+        elif (len(jax.devices()) > 1
+                and envreg.get_bool("LHTPU_MSM_SHARDED", True)):
+            _AUTO_RUNG = "sharded"
         else:
-            _AUTO_RUNG = "sharded" if len(jax.devices()) > 1 else "device"
+            _AUTO_RUNG = "device"
     return _AUTO_RUNG
 
 
@@ -355,9 +358,9 @@ class PubkeyPlane:
             # so one read under the lock keeps this fold consistent
             table = self._table
         if backend == "sharded":
-            from lighthouse_tpu.parallel import pubkey_sharded
+            from lighthouse_tpu.parallel import msm_sharded
 
-            xa, ya, inf = pubkey_sharded.gather_fold_sharded(
+            xa, ya, inf = msm_sharded.gather_fold_sharded(
                 table, np.asarray(indices, np.int64),
                 np.asarray(scalars, np.uint64),
                 np.asarray(groups, np.int64), n_groups)

@@ -263,6 +263,30 @@ _register("LHTPU_PUBKEY_DEVICE_MIN", "256",
           "routing considers a device rung (smaller batches never "
           "import jax).")
 
+# -- unified MSM plane (ops/msm, parallel/msm_sharded) ------------------------
+
+_register("LHTPU_MSM_BUCKET_FLOOR", "1",
+          "Minimum pow2 lane bucket for the unified MSM plane "
+          "(ops/msm.bucket): smaller folds pad their zero-scalar tail "
+          "lanes up to it so batch composition cannot churn compiles; "
+          "rounded up to a power of two.")
+_register("LHTPU_MSM_DEVICE_MIN", None,
+          "Lane count at or above which msm_g1 auto routing picks the "
+          "device fold over the host lincomb seam; set = operator pin "
+          "for every track, unset = the persisted msm_calibration "
+          "sidecar (or the static 256 default before calibration).")
+_register("LHTPU_MSM_SHARDED", "1",
+          "0 drops the sharded MSM rung (parallel/msm_sharded) from "
+          "the pubkey-plane auto policy: multi-device TPU hosts fold "
+          "on a single device instead of partitioning lanes over the "
+          "mesh.  Forced rungs (LHTPU_PUBKEY_BACKEND=sharded) still "
+          "work.")
+_register("LHTPU_MSM_CALIBRATION", "1",
+          "0 disables MSM device-threshold calibration at prewarm: no "
+          "measurement, no msm_calibration sidecar adoption; routing "
+          "uses the static default unless LHTPU_MSM_DEVICE_MIN pins "
+          "it.")
+
 # -- device epoch processing (state_transition/epoch_processing seam,
 #    state_transition/epoch_device, ops/epoch_kernels) -------------------------
 

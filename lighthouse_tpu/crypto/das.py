@@ -22,13 +22,6 @@ for these butterflies when DAS hits the hot path).
 
 from __future__ import annotations
 
-from lighthouse_tpu.ops import program_store as _pstore
-
-# AOT program-store coverage (lhlint LH606): the chunked cell-proof MSM
-# is prewarmed by the "das" driver in ops/prewarm
-_pstore.register_entry("crypto/das.py::_batched_cell_proof_msms@_f",
-                       driver="das")
-
 from lighthouse_tpu.crypto.kzg import (
     BLS_MODULUS,
     KzgError,
@@ -247,12 +240,12 @@ def _require_monomials(settings, cell_size: int):
 
 _CELL_PROOF_FUSED_MIN_WIDTH = 256   # device-batch at production widths
 _CELL_PROOF_MAX_LANES = 1 << 17     # chunk cells to bound HBM footprint
-_CELL_PROOFS_JIT = None
 
 
 def _batched_cell_proof_msms(q_lists: list[list[int]], settings
                              ) -> list:
-    """All cells' quotient MSMs as chunked fused dispatches.
+    """All cells' quotient MSMs as chunked fused dispatches on the
+    unified MSM plane (ops/msm, plain g1 track).
 
     The per-cell loop below issues one device MSM PER CELL (128
     dispatches per blob on a proposer).  Here lanes lay out s-major
@@ -260,28 +253,13 @@ def _batched_cell_proof_msms(q_lists: list[list[int]], settings
     through ONE windowed scan + segment sum per chunk; chunk size caps
     resident lanes so the 16-entry per-lane window tables stay inside
     HBM.  Returns affine (x, y) int pairs or cv.INF per cell."""
-    import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from lighthouse_tpu.crypto.bls import curve as cv
     from lighthouse_tpu.ops import bigint as bi
-    from lighthouse_tpu.ops import cache_guard, ec
+    from lighthouse_tpu.ops import ec
+    from lighthouse_tpu.ops import msm as _msm
 
-    cache_guard.install()
-    global _CELL_PROOFS_JIT
-    if _CELL_PROOFS_JIT is None:
-        def _f(xs, ys, digits, n_seg):
-            X, Y, Z = ec.g1_scalar_mul_windowed(xs, ys, digits)
-            return ec.g1_segment_sum(X, Y, Z, n_seg)
-
-        _CELL_PROOFS_JIT = jax.jit(_f, static_argnums=(3,))
-        from lighthouse_tpu.common import device_telemetry as _dtel
-
-        _CELL_PROOFS_JIT = _dtel.instrument(
-            "crypto/das.py::_batched_cell_proof_msms@_f", _CELL_PROOFS_JIT)
-
-    seg_pad = 1 << max(len(q_lists[0]) - 1, 0).bit_length()
+    seg_pad = _msm.bucket(len(q_lists[0]))
     chunk = max(1, _CELL_PROOF_MAX_LANES // seg_pad)
     chunk = 1 << (chunk.bit_length() - 1)   # floor to a power of two
     mono = settings.g1_monomial[:seg_pad] + [None] * max(
@@ -294,7 +272,7 @@ def _batched_cell_proof_msms(q_lists: list[list[int]], settings
     for c0 in range(0, len(q_lists), chunk):
         qs = q_lists[c0:c0 + chunk]
         g = len(qs)
-        g_pad = 1 << max(g - 1, 0).bit_length()
+        g_pad = _msm.bucket(g)
         lanes = seg_pad * g_pad
         xs = np.zeros((lanes, bi.L), np.uint32)
         ys = np.zeros((lanes, bi.L), np.uint32)
@@ -308,19 +286,9 @@ def _batched_cell_proof_msms(q_lists: list[list[int]], settings
                     xs[base + gi] = row_x
                     ys[base + gi] = row_y
                     scalars[base + gi] = k
-        digits = jnp.asarray(ec.scalars_to_digits(scalars, n_bits=256))
-        X, Y, Z = jax.device_get(_CELL_PROOFS_JIT(
-            jnp.asarray(xs), jnp.asarray(ys), digits, g_pad))
-        for gi in range(g):
-            z = int(bi.from_mont(np.asarray(Z[gi])))
-            if z == 0:
-                out.append(cv.INF)
-                continue
-            x = int(bi.from_mont(np.asarray(X[gi])))
-            y = int(bi.from_mont(np.asarray(Y[gi])))
-            zi = pow(z, -1, cv.P)
-            out.append((x * zi * zi % cv.P,
-                        y * zi * zi % cv.P * zi % cv.P))
+        digits = ec.scalars_to_digits(scalars, n_bits=256)
+        X, Y, Z = _msm.fold_device(xs, ys, digits, g_pad)
+        out.extend(_msm.jacobian_rows_to_affine(X[:g], Y[:g], Z[:g]))
     return out
 
 

@@ -6,9 +6,9 @@ et al.), math per the consensus specs' polynomial-commitments.md, riding
 this repo's own BLS12-381 core:
 
 - commitments / proofs are multi-scalar multiplications over the
-  Lagrange-basis setup points — batched on device (windowed scan,
-  ops/ec.g1_msm_windowed) for production sizes, with a host Jacobian
-  path for tiny dev setups;
+  Lagrange-basis setup points — routed through the unified MSM plane
+  (ops/msm.msm_g1: calibrated device threshold, native/pure-Python
+  host seam for tiny dev setups);
 - single-proof verification is ONE multi-pairing on the batched device
   Miller loop (ops/bls12_381.multi_pairing_device);
 - `verify_blob_kzg_proof_batch` folds n proofs into a single 2-pairing
@@ -34,10 +34,9 @@ from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.crypto.bls import curve as cv
 from lighthouse_tpu.ops import program_store as _pstore
 
-# AOT program-store coverage (lhlint LH606): the MSM and fused
-# verification programs are prewarmed by the "kzg" driver in ops/prewarm
-_pstore.register_entry("crypto/kzg.py::_msm_device@ec.g1_msm_windowed",
-                       driver="kzg")
+# AOT program-store coverage (lhlint LH606): the fused verification
+# program is prewarmed by the "kzg" driver in ops/prewarm; the plain
+# MSM rides the unified plane's entry (ops/msm.py, "msm" driver)
 _pstore.register_entry("crypto/kzg.py::_kzg_fused_check@_kzg_fused",
                        driver="kzg")
 from lighthouse_tpu.crypto.bls.fields import R as BLS_MODULUS
@@ -47,10 +46,6 @@ KZG_ENDIANNESS = "big"
 FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
 RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
 PRIMITIVE_ROOT_OF_UNITY = 7
-
-# below this many MSM lanes the device dispatch + compile isn't worth it
-_DEVICE_MSM_MIN = 256
-
 
 class KzgError(ValueError):
     pass
@@ -231,66 +226,16 @@ def compute_challenge(blob: bytes, commitment: bytes, settings: KzgSettings) -> 
 
 # --- MSM --------------------------------------------------------------------
 
-def _msm_host(points, scalars):
-    acc = cv.INF
-    for p, k in zip(points, scalars):
-        if k == 0 or p is cv.INF:
-            continue
-        acc = cv.g1_add(acc, cv.g1_mul(p, k))
-    return acc
-
-
-_MSM_JIT = None  # jax.jit caches per input shape internally
-
-
-def _msm_device(points, scalars, pad_to: int | None = None):
-    import jax
-    import jax.numpy as jnp
-
-    from lighthouse_tpu.crypto.bls.fields import P
-    from lighthouse_tpu.ops import bigint as bi
-    from lighthouse_tpu.ops import ec
-
-    n = len(points)
-    padded = 1 << max(n - 1, 0).bit_length()
-    if pad_to is not None:
-        padded = max(padded, pad_to)  # share one compiled MSM shape
-    # infinity inputs get zero scalars (identity lanes)
-    xs, ys, ks = [], [], []
-    for p, k in zip(points, scalars):
-        if p is cv.INF:
-            xs.append(0); ys.append(0); ks.append(0)
-        else:
-            xs.append(p[0]); ys.append(p[1]); ks.append(k % BLS_MODULUS)
-    xs += [0] * (padded - n)
-    ys += [0] * (padded - n)
-    ks += [0] * (padded - n)
-    xp = ec.ints_to_mont_limbs(xs)
-    yp = ec.ints_to_mont_limbs(ys)
-    bits = ec.scalars_to_digits(ks, n_bits=256)
-
-    global _MSM_JIT
-    if _MSM_JIT is None:
-        _MSM_JIT = jax.jit(ec.g1_msm_windowed)
-        _MSM_JIT = _dtel.instrument(
-            "crypto/kzg.py::_msm_device@ec.g1_msm_windowed", _MSM_JIT)
-    X, Y, Z = _MSM_JIT(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(bits))
-    x, y, z = (int(bi.from_mont(np.asarray(c))) for c in (X, Y, Z))
-    if z == 0:
-        return cv.INF
-    zi = pow(z, -1, P)
-    return (x * zi * zi % P, y * pow(zi, 3, P) % P)
-
-
 def g1_lincomb(points, scalars, *, device: bool | None = None,
                pad_to: int | None = None):
-    """Σ k_i·P_i (the c-kzg g1_lincomb seam).  `pad_to` rounds the lane
-    count up so differently-sized MSMs share one compiled program."""
-    use_device = (device if device is not None
-                  else len(points) >= _DEVICE_MSM_MIN)
-    if use_device:
-        return _msm_device(points, scalars, pad_to=pad_to)
-    return _msm_host(points, scalars)
+    """Σ k_i·P_i (the c-kzg g1_lincomb seam), riding the unified MSM
+    plane (ops/msm): device routing by the calibrated g1-track
+    threshold, host fallback through the native lincomb seam.  `pad_to`
+    rounds the lane count up so differently-sized MSMs share one
+    compiled program."""
+    from lighthouse_tpu.ops import msm as _msm
+
+    return _msm.msm_g1(points, scalars, device=device, pad_to=pad_to)
 
 
 # --- core KZG ---------------------------------------------------------------
@@ -470,6 +415,7 @@ def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
 
     from lighthouse_tpu.ops import bigint as bi
     from lighthouse_tpu.ops import ec
+    from lighthouse_tpu.ops import msm as _msm
     from lighthouse_tpu.ops.bls12_381 import (
         batch_miller_loop,
         fq12_from_device,
@@ -483,8 +429,7 @@ def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
     global _KZG_FUSED_JIT
     if _KZG_FUSED_JIT is None:
         def _kzg_fused(xs, ys, digits, xqa, xqb, yqa, yqb):
-            X, Y, Z = ec.g1_scalar_mul_windowed(xs, ys, digits)
-            Xg, Yg, Zg = ec.g1_segment_sum(X, Y, Z, 2)
+            Xg, Yg, Zg = _msm.fold_segments_g1(xs, ys, digits, 2)
             ok = ~bi.is_zero_mod_p_device(Zg)
             f = batch_miller_loop(Xg, Yg, xqa, xqb, yqa, yqb, zp=Zg)
             return reduce_product(f, ok)
@@ -493,7 +438,7 @@ def _kzg_fused_check(lhs_points, lhs_scalars, pis, r_pows,
         _KZG_FUSED_JIT = _dtel.instrument(
             "crypto/kzg.py::_kzg_fused_check@_kzg_fused", _KZG_FUSED_JIT)
 
-    m = 1 << max(len(lhs_points) - 1, 0).bit_length()
+    m = _msm.bucket(len(lhs_points))
 
     def lane_arrays(points, scalars):
         xs, ys, ks = [], [], []

@@ -50,13 +50,12 @@ _pstore.register_entry(
 _pstore.register_entry(
     "ops/bls_backend.py::_g1_subgroup_kernel@_g1_subgroup_kernel",
     driver="bls")
-_pstore.register_entry(
-    "ops/bls_backend.py::_aggregate_kernel@_aggregate_kernel", driver="bls")
 _pstore.register_entry("ops/bls_backend.py::<module>@final_exp_hard_device",
                        driver="pairing")
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import cache_guard
 from lighthouse_tpu.ops import ec
+from lighthouse_tpu.ops import msm as _msm
 from lighthouse_tpu.ops import faults
 from lighthouse_tpu.ops.bls12_381 import (
     batch_miller_loop,
@@ -147,11 +146,8 @@ def _pipeline_fused(pkx, pky, sxa, sxb, sya, syb,
     both run through ONE merged windowed scan (4 bits per step from
     16-entry Jacobian tables, shared mul-queue rounds — ~2.5x fewer
     sequential rounds than the two binary scans it replaces)."""
-    (Xp, Yp, Zp), (SX, SY, SZ) = ec.gj_scalar_mul_windowed(
-        pkx, pky, (sxa, sxb), (sya, syb), bits)
-    if n_groups:
-        Xp, Yp, Zp = ec.g1_segment_sum(Xp, Yp, Zp, n_groups)
-    SX, SY, SZ = ec.g2_sum_reduce(SX, SY, SZ)
+    (Xp, Yp, Zp), (SX, SY, SZ) = _msm.fold_segments_gj(
+        pkx, pky, (sxa, sxb), (sya, syb), bits, n_groups)
     sum_ok = ~(bi.is_zero_mod_p_device(SZ[0])
                & bi.is_zero_mod_p_device(SZ[1]))
     one = jnp.broadcast_to(bi._jconst("one_m"), (1, bi.L))
@@ -219,7 +215,7 @@ _g1_subgroup_kernel = _dtel.instrument(
 
 
 def _next_pow2(x: int, floor: int = 1) -> int:
-    return max(floor, 1 << max(x - 1, 0).bit_length())
+    return _msm.bucket(x, floor=floor)
 
 
 def _grouped_layout(n: int, n_groups: int,
@@ -242,26 +238,6 @@ def _grouped_layout(n: int, n_groups: int,
         if seg >= max_sz:
             return seg, g_pad, padded_flat
     return None, g_pad, padded_flat
-
-
-@partial(jax.jit, static_argnums=(5,))
-def _aggregate_kernel(X, Y, Z, ux, uy, n_sets):
-    """Segmented G1 sum over (pubkey + blinding) lanes, minus the
-    blinding total, then affine conversion.  The infinity flag (Z ≡ 0)
-    is resolved on device — one bool row home, not a limb row."""
-    Xg, Yg, Zg = ec.g1_segment_sum(X, Y, Z, n_sets)
-    one = jnp.broadcast_to(bi._jconst("one_m"), Xg.shape)
-    Xr, Yr, Zr = ec._jac_add_full(
-        ec._FpAdapter, (Xg, Yg, Zg),
-        (jnp.broadcast_to(ux, Xg.shape), jnp.broadcast_to(uy, Yg.shape),
-         one))
-    xa, ya = ec.g1_jacobian_to_affine_batch(Xr, Yr, Zr)
-    return xa, ya, bi.is_zero_mod_p_device(Zr)
-
-
-_aggregate_kernel = _dtel.instrument(
-    "ops/bls_backend.py::_aggregate_kernel@_aggregate_kernel",
-    _aggregate_kernel)
 
 
 # blinding pool: lane j carries B_j = [u_j]G alongside the pubkeys, and
@@ -339,9 +315,8 @@ def aggregate_pubkeys_device(sets):
         X[lanes] = bx
         Y[lanes] = by
         Z[lanes] = one
-    xa, ya, inf = jax.device_get(_aggregate_kernel(
-        jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z),
-        neg_total[0], neg_total[1], n_pad))
+    xa, ya, inf = jax.device_get(_msm.blinded_fold_device(
+        X, Y, Z, neg_total[0], neg_total[1], n_pad))
     return xa[:n], ya[:n], inf[:n]
 
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from lighthouse_tpu.common import env as envreg
 
-_GUARDED_NAMES = ("_pipeline_fused", "_kzg_fused", "_aggregate_kernel")
+_GUARDED_NAMES = ("_pipeline_fused", "_kzg_fused", "_blinded_fold")
 _MAP_TARGET = 262144
 _MAP_PATH = "/proc/sys/vm/max_map_count"
 

@@ -46,12 +46,14 @@ from lighthouse_tpu.common import flight_recorder as _flight
 from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
 from lighthouse_tpu.ops import program_store
 
-#: driver priority, the ISSUE 12 order: BLS verify lanes first (a
-#: production client must verify its first block), then the merkle
-#: hashers, the blob planes, the epoch pass, the shuffle, and the
-#: multichip dryrun fold last
-DRIVER_ORDER = ("bls", "pairing", "sharded", "pubkey", "sha256", "kzg",
-                "fr", "das", "epoch", "shuffle", "dryrun")
+#: driver priority, the ISSUE 12 order amended by ISSUE 17: the unified
+#: MSM plane loads FIRST (the BLS verify driver dispatches its blinded
+#: fold internally, so its programs must be resident by then), then the
+#: BLS verify lanes (a production client must verify its first block),
+#: the merkle hashers, the blob planes, the epoch pass, the shuffle,
+#: and the multichip dryrun fold last
+DRIVER_ORDER = ("msm", "bls", "pairing", "sharded", "sha256", "kzg",
+                "fr", "epoch", "shuffle", "dryrun")
 
 
 def _import_owners() -> None:
@@ -61,7 +63,7 @@ def _import_owners() -> None:
     from lighthouse_tpu.crypto import das, kzg  # noqa: F401
     from lighthouse_tpu.ops import (  # noqa: F401
         bls12_381, bls_backend, dispatch_pipeline, epoch_kernels, fr,
-        pubkey_kernels, sha256)
+        msm, pubkey_kernels, sha256)
     from lighthouse_tpu.parallel import (  # noqa: F401
         bls_sharded, dryrun_worker)
 
@@ -180,18 +182,30 @@ def _drv_sharded(scale: str) -> None:
         raise RuntimeError("prewarm sharded verify rejected")
 
 
-def _drv_pubkey(scale: str) -> None:
-    """The ingest pubkey plane's fused gather+MSM at its fold bucket,
-    with a host-point-math sanity gate (a mis-prewarmed program must
-    never serve committee aggregates)."""
+def _drv_msm(scale: str) -> None:
+    """The unified MSM plane (ops/msm): every track's program at its
+    prewarm bucket — the plain g1 fold (kzg lincomb + das cell-proof
+    chunk shapes), the fused gather fold, and the blinded merge — each
+    gated by host point math (a mis-prewarmed program must never serve
+    commitments or committee aggregates)."""
     import numpy as np
 
+    from lighthouse_tpu.crypto import das, kzg
     from lighthouse_tpu.crypto.bls import curve as cv
     from lighthouse_tpu.ops import bigint as bi
-    from lighthouse_tpu.ops import pubkey_kernels
+    from lighthouse_tpu.ops import bls_backend, pubkey_kernels
 
-    lanes = 64 if scale == "production" else 2
+    # plain g1 track at the lincomb/calibration bucket
     pts = [cv.g1_mul(cv.g1_generator(), 3 + i) for i in range(2)]
+    got = kzg.g1_lincomb(pts, [3, 5], device=True)
+    want = cv.g1_add(cv.g1_mul(pts[0], 3), cv.g1_mul(pts[1], 5))
+    if got != want:
+        raise RuntimeError("prewarmed g1 fold mismatches host adds")
+    # the das cell-proof chunk shape rides the same program
+    das._batched_cell_proof_msms([[1, 2], [3, 4]],
+                                 kzg.KzgSettings.dev(width=16))
+    # gather track over a tiny resident table
+    lanes = 64 if scale == "production" else 2
     table = pubkey_kernels.build_table(pts)
     rows = np.arange(lanes, dtype=np.int64) % 2
     scalars = (np.arange(lanes, dtype=np.uint64) % 7) + 1
@@ -203,7 +217,18 @@ def _drv_pubkey(scale: str) -> None:
         want = cv.g1_add(want, cv.g1_mul(pts[int(r)], int(s)))
     got = (int(bi.from_mont(xa[0])), int(bi.from_mont(ya[0])))
     if bool(inf[0]) or got != want:
-        raise RuntimeError("prewarmed pubkey fold mismatches host adds")
+        raise RuntimeError("prewarmed gather fold mismatches host adds")
+    # blinded-merge track via the per-set aggregation front end
+    sets = _fresh_sets(2, n_keys=2, tag=b"msm")
+    bx, by, binf = bls_backend.aggregate_pubkeys_device(sets)
+    for i, s in enumerate(sets):
+        want = cv.INF
+        for pk in s.pubkeys:
+            want = cv.g1_add(want, pk.point)
+        got = (int(bi.from_mont(bx[i])), int(bi.from_mont(by[i])))
+        if bool(binf[i]) or got != want:
+            raise RuntimeError(
+                "prewarmed blinded fold mismatches host adds")
 
 
 def _drv_sha256(scale: str) -> None:
@@ -248,11 +273,10 @@ def _kzg_blob(settings, seed: int) -> bytes:
 
 def _drv_kzg(scale: str) -> None:
     from lighthouse_tpu.crypto import kzg
-    from lighthouse_tpu.crypto.bls import curve as cv
 
     width = 64 if scale == "production" else 16
     settings = kzg.KzgSettings.dev(width=width)
-    kzg.g1_lincomb([cv.g1_generator()] * 2, [3, 5], device=True)
+    # the 2-lane device lincomb itself is prewarmed by the msm driver
     n = kzg._DEVICE_EVAL_MIN
     blobs = [_kzg_blob(settings, 40 + i) for i in range(n)]
     cs = [kzg.blob_to_kzg_commitment(b, settings) for b in blobs]
@@ -275,13 +299,6 @@ def _drv_fr(scale: str) -> None:
     raw = np.stack([np.stack([fr_ops._int_to_limbs(v) for v in p])
                     for p in polys])
     fr_ops.evaluate_polynomials_batch(raw, [11, 13], settings.roots_brp)
-
-
-def _drv_das(scale: str) -> None:
-    from lighthouse_tpu.crypto import das, kzg
-
-    das._batched_cell_proof_msms([[1, 2], [3, 4]],
-                                 kzg.KzgSettings.dev(width=16))
 
 
 def _drv_epoch(scale: str) -> None:
@@ -318,14 +335,13 @@ def _drv_dryrun(scale: str) -> None:
 
 
 _DRIVERS = {
+    "msm": _drv_msm,
     "bls": _drv_bls,
     "pairing": _drv_pairing,
     "sharded": _drv_sharded,
-    "pubkey": _drv_pubkey,
     "sha256": _drv_sha256,
     "kzg": _drv_kzg,
     "fr": _drv_fr,
-    "das": _drv_das,
     "epoch": _drv_epoch,
     "shuffle": _drv_shuffle,
     "dryrun": _drv_dryrun,
@@ -361,6 +377,40 @@ def _calibrate_into(report: dict) -> None:
         record_swallowed("prewarm.calibration", e)
         report["calibration"] = {"source": "failed",
                                  "error": f"{type(e).__name__}: {e}"}
+
+
+def msm_calibration_step() -> dict:
+    """Load the persisted MSM device-threshold calibration for this
+    fingerprint, or measure once and persist it (its own sidecar record
+    next to the sha one).  An explicit LHTPU_MSM_DEVICE_MIN pin
+    bypasses both, and LHTPU_MSM_CALIBRATION=0 disables measurement
+    entirely (static defaults serve)."""
+    from lighthouse_tpu.ops import msm as msm_ops
+
+    if envreg.get_int("LHTPU_MSM_DEVICE_MIN") is not None:
+        return {"source": "env",
+                **msm_ops.calibrate_device_thresholds()}
+    if envreg.get_bool("LHTPU_MSM_CALIBRATION", True) is False:
+        return {"source": "disabled"}
+    stored = program_store.load_calibration(
+        record=program_store.MSM_CALIBRATION_RECORD)
+    if stored is not None and msm_ops.apply_calibration(stored):
+        return {**stored, "source": "store"}
+    measured = msm_ops.calibrate_device_thresholds(force=True)
+    program_store.save_calibration(
+        measured, record=program_store.MSM_CALIBRATION_RECORD)
+    return {"source": "measured", **measured}
+
+
+def _msm_calibrate_into(report: dict) -> None:
+    """One MSM calibration attempt recorded into the report (a failure
+    is accounted, never fatal to the walk)."""
+    try:
+        report["msm_calibration"] = msm_calibration_step()
+    except Exception as e:
+        record_swallowed("prewarm.msm_calibration", e)
+        report["msm_calibration"] = {"source": "failed",
+                                     "error": f"{type(e).__name__}: {e}"}
 
 
 # -- the prewarm walk ---------------------------------------------------------
@@ -447,6 +497,12 @@ def run(stop_event=None, force: bool = False) -> dict:
         # program loads — exactly the cold-start budget the warm run is
         # judged on
         load_group(set(entries))
+        if driver == "msm" and "msm_calibration" not in report:
+            # MSM calibration gates the lincomb/fold routing every
+            # consumer (including the BLS driver's blinded merge) uses;
+            # its 2-lane measurement dispatch reuses the programs the
+            # load_group above just made resident
+            _msm_calibrate_into(report)
         if driver == "sha256" and not calibrated:
             # calibration gates the sha routing the merkle driver (and
             # everything after it) uses
@@ -497,6 +553,8 @@ def run(stop_event=None, force: bool = False) -> dict:
             if d in DRIVER_ORDER})
     if "calibration" not in report:
         _calibrate_into(report)
+    if "msm_calibration" not in report:
+        _msm_calibrate_into(report)
     report["load_phase"] = load_phase
 
     report.update({

@@ -84,6 +84,7 @@ def _envelope():
 PAYLOAD_VERSION = 1
 FILE_SUFFIX = ".aotx"
 CALIBRATION_RECORD = "sha_calibration"
+MSM_CALIBRATION_RECORD = "msm_calibration"
 
 # -- declarative entry registry (lhlint LH606) --------------------------------
 #
@@ -432,23 +433,26 @@ class ProgramStore:
                 rec["_path"] = str(path)
                 yield rec
 
-    # -- calibration sidecar (sha256 device thresholds) -------------------
+    # -- calibration sidecars (sha256 / msm device thresholds) ------------
 
-    def _calibration_path(self) -> pathlib.Path:
-        return self.fpdir() / "sha_calibration.json"
+    def _calibration_path(
+            self, record: str = CALIBRATION_RECORD) -> pathlib.Path:
+        return self.fpdir() / f"{record}.json"
 
-    def save_calibration(self, data: dict) -> bool:
+    def save_calibration(self, data: dict,
+                         record: str = CALIBRATION_RECORD) -> bool:
         try:
             self._atomic_write(
-                self._calibration_path(),
+                self._calibration_path(record),
                 _envelope().wrap(json.dumps(data, sort_keys=True).encode()))
             return True
         except (OSError, TypeError, ValueError) as e:
             record_swallowed("program_store.calibration_save", e)
             return False
 
-    def load_calibration(self) -> dict | None:
-        path = self._calibration_path()
+    def load_calibration(
+            self, record: str = CALIBRATION_RECORD) -> dict | None:
+        path = self._calibration_path(record)
         try:
             raw = path.read_bytes()
         except FileNotFoundError:
@@ -458,15 +462,15 @@ class ProgramStore:
             return None
         env = _envelope()
         try:
-            data = json.loads(env.unwrap(raw, what=CALIBRATION_RECORD))
+            data = json.loads(env.unwrap(raw, what=record))
             if not isinstance(data, dict):
                 raise env.StoreCorruptionError(
-                    f"{CALIBRATION_RECORD}: not a measurement object")
+                    f"{record}: not a measurement object")
             return data
         except (env.StoreCorruptionError, ValueError) as e:
             record_swallowed("program_store.calibration_corrupt", e)
             _record_miss("corrupt")
-            _flight.emit("aot_store_corrupt", record=CALIBRATION_RECORD,
+            _flight.emit("aot_store_corrupt", record=record,
                          error=f"{type(e).__name__}: {e}"[:200])
             self._quarantine(path)
             return None
@@ -771,11 +775,12 @@ def load_store_programs(priority=None, stop=None, entries=None,
 # -- calibration facade -------------------------------------------------------
 
 
-def save_calibration(data: dict) -> bool:
+def save_calibration(data: dict, record: str = CALIBRATION_RECORD) -> bool:
     st = _STATE
-    return st.store.save_calibration(data) if st is not None else False
+    return (st.store.save_calibration(data, record)
+            if st is not None else False)
 
 
-def load_calibration() -> dict | None:
+def load_calibration(record: str = CALIBRATION_RECORD) -> dict | None:
     st = _STATE
-    return st.store.load_calibration() if st is not None else None
+    return st.store.load_calibration(record) if st is not None else None

@@ -24,43 +24,21 @@ groups to powers of two so batch composition cannot churn compiles.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lighthouse_tpu.common import device_telemetry as _dtel
 from lighthouse_tpu.ops import bigint as bi
 from lighthouse_tpu.ops import cache_guard, ec
-from lighthouse_tpu.ops import program_store as _pstore
+from lighthouse_tpu.ops import msm as _msm
 
-_pstore.register_entry(
-    "ops/pubkey_kernels.py::_gather_fold_kernel@_gather_fold_kernel",
-    driver="pubkey")
-
-
-@partial(jax.jit, static_argnums=(4,))
-def _gather_fold_kernel(tx, ty, lane_idx, digits, n_groups):
-    """tx/ty: uint32[T, L] device-resident affine Montgomery table;
-    lane_idx: int32[S*G] s-major lane -> table row; digits: uint32[W,
-    S*G] blinder window digits (zero digits = padding lane = identity);
-    -> (x rows, y rows, identity flags) per group."""
-    xp = jnp.take(tx, lane_idx, axis=0)
-    yp = jnp.take(ty, lane_idx, axis=0)
-    X, Y, Z = ec.g1_scalar_mul_windowed(xp, yp, digits)
-    Xg, Yg, Zg = ec.g1_segment_sum(X, Y, Z, n_groups)
-    xa, ya = ec.g1_jacobian_to_affine_batch(Xg, Yg, Zg)
-    return xa, ya, bi.is_zero_mod_p_device(Zg)
-
-
-_gather_fold_kernel = _dtel.instrument(
-    "ops/pubkey_kernels.py::_gather_fold_kernel@_gather_fold_kernel",
-    _gather_fold_kernel)
+# the fused gather+fold program itself lives on the unified MSM plane
+# (ops/msm._gather_fold, "msm" prewarm driver); this module keeps the
+# registry-table residency and the host lane layout
 
 
 def _next_pow2(x: int, floor: int = 1) -> int:
-    return max(floor, 1 << max(x - 1, 0).bit_length())
+    return _msm.bucket(x, floor=floor)
 
 
 def mont_rows(points) -> tuple:
@@ -109,7 +87,7 @@ def gather_fold(table, row_of_lane: np.ndarray, scalars: np.ndarray,
     Lanes are laid out s-major over padded (segment, group) geometry so
     the jit shape is a pure function of (lanes_pow2, groups_pow2).
     ``shardings=(lane_sh, table_sh)`` places lanes over a mesh and
-    replicates the table (the parallel/pubkey_sharded rung)."""
+    replicates the table (the parallel/msm_sharded rung)."""
     cache_guard.install()   # mmap headroom before any XLA compile
     n = len(row_of_lane)
     if n == 0 or n_groups == 0:
@@ -147,7 +125,7 @@ def gather_fold(table, row_of_lane: np.ndarray, scalars: np.ndarray,
             digits_j, NamedSharding(mesh, P(None, *lane_sh.spec)))
         tx = jax.device_put(tx, tbl_sh)
         ty = jax.device_put(ty, tbl_sh)
-    xa, ya, inf = jax.device_get(_gather_fold_kernel(
+    xa, ya, inf = jax.device_get(_msm.gather_fold_device(
         tx, ty, lane_idx_j, digits_j, g_pad))
     return np.asarray(xa)[:n_groups], np.asarray(ya)[:n_groups], \
         np.asarray(inf)[:n_groups]

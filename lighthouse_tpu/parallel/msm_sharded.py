@@ -1,12 +1,14 @@
-"""Pubkey-plane gather+MSM sharded over the device mesh.
+"""The MSM plane's sharded mesh rung: folds partitioned over devices.
 
-Same model as parallel/epoch_sharded: the fold is pure lane
-parallelism (each lane multiplies its own gathered table row by its
-own blinder; the segment tree only combines lanes of one group), so
-the lanes partition over a pow2 1-D mesh with the resident table
-replicated, and GSPMD splits the one fused program — no second kernel,
-no per-device re-padding (the plane's pow2 lane/group padding always
-covers a pow2 mesh).
+Same model as parallel/epoch_sharded: a windowed MSM fold is pure lane
+parallelism (each lane multiplies its own point by its own scalar; the
+segment tree only combines lanes of one group), so the lanes partition
+over a pow2 1-D mesh with any resident table replicated, and GSPMD
+splits the one fused program — no second kernel, no per-device
+re-padding (ops/msm's pow2 lane/group buckets always cover a pow2
+mesh).  This replaces the per-consumer sharding that lived in
+parallel/pubkey_sharded: every gather-track consumer (today the pubkey
+plane; LHTPU_MSM_SHARDED gates its auto-pick) shares this one rung.
 """
 
 from __future__ import annotations
@@ -18,7 +20,7 @@ import jax
 from lighthouse_tpu.ops import pubkey_kernels
 
 
-def pubkey_mesh(n_devices: int | None = None):
+def msm_mesh(n_devices: int | None = None):
     """A pow2-sized 1-D mesh over the available devices."""
     from jax.sharding import Mesh
 
@@ -37,7 +39,7 @@ def gather_fold_sharded(table, row_of_lane: np.ndarray,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if mesh is None:
-        mesh = pubkey_mesh()
+        mesh = msm_mesh()
     lane_sh = NamedSharding(mesh, P("data"))
     tbl_sh = NamedSharding(mesh, P())
     return pubkey_kernels.gather_fold(
@@ -45,4 +47,4 @@ def gather_fold_sharded(table, row_of_lane: np.ndarray,
         shardings=(lane_sh, tbl_sh))
 
 
-__all__ = ["gather_fold_sharded", "pubkey_mesh"]
+__all__ = ["gather_fold_sharded", "msm_mesh"]

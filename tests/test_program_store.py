@@ -519,6 +519,8 @@ def test_prewarm_accounts_unknown_driver_tags(store, monkeypatch):
     monkeypatch.setattr(prewarm, "_resolve_scale", lambda: "tiny")
     monkeypatch.setattr(prewarm, "calibration_step", lambda: {
         "source": "env"})
+    monkeypatch.setattr(prewarm, "msm_calibration_step", lambda: {
+        "source": "env"})
     import lighthouse_tpu.ops.cache_guard as cg
 
     monkeypatch.setattr(cg, "install", lambda: None)

@@ -54,6 +54,7 @@ BACKEND_OWNERS = {
     "ops/sha256.py": "sha256",
     "ops/epoch_kernels.py": "epoch",
     "ops/pubkey_kernels.py": "pubkey",
+    "ops/msm.py": "msm",
     "parallel/epoch_sharded.py": "epoch.sharded",
     "state_transition/epoch_device.py": "epoch",
     "crypto/kzg.py": "kzg",

@@ -90,6 +90,8 @@ FAMILY_OWNERS = {
     # edge counts, the simulator the node stop/kill/restart lifecycle
     "chaos_": "lighthouse_tpu/chain/chaos.py",
     "node_lifecycle_": "lighthouse_tpu/simulator.py",
+    # the unified MSM plane (ISSUE 17) owns its routing gauges
+    "msm_": "lighthouse_tpu/ops/msm.py",
 }
 
 
