@@ -466,7 +466,7 @@ _WARNED_UNPARSEABLE: set[str] = set()
 def _warn_unparseable(name: str, val: str, expected: str) -> None:
     if name in _WARNED_UNPARSEABLE:
         return
-    _WARNED_UNPARSEABLE.add(name)
+    _WARNED_UNPARSEABLE.add(name)  # lhlint: allow(LH1003) — warn-once set: GIL-atomic add; a lost race costs one duplicate stderr line
     import sys
 
     print(f"lighthouse_tpu: ignoring unparseable {name}={val!r} "

@@ -112,48 +112,65 @@ class FlightRecorder:
         self.last_dump: dict | None = None
         self._dump_paths: deque[str] = deque()
         self._counter_memo: dict = {}
+        # leaf locks (never nested with self._lock, which is held on
+        # the emit path when the memoized counters get built): one for
+        # the labeled-child memo, one for the dump-rotation deque —
+        # both are touched from every producer thread in the process
+        self._memo_lock = threading.Lock()
+        self._dump_lock = threading.Lock()
 
     # -- accounting helpers (memoized labeled children) ---------------------
 
     def _count_event(self, kind: str) -> None:
         child = self._counter_memo.get(("event", kind))
         if child is None:
-            try:
-                child = REGISTRY.counter(
-                    "flight_events_total",
-                    "flight-recorder events by kind").labels(kind=kind)
-            except Exception as e:
-                record_swallowed("flight.counter", e)
-                return
-            self._counter_memo[("event", kind)] = child
+            with self._memo_lock:
+                child = self._counter_memo.get(("event", kind))
+                if child is None:
+                    try:
+                        child = REGISTRY.counter(
+                            "flight_events_total",
+                            "flight-recorder events by kind",
+                        ).labels(kind=kind)
+                    except Exception as e:
+                        record_swallowed("flight.counter", e)
+                        return
+                    self._counter_memo[("event", kind)] = child
         child.inc()
 
     def _count_evicted(self) -> None:
         child = self._counter_memo.get("evicted")
         if child is None:
-            try:
-                child = REGISTRY.counter(
-                    "flight_evicted_total",
-                    "flight-recorder events rotated out by the ring "
-                    "bound")
-            except Exception as e:
-                record_swallowed("flight.counter", e)
-                return
-            self._counter_memo["evicted"] = child
+            with self._memo_lock:
+                child = self._counter_memo.get("evicted")
+                if child is None:
+                    try:
+                        child = REGISTRY.counter(
+                            "flight_evicted_total",
+                            "flight-recorder events rotated out by the "
+                            "ring bound")
+                    except Exception as e:
+                        record_swallowed("flight.counter", e)
+                        return
+                    self._counter_memo["evicted"] = child
         child.inc()
 
     def _count_trip(self, reason: str) -> None:
         child = self._counter_memo.get(("trip", reason))
         if child is None:
-            try:
-                child = REGISTRY.counter(
-                    "flight_trips_total",
-                    "flight-recorder trip conditions fired, by reason",
-                ).labels(reason=reason)
-            except Exception as e:
-                record_swallowed("flight.counter", e)
-                return
-            self._counter_memo[("trip", reason)] = child
+            with self._memo_lock:
+                child = self._counter_memo.get(("trip", reason))
+                if child is None:
+                    try:
+                        child = REGISTRY.counter(
+                            "flight_trips_total",
+                            "flight-recorder trip conditions fired, "
+                            "by reason",
+                        ).labels(reason=reason)
+                    except Exception as e:
+                        record_swallowed("flight.counter", e)
+                        return
+                    self._counter_memo[("trip", reason)] = child
         child.inc()
 
     # -- the ring ------------------------------------------------------------
@@ -269,9 +286,12 @@ class FlightRecorder:
                 json.dump(dump, fh, indent=1)
             os.replace(tmp, path)
             dump["path"] = path
-            self._dump_paths.append(path)
-            while len(self._dump_paths) > self.max_dumps:
-                old = self._dump_paths.popleft()
+            stale: list[str] = []
+            with self._dump_lock:
+                self._dump_paths.append(path)
+                while len(self._dump_paths) > self.max_dumps:
+                    stale.append(self._dump_paths.popleft())
+            for old in stale:   # unlink outside the lock: disk I/O
                 try:
                     os.remove(old)
                 except OSError:
@@ -307,8 +327,10 @@ class FlightRecorder:
             "LHTPU_FLIGHT_SPAN_MS", 50.0) or 0.0)
         self.max_dumps = max(1, envreg.get_int("LHTPU_FLIGHT_DUMPS", 8) or 8)
         cap = max(16, envreg.get_int("LHTPU_FLIGHT_CAPACITY", 512) or 512)
-        if cap != self.capacity:
-            with self._lock:
+        with self._lock:
+            # check INSIDE the hold: a concurrent reconfigure between a
+            # bare check and the rebuild would rebuild the ring twice
+            if cap != self.capacity:
                 self.capacity = cap
                 self._ring = deque(self._ring, maxlen=cap)
 

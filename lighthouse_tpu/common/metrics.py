@@ -325,7 +325,7 @@ def record_swallowed(site: str, exc: BaseException) -> None:
         pass  # the terminal sink: accounting must never re-raise (routing
         # the failure back through record_swallowed would recurse)
     if site not in _SWALLOWED_LOGGED:
-        _SWALLOWED_LOGGED.add(site)
+        _SWALLOWED_LOGGED.add(site)  # lhlint: allow(LH1003) — warn-once set: GIL-atomic add; a lost race costs one duplicate stderr line
         import sys
 
         print(f"lighthouse_tpu: swallowed {type(exc).__name__} at {site}: "

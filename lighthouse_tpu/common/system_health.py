@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 import platform as _platform
+import re
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -99,21 +101,18 @@ def _read_proc_stat_cpu() -> tuple[int, int, int, int]:
     return 0, 0, 0, 0
 
 
-_PARTITION_RE = None
+# compiled eagerly: the old lazy check-then-act init raced between the
+# validator-client metrics thread and the monitoring_api poster
+_PARTITION_RE = re.compile(
+    r"^(?:(?:s|h|v|xv)d[a-z]+\d+"        # sda1 / vdb2 / xvda1
+    r"|nvme\d+n\d+p\d+"                  # nvme0n1p3
+    r"|mmcblk\d+p\d+)$")                 # mmcblk0p1
 
 
 def _is_partition(name: str) -> bool:
     """Partition (vs whole-disk) device name: sda1, vdb2, nvme0n1p3,
     mmcblk0p1 — but NOT mmcblk0, md0, nbd0, nvme0n1, which are whole
     devices whose names merely end in a digit."""
-    global _PARTITION_RE
-    if _PARTITION_RE is None:
-        import re
-
-        _PARTITION_RE = re.compile(
-            r"^(?:(?:s|h|v|xv)d[a-z]+\d+"        # sda1 / vdb2 / xvda1
-            r"|nvme\d+n\d+p\d+"                  # nvme0n1p3
-            r"|mmcblk\d+p\d+)$")                 # mmcblk0p1
     return _PARTITION_RE.match(name) is not None
 
 
@@ -280,6 +279,9 @@ class MonitoringHttpClient:
         self.last_post_ok: bool | None = None
         self.last_error: str | None = None
         self.posts_total = 0
+        # a VC and the auto_update poster can share one client; the
+        # posts counter is read-modify-write, so it takes a lock
+        self._stats_lock = threading.Lock()
 
     # -- gather (reference gather.rs) -----------------------------------
 
@@ -375,7 +377,8 @@ class MonitoringHttpClient:
         except OSError as e:
             self.last_post_ok = False
             self.last_error = str(e)
-        self.posts_total += 1
+        with self._stats_lock:
+            self.posts_total += 1
         return bool(self.last_post_ok)
 
     def auto_update(self, executor,

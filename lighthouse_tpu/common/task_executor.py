@@ -28,6 +28,11 @@ class TaskExecutor:
         self.name = name
         self.exit_event = threading.Event()
         self._shutdown_cb: list = []
+        # registration happens on the main thread while shutdown() can
+        # fire from any critical task's thread — appending into a list
+        # another thread is iterating raises at best, drops a callback
+        # at worst
+        self._cb_lock = threading.Lock()
         self.shutdown_reason: ShutdownReason | None = None
         self._pool = ThreadPoolExecutor(
             max_workers=max_blocking_workers,
@@ -79,7 +84,8 @@ class TaskExecutor:
     # -- shutdown ---------------------------------------------------------
 
     def on_shutdown(self, cb) -> None:
-        self._shutdown_cb.append(cb)
+        with self._cb_lock:
+            self._shutdown_cb.append(cb)
 
     def shutdown(self, message: str = "requested", failure: bool = False
                  ) -> None:
@@ -87,7 +93,9 @@ class TaskExecutor:
             return
         self.shutdown_reason = ShutdownReason(message, failure)
         self.exit_event.set()
-        for cb in self._shutdown_cb:
+        with self._cb_lock:
+            cbs = list(self._shutdown_cb)
+        for cb in cbs:   # call outside the lock: callbacks are arbitrary
             try:
                 cb(self.shutdown_reason)
             except Exception as e:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 from dataclasses import dataclass, field
 
 from lighthouse_tpu.network.rpc import RpcError
@@ -169,6 +170,10 @@ class Discovery:
             require_signed = locally_signed
         self.require_signed = require_signed
         self.table = RoutingTable(enr.node_id)
+        # the table is written from two threads: the RPC server side
+        # (_serve_ping admits records on the transport's thread) and
+        # the bootstrap/lookup client side
+        self._table_lock = threading.Lock()
         rpc_ep.register(P_DISCOVERY_PING, self._serve_ping)
         rpc_ep.register(P_DISCOVERY_FINDNODE, self._serve_findnode)
 
@@ -185,12 +190,14 @@ class Discovery:
         # only self-describing records on OUR network enter the table
         # (same eth2-field filter as the client side)
         if remote.peer_id == src and self._admissible(remote):
-            self.table.insert(remote)
+            with self._table_lock:
+                self.table.insert(remote)
         return [self.enr.to_bytes()]
 
     def _serve_findnode(self, src: str, data: bytes) -> list[bytes]:
         target = data[:32]
-        return [e.to_bytes() for e in self.table.closest(target)]
+        with self._table_lock:
+            return [e.to_bytes() for e in self.table.closest(target)]
 
     # -- client side --------------------------------------------------------
 
@@ -199,8 +206,9 @@ class Discovery:
             chunks = self.rpc.request(
                 peer, P_DISCOVERY_PING, self.enr.to_bytes())
         except RpcError:
-            self.table.remove(
-                hashlib.sha256(peer.encode()).digest())
+            with self._table_lock:
+                self.table.remove(
+                    hashlib.sha256(peer.encode()).digest())
             return None
         if not chunks:
             return None
@@ -208,7 +216,8 @@ class Discovery:
         # only table peers on our network (the eth2 ENR-field filter the
         # reference applies before dialing, discovery/enr_ext.rs)
         if self._admissible(remote):
-            self.table.insert(remote)
+            with self._table_lock:
+                self.table.insert(remote)
         return remote
 
     def find_node(self, peer: str, target: bytes) -> list[Enr]:
@@ -224,7 +233,8 @@ class Discovery:
         discv5 self-lookup that populates the table)."""
         target = target if target is not None else self.enr.node_id
         queried: set[str] = set()
-        candidates = {e.node_id: e for e in self.table.closest(target)}
+        with self._table_lock:
+            candidates = {e.node_id: e for e in self.table.closest(target)}
         for _ in range(max_rounds):
             frontier = sorted(
                 (e for e in candidates.values() if e.peer_id not in queried),
@@ -237,9 +247,11 @@ class Discovery:
                 for found in self.find_node(enr.peer_id, target):
                     if not self._admissible(found):
                         continue
-                    self.table.insert(found)
+                    with self._table_lock:
+                        self.table.insert(found)
                     candidates.setdefault(found.node_id, found)
-        return self.table.closest(target)
+        with self._table_lock:
+            return self.table.closest(target)
 
     def bootstrap(self, bootnode_peer: str) -> int:
         """Dial a bootnode, then self-lookup to fill the table.  Returns

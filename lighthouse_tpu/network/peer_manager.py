@@ -240,7 +240,8 @@ class PeerManager:
             self._info(peer).connected = False
 
     def connected_peers(self) -> list[str]:
-        return [p for p, i in self.peers.items() if i.connected]
+        with self._lock:
+            return [p for p, i in self.peers.items() if i.connected]
 
     def client_counts(self) -> dict[str, int]:
         """Connected-peer census by client family (the reference's
@@ -287,7 +288,9 @@ class PeerManager:
     def good_peers(self) -> list[str]:
         # decay-aware: a long-quiet banned peer is eligible again, the
         # same verdict is_banned()/accept_connection() would give
-        return [p for p in list(self.peers) if not self.is_banned(p)]
+        with self._lock:
+            candidates = list(self.peers)
+        return [p for p in candidates if not self.is_banned(p)]
 
     # -- heartbeat ----------------------------------------------------------
 

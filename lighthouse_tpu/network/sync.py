@@ -40,6 +40,7 @@ hardening).
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -92,6 +93,11 @@ class SyncManager:
         self.router = router
         self.peers = peer_manager
         self.statuses: dict[str, PeerStatus] = {}
+        # handshakes land from both the bootstrap thread and the
+        # net-slot loop; the status table and the downscore tally are
+        # the two cells both write (the books keep their documented
+        # lock-free single-writer ordering)
+        self._ledger_lock = threading.Lock()
         self._inflight_lookups: set[bytes] = set()
         self._failed_lookups: OrderedDict[bytes, None] = OrderedDict()
         # per-advertised-target abandoned-attempt accounting (PR 8
@@ -154,7 +160,8 @@ class SyncManager:
         """EVERY penalty the sync plane issues goes through here:
         reason-labeled in sync_downscores_total and tallied in the
         local ledger (zero-unaccounted-downscores discipline)."""
-        self.downscores += 1
+        with self._ledger_lock:
+            self.downscores += 1
         REGISTRY.counter(
             "sync_downscores_total",
             "peer downscores issued by the sync plane, by reason",
@@ -192,7 +199,8 @@ class SyncManager:
             head_root=bytes(remote.head_root),
             finalized_epoch=int(remote.finalized_epoch),
         )
-        self.statuses[peer] = st
+        with self._ledger_lock:
+            self.statuses[peer] = st
         self.peers.report(peer, "useful_response")  # register as connected
         return st
 

@@ -168,6 +168,10 @@ class WireNode:
         self._udp_transport = None
         self._conns: dict[str, _Conn] = {}           # peer_id -> conn
         self._topics: dict[str, Callable] = {}       # local subscriptions
+        # subscribe/unsubscribe mutate from the caller's thread while
+        # the wire loop iterates the table for HELLO; single-key gets
+        # stay bare (GIL-atomic), whole-table iteration takes the lock
+        self._topics_lock = threading.Lock()
         self._rpc_handlers: dict[str, Callable] = {}
         self._rpc_limiter = RateLimiter()
         self._streams: dict[int, dict] = {}          # stream id -> state
@@ -351,7 +355,7 @@ class WireNode:
             "identity_pub": self.identity_pub.hex(),
             "static_sig": self._static_binding.hex(),
             "fork_digest": self.fork_digest.hex(),
-            "topics": sorted(self._topics),
+            "topics": self._topic_names(),
             "listen_port": self.listen_port,
             "agent": self.agent,
         }).encode()
@@ -678,8 +682,15 @@ class WireNode:
             await self._fanout(topic, data, exclude=set(), flood=True)
         asyncio.run_coroutine_threadsafe(run(), self.loop)
 
+    def _topic_names(self) -> list[str]:
+        """Sorted snapshot of the local subscriptions, safe against a
+        concurrent subscribe() from another thread."""
+        with self._topics_lock:
+            return sorted(self._topics)
+
     def subscribe(self, topic: str, handler: Callable):
-        self._topics[topic] = handler
+        with self._topics_lock:
+            self._topics[topic] = handler
         self._announce(K_SUBSCRIBE, topic)
         if self.loop is None:
             # pre-start subscribe (supported everywhere else in this
@@ -699,7 +710,8 @@ class WireNode:
             asyncio.run_coroutine_threadsafe(_join(), self.loop)
 
     def unsubscribe(self, topic: str):
-        self._topics.pop(topic, None)
+        with self._topics_lock:
+            self._topics.pop(topic, None)
         self._announce(K_UNSUBSCRIBE, topic)
         if self.loop is not None:
             async def _leave():
@@ -911,7 +923,12 @@ class WireNode:
             }).encode()
             self._udp_transport.sendto(resp, addr)
         elif d.get("t") == "resp":
-            fut = self._udp_waiters.pop(bytes.fromhex(d.get("n", "")), None)
+            # asyncio datagram callback: runs on the wire loop, the same
+            # thread as every other _udp_waiters access (udp_request's
+            # _do is loop-submitted) — lint cannot see protocol-callback
+            # threading; there is no second thread here
+            fut = self._udp_waiters.pop(  # lhlint: allow(LH1003) — loop-confined: datagram callbacks run on the wire loop
+                bytes.fromhex(d.get("n", "")), None)
             if fut is not None and not fut.done():
                 fut.set_result([bytes.fromhex(c) for c in d.get("c", ())])
 
@@ -932,7 +949,10 @@ class WireNode:
 
     @property
     def peers(self) -> list[str]:
-        return [pid for pid, c in self._conns.items() if c.alive]
+        # _conns is mutated ONLY on the wire loop (single-writer); this
+        # sync facade iterates a snapshot taken in one C-level call
+        conns = list(self._conns.items())  # lhlint: allow(LH1003) — single-writer dict, GIL-atomic list() snapshot
+        return [pid for pid, c in conns if c.alive]
 
     def peer_addr(self, peer_id: str) -> tuple[str, int] | None:
         conn = self._conns.get(peer_id)

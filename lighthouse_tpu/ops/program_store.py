@@ -101,7 +101,7 @@ _REGISTERED: dict[str, str] = {}
 def register_entry(entry_id: str, *, driver: str) -> None:
     """Declare that ``entry_id`` (a shape-manifest id) is served by the
     program store, prewarmed by the named :mod:`ops/prewarm` driver."""
-    _REGISTERED[entry_id] = driver
+    _REGISTERED[entry_id] = driver  # lhlint: allow(LH1003) — import-time/prewarm registration: idempotent GIL-atomic setitem, each driver owns its own keys
 
 
 def registered_entries() -> dict[str, str]:

@@ -479,8 +479,12 @@ class BeaconProcessor:
                     retry_after_s=self.admission.retry_after_s(
                         len(q), limit))
         q.append(event)
+        # deliberately lock-free, like the deques (module docstring):
+        # the worst interleaving with the manager's pop is a batch
+        # window stamped one flush interval early/late, self-healing on
+        # the next sweep — a lock here would sit on every submit
         if wt in _BATCHABLE and wt not in self._batch_first_seen:
-            self._batch_first_seen[wt] = time.monotonic()
+            self._batch_first_seen[wt] = time.monotonic()  # lhlint: allow(LH1003) — benign by design: single GIL-atomic setitem, staleness bounded by the flush interval
         self._wakeup.set()
         return ACCEPTED
 

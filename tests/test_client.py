@@ -70,6 +70,39 @@ class TestTaskExecutor:
         ex = TaskExecutor("t")
         assert ex.spawn_blocking(lambda a, b: a + b, 2, 3).result() == 5
 
+    def test_concurrent_callback_registration_during_shutdown(self):
+        """Regression pin for the lhrace fix: ``on_shutdown`` appends
+        while ``shutdown`` iterates — both now go through ``_cb_lock``
+        (snapshot under the lock, callbacks invoked outside it), so 6
+        racing registrars never blow up the iteration or lose a
+        registration."""
+        ex = TaskExecutor("t")
+        fired = []
+        n_regs, per_thread = 5, 50
+        barrier = threading.Barrier(n_regs + 1)
+
+        def register(t):
+            barrier.wait()
+            for i in range(per_thread):
+                ex.on_shutdown(lambda r, t=t, i=i: fired.append((t, i)))
+
+        def stopper():
+            barrier.wait()
+            ex.shutdown("stress")
+
+        threads = [threading.Thread(target=register, args=(t,))
+                   for t in range(n_regs)] \
+            + [threading.Thread(target=stopper)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ex.exit_event.is_set()
+        # every registration landed (appends are never dropped) and no
+        # snapshot callback ran twice
+        assert len(ex._shutdown_cb) == n_regs * per_thread
+        assert len(fired) == len(set(fired))
+
 
 class TestClientBuilder:
     def test_full_assembly_and_http(self):

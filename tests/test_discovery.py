@@ -112,3 +112,48 @@ class TestDiscoveryProtocol:
         assert len(d.table) == 1
         assert d.ping("ghost") is None
         assert len(d.table) == 0
+
+
+class TestConcurrentTable:
+    def test_concurrent_pings_and_lookups(self):
+        """Regression pin for the lhrace fix: the routing table is
+        shared between the discovery sweep thread and RPC serving —
+        inserts, evictions and closest-scans now run under
+        ``_table_lock`` (RPC itself stays outside the hold), so 6
+        racing threads never tear a bucket."""
+        import threading
+
+        fabric = NetworkFabric()
+        for i in range(8):
+            rpc = fabric.rpc.join(f"peer-{i}")
+            Discovery(rpc, Enr(peer_id=f"peer-{i}"))
+        hub = Discovery(fabric.rpc.join("hub"), Enr(peer_id="hub"))
+        n_ping, n_search = 3, 3
+        barrier = threading.Barrier(n_ping + n_search)
+        errors = []
+
+        def pinger(t):
+            barrier.wait()
+            try:
+                for i in range(30):
+                    hub.ping(f"peer-{(t + i) % 8}")
+            except Exception as e:
+                errors.append(e)
+
+        def searcher():
+            barrier.wait()
+            try:
+                for _ in range(30):
+                    hub.lookup()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=pinger, args=(t,))
+                   for t in range(n_ping)] \
+            + [threading.Thread(target=searcher) for _ in range(n_search)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert len(hub.table) == 8

@@ -240,3 +240,91 @@ def test_observatory_view_shape(fresh_recorder):
     assert view["armed"] and view["trips"] == 1
     assert view["last_dump"]["reason"] == "drill"
     assert view["tail"][-1]["kind"] == "trip"
+
+
+# -- cross-thread regression pins (the lhrace LH1001-1003 fixes) --------------
+# Each test drives the exact shape the race pass flagged with 6 racing
+# threads and asserts the post-fix invariant holds under contention.
+
+
+def test_concurrent_first_emits_memoize_one_counter_child(fresh_recorder):
+    """6 threads racing the FIRST emit of a kind: the double-checked
+    ``_memo_lock`` admits exactly one memoized child and no increment
+    lands on an orphaned duplicate (the check-then-act fix on
+    ``_counter_memo``)."""
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    rec = flight.FlightRecorder(capacity=4096, dump_dir=None)
+    kind = "memo-race-pin"
+    child = REGISTRY.counter("flight_events_total").labels(kind=kind)
+    start = child.value
+    n_threads, per_thread = 6, 50
+    barrier = threading.Barrier(n_threads)
+
+    def pump():
+        barrier.wait()
+        for _ in range(per_thread):
+            rec.emit(kind)
+
+    threads = [threading.Thread(target=pump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert child.value == start + n_threads * per_thread
+    assert ("event", kind) in rec._counter_memo
+
+
+def test_concurrent_trips_prune_dump_files_consistently(fresh_recorder,
+                                                        tmp_path):
+    """6 threads tripping at once: the ``_dump_lock`` keeps the
+    rotation deque and the on-disk dump set in lockstep (the unlocked
+    append/popleft pair used to drop or double-prune paths)."""
+    import os
+
+    rec = fresh_recorder      # max_dumps=4, dumping into tmp_path
+    n_threads, per_thread = 6, 3
+    barrier = threading.Barrier(n_threads)
+
+    def tripper(t):
+        barrier.wait()
+        for i in range(per_thread):
+            rec.trip("stress", thread=t, i=i)
+
+    threads = [threading.Thread(target=tripper, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.trip_count == n_threads * per_thread
+    assert len(rec._dump_paths) <= rec.max_dumps
+    on_disk = sorted(p.name for p in tmp_path.glob("flight-*.json"))
+    assert sorted(os.path.basename(p) for p in rec._dump_paths) == on_disk
+
+
+def test_concurrent_reconfigure_rebuilds_ring_once(fresh_recorder,
+                                                   monkeypatch):
+    """6 threads re-reading a changed capacity knob: the check now sits
+    INSIDE the lock hold, so the ring is rebuilt exactly once and no
+    buffered event is lost to a double rebuild."""
+    rec = fresh_recorder
+    for i in range(10):
+        rec.emit("keep", i=i)
+    monkeypatch.setenv("LHTPU_FLIGHT_CAPACITY", "64")
+    n_threads = 6
+    barrier = threading.Barrier(n_threads)
+
+    def reconf():
+        barrier.wait()
+        rec.reconfigure()
+
+    threads = [threading.Thread(target=reconf) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.capacity == 64
+    assert rec._ring.maxlen == 64
+    kept = [e["i"] for e in rec.snapshot() if "i" in e]
+    assert kept == list(range(10))

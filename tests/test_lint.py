@@ -1652,11 +1652,12 @@ def test_real_tree_clean_for_v2_rules():
 
 
 def test_real_tree_waivers_are_justified():
-    """Every inline LH90x/LH602 waiver must carry prose (a comment
-    beyond the allow() itself) on the same or adjacent line."""
+    """Every inline LH90x/LH602/LH100x waiver must carry prose (a
+    comment beyond the allow() itself) on the same or adjacent line."""
     import re
 
-    allow_re = re.compile(r"#\s*lhlint:\s*allow\((LH9\d\d|LH602)\)")
+    allow_re = re.compile(
+        r"#\s*lhlint:\s*allow\((LH9\d\d|LH602|LH10\d\d)\)")
     for path in sorted((REPO / "lighthouse_tpu").rglob("*.py")):
         lines = path.read_text().splitlines()
         for i, line in enumerate(lines):
@@ -1924,18 +1925,22 @@ def test_cli_exit_codes_documented():
 
 
 def test_full_tree_run_stays_under_budget():
-    """Engine perf gate: a COLD full-tree analyze (module-lattice memo
-    dropped) stays under the 10 s CI budget."""
+    """Engine perf gate: a COLD full-tree analyze (module-lattice memo,
+    race-pass access memo AND thread-root closure memo all dropped)
+    stays under the 10 s CI budget."""
     import time
 
-    from tools.lint import dataflow
+    from tools.lint import dataflow, race_pass, threads
 
     dataflow.clear_cache()
+    race_pass.clear_cache()
+    threads.clear_cache()
     t0 = time.perf_counter()
     analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
     cold = time.perf_counter() - t0
     assert cold < 10.0, f"cold full-tree lhlint took {cold:.1f}s"
-    # warm re-run must hit the mtime-keyed memo (same process)
+    # warm re-run must hit the mtime-keyed memos (module lattices, race
+    # accesses) and the tree-keyed closure memo (same process)
     t0 = time.perf_counter()
     analyze(REPO / "lighthouse_tpu", readme=REPO / "README.md")
     warm = time.perf_counter() - t0
@@ -2128,3 +2133,483 @@ def test_engine_memo_invalidated_by_cross_module_edit(tmp_path):
     # api/http_api.py itself is untouched — a stale per-file memo would
     # keep its lock body's old resolved-edge view and miss this
     assert rules_of(analyze(pkg)) == ["LH811"]
+
+
+# -- pass 15: cross-thread races (LH1001-1004) + the thread-root manifest ------
+
+
+RACE_POOL_HEADER = """
+    import threading
+
+    class JobPool:
+        def __init__(self):
+            self.jobs = []
+            self._lock = threading.Lock()
+            threading.Thread(target=self._drain, daemon=True).start()
+"""
+
+
+def race_rules(findings):
+    return [f for f in findings
+            if f.rule in ("LH1001", "LH1002", "LH1003", "LH1004")]
+
+
+def test_race_pass_flags_unlocked_shared_state(tmp_path):
+    """LH1003 positive: a list mutated in place from the drain thread
+    AND the main thread, no lock anywhere."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while self.jobs:
+                self.jobs.pop()
+
+        def submit(self, job):
+            self.jobs.append(job)
+    """})
+    findings = race_rules(analyze(pkg))
+    assert rules_of(findings) == ["LH1003"]
+    assert findings[0].symbol == "JobPool.jobs"
+    assert "multiple thread roots" in findings[0].message
+
+
+def test_race_pass_locked_twin_negative(tmp_path):
+    """Compliant twin: the same shape with every compound access under
+    the instance lock stays silent (and the lexical check-inside-the-
+    hold also defuses LH1002)."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            with self._lock:
+                while self.jobs:
+                    self.jobs.pop()
+
+        def submit(self, job):
+            with self._lock:
+                self.jobs.append(job)
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_flags_disjoint_lock_sets(tmp_path):
+    """LH1001 positive: one path locks, the other mutates bare — the
+    lock sets never intersect."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while True:
+                self.jobs.pop()
+
+        def submit(self, job):
+            with self._lock:
+                self.jobs.append(job)
+    """})
+    findings = race_rules(analyze(pkg))
+    assert rules_of(findings) == ["LH1001"]
+    assert "disjoint lock sets" in findings[0].message
+
+
+def test_race_pass_single_writer_confined_twin_negative(tmp_path):
+    """The blessed confined-writer idiom: compound updates on ONE root,
+    other roots touch only GIL-atomic single-key reads (len/get/[k]) —
+    never a finding."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while True:
+                self.jobs.pop()
+
+        def pending(self):
+            return len(self.jobs)
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_cross_root_iteration_rearms(tmp_path):
+    """Iterating the in-place-mutated container from ANOTHER root can
+    observe torn state ("changed size during iteration") — the single-
+    writer exemption does not apply."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while True:
+                self.jobs.pop()
+
+        def snapshot(self):
+            return list(self.jobs)
+    """})
+    assert rules_of(race_rules(analyze(pkg))) == ["LH1003"]
+
+
+def test_race_pass_immutable_snapshot_twin_negative(tmp_path):
+    """Atomic publish: every write is a plain store of a fresh object
+    (the `self._shed_lanes = frozenset(...)` idiom) — GIL-atomic,
+    never LH1001/1003."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": """
+        import threading
+
+        class LaneView:
+            def __init__(self):
+                self.lanes = ()
+                threading.Thread(target=self._refresh, daemon=True).start()
+
+            def _refresh(self):
+                while True:
+                    self.lanes = tuple(range(3))
+
+        def reset(view: LaneView):
+            view.lanes = ()
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_flags_check_then_act(tmp_path):
+    """LH1002 positive: bare membership check, then the act under the
+    lock — the resurrection window lives between them."""
+    pkg, _ = make_pkg(tmp_path, {"pool/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self.entries = {}
+                self._lock = threading.Lock()
+                threading.Thread(target=self._sweep, daemon=True).start()
+
+            def _sweep(self):
+                while True:
+                    with self._lock:
+                        self.entries.clear()
+
+            def lookup(self, key):
+                if key not in self.entries:
+                    with self._lock:
+                        self.entries[key] = object()
+                return self.entries[key]
+    """})
+    findings = race_rules(analyze(pkg))
+    assert rules_of(findings) == ["LH1002"]
+    assert "without one continuous lock hold" in findings[0].message
+
+
+def test_race_pass_double_checked_locking_negative(tmp_path):
+    """Compliant twin: bare check, lock, RE-check, act — the innermost
+    (locked) guard decides, so the idiom the real-tree fixes use stays
+    silent."""
+    pkg, _ = make_pkg(tmp_path, {"pool/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self.entries = {}
+                self._lock = threading.Lock()
+                threading.Thread(target=self._sweep, daemon=True).start()
+
+            def _sweep(self):
+                while True:
+                    with self._lock:
+                        self.entries.clear()
+
+            def lookup(self, key):
+                if key not in self.entries:
+                    with self._lock:
+                        if key not in self.entries:
+                            self.entries[key] = object()
+                return self.entries.get(key)
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_caller_lock_inheritance(tmp_path):
+    """A helper whose EVERY call site runs under the lock inherits it
+    (the PeerManager._info contract) — no finding, even though the
+    helper's own body mutates bare."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            with self._lock:
+                self._pop_one()
+
+        def _pop_one(self):
+            if self.jobs:
+                self.jobs.pop()
+
+        def submit(self, job):
+            with self._lock:
+                self.jobs.append(job)
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_confined_to_one_root_twin_negative(tmp_path):
+    """A cell only the spawned thread ever touches is not shared —
+    no root pair, no finding."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while True:
+                self.jobs.pop()
+                self.jobs.append(0)
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_suppression_requires_anchor_line(tmp_path):
+    """An allow() on one of the participating access lines suppresses;
+    the justification-prose policy for the real tree is asserted by
+    test_real_tree_waivers_are_justified."""
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while self.jobs:
+                self.jobs.pop()
+
+        def submit(self, job):
+            self.jobs.append(job)  # lhlint: allow(LH1003) — fixture
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_flags_lock_inversion_across_calls(tmp_path):
+    """LH1004 positive: A->B through a resolved call chain conflicting
+    with a lexical B->A elsewhere — LH103 cannot see this cycle (only
+    one direction is lexical), LH1004 must."""
+    pkg, _ = make_pkg(tmp_path, {"net/ordering.py": """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def grab_b():
+            with LOCK_B:
+                return 1
+
+        def forward():
+            with LOCK_A:
+                return grab_b()
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:
+                    return 2
+    """})
+    findings = race_rules(analyze(pkg))
+    assert rules_of(findings) == ["LH1004"]
+    assert "deadlock risk" in findings[0].message
+
+
+def test_race_pass_consistent_lock_order_negative(tmp_path):
+    """Same nesting order everywhere (even through calls): no cycle."""
+    pkg, _ = make_pkg(tmp_path, {"net/ordering.py": """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def grab_b():
+            with LOCK_B:
+                return 1
+
+        def forward():
+            with LOCK_A:
+                return grab_b()
+
+        def also_forward():
+            with LOCK_A:
+                with LOCK_B:
+                    return 2
+    """})
+    assert race_rules(analyze(pkg)) == []
+
+
+def test_race_pass_real_tree_is_clean():
+    """The PR's headline gate: zero LH1001-1004 findings on the real
+    tree — every race found was FIXED (or carries an inline prose-
+    justified waiver), none baselined."""
+    findings = race_rules(analyze(REPO / "lighthouse_tpu",
+                                  readme=REPO / "README.md"))
+    assert findings == [], "race findings in the real tree:\n" + "\n".join(
+        f.render() for f in findings)
+
+
+# -- the thread-root manifest --------------------------------------------------
+
+THREAD_MANIFEST_PATH = REPO / "tools" / "lint" / "thread_roots.json"
+
+
+def _build_real_thread_manifest():
+    from tools.lint import build_context
+    from tools.lint import threads as th
+
+    ctx = build_context(REPO / "lighthouse_tpu", readme=REPO / "README.md")
+    return th.build_thread_manifest(ctx)
+
+
+def test_thread_manifest_matches_tree():
+    """Byte-identical sync gate, like the jit shape manifest: the
+    checked-in thread_roots.json must equal a regeneration from the
+    tree (`python -m tools.lint --thread-roots` refreshes it)."""
+    from tools.lint import threads as th
+
+    assert THREAD_MANIFEST_PATH.exists(), \
+        "run: python -m tools.lint --thread-roots"
+    assert th.render(_build_real_thread_manifest()) \
+        == THREAD_MANIFEST_PATH.read_text(), (
+            "tools/lint/thread_roots.json is stale — regenerate with "
+            "`python -m tools.lint --thread-roots`")
+
+
+def test_thread_manifest_covers_every_spawn_site():
+    """Independent cross-check: a from-scratch AST sweep for spawn
+    calls (threading.Thread, TaskExecutor spawn/spawn_periodic/
+    spawn_blocking, run_coroutine_threadsafe) must find no site the
+    manifest misses."""
+    import ast as _ast
+
+    manifest = json.loads(THREAD_MANIFEST_PATH.read_text())
+    covered = {(e["file"], e["line"]) for e in manifest["roots"]}
+
+    def dotted(expr):
+        parts = []
+        node = expr
+        while isinstance(node, _ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, _ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    missing = []
+    for path in sorted((REPO / "lighthouse_tpu").rglob("*.py")):
+        rel = str(path.relative_to(REPO))
+        tree = _ast.parse(path.read_text())
+        for node in _ast.walk(tree):
+            if not isinstance(node, _ast.Call):
+                continue
+            text = dotted(node.func)
+            if text is None:
+                continue
+            terminal = text.rsplit(".", 1)[-1]
+            if terminal == "Thread":
+                root = text.split(".", 1)[0]
+                if "." in text and "threading" not in root.lower():
+                    continue
+            elif terminal == "run_coroutine_threadsafe":
+                if not node.args:
+                    continue
+            elif terminal in ("spawn", "spawn_periodic", "spawn_blocking"):
+                if "." not in text or not node.args or not isinstance(
+                        node.args[0],
+                        (_ast.Name, _ast.Attribute, _ast.Lambda)):
+                    continue
+            else:
+                continue
+            if (rel, node.lineno) not in covered:
+                missing.append(f"{rel}:{node.lineno} ({terminal})")
+    assert not missing, "spawn sites absent from thread_roots.json:\n" \
+        + "\n".join(missing)
+
+
+def test_thread_manifest_entry_shape():
+    manifest = json.loads(THREAD_MANIFEST_PATH.read_text())
+    assert manifest["version"] == 1
+    roots = manifest["roots"]
+    assert roots, "the client spawns threads; the manifest must list them"
+    required = {"id", "file", "line", "kind", "spawner", "entry", "name",
+                "daemon", "lifecycle"}
+    ids = [r["id"] for r in roots]
+    assert len(ids) == len(set(ids))
+    for r in roots:
+        assert required <= set(r), r.get("id")
+        assert r["kind"] in ("thread", "executor", "periodic", "blocking",
+                             "coroutine"), r["id"]
+        assert r["lifecycle"] in ("loop", "oneshot", "periodic", "server",
+                                  "pool", "coroutine"), r["id"]
+        # a folded coroutine must point at a real thread root
+        if "runs_on" in r:
+            assert r["runs_on"] in ids, r["id"]
+    files_lines = [(r["file"], r["line"], r["id"]) for r in roots]
+    assert files_lines == sorted(files_lines)
+
+
+def test_thread_root_discovery_folds_coroutines_into_their_loop(tmp_path):
+    """A run_coroutine_threadsafe submission in the class that owns the
+    loop thread attributes to THAT root (runs_on in the manifest), so
+    the race pass never invents sharing inside one asyncio plane."""
+    from tools.lint import build_context
+    from tools.lint import threads as th
+
+    pkg, _ = make_pkg(tmp_path, {"net/wire.py": """
+        import asyncio
+        import threading
+
+        class WireNode:
+            def __init__(self):
+                self.loop = asyncio.new_event_loop()
+                threading.Thread(target=self._run_loop,
+                                 name="wire-loop", daemon=True).start()
+
+            def _run_loop(self):
+                self.loop.run_forever()
+
+            async def _do(self):
+                return 1
+
+            def request(self):
+                fut = asyncio.run_coroutine_threadsafe(self._do(),
+                                                       self.loop)
+                return fut.result()
+    """})
+    ctx = build_context(pkg)
+    data = th.build_thread_manifest(ctx)
+    by_kind = {r["kind"]: r for r in data["roots"]}
+    assert by_kind["thread"]["name"] == "wire-loop"
+    assert by_kind["thread"]["lifecycle"] == "loop"
+    assert by_kind["coroutine"]["runs_on"] == by_kind["thread"]["id"]
+    # and the async method's accesses attribute to the loop root
+    roots_map = th.roots_by_function(ctx)
+    assert th.roots_of(roots_map, "net/wire.py::WireNode._do") \
+        == frozenset((by_kind["thread"]["id"],))
+
+
+# -- CLI: --only / --changed report filters ------------------------------------
+
+
+def test_cli_only_filters_reporting(tmp_path):
+    pkg, _ = make_pkg(tmp_path, {"pool/jobs.py": RACE_POOL_HEADER + """
+        def _drain(self):
+            while self.jobs:
+                self.jobs.pop()
+
+        def submit(self, job):
+            self.jobs.append(job)
+    """})
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    base = [sys.executable, "-m", "tools.lint", "--root", str(pkg),
+            "--no-baseline"]
+    hit = subprocess.run(base + ["--only", "LH1003"],
+                         capture_output=True, text=True, cwd=REPO, env=env)
+    assert hit.returncode == 1
+    assert "LH1003" in hit.stderr
+    # rule NAME works too
+    named = subprocess.run(base + ["--only", "unlocked-shared-state"],
+                           capture_output=True, text=True, cwd=REPO, env=env)
+    assert named.returncode == 1
+    miss = subprocess.run(base + ["--only", "LH101"],
+                          capture_output=True, text=True, cwd=REPO, env=env)
+    assert miss.returncode == 0, miss.stderr
+
+
+def test_cli_changed_filter_accepted_on_real_tree():
+    """--changed restricts reporting to files touched vs HEAD; on the
+    real tree this must never FAIL (the tree is kept clean of new
+    findings regardless of which files are in flight)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--changed"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
+def test_cli_thread_roots_mode(tmp_path):
+    out = tmp_path / "thread_roots.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--thread-roots",
+         "--manifest-path", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "thread-root manifest" in proc.stdout
+    assert json.loads(out.read_text()) \
+        == json.loads(THREAD_MANIFEST_PATH.read_text())

@@ -248,6 +248,93 @@ class TestPeerScoring:
         assert "bad" in node.dropped
         assert dials == 3 and len(node.dialed) == 3  # capped at deficit
 
+    def test_concurrent_census_and_churn(self):
+        """Regression pin for the lhrace fixes: ``connected_peers`` /
+        ``good_peers`` snapshot the table under ``self._lock`` while 6
+        racing threads churn it — the bare comprehensions used to die
+        with "dictionary changed size during iteration"."""
+        import threading
+
+        pm = PeerManager()
+        stable = [f"peer-{i}" for i in range(32)]
+        for i, p in enumerate(stable):
+            pm.mark_connected(p, ip=f"10.0.0.{i % 8}")
+        n_churn, n_census = 3, 3
+        barrier = threading.Barrier(n_churn + n_census)
+        errors = []
+
+        def churn(t):
+            barrier.wait()
+            try:
+                for i in range(200):
+                    pid = f"churn-{t}-{i}"
+                    pm.mark_connected(pid, ip=f"10.1.{t}.{i % 16}")
+                    pm.mark_disconnected(pid)
+            except Exception as e:
+                errors.append(e)
+
+        def census():
+            barrier.wait()
+            try:
+                for _ in range(200):
+                    pm.connected_peers()
+                    pm.good_peers()
+                    pm.client_counts()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn, args=(t,))
+                   for t in range(n_churn)] \
+            + [threading.Thread(target=census) for _ in range(n_census)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert sorted(pm.connected_peers()) == sorted(stable)
+
+
+class TestSyncLedgerContention:
+    def test_concurrent_handshakes_and_downscores(self, two_nodes):
+        """Regression pin for the lhrace fixes in SyncManager:
+        handshakes land from the bootstrap thread AND the net-slot loop
+        — ``statuses`` and the ``downscores`` tally now update under
+        ``_ledger_lock``, so 6 racing threads lose no count."""
+        import threading
+
+        h, a, b = two_nodes
+        n_shake, n_penal, per_penal = 3, 3, 25
+        barrier = threading.Barrier(n_shake + n_penal)
+        errors = []
+
+        def handshake():
+            barrier.wait()
+            try:
+                for _ in range(10):
+                    assert a.sync.status_handshake("node-b") is not None
+            except Exception as e:
+                errors.append(e)
+
+        def penalize(t):
+            barrier.wait()
+            try:
+                for i in range(per_penal):
+                    a.sync._downscore(f"sybil-{t}-{i}", "low", "stress")
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=handshake)
+                   for _ in range(n_shake)] \
+            + [threading.Thread(target=penalize, args=(t,))
+               for t in range(n_penal)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert a.sync.downscores == n_penal * per_penal
+        assert "node-b" in a.sync.statuses
+
 
 class TestPartition:
     def test_partitioned_peer_misses_gossip_then_syncs(self, two_nodes):

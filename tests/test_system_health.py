@@ -143,3 +143,35 @@ class TestMonitoringHttpClient:
         h = observe_process_health()
         assert h.pid > 0
         assert h.memory_process_bytes > 0
+
+
+class TestConcurrentPosting:
+    """Regression pin for the lhrace fix: ``posts_total`` is a compound
+    update reached from the VC metrics thread AND the monitoring_api
+    periodic poster — it now counts under ``_stats_lock``."""
+
+    def test_six_racing_posters_lose_no_count(self):
+        received = []
+        srv = _capture_server(received)
+        try:
+            mon = MonitoringHttpClient(
+                f"http://127.0.0.1:{srv.server_port}/metrics")
+            n_threads, per_thread = 6, 3
+            barrier = threading.Barrier(n_threads)
+
+            def post():
+                barrier.wait()
+                for _ in range(per_thread):
+                    mon.send_metrics(("system",))
+
+            threads = [threading.Thread(target=post)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        assert mon.posts_total == n_threads * per_thread
+        assert len(received) == n_threads * per_thread

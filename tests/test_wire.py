@@ -276,3 +276,50 @@ class TestSnappyCompression:
         framed = snappy.frame_compress(data)
         assert len(framed) < 3000            # compressed chunk won
         assert snappy.frame_decompress(framed) == data
+
+
+class TestConcurrentTopicTable:
+    def test_concurrent_subscribe_vs_hello_snapshot(self):
+        """Regression pin for the lhrace fix: subscribe/unsubscribe
+        mutate the topic table from the caller's thread while the wire
+        loop snapshots it for HELLO — both now go through
+        ``_topics_lock``, so 6 racing threads never tear the sorted
+        snapshot."""
+        import threading
+
+        node = WireNode("topic-stress")
+        n_sub, n_read = 3, 3
+        barrier = threading.Barrier(n_sub + n_read)
+        errors = []
+
+        def subscriber(t):
+            barrier.wait()
+            try:
+                for i in range(100):
+                    node.subscribe(f"topic-{t}-{i}", lambda *_: None)
+                    if i % 3 == 0:
+                        node.unsubscribe(f"topic-{t}-{i}")
+            except Exception as e:
+                errors.append(e)
+
+        def reader():
+            barrier.wait()
+            try:
+                for _ in range(150):
+                    names = node._topic_names()
+                    assert names == sorted(names)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=subscriber, args=(t,))
+                   for t in range(n_sub)] \
+            + [threading.Thread(target=reader) for _ in range(n_read)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        node._pool.shutdown(wait=False)
+        assert errors == []
+        expected = {f"topic-{t}-{i}" for t in range(n_sub)
+                    for i in range(100) if i % 3 != 0}
+        assert set(node._topic_names()) == expected

@@ -98,6 +98,23 @@ LH901       swallowed-exception    broad ``except: pass`` — the error
 LH902       unaccounted-swallow    broad handler in the offload or
                                    network modules that handles a fault
                                    but never records/raises/logs it
+LH1001      racy-compound-update   compound update (``+=`` / ``x =
+                                   f(x)`` / in-place container
+                                   mutation) of state shared across
+                                   thread roots under DISJOINT lock
+                                   sets — some paths lock, others
+                                   don't
+LH1002      check-then-act         guard reads shared state, the act
+                                   mutates it, and no single
+                                   continuous lock hold spans both
+                                   (the PR 12 resurrection shape)
+LH1003      unlocked-shared-state  shared mutable state with NO lock
+                                   on any access path at all
+LH1004      lock-inversion-        lock order A→B through a call
+            across-threads         chain conflicting with B→A
+                                   elsewhere, with thread-root
+                                   attribution (LH103 made
+                                   interprocedural)
 ==========  =====================  =========================================
 
 The v2 passes (LH602, LH80x, LH81x, LH90x) share the interprocedural
@@ -236,8 +253,9 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
     CLI/baseline layer's job)."""
     from tools.lint import (aot_pass, blocking_pass, envpass,
                             exceptions_pass, fetch, flight_pass, locks,
-                            metrics_pass, numeric_pass, shapes, shed_pass,
-                            store_pass, supervisor_pass, sync_pass)
+                            metrics_pass, numeric_pass, race_pass, shapes,
+                            shed_pass, store_pass, supervisor_pass,
+                            sync_pass)
 
     modules, findings = load_package(pathlib.Path(pkg_root))
     readme = pathlib.Path(readme) if readme is not None else None
@@ -246,7 +264,8 @@ def analyze(pkg_root, readme=None) -> list[Finding]:
                      metrics_pass.run, supervisor_pass.run,
                      store_pass.run, shed_pass.run, sync_pass.run,
                      flight_pass.run, aot_pass.run, numeric_pass.run,
-                     blocking_pass.run, exceptions_pass.run):
+                     blocking_pass.run, exceptions_pass.run,
+                     race_pass.run):
         findings.extend(pass_run(ctx))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings

@@ -6,7 +6,8 @@ Exit status (documented contract, asserted by tests/test_lint.py):
 code  meaning
 ====  =====================================================
 0     no NEW findings (stale baseline entries only warn);
-      also: ``--update-baseline`` / ``--manifest`` succeeded
+      also: ``--update-baseline`` / ``--manifest`` /
+      ``--thread-roots`` succeeded
 1     at least one finding beyond the baseline allowance
       (or, with ``--no-baseline``, any finding at all)
 2     usage error (argparse)
@@ -15,10 +16,18 @@ code  meaning
 ``--update-baseline`` rewrites baseline.json from the current tree (use
 after consciously fixing or accepting findings — the tier-1 test
 asserts the file never grows).  ``--manifest`` regenerates
-``tools/lint/shape_manifest.json`` from the tree (the tier-1 sync gate
-asserts the checked-in copy matches).  ``--json`` renders findings as a
-JSON array on stdout for tooling (each: rule, name, file, line, symbol,
-message, new).
+``tools/lint/shape_manifest.json`` from the tree; ``--thread-roots``
+regenerates ``tools/lint/thread_roots.json`` the same way (for both,
+the tier-1 sync gate asserts the checked-in copy matches).  ``--json``
+renders findings as a JSON array on stdout for tooling (each: rule,
+name, file, line, symbol, message, new).
+
+Pre-commit ergonomics: ``--only LH1003`` (rule id or name) restricts
+REPORTING to one rule, and ``--changed`` restricts it to files touched
+in the working tree / index vs HEAD (per ``git diff`` + untracked).
+Both are report-side filters — the analysis itself always runs over the
+whole tree, because the interprocedural passes need the full call
+graph; exit codes keep their meaning over the filtered set.
 """
 
 from __future__ import annotations
@@ -29,6 +38,26 @@ import pathlib
 import sys
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _changed_files() -> set[str]:
+    """Repo-relative paths changed vs HEAD (worktree + index) plus
+    untracked files — the ``--changed`` report filter."""
+    import subprocess
+
+    out: set[str] = set()
+    for cmd in (["git", "-C", str(_REPO), "diff", "--name-only", "HEAD"],
+                ["git", "-C", str(_REPO), "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            got = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if got.returncode == 0:
+            out.update(ln.strip() for ln in got.stdout.splitlines()
+                       if ln.strip())
+    return out
 
 
 def _findings_json(findings, new_keys: set[str]) -> str:
@@ -68,6 +97,16 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--manifest-path", type=pathlib.Path, default=None,
                         help="write the manifest here instead of the "
                              "checked-in location")
+    parser.add_argument("--thread-roots", action="store_true",
+                        dest="thread_roots",
+                        help="regenerate the thread-root manifest "
+                             "(tools/lint/thread_roots.json) and exit")
+    parser.add_argument("--only", metavar="RULE", default=None,
+                        help="report only this rule (id like LH1003 or "
+                             "name like unlocked-shared-state)")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only findings in files changed vs "
+                             "HEAD (git diff + untracked)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="render findings as JSON on stdout")
     args = parser.parse_args(argv)
@@ -90,13 +129,39 @@ def main(argv: list[str] | None = None) -> int:
               f"{path}")
         return 0
 
+    if args.thread_roots:
+        from tools.lint import threads as th
+
+        ctx = build_context(args.root, readme=args.readme)
+        if ctx.parse_errors:
+            for f in ctx.parse_errors:
+                print(f"lhlint: {f.render()}", file=sys.stderr)
+            print("lhlint: refusing to write a thread-root manifest over "
+                  "unparseable modules (their spawn sites would be "
+                  "silently missing)", file=sys.stderr)
+            return 1
+        data = th.build_thread_manifest(ctx)
+        path = th.write(data, args.manifest_path)
+        print(f"lhlint: thread-root manifest — {len(data['roots'])} "
+              f"root{'' if len(data['roots']) == 1 else 's'} at {path}")
+        return 0
+
     findings = analyze(args.root, readme=args.readme)
 
     if args.update_baseline:
+        # deliberately unfiltered: a baseline written under --only /
+        # --changed would silently drop every other rule's debt
         data = bl.save(args.baseline, findings)
         print(f"lhlint: baseline updated — {len(data)} key(s), "
               f"{len(findings)} finding(s) at {args.baseline}")
         return 0
+
+    if args.only:
+        findings = [f for f in findings
+                    if args.only in (f.rule, f.name)]
+    if args.changed:
+        changed = _changed_files()
+        findings = [f for f in findings if f.file in changed]
 
     if args.no_baseline:
         if args.as_json:

@@ -138,7 +138,13 @@ def _scan_reachable(ctx: Context, start_sites: list[CallSite],
 
 
 def _with_lock_blocks(module) -> list[tuple[ast.AST, str, str]]:
-    """Every (with-node, lock-text, enclosing-qualname) in the module."""
+    """Every (with-node, lock-text, enclosing-qualname) in the module.
+    Memoized on the module object — LH103, LH1004 and the blocking
+    passes all ask, and the tree never changes within a Context.
+    Statement-only descent: with-blocks are statements."""
+    cached = getattr(module, "_with_lock_memo", None)
+    if cached is not None:
+        return cached
     out = []
 
     def visit(node, stack):
@@ -154,9 +160,12 @@ def _with_lock_blocks(module) -> list[tuple[ast.AST, str, str]]:
                         out.append((child, lock,
                                     ".".join(stack) or "<module>"))
                         break
+            elif not isinstance(child, (ast.stmt, ast.excepthandler)):
+                continue
             visit(child, new_stack)
 
     visit(module.tree, [])
+    module._with_lock_memo = out
     return out
 
 

@@ -1,0 +1,792 @@
+"""Pass — cross-thread data races (LH1001/LH1002/LH1003/LH1004).
+
+The most repeated hand-caught bug class in this repo's review history
+is the cross-thread race: PR 8 lost producer counts until ``bump()``
+grew a lock, PR 12 needed four review rounds to close the
+check-then-act resurrection window between the prewarmer and
+foreground dispatch.  This pass catches that class mechanically.
+
+**Escape analysis.**  A *cell* is a unit of shared state: an instance
+attribute (``self.X`` of a class that assigns it) or a module-global
+name.  Every access outside ``__init__``/``__new__`` is classified —
+``store`` (whole-object rebind), ``rmw`` (``+=`` / ``x = f(x)``),
+``mutate`` (in-place container mutation: ``append``/``pop``/subscript
+store/...), ``read-iter`` (whole-container read: iteration,
+``.items``/``.copy``, ``sorted(...)``...), ``read-key`` (single-key
+GIL-atomic read: ``.get``/subscript load/``in``/``len``) or plain
+scalar ``read`` — and attributed to the thread roots whose closures
+(tools/lint/threads.py) reach the enclosing function; functions no
+closure reaches run on ``<main>``.  A cell is *shared* when its
+accesses span ≥2 roots.
+
+**Lock sets.**  Each access records the lexical ``with <lock>:`` stack
+above it, widened by caller-lock inheritance: a helper whose EVERY
+known call site runs under lock L holds L by contract (the
+``PeerManager._info`` shape).  Instance locks are class-scoped (two
+classes' private ``self._lock`` are different locks); bare/CONSTANT
+names match package-wide, like LH103.
+
+==========  ========================  ================================
+rule id     name                      shared-cell shape flagged
+==========  ========================  ================================
+LH1001      racy-compound-update      compound update (rmw / in-place
+                                      mutation) where the accesses'
+                                      lock sets have no common lock —
+                                      some paths lock, others don't
+LH1002      check-then-act            guard reads the cell, the act
+                                      mutates it, and no single
+                                      continuous lock hold spans both
+                                      (the PR 12 resurrection shape)
+LH1003      unlocked-shared-state     compound updates with NO lock on
+                                      any access path at all
+LH1004      lock-inversion-across-    lock order A→B via a call chain
+            threads                   conflicting with B→A elsewhere —
+                                      LH103's lexical cycles extended
+                                      interprocedurally, with thread-
+                                      root attribution
+==========  ========================  ================================
+
+GIL-atomicity is respected: a cell whose every write is a plain
+``store`` (atomic publish of an immutable snapshot — the blessed
+``self._shed_lanes = frozenset(...)`` idiom) never yields LH1001/1003,
+and neither does a *single-writer* cell — compound updates confined to
+one root with only single-key (``read-key``) or scalar reads from the
+others (the confined-writer idiom sync.py documents).  Cross-root
+ITERATION of an in-place-mutated container re-arms the gate: that
+read can observe torn multi-key state or die with "changed size
+during iteration".  At most one of LH1001/1002/1003 fires per cell
+(most specific wins: no-lock-anywhere beats disjoint beats
+released-between).  Per repo convention real-tree findings are FIXED,
+not baselined; ``# lhlint: allow(...)`` waivers on the anchor line
+require justification prose.
+
+Everything here is conservative in the direction lint needs: an
+unresolved call edge or opaque thread entry can only shrink a closure
+or a root set — a missed finding, never an invented one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.lint import Context, Finding
+from tools.lint.callgraph import dotted_name
+from tools.lint import threads
+from tools.lint.locks import _is_lock_expr, _lock_identity
+
+#: in-place container mutators (conservative: unknown methods are
+#: ignored rather than guessed; ``update`` is deliberately absent —
+#: domain objects name methods ``update(slot)`` and a misread here
+#: would invent findings)
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear",
+    "setdefault", "rotate", "sort", "reverse",
+}
+#: single-key readers: GIL-atomic against a concurrent single-key
+#: write, so they never gate
+KEY_READER_METHODS = {"get", "count", "index"}
+KEY_READER_BUILTINS = {"len"}
+#: whole-container readers: can observe a torn multi-key state or
+#: raise "changed size during iteration" against a concurrent mutator
+ITER_READER_METHODS = {"items", "keys", "values", "copy"}
+ITER_READER_BUILTINS = {"list", "tuple", "dict", "set", "sorted",
+                        "sum", "min", "max", "any", "all", "frozenset"}
+
+_EXEMPT_FNS = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+@dataclass(frozen=True)
+class Access:
+    cell: tuple           # ("attr", pkg_rel, Class, name) | ("global", pkg_rel, name)
+    fn_key: str
+    line: int
+    kind: str             # store | rmw | mutate | read-iter | read-key | read
+    locks: frozenset      # lock identities held
+    with_ids: frozenset   # ids of the enclosing with-lock nodes
+
+
+@dataclass(frozen=True)
+class GuardedMutation:
+    """A check-then-act candidate: guard read + body mutation of the
+    same cell with no shared continuous lock hold."""
+
+    cell: tuple
+    fn_key: str
+    guard_line: int
+    act_line: int
+
+
+def _cell_label(cell: tuple) -> str:
+    if cell[0] == "attr":
+        return f"{cell[2]}.{cell[3]}"
+    return cell[2]
+
+
+# -- per-module access collection ---------------------------------------------
+
+#: (path, mtime_ns) -> (accesses, guarded mutations); mirrors
+#: dataflow._MODULE_CACHE so warm runs skip the whole-tree re-walk
+_MODULE_CACHE: dict[tuple, tuple] = {}
+
+
+def _owned_attrs(ti: threads.TypeIndex, module) -> dict[str, set[str]]:
+    """class bare name -> attrs the class itself assigns (``self.X =``
+    anywhere, or class-body targets — dataclass fields included)."""
+    owned: dict[str, set[str]] = {}
+
+    def class_visit(cnode: ast.ClassDef):
+        attrs = owned.setdefault(cnode.name, set())
+        for stmt in cnode.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                attrs.add(stmt.target.id)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        attrs.add(t.id)
+        for node in ast.walk(cnode):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name) and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Store):
+                attrs.add(node.attr)
+
+    # classes are statements: find them without touching expression
+    # subtrees (the per-class walk below still covers method bodies)
+    stack: list = [module.tree]
+    while stack:
+        parent = stack.pop()
+        for node in ast.iter_child_nodes(parent):
+            if isinstance(node, ast.ClassDef):
+                class_visit(node)
+            if isinstance(node, (ast.stmt, ast.excepthandler)):
+                stack.append(node)
+    return owned
+
+
+def _module_globals(module) -> set[str]:
+    out: set[str] = set()
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name):
+            out.add(stmt.target.id)
+    return out
+
+
+class _FnCollector:
+    """Walks ONE function body (nested defs excluded — they are their
+    own fn_keys) collecting cell accesses, the lexical lock stack, and
+    check-then-act candidates."""
+
+    def __init__(self, module, fn_key: str, cls: str | None,
+                 owned: dict[str, set[str]], globs: set[str],
+                 resolve=None):
+        self.module = module
+        self.fn_key = fn_key
+        self.cls = cls
+        self.owned = owned
+        self.globs = globs
+        self.resolve = resolve or (lambda node: None)
+        self.accesses: list[Access] = []
+        self.guarded: list[GuardedMutation] = []
+        #: (caller fn_key, resolved callee fn_key, lock idents held) —
+        #: feeds the caller-lock-inheritance fixpoint
+        self.call_sites: list[tuple[str, str, frozenset]] = []
+        self._lock_stack: list[tuple[str, int]] = []
+        #: active guards: list of {cell: (with_ids at guard read, line)}
+        self._guards: list[dict] = []
+        #: local name -> (cells read, with_ids, line) taint
+        self._taint: dict[str, tuple[set, frozenset, int]] = {}
+
+    # -- cell resolution ---------------------------------------------------
+
+    def _cell_of(self, expr: ast.expr) -> tuple | None:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            if self.cls and expr.attr in self.owned.get(self.cls, ()):
+                return ("attr", self.module.pkg_rel, self.cls, expr.attr)
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.globs:
+            return ("global", self.module.pkg_rel, expr.id)
+        return None
+
+    def _record(self, cell: tuple | None, line: int, kind: str) -> None:
+        if cell is None:
+            return
+        self.accesses.append(Access(
+            cell, self.fn_key, line, kind,
+            frozenset(ident for ident, _ in self._lock_stack),
+            frozenset(wid for _, wid in self._lock_stack)))
+        if kind in ("store", "rmw", "mutate"):
+            held = {wid for _, wid in self._lock_stack}
+            # innermost matching guard only: in double-checked locking
+            # (bare check, lock, re-check, act) the act is judged by
+            # the locked inner re-check — the idiom the fixes use
+            for frame in reversed(self._guards):
+                got = frame.get(cell)
+                if got is None:
+                    continue
+                if not (got[0] & held):
+                    self.guarded.append(GuardedMutation(
+                        cell, self.fn_key, got[1], line))
+                break
+
+    def _cells_read(self, expr: ast.expr) -> set[tuple]:
+        """Cells the expression reads, directly or via tainted locals."""
+        if isinstance(expr, ast.Constant):
+            return set()
+        if isinstance(expr, ast.Name):
+            got = self._cell_of(expr)
+            return {got} if got is not None else set()
+        out: set[tuple] = set()
+        for node in ast.walk(expr):
+            got = self._cell_of(node)
+            if got is not None and isinstance(
+                    getattr(node, "ctx", ast.Load()), ast.Load):
+                out.add(got)
+        return out
+
+    # -- the walk ----------------------------------------------------------
+
+    def walk_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                lock_text = _is_lock_expr(item.context_expr)
+                if lock_text:
+                    self._lock_stack.append(
+                        (self._identity(lock_text), id(stmt)))
+                    pushed += 1
+            self.walk_body(stmt.body)
+            for _ in range(pushed):
+                self._lock_stack.pop()
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            frame = self._guard_frame(stmt.test)
+            self._guards.append(frame)
+            self.walk_body(stmt.body)
+            self._guards.pop()
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            for target in stmt.targets:
+                self._assign_target(target, stmt.value)
+            self._update_taint(stmt)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+                self._assign_target(stmt.target, stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            target = stmt.target
+            cell = self._cell_of(target)
+            if cell is not None:
+                self._record(cell, stmt.lineno, "rmw")
+            elif isinstance(target, (ast.Subscript, ast.Attribute)):
+                self._record(self._cell_of(target.value), stmt.lineno,
+                             "mutate")
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, (ast.Subscript, ast.Attribute)):
+                    self._record(self._cell_of(target.value),
+                                 stmt.lineno, "mutate")
+            return
+        if isinstance(stmt, ast.For):
+            self._record(self._cell_of(stmt.iter), stmt.iter.lineno,
+                         "read-iter")
+            self._expr(stmt.iter, skip_direct=True)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            for handler in stmt.handlers:
+                self.walk_body(handler.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value)
+            return
+        # anything else: scan expressions generically
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._expr(node)
+            elif isinstance(node, ast.stmt):
+                self._stmt(node)
+
+    def _assign_target(self, target: ast.expr, value: ast.expr) -> None:
+        cell = self._cell_of(target)
+        if cell is not None:
+            kind = "rmw" if cell in self._cells_read(value) else "store"
+            self._record(cell, target.lineno, kind)
+            return
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            self._record(self._cell_of(target.value), target.lineno,
+                         "mutate")
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign_target(el, value)
+
+    def _update_taint(self, stmt: ast.Assign) -> None:
+        if len(stmt.targets) != 1 or not isinstance(
+                stmt.targets[0], ast.Name):
+            return
+        name = stmt.targets[0].id
+        cells = self._cells_read(stmt.value)
+        for node in ast.walk(stmt.value):
+            if isinstance(node, ast.Name) and node.id in self._taint:
+                cells |= self._taint[node.id][0]
+        if cells:
+            self._taint[name] = (
+                cells, frozenset(wid for _, wid in self._lock_stack),
+                stmt.lineno)
+        else:
+            self._taint.pop(name, None)
+
+    def _guard_frame(self, test: ast.expr) -> dict:
+        frame: dict = {}
+        held = frozenset(wid for _, wid in self._lock_stack)
+        for cell in self._cells_read(test):
+            frame[cell] = (held, test.lineno)
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in self._taint:
+                cells, wids, line = self._taint[node.id]
+                for cell in cells:
+                    frame.setdefault(cell, (wids, line))
+        return frame
+
+    def _expr(self, expr: ast.expr, skip_direct: bool = False) -> None:
+        """Classify reads/mutations inside an expression."""
+        if isinstance(expr, ast.Constant):
+            return
+        if isinstance(expr, ast.Name):
+            if not skip_direct:
+                cell = self._cell_of(expr)
+                if cell is not None and isinstance(expr.ctx, ast.Load):
+                    self._record(cell, expr.lineno, "read")
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                callee = self.resolve(node)
+                if callee is not None:
+                    self.call_sites.append((
+                        self.fn_key, callee,
+                        frozenset(i for i, _ in self._lock_stack)))
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    cell = self._cell_of(func.value)
+                    if cell is not None:
+                        if func.attr in MUTATOR_METHODS:
+                            self._record(cell, node.lineno, "mutate")
+                        elif func.attr in ITER_READER_METHODS:
+                            self._record(cell, node.lineno, "read-iter")
+                        elif func.attr in KEY_READER_METHODS:
+                            self._record(cell, node.lineno, "read-key")
+                elif isinstance(func, ast.Name):
+                    if func.id in ITER_READER_BUILTINS:
+                        for arg in node.args:
+                            self._record(self._cell_of(arg),
+                                         node.lineno, "read-iter")
+                    elif func.id in KEY_READER_BUILTINS:
+                        for arg in node.args:
+                            self._record(self._cell_of(arg),
+                                         node.lineno, "read-key")
+            elif isinstance(node, ast.Subscript):
+                if isinstance(node.ctx, ast.Load):
+                    self._record(self._cell_of(node.value), node.lineno,
+                                 "read-key")
+            elif isinstance(node, ast.Compare):
+                if any(isinstance(op, (ast.In, ast.NotIn))
+                       for op in node.ops):
+                    for operand in node.comparators:
+                        self._record(self._cell_of(operand), node.lineno,
+                                     "read-key")
+            elif not skip_direct:
+                cell = self._cell_of(node)
+                if cell is not None and isinstance(
+                        getattr(node, "ctx", None), ast.Load):
+                    # plain load of the whole cell: scalars stay
+                    # info-level "read" and never gate
+                    self._record(cell, node.lineno, "read")
+
+    def _identity(self, lock_text: str) -> str:
+        if lock_text.startswith("self.") and self.cls:
+            return f"{self.module.pkg_rel}:{self.cls}:{lock_text}"
+        return _lock_identity(self.module, lock_text)
+
+
+def _functions_of(module, ctx) -> list:
+    return [info for key, info in ctx.graph.functions.items()
+            if key.startswith(module.pkg_rel + "::")]
+
+
+def _make_resolver(ctx, ti, module, info):
+    """call node -> resolved fn key, via the call graph's own
+    resolution with the typed-chain fallback threads.py adds."""
+    by_node = {id(site.node): site.resolved
+               for site in info.calls if site.resolved}
+
+    def resolve(node: ast.Call) -> str | None:
+        got = by_node.get(id(node))
+        if got is not None:
+            return got
+        text = dotted_name(node.func)
+        if not text:
+            return None
+        return threads._resolve_callable_name(
+            ctx, ti, module, info.qualname, text)
+
+    return resolve
+
+
+def collect_module(ctx: Context, module) -> tuple[list, list, list]:
+    """(accesses, check-then-act candidates, resolved call sites) for
+    one module, memoized by file mtime like the dataflow lattices."""
+    try:
+        key = (str(module.path), module.path.stat().st_mtime_ns)
+    except OSError:
+        key = (str(module.path), -1)
+    cached = _MODULE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    ti = threads.type_index(ctx)
+    owned = _owned_attrs(ti, module)
+    globs = _module_globals(module)
+    accesses: list[Access] = []
+    guarded: list[GuardedMutation] = []
+    call_sites: list[tuple[str, str, frozenset]] = []
+    for info in _functions_of(module, ctx):
+        terminal = info.qualname.rsplit(".", 1)[-1]
+        if terminal in _EXEMPT_FNS:
+            continue
+        cls = ti.enclosing_class(module.pkg_rel, info.qualname)
+        col = _FnCollector(module, info.key, cls, owned, globs,
+                           resolve=_make_resolver(ctx, ti, module, info))
+        col.walk_body(info.node.body)
+        accesses.extend(col.accesses)
+        guarded.extend(col.guarded)
+        call_sites.extend(col.call_sites)
+    _MODULE_CACHE[key] = (accesses, guarded, call_sites)
+    return accesses, guarded, call_sites
+
+
+def clear_cache() -> None:
+    _MODULE_CACHE.clear()
+
+
+# -- aggregation / rules -------------------------------------------------------
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    roots_map = threads.roots_by_function(ctx)
+    all_accesses: list[Access] = []
+    all_guarded: list[GuardedMutation] = []
+    all_calls: list[tuple[str, str, frozenset]] = []
+    for module in ctx.modules:
+        acc, guarded, calls = collect_module(ctx, module)
+        all_accesses.extend(acc)
+        all_guarded.extend(guarded)
+        all_calls.extend(calls)
+    inherited = _inherited_locks(ctx, all_calls)
+    by_cell: dict[tuple, list[Access]] = {}
+    for a in all_accesses:
+        by_cell.setdefault(a.cell, []).append(a)
+    guarded_by_cell: dict[tuple, list[GuardedMutation]] = {}
+    for g in all_guarded:
+        guarded_by_cell.setdefault(g.cell, []).append(g)
+
+    for cell in sorted(by_cell):
+        findings.extend(_judge_cell(
+            ctx, roots_map, cell, by_cell[cell],
+            guarded_by_cell.get(cell, ()), inherited))
+    findings.extend(_lock_inversions(ctx, roots_map))
+    return findings
+
+
+def _inherited_locks(ctx, call_sites) -> dict[str, frozenset]:
+    """Caller-held locks a function can count on: when EVERY known call
+    site of a helper runs with lock L held, the helper's accesses
+    inherit L (``PeerManager._info`` mutates bare, but every caller
+    holds ``self._lock`` — serialized by contract, not a race).  Thread
+    entry points never inherit: their primary caller is the spawner.
+    Inheritance only ADDS locks, so it can only suppress a finding —
+    the conservative direction lint needs."""
+    entry_keys = {k for r in threads.collect_roots(ctx)
+                  for k in r.entry_keys}
+    callers: dict[str, list[tuple[str, frozenset]]] = {}
+    for caller, callee, locks in call_sites:
+        if callee == caller or callee in entry_keys:
+            continue
+        callers.setdefault(callee, []).append((caller, locks))
+    inherited: dict[str, frozenset] = {}
+    for _ in range(3):
+        changed = False
+        for callee, sites in callers.items():
+            vals = [locks | inherited.get(caller, frozenset())
+                    for caller, locks in sites]
+            new = frozenset.intersection(*vals)
+            if new and inherited.get(callee, frozenset()) != new:
+                inherited[callee] = new
+                changed = True
+        if not changed:
+            break
+    return inherited
+
+
+def _roots_of_accesses(roots_map, accesses) -> frozenset:
+    out: set = set()
+    for a in accesses:
+        out |= threads.roots_of(roots_map, a.fn_key)
+    return frozenset(out)
+
+
+def _module_of(ctx: Context, cell: tuple):
+    return ctx.by_pkg_rel.get(cell[1])
+
+
+def _judge_cell(ctx, roots_map, cell, accesses, guarded,
+                inherited) -> list[Finding]:
+    writes = [a for a in accesses if a.kind in ("store", "rmw", "mutate")]
+    if not writes:
+        return []
+    roots = _roots_of_accesses(roots_map, accesses)
+    if len(roots) < 2:
+        return []      # confined to one root: not shared
+    module = _module_of(ctx, cell)
+    if module is None:
+        return []
+    label = _cell_label(cell)
+    root_text = ", ".join(sorted(roots)[:4]) + (
+        ", ..." if len(roots) > 4 else "")
+
+    def eff(a: Access) -> frozenset:
+        return a.locks | inherited.get(a.fn_key, frozenset())
+
+    compound = [a for a in writes if a.kind in ("rmw", "mutate")]
+    if compound:
+        # the single-writer exemption: compound updates confined to ONE
+        # root race with nothing — cross-root single-key reads are
+        # GIL-atomic (the blessed confined-writer idiom).  Only
+        # cross-root ITERATION of an in-place-mutated container (torn
+        # multi-key state, "changed size during iteration") re-arms
+        # the gate.
+        mut_roots = _roots_of_accesses(roots_map, compound)
+        has_inplace = any(a.kind == "mutate" for a in compound)
+        cross_iter = [
+            a for a in accesses if a.kind == "read-iter"
+            and threads.roots_of(roots_map, a.fn_key) - mut_roots
+        ] if has_inplace else []
+        if len(mut_roots) >= 2 or cross_iter:
+            participating = compound + cross_iter
+            locksets = [eff(a) for a in participating]
+            common = frozenset.intersection(*locksets)
+            anchor = min(compound, key=lambda a: (eff(a) != frozenset(),
+                                                  a.line))
+            if all(not ls for ls in locksets):
+                if not _suppressed(ctx, module, "LH1003",
+                                   "unlocked-shared-state",
+                                   participating):
+                    return [Finding(
+                        "LH1003", "unlocked-shared-state", module.rel,
+                        anchor.line, label,
+                        f"`{label}` is mutated in place with no lock on "
+                        f"any access path, but is reachable from "
+                        f"multiple thread roots ({root_text}); add a "
+                        f"lock or publish an immutable snapshot")]
+                return []
+            if not common:
+                bare = next((a for a in participating if not eff(a)),
+                            None)
+                locked = next((a for a in participating if eff(a)),
+                              None)
+                where = ""
+                if bare is not None and locked is not None:
+                    where = (f"; e.g. line {locked.line} holds "
+                             f"{sorted(eff(locked))[0].rsplit(':', 1)[-1]} "
+                             f"while line {bare.line} holds nothing")
+                if not _suppressed(ctx, module, "LH1001",
+                                   "racy-compound-update",
+                                   participating):
+                    return [Finding(
+                        "LH1001", "racy-compound-update", module.rel,
+                        anchor.line, label,
+                        f"compound updates of `{label}` run under "
+                        f"disjoint lock sets across thread roots "
+                        f"({root_text}){where}; every compound access "
+                        f"needs a common lock")]
+                return []
+    # locks exist and intersect (or writes are all plain stores /
+    # single-writer): check-then-act is the remaining reportable shape
+    for g in sorted(guarded, key=lambda g: (g.act_line, g.guard_line)):
+        if inherited.get(g.fn_key):
+            continue   # a caller-held lock spans the check AND the act
+        if _suppressed_lines(ctx, module, "LH1002", "check-then-act",
+                             (g.guard_line, g.act_line)):
+            continue
+        fn = g.fn_key.partition("::")[2]
+        return [Finding(
+            "LH1002", "check-then-act", module.rel, g.act_line, label,
+            f"`{fn}` checks `{label}` (line {g.guard_line}) and "
+            f"mutates it (line {g.act_line}) without one continuous "
+            f"lock hold spanning both, and the cell is shared across "
+            f"thread roots ({root_text}); hold the lock across the "
+            f"check and the act")]
+    return []
+
+
+def _suppressed(ctx, module, rule, name, accesses) -> bool:
+    return ctx.suppressed(module, rule, name,
+                          *[a.line for a in accesses])
+
+
+def _suppressed_lines(ctx, module, rule, name, lines) -> bool:
+    return ctx.suppressed(module, rule, name, *lines)
+
+
+# -- LH1004: interprocedural lock-order inversion -----------------------------
+
+_INV_DEPTH = 3
+
+
+def _lock_blocks_of(ctx, module):
+    from tools.lint.locks import _with_lock_blocks
+
+    return _with_lock_blocks(module)
+
+
+def _lock_pairs(ctx) -> dict[tuple, list]:
+    """(outer id, inner id) -> occurrences; lexical pairs and pairs
+    discovered through resolved call chains out of a with-lock body."""
+    from tools.lint.locks import _direct_calls, _with_lock_blocks, \
+        _with_lock_blocks_in
+
+    ti = threads.type_index(ctx)
+    pairs: dict[tuple, list] = {}
+    # every function's own lock acquisitions (for the BFS)
+    acquires: dict[str, list[tuple[str, int]]] = {}
+    for module in ctx.modules:
+        for with_node, lock_text, qual in _with_lock_blocks(module):
+            cls = ti.enclosing_class(module.pkg_rel, qual) \
+                if qual != "<module>" else None
+            ident = _scoped_identity(module, cls, lock_text)
+            acquires.setdefault(f"{module.pkg_rel}::{qual}", []).append(
+                (ident, with_node.lineno))
+
+    for module in ctx.modules:
+        for with_node, lock_text, qual in _with_lock_blocks(module):
+            cls = ti.enclosing_class(module.pkg_rel, qual) \
+                if qual != "<module>" else None
+            outer_id = _scoped_identity(module, cls, lock_text)
+            fn_key = f"{module.pkg_rel}::{qual}"
+            # lexical nesting (LH103's domain — recorded for cycle
+            # matching, marked lexical so pure-lexical cycles defer)
+            for inner, inner_text, _q in _with_lock_blocks_in(
+                    with_node.body, module):
+                inner_id = _scoped_identity(module, cls, inner_text)
+                pairs.setdefault((outer_id, inner_id), []).append(
+                    (module, inner.lineno, qual, fn_key, True, ()))
+            # interprocedural: BFS resolved calls out of the body
+            start = set()
+            for call in _direct_calls(with_node.body):
+                text = dotted_name(call.func)
+                if text is None and isinstance(call.func, ast.Call):
+                    continue
+                edge = _resolve_body_call(ctx, ti, module, qual, call)
+                if edge:
+                    start.add(edge)
+            seen: set[str] = set()
+            frontier = list(start)
+            depth = 0
+            path_hint = tuple(sorted(start))
+            while frontier and depth < _INV_DEPTH:
+                nxt = []
+                for key in frontier:
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    for ident, line in acquires.get(key, ()):
+                        pairs.setdefault((outer_id, ident), []).append(
+                            (module, with_node.lineno, qual, fn_key,
+                             False, (key,)))
+                    nxt.extend(threads.extended_edges(ctx, key))
+                frontier = nxt
+                depth += 1
+    return pairs
+
+
+def _resolve_body_call(ctx, ti, module, qual, call) -> str | None:
+    text = dotted_name(call.func)
+    if not text:
+        return None
+    from tools.lint.threads import _resolve_callable_name
+
+    return _resolve_callable_name(ctx, ti, module, qual, text)
+
+
+def _scoped_identity(module, cls, lock_text: str) -> str:
+    if lock_text.startswith("self.") and cls:
+        return f"{module.pkg_rel}:{cls}:{lock_text}"
+    return _lock_identity(module, lock_text)
+
+
+def _lock_inversions(ctx, roots_map) -> list[Finding]:
+    pairs = _lock_pairs(ctx)
+    findings: list[Finding] = []
+    reported: set = set()
+    for (a, b), occurrences in sorted(pairs.items()):
+        if a == b or (b, a) not in pairs:
+            continue
+        key = tuple(sorted((a, b)))
+        if key in reported:
+            continue
+        fwd = occurrences
+        rev = pairs[(b, a)]
+        # purely lexical cycles are LH103's finding, not ours
+        if all(o[4] for o in fwd) and all(o[4] for o in rev):
+            continue
+        reported.add(key)
+        occ = next((o for o in fwd if not o[4]), fwd[0])
+        module, line, qual, fn_key, _lex, via = occ
+        roots = threads.roots_of(roots_map, fn_key)
+        rev_occ = next((o for o in rev if not o[4]), rev[0])
+        rev_roots = threads.roots_of(roots_map, rev_occ[3])
+        if ctx.suppressed(module, "LH1004",
+                          "lock-inversion-across-threads", line):
+            continue
+        short_a = a.rsplit(":", 1)[-1]
+        short_b = b.rsplit(":", 1)[-1]
+        via_text = f" via {via[0]}" if via else ""
+        findings.append(Finding(
+            "LH1004", "lock-inversion-across-threads", module.rel, line,
+            f"{qual}:{short_a}->{short_b}",
+            f"lock order {short_a} -> {short_b}{via_text} (roots: "
+            f"{', '.join(sorted(roots))}) conflicts with {short_b} -> "
+            f"{short_a} at {rev_occ[0].rel}:{rev_occ[1]} (roots: "
+            f"{', '.join(sorted(rev_roots))}); deadlock risk across "
+            f"threads"))
+    return findings

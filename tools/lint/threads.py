@@ -1,0 +1,845 @@
+"""Thread-root discovery + the checked-in thread-root manifest.
+
+The client runs a growing set of concurrent roots — ``TaskExecutor``
+spawns (the beacon-processor asyncio loop, the AOT prewarmer, the
+periodic slot services), raw ``threading.Thread`` daemons (wire loop,
+UPnP renewer, HTTP servers, watchdog deadlines, the invariant sweeper),
+and ``asyncio.run_coroutine_threadsafe`` submissions into the wire
+loop.  PR 8 and PR 12 both lost review rounds to cross-thread races
+precisely because that root set existed only in reviewers' heads.
+
+This module makes it a checked-in artifact, exactly like the jit shape
+manifest: a package-wide AST sweep finds every spawn site and emits
+``tools/lint/thread_roots.json`` (id, spawn site, entry function,
+thread name, lifecycle).  ``python -m tools.lint --thread-roots``
+regenerates it; ``tests/test_lint.py`` asserts byte-identical sync AND
+that an independent sweep finds no spawn site the manifest misses.
+
+On top of the manifest this module computes the **root closures** the
+LH1001-1004 race pass consumes: for each root whose entry function is
+statically resolvable, the set of package functions reachable from it.
+Reachability extends the PR 3 call graph with a lightweight
+constructor-type layer (``self.x = ClassName(...)``, typed locals,
+module-global instances, annotated parameters) so ``self.admission.
+sweep()``-shaped dispatches resolve across modules.  Both layers are
+deliberately conservative: an unresolvable entry (``self._srv.
+serve_forever``) contributes an EMPTY closure — a missed edge can only
+miss a finding, never invent one.
+
+Coroutines submitted to a loop owned by the same class as a thread
+root (``run_coroutine_threadsafe(co(), self.loop)`` next to
+``Thread(target=self._run_loop)``) are attributed to THAT root: they
+execute on the loop thread, so counting them as independent roots
+would invent sharing inside a single-threaded asyncio plane.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+from dataclasses import dataclass, field
+
+from tools.lint.callgraph import dotted_name
+
+MANIFEST_VERSION = 1
+
+#: call terminals that spawn concurrent execution (the independent
+#: coverage sweep in tests/test_lint.py greps for exactly these)
+SPAWN_TERMINALS = ("Thread", "spawn", "spawn_periodic", "spawn_blocking",
+                   "run_coroutine_threadsafe")
+
+_MUT_KIND_BY_TERMINAL = {
+    "Thread": "thread",
+    "spawn": "executor",
+    "spawn_periodic": "periodic",
+    "spawn_blocking": "blocking",
+    "run_coroutine_threadsafe": "coroutine",
+}
+
+
+@dataclass(frozen=True)
+class ThreadRoot:
+    """One spawn site: the unit of the manifest and of root attribution."""
+
+    id: str
+    file: str            # repo-relative path ("lighthouse_tpu/...")
+    pkg_rel: str
+    line: int
+    kind: str            # thread | executor | periodic | blocking | coroutine
+    spawner: str         # enclosing qualname ("<module>" at top level)
+    entry: str           # resolved fn key, or "~<dotted>" when opaque
+    entry_keys: tuple    # resolved package fn keys the closure BFS seeds from
+    name: str | None     # thread-name literal when statically visible
+    daemon: bool | None
+    lifecycle: str       # loop | oneshot | periodic | server | pool | coroutine
+    #: merged attribution id (coroutine roots fold into their loop's
+    #: thread root); everything else attributes as itself
+    attribution: str = ""
+
+    @property
+    def root_id(self) -> str:
+        return self.attribution or self.id
+
+
+# -- the constructor-type layer ------------------------------------------------
+
+
+class TypeIndex:
+    """Package-wide constructor/annotation typing, just deep enough to
+    resolve ``self.attr.method()`` / ``local.method()`` dispatch chains
+    the bare call graph cannot."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        pkg_name = ctx.pkg_root.name
+        known = {m.pkg_rel for m in ctx.modules}
+        #: bare class name -> defining pkg_rel (unique names only)
+        self.classes: dict[str, str] = {}
+        #: (pkg_rel, class qualname) present in the tree
+        self.class_quals: set[tuple[str, str]] = set()
+        ambiguous: set[str] = set()
+        for m in ctx.modules:
+            for qual, node in _classes_of(m.tree):
+                self.class_quals.add((m.pkg_rel, qual))
+                bare = qual.rsplit(".", 1)[-1]
+                if bare in self.classes and self.classes[bare] != m.pkg_rel:
+                    ambiguous.add(bare)
+                else:
+                    self.classes[bare] = m.pkg_rel
+        for name in ambiguous:
+            self.classes.pop(name, None)
+
+        #: (ClassName, method) -> fn key, unique across the package
+        self.methods: dict[tuple[str, str], str] = {}
+        dup: set[tuple[str, str]] = set()
+        for key, info in ctx.graph.functions.items():
+            if "." not in info.qualname:
+                continue
+            holder, meth = info.qualname.rsplit(".", 1)
+            pkg_rel = key.partition("::")[0]
+            if (pkg_rel, holder) not in self.class_quals:
+                continue
+            bare = holder.rsplit(".", 1)[-1]
+            mk = (bare, meth)
+            if mk in self.methods and self.methods[mk] != key:
+                dup.add(mk)
+            else:
+                self.methods[mk] = key
+        for mk in dup:
+            self.methods.pop(mk, None)
+
+        #: (pkg_rel, ClassName, attr) -> ClassName of the instance
+        self.attr_types: dict[tuple[str, str, str], str] = {}
+        #: (pkg_rel, global name) -> ClassName
+        self.global_types: dict[tuple[str, str], str] = {}
+        #: fn key -> {local/param name: ClassName}
+        self.fn_locals: dict[str, dict[str, str]] = {}
+        #: pkg_rel -> {alias: pkg_rel} (module imports)
+        self.module_aliases: dict[str, dict[str, str]] = {}
+        #: pkg_rel -> {name: (pkg_rel, member)} (from-imports)
+        self.member_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        for m in ctx.modules:
+            self._collect_imports(m, pkg_name, known)
+            self._collect_types(m)
+
+    # -- construction ------------------------------------------------------
+
+    def _collect_imports(self, m, pkg_name: str, known: set[str]) -> None:
+        aliases: dict[str, str] = {}
+        members: dict[str, tuple[str, str]] = {}
+        own_pkg = "/".join(m.pkg_rel.split("/")[:-1])
+        # statement-only scan: imports are statements, so expression
+        # subtrees (most of the node count) never need visiting
+        stack: list = [m.tree]
+        while stack:
+            parent = stack.pop()
+            for node in ast.iter_child_nodes(parent):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        key = _module_key(alias.name, pkg_name, known)
+                        if key:
+                            aliases[alias.asname
+                                    or alias.name.split(".")[0]] = key
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:
+                        base = own_pkg.split("/") if own_pkg else []
+                        base = base[: len(base) - (node.level - 1)] \
+                            if node.level > 1 else base
+                        mod = ".".join([pkg_name] + base
+                                       + (node.module or "").split(".")
+                                       ).rstrip(".")
+                    else:
+                        mod = node.module or ""
+                    key = _module_key(mod, pkg_name, known)
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        sub = _module_key(f"{mod}.{alias.name}",
+                                          pkg_name, known)
+                        if sub:
+                            aliases[local] = sub
+                        elif key:
+                            members[local] = (key, alias.name)
+                elif isinstance(node, (ast.stmt, ast.excepthandler)):
+                    stack.append(node)
+        self.module_aliases[m.pkg_rel] = aliases
+        self.member_imports[m.pkg_rel] = members
+
+    def _class_of_value(self, value: ast.expr) -> str | None:
+        """ClassName when ``value`` is a visible constructor call."""
+        if not isinstance(value, ast.Call):
+            return None
+        text = dotted_name(value.func)
+        if not text:
+            return None
+        leaf = text.rsplit(".", 1)[-1]
+        if leaf in self.classes and leaf[:1].isupper():
+            return leaf
+        return None
+
+    def _class_of_annotation(self, ann: ast.expr | None) -> str | None:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            leaf = ann.value.strip("'\"").rsplit(".", 1)[-1]
+        else:
+            text = dotted_name(ann)
+            if text is None:
+                return None
+            leaf = text.rsplit(".", 1)[-1]
+        return leaf if leaf in self.classes and leaf[:1].isupper() else None
+
+    def _note_attr_type(self, tgt: ast.expr, got: str, m,
+                        cls: str | None, local: dict[str, str]) -> None:
+        """``self.x = C()`` types attr x of the enclosing class;
+        ``obj.x = C()`` where obj is a typed local types attr x of
+        obj's class (``client.processor = BeaconProcessor()``)."""
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)):
+            return
+        holder = tgt.value.id
+        if holder == "self" and cls:
+            self.attr_types[(m.pkg_rel, cls, tgt.attr)] = got
+        elif holder in local:
+            holder_cls = local[holder]
+            holder_pkg = self.classes.get(holder_cls)
+            if holder_pkg is not None:
+                self.attr_types[(holder_pkg, holder_cls, tgt.attr)] = got
+
+    def _collect_types(self, m) -> None:
+        def visit(node, stack, cls, inherited):
+            local = dict(inherited)
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                args = node.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                    got = self._class_of_annotation(a.annotation)
+                    if got:
+                        local[a.arg] = got
+            body = node.body if hasattr(node, "body") else []
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [stmt.name])
+                    visit(stmt, stack + [stmt.name], cls, local)
+                    self.fn_locals.setdefault(f"{m.pkg_rel}::{qual}", {})
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt, stack + [stmt.name],
+                          stmt.name, {})
+                    continue
+                targets: list[tuple[ast.expr, ast.expr]] = []
+                if isinstance(stmt, ast.Assign):
+                    targets.extend((t, stmt.value) for t in stmt.targets)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    targets.append((stmt.target, stmt.value))
+                for tgt, value in targets:
+                    got = self._class_of_value(value)
+                    if got is None:
+                        continue
+                    if isinstance(tgt, ast.Name):
+                        if is_fn:
+                            local[tgt.id] = got
+                        elif not stack:
+                            self.global_types[(m.pkg_rel, tgt.id)] = got
+                    else:
+                        self._note_attr_type(tgt, got, m, cls, local)
+                # recurse into compound statements for nested defs/assigns
+                _walk_nested(stmt, stack, cls, local, self, m, visit)
+            if is_fn:
+                qual = ".".join(stack)
+                self.fn_locals[f"{m.pkg_rel}::{qual}"] = local
+
+        visit(m.tree, [], None, {})
+
+    # -- queries -----------------------------------------------------------
+
+    def enclosing_class(self, pkg_rel: str, qualname: str) -> str | None:
+        """The bare name of the class whose ``self`` a method sees."""
+        parts = qualname.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            holder = ".".join(parts[:i])
+            if (pkg_rel, holder) in self.class_quals:
+                return holder.rsplit(".", 1)[-1]
+        return None
+
+    def method_key(self, class_name: str, meth: str) -> str | None:
+        got = self.methods.get((class_name, meth))
+        if got is not None:
+            return got
+        cls_pkg = self.classes.get(class_name)
+        if cls_pkg is None:
+            return None
+        key = f"{cls_pkg}::{class_name}.{meth}"
+        return key if key in self.ctx.graph.functions else None
+
+    def resolve_chain(self, parts: list[str], pkg_rel: str,
+                      qualname: str) -> str | None:
+        """``a.b.c`` -> method fn key, chasing constructor types."""
+        if len(parts) < 2:
+            return None
+        fn_key = f"{pkg_rel}::{qualname}"
+        locals_map = self.fn_locals.get(fn_key, {})
+        head = parts[0]
+        cls: str | None = None
+        rest = parts[1:]
+        if head == "self":
+            cls = self.enclosing_class(pkg_rel, qualname)
+        elif head in locals_map:
+            cls = locals_map[head]
+        elif (pkg_rel, head) in self.global_types:
+            cls = self.global_types[(pkg_rel, head)]
+        elif head in self.member_imports.get(pkg_rel, {}):
+            src_pkg, member = self.member_imports[pkg_rel][head]
+            cls = self.global_types.get((src_pkg, member))
+        elif head in self.module_aliases.get(pkg_rel, {}) and len(rest) >= 2:
+            src_pkg = self.module_aliases[pkg_rel][head]
+            cls = self.global_types.get((src_pkg, rest[0]))
+            rest = rest[1:]
+        if cls is None:
+            return None
+        for attr in rest[:-1]:
+            holder_pkg = self.classes.get(cls)
+            if holder_pkg is None:
+                return None
+            cls = self.attr_types.get((holder_pkg, cls, attr))
+            if cls is None:
+                return None
+        return self.method_key(cls, rest[-1])
+
+
+def _walk_nested(stmt, stack, cls, local, ti, m, visit) -> None:
+    """Descend into compound statements (if/for/while/with/try) looking
+    for nested defs and typed assignments, without re-entering function
+    or class bodies (those own their scopes).  Statement-only descent:
+    nested defs live in statement bodies, never inside expressions, so
+    skipping expression subtrees keeps this O(statements)."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit(child, stack + [child.name], cls, local)
+            continue
+        if isinstance(child, ast.ClassDef):
+            visit(child, stack + [child.name], child.name, {})
+            continue
+        if isinstance(child, ast.Assign):
+            got = ti._class_of_value(child.value)
+            if got is not None:
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Name):
+                        local[tgt.id] = got
+                    else:
+                        ti._note_attr_type(tgt, got, m, cls, local)
+            continue
+        if isinstance(child, (ast.stmt, ast.excepthandler)):
+            _walk_nested(child, stack, cls, local, ti, m, visit)
+
+
+def _classes_of(tree) -> list[tuple[str, ast.ClassDef]]:
+    out: list[tuple[str, ast.ClassDef]] = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                out.append((".".join(stack + [child.name]), child))
+                visit(child, stack + [child.name])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, stack + [child.name])
+            elif isinstance(child, (ast.stmt, ast.excepthandler)):
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+def _module_key(dotted_module: str, pkg_name: str,
+                known: set[str]) -> str | None:
+    if dotted_module == pkg_name:
+        return "__init__.py" if "__init__.py" in known else None
+    prefix = pkg_name + "."
+    if not dotted_module.startswith(prefix):
+        return None
+    rel = dotted_module[len(prefix):].replace(".", "/")
+    if rel + ".py" in known:
+        return rel + ".py"
+    if rel + "/__init__.py" in known:
+        return rel + "/__init__.py"
+    return None
+
+
+# -- spawn-site discovery ------------------------------------------------------
+
+
+@dataclass
+class _SpawnSite:
+    module: object
+    call: ast.Call
+    spawner: str         # enclosing qualname
+    kind: str
+
+
+def _spawn_sites(ctx) -> list[_SpawnSite]:
+    out: list[_SpawnSite] = []
+    for m in ctx.modules:
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                child_stack = stack
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    child_stack = stack + [child.name]
+                elif isinstance(child, ast.Call):
+                    kind = _spawn_kind(child)
+                    if kind is not None:
+                        out.append(_SpawnSite(
+                            m, child, ".".join(stack) or "<module>", kind))
+                visit(child, child_stack)
+
+        visit(m.tree, [])
+    return out
+
+
+def _spawn_kind(call: ast.Call) -> str | None:
+    text = dotted_name(call.func)
+    if text is None:
+        return None
+    terminal = text.rsplit(".", 1)[-1]
+    kind = _MUT_KIND_BY_TERMINAL.get(terminal)
+    if kind is None:
+        return None
+    if kind == "thread":
+        # `threading.Thread(...)` / `_threading.Thread(...)` / bare
+        # `Thread(...)` import — but not `SomeClass.Thread` lookalikes
+        root = text.split(".", 1)[0]
+        if "." in text and "threading" not in root.lower():
+            return None
+        return kind
+    if kind == "coroutine":
+        return kind if call.args else None
+    # executor spawns: method call with a callable-looking first arg
+    if "." not in text or not call.args:
+        return None
+    first = call.args[0]
+    if isinstance(first, (ast.Name, ast.Attribute, ast.Lambda)):
+        return kind
+    return None
+
+
+def _const_kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def _callable_expr(site: _SpawnSite) -> ast.expr | None:
+    """The expression naming the code the new thread runs."""
+    call = site.call
+    if site.kind == "thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return call.args[0] if call.args else None
+    if site.kind == "coroutine":
+        first = call.args[0]
+        return first.func if isinstance(first, ast.Call) else first
+    return call.args[0] if call.args else None
+
+
+def _thread_name(site: _SpawnSite) -> str | None:
+    call = site.call
+    if site.kind == "thread":
+        got = _const_kwarg(call, "name")
+        return got if isinstance(got, str) else None
+    if site.kind in ("executor", "periodic"):
+        idx = 1 if site.kind == "executor" else 2
+        got = _const_kwarg(call, "name")
+        if isinstance(got, str):
+            return got
+        if len(call.args) > idx and isinstance(call.args[idx], ast.Constant) \
+                and isinstance(call.args[idx].value, str):
+            return call.args[idx].value
+    return None
+
+
+def _resolve_entry(ctx, ti: TypeIndex, site: _SpawnSite
+                   ) -> tuple[str, tuple[str, ...]]:
+    """(entry label, closure seed keys) for a spawn site's callable."""
+    m = site.module
+    expr = _callable_expr(site)
+    if expr is None:
+        return "~<unknown>", ()
+    if isinstance(expr, ast.Lambda):
+        keys = _lambda_entry_keys(ctx, ti, m, site.spawner, expr)
+        return "<lambda>", tuple(sorted(keys))
+    text = dotted_name(expr)
+    if text is None:
+        return "~<expr>", ()
+    key = _resolve_callable_name(ctx, ti, m, site.spawner, text)
+    if key is not None:
+        return key, (key,)
+    return "~" + text, ()
+
+
+def _resolve_callable_name(ctx, ti: TypeIndex, m, spawner: str,
+                           text: str) -> str | None:
+    parts = text.split(".")
+    if len(parts) == 1:
+        # bare name: a nested def in an enclosing scope, a module-level
+        # function, or a from-import
+        name = parts[0]
+        prefixes = []
+        if spawner != "<module>":
+            segs = spawner.split(".")
+            prefixes = [".".join(segs[:i]) for i in range(len(segs), 0, -1)]
+        for prefix in prefixes + [""]:
+            qual = f"{prefix}.{name}" if prefix else name
+            key = f"{m.pkg_rel}::{qual}"
+            if key in ctx.graph.functions:
+                return key
+        imported = ti.member_imports.get(m.pkg_rel, {}).get(name)
+        if imported is not None:
+            key = f"{imported[0]}::{imported[1]}"
+            if key in ctx.graph.functions:
+                return key
+        return None
+    if parts[0] == "self" and len(parts) == 2 and spawner != "<module>":
+        cls = ti.enclosing_class(m.pkg_rel, spawner)
+        if cls is not None:
+            key = ti.method_key(cls, parts[1])
+            if key is not None:
+                return key
+    return ti.resolve_chain(parts, m.pkg_rel, spawner)
+
+
+def _lambda_entry_keys(ctx, ti: TypeIndex, m, spawner: str,
+                       lam: ast.Lambda) -> set[str]:
+    keys: set[str] = set()
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Call):
+            text = dotted_name(node.func)
+            if text:
+                got = _resolve_callable_name(ctx, ti, m, spawner, text)
+                if got:
+                    keys.add(got)
+    return keys
+
+
+def _lifecycle(ctx, site: _SpawnSite, entry: str) -> str:
+    if site.kind == "periodic":
+        return "periodic"
+    if site.kind == "blocking":
+        return "pool"
+    if site.kind == "coroutine":
+        return "coroutine"
+    terminal = entry.rsplit(".", 1)[-1]
+    if terminal == "serve_forever":
+        return "server"
+    info = ctx.graph.functions.get(entry)
+    if info is not None:
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.While):
+                return "loop"
+            if isinstance(n, ast.Call):
+                text = dotted_name(n.func)
+                if text and text.rsplit(".", 1)[-1] in (
+                        "run_forever", "serve_forever"):
+                    return "loop"
+    return "oneshot"
+
+
+def _daemon_flag(site: _SpawnSite) -> bool | None:
+    if site.kind == "thread":
+        got = _const_kwarg(site.call, "daemon")
+        return got if isinstance(got, bool) else None
+    if site.kind in ("executor", "periodic"):
+        return True    # TaskExecutor threads are daemonic by construction
+    return None
+
+
+def collect_roots(ctx) -> list[ThreadRoot]:
+    """Every spawn site in the package, entries resolved, coroutine
+    roots folded into their owning loop's thread root."""
+    cached = getattr(ctx, "_thread_roots", None)
+    if cached is not None:
+        return cached
+    ti = type_index(ctx)
+    sites = _spawn_sites(ctx)
+    roots: list[ThreadRoot] = []
+    used_ids: dict[str, int] = {}
+    #: (pkg_rel, class) -> thread-root id, for coroutine folding
+    loop_owner: dict[tuple[str, str | None], str] = {}
+    prelim: list[tuple[_SpawnSite, str, tuple, str | None]] = []
+    for site in sites:
+        entry, entry_keys = _resolve_entry(ctx, ti, site)
+        name = _thread_name(site)
+        prelim.append((site, entry, entry_keys, name))
+    # pass 1: mint ids for non-coroutine roots (thread roots register as
+    # loop owners for their class)
+    minted: list[tuple[_SpawnSite, str, tuple, str | None, str]] = []
+    for site, entry, entry_keys, name in prelim:
+        label = name or (entry.rsplit(".", 1)[-1]
+                         if not entry.startswith("~")
+                         else entry.lstrip("~").rsplit(".", 1)[-1])
+        base = f"{site.module.pkg_rel}::{site.spawner}@{label}"
+        n = used_ids.get(base, 0)
+        used_ids[base] = n + 1
+        rid = base if n == 0 else f"{base}#{n + 1}"
+        minted.append((site, entry, entry_keys, name, rid))
+        if site.kind == "thread":
+            cls = ti.enclosing_class(site.module.pkg_rel, site.spawner)
+            loop_owner.setdefault((site.module.pkg_rel, cls), rid)
+    for site, entry, entry_keys, name, rid in minted:
+        attribution = ""
+        if site.kind == "coroutine":
+            cls = ti.enclosing_class(site.module.pkg_rel, site.spawner)
+            owner = loop_owner.get((site.module.pkg_rel, cls))
+            if owner is not None:
+                attribution = owner
+        roots.append(ThreadRoot(
+            id=rid, file=site.module.rel, pkg_rel=site.module.pkg_rel,
+            line=site.call.lineno, kind=site.kind, spawner=site.spawner,
+            entry=entry, entry_keys=entry_keys, name=name,
+            daemon=_daemon_flag(site),
+            lifecycle=_lifecycle(ctx, site, entry),
+            attribution=attribution))
+    roots.sort(key=lambda r: (r.file, r.line, r.id))
+    ctx._thread_roots = roots
+    ctx._loop_owner = loop_owner
+    return roots
+
+
+def type_index(ctx) -> TypeIndex:
+    ti = getattr(ctx, "_type_index", None)
+    if ti is None:
+        ti = TypeIndex(ctx)
+        ctx._type_index = ti
+    return ti
+
+
+# -- root closures -------------------------------------------------------------
+
+#: tree fingerprint -> {fn key: frozenset of root ids}; in-process memo
+#: mirroring dataflow._MODULE_CACHE so the fixture-heavy suite and warm
+#: CLI reruns pay the closure BFS once per tree state
+_CLOSURE_CACHE: dict[int, dict[str, frozenset]] = {}
+
+#: the pseudo-root for functions no spawn closure reaches (they run on
+#: whichever thread calls them — the main thread until proven otherwise)
+MAIN_ROOT = "<main>"
+
+_CLOSURE_DEPTH = 64
+
+
+def _tree_key(ctx) -> int:
+    def mtime(path):
+        try:
+            return path.stat().st_mtime_ns
+        except OSError:
+            return -1
+
+    return hash(tuple(sorted((str(m.path), mtime(m.path))
+                             for m in ctx.modules)))
+
+
+def extended_edges(ctx, fn_key: str) -> frozenset:
+    """Resolved callees of ``fn_key``: call-graph edges plus the
+    constructor-typed ``obj.method()`` / ``self.attr.method()`` chains
+    the bare graph cannot see.  Cached per context."""
+    cache = getattr(ctx, "_edge_cache", None)
+    if cache is None:
+        cache = ctx._edge_cache = {}
+    got = cache.get(fn_key)
+    if got is not None:
+        return got
+    ti = type_index(ctx)
+    info = ctx.graph.functions.get(fn_key)
+    if info is None:
+        cache[fn_key] = frozenset()
+        return cache[fn_key]
+    pkg_rel, _, qual = fn_key.partition("::")
+    out: set[str] = set()
+    for site in info.calls:
+        if site.resolved:
+            out.add(site.resolved)
+            continue
+        if not site.dotted:
+            continue
+        parts = site.dotted.split(".")
+        edge = None
+        if len(parts) == 1:
+            edge = _resolve_callable_name(ctx, ti, info.module, qual,
+                                          site.dotted)
+        else:
+            edge = ti.resolve_chain(parts, pkg_rel, qual)
+        if edge is not None:
+            out.add(edge)
+    cache[fn_key] = frozenset(out)
+    return cache[fn_key]
+
+
+def _nested_children(ctx) -> dict[str, list[str]]:
+    """fn key -> function keys lexically nested under it (a loop body
+    defined inside a thread entry runs on that thread)."""
+    cached = getattr(ctx, "_nested_children", None)
+    if cached is not None:
+        return cached
+    out: dict[str, list[str]] = {}
+    for key, info in ctx.graph.functions.items():
+        if "." not in info.qualname:
+            continue
+        pkg_rel = key.partition("::")[0]
+        parts = info.qualname.split(".")
+        # attach to the nearest enclosing FUNCTION (skipping class
+        # holders in the qualname chain)
+        for i in range(len(parts) - 1, 0, -1):
+            parent = f"{pkg_rel}::{'.'.join(parts[:i])}"
+            if parent in ctx.graph.functions:
+                out.setdefault(parent, []).append(key)
+                break
+    ctx._nested_children = out
+    return out
+
+
+def closure_of(ctx, entry_keys) -> set[str]:
+    """Function keys reachable from the entries over call-graph +
+    constructor-typed edges, expanding lexically nested defs with their
+    parents (a loop body defined inside the entry runs on its thread)."""
+    children = _nested_children(ctx)
+    seen: set[str] = set()
+    frontier = [k for k in entry_keys if k in ctx.graph.functions]
+    depth = 0
+    while frontier and depth < _CLOSURE_DEPTH:
+        nxt: list[str] = []
+        for key in frontier:
+            if key in seen:
+                continue
+            seen.add(key)
+            nxt.extend(extended_edges(ctx, key))
+            nxt.extend(children.get(key, ()))
+        frontier = [k for k in nxt if k not in seen]
+        depth += 1
+    return seen
+
+
+def roots_by_function(ctx) -> dict[str, frozenset]:
+    """fn key -> frozenset of root ids whose closure contains it.
+    Functions absent from the map belong to :data:`MAIN_ROOT`."""
+    key = _tree_key(ctx)
+    cached = _CLOSURE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    out: dict[str, set] = {}
+    for root in collect_roots(ctx):
+        if not root.entry_keys:
+            continue
+        for fn_key in closure_of(ctx, root.entry_keys):
+            out.setdefault(fn_key, set()).add(root.root_id)
+    # async methods of a loop-owning class run on that class's loop
+    # thread, regardless of which sync facade lexically defines or
+    # submits them — attributing `request._do` to the CALLER's thread
+    # would invent sharing inside a single-threaded asyncio plane
+    loop_owner = getattr(ctx, "_loop_owner", {})
+    if loop_owner:
+        ti = type_index(ctx)
+        for fn_key, info in ctx.graph.functions.items():
+            if not _runs_on_loop(ctx, ti, fn_key, info):
+                continue
+            pkg_rel = fn_key.partition("::")[0]
+            cls = ti.enclosing_class(pkg_rel, info.qualname)
+            owner = loop_owner.get((pkg_rel, cls))
+            if owner is not None:
+                out[fn_key] = {owner}
+    frozen = {k: frozenset(v) for k, v in out.items()}
+    _CLOSURE_CACHE[key] = frozen
+    return frozen
+
+
+def _runs_on_loop(ctx, ti: TypeIndex, fn_key: str, info) -> bool:
+    """True when the function is an ``async def`` (or is lexically
+    nested inside one) — asyncio code executes on the owning loop."""
+    import ast as _ast
+
+    if isinstance(info.node, _ast.AsyncFunctionDef):
+        return True
+    pkg_rel = fn_key.partition("::")[0]
+    parts = info.qualname.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        parent = ctx.graph.functions.get(
+            f"{pkg_rel}::{'.'.join(parts[:i])}")
+        if parent is not None and isinstance(parent.node,
+                                             _ast.AsyncFunctionDef):
+            return True
+    return False
+
+
+def roots_of(roots_map: dict[str, frozenset], fn_key: str) -> frozenset:
+    return roots_map.get(fn_key) or frozenset((MAIN_ROOT,))
+
+
+# -- the manifest --------------------------------------------------------------
+
+
+def build_thread_manifest(ctx) -> dict:
+    entries: list[dict] = []
+    for root in collect_roots(ctx):
+        entry = {
+            "id": root.id,
+            "file": root.file,
+            "line": root.line,
+            "kind": root.kind,
+            "spawner": root.spawner,
+            "entry": root.entry,
+            "name": root.name,
+            "daemon": root.daemon,
+            "lifecycle": root.lifecycle,
+        }
+        if root.attribution:
+            entry["runs_on"] = root.attribution
+        entries.append(entry)
+    return {"version": MANIFEST_VERSION,
+            "description": "every thread-spawn site in the package "
+                           "(threading.Thread, TaskExecutor spawns, "
+                           "run_coroutine_threadsafe) with its entry "
+                           "function and lifecycle — the root set the "
+                           "LH1001-1004 race pass attributes shared-state "
+                           "accesses to (regenerate: python -m tools.lint "
+                           "--thread-roots)",
+            "roots": entries}
+
+
+def render(manifest: dict) -> str:
+    return json.dumps(manifest, indent=1, sort_keys=False) + "\n"
+
+
+def default_path() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent / "thread_roots.json"
+
+
+def write(manifest: dict, path: pathlib.Path | None = None) -> pathlib.Path:
+    path = pathlib.Path(path) if path is not None else default_path()
+    path.write_text(render(manifest))
+    return path
+
+
+def clear_cache() -> None:
+    """Drop the closure memo (tests)."""
+    _CLOSURE_CACHE.clear()
