@@ -210,6 +210,9 @@ class BeaconApi:
         r("GET", r"/lighthouse/observatory/flight", self.observatory_flight)
         r("GET", r"/lighthouse/observatory/slo", self.observatory_slo)
         r("GET", r"/lighthouse/observatory/jit", self.observatory_jit)
+        r("GET", r"/lighthouse/admin/partition", self.admin_partition_get)
+        r("POST", r"/lighthouse/admin/partition", self.admin_partition)
+        r("POST", r"/lighthouse/admin/fault", self.admin_fault)
         r("GET", r"/eth/v1/node/syncing", self.syncing)
         r("GET", r"/eth/v1/node/identity", self.node_identity)
         r("GET", r"/eth/v1/node/peers", self.node_peers)
@@ -1554,6 +1557,89 @@ class BeaconApi:
 
         return {"data": slo.ENGINE.report()}
 
+    # -- the fleet admin seam (ISSUE 19) ------------------------------------
+    #
+    # A process-fleet parent has no in-memory handle on its nodes: the
+    # partition/fault drills that the in-process simulator applies by
+    # direct call arrive here over the node's OWN bound API port.  The
+    # partition endpoint mirrors network/partition.PartitionSet at the
+    # socket level (refuse + sever, symmetric by installation on both
+    # sides); the fault endpoint re-arms the existing LHTPU_* env-knob
+    # planes in-process, so a running node can enter/leave a drill
+    # window without a relaunch.
+
+    def _wire_node(self):
+        svc = getattr(self.chain, "network_service", None)
+        node = getattr(getattr(svc, "fabric", None), "node", None)
+        if node is None or not hasattr(node, "set_blocked_peers"):
+            raise ApiError(400, "no socket wire node attached")
+        return node
+
+    def admin_partition(self, body=None):
+        """Install the blocked-peer set: drop live connections to every
+        listed peer id and refuse their redials at the HELLO door.  An
+        empty list heals."""
+        try:
+            d = json.loads(body or b"{}")
+        except ValueError:
+            raise ApiError(400, "body must be JSON")
+        blocked = d.get("blocked")
+        if not isinstance(blocked, list):
+            raise ApiError(400, 'expected {"blocked": [peer ids]}')
+        node = self._wire_node()
+        node.set_blocked_peers(blocked)
+        return {"data": {"blocked": sorted(node.blocked_peers)}}
+
+    def admin_partition_get(self, body=None):
+        return {"data": {
+            "blocked": sorted(self._wire_node().blocked_peers)}}
+
+    _FAULT_ENV_PREFIXES = (
+        "LHTPU_PEERFAULT_", "LHTPU_INGEST_", "LHTPU_FAULT_")
+
+    def admin_fault(self, body=None):
+        """Arm/disarm the env-knob fault planes at runtime: the body's
+        ``env`` map is applied to this process's environment (None
+        deletes a key), then each plane in ``planes`` re-reads its
+        knobs through the SAME ``*_from_env`` + ``install_*`` path the
+        client builder arms at startup — one arming discipline, two
+        doors."""
+        import os
+
+        from lighthouse_tpu.ops import faults
+
+        try:
+            d = json.loads(body or b"{}")
+        except ValueError:
+            raise ApiError(400, "body must be JSON")
+        env = d.get("env") or {}
+        for key in env:
+            if not str(key).startswith(self._FAULT_ENV_PREFIXES):
+                raise ApiError(
+                    400, f"refusing non-fault env key {key!r} "
+                    f"(allowed prefixes: {self._FAULT_ENV_PREFIXES})")
+        for key, value in env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = str(value)
+        armed = {}
+        planes = d.get("planes") or ["peer", "ingest", "offload"]
+        if "peer" in planes:
+            plan = faults.peer_plan_from_env()
+            faults.install_peer_plans((plan,) if plan else ())
+            armed["peer"] = plan.mode if plan else None
+        if "ingest" in planes:
+            plan = faults.ingest_plan_from_env()
+            faults.install_ingest_plan(
+                plan, duration_s=plan.duration_s if plan else None)
+            armed["ingest"] = plan.mode if plan else None
+        if "offload" in planes:
+            plan = faults.plan_from_env()
+            faults.install_plan(plan)
+            armed["offload"] = plan.mode if plan else None
+        return {"data": {"armed": armed}}
+
     def observatory_jit(self, body=None):
         """Manifest-keyed device-runtime telemetry: per-entry compile/
         dispatch stats (including the serving ``source`` —
@@ -1664,10 +1750,24 @@ class _Handler(BaseHTTPRequestHandler):
 class HttpServer:
     """Threaded HTTP server on an ephemeral localhost port."""
 
+    # fixed-port collisions (multi-node hosts): walk successive ports,
+    # then fall back to ephemeral — callers read .port for the truth
+    PORT_BIND_RETRIES = 8
+
     def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+        import errno
+
         self.api = BeaconApi(chain)
         handler = type("Handler", (_Handler,), {"api": self.api})
-        self._srv = ThreadingHTTPServer((host, port), handler)
+        for attempt in range(self.PORT_BIND_RETRIES + 1):
+            try:
+                self._srv = ThreadingHTTPServer((host, port), handler)
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or port == 0:
+                    raise
+                port = (0 if attempt >= self.PORT_BIND_RETRIES - 1
+                        else port + 1)
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True)

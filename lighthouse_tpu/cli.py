@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 
 
@@ -63,6 +64,17 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--listen-port", type=int, default=None,
                     help="TCP+UDP wire port (0 = ephemeral); omit to run "
                          "without the socket network stack")
+    bn.add_argument("--seconds-per-slot", type=int, default=None,
+                    help="dev-only slot pacing override (process-fleet "
+                         "devnets walk fast slots; None = the spec's)")
+    bn.add_argument("--identity-seed", default=None,
+                    help="deterministic wire identity seed: the node "
+                         "keeps its peer id across restarts (fleets); "
+                         "None = random per start")
+    bn.add_argument("--interop-vc", default=None, metavar="LO:HI",
+                    help="run an in-process duty loop for interop "
+                         "validators [LO, HI) — the process-fleet "
+                         "analogue of the simulator's validator split")
     bn.add_argument("--boot-nodes", default=None,
                     help="comma-separated host:port discovery bootstrap "
                          "addresses")
@@ -190,16 +202,20 @@ def _run_bn(args) -> int:
     # rates and pick the merkle device thresholds for THIS host (the
     # static defaults assume a real TPU; an XLA-CPU fallback node would
     # route mid-sized trees to the slower path).  LHTPU_SHA_DEVICE_MIN
-    # pins the threshold and skips the measurement.
-    try:
-        from lighthouse_tpu.ops import sha256 as _sha_ops
+    # pins the threshold and skips the measurement.  Fake-crypto nodes
+    # (process-fleet drills) skip it entirely: they never route device
+    # work, and a fleet paying N calibration warmups serially would
+    # blow its launch deadline
+    if args.bls_backend != "fake":
+        try:
+            from lighthouse_tpu.ops import sha256 as _sha_ops
 
-        _sha_ops.calibrate_device_thresholds()
-    except Exception as e:
-        # never block node startup on a calibration failure
-        from lighthouse_tpu.common.metrics import record_swallowed
+            _sha_ops.calibrate_device_thresholds()
+        except Exception as e:
+            # never block node startup on a calibration failure
+            from lighthouse_tpu.common.metrics import record_swallowed
 
-        record_swallowed("cli.sha_calibration", e)
+            record_swallowed("cli.sha_calibration", e)
 
     cfg = ClientConfig(
         network=args.network,
@@ -222,8 +238,39 @@ def _run_bn(args) -> int:
         builder_url=args.builder,
         trusted_setup_path=args.trusted_setup,
         monitoring_endpoint=args.monitoring_endpoint,
+        seconds_per_slot=args.seconds_per_slot,
+        identity_seed=args.identity_seed,
+        interop_vc_range=(tuple(int(x) for x in args.interop_vc.split(":"))
+                          if args.interop_vc else None),
     )
+
+    # SIGTERM/SIGINT run the ORDERLY path — persist-frame + store close
+    # + clean dirty-marker — so a fleet's stop() (SIGTERM) and kill()
+    # (SIGKILL) have genuinely distinct on-disk semantics.  Installed
+    # before the build: a TERM racing a slow assembly still lands
+    import signal
+
+    _stop_requested = threading.Event()
+    _client_box: list = [None]
+
+    def _graceful(signum, frame):
+        _stop_requested.set()
+        c = _client_box[0]
+        if c is not None:
+            c.executor.exit_event.set()
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(_sig, _graceful)
+        except ValueError:
+            # not the main thread (embedded use) — the KeyboardInterrupt
+            # fallback below still covers interactive ^C
+            break
+
     client = ClientBuilder(cfg).build()
+    _client_box[0] = client
+    if _stop_requested.is_set():
+        client.executor.exit_event.set()
     wire = client.services.get("wire")
     print(json.dumps({
         "running": "bn", "network": client.spec.config_name,

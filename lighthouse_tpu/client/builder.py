@@ -71,6 +71,19 @@ class ClientConfig:
     # remote monitoring service URL; None = disabled (reference
     # --monitoring-endpoint, common/monitoring_api/src/lib.rs:51)
     monitoring_endpoint: str | None = None
+    # dev-only slot pacing override: a process-fleet devnet walks slots
+    # at seconds, not the preset's 6/12; None = the spec's own value
+    seconds_per_slot: int | None = None
+    # deterministic wire identity (the peer id is the Ed25519 key's
+    # fingerprint): a fleet node keeps its peer id across SIGKILL +
+    # relaunch, so partition sets installed by name stay valid.  None =
+    # a random identity per start (production)
+    identity_seed: str | None = None
+    # in-process interop duty loop: (lo, hi) assigns interop validators
+    # [lo, hi) to a VC thread inside this node — the process-fleet
+    # equivalent of the simulator's per-node validator split.  None =
+    # no duties (a plain beacon node)
+    interop_vc_range: tuple | None = None
 
 
 @dataclass
@@ -148,6 +161,11 @@ class ClientBuilder:
             self.spec = load_network_config(cfg.network_config_path)
         else:
             self.spec = spec_for_network(cfg.network)
+        if cfg.seconds_per_slot:
+            import dataclasses
+
+            self.spec = dataclasses.replace(
+                self.spec, seconds_per_slot=int(cfg.seconds_per_slot))
         return self
 
     def genesis(self, state=None) -> "ClientBuilder":
@@ -161,6 +179,19 @@ class ClientBuilder:
             return self.checkpoint_sync(self.config.checkpoint_sync_url)
         else:
             fork = self.config.genesis_fork
+            if self.spec.fork_at_epoch(0) != fork:
+                # An interop genesis state is built AT `fork`, so the
+                # schedule's epoch-0 fork must agree: otherwise every
+                # fork_at_epoch() consumer (block classes, payload
+                # production, upgrade sweeps) addresses fields the state
+                # does not carry — e.g. a capella-at-0 schedule over an
+                # altair state kills each proposal on a missing
+                # latest_execution_payload_header.  Re-pin the schedule
+                # so --genesis-fork means what it says (the in-process
+                # LocalNetwork pins its spec the same way).
+                self.spec = self.spec.with_forks_at(0, through=fork)
+                self.log.info("fork schedule pinned to interop genesis "
+                              "fork", fork=fork)
             # interop genesis anchored NOW by default so a wall-clock
             # slot clock starts at slot 0 (the reference's interop
             # genesis_time); explicit genesis_time keeps multi-node
@@ -237,6 +268,13 @@ class ClientBuilder:
         store = None
         if self.config.datadir:
             os.makedirs(self.config.datadir, exist_ok=True)
+            # node-scoped flight dumps: unless LHTPU_FLIGHT_DIR pins a
+            # directory, this node's black box lands under its OWN
+            # datadir — N nodes on one host must never race one dir
+            from lighthouse_tpu.common import flight_recorder as _flight
+
+            _flight.set_default_dump_dir(
+                os.path.join(self.config.datadir, "flight"))
             # exclusive datadir ownership: two nodes sharing one DB would
             # corrupt it (reference common/lockfile)
             from lighthouse_tpu.common.utils import Lockfile
@@ -369,6 +407,9 @@ class ClientBuilder:
         client = Client(self.config, self.spec, self.chain, self.executor,
                         lockfile=self._lockfile)
         client.processor = processor = BeaconProcessor()
+        # the observatory roll-up (api.node_rollup) audits the processor
+        # ledger through the chain handle, same as the simulator's nodes
+        self.chain.beacon_processor = processor
 
         def _processor_loop(exit_event):
             """Dedicated asyncio loop for the beacon processor — the
@@ -475,6 +516,9 @@ class ClientBuilder:
             self.log.info("http api listening",
                           port=client.http_server.port)
 
+        if self.config.interop_vc_range:
+            self._interop_vc(client)
+
         # per-slot services: eth1 follow + slasher batches + notifier
         # (reference timer + notifier + slasher service)
         def slot_tick():
@@ -516,6 +560,50 @@ class ClientBuilder:
                           endpoint=self.config.monitoring_endpoint)
         return client
 
+    def _interop_vc(self, client: Client) -> None:
+        """In-process interop duty loop: the process-fleet analogue of
+        the simulator's per-node validator split.  One thread paces the
+        wall clock and runs the full VC tick a third into each slot
+        (the attestation-deadline shape) — gossip-delivered blocks from
+        OTHER nodes land before this node's attesters vote."""
+        from lighthouse_tpu.testing import interop_secret_key
+        from lighthouse_tpu.validator import ValidatorClient, ValidatorStore
+
+        lo, hi = self.config.interop_vc_range
+        store = ValidatorStore(
+            self.spec, bytes(self.genesis_state.genesis_validators_root))
+        for i in range(int(lo), int(hi)):
+            store.add_validator(interop_secret_key(i), index=i)
+        router = (client.network.router
+                  if client.network is not None else None)
+        vc = ValidatorClient(self.chain, store, router=router)
+        client.services["interop_vc"] = vc
+        chain = self.chain
+        self.log.info("interop duty loop armed", validators=hi - lo)
+
+        def duty_loop(exit_event):
+            from lighthouse_tpu.common.metrics import record_swallowed
+
+            # a (re)started node picks up duties at the NEXT slot: the
+            # in-progress slot's proposal window is already compromised
+            last = chain.slot_clock.current_slot()
+            while not exit_event.is_set():
+                clock = chain.slot_clock  # re-read: resume realigns it
+                offset = clock.seconds_per_slot / 3.0
+                slot = clock.current_slot()
+                if slot <= last or clock.seconds_into_slot() < offset:
+                    exit_event.wait(0.05)
+                    continue
+                last = slot
+                try:
+                    vc.run_slot(slot)
+                except Exception as e:
+                    # a failed duty tick misses ITS slot only — the
+                    # loop keeps the node's remaining duties alive
+                    record_swallowed("client.interop_vc", e)
+
+        self.executor.spawn(duty_loop, "interop-vc")
+
     def _wire_network(self, client: Client) -> None:
         """Socket network stack: TCP gossip/RPC + UDP discovery
         (reference network service assembly, network/src/service.rs:160)."""
@@ -524,6 +612,7 @@ class ClientBuilder:
         from lighthouse_tpu.network.wire import WireFabric
 
         fabric = WireFabric(
+            identity_seed=self.config.identity_seed,
             listen_port=self.config.listen_port,
             fork_digest=fork_digest(self.chain),
             transport=self.config.wire_transport)

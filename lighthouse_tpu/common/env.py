@@ -434,6 +434,29 @@ _register("LHTPU_CHAOS_KILL_EVERY", "10",
           "(staggered: at most one node down at a time; floored at "
           "4).")
 
+# -- the process fleet: N beacon nodes as real OS processes
+#    (lighthouse_tpu/fleet, bench --child-socksoak) ---------------------------
+
+_register("LHTPU_FLEET_PROC_NODES", "3",
+          "Node count for the bench --child-socksoak process fleet "
+          "(floored at 3 so one SIGKILLed node leaves quorum).")
+_register("LHTPU_FLEET_PORT_BASE", "0",
+          "Port base for fleet children: 0 = ephemeral everywhere (the "
+          "parent reads ports back from the startup handshake); a "
+          "nonzero base pins node i at base+2i (wire) / base+2i+1 "
+          "(http).")
+_register("LHTPU_FLEET_LAUNCH_S", "45",
+          "Per-node launch deadline in seconds: the child must print "
+          "its startup handshake (ports + peer id) within this or the "
+          "fleet tears down and fails the launch.")
+_register("LHTPU_FLEET_REJOIN_S", "90",
+          "Rejoin deadline in seconds for a relaunched node to catch "
+          "back up to the fleet head (the socksoak lifecycle gate).")
+_register("LHTPU_FLEET_SLOT_S", "3",
+          "Seconds per slot for fleet children (devnet override via "
+          "the bn --seconds-per-slot flag): the process soak runs on "
+          "a real wall clock, so shorter slots bound the drill.")
+
 # -- the pull observatory: per-node scrape discipline (simulator
 #    ScrapeDiscipline, bench --child-scrapewatch) -----------------------------
 

@@ -100,6 +100,10 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
+        # programmatic fallback below the env knob: a datadir-owning
+        # client points this at <datadir>/flight so N nodes on one host
+        # never race one dump directory (set_default_dump_dir)
+        self._default_dump_dir: str | None = None
         self.dump_dir = (dump_dir if dump_dir is not None
                          else envreg.get("LHTPU_FLIGHT_DIR"))
         md = (max_dumps if max_dumps is not None
@@ -322,7 +326,8 @@ class FlightRecorder:
         mutate os.environ after import).  A changed capacity rebuilds
         the ring in place, keeping the newest events."""
         self.enabled = envreg.get_bool("LHTPU_OBS_ARMED", True) is not False
-        self.dump_dir = envreg.get("LHTPU_FLIGHT_DIR")
+        self.dump_dir = (envreg.get("LHTPU_FLIGHT_DIR")
+                         or self._default_dump_dir)
         self.span_floor_ms = max(0.0, envreg.get_float(
             "LHTPU_FLIGHT_SPAN_MS", 50.0) or 0.0)
         self.max_dumps = max(1, envreg.get_int("LHTPU_FLIGHT_DUMPS", 8) or 8)
@@ -342,6 +347,17 @@ def emit(kind: str, **fields) -> None:
     """Module-level convenience: file one event into the process
     recorder (the emit funnel the LH605 lint pass recognizes)."""
     RECORDER.emit(kind, **fields)
+
+
+def set_default_dump_dir(path: str) -> None:
+    """Point the recorder's dump directory at a node-scoped default
+    (``<datadir>/flight``) unless LHTPU_FLIGHT_DIR pins it explicitly.
+    Survives reconfigure(): the env knob stays the override, this stays
+    the fallback — N nodes on one host each dump under their own
+    datadir instead of racing one shared directory."""
+    RECORDER._default_dump_dir = path
+    if not envreg.get("LHTPU_FLIGHT_DIR"):
+        RECORDER.dump_dir = path
 
 
 def trip(reason: str, **fields) -> dict | None:

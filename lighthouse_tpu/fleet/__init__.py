@@ -1,0 +1,34 @@
+"""Process fleet orchestration: N beacon nodes as separate OS processes.
+
+The in-process simulator (simulator.LocalNetwork) proves protocol
+outcomes under composed faults inside ONE interpreter; this package
+moves the same drills out of the sandbox (ROADMAP item 5, ISSUE 19):
+
+- every node is a real ``cli.py bn`` child with its own datadir, bound
+  wire-transport port and bound HTTP API port;
+- ``kill`` is a genuine ``os.kill(pid, SIGKILL)`` — the PR 5 crash
+  ladder (dirty marker -> startup sweep -> try_resume -> range-sync
+  rejoin) meets a truly torn process;
+- ``stop`` is SIGTERM into the cli's orderly handler (persist-frame +
+  store close + clean marker) — the two have distinct on-disk
+  semantics;
+- partitions are socket-level severing through each node's admin seam
+  (POST /lighthouse/admin/partition), mirroring
+  ``network/partition.PartitionSet``;
+- observation is HTTP-only: the PR 13/16 ``FleetObserver`` runs in the
+  parent over ``HttpSource`` against each node's bound API port.
+"""
+
+from lighthouse_tpu.fleet.chaos import FleetChaosController
+from lighthouse_tpu.fleet.fleet import FleetError, FleetNode, ProcessFleet
+from lighthouse_tpu.fleet.scenario import (
+    books_gate,
+    finality_lag_gate,
+    lifecycle_gates,
+    liveness_gate,
+)
+
+__all__ = [
+    "FleetChaosController", "FleetError", "FleetNode", "ProcessFleet",
+    "books_gate", "finality_lag_gate", "lifecycle_gates", "liveness_gate",
+]

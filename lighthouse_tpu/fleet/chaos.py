@@ -1,0 +1,173 @@
+"""FleetChaosController: the PR 15 chaos schedule over real processes.
+
+Consumes the SAME :func:`chain.chaos.build_plan` output the in-process
+``ChaosController`` replays (same seed => byte-identical schedule), but
+applies each window through the process fleet's real seams:
+
+- ``crash``   -> genuine ``SIGKILL`` + relaunch over the surviving
+  datadir (the in-process plane's mid-commit crashpoint params have no
+  process analogue: a torn process IS the crash, wherever it was);
+- ``partition`` -> socket-level sever via each member's admin seam;
+- ``wedge``/``ingest``/``offload``/``peer`` -> the same ``LHTPU_*``
+  env knobs the builder arms at startup, installed into the RUNNING
+  children over ``POST /lighthouse/admin/fault`` (peer plans go to the
+  requester side — every node EXCEPT the victim — exactly like the
+  simulator's discipline-seam injection).
+
+The parent has no object handles, so arming evidence and rejoin resume
+modes are scraped back over HTTP like everything else.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.chain.chaos import ChaosAction, ChaosPlan, _ActionRecord
+from lighthouse_tpu.common import flight_recorder as flight
+from lighthouse_tpu.common.metrics import REGISTRY, record_swallowed
+
+#: env keys each plane arms (disarm POSTs the same keys as ``None``)
+_PLANE_KEYS = {
+    "wedge": ("LHTPU_INGEST_FAULT_MODE", "LHTPU_INGEST_STALL_S",
+              "LHTPU_INGEST_FAULT_S"),
+    "ingest": ("LHTPU_INGEST_FAULT_MODE", "LHTPU_INGEST_FAULT_FACTOR",
+               "LHTPU_INGEST_FAULT_S"),
+    "offload": ("LHTPU_FAULT_MODE", "LHTPU_FAULT_SITE"),
+    "peer": ("LHTPU_PEERFAULT_MODE", "LHTPU_PEERFAULT_PEERS",
+             "LHTPU_PEERFAULT_MAX_FIRES"),
+}
+_PLANE_ADMIN = {"wedge": "ingest", "ingest": "ingest",
+                "offload": "offload", "peer": "peer"}
+
+
+class FleetChaosController:
+    """Applies a :class:`ChaosPlan` to a live :class:`ProcessFleet`.
+
+    Same driving contract as the in-process controller: ``on_slot``
+    once per slot (the parent computes the slot from the shared
+    genesis time), ``quiesce`` at phase end to close anything still
+    open and relaunch anything still dead."""
+
+    def __init__(self, fleet, plan: ChaosPlan):
+        self.fleet = fleet
+        self.plan = plan
+        self._records = [_ActionRecord(a) for a in plan.actions]
+        self.killed: list[str] = []
+        self.restarted: list[tuple[str, str]] = []   # (node, resume_mode)
+        self._armed = 0
+        self._counter = REGISTRY.counter(
+            "fleet_chaos_actions_total",
+            "chaos-plan fault windows applied to the process fleet "
+            "by plane and edge (armed/disarmed)")
+        self._gauge = REGISTRY.gauge(
+            "fleet_chaos_armed_actions",
+            "fault windows currently armed against the process fleet")
+
+    # -- the clock -----------------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        for rec in self._records:
+            if rec.state == "pending" and slot >= rec.action.at_slot:
+                self._arm(rec, slot)
+            elif rec.state == "armed" and slot >= rec.action.until_slot:
+                self._disarm(rec, slot)
+
+    def quiesce(self, slot: int) -> None:
+        for rec in self._records:
+            if rec.state == "armed":
+                self._disarm(rec, slot)
+
+    def armed_planes(self) -> set[str]:
+        return {r.action.plane for r in self._records if r.state == "armed"}
+
+    # -- edges ---------------------------------------------------------------
+
+    def _edge(self, action: ChaosAction, edge: str, slot: int) -> None:
+        self._counter.labels(plane=action.plane, edge=edge).inc()
+        self._gauge.set(self._armed)
+        flight.emit("fleet_chaos_edge", plane=action.plane, edge=edge,
+                    slot=int(slot), node=action.node,
+                    window=[action.at_slot, action.until_slot],
+                    params=dict(action.params))
+
+    def _fault_targets(self, action: ChaosAction) -> list:
+        if action.plane == "peer":
+            # requester-side injection: every live node except the
+            # victim faults its requests TO the victim
+            return [n for n in self.fleet.live_nodes
+                    if n.name != action.node]
+        return list(self.fleet.live_nodes)
+
+    def _fault_env(self, action: ChaosAction) -> dict:
+        a = action
+        if a.plane == "wedge":
+            return {"LHTPU_INGEST_FAULT_MODE": "stall",
+                    "LHTPU_INGEST_STALL_S": str(a.param("stall_s", 0.01)),
+                    # the env path bounds a storm by duration; the
+                    # controller owns the window, so effectively unbound
+                    "LHTPU_INGEST_FAULT_S": "600"}
+        if a.plane == "ingest":
+            return {"LHTPU_INGEST_FAULT_MODE": str(a.param("mode")),
+                    "LHTPU_INGEST_FAULT_FACTOR":
+                        str(a.param("factor", 4.0)),
+                    "LHTPU_INGEST_FAULT_S": "600"}
+        if a.plane == "offload":
+            return {"LHTPU_FAULT_MODE": str(a.param("mode")),
+                    "LHTPU_FAULT_SITE":
+                        ",".join(a.param("sites", ("tpu",)))}
+        if a.plane == "peer":
+            victim = self.fleet.node(a.node)
+            return {"LHTPU_PEERFAULT_MODE": str(a.param("mode")),
+                    "LHTPU_PEERFAULT_PEERS": victim.peer_id or a.node,
+                    "LHTPU_PEERFAULT_MAX_FIRES":
+                        str(a.param("max_fires", 4))}
+        raise ValueError(a.plane)
+
+    def _apply_fault(self, action: ChaosAction, env: dict) -> None:
+        planes = [_PLANE_ADMIN[action.plane]]
+        for node in self._fault_targets(action):
+            try:
+                self.fleet.admin_fault(node.name, env, planes)
+            except Exception as e:
+                # a target dying mid-window must not wedge the plan
+                record_swallowed("fleet.chaos_admin", e)
+
+    def _arm(self, rec: _ActionRecord, slot: int) -> None:
+        a = rec.action
+        if a.plane == "partition":
+            by_name = {n.name: n.index for n in self.fleet.nodes}
+            self.fleet.partition(*[[by_name[name] for name in g]
+                                   for g in a.param("groups")])
+        elif a.plane == "crash":
+            self.fleet.kill(a.node)
+            self.killed.append(a.node)
+        else:
+            self._apply_fault(a, self._fault_env(a))
+        rec.state = "armed"
+        self._armed += 1
+        self._edge(a, "armed", slot)
+
+    def _disarm(self, rec: _ActionRecord, slot: int) -> None:
+        a = rec.action
+        if a.plane == "partition":
+            self.fleet.heal()
+        elif a.plane == "crash":
+            self.fleet.restart(a.node)
+            mode = self._scrape_resume_mode(a.node)
+            self.restarted.append((a.node, mode))
+        else:
+            self._apply_fault(
+                a, {k: None for k in _PLANE_KEYS[a.plane]})
+        rec.state = "done"
+        self._armed -= 1
+        self._edge(a, "disarmed", slot)
+
+    def _scrape_resume_mode(self, name: str) -> str:
+        try:
+            return self.fleet.wait_until(
+                lambda: self.fleet.resume_mode(name),
+                deadline_s=10.0, what=f"{name} resume_mode scrape")
+        except Exception as e:
+            record_swallowed("fleet.chaos_resume_scrape", e)
+            return "unknown"
+
+
+__all__ = ["FleetChaosController"]

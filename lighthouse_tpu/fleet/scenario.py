@@ -1,0 +1,75 @@
+"""Shared chaos-soak gate logic: one set of assertions, two transports.
+
+``bench.py --child-chaossoak`` (in-process ``LocalNetwork``) and
+``--child-socksoak`` (the process fleet) prove the SAME protocol
+outcomes — liveness per phase, non-fresh rejoins, clean books,
+bounded finality lag.  The gates live here so the two scenarios stay
+one calibrated drill over two transports instead of drifting forks.
+
+Stdlib-only and handle-agnostic: every gate takes plain values the
+caller already scraped (object attributes for the simulator, HTTP
+JSON for the fleet), asserts, and returns the derived number so the
+caller can report it.
+"""
+
+from __future__ import annotations
+
+
+def liveness_gate(phase: str, head_before: int, head_after: int,
+                  n_slots: int, min_fraction: float = 0.5) -> int:
+    """The head must advance at least ``min_fraction`` of the slots the
+    phase ran — a wedged fleet fails HERE, not in a downstream average.
+    Returns the gained slot count."""
+    gained = head_after - head_before
+    assert gained >= int(n_slots * min_fraction), (
+        f"liveness lost in {phase}: head advanced {gained} "
+        f"of {n_slots} slots")
+    return gained
+
+
+def lifecycle_gates(resumes, min_killed: int = 2,
+                    allowed=("snapshot", "rebuilt")) -> set:
+    """At least ``min_killed`` DISTINCT nodes died across the run, and
+    every restart resumed from its store image (``allowed`` modes),
+    never fresh.  ``resumes`` is the (node, resume_mode) list both
+    controllers accumulate.  Returns the distinct killed-node set."""
+    killed = {name for name, _ in resumes}
+    assert len(killed) >= min_killed, (
+        f"only {sorted(killed)} were killed (need >= {min_killed})")
+    bad = [(n, m) for n, m in resumes if m not in allowed]
+    assert not bad, f"fresh resumes after kill: {bad}"
+    return killed
+
+
+def books_gate(snapshots, killed=(), require_ledgers=()) -> int:
+    """Zero unaccounted drops fleet-wide across EVERY snapshot; each
+    killed-and-restarted node's per-node books must carry the
+    ``require_ledgers`` families live (proof the rejoined process is
+    doing soak work, not idling).  Returns the worst unaccounted."""
+    snapshots = list(snapshots)
+    assert snapshots, "no observer snapshots to audit"
+    worst = max(s.unaccounted for s in snapshots)
+    assert worst == 0, f"fleet books leak: unaccounted={worst}"
+    if require_ledgers:
+        per_node = snapshots[-1].books["per_node"]
+        for name in killed:
+            ledgers = per_node.get(name) or {}
+            missing = [k for k in require_ledgers if k not in ledgers]
+            assert not missing, (
+                f"{name} restarted without live soak ledgers "
+                f"{missing}: {sorted(ledgers)}")
+    return worst
+
+
+def finality_lag_gate(epoch_now: int, finalized_epoch: int,
+                      bound: int) -> int:
+    """Finality lag at the end of the settle phase stays within
+    ``bound`` epochs.  Returns the lag."""
+    lag = epoch_now - finalized_epoch
+    assert lag <= bound, (
+        f"finality lag {lag} epochs exceeds the {bound} bound")
+    return lag
+
+
+__all__ = ["books_gate", "finality_lag_gate", "lifecycle_gates",
+           "liveness_gate"]

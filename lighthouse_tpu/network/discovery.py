@@ -97,6 +97,19 @@ class Enr:
                    identity_pub=bytes.fromhex(d.get("identity_pub", "")),
                    sig=bytes.fromhex(d.get("sig", "")))
 
+    @staticmethod
+    def try_from_bytes(raw: bytes) -> "Enr | None":
+        """Decode a record a REMOTE handed us, or None when it is
+        garbage.  Every byte of a remote's response is attacker- (or
+        fault-plane-) controlled: a corrupted record must cost the
+        querier one dropped chunk, never a crashed lookup."""
+        try:
+            return Enr.from_bytes(raw)
+        except (ValueError, KeyError, TypeError):
+            # json/hex/int decode failures, missing fields, non-dict
+            # payloads (UnicodeDecodeError is a ValueError)
+            return None
+
 
 def xor_distance(a: bytes, b: bytes) -> int:
     return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
@@ -186,10 +199,12 @@ class Discovery:
     # -- server side --------------------------------------------------------
 
     def _serve_ping(self, src: str, data: bytes) -> list[bytes]:
-        remote = Enr.from_bytes(data)
+        remote = Enr.try_from_bytes(data)
         # only self-describing records on OUR network enter the table
-        # (same eth2-field filter as the client side)
-        if remote.peer_id == src and self._admissible(remote):
+        # (same eth2-field filter as the client side); our reply never
+        # depends on the caller's record decoding
+        if (remote is not None and remote.peer_id == src
+                and self._admissible(remote)):
             with self._table_lock:
                 self.table.insert(remote)
         return [self.enr.to_bytes()]
@@ -212,7 +227,9 @@ class Discovery:
             return None
         if not chunks:
             return None
-        remote = Enr.from_bytes(chunks[0])
+        remote = Enr.try_from_bytes(chunks[0])
+        if remote is None:
+            return None
         # only table peers on our network (the eth2 ENR-field filter the
         # reference applies before dialing, discovery/enr_ext.rs)
         if self._admissible(remote):
@@ -225,7 +242,11 @@ class Discovery:
             chunks = self.rpc.request(peer, P_DISCOVERY_FINDNODE, target)
         except RpcError:
             return []
-        return [Enr.from_bytes(c) for c in chunks]
+        # drop chunks a faulted/Byzantine peer mangled — the soak's
+        # malformed plane XORs response prefixes, and a real network's
+        # FINDNODE answers deserve no more trust
+        found = (Enr.try_from_bytes(c) for c in chunks)
+        return [e for e in found if e is not None]
 
     def lookup(self, target: bytes | None = None,
                max_rounds: int = 8) -> list[Enr]:

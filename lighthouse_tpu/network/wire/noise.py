@@ -30,16 +30,33 @@ from __future__ import annotations
 import hashlib
 import hmac as _hmac
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    CRYPTO_BACKEND = "cryptography"
+except ImportError:
+    # containers without the wheel still get the identical wire
+    # protocol from the RFC-pinned pure-Python fallback (purecrypto
+    # docstring); the wheel wins whenever it is importable
+    from lighthouse_tpu.network.wire.purecrypto import (
+        ChaCha20Poly1305,
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        InvalidSignature,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+
+    CRYPTO_BACKEND = "purecrypto"
 
 PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
 DHLEN = 32

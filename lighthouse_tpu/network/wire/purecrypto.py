@@ -1,0 +1,364 @@
+"""Pure-Python fallback for the ``cryptography`` primitives noise.py uses.
+
+Containers without the ``cryptography`` wheel could not even IMPORT the
+wire stack (the process-fleet drills and the wire tests died at
+collection).  This module implements the three primitives the Noise
+channel needs straight from their RFCs, byte-compatible with the
+``cryptography`` API surface noise.py consumes, so the wire protocol is
+identical whichever backend loads — a fallback node interoperates with
+a wheel-backed node:
+
+- X25519 (RFC 7748): Montgomery-ladder scalar multiplication;
+- Ed25519 (RFC 8032): sign/verify over edwards25519;
+- ChaCha20-Poly1305 (RFC 8439): the AEAD, one-shot per frame.
+
+Host-side session crypto only (handshakes + small gossip frames on a
+drill fleet); the wheel is preferred whenever present — noise.py falls
+back here only on ImportError.  Known-answer tests in
+tests/test_wire.py pin all three against the RFC vectors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+
+class InvalidSignature(Exception):
+    pass
+
+
+# --- ChaCha20-Poly1305 (RFC 8439) --------------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _chacha20_block(state16: list, out: bytearray, off: int) -> None:
+    x = list(state16)
+    for _ in range(10):
+        # column rounds
+        for a, b, c, d in ((0, 4, 8, 12), (1, 5, 9, 13),
+                           (2, 6, 10, 14), (3, 7, 11, 15),
+                           (0, 5, 10, 15), (1, 6, 11, 12),
+                           (2, 7, 8, 13), (3, 4, 9, 14)):
+            xa = (x[a] + x[b]) & _MASK32
+            xd = x[d] ^ xa
+            xd = ((xd << 16) | (xd >> 16)) & _MASK32
+            xc = (x[c] + xd) & _MASK32
+            xb = x[b] ^ xc
+            xb = ((xb << 12) | (xb >> 20)) & _MASK32
+            xa = (xa + xb) & _MASK32
+            xd ^= xa
+            xd = ((xd << 8) | (xd >> 24)) & _MASK32
+            xc = (xc + xd) & _MASK32
+            xb ^= xc
+            x[a], x[b], x[c], x[d] = (
+                xa, ((xb << 7) | (xb >> 25)) & _MASK32, xc, xd)
+    struct.pack_into("<16I", out, off,
+                     *((x[i] + state16[i]) & _MASK32 for i in range(16)))
+
+
+def _chacha20_xor(key: bytes, counter: int, nonce: bytes,
+                  data: bytes) -> bytes:
+    state = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+             *struct.unpack("<8I", key), counter,
+             *struct.unpack("<3I", nonce)]
+    n = len(data)
+    stream = bytearray((n + 63) & ~63)
+    for i in range(0, n, 64):
+        _chacha20_block(state, stream, i)
+        state[12] = (state[12] + 1) & _MASK32
+    return bytes(a ^ b for a, b in zip(data, stream))
+
+
+def _poly1305(key: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key[:16], "little") \
+        & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i:i + 16]
+        acc = ((acc + int.from_bytes(block, "little")
+                + (1 << (8 * len(block)))) * r) % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(data: bytes) -> bytes:
+    rem = len(data) % 16
+    return data + b"\x00" * (16 - rem) if rem else data
+
+
+class ChaCha20Poly1305:
+    """RFC 8439 AEAD construction with the ``cryptography`` call shape
+    (12-byte nonce, detached nothing — tag appended to the
+    ciphertext)."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _tag(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        otk = _chacha20_xor(self._key, 0, nonce, b"\x00" * 32)
+        mac_data = (_pad16(aad) + _pad16(ct)
+                    + struct.pack("<QQ", len(aad), len(ct)))
+        return _poly1305(otk, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        aad = aad or b""
+        ct = _chacha20_xor(self._key, 1, nonce, data)
+        return ct + self._tag(nonce, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        aad = aad or b""
+        if len(data) < 16:
+            raise InvalidSignature("short AEAD ciphertext")
+        ct, tag = data[:-16], data[-16:]
+        expect = self._tag(nonce, ct, aad)
+        # constant-time compare: session keys must not leak via timing
+        if not _ct_eq(tag, expect):
+            raise InvalidSignature("AEAD tag mismatch")
+        return _chacha20_xor(self._key, 1, nonce, ct)
+
+
+def _ct_eq(a: bytes, b: bytes) -> bool:
+    if len(a) != len(b):
+        return False
+    acc = 0
+    for x, y in zip(a, b):
+        acc |= x ^ y
+    return acc == 0
+
+
+# --- X25519 (RFC 7748) --------------------------------------------------------
+
+_P = (1 << 255) - 19
+_A24 = 121665
+
+
+def _x25519_scalarmult(k: bytes, u: bytes) -> bytes:
+    kn = int.from_bytes(k, "little")
+    kn &= ~7
+    kn &= (1 << 254) - 1
+    kn |= 1 << 254
+    x1 = int.from_bytes(u, "little") & ((1 << 255) - 1)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (kn >> t) & 1
+        if swap ^ kt:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % _P
+        aa = (a * a) % _P
+        b = (x2 - z2) % _P
+        bb = (b * b) % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = (d * a) % _P
+        cb = (c * b) % _P
+        x3 = (da + cb) % _P
+        x3 = (x3 * x3) % _P
+        z3 = (da - cb) % _P
+        z3 = (x1 * z3 * z3) % _P
+        x2 = (aa * bb) % _P
+        z2 = (e * (aa + _A24 * e)) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    return ((x2 * pow(z2, _P - 2, _P)) % _P).to_bytes(32, "little")
+
+
+class X25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("X25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, raw: bytes) -> "X25519PublicKey":
+        return cls(raw)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+
+class X25519PrivateKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("X25519 private key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_private_bytes(cls, raw: bytes) -> "X25519PrivateKey":
+        return cls(raw)
+
+    def public_key(self) -> X25519PublicKey:
+        base = (9).to_bytes(32, "little")
+        return X25519PublicKey(_x25519_scalarmult(self._raw, base))
+
+    def exchange(self, peer: X25519PublicKey) -> bytes:
+        out = _x25519_scalarmult(self._raw, peer.public_bytes_raw())
+        if out == b"\x00" * 32:
+            raise ValueError("X25519 exchange produced the zero point")
+        return out
+
+
+# --- Ed25519 (RFC 8032) -------------------------------------------------------
+
+_ED_D = (-121665 * pow(121666, _P - 2, _P)) % _P
+_ED_L = (1 << 252) + 27742317777372353535851937790883648493
+_ED_BY = (4 * pow(5, _P - 2, _P)) % _P
+_ED_BX = None  # recovered below
+_SQRT_M1 = pow(2, (_P - 1) // 4, _P)
+
+
+def _ed_recover_x(y: int, sign: int) -> int:
+    if y >= _P:
+        raise InvalidSignature("point y out of range")
+    x2 = ((y * y - 1) * pow(_ED_D * y * y + 1, _P - 2, _P)) % _P
+    if x2 == 0:
+        if sign:
+            raise InvalidSignature("invalid point compression")
+        return 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P != 0:
+        x = (x * _SQRT_M1) % _P
+    if (x * x - x2) % _P != 0:
+        raise InvalidSignature("not a curve point")
+    if (x & 1) != sign:
+        x = _P - x
+    return x
+
+
+_ED_BX = _ed_recover_x(_ED_BY, 0)
+_ED_B = (_ED_BX, _ED_BY, 1, (_ED_BX * _ED_BY) % _P)   # extended coords
+_ED_IDENT = (0, 1, 1, 0)
+
+
+def _ed_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = ((y1 - x1) * (y2 - x2)) % _P
+    b = ((y1 + x1) * (y2 + x2)) % _P
+    c = (2 * t1 * t2 * _ED_D) % _P
+    d = (2 * z1 * z2) % _P
+    e, f, g, h = (b - a) % _P, (d - c) % _P, (d + c) % _P, (b + a) % _P
+    return ((e * f) % _P, (g * h) % _P, (f * g) % _P, (e * h) % _P)
+
+
+def _ed_mul(s: int, p):
+    q = _ED_IDENT
+    while s > 0:
+        if s & 1:
+            q = _ed_add(q, p)
+        p = _ed_add(p, p)
+        s >>= 1
+    return q
+
+
+def _ed_compress(p) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, _P - 2, _P)
+    x, y = (x * zi) % _P, (y * zi) % _P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _ed_decompress(raw: bytes):
+    if len(raw) != 32:
+        raise InvalidSignature("Ed25519 point must be 32 bytes")
+    enc = int.from_bytes(raw, "little")
+    y = enc & ((1 << 255) - 1)
+    x = _ed_recover_x(y, enc >> 255)
+    return (x, y, 1, (x * y) % _P)
+
+
+def _ed_eq(p, q) -> bool:
+    # x1/z1 == x2/z2 and y1/z1 == y2/z2, avoided divisions
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return ((x1 * z2 - x2 * z1) % _P == 0
+            and (y1 * z2 - y2 * z1) % _P == 0)
+
+
+def _ed_secret_expand(seed: bytes) -> tuple:
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+class Ed25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("Ed25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, raw: bytes) -> "Ed25519PublicKey":
+        return cls(raw)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+    def verify(self, signature: bytes, data: bytes) -> None:
+        if len(signature) != 64:
+            raise InvalidSignature("Ed25519 signature must be 64 bytes")
+        a = _ed_decompress(self._raw)
+        r_raw, s_raw = signature[:32], signature[32:]
+        s = int.from_bytes(s_raw, "little")
+        if s >= _ED_L:
+            raise InvalidSignature("signature scalar out of range")
+        r = _ed_decompress(r_raw)
+        k = int.from_bytes(
+            hashlib.sha512(r_raw + self._raw + data).digest(),
+            "little") % _ED_L
+        # [s]B == R + [k]A
+        if not _ed_eq(_ed_mul(s, _ED_B), _ed_add(r, _ed_mul(k, a))):
+            raise InvalidSignature("Ed25519 verification failed")
+
+
+class Ed25519PrivateKey:
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("Ed25519 private key must be 32 bytes")
+        self._seed = bytes(seed)
+        a, self._prefix = _ed_secret_expand(self._seed)
+        self._a = a
+        self._pub = _ed_compress(_ed_mul(a, _ED_B))
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivateKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_private_bytes(cls, seed: bytes) -> "Ed25519PrivateKey":
+        return cls(seed)
+
+    def public_key(self) -> Ed25519PublicKey:
+        return Ed25519PublicKey(self._pub)
+
+    def sign(self, data: bytes) -> bytes:
+        r = int.from_bytes(
+            hashlib.sha512(self._prefix + data).digest(), "little") % _ED_L
+        r_enc = _ed_compress(_ed_mul(r, _ED_B))
+        k = int.from_bytes(
+            hashlib.sha512(r_enc + self._pub + data).digest(),
+            "little") % _ED_L
+        s = (r + k * self._a) % _ED_L
+        return r_enc + s.to_bytes(32, "little")
+
+
+__all__ = [
+    "ChaCha20Poly1305", "Ed25519PrivateKey", "Ed25519PublicKey",
+    "InvalidSignature", "X25519PrivateKey", "X25519PublicKey",
+]

@@ -34,6 +34,7 @@ plane stays in ops/).
 from __future__ import annotations
 
 import asyncio
+import errno
 import json
 import secrets
 import struct
@@ -52,6 +53,10 @@ REQUEST_TIMEOUT_S = 10.0
 MAX_FRAME = 16 * 1024 * 1024
 HANDSHAKE_TIMEOUT_S = 5.0
 MAX_HANDSHAKE_FRAME = 4096
+# fixed-port bind collisions (N nodes on one host racing a port range):
+# walk this many successive ports, then fall back to an ephemeral bind —
+# the caller reads the truth back from .listen_port either way
+PORT_BIND_RETRIES = 8
 
 # frame kinds
 K_HELLO = 0x01
@@ -195,6 +200,11 @@ class WireNode:
         # (peer_manager.accept_connection when a NetworkService attaches);
         # called with (peer_id, remote_ip) so IP-collated bans apply
         self.accept_peer: Callable[[str, str], bool] | None = None
+        # admin partition seam: peers in this set are refused at the
+        # HELLO door AND severed if live — the socket-level mirror of
+        # network/partition.PartitionSet (both sides of a severed pair
+        # carry the other, so neither direction can re-establish)
+        self._blocked: frozenset[str] = frozenset()
         # agent string advertised in HELLO (identify protocol analogue)
         from lighthouse_tpu import __version__ as _v
 
@@ -222,6 +232,24 @@ class WireNode:
             self.loop.close()
 
     async def _start_servers(self):
+        # fixed-port binds retry across successive ports before falling
+        # back to ephemeral: a multi-node-per-host fleet racing a port
+        # base must degrade to "a port", never to a dead node (the
+        # caller reads the outcome back from .listen_port)
+        port = self.listen_port
+        for attempt in range(PORT_BIND_RETRIES + 1):
+            try:
+                await self._bind_servers(port)
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or port == 0:
+                    raise
+                port = 0 if attempt >= PORT_BIND_RETRIES - 1 else port + 1
+        self.log.info("listening", tcp=self.listen_port,
+                      udp=self.listen_port)
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def _bind_servers(self, port: int):
         if self.transport == "quic":
             from lighthouse_tpu.network.wire import quic
 
@@ -229,21 +257,28 @@ class WireNode:
             # endpoint demuxes by magic byte and hands discovery
             # datagrams through the fallback
             self._server = await quic.start_listener(
-                self.listen_host, self.listen_port,
+                self.listen_host, port,
                 lambda r, w: asyncio.ensure_future(self._on_inbound(r, w)),
                 fallback=self._on_datagram)
             self.listen_port = self._server.port
             self._udp_transport = self._server._transport
         else:
             self._server = await asyncio.start_server(
-                self._on_inbound, self.listen_host, self.listen_port)
+                self._on_inbound, self.listen_host, port)
             self.listen_port = self._server.sockets[0].getsockname()[1]
-            self._udp_transport, _ = await self.loop.create_datagram_endpoint(
-                lambda: _UdpProtocol(self),
-                local_addr=(self.listen_host, self.listen_port))
-        self.log.info("listening", tcp=self.listen_port,
-                      udp=self.listen_port)
-        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+            try:
+                self._udp_transport, _ = (
+                    await self.loop.create_datagram_endpoint(
+                        lambda: _UdpProtocol(self),
+                        local_addr=(self.listen_host, self.listen_port)))
+            except OSError:
+                # TCP landed but the matching UDP port is taken: the
+                # pair binds together or not at all (discovery and
+                # streams advertise ONE port)
+                self._server.close()
+                await self._server.wait_closed()
+                self._server = None
+                raise
 
     def stop(self):
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -254,14 +289,24 @@ class WireNode:
             if getattr(self, "_hb_task", None) is not None:
                 self._hb_task.cancel()
             for conn in list(self._conns.values()):
+                # abort, not close: RST hits the OS socket now, so a
+                # peer observes the departure even though this loop is
+                # about to die (close() only schedules the FIN, and a
+                # stopped loop would never flush it)
                 try:
-                    conn.writer.close()
-                except Exception as e:
-                    record_swallowed("wire.shutdown_close", e)
+                    conn.writer.transport.abort()
+                except Exception:
+                    try:
+                        conn.writer.close()
+                    except Exception as e:
+                        record_swallowed("wire.shutdown_close", e)
             if self._server is not None:
                 self._server.close()
             if self._udp_transport is not None:
                 self._udp_transport.close()
+            # one breath for the scheduled connection_lost callbacks to
+            # actually release the fds before the loop halts
+            await asyncio.sleep(0.05)
             self.loop.stop()
 
         try:
@@ -443,10 +488,13 @@ class WireNode:
             if pid != noise.peer_id_of(ipub):
                 raise RpcError("peer id does not match identity key")
             peer_host = conn.writer.get_extra_info("peername")[0]
-            if self.accept_peer is not None \
-                    and not self.accept_peer(pid, peer_host):
+            if pid in self._blocked or (
+                    self.accept_peer is not None
+                    and not self.accept_peer(pid, peer_host)):
                 # refuse BEFORE exposing peer_id: the dialer's connect()
-                # polls conn.peer_id as its success signal
+                # polls conn.peer_id as its success signal.  The blocked
+                # set rides the same gate — a partitioned peer's redial
+                # dies exactly like a banned one's
                 conn.alive = False
                 conn.writer.close()
                 return
@@ -946,6 +994,20 @@ class WireNode:
                 record_swallowed("wire.disconnect_close", e)
 
         asyncio.run_coroutine_threadsafe(_close(), self.loop)
+
+    def set_blocked_peers(self, peers) -> None:
+        """Install the admin partition set (PartitionSet semantics over
+        sockets): every peer id in ``peers`` is refused at the HELLO
+        door and any live connection to it is severed now.  An empty
+        set heals.  Symmetry is the caller's job — the fleet admin
+        installs each side of a severed pair on BOTH processes."""
+        self._blocked = frozenset(str(p) for p in peers)
+        for pid in self._blocked:
+            self.disconnect(pid)
+
+    @property
+    def blocked_peers(self) -> frozenset:
+        return self._blocked
 
     @property
     def peers(self) -> list[str]:

@@ -223,6 +223,10 @@ class HttpSource(NodeScrapeSource):
 
     transport = "http"
     guarded = True
+    #: True when each scraped node owns its OWN flight ring (separate
+    #: processes): ring seqs then collide across nodes and the observer
+    #: dedups/tags per serving node.  In-sim all nodes share one ring.
+    per_node_rings = False
 
     def __init__(self, urls: dict):
         #: node name -> base url ("http://127.0.0.1:<port>")
@@ -438,7 +442,7 @@ class FleetObserver:
             self._mark_reachable(name)
         flt = obs.get("flight") or {}
         self._cursors[name] = int(flt.get("seq") or cursor)
-        self._ingest_events(flt.get("events") or ())
+        self._ingest_events(flt.get("events") or (), scraped_from=name)
         return obs
 
     def _mark_unreachable(self, name: str, fails: int, err) -> None:
@@ -452,24 +456,34 @@ class FleetObserver:
         reach.state = "reachable"
         flight.emit("node_reachable", node=name)
 
-    def _ingest_events(self, events) -> None:
+    def _ingest_events(self, events, scraped_from: str | None = None) -> None:
         """Fold one scrape's flight tail into the merged event store
         (pull transports; the direct transport reads the live ring).
-        Nodes share the process ring in-sim, so dedup by seq."""
+        Nodes share the process ring in-sim, so dedup by seq; a
+        process fleet has one ring PER node (``per_node_rings`` on the
+        source), where seqs collide across nodes — there the dedup key
+        carries the serving node and each event is tagged with it."""
         if self.source.transport == "direct":
             return
+        per_node = getattr(self.source, "per_node_rings", False)
         for e in events:
             seq = int(e.get("seq", 0))
-            if seq in self._event_seqs:
+            key = (scraped_from, seq) if per_node else seq
+            if key in self._event_seqs:
                 continue
-            self._event_seqs.add(seq)
-            self._events.append(dict(e))
+            self._event_seqs.add(key)
+            e = dict(e)
+            if per_node and scraped_from is not None:
+                e.setdefault("node", scraped_from)
+            self._events.append(e)
         if len(self._events) > self._MAX_EVENTS:
             self._events.sort(key=lambda e: e.get("seq", 0))
             dropped = self._events[:-self._MAX_EVENTS]
             del self._events[:-self._MAX_EVENTS]
             self._event_seqs.difference_update(
-                int(e.get("seq", 0)) for e in dropped)
+                ((e.get("node"), int(e.get("seq", 0))) if per_node
+                 else int(e.get("seq", 0)))
+                for e in dropped)
 
     # -- the per-slot observation -------------------------------------------
 

@@ -190,17 +190,23 @@ def resolve_epoch_backend(n_validators: int) -> str:
     if n_validators < max(device_min, 1):
         return "reference"
     global _AUTO_RUNG
-    if _AUTO_RUNG is None:
+    rung = _AUTO_RUNG
+    if rung is None:
         # probing the platform imports jax (multi-second XLA init on a
-        # cold process); memoize so a CPU-fallback node pays it once,
-        # not on every large committee shuffle in the worker threads
-        import jax
+        # cold process); memoize under the lock so concurrent thread
+        # roots (worker threads, the interop duty loop) pay it once —
+        # the losers block on the winner instead of double-probing
+        with _BREAKER_LOCK:
+            if _AUTO_RUNG is None:
+                import jax
 
-        if jax.devices()[0].platform != "tpu":
-            _AUTO_RUNG = "reference"
-        else:
-            _AUTO_RUNG = "sharded" if len(jax.devices()) > 1 else "device"
-    return _AUTO_RUNG
+                if jax.devices()[0].platform != "tpu":
+                    _AUTO_RUNG = "reference"
+                else:
+                    _AUTO_RUNG = ("sharded" if len(jax.devices()) > 1
+                                  else "device")
+            rung = _AUTO_RUNG
+    return rung
 
 
 def _breaker_ok() -> None:

@@ -114,6 +114,52 @@ class TestDiscoveryProtocol:
         assert len(d.table) == 0
 
 
+class TestMalformedRecords:
+    """Every byte of a remote's discovery answer is untrusted: the
+    chaos soak's malformed peer plane XORs response prefixes (rpc.py
+    PeerFaultPlan), and a crashed lookup on a mangled chunk took the
+    whole node down with it (caught by bench --child-socksoak)."""
+
+    @staticmethod
+    def _mangle(raw: bytes) -> bytes:
+        # the exact corruption the fault plane applies
+        return bytes(b ^ 0xA5 for b in raw[:16]) + raw[16:]
+
+    def test_mangled_findnode_chunks_dropped(self):
+        from lighthouse_tpu.network.discovery import P_DISCOVERY_FINDNODE
+
+        fabric = NetworkFabric()
+        d = Discovery(fabric.rpc.join("solo"), Enr(peer_id="solo"))
+        good = Enr(peer_id="honest").to_bytes()
+        evil = fabric.rpc.join("evil")
+        evil.register(
+            P_DISCOVERY_FINDNODE,
+            lambda src, data: [self._mangle(good), b"\xa5", b"[]", good])
+        found = d.find_node("evil", b"\x00" * 32)
+        # the honest record survives; the garbage costs only itself
+        assert [e.peer_id for e in found] == ["honest"]
+
+    def test_mangled_ping_reply_returns_none(self):
+        from lighthouse_tpu.network.discovery import P_DISCOVERY_PING
+
+        fabric = NetworkFabric()
+        d = Discovery(fabric.rpc.join("solo"), Enr(peer_id="solo"))
+        evil = fabric.rpc.join("evil")
+        evil.register(P_DISCOVERY_PING,
+                      lambda src, data: [b"\xa5\xa5 garbage"])
+        assert d.ping("evil") is None
+        assert len(d.table) == 0
+
+    def test_serve_ping_tolerates_mangled_request(self):
+        fabric = NetworkFabric()
+        d = Discovery(fabric.rpc.join("solo"), Enr(peer_id="solo"))
+        # the reply carries OUR record regardless of the caller's bytes
+        reply = d._serve_ping("evil", self._mangle(
+            Enr(peer_id="evil").to_bytes()))
+        assert Enr.from_bytes(reply[0]).peer_id == "solo"
+        assert len(d.table) == 0
+
+
 class TestConcurrentTable:
     def test_concurrent_pings_and_lookups(self):
         """Regression pin for the lhrace fix: the routing table is

@@ -1,0 +1,155 @@
+"""Process fleet (ISSUE 19): real-signal lifecycle semantics, orphan
+hygiene, and the HTTP-only observation plane's dead-socket behavior.
+
+The lifecycle tests launch REAL ``cli.py bn`` child processes — the
+same path ``bench.py --child-socksoak`` drives — so they pin the
+out-of-the-sandbox semantics nothing in-process can: a genuine SIGTERM
+runs the cli handler to an orderly ``Client.stop()`` (clean dirty
+marker on disk, exit code 0), a genuine SIGKILL leaves the marker dirty
+and the relaunch walks the startup repair sweep to a non-"fresh"
+resume.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from lighthouse_tpu.fleet import FleetError, ProcessFleet
+
+
+def _dirty_marker(datadir: str) -> bytes | None:
+    """Read the store's crash marker straight off the child's disk
+    (only safe once the child is dead — the fleet waits on the pid)."""
+    from lighthouse_tpu.store.kv import NativeKVStore
+    from lighthouse_tpu.store.migrations import K_DIRTY
+
+    db = NativeKVStore(os.path.join(datadir, "hot.db"))
+    try:
+        return db.get(K_DIRTY)
+    finally:
+        close = getattr(db, "close", None)
+        if close is not None:
+            close()
+
+
+class TestSignalLifecycle:
+    def test_sigterm_clean_sigkill_dirty(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LHTPU_AOT_STORE", "0")
+        fleet = ProcessFleet(1, str(tmp_path), slot_seconds=2,
+                             max_run_seconds=240)
+        try:
+            fleet.launch()
+            node = fleet.nodes[0]
+            assert node.state == "up" and node.peer_id
+
+            # orderly SIGTERM: the cli handler drives Client.stop() —
+            # exit code 0 and the dirty marker flipped back to clean
+            rc = fleet.stop("node-0")
+            assert rc == 0
+            assert _dirty_marker(node.datadir) == b"clean"
+
+            # relaunch over the surviving datadir: a clean close
+            # resumes from the persisted frame, never genesis
+            fleet.restart("node-0")
+            mode = fleet.wait_until(
+                lambda: fleet.resume_mode("node-0"), 15,
+                "resume_mode scrape after clean stop")
+            assert mode in ("snapshot", "rebuilt")
+
+            # genuine SIGKILL: no handler runs, the marker stays dirty
+            fleet.kill("node-0")
+            assert node.state == "down"
+            assert _dirty_marker(node.datadir) == b"dirty"
+
+            # the relaunch walks the repair sweep and still comes back
+            # non-"fresh" — the chain survives the crash
+            fleet.restart("node-0")
+            mode = fleet.wait_until(
+                lambda: fleet.resume_mode("node-0"), 15,
+                "resume_mode scrape after SIGKILL")
+            assert mode in ("snapshot", "rebuilt")
+        finally:
+            fleet.shutdown()
+
+
+class TestOrphanHygiene:
+    def test_failed_launch_leaves_no_survivors(self, tmp_path,
+                                               monkeypatch):
+        """Launch failure of node k tears down nodes 0..k-1: after the
+        raise, not one child pid is alive."""
+        monkeypatch.setenv("LHTPU_AOT_STORE", "0")
+        fleet = ProcessFleet(
+            2, str(tmp_path), slot_seconds=2, max_run_seconds=120,
+            # node 1 dies at argparse — a launch failure mid-fleet
+            extra_args={1: ("--definitely-not-a-flag",)})
+        with pytest.raises(FleetError):
+            fleet.launch()
+        pids = [n.pid for n in fleet.nodes if n.pid is not None]
+        assert pids, "node 0 must have launched before node 1 failed"
+        deadline = time.time() + 15
+        for pid in pids:
+            while time.time() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break                  # gone (reaped by the fleet)
+                time.sleep(0.2)
+            else:
+                pytest.fail(f"pid {pid} survived the failed launch")
+        assert all(n.state == "down" for n in fleet.nodes)
+
+
+class _StubNode:
+    state = "up"
+
+    def __init__(self, name):
+        self.name = name
+
+
+class _StubNet:
+    def __init__(self, names):
+        self.nodes = [_StubNode(n) for n in names]
+
+    @property
+    def live_nodes(self):
+        return [n for n in self.nodes if n.state == "up"]
+
+
+def _refused_port() -> int:
+    """A port nothing listens on: bind, read it back, close."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestHttpSourceDeadSocket:
+    def test_observe_connection_refused_raises(self):
+        from lighthouse_tpu.simulator import HttpSource
+
+        src = HttpSource({"node-0": f"http://127.0.0.1:{_refused_port()}"})
+        with pytest.raises(Exception):
+            src.observe(_StubNode("node-0"), since_seq=0, deadline_s=1.0)
+
+    def test_observer_classifies_unreachable_never_phantom(self,
+                                                           monkeypatch):
+        """Connection-refused scrapes exhaust the discipline budget and
+        degrade the node to ``unreachable`` — it never contributes a
+        head class, so a dead socket cannot manufacture a fleet split."""
+        monkeypatch.setenv("LHTPU_SCRAPE_UNREACHABLE_AFTER", "2")
+        monkeypatch.setenv("LHTPU_SCRAPE_RETRIES", "0")
+        monkeypatch.setenv("LHTPU_SCRAPE_DEADLINE_S", "1")
+        from lighthouse_tpu.simulator import FleetObserver, HttpSource
+
+        net = _StubNet(["node-0"])
+        src = HttpSource({"node-0": f"http://127.0.0.1:{_refused_port()}"})
+        obs = FleetObserver(net, source=src)
+        for slot in range(3):
+            snap = obs.snapshot(slot)
+            # every scrape failed -> no observations -> no snapshot,
+            # and therefore no phantom head class either
+            assert snap is None
+        assert obs._reach["node-0"].state == "unreachable"

@@ -278,6 +278,187 @@ class TestSnappyCompression:
         assert snappy.frame_decompress(framed) == data
 
 
+class TestPeerDeath:
+    def test_peer_death_detected_and_reconnect(self):
+        """A peer dying (socket torn, no goodbye) must drop out of the
+        survivor's peer list, and a fresh node is dialable afterwards —
+        the unit-level shape of the fleet's SIGKILL + relaunch cycle."""
+        a, b = _mk_node("PDA"), _mk_node("PDB")
+        try:
+            a.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: b.peer_id in a.peers)
+            b.stop()                      # dead socket: no goodbye frame
+            assert _wait(lambda: b.peer_id not in a.peers)
+            # the survivor keeps serving: a reborn peer dials right in
+            c = _mk_node("PDC")
+            try:
+                a.connect("127.0.0.1", c.listen_port)
+                assert _wait(lambda: c.peer_id in a.peers)
+                got = []
+                a.subscribe("topic/pd", lambda t, d, s: got.append(d))
+                c.publish("topic/pd", b"alive")
+                assert _wait(lambda: got == [b"alive"])
+            finally:
+                c.stop()
+        finally:
+            a.stop(), b.stop()
+
+    def test_request_to_dead_peer_raises(self):
+        from lighthouse_tpu.network.rpc import RpcError
+
+        a, b = _mk_node("PDD"), _mk_node("PDE")
+        try:
+            b.register_rpc("/test/echo/1", lambda src, data: [data])
+            a.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: b.peer_id in a.peers)
+            b.stop()
+            assert _wait(lambda: b.peer_id not in a.peers)
+            with pytest.raises(RpcError):
+                a.request(b.peer_id, "/test/echo/1", b"ping")
+        finally:
+            a.stop(), b.stop()
+
+
+class TestBlockedPeers:
+    def test_blocked_peer_severed_and_refused_then_healed(self):
+        """The admin partition seam: set_blocked_peers severs the live
+        connection, refuses the redial at the HELLO door, and an empty
+        set heals — the socket-level PartitionSet the process fleet's
+        ``partition()`` installs on both sides of every severed pair."""
+        a, b = _mk_node("BPA"), _mk_node("BPB")
+        try:
+            a.connect("127.0.0.1", b.listen_port)
+            assert _wait(lambda: b.peer_id in a.peers)
+            a.set_blocked_peers({b.peer_id})
+            assert a.blocked_peers == frozenset({b.peer_id})
+            assert _wait(lambda: b.peer_id not in a.peers)   # severed
+            try:                                             # redial refused
+                b.connect("127.0.0.1", a.listen_port)
+            except Exception:
+                pass
+            import time as _t
+            _t.sleep(0.3)
+            assert b.peer_id not in a.peers
+            a.set_blocked_peers(set())                       # heal
+            b.connect("127.0.0.1", a.listen_port)
+            assert _wait(lambda: b.peer_id in a.peers)
+        finally:
+            a.stop(), b.stop()
+
+
+class TestPureCrypto:
+    """Known-answer tests pinning network/wire/purecrypto against the
+    RFC vectors (the fallback backend noise.py imports when the
+    `cryptography` wheel is absent — as in the fleet containers)."""
+
+    def test_x25519_rfc7748_scalarmult_vector(self):
+        from lighthouse_tpu.network.wire import purecrypto as pc
+
+        k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                          "62144c0ac1fc5a18506a2244ba449ac4")
+        u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                          "726624ec26b3353b10a903a6d0ab1c4c")
+        out = pc.X25519PrivateKey.from_private_bytes(k).exchange(
+            pc.X25519PublicKey.from_public_bytes(u))
+        assert out == bytes.fromhex(
+            "c3da55379de9c6908e94ea4df28d084f"
+            "32eccf03491c71f754b4075577a28552")
+
+    def test_x25519_rfc7748_diffie_hellman(self):
+        from lighthouse_tpu.network.wire import purecrypto as pc
+
+        a = pc.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+            "77076d0a7318a57d3c16c17251b26645"
+            "df4c2f87ebc0992ab177fba51db92c2a"))
+        b = pc.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+            "5dab087e624a8a4b79e17f8b83800ee6"
+            "6f3bb1292618b6fd1c2f8b27ff88e0eb"))
+        a_pub = a.public_key().public_bytes_raw()
+        b_pub = b.public_key().public_bytes_raw()
+        assert a_pub == bytes.fromhex(
+            "8520f0098930a754748b7ddcb43ef75a"
+            "0dbf3a0d26381af4eba4a98eaa9b4e6a")
+        assert b_pub == bytes.fromhex(
+            "de9edb7d7b7dc1b4d35b61c2ece43537"
+            "3f8343c85b78674dadfc7e146f882b4f")
+        shared = bytes.fromhex("4a5d9d5ba4ce2de1728e3bf480350f25"
+                               "e07e21c947d19e3376f09b3c1e161742")
+        assert a.exchange(pc.X25519PublicKey.from_public_bytes(
+            b_pub)) == shared
+        assert b.exchange(pc.X25519PublicKey.from_public_bytes(
+            a_pub)) == shared
+
+    def test_ed25519_rfc8032_vector(self):
+        from lighthouse_tpu.network.wire import purecrypto as pc
+
+        sk = bytes.fromhex("c5aa8df43f9f837bedb7442f31dcb7b1"
+                           "66d38535076f094b85ce3a2e0b4458f7")
+        pk = bytes.fromhex("fc51cd8e6218a1a38da47ed00230f058"
+                           "0816ed13ba3303ac5deb911548908025")
+        msg = bytes.fromhex("af82")
+        sig = bytes.fromhex(
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7"
+            "db5ac3ac18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28d"
+            "c027beceea1ec40a")
+        priv = pc.Ed25519PrivateKey.from_private_bytes(sk)
+        assert priv.public_key().public_bytes_raw() == pk
+        assert priv.sign(msg) == sig
+        pub = pc.Ed25519PublicKey.from_public_bytes(pk)
+        pub.verify(sig, msg)             # no raise = valid
+        with pytest.raises(pc.InvalidSignature):
+            pub.verify(sig, msg + b"!")
+        with pytest.raises(pc.InvalidSignature):
+            pub.verify(sig[:-1] + bytes([sig[-1] ^ 1]), msg)
+
+    def test_chacha20poly1305_rfc8439_vector(self):
+        from lighthouse_tpu.network.wire import purecrypto as pc
+
+        key = bytes(range(0x80, 0xa0))
+        nonce = bytes.fromhex("070000004041424344454647")
+        aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+        pt = (b"Ladies and Gentlemen of the class of '99: If I could "
+              b"offer you only one tip for the future, sunscreen would "
+              b"be it.")
+        want_ct = bytes.fromhex(
+            "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a7"
+            "36ee62d63dbea45e8ca9671282fafb69da92728b1a71de0a9e060b29"
+            "05d6a5b67ecd3b3692ddbd7f2d778b8c9803aee328091b58fab324e4"
+            "fad675945585808b4831d7bc3ff4def08e4b7a9de576d26586cec64b"
+            "6116")
+        want_tag = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+        aead = pc.ChaCha20Poly1305(key)
+        sealed = aead.encrypt(nonce, pt, aad)
+        assert sealed == want_ct + want_tag
+        assert aead.decrypt(nonce, sealed, aad) == pt
+        with pytest.raises(Exception):
+            aead.decrypt(nonce, sealed[:-1] + bytes([sealed[-1] ^ 1]),
+                         aad)
+        with pytest.raises(Exception):
+            aead.decrypt(nonce, sealed, aad + b"x")
+
+    def test_noise_handshake_on_pure_backend(self):
+        """The full XX handshake + transport round-trip driven directly
+        on the purecrypto primitives (regardless of which backend
+        noise.py picked at import)."""
+        from lighthouse_tpu.network.wire import noise as n
+        from lighthouse_tpu.network.wire import purecrypto as pc
+
+        init = n.NoiseXX(initiator=True,
+                         static=pc.X25519PrivateKey.generate())
+        resp = n.NoiseXX(initiator=False,
+                         static=pc.X25519PrivateKey.generate())
+        resp.read_msg1(init.write_msg1())
+        init.read_msg2(resp.write_msg2())
+        resp.read_msg3(init.write_msg3())
+        i_send, i_recv, i_h = init.finalize()
+        r_send, r_recv, r_h = resp.finalize()
+        assert i_h == r_h
+        ct = i_send.encrypt_with_ad(b"", b"over the wire")
+        assert r_recv.decrypt_with_ad(b"", ct) == b"over the wire"
+        ct2 = r_send.encrypt_with_ad(b"", b"and back")
+        assert i_recv.decrypt_with_ad(b"", ct2) == b"and back"
+
+
 class TestConcurrentTopicTable:
     def test_concurrent_subscribe_vs_hello_snapshot(self):
         """Regression pin for the lhrace fix: subscribe/unsubscribe

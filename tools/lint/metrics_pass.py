@@ -90,6 +90,12 @@ FAMILY_OWNERS = {
     # edge counts, the simulator the node stop/kill/restart lifecycle
     "chaos_": "lighthouse_tpu/chain/chaos.py",
     "node_lifecycle_": "lighthouse_tpu/simulator.py",
+    # the process fleet (ISSUE 19): child-process lifecycle counters
+    # live with the fleet, its chaos-plan edges with the fleet
+    # controller (longest matching prefix wins, so these carve
+    # sub-families out of the simulator-owned fleet_* space)
+    "fleet_proc_": "lighthouse_tpu/fleet/fleet.py",
+    "fleet_chaos_": "lighthouse_tpu/fleet/chaos.py",
     # the unified MSM plane (ISSUE 17) owns its routing gauges
     "msm_": "lighthouse_tpu/ops/msm.py",
 }
@@ -169,14 +175,19 @@ def _cross_checks(regs, errors) -> None:
         if len(modules) > 1:
             errors.append(
                 f"{name}: registered from multiple modules {modules}")
-        for prefix, owner in FAMILY_OWNERS.items():
-            if name.startswith(prefix):
-                outside = [m for m in modules
-                           if not m.replace("\\", "/").endswith(owner)]
-                if outside:
-                    errors.append(
-                        f"{name}: family {prefix}* is owned by {owner}, "
-                        f"but registered from {outside}")
+        # most-specific family wins: a name matching several prefixes
+        # (fleet_proc_* under fleet_*) answers only to the longest one,
+        # so sub-families can carve ownership out of a broader family
+        matches = [p for p in FAMILY_OWNERS if name.startswith(p)]
+        if matches:
+            prefix = max(matches, key=len)
+            owner = FAMILY_OWNERS[prefix]
+            outside = [m for m in modules
+                       if not m.replace("\\", "/").endswith(owner)]
+            if outside:
+                errors.append(
+                    f"{name}: family {prefix}* is owned by {owner}, "
+                    f"but registered from {outside}")
 
 
 _LOC_RE = re.compile(r"^(?P<file>[^:]+\.py):(?P<line>\d+): (?P<msg>.*)$",
